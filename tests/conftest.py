@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Tests exercise multi-chip sharding on a virtual CPU mesh (the driver
+dry-runs the real multi-chip path separately); set
+VENEUR_TPU_TEST_REAL=1 to run the suite against real devices instead.
+This must run before jax is imported anywhere.
+"""
+
+import os
+
+if not os.environ.get("VENEUR_TPU_TEST_REAL"):
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        "--xla_force_host_platform_device_count=8 "
+        + os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")

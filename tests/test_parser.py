@@ -1,0 +1,280 @@
+"""DogStatsD parser tests.
+
+Mirrors the malformed-packet coverage of the reference's
+samplers/parser_test.go against our parser.
+"""
+
+import pytest
+
+from veneur_tpu.core.metrics import MetricScope
+from veneur_tpu.protocol.dogstatsd import (
+    ParseError,
+    parse_event,
+    parse_metric,
+    parse_metric_ssf,
+    parse_service_check,
+    EVENT_HOSTNAME_TAG_KEY,
+    EVENT_PRIORITY_TAG_KEY,
+    EVENT_ALERT_TYPE_TAG_KEY,
+)
+from veneur_tpu import ssf
+from veneur_tpu.utils.hashing import fnv1a_32_str, fnv1a_32
+
+
+def test_fnv1a_known_vectors():
+    # Standard FNV-1a 32-bit test vectors.
+    assert fnv1a_32(b"") == 2166136261
+    assert fnv1a_32(b"a") == 0xE40C292C
+    assert fnv1a_32(b"foobar") == 0xBF9CF968
+
+
+def test_basic_counter():
+    m = parse_metric(b"a.b.c:1|c")
+    assert m.name == "a.b.c"
+    assert m.type == "counter"
+    assert m.value == 1.0
+    assert m.sample_rate == 1.0
+    assert m.tags == []
+    assert m.scope == MetricScope.MIXED
+    h = fnv1a_32_str("a.b.c")
+    h = fnv1a_32_str("counter", h)
+    h = fnv1a_32_str("", h)
+    assert m.digest == h
+
+
+def test_types():
+    assert parse_metric(b"x:1|g").type == "gauge"
+    assert parse_metric(b"x:1|ms").type == "timer"
+    assert parse_metric(b"x:1|h").type == "histogram"
+    assert parse_metric(b"x:1|d").type == "histogram"
+    assert parse_metric(b"x:foo|s").type == "set"
+    assert parse_metric(b"x:foo|s").value == "foo"
+    with pytest.raises(ParseError):
+        parse_metric(b"x:1|z")
+
+
+def test_tags_sorted_and_joined():
+    m = parse_metric(b"foo:1|c|#b:2,a:1,c")
+    assert m.tags == ["a:1", "b:2", "c"]
+    assert m.joined_tags == "a:1,b:2,c"
+    # digest covers sorted joined tags
+    h = fnv1a_32_str("foo")
+    h = fnv1a_32_str("counter", h)
+    h = fnv1a_32_str("a:1,b:2,c", h)
+    assert m.digest == h
+
+
+def test_magic_scope_tags():
+    m = parse_metric(b"foo:1|c|#veneurlocalonly,a:1")
+    assert m.scope == MetricScope.LOCAL_ONLY
+    assert m.tags == ["a:1"]
+
+    m = parse_metric(b"foo:1|c|#veneurglobalonly,a:1")
+    assert m.scope == MetricScope.GLOBAL_ONLY
+    assert m.tags == ["a:1"]
+
+    # prefix match (e.g. veneurglobalonly:true) also triggers
+    m = parse_metric(b"foo:1|c|#veneurglobalonly:true")
+    assert m.scope == MetricScope.GLOBAL_ONLY
+    assert m.tags == []
+
+
+def test_sample_rate():
+    m = parse_metric(b"foo:1|c|@0.1")
+    assert abs(m.sample_rate - 0.1) < 1e-9
+    with pytest.raises(ParseError):
+        parse_metric(b"foo:1|c|@0")
+    with pytest.raises(ParseError):
+        parse_metric(b"foo:1|c|@1.5")
+    with pytest.raises(ParseError):
+        parse_metric(b"foo:1|c|@-0.5")
+    with pytest.raises(ParseError):
+        parse_metric(b"foo:1|c|@bar")
+    with pytest.raises(ParseError):
+        parse_metric(b"foo:1|c|@0.1|@0.2")
+
+
+def test_malformed_packets():
+    cases = [
+        b"foo",  # no colon
+        b":1|c",  # empty name
+        b"foo:1",  # no type
+        b"foo:1||",  # empty type
+        b"foo:1|g|",  # trailing pipe
+        b"foo:1|c||@0.1",  # empty section
+        b"foo:bar|c",  # bad value
+        b"foo:nan|c",  # NaN value
+        b"foo:inf|c",  # Inf
+        b"foo:-inf|c",  # -Inf
+        b"foo:1|c|x",  # unknown section
+        b"foo:1|c|#a:1|#b:2",  # multiple tag sections
+        b"foo:1 |c",  # whitespace in value
+        b"foo:1_0|c",  # underscore not a valid float
+    ]
+    for packet in cases:
+        with pytest.raises(ParseError):
+            parse_metric(packet)
+
+
+def test_value_forms():
+    assert parse_metric(b"x:1.5|g").value == 1.5
+    assert parse_metric(b"x:-1.5|g").value == -1.5
+    assert parse_metric(b"x:1e3|g").value == 1000.0
+    assert parse_metric(b"x:+4|g").value == 4.0
+
+
+# ---------------------------------------------------------------------------
+# Events
+
+
+def test_basic_event():
+    e = parse_event(b"_e{5,4}:title|text")
+    assert e.name == "title"
+    assert e.message == "text"
+
+
+def test_event_newline_unescape():
+    # length counts the raw (escaped) bytes, before \n unescaping
+    e = parse_event(b"_e{5,10}:title|text\\nmore")
+    assert e.message == "text\nmore"
+
+
+def test_event_sections():
+    e = parse_event(
+        b"_e{5,4}:title|text|d:1136239445|h:myhost|p:low|t:warning|#tag1:v,tag2"
+    )
+    assert e.timestamp == 1136239445
+    assert e.tags[EVENT_HOSTNAME_TAG_KEY] == "myhost"
+    assert e.tags[EVENT_PRIORITY_TAG_KEY] == "low"
+    assert e.tags[EVENT_ALERT_TYPE_TAG_KEY] == "warning"
+    assert e.tags["tag1"] == "v"
+    assert e.tags["tag2"] == ""
+
+
+def test_event_malformed():
+    cases = [
+        b"_e{5,4}title|text",  # no colon
+        b"_e5,4:title|text",  # no braces
+        b"_e{54}:title|text",  # no comma
+        b"_e{x,4}:title|text",  # bad title len
+        b"_e{5,x}:title|text",  # bad text len
+        b"_e{0,4}:|text",  # zero title len
+        b"_e{5,0}:title|",  # zero text len
+        b"_e{6,4}:title|text",  # mismatched title len
+        b"_e{5,5}:title|text",  # mismatched text len
+        b"_e{5,4}:title",  # no text
+        b"_e{5,4}:title|text|p:urgent",  # bad priority
+        b"_e{5,4}:title|text|t:fatal",  # bad alert
+        b"_e{5,4}:title|text|d:xyz",  # bad date
+        b"_e{5,4}:title|text|q:what",  # unknown section
+        b"_e{5,4}:title|text||",  # empty section
+        b"_e{5,4}:title|text|d:1|d:2",  # repeated section
+    ]
+    for packet in cases:
+        with pytest.raises(ParseError):
+            parse_event(packet)
+
+
+# ---------------------------------------------------------------------------
+# Service checks
+
+
+def test_basic_service_check():
+    m = parse_service_check(b"_sc|my.service|0")
+    assert m.name == "my.service"
+    assert m.type == "status"
+    assert m.value == ssf.SSFStatus.OK
+
+
+def test_service_check_statuses():
+    assert parse_service_check(b"_sc|x|1").value == ssf.SSFStatus.WARNING
+    assert parse_service_check(b"_sc|x|2").value == ssf.SSFStatus.CRITICAL
+    assert parse_service_check(b"_sc|x|3").value == ssf.SSFStatus.UNKNOWN
+    with pytest.raises(ParseError):
+        parse_service_check(b"_sc|x|4")
+
+
+def test_service_check_sections():
+    m = parse_service_check(
+        b"_sc|svc|2|d:1136239445|h:host1|#a:1,b:2|m:it \\nbroke"
+    )
+    assert m.timestamp == 1136239445
+    assert m.hostname == "host1"
+    assert m.tags == ["a:1", "b:2"]
+    assert m.message == "it \nbroke"
+
+
+def test_service_check_message_must_be_last():
+    with pytest.raises(ParseError):
+        parse_service_check(b"_sc|svc|2|m:broke|h:host1")
+
+
+def test_service_check_magic_tags_exact_match():
+    m = parse_service_check(b"_sc|svc|0|#veneurlocalonly,a:1")
+    assert m.scope == MetricScope.LOCAL_ONLY
+    assert m.tags == ["a:1"]
+    # prefix forms do NOT trigger for service checks (exact match required)
+    m = parse_service_check(b"_sc|svc|0|#veneurlocalonly:true")
+    assert m.scope == MetricScope.MIXED
+    assert m.tags == ["veneurlocalonly:true"]
+
+
+def test_service_check_malformed():
+    cases = [
+        b"_scx|svc|0",
+        b"_sc||0",
+        b"_sc|svc",
+        b"_sc|svc|0|",
+        b"_sc|svc|0|q:unknown",
+        b"_sc|svc|0|d:xyz",
+    ]
+    for packet in cases:
+        with pytest.raises(ParseError):
+            parse_service_check(packet)
+
+
+# ---------------------------------------------------------------------------
+# SSF sample conversion
+
+
+def test_parse_metric_ssf():
+    s = ssf.count("my.counter", 2, {"b": "2", "a": "1"})
+    m = parse_metric_ssf(s)
+    assert m.name == "my.counter"
+    assert m.type == "counter"
+    assert m.value == 2.0
+    assert m.tags == ["a:1", "b:2"]
+    assert m.joined_tags == "a:1,b:2"
+
+
+def test_parse_metric_ssf_scope_tags():
+    s = ssf.gauge("g", 1, {"veneurglobalonly": "true", "x": "y"})
+    m = parse_metric_ssf(s)
+    assert m.scope == MetricScope.GLOBAL_ONLY
+    assert m.tags == ["x:y"]
+
+    s = ssf.gauge("g", 1, {"veneurlocalonly": "", "x": "y"})
+    m = parse_metric_ssf(s)
+    assert m.scope == MetricScope.LOCAL_ONLY
+
+
+def test_parse_metric_ssf_set_and_status():
+    s = ssf.set_sample("s", "unique-value")
+    m = parse_metric_ssf(s)
+    assert m.type == "set"
+    assert m.value == "unique-value"
+
+    s = ssf.status("st", ssf.SSFStatus.CRITICAL, "broken")
+    m = parse_metric_ssf(s)
+    assert m.type == "status"
+    assert m.value == ssf.SSFStatus.CRITICAL
+
+
+def test_digest_stability_across_sources():
+    # The same logical metric arriving via DogStatsD and via SSF must land on
+    # the same digest (and therefore the same worker shard / series row).
+    dog = parse_metric(b"api.latency:5|h|#env:prod,service:api")
+    s = ssf.histogram("api.latency", 5, {"env": "prod", "service": "api"})
+    from_ssf = parse_metric_ssf(s)
+    assert dog.digest == from_ssf.digest
+    assert dog.key == from_ssf.key

@@ -1,0 +1,24 @@
+"""veneur_tpu: a TPU-native observability-aggregation framework.
+
+A brand-new framework with the capabilities of Veneur (the reference
+implementation lives at github.com/stripe/veneur): a DogStatsD/SSF-compatible
+aggregation server whose numeric core — per-flush t-digest histogram
+compression, HyperLogLog set cardinality, and cross-host global sketch
+merging — executes as batched JAX/XLA programs on TPU instead of per-series
+CPU loops.
+
+Package layout:
+  ops/          batched sketch kernels (t-digest, HLL, scalar reductions)
+  core/         metric types, parser-facing model, series directory,
+                device worker, flusher, server
+  protocol/     DogStatsD and SSF wire parsing
+  ssf/          SSF sample/span schema
+  distributed/  forwarding, import server, proxy, consistent hashing,
+                discovery, device-mesh collectives
+  sinks/        egress sinks (datadog, prometheus, kafka, ...)
+  trace/        tracing client library
+  cli/          command-line entry points
+  utils/        hashing and helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,74 @@
+"""Hash functions used for metric routing and sketch insertion.
+
+The 32-bit FNV-1a digest keys every metric for worker routing, matching the
+reference's use of fnv1a over (name, type, joined-tags) at parse time
+(reference: samplers/parser.go:325-420). The 64-bit variant feeds the
+HyperLogLog register/rank split (reference vendored axiomhq/hyperloglog uses
+a 64-bit hash the same way).
+
+Both scalar (Python int) and vectorized (numpy array-of-bytes) forms are
+provided; the C++ native parser (native/) supersedes the scalar path on hot
+ingest loops when available.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FNV1A_32_OFFSET = 2166136261
+FNV1A_32_PRIME = 16777619
+FNV1A_64_OFFSET = 0xCBF29CE484222325
+FNV1A_64_PRIME = 0x100000001B3
+
+_U32 = 0xFFFFFFFF
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a_32(data: bytes, h: int = FNV1A_32_OFFSET) -> int:
+    """32-bit FNV-1a over ``data``, continuing from state ``h``."""
+    for b in data:
+        h = ((h ^ b) * FNV1A_32_PRIME) & _U32
+    return h
+
+
+def fnv1a_32_str(s: str, h: int = FNV1A_32_OFFSET) -> int:
+    return fnv1a_32(s.encode("utf-8"), h)
+
+
+def fnv1a_64(data: bytes, h: int = FNV1A_64_OFFSET) -> int:
+    """64-bit FNV-1a over ``data``, continuing from state ``h``."""
+    for b in data:
+        h = ((h ^ b) * FNV1A_64_PRIME) & _U64
+    return h
+
+
+def metric_digest(name: str, mtype: str, joined_tags: str) -> int:
+    """The 32-bit routing digest of a metric: fnv1a(name + type + joined_tags).
+
+    Mirrors the digest accumulation order of the reference parser
+    (samplers/parser.go:325-420: name, then type, then joined tags).
+    """
+    h = fnv1a_32_str(name)
+    h = fnv1a_32_str(mtype, h)
+    h = fnv1a_32_str(joined_tags, h)
+    return h
+
+
+def hll_hash(value: bytes) -> int:
+    """64-bit hash for HyperLogLog insertion.
+
+    We use 64-bit FNV-1a; the precise function only needs to be (a) well
+    mixed and (b) identical across every host in a deployment, since HLL
+    registers are merged across hosts. This intentionally differs from the
+    reference's vendored hash — our wire format is our own (see
+    distributed/codec.py).
+    """
+    return fnv1a_64(value)
+
+
+def hll_hash_batch(values: list[bytes]) -> np.ndarray:
+    """Vectorized-ish batch HLL hashing; returns uint64 array."""
+    out = np.empty(len(values), dtype=np.uint64)
+    for i, v in enumerate(values):
+        out[i] = fnv1a_64(v)
+    return out
