@@ -1,17 +1,24 @@
-"""Test configuration: force an 8-device virtual CPU platform.
+"""Test configuration: run the suite on a virtual 8-device CPU platform.
 
-Tests exercise multi-chip sharding on a virtual CPU mesh (the driver
-dry-runs the real multi-chip path separately); set
-VENEUR_TPU_TEST_REAL=1 to run the suite against real devices instead.
-This must run before jax is imported anywhere.
+Multi-chip sharding is tested on a virtual CPU mesh; the driver dry-runs the
+real multi-chip path separately via __graft_entry__.dryrun_multichip, and
+VENEUR_TPU_TEST_REAL=1 runs this suite against real devices instead.
+
+The interpreter may boot with a TPU PJRT plugin already registered and jax
+already imported (a site hook), so env vars alone are too late — but JAX
+backends initialize lazily, so overriding the platform through jax.config
+before any backend is touched still works. XLA_FLAGS is read at backend
+init, so setting it here (before the first jax computation) is early enough.
 """
 
 import os
 
 if not os.environ.get("VENEUR_TPU_TEST_REAL"):
-    os.environ.setdefault(
-        "XLA_FLAGS",
-        "--xla_force_host_platform_device_count=8 "
-        + os.environ.get("XLA_FLAGS", ""),
-    )
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _want = "--xla_force_host_platform_device_count=8"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _want not in flags:
+        os.environ["XLA_FLAGS"] = (_want + " " + flags).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
