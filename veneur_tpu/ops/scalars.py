@@ -1,0 +1,74 @@
+"""Scalar aggregators: counters, gauges, and their batched reductions.
+
+Semantics spec: reference samplers/samplers.go:130-304 — Counter.Sample
+truncates both the sample and the rate reciprocal to integers
+(`value += int64(sample) * int64(1/rate)`, :142-144); Gauge is
+last-write-wins (:225-227).
+
+Counters and gauges are not sketches: their per-batch reduction is a
+segment-sum / segment-last, and their running state must be *exact*
+(counters count bytes and requests — f32 would saturate at 2^24). The
+running accumulation therefore lives host-side in float64 numpy (exact up
+to 2^53, matching the practical range of the reference's int64), while the
+device versions below exist for the fused flush/mesh paths where counter
+shards ride the same program as the sketches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def counter_contribution(value: float, sample_rate: float) -> int:
+    """One counter sample's contribution, with the reference's double
+    truncation (samplers/samplers.go:142-144)."""
+    return int(value) * int(1.0 / sample_rate)
+
+
+def accumulate_counters(
+    state: np.ndarray, rows: np.ndarray, contributions: np.ndarray
+) -> None:
+    """In-place exact segment-sum of a batch into f64 counter state."""
+    if len(rows):
+        np.add.at(state, rows, contributions)
+
+
+def apply_gauges(
+    state: np.ndarray, present: np.ndarray, rows: np.ndarray,
+    values: np.ndarray,
+) -> None:
+    """In-place last-write-wins gauge update for a batch (arrival order).
+
+    numpy fancy assignment applies duplicate indices in order, so the last
+    sample for a row wins — the reference's Gauge.Sample semantics.
+    """
+    if len(rows):
+        state[rows] = values
+        present[rows] = True
+
+
+# ---------------------------------------------------------------------------
+# Device-side segment reductions (used by the fused mesh/flush programs)
+
+
+@jax.jit
+def segment_counter_sum(
+    rows: jax.Array, contributions: jax.Array, num_rows: jax.Array
+) -> jax.Array:  # pragma: no cover - thin wrapper
+    return jax.ops.segment_sum(contributions, rows, num_segments=num_rows)
+
+
+def segment_gauge_last(
+    rows: jax.Array, values: jax.Array, num_rows: int
+) -> tuple[jax.Array, jax.Array]:
+    """Last-write-wins per row on device: returns (values[num_rows],
+    present[num_rows]). The winner is the sample with the highest arrival
+    position per row."""
+    n = rows.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    last_pos = jax.ops.segment_max(pos, rows, num_segments=num_rows)
+    present = last_pos >= 0
+    safe = jnp.clip(last_pos, 0, n - 1)
+    return values[safe], present

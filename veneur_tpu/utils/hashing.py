@@ -54,21 +54,34 @@ def metric_digest(name: str, mtype: str, joined_tags: str) -> int:
     return h
 
 
+def fmix64(h: int) -> int:
+    """murmur3's 64-bit finalizer: full avalanche over all bits."""
+    h &= _U64
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & _U64
+    h ^= h >> 33
+    h = (h * 0xC4CEB9FE1A85EC53) & _U64
+    h ^= h >> 33
+    return h
+
+
 def hll_hash(value: bytes) -> int:
     """64-bit hash for HyperLogLog insertion.
 
-    We use 64-bit FNV-1a; the precise function only needs to be (a) well
-    mixed and (b) identical across every host in a deployment, since HLL
-    registers are merged across hosts. This intentionally differs from the
-    reference's vendored hash — our wire format is our own (see
-    distributed/codec.py).
+    FNV-1a 64 followed by a murmur3 finalizer: raw FNV's top bits barely
+    avalanche on short sequential keys (statsd set members are exactly
+    that), and HLL takes its register index from the top bits. The precise
+    function only needs to be (a) well mixed and (b) identical across every
+    host in a deployment, since HLL registers merge across hosts. This
+    intentionally differs from the reference's vendored hash — our wire
+    format is our own (see distributed/codec.py).
     """
-    return fnv1a_64(value)
+    return fmix64(fnv1a_64(value))
 
 
 def hll_hash_batch(values: list[bytes]) -> np.ndarray:
-    """Vectorized-ish batch HLL hashing; returns uint64 array."""
+    """Batch HLL hashing; returns uint64 array."""
     out = np.empty(len(values), dtype=np.uint64)
     for i, v in enumerate(values):
-        out[i] = fnv1a_64(v)
+        out[i] = fmix64(fnv1a_64(v))
     return out
