@@ -1,0 +1,257 @@
+"""DeviceWorker + flusher tests.
+
+Mirrors the reference's worker_test.go (ingest/import/scope) and the
+deterministic end-to-end value assertions of server_test.go:110-127 /
+TestLocalServerMixedMetrics (:299).
+"""
+
+import numpy as np
+
+from veneur_tpu.core.directory import ScopeClass
+from veneur_tpu.core.flusher import (
+    device_quantiles,
+    forwardable_rows,
+    generate_inter_metrics,
+)
+from veneur_tpu.core.metrics import (
+    HistogramAggregates,
+    MetricType,
+)
+from veneur_tpu.core.worker import DeviceWorker
+from veneur_tpu.protocol.dogstatsd import parse_metric
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+PCTS = [0.5, 0.9, 0.99]
+
+
+def _flush(worker, is_local=True, percentiles=PCTS, aggregates=AGGS):
+    qs = device_quantiles(percentiles, aggregates)
+    snap = worker.flush(qs, interval_s=10.0)
+    metrics = generate_inter_metrics(snap, is_local, percentiles, aggregates,
+                                     now=1000)
+    return snap, {(m.name, m.type): m for m in metrics}, metrics
+
+
+def test_counter_with_sample_rate():
+    w = DeviceWorker()
+    for _ in range(3):
+        w.process_metric(parse_metric(b"a.b.c:1|c"))
+    w.process_metric(parse_metric(b"a.b.c:1|c|@0.5"))
+    _, by_key, _ = _flush(w)
+    m = by_key[("a.b.c", MetricType.COUNTER)]
+    assert m.value == 5.0  # 3*1 + 1*2
+
+
+def test_gauge_last_write_wins():
+    w = DeviceWorker()
+    w.process_metric(parse_metric(b"g:1|g"))
+    w.process_metric(parse_metric(b"g:42|g"))
+    _, by_key, _ = _flush(w)
+    assert by_key[("g", MetricType.GAUGE)].value == 42.0
+
+
+def test_mixed_histo_local_instance_aggregates_only():
+    w = DeviceWorker()
+    for v in [1, 2, 3, 4, 5]:
+        w.process_metric(parse_metric(f"t:{v}|ms".encode()))
+    _, by_key, metrics = _flush(w, is_local=True)
+    assert by_key[("t.min", MetricType.GAUGE)].value == 1.0
+    assert by_key[("t.max", MetricType.GAUGE)].value == 5.0
+    assert by_key[("t.count", MetricType.COUNTER)].value == 5.0
+    # no percentiles on a forwarding (local) instance for mixed scope
+    assert not any(".percentile" in m.name or "percentile" in m.name
+                   for m in metrics)
+
+
+def test_local_only_histo_gets_percentiles():
+    w = DeviceWorker()
+    for v in range(1, 101):
+        w.process_metric(
+            parse_metric(f"t:{v}|ms|#veneurlocalonly".encode())
+        )
+    _, by_key, _ = _flush(w, is_local=True)
+    assert ("t.50percentile", MetricType.GAUGE) in by_key
+    p50 = by_key[("t.50percentile", MetricType.GAUGE)].value
+    assert abs(p50 - 50.5) < 2.0
+    assert by_key[("t.min", MetricType.GAUGE)].value == 1.0
+    assert by_key[("t.max", MetricType.GAUGE)].value == 100.0
+
+
+def test_global_only_histo_forwarded_not_emitted():
+    w = DeviceWorker()
+    w.process_metric(parse_metric(b"t:5|ms|#veneurglobalonly"))
+    snap, by_key, metrics = _flush(w, is_local=True)
+    assert not metrics  # nothing emitted locally
+    fw = list(forwardable_rows(snap))
+    assert len(fw) == 1
+    assert fw[0][0] == "timer"
+    assert fw[0][3] == ScopeClass.GLOBAL
+
+
+def test_mixed_set_only_on_global():
+    w = DeviceWorker()
+    for i in range(100):
+        w.process_metric(parse_metric(f"s:item{i}|s".encode()))
+    snap, by_key, metrics = _flush(w, is_local=True)
+    assert not metrics  # mixed sets have no local part
+    fw = [f for f in forwardable_rows(snap) if f[0] == "set"]
+    assert len(fw) == 1
+
+    # global instance emits the estimate
+    w2 = DeviceWorker(is_local=False)
+    for i in range(100):
+        w2.process_metric(parse_metric(f"s:item{i}|s".encode()))
+    _, by_key2, _ = _flush(w2, is_local=False)
+    est = by_key2[("s", MetricType.GAUGE)].value
+    assert abs(est - 100) / 100 < 0.03
+
+
+def test_local_set_always_flushes():
+    w = DeviceWorker()
+    for i in range(50):
+        w.process_metric(
+            parse_metric(f"s:item{i}|s|#veneurlocalonly".encode())
+        )
+    _, by_key, _ = _flush(w, is_local=True)
+    est = by_key[("s", MetricType.GAUGE)].value
+    assert abs(est - 50) / 50 < 0.05
+
+
+def test_global_counter_forward_only():
+    w = DeviceWorker()
+    w.process_metric(parse_metric(b"c:7|c|#veneurglobalonly"))
+    snap, by_key, metrics = _flush(w, is_local=True)
+    assert not metrics
+    fw = list(forwardable_rows(snap))
+    assert fw[0][0] == "counter" and fw[0][3] == 7
+
+
+def test_status_check_flushes():
+    from veneur_tpu.protocol.dogstatsd import parse_service_check
+    w = DeviceWorker()
+    w.process_metric(parse_service_check(b"_sc|svc|1|h:host9|m:warn msg"))
+    _, by_key, _ = _flush(w)
+    m = by_key[("svc", MetricType.STATUS)]
+    assert m.value == 1.0
+    assert m.message == "warn msg"
+    assert m.hostname == "host9"
+
+
+def test_import_digest_merge_on_global():
+    # 8 local workers each aggregate a shard; the global worker merges
+    # their forwarded digests and emits percentiles (reference forward path
+    # §3.4 of SURVEY.md)
+    rng = np.random.default_rng(23)
+    all_vals = []
+    g = DeviceWorker(is_local=False)
+    for _ in range(8):
+        w = DeviceWorker()
+        vals = rng.normal(100, 10, 5000)
+        all_vals.append(vals)
+        for v in vals:
+            w.process_metric(parse_metric(f"lat:{v}|h".encode()))
+        snap = w.flush(device_quantiles(PCTS, AGGS))
+        for item in forwardable_rows(snap):
+            kind, key, tags, cls, means, weights, dmin, dmax, drecip = item
+            g.import_digest(key, tags, kind, cls, means, weights,
+                            dmin, dmax, drecip)
+    _, by_key, _ = _flush(g, is_local=False)
+    combined = np.concatenate(all_vals)
+    p50 = by_key[("lat.50percentile", MetricType.GAUGE)].value
+    p99 = by_key[("lat.99percentile", MetricType.GAUGE)].value
+    assert abs(p50 - np.quantile(combined, 0.5)) < 0.5
+    assert abs(p99 - np.quantile(combined, 0.99)) < 1.0
+    # mixed histo on global with no local samples: no min/max/count
+    assert ("lat.min", MetricType.GAUGE) not in by_key
+    assert ("lat.count", MetricType.COUNTER) not in by_key
+
+
+def test_import_hll_merge():
+    g = DeviceWorker(is_local=False)
+    for shard in range(4):
+        w = DeviceWorker()
+        for i in range(shard * 500, shard * 500 + 1000):
+            w.process_metric(parse_metric(f"s:u{i}|s".encode()))
+        snap = w.flush(device_quantiles(PCTS, AGGS))
+        for item in forwardable_rows(snap):
+            if item[0] == "set":
+                _, key, tags, regs = item
+                g.import_hll(key, tags, ScopeClass.MIXED, regs)
+    _, by_key, _ = _flush(g, is_local=False)
+    est = by_key[("s", MetricType.GAUGE)].value
+    true_n = 2500  # overlapping ranges
+    assert abs(est - true_n) / true_n < 0.03
+
+
+def test_import_counter_gauge():
+    g = DeviceWorker(is_local=False)
+    from veneur_tpu.core.metrics import MetricKey
+    key = MetricKey("reqs", "counter", "")
+    g.import_counter(key, [], 10)
+    g.import_counter(key, [], 5)
+    gkey = MetricKey("temp", "gauge", "")
+    g.import_gauge(gkey, [], 3.5)
+    _, by_key, _ = _flush(g, is_local=False)
+    assert by_key[("reqs", MetricType.COUNTER)].value == 15.0
+    assert by_key[("temp", MetricType.GAUGE)].value == 3.5
+
+
+def test_flush_resets_state():
+    w = DeviceWorker()
+    w.process_metric(parse_metric(b"c:1|c"))
+    _flush(w)
+    _, by_key, metrics = _flush(w)
+    assert not metrics  # state expires every interval
+
+
+def test_growth_across_capacity():
+    w = DeviceWorker(initial_histo_rows=64, initial_set_rows=64,
+                     batch_size=128)
+    for i in range(500):
+        w.process_metric(parse_metric(f"h{i}:{i}|h".encode()))
+        w.process_metric(parse_metric(f"s{i}:v{i}|s".encode()))
+    snap, _, _ = _flush(w, is_local=False)
+    assert snap.directory.num_histo_rows == 500
+    assert snap.directory.num_set_rows == 500
+    # spot check one series
+    row = snap.directory.histo.index[
+        (parse_metric(b"h123:1|h").key, ScopeClass.MIXED)]
+    assert snap.lmin[row] == 123.0 and snap.lmax[row] == 123.0
+
+
+def test_same_key_different_scopes_coexist():
+    # reference: the same MetricKey can live in timers and globalTimers
+    w = DeviceWorker()
+    w.process_metric(parse_metric(b"t:1|ms"))
+    w.process_metric(parse_metric(b"t:2|ms|#veneurglobalonly"))
+    snap, _, _ = _flush(w)
+    assert snap.directory.num_histo_rows == 2
+
+
+def test_histo_sum_avg_hmean_aggregates():
+    aggs = HistogramAggregates.from_names(
+        ["min", "max", "count", "sum", "avg", "hmean", "median"])
+    w = DeviceWorker()
+    for v in [1.0, 2.0, 4.0]:
+        w.process_metric(parse_metric(f"t:{v}|h".encode()))
+    _, by_key, _ = _flush(w, is_local=True, aggregates=aggs)
+    assert by_key[("t.sum", MetricType.GAUGE)].value == 7.0
+    assert abs(by_key[("t.avg", MetricType.GAUGE)].value - 7.0 / 3) < 1e-6
+    hmean = by_key[("t.hmean", MetricType.GAUGE)].value
+    assert abs(hmean - 3.0 / (1 + 0.5 + 0.25)) < 1e-5
+    med = by_key[("t.median", MetricType.GAUGE)].value
+    assert 1.0 <= med <= 4.0
+
+
+def test_unique_timeseries_counting():
+    w = DeviceWorker(count_unique_timeseries=True, is_local=False)
+    for i in range(200):
+        w.process_metric(parse_metric(f"m{i}:1|c".encode()))
+        w.process_metric(parse_metric(f"m{i}:2|c".encode()))  # same series
+    snap = w.flush(device_quantiles(PCTS, AGGS))
+    regs = snap.unique_timeseries_registers
+    assert regs is not None
+    import jax.numpy as jnp
+    from veneur_tpu.ops import hll as hll_ops
+    est = float(hll_ops.estimate(jnp.asarray(regs[None, :]))[0])
+    assert abs(est - 200) / 200 < 0.05

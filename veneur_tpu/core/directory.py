@@ -1,0 +1,120 @@
+"""Series directory: MetricKey → dense device-pool row assignment.
+
+The reference keys per-flush sampler state with 13 Go maps split by type and
+scope (worker.go:60-103). On TPU, sketch state must live in dense, fixed-
+shape device arrays, so the maps become this directory: each (key, class)
+gets a row index into one of two device pools (t-digest rows for
+histogram/timer series, HLL rows for set series), and the scope split
+becomes a per-row class label consulted only at flush/forward time — the
+device programs are scope-oblivious and operate on whole pools.
+
+Like the reference, all aggregation state lives exactly one flush interval:
+the directory (and its pools) is swapped wholesale at flush (the map-swap of
+worker.go:498-517 becomes a directory+buffer swap).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from veneur_tpu.core.metrics import MetricKey, MetricScope, route_info
+
+
+class ScopeClass(enum.IntEnum):
+    """Which of the reference's map groups a series belongs to
+    (worker.go:60-103: plain / global* / local* maps)."""
+
+    MIXED = 0
+    LOCAL = 1
+    GLOBAL = 2
+
+
+def classify(mtype: str, scope: MetricScope) -> ScopeClass:
+    """Reference WorkerMetrics.Upsert routing (worker.go:108-177)."""
+    if mtype in ("counter", "gauge"):
+        return (
+            ScopeClass.GLOBAL
+            if scope == MetricScope.GLOBAL_ONLY
+            else ScopeClass.MIXED
+        )
+    if mtype in ("histogram", "timer"):
+        if scope == MetricScope.LOCAL_ONLY:
+            return ScopeClass.LOCAL
+        if scope == MetricScope.GLOBAL_ONLY:
+            return ScopeClass.GLOBAL
+        return ScopeClass.MIXED
+    if mtype == "set":
+        return (
+            ScopeClass.LOCAL
+            if scope == MetricScope.LOCAL_ONLY
+            else ScopeClass.MIXED
+        )
+    if mtype == "status":
+        return ScopeClass.LOCAL
+    return ScopeClass.MIXED
+
+
+@dataclass
+class RowMeta:
+    """Host-side metadata for one pool row (what the dense arrays can't
+    hold: names, tags, routing)."""
+
+    key: MetricKey
+    tags: list[str]
+    scope_class: ScopeClass
+    sinks: Optional[frozenset[str]]  # from veneursinkonly: tags
+
+
+@dataclass
+class _Pool:
+    index: dict[tuple[MetricKey, ScopeClass], int] = field(default_factory=dict)
+    rows: list[RowMeta] = field(default_factory=list)
+
+    def upsert(self, key: MetricKey, scope_class: ScopeClass, tags: list[str]
+               ) -> tuple[int, bool]:
+        k = (key, scope_class)
+        row = self.index.get(k)
+        if row is not None:
+            return row, False
+        row = len(self.rows)
+        self.index[k] = row
+        self.rows.append(
+            RowMeta(
+                key=key,
+                tags=tags,
+                scope_class=scope_class,
+                sinks=route_info(tags),
+            )
+        )
+        return row, True
+
+
+class SeriesDirectory:
+    """One flush interval's series → row mapping for both device pools.
+
+    Distinct (key, scope_class) pairs get distinct rows, mirroring the
+    reference where the same MetricKey can live in e.g. both `timers` and
+    `globalTimers` maps simultaneously.
+    """
+
+    def __init__(self) -> None:
+        self.histo = _Pool()  # histogram + timer series → t-digest rows
+        self.sets = _Pool()  # set series → HLL rows
+
+    def upsert_histo(self, key: MetricKey, scope_class: ScopeClass,
+                     tags: list[str]) -> tuple[int, bool]:
+        return self.histo.upsert(key, scope_class, tags)
+
+    def upsert_set(self, key: MetricKey, scope_class: ScopeClass,
+                   tags: list[str]) -> tuple[int, bool]:
+        return self.sets.upsert(key, scope_class, tags)
+
+    @property
+    def num_histo_rows(self) -> int:
+        return len(self.histo.rows)
+
+    @property
+    def num_set_rows(self) -> int:
+        return len(self.sets.rows)

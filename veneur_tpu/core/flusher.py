@@ -1,0 +1,259 @@
+"""InterMetric generation from a flushed interval.
+
+Behavioral spec: reference generateInterMetrics (flusher.go:225-298) plus the
+per-sampler Flush methods (samplers/samplers.go:147-158 Counter, :230-242
+Gauge, :319-324 StatusCheck, :392-403 Set, :511-675 Histo) — including the
+mixed-scope double-count avoidance: a local (forwarding) instance emits only
+host-local aggregates for mixed histograms, never percentiles; the global
+instance emits percentiles but no local aggregates (flusher.go:61-74).
+
+The flusher consumes a FlushSnapshot (dense arrays + row metadata) and emits
+InterMetric objects row by row; all numeric work already happened on device.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+import numpy as np
+
+from veneur_tpu.core.directory import ScopeClass
+from veneur_tpu.core.metrics import (
+    Aggregate,
+    HistogramAggregates,
+    InterMetric,
+    MetricType,
+)
+from veneur_tpu.core.worker import FlushSnapshot
+
+
+def device_quantiles(
+    percentiles: list[float], aggregates: HistogramAggregates
+) -> np.ndarray:
+    """The quantile vector the device must evaluate: configured percentiles
+    plus the median when the median aggregate is enabled (reference
+    samplers.go:622-636 pulls the median from the digest)."""
+    qs = list(percentiles)
+    if aggregates.value & Aggregate.MEDIAN and 0.5 not in qs:
+        qs.append(0.5)
+    # float64 so host-side lookups by the exact configured value round-trip;
+    # the worker casts to f32 only at the device boundary
+    return np.asarray(qs, dtype=np.float64)
+
+
+def _percentile_name(name: str, p: float) -> str:
+    # reference formats with int(p*100) (samplers.go:657-672)
+    return f"{name}.{int(p * 100)}percentile"
+
+
+def generate_inter_metrics(
+    snap: FlushSnapshot,
+    is_local: bool,
+    percentiles: list[float],
+    aggregates: HistogramAggregates,
+    now: Optional[int] = None,
+) -> list[InterMetric]:
+    """Emit every InterMetric this interval owes its sinks."""
+    ts = int(time.time()) if now is None else now
+    out: list[InterMetric] = []
+
+    # mixed histograms/timers forward their digests, so a local instance
+    # flushes only aggregates for them (flusher.go:61-74)
+    mixed_percentiles: list[float] = [] if is_local else list(percentiles)
+
+    # -- histogram/timer rows ---------------------------------------------
+    hrows = snap.directory.histo.rows
+    if hrows:
+        q_index = {
+            float(q): i for i, q in enumerate(np.asarray(snap.quantile_qs))
+        }
+        for row, meta in enumerate(hrows):
+            cls = meta.scope_class
+            if cls == ScopeClass.MIXED:
+                ps, use_global = mixed_percentiles, False
+            elif cls == ScopeClass.LOCAL:
+                ps, use_global = list(percentiles), False
+            else:  # GLOBAL: flushed only by the global instance, from digest
+                if is_local:
+                    continue
+                ps, use_global = list(percentiles), True
+            out.extend(
+                _flush_histo_row(snap, row, meta, ts, ps, aggregates,
+                                 use_global, q_index)
+            )
+
+    # -- set rows ----------------------------------------------------------
+    srows = snap.directory.sets.rows
+    if srows:
+        for row, meta in enumerate(srows):
+            # mixed sets have no local part: only the global instance emits
+            # them (flusher.go:269-274); local-only sets always flush
+            if meta.scope_class == ScopeClass.MIXED and is_local:
+                continue
+            out.append(
+                InterMetric(
+                    name=meta.key.name,
+                    timestamp=ts,
+                    value=float(snap.set_estimates[row]),
+                    tags=list(meta.tags),
+                    type=MetricType.GAUGE,
+                    sinks=meta.sinks,
+                )
+            )
+
+    # -- counters ----------------------------------------------------------
+    for (key, tags, cls, sinks), value in zip(
+        snap.scalars.counter_meta, snap.scalars.counter_values
+    ):
+        if cls == ScopeClass.GLOBAL and is_local:
+            continue  # forwarded, not emitted (flusher.go:276-283)
+        out.append(
+            InterMetric(
+                name=key.name, timestamp=ts, value=float(value),
+                tags=list(tags), type=MetricType.COUNTER, sinks=sinks,
+            )
+        )
+
+    # -- gauges ------------------------------------------------------------
+    for (key, tags, cls, sinks), value in zip(
+        snap.scalars.gauge_meta, snap.scalars.gauge_values
+    ):
+        if cls == ScopeClass.GLOBAL and is_local:
+            continue
+        out.append(
+            InterMetric(
+                name=key.name, timestamp=ts, value=float(value),
+                tags=list(tags), type=MetricType.GAUGE, sinks=sinks,
+            )
+        )
+
+    # -- status checks -----------------------------------------------------
+    for (key, tags, _cls, sinks), sv in zip(
+        snap.scalars.status_meta, snap.scalars.status_values
+    ):
+        value, message, hostname = sv
+        out.append(
+            InterMetric(
+                name=key.name, timestamp=ts, value=float(value),
+                tags=list(tags), type=MetricType.STATUS, message=message,
+                hostname=hostname, sinks=sinks,
+            )
+        )
+
+    return out
+
+
+def _flush_histo_row(
+    snap: FlushSnapshot,
+    row: int,
+    meta,
+    ts: int,
+    percentiles: list[float],
+    aggregates: HistogramAggregates,
+    use_global: bool,
+    q_index: dict[float, int],
+) -> list[InterMetric]:
+    """One histogram/timer row → aggregate + percentile series
+    (reference Histo.Flush, samplers.go:511-675)."""
+    name = meta.key.name
+    tags = list(meta.tags)
+    sinks = meta.sinks
+    agg = aggregates.value
+    out: list[InterMetric] = []
+
+    lmin = float(snap.lmin[row])
+    lmax = float(snap.lmax[row])
+    lsum = float(snap.lsum[row])
+    lweight = float(snap.lweight[row])
+    lrecip = float(snap.lrecip[row])
+
+    def gauge(metric_name: str, value: float) -> InterMetric:
+        return InterMetric(name=metric_name, timestamp=ts, value=value,
+                           tags=list(tags), type=MetricType.GAUGE, sinks=sinks)
+
+    if agg & Aggregate.MAX and (not math.isinf(lmax) or use_global):
+        val = float(snap.dmax[row]) if use_global else lmax
+        out.append(gauge(f"{name}.max", val))
+    if agg & Aggregate.MIN and (not math.isinf(lmin) or use_global):
+        val = float(snap.dmin[row]) if use_global else lmin
+        out.append(gauge(f"{name}.min", val))
+    if agg & Aggregate.SUM and (lsum != 0 or use_global):
+        val = float(snap.dsum[row]) if use_global else lsum
+        out.append(gauge(f"{name}.sum", val))
+    if agg & Aggregate.AVERAGE and (use_global or (lsum != 0 and lweight != 0)):
+        if use_global:
+            val = float(snap.dsum[row]) / float(snap.dcount[row])
+        else:
+            val = lsum / lweight
+        out.append(gauge(f"{name}.avg", val))
+    if agg & Aggregate.COUNT and (lweight != 0 or use_global):
+        val = float(snap.dcount[row]) if use_global else lweight
+        out.append(
+            InterMetric(name=f"{name}.count", timestamp=ts, value=val,
+                        tags=list(tags), type=MetricType.COUNTER, sinks=sinks)
+        )
+    if agg & Aggregate.MEDIAN:
+        # always emitted when configured; the value comes from the digest
+        out.append(
+            gauge(f"{name}.median",
+                  float(snap.quantile_values[row, q_index[0.5]]))
+        )
+    if agg & Aggregate.HARMONIC_MEAN and (
+        use_global or (lrecip != 0 and lweight != 0)
+    ):
+        if use_global:
+            val = float(snap.dcount[row]) / float(snap.drecip[row])
+        else:
+            val = lweight / lrecip
+        out.append(gauge(f"{name}.hmean", val))
+
+    for p in percentiles:
+        out.append(
+            gauge(_percentile_name(name, p),
+                  float(snap.quantile_values[row, q_index[float(p)]]))
+        )
+
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Forwarding selection
+
+
+def forwardable_rows(snap: FlushSnapshot):
+    """Yield the forwardable content of a snapshot, typed, mirroring
+    reference ForwardableMetrics (worker.go:181-209): global counters and
+    gauges, mixed+global histograms/timers, mixed sets. Local-only series
+    never leave the instance.
+
+    Yields tuples:
+      ("counter", key, tags, value)
+      ("gauge", key, tags, value)
+      ("histogram"|"timer", key, tags, scope_class, means, weights,
+       dmin, dmax, drecip)
+      ("set", key, tags, registers)
+    """
+    for (key, tags, cls, _sinks), value in zip(
+        snap.scalars.counter_meta, snap.scalars.counter_values
+    ):
+        if cls == ScopeClass.GLOBAL:
+            yield ("counter", key, tags, value)
+    for (key, tags, cls, _sinks), value in zip(
+        snap.scalars.gauge_meta, snap.scalars.gauge_values
+    ):
+        if cls == ScopeClass.GLOBAL:
+            yield ("gauge", key, tags, value)
+    for row, meta in enumerate(snap.directory.histo.rows):
+        if meta.scope_class == ScopeClass.LOCAL:
+            continue
+        yield (
+            meta.key.type, meta.key, meta.tags, meta.scope_class,
+            snap.digest_means[row], snap.digest_weights[row],
+            float(snap.dmin[row]), float(snap.dmax[row]),
+            float(snap.drecip[row]),
+        )
+    for row, meta in enumerate(snap.directory.sets.rows):
+        if meta.scope_class == ScopeClass.MIXED:
+            yield ("set", meta.key, meta.tags, snap.set_registers[row])

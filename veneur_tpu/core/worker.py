@@ -1,0 +1,620 @@
+"""DeviceWorker: the batched aggregation engine.
+
+Replaces the reference's worker goroutines (worker.go:265-517): instead of N
+workers each holding Go maps of per-series sampler objects and processing
+one metric at a time, one DeviceWorker owns dense device pools —
+
+  t-digest rows   f32[S_h, C]×2 + scalars   (histogram & timer series)
+  HLL registers   int8[S_s, 2^p]            (set series)
+  local stats     f32[S_h] × 5              (the Histo sampler's host-local
+                                             aggregates, samplers.go:467-494)
+
+— and ingests *batches*: samples buffer host-side into SoA pending arrays,
+and one jitted program per batch gathers the active rows, runs the digest
+compression / HLL scatter, and scatters the rows back. Counters and gauges
+are not sketches; their running state stays host-side in exact float64
+(np.bincount-style segment adds), since f32 device accumulators would lose
+counts past 2^24 — see ops/scalars.py.
+
+Scope handling: the reference splits state across 13 maps by (type, scope)
+(worker.go:60-103); here scope is a per-row *label* (directory.ScopeClass)
+and the device programs are scope-oblivious — flush/forward select rows by
+label (core/flusher.py).
+
+Flush is a buffer swap (the map-swap of worker.go:498-517): the directory
+and pools are handed to the flusher wholesale and replaced with fresh ones,
+so next-interval ingest proceeds while extraction runs on the old buffers.
+
+The import path (global tier) merges serialized sketches from downstream
+instances: digests buffer host-side per row and merge in one concat+compress
+program at flush; HLLs fold with np.maximum and one scatter-max
+(reference Worker.ImportMetric/ImportMetricGRPC, worker.go:394-495).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.core.directory import ScopeClass, SeriesDirectory, classify
+from veneur_tpu.core.metrics import MetricKey, UDPMetric, route_info
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import tdigest as td
+from veneur_tpu.ops.scalars import counter_contribution
+from veneur_tpu.utils.hashing import hll_hash, fmix64
+
+
+def _next_pow2(n: int, floor: int = 1) -> int:
+    v = floor
+    while v < n:
+        v *= 2
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Jitted device steps
+
+
+@functools.partial(jax.jit, static_argnames=("compression",), donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _histo_ingest_step(
+    means, weights, dmin, dmax, drecip,
+    lmin, lmax, lsum, lweight, lrecip,
+    active, lids, values, wts,
+    compression: float = td.DEFAULT_COMPRESSION,
+):
+    """Gather active digest rows, fold one sample batch in, scatter back.
+
+    active: i32[K] pool rows (padded with a scratch row); lids index into
+    `active`. Also updates the sampler-local scalar arrays for those rows.
+    """
+    g_means = means[active]
+    g_w = weights[active]
+    g_min = dmin[active]
+    g_max = dmax[active]
+    g_recip = drecip[active]
+
+    n_means, n_w, n_min, n_max, n_recip, stats = td.add_batch(
+        g_means, g_w, g_min, g_max, g_recip, lids, values, wts,
+        compression=compression,
+    )
+
+    means = means.at[active].set(n_means, mode="drop")
+    weights = weights.at[active].set(n_w, mode="drop")
+    dmin = dmin.at[active].set(n_min, mode="drop")
+    dmax = dmax.at[active].set(n_max, mode="drop")
+    drecip = drecip.at[active].set(n_recip, mode="drop")
+
+    lmin = lmin.at[active].min(stats.min, mode="drop")
+    lmax = lmax.at[active].max(stats.max, mode="drop")
+    lsum = lsum.at[active].add(stats.sum, mode="drop")
+    lweight = lweight.at[active].add(stats.weight, mode="drop")
+    lrecip = lrecip.at[active].add(stats.recip, mode="drop")
+    return means, weights, dmin, dmax, drecip, lmin, lmax, lsum, lweight, lrecip
+
+
+@functools.partial(jax.jit, static_argnames=("compression",), donate_argnums=(0, 1, 2, 3, 4))
+def _histo_import_step(
+    means, weights, dmin, dmax, drecip,
+    rows, imp_means, imp_w, imp_min, imp_max, imp_recip,
+    compression: float = td.DEFAULT_COMPRESSION,
+):
+    """Merge imported digest rows [K, W] into pool rows (global tier)."""
+    c = means.shape[1]
+    g_means = means[rows]
+    g_w = weights[rows]
+    cat_means = jnp.concatenate([g_means, imp_means], axis=-1)
+    cat_w = jnp.concatenate([g_w, imp_w], axis=-1)
+    n_means, n_w = td.compress_rows(cat_means, cat_w, compression, c)
+    means = means.at[rows].set(n_means, mode="drop")
+    weights = weights.at[rows].set(n_w, mode="drop")
+    dmin = dmin.at[rows].min(imp_min, mode="drop")
+    dmax = dmax.at[rows].max(imp_max, mode="drop")
+    drecip = drecip.at[rows].add(imp_recip, mode="drop")
+    return means, weights, dmin, dmax, drecip
+
+
+@jax.jit
+def _histo_flush_extract(means, weights, dmin, dmax, drecip,
+                         lmin, lmax, lsum, lweight, lrecip, qs):
+    """One program extracting everything the flusher needs from all rows."""
+    quantiles = td.quantile(means, weights, dmin, dmax, qs)
+    dsum = td.row_sum(means, weights)
+    dcount = td.row_count(weights)
+    return (quantiles, dmin, dmax, dsum, dcount, drecip,
+            lmin, lmax, lsum, lweight, lrecip)
+
+
+@functools.partial(jax.jit, static_argnames=("new_rows",), donate_argnums=(0,))
+def _grow_2d(old, new_rows: int):
+    s, c = old.shape
+    return jnp.zeros((new_rows, c), old.dtype).at[:s].set(old)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("new_rows", "fill"), donate_argnums=(0,)
+)
+def _grow_1d(old, new_rows: int, fill: float):
+    s = old.shape[0]
+    return jnp.full((new_rows,), fill, old.dtype).at[:s].set(old)
+
+
+# ---------------------------------------------------------------------------
+# Host-side state containers
+
+
+@dataclass
+class HostScalars:
+    """Exact host-side counter/gauge/status state for one interval."""
+
+    counter_index: dict = field(default_factory=dict)  # (key, class) → row
+    counter_meta: list = field(default_factory=list)
+    counter_values: list = field(default_factory=list)  # python ints (exact)
+
+    gauge_index: dict = field(default_factory=dict)
+    gauge_meta: list = field(default_factory=list)
+    gauge_values: list = field(default_factory=list)
+
+    status_index: dict = field(default_factory=dict)
+    status_meta: list = field(default_factory=list)
+    status_values: list = field(default_factory=list)  # (value, message, host)
+
+
+@dataclass
+class HistoDeviceState:
+    means: jax.Array
+    weights: jax.Array
+    dmin: jax.Array
+    dmax: jax.Array
+    drecip: jax.Array
+    lmin: jax.Array
+    lmax: jax.Array
+    lsum: jax.Array
+    lweight: jax.Array
+    lrecip: jax.Array
+
+    @classmethod
+    def create(cls, rows: int, capacity: int) -> "HistoDeviceState":
+        # every field gets its own buffer — the ingest step donates all of
+        # them, and donating one buffer twice is an error
+        pool = td.init_pool(rows, capacity)
+
+        def _full(v):
+            return jnp.full((rows,), v, jnp.float32)
+
+        return cls(
+            means=pool.means, weights=pool.weights, dmin=pool.min,
+            dmax=pool.max, drecip=pool.recip,
+            lmin=_full(jnp.inf), lmax=_full(-jnp.inf), lsum=_full(0.0),
+            lweight=_full(0.0), lrecip=_full(0.0),
+        )
+
+    @property
+    def num_rows(self) -> int:
+        return self.means.shape[0]
+
+    def grow(self, new_rows: int) -> "HistoDeviceState":
+        # zero-filled new mean rows are safe: every kernel keys empty slots
+        # off weight==0, never the stored mean
+        inf = float("inf")
+        return HistoDeviceState(
+            means=_grow_2d(self.means, new_rows),
+            weights=_grow_2d(self.weights, new_rows),
+            dmin=_grow_1d(self.dmin, new_rows, inf),
+            dmax=_grow_1d(self.dmax, new_rows, -inf),
+            drecip=_grow_1d(self.drecip, new_rows, 0.0),
+            lmin=_grow_1d(self.lmin, new_rows, inf),
+            lmax=_grow_1d(self.lmax, new_rows, -inf),
+            lsum=_grow_1d(self.lsum, new_rows, 0.0),
+            lweight=_grow_1d(self.lweight, new_rows, 0.0),
+            lrecip=_grow_1d(self.lrecip, new_rows, 0.0),
+        )
+
+
+@dataclass
+class FlushSnapshot:
+    """Everything one interval produced, in host memory: the input to
+    InterMetric generation (core/flusher.py) and to forwarding
+    (distributed/forward.py)."""
+
+    directory: SeriesDirectory
+    scalars: HostScalars
+    interval_s: float
+    # histogram/timer extraction [rows in directory.histo order]:
+    quantile_values: Optional[np.ndarray] = None  # [S, P]
+    quantile_qs: Optional[np.ndarray] = None  # [P]
+    dmin: Optional[np.ndarray] = None
+    dmax: Optional[np.ndarray] = None
+    dsum: Optional[np.ndarray] = None
+    dcount: Optional[np.ndarray] = None
+    drecip: Optional[np.ndarray] = None
+    lmin: Optional[np.ndarray] = None
+    lmax: Optional[np.ndarray] = None
+    lsum: Optional[np.ndarray] = None
+    lweight: Optional[np.ndarray] = None
+    lrecip: Optional[np.ndarray] = None
+    # raw digest rows (for forwarding):
+    digest_means: Optional[np.ndarray] = None
+    digest_weights: Optional[np.ndarray] = None
+    # sets:
+    set_estimates: Optional[np.ndarray] = None  # [S_sets]
+    set_registers: Optional[np.ndarray] = None  # [S_sets, m] (forwarding)
+    # unique-timeseries count for this worker (None if disabled):
+    unique_timeseries_registers: Optional[np.ndarray] = None
+
+
+class DeviceWorker:
+    """Batched aggregation engine for one shard of the metric space.
+
+    The reference routes each metric to one of N workers by Digest%N
+    (server.go:1028,1039) to keep every series in exactly one histogram;
+    here a single DeviceWorker typically owns the whole space (the TPU *is*
+    the parallelism), but sharding across workers/devices composes the same
+    way — see distributed/mesh.py.
+    """
+
+    def __init__(
+        self,
+        batch_size: int = 16384,
+        compression: float = td.DEFAULT_COMPRESSION,
+        capacity: int = td.DEFAULT_CAPACITY,
+        hll_precision: int = hll_ops.DEFAULT_PRECISION,
+        initial_histo_rows: int = 1024,
+        initial_set_rows: int = 256,
+        count_unique_timeseries: bool = False,
+        is_local: bool = True,
+    ) -> None:
+        self.batch_size = batch_size
+        self.compression = compression
+        self.capacity = capacity
+        self.hll_precision = hll_precision
+        self._initial_histo_rows = initial_histo_rows
+        self._initial_set_rows = initial_set_rows
+        self.count_unique_timeseries = count_unique_timeseries
+        self.is_local = is_local
+        self.processed = 0
+        self.imported = 0
+        self._reset_epoch()
+
+    # -- epoch lifecycle ----------------------------------------------------
+
+    def _reset_epoch(self) -> None:
+        self.directory = SeriesDirectory()
+        self.scalars = HostScalars()
+        self._histo: Optional[HistoDeviceState] = None
+        self._sets: Optional[jax.Array] = None
+        # pending SoA buffers (host)
+        self._ph_rows: list[int] = []
+        self._ph_vals: list[float] = []
+        self._ph_wts: list[float] = []
+        self._ps_rows: list[int] = []
+        self._ps_idx: list[int] = []
+        self._ps_rank: list[int] = []
+        # import buffers (global tier)
+        self._imp_digests: dict[int, list] = {}
+        self._imp_hll: dict[int, np.ndarray] = {}
+        # unique-timeseries HLL registers (host, tiny)
+        m = hll_ops.num_registers(self.hll_precision)
+        self._umts = (
+            np.zeros(m, dtype=np.int8) if self.count_unique_timeseries else None
+        )
+
+    def _ensure_histo(self, needed_rows: int) -> None:
+        # keep one scratch row free at the top for gather/scatter padding
+        if self._histo is None:
+            rows = _next_pow2(needed_rows + 1, self._initial_histo_rows)
+            self._histo = HistoDeviceState.create(rows, self.capacity)
+        elif needed_rows + 1 > self._histo.num_rows:
+            self._flush_pending_histos()  # pending lids reference old layout
+            self._histo = self._histo.grow(
+                _next_pow2(needed_rows + 1, self._histo.num_rows * 2)
+            )
+
+    def _ensure_sets(self, needed_rows: int) -> None:
+        if self._sets is None:
+            rows = _next_pow2(needed_rows + 1, self._initial_set_rows)
+            self._sets = hll_ops.init_pool(rows, self.hll_precision)
+        elif needed_rows + 1 > self._sets.shape[0]:
+            self._flush_pending_sets()
+            self._sets = _grow_2d(
+                self._sets, _next_pow2(needed_rows + 1, self._sets.shape[0] * 2)
+            )
+
+    # -- ingest -------------------------------------------------------------
+
+    def process_metric(self, m: UDPMetric) -> None:
+        """Route one parsed sample into the right pool
+        (reference Worker.ProcessMetric, worker.go:344-394)."""
+        self.processed += 1
+        mtype = m.key.type
+        scope_class = classify(mtype, m.scope)
+        if self.count_unique_timeseries:
+            self._sample_timeseries(m, mtype)
+
+        if mtype == "counter":
+            self._host_counter(m.key, scope_class, m.tags,
+                               counter_contribution(m.value, m.sample_rate))
+        elif mtype == "gauge":
+            self._host_gauge(m.key, scope_class, m.tags, float(m.value))
+        elif mtype in ("histogram", "timer"):
+            row, _ = self.directory.upsert_histo(m.key, scope_class, m.tags)
+            self._ensure_histo(self.directory.num_histo_rows)
+            self._ph_rows.append(row)
+            self._ph_vals.append(float(m.value))
+            self._ph_wts.append(1.0 / m.sample_rate)
+            if len(self._ph_rows) >= self.batch_size:
+                self._flush_pending_histos()
+        elif mtype == "set":
+            row, _ = self.directory.upsert_set(m.key, scope_class, m.tags)
+            self._ensure_sets(self.directory.num_set_rows)
+            h = hll_hash(str(m.value).encode("utf-8"))
+            idx, rank = hll_ops.split_hashes(
+                np.array([h], dtype=np.uint64), self.hll_precision
+            )
+            self._ps_rows.append(row)
+            self._ps_idx.append(int(idx[0]))
+            self._ps_rank.append(int(rank[0]))
+            if len(self._ps_rows) >= self.batch_size:
+                self._flush_pending_sets()
+        elif mtype == "status":
+            self._host_status(m)
+
+    def _sample_timeseries(self, m: UDPMetric, mtype: str) -> None:
+        """Count a series toward unique-timeseries cardinality per the
+        forwarding-aware rules of reference SampleTimeseries
+        (worker.go:300-341)."""
+        count = True
+        if self.is_local:
+            if mtype in ("counter", "gauge"):
+                count = m.scope != 2  # not GlobalOnly
+            elif mtype in ("histogram", "set", "timer"):
+                count = m.scope == 1  # LocalOnly
+        if count and self._umts is not None:
+            h = fmix64(m.digest)
+            idx, rank = hll_ops.split_hashes(
+                np.array([h], dtype=np.uint64), self.hll_precision
+            )
+            self._umts[idx[0]] = max(self._umts[idx[0]], rank[0])
+
+    # host scalar paths
+
+    def _host_counter(self, key: MetricKey, scope_class: ScopeClass,
+                      tags: list[str], contribution: int) -> None:
+        sc = self.scalars
+        k = (key, scope_class)
+        row = sc.counter_index.get(k)
+        if row is None:
+            row = len(sc.counter_values)
+            sc.counter_index[k] = row
+            sc.counter_meta.append((key, tags, scope_class, route_info(tags)))
+            sc.counter_values.append(0)
+        sc.counter_values[row] += contribution
+
+    def _host_gauge(self, key: MetricKey, scope_class: ScopeClass,
+                    tags: list[str], value: float) -> None:
+        sc = self.scalars
+        k = (key, scope_class)
+        row = sc.gauge_index.get(k)
+        if row is None:
+            row = len(sc.gauge_values)
+            sc.gauge_index[k] = row
+            sc.gauge_meta.append((key, tags, scope_class, route_info(tags)))
+            sc.gauge_values.append(value)
+        else:
+            sc.gauge_values[row] = value
+
+    def _host_status(self, m: UDPMetric) -> None:
+        sc = self.scalars
+        k = (m.key, ScopeClass.LOCAL)
+        row = sc.status_index.get(k)
+        if row is None:
+            row = len(sc.status_values)
+            sc.status_index[k] = row
+            sc.status_meta.append(
+                (m.key, m.tags, ScopeClass.LOCAL, route_info(m.tags))
+            )
+            sc.status_values.append(None)
+        sc.status_values[row] = (float(m.value), m.message, m.hostname)
+
+    # -- pending-batch device steps ----------------------------------------
+
+    def _flush_pending_histos(self) -> None:
+        if not self._ph_rows:
+            return
+        h = self._histo
+        assert h is not None
+        rows = np.asarray(self._ph_rows, dtype=np.int32)
+        vals = np.asarray(self._ph_vals, dtype=np.float32)
+        wts = np.asarray(self._ph_wts, dtype=np.float32)
+        self._ph_rows, self._ph_vals, self._ph_wts = [], [], []
+
+        uniq, inverse = np.unique(rows, return_inverse=True)
+        scratch = h.num_rows - 1
+        k = _next_pow2(len(uniq), 64)
+        n = _next_pow2(len(vals), 256)
+        active = np.full(k, scratch, dtype=np.int32)
+        active[: len(uniq)] = uniq
+        lids = np.full(n, k - 1, dtype=np.int32)
+        lids[: len(vals)] = inverse
+        v = np.zeros(n, dtype=np.float32)
+        v[: len(vals)] = vals
+        w = np.zeros(n, dtype=np.float32)
+        w[: len(vals)] = wts
+
+        out = _histo_ingest_step(
+            h.means, h.weights, h.dmin, h.dmax, h.drecip,
+            h.lmin, h.lmax, h.lsum, h.lweight, h.lrecip,
+            jnp.asarray(active), jnp.asarray(lids), jnp.asarray(v),
+            jnp.asarray(w), compression=self.compression,
+        )
+        (h.means, h.weights, h.dmin, h.dmax, h.drecip,
+         h.lmin, h.lmax, h.lsum, h.lweight, h.lrecip) = out
+
+    def _flush_pending_sets(self) -> None:
+        if not self._ps_rows:
+            return
+        regs = self._sets
+        assert regs is not None
+        rows = np.asarray(self._ps_rows, dtype=np.int32)
+        idx = np.asarray(self._ps_idx, dtype=np.int32)
+        rank = np.asarray(self._ps_rank, dtype=np.int8)
+        self._ps_rows, self._ps_idx, self._ps_rank = [], [], []
+
+        n = _next_pow2(len(rows), 256)
+        scratch = regs.shape[0] - 1
+        prow = np.full(n, scratch, dtype=np.int32)
+        prow[: len(rows)] = rows
+        pidx = np.zeros(n, dtype=np.int32)
+        pidx[: len(rows)] = idx
+        prank = np.zeros(n, dtype=np.int8)
+        prank[: len(rows)] = rank
+        self._sets = hll_ops.insert_batch(
+            regs, jnp.asarray(prow), jnp.asarray(pidx), jnp.asarray(prank)
+        )
+
+    # -- import path (global tier) ------------------------------------------
+
+    def import_digest(
+        self, key: MetricKey, tags: list[str], mtype: str,
+        scope_class: ScopeClass, means: np.ndarray, weights: np.ndarray,
+        dmin: float, dmax: float, drecip: float,
+    ) -> None:
+        """Buffer a downstream instance's digest for row-wise merge at flush
+        (reference Histo.Merge path, worker.go:438-495)."""
+        self.imported += 1
+        row, _ = self.directory.upsert_histo(key, scope_class, tags)
+        self._ensure_histo(self.directory.num_histo_rows)
+        self._imp_digests.setdefault(row, []).append(
+            (np.asarray(means, np.float32), np.asarray(weights, np.float32),
+             float(dmin), float(dmax), float(drecip))
+        )
+
+    def import_hll(self, key: MetricKey, tags: list[str],
+                   scope_class: ScopeClass, registers: np.ndarray) -> None:
+        self.imported += 1
+        row, _ = self.directory.upsert_set(key, scope_class, tags)
+        self._ensure_sets(self.directory.num_set_rows)
+        prev = self._imp_hll.get(row)
+        regs = np.asarray(registers, np.int8)
+        self._imp_hll[row] = regs if prev is None else np.maximum(prev, regs)
+
+    def import_counter(self, key: MetricKey, tags: list[str],
+                       value: int) -> None:
+        """Imported counters are global by definition
+        (reference worker.go:404-407, 449-451)."""
+        self.imported += 1
+        self._host_counter(key, ScopeClass.GLOBAL, tags, int(value))
+
+    def import_gauge(self, key: MetricKey, tags: list[str],
+                     value: float) -> None:
+        self.imported += 1
+        self._host_gauge(key, ScopeClass.GLOBAL, tags, float(value))
+
+    def _merge_imports(self) -> None:
+        if self._imp_digests:
+            h = self._histo
+            assert h is not None
+            rows = sorted(self._imp_digests)
+            c = self.capacity
+            widths = {
+                r: sum(len(m) for m, *_ in self._imp_digests[r])
+                for r in rows
+            }
+            w_bucket = _next_pow2(max(widths.values()), c)
+            k = _next_pow2(len(rows), 16)
+            scratch = h.num_rows - 1
+            arows = np.full(k, scratch, dtype=np.int32)
+            imp_means = np.full((k, w_bucket), np.inf, dtype=np.float32)
+            imp_w = np.zeros((k, w_bucket), dtype=np.float32)
+            imp_min = np.full(k, np.inf, dtype=np.float32)
+            imp_max = np.full(k, -np.inf, dtype=np.float32)
+            imp_recip = np.zeros(k, dtype=np.float32)
+            for i, r in enumerate(rows):
+                arows[i] = r
+                off = 0
+                for m, wts, mn, mx, rc in self._imp_digests[r]:
+                    nz = wts > 0
+                    cnt = int(nz.sum())
+                    imp_means[i, off:off + cnt] = m[nz]
+                    imp_w[i, off:off + cnt] = wts[nz]
+                    off += cnt
+                    imp_min[i] = min(imp_min[i], mn)
+                    imp_max[i] = max(imp_max[i], mx)
+                    imp_recip[i] += rc
+            self._imp_digests = {}
+            out = _histo_import_step(
+                h.means, h.weights, h.dmin, h.dmax, h.drecip,
+                jnp.asarray(arows), jnp.asarray(imp_means),
+                jnp.asarray(imp_w), jnp.asarray(imp_min),
+                jnp.asarray(imp_max), jnp.asarray(imp_recip),
+                compression=self.compression,
+            )
+            h.means, h.weights, h.dmin, h.dmax, h.drecip = out
+
+        if self._imp_hll:
+            regs = self._sets
+            assert regs is not None
+            rows = sorted(self._imp_hll)
+            k = len(rows)
+            arows = np.asarray(rows, dtype=np.int32)
+            imp = np.stack([self._imp_hll[r] for r in rows])
+            self._imp_hll = {}
+            self._sets = regs.at[jnp.asarray(arows)].max(
+                jnp.asarray(imp), mode="drop"
+            )
+
+    # -- flush --------------------------------------------------------------
+
+    def flush(self, quantiles: np.ndarray, interval_s: float = 10.0
+              ) -> FlushSnapshot:
+        """Swap state and extract the finished interval.
+
+        quantiles: the percentile set to evaluate on device (the flusher
+        decides which rows' values are actually emitted).
+        """
+        self._flush_pending_histos()
+        self._flush_pending_sets()
+        self._merge_imports()
+
+        directory = self.directory
+        scalars = self.scalars
+        histo = self._histo
+        sets = self._sets
+        umts = self._umts
+        self.processed = 0
+        self.imported = 0
+        self._reset_epoch()
+
+        snap = FlushSnapshot(
+            directory=directory, scalars=scalars, interval_s=interval_s,
+            unique_timeseries_registers=umts,
+        )
+        if histo is not None and directory.num_histo_rows:
+            qs = jnp.asarray(np.asarray(quantiles, dtype=np.float32))
+            out = _histo_flush_extract(
+                histo.means, histo.weights, histo.dmin, histo.dmax,
+                histo.drecip, histo.lmin, histo.lmax, histo.lsum,
+                histo.lweight, histo.lrecip, qs,
+            )
+            (qv, dmin, dmax, dsum, dcount, drecip,
+             lmin, lmax, lsum, lweight, lrecip) = [np.asarray(a) for a in out]
+            n = directory.num_histo_rows
+            snap.quantile_values = qv[:n]
+            snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
+            snap.dmin, snap.dmax = dmin[:n], dmax[:n]
+            snap.dsum, snap.dcount, snap.drecip = dsum[:n], dcount[:n], drecip[:n]
+            snap.lmin, snap.lmax = lmin[:n], lmax[:n]
+            snap.lsum, snap.lweight, snap.lrecip = lsum[:n], lweight[:n], lrecip[:n]
+            snap.digest_means = np.asarray(histo.means)[:n]
+            snap.digest_weights = np.asarray(histo.weights)[:n]
+        if sets is not None and directory.num_set_rows:
+            n = directory.num_set_rows
+            snap.set_estimates = np.asarray(
+                hll_ops.estimate(sets, self.hll_precision)
+            )[:n]
+            snap.set_registers = np.asarray(sets)[:n]
+        return snap
