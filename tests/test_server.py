@@ -1,0 +1,242 @@
+"""End-to-end server tests over loopback sockets.
+
+Mirrors the reference's in-process fixture style (server_test.go:61-169):
+a full Server on ephemeral ports, a channel sink capturing flushes, and
+deterministic input vectors with value assertions
+(TestLocalServerMixedMetrics, server_test.go:299).
+"""
+
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.core.config import Config, load_config, parse_duration, redacted_dict
+from veneur_tpu.core.metrics import MetricType
+from veneur_tpu.core.server import Server, calculate_tick_delay
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+
+def _server(**cfg_kwargs) -> tuple[Server, ChannelMetricSink, dict]:
+    cfg = Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        num_workers=2,
+        num_readers=1,
+        interval="10s",
+        percentiles=[0.5, 0.99],
+        **cfg_kwargs,
+    )
+    sink = ChannelMetricSink()
+    srv = Server(cfg, metric_sinks=[sink])
+    ports = srv.start()
+    return srv, sink, ports
+
+
+def _send_udp(port: int, payload: bytes) -> None:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(payload, ("127.0.0.1", port))
+    s.close()
+
+
+def _wait_for(predicate, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_udp_ingest_to_flush():
+    srv, sink, ports = _server()
+    try:
+        port = next(iter(ports.values()))
+        for v in range(1, 101):
+            _send_udp(port, f"e2e.timer:{v}|ms".encode())
+        _send_udp(port, b"e2e.count:3|c\ne2e.count:4|c")  # multi-line datagram
+        _send_udp(port, b"e2e.gauge:1.5|g")
+        assert _wait_for(lambda: srv.packets_received >= 102)
+        assert _wait_for(
+            lambda: sum(w.processed for w in srv.workers) >= 103)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("e2e.count", MetricType.COUNTER)].value == 7.0
+        assert by_key[("e2e.gauge", MetricType.GAUGE)].value == 1.5
+        # local instance: aggregates only for the mixed timer
+        assert by_key[("e2e.timer.min", MetricType.GAUGE)].value == 1.0
+        assert by_key[("e2e.timer.max", MetricType.GAUGE)].value == 100.0
+        assert by_key[("e2e.timer.count", MetricType.COUNTER)].value == 100.0
+        # channel sink received the same flush
+        flushed = sink.queue.get(timeout=2)
+        assert len(flushed) == len(metrics)
+    finally:
+        srv.shutdown()
+
+
+def test_local_vs_global_percentiles():
+    # a server WITHOUT forward_address is global: percentiles emitted
+    srv, sink, ports = _server(forward_address="")
+    try:
+        port = next(iter(ports.values()))
+        for v in range(1, 101):
+            _send_udp(port, f"lat:{v}|h".encode())
+        assert _wait_for(lambda: sum(w.processed for w in srv.workers) >= 100)
+        metrics = srv.flush()
+        names = {m.name for m in metrics}
+        assert "lat.50percentile" in names
+        assert "lat.99percentile" in names
+    finally:
+        srv.shutdown()
+
+    # with forward_address set, it's local: no percentiles for mixed scope
+    srv2, _, ports2 = _server(forward_address="http://upstream:8127")
+    try:
+        port2 = next(iter(ports2.values()))
+        for v in range(1, 101):
+            _send_udp(port2, f"lat:{v}|h".encode())
+        assert _wait_for(lambda: sum(w.processed for w in srv2.workers) >= 100)
+        metrics = srv2.flush()
+        names = {m.name for m in metrics}
+        assert "lat.50percentile" not in names
+        assert "lat.min" in names
+    finally:
+        srv2.shutdown()
+
+
+def test_overlong_datagram_dropped():
+    srv, _, ports = _server()
+    try:
+        port = next(iter(ports.values()))
+        _send_udp(port, b"x" * 5000)
+        _send_udp(port, b"ok:1|c")
+        assert _wait_for(lambda: srv.packets_received >= 2)
+        assert srv.parse_errors >= 1
+        metrics = srv.flush()
+        assert any(m.name == "ok" for m in metrics)
+    finally:
+        srv.shutdown()
+
+
+def test_events_flow_to_other_samples():
+    srv, sink, ports = _server()
+    try:
+        port = next(iter(ports.values()))
+        _send_udp(port, b"_e{5,4}:title|text|t:warning")
+        _send_udp(port, b"_sc|svc|0|m:all good")
+        assert _wait_for(lambda: srv.packets_received >= 2)
+        metrics = srv.flush()
+        samples = sink.other_samples.get(timeout=2)
+        assert samples[0].name == "title"
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("svc", MetricType.STATUS)].value == 0.0
+    finally:
+        srv.shutdown()
+
+
+def test_tcp_listener():
+    cfg = Config(
+        statsd_listen_addresses=["tcp://127.0.0.1:0"],
+        interval="10s",
+    )
+    sink = ChannelMetricSink()
+    srv = Server(cfg, metric_sinks=[sink])
+    ports = srv.start()
+    try:
+        port = next(iter(ports.values()))
+        c = socket.create_connection(("127.0.0.1", port))
+        c.sendall(b"tcp.counter:5|c\ntcp.counter:6|c\n")
+        c.close()
+        assert _wait_for(lambda: sum(w.processed for w in srv.workers) >= 2)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("tcp.counter", MetricType.COUNTER)].value == 11.0
+    finally:
+        srv.shutdown()
+
+
+def test_flush_ticker_runs():
+    cfg = Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        interval="200ms",
+    )
+    sink = ChannelMetricSink()
+    srv = Server(cfg, metric_sinks=[sink])
+    ports = srv.start()
+    try:
+        port = next(iter(ports.values()))
+        _send_udp(port, b"tick:1|c")
+        assert _wait_for(lambda: sum(w.processed for w in srv.workers) >= 1)
+        flushed = sink.queue.get(timeout=5)
+        assert any(m.name == "tick" for m in flushed)
+    finally:
+        srv.shutdown()
+
+
+def test_sink_routing_and_excluded_tags():
+    srv, sink, ports = _server()
+    other = ChannelMetricSink()
+    other.name = lambda: "othersink"  # type: ignore[method-assign]
+    srv.metric_sinks.append(other)
+    srv.sink_excluded_tags["channel"] = {"secret"}
+    try:
+        port = next(iter(ports.values()))
+        _send_udp(port, b"routed:1|c|#veneursinkonly:othersink")
+        _send_udp(port, b"tagged:1|c|#secret:x,keep:y")
+        assert _wait_for(lambda: sum(w.processed for w in srv.workers) >= 2)
+        srv.flush()
+        channel_metrics = sink.queue.get(timeout=2)
+        other_metrics = other.queue.get(timeout=2)
+        ch_names = {m.name for m in channel_metrics}
+        assert "routed" not in ch_names  # routed exclusively to othersink
+        assert "routed" in {m.name for m in other_metrics}
+        tagged = [m for m in channel_metrics if m.name == "tagged"][0]
+        assert tagged.tags == ["keep:y"]  # excluded tag stripped
+        tagged_other = [m for m in other_metrics if m.name == "tagged"][0]
+        assert "secret:x" in tagged_other.tags
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Config
+
+
+def test_parse_duration():
+    assert parse_duration("10s") == 10.0
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration("2m30s") == 150.0
+    assert parse_duration("1h") == 3600.0
+    with pytest.raises(ValueError):
+        parse_duration("xyz")
+
+
+def test_load_config_yaml_env_overlay(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        "interval: 5s\n"
+        "percentiles: [0.5, 0.9]\n"
+        "forward_address: http://global:8127\n"
+        "datadog_api_key: sekrit\n"
+        "unknown_key_xyz: 1\n"
+    )
+    cfg = load_config(str(p), env={"VENEUR_HOSTNAME": "h1",
+                                   "VENEUR_NUMWORKERS": "3"})
+    assert cfg.interval_seconds() == 5.0
+    assert cfg.percentiles == [0.5, 0.9]
+    assert cfg.is_local()
+    assert cfg.hostname == "h1"
+    assert cfg.num_workers == 3
+    red = redacted_dict(cfg)
+    assert red["datadog_api_key"] == "REDACTED"
+
+
+def test_load_config_strict_rejects_unknown(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("no_such_key: true\n")
+    with pytest.raises(ValueError):
+        load_config(str(p), strict=True)
+
+
+def test_calculate_tick_delay():
+    assert calculate_tick_delay(10.0, 103.0) == pytest.approx(7.0)
+    assert calculate_tick_delay(10.0, 100.0) == pytest.approx(10.0)

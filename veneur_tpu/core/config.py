@@ -1,0 +1,345 @@
+"""Configuration: YAML file + VENEUR_* environment overlay.
+
+Parity spec: reference config.go:3-131 (field inventory), config_parse.go
+(strict-then-loose YAML parse with unknown-key warnings, envconfig overlay,
+defaults struct :14-30). The reference generates its struct from
+example.yaml; here the dataclass is the source of truth and yaml keys are
+derived from field names.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from dataclasses import dataclass, field, fields
+from typing import Any, Optional
+
+import yaml
+
+log = logging.getLogger("veneur_tpu.config")
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DURATION_UNITS = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+    "s": 1.0, "m": 60.0, "h": 3600.0,
+}
+
+
+def parse_duration(s: str) -> float:
+    """Go-style duration string → seconds ("10s", "500ms", "2m30s")."""
+    if not s:
+        raise ValueError("empty duration")
+    if s in ("0",):
+        return 0.0
+    pos = 0
+    total = 0.0
+    for m in _DURATION_RE.finditer(s):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration {s!r}")
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise ValueError(f"invalid duration {s!r}")
+    return total
+
+
+@dataclass
+class PerTagApiKey:
+    name: str = ""
+    api_key: str = ""
+
+
+@dataclass
+class ExcludeTagsPrefixByPrefixMetric:
+    metric_prefix: str = ""
+    tags: list[str] = field(default_factory=list)
+
+
+@dataclass
+class MetricsScopes:
+    counter: str = ""
+    gauge: str = ""
+    histogram: str = ""
+    set: str = ""
+    status: str = ""
+
+
+@dataclass
+class Config:
+    """Server configuration; field names are the yaml keys
+    (reference config.go:3-131)."""
+
+    # core pipeline
+    aggregates: list[str] = field(
+        default_factory=lambda: ["min", "max", "count"])
+    percentiles: list[float] = field(default_factory=list)
+    interval: str = "10s"
+    synchronize_with_interval: bool = False
+    metric_max_length: int = 4096
+    trace_max_length_bytes: int = 16 * 1024 * 1024
+    num_workers: int = 1
+    num_readers: int = 1
+    num_span_workers: int = 1
+    count_unique_timeseries: bool = False
+    flush_watchdog_missed_flushes: int = 0
+    flush_max_per_body: int = 0
+    flush_file: str = ""
+    omit_empty_hostname: bool = False
+    hostname: str = ""
+    tags: list[str] = field(default_factory=list)
+    tags_exclude: list[str] = field(default_factory=list)
+    span_channel_capacity: int = 100
+    ssf_buffer_size: int = 16 * 1024
+    read_buffer_size_bytes: int = 2 * 1048576
+
+    # listeners
+    statsd_listen_addresses: list[str] = field(default_factory=list)
+    ssf_listen_addresses: list[str] = field(default_factory=list)
+    http_address: str = ""
+    grpc_address: str = ""
+    http_quit: bool = False
+    stats_address: str = ""
+
+    # TLS
+    tls_key: str = ""
+    tls_certificate: str = ""
+    tls_authority_certificate: str = ""
+
+    # forwarding
+    forward_address: str = ""
+    forward_use_grpc: bool = False
+
+    # device / TPU execution
+    tpu_batch_size: int = 16384
+    tpu_compression: float = 100.0
+    tpu_hll_precision: int = 14
+    tpu_initial_histo_rows: int = 4096
+    tpu_initial_set_rows: int = 512
+
+    # self-telemetry & debugging
+    debug: bool = False
+    debug_flushed_metrics: bool = False
+    debug_ingested_spans: bool = False
+    enable_profiling: bool = False
+    block_profile_rate: int = 0
+    mutex_profile_fraction: int = 0
+    sentry_dsn: str = ""
+    veneur_metrics_additional_tags: list[str] = field(default_factory=list)
+    veneur_metrics_scopes: MetricsScopes = field(default_factory=MetricsScopes)
+
+    # spans → derived metrics
+    indicator_span_timer_name: str = ""
+    objective_span_timer_name: str = ""
+
+    # sink: datadog
+    datadog_api_hostname: str = ""
+    datadog_api_key: str = ""
+    datadog_flush_max_per_body: int = 25000
+    datadog_metric_name_prefix_drops: list[str] = field(default_factory=list)
+    datadog_exclude_tags_prefix_by_prefix_metric: list[
+        ExcludeTagsPrefixByPrefixMetric] = field(default_factory=list)
+    datadog_span_buffer_size: int = 1 << 14
+    datadog_trace_api_address: str = ""
+
+    # sink: signalfx
+    signalfx_api_key: str = ""
+    signalfx_dynamic_per_tag_api_keys_enable: bool = False
+    signalfx_dynamic_per_tag_api_keys_refresh_period: str = ""
+    signalfx_endpoint_base: str = ""
+    signalfx_endpoint_api: str = ""
+    signalfx_flush_max_per_body: int = 0
+    signalfx_hostname_tag: str = ""
+    signalfx_metric_name_prefix_drops: list[str] = field(default_factory=list)
+    signalfx_metric_tag_prefix_drops: list[str] = field(default_factory=list)
+    signalfx_per_tag_api_keys: list[PerTagApiKey] = field(default_factory=list)
+    signalfx_vary_key_by: str = ""
+
+    # sink: kafka
+    kafka_broker: str = ""
+    kafka_check_topic: str = ""
+    kafka_event_topic: str = ""
+    kafka_metric_topic: str = ""
+    kafka_span_topic: str = ""
+    kafka_metric_buffer_bytes: int = 0
+    kafka_metric_buffer_frequency: str = ""
+    kafka_metric_buffer_messages: int = 0
+    kafka_metric_require_acks: str = ""
+    kafka_partitioner: str = ""
+    kafka_retry_max: int = 0
+    kafka_span_buffer_bytes: int = 0
+    kafka_span_buffer_frequency: str = ""
+    kafka_span_buffer_mesages: int = 0
+    kafka_span_require_acks: str = ""
+    kafka_span_sample_rate_percent: float = 100.0
+    kafka_span_sample_tag: str = ""
+    kafka_span_serialization_format: str = "protobuf"
+
+    # sink: splunk
+    splunk_hec_address: str = ""
+    splunk_hec_token: str = ""
+    splunk_hec_batch_size: int = 100
+    splunk_hec_connection_lifetime_jitter: str = ""
+    splunk_hec_ingest_timeout: str = ""
+    splunk_hec_max_connection_lifetime: str = "10s"
+    splunk_hec_send_timeout: str = ""
+    splunk_hec_submission_workers: int = 1
+    splunk_hec_tls_validate_hostname: str = ""
+    splunk_span_sample_rate: int = 100
+
+    # sink: newrelic
+    newrelic_account_id: int = 0
+    newrelic_common_tags: list[str] = field(default_factory=list)
+    newrelic_event_type: str = ""
+    newrelic_insert_key: str = ""
+    newrelic_region: str = ""
+    newrelic_service_check_event_type: str = ""
+    newrelic_trace_observer_url: str = ""
+
+    # sink: lightstep
+    lightstep_access_token: str = ""
+    lightstep_collector_host: str = ""
+    lightstep_maximum_spans: int = 0
+    lightstep_num_clients: int = 0
+    lightstep_reconnect_period: str = ""
+    trace_lightstep_access_token: str = ""
+    trace_lightstep_collector_host: str = ""
+    trace_lightstep_maximum_spans: int = 0
+    trace_lightstep_num_clients: int = 0
+    trace_lightstep_reconnect_period: str = ""
+
+    # sink: xray
+    xray_address: str = ""
+    xray_annotation_tags: list[str] = field(default_factory=list)
+    xray_sample_percentage: float = 100.0
+
+    # sink: falconer (grpsink)
+    falconer_address: str = ""
+
+    # sink: prometheus repeater
+    prometheus_repeater_address: str = ""
+    prometheus_network_type: str = "tcp"
+
+    # plugins: s3
+    aws_access_key_id: str = ""
+    aws_secret_access_key: str = ""
+    aws_region: str = ""
+    aws_s3_bucket: str = ""
+
+    def interval_seconds(self) -> float:
+        return parse_duration(self.interval)
+
+    def is_local(self) -> bool:
+        """A server is 'local' iff it forwards upstream
+        (reference server.go:1489-1491)."""
+        return self.forward_address != ""
+
+
+SECRET_FIELDS = {
+    "datadog_api_key", "signalfx_api_key", "sentry_dsn",
+    "aws_access_key_id", "aws_secret_access_key", "newrelic_insert_key",
+    "splunk_hec_token", "lightstep_access_token",
+    "trace_lightstep_access_token", "tls_key",
+}
+
+
+def redacted_dict(cfg: Config) -> dict[str, Any]:
+    """Config as a dict with secrets masked, for debug logging
+    (reference server.go:794-802)."""
+    out = {}
+    for f in fields(cfg):
+        v = getattr(cfg, f.name)
+        if f.name in SECRET_FIELDS and v:
+            v = "REDACTED"
+        out[f.name] = v
+    return out
+
+
+class UnknownConfigKeys(Warning):
+    pass
+
+
+def _coerce(value: Any, target: Any, key: str) -> Any:
+    if isinstance(target, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(target, int) and not isinstance(target, bool):
+        return int(value)
+    if isinstance(target, float):
+        return float(value)
+    if isinstance(target, list):
+        if isinstance(value, str):
+            return [v for v in value.split(",") if v]
+        return list(value)
+    return value
+
+
+def load_config(path: Optional[str] = None, data: Optional[dict] = None,
+                env: Optional[dict] = None, strict: bool = False) -> Config:
+    """Read config: yaml → env overlay → defaults.
+
+    Unknown yaml keys warn (the reference falls back from strict to loose
+    parse, config_parse.go:115). Environment variables named VENEUR_<KEY>
+    (yaml key uppercased, with or without underscores) override file values
+    (reference envconfig overlay).
+    """
+    raw: dict[str, Any] = {}
+    if path is not None:
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+    if data is not None:
+        raw.update(data)
+
+    cfg = Config()
+    known = {f.name: f for f in fields(cfg)}
+    unknown = []
+    for key, value in raw.items():
+        if key not in known:
+            unknown.append(key)
+            continue
+        if value is None:
+            continue
+        current = getattr(cfg, key)
+        if key == "veneur_metrics_scopes" and isinstance(value, dict):
+            setattr(cfg, key, MetricsScopes(**value))
+        elif key == "signalfx_per_tag_api_keys":
+            setattr(cfg, key, [PerTagApiKey(**v) for v in value])
+        elif key == "datadog_exclude_tags_prefix_by_prefix_metric":
+            setattr(cfg, key,
+                    [ExcludeTagsPrefixByPrefixMetric(**v) for v in value])
+        else:
+            setattr(cfg, key, _coerce(value, current, key))
+    if unknown:
+        msg = f"unknown config keys: {sorted(unknown)}"
+        if strict:
+            raise ValueError(msg)
+        log.warning(msg)
+
+    env = os.environ if env is None else env
+    for name in known:
+        for candidate in (
+            "VENEUR_" + name.upper(),
+            "VENEUR_" + name.upper().replace("_", ""),
+        ):
+            if candidate in env:
+                setattr(
+                    cfg, name, _coerce(env[candidate], getattr(cfg, name), name)
+                )
+                break
+
+    validate_config(cfg)
+    return cfg
+
+
+def validate_config(cfg: Config) -> None:
+    parse_duration(cfg.interval)  # raises on nonsense
+    if cfg.interval_seconds() <= 0:
+        raise ValueError("interval must be positive")
+    for p in cfg.percentiles:
+        if not (0 <= p <= 1):
+            raise ValueError(f"percentile {p} out of [0,1]")
+    if cfg.num_workers < 1 or cfg.num_readers < 1:
+        raise ValueError("num_workers and num_readers must be >= 1")
+    if not (4 <= cfg.tpu_hll_precision <= 18):
+        raise ValueError("tpu_hll_precision must be in [4,18]")

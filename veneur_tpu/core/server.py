@@ -1,0 +1,414 @@
+"""The Server: listeners → parser → device workers → flush loop → sinks.
+
+Parity spec: reference server.go — NewFromConfig (:262), Start (:826),
+HandleMetricPacket (:994), processMetricPacket (:1136), ReadMetricSocket
+(:1123), TCP/TLS statsd (:1254-1335, networking.go:97), flush ticker with
+clock alignment (:908-946, CalculateTickDelay :1517), FlushWatchdog
+(:948-990), Shutdown (:1473). Ingest listeners are OS threads (socket reads
+release the GIL); aggregation is batched onto the device by DeviceWorker.
+
+The reference shards series across N workers by Digest%N (server.go:1028,
+1039) so each series lives in exactly one sampler; we keep the same routing
+(it also keeps every series in exactly one device-pool row).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import ssl
+import threading
+import time
+from typing import Callable, Optional
+
+from veneur_tpu import __version__
+from veneur_tpu.core.config import Config, parse_duration
+from veneur_tpu.core.flusher import device_quantiles, generate_inter_metrics
+from veneur_tpu.core.metrics import HistogramAggregates, InterMetric
+from veneur_tpu.core.worker import DeviceWorker, FlushSnapshot
+from veneur_tpu.protocol import dogstatsd
+from veneur_tpu.sinks import (
+    MetricSink,
+    SpanSink,
+    filter_routed,
+    strip_excluded_tags,
+)
+from veneur_tpu.ssf import SSFSample
+
+log = logging.getLogger("veneur_tpu.server")
+
+
+class EventWorker:
+    """Accumulates DogStatsD events (as SSF samples) until flush
+    (reference EventWorker, worker.go:527-572)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._samples: list[SSFSample] = []
+
+    def ingest(self, sample: SSFSample) -> None:
+        with self._lock:
+            self._samples.append(sample)
+
+    def flush(self) -> list[SSFSample]:
+        with self._lock:
+            out = self._samples
+            self._samples = []
+        return out
+
+
+def calculate_tick_delay(interval_s: float, now: float) -> float:
+    """Seconds until the next interval-aligned tick
+    (reference CalculateTickDelay, server.go:1517)."""
+    return interval_s - (now % interval_s)
+
+
+class Server:
+    """One veneur_tpu instance (local or global)."""
+
+    def __init__(self, cfg: Config,
+                 metric_sinks: Optional[list[MetricSink]] = None,
+                 span_sinks: Optional[list[SpanSink]] = None) -> None:
+        self.config = cfg
+        self.interval = cfg.interval_seconds()
+        self.hostname = cfg.hostname or (
+            "" if cfg.omit_empty_hostname else socket.gethostname())
+        self.tags = list(cfg.tags)
+        self.percentiles = list(cfg.percentiles)
+        self.aggregates = HistogramAggregates.from_names(cfg.aggregates)
+
+        self.workers = [
+            DeviceWorker(
+                batch_size=cfg.tpu_batch_size,
+                compression=cfg.tpu_compression,
+                hll_precision=cfg.tpu_hll_precision,
+                initial_histo_rows=cfg.tpu_initial_histo_rows,
+                initial_set_rows=cfg.tpu_initial_set_rows,
+                count_unique_timeseries=cfg.count_unique_timeseries,
+                is_local=self.is_local,
+            )
+            for _ in range(cfg.num_workers)
+        ]
+        self._worker_locks = [threading.Lock() for _ in self.workers]
+        self.event_worker = EventWorker()
+
+        self.metric_sinks: list[MetricSink] = list(metric_sinks or [])
+        self.span_sinks: list[SpanSink] = list(span_sinks or [])
+        self.sink_excluded_tags: dict[str, set[str]] = {}
+
+        # installed by distributed/forward.py on local instances
+        self.forwarder: Optional[Callable[[list[FlushSnapshot]], None]] = None
+        # installed by protocol/ssf_server.py for span ingest
+        self.span_handler = None
+
+        self._threads: list[threading.Thread] = []
+        self._sockets: list[socket.socket] = []
+        self._shutdown = threading.Event()
+        self.last_flush_unix = time.time()
+        self.flush_count = 0
+
+        # ingest counters (self-telemetry)
+        self.packets_received = 0
+        self.parse_errors = 0
+
+    @property
+    def is_local(self) -> bool:
+        return self.config.is_local()
+
+    # -- packet handling ----------------------------------------------------
+
+    def handle_metric_packet(self, packet: bytes) -> None:
+        """Dispatch one line: event / service check / metric
+        (reference HandleMetricPacket, server.go:994-1046)."""
+        if not packet:
+            return
+        try:
+            if packet.startswith(b"_e{"):
+                sample = dogstatsd.parse_event(packet)
+                self.event_worker.ingest(sample)
+            elif packet.startswith(b"_sc"):
+                metric = dogstatsd.parse_service_check(packet)
+                self._route(metric)
+            else:
+                metric = dogstatsd.parse_metric(packet)
+                self._route(metric)
+        except dogstatsd.ParseError as e:
+            self.parse_errors += 1
+            log.debug("bad metric packet %r: %s", packet[:128], e)
+
+    def _route(self, metric) -> None:
+        i = metric.digest % len(self.workers)
+        with self._worker_locks[i]:
+            self.workers[i].process_metric(metric)
+
+    def process_metric_packet(self, datagram: bytes) -> None:
+        """Split a datagram on newlines and handle each line
+        (reference processMetricPacket, server.go:1136)."""
+        self.packets_received += 1
+        if len(datagram) > self.config.metric_max_length:
+            self.parse_errors += 1
+            log.debug("overlong metric datagram (%d bytes)", len(datagram))
+            return
+        for line in datagram.split(b"\n"):
+            if line:
+                self.handle_metric_packet(line)
+
+    # -- listeners ----------------------------------------------------------
+
+    def _spawn(self, target, name: str) -> None:
+        t = threading.Thread(target=target, name=name, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def start_statsd_udp(self, addr: str, port: int) -> int:
+        """N reader threads sharing the port via SO_REUSEPORT
+        (reference networking.go:41-91, socket_linux.go)."""
+        bound_port = port
+        for i in range(self.config.num_readers):
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if self.config.num_readers > 1:
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            if self.config.read_buffer_size_bytes:
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                self.config.read_buffer_size_bytes)
+            sock.bind((addr, bound_port))
+            bound_port = sock.getsockname()[1]  # resolve port 0 once
+            self._sockets.append(sock)
+            self._spawn(
+                lambda s=sock: self._read_metric_socket(s),
+                f"statsd-udp-{i}",
+            )
+        return bound_port
+
+    def _read_metric_socket(self, sock: socket.socket) -> None:
+        """reference ReadMetricSocket (server.go:1123): tight recv loop.
+        Reads max_length+1 so overlong datagrams are detectable."""
+        bufsize = self.config.metric_max_length + 1
+        while not self._shutdown.is_set():
+            try:
+                data = sock.recv(bufsize)
+            except OSError:
+                return  # socket closed during shutdown
+            self.process_metric_packet(data)
+
+    def start_statsd_tcp(self, addr: str, port: int) -> int:
+        """Line-delimited TCP statsd, optional (mutual) TLS
+        (reference server.go:1254-1335, TLS setup :438-472)."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((addr, port))
+        sock.listen(128)
+        bound_port = sock.getsockname()[1]
+        self._sockets.append(sock)
+
+        ssl_ctx = None
+        if self.config.tls_key and self.config.tls_certificate:
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(self.config.tls_certificate,
+                                    self.config.tls_key)
+            if self.config.tls_authority_certificate:
+                ssl_ctx.load_verify_locations(
+                    self.config.tls_authority_certificate)
+                ssl_ctx.verify_mode = ssl.CERT_REQUIRED
+
+        def accept_loop():
+            while not self._shutdown.is_set():
+                try:
+                    conn, peer = sock.accept()
+                except OSError:
+                    return
+                self._spawn(
+                    lambda c=conn, p=peer: self._handle_tcp_conn(c, p, ssl_ctx),
+                    "statsd-tcp-conn",
+                )
+
+        self._spawn(accept_loop, "statsd-tcp-accept")
+        return bound_port
+
+    def _handle_tcp_conn(self, conn: socket.socket, peer, ssl_ctx) -> None:
+        """reference handleTCPGoroutine (server.go:1254-1335)."""
+        try:
+            if ssl_ctx is not None:
+                conn = ssl_ctx.wrap_socket(conn, server_side=True)
+            conn.settimeout(10.0 * self.interval)
+            buf = b""
+            while not self._shutdown.is_set():
+                data = conn.recv(65536)
+                if not data:
+                    break
+                buf += data
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if len(line) > self.config.metric_max_length:
+                        self.parse_errors += 1
+                        continue
+                    if line:
+                        self.handle_metric_packet(line)
+            # trailing partial line without newline still counts
+            if buf and len(buf) <= self.config.metric_max_length:
+                self.handle_metric_packet(buf)
+        except (OSError, ssl.SSLError) as e:
+            log.debug("tcp statsd conn from %s error: %s", peer, e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def start_statsd_unixgram(self, path: str) -> None:
+        """Datagram unix socket statsd (reference networking.go:144-196).
+        Stale socket files are unlinked before bind."""
+        if os.path.exists(path):
+            os.unlink(path)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        sock.bind(path)
+        self._sockets.append(sock)
+        self._spawn(lambda: self._read_metric_socket(sock), "statsd-unixgram")
+
+    def start_listeners(self) -> dict[str, int]:
+        """Start every configured statsd listener; returns resolved ports
+        keyed by address string (reference StartStatsd, networking.go:19)."""
+        ports = {}
+        for spec in self.config.statsd_listen_addresses:
+            proto, _, rest = spec.partition("://")
+            if proto == "udp":
+                host, _, port = rest.rpartition(":")
+                ports[spec] = self.start_statsd_udp(host or "127.0.0.1",
+                                                    int(port))
+            elif proto == "tcp":
+                host, _, port = rest.rpartition(":")
+                ports[spec] = self.start_statsd_tcp(host or "127.0.0.1",
+                                                    int(port))
+            elif proto == "unixgram":
+                self.start_statsd_unixgram(rest)
+            else:
+                raise ValueError(f"unsupported statsd listener {spec!r}")
+        return ports
+
+    # -- flush loop ---------------------------------------------------------
+
+    def start(self) -> dict[str, int]:
+        """Start listeners, sinks and the flush ticker
+        (reference Server.Start, server.go:826)."""
+        for sink in self.metric_sinks + self.span_sinks:
+            sink.start()
+        ports = self.start_listeners()
+        self._spawn(self._flush_loop, "flush-ticker")
+        return ports
+
+    def _flush_loop(self) -> None:
+        """Interval ticker, optionally aligned to the wall clock
+        (reference server.go:908-946)."""
+        if self.config.synchronize_with_interval:
+            time.sleep(calculate_tick_delay(self.interval, time.time()))
+        next_tick = time.time()
+        while not self._shutdown.is_set():
+            next_tick += self.interval
+            delay = next_tick - time.time()
+            if delay > 0 and self._shutdown.wait(delay):
+                return
+            try:
+                self.flush()
+            except Exception:
+                log.exception("flush failed")
+
+    def flush(self) -> list[InterMetric]:
+        """One flush pass (reference Server.Flush, flusher.go:28-134)."""
+        self.last_flush_unix = time.time()
+        self.flush_count += 1
+
+        other_samples = self.event_worker.flush()
+        for sink in self.metric_sinks:
+            try:
+                sink.flush_other_samples(other_samples)
+            except Exception:
+                log.exception("sink %s FlushOtherSamples failed", sink.name())
+
+        for sink in self.span_sinks:
+            try:
+                sink.flush()
+            except Exception:
+                log.exception("span sink %s flush failed", sink.name())
+
+        qs = device_quantiles(self.percentiles, self.aggregates)
+        snaps: list[FlushSnapshot] = []
+        for worker, lock in zip(self.workers, self._worker_locks):
+            with lock:
+                snaps.append(worker.flush(qs, self.interval))
+
+        final: list[InterMetric] = []
+        for snap in snaps:
+            final.extend(
+                generate_inter_metrics(
+                    snap, self.is_local, self.percentiles, self.aggregates
+                )
+            )
+
+        if self.is_local and self.forwarder is not None:
+            fwd_thread = threading.Thread(
+                target=self.forwarder, args=(snaps,), daemon=True,
+                name="forward",
+            )
+            fwd_thread.start()
+
+        if final:
+            threads = []
+            for sink in self.metric_sinks:
+                routed = filter_routed(final, sink.name())
+                routed = strip_excluded_tags(
+                    routed, self.sink_excluded_tags.get(sink.name()))
+                t = threading.Thread(
+                    target=self._flush_sink, args=(sink, routed),
+                    daemon=True, name=f"flush-{sink.name()}",
+                )
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=self.interval)
+        return final
+
+    @staticmethod
+    def _flush_sink(sink: MetricSink, metrics: list[InterMetric]) -> None:
+        try:
+            sink.flush(metrics)
+        except Exception:
+            log.exception("sink %s flush failed", sink.name())
+
+    # -- watchdog -----------------------------------------------------------
+
+    def flush_watchdog(self) -> None:
+        """Die if flushes stop happening, so process supervision restarts us
+        (reference FlushWatchdog, server.go:948-990)."""
+        missed = self.config.flush_watchdog_missed_flushes
+        if missed == 0:
+            return
+        while not self._shutdown.is_set():
+            if self._shutdown.wait(self.interval):
+                return
+            overdue = time.time() - self.last_flush_unix
+            if overdue > missed * self.interval:
+                log.critical(
+                    "flush watchdog: no flush for %.1fs (> %d intervals);"
+                    " aborting", overdue, missed,
+                )
+                os._exit(2)
+
+    def start_watchdog(self) -> None:
+        self._spawn(self.flush_watchdog, "flush-watchdog")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """reference Server.Shutdown (server.go:1473)."""
+        self._shutdown.set()
+        for sock in self._sockets:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    @property
+    def version(self) -> str:
+        return __version__

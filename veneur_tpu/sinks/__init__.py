@@ -1,0 +1,79 @@
+"""Sink interfaces and registry.
+
+Parity spec: reference sinks/sinks.go — MetricSink (:32-47), SpanSink
+(:85-103), and the canonical self-telemetry metric names (:11-29, :60-78).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, Optional
+
+from veneur_tpu.core.metrics import InterMetric, route_to
+from veneur_tpu.ssf import SSFSample, SSFSpan
+
+# Canonical sink self-telemetry metric names (reference sinks/sinks.go:11-29)
+METRIC_KEY_TOTAL_SPANS_FLUSHED = "sink.spans_flushed_total"
+METRIC_KEY_TOTAL_SPANS_DROPPED = "sink.spans_dropped_total"
+METRIC_KEY_TOTAL_METRICS_FLUSHED = "sink.metrics_flushed_total"
+METRIC_KEY_TOTAL_METRICS_SKIPPED = "sink.metrics_skipped_total"
+
+
+class MetricSink(abc.ABC):
+    """A destination for flushed metrics (reference sinks/sinks.go:32-47)."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    def start(self, trace_client=None) -> None:
+        """Called once before the server starts flushing."""
+
+    @abc.abstractmethod
+    def flush(self, metrics: list[InterMetric]) -> None: ...
+
+    def flush_other_samples(self, samples: list[SSFSample]) -> None:
+        """Receive 'other' samples (events, service checks carried as SSF);
+        sinks that can't represent them drop them."""
+
+
+class SpanSink(abc.ABC):
+    """A destination for trace spans (reference sinks/sinks.go:85-103)."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    def start(self, trace_client=None) -> None: ...
+
+    @abc.abstractmethod
+    def ingest(self, span: SSFSpan) -> None: ...
+
+    def flush(self) -> None: ...
+
+
+def filter_routed(metrics: Iterable[InterMetric], sink_name: str
+                  ) -> list[InterMetric]:
+    """Apply veneursinkonly: routing for one sink
+    (reference sinks route check via RouteInformation.RouteTo)."""
+    return [m for m in metrics if route_to(m.sinks, sink_name)]
+
+
+def strip_excluded_tags(metrics: list[InterMetric],
+                        excluded: Optional[set[str]]) -> list[InterMetric]:
+    """Per-sink tag exclusion (reference setSinkExcludedTags,
+    server.go:1522-1548): drops matching "key" or "key:value" tags."""
+    if not excluded:
+        return metrics
+    out = []
+    for m in metrics:
+        tags = [
+            t for t in m.tags
+            if t.split(":", 1)[0] not in excluded
+        ]
+        if len(tags) != len(m.tags):
+            m = InterMetric(
+                name=m.name, timestamp=m.timestamp, value=m.value, tags=tags,
+                type=m.type, message=m.message, hostname=m.hostname,
+                sinks=m.sinks,
+            )
+        out.append(m)
+    return out
