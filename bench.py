@@ -1,0 +1,84 @@
+"""Benchmark: batched sketch-aggregation throughput on one chip.
+
+Workload: the DogStatsD timer-replay configuration (BASELINE.md) — S
+histogram series, every interval each series receives a stream of timer
+samples; the chip folds fixed-size batches into the t-digest pool (sort +
+arcsine-bucket compress over all series at once) and extracts the percentile
+set at flush. The reported metric is raw-sample throughput through the
+aggregation kernel, the analog of the reference's ingest packets/sec
+(README.md:309: >60k packets/sec/instance in production — the vs_baseline
+denominator).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: VENEUR_BENCH_SERIES (default 16384), VENEUR_BENCH_BATCH (default
+1048576), VENEUR_BENCH_ITERS (default 20).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import tdigest as td
+
+    series = int(os.environ.get("VENEUR_BENCH_SERIES", 16384))
+    batch = int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 20))
+    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 20))
+
+    rng = np.random.default_rng(42)
+    pool = td.init_pool(series, td.DEFAULT_CAPACITY)
+    state = [pool.means, pool.weights, pool.min, pool.max, pool.recip]
+
+    # two pre-staged input batches, alternated so no result is ever reused
+    batches = []
+    for _ in range(2):
+        rows = rng.integers(0, series, batch).astype(np.int32)
+        vals = rng.gamma(2.0, 50.0, batch).astype(np.float32)
+        wts = np.ones(batch, np.float32)
+        batches.append(
+            (jnp.asarray(rows), jnp.asarray(vals), jnp.asarray(wts))
+        )
+    qs = jnp.asarray(np.array([0.5, 0.9, 0.99], np.float32))
+
+    def ingest(state, b):
+        means, weights, dmin, dmax, drecip, _ = td.add_batch(
+            state[0], state[1], state[2], state[3], state[4],
+            b[0], b[1], b[2],
+        )
+        return [means, weights, dmin, dmax, drecip]
+
+    # warmup / compile
+    state = ingest(state, batches[0])
+    state = ingest(state, batches[1])
+    quant = td.quantile(state[0], state[1], state[2], state[3], qs)
+    quant.block_until_ready()
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state = ingest(state, batches[i % 2])
+    quant = td.quantile(state[0], state[1], state[2], state[3], qs)
+    quant.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    total_samples = iters * batch
+    rate = total_samples / elapsed
+    baseline = 60000.0  # reference production ingest packets/sec
+    print(json.dumps({
+        "metric": "histo_samples_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(rate / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,191 @@
+"""Device-mesh aggregation: the distributed tier as XLA collectives.
+
+The reference's distributed design (SURVEY.md §2.3/§5.7-5.8): N local
+instances each aggregate a shard of traffic, then forward mergeable sketches
+over gRPC to global instances that reduce them per series. Veneur's
+parallelism strategies map onto the device mesh as:
+
+  axis "series" — the reference's in-process worker sharding
+                  (Digest % N, server.go:1039): each device owns a
+                  contiguous shard of series rows. No communication is
+                  needed on this axis: metric identity → row → shard is
+                  deterministic, like the consistent-hash ring of the proxy
+                  tier (proxy.go:587-628).
+  axis "hosts"  — the local→global aggregation tier (importsrv →
+                  worker.go:438-495): each host-rank aggregates its own
+                  traffic for the *same* series space, and the global
+                  reduce becomes collectives over ICI instead of per-series
+                  Go loops: all_gather of digest centroid rows + one batched
+                  compress for t-digests, psum-style max for HLL registers,
+                  psum for counters.
+
+When real deployments span machines, the host boundary still speaks the
+protobuf sketch codec (distributed/codec.py); this module covers the
+single-process multi-chip mesh where the whole reduce rides ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from veneur_tpu.ops import tdigest as td
+from veneur_tpu.ops import hll as hll_ops
+
+
+def make_mesh(n_devices: Optional[int] = None, hosts: Optional[int] = None
+              ) -> Mesh:
+    """Build a (hosts, series) mesh over the first n devices.
+
+    hosts defaults to 2 when the device count is even (so the cross-host
+    reduce path is exercised), else 1.
+    """
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    devs = devs[:n]
+    if hosts is None:
+        hosts = 2 if n % 2 == 0 and n >= 2 else 1
+    if n % hosts:
+        raise ValueError(f"{n} devices not divisible by hosts={hosts}")
+    arr = np.array(devs).reshape(hosts, n // hosts)
+    return Mesh(arr, ("hosts", "series"))
+
+
+def _local_aggregate_step(means, weights, dmin, dmax, drecip,
+                          rows, values, wts, qs, compression):
+    """Per-device block: ingest this host-shard's batch into its series
+    rows, then reduce digests across the hosts axis and extract quantiles.
+
+    Shapes inside shard_map (leading mesh dims stripped to 1):
+      means/weights: [1, s_loc, C]; dmin/dmax/drecip: [1, s_loc]
+      rows/values/wts: [1, n_loc]; qs: [P] (replicated)
+    """
+    m = means[0]
+    w = weights[0]
+    mn = dmin[0]
+    mx = dmax[0]
+    rc = drecip[0]
+
+    n_m, n_w, n_mn, n_mx, n_rc, _stats = td.add_batch(
+        m, w, mn, mx, rc, rows[0], values[0], wts[0],
+        compression=compression,
+    )
+
+    # cross-host digest reduce over ICI: gather every host's centroid rows
+    # for the series this device owns, merge in one batched compress
+    g_means = jax.lax.all_gather(n_m, "hosts")  # [H, s_loc, C]
+    g_w = jax.lax.all_gather(n_w, "hosts")
+    g_mn = jax.lax.pmin(n_mn, "hosts")
+    g_mx = jax.lax.pmax(n_mx, "hosts")
+    g_rc = jax.lax.psum(n_rc, "hosts")
+
+    h, s_loc, c = g_means.shape
+    cat_means = jnp.transpose(g_means, (1, 0, 2)).reshape(s_loc, h * c)
+    cat_w = jnp.transpose(g_w, (1, 0, 2)).reshape(s_loc, h * c)
+    mg_means, mg_w = td.compress_rows(cat_means, cat_w, compression, c)
+
+    quant = td.quantile(mg_means, mg_w, g_mn, g_mx, qs)  # [s_loc, P]
+
+    return (n_m[None], n_w[None], n_mn[None], n_mx[None], n_rc[None],
+            quant[None])
+
+
+def build_sharded_flush_step(mesh: Mesh,
+                             compression: float = td.DEFAULT_COMPRESSION):
+    """Jit the fused multi-chip aggregation+reduce+extract step.
+
+    Logical shapes:
+      means/weights: f32[H, S, C]   sharded (hosts, series, -)
+      dmin/dmax/drecip: f32[H, S]   sharded (hosts, series)
+      rows: i32[H, N] values/wts: f32[H, N]  sharded (hosts, series)
+        — each (host, series-shard) device gets its own batch slice whose
+          row ids are LOCAL to its series shard
+      qs: f32[P] replicated
+    Returns (updated per-host state..., quantiles f32[H', S, P]) where the
+    quantile output's host dim is the per-device copy of the merged result.
+    """
+    spec_state2 = P("hosts", "series", None)
+    spec_state1 = P("hosts", "series")
+    spec_batch = P("hosts", "series")
+    spec_q = P(None)
+
+    fn = shard_map(
+        functools.partial(_local_aggregate_step, compression=compression),
+        mesh=mesh,
+        in_specs=(spec_state2, spec_state2, spec_state1, spec_state1,
+                  spec_state1, spec_batch, spec_batch, spec_batch, spec_q),
+        out_specs=(spec_state2, spec_state2, spec_state1, spec_state1,
+                   spec_state1, P("hosts", "series", None)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def make_example_state(mesh: Mesh, s_per_shard: int = 8, n_per_shard: int = 64,
+                       capacity: int = td.DEFAULT_CAPACITY, p: int = 3):
+    """Tiny sharded example inputs for the sharded flush step."""
+    hosts = mesh.shape["hosts"]
+    series_shards = mesh.shape["series"]
+    s = s_per_shard * series_shards
+    n = n_per_shard * series_shards
+
+    def shard(arr, spec):
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    rng = np.random.default_rng(0)
+    means = shard(np.full((hosts, s, capacity), np.inf, np.float32),
+                  P("hosts", "series", None))
+    weights = shard(np.zeros((hosts, s, capacity), np.float32),
+                    P("hosts", "series", None))
+    dmin = shard(np.full((hosts, s), np.inf, np.float32), P("hosts", "series"))
+    dmax = shard(np.full((hosts, s), -np.inf, np.float32),
+                 P("hosts", "series"))
+    drecip = shard(np.zeros((hosts, s), np.float32), P("hosts", "series"))
+    # per-device-local row ids in [0, s_per_shard)
+    rows = shard(
+        rng.integers(0, s_per_shard, (hosts, n)).astype(np.int32),
+        P("hosts", "series"))
+    values = shard(rng.uniform(1, 100, (hosts, n)).astype(np.float32),
+                   P("hosts", "series"))
+    wts = shard(np.ones((hosts, n), np.float32), P("hosts", "series"))
+    qs = jnp.asarray(np.linspace(0.25, 0.99, p, dtype=np.float32))
+    return (means, weights, dmin, dmax, drecip, rows, values, wts, qs)
+
+
+# ---------------------------------------------------------------------------
+# Standalone collective merges (used by the global tier when local+global
+# shards share a pod)
+
+
+def build_hll_merge(mesh: Mesh):
+    """HLL register merge across hosts: elementwise max collective."""
+
+    def _merge(regs):  # [1, s_loc, m]
+        return jax.lax.pmax(regs[0], "hosts")[None]
+
+    return jax.jit(shard_map(
+        _merge, mesh=mesh,
+        in_specs=(P("hosts", "series", None),),
+        out_specs=P("hosts", "series", None),
+        check_vma=False,
+    ))
+
+
+def build_counter_merge(mesh: Mesh):
+    """Counter sum across hosts (the trivial segment-sum analog)."""
+
+    def _merge(vals):  # [1, s_loc]
+        return jax.lax.psum(vals[0], "hosts")[None]
+
+    return jax.jit(shard_map(
+        _merge, mesh=mesh,
+        in_specs=(P("hosts", "series"),),
+        out_specs=P("hosts", "series"),
+        check_vma=False,
+    ))
