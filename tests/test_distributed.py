@@ -1,0 +1,279 @@
+"""Distributed-tier tests: multi-node behavior in one process.
+
+Mirrors the reference's fixtures: forward_grpc_test.go (real gRPC listeners
+on ephemeral ports), proxy_test.go (consistent-forward, unreachable
+destinations), importsrv/server_test.go (consistent-hash property),
+consul_discovery_test.go (stubbed HTTP responses).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.flusher import device_quantiles
+from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+from veneur_tpu.core.server import Server
+from veneur_tpu.distributed import codec
+from veneur_tpu.distributed.discovery import (
+    ConsulDiscoverer,
+    KubernetesDiscoverer,
+)
+from veneur_tpu.distributed.forward import (
+    GRPCForwarder,
+    HTTPForwarder,
+    install_forwarder,
+)
+from veneur_tpu.distributed.import_server import ImportHTTPServer, ImportServer
+from veneur_tpu.distributed.proxy import DestinationRefresher, ProxyServer
+from veneur_tpu.distributed.ring import ConsistentRing
+from veneur_tpu.protocol.dogstatsd import parse_metric
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+PCTS = [0.5, 0.99]
+
+
+def _global_server() -> tuple[Server, ImportServer, int]:
+    cfg = Config(interval="10s", percentiles=PCTS, num_workers=2)
+    srv = Server(cfg)
+    imp = ImportServer(srv)
+    port = imp.start_grpc()
+    return srv, imp, port
+
+
+def _local_server(forward_port: int, use_grpc=True) -> Server:
+    cfg = Config(
+        interval="10s", percentiles=PCTS,
+        forward_address=(f"127.0.0.1:{forward_port}" if use_grpc
+                         else f"http://127.0.0.1:{forward_port}"),
+        forward_use_grpc=use_grpc,
+    )
+    srv = Server(cfg)
+    install_forwarder(srv)
+    return srv
+
+
+def _ingest_histo(srv: Server, name: str, values) -> None:
+    for v in values:
+        m = parse_metric(f"{name}:{v}|h".encode())
+        srv.workers[m.digest % len(srv.workers)].process_metric(m)
+
+
+def _flush_global(srv: Server):
+    qs = device_quantiles(PCTS, AGGS)
+    metrics = []
+    from veneur_tpu.core.flusher import generate_inter_metrics
+    for w, lock in zip(srv.workers, srv._worker_locks):
+        with lock:
+            snap = w.flush(qs, 10.0)
+        metrics.extend(generate_inter_metrics(snap, False, PCTS, AGGS))
+    return {(m.name, m.type): m for m in metrics}
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_grpc_forward_to_global():
+    gsrv, imp, port = _global_server()
+    try:
+        local = _local_server(port)
+        rng = np.random.default_rng(1)
+        vals = rng.normal(50, 5, 4000)
+        _ingest_histo(local, "fwd.lat", vals)
+        local.workers[0].process_metric(
+            parse_metric(b"fwd.count:9|c|#veneurglobalonly"))
+        for i in range(300):
+            m = parse_metric(f"fwd.set:u{i}|s".encode())
+            local.workers[m.digest % len(local.workers)].process_metric(m)
+
+        local.flush()  # runs the forwarder in a background thread
+        assert _wait_until(lambda: imp.received_metrics >= 3)
+
+        by_key = _flush_global(gsrv)
+        p50 = by_key[("fwd.lat.50percentile", MetricType.GAUGE)].value
+        assert abs(p50 - np.quantile(vals, 0.5)) < 0.5
+        assert by_key[("fwd.count", MetricType.COUNTER)].value == 9.0
+        est = by_key[("fwd.set", MetricType.GAUGE)].value
+        assert abs(est - 300) / 300 < 0.05
+    finally:
+        imp.stop()
+
+
+def test_http_forward_to_global():
+    gsrv, imp, _ = _global_server()
+    http = ImportHTTPServer(imp)
+    port = http.start()
+    try:
+        local = _local_server(port, use_grpc=False)
+        _ingest_histo(local, "h.lat", [1.0, 2.0, 3.0, 4.0, 5.0])
+        local.flush()
+        assert _wait_until(lambda: imp.received_metrics >= 1)
+        by_key = _flush_global(gsrv)
+        assert ("h.lat.50percentile", MetricType.GAUGE) in by_key
+    finally:
+        http.stop()
+        imp.stop()
+
+
+def test_proxy_consistent_routing():
+    # local → proxy → 2 globals; each series must land on exactly one global
+    g1, imp1, port1 = _global_server()
+    g2, imp2, port2 = _global_server()
+    proxy = ProxyServer([f"127.0.0.1:{port1}", f"127.0.0.1:{port2}"])
+    pport = proxy.start_grpc()
+    try:
+        local = _local_server(pport)
+        for i in range(40):
+            _ingest_histo(local, f"series{i}", [float(i)] * 10)
+        local.flush()
+        assert _wait_until(
+            lambda: imp1.received_metrics + imp2.received_metrics >= 40)
+        assert imp1.received_metrics > 0 and imp2.received_metrics > 0
+
+        by1 = _flush_global(g1)
+        by2 = _flush_global(g2)
+        names1 = {k[0].rsplit(".", 1)[0] for k in by1}
+        names2 = {k[0].rsplit(".", 1)[0] for k in by2}
+        assert not (names1 & names2)  # disjoint ownership
+        assert len(names1 | names2) == 40
+    finally:
+        proxy.stop()
+        imp1.stop()
+        imp2.stop()
+
+
+def test_proxy_unreachable_destination_counts_drops():
+    proxy = ProxyServer(["127.0.0.1:1"])  # nothing listens there
+    proxy.timeout_s = 0.5
+    batch = codec.pb.MetricBatch()
+    m = batch.metrics.add()
+    m.name = "x"
+    m.kind = codec.pb.KIND_COUNTER
+    m.counter.value = 1
+    proxy._route_batch(batch)
+    assert proxy.drops == 1
+    proxy.stop()
+
+
+def test_forward_bad_address_counts_errors():
+    cfg = Config(forward_address="127.0.0.1:1", forward_use_grpc=True,
+                 interval="1s")
+    srv = Server(cfg)
+    install_forwarder(srv)
+    srv.workers[0].process_metric(parse_metric(b"x:1|h"))
+    qs = device_quantiles(PCTS, AGGS)
+    snaps = [w.flush(qs, 1.0) for w in srv.workers]
+    srv.forwarder(snaps)  # synchronous call
+    assert sum(srv.forwarder.client.errors.values()) == 1
+
+
+# ---------------------------------------------------------------------------
+# Ring
+
+
+def test_ring_consistency():
+    ring = ConsistentRing(["a:1", "b:1", "c:1"])
+    for key in ("k1", "k2", "k3"):
+        assert ring.get(key) == ring.get(key)
+
+
+def test_ring_balance():
+    ring = ConsistentRing([f"node{i}:80" for i in range(4)])
+    counts = {}
+    for i in range(8000):
+        counts[ring.get(f"key-{i}")] = counts.get(ring.get(f"key-{i}"), 0) + 1
+    for node, c in counts.items():
+        assert 0.5 < c / 2000 < 1.6, counts
+
+
+def test_ring_minimal_remap_on_membership_change():
+    members = [f"node{i}:80" for i in range(4)]
+    ring = ConsistentRing(members)
+    before = {f"key-{i}": ring.get(f"key-{i}") for i in range(2000)}
+    ring.remove("node3:80")
+    moved = 0
+    for key, owner in before.items():
+        now = ring.get(key)
+        if owner != "node3:80":
+            # keys not owned by the removed node must not move
+            assert now == owner
+        else:
+            moved += 1
+    assert moved > 0
+
+
+def test_ring_set_members_prunes():
+    ring = ConsistentRing(["a:1", "b:1"])
+    assert ring.set_members(["b:1", "c:1"])
+    assert ring.members() == ["b:1", "c:1"]
+    assert not ring.set_members(["b:1", "c:1"])  # no change
+
+
+# ---------------------------------------------------------------------------
+# Discovery
+
+
+def test_consul_discoverer_parses_health_response():
+    payload = json.dumps([
+        {"Node": {"Address": "10.0.0.1"},
+         "Service": {"Address": "10.0.0.1", "Port": 8128}},
+        {"Node": {"Address": "10.0.0.2"},
+         "Service": {"Address": "", "Port": 8128}},
+    ]).encode()
+    seen_urls = []
+
+    def opener(url, **kw):
+        seen_urls.append(url)
+        return payload
+
+    d = ConsulDiscoverer("http://consul:8500", opener=opener)
+    dests = d.get_destinations_for_service("veneur-global")
+    assert dests == ["10.0.0.1:8128", "10.0.0.2:8128"]
+    assert "v1/health/service/veneur-global?passing" in seen_urls[0]
+
+
+def test_kubernetes_discoverer_parses_pod_list():
+    payload = json.dumps({
+        "items": [
+            {"status": {"phase": "Running", "podIP": "10.1.0.1"},
+             "spec": {"containers": [
+                 {"ports": [{"name": "grpc", "containerPort": 8128}]}]}},
+            {"status": {"phase": "Pending", "podIP": "10.1.0.2"},
+             "spec": {"containers": [
+                 {"ports": [{"containerPort": 9999}]}]}},
+        ]
+    }).encode()
+
+    def opener(url, **kw):
+        return payload
+
+    d = KubernetesDiscoverer(opener=opener, token="tok")
+    dests = d.get_destinations_for_service("veneur-global")
+    assert dests == ["10.1.0.1:8128"]  # pending pod excluded
+
+
+def test_destination_refresher_keeps_last_good():
+    proxy = ProxyServer(["old:1"])
+    calls = {"n": 0}
+
+    class FlakyDiscoverer:
+        def get_destinations_for_service(self, service):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return ["new1:1", "new2:1"]
+            raise RuntimeError("consul down")
+
+    r = DestinationRefresher(proxy, FlakyDiscoverer(), "svc")
+    r.refresh()
+    assert proxy.ring.members() == ["new1:1", "new2:1"]
+    r.refresh()  # fails → keeps last good
+    assert proxy.ring.members() == ["new1:1", "new2:1"]
+    assert r.refresh_errors == 1

@@ -1,0 +1,135 @@
+"""Sketch wire codec: FlushSnapshot rows ↔ protobuf Metric messages.
+
+The forwarding serialization plays the role of the reference's
+metricpb/tdigest protos (samplers/metricpb/metric.proto,
+tdigest/tdigest.proto:8-22) and gob Export/Combine path
+(samplers/samplers.go:161-208, :678-703): counters/gauges travel as exact
+scalars, histograms as t-digest centroid rows + min/max/reciprocal-sum,
+sets as dense HLL registers. This is also the only serialization state in
+the system — like the reference, aggregation state never outlives a flush
+interval, so the forwarding codec doubles as the checkpoint format for
+host↔host and host↔device movement (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from veneur_tpu.core.directory import ScopeClass
+from veneur_tpu.core.flusher import forwardable_rows
+from veneur_tpu.core.metrics import MetricKey
+from veneur_tpu.core.worker import FlushSnapshot
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+_SCOPE_TO_PB = {
+    ScopeClass.MIXED: pb.SCOPE_MIXED,
+    ScopeClass.LOCAL: pb.SCOPE_LOCAL,
+    ScopeClass.GLOBAL: pb.SCOPE_GLOBAL,
+}
+_SCOPE_FROM_PB = {v: k for k, v in _SCOPE_TO_PB.items()}
+
+_KIND_TO_TYPE = {
+    pb.KIND_COUNTER: "counter",
+    pb.KIND_GAUGE: "gauge",
+    pb.KIND_HISTOGRAM: "histogram",
+    pb.KIND_TIMER: "timer",
+    pb.KIND_SET: "set",
+}
+_TYPE_TO_KIND = {v: k for k, v in _KIND_TO_TYPE.items()}
+
+
+def snapshot_to_batch(snap: FlushSnapshot,
+                      compression: float = 100.0,
+                      hll_precision: int = 14) -> pb.MetricBatch:
+    """Serialize the forwardable part of a snapshot
+    (reference ForwardableMetrics, worker.go:181-209)."""
+    batch = pb.MetricBatch()
+    for item in forwardable_rows(snap):
+        kind = item[0]
+        m = batch.metrics.add()
+        if kind == "counter":
+            _, key, tags, value = item
+            m.name = key.name
+            m.tags.extend(tags)
+            m.kind = pb.KIND_COUNTER
+            m.scope = pb.SCOPE_GLOBAL
+            m.counter.value = int(value)
+        elif kind == "gauge":
+            _, key, tags, value = item
+            m.name = key.name
+            m.tags.extend(tags)
+            m.kind = pb.KIND_GAUGE
+            m.scope = pb.SCOPE_GLOBAL
+            m.gauge.value = float(value)
+        elif kind == "set":
+            _, key, tags, registers = item
+            m.name = key.name
+            m.tags.extend(tags)
+            m.kind = pb.KIND_SET
+            m.scope = pb.SCOPE_MIXED
+            m.hll.registers = np.asarray(registers, np.int8).tobytes()
+            m.hll.precision = hll_precision
+        else:  # histogram | timer
+            _, key, tags, cls, means, weights, dmin, dmax, drecip = item
+            m.name = key.name
+            m.tags.extend(tags)
+            m.kind = _TYPE_TO_KIND[kind]
+            m.scope = _SCOPE_TO_PB[cls]
+            nz = np.asarray(weights) > 0
+            m.digest.centroids.means.extend(
+                np.asarray(means, np.float32)[nz].tolist())
+            m.digest.centroids.weights.extend(
+                np.asarray(weights, np.float32)[nz].tolist())
+            m.digest.min = float(dmin)
+            m.digest.max = float(dmax)
+            m.digest.reciprocal_sum = float(drecip)
+            m.digest.compression = compression
+    return batch
+
+
+def metric_key(m: pb.Metric) -> MetricKey:
+    return MetricKey(
+        name=m.name,
+        type=_KIND_TO_TYPE[m.kind],
+        joined_tags=",".join(m.tags),
+    )
+
+
+def apply_to_worker(worker, m: pb.Metric) -> None:
+    """Merge one received metric into a DeviceWorker (the global tier's
+    ingest; reference ImportMetricGRPC, worker.go:438-495: counters/gauges
+    are forced global, local scope is rejected)."""
+    key = metric_key(m)
+    tags = list(m.tags)
+    which = m.WhichOneof("value")
+    if which == "counter":
+        worker.import_counter(key, tags, m.counter.value)
+    elif which == "gauge":
+        worker.import_gauge(key, tags, m.gauge.value)
+    elif which == "hll":
+        regs = np.frombuffer(m.hll.registers, dtype=np.int8)
+        worker.import_hll(key, tags, ScopeClass.MIXED, regs)
+    elif which == "digest":
+        scope = _SCOPE_FROM_PB.get(m.scope, ScopeClass.MIXED)
+        if scope == ScopeClass.LOCAL:
+            raise ValueError("import does not accept local metrics")
+        means = np.asarray(m.digest.centroids.means, np.float32)
+        weights = np.asarray(m.digest.centroids.weights, np.float32)
+        worker.import_digest(
+            key, tags, key.type, scope, means, weights,
+            m.digest.min, m.digest.max, m.digest.reciprocal_sum,
+        )
+    else:
+        raise ValueError("metric with no value")
+
+
+def routing_digest(m: pb.Metric) -> int:
+    """Worker-routing digest of a received metric. Computed exactly like
+    the parse-time digest (utils/hashing.metric_digest), so a series lands
+    on the same worker shard whether it arrived raw or forwarded
+    (reference importsrv hashes the same identity, importsrv/server.go:
+    141-148)."""
+    from veneur_tpu.utils.hashing import metric_digest
+
+    key = metric_key(m)
+    return metric_digest(key.name, key.type, key.joined_tags)

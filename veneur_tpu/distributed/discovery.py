@@ -1,0 +1,109 @@
+"""Service discovery: Consul and Kubernetes backends.
+
+Parity: reference Discoverer interface (discoverer.go:5-7), Consul
+health-API implementation (consul.go:29-47), Kubernetes pod-list
+implementation (kubernetes.go:32-80, label app=veneur-global). HTTP access
+goes through an injectable opener so tests stub responses the way the
+reference stubs its Consul HTTP client (consul_discovery_test.go).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import urllib.request
+from typing import Callable, Optional, Protocol
+
+log = logging.getLogger("veneur_tpu.discovery")
+
+
+class Discoverer(Protocol):
+    def get_destinations_for_service(self, service: str) -> list[str]: ...
+
+
+def _default_opener(url: str, headers: Optional[dict] = None,
+                    ca_file: Optional[str] = None, timeout: float = 10.0
+                    ) -> bytes:
+    req = urllib.request.Request(url, headers=headers or {})
+    ctx = None
+    if url.startswith("https"):
+        ctx = ssl.create_default_context(
+            cafile=ca_file) if ca_file else ssl.create_default_context()
+    with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
+        return resp.read()
+
+
+class ConsulDiscoverer:
+    """Queries Consul's health API for passing instances of a service."""
+
+    def __init__(self, consul_url: str = "http://127.0.0.1:8500",
+                 opener: Callable = _default_opener) -> None:
+        self.consul_url = consul_url.rstrip("/")
+        self.opener = opener
+
+    def get_destinations_for_service(self, service: str) -> list[str]:
+        url = f"{self.consul_url}/v1/health/service/{service}?passing"
+        body = self.opener(url)
+        entries = json.loads(body)
+        out = []
+        for entry in entries:
+            svc = entry.get("Service", {})
+            addr = svc.get("Address") or entry.get("Node", {}).get("Address")
+            port = svc.get("Port")
+            if addr and port:
+                out.append(f"{addr}:{port}")
+        return out
+
+
+class KubernetesDiscoverer:
+    """Lists ready pods with label app=<service> through the API server
+    using the in-cluster service account."""
+
+    TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+    CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+
+    def __init__(self, api_url: str = "https://kubernetes.default.svc",
+                 namespace: str = "default",
+                 opener: Callable = _default_opener,
+                 token: Optional[str] = None) -> None:
+        self.api_url = api_url.rstrip("/")
+        self.namespace = namespace
+        self.opener = opener
+        self._token = token
+
+    def _read_token(self) -> str:
+        if self._token is None:
+            with open(self.TOKEN_PATH) as f:
+                self._token = f.read().strip()
+        return self._token
+
+    def get_destinations_for_service(self, service: str) -> list[str]:
+        url = (f"{self.api_url}/api/v1/namespaces/{self.namespace}/pods"
+               f"?labelSelector=app%3D{service}")
+        body = self.opener(
+            url,
+            headers={"Authorization": f"Bearer {self._read_token()}"},
+            ca_file=self.CA_PATH,
+        )
+        data = json.loads(body)
+        out = []
+        for pod in data.get("items", []):
+            status = pod.get("status", {})
+            if status.get("phase") != "Running":
+                continue
+            ip = status.get("podIP")
+            ports = (
+                pod.get("spec", {}).get("containers", [{}])[0]
+                .get("ports", [])
+            )
+            port = None
+            for p in ports:
+                if p.get("name") in ("grpc", "import", "http"):
+                    port = p.get("containerPort")
+                    break
+            if port is None and ports:
+                port = ports[0].get("containerPort")
+            if ip and port:
+                out.append(f"{ip}:{port}")
+        return out

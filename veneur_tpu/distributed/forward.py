@@ -1,0 +1,116 @@
+"""Forwarding client: local instance → upstream (proxy or global).
+
+Parity: reference flusher.go — forwardGRPC (:474-534) and the HTTP/JSON
+flushForward (:338-433, zlib "deflate" body). Installed on a local Server
+as `server.forwarder`; runs once per flush with a deadline of one interval;
+failures are counted, never retried (per-flush data is expendable,
+README.md:133-137).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import urllib.request
+import zlib
+from typing import Optional
+
+from veneur_tpu.distributed import codec
+from veneur_tpu.distributed.rpc import ForwardClient
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+log = logging.getLogger("veneur_tpu.forward")
+
+
+class GRPCForwarder:
+    def __init__(self, address: str, timeout_s: float = 10.0,
+                 compression: float = 100.0, hll_precision: int = 14) -> None:
+        self.client = ForwardClient(address, timeout_s)
+        self.compression = compression
+        self.hll_precision = hll_precision
+
+    def __call__(self, snapshots) -> None:
+        batch = pb.MetricBatch()
+        for snap in snapshots:
+            batch.metrics.extend(
+                codec.snapshot_to_batch(
+                    snap, self.compression, self.hll_precision
+                ).metrics
+            )
+        if not batch.metrics:
+            return
+        if not self.client.send(batch):
+            log.warning(
+                "forward to %s failed (errors so far: %s)",
+                self.client.address, self.client.errors,
+            )
+
+    def close(self) -> None:
+        self.client.close()
+
+
+class HTTPForwarder:
+    """POST /import with a deflate JSON body (the v1 forwarding path)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 compression: float = 100.0, hll_precision: int = 14) -> None:
+        self.url = base_url.rstrip("/") + "/import"
+        self.timeout_s = timeout_s
+        self.compression = compression
+        self.hll_precision = hll_precision
+        self.errors = 0
+        self.sent_batches = 0
+
+    def __call__(self, snapshots) -> None:
+        items = []
+        for snap in snapshots:
+            batch = codec.snapshot_to_batch(
+                snap, self.compression, self.hll_precision)
+            for m in batch.metrics:
+                items.append({
+                    "name": m.name,
+                    "type": codec._KIND_TO_TYPE[m.kind],
+                    "tags": list(m.tags),
+                    "value": base64.b64encode(
+                        m.SerializeToString()).decode("ascii"),
+                })
+        if not items:
+            return
+        body = zlib.compress(json.dumps(items).encode("utf-8"))
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={
+                "Content-Type": "application/json",
+                "Content-Encoding": "deflate",
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                resp.read()
+            self.sent_batches += 1
+        except Exception as e:
+            self.errors += 1
+            log.warning("http forward to %s failed: %s", self.url, e)
+
+
+def install_forwarder(server, compression: Optional[float] = None,
+                      hll_precision: Optional[int] = None) -> None:
+    """Wire a Server's forward_address into the right forwarder
+    (reference flusher.go:82-95 picks gRPC vs HTTP by config)."""
+    cfg = server.config
+    if not cfg.forward_address:
+        return
+    compression = compression or cfg.tpu_compression
+    hll_precision = hll_precision or cfg.tpu_hll_precision
+    timeout = cfg.interval_seconds()
+    if cfg.forward_use_grpc:
+        addr = cfg.forward_address
+        for prefix in ("grpc://", "http://", "https://"):
+            if addr.startswith(prefix):
+                addr = addr[len(prefix):]
+        server.forwarder = GRPCForwarder(
+            addr, timeout, compression, hll_precision)
+    else:
+        server.forwarder = HTTPForwarder(
+            cfg.forward_address, timeout, compression, hll_precision)

@@ -1,0 +1,145 @@
+"""Global-side ingest: receive forwarded sketches into device workers.
+
+Parity: reference importsrv (importsrv/server.go:38-148) — SendMetrics
+hashes each metric's identity, batches per worker, and merges into worker
+state; plus the HTTP `POST /import` path (handlers_global.go:60-196,
+http.go:63-140) with deflate support.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import grpc
+
+from veneur_tpu.distributed import codec, rpc
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+log = logging.getLogger("veneur_tpu.import")
+
+
+class ImportServer:
+    """Receives MetricBatch RPCs and routes metrics into a server's
+    workers by identity digest (one series → one worker shard,
+    importsrv/server.go:107-125)."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+        self.grpc_server: Optional[grpc.Server] = None
+        self.port: Optional[int] = None
+        self.received_metrics = 0
+        self.import_errors = 0
+
+    def handle_batch(self, batch: pb.MetricBatch) -> None:
+        workers = self.server.workers
+        locks = self.server._worker_locks
+        # pre-sort into per-worker chunks so each lock is taken once
+        chunks: dict[int, list] = {}
+        for m in batch.metrics:
+            i = codec.routing_digest(m) % len(workers)
+            chunks.setdefault(i, []).append(m)
+        for i, metrics in chunks.items():
+            with locks[i]:
+                for m in metrics:
+                    try:
+                        codec.apply_to_worker(workers[i], m)
+                        self.received_metrics += 1
+                    except ValueError as e:
+                        self.import_errors += 1
+                        log.debug("rejected import %s: %s", m.name, e)
+
+    def start_grpc(self, address: str = "127.0.0.1:0") -> int:
+        self.grpc_server, self.port = rpc.make_server(
+            self.handle_batch, address)
+        return self.port
+
+    def stop(self) -> None:
+        if self.grpc_server is not None:
+            self.grpc_server.stop(grace=1.0)
+
+
+def decode_http_import_body(body: bytes, content_encoding: str
+                            ) -> pb.MetricBatch:
+    """Decode an HTTP /import request body.
+
+    Accepts the protobuf MetricBatch directly, or a JSON array of
+    {name, type, tags, scope, value} where value is the base64 protobuf
+    Metric (the curl-able analog of the reference's JSONMetric+gob format,
+    handlers_global.go:117-196). deflate (zlib) bodies are accepted either
+    way (reference http.go import encodings).
+    """
+    if content_encoding == "deflate":
+        body = zlib.decompress(body)
+    if body[:1] in (b"[", b"{"):
+        import base64
+
+        items = json.loads(body.decode("utf-8"))
+        batch = pb.MetricBatch()
+        for item in items:
+            m = pb.Metric.FromString(base64.b64decode(item["value"]))
+            batch.metrics.append(m)
+        return batch
+    return pb.MetricBatch.FromString(body)
+
+
+class ImportHTTPServer:
+    """HTTP server exposing /import, /healthcheck, /version
+    (reference Server.Handler, http.go:22-60)."""
+
+    def __init__(self, import_server: ImportServer) -> None:
+        self.import_server = import_server
+        self.httpd: Optional[ThreadingHTTPServer] = None
+        self.port: Optional[int] = None
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        imp = self.import_server
+        version = imp.server.version if imp.server else "unknown"
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthcheck":
+                    self._respond(200, b"ok")
+                elif self.path == "/version":
+                    self._respond(200, version.encode())
+                else:
+                    self._respond(404, b"not found")
+
+            def do_POST(self):
+                if self.path != "/import":
+                    self._respond(404, b"not found")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    batch = decode_http_import_body(
+                        body, self.headers.get("Content-Encoding", ""))
+                except Exception as e:
+                    self._respond(400, f"bad import body: {e}".encode())
+                    return
+                imp.handle_batch(batch)
+                self._respond(200, b"accepted")
+
+            def _respond(self, code: int, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True,
+                             name="import-http")
+        t.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self.httpd is not None:
+            self.httpd.shutdown()

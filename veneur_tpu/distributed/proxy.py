@@ -1,0 +1,139 @@
+"""Proxy tier: ring-route forwarded metrics across global instances.
+
+Parity: reference proxysrv (proxysrv/server.go:44-384 — gRPC proxy with a
+connection map pruned on membership change, fire-and-forget forwarding) and
+the veneur-proxy HTTP tier (proxy.go:40-687 — ring routing, periodic
+service-discovery refresh keeping last-good destinations on error).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+from veneur_tpu.distributed import codec, rpc
+from veneur_tpu.distributed.ring import ConsistentRing
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+log = logging.getLogger("veneur_tpu.proxy")
+
+
+class ProxyServer:
+    """Receives MetricBatch RPCs and re-sends each metric to the global
+    instance owning its key on the consistent ring."""
+
+    def __init__(self, destinations: Optional[list[str]] = None,
+                 timeout_s: float = 10.0) -> None:
+        self.ring = ConsistentRing(destinations or [])
+        self.timeout_s = timeout_s
+        self._conns: dict[str, rpc.ForwardClient] = {}
+        self._lock = threading.Lock()
+        self.grpc_server: Optional[grpc.Server] = None
+        self.port: Optional[int] = None
+        self.proxied_metrics = 0
+        self.drops = 0
+
+    # -- membership (reference SetDestinations, proxysrv/server.go:148-176)
+
+    def set_destinations(self, destinations: list[str]) -> None:
+        with self._lock:
+            if not self.ring.set_members(destinations):
+                return
+            live = set(destinations)
+            for dest in list(self._conns):
+                if dest not in live:
+                    self._conns.pop(dest).close()
+
+    def _conn(self, dest: str) -> rpc.ForwardClient:
+        with self._lock:
+            client = self._conns.get(dest)
+            if client is None:
+                client = rpc.ForwardClient(dest, self.timeout_s)
+                self._conns[dest] = client
+            return client
+
+    # -- forwarding (reference SendMetrics :180 / sendMetrics :190)
+
+    def handle_batch(self, batch: pb.MetricBatch) -> None:
+        # return to the caller immediately; route in the background
+        # (reference returns before forwarding completes)
+        threading.Thread(
+            target=self._route_batch, args=(batch,), daemon=True,
+            name="proxy-route",
+        ).start()
+
+    def _route_batch(self, batch: pb.MetricBatch) -> None:
+        by_dest: dict[str, pb.MetricBatch] = {}
+        for m in batch.metrics:
+            key = codec.metric_key(m)
+            try:
+                dest = self.ring.get(key.key_string())
+            except LookupError:
+                self.drops += len(batch.metrics)
+                log.warning("no destinations; dropping batch")
+                return
+            by_dest.setdefault(dest, pb.MetricBatch()).metrics.append(m)
+        for dest, sub in by_dest.items():
+            if self._conn(dest).send(sub):
+                self.proxied_metrics += len(sub.metrics)
+            else:
+                self.drops += len(sub.metrics)
+
+    def start_grpc(self, address: str = "127.0.0.1:0") -> int:
+        self.grpc_server, self.port = rpc.make_server(
+            self.handle_batch, address)
+        return self.port
+
+    def stop(self) -> None:
+        if self.grpc_server is not None:
+            self.grpc_server.stop(grace=1.0)
+        with self._lock:
+            for client in self._conns.values():
+                client.close()
+            self._conns.clear()
+
+
+class DestinationRefresher:
+    """Periodically re-poll service discovery and reset the ring, keeping
+    the last good destination set on error
+    (reference proxy.go:328-354, 505-515)."""
+
+    def __init__(self, proxy: ProxyServer, discoverer, service: str,
+                 interval_s: float = 30.0) -> None:
+        self.proxy = proxy
+        self.discoverer = discoverer
+        self.service = service
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self.refresh_errors = 0
+        self.last_refresh: float = 0.0
+
+    def refresh(self) -> None:
+        try:
+            destinations = self.discoverer.get_destinations_for_service(
+                self.service)
+        except Exception as e:
+            self.refresh_errors += 1
+            log.warning("discovery refresh failed (keeping %d last-good"
+                        " destinations): %s", len(self.proxy.ring), e)
+            return
+        if destinations:
+            self.proxy.set_destinations(destinations)
+        self.last_refresh = time.time()
+
+    def start(self) -> None:
+        self.refresh()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.refresh()
+
+        threading.Thread(target=loop, daemon=True,
+                         name="discovery-refresh").start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,82 @@
+"""Consistent hash ring for cross-host series sharding.
+
+Plays the role of the reference's vendored stathat.com/c/consistent ring
+(proxy.go:587-628, proxysrv/server.go:273-282): metric keys hash onto a
+ring of virtual nodes so each series consistently lands on one global
+instance, and membership churn only remaps the affected arc.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Optional
+
+from veneur_tpu.utils.hashing import fnv1a_64, fmix64
+
+DEFAULT_REPLICAS = 64
+
+
+class ConsistentRing:
+    def __init__(self, members: Optional[list[str]] = None,
+                 replicas: int = DEFAULT_REPLICAS) -> None:
+        self.replicas = replicas
+        self._members: set[str] = set()
+        self._hashes: list[int] = []
+        self._owners: dict[int, str] = {}
+        if members:
+            for m in members:
+                self.add(m)
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return fmix64(fnv1a_64(s.encode("utf-8")))
+
+    def add(self, member: str) -> None:
+        if member in self._members:
+            return
+        self._members.add(member)
+        for i in range(self.replicas):
+            h = self._hash(f"{member}#{i}")
+            if h in self._owners:
+                continue
+            bisect.insort(self._hashes, h)
+            self._owners[h] = member
+
+    def remove(self, member: str) -> None:
+        if member not in self._members:
+            return
+        self._members.discard(member)
+        for i in range(self.replicas):
+            h = self._hash(f"{member}#{i}")
+            if self._owners.get(h) == member:
+                del self._owners[h]
+                idx = bisect.bisect_left(self._hashes, h)
+                if idx < len(self._hashes) and self._hashes[idx] == h:
+                    del self._hashes[idx]
+
+    def set_members(self, members: list[str]) -> bool:
+        """Replace membership; returns True if anything changed."""
+        new = set(members)
+        if new == self._members:
+            return False
+        for m in list(self._members - new):
+            self.remove(m)
+        for m in new - self._members:
+            self.add(m)
+        return True
+
+    def members(self) -> list[str]:
+        return sorted(self._members)
+
+    def get(self, key: str) -> str:
+        """Owner of a key (the first virtual node clockwise)."""
+        if not self._hashes:
+            raise LookupError("empty ring")
+        h = self._hash(key)
+        idx = bisect.bisect_right(self._hashes, h)
+        if idx == len(self._hashes):
+            idx = 0
+        return self._owners[self._hashes[idx]]
+
+    def __len__(self) -> int:
+        return len(self._members)

@@ -1,0 +1,89 @@
+"""gRPC plumbing for the Forward service.
+
+Service parity: reference forwardrpc/forward.proto:9-17 — one RPC,
+SendMetrics(MetricList), used local→proxy, proxy→global, and for global
+ingest. Stubs are hand-wired through grpc's generic handler API (the
+message codegen comes from protoc; see proto/veneur_tpu.proto).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Callable, Optional
+
+import grpc
+
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+SERVICE_NAME = "veneurtpu.Forward"
+SEND_METRICS = f"/{SERVICE_NAME}/SendMetrics"
+
+
+def make_server(handler: Callable[[pb.MetricBatch], None],
+                address: str = "127.0.0.1:0",
+                max_workers: int = 4) -> tuple[grpc.Server, int]:
+    """Start a Forward gRPC server; returns (server, bound_port).
+
+    handler receives each MetricBatch; exceptions become INTERNAL errors.
+    """
+
+    def send_metrics(request: pb.MetricBatch, context) -> pb.SendResponse:
+        handler(request)
+        return pb.SendResponse()
+
+    rpc_handlers = grpc.method_handlers_generic_handler(
+        SERVICE_NAME,
+        {
+            "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                send_metrics,
+                request_deserializer=pb.MetricBatch.FromString,
+                response_serializer=pb.SendResponse.SerializeToString,
+            )
+        },
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((rpc_handlers,))
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port
+
+
+class ForwardClient:
+    """Client for the Forward service with the reference's error
+    classification (flusher.go:511-527: deadline / transient / send —
+    counted, never retried; per-flush data is expendable by design)."""
+
+    def __init__(self, address: str, timeout_s: float = 10.0) -> None:
+        self.address = address
+        self.timeout_s = timeout_s
+        self.channel = grpc.insecure_channel(address)
+        self._call = self.channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=pb.MetricBatch.SerializeToString,
+            response_deserializer=pb.SendResponse.FromString,
+        )
+        self.errors: dict[str, int] = {
+            "deadline_exceeded": 0, "unavailable": 0, "send": 0,
+        }
+        self.sent_batches = 0
+        self.sent_metrics = 0
+
+    def send(self, batch: pb.MetricBatch,
+             timeout_s: Optional[float] = None) -> bool:
+        try:
+            self._call(batch, timeout=timeout_s or self.timeout_s)
+        except grpc.RpcError as e:
+            code = e.code()
+            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                self.errors["deadline_exceeded"] += 1
+            elif code == grpc.StatusCode.UNAVAILABLE:
+                self.errors["unavailable"] += 1
+            else:
+                self.errors["send"] += 1
+            return False
+        self.sent_batches += 1
+        self.sent_metrics += len(batch.metrics)
+        return True
+
+    def close(self) -> None:
+        self.channel.close()
