@@ -1,0 +1,317 @@
+"""SSF protocol, span pipeline, and trace client tests.
+
+Mirrors reference coverage: protocol/wire_test.go (framing), server SSF
+ingest (server_test.go SSF benches/tests), ssfmetrics extraction, and the
+trace-client test backends (trace/testbackend)."""
+
+import io
+import queue
+import socket
+import time
+
+import pytest
+
+from veneur_tpu import ssf
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.metrics import MetricType
+from veneur_tpu.core.server import Server
+from veneur_tpu.core.spans import (
+    MetricExtractionSink,
+    SpanWorker,
+    convert_indicator_metrics,
+    convert_metrics,
+    convert_span_uniqueness_metrics,
+)
+from veneur_tpu.core.directory import ScopeClass
+from veneur_tpu.protocol import ssf_wire
+from veneur_tpu.sinks.channel import ChannelSpanSink
+from veneur_tpu.trace import (
+    ChannelBackend,
+    Client,
+    ErrWouldBlock,
+    UDPBackend,
+    neutralize_client,
+)
+from veneur_tpu.trace.metrics import report_one, Samples
+from veneur_tpu.trace.span import Span, extract_request_child
+
+
+def _span(**kw) -> ssf.SSFSpan:
+    base = dict(
+        trace_id=5, id=6, parent_id=1,
+        start_timestamp=1_000_000_000, end_timestamp=2_000_000_000,
+        service="svc", name="op",
+    )
+    base.update(kw)
+    return ssf.SSFSpan(**base)
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol
+
+
+def test_roundtrip_datagram():
+    span = _span(tags={"x": "y"}, metrics=[ssf.count("c", 2, {"a": "b"})])
+    data = ssf_wire.encode_datagram(span)
+    back = ssf_wire.parse_ssf(data)
+    assert back.name == "op" and back.service == "svc"
+    assert back.tags == {"x": "y"}
+    assert back.metrics[0].name == "c"
+    assert back.metrics[0].tags == {"a": "b"}
+
+
+def test_framed_stream_roundtrip():
+    buf = io.BytesIO()
+    spans = [_span(id=i + 1, name=f"op{i}") for i in range(3)]
+    for s in spans:
+        ssf_wire.write_ssf(buf, s)
+    buf.seek(0)
+    out = []
+    while True:
+        s = ssf_wire.read_ssf(buf)
+        if s is None:
+            break
+        out.append(s)
+    assert [s.name for s in out] == ["op0", "op1", "op2"]
+
+
+def test_framing_errors():
+    # unknown version byte
+    with pytest.raises(ssf_wire.FramingError):
+        ssf_wire.read_ssf(io.BytesIO(b"\x01\x00\x00\x00\x00"))
+    # oversize length
+    with pytest.raises(ssf_wire.FramingError):
+        ssf_wire.read_ssf(io.BytesIO(b"\x00\xff\xff\xff\xff"))
+    # truncated body
+    with pytest.raises(ssf_wire.FramingError):
+        ssf_wire.read_ssf(io.BytesIO(b"\x00\x00\x00\x00\x09abc"))
+    # clean EOF at frame boundary is None
+    assert ssf_wire.read_ssf(io.BytesIO(b"")) is None
+
+
+def test_normalization_name_tag_and_sample_rate():
+    span = _span(name="")
+    span.tags["name"] = "from-tag"
+    s = ssf.count("c", 1)
+    s.sample_rate = 0.0
+    span.metrics = [s]
+    data = ssf_wire.encode_datagram(span)
+    back = ssf_wire.parse_ssf(data)
+    assert back.name == "from-tag"
+    assert "name" not in back.tags
+    assert back.metrics[0].sample_rate == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Conversion
+
+
+def test_convert_metrics():
+    span = _span(metrics=[ssf.count("c", 1), ssf.gauge("g", 2)])
+    metrics, invalid = convert_metrics(span)
+    assert invalid == 0
+    assert {m.key.type for m in metrics} == {"counter", "gauge"}
+
+
+def test_convert_indicator_metrics():
+    span = _span(indicator=True, error=True)
+    out = convert_indicator_metrics(span, "ind.timer", "obj.timer")
+    assert len(out) == 2
+    ind, obj = out
+    assert ind.key.name == "ind.timer"
+    assert ind.key.type == "histogram"
+    assert "error:true" in ind.tags
+    assert "service:svc" in ind.tags
+    # duration: 1s in ns
+    assert ind.value == 1_000_000_000.0
+    assert obj.scope.name == "GLOBAL_ONLY"
+    assert "objective:op" in obj.tags
+
+    # ssf_objective tag overrides the objective name
+    span2 = _span(indicator=True, tags={"ssf_objective": "custom"})
+    out2 = convert_indicator_metrics(span2, "", "obj.timer")
+    assert len(out2) == 1
+    assert "objective:custom" in out2[0].tags
+
+    # non-indicator span produces nothing
+    assert convert_indicator_metrics(_span(), "i", "o") == []
+
+
+def test_convert_span_uniqueness():
+    out = convert_span_uniqueness_metrics(_span(), 1.0)
+    assert len(out) == 1
+    assert out[0].key.type == "set"
+    assert out[0].value == "op"
+    assert convert_span_uniqueness_metrics(_span(service=""), 1.0) == []
+
+
+# ---------------------------------------------------------------------------
+# Span worker + extraction
+
+
+def test_span_worker_fanout_and_common_tags():
+    sink = ChannelSpanSink()
+    w = SpanWorker([sink], common_tags={"env": "prod"})
+    w.start()
+    w.ingest(_span(tags={"have": "x"}))
+    time.sleep(0.2)
+    w.stop()
+    assert len(sink.spans) == 1
+    assert sink.spans[0].tags == {"have": "x", "env": "prod"}
+
+
+def test_span_worker_drops_when_full():
+    w = SpanWorker([], capacity=2)  # not started: queue fills up
+    w.ingest(_span())
+    w.ingest(_span())
+    w.ingest(_span())
+    assert w.spans_dropped == 1
+
+
+def test_extraction_sink_routes_metrics():
+    routed = []
+    sink = MetricExtractionSink(routed.append, "ind.t", "obj.t",
+                                uniqueness_rate=1.0)
+    span = _span(indicator=True, metrics=[ssf.count("c", 3)])
+    sink.ingest(span)
+    types = sorted(m.key.type for m in routed)
+    assert types == ["counter", "histogram", "histogram", "set"]
+
+
+# ---------------------------------------------------------------------------
+# Server SSF ingest end-to-end
+
+
+def test_ssf_udp_ingest_to_derived_metrics():
+    cfg = Config(
+        ssf_listen_addresses=["udp://127.0.0.1:0"],
+        interval="10s",
+        percentiles=[0.5],
+        indicator_span_timer_name="svc.indicator",
+    )
+    srv = Server(cfg)
+    ports = srv.start()
+    try:
+        port = ports["udp://127.0.0.1:0"]
+        span = _span(indicator=True,
+                     metrics=[ssf.count("span.counter", 4)])
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(ssf_wire.encode_datagram(span), ("127.0.0.1", port))
+        s.close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if sum(w.processed for w in srv.workers) >= 2:
+                break
+            time.sleep(0.02)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("span.counter", MetricType.COUNTER)].value == 4.0
+        assert ("svc.indicator.max", MetricType.GAUGE) in by_key
+        assert srv.ssf_spans_received.get("svc") == 1
+    finally:
+        srv.shutdown()
+
+
+def test_ssf_unix_stream_ingest(tmp_path):
+    path = str(tmp_path / "ssf.sock")
+    cfg = Config(
+        ssf_listen_addresses=[f"unix://{path}"],
+        interval="10s",
+    )
+    srv = Server(cfg)
+    srv.start()
+    try:
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.connect(path)
+        f = c.makefile("wb")
+        for i in range(3):
+            ssf_wire.write_ssf(f, _span(id=i + 1,
+                                        metrics=[ssf.count("u.c", 1)]))
+        f.flush()
+        c.close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if sum(w.processed for w in srv.workers) >= 3:
+                break
+            time.sleep(0.02)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("u.c", MetricType.COUNTER)].value == 3.0
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Trace client
+
+
+def test_client_record_and_drop():
+    out: "queue.Queue" = queue.Queue()
+    c = Client(ChannelBackend(out), capacity=8)
+    c.record(_span())
+    got = out.get(timeout=2)
+    assert got.name == "op"
+    c.close()
+
+
+def test_client_would_block():
+    # backend that never drains: unstarted queue capacity 1
+    c = Client(ChannelBackend(queue.Queue()), capacity=1, num_backends=0)
+    c.record(_span())
+    with pytest.raises(ErrWouldBlock):
+        c.record(_span())
+    assert c.records_dropped == 1
+
+
+def test_client_neutralize():
+    c = Client(ChannelBackend(queue.Queue()), capacity=8)
+    neutralize_client(c)
+    c.record(_span())
+    c.flush()
+    c.close()
+
+
+def test_udp_backend_sends_parseable_span():
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2)
+    port = recv.getsockname()[1]
+    b = UDPBackend(("127.0.0.1", port))
+    b.send(_span(name="net-op"))
+    data = recv.recv(65536)
+    back = ssf_wire.parse_ssf(data)
+    assert back.name == "net-op"
+    b.close()
+    recv.close()
+
+
+def test_report_one_and_samples():
+    out: "queue.Queue" = queue.Queue()
+    c = Client(ChannelBackend(out), capacity=8)
+    assert report_one(c, ssf.count("internal.c", 1))
+    got = out.get(timeout=2)
+    assert got.metrics[0].name == "internal.c"
+    s = Samples()
+    s.add(ssf.gauge("g", 1), ssf.count("c", 2))
+    assert s.report(c)
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# Span model
+
+
+def test_span_lineage_and_headers():
+    root = Span("root", service="svc")
+    child = root.child("child")
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.id
+    finished = child.finish()
+    assert finished.start_timestamp > 0
+    assert finished.end_timestamp >= finished.start_timestamp
+
+    headers: dict = {}
+    root.inject_headers(headers)
+    cont = extract_request_child(headers, "next-hop")
+    assert cont.trace_id == root.trace_id
+    assert cont.parent_id == root.id

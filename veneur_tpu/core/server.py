@@ -26,8 +26,9 @@ from veneur_tpu import __version__
 from veneur_tpu.core.config import Config, parse_duration
 from veneur_tpu.core.flusher import device_quantiles, generate_inter_metrics
 from veneur_tpu.core.metrics import HistogramAggregates, InterMetric
+from veneur_tpu.core.spans import MetricExtractionSink, SpanWorker
 from veneur_tpu.core.worker import DeviceWorker, FlushSnapshot
-from veneur_tpu.protocol import dogstatsd
+from veneur_tpu.protocol import dogstatsd, ssf_wire
 from veneur_tpu.sinks import (
     MetricSink,
     SpanSink,
@@ -97,6 +98,24 @@ class Server:
         self.span_sinks: list[SpanSink] = list(span_sinks or [])
         self.sink_excluded_tags: dict[str, set[str]] = {}
 
+        # the span→metric bridge is always wired in, like the reference's
+        # ssfmetrics sink (server.go:407-415)
+        self._extraction_sink = MetricExtractionSink(
+            route_metric=self._route,
+            indicator_timer_name=cfg.indicator_span_timer_name,
+            objective_timer_name=cfg.objective_span_timer_name,
+        )
+        common_tags = dict(
+            t.split(":", 1) for t in self.tags if ":" in t)
+        self.span_worker = SpanWorker(
+            [self._extraction_sink] + self.span_sinks,
+            common_tags=common_tags,
+            capacity=cfg.span_channel_capacity,
+        )
+        # per-service span ingest counters (reference server.go:1088-1101)
+        self.ssf_spans_received: dict[str, int] = {}
+        self._ssf_stats_lock = threading.Lock()
+
         # installed by distributed/forward.py on local instances
         self.forwarder: Optional[Callable[[list[FlushSnapshot]], None]] = None
         # installed by protocol/ssf_server.py for span ingest
@@ -153,6 +172,105 @@ class Server:
         for line in datagram.split(b"\n"):
             if line:
                 self.handle_metric_packet(line)
+
+    # -- SSF ingest ---------------------------------------------------------
+
+    def handle_trace_packet(self, packet: bytes) -> None:
+        """One unframed SSF datagram → span pipeline
+        (reference HandleTracePacket, server.go:1046)."""
+        if not packet:
+            self.parse_errors += 1
+            return
+        try:
+            span = ssf_wire.parse_ssf(packet)
+        except ssf_wire.FramingError as e:
+            self.parse_errors += 1
+            log.debug("bad SSF packet: %s", e)
+            return
+        self.handle_ssf(span)
+
+    def handle_ssf(self, span) -> None:
+        """reference handleSSF (server.go:1077): per-service counters,
+        then into the span worker."""
+        service = span.service or "unknown"
+        with self._ssf_stats_lock:
+            self.ssf_spans_received[service] = (
+                self.ssf_spans_received.get(service, 0) + 1)
+        self.span_worker.ingest(span)
+
+    def start_ssf_udp(self, addr: str, port: int) -> int:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((addr, port))
+        bound_port = sock.getsockname()[1]
+        self._sockets.append(sock)
+
+        def loop():
+            while not self._shutdown.is_set():
+                try:
+                    data = sock.recv(ssf_wire.MAX_SSF_PACKET_LENGTH)
+                except OSError:
+                    return
+                self.handle_trace_packet(data)
+
+        self._spawn(loop, "ssf-udp")
+        return bound_port
+
+    def start_ssf_unix(self, path: str) -> None:
+        """Framed SSF over a unix stream socket
+        (reference startSSFUnix, networking.go:222-285)."""
+        if os.path.exists(path):
+            os.unlink(path)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(path)
+        sock.listen(64)
+        self._sockets.append(sock)
+
+        def accept_loop():
+            while not self._shutdown.is_set():
+                try:
+                    conn, _ = sock.accept()
+                except OSError:
+                    return
+                self._spawn(lambda c=conn: self._read_ssf_stream(c),
+                            "ssf-unix-conn")
+
+        self._spawn(accept_loop, "ssf-unix-accept")
+
+    def _read_ssf_stream(self, conn: socket.socket) -> None:
+        """Framed read loop; a framing error poisons the stream
+        (reference ReadSSFStreamSocket, server.go:1215)."""
+        f = conn.makefile("rb")
+        try:
+            while not self._shutdown.is_set():
+                span = ssf_wire.read_ssf(f)
+                if span is None:
+                    return
+                self.handle_ssf(span)
+        except ssf_wire.FramingError as e:
+            self.parse_errors += 1
+            log.debug("SSF stream framing error, closing: %s", e)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def start_ssf_listeners(self) -> dict[str, int]:
+        ports = {}
+        for spec in self.config.ssf_listen_addresses:
+            proto, _, rest = spec.partition("://")
+            if proto == "udp":
+                host, _, port = rest.rpartition(":")
+                ports[spec] = self.start_ssf_udp(host or "127.0.0.1",
+                                                 int(port))
+            elif proto in ("unix", "unixstream"):
+                self.start_ssf_unix(rest)
+            else:
+                raise ValueError(f"unsupported SSF listener {spec!r}")
+        return ports
 
     # -- listeners ----------------------------------------------------------
 
@@ -294,7 +412,9 @@ class Server:
         (reference Server.Start, server.go:826)."""
         for sink in self.metric_sinks + self.span_sinks:
             sink.start()
+        self.span_worker.start()
         ports = self.start_listeners()
+        ports.update(self.start_ssf_listeners())
         self._spawn(self._flush_loop, "flush-ticker")
         return ports
 
@@ -326,11 +446,7 @@ class Server:
             except Exception:
                 log.exception("sink %s FlushOtherSamples failed", sink.name())
 
-        for sink in self.span_sinks:
-            try:
-                sink.flush()
-            except Exception:
-                log.exception("span sink %s flush failed", sink.name())
+        self.span_worker.flush()
 
         qs = device_quantiles(self.percentiles, self.aggregates)
         snaps: list[FlushSnapshot] = []
@@ -403,6 +519,7 @@ class Server:
     def shutdown(self) -> None:
         """reference Server.Shutdown (server.go:1473)."""
         self._shutdown.set()
+        self.span_worker.stop()
         for sock in self._sockets:
             try:
                 sock.close()

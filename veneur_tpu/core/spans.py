@@ -1,0 +1,193 @@
+"""Span ingestion: SpanWorker and the span→metric bridge.
+
+Parity: reference SpanWorker (worker.go:611-695 — consumes the span
+channel, applies common tags, fans each span out to every span sink with a
+per-sink timeout) and the ssfmetrics extraction sink
+(sinks/ssfmetrics/metrics.go:66-141 — pulls the samples attached to a span,
+derives indicator/objective timers from indicator spans, counts span-name
+uniqueness, and feeds it all back into the metric workers by digest).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Optional
+
+from veneur_tpu import ssf
+from veneur_tpu.core.metrics import UDPMetric
+from veneur_tpu.protocol.dogstatsd import parse_metric_ssf, ParseError
+
+log = logging.getLogger("veneur_tpu.spans")
+
+
+def convert_metrics(span: ssf.SSFSpan) -> tuple[list[UDPMetric], int]:
+    """Extract the SSF samples attached to a span as UDPMetrics; returns
+    (metrics, invalid_count) (reference ConvertMetrics,
+    samplers/parser.go:103-120)."""
+    out = []
+    invalid = 0
+    for sample in span.metrics:
+        try:
+            m = parse_metric_ssf(sample)
+        except ParseError:
+            invalid += 1
+            continue
+        if not m.key.name or m.value is None:
+            invalid += 1
+            continue
+        out.append(m)
+    return out, invalid
+
+
+def convert_indicator_metrics(
+    span: ssf.SSFSpan, indicator_timer_name: str, objective_timer_name: str
+) -> list[UDPMetric]:
+    """Derive duration timers from an indicator span (reference
+    ConvertIndicatorMetrics, samplers/parser.go:129-181): the "indicator"
+    timer is tagged with service+error; the "objective" timer adds the
+    span name (overridable via the ssf_objective tag) and is global-only.
+    """
+    if not span.indicator or not ssf.valid_trace_span(span):
+        return []
+    duration_ns = span.end_timestamp - span.start_timestamp
+    out = []
+    if indicator_timer_name:
+        tags = {
+            "service": span.service,
+            "error": "true" if span.error else "false",
+        }
+        out.append(parse_metric_ssf(
+            ssf.timing_ns(indicator_timer_name, duration_ns, tags)))
+    if objective_timer_name:
+        tags = {
+            "service": span.service,
+            "objective": span.tags.get("ssf_objective") or span.name,
+            "error": "true" if span.error else "false",
+            "veneurglobalonly": "true",
+        }
+        out.append(parse_metric_ssf(
+            ssf.timing_ns(objective_timer_name, duration_ns, tags)))
+    return out
+
+
+def convert_span_uniqueness_metrics(span: ssf.SSFSpan, rate: float
+                                    ) -> list[UDPMetric]:
+    """Span-name uniqueness Set per service/indicator flag, sampled at
+    ``rate`` (reference ConvertSpanUniquenessMetrics,
+    samplers/parser.go:187-208)."""
+    if not span.service:
+        return []
+    samples = ssf.randomly_sample(
+        rate,
+        ssf.set_sample(
+            "ssf.names_unique", span.name,
+            {
+                "indicator": str(span.indicator).lower(),
+                "service": span.service,
+                "root_span": str(span.id == span.trace_id).lower(),
+            },
+        ),
+    )
+    return [parse_metric_ssf(s) for s in samples]
+
+
+class MetricExtractionSink:
+    """Span sink bridging spans back into the metric pipeline
+    (reference sinks/ssfmetrics — registered like any other span sink)."""
+
+    def __init__(
+        self,
+        route_metric: Callable[[UDPMetric], None],
+        indicator_timer_name: str = "",
+        objective_timer_name: str = "",
+        uniqueness_rate: float = 0.0,
+    ) -> None:
+        self.route_metric = route_metric
+        self.indicator_timer_name = indicator_timer_name
+        self.objective_timer_name = objective_timer_name
+        self.uniqueness_rate = uniqueness_rate
+        self.invalid_samples = 0
+
+    def name(self) -> str:
+        return "metric_extraction"
+
+    def start(self, trace_client=None) -> None:
+        pass
+
+    def ingest(self, span: ssf.SSFSpan) -> None:
+        metrics, invalid = convert_metrics(span)
+        self.invalid_samples += invalid
+        try:
+            metrics.extend(convert_indicator_metrics(
+                span, self.indicator_timer_name, self.objective_timer_name))
+        except ParseError:
+            self.invalid_samples += 1
+        if self.uniqueness_rate > 0:
+            metrics.extend(
+                convert_span_uniqueness_metrics(span, self.uniqueness_rate))
+        for m in metrics:
+            self.route_metric(m)
+
+    def flush(self) -> None:
+        pass
+
+
+class SpanWorker:
+    """Fans ingested spans out to every span sink
+    (reference SpanWorker.Work, worker.go:611-695)."""
+
+    def __init__(self, span_sinks: list, common_tags: Optional[dict] = None,
+                 capacity: int = 100, sink_timeout_s: float = 9.0) -> None:
+        self.span_sinks = span_sinks
+        self.common_tags = common_tags or {}
+        self.chan: "queue.Queue[Optional[ssf.SSFSpan]]" = queue.Queue(capacity)
+        self.sink_timeout_s = sink_timeout_s
+        self.spans_ingested = 0
+        self.spans_dropped = 0
+        self.sink_errors: dict[str, int] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    def ingest(self, span: ssf.SSFSpan) -> None:
+        """Non-blocking enqueue; drops when full (backpressure policy of
+        the span pipeline: loss over stalling)."""
+        try:
+            self.chan.put_nowait(span)
+        except queue.Full:
+            self.spans_dropped += 1
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.work, daemon=True, name="span-worker")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.chan.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def work(self) -> None:
+        while True:
+            span = self.chan.get()
+            if span is None:
+                return
+            self.spans_ingested += 1
+            # common tags fill in missing span tags (worker.go:627-634)
+            for k, v in self.common_tags.items():
+                span.tags.setdefault(k, v)
+            for sink in self.span_sinks:
+                try:
+                    sink.ingest(span)
+                except Exception as e:
+                    self.sink_errors[sink.name()] = (
+                        self.sink_errors.get(sink.name(), 0) + 1)
+                    log.debug("span sink %s ingest failed: %s",
+                              sink.name(), e)
+
+    def flush(self) -> None:
+        for sink in self.span_sinks:
+            try:
+                sink.flush()
+            except Exception:
+                log.exception("span sink %s flush failed", sink.name())

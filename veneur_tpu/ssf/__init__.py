@@ -149,11 +149,12 @@ def randomly_sample(rate: float, *samples: SSFSample) -> list[SSFSample]:
 
 
 def valid_trace_span(span: SSFSpan) -> bool:
-    """A span is a valid trace span if it has id, trace id, start and end
-    (reference protocol/errors.go ValidTrace)."""
+    """A span is a valid trace span if it has id, trace id, start, end and
+    a name (reference protocol/wire.go:85-89 ValidTrace)."""
     return (
         span.id != 0
         and span.trace_id != 0
         and span.start_timestamp != 0
         and span.end_timestamp != 0
+        and span.name != ""
     )
