@@ -1,0 +1,381 @@
+"""Sink and plugin tests with stubbed network."""
+
+import gzip
+import json
+import socket
+import time
+import zlib
+
+import pytest
+
+from veneur_tpu.core.metrics import InterMetric, MetricType
+from veneur_tpu.ssf import SSFSample, SSFSpan
+from veneur_tpu.protocol.dogstatsd import EVENT_IDENTIFIER_KEY
+
+
+class FakeOpener:
+    """Records every request; returns a canned response."""
+
+    def __init__(self):
+        self.requests = []
+
+    def __call__(self, req, timeout):
+        body = req.data or b""
+        if req.headers.get("Content-encoding") == "deflate":
+            body = zlib.decompress(body)
+        self.requests.append({
+            "url": req.full_url,
+            "method": req.get_method(),
+            "headers": dict(req.headers),
+            "body": body,
+        })
+        return b"{}"
+
+
+def _metric(name="m", value=5.0, mtype=MetricType.COUNTER, tags=None,
+            ts=1000):
+    return InterMetric(name=name, timestamp=ts, value=value,
+                       tags=tags or [], type=mtype)
+
+
+def _span(**kw):
+    base = dict(trace_id=7, id=8, parent_id=2,
+                start_timestamp=1_000_000_000, end_timestamp=3_000_000_000,
+                service="svc", name="op", tags={"k": "v"})
+    base.update(kw)
+    return SSFSpan(**base)
+
+
+# ---------------------------------------------------------------------------
+# Datadog
+
+
+def test_datadog_metric_conversion_and_post():
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    opener = FakeOpener()
+    sink = DatadogMetricSink(
+        interval=10.0, flush_max_per_body=100, hostname="h1",
+        tags=["global:tag"], dd_hostname="https://dd.example.com",
+        api_key="k", opener=opener)
+    sink.flush([
+        _metric("reqs", 50.0, MetricType.COUNTER, ["env:prod"]),
+        _metric("temp", 21.5, MetricType.GAUGE, ["host:other", "device:sda"]),
+        _metric("check", 1.0, MetricType.STATUS, []),
+    ])
+    series_reqs = [r for r in opener.requests if "/api/v1/series" in r["url"]]
+    check_reqs = [r for r in opener.requests if "check_run" in r["url"]]
+    assert len(series_reqs) == 1 and len(check_reqs) == 1
+    series = json.loads(series_reqs[0]["body"])["series"]
+    by_name = {s["metric"]: s for s in series}
+    # counter → rate divided by interval
+    assert by_name["reqs"]["type"] == "rate"
+    assert by_name["reqs"]["points"][0][1] == 5.0
+    assert by_name["reqs"]["host"] == "h1"
+    assert "global:tag" in by_name["reqs"]["tags"]
+    # host:/device: magic tags override fields and are stripped
+    assert by_name["temp"]["host"] == "other"
+    assert by_name["temp"]["device_name"] == "sda"
+    assert all(not t.startswith("host:") for t in by_name["temp"]["tags"])
+
+
+def test_datadog_prefix_drops_and_chunking():
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    opener = FakeOpener()
+    sink = DatadogMetricSink(
+        interval=10.0, flush_max_per_body=2, hostname="h", tags=[],
+        dd_hostname="https://dd", api_key="k",
+        metric_name_prefix_drops=["dropme."], opener=opener)
+    metrics = [_metric(f"keep.{i}", mtype=MetricType.GAUGE) for i in range(5)]
+    metrics.append(_metric("dropme.x", mtype=MetricType.GAUGE))
+    sink.flush(metrics)
+    series_reqs = [r for r in opener.requests if "series" in r["url"]]
+    assert len(series_reqs) == 3  # 5 metrics / 2 per body
+    total = sum(len(json.loads(r["body"])["series"]) for r in series_reqs)
+    assert total == 5
+
+
+def test_datadog_events():
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    opener = FakeOpener()
+    sink = DatadogMetricSink(10.0, 100, "h", [], "https://dd", "k",
+                             opener=opener)
+    sample = SSFSample(name="deploy", message="done",
+                       tags={EVENT_IDENTIFIER_KEY: "",
+                             "vdogstatsd_pri": "low", "team": "x"},
+                       timestamp=123)
+    sink.flush_other_samples([sample])
+    ev_reqs = [r for r in opener.requests if "/intake" in r["url"]]
+    assert len(ev_reqs) == 1
+    events = json.loads(ev_reqs[0]["body"])["events"]["api"]
+    assert events[0]["title"] == "deploy"
+    assert events[0]["priority"] == "low"
+    assert "team:x" in events[0]["tags"]
+
+
+def test_datadog_span_sink_ring_buffer():
+    from veneur_tpu.sinks.datadog import DatadogSpanSink
+
+    opener = FakeOpener()
+    sink = DatadogSpanSink("https://trace", buffer_size=2, opener=opener)
+    for i in range(5):
+        sink.ingest(_span(id=i + 1))
+    sink.flush()
+    traces = json.loads(opener.requests[0]["body"])
+    flat = [s for t in traces for s in t]
+    assert len(flat) == 2  # ring buffer kept the last 2
+    assert {s["span_id"] for s in flat} == {4, 5}
+
+
+# ---------------------------------------------------------------------------
+# SignalFx
+
+
+def test_signalfx_vary_key_by_and_drops():
+    from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+
+    opener = FakeOpener()
+    sink = SignalFxMetricSink(
+        api_key="default-key", hostname="h",
+        endpoint_base="https://sfx",
+        per_tag_api_keys={"teamA": "key-a"}, vary_key_by="team",
+        metric_name_prefix_drops=["noisy."],
+        metric_tag_prefix_drops=["secret"],
+        opener=opener)
+    sink.flush([
+        _metric("m1", 1.0, MetricType.GAUGE, ["team:teamA"]),
+        _metric("m2", 2.0, MetricType.GAUGE, ["team:other"]),
+        _metric("noisy.m", 3.0, MetricType.GAUGE),
+        _metric("m3", 4.0, MetricType.GAUGE, ["secret:x"]),
+    ])
+    tokens = {r["headers"]["X-sf-token"] for r in opener.requests}
+    assert tokens == {"key-a", "default-key"}
+    all_points = []
+    for r in opener.requests:
+        body = json.loads(r["body"])
+        all_points.extend(p["metric"] for p in body.get("gauge", []))
+    assert sorted(all_points) == ["m1", "m2"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus
+
+
+def test_prometheus_repeater_udp():
+    from veneur_tpu.sinks.prometheus import PrometheusMetricSink
+
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2)
+    port = recv.getsockname()[1]
+    sink = PrometheusMetricSink(f"127.0.0.1:{port}", "udp")
+    sink.flush([
+        _metric("http.reqs", 10.0, MetricType.COUNTER, ["code:200"]),
+        _metric("bad-name!", 1.5, MetricType.GAUGE),
+    ])
+    lines = {recv.recv(4096) for _ in range(2)}
+    assert b"http.reqs:10.0|c|#code:200" in lines
+    assert b"bad_name_:1.5|g" in lines
+    recv.close()
+
+
+# ---------------------------------------------------------------------------
+# Splunk
+
+
+def test_splunk_hec_batches():
+    from veneur_tpu.sinks.splunk import SplunkSpanSink
+
+    opener = FakeOpener()
+    sink = SplunkSpanSink("https://splunk:8088", "tok", batch_size=2,
+                          opener=opener)
+    sink.start()
+    for i in range(4):
+        sink.ingest(_span(id=i + 1))
+    deadline = time.time() + 5
+    while time.time() < deadline and sink.spans_flushed < 4:
+        time.sleep(0.05)
+    assert sink.spans_flushed >= 4
+    assert opener.requests[0]["headers"]["Authorization"] == "Splunk tok"
+    events = json.loads(opener.requests[0]["body"])
+    assert events[0]["event"]["service"] == "svc"
+
+
+# ---------------------------------------------------------------------------
+# New Relic
+
+
+def test_newrelic_insights_events():
+    from veneur_tpu.sinks.newrelic import NewRelicMetricSink
+
+    opener = FakeOpener()
+    sink = NewRelicMetricSink(123, "ik", common_tags=["env:prod"],
+                              opener=opener)
+    sink.flush([_metric("m", 5.0, MetricType.GAUGE, ["a:1"])])
+    req = opener.requests[0]
+    assert "/v1/accounts/123/events" in req["url"]
+    events = json.loads(req["body"])
+    assert events[0]["name"] == "m"
+    assert events[0]["a"] == "1"
+    assert events[0]["env"] == "prod"
+
+
+# ---------------------------------------------------------------------------
+# X-Ray
+
+
+def test_xray_segments_over_udp():
+    from veneur_tpu.sinks.xray import XRaySpanSink
+
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2)
+    port = recv.getsockname()[1]
+    sink = XRaySpanSink(f"127.0.0.1:{port}", 100.0, ["k"])
+    sink.ingest(_span())
+    data = recv.recv(65536)
+    header, payload = data.split(b"\n", 1)
+    assert json.loads(header)["format"] == "json"
+    seg = json.loads(payload)
+    assert seg["name"] == "svc"
+    assert seg["annotations"] == {"k": "v"}
+    assert seg["type"] == "subsegment"
+    recv.close()
+
+
+# ---------------------------------------------------------------------------
+# Kafka (injected producer)
+
+
+class FakeProducer:
+    def __init__(self):
+        self.messages = []
+
+    def send(self, topic, key, value):
+        self.messages.append((topic, key, value))
+
+    def flush(self):
+        pass
+
+
+def test_kafka_metric_and_span_sinks():
+    from veneur_tpu.sinks.kafka import KafkaMetricSink, KafkaSpanSink
+    from veneur_tpu.protocol import ssf_wire
+
+    prod = FakeProducer()
+    msink = KafkaMetricSink(prod, metric_topic="metrics")
+    msink.flush([_metric("km", 1.0)])
+    assert prod.messages[0][0] == "metrics"
+    assert json.loads(prod.messages[0][2])["name"] == "km"
+
+    ssink = KafkaSpanSink(prod, "spans", serialization="protobuf")
+    ssink.ingest(_span())
+    topic, key, value = prod.messages[-1]
+    assert topic == "spans"
+    back = ssf_wire.parse_ssf(value)
+    assert back.name == "op"
+
+
+# ---------------------------------------------------------------------------
+# grpsink / falconer
+
+
+def test_grpc_span_sink_roundtrip():
+    from veneur_tpu.sinks.grpsink import GRPCSpanSink, make_span_server
+
+    received = []
+    server, port = make_span_server(received.append)
+    try:
+        sink = GRPCSpanSink(f"127.0.0.1:{port}")
+        sink.start()
+        sink.ingest(_span(name="grpc-op"))
+        deadline = time.time() + 5
+        while time.time() < deadline and not received:
+            time.sleep(0.05)
+        assert received[0].name == "grpc-op"
+        assert sink.spans_flushed == 1
+        sink.stop()
+    finally:
+        server.stop(grace=1)
+
+
+# ---------------------------------------------------------------------------
+# Lightstep
+
+
+def test_lightstep_client_pool():
+    from veneur_tpu.sinks.lightstep import LightStepSpanSink
+
+    reports = []
+    sink = LightStepSpanSink(
+        "tok", num_clients=2,
+        transport=lambda client, spans: reports.append((client, spans)))
+    sink.ingest(_span(trace_id=2))  # → client 0
+    sink.ingest(_span(trace_id=3))  # → client 1
+    sink.flush()
+    assert sorted(r[0] for r in reports) == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# Plugins
+
+
+def test_localfile_plugin(tmp_path):
+    from veneur_tpu.plugins.localfile import LocalFilePlugin
+
+    path = tmp_path / "flush.tsv"
+    p = LocalFilePlugin(str(path), 10.0)
+    p.flush([_metric("fm", 2.5, MetricType.GAUGE, ["a:1"])], "host9")
+    content = path.read_text()
+    fields = content.strip().split("\t")
+    assert fields[0] == "fm"
+    assert fields[1] == "a:1"
+    assert fields[2] == "gauge"
+    assert fields[3] == "host9"
+
+
+def test_s3_plugin_sigv4(tmp_path):
+    from veneur_tpu.plugins.s3 import S3Plugin
+
+    opener = FakeOpener()
+    p = S3Plugin("bkt", "us-west-2", "AKID", "SECRET", 10.0, opener=opener)
+    p.flush([_metric("sm", 1.0)], "host1")
+    req = opener.requests[0]
+    assert req["method"] == "PUT"
+    assert req["url"].startswith("https://bkt.s3.us-west-2.amazonaws.com/")
+    auth = req["headers"]["Authorization"]
+    assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKID/")
+    assert "Signature=" in auth
+    body = gzip.decompress(req["body"])
+    assert body.split(b"\t")[0] == b"sm"
+
+
+# ---------------------------------------------------------------------------
+# Factory
+
+
+def test_build_server_from_config():
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.factory import build_server
+
+    opener = FakeOpener()
+    cfg = Config(
+        interval="10s",
+        hostname="h",
+        datadog_api_key="k", datadog_api_hostname="https://dd",
+        signalfx_api_key="sk",
+        flush_file="/tmp/veneur-test-flush.tsv",
+        tags_exclude=["noisy", "scoped|datadog"],
+        grpc_address="127.0.0.1:0",
+    )
+    srv = build_server(cfg, opener=opener)
+    names = {s.name() for s in srv.metric_sinks}
+    assert {"datadog", "signalfx"} <= names
+    assert srv.plugins[0].name() == "localfile"
+    assert srv.import_server is not None
+    assert "noisy" in srv.sink_excluded_tags["datadog"]
+    assert "scoped" in srv.sink_excluded_tags["datadog"]
+    assert "scoped" not in srv.sink_excluded_tags.get("signalfx", set())
+    srv.shutdown()

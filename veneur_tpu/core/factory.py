@@ -1,0 +1,234 @@
+"""Server assembly from config: sinks, plugins, forwarding, import servers.
+
+Parity: reference NewFromConfig (server.go:262-822) — per-config sink
+construction (:474-732), plugin registration (:737-785), importsrv when
+grpc_address is set (:807-817), and sink-name routing/excluded tags.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.server import Server
+
+log = logging.getLogger("veneur_tpu.factory")
+
+
+def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
+                 opener=None) -> Server:
+    """Construct a fully wired Server from configuration.
+
+    opener (optional) is injected into every HTTP-based sink for tests.
+    """
+    metric_sinks = list(extra_metric_sinks or [])
+    span_sinks = list(extra_span_sinks or [])
+    interval = cfg.interval_seconds()
+    kw = {"opener": opener} if opener else {}
+
+    hostname = cfg.hostname
+    if not hostname and not cfg.omit_empty_hostname:
+        import socket as _socket
+
+        hostname = _socket.gethostname()
+
+    if cfg.datadog_api_key and cfg.datadog_api_hostname:
+        from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+        metric_sinks.append(DatadogMetricSink(
+            interval=interval,
+            flush_max_per_body=cfg.datadog_flush_max_per_body,
+            hostname=hostname,
+            tags=list(cfg.tags),
+            dd_hostname=cfg.datadog_api_hostname,
+            api_key=cfg.datadog_api_key,
+            metric_name_prefix_drops=cfg.datadog_metric_name_prefix_drops,
+            exclude_tags_prefix_by_prefix_metric={
+                e.metric_prefix: e.tags
+                for e in cfg.datadog_exclude_tags_prefix_by_prefix_metric
+            },
+            **kw,
+        ))
+    if cfg.datadog_trace_api_address:
+        from veneur_tpu.sinks.datadog import DatadogSpanSink
+
+        span_sinks.append(DatadogSpanSink(
+            cfg.datadog_trace_api_address,
+            buffer_size=cfg.datadog_span_buffer_size,
+            **kw,
+        ))
+
+    if cfg.signalfx_api_key:
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+
+        metric_sinks.append(SignalFxMetricSink(
+            api_key=cfg.signalfx_api_key,
+            hostname=hostname,
+            hostname_tag=cfg.signalfx_hostname_tag,
+            endpoint_base=(cfg.signalfx_endpoint_base
+                           or "https://ingest.signalfx.com"),
+            per_tag_api_keys={
+                k.name: k.api_key for k in cfg.signalfx_per_tag_api_keys
+            },
+            vary_key_by=cfg.signalfx_vary_key_by,
+            metric_name_prefix_drops=cfg.signalfx_metric_name_prefix_drops,
+            metric_tag_prefix_drops=cfg.signalfx_metric_tag_prefix_drops,
+            flush_max_per_body=cfg.signalfx_flush_max_per_body,
+            **kw,
+        ))
+
+    if cfg.prometheus_repeater_address:
+        from veneur_tpu.sinks.prometheus import PrometheusMetricSink
+
+        metric_sinks.append(PrometheusMetricSink(
+            cfg.prometheus_repeater_address, cfg.prometheus_network_type))
+
+    if cfg.newrelic_insert_key and cfg.newrelic_account_id:
+        from veneur_tpu.sinks.newrelic import NewRelicMetricSink
+
+        metric_sinks.append(NewRelicMetricSink(
+            account_id=cfg.newrelic_account_id,
+            insert_key=cfg.newrelic_insert_key,
+            event_type=cfg.newrelic_event_type,
+            service_check_event_type=cfg.newrelic_service_check_event_type,
+            common_tags=cfg.newrelic_common_tags,
+            region=cfg.newrelic_region,
+            **kw,
+        ))
+    if cfg.newrelic_insert_key and cfg.newrelic_trace_observer_url:
+        from veneur_tpu.sinks.newrelic import NewRelicSpanSink
+
+        span_sinks.append(NewRelicSpanSink(
+            insert_key=cfg.newrelic_insert_key,
+            trace_observer_url=cfg.newrelic_trace_observer_url,
+            common_tags=cfg.newrelic_common_tags,
+            **kw,
+        ))
+
+    if cfg.kafka_broker:
+        from veneur_tpu.sinks.kafka import (
+            KafkaMetricSink, KafkaSpanSink, default_producer)
+
+        try:
+            producer = default_producer(
+                cfg.kafka_broker, cfg.kafka_retry_max,
+                cfg.kafka_metric_require_acks)
+            if cfg.kafka_metric_topic or cfg.kafka_check_topic:
+                metric_sinks.append(KafkaMetricSink(
+                    producer, cfg.kafka_check_topic, cfg.kafka_event_topic,
+                    cfg.kafka_metric_topic))
+            if cfg.kafka_span_topic:
+                span_sinks.append(KafkaSpanSink(
+                    producer, cfg.kafka_span_topic,
+                    cfg.kafka_span_serialization_format,
+                    cfg.kafka_span_sample_rate_percent,
+                    cfg.kafka_span_sample_tag))
+        except RuntimeError as e:
+            log.warning("kafka sink disabled: %s", e)
+
+    if cfg.splunk_hec_address and cfg.splunk_hec_token:
+        from veneur_tpu.core.config import parse_duration
+        from veneur_tpu.sinks.splunk import SplunkSpanSink
+
+        span_sinks.append(SplunkSpanSink(
+            hec_address=cfg.splunk_hec_address,
+            token=cfg.splunk_hec_token,
+            hostname=hostname,
+            batch_size=cfg.splunk_hec_batch_size,
+            submission_workers=cfg.splunk_hec_submission_workers,
+            span_sample_rate=cfg.splunk_span_sample_rate,
+            send_timeout_s=(parse_duration(cfg.splunk_hec_send_timeout)
+                            if cfg.splunk_hec_send_timeout else 10.0),
+            **kw,
+        ))
+
+    if cfg.xray_address:
+        from veneur_tpu.sinks.xray import XRaySpanSink
+
+        span_sinks.append(XRaySpanSink(
+            cfg.xray_address, cfg.xray_sample_percentage,
+            cfg.xray_annotation_tags))
+
+    if cfg.lightstep_access_token or cfg.trace_lightstep_access_token:
+        from veneur_tpu.sinks.lightstep import LightStepSpanSink
+
+        span_sinks.append(LightStepSpanSink(
+            access_token=(cfg.lightstep_access_token
+                          or cfg.trace_lightstep_access_token),
+            collector_host=(cfg.lightstep_collector_host
+                            or cfg.trace_lightstep_collector_host
+                            or "https://collector.lightstep.com"),
+            num_clients=(cfg.lightstep_num_clients
+                         or cfg.trace_lightstep_num_clients or 1),
+            maximum_spans=(cfg.lightstep_maximum_spans
+                           or cfg.trace_lightstep_maximum_spans or 100000),
+            **kw,
+        ))
+
+    if cfg.falconer_address:
+        from veneur_tpu.sinks.grpsink import FalconerSpanSink
+
+        span_sinks.append(FalconerSpanSink(cfg.falconer_address))
+
+    if cfg.debug_flushed_metrics:
+        from veneur_tpu.sinks.debug import DebugMetricSink
+
+        metric_sinks.append(DebugMetricSink())
+    if cfg.debug_ingested_spans:
+        from veneur_tpu.sinks.debug import DebugSpanSink
+
+        span_sinks.append(DebugSpanSink())
+
+    server = Server(cfg, metric_sinks=metric_sinks, span_sinks=span_sinks)
+
+    # plugins (reference server.go:737-785)
+    if cfg.flush_file:
+        from veneur_tpu.plugins.localfile import LocalFilePlugin
+
+        server.plugins.append(LocalFilePlugin(cfg.flush_file, interval))
+    if cfg.aws_s3_bucket and cfg.aws_access_key_id:
+        from veneur_tpu.plugins.s3 import S3Plugin
+
+        server.plugins.append(S3Plugin(
+            cfg.aws_s3_bucket, cfg.aws_region or "us-east-1",
+            cfg.aws_access_key_id, cfg.aws_secret_access_key, interval,
+            **kw,
+        ))
+
+    # forwarding (local instances)
+    if cfg.forward_address:
+        from veneur_tpu.distributed.forward import install_forwarder
+
+        install_forwarder(server)
+
+    # import servers (global instances; reference server.go:807-817 for
+    # gRPC, http.go:22-60 for the HTTP /import + healthcheck API)
+    if cfg.grpc_address:
+        from veneur_tpu.distributed.import_server import ImportServer
+
+        server.import_server = ImportServer(server)
+        server.import_server.start_grpc(cfg.grpc_address)
+    if cfg.http_address:
+        from veneur_tpu.distributed.import_server import (
+            ImportHTTPServer, ImportServer)
+
+        if server.import_server is None:
+            server.import_server = ImportServer(server)
+        host, _, port = cfg.http_address.rpartition(":")
+        server.import_http = ImportHTTPServer(server.import_server)
+        server.import_http.start(host or "127.0.0.1", int(port))
+
+    # per-sink excluded tags from tags_exclude "tag:sink1:sink2" syntax
+    # (reference setSinkExcludedTags, server.go:1522-1548: a plain entry
+    # excludes the tag everywhere; "tag|sink" limits it to one sink)
+    for entry in cfg.tags_exclude:
+        if "|" in entry:
+            tag, _, sink_name = entry.partition("|")
+            server.sink_excluded_tags.setdefault(sink_name, set()).add(tag)
+        else:
+            for sink in metric_sinks:
+                server.sink_excluded_tags.setdefault(
+                    sink.name(), set()).add(entry)
+
+    return server

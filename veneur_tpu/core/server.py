@@ -118,6 +118,11 @@ class Server:
 
         # installed by distributed/forward.py on local instances
         self.forwarder: Optional[Callable[[list[FlushSnapshot]], None]] = None
+        # flush-time archival plugins (reference plugins/plugins.go)
+        self.plugins: list = []
+        # attached by core/factory.py when grpc/http addresses are set
+        self.import_server = None
+        self.import_http = None
         # installed by protocol/ssf_server.py for span ingest
         self.span_handler = None
 
@@ -483,7 +488,20 @@ class Server:
                 threads.append(t)
             for t in threads:
                 t.join(timeout=self.interval)
+            if self.plugins:
+                threading.Thread(
+                    target=self._flush_plugins, args=(final,), daemon=True,
+                    name="flush-plugins",
+                ).start()
         return final
+
+    def _flush_plugins(self, metrics: list[InterMetric]) -> None:
+        """reference flusher.go:117-131: plugins run after the sinks."""
+        for plugin in self.plugins:
+            try:
+                plugin.flush(metrics, self.hostname)
+            except Exception:
+                log.exception("plugin %s flush failed", plugin.name())
 
     @staticmethod
     def _flush_sink(sink: MetricSink, metrics: list[InterMetric]) -> None:
@@ -520,6 +538,10 @@ class Server:
         """reference Server.Shutdown (server.go:1473)."""
         self._shutdown.set()
         self.span_worker.stop()
+        if self.import_server is not None:
+            self.import_server.stop()
+        if self.import_http is not None:
+            self.import_http.stop()
         for sock in self._sockets:
             try:
                 sock.close()

@@ -1,0 +1,32 @@
+"""Local-file plugin: append each flush as TSV to a file.
+
+Parity: reference plugins/localfile/localfile.go (the flush_file config).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from veneur_tpu.plugins import Plugin, encode_inter_metrics_tsv
+
+log = logging.getLogger("veneur_tpu.plugins.localfile")
+
+
+class LocalFilePlugin(Plugin):
+    def __init__(self, path: str, interval_s: float = 10.0) -> None:
+        self.path = path
+        self.interval_s = interval_s
+        self.flush_errors = 0
+
+    def name(self) -> str:
+        return "localfile"
+
+    def flush(self, metrics, hostname: str) -> None:
+        try:
+            data = encode_inter_metrics_tsv(metrics, hostname,
+                                            self.interval_s)
+            with open(self.path, "ab") as f:
+                f.write(data)
+        except OSError as e:
+            self.flush_errors += 1
+            log.warning("localfile flush failed: %s", e)

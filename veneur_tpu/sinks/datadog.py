@@ -1,0 +1,260 @@
+"""Datadog sinks: metrics (+service checks +events) and APM spans.
+
+Parity: reference sinks/datadog/datadog.go — counter→rate conversion
+divided by the flush interval (:353-358), host:/device: magic tags
+(:300-330), metric-name prefix drops, per-metric-prefix tag exclusion,
+chunked parallel POSTs sized by flush_max_per_body (:112-148), span sink
+with a bounded ring buffer (:32, datadogSpanBufferSize 1<<14), events and
+service checks unwound from their special SSF tags.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Optional
+
+from veneur_tpu.core.metrics import InterMetric, MetricType
+from veneur_tpu.protocol import dogstatsd as ddproto
+from veneur_tpu.sinks import MetricSink, SpanSink
+from veneur_tpu.ssf import SSFSample, SSFSpan
+from veneur_tpu.utils.http import default_opener, post_json
+
+log = logging.getLogger("veneur_tpu.sinks.datadog")
+
+DEFAULT_SPAN_BUFFER_SIZE = 1 << 14
+
+
+class DatadogMetricSink(MetricSink):
+    def __init__(
+        self,
+        interval: float,
+        flush_max_per_body: int,
+        hostname: str,
+        tags: list[str],
+        dd_hostname: str,
+        api_key: str,
+        metric_name_prefix_drops: Optional[list[str]] = None,
+        exclude_tags_prefix_by_prefix_metric: Optional[dict] = None,
+        excluded_tags: Optional[list[str]] = None,
+        opener=default_opener,
+    ) -> None:
+        self.interval = interval
+        self.flush_max_per_body = flush_max_per_body or 25000
+        self.hostname = hostname
+        self.tags = list(tags)
+        self.dd_hostname = dd_hostname.rstrip("/")
+        self.api_key = api_key
+        self.metric_name_prefix_drops = metric_name_prefix_drops or []
+        self.exclude_tags_prefix_by_prefix_metric = (
+            exclude_tags_prefix_by_prefix_metric or {})
+        self.excluded_tags = list(excluded_tags or [])
+        self.opener = opener
+        self.flushed_metrics = 0
+        self.flush_errors = 0
+
+    def name(self) -> str:
+        return "datadog"
+
+    def set_excluded_tags(self, excluded: list[str]) -> None:
+        self.excluded_tags = list(excluded)
+
+    # -- conversion (reference finalizeMetrics :256-384) --------------------
+
+    def _finalize(self, metrics: list[InterMetric]
+                  ) -> tuple[list[dict], list[dict]]:
+        dd_metrics = []
+        checks = []
+        for m in metrics:
+            if any(m.name.startswith(p)
+                   for p in self.metric_name_prefix_drops):
+                continue
+            per_metric_excludes: list[str] = []
+            for prefix, extags in (
+                self.exclude_tags_prefix_by_prefix_metric.items()
+            ):
+                if m.name.startswith(prefix):
+                    per_metric_excludes = list(extags)
+                    break
+
+            tags = [
+                t for t in self.tags
+                if not any(t.startswith(e) for e in self.excluded_tags)
+            ]
+            hostname = ""
+            devicename = ""
+            for tag in m.tags:
+                if tag.startswith("host:"):
+                    hostname = tag[5:]
+                elif tag.startswith("device:"):
+                    devicename = tag[7:]
+                elif any(tag.startswith(e) for e in self.excluded_tags):
+                    continue
+                elif any(tag.startswith(e) for e in per_metric_excludes):
+                    continue
+                else:
+                    tags.append(tag)
+            if not hostname:
+                hostname = self.hostname
+
+            if m.type == MetricType.STATUS:
+                checks.append({
+                    "check": m.name,
+                    "message": m.message,
+                    "timestamp": m.timestamp,
+                    "tags": tags,
+                    "status": int(m.value),
+                    "host_name": hostname,
+                })
+                continue
+
+            if m.type == MetricType.COUNTER:
+                # counters are reported to Datadog as rates
+                metric_type = "rate"
+                value = m.value / self.interval
+            elif m.type == MetricType.GAUGE:
+                metric_type = "gauge"
+                value = m.value
+            else:
+                continue
+
+            dd_metrics.append({
+                "metric": m.name,
+                "points": [[m.timestamp, value]],
+                "tags": tags,
+                "type": metric_type,
+                "interval": int(self.interval),
+                "host": hostname,
+                "device_name": devicename,
+            })
+        return dd_metrics, checks
+
+    # -- flushing (reference Flush :112-160, chunked parallel posts) --------
+
+    def flush(self, metrics: list[InterMetric]) -> None:
+        dd_metrics, checks = self._finalize(metrics)
+        threads = []
+        for i in range(0, len(dd_metrics), self.flush_max_per_body):
+            chunk = dd_metrics[i:i + self.flush_max_per_body]
+            t = threading.Thread(
+                target=self._post_series, args=(chunk,), daemon=True)
+            t.start()
+            threads.append(t)
+        for check in checks:
+            try:
+                post_json(
+                    f"{self.dd_hostname}/api/v1/check_run"
+                    f"?api_key={self.api_key}",
+                    check, opener=self.opener)
+            except Exception as e:
+                self.flush_errors += 1
+                log.warning("datadog check_run post failed: %s", e)
+        for t in threads:
+            t.join(timeout=30)
+
+    def _post_series(self, chunk: list[dict]) -> None:
+        try:
+            post_json(
+                f"{self.dd_hostname}/api/v1/series?api_key={self.api_key}",
+                {"series": chunk}, compress=True, opener=self.opener)
+            self.flushed_metrics += len(chunk)
+        except Exception as e:
+            self.flush_errors += 1
+            log.warning("datadog series post failed: %s", e)
+
+    # -- events (reference FlushOtherSamples :162-253) ----------------------
+
+    def flush_other_samples(self, samples: list[SSFSample]) -> None:
+        events = []
+        for s in samples:
+            if ddproto.EVENT_IDENTIFIER_KEY not in s.tags:
+                continue
+            tags = {
+                k: v for k, v in s.tags.items()
+                if k != ddproto.EVENT_IDENTIFIER_KEY
+            }
+            event = {
+                "title": s.name,
+                "text": s.message,
+                "date_happened": s.timestamp,
+                "tags": [
+                    f"{k}:{v}" if v else k
+                    for k, v in tags.items()
+                    if not k.startswith("vdogstatsd_")
+                ] + self.tags,
+            }
+            if ddproto.EVENT_HOSTNAME_TAG_KEY in tags:
+                event["host"] = tags[ddproto.EVENT_HOSTNAME_TAG_KEY]
+            if ddproto.EVENT_AGGREGATION_KEY_TAG_KEY in tags:
+                event["aggregation_key"] = (
+                    tags[ddproto.EVENT_AGGREGATION_KEY_TAG_KEY])
+            if ddproto.EVENT_PRIORITY_TAG_KEY in tags:
+                event["priority"] = tags[ddproto.EVENT_PRIORITY_TAG_KEY]
+            if ddproto.EVENT_SOURCE_TYPE_TAG_KEY in tags:
+                event["source_type_name"] = (
+                    tags[ddproto.EVENT_SOURCE_TYPE_TAG_KEY])
+            if ddproto.EVENT_ALERT_TYPE_TAG_KEY in tags:
+                event["alert_type"] = tags[ddproto.EVENT_ALERT_TYPE_TAG_KEY]
+            events.append(event)
+        if not events:
+            return
+        try:
+            post_json(
+                f"{self.dd_hostname}/intake?api_key={self.api_key}",
+                {"events": {"api": events}}, opener=self.opener)
+        except Exception as e:
+            self.flush_errors += 1
+            log.warning("datadog event post failed: %s", e)
+
+
+class DatadogSpanSink(SpanSink):
+    """Buffers spans in a bounded ring and flushes them to the Datadog
+    trace-agent API (reference datadogSpanSink, ring buffer :32)."""
+
+    def __init__(self, trace_api_address: str,
+                 buffer_size: int = DEFAULT_SPAN_BUFFER_SIZE,
+                 opener=default_opener) -> None:
+        self.trace_api_address = trace_api_address.rstrip("/")
+        self.buffer: "collections.deque[SSFSpan]" = collections.deque(
+            maxlen=buffer_size)
+        self._lock = threading.Lock()
+        self.opener = opener
+        self.spans_flushed = 0
+        self.flush_errors = 0
+
+    def name(self) -> str:
+        return "datadog"
+
+    def ingest(self, span: SSFSpan) -> None:
+        with self._lock:
+            self.buffer.append(span)
+
+    def flush(self) -> None:
+        with self._lock:
+            spans = list(self.buffer)
+            self.buffer.clear()
+        if not spans:
+            return
+        traces: dict[int, list[dict]] = {}
+        for s in spans:
+            traces.setdefault(s.trace_id, []).append({
+                "trace_id": s.trace_id,
+                "span_id": s.id,
+                "parent_id": s.parent_id,
+                "start": s.start_timestamp,
+                "duration": s.end_timestamp - s.start_timestamp,
+                "name": s.name,
+                "resource": s.tags.get("resource", s.name),
+                "service": s.service,
+                "error": 1 if s.error else 0,
+                "meta": dict(s.tags),
+            })
+        try:
+            post_json(
+                f"{self.trace_api_address}/v0.3/traces",
+                list(traces.values()), opener=self.opener)
+            self.spans_flushed += len(spans)
+        except Exception as e:
+            self.flush_errors += 1
+            log.warning("datadog trace post failed: %s", e)

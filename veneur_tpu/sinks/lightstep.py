@@ -1,0 +1,87 @@
+"""Lightstep span sink.
+
+Parity: reference sinks/lightstep/lightstep.go — spans forwarded to a
+Lightstep collector through a pool of N clients, round-robining on trace
+id so one trace always lands on one client.
+
+The Lightstep collector protocol is carried by its proprietary client
+library, which this environment doesn't ship; the transport is injectable
+(any callable accepting a span dict) and defaults to the collector's HTTP
+JSON report endpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from veneur_tpu.sinks import SpanSink
+from veneur_tpu.ssf import SSFSpan
+from veneur_tpu.utils.http import default_opener, post_json
+
+log = logging.getLogger("veneur_tpu.sinks.lightstep")
+
+
+class LightStepSpanSink(SpanSink):
+    def __init__(self, access_token: str,
+                 collector_host: str = "https://collector.lightstep.com",
+                 num_clients: int = 1,
+                 maximum_spans: int = 100000,
+                 transport: Optional[Callable[[int, list[dict]], None]] = None,
+                 opener=default_opener) -> None:
+        self.access_token = access_token
+        self.collector_host = collector_host.rstrip("/")
+        self.num_clients = max(1, num_clients)
+        self.maximum_spans = maximum_spans
+        self.opener = opener
+        self.transport = transport or self._http_report
+        # per-client span buffers
+        self._buffers: list[list[dict]] = [[] for _ in range(self.num_clients)]
+        self.spans_flushed = 0
+        self.spans_dropped = 0
+        self.flush_errors = 0
+
+    def name(self) -> str:
+        return "lightstep"
+
+    def ingest(self, span: SSFSpan) -> None:
+        # one trace → one client (reference round-robins on trace id)
+        client = span.trace_id % self.num_clients
+        buf = self._buffers[client]
+        if len(buf) >= self.maximum_spans // self.num_clients:
+            self.spans_dropped += 1
+            return
+        buf.append({
+            "span_guid": str(span.id),
+            "trace_guid": str(span.trace_id),
+            "parent_guid": str(span.parent_id) if span.parent_id else "",
+            "operation_name": span.name,
+            "oldest_micros": span.start_timestamp // 1000,
+            "youngest_micros": span.end_timestamp // 1000,
+            "attributes": [
+                {"Key": k, "Value": v} for k, v in span.tags.items()
+            ] + [
+                {"Key": "component", "Value": span.service},
+                {"Key": "error", "Value": str(span.error).lower()},
+            ],
+        })
+
+    def flush(self) -> None:
+        for client, buf in enumerate(self._buffers):
+            if not buf:
+                continue
+            self._buffers[client] = []
+            try:
+                self.transport(client, buf)
+                self.spans_flushed += len(buf)
+            except Exception as e:
+                self.flush_errors += 1
+                log.warning("lightstep report failed: %s", e)
+
+    def _http_report(self, client: int, spans: list[dict]) -> None:
+        post_json(
+            f"{self.collector_host}/api/v0/reports",
+            {"auth": {"access_token": self.access_token},
+             "span_records": spans},
+            opener=self.opener,
+        )

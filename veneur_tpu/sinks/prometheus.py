@@ -1,0 +1,84 @@
+"""Prometheus sink: statsd repeater to a prometheus statsd-exporter.
+
+Parity: reference sinks/prometheus/prometheus.go — each flushed metric is
+re-emitted as a DogStatsD line to a statsd_exporter address over UDP or
+TCP; metric names and tags are sanitized to the exporter's accepted
+character set.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import socket
+from typing import Optional
+
+from veneur_tpu.core.metrics import InterMetric, MetricType
+from veneur_tpu.sinks import MetricSink
+
+log = logging.getLogger("veneur_tpu.sinks.prometheus")
+
+_INVALID_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+_INVALID_TAG = re.compile(r"[^a-zA-Z0-9_:,=\.]")
+
+
+def sanitize_name(name: str) -> str:
+    return _INVALID_NAME.sub("_", name)
+
+
+def sanitize_tag(tag: str) -> str:
+    return _INVALID_TAG.sub("_", tag)
+
+
+class PrometheusMetricSink(MetricSink):
+    def __init__(self, repeater_address: str, network_type: str = "tcp"
+                 ) -> None:
+        host, _, port = repeater_address.rpartition(":")
+        self.address = (host or "127.0.0.1", int(port))
+        self.network_type = network_type
+        self._sock: Optional[socket.socket] = None
+        self.flushed_metrics = 0
+        self.flush_errors = 0
+
+    def name(self) -> str:
+        return "prometheus"
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            if self.network_type == "udp":
+                self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                self._sock.connect(self.address)
+            else:
+                self._sock = socket.create_connection(self.address,
+                                                      timeout=10)
+        return self._sock
+
+    def _statsd_line(self, m: InterMetric) -> Optional[bytes]:
+        if m.type == MetricType.COUNTER:
+            kind = "c"
+        elif m.type == MetricType.GAUGE:
+            kind = "g"
+        else:
+            return None  # statsd_exporter has no service-check concept
+        line = f"{sanitize_name(m.name)}:{m.value}|{kind}"
+        if m.tags:
+            line += "|#" + ",".join(sanitize_tag(t) for t in m.tags)
+        return line.encode("utf-8")
+
+    def flush(self, metrics: list[InterMetric]) -> None:
+        lines = [ln for ln in (self._statsd_line(m) for m in metrics)
+                 if ln is not None]
+        if not lines:
+            return
+        try:
+            sock = self._connect()
+            if self.network_type == "udp":
+                for ln in lines:
+                    sock.send(ln)
+            else:
+                sock.sendall(b"\n".join(lines) + b"\n")
+            self.flushed_metrics += len(lines)
+        except OSError as e:
+            self.flush_errors += 1
+            self._sock = None
+            log.warning("prometheus repeater send failed: %s", e)

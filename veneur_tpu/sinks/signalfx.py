@@ -1,0 +1,143 @@
+"""SignalFx sink: datapoints + events, per-tag API-key fan-out.
+
+Parity: reference sinks/signalfx/signalfx.go — counters and gauges become
+SignalFx datapoints (counter → cumulative counter-style rate point), the
+`vary_key_by` tag selects a per-key client so each customer's traffic uses
+its own API key (:per-tag clients), metric/tag prefix drops, and events
+via FlushOtherSamples.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from veneur_tpu.core.metrics import InterMetric, MetricType
+from veneur_tpu.protocol import dogstatsd as ddproto
+from veneur_tpu.sinks import MetricSink
+from veneur_tpu.ssf import SSFSample
+from veneur_tpu.utils.http import default_opener, post_json
+
+log = logging.getLogger("veneur_tpu.sinks.signalfx")
+
+
+class SignalFxMetricSink(MetricSink):
+    def __init__(
+        self,
+        api_key: str,
+        hostname: str,
+        hostname_tag: str = "host",
+        endpoint_base: str = "https://ingest.signalfx.com",
+        per_tag_api_keys: Optional[dict[str, str]] = None,
+        vary_key_by: str = "",
+        metric_name_prefix_drops: Optional[list[str]] = None,
+        metric_tag_prefix_drops: Optional[list[str]] = None,
+        flush_max_per_body: int = 0,
+        opener=default_opener,
+    ) -> None:
+        self.api_key = api_key
+        self.hostname = hostname
+        self.hostname_tag = hostname_tag or "host"
+        self.endpoint_base = endpoint_base.rstrip("/")
+        self.per_tag_api_keys = dict(per_tag_api_keys or {})
+        self.vary_key_by = vary_key_by
+        self.name_drops = metric_name_prefix_drops or []
+        self.tag_drops = metric_tag_prefix_drops or []
+        self.flush_max_per_body = flush_max_per_body or 5000
+        self.opener = opener
+        self.flushed_metrics = 0
+        self.flush_errors = 0
+
+    def name(self) -> str:
+        return "signalfx"
+
+    def _convert(self, m: InterMetric) -> Optional[tuple[str, dict]]:
+        if any(m.name.startswith(p) for p in self.name_drops):
+            return None
+        dims = {self.hostname_tag: m.hostname or self.hostname}
+        vary_value = ""
+        drop = False
+        for tag in m.tags:
+            if any(tag.startswith(p) for p in self.tag_drops):
+                drop = True
+                break
+            k, _, v = tag.partition(":")
+            dims[k] = v
+            if self.vary_key_by and k == self.vary_key_by:
+                vary_value = v
+        if drop:
+            return None
+        if m.type == MetricType.COUNTER:
+            kind = "counter"
+            value = m.value
+        elif m.type == MetricType.GAUGE:
+            kind = "gauge"
+            value = m.value
+        else:
+            return None
+        point = {
+            "metric": m.name,
+            "value": value,
+            "timestamp": m.timestamp * 1000,
+            "dimensions": dims,
+        }
+        api_key = self.per_tag_api_keys.get(vary_value, self.api_key)
+        return api_key, {kind: point}
+
+    def flush(self, metrics: list[InterMetric]) -> None:
+        # group by API key (per-tag clients)
+        by_key: dict[str, dict[str, list]] = {}
+        for m in metrics:
+            conv = self._convert(m)
+            if conv is None:
+                continue
+            api_key, kinds = conv
+            bucket = by_key.setdefault(api_key, {"counter": [], "gauge": []})
+            for kind, point in kinds.items():
+                bucket[kind].append(point)
+        threads = []
+        for api_key, payload in by_key.items():
+            body = {k: v for k, v in payload.items() if v}
+            t = threading.Thread(
+                target=self._post, args=(api_key, body), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=30)
+
+    def _post(self, api_key: str, body: dict) -> None:
+        try:
+            post_json(
+                f"{self.endpoint_base}/v2/datapoint", body,
+                headers={"X-SF-Token": api_key}, opener=self.opener)
+            self.flushed_metrics += sum(len(v) for v in body.values())
+        except Exception as e:
+            self.flush_errors += 1
+            log.warning("signalfx datapoint post failed: %s", e)
+
+    def flush_other_samples(self, samples: list[SSFSample]) -> None:
+        events = []
+        for s in samples:
+            if ddproto.EVENT_IDENTIFIER_KEY not in s.tags:
+                continue
+            dims = {
+                k: v for k, v in s.tags.items()
+                if not k.startswith("vdogstatsd_")
+            }
+            events.append({
+                "eventType": s.name,
+                "category": "USER_DEFINED",
+                "dimensions": dims,
+                "properties": {"description": s.message},
+                "timestamp": s.timestamp * 1000,
+            })
+        if not events:
+            return
+        try:
+            post_json(
+                f"{self.endpoint_base}/v2/event", events,
+                headers={"X-SF-Token": self.api_key}, opener=self.opener)
+        except Exception as e:
+            self.flush_errors += 1
+            log.warning("signalfx event post failed: %s", e)

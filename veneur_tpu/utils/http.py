@@ -1,0 +1,54 @@
+"""Small HTTP helper used by sinks and forwarding.
+
+Plays the role of the reference's http/http.go PostHelper (JSON body,
+optional zlib deflate, tracing hooks kept simple). The opener is
+injectable so sink tests stub the network.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.error
+import urllib.request
+import zlib
+from typing import Callable, Optional
+
+log = logging.getLogger("veneur_tpu.http")
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, body: bytes) -> None:
+        super().__init__(f"HTTP {status}: {body[:200]!r}")
+        self.status = status
+        self.body = body
+
+
+def default_opener(req: urllib.request.Request, timeout: float) -> bytes:
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        raise HTTPError(e.code, e.read()) from None
+
+
+Opener = Callable[[urllib.request.Request, float], bytes]
+
+
+def post_json(
+    url: str,
+    obj,
+    headers: Optional[dict[str, str]] = None,
+    timeout: float = 10.0,
+    compress: bool = False,
+    opener: Opener = default_opener,
+) -> bytes:
+    body = json.dumps(obj).encode("utf-8")
+    hdrs = {"Content-Type": "application/json"}
+    if compress:
+        body = zlib.compress(body)
+        hdrs["Content-Encoding"] = "deflate"
+    if headers:
+        hdrs.update(headers)
+    req = urllib.request.Request(url, data=body, method="POST", headers=hdrs)
+    return opener(req, timeout)
