@@ -18,7 +18,7 @@ from veneur_tpu.sinks import MetricSink
 
 log = logging.getLogger("veneur_tpu.sinks.prometheus")
 
-_INVALID_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+_INVALID_NAME = re.compile(r"[^a-zA-Z0-9_:.]")  # dots map to exporter paths
 _INVALID_TAG = re.compile(r"[^a-zA-Z0-9_:,=\.]")
 
 
