@@ -1,0 +1,180 @@
+"""CLI binary tests: emit, prometheus poller, config validation."""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from veneur_tpu.cli import emit, prometheus_poller
+from veneur_tpu.cli.veneur_main import main as veneur_main
+from veneur_tpu.core.config import load_proxy_config
+from veneur_tpu.protocol import ssf_wire
+from veneur_tpu.protocol.dogstatsd import parse_metric, parse_event
+
+
+def _udp_receiver():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(3)
+    return sock, sock.getsockname()[1]
+
+
+def test_emit_statsd_metrics():
+    sock, port = _udp_receiver()
+    rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                    "-name", "cli.counter", "-count", "3",
+                    "-tag", "env:dev,team:x"])
+    assert rc == 0
+    data = sock.recv(4096)
+    m = parse_metric(data)
+    assert m.name == "cli.counter"
+    assert m.value == 3.0
+    assert m.tags == ["env:dev", "team:x"]
+    sock.close()
+
+
+def test_emit_event():
+    sock, port = _udp_receiver()
+    rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                    "-mode", "event",
+                    "-e_title", "deploy", "-e_text", "done",
+                    "-e_alert_type", "info"])
+    assert rc == 0
+    e = parse_event(sock.recv(4096))
+    assert e.name == "deploy"
+    sock.close()
+
+
+def test_emit_service_check():
+    sock, port = _udp_receiver()
+    rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                    "-mode", "sc", "-sc_name", "svc", "-sc_status", "2",
+                    "-sc_msg", "broken"])
+    assert rc == 0
+    from veneur_tpu.protocol.dogstatsd import parse_service_check
+    sc = parse_service_check(sock.recv(4096))
+    assert sc.name == "svc"
+    sock.close()
+
+
+def test_emit_command_mode_ssf_span():
+    sock, port = _udp_receiver()
+    rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                    "-ssf", "-name", "cmd.duration",
+                    "-command", "true"])
+    assert rc == 0
+    span = ssf_wire.parse_ssf(sock.recv(65536))
+    assert span.name == "cmd.duration"
+    assert not span.error
+    assert span.metrics[0].name == "cmd.duration"
+    sock.close()
+
+
+def test_emit_command_failure_propagates_exit():
+    sock, port = _udp_receiver()
+    rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                    "-ssf", "-name", "cmd.duration",
+                    "-command", "false"])
+    assert rc == 1
+    span = ssf_wire.parse_ssf(sock.recv(65536))
+    assert span.error
+    sock.close()
+
+
+# ---------------------------------------------------------------------------
+# prometheus poller
+
+
+PROM_BODY = """\
+# HELP http_requests_total Requests.
+# TYPE http_requests_total counter
+http_requests_total{code="200"} 100
+http_requests_total{code="500"} 5
+# TYPE temp_gauge gauge
+temp_gauge 21.5
+# TYPE req_latency histogram
+req_latency_bucket{le="0.1"} 50
+req_latency_bucket{le="+Inf"} 60
+req_latency_sum 12.5
+req_latency_count 60
+"""
+
+
+def test_prometheus_text_parsing():
+    types, samples = prometheus_poller.parse_prometheus_text(PROM_BODY)
+    assert types["http_requests_total"] == "counter"
+    assert types["req_latency"] == "histogram"
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert ({"code": "200"}, 100.0) in by_name["http_requests_total"]
+    assert by_name["temp_gauge"][0][1] == 21.5
+
+
+def test_prometheus_counter_dedupe():
+    cache = prometheus_poller.CountCache()
+    types, samples = prometheus_poller.parse_prometheus_text(PROM_BODY)
+    # first scrape establishes baselines; only gauges emitted
+    lines1 = prometheus_poller.translate(types, samples, cache, [])
+    assert any(b"temp_gauge:21.5|g" in ln for ln in lines1)
+    assert not any(b"http_requests_total" in ln for ln in lines1)
+    # second scrape with +10 on the 200 counter
+    body2 = PROM_BODY.replace('code="200"} 100', 'code="200"} 110')
+    types2, samples2 = prometheus_poller.parse_prometheus_text(body2)
+    lines2 = prometheus_poller.translate(types2, samples2, cache, ["x:y"])
+    counter_lines = [ln for ln in lines2 if b"http_requests_total" in ln]
+    assert counter_lines == [b"http_requests_total:10.0|c|#code:200,x:y"]
+
+
+def test_prometheus_poller_end_to_end():
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = PROM_BODY.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    sock, port = _udp_receiver()
+    try:
+        rc = prometheus_poller.main([
+            "-p", f"http://127.0.0.1:{httpd.server_port}/metrics",
+            "-s", f"127.0.0.1:{port}", "-once"])
+        assert rc == 0
+        data = sock.recv(65536)
+        assert b"temp_gauge:21.5|g" in data
+    finally:
+        httpd.shutdown()
+        sock.close()
+
+
+# ---------------------------------------------------------------------------
+# config CLIs
+
+
+def test_veneur_main_validate_config(tmp_path):
+    p = tmp_path / "ok.yaml"
+    p.write_text("interval: 5s\npercentiles: [0.5]\n")
+    assert veneur_main(["-f", str(p), "-validate-config"]) == 0
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("interval: nonsense\n")
+    assert veneur_main(["-f", str(bad), "-validate-config"]) == 1
+
+
+def test_load_proxy_config(tmp_path):
+    p = tmp_path / "proxy.yaml"
+    p.write_text(
+        "consul_forward_service_name: veneur-global\n"
+        "grpc_address: 127.0.0.1:8128\n"
+    )
+    cfg = load_proxy_config(str(p))
+    assert cfg.consul_forward_service_name == "veneur-global"
+    assert cfg.grpc_address == "127.0.0.1:8128"

@@ -1,0 +1,82 @@
+"""veneur-tpu-proxy: the consistent-hash proxy tier binary.
+
+Parity: reference cmd/veneur-proxy/main.go:20-58 — reads the proxy config,
+starts the gRPC proxy with Consul/Kubernetes discovery (or a static
+forward address), and refreshes destinations periodically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from veneur_tpu.core.config import load_proxy_config, parse_duration
+from veneur_tpu.distributed.proxy import DestinationRefresher, ProxyServer
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="veneur-tpu-proxy")
+    parser.add_argument("-f", dest="config", required=True)
+    parser.add_argument("-validate-config", action="store_true",
+                        dest="validate")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    log = logging.getLogger("veneur_tpu.proxy-main")
+
+    try:
+        cfg = load_proxy_config(args.config)
+    except Exception as e:
+        print(f"config invalid: {e}", file=sys.stderr)
+        return 1
+    if args.validate:
+        print("config valid")
+        return 0
+    if cfg.debug:
+        logging.getLogger().setLevel(logging.DEBUG)
+
+    static = [cfg.forward_address] if cfg.forward_address else []
+    proxy = ProxyServer(static,
+                        timeout_s=parse_duration(cfg.forward_timeout))
+    address = cfg.grpc_address or "127.0.0.1:8128"
+    port = proxy.start_grpc(address)
+    log.info("proxy serving gRPC on %s (port %s)", address, port)
+
+    refresher = None
+    if cfg.consul_forward_service_name:
+        from veneur_tpu.distributed.discovery import ConsulDiscoverer
+
+        refresher = DestinationRefresher(
+            proxy, ConsulDiscoverer(cfg.consul_url),
+            cfg.consul_forward_service_name,
+            parse_duration(cfg.consul_refresh_interval))
+    elif cfg.kubernetes_forward_service_name:
+        from veneur_tpu.distributed.discovery import KubernetesDiscoverer
+
+        refresher = DestinationRefresher(
+            proxy, KubernetesDiscoverer(namespace=cfg.kubernetes_namespace),
+            cfg.kubernetes_forward_service_name,
+            parse_duration(cfg.consul_refresh_interval))
+    if refresher is not None:
+        refresher.start()
+    elif not static:
+        log.warning("no destinations configured: set forward_address or a"
+                    " discovery service name")
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    if refresher is not None:
+        refresher.stop()
+    proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

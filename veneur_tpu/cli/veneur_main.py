@@ -1,0 +1,68 @@
+"""veneur-tpu: the aggregation server binary.
+
+Parity: reference cmd/veneur/main.go:25-95 — `-f config.yaml` plus
+`-validate-config` / `-validate-config-strict` modes, watchdog startup,
+and signal-driven graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from veneur_tpu.core.config import load_config, redacted_dict
+from veneur_tpu.core.factory import build_server
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="veneur-tpu")
+    parser.add_argument("-f", dest="config", required=True,
+                        help="path to config yaml")
+    parser.add_argument("-validate-config", action="store_true",
+                        dest="validate")
+    parser.add_argument("-validate-config-strict", action="store_true",
+                        dest="validate_strict")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    try:
+        cfg = load_config(args.config, strict=args.validate_strict)
+    except Exception as e:
+        print(f"config invalid: {e}", file=sys.stderr)
+        return 1
+    if args.validate or args.validate_strict:
+        print("config valid")
+        return 0
+
+    if cfg.debug:
+        logging.getLogger().setLevel(logging.DEBUG)
+        logging.getLogger("veneur_tpu").debug(
+            "config: %s", redacted_dict(cfg))
+
+    server = build_server(cfg)
+    ports = server.start()
+    server.start_watchdog()
+    logging.getLogger("veneur_tpu").info(
+        "veneur-tpu %s serving (local=%s) listeners=%s",
+        server.version, server.is_local, ports)
+
+    stop = threading.Event()
+
+    def _handle(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _handle)
+    signal.signal(signal.SIGINT, _handle)
+    stop.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -235,6 +235,60 @@ class Config:
         return self.forward_address != ""
 
 
+@dataclass
+class ProxyConfig:
+    """veneur-proxy configuration (reference config_proxy.go:3-27)."""
+
+    consul_forward_service_name: str = ""
+    consul_refresh_interval: str = "30s"
+    consul_url: str = "http://127.0.0.1:8500"
+    kubernetes_forward_service_name: str = ""
+    kubernetes_namespace: str = "default"
+    debug: bool = False
+    enable_profiling: bool = False
+    forward_address: str = ""  # static destination (no discovery)
+    forward_timeout: str = "10s"
+    grpc_address: str = ""
+    grpc_forward_address: str = ""
+    http_address: str = ""
+    max_idle_conns_per_host: int = 100
+    sentry_dsn: str = ""
+    ssf_destination_address: str = ""
+    stats_address: str = ""
+    tracing_client_capacity: int = 1024
+    tracing_client_flush_interval: str = "500ms"
+    tracing_client_metrics_interval: str = "1s"
+
+
+def load_proxy_config(path: Optional[str] = None,
+                      data: Optional[dict] = None,
+                      env: Optional[dict] = None) -> ProxyConfig:
+    """reference ReadProxyConfig (config_parse.go:33)."""
+    raw: dict[str, Any] = {}
+    if path is not None:
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+    if data is not None:
+        raw.update(data)
+    cfg = ProxyConfig()
+    known = {f.name for f in fields(cfg)}
+    unknown = [k for k in raw if k not in known]
+    if unknown:
+        log.warning("unknown proxy config keys: %s", sorted(unknown))
+    for key, value in raw.items():
+        if key in known and value is not None:
+            setattr(cfg, key, _coerce(value, getattr(cfg, key), key))
+    env = os.environ if env is None else env
+    for name in known:
+        for candidate in ("VENEUR_" + name.upper(),
+                          "VENEUR_" + name.upper().replace("_", "")):
+            if candidate in env:
+                setattr(cfg, name,
+                        _coerce(env[candidate], getattr(cfg, name), name))
+                break
+    return cfg
+
+
 SECRET_FIELDS = {
     "datadog_api_key", "signalfx_api_key", "sentry_dsn",
     "aws_access_key_id", "aws_secret_access_key", "newrelic_insert_key",
