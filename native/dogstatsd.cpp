@@ -1,0 +1,633 @@
+// Native ingest hot path: DogStatsD parsing, tag normalization, series
+// directory, and SoA batch building.
+//
+// The reference's per-packet CPU hotspot is its zero-allocation Go parser +
+// map upsert (samplers/parser.go:298-423, worker.go:108-177, SURVEY.md
+// §3.2). Here the whole host-side ingest path is one C++ translation unit:
+// a packet buffer goes in; dense (row, value, weight) SoA arrays come out,
+// ready to be shipped to the device. Row assignment (the series directory)
+// lives in an open-addressing hash table keyed by the same 32-bit FNV-1a
+// identity digest the Python parser computes, so both front ends agree.
+//
+// Events (_e{) and service checks (_sc) are rare control-plane traffic and
+// are handed back to Python verbatim.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kFnv32Offset = 2166136261u;
+constexpr uint32_t kFnv32Prime = 16777619u;
+constexpr uint64_t kFnv64Offset = 0xcbf29ce484222325ull;
+constexpr uint64_t kFnv64Prime = 0x100000001b3ull;
+
+inline uint32_t fnv1a32(std::string_view s, uint32_t h = kFnv32Offset) {
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= kFnv32Prime;
+  }
+  return h;
+}
+
+inline uint64_t fnv1a64(std::string_view s) {
+  uint64_t h = kFnv64Offset;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= kFnv64Prime;
+  }
+  return h;
+}
+
+inline uint64_t fmix64(uint64_t h) {
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdull;
+  h ^= h >> 33;
+  h *= 0xc4ceb9fe1a85ec53ull;
+  h ^= h >> 33;
+  return h;
+}
+
+// Strict float parse matching the Python/Go rules: full consumption, no
+// whitespace or underscores, finite.
+bool parse_value(std::string_view s, double* out) {
+  if (s.empty()) return false;
+  for (char c : s) {
+    if (c == '_' || std::isspace(static_cast<unsigned char>(c))) return false;
+  }
+  std::string buf(s);
+  char* end = nullptr;
+  double v = std::strtod(buf.c_str(), &end);
+  if (end != buf.c_str() + buf.size()) return false;
+  if (!std::isfinite(v)) return false;
+  *out = v;
+  return true;
+}
+
+enum MetricKind : int32_t {
+  KIND_COUNTER = 0,
+  KIND_GAUGE = 1,
+  KIND_HISTOGRAM = 2,
+  KIND_TIMER = 3,
+  KIND_SET = 4,
+};
+
+enum ScopeClass : int32_t {
+  SCOPE_MIXED = 0,
+  SCOPE_LOCAL = 1,
+  SCOPE_GLOBAL = 2,
+};
+
+const char* kind_type_string(MetricKind k) {
+  switch (k) {
+    case KIND_COUNTER: return "counter";
+    case KIND_GAUGE: return "gauge";
+    case KIND_HISTOGRAM: return "histogram";
+    case KIND_TIMER: return "timer";
+    case KIND_SET: return "set";
+  }
+  return "";
+}
+
+// scope label per WorkerMetrics.Upsert routing (worker.go:108-177)
+ScopeClass classify(MetricKind kind, int scope /*0 mixed,1 local,2 global*/) {
+  switch (kind) {
+    case KIND_COUNTER:
+    case KIND_GAUGE:
+      return scope == 2 ? SCOPE_GLOBAL : SCOPE_MIXED;
+    case KIND_HISTOGRAM:
+    case KIND_TIMER:
+      if (scope == 1) return SCOPE_LOCAL;
+      if (scope == 2) return SCOPE_GLOBAL;
+      return SCOPE_MIXED;
+    case KIND_SET:
+      return scope == 1 ? SCOPE_LOCAL : SCOPE_MIXED;
+  }
+  return SCOPE_MIXED;
+}
+
+struct NewSeries {
+  int32_t pool;  // 0 histo, 1 set, 2 counter, 3 gauge
+  int32_t row;
+  int32_t kind;
+  int32_t scope_class;
+  std::string name;
+  std::string joined_tags;
+};
+
+// Open-addressing directory: identity = (kind-type string, scope class,
+// name, joined tags), hashed with the same fnv1a32 digest as parse time.
+struct Directory {
+  struct Slot {
+    uint64_t key_hash = 0;
+    int32_t row = -1;
+    uint32_t key_off = 0;
+    uint32_t key_len = 0;
+  };
+  std::vector<Slot> slots;
+  std::string arena;
+  size_t used = 0;
+
+  Directory() : slots(1 << 12) {}
+
+  void reset() {
+    slots.assign(1 << 12, Slot{});
+    arena.clear();
+    used = 0;
+  }
+
+  void grow() {
+    std::vector<Slot> old;
+    old.swap(slots);
+    slots.assign(old.size() * 2, Slot{});
+    for (const Slot& s : old) {
+      if (s.row >= 0) {
+        size_t mask = slots.size() - 1;
+        size_t i = s.key_hash & mask;
+        while (slots[i].row >= 0) i = (i + 1) & mask;
+        slots[i] = s;
+      }
+    }
+  }
+
+  // returns row; *created set when the series is new. next_row supplies
+  // the row id for a new series.
+  int32_t upsert(uint64_t key_hash, std::string_view key, int32_t next_row,
+                 bool* created) {
+    if (used * 4 >= slots.size() * 3) grow();
+    size_t mask = slots.size() - 1;
+    size_t i = key_hash & mask;
+    while (slots[i].row >= 0) {
+      if (slots[i].key_hash == key_hash &&
+          std::string_view(arena).substr(slots[i].key_off,
+                                         slots[i].key_len) == key) {
+        *created = false;
+        return slots[i].row;
+      }
+      i = (i + 1) & mask;
+    }
+    slots[i].key_hash = key_hash;
+    slots[i].row = next_row;
+    slots[i].key_off = static_cast<uint32_t>(arena.size());
+    slots[i].key_len = static_cast<uint32_t>(key.size());
+    arena.append(key);
+    ++used;
+    *created = true;
+    return next_row;
+  }
+};
+
+struct Ctx {
+  int hll_precision = 14;
+
+  Directory dir;
+  int32_t next_histo_row = 0;
+  int32_t next_set_row = 0;
+  int32_t next_counter_row = 0;
+  int32_t next_gauge_row = 0;
+
+  // pending SoA batches
+  std::vector<int32_t> h_rows;
+  std::vector<float> h_vals;
+  std::vector<float> h_wts;
+  std::vector<int32_t> c_rows;
+  std::vector<double> c_contribs;
+  std::vector<int32_t> g_rows;
+  std::vector<double> g_vals;
+  std::vector<int32_t> s_rows;
+  std::vector<int32_t> s_idx;
+  std::vector<int8_t> s_rank;
+
+  std::vector<NewSeries> new_series;
+  std::string other_lines;  // events/_sc handed back to Python, \n-joined
+
+  long long processed = 0;
+  long long errors = 0;
+
+  // scratch reused across lines
+  std::vector<std::string_view> tags;
+  std::string joined;
+  std::string key;
+};
+
+// Parse one metric line; returns false on parse error.
+bool handle_line(Ctx* ctx, std::string_view line) {
+  size_t colon = line.find(':');
+  if (colon == std::string_view::npos || colon == 0) return false;
+  std::string_view name = line.substr(0, colon);
+  size_t pipe1 = line.find('|', colon + 1);
+  if (pipe1 == std::string_view::npos) return false;
+  std::string_view value_chunk = line.substr(colon + 1, pipe1 - colon - 1);
+  size_t pipe2 = line.find('|', pipe1 + 1);
+  std::string_view type_chunk =
+      line.substr(pipe1 + 1, (pipe2 == std::string_view::npos
+                                  ? line.size()
+                                  : pipe2) - pipe1 - 1);
+  if (type_chunk.empty()) return false;
+
+  MetricKind kind;
+  switch (type_chunk[0]) {
+    case 'c': kind = KIND_COUNTER; break;
+    case 'g': kind = KIND_GAUGE; break;
+    case 'd':
+    case 'h': kind = KIND_HISTOGRAM; break;
+    case 'm': kind = KIND_TIMER; break;
+    case 's': kind = KIND_SET; break;
+    default: return false;
+  }
+
+  double value = 0;
+  std::string_view set_value;
+  if (kind == KIND_SET) {
+    set_value = value_chunk;
+  } else {
+    if (!parse_value(value_chunk, &value)) return false;
+  }
+
+  double sample_rate = 1.0;
+  bool found_rate = false, found_tags = false;
+  int scope = 0;
+  ctx->tags.clear();
+  ctx->joined.clear();
+
+  size_t pos = pipe2;
+  while (pos != std::string_view::npos) {
+    size_t next = line.find('|', pos + 1);
+    std::string_view chunk =
+        line.substr(pos + 1, (next == std::string_view::npos ? line.size()
+                                                             : next) -
+                                 pos - 1);
+    if (chunk.empty()) return false;
+    if (chunk[0] == '@') {
+      if (found_rate) return false;
+      if (!parse_value(chunk.substr(1), &sample_rate)) return false;
+      if (!(sample_rate > 0 && sample_rate <= 1)) return false;
+      found_rate = true;
+    } else if (chunk[0] == '#') {
+      if (found_tags) return false;
+      found_tags = true;
+      std::string_view rest = chunk.substr(1);
+      while (true) {
+        size_t comma = rest.find(',');
+        ctx->tags.push_back(rest.substr(0, comma));
+        if (comma == std::string_view::npos) break;
+        rest = rest.substr(comma + 1);
+      }
+      std::sort(ctx->tags.begin(), ctx->tags.end());
+      // first magic scope tag (prefix match) is consumed
+      // (samplers/parser.go:394-408)
+      for (size_t i = 0; i < ctx->tags.size(); ++i) {
+        constexpr std::string_view kLocal = "veneurlocalonly";
+        constexpr std::string_view kGlobal = "veneurglobalonly";
+        if (ctx->tags[i].substr(0, kLocal.size()) == kLocal) {
+          scope = 1;
+          ctx->tags.erase(ctx->tags.begin() + i);
+          break;
+        }
+        if (ctx->tags[i].substr(0, kGlobal.size()) == kGlobal) {
+          scope = 2;
+          ctx->tags.erase(ctx->tags.begin() + i);
+          break;
+        }
+      }
+      for (size_t i = 0; i < ctx->tags.size(); ++i) {
+        if (i) ctx->joined.push_back(',');
+        ctx->joined.append(ctx->tags[i]);
+      }
+    } else {
+      return false;
+    }
+    pos = next;
+  }
+
+  const char* type_str = kind_type_string(kind);
+  ScopeClass cls = classify(kind, scope);
+
+  // identity digest: fnv1a32 over name, type, joined tags (parse-time
+  // digest, samplers/parser.go:325-420)
+  uint32_t digest = fnv1a32(name);
+  digest = fnv1a32(type_str, digest);
+  digest = fnv1a32(ctx->joined, digest);
+
+  // directory key spans identity + scope class (the same MetricKey can
+  // legally live in two scope maps)
+  ctx->key.clear();
+  ctx->key.append(name);
+  ctx->key.push_back('\x1f');
+  ctx->key.append(type_str);
+  ctx->key.push_back('\x1f');
+  ctx->key.append(ctx->joined);
+  ctx->key.push_back('\x1f');
+  ctx->key.push_back(static_cast<char>('0' + cls));
+  uint64_t key_hash =
+      fmix64((static_cast<uint64_t>(digest) << 32) ^ fnv1a64(ctx->key));
+
+  bool created = false;
+  int32_t row;
+  int32_t pool;
+  switch (kind) {
+    case KIND_HISTOGRAM:
+    case KIND_TIMER: {
+      pool = 0;
+      row = ctx->dir.upsert(key_hash, ctx->key, ctx->next_histo_row,
+                            &created);
+      if (created) ++ctx->next_histo_row;
+      ctx->h_rows.push_back(row);
+      ctx->h_vals.push_back(static_cast<float>(value));
+      ctx->h_wts.push_back(static_cast<float>(1.0 / sample_rate));
+      break;
+    }
+    case KIND_SET: {
+      pool = 1;
+      row = ctx->dir.upsert(key_hash, ctx->key, ctx->next_set_row, &created);
+      if (created) ++ctx->next_set_row;
+      uint64_t h = fmix64(fnv1a64(set_value));
+      int p = ctx->hll_precision;
+      uint32_t idx = static_cast<uint32_t>(h >> (64 - p));
+      uint64_t w = h << p;
+      int rank = w == 0 ? (64 - p + 1) : (__builtin_clzll(w) + 1);
+      if (rank > 64 - p + 1) rank = 64 - p + 1;
+      ctx->s_rows.push_back(row);
+      ctx->s_idx.push_back(static_cast<int32_t>(idx));
+      ctx->s_rank.push_back(static_cast<int8_t>(rank));
+      break;
+    }
+    case KIND_COUNTER: {
+      pool = 2;
+      row = ctx->dir.upsert(key_hash, ctx->key, ctx->next_counter_row,
+                            &created);
+      if (created) ++ctx->next_counter_row;
+      // Go semantics: int64(sample) * int64(1/rate)
+      ctx->c_rows.push_back(row);
+      ctx->c_contribs.push_back(
+          static_cast<double>(static_cast<long long>(value) *
+                              static_cast<long long>(1.0 / sample_rate)));
+      break;
+    }
+    case KIND_GAUGE: {
+      pool = 3;
+      row = ctx->dir.upsert(key_hash, ctx->key, ctx->next_gauge_row,
+                            &created);
+      if (created) ++ctx->next_gauge_row;
+      ctx->g_rows.push_back(row);
+      ctx->g_vals.push_back(value);
+      break;
+    }
+  }
+  if (created) {
+    NewSeries ns;
+    ns.pool = pool;
+    ns.row = row;
+    ns.kind = kind;
+    ns.scope_class = cls;
+    ns.name.assign(name);
+    ns.joined_tags = ctx->joined;
+    ctx->new_series.push_back(std::move(ns));
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* vn_ctx_new(int hll_precision) {
+  Ctx* ctx = new Ctx();
+  ctx->hll_precision = hll_precision;
+  return ctx;
+}
+
+void vn_ctx_free(void* p) { delete static_cast<Ctx*>(p); }
+
+void vn_ctx_reset(void* p) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  ctx->dir.reset();
+  ctx->next_histo_row = ctx->next_set_row = 0;
+  ctx->next_counter_row = ctx->next_gauge_row = 0;
+  ctx->h_rows.clear();
+  ctx->h_vals.clear();
+  ctx->h_wts.clear();
+  ctx->c_rows.clear();
+  ctx->c_contribs.clear();
+  ctx->g_rows.clear();
+  ctx->g_vals.clear();
+  ctx->s_rows.clear();
+  ctx->s_idx.clear();
+  ctx->s_rank.clear();
+  ctx->new_series.clear();
+  ctx->other_lines.clear();
+  ctx->processed = 0;
+  ctx->errors = 0;
+}
+
+// Ingest a datagram (possibly multiple newline-separated lines).
+// Returns the number of metric lines accepted.
+int vn_ingest(void* p, const char* buf, int len) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::string_view data(buf, static_cast<size_t>(len));
+  int accepted = 0;
+  while (!data.empty()) {
+    size_t nl = data.find('\n');
+    std::string_view line =
+        nl == std::string_view::npos ? data : data.substr(0, nl);
+    data = nl == std::string_view::npos ? std::string_view()
+                                        : data.substr(nl + 1);
+    if (line.empty()) continue;
+    if (line.substr(0, 3) == "_e{" || line.substr(0, 3) == "_sc") {
+      ctx->other_lines.append(line);
+      ctx->other_lines.push_back('\n');
+      continue;
+    }
+    if (handle_line(ctx, line)) {
+      ++ctx->processed;
+      ++accepted;
+    } else {
+      ++ctx->errors;
+    }
+  }
+  return accepted;
+}
+
+int vn_pending_histo(void* p) {
+  return static_cast<int>(static_cast<Ctx*>(p)->h_rows.size());
+}
+int vn_pending_set(void* p) {
+  return static_cast<int>(static_cast<Ctx*>(p)->s_rows.size());
+}
+int vn_pending_counter(void* p) {
+  return static_cast<int>(static_cast<Ctx*>(p)->c_rows.size());
+}
+int vn_pending_gauge(void* p) {
+  return static_cast<int>(static_cast<Ctx*>(p)->g_rows.size());
+}
+int vn_num_histo_rows(void* p) {
+  return static_cast<Ctx*>(p)->next_histo_row;
+}
+int vn_num_set_rows(void* p) { return static_cast<Ctx*>(p)->next_set_row; }
+int vn_num_counter_rows(void* p) {
+  return static_cast<Ctx*>(p)->next_counter_row;
+}
+int vn_num_gauge_rows(void* p) { return static_cast<Ctx*>(p)->next_gauge_row; }
+long long vn_processed(void* p) { return static_cast<Ctx*>(p)->processed; }
+long long vn_errors(void* p) { return static_cast<Ctx*>(p)->errors; }
+
+int vn_drain_histo(void* p, int32_t* rows, float* vals, float* wts, int cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  int n = std::min<int>(cap, static_cast<int>(ctx->h_rows.size()));
+  std::memcpy(rows, ctx->h_rows.data(), n * sizeof(int32_t));
+  std::memcpy(vals, ctx->h_vals.data(), n * sizeof(float));
+  std::memcpy(wts, ctx->h_wts.data(), n * sizeof(float));
+  ctx->h_rows.erase(ctx->h_rows.begin(), ctx->h_rows.begin() + n);
+  ctx->h_vals.erase(ctx->h_vals.begin(), ctx->h_vals.begin() + n);
+  ctx->h_wts.erase(ctx->h_wts.begin(), ctx->h_wts.begin() + n);
+  return n;
+}
+
+int vn_drain_set(void* p, int32_t* rows, int32_t* idx, int8_t* rank,
+                 int cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  int n = std::min<int>(cap, static_cast<int>(ctx->s_rows.size()));
+  std::memcpy(rows, ctx->s_rows.data(), n * sizeof(int32_t));
+  std::memcpy(idx, ctx->s_idx.data(), n * sizeof(int32_t));
+  std::memcpy(rank, ctx->s_rank.data(), n * sizeof(int8_t));
+  ctx->s_rows.erase(ctx->s_rows.begin(), ctx->s_rows.begin() + n);
+  ctx->s_idx.erase(ctx->s_idx.begin(), ctx->s_idx.begin() + n);
+  ctx->s_rank.erase(ctx->s_rank.begin(), ctx->s_rank.begin() + n);
+  return n;
+}
+
+int vn_drain_counter(void* p, int32_t* rows, double* contribs, int cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  int n = std::min<int>(cap, static_cast<int>(ctx->c_rows.size()));
+  std::memcpy(rows, ctx->c_rows.data(), n * sizeof(int32_t));
+  std::memcpy(contribs, ctx->c_contribs.data(), n * sizeof(double));
+  ctx->c_rows.erase(ctx->c_rows.begin(), ctx->c_rows.begin() + n);
+  ctx->c_contribs.erase(ctx->c_contribs.begin(),
+                        ctx->c_contribs.begin() + n);
+  return n;
+}
+
+int vn_drain_gauge(void* p, int32_t* rows, double* vals, int cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  int n = std::min<int>(cap, static_cast<int>(ctx->g_rows.size()));
+  std::memcpy(rows, ctx->g_rows.data(), n * sizeof(int32_t));
+  std::memcpy(vals, ctx->g_vals.data(), n * sizeof(double));
+  ctx->g_rows.erase(ctx->g_rows.begin(), ctx->g_rows.begin() + n);
+  ctx->g_vals.erase(ctx->g_vals.begin(), ctx->g_vals.begin() + n);
+  return n;
+}
+
+// Drain new-series records: fills parallel arrays plus a packed string
+// buffer of "name\x1fjoined_tags\x1e" records. Returns the count drained
+// (0 if strbuf is too small for the next record).
+int vn_drain_new_series(void* p, int32_t* pools, int32_t* rows,
+                        int32_t* kinds, int32_t* scopes, char* strbuf,
+                        int strcap, int* strlen_out, int max) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  int n = 0;
+  int off = 0;
+  while (n < max && n < static_cast<int>(ctx->new_series.size())) {
+    const NewSeries& ns = ctx->new_series[n];
+    int need = static_cast<int>(ns.name.size() + ns.joined_tags.size() + 2);
+    if (off + need > strcap) break;
+    pools[n] = ns.pool;
+    rows[n] = ns.row;
+    kinds[n] = ns.kind;
+    scopes[n] = ns.scope_class;
+    std::memcpy(strbuf + off, ns.name.data(), ns.name.size());
+    off += static_cast<int>(ns.name.size());
+    strbuf[off++] = '\x1f';
+    std::memcpy(strbuf + off, ns.joined_tags.data(), ns.joined_tags.size());
+    off += static_cast<int>(ns.joined_tags.size());
+    strbuf[off++] = '\x1e';
+    ++n;
+  }
+  ctx->new_series.erase(ctx->new_series.begin(),
+                        ctx->new_series.begin() + n);
+  *strlen_out = off;
+  return n;
+}
+
+// Directory upsert for the Python-side ingest paths (SSF-derived metrics,
+// imports): returns the row id, assigning a new one when the series is
+// unseen this epoch. kind: MetricKind; scope_class: ScopeClass. The new
+// series is recorded for vn_drain_new_series like any parsed one.
+int vn_upsert(void* p, const char* name, int name_len, int kind,
+              const char* joined_tags, int tags_len, int scope_class) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::string_view name_sv(name, static_cast<size_t>(name_len));
+  std::string_view tags_sv(joined_tags, static_cast<size_t>(tags_len));
+  MetricKind k = static_cast<MetricKind>(kind);
+  const char* type_str = kind_type_string(k);
+
+  uint32_t digest = fnv1a32(name_sv);
+  digest = fnv1a32(type_str, digest);
+  digest = fnv1a32(tags_sv, digest);
+
+  ctx->key.clear();
+  ctx->key.append(name_sv);
+  ctx->key.push_back('\x1f');
+  ctx->key.append(type_str);
+  ctx->key.push_back('\x1f');
+  ctx->key.append(tags_sv);
+  ctx->key.push_back('\x1f');
+  ctx->key.push_back(static_cast<char>('0' + scope_class));
+  uint64_t key_hash =
+      fmix64((static_cast<uint64_t>(digest) << 32) ^ fnv1a64(ctx->key));
+
+  int32_t* next = nullptr;
+  int32_t pool = 0;
+  switch (k) {
+    case KIND_HISTOGRAM:
+    case KIND_TIMER:
+      next = &ctx->next_histo_row;
+      pool = 0;
+      break;
+    case KIND_SET:
+      next = &ctx->next_set_row;
+      pool = 1;
+      break;
+    case KIND_COUNTER:
+      next = &ctx->next_counter_row;
+      pool = 2;
+      break;
+    case KIND_GAUGE:
+      next = &ctx->next_gauge_row;
+      pool = 3;
+      break;
+  }
+  bool created = false;
+  int32_t row = ctx->dir.upsert(key_hash, ctx->key, *next, &created);
+  if (created) {
+    ++*next;
+    NewSeries ns;
+    ns.pool = pool;
+    ns.row = row;
+    ns.kind = kind;
+    ns.scope_class = scope_class;
+    ns.name.assign(name_sv);
+    ns.joined_tags.assign(tags_sv);
+    ctx->new_series.push_back(std::move(ns));
+  }
+  return row;
+}
+
+// Drain the buffered event/service-check lines (newline separated).
+int vn_drain_other(void* p, char* buf, int cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  int n = std::min<int>(cap, static_cast<int>(ctx->other_lines.size()));
+  std::memcpy(buf, ctx->other_lines.data(), n);
+  ctx->other_lines.erase(0, n);
+  return n;
+}
+
+}  // extern "C"

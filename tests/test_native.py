@@ -1,0 +1,191 @@
+"""Native C++ ingest pipeline tests: parity with the Python parser and
+native-mode server end-to-end."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("ctypes")
+
+from veneur_tpu import native as native_mod
+
+if not native_mod.available():  # pragma: no cover - toolchain missing
+    pytest.skip("native library unavailable", allow_module_level=True)
+
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.metrics import MetricType
+from veneur_tpu.core.server import Server
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.protocol.dogstatsd import ParseError, parse_metric
+from veneur_tpu.utils.hashing import hll_hash
+
+
+def test_parser_parity_property():
+    """Every accepted line must produce the same (type, tags, scope, value)
+    as the Python parser; every rejected line must be rejected by both."""
+    ni = native_mod.NativeIngest()
+    packets = [
+        b"a.b.c:1|c",
+        b"a.b.c:2.5|g",
+        b"t:3|ms|@0.5|#b:2,a:1",
+        b"h:4.25|h|#veneurlocalonly,x",
+        b"d:5|d|#veneurglobalonly:true",
+        b"s:member|s|#k:v",
+        b"neg:-42.5|g",
+        b"exp:1e3|c",
+        b"plus:+4|g",
+        # malformed — both should reject
+        b"foo",
+        b":1|c",
+        b"foo:1",
+        b"foo:1||",
+        b"foo:bar|c",
+        b"foo:nan|c",
+        b"foo:1|z",
+        b"foo:1|c|x",
+        b"foo:1|c|@0",
+        b"foo:1|c|@2",
+        b"foo:1|c|@0.1|@0.2",
+        b"foo:1|c|#a|#b",
+        b"foo:1 |c",
+        b"foo:1_0|c",
+    ]
+    for pkt in packets:
+        try:
+            py = parse_metric(pkt)
+            py_ok = True
+        except ParseError:
+            py_ok = False
+        before = ni.processed
+        ni.ingest(pkt)
+        native_ok = ni.processed > before
+        assert native_ok == py_ok, pkt
+
+    # new-series records carry the normalized identity; compare against
+    # the python parser's view
+    records = {
+        (name, native_mod.NativeIngest.TYPE_BY_KIND[kind]): (joined, scope)
+        for _, _, kind, scope, name, joined in ni.drain_new_series()
+    }
+    py_t = parse_metric(b"t:3|ms|@0.5|#b:2,a:1")
+    assert records[("t", "timer")] == ("a:1,b:2", 0)
+    assert py_t.joined_tags == "a:1,b:2"
+    py_h = parse_metric(b"h:4.25|h|#veneurlocalonly,x")
+    assert records[("h", "histogram")] == ("x", 1)
+    assert py_h.scope == 1 and py_h.tags == ["x"]
+    assert records[("d", "histogram")] == ("", 2)
+
+
+def test_native_values_and_weights():
+    ni = native_mod.NativeIngest()
+    ni.ingest(b"t:3|ms|@0.5")
+    ni.ingest(b"t:7|ms")
+    rows, vals, wts = ni.drain_histo(16)
+    assert list(rows) == [0, 0]
+    assert list(vals) == [3.0, 7.0]
+    assert list(wts) == [2.0, 1.0]  # weight = 1/sample_rate
+
+
+def test_native_counter_truncation():
+    ni = native_mod.NativeIngest()
+    ni.ingest(b"c:2.7|c")  # int(2.7) = 2
+    ni.ingest(b"c:1|c|@0.3")  # 1 * int(1/0.3)=3
+    rows, contribs = ni.drain_counter(16)
+    assert contribs.sum() == 5.0
+
+
+def test_native_hll_split_matches_python():
+    ni = native_mod.NativeIngest()
+    values = [f"member-{i}" for i in range(200)]
+    for v in values:
+        ni.ingest(f"s:{v}|s".encode())
+    rows, idx, rank = ni.drain_set(1024)
+    hashes = np.array([hll_hash(v.encode()) for v in values],
+                      dtype=np.uint64)
+    py_idx, py_rank = hll_ops.split_hashes(hashes)
+    np.testing.assert_array_equal(idx, py_idx)
+    np.testing.assert_array_equal(rank, py_rank)
+
+
+def test_native_shared_directory_with_python_upsert():
+    ni = native_mod.NativeIngest()
+    ni.ingest(b"x:1|ms|#a:1")  # row 0 via parsing
+    row = ni.upsert("x", "timer", "a:1", 0)  # same series via python path
+    assert row == 0
+    row2 = ni.upsert("y", "timer", "", 0)
+    assert row2 == 1
+
+
+def test_native_mode_server_end_to_end():
+    cfg = Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        num_workers=1,
+        interval="10s",
+        percentiles=[0.5],
+        tpu_native_ingest=True,
+    )
+    srv = Server(cfg)
+    assert srv.native_mode
+    ports = srv.start()
+    try:
+        port = next(iter(ports.values()))
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for v in range(1, 101):
+            s.sendto(f"nat.timer:{v}|ms|#env:prod".encode(),
+                     ("127.0.0.1", port))
+        s.sendto(b"nat.count:3|c\nnat.count:4|c", ("127.0.0.1", port))
+        s.sendto(b"nat.gauge:1.5|g\nnat.gauge:9.5|g", ("127.0.0.1", port))
+        for i in range(300):
+            s.sendto(f"nat.set:u{i}|s".encode(), ("127.0.0.1", port))
+        s.sendto(b"_sc|natsvc|0|m:fine", ("127.0.0.1", port))
+        s.close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if srv.packets_received >= 404:
+                break
+            time.sleep(0.02)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("nat.count", MetricType.COUNTER)].value == 7.0
+        assert by_key[("nat.gauge", MetricType.GAUGE)].value == 9.5
+        assert by_key[("nat.timer.min", MetricType.GAUGE)].value == 1.0
+        assert by_key[("nat.timer.max", MetricType.GAUGE)].value == 100.0
+        timer_meta = by_key[("nat.timer.max", MetricType.GAUGE)]
+        assert timer_meta.tags == ["env:prod"]
+        assert by_key[("natsvc", MetricType.STATUS)].value == 0.0
+        # set estimate (global server without forward address)
+        est = by_key[("nat.set", MetricType.GAUGE)].value
+        assert abs(est - 300) / 300 < 0.05
+        # percentiles present (no forward address → global)
+        assert ("nat.timer.50percentile", MetricType.GAUGE) in by_key
+    finally:
+        srv.shutdown()
+
+
+def test_native_mode_epoch_reset():
+    cfg = Config(num_workers=1, interval="10s", tpu_native_ingest=True)
+    srv = Server(cfg)
+    assert srv.native_mode
+    srv.process_metric_packet(b"e.c:1|c")
+    m1 = srv.flush()
+    assert any(m.name == "e.c" for m in m1)
+    m2 = srv.flush()
+    assert not any(m.name == "e.c" for m in m2)
+    # same series again in the new epoch gets a fresh row cleanly
+    srv.process_metric_packet(b"e.c:5|c")
+    m3 = srv.flush()
+    by = {m.name: m for m in m3}
+    assert by["e.c"].value == 5.0
+    srv.shutdown()
+
+
+def test_native_parse_errors_counted():
+    cfg = Config(num_workers=1, interval="10s", tpu_native_ingest=True)
+    srv = Server(cfg)
+    srv.process_metric_packet(b"bad::packet|q")
+    srv.process_metric_packet(b"ok:1|c")
+    srv.flush()
+    assert srv.workers[0].parse_errors >= 1
+    srv.shutdown()

@@ -111,6 +111,7 @@ class Config:
     forward_use_grpc: bool = False
 
     # device / TPU execution
+    tpu_native_ingest: bool = True
     tpu_batch_size: int = 16384
     tpu_compression: float = 100.0
     tpu_hll_precision: int = 14

@@ -79,7 +79,15 @@ class _Pool:
         if row is not None:
             return row, False
         row = len(self.rows)
-        self.index[k] = row
+        self.adopt(row, key, scope_class, tags)
+        return row, True
+
+    def adopt(self, row: int, key: MetricKey, scope_class: ScopeClass,
+              tags: list[str]) -> None:
+        """Register metadata for a row assigned externally (the native
+        directory assigns rows in the same append order)."""
+        assert row == len(self.rows), "rows must be adopted in order"
+        self.index[(key, scope_class)] = row
         self.rows.append(
             RowMeta(
                 key=key,
@@ -88,7 +96,6 @@ class _Pool:
                 sinks=route_info(tags),
             )
         )
-        return row, True
 
 
 class SeriesDirectory:

@@ -136,6 +136,15 @@ class Server:
         self.packets_received = 0
         self.parse_errors = 0
 
+        # native C++ ingest path: one worker owns the whole series space
+        # (the device is the parallelism); multi-worker sharding keeps the
+        # per-metric Python path
+        self.native_mode = False
+        if cfg.tpu_native_ingest and cfg.num_workers == 1:
+            self.native_mode = self.workers[0].attach_native()
+            if self.native_mode:
+                log.info("native C++ ingest pipeline enabled")
+
     @property
     def is_local(self) -> bool:
         return self.config.is_local()
@@ -173,6 +182,17 @@ class Server:
         if len(datagram) > self.config.metric_max_length:
             self.parse_errors += 1
             log.debug("overlong metric datagram (%d bytes)", len(datagram))
+            return
+        if self.native_mode:
+            worker = self.workers[0]
+            with self._worker_locks[0]:
+                worker.ingest_datagram(datagram)
+            # events and service checks come back for the Python parser
+            if b"_e{" in datagram or b"_sc" in datagram:
+                with self._worker_locks[0]:
+                    others = worker._native.drain_other()
+                for line in others:
+                    self.handle_metric_packet(line)
             return
         for line in datagram.split(b"\n"):
             if line:

@@ -147,21 +147,73 @@ def _grow_1d(old, new_rows: int, fill: float):
 # Host-side state containers
 
 
+class ScalarPool:
+    """Growable f64 value array + per-row metadata; row ids are
+    append-ordered so the Python dict path and the native directory agree
+    on assignment."""
+
+    def __init__(self, initial: int = 256) -> None:
+        self.index: dict = {}  # (key, class) → row (python path only)
+        self.meta: list = []  # (key, tags, scope_class, sinks)
+        self.values = np.zeros(initial, np.float64)
+        self.present = np.zeros(initial, bool)
+        self.used = 0
+
+    def ensure(self, rows: int) -> None:
+        if rows > len(self.values):
+            cap = len(self.values)
+            while cap < rows:
+                cap *= 2
+            self.values = np.resize(self.values, cap)
+            self.values[self.used:] = 0.0
+            newp = np.zeros(cap, bool)
+            newp[: self.used] = self.present[: self.used]
+            self.present = newp
+
+    def upsert(self, key, scope_class, tags, sinks) -> int:
+        k = (key, scope_class)
+        row = self.index.get(k)
+        if row is None:
+            row = self.used
+            self.index[k] = row
+            self.adopt_row(row, key, tags, scope_class, sinks)
+        return row
+
+    def adopt_row(self, row: int, key, tags, scope_class, sinks) -> None:
+        """Register metadata for a row assigned externally (native path)."""
+        assert row == len(self.meta), "rows must be adopted in order"
+        self.meta.append((key, tags, scope_class, sinks))
+        self.used = row + 1
+        self.ensure(self.used)
+
+
 @dataclass
 class HostScalars:
     """Exact host-side counter/gauge/status state for one interval."""
 
-    counter_index: dict = field(default_factory=dict)  # (key, class) → row
-    counter_meta: list = field(default_factory=list)
-    counter_values: list = field(default_factory=list)  # python ints (exact)
-
-    gauge_index: dict = field(default_factory=dict)
-    gauge_meta: list = field(default_factory=list)
-    gauge_values: list = field(default_factory=list)
+    counters: ScalarPool = field(default_factory=ScalarPool)
+    gauges: ScalarPool = field(default_factory=ScalarPool)
 
     status_index: dict = field(default_factory=dict)
     status_meta: list = field(default_factory=list)
     status_values: list = field(default_factory=list)  # (value, message, host)
+
+    # compatibility iteration helpers used by the flusher/codec
+    @property
+    def counter_meta(self):
+        return self.counters.meta
+
+    @property
+    def counter_values(self):
+        return self.counters.values[: self.counters.used]
+
+    @property
+    def gauge_meta(self):
+        return self.gauges.meta
+
+    @property
+    def gauge_values(self):
+        return self.gauges.values[: self.gauges.used]
 
 
 @dataclass
@@ -278,11 +330,93 @@ class DeviceWorker:
         self.is_local = is_local
         self.processed = 0
         self.imported = 0
+        self._native = None
         self._reset_epoch()
+
+    # -- native front-end ----------------------------------------------------
+
+    def attach_native(self) -> bool:
+        """Attach the C++ ingest pipeline (native/dogstatsd.cpp): parsing,
+        tag normalization, and row assignment move off the Python path;
+        this worker's Python-side paths (SSF-derived metrics, imports)
+        share the native directory through upsert."""
+        try:
+            from veneur_tpu.native import NativeIngest
+
+            self._native = NativeIngest(self.hll_precision)
+        except (RuntimeError, OSError):
+            return False
+        return True
+
+    def ingest_datagram(self, datagram: bytes) -> int:
+        """Native-path ingest of one (possibly multi-line) datagram.
+        Returns leftover event/service-check lines via drain_other on the
+        caller's schedule."""
+        n = self._native.ingest(datagram)
+        self.processed += n
+        if (self._native.pending_histo >= self.batch_size
+                or self._native.pending_set >= self.batch_size):
+            self.drain_native()
+        return n
+
+    def _sync_native_series(self) -> None:
+        from veneur_tpu.native import NativeIngest
+
+        for pool, row, kind, scope, name, joined in (
+            self._native.drain_new_series()
+        ):
+            mtype = NativeIngest.TYPE_BY_KIND[kind]
+            key = MetricKey(name=name, type=mtype, joined_tags=joined)
+            tags = joined.split(",") if joined else []
+            cls = ScopeClass(scope)
+            if pool == 0:
+                self.directory.histo.adopt(row, key, cls, tags)
+            elif pool == 1:
+                self.directory.sets.adopt(row, key, cls, tags)
+            elif pool == 2:
+                self.scalars.counters.adopt_row(row, key, tags, cls,
+                                                route_info(tags))
+            else:
+                self.scalars.gauges.adopt_row(row, key, tags, cls,
+                                              route_info(tags))
+
+    def drain_native(self) -> None:
+        """Move everything pending in the native pipeline into device/host
+        state."""
+        if self._native is None:
+            return
+        errs = int(self._native.errors)
+        self.parse_errors += errs - self._native_errs_seen
+        self._native_errs_seen = errs
+        self._sync_native_series()
+        n = self._native.pending_histo
+        if n:
+            rows, vals, wts = self._native.drain_histo(n)
+            self._ensure_histo(self.directory.num_histo_rows)
+            self._device_histo_step(rows, vals, wts)
+        n = self._native.pending_set
+        if n:
+            rows, idx, rank = self._native.drain_set(n)
+            self._ensure_sets(self.directory.num_set_rows)
+            self._device_set_step(rows, idx, rank)
+        rows, contribs = self._native.drain_counter(1 << 22)
+        if len(rows):
+            pool = self.scalars.counters
+            np.add.at(pool.values, rows, contribs)
+            pool.present[rows] = True
+        rows, vals = self._native.drain_gauge(1 << 22)
+        if len(rows):
+            pool = self.scalars.gauges
+            pool.values[rows] = vals  # in-order: last write wins
+            pool.present[rows] = True
 
     # -- epoch lifecycle ----------------------------------------------------
 
     def _reset_epoch(self) -> None:
+        if self._native is not None:
+            self._native.reset()
+        self._native_errs_seen = 0
+        self.parse_errors = getattr(self, "parse_errors", 0)
         self.directory = SeriesDirectory()
         self.scalars = HostScalars()
         self._histo: Optional[HistoDeviceState] = None
@@ -341,7 +475,7 @@ class DeviceWorker:
         elif mtype == "gauge":
             self._host_gauge(m.key, scope_class, m.tags, float(m.value))
         elif mtype in ("histogram", "timer"):
-            row, _ = self.directory.upsert_histo(m.key, scope_class, m.tags)
+            row = self._upsert_histo(m.key, scope_class, m.tags)
             self._ensure_histo(self.directory.num_histo_rows)
             self._ph_rows.append(row)
             self._ph_vals.append(float(m.value))
@@ -349,7 +483,7 @@ class DeviceWorker:
             if len(self._ph_rows) >= self.batch_size:
                 self._flush_pending_histos()
         elif mtype == "set":
-            row, _ = self.directory.upsert_set(m.key, scope_class, m.tags)
+            row = self._upsert_set(m.key, scope_class, m.tags)
             self._ensure_sets(self.directory.num_set_rows)
             h = hll_hash(str(m.value).encode("utf-8"))
             idx, rank = hll_ops.split_hashes(
@@ -362,6 +496,26 @@ class DeviceWorker:
                 self._flush_pending_sets()
         elif mtype == "status":
             self._host_status(m)
+
+    def _upsert_histo(self, key: MetricKey, scope_class: ScopeClass,
+                      tags: list[str]) -> int:
+        if self._native is not None:
+            row = self._native.upsert(key.name, key.type, key.joined_tags,
+                                      int(scope_class))
+            self._sync_native_series()
+            return row
+        row, _ = self.directory.upsert_histo(key, scope_class, tags)
+        return row
+
+    def _upsert_set(self, key: MetricKey, scope_class: ScopeClass,
+                    tags: list[str]) -> int:
+        if self._native is not None:
+            row = self._native.upsert(key.name, "set", key.joined_tags,
+                                      int(scope_class))
+            self._sync_native_series()
+            return row
+        row, _ = self.directory.upsert_set(key, scope_class, tags)
+        return row
 
     def _sample_timeseries(self, m: UDPMetric, mtype: str) -> None:
         """Count a series toward unique-timeseries cardinality per the
@@ -384,28 +538,27 @@ class DeviceWorker:
 
     def _host_counter(self, key: MetricKey, scope_class: ScopeClass,
                       tags: list[str], contribution: int) -> None:
-        sc = self.scalars
-        k = (key, scope_class)
-        row = sc.counter_index.get(k)
-        if row is None:
-            row = len(sc.counter_values)
-            sc.counter_index[k] = row
-            sc.counter_meta.append((key, tags, scope_class, route_info(tags)))
-            sc.counter_values.append(0)
-        sc.counter_values[row] += contribution
+        pool = self.scalars.counters
+        if self._native is not None:
+            row = self._native.upsert(key.name, "counter", key.joined_tags,
+                                      int(scope_class))
+            self._sync_native_series()
+        else:
+            row = pool.upsert(key, scope_class, tags, route_info(tags))
+        pool.values[row] += contribution
+        pool.present[row] = True
 
     def _host_gauge(self, key: MetricKey, scope_class: ScopeClass,
                     tags: list[str], value: float) -> None:
-        sc = self.scalars
-        k = (key, scope_class)
-        row = sc.gauge_index.get(k)
-        if row is None:
-            row = len(sc.gauge_values)
-            sc.gauge_index[k] = row
-            sc.gauge_meta.append((key, tags, scope_class, route_info(tags)))
-            sc.gauge_values.append(value)
+        pool = self.scalars.gauges
+        if self._native is not None:
+            row = self._native.upsert(key.name, "gauge", key.joined_tags,
+                                      int(scope_class))
+            self._sync_native_series()
         else:
-            sc.gauge_values[row] = value
+            row = pool.upsert(key, scope_class, tags, route_info(tags))
+        pool.values[row] = value
+        pool.present[row] = True
 
     def _host_status(self, m: UDPMetric) -> None:
         sc = self.scalars
@@ -425,13 +578,16 @@ class DeviceWorker:
     def _flush_pending_histos(self) -> None:
         if not self._ph_rows:
             return
-        h = self._histo
-        assert h is not None
         rows = np.asarray(self._ph_rows, dtype=np.int32)
         vals = np.asarray(self._ph_vals, dtype=np.float32)
         wts = np.asarray(self._ph_wts, dtype=np.float32)
         self._ph_rows, self._ph_vals, self._ph_wts = [], [], []
+        self._device_histo_step(rows, vals, wts)
 
+    def _device_histo_step(self, rows: np.ndarray, vals: np.ndarray,
+                           wts: np.ndarray) -> None:
+        h = self._histo
+        assert h is not None
         uniq, inverse = np.unique(rows, return_inverse=True)
         scratch = h.num_rows - 1
         k = _next_pow2(len(uniq), 64)
@@ -457,13 +613,16 @@ class DeviceWorker:
     def _flush_pending_sets(self) -> None:
         if not self._ps_rows:
             return
-        regs = self._sets
-        assert regs is not None
         rows = np.asarray(self._ps_rows, dtype=np.int32)
         idx = np.asarray(self._ps_idx, dtype=np.int32)
         rank = np.asarray(self._ps_rank, dtype=np.int8)
         self._ps_rows, self._ps_idx, self._ps_rank = [], [], []
+        self._device_set_step(rows, idx, rank)
 
+    def _device_set_step(self, rows: np.ndarray, idx: np.ndarray,
+                         rank: np.ndarray) -> None:
+        regs = self._sets
+        assert regs is not None
         n = _next_pow2(len(rows), 256)
         scratch = regs.shape[0] - 1
         prow = np.full(n, scratch, dtype=np.int32)
@@ -486,7 +645,7 @@ class DeviceWorker:
         """Buffer a downstream instance's digest for row-wise merge at flush
         (reference Histo.Merge path, worker.go:438-495)."""
         self.imported += 1
-        row, _ = self.directory.upsert_histo(key, scope_class, tags)
+        row = self._upsert_histo(key, scope_class, tags)
         self._ensure_histo(self.directory.num_histo_rows)
         self._imp_digests.setdefault(row, []).append(
             (np.asarray(means, np.float32), np.asarray(weights, np.float32),
@@ -496,7 +655,7 @@ class DeviceWorker:
     def import_hll(self, key: MetricKey, tags: list[str],
                    scope_class: ScopeClass, registers: np.ndarray) -> None:
         self.imported += 1
-        row, _ = self.directory.upsert_set(key, scope_class, tags)
+        row = self._upsert_set(key, scope_class, tags)
         self._ensure_sets(self.directory.num_set_rows)
         prev = self._imp_hll.get(row)
         regs = np.asarray(registers, np.int8)
@@ -576,6 +735,7 @@ class DeviceWorker:
         quantiles: the percentile set to evaluate on device (the flusher
         decides which rows' values are actually emitted).
         """
+        self.drain_native()
         self._flush_pending_histos()
         self._flush_pending_sets()
         self._merge_imports()

@@ -1,0 +1,232 @@
+"""ctypes binding for the native ingest pipeline (native/dogstatsd.cpp).
+
+Builds the shared library on first use if the toolchain is available;
+callers fall back to the pure-Python parser when it isn't.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("veneur_tpu.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libveneur_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR],
+                       capture_output=True, check=True, timeout=120)
+        return True
+    except Exception as e:
+        log.info("native build unavailable: %s", e)
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        c = ctypes
+        lib.vn_ctx_new.restype = c.c_void_p
+        lib.vn_ctx_new.argtypes = [c.c_int]
+        lib.vn_ctx_free.argtypes = [c.c_void_p]
+        lib.vn_ctx_reset.argtypes = [c.c_void_p]
+        lib.vn_ingest.restype = c.c_int
+        lib.vn_ingest.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+        for name in ("vn_pending_histo", "vn_pending_set",
+                     "vn_pending_counter", "vn_pending_gauge",
+                     "vn_num_histo_rows", "vn_num_set_rows",
+                     "vn_num_counter_rows", "vn_num_gauge_rows"):
+            fn = getattr(lib, name)
+            fn.restype = c.c_int
+            fn.argtypes = [c.c_void_p]
+        for name in ("vn_processed", "vn_errors"):
+            fn = getattr(lib, name)
+            fn.restype = c.c_longlong
+            fn.argtypes = [c.c_void_p]
+        lib.vn_drain_histo.restype = c.c_int
+        lib.vn_drain_histo.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int]
+        lib.vn_drain_set.restype = c.c_int
+        lib.vn_drain_set.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int]
+        lib.vn_drain_counter.restype = c.c_int
+        lib.vn_drain_counter.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_int]
+        lib.vn_drain_gauge.restype = c.c_int
+        lib.vn_drain_gauge.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_int]
+        lib.vn_drain_new_series.restype = c.c_int
+        lib.vn_drain_new_series.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_char_p, c.c_int, c.POINTER(c.c_int), c.c_int]
+        lib.vn_drain_other.restype = c.c_int
+        lib.vn_drain_other.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+        lib.vn_upsert.restype = c.c_int
+        lib.vn_upsert.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_int, c.c_int, c.c_char_p, c.c_int,
+            c.c_int]
+        _lib = lib
+        return _lib
+
+
+def _ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+class NativeIngest:
+    """One epoch-scoped native parser+directory context."""
+
+    def __init__(self, hll_precision: int = 14) -> None:
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._ctx = lib.vn_ctx_new(hll_precision)
+
+    def __del__(self):
+        if getattr(self, "_ctx", None):
+            self._lib.vn_ctx_free(self._ctx)
+            self._ctx = None
+
+    def reset(self) -> None:
+        self._lib.vn_ctx_reset(self._ctx)
+
+    def ingest(self, datagram: bytes) -> int:
+        return self._lib.vn_ingest(self._ctx, datagram, len(datagram))
+
+    # pending counts ---------------------------------------------------------
+
+    @property
+    def pending_histo(self) -> int:
+        return self._lib.vn_pending_histo(self._ctx)
+
+    @property
+    def pending_set(self) -> int:
+        return self._lib.vn_pending_set(self._ctx)
+
+    @property
+    def processed(self) -> int:
+        return self._lib.vn_processed(self._ctx)
+
+    @property
+    def errors(self) -> int:
+        return self._lib.vn_errors(self._ctx)
+
+    def num_rows(self) -> tuple[int, int, int, int]:
+        """(histo, set, counter, gauge) row counts."""
+        return (self._lib.vn_num_histo_rows(self._ctx),
+                self._lib.vn_num_set_rows(self._ctx),
+                self._lib.vn_num_counter_rows(self._ctx),
+                self._lib.vn_num_gauge_rows(self._ctx))
+
+    # drains -----------------------------------------------------------------
+
+    def drain_histo(self, cap: int):
+        rows = np.empty(cap, np.int32)
+        vals = np.empty(cap, np.float32)
+        wts = np.empty(cap, np.float32)
+        n = self._lib.vn_drain_histo(
+            self._ctx, _ptr(rows), _ptr(vals), _ptr(wts), cap)
+        return rows[:n], vals[:n], wts[:n]
+
+    def drain_set(self, cap: int):
+        rows = np.empty(cap, np.int32)
+        idx = np.empty(cap, np.int32)
+        rank = np.empty(cap, np.int8)
+        n = self._lib.vn_drain_set(
+            self._ctx, _ptr(rows), _ptr(idx), _ptr(rank), cap)
+        return rows[:n], idx[:n], rank[:n]
+
+    def drain_counter(self, cap: int):
+        rows = np.empty(cap, np.int32)
+        contribs = np.empty(cap, np.float64)
+        n = self._lib.vn_drain_counter(
+            self._ctx, _ptr(rows), _ptr(contribs), cap)
+        return rows[:n], contribs[:n]
+
+    def drain_gauge(self, cap: int):
+        rows = np.empty(cap, np.int32)
+        vals = np.empty(cap, np.float64)
+        n = self._lib.vn_drain_gauge(self._ctx, _ptr(rows), _ptr(vals), cap)
+        return rows[:n], vals[:n]
+
+    def drain_new_series(self, max_records: int = 4096):
+        """Returns list of (pool, row, kind, scope_class, name, joined_tags).
+        pool: 0 histo, 1 set, 2 counter, 3 gauge; kind: MetricKind int."""
+        pools = np.empty(max_records, np.int32)
+        rows = np.empty(max_records, np.int32)
+        kinds = np.empty(max_records, np.int32)
+        scopes = np.empty(max_records, np.int32)
+        strcap = 1 << 20
+        strbuf = ctypes.create_string_buffer(strcap)
+        strlen = ctypes.c_int(0)
+        out = []
+        while True:
+            n = self._lib.vn_drain_new_series(
+                self._ctx, _ptr(pools), _ptr(rows), _ptr(kinds),
+                _ptr(scopes), strbuf, strcap, ctypes.byref(strlen),
+                max_records)
+            if n == 0:
+                break
+            packed = strbuf.raw[:strlen.value]
+            records = packed.split(b"\x1e")[:n]
+            for i, rec in enumerate(records):
+                name, _, joined = rec.partition(b"\x1f")
+                out.append((
+                    int(pools[i]), int(rows[i]), int(kinds[i]),
+                    int(scopes[i]),
+                    name.decode("utf-8", "replace"),
+                    joined.decode("utf-8", "replace"),
+                ))
+            if n < max_records:
+                break
+        return out
+
+    KIND_BY_TYPE = {"counter": 0, "gauge": 1, "histogram": 2, "timer": 3,
+                    "set": 4}
+    TYPE_BY_KIND = {v: k for k, v in KIND_BY_TYPE.items()}
+
+    def upsert(self, name: str, mtype: str, joined_tags: str,
+               scope_class: int) -> int:
+        """Directory upsert for Python-side ingest (shares row space with
+        parsed traffic)."""
+        nb = name.encode("utf-8")
+        tb = joined_tags.encode("utf-8")
+        return self._lib.vn_upsert(
+            self._ctx, nb, len(nb), self.KIND_BY_TYPE[mtype], tb, len(tb),
+            scope_class)
+
+    def drain_other(self) -> list[bytes]:
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        out = []
+        while True:
+            n = self._lib.vn_drain_other(self._ctx, buf, cap)
+            if n == 0:
+                break
+            out.extend(ln for ln in buf.raw[:n].split(b"\n") if ln)
+            if n < cap:
+                break
+        return out
+
+
+def available() -> bool:
+    return load_library() is not None
