@@ -121,19 +121,34 @@ def _compress_rows(
     w_cum = jnp.cumsum(sorted_w, axis=-1)
     total = w_cum[:, -1:]
     q_left = (w_cum - sorted_w) / jnp.maximum(total, 1e-30)
-    # 3. Quantize to k-function buckets.
+    # 3. Quantize to k-function buckets. Zero-weight padding is forced to
+    #    the top bucket so real buckets see none of it.
     bucket = jnp.floor(_k_scale(q_left, compression)).astype(jnp.int32)
     bucket = jnp.clip(bucket, 0, capacity - 1)
-    # 4. One flat segment-sum over all rows at once.
-    seg = (jnp.arange(s, dtype=jnp.int32)[:, None] * capacity + bucket).reshape(-1)
-    w_flat = sorted_w.reshape(-1)
-    mw_flat = jnp.where(sorted_w > 0, sorted_means * sorted_w, 0.0).reshape(-1)
-    new_w = jax.ops.segment_sum(
-        w_flat, seg, num_segments=s * capacity, indices_are_sorted=True
-    ).reshape(s, capacity)
-    new_mw = jax.ops.segment_sum(
-        mw_flat, seg, num_segments=s * capacity, indices_are_sorted=True
-    ).reshape(s, capacity)
+    bucket = jnp.where(sorted_w > 0, bucket, capacity - 1)
+    # 4. Scatter-free bucket accumulation (TPU-first: scatters serialize on
+    #    TPU, gathers vectorize). Buckets are non-decreasing within a row,
+    #    so each bucket's sum is a difference of running prefix sums at the
+    #    bucket boundary: boundary[r,c] = #entries with bucket <= c, and
+    #    sum(bucket==c) = prefix[boundary[r,c]] - prefix[boundary[r,c-1]].
+    mw = jnp.where(sorted_w > 0, sorted_means * sorted_w, 0.0)
+    zero = jnp.zeros((s, 1), sorted_w.dtype)
+    pre_w = jnp.concatenate([zero, w_cum], axis=-1)  # [S, M+1]
+    pre_mw = jnp.concatenate([zero, jnp.cumsum(mw, axis=-1)], axis=-1)
+    cbins = jnp.arange(capacity, dtype=jnp.int32)
+    # boundary: count of entries per row with bucket <= c → [S, C].
+    # buckets are non-decreasing per row, so this is a per-row binary
+    # search (O(S·C·log M)), not a dense comparison tensor.
+    boundary = jax.vmap(
+        lambda b: jnp.searchsorted(b, cbins, side="right")
+    )(bucket).astype(jnp.int32)
+    lower = jnp.concatenate(
+        [jnp.zeros((s, 1), jnp.int32), boundary[:, :-1]], axis=-1)
+    new_w = (jnp.take_along_axis(pre_w, boundary, axis=1)
+             - jnp.take_along_axis(pre_w, lower, axis=1))
+    new_w = jnp.maximum(new_w, 0.0)
+    new_mw = (jnp.take_along_axis(pre_mw, boundary, axis=1)
+              - jnp.take_along_axis(pre_mw, lower, axis=1))
     new_means = jnp.where(new_w > 0, new_mw / jnp.maximum(new_w, 1e-30), _INF)
     # 5. Empty buckets are interleaved; re-sort rows to restore the
     #    contiguous sorted-prefix invariant.
@@ -193,50 +208,89 @@ def add_batch(
     k, c = means.shape
     n = rows.shape[0]
     live = sample_weights > 0
-    # Neutralize padding lanes.
+    # Neutralize padding lanes: weight 0 + a value that keeps 1/v finite.
     rows = jnp.where(live, rows, k - 1)
-    safe_vals = jnp.where(live, values, 0.0)
+    safe_vals = jnp.where(live, values, 1.0)
 
-    # --- 1. Sort the batch by (row, value).
+    # --- 1. Sort the batch by (row, value). Padding sorts into its row
+    #        but carries zero weight everywhere below.
     srows, svals, sw = jax.lax.sort(
         (rows, safe_vals, sample_weights), dimension=0, num_keys=2
     )
 
-    # --- 2. Per-row scalar stats via segment reductions.
-    seg_w = jax.ops.segment_sum(sw, srows, num_segments=k, indices_are_sorted=True)
-    seg_min = jax.ops.segment_min(
-        jnp.where(sw > 0, svals, _INF), srows, num_segments=k, indices_are_sorted=True
-    )
-    seg_max = jax.ops.segment_max(
-        jnp.where(sw > 0, svals, -_INF), srows, num_segments=k, indices_are_sorted=True
-    )
-    seg_sum = jax.ops.segment_sum(
-        svals * sw, srows, num_segments=k, indices_are_sorted=True
-    )
-    seg_recip = jax.ops.segment_sum(
-        jnp.where(sw > 0, sw / svals, 0.0),
-        srows,
-        num_segments=k,
-        indices_are_sorted=True,
-    )
+    # --- 2. Per-row stats, scatter-free (TPU-first): rows are contiguous
+    #        runs in the sorted order, so every per-row reduction is either
+    #        a prefix-sum difference at run boundaries or — because values
+    #        sort ascending within a row — a boundary gather (min = first
+    #        live element, max = last).
+    zero1 = jnp.zeros((1,), sw.dtype)
+    pre_w = jnp.concatenate([zero1, jnp.cumsum(sw)])  # [N+1]
+    pre_vw = jnp.concatenate([zero1, jnp.cumsum(svals * sw)])
+    pre_recip = jnp.concatenate(
+        [zero1, jnp.cumsum(jnp.where(sw > 0, sw / svals, 0.0))])
+    # live-entry count prefix (to find each row's first/last live sample;
+    # zero-weight padding sorts among them but must not win min/max)
+    pre_live = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum((sw > 0).astype(jnp.int32))])
+
+    kbins = jnp.arange(k, dtype=jnp.int32)
+    row_upper = jnp.searchsorted(srows, kbins, side="right").astype(jnp.int32)
+    row_lower = jnp.concatenate([jnp.zeros((1,), jnp.int32), row_upper[:-1]])
+
+    seg_w = (jnp.take(pre_w, row_upper) - jnp.take(pre_w, row_lower))
+    seg_sum = (jnp.take(pre_vw, row_upper) - jnp.take(pre_vw, row_lower))
+    seg_recip = (jnp.take(pre_recip, row_upper)
+                 - jnp.take(pre_recip, row_lower))
+    # min/max: within a row the sort keys are (row, value) with padding
+    # values mixed in; find the first/last LIVE element by scanning the
+    # live-count prefix. Live entries of a row are not necessarily
+    # contiguous (padding interleaves by value), so gather candidates via
+    # sorted positions of live elements: build an index of live positions.
+    live_sorted = sw > 0
+    # position of each element among its row's live elements
+    live_in_row = jnp.take(pre_live, row_upper) - jnp.take(pre_live, row_lower)
+    has = live_in_row > 0
+    # first live element at-or-after row_lower / last live at-or-before
+    # row_upper-1, via a global index of live positions:
+    live_positions = jnp.nonzero(
+        live_sorted, size=n, fill_value=n - 1)[0].astype(jnp.int32)
+    first_live = jnp.take(
+        live_positions,
+        jnp.clip(jnp.take(pre_live, row_lower), 0, n - 1))
+    last_live = jnp.take(
+        live_positions,
+        jnp.clip(jnp.take(pre_live, row_upper) - 1, 0, n - 1))
+    seg_min = jnp.where(has, jnp.take(svals, first_live), _INF)
+    seg_max = jnp.where(has, jnp.take(svals, last_live), -_INF)
     stats = BatchStats(seg_w, seg_min, seg_max, seg_sum, seg_recip)
 
-    # --- 3. Batch digest: segmented cumulative weight → k-bucket per sample.
-    w_cum = jnp.cumsum(sw)
-    # exclusive per-row offset: total weight in preceding rows
-    row_excl = jnp.concatenate([jnp.zeros((1,), sw.dtype), jnp.cumsum(seg_w)[:-1]])
-    seg_cum = w_cum - row_excl[srows]
-    q_left = (seg_cum - sw) / jnp.maximum(seg_w[srows], 1e-30)
+    # --- 3. Batch digest: segmented cumulative weight → k-bucket per
+    #        sample, accumulated scatter-free with searchsorted boundaries
+    #        when the bin count is comparable to the batch size; for very
+    #        wide active sets the sorted scatter-add is cheaper.
+    row_start_w = jnp.take(pre_w, row_lower)  # [K]
+    seg_cum = pre_w[1:] - jnp.take(row_start_w, srows)
+    q_left = (seg_cum - sw) / jnp.maximum(jnp.take(seg_w, srows), 1e-30)
     bucket = jnp.clip(
         jnp.floor(_k_scale(q_left, compression)).astype(jnp.int32), 0, c - 1
     )
-    seg_id = srows * c + bucket
-    bd_w = jax.ops.segment_sum(
-        sw, seg_id, num_segments=k * c, indices_are_sorted=True
-    ).reshape(k, c)
-    bd_mw = jax.ops.segment_sum(
-        svals * sw, seg_id, num_segments=k * c, indices_are_sorted=True
-    ).reshape(k, c)
+    seg_id = srows * c + bucket  # non-decreasing
+    if k * c <= 4 * n:
+        cbins_flat = jnp.arange(k * c, dtype=jnp.int32)
+        upper = jnp.searchsorted(seg_id, cbins_flat, side="right").astype(
+            jnp.int32)
+        lower = jnp.concatenate([jnp.zeros((1,), jnp.int32), upper[:-1]])
+        bd_w = (jnp.take(pre_w, upper) - jnp.take(pre_w, lower)).reshape(k, c)
+        bd_w = jnp.maximum(bd_w, 0.0)
+        bd_mw = (jnp.take(pre_vw, upper)
+                 - jnp.take(pre_vw, lower)).reshape(k, c)
+    else:
+        bd_w = jax.ops.segment_sum(
+            sw, seg_id, num_segments=k * c, indices_are_sorted=True
+        ).reshape(k, c)
+        bd_mw = jax.ops.segment_sum(
+            svals * sw, seg_id, num_segments=k * c, indices_are_sorted=True
+        ).reshape(k, c)
     bd_means = jnp.where(bd_w > 0, bd_mw / jnp.maximum(bd_w, 1e-30), _INF)
 
     # --- 4. Merge with the existing rows and recompress.
