@@ -1,0 +1,61 @@
+"""Pallas flush-extraction kernel vs the XLA oracle (interpret mode)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from veneur_tpu.ops import pallas_kernels as pk
+from veneur_tpu.ops import tdigest as td
+
+
+def _pool_with_data(s=64, seed=0):
+    import sys
+    sys.path.insert(0, "tests")
+    from test_tdigest import _ingest
+
+    rng = np.random.default_rng(seed)
+    per = 3000
+    vals = np.concatenate([
+        rng.normal(100 * (i + 1), 10, per).astype(np.float32)
+        for i in range(s)])
+    rows = np.repeat(np.arange(s, dtype=np.int32), per)
+    perm = rng.permutation(len(vals))
+    return _ingest(vals[perm], rows=rows[perm], k=s, batch=16384)
+
+
+def test_pallas_matches_xla_oracle():
+    pool = _pool_with_data()
+    qs = jnp.asarray([0.1, 0.5, 0.9, 0.99], dtype=jnp.float32)
+    quant_p, dsum_p, dcount_p = pk.flush_extract(
+        pool.means, pool.weights, pool.min, pool.max, qs,
+        block_rows=16, interpret=True)
+    quant_x, dsum_x, dcount_x = pk.flush_extract_reference(
+        pool.means, pool.weights, pool.min, pool.max, qs)
+    np.testing.assert_allclose(np.asarray(quant_p), np.asarray(quant_x),
+                               rtol=1e-5, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dsum_p), np.asarray(dsum_x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dcount_p), np.asarray(dcount_x),
+                               rtol=1e-6)
+
+
+def test_pallas_empty_rows_nan():
+    pool = td.init_pool(32)
+    qs = jnp.asarray([0.5], dtype=jnp.float32)
+    quant, dsum, dcount = pk.flush_extract(
+        pool.means, pool.weights, pool.min, pool.max, qs,
+        block_rows=8, interpret=True)
+    assert np.isnan(np.asarray(quant)).all()
+    assert np.allclose(np.asarray(dcount), 0.0)
+
+
+def test_pallas_uneven_rows_fall_back_to_smaller_blocks():
+    pool = _pool_with_data(s=24, seed=3)  # 24 % 16 != 0 → halves to 8
+    qs = jnp.asarray([0.5], dtype=jnp.float32)
+    quant, _, _ = pk.flush_extract(
+        pool.means, pool.weights, pool.min, pool.max, qs,
+        block_rows=16, interpret=True)
+    oracle = np.asarray(td.quantile(pool.means, pool.weights, pool.min,
+                                    pool.max, qs))
+    np.testing.assert_allclose(np.asarray(quant), oracle, rtol=1e-5,
+                               atol=1e-3)

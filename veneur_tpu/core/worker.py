@@ -726,6 +726,32 @@ class DeviceWorker:
                 jnp.asarray(imp), mode="drop"
             )
 
+    _pallas_ok: Optional[bool] = None
+
+    def _extract(self, histo: "HistoDeviceState", qs):
+        """Flush extraction: the fused Pallas kernel on TPU, the XLA
+        program elsewhere (ops/pallas_kernels.py)."""
+        if DeviceWorker._pallas_ok is None:
+            from veneur_tpu.ops import pallas_kernels as pk
+
+            DeviceWorker._pallas_ok = pk.supported()
+        if DeviceWorker._pallas_ok:
+            from veneur_tpu.ops import pallas_kernels as pk
+
+            try:
+                quant, dsum, dcount = pk.flush_extract(
+                    histo.means, histo.weights, histo.dmin, histo.dmax, qs)
+                return (quant, histo.dmin, histo.dmax, dsum, dcount,
+                        histo.drecip, histo.lmin, histo.lmax, histo.lsum,
+                        histo.lweight, histo.lrecip)
+            except Exception:  # pragma: no cover - TPU-only path
+                DeviceWorker._pallas_ok = False
+        return _histo_flush_extract(
+            histo.means, histo.weights, histo.dmin, histo.dmax,
+            histo.drecip, histo.lmin, histo.lmax, histo.lsum,
+            histo.lweight, histo.lrecip, qs,
+        )
+
     # -- flush --------------------------------------------------------------
 
     def flush(self, quantiles: np.ndarray, interval_s: float = 10.0
@@ -755,11 +781,7 @@ class DeviceWorker:
         )
         if histo is not None and directory.num_histo_rows:
             qs = jnp.asarray(np.asarray(quantiles, dtype=np.float32))
-            out = _histo_flush_extract(
-                histo.means, histo.weights, histo.dmin, histo.dmax,
-                histo.drecip, histo.lmin, histo.lmax, histo.lsum,
-                histo.lweight, histo.lrecip, qs,
-            )
+            out = self._extract(histo, qs)
             (qv, dmin, dmax, dsum, dcount, drecip,
              lmin, lmax, lsum, lweight, lrecip) = [np.asarray(a) for a in out]
             n = directory.num_histo_rows

@@ -1,0 +1,126 @@
+"""Pallas TPU kernels for the flush hot path.
+
+The flush-time percentile extraction at high cardinality (BASELINE.md: p99
+flush latency at 1M histogram series) reads the whole digest pool. The XLA
+path materializes several intermediates ([S,C] bounds, [S,C,P] reach masks)
+in HBM; this kernel fuses the entire extraction — cumulative weights,
+centroid bounds, quantile interpolation, sum/count aggregates — into one
+VMEM pass per row block:
+
+* cumsum along the 128-wide centroid axis is a [B,C]×[C,C] lower-triangular
+  matmul (MXU work instead of a serial scan),
+* per-quantile slot selection is a one-hot mask-and-reduce (no gathers —
+  dynamic per-lane gathers don't vectorize on TPU),
+* all P quantiles and the sum/count aggregates come out of the single load
+  of means/weights.
+
+Falls back to the XLA implementation (ops/tdigest.quantile et al.) on
+platforms without Pallas TPU support; tests run the kernel in interpret
+mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from veneur_tpu.ops import tdigest as td
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _extract_kernel(means_ref, weights_ref, dmin_ref, dmax_ref, qs_ref,
+                    quant_ref, dsum_ref, dcount_ref):
+    means = means_ref[...]  # [B, C]
+    weights = weights_ref[...]  # [B, C]
+    dmin = dmin_ref[...]  # [B]
+    dmax = dmax_ref[...]  # [B]
+    qs = qs_ref[...]  # [P]
+    b, c = means.shape
+    p = qs.shape[0]
+
+    # cumulative weight via lower-triangular matmul (rides the MXU)
+    col = jax.lax.broadcasted_iota(jnp.float32, (c, c), 0)
+    row = jax.lax.broadcasted_iota(jnp.float32, (c, c), 1)
+    tril = (col <= row).astype(jnp.float32)  # [C, C]; cum[j] = Σ_{i<=j} w_i
+    w_cum = jnp.dot(weights, tril, preferred_element_type=jnp.float32)
+    total = w_cum[:, -1]  # [B]
+
+    nonempty = weights > 0
+    count = jnp.sum(nonempty.astype(jnp.float32), axis=-1)  # [B]
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, (b, c), 1)
+    # next-slot means: shift left, +inf in the last lane
+    next_means = jnp.concatenate(
+        [means[:, 1:], jnp.full((b, 1), jnp.inf, means.dtype)], axis=-1)
+    mid = (means + next_means) * 0.5
+    is_last = idx == (count.astype(jnp.int32) - 1)[:, None]
+    ub = jnp.where(is_last, dmax[:, None], mid)
+    lb = jnp.concatenate([dmin[:, None], ub[:, :-1]], axis=-1)
+
+    # aggregates from the same load
+    dsum_ref[...] = jnp.sum(jnp.where(nonempty, means * weights, 0.0),
+                            axis=-1)
+    dcount_ref[...] = total
+
+    w_before = w_cum - weights
+    safe_w = jnp.maximum(weights, 1e-30)
+    empty_row = (total <= 0) | (count <= 0)
+    for j in range(p):
+        target = qs[j] * total  # [B]
+        reached = target[:, None] <= w_cum  # [B, C]
+        first = jnp.argmax(reached, axis=-1)  # [B]
+        sel = idx == first[:, None]  # one-hot [B, C]
+        proportion = (target[:, None] - w_before) / safe_w
+        val_all = lb + proportion * (ub - lb)
+        val = jnp.sum(jnp.where(sel, val_all, 0.0), axis=-1)
+        quant_ref[:, j] = jnp.where(empty_row, jnp.nan, val)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def flush_extract(means, weights, dmin, dmax, qs,
+                  block_rows: int = DEFAULT_BLOCK_ROWS,
+                  interpret: bool = False):
+    """Fused flush extraction: (quantiles [S,P], dsum [S], dcount [S])."""
+    s, c = means.shape
+    p = qs.shape[0]
+    if s % block_rows:
+        block_rows = min(block_rows, s)
+        while s % block_rows:
+            block_rows //= 2
+    grid = (s // block_rows,)
+    return pl.pallas_call(
+        _extract_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((p,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, p), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((block_rows,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s, p), jnp.float32),
+            jax.ShapeDtypeStruct((s,), jnp.float32),
+            jax.ShapeDtypeStruct((s,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(means, weights, dmin, dmax, qs)
+
+
+def flush_extract_reference(means, weights, dmin, dmax, qs):
+    """The XLA path producing identical outputs (fallback + test oracle)."""
+    quant = td.quantile(means, weights, dmin, dmax, qs)
+    return quant, td.row_sum(means, weights), td.row_count(weights)
+
+
+def supported() -> bool:
+    return jax.default_backend() == "tpu"
