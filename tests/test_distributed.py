@@ -277,3 +277,54 @@ def test_destination_refresher_keeps_last_good():
     r.refresh()  # fails → keeps last good
     assert proxy.ring.members() == ["new1:1", "new2:1"]
     assert r.refresh_errors == 1
+
+
+def test_http_api_endpoints():
+    """Reference Server.Handler surface (http.go:22-60)."""
+    import urllib.request
+
+    cfg = Config(interval="10s", http_quit=True)
+    srv = Server(cfg)
+    imp = ImportServer(srv)
+    http = ImportHTTPServer(imp)
+    port = http.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        assert urllib.request.urlopen(f"{base}/healthcheck").read() == b"ok\n"
+        assert urllib.request.urlopen(
+            f"{base}/healthcheck/tracing").read() == b"ok\n"
+        assert urllib.request.urlopen(
+            f"{base}/version").read().decode() == srv.version
+        assert urllib.request.urlopen(f"{base}/builddate").read() == b"dev"
+        body = urllib.request.urlopen(f"{base}/debug/pprof/").read()
+        assert b"thread" in body
+        # POST /quitquitquit triggers graceful shutdown when http_quit=true
+        req = urllib.request.Request(f"{base}/quitquitquit", data=b"",
+                                     method="POST")
+        assert b"graceful" in urllib.request.urlopen(req).read()
+        assert _wait_until(lambda: srv._shutdown.is_set())
+    finally:
+        http.stop()
+        imp.stop()
+
+
+def test_quitquitquit_disabled_by_default():
+    import urllib.error
+    import urllib.request
+
+    srv = Server(Config(interval="10s"))
+    imp = ImportServer(srv)
+    http = ImportHTTPServer(imp)
+    port = http.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/quitquitquit", data=b"", method="POST")
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        assert not srv._shutdown.is_set()
+    finally:
+        http.stop()
+        imp.stop()

@@ -1,0 +1,77 @@
+"""scopedstatsd: line format, scope tags, server flush telemetry.
+
+Parity spec: reference scopedstatsd/client.go:13-119 and flusher.go:38-47.
+"""
+
+from veneur_tpu import scopedstatsd
+from veneur_tpu.core.config import Config, MetricsScopes
+
+
+def _client(scopes=None, tags=None):
+    cap = scopedstatsd.CaptureSender()
+    cl = scopedstatsd.ScopedClient(cap, add_tags=tags, scopes=scopes,
+                                   namespace="veneur.")
+    return cl, cap
+
+
+def test_line_format_basic():
+    cl, cap = _client()
+    cl.count("packets", 3, tags=["proto:udp"])
+    assert cap.lines == ["veneur.packets:3|c|#proto:udp"]
+
+
+def test_rate_rendered():
+    cl, cap = _client()
+    cl.gauge("g", 1.5, rate=0.5)
+    assert cap.lines == ["veneur.g:1.5|g|@0.5"]
+
+
+def test_scope_tags_per_type():
+    scopes = MetricsScopes(counter="global", gauge="local", histogram="global")
+    cl, cap = _client(scopes=scopes)
+    cl.incr("c")
+    cl.gauge("g", 1)
+    cl.histogram("h", 2.0)
+    cl.timing("t", 0.25)
+    assert cap.lines[0] == "veneur.c:1|c|#veneurglobalonly:true"
+    assert cap.lines[1] == "veneur.g:1|g|#veneurlocalonly:true"
+    assert cap.lines[2] == "veneur.h:2.0|h|#veneurglobalonly:true"
+    # timing reports ms and takes the histogram scope
+    assert cap.lines[3] == "veneur.t:250.0|ms|#veneurglobalonly:true"
+
+
+def test_add_tags_appended():
+    cl, cap = _client(tags=["host:x"])
+    cl.incr("c", tags=["a:b"])
+    assert cap.lines == ["veneur.c:1|c|#a:b,host:x"]
+
+
+def test_ensure_nil_safe():
+    cl = scopedstatsd.ensure(None)
+    cl.incr("anything")  # no-op, must not raise
+
+
+def test_server_flush_emits_telemetry():
+    from veneur_tpu.core.server import Server
+
+    cfg = Config(interval="50ms", count_unique_timeseries=True)
+    srv = Server(cfg)
+    cap = scopedstatsd.CaptureSender()
+    srv.stats = scopedstatsd.ScopedClient(cap, namespace="veneur.")
+    srv.handle_metric_packet(b"a.timer:5|ms")
+    srv.handle_metric_packet(b"a.counter:2|c")
+    srv.flush()
+    names = {line.split(":", 1)[0] for line in cap.lines}
+    assert "veneur.flush.flush_timestamp_ns" in names
+    assert "veneur.flush.post_metrics_total" in names
+    assert "veneur.flush.total_duration_ns" in names
+    assert "veneur.flush.unique_timeseries_total" in names
+    srv.shutdown()
+
+
+def test_loopback_sender_feeds_handler():
+    got = []
+    s = scopedstatsd.LoopbackSender(got.append)
+    cl = scopedstatsd.ScopedClient(s)
+    cl.incr("x")
+    assert got == [b"x:1|c"]

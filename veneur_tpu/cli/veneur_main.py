@@ -59,7 +59,10 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, _handle)
     signal.signal(signal.SIGINT, _handle)
-    stop.wait()
+    # wake on signal OR server-initiated shutdown (POST /quitquitquit sets
+    # server._shutdown; the process must exit too, reference http.go:37-44)
+    while not stop.is_set() and not server._shutdown.is_set():
+        stop.wait(0.5)
     server.shutdown()
     return 0
 

@@ -135,6 +135,23 @@ class Server:
         # ingest counters (self-telemetry)
         self.packets_received = 0
         self.parse_errors = 0
+        self._errors_reported = 0
+
+        # scoped self-telemetry statsd client (reference server.go:298-308
+        # builds a datadog-go client with namespace "veneur." wrapped by
+        # scopedstatsd per veneur_metrics_scopes)
+        from veneur_tpu import scopedstatsd
+        if cfg.stats_address:
+            sender: scopedstatsd.Sender = scopedstatsd.UDPSender(
+                cfg.stats_address)
+        else:
+            sender = scopedstatsd.NullSender()
+        self.stats = scopedstatsd.ScopedClient(
+            sender,
+            add_tags=self.tags,
+            scopes=cfg.veneur_metrics_scopes,
+            namespace="veneur.",
+        )
 
         # native C++ ingest path: one worker owns the whole series space
         # (the device is the parallelism); multi-worker sharding keeps the
@@ -461,8 +478,10 @@ class Server:
 
     def flush(self) -> list[InterMetric]:
         """One flush pass (reference Server.Flush, flusher.go:28-134)."""
-        self.last_flush_unix = time.time()
+        flush_start = time.time()
+        self.last_flush_unix = flush_start
         self.flush_count += 1
+        self.stats.gauge("flush.flush_timestamp_ns", flush_start * 1e9)
 
         other_samples = self.event_worker.flush()
         for sink in self.metric_sinks:
@@ -513,7 +532,41 @@ class Server:
                     target=self._flush_plugins, args=(final,), daemon=True,
                     name="flush-plugins",
                 ).start()
+
+        # flush self-telemetry (reference flusher.go:38-47, worker.go:513)
+        if self.config.count_unique_timeseries:
+            self.stats.count(
+                "flush.unique_timeseries_total", self._tally_timeseries(snaps),
+                tags=[f"global_veneur:{str(not self.is_local).lower()}"])
+        self.stats.count("flush.post_metrics_total", len(final))
+        # statsd counters are per-interval increments: report the delta,
+        # covering both the Python parser and the native C++ parser
+        errors_now = self.parse_errors + sum(
+            getattr(w, "parse_errors", 0) for w in self.workers)
+        self.stats.count("packet.error_total",
+                         errors_now - self._errors_reported)
+        self._errors_reported = errors_now
+        self.stats.time_in_nanoseconds(
+            "flush.total_duration_ns", (time.time() - flush_start) * 1e9)
         return final
+
+    @staticmethod
+    def _tally_timeseries(snaps: list[FlushSnapshot]) -> int:
+        """Merge per-worker unique-timeseries HLLs and estimate
+        (reference Server.tallyTimeseries, flusher.go:134-143)."""
+        import numpy as np
+        from veneur_tpu.ops import hll as hll_ops
+        regs = [s.unique_timeseries_registers for s in snaps
+                if s.unique_timeseries_registers is not None]
+        if not regs:
+            return 0
+        merged = regs[0]
+        for r in regs[1:]:
+            merged = np.maximum(merged, r)
+        import math
+        precision = int(math.log2(merged.shape[-1]))
+        est = hll_ops.estimate(merged[None, :], precision=precision)
+        return int(float(np.asarray(est)[0]))
 
     def _flush_plugins(self, metrics: list[InterMetric]) -> None:
         """reference flusher.go:117-131: plugins run after the sinks."""
@@ -523,12 +576,23 @@ class Server:
             except Exception:
                 log.exception("plugin %s flush failed", plugin.name())
 
-    @staticmethod
-    def _flush_sink(sink: MetricSink, metrics: list[InterMetric]) -> None:
+    def _flush_sink(self, sink: MetricSink,
+                    metrics: list[InterMetric]) -> None:
+        start = time.time()
+        tags = [f"sink:{sink.name()}"]
         try:
             sink.flush(metrics)
         except Exception:
             log.exception("sink %s flush failed", sink.name())
+        else:
+            self.stats.count(
+                "sink.metrics_flushed_total", len(metrics), tags=tags)
+        finally:
+            # canonical per-sink telemetry (reference sinks/sinks.go:11-24);
+            # duration is recorded even on failure — that's when it matters
+            self.stats.time_in_nanoseconds(
+                "sink.metric_flush_total_duration_ns",
+                (time.time() - start) * 1e9, tags=tags)
 
     # -- watchdog -----------------------------------------------------------
 
@@ -557,6 +621,7 @@ class Server:
     def shutdown(self) -> None:
         """reference Server.Shutdown (server.go:1473)."""
         self._shutdown.set()
+        self.stats.close()
         self.span_worker.stop()
         if self.import_server is not None:
             self.import_server.stop()
@@ -571,3 +636,8 @@ class Server:
     @property
     def version(self) -> str:
         return __version__
+
+    @property
+    def build_date(self) -> str:
+        """Analog of the reference's linker-injected BUILD_DATE."""
+        return os.environ.get("VENEUR_TPU_BUILD_DATE", "dev")

@@ -46,7 +46,7 @@ from veneur_tpu.core.metrics import MetricKey, UDPMetric, route_info
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import tdigest as td
 from veneur_tpu.ops.scalars import counter_contribution
-from veneur_tpu.utils.hashing import hll_hash, fmix64
+from veneur_tpu.utils.hashing import hll_hash, fmix64, metric_digest
 
 
 def _next_pow2(n: int, floor: int = 1) -> int:
@@ -369,6 +369,11 @@ class DeviceWorker:
             key = MetricKey(name=name, type=mtype, joined_tags=joined)
             tags = joined.split(",") if joined else []
             cls = ScopeClass(scope)
+            if self.count_unique_timeseries:
+                # feed the unique-timeseries HLL once per new series; the
+                # HLL insert is idempotent so per-sample feeding (the Python
+                # path, worker.go:300-341) and per-series feeding agree
+                self._sample_timeseries_key(name, mtype, joined, cls)
             if pool == 0:
                 self.directory.histo.adopt(row, key, cls, tags)
             elif pool == 1:
@@ -517,22 +522,37 @@ class DeviceWorker:
         row, _ = self.directory.upsert_set(key, scope_class, tags)
         return row
 
+    def _should_count_timeseries(self, mtype: str, cls: ScopeClass) -> bool:
+        """Forwarding-aware unique-timeseries gating (reference
+        SampleTimeseries, worker.go:300-341): a local instance skips series
+        it forwards upstream (the global instance counts those)."""
+        if not self.is_local:
+            return True
+        if mtype in ("counter", "gauge"):
+            return cls != ScopeClass.GLOBAL
+        if mtype in ("histogram", "set", "timer"):
+            return cls == ScopeClass.LOCAL
+        return True
+
+    def _insert_timeseries(self, digest: int) -> None:
+        h = fmix64(digest)
+        idx, rank = hll_ops.split_hashes(
+            np.array([h], dtype=np.uint64), self.hll_precision
+        )
+        self._umts[idx[0]] = max(self._umts[idx[0]], rank[0])
+
+    def _sample_timeseries_key(self, name: str, mtype: str, joined: str,
+                               cls: ScopeClass) -> None:
+        """Native-path unique-timeseries sampling, keyed by series identity
+        (idempotent, so per-series feeding agrees with per-sample)."""
+        if self._umts is not None and self._should_count_timeseries(mtype, cls):
+            self._insert_timeseries(metric_digest(name, mtype, joined))
+
     def _sample_timeseries(self, m: UDPMetric, mtype: str) -> None:
-        """Count a series toward unique-timeseries cardinality per the
-        forwarding-aware rules of reference SampleTimeseries
-        (worker.go:300-341)."""
-        count = True
-        if self.is_local:
-            if mtype in ("counter", "gauge"):
-                count = m.scope != 2  # not GlobalOnly
-            elif mtype in ("histogram", "set", "timer"):
-                count = m.scope == 1  # LocalOnly
-        if count and self._umts is not None:
-            h = fmix64(m.digest)
-            idx, rank = hll_ops.split_hashes(
-                np.array([h], dtype=np.uint64), self.hll_precision
-            )
-            self._umts[idx[0]] = max(self._umts[idx[0]], rank[0])
+        """Python-path unique-timeseries sampling (one call per sample)."""
+        cls = classify(mtype, m.scope)
+        if self._umts is not None and self._should_count_timeseries(mtype, cls):
+            self._insert_timeseries(m.digest)
 
     # host scalar paths
 

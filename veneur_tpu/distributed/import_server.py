@@ -88,8 +88,10 @@ def decode_http_import_body(body: bytes, content_encoding: str
 
 
 class ImportHTTPServer:
-    """HTTP server exposing /import, /healthcheck, /version
-    (reference Server.Handler, http.go:22-60)."""
+    """HTTP server exposing the reference Server.Handler surface
+    (http.go:22-60): /healthcheck, /healthcheck/tracing, /version,
+    /builddate, POST /import, optional POST /quitquitquit (http_quit),
+    and a /debug/pprof analog (live Python thread stack dump)."""
 
     def __init__(self, import_server: ImportServer) -> None:
         self.import_server = import_server
@@ -98,21 +100,44 @@ class ImportHTTPServer:
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         imp = self.import_server
-        version = imp.server.version if imp.server else "unknown"
+        srv = imp.server
+        version = srv.version if srv else "unknown"
+        build_date = getattr(srv, "build_date", "dev") if srv else "dev"
+        http_quit = bool(srv and srv.config.http_quit)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
             def do_GET(self):
-                if self.path == "/healthcheck":
-                    self._respond(200, b"ok")
+                if self.path in ("/healthcheck", "/healthcheck/tracing"):
+                    self._respond(200, b"ok\n")
                 elif self.path == "/version":
                     self._respond(200, version.encode())
+                elif self.path == "/builddate":
+                    self._respond(200, str(build_date).encode())
+                elif self.path.startswith("/debug/pprof"):
+                    # pprof analog: dump every live thread's stack
+                    # (reference wires net/http/pprof, http.go:52-57)
+                    import sys
+                    import traceback
+                    frames = sys._current_frames()
+                    out = []
+                    for tid, frame in frames.items():
+                        out.append(f"--- thread {tid} ---\n")
+                        out.extend(traceback.format_stack(frame))
+                    self._respond(200, "".join(out).encode())
                 else:
                     self._respond(404, b"not found")
 
             def do_POST(self):
+                if self.path == "/quitquitquit" and http_quit:
+                    # graceful shutdown endpoint (reference http.go:37-44)
+                    self._respond(200, b"Beginning graceful shutdown....\n")
+                    threading.Thread(
+                        target=srv.shutdown, daemon=True, name="http-quit"
+                    ).start()
+                    return
                 if self.path != "/import":
                     self._respond(404, b"not found")
                     return
