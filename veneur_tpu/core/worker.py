@@ -472,7 +472,7 @@ class DeviceWorker:
         mtype = m.key.type
         scope_class = classify(mtype, m.scope)
         if self.count_unique_timeseries:
-            self._sample_timeseries(m, mtype)
+            self._sample_timeseries(m, mtype, scope_class)
 
         if mtype == "counter":
             self._host_counter(m.key, scope_class, m.tags,
@@ -548,9 +548,9 @@ class DeviceWorker:
         if self._umts is not None and self._should_count_timeseries(mtype, cls):
             self._insert_timeseries(metric_digest(name, mtype, joined))
 
-    def _sample_timeseries(self, m: UDPMetric, mtype: str) -> None:
+    def _sample_timeseries(self, m: UDPMetric, mtype: str,
+                           cls: ScopeClass) -> None:
         """Python-path unique-timeseries sampling (one call per sample)."""
-        cls = classify(mtype, m.scope)
         if self._umts is not None and self._should_count_timeseries(mtype, cls):
             self._insert_timeseries(m.digest)
 

@@ -63,9 +63,26 @@ class UDPSender(Sender):
     """Fire-and-forget UDP datagrams to ``stats_address``."""
 
     def __init__(self, address: str) -> None:
-        host, _, port = address.rpartition(":")
-        self._addr = (host or "127.0.0.1", int(port))
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        raw = address
+        if address.startswith("udp://"):
+            address = address[len("udp://"):]
+        host, port = "127.0.0.1", 8125
+        try:
+            if address.startswith("["):  # [::1]:8125
+                host, _, rest = address[1:].partition("]")
+                if rest.startswith(":"):
+                    port = int(rest[1:])
+            elif ":" in address:
+                host, _, p = address.rpartition(":")
+                port = int(p)
+            elif address:
+                host = address
+            info = socket.getaddrinfo(host, port, socket.AF_UNSPEC,
+                                      socket.SOCK_DGRAM)[0]
+        except (OSError, ValueError) as e:
+            raise ValueError(f"invalid stats_address {raw!r}: {e}") from e
+        self._addr = info[4]
+        self._sock = socket.socket(info[0], socket.SOCK_DGRAM)
 
     def send(self, line: str) -> None:
         try:
