@@ -1,0 +1,95 @@
+"""Panic-capture tests (reference sentry.go:22-60 ConsumePanic behavior:
+report with full-thread traceback, then abort)."""
+
+import http.server
+import json
+import threading
+
+from veneur_tpu.core import crash
+
+
+def test_file_dsn_report(tmp_path):
+    path = tmp_path / "crash.log"
+    exits = []
+    try:
+        raise ValueError("kaboom")
+    except ValueError as e:
+        crash.consume_panic(e, f"file://{path}", "flush-loop",
+                            exit_fn=exits.append)
+    assert exits == [1]
+    report = json.loads(path.read_text().strip())
+    assert report["component"] == "flush-loop"
+    assert "kaboom" in report["error"]
+    assert "ValueError" in report["traceback"]
+    # full-thread stack dump includes the current (main) thread
+    assert "thread MainThread" in report["threads"]
+
+
+def test_guard_suppresses_during_shutdown(tmp_path):
+    shutting_down = threading.Event()
+    shutting_down.set()
+    exits = []
+
+    def boom():
+        raise OSError("socket closed")
+
+    crash.guard(boom, "", "reader", exit_fn=exits.append,
+                suppress=shutting_down.is_set)()
+    assert exits == []  # routine shutdown, no panic
+
+
+def test_guard_panics_when_live(tmp_path):
+    path = tmp_path / "crash.log"
+    exits = []
+
+    def boom():
+        raise RuntimeError("real bug")
+
+    crash.guard(boom, f"file://{path}", "worker", exit_fn=exits.append,
+                suppress=lambda: False)()
+    assert exits == [1]
+    assert "real bug" in path.read_text()
+
+
+def test_http_dsn_sentry_post():
+    """Minimal Sentry store-API delivery against a local HTTP server."""
+    received = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            received["path"] = self.path
+            received["auth"] = self.headers.get("X-Sentry-Auth", "")
+            n = int(self.headers["Content-Length"])
+            received["body"] = json.loads(self.rfile.read(n))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        try:
+            raise KeyError("boom")
+        except KeyError as e:
+            report = crash.build_report(e, "proxy")
+        crash.deliver(report, f"http://pubkey@127.0.0.1:{port}/42")
+        assert received["path"] == "/api/42/store/"
+        assert "sentry_key=pubkey" in received["auth"]
+        assert received["body"]["extra"]["component"] == "proxy"
+    finally:
+        httpd.shutdown()
+
+
+def test_deliver_never_raises(tmp_path):
+    try:
+        raise ValueError("x")
+    except ValueError as e:
+        report = crash.build_report(e, "c")
+    crash.deliver(report, "http://key@127.0.0.1:1/1")  # connection refused
+    crash.deliver(report, "garbage-dsn")
+    crash.deliver(report, f"file:///nonexistent-dir-{id(report)}/x.log")

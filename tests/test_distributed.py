@@ -328,3 +328,97 @@ def test_quitquitquit_disabled_by_default():
     finally:
         http.stop()
         imp.stop()
+
+
+def test_proxy_http_import_ring_splits():
+    """HTTP face of the proxy: POST /import is ring-split across globals
+    (reference veneur-proxy ProxyMetrics, proxy.go:587-628)."""
+    import urllib.request
+
+    from veneur_tpu.distributed.proxy import ProxyHTTPServer
+
+    g1, imp1, port1 = _global_server()
+    g2, imp2, port2 = _global_server()
+    proxy = ProxyServer([f"127.0.0.1:{port1}", f"127.0.0.1:{port2}"])
+    front = ProxyHTTPServer(proxy)
+    fport = front.start()
+    try:
+        # build a forwardable batch from a local flush
+        from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+        local = _local_server(1, use_grpc=True)  # port unused; no flush here
+        for i in range(30):
+            _ingest_histo(local, f"hseries{i}", [float(i)] * 5)
+        qs = device_quantiles(PCTS, AGGS)
+        batch = pb.MetricBatch()
+        for w, lock in zip(local.workers, local._worker_locks):
+            with lock:
+                snap = w.flush(qs, 10.0)
+            batch.metrics.extend(
+                codec.snapshot_to_batch(snap, 100.0, 14).metrics)
+        body = batch.SerializeToString()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fport}/import", data=body)
+        assert urllib.request.urlopen(req).status == 200
+        assert _wait_until(
+            lambda: imp1.received_metrics + imp2.received_metrics >= 30)
+        assert imp1.received_metrics > 0 and imp2.received_metrics > 0
+    finally:
+        front.stop()
+        proxy.stop()
+        imp1.stop()
+        imp2.stop()
+
+
+def test_trace_proxy_routes_by_trace_id():
+    """Spans of one trace all land on the destination owning the TraceID
+    (reference ProxyTraces, proxy.go:543-586)."""
+    import io
+    import socket as socket_mod
+    import urllib.request
+
+    from veneur_tpu.distributed.proxy import ProxyHTTPServer, TraceProxy
+    from veneur_tpu.protocol import ssf_wire
+    from veneur_tpu.ssf import SSFSpan
+
+    rx1 = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+    rx1.bind(("127.0.0.1", 0)); rx1.settimeout(5)
+    rx2 = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+    rx2.bind(("127.0.0.1", 0)); rx2.settimeout(5)
+    dests = [f"127.0.0.1:{rx1.getsockname()[1]}",
+             f"127.0.0.1:{rx2.getsockname()[1]}"]
+
+    tp = TraceProxy(dests)
+    front = ProxyHTTPServer(ProxyServer([]), trace_proxy=tp)
+    fport = front.start()
+    try:
+        buf = io.BytesIO()
+        for trace_id in (101, 202, 303, 404, 505, 606):
+            for span_id in (1, 2, 3):
+                ssf_wire.write_ssf(buf, SSFSpan(
+                    id=span_id, trace_id=trace_id, service="svc",
+                    start_timestamp=1, end_timestamp=2))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fport}/spans", data=buf.getvalue())
+        assert urllib.request.urlopen(req).status == 200
+        assert _wait_until(lambda: tp.proxied_spans >= 18)
+
+        where = {}
+        for rx, label in ((rx1, 0), (rx2, 1)):
+            rx.setblocking(False)
+            while True:
+                try:
+                    data, _ = rx.recvfrom(65536)
+                except (BlockingIOError, OSError):
+                    break
+                span = ssf_wire.parse_ssf(data)
+                where.setdefault(span.trace_id, set()).add(label)
+        assert len(where) == 6  # every trace arrived somewhere
+        for trace_id, labels in where.items():
+            assert len(labels) == 1  # never split across destinations
+        assert tp.drops == 0
+    finally:
+        front.stop()
+        tp.stop()
+        rx1.close()
+        rx2.close()

@@ -18,14 +18,15 @@ from veneur_tpu.sinks.channel import ChannelMetricSink
 
 
 def _server(**cfg_kwargs) -> tuple[Server, ChannelMetricSink, dict]:
-    cfg = Config(
+    base = dict(
         statsd_listen_addresses=["udp://127.0.0.1:0"],
         num_workers=2,
         num_readers=1,
         interval="10s",
         percentiles=[0.5, 0.99],
-        **cfg_kwargs,
     )
+    base.update(cfg_kwargs)
+    cfg = Config(**base)
     sink = ChannelMetricSink()
     srv = Server(cfg, metric_sinks=[sink])
     ports = srv.start()
@@ -240,3 +241,65 @@ def test_load_config_strict_rejects_unknown(tmp_path):
 def test_calculate_tick_delay():
     assert calculate_tick_delay(10.0, 103.0) == pytest.approx(7.0)
     assert calculate_tick_delay(10.0, 100.0) == pytest.approx(10.0)
+
+
+def test_unixgram_statsd_flock_and_abstract(tmp_path):
+    """Unixgram listener: flock exclusivity (networking.go:289-306 analog)
+    plus abstract-socket ingest."""
+    path = str(tmp_path / "statsd.sock")
+    srv, sink, _ = _server(statsd_listen_addresses=[f"unixgram://{path}"])
+    try:
+        # a second server on the same path must refuse to start
+        cfg2 = Config(statsd_listen_addresses=[f"unixgram://{path}"],
+                      num_workers=1, num_readers=1, interval="10s")
+        srv2 = Server(cfg2, metric_sinks=[])
+        with pytest.raises(RuntimeError, match="locked"):
+            srv2.start()
+        srv2.shutdown()
+
+        tx = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        tx.sendto(b"ug.count:5|c", path)
+        tx.close()
+        assert _wait_for(lambda: sum(w.processed for w in srv.workers) >= 1)
+        metrics = srv.flush()
+        assert {(m.name, m.value) for m in metrics} == {("ug.count", 5.0)}
+    finally:
+        srv.shutdown()
+
+    # abstract socket: no filesystem entry, no lock file
+    srv3, _, _ = _server(statsd_listen_addresses=["unixgram://@vtpu-test-abs"])
+    try:
+        tx = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        tx.sendto(b"abs.count:2|c", "\0vtpu-test-abs")
+        tx.close()
+        assert _wait_for(lambda: sum(w.processed for w in srv3.workers) >= 1)
+        # abstract sockets have no filesystem presence: no lock fds taken
+        assert srv3._socket_locks == []
+    finally:
+        srv3.shutdown()
+
+
+def test_lock_released_after_shutdown(tmp_path):
+    """Shutdown releases the flock so a successor instance can bind."""
+    path = str(tmp_path / "reuse.sock")
+    srv, _, _ = _server(statsd_listen_addresses=[f"unixgram://{path}"])
+    srv.shutdown()
+    srv2, _, _ = _server(statsd_listen_addresses=[f"unixgram://{path}"])
+    srv2.shutdown()
+
+
+def test_ssf_unixgram(tmp_path):
+    """SSF spans over a unix datagram socket."""
+    from veneur_tpu.gen import ssf_pb2
+
+    path = str(tmp_path / "ssf.sock")
+    srv, _, _ = _server(ssf_listen_addresses=[f"unixgram://{path}"])
+    try:
+        span = ssf_pb2.SSFSpan(id=7, trace_id=7, service="svc",
+                               start_timestamp=1, end_timestamp=2)
+        tx = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        tx.sendto(span.SerializeToString(), path)
+        tx.close()
+        assert _wait_for(lambda: srv.ssf_spans_received.get("svc", 0) >= 1)
+    finally:
+        srv.shutdown()

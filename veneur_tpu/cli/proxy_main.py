@@ -14,7 +14,12 @@ import sys
 import threading
 
 from veneur_tpu.core.config import load_proxy_config, parse_duration
-from veneur_tpu.distributed.proxy import DestinationRefresher, ProxyServer
+from veneur_tpu.distributed.proxy import (
+    DestinationRefresher,
+    ProxyHTTPServer,
+    ProxyServer,
+    TraceProxy,
+)
 
 
 def main(argv=None) -> int:
@@ -47,7 +52,37 @@ def main(argv=None) -> int:
     port = proxy.start_grpc(address)
     log.info("proxy serving gRPC on %s (port %s)", address, port)
 
+    trace_proxy = None
+    if cfg.trace_address or cfg.consul_trace_service_name:
+        if cfg.http_address:
+            trace_proxy = TraceProxy(
+                [cfg.trace_address] if cfg.trace_address else [])
+        else:
+            # /spans over the HTTP front is the only ingest path into the
+            # trace ring; without it the pipeline would be silently dead
+            log.warning("trace_address/consul_trace_service_name configured"
+                        " but http_address is not: trace proxying disabled"
+                        " (spans arrive via POST /spans on http_address)")
+
+    http_front = None
+    if cfg.http_address:
+        from veneur_tpu.utils.http import parse_host_port
+
+        host, hport = parse_host_port(cfg.http_address, what="http_address")
+        http_front = ProxyHTTPServer(proxy, trace_proxy=trace_proxy)
+        http_front.start(host, hport)
+        log.info("proxy serving HTTP on %s", cfg.http_address)
+
     refresher = None
+    trace_refresher = None
+    if cfg.consul_trace_service_name and trace_proxy is not None:
+        from veneur_tpu.distributed.discovery import ConsulDiscoverer
+
+        trace_refresher = DestinationRefresher(
+            trace_proxy, ConsulDiscoverer(cfg.consul_url),
+            cfg.consul_trace_service_name,
+            parse_duration(cfg.consul_refresh_interval))
+        trace_refresher.start()
     if cfg.consul_forward_service_name:
         from veneur_tpu.distributed.discovery import ConsulDiscoverer
 
@@ -74,6 +109,12 @@ def main(argv=None) -> int:
     stop.wait()
     if refresher is not None:
         refresher.stop()
+    if trace_refresher is not None:
+        trace_refresher.stop()
+    if http_front is not None:
+        http_front.stop()
+    if trace_proxy is not None:
+        trace_proxy.stop()
     proxy.stop()
     return 0
 

@@ -242,6 +242,7 @@ class ProxyConfig:
 
     consul_forward_service_name: str = ""
     consul_refresh_interval: str = "30s"
+    consul_trace_service_name: str = ""
     consul_url: str = "http://127.0.0.1:8500"
     kubernetes_forward_service_name: str = ""
     kubernetes_namespace: str = "default"
@@ -256,6 +257,7 @@ class ProxyConfig:
     sentry_dsn: str = ""
     ssf_destination_address: str = ""
     stats_address: str = ""
+    trace_address: str = ""  # static trace destination (no discovery)
     tracing_client_capacity: int = 1024
     tracing_client_flush_interval: str = "500ms"
     tracing_client_metrics_interval: str = "1s"

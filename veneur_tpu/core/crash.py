@@ -1,0 +1,132 @@
+"""Panic capture: report, then die.
+
+Parity spec: reference sentry.go:22-60 — ``ConsumePanic`` reports the panic
+(with a full-goroutine traceback) to Sentry, waits briefly for delivery, and
+re-panics so process supervision restarts the server. Every long-lived
+goroutine is wrapped (e.g. server.go:395-400, 909-912).
+
+Here the same contract wraps every long-lived server thread: on an unhandled
+exception we build a crash report containing the exception traceback plus a
+stack dump of every live thread (the "full goroutine traceback" analog),
+deliver it best-effort to ``sentry_dsn``, and abort the process.
+
+DSN forms:
+- ``file:///path/to/crash.log`` — append one JSON report per line. The
+  native choice for air-gapped TPU pods; a supervisor ships the file.
+- ``http(s)://key@host/project`` — minimal Sentry store-API POST with a
+  short timeout. Delivery errors are swallowed: reporting is best-effort,
+  dying is mandatory.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+from urllib import request as urlrequest
+from urllib.parse import urlsplit
+
+log = logging.getLogger("veneur_tpu.crash")
+
+REPORT_TIMEOUT_S = 3.0
+
+
+def format_all_threads() -> str:
+    """Stack dump of every live thread (the full-goroutine-traceback
+    analog from the reference's panic handler)."""
+    frames = sys._current_frames()
+    chunks = []
+    for t in threading.enumerate():
+        frame = frames.get(t.ident)
+        header = f"--- thread {t.name} (daemon={t.daemon})"
+        body = "".join(traceback.format_stack(frame)) if frame else "  <gone>\n"
+        chunks.append(header + "\n" + body)
+    return "\n".join(chunks)
+
+
+def build_report(exc: BaseException, component: str) -> dict:
+    return {
+        "timestamp": time.time(),
+        "component": component,
+        "error": repr(exc),
+        "traceback": "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)),
+        "threads": format_all_threads(),
+    }
+
+
+def deliver(report: dict, dsn: str) -> None:
+    """Best-effort delivery; never raises."""
+    try:
+        if dsn.startswith("file://"):
+            with open(dsn[len("file://"):], "a", encoding="utf-8") as f:
+                f.write(json.dumps(report) + "\n")
+            return
+        parts = urlsplit(dsn)
+        if parts.scheme in ("http", "https") and parts.username:
+            # Sentry store API: scheme://key@host/project
+            project = parts.path.strip("/")
+            url = (f"{parts.scheme}://{parts.hostname}"
+                   + (f":{parts.port}" if parts.port else "")
+                   + f"/api/{project}/store/")
+            body = json.dumps({
+                "message": report["error"],
+                "timestamp": report["timestamp"],
+                "logger": "veneur_tpu",
+                "platform": "python",
+                "extra": {"component": report["component"],
+                          "threads": report["threads"]},
+                "exception": {"values": [{"type": report["error"],
+                                          "value": report["traceback"]}]},
+            }).encode("utf-8")
+            req = urlrequest.Request(url, data=body, headers={
+                "Content-Type": "application/json",
+                "X-Sentry-Auth": ("Sentry sentry_version=7, "
+                                  f"sentry_key={parts.username}, "
+                                  "sentry_client=veneur-tpu/1"),
+            })
+            urlrequest.urlopen(req, timeout=REPORT_TIMEOUT_S).read()
+            return
+        log.error("unrecognized sentry_dsn %r; crash report dropped", dsn)
+    except Exception as e:  # reporting must never mask the crash
+        log.error("crash report delivery failed: %s", e)
+
+
+def consume_panic(exc: BaseException, dsn: str, component: str,
+                  exit_fn: Optional[Callable[[int], None]] = None) -> None:
+    """Report the exception, then abort (reference ConsumePanic,
+    sentry.go:22-60: report → wait → re-panic). ``exit_fn`` defaults to
+    ``os._exit(1)``; tests inject a recorder instead."""
+    report = build_report(exc, component)
+    log.critical("panic in %s: %s\n%s", component, report["error"],
+                 report["traceback"])
+    if dsn:
+        deliver(report, dsn)
+    if exit_fn is None:
+        import os
+
+        exit_fn = os._exit
+    exit_fn(1)
+
+
+def guard(fn: Callable[[], None], dsn: str, component: str,
+          exit_fn: Optional[Callable[[int], None]] = None,
+          suppress: Optional[Callable[[], bool]] = None) -> Callable[[], None]:
+    """Wrap a long-lived thread target with panic capture. ``suppress``
+    (e.g. "server is shutting down") turns a crash into a debug log —
+    sockets closing underneath reader threads during shutdown is routine."""
+
+    def wrapped() -> None:
+        try:
+            fn()
+        except BaseException as exc:  # noqa: BLE001 — panic boundary
+            if suppress is not None and suppress():
+                log.debug("%s exited during shutdown: %r", component, exc)
+                return
+            consume_panic(exc, dsn, component, exit_fn=exit_fn)
+
+    return wrapped

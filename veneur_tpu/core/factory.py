@@ -215,9 +215,11 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
 
         if server.import_server is None:
             server.import_server = ImportServer(server)
-        host, _, port = cfg.http_address.rpartition(":")
+        from veneur_tpu.utils.http import parse_host_port
+
+        host, port = parse_host_port(cfg.http_address, what="http_address")
         server.import_http = ImportHTTPServer(server.import_server)
-        server.import_http.start(host or "127.0.0.1", int(port))
+        server.import_http.start(host, port)
 
     # per-sink excluded tags from tags_exclude "tag:sink1:sink2" syntax
     # (reference setSinkExcludedTags, server.go:1522-1548: a plain entry

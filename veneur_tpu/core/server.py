@@ -23,6 +23,7 @@ import time
 from typing import Callable, Optional
 
 from veneur_tpu import __version__
+from veneur_tpu.core import crash
 from veneur_tpu.core.config import Config, parse_duration
 from veneur_tpu.core.flusher import device_quantiles, generate_inter_metrics
 from veneur_tpu.core.metrics import HistogramAggregates, InterMetric
@@ -128,6 +129,7 @@ class Server:
 
         self._threads: list[threading.Thread] = []
         self._sockets: list[socket.socket] = []
+        self._socket_locks: list[int] = []
         self._shutdown = threading.Event()
         self.last_flush_unix = time.time()
         self.flush_count = 0
@@ -261,12 +263,8 @@ class Server:
     def start_ssf_unix(self, path: str) -> None:
         """Framed SSF over a unix stream socket
         (reference startSSFUnix, networking.go:222-285)."""
-        if os.path.exists(path):
-            os.unlink(path)
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.bind(path)
+        sock = self._bind_unix_socket(path, socket.SOCK_STREAM)
         sock.listen(64)
-        self._sockets.append(sock)
 
         def accept_loop():
             while not self._shutdown.is_set():
@@ -300,6 +298,21 @@ class Server:
             except OSError:
                 pass
 
+    def start_ssf_unixgram(self, path: str) -> None:
+        """Unframed SSF datagrams over a unix datagram socket (reference
+        ReadSSFPacketSocket over unixgram, networking.go:222-285)."""
+        sock = self._bind_unix_socket(path, socket.SOCK_DGRAM)
+
+        def loop():
+            while not self._shutdown.is_set():
+                try:
+                    data = sock.recv(ssf_wire.MAX_SSF_PACKET_LENGTH)
+                except OSError:
+                    return
+                self.handle_trace_packet(data)
+
+        self._spawn(loop, "ssf-unixgram")
+
     def start_ssf_listeners(self) -> dict[str, int]:
         ports = {}
         for spec in self.config.ssf_listen_addresses:
@@ -310,6 +323,8 @@ class Server:
                                                  int(port))
             elif proto in ("unix", "unixstream"):
                 self.start_ssf_unix(rest)
+            elif proto == "unixgram":
+                self.start_ssf_unixgram(rest)
             else:
                 raise ValueError(f"unsupported SSF listener {spec!r}")
         return ports
@@ -317,7 +332,15 @@ class Server:
     # -- listeners ----------------------------------------------------------
 
     def _spawn(self, target, name: str) -> None:
-        t = threading.Thread(target=target, name=name, daemon=True)
+        """Every long-lived server thread is wrapped in panic capture
+        (reference ConsumePanic around goroutines, sentry.go:22-60,
+        server.go:395-400): report to sentry_dsn, then abort so process
+        supervision restarts us. Exceptions during shutdown are routine
+        (sockets closed underneath readers) and are suppressed."""
+        t = threading.Thread(
+            target=crash.guard(target, self.config.sentry_dsn, name,
+                               suppress=self._shutdown.is_set),
+            name=name, daemon=True)
         t.start()
         self._threads.append(t)
 
@@ -417,14 +440,39 @@ class Server:
             except OSError:
                 pass
 
-    def start_statsd_unixgram(self, path: str) -> None:
-        """Datagram unix socket statsd (reference networking.go:144-196).
-        Stale socket files are unlinked before bind."""
-        if os.path.exists(path):
-            os.unlink(path)
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
-        sock.bind(path)
+    def _bind_unix_socket(self, path: str, sock_type: int) -> socket.socket:
+        """Bind a unix socket with flock-based exclusivity (reference
+        acquireLockForSocket, networking.go:289-306): a `<path>.lock` file
+        is flocked exclusively before the stale socket file is unlinked, so
+        two server instances can never steal each other's socket. Abstract
+        sockets (`@name`) have no filesystem presence and need no lock."""
+        if path.startswith("@"):
+            addr: bytes | str = "\0" + path[1:]
+        else:
+            import fcntl
+
+            lock_path = path + ".lock"
+            fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)
+                raise RuntimeError(
+                    f"socket {path!r} is locked by another veneur instance "
+                    f"(flock on {lock_path!r} held)")
+            self._socket_locks.append(fd)
+            if os.path.exists(path):
+                os.unlink(path)
+            addr = path
+        sock = socket.socket(socket.AF_UNIX, sock_type)
+        sock.bind(addr)
         self._sockets.append(sock)
+        return sock
+
+    def start_statsd_unixgram(self, path: str) -> None:
+        """Datagram unix socket statsd (reference networking.go:144-196),
+        with flock exclusivity and abstract-socket (@name) support."""
+        sock = self._bind_unix_socket(path, socket.SOCK_DGRAM)
         self._spawn(lambda: self._read_metric_socket(sock), "statsd-unixgram")
 
     def start_listeners(self) -> dict[str, int]:
@@ -632,6 +680,12 @@ class Server:
                 sock.close()
             except OSError:
                 pass
+        for fd in self._socket_locks:
+            try:
+                os.close(fd)  # releases the flock
+            except OSError:
+                pass
+        self._socket_locks.clear()
 
     @property
     def version(self) -> str:

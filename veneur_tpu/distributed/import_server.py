@@ -19,6 +19,7 @@ import grpc
 
 from veneur_tpu.distributed import codec, rpc
 from veneur_tpu.gen import veneur_tpu_pb2 as pb
+from veneur_tpu.utils.http import APIHandlerBase
 
 log = logging.getLogger("veneur_tpu.import")
 
@@ -105,28 +106,14 @@ class ImportHTTPServer:
         build_date = getattr(srv, "build_date", "dev") if srv else "dev"
         http_quit = bool(srv and srv.config.http_quit)
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
+        class Handler(APIHandlerBase, BaseHTTPRequestHandler):
+            version_string_body = version
 
             def do_GET(self):
-                if self.path in ("/healthcheck", "/healthcheck/tracing"):
-                    self._respond(200, b"ok\n")
-                elif self.path == "/version":
-                    self._respond(200, version.encode())
-                elif self.path == "/builddate":
+                if self.handle_common_get():
+                    return
+                if self.path == "/builddate":
                     self._respond(200, str(build_date).encode())
-                elif self.path.startswith("/debug/pprof"):
-                    # pprof analog: dump every live thread's stack
-                    # (reference wires net/http/pprof, http.go:52-57)
-                    import sys
-                    import traceback
-                    frames = sys._current_frames()
-                    out = []
-                    for tid, frame in frames.items():
-                        out.append(f"--- thread {tid} ---\n")
-                        out.extend(traceback.format_stack(frame))
-                    self._respond(200, "".join(out).encode())
                 else:
                     self._respond(404, b"not found")
 
@@ -151,12 +138,6 @@ class ImportHTTPServer:
                     return
                 imp.handle_batch(batch)
                 self._respond(200, b"accepted")
-
-            def _respond(self, code: int, body: bytes):
-                self.send_response(code)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_port

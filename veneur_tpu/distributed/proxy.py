@@ -9,6 +9,7 @@ service-discovery refresh keeping last-good destinations on error).
 from __future__ import annotations
 
 import logging
+import socket
 import threading
 import time
 from typing import Optional
@@ -18,6 +19,7 @@ import grpc
 from veneur_tpu.distributed import codec, rpc
 from veneur_tpu.distributed.ring import ConsistentRing
 from veneur_tpu.gen import veneur_tpu_pb2 as pb
+from veneur_tpu.protocol import ssf_wire
 
 log = logging.getLogger("veneur_tpu.proxy")
 
@@ -95,6 +97,124 @@ class ProxyServer:
             for client in self._conns.values():
                 client.close()
             self._conns.clear()
+
+
+class TraceProxy:
+    """Ring-route trace spans to the destination owning their TraceID
+    (reference ProxyTraces, proxy.go:543-586: spans are sharded across
+    downstream collectors by consistent hash of the trace ID, so every
+    span of one trace lands on the same host).
+
+    Spans leave over UDP SSF datagrams — the ingest path every
+    destination server already listens on — so the proxy works against
+    plain veneur-tpu globals with no extra endpoint."""
+
+    def __init__(self, destinations: Optional[list[str]] = None) -> None:
+        self.ring = ConsistentRing(destinations or [])
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._lock = threading.Lock()  # ring mutation vs handler threads
+        self.proxied_spans = 0
+        self.drops = 0
+
+    def set_destinations(self, destinations: list[str]) -> None:
+        with self._lock:
+            self.ring.set_members(destinations)
+
+    def handle_spans(self, spans) -> None:
+        for span in spans:
+            try:
+                with self._lock:
+                    dest = self.ring.get(str(span.trace_id))
+            except LookupError:
+                self.drops += 1
+                continue
+            host, _, port = dest.rpartition(":")
+            try:
+                self._sock.sendto(ssf_wire.encode_datagram(span),
+                                  (host, int(port)))
+                self.proxied_spans += 1
+            except OSError as e:
+                self.drops += 1
+                log.debug("span forward to %s failed: %s", dest, e)
+
+    def stop(self) -> None:
+        self._sock.close()
+
+
+class ProxyHTTPServer:
+    """HTTP face of the proxy tier (reference veneur-proxy, proxy.go:40-74:
+    POST /import ring-splits metrics, POST /spans ring-routes traces,
+    plus /healthcheck /version /debug/pprof).
+
+    /import takes the same bodies as the global import endpoint (protobuf
+    MetricBatch, JSON+base64, optionally deflate). /spans takes a framed
+    SSF stream (any number of frames back-to-back)."""
+
+    def __init__(self, proxy: ProxyServer,
+                 trace_proxy: Optional[TraceProxy] = None) -> None:
+        self.proxy = proxy
+        self.trace_proxy = trace_proxy
+        self.httpd = None
+        self.port: Optional[int] = None
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        import io
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from veneur_tpu import __version__
+        from veneur_tpu.distributed.import_server import (
+            decode_http_import_body,
+        )
+        from veneur_tpu.utils.http import APIHandlerBase
+
+        proxy = self.proxy
+        trace_proxy = self.trace_proxy
+
+        class Handler(APIHandlerBase, BaseHTTPRequestHandler):
+            version_string_body = __version__
+
+            def do_GET(self):
+                if not self.handle_common_get():
+                    self._respond(404, b"not found")
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if self.path == "/import":
+                    try:
+                        batch = decode_http_import_body(
+                            body, self.headers.get("Content-Encoding", ""))
+                    except Exception as e:
+                        self._respond(400, f"bad import body: {e}".encode())
+                        return
+                    proxy.handle_batch(batch)
+                    self._respond(200, b"accepted")
+                elif self.path == "/spans" and trace_proxy is not None:
+                    spans = []
+                    stream = io.BytesIO(body)
+                    try:
+                        while True:
+                            span = ssf_wire.read_ssf(stream)
+                            if span is None:
+                                break
+                            spans.append(span)
+                    except ssf_wire.FramingError as e:
+                        self._respond(400, f"bad span frame: {e}".encode())
+                        return
+                    trace_proxy.handle_spans(spans)
+                    self._respond(200, b"accepted")
+                else:
+                    self._respond(404, b"not found")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever, daemon=True,
+                         name="proxy-http").start()
+        return self.port
+
+    def stop(self) -> None:
+        if self.httpd is not None:
+            self.httpd.shutdown()
 
 
 class DestinationRefresher:

@@ -75,6 +75,8 @@ class UDPSender(Sender):
             elif ":" in address:
                 host, _, p = address.rpartition(":")
                 port = int(p)
+            elif address.isdigit():  # bare port, e.g. "8125"
+                port = int(address)
             elif address:
                 host = address
             info = socket.getaddrinfo(host, port, socket.AF_UNSPEC,

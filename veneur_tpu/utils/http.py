@@ -52,3 +52,65 @@ def post_json(
         hdrs.update(headers)
     req = urllib.request.Request(url, data=body, method="POST", headers=hdrs)
     return opener(req, timeout)
+
+
+def thread_stack_dump() -> bytes:
+    """Every live thread's stack — the /debug/pprof analog for a runtime
+    without Go's pprof (reference wires net/http/pprof, http.go:52-57)."""
+    import sys
+    import traceback
+
+    frames = sys._current_frames()
+    out = []
+    for tid, frame in frames.items():
+        out.append(f"--- thread {tid} ---\n")
+        out.extend(traceback.format_stack(frame))
+    return "".join(out).encode()
+
+
+def parse_host_port(address: str, default_host: str = "127.0.0.1",
+                    what: str = "address") -> tuple[str, int]:
+    """Parse "host:port" / ":port" / "[v6]:port" with a clear config error
+    instead of a bare int() traceback."""
+    try:
+        if address.startswith("["):
+            host, _, rest = address[1:].partition("]")
+            if not rest.startswith(":"):
+                raise ValueError("missing port")
+            return host, int(rest[1:])
+        host, sep, port = address.rpartition(":")
+        if not sep:
+            raise ValueError("missing port")
+        return host or default_host, int(port)
+    except ValueError as e:
+        raise ValueError(f"invalid {what} {address!r}: {e}") from None
+
+
+class APIHandlerBase:
+    """Shared request plumbing for the small stdlib HTTP servers
+    (global /import endpoint, proxy front): quiet logs, _respond, and the
+    common GET routes (/healthcheck, /version, /debug/pprof)."""
+
+    version_string_body = "unknown"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _respond(self, code: int, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def handle_common_get(self) -> bool:
+        """Serve a common GET route; returns False if the path is not one
+        of them (caller then tries its own routes or 404s)."""
+        if self.path in ("/healthcheck", "/healthcheck/tracing"):
+            self._respond(200, b"ok\n")
+        elif self.path == "/version":
+            self._respond(200, self.version_string_body.encode())
+        elif self.path.startswith("/debug/pprof"):
+            self._respond(200, thread_stack_dump())
+        else:
+            return False
+        return True
