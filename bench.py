@@ -12,7 +12,7 @@ denominator).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Env knobs: VENEUR_BENCH_SERIES (default 16384), VENEUR_BENCH_BATCH (default
-1048576), VENEUR_BENCH_ITERS (default 20).
+4194304), VENEUR_BENCH_ITERS (default 20).
 """
 
 from __future__ import annotations
@@ -57,7 +57,7 @@ def main() -> None:
     from veneur_tpu.ops import tdigest as td
 
     series = int(os.environ.get("VENEUR_BENCH_SERIES", 16384))
-    batch = int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 20))
+    batch = int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 22))
     iters = int(os.environ.get("VENEUR_BENCH_ITERS", 20))
 
     rng = np.random.default_rng(42)
@@ -82,17 +82,27 @@ def main() -> None:
         )
         return [means, weights, dmin, dmax, drecip]
 
+    @jax.jit
+    def force(state, quant):
+        # single scalar that depends on every output buffer — fetching it
+        # (4 bytes) proves the whole chain executed without paying a bulk
+        # device→host transfer. block_until_ready alone is NOT sufficient
+        # on relayed/tunnelled device backends (observed: it returns before
+        # the dependency chain has run, inflating throughput ~1000x).
+        return (jnp.sum(state[1]) + jnp.sum(quant)
+                + jnp.sum(jnp.where(jnp.isfinite(state[0]), state[0], 0.0)))
+
     # warmup / compile
     state = ingest(state, batches[0])
     state = ingest(state, batches[1])
     quant = td.quantile(state[0], state[1], state[2], state[3], qs)
-    quant.block_until_ready()
+    float(force(state, quant))
 
     t0 = time.perf_counter()
     for i in range(iters):
         state = ingest(state, batches[i % 2])
     quant = td.quantile(state[0], state[1], state[2], state[3], qs)
-    quant.block_until_ready()
+    float(force(state, quant))
     elapsed = time.perf_counter() - t0
 
     total_samples = iters * batch

@@ -19,6 +19,7 @@ import grpc
 from veneur_tpu.distributed import codec, rpc
 from veneur_tpu.distributed.ring import ConsistentRing
 from veneur_tpu.gen import veneur_tpu_pb2 as pb
+from veneur_tpu.utils.http import parse_host_port
 from veneur_tpu.protocol import ssf_wire
 
 log = logging.getLogger("veneur_tpu.proxy")
@@ -128,12 +129,12 @@ class TraceProxy:
             except LookupError:
                 self.drops += 1
                 continue
-            host, _, port = dest.rpartition(":")
             try:
+                host, port = parse_host_port(dest, what="trace destination")
                 self._sock.sendto(ssf_wire.encode_datagram(span),
-                                  (host, int(port)))
+                                  (host, port))
                 self.proxied_spans += 1
-            except OSError as e:
+            except (OSError, ValueError) as e:
                 self.drops += 1
                 log.debug("span forward to %s failed: %s", dest, e)
 

@@ -57,21 +57,15 @@ def post_json(
 def thread_stack_dump() -> bytes:
     """Every live thread's stack — the /debug/pprof analog for a runtime
     without Go's pprof (reference wires net/http/pprof, http.go:52-57)."""
-    import sys
-    import traceback
+    from veneur_tpu.core.crash import format_all_threads
 
-    frames = sys._current_frames()
-    out = []
-    for tid, frame in frames.items():
-        out.append(f"--- thread {tid} ---\n")
-        out.extend(traceback.format_stack(frame))
-    return "".join(out).encode()
+    return format_all_threads().encode()
 
 
 def parse_host_port(address: str, default_host: str = "127.0.0.1",
                     what: str = "address") -> tuple[str, int]:
-    """Parse "host:port" / ":port" / "[v6]:port" with a clear config error
-    instead of a bare int() traceback."""
+    """Parse "host:port" / ":port" / "port" / "[v6]:port" with a clear
+    config error instead of a bare int() traceback."""
     try:
         if address.startswith("["):
             host, _, rest = address[1:].partition("]")
@@ -80,7 +74,8 @@ def parse_host_port(address: str, default_host: str = "127.0.0.1",
             return host, int(rest[1:])
         host, sep, port = address.rpartition(":")
         if not sep:
-            raise ValueError("missing port")
+            # bare port, e.g. "8127"
+            return default_host, int(address)
         return host or default_host, int(port)
     except ValueError as e:
         raise ValueError(f"invalid {what} {address!r}: {e}") from None
