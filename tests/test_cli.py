@@ -178,3 +178,93 @@ def test_load_proxy_config(tmp_path):
     cfg = load_proxy_config(str(p))
     assert cfg.consul_forward_service_name == "veneur-global"
     assert cfg.grpc_address == "127.0.0.1:8128"
+
+
+def test_tdigest_analysis_harness(tmp_path):
+    """The offline accuracy harness (tools/tdigest_analysis.py, the
+    reference tdigest/analysis analog) meets the q-space error budget."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "tdigest_analysis",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "tdigest_analysis.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    r = mod.analyze("gamma", mod.DISTRIBUTIONS["gamma"], 20_000, 100.0,
+                    str(tmp_path))
+    assert r["max_q_err"] < 0.01
+    assert (tmp_path / "gamma.csv").exists()
+
+
+def test_veneur_main_sighup_graceful_restart(tmp_path):
+    """SIGHUP drains and re-execs in place (reference einhorn-style
+    graceful restart, server.go:1401-1429) — the supervised PID survives
+    and the restarted server answers on the same ports."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    def _free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    udp_port = _free_port()
+    http_port = _free_port()
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        f"statsd_listen_addresses: [udp://127.0.0.1:{udp_port}]\n"
+        f"http_address: 127.0.0.1:{http_port}\n"
+        "http_quit: true\n"
+        "interval: 60s\n")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "veneur_tpu.cli.veneur_main",
+         "-f", str(cfg)], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/healthcheck", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.3)
+        else:
+            raise AssertionError("server never became healthy")
+        proc.send_signal(signal.SIGHUP)
+        # same PID re-execs: it must go unhealthy (drain) then healthy again
+        deadline = time.time() + 45
+        ok = False
+        saw_down = False
+        while time.time() < deadline:
+            assert proc.poll() is None, \
+                "process exited instead of re-exec'ing"
+            try:
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/healthcheck", timeout=1)
+                if saw_down and r.status == 200:
+                    ok = True
+                    break
+            except Exception:
+                saw_down = True
+            time.sleep(0.3)
+        assert ok, "restarted server never became healthy"
+        # /quitquitquit must terminate the restarted process for real
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{http_port}/quitquitquit",
+                method="POST"), timeout=5)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -1,0 +1,114 @@
+"""Offline t-digest accuracy analysis harness.
+
+Port of the reference's analysis tool (tdigest/analysis/main.go:19-60),
+which generates CSVs of estimated-vs-actual quantiles over several
+distributions so digest error profiles can be eyeballed/plotted. Here the
+digest under test is the batched TPU kernel (veneur_tpu.ops.tdigest); the
+oracle is exact order statistics of the drawn sample.
+
+Usage:
+    python tools/tdigest_analysis.py [--samples 100000]
+        [--compression 100] [--out-dir analysis_out]
+        [--distributions gamma normal ...]
+
+Writes one CSV per distribution: q, estimated, actual, abs_err, q_err
+(q_err = |CDF(estimated) - q|, the error measured in quantile space — the
+bound t-digest actually promises), plus a summary line per distribution on
+stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DISTRIBUTIONS = {
+    "uniform": lambda rng, n: rng.random(n),
+    "normal": lambda rng, n: rng.normal(50, 15, n),
+    "exponential": lambda rng, n: rng.exponential(100, n),
+    "lognormal": lambda rng, n: rng.lognormal(3, 1, n),
+    "gamma": lambda rng, n: rng.gamma(2.0, 50.0, n),
+    "bimodal": lambda rng, n: np.concatenate(
+        [rng.normal(10, 2, n // 2), rng.normal(100, 10, n - n // 2)]),
+    "heavy_tail": lambda rng, n: rng.pareto(1.5, n) + 1.0,
+}
+
+QS = np.array([0.001, 0.01, 0.05, 0.1, 0.25, 0.5, 0.75,
+               0.9, 0.95, 0.99, 0.999], np.float64)
+
+
+def analyze(name: str, draw, n: int, compression: float, out_dir: str,
+            seed: int = 42) -> dict:
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import tdigest as td
+
+    rng = np.random.default_rng(seed)
+    samples = draw(rng, n).astype(np.float32)
+
+    capacity = td.capacity_for(compression)
+    pool = td.init_pool(1, capacity)
+    rows = jnp.zeros(n, jnp.int32)
+    means, weights, dmin, dmax, drecip, _ = td.add_batch(
+        pool.means, pool.weights, pool.min, pool.max, pool.recip,
+        rows, jnp.asarray(samples), jnp.ones(n, jnp.float32),
+        compression=compression)
+
+    est = np.asarray(td.quantile(
+        means, weights, dmin, dmax, jnp.asarray(QS.astype(np.float32))))[0]
+    actual = np.quantile(samples.astype(np.float64), QS)
+    sorted_samples = np.sort(samples)
+    # CDF of the estimate within the true sample — error in q space
+    est_rank = np.searchsorted(sorted_samples, est) / n
+    q_err = np.abs(est_rank - QS)
+    abs_err = np.abs(est - actual)
+
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"{name}.csv")
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["q", "estimated", "actual", "abs_err", "q_err"])
+        for i, q in enumerate(QS):
+            w.writerow([q, est[i], actual[i], abs_err[i], q_err[i]])
+
+    centroid_count = int(np.sum(np.asarray(weights)[0] > 0))
+    return {
+        "name": name,
+        "max_q_err": float(q_err.max()),
+        "mean_q_err": float(q_err.mean()),
+        "centroids": centroid_count,
+        "csv": path,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--samples", type=int, default=100_000)
+    p.add_argument("--compression", type=float, default=100.0)
+    p.add_argument("--out-dir", default="analysis_out")
+    p.add_argument("--distributions", nargs="*",
+                   default=sorted(DISTRIBUTIONS))
+    args = p.parse_args(argv)
+
+    worst = 0.0
+    for name in args.distributions:
+        r = analyze(name, DISTRIBUTIONS[name], args.samples,
+                    args.compression, args.out_dir)
+        worst = max(worst, r["max_q_err"])
+        print(f"{r['name']:>12}: max q-err {r['max_q_err']:.5f}  "
+              f"mean {r['mean_q_err']:.5f}  centroids {r['centroids']}  "
+              f"-> {r['csv']}")
+    # t-digest promises q-space error shrinking as q(1-q)/δ; 1% at the
+    # median for δ=100 is the practical budget (BASELINE.md north star)
+    print(f"worst-case q-err across distributions: {worst:.5f}")
+    return 0 if worst < 0.01 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,17 +53,37 @@ def main(argv=None) -> int:
         server.version, server.is_local, ports)
 
     stop = threading.Event()
+    restart = threading.Event()
 
     def _handle(signum, frame):
         stop.set()
 
+    def _handle_restart(signum, frame):
+        # Graceful restart (reference einhorn handoff + SIGHUP/SIGUSR2,
+        # server.go:1401-1429): drain (final flush), then re-exec in place
+        # so the supervised PID survives. Aggregation state loss is bounded
+        # by one interval, the reference's own restart-gap contract
+        # (README.md:133-141).
+        restart.set()
+        stop.set()
+
     signal.signal(signal.SIGTERM, _handle)
     signal.signal(signal.SIGINT, _handle)
+    signal.signal(signal.SIGHUP, _handle_restart)
+    signal.signal(signal.SIGUSR2, _handle_restart)
     # wake on signal OR server-initiated shutdown (POST /quitquitquit sets
     # server._shutdown; the process must exit too, reference http.go:37-44)
     while not stop.is_set() and not server._shutdown.is_set():
         stop.wait(0.5)
     server.shutdown()
+    if restart.is_set():
+        import os
+
+        logging.getLogger("veneur_tpu").info(
+            "graceful restart: drained, re-executing")
+        os.execv(sys.executable, [sys.executable, "-m",
+                                  "veneur_tpu.cli.veneur_main",
+                                  *(argv or sys.argv[1:])])
     return 0
 
 

@@ -36,15 +36,21 @@ REPORT_TIMEOUT_S = 3.0
 
 
 def format_all_threads() -> str:
-    """Stack dump of every live thread (the full-goroutine-traceback
-    analog from the reference's panic handler)."""
-    frames = sys._current_frames()
+    """Stack dump of every live interpreter thread (the
+    full-goroutine-traceback analog from the reference's panic handler).
+
+    Iterates sys._current_frames() rather than threading.enumerate() so
+    threads created outside the threading module (C-extension pools, e.g.
+    grpc executors) are included; names come from the threading map when
+    known."""
+    by_ident = {t.ident: t for t in threading.enumerate()}
     chunks = []
-    for t in threading.enumerate():
-        frame = frames.get(t.ident)
-        header = f"--- thread {t.name} (daemon={t.daemon})"
-        body = "".join(traceback.format_stack(frame)) if frame else "  <gone>\n"
-        chunks.append(header + "\n" + body)
+    for ident, frame in sys._current_frames().items():
+        t = by_ident.get(ident)
+        label = (f"{t.name} (daemon={t.daemon})" if t is not None
+                 else f"tid {ident} (unregistered)")
+        chunks.append(f"--- thread {label}\n"
+                      + "".join(traceback.format_stack(frame)))
     return "\n".join(chunks)
 
 
