@@ -1,7 +1,7 @@
 """Benchmark: batched sketch-aggregation throughput on one chip.
 
-Workload: the DogStatsD timer-replay configuration (BASELINE.md) — S
-histogram series, every interval each series receives a stream of timer
+Default workload: the DogStatsD timer-replay configuration (BASELINE.md) —
+S histogram series, every interval each series receives a stream of timer
 samples; the chip folds fixed-size batches into the t-digest pool (sort +
 arcsine-bucket compress over all series at once) and extracts the percentile
 set at flush. The reported metric is raw-sample throughput through the
@@ -10,6 +10,14 @@ aggregation kernel, the analog of the reference's ingest packets/sec
 denominator).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+VENEUR_BENCH_WORKLOAD selects among the BASELINE.json configs:
+  timer_replay (default) — t-digest-only ingest throughput
+  mixed         — counters + HLL sets + histos over 100k series
+  global_merge  — 8 local pools -> 1 global cross-host t-digest merge
+  ssf_histo     — SSF spans -> derived latency histograms end to end
+  prometheus_1m — 1M-series flush: one giant ingest + full percentile
+                  extraction; reports p99-style flush latency
 
 Env knobs: VENEUR_BENCH_SERIES (default 16384), VENEUR_BENCH_BATCH (default
 4194304), VENEUR_BENCH_ITERS (default 20).
@@ -50,7 +58,7 @@ def _ensure_live_backend() -> None:
               env)
 
 
-def main() -> None:
+def timer_replay() -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -108,12 +116,269 @@ def main() -> None:
     total_samples = iters * batch
     rate = total_samples / elapsed
     baseline = 60000.0  # reference production ingest packets/sec
-    print(json.dumps({
+    return {
         "metric": "histo_samples_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": "samples/s",
         "vs_baseline": round(rate / baseline, 2),
-    }))
+    }
+
+
+def mixed() -> dict:
+    """BASELINE config 2: counters + Set(HLL) + histos, 100k series."""
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import hll, scalars, tdigest as td
+    from veneur_tpu.utils.hashing import fnv1a_64
+
+    series = int(os.environ.get("VENEUR_BENCH_SERIES", 100_000))
+    batch = int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 22))
+    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 10))
+    s_counter, s_set = series // 2, series // 4
+    s_histo = series - s_counter - s_set
+
+    rng = np.random.default_rng(1)
+    n_c, n_s = batch // 2, batch // 4
+    n_h = batch - n_c - n_s
+    c_rows = jnp.asarray(rng.integers(0, s_counter, n_c).astype(np.int32))
+    c_vals = jnp.asarray(rng.poisson(3, n_c).astype(np.float32))
+    # set inserts arrive as pre-hashed 64-bit member hashes (strings are
+    # hashed host-side, as in the reference's hll.Insert)
+    set_rows = jnp.asarray(rng.integers(0, s_set, n_s).astype(np.int32))
+    set_hash = rng.integers(0, 1 << 63, n_s, dtype=np.uint64)
+    reg_idx_np, rank_np = hll.split_hashes(set_hash)
+    set_reg = jnp.asarray(reg_idx_np)
+    set_rank = jnp.asarray(rank_np)
+    h_rows = jnp.asarray(rng.integers(0, s_histo, n_h).astype(np.int32))
+    h_vals = jnp.asarray(rng.gamma(2.0, 50.0, n_h).astype(np.float32))
+    ones_h = jnp.ones(n_h, np.float32)
+
+    counters = jnp.zeros(s_counter, jnp.float32)
+    regs = hll.init_pool(s_set)
+    pool = td.init_pool(s_histo, td.DEFAULT_CAPACITY)
+    state = (counters, regs,
+             (pool.means, pool.weights, pool.min, pool.max, pool.recip))
+
+    @jax.jit
+    def step(state):
+        counters, regs, hstate = state
+        counters = counters + scalars.segment_counter_sum(
+            c_rows, c_vals, s_counter)
+        regs = hll.insert_batch(regs, set_rows, set_reg, set_rank)
+        m, w, a, b, r, _ = td.add_batch(*hstate, h_rows, h_vals, ones_h)
+        return (counters, regs, (m, w, a, b, r))
+
+    @jax.jit
+    def force(state):
+        return (jnp.sum(state[0]) + jnp.sum(state[1].astype(jnp.int32))
+                + jnp.sum(state[2][1]))
+
+    state = step(state)
+    float(force(state))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = step(state)
+    float(force(state))
+    elapsed = time.perf_counter() - t0
+    rate = iters * batch / elapsed
+    return {
+        "metric": "mixed_samples_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(rate / 60000.0, 2),
+    }
+
+
+def global_merge() -> dict:
+    """BASELINE config 3: 8 local digests per series merged into one
+    global digest — the importsrv cross-host merge as a batched kernel
+    (replaces reference worker.go:438-495 per-series loops)."""
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import tdigest as td
+
+    series = int(os.environ.get("VENEUR_BENCH_SERIES", 65536))
+    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 10))
+    fill = min(int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 20)), 1 << 20)
+    hosts = 8
+    rng = np.random.default_rng(2)
+
+    pools = []
+    for h in range(hosts):
+        pool = td.init_pool(series, td.DEFAULT_CAPACITY)
+        rows = jnp.asarray(
+            rng.integers(0, series, fill).astype(np.int32))
+        vals = jnp.asarray(
+            rng.gamma(2.0, 50.0 * (h + 1), fill).astype(np.float32))
+        m, w, a, b, r, _ = td.add_batch(
+            pool.means, pool.weights, pool.min, pool.max, pool.recip,
+            rows, vals, jnp.ones(fill, np.float32))
+        pools.append(td.TDigestPool(m, w, a, b, r))
+    stacked = td.TDigestPool(*[
+        jnp.stack([getattr(p, f) for p in pools]) for f in pools[0]._fields])
+
+    @jax.jit
+    def step(stacked, bump):
+        # perturb means so no result can be cached between iterations
+        st = stacked._replace(means=stacked.means + bump)
+        merged = td.merge_many(st)
+        return jnp.sum(merged.weights) + jnp.sum(
+            jnp.where(jnp.isfinite(merged.means), merged.means, 0.0))
+
+    float(step(stacked, 0.0))
+    t0 = time.perf_counter()
+    acc = 0.0
+    for i in range(iters):
+        acc += float(step(stacked, 1e-6 * (i + 1)))
+    elapsed = time.perf_counter() - t0
+    rate = iters * series * hosts / elapsed
+    # budget: a global veneur must merge all hosts' digests for every
+    # series within the reference's 10s flush interval
+    needed = series * hosts / 10.0
+    return {
+        "metric": "global_merge_series_digests_per_sec",
+        "value": round(rate, 1),
+        "unit": "digest-merges/s",
+        "vs_baseline": round(rate / needed, 2),
+    }
+
+
+def ssf_histo() -> dict:
+    """BASELINE config 4: SSF spans -> derived indicator/objective latency
+    histograms, host conversion + device ingest end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu import ssf
+    from veneur_tpu.core.spans import convert_indicator_metrics
+    from veneur_tpu.ops import tdigest as td
+
+    n_spans = int(os.environ.get("VENEUR_BENCH_BATCH", 50_000))
+    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 5))
+    rng = np.random.default_rng(3)
+    services = [f"svc{i}" for i in range(64)]
+    base = int(time.time() * 1e9)
+    spans = []
+    for i in range(n_spans):
+        start = base + i
+        spans.append(ssf.SSFSpan(
+            trace_id=i + 1, id=i + 1, start_timestamp=start,
+            end_timestamp=start + int(rng.gamma(2.0, 5e6)),
+            service=services[i % len(services)], name="op",
+            indicator=True))
+
+    directory: dict = {}
+    rows_buf = np.empty(4 * n_spans, np.int32)
+    vals_buf = np.empty(4 * n_spans, np.float32)
+
+    def convert_all():
+        n = 0
+        for span in spans:
+            for m in convert_indicator_metrics(
+                    span, "indicator", "objective"):
+                key = (m.name, m.joined_tags)
+                row = directory.setdefault(key, len(directory))
+                rows_buf[n] = row
+                vals_buf[n] = m.value
+                n += 1
+        return n
+
+    pool = td.init_pool(1024, td.DEFAULT_CAPACITY)
+    state = (pool.means, pool.weights, pool.min, pool.max, pool.recip)
+
+    @jax.jit
+    def ingest(state, rows, vals, w):
+        m, wg, a, b, r, _ = td.add_batch(*state, rows, vals, w)
+        return (m, wg, a, b, r)
+
+    n = convert_all()
+    state = ingest(state, jnp.asarray(rows_buf[:n]),
+                   jnp.asarray(vals_buf[:n]), jnp.ones(n, np.float32))
+    float(jnp.sum(state[1]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        n = convert_all()
+        state = ingest(state, jnp.asarray(rows_buf[:n]),
+                       jnp.asarray(vals_buf[:n]), jnp.ones(n, np.float32))
+    float(jnp.sum(state[1]))
+    elapsed = time.perf_counter() - t0
+    rate = iters * n_spans / elapsed
+    # spans arrive as ingest packets, so the reference's >60k packets/sec
+    # production claim is the comparable denominator
+    return {
+        "metric": "ssf_spans_to_histo_per_sec",
+        "value": round(rate, 1),
+        "unit": "spans/s",
+        "vs_baseline": round(rate / 60000.0, 2),
+    }
+
+
+def prometheus_1m() -> dict:
+    """BASELINE config 5 + the north-star latency metric: one flush over
+    1M unique histogram series — giant ingest + full percentile
+    extraction; reports the flush latency (budget: the 10s interval)."""
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import tdigest as td
+
+    series = int(os.environ.get("VENEUR_BENCH_SERIES", 1 << 20))
+    batch = int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 22))
+    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 5))
+    rng = np.random.default_rng(4)
+    pool = td.init_pool(series, td.DEFAULT_CAPACITY)
+    state = (pool.means, pool.weights, pool.min, pool.max, pool.recip)
+    rows = jnp.asarray(np.arange(batch, dtype=np.int32) % series)
+    vals = jnp.asarray(rng.gamma(2.0, 50.0, batch).astype(np.float32))
+    ones = jnp.ones(batch, np.float32)
+    qs = jnp.asarray(np.array([0.5, 0.9, 0.99], np.float32))
+
+    @jax.jit
+    def flush_pass(state, bump):
+        m, w, a, b, r, _ = td.add_batch(
+            state[0], state[1], state[2], state[3], state[4],
+            rows, vals + bump, ones)
+        quant = td.quantile(m, w, a, b, qs)
+        return (m, w, a, b, r), jnp.sum(jnp.where(
+            jnp.isnan(quant), 0.0, quant))
+
+    state, s = flush_pass(state, 0.0)
+    float(s)
+    lat = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        state, s = flush_pass(state, 1e-6 * (i + 1))
+        float(s)
+        lat.append(time.perf_counter() - t0)
+    worst = max(lat)
+    return {
+        "metric": "flush_latency_s_1m_series",
+        "value": round(worst, 4),
+        "unit": "s",
+        # budget = the reference's 10s default flush interval; >1 means
+        # the 1M-series flush fits in the interval with headroom
+        "vs_baseline": round(10.0 / worst, 2),
+    }
+
+
+WORKLOADS = {
+    "timer_replay": timer_replay,
+    "mixed": mixed,
+    "global_merge": global_merge,
+    "ssf_histo": ssf_histo,
+    "prometheus_1m": prometheus_1m,
+}
+
+
+def main() -> None:
+    name = os.environ.get("VENEUR_BENCH_WORKLOAD", "timer_replay")
+    workload = WORKLOADS.get(name)
+    if workload is None:
+        sys.exit(f"unknown VENEUR_BENCH_WORKLOAD {name!r}; "
+                 f"valid: {', '.join(sorted(WORKLOADS))}")
+    print(json.dumps(workload()))
 
 
 if __name__ == "__main__":

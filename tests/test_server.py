@@ -303,3 +303,24 @@ def test_ssf_unixgram(tmp_path):
         assert _wait_for(lambda: srv.ssf_spans_received.get("svc", 0) >= 1)
     finally:
         srv.shutdown()
+
+
+def test_enable_profiling_writes_xla_trace(tmp_path):
+    """enable_profiling starts a JAX profiler trace on start and flushes
+    it on shutdown (reference profile.Start(), server.go:1392-1399)."""
+    from veneur_tpu.core.config import load_config
+    from veneur_tpu.core.factory import build_server
+
+    prof = tmp_path / "prof"
+    cfg = load_config(data={
+        "statsd_listen_addresses": [],
+        "interval": "60s",
+        "enable_profiling": True,
+        "profile_dir": str(prof),
+    })
+    srv = build_server(cfg)
+    srv.start()
+    srv.flush()
+    srv.shutdown()
+    files = list(prof.rglob("*"))
+    assert any(f.is_file() for f in files), "no profiler artifacts written"

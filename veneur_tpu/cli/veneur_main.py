@@ -75,6 +75,15 @@ def main(argv=None) -> int:
     # server._shutdown; the process must exit too, reference http.go:37-44)
     while not stop.is_set() and not server._shutdown.is_set():
         stop.wait(0.5)
+    if restart.is_set():
+        # final best-effort flush so the partial interval survives the
+        # restart (the reference accepts losing it, README.md:133-141;
+        # draining is strictly better and cheap here)
+        try:
+            server.flush()
+        except Exception:
+            logging.getLogger("veneur_tpu").exception(
+                "final flush before restart failed")
     server.shutdown()
     if restart.is_set():
         import os

@@ -123,6 +123,9 @@ class Config:
     debug_flushed_metrics: bool = False
     debug_ingested_spans: bool = False
     enable_profiling: bool = False
+    # where the XLA/JAX profiler trace is written when enable_profiling
+    # (TPU-native analog of the reference's pprof profile.Start())
+    profile_dir: str = ""
     block_profile_rate: int = 0
     mutex_profile_fraction: int = 0
     sentry_dsn: str = ""

@@ -131,6 +131,8 @@ class Server:
         self._sockets: list[socket.socket] = []
         self._socket_locks: list[int] = []
         self._shutdown = threading.Event()
+        self._shutdown_once_lock = threading.Lock()
+        self._shutdown_done = False
         self.last_flush_unix = time.time()
         self.flush_count = 0
 
@@ -500,6 +502,21 @@ class Server:
     def start(self) -> dict[str, int]:
         """Start listeners, sinks and the flush ticker
         (reference Server.Start, server.go:826)."""
+        if self.config.enable_profiling:
+            # XLA-native analog of the reference's profile.Start()
+            # (server.go:1392-1399): a JAX profiler trace capturing both
+            # host Python and device (TPU) activity, viewable in
+            # TensorBoard / Perfetto.
+            try:
+                import jax.profiler
+
+                self._profile_dir = (self.config.profile_dir
+                                     or "veneur-tpu-profile")
+                jax.profiler.start_trace(self._profile_dir)
+                log.info("XLA profiling enabled -> %s", self._profile_dir)
+            except Exception:
+                log.exception("could not start the JAX profiler")
+                self._profile_dir = None
         for sink in self.metric_sinks + self.span_sinks:
             sink.start()
         self.span_worker.start()
@@ -667,8 +684,22 @@ class Server:
     # -- lifecycle ----------------------------------------------------------
 
     def shutdown(self) -> None:
-        """reference Server.Shutdown (server.go:1473)."""
+        """reference Server.Shutdown (server.go:1473). Idempotent — the
+        /quitquitquit handler thread and the main loop may both call it."""
         self._shutdown.set()
+        with self._shutdown_once_lock:
+            if self._shutdown_done:
+                return
+            self._shutdown_done = True
+        if getattr(self, "_profile_dir", None):
+            try:
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+                log.info("XLA profile written to %s", self._profile_dir)
+            except Exception:
+                log.exception("could not stop the JAX profiler")
+            self._profile_dir = None
         self.stats.close()
         self.span_worker.stop()
         if self.import_server is not None:

@@ -16,6 +16,8 @@ shards ride the same program as the sketches.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -53,10 +55,10 @@ def apply_gauges(
 # Device-side segment reductions (used by the fused mesh/flush programs)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("num_rows",))
 def segment_counter_sum(
-    rows: jax.Array, contributions: jax.Array, num_rows: jax.Array
-) -> jax.Array:  # pragma: no cover - thin wrapper
+    rows: jax.Array, contributions: jax.Array, num_rows: int
+) -> jax.Array:
     return jax.ops.segment_sum(contributions, rows, num_segments=num_rows)
 
 
