@@ -247,12 +247,12 @@ def global_merge() -> dict:
 
 def ssf_histo() -> dict:
     """BASELINE config 4: SSF spans -> derived indicator/objective latency
-    histograms, host conversion + device ingest end to end."""
+    histograms — wire decode + extraction (native C++ when available) +
+    device ingest, end to end."""
     import jax
     import jax.numpy as jnp
 
-    from veneur_tpu import ssf
-    from veneur_tpu.core.spans import convert_indicator_metrics
+    from veneur_tpu.gen import ssf_pb2
     from veneur_tpu.ops import tdigest as td
 
     n_spans = int(os.environ.get("VENEUR_BENCH_BATCH", 50_000))
@@ -260,30 +260,24 @@ def ssf_histo() -> dict:
     rng = np.random.default_rng(3)
     services = [f"svc{i}" for i in range(64)]
     base = int(time.time() * 1e9)
-    spans = []
+    payloads = []
     for i in range(n_spans):
-        start = base + i
-        spans.append(ssf.SSFSpan(
-            trace_id=i + 1, id=i + 1, start_timestamp=start,
-            end_timestamp=start + int(rng.gamma(2.0, 5e6)),
-            service=services[i % len(services)], name="op",
-            indicator=True))
+        pb = ssf_pb2.SSFSpan()
+        pb.trace_id = i + 1
+        pb.id = i + 1
+        pb.start_timestamp = base + i
+        pb.end_timestamp = base + i + int(rng.gamma(2.0, 5e6))
+        pb.service = services[i % len(services)]
+        pb.name = "op"
+        pb.indicator = True
+        payloads.append(pb.SerializeToString())
 
-    directory: dict = {}
-    rows_buf = np.empty(4 * n_spans, np.int32)
-    vals_buf = np.empty(4 * n_spans, np.float32)
+    try:
+        from veneur_tpu.native import NativeIngest
 
-    def convert_all():
-        n = 0
-        for span in spans:
-            for m in convert_indicator_metrics(
-                    span, "indicator", "objective"):
-                key = (m.name, m.joined_tags)
-                row = directory.setdefault(key, len(directory))
-                rows_buf[n] = row
-                vals_buf[n] = m.value
-                n += 1
-        return n
+        ni = NativeIngest()
+    except Exception:
+        ni = None
 
     pool = td.init_pool(1024, td.DEFAULT_CAPACITY)
     state = (pool.means, pool.weights, pool.min, pool.max, pool.recip)
@@ -293,15 +287,38 @@ def ssf_histo() -> dict:
         m, wg, a, b, r, _ = td.add_batch(*state, rows, vals, w)
         return (m, wg, a, b, r)
 
-    n = convert_all()
-    state = ingest(state, jnp.asarray(rows_buf[:n]),
-                   jnp.asarray(vals_buf[:n]), jnp.ones(n, np.float32))
+    def convert_all():
+        if ni is not None:
+            for p in payloads:
+                ni.ingest_ssf(p, b"indicator", b"objective")
+            rows, vals, wts = ni.drain_histo(4 * n_spans)
+            ni.drain_new_series()
+            return rows, vals, wts
+        from veneur_tpu.core.spans import convert_indicator_metrics
+        from veneur_tpu.protocol.ssf_wire import parse_ssf
+
+        directory: dict = {}
+        rows, vals = [], []
+        for p in payloads:
+            span = parse_ssf(p)
+            for m in convert_indicator_metrics(span, "indicator",
+                                               "objective"):
+                key = (m.name, m.joined_tags)
+                rows.append(directory.setdefault(key, len(directory)))
+                vals.append(m.value)
+        n = len(rows)
+        return (np.asarray(rows, np.int32), np.asarray(vals, np.float32),
+                np.ones(n, np.float32))
+
+    rows, vals, wts = convert_all()
+    state = ingest(state, jnp.asarray(rows), jnp.asarray(vals),
+                   jnp.asarray(wts))
     float(jnp.sum(state[1]))
     t0 = time.perf_counter()
     for _ in range(iters):
-        n = convert_all()
-        state = ingest(state, jnp.asarray(rows_buf[:n]),
-                       jnp.asarray(vals_buf[:n]), jnp.ones(n, np.float32))
+        rows, vals, wts = convert_all()
+        state = ingest(state, jnp.asarray(rows), jnp.asarray(vals),
+                       jnp.asarray(wts))
     float(jnp.sum(state[1]))
     elapsed = time.perf_counter() - t0
     rate = iters * n_spans / elapsed

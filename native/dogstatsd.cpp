@@ -22,6 +22,7 @@
 #include <cstring>
 #include <string>
 #include <string_view>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -213,11 +214,23 @@ struct Ctx {
   long long processed = 0;
   long long errors = 0;
 
+  // SSF span ingest stats (native span→metric fast path). Service names
+  // come from untrusted payloads — keyed by hash map so per-span cost
+  // stays O(1) under high service cardinality.
+  long long ssf_spans = 0;
+  long long ssf_invalid = 0;
+  std::unordered_map<std::string, long long> ssf_services;
+  std::string ssf_services_out;  // drained lines awaiting pickup
+
   // scratch reused across lines
   std::vector<std::string_view> tags;
   std::string joined;
   std::string key;
 };
+
+bool route_metric(Ctx* ctx, std::string_view name, MetricKind kind,
+                  double value, std::string_view set_value,
+                  double sample_rate, int scope);
 
 // Parse one metric line; returns false on parse error.
 bool handle_line(Ctx* ctx, std::string_view line) {
@@ -309,6 +322,15 @@ bool handle_line(Ctx* ctx, std::string_view line) {
     pos = next;
   }
 
+  return route_metric(ctx, name, kind, value, set_value, sample_rate, scope);
+}
+
+// Route one parsed/converted sample into the pools. Expects ctx->joined to
+// hold the sorted, magic-stripped tag string. Shared by the DogStatsD text
+// parser above and the SSF span extraction below.
+bool route_metric(Ctx* ctx, std::string_view name, MetricKind kind,
+                  double value, std::string_view set_value,
+                  double sample_rate, int scope) {
   const char* type_str = kind_type_string(kind);
   ScopeClass cls = classify(kind, scope);
 
@@ -396,6 +418,354 @@ bool handle_line(Ctx* ctx, std::string_view line) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// SSF span ingest: protobuf wire decode + span→metric extraction.
+//
+// Replaces the Python path (protocol/ssf_wire.parse_ssf +
+// core/spans.MetricExtractionSink) for the hot case — spans carrying
+// counter/gauge/histogram/set samples and indicator timers (reference
+// sinks/ssfmetrics/metrics.go:66-141, samplers/parser.go:103-208). The
+// decoder is a minimal hand-rolled proto3 reader over proto/ssf.proto
+// (field numbers follow the public SSF spec, ssf/sample.proto), reading
+// string fields as zero-copy views into the datagram. STATUS samples are
+// control-plane traffic; spans carrying them return -1 so the caller can
+// take the Python path.
+
+struct TagPair {
+  std::string_view k, v;
+};
+
+struct SampleView {
+  int metric = 0;  // SSFSample.Metric enum
+  std::string_view name;
+  float value = 0;
+  std::string_view message;
+  int status = 0;
+  float sample_rate = 1.0f;
+  int scope = 0;  // SSFSample.Scope enum
+  std::vector<TagPair> tags;
+};
+
+struct SpanView {
+  int64_t trace_id = 0, id = 0, parent_id = 0;
+  int64_t start_ts = 0, end_ts = 0;
+  bool error = false, indicator = false;
+  std::string_view service, name;
+  std::vector<TagPair> tags;
+  std::vector<SampleView> samples;
+  bool has_status = false;
+};
+
+struct ProtoReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  std::string_view bytes() {
+    uint64_t n = varint();
+    if (!ok || n > static_cast<uint64_t>(end - p)) {
+      ok = false;
+      return {};
+    }
+    std::string_view s(reinterpret_cast<const char*>(p),
+                       static_cast<size_t>(n));
+    p += n;
+    return s;
+  }
+
+  float fixed32f() {
+    if (end - p < 4) {
+      ok = false;
+      return 0;
+    }
+    float f;
+    std::memcpy(&f, p, 4);
+    p += 4;
+    return f;
+  }
+
+  void skip(int wire_type) {
+    switch (wire_type) {
+      case 0: varint(); break;
+      case 1: p += 8; if (p > end) ok = false; break;
+      case 2: bytes(); break;
+      case 5: p += 4; if (p > end) ok = false; break;
+      default: ok = false;
+    }
+  }
+};
+
+// A known field whose declared wire type doesn't match the schema is a
+// corrupt/incompatible packet: reject it (the Python protobuf parser
+// raises; silently consuming with the wrong reader would desync the
+// stream and ingest garbage into the series directory).
+#define VN_EXPECT_WT(want) \
+  if (wt != (want)) return false
+
+// map<string,string> entry: {1: key, 2: value}
+bool decode_tag_entry(std::string_view buf, TagPair* out) {
+  ProtoReader r{reinterpret_cast<const uint8_t*>(buf.data()),
+                reinterpret_cast<const uint8_t*>(buf.data() + buf.size())};
+  while (r.ok && r.p < r.end) {
+    uint64_t tag = r.varint();
+    if (!r.ok) return false;
+    int field = static_cast<int>(tag >> 3), wt = static_cast<int>(tag & 7);
+    if (field == 1) {
+      VN_EXPECT_WT(2);
+      out->k = r.bytes();
+    } else if (field == 2) {
+      VN_EXPECT_WT(2);
+      out->v = r.bytes();
+    } else {
+      r.skip(wt);
+    }
+  }
+  return r.ok;
+}
+
+bool decode_sample(std::string_view buf, SampleView* s) {
+  ProtoReader r{reinterpret_cast<const uint8_t*>(buf.data()),
+                reinterpret_cast<const uint8_t*>(buf.data() + buf.size())};
+  while (r.ok && r.p < r.end) {
+    uint64_t tag = r.varint();
+    if (!r.ok) return false;
+    int field = static_cast<int>(tag >> 3), wt = static_cast<int>(tag & 7);
+    switch (field) {
+      case 1: VN_EXPECT_WT(0); s->metric = static_cast<int>(r.varint());
+        break;
+      case 2: VN_EXPECT_WT(2); s->name = r.bytes(); break;
+      case 3: VN_EXPECT_WT(5); s->value = r.fixed32f(); break;
+      case 5: VN_EXPECT_WT(2); s->message = r.bytes(); break;
+      case 6: VN_EXPECT_WT(0); s->status = static_cast<int>(r.varint());
+        break;
+      case 7: VN_EXPECT_WT(5); s->sample_rate = r.fixed32f(); break;
+      case 8: {
+        VN_EXPECT_WT(2);
+        TagPair t;
+        if (!decode_tag_entry(r.bytes(), &t)) return false;
+        s->tags.push_back(t);
+        break;
+      }
+      case 10: VN_EXPECT_WT(0); s->scope = static_cast<int>(r.varint());
+        break;
+      default: r.skip(wt);
+    }
+  }
+  if (s->sample_rate == 0) s->sample_rate = 1.0f;  // wire normalization
+  return r.ok;
+}
+
+bool decode_span(std::string_view buf, SpanView* sp) {
+  ProtoReader r{reinterpret_cast<const uint8_t*>(buf.data()),
+                reinterpret_cast<const uint8_t*>(buf.data() + buf.size())};
+  while (r.ok && r.p < r.end) {
+    uint64_t tag = r.varint();
+    if (!r.ok) return false;
+    int field = static_cast<int>(tag >> 3), wt = static_cast<int>(tag & 7);
+    switch (field) {
+      case 2: VN_EXPECT_WT(0);
+        sp->trace_id = static_cast<int64_t>(r.varint());
+        break;
+      case 3: VN_EXPECT_WT(0); sp->id = static_cast<int64_t>(r.varint());
+        break;
+      case 4: VN_EXPECT_WT(0);
+        sp->parent_id = static_cast<int64_t>(r.varint());
+        break;
+      case 5: VN_EXPECT_WT(0);
+        sp->start_ts = static_cast<int64_t>(r.varint());
+        break;
+      case 6: VN_EXPECT_WT(0);
+        sp->end_ts = static_cast<int64_t>(r.varint());
+        break;
+      case 7: VN_EXPECT_WT(0); sp->error = r.varint() != 0; break;
+      case 8: VN_EXPECT_WT(2); sp->service = r.bytes(); break;
+      case 10: {
+        VN_EXPECT_WT(2);
+        SampleView s;
+        if (!decode_sample(r.bytes(), &s)) return false;
+        if (s.metric == 4) sp->has_status = true;
+        sp->samples.push_back(std::move(s));
+        break;
+      }
+      case 11: {
+        VN_EXPECT_WT(2);
+        TagPair t;
+        if (!decode_tag_entry(r.bytes(), &t)) return false;
+        sp->tags.push_back(t);
+        break;
+      }
+      case 12: VN_EXPECT_WT(0); sp->indicator = r.varint() != 0; break;
+      case 13: VN_EXPECT_WT(2); sp->name = r.bytes(); break;
+      default: r.skip(wt);
+    }
+  }
+  if (!r.ok) return false;
+  // wire normalization: empty span name falls back to the "name" tag
+  if (sp->name.empty()) {
+    for (size_t i = 0; i < sp->tags.size(); ++i) {
+      if (sp->tags[i].k == "name") {
+        sp->name = sp->tags[i].v;
+        sp->tags.erase(sp->tags.begin() + i);
+        break;
+      }
+    }
+  }
+  return true;
+}
+
+// "k1:v1" < "k2:v2" without materializing the joined strings. Bytes
+// compare UNSIGNED — matching Python's code-point sort and
+// std::string_view's char_traits compare — or non-ASCII tags would order
+// differently per ingest path and split one series into two digests.
+bool tagpair_less(const TagPair& a, const TagPair& b) {
+  size_t na = a.k.size() + 1 + a.v.size();
+  size_t nb = b.k.size() + 1 + b.v.size();
+  size_t n = na < nb ? na : nb;
+  for (size_t i = 0; i < n; ++i) {
+    unsigned char ca = static_cast<unsigned char>(
+        i < a.k.size() ? a.k[i]
+        : (i == a.k.size() ? ':' : a.v[i - a.k.size() - 1]));
+    unsigned char cb = static_cast<unsigned char>(
+        i < b.k.size() ? b.k[i]
+        : (i == b.k.size() ? ':' : b.v[i - b.k.size() - 1]));
+    if (ca != cb) return ca < cb;
+  }
+  return na < nb;
+}
+
+// Build ctx->joined from tag pairs, consuming magic scope keys (exact-key
+// match in wire order — parse_metric_ssf semantics, parser.go:276-287).
+void build_joined(Ctx* ctx, std::vector<TagPair>& pairs, int* scope) {
+  for (size_t i = 0; i < pairs.size();) {
+    if (pairs[i].k == "veneurlocalonly") {
+      *scope = 1;
+      pairs.erase(pairs.begin() + i);
+    } else if (pairs[i].k == "veneurglobalonly") {
+      *scope = 2;
+      pairs.erase(pairs.begin() + i);
+    } else {
+      ++i;
+    }
+  }
+  std::sort(pairs.begin(), pairs.end(), tagpair_less);
+  ctx->joined.clear();
+  for (size_t i = 0; i < pairs.size(); ++i) {
+    if (i) ctx->joined.push_back(',');
+    ctx->joined.append(pairs[i].k);
+    ctx->joined.push_back(':');
+    ctx->joined.append(pairs[i].v);
+  }
+}
+
+bool ingest_sample(Ctx* ctx, SampleView& s) {
+  if (s.name.empty()) return false;
+  MetricKind kind;
+  std::string_view set_value;
+  double value = 0;
+  switch (s.metric) {
+    case 0: kind = KIND_COUNTER; value = s.value; break;
+    case 1: kind = KIND_GAUGE; value = s.value; break;
+    case 2: kind = KIND_HISTOGRAM; value = s.value; break;
+    case 3: kind = KIND_SET; set_value = s.message; break;
+    default: return false;  // STATUS handled by the Python path
+  }
+  int scope = 0;
+  if (s.scope == 1) scope = 1;
+  else if (s.scope == 2) scope = 2;
+  build_joined(ctx, s.tags, &scope);
+  return route_metric(ctx, s.name, kind, value, set_value,
+                      s.sample_rate, scope);
+}
+
+// xorshift64* for uniqueness sampling — statistical, parity not required
+// (the Python path uses random.random(), ssf/samples.go RandomlySample)
+inline double uniform01(uint64_t* state) {
+  uint64_t x = *state;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  *state = x;
+  return static_cast<double>((x * 0x2545F4914F6CDD1Dull) >> 11) /
+         static_cast<double>(1ull << 53);
+}
+
+uint64_t g_uniq_rng = 0x9E3779B97F4A7C15ull;
+
+void bump_service_count(Ctx* ctx, std::string_view service) {
+  if (service.empty()) service = "unknown";
+  ++ctx->ssf_services[std::string(service)];
+}
+
+// returns 1 ok, 0 decode error, -1 span carries STATUS samples (take the
+// Python path; nothing was ingested)
+int ingest_ssf_span(Ctx* ctx, std::string_view buf,
+                    std::string_view indicator_name,
+                    std::string_view objective_name, double uniq_rate) {
+  SpanView sp;
+  if (!decode_span(buf, &sp)) return 0;
+  if (sp.has_status) return -1;
+
+  for (SampleView& s : sp.samples) {
+    if (!ingest_sample(ctx, s)) ++ctx->ssf_invalid;
+  }
+
+  bool valid_trace = sp.id != 0 && sp.trace_id != 0 && sp.start_ts != 0 &&
+                     sp.end_ts != 0 && !sp.name.empty();
+  if (sp.indicator && valid_trace) {
+    double duration_ns = static_cast<double>(sp.end_ts - sp.start_ts);
+    const std::string_view error_sv = sp.error ? "true" : "false";
+    if (!indicator_name.empty()) {
+      std::vector<TagPair> tags{{"service", sp.service}, {"error", error_sv}};
+      int scope = 0;
+      build_joined(ctx, tags, &scope);
+      route_metric(ctx, indicator_name, KIND_HISTOGRAM, duration_ns, {},
+                   1.0, scope);
+    }
+    if (!objective_name.empty()) {
+      std::string_view objective = sp.name;
+      for (const TagPair& t : sp.tags) {
+        if (t.k == "ssf_objective" && !t.v.empty()) objective = t.v;
+      }
+      std::vector<TagPair> tags{{"service", sp.service},
+                                {"objective", objective},
+                                {"error", error_sv}};
+      int scope = 2;  // veneurglobalonly
+      build_joined(ctx, tags, &scope);
+      route_metric(ctx, objective_name, KIND_HISTOGRAM, duration_ns, {},
+                   1.0, scope);
+    }
+  }
+
+  if (uniq_rate > 0 && !sp.service.empty() &&
+      (uniq_rate >= 1.0 || uniform01(&g_uniq_rng) < uniq_rate)) {
+    std::vector<TagPair> tags{
+        {"indicator", sp.indicator ? "true" : "false"},
+        {"service", sp.service},
+        {"root_span", sp.id == sp.trace_id ? "true" : "false"}};
+    int scope = 0;
+    build_joined(ctx, tags, &scope);
+    route_metric(ctx, "ssf.names_unique", KIND_SET, 0.0, sp.name, 1.0,
+                 scope);
+  }
+
+  ++ctx->ssf_spans;
+  bump_service_count(ctx, sp.service);
+  return 1;
+}
+
 }  // namespace
 
 extern "C" {
@@ -427,6 +797,10 @@ void vn_ctx_reset(void* p) {
   ctx->other_lines.clear();
   ctx->processed = 0;
   ctx->errors = 0;
+  ctx->ssf_spans = 0;
+  ctx->ssf_invalid = 0;
+  ctx->ssf_services.clear();
+  ctx->ssf_services_out.clear();
 }
 
 // Ingest a datagram (possibly multiple newline-separated lines).
@@ -619,6 +993,43 @@ int vn_upsert(void* p, const char* name, int name_len, int kind,
     ctx->new_series.push_back(std::move(ns));
   }
   return row;
+}
+
+// SSF span fast path. Returns 1 ok, 0 decode error, -1 fallback needed
+// (span carries STATUS samples; nothing was ingested).
+int vn_ingest_ssf(void* p, const char* buf, int len, const char* ind_name,
+                  int ind_len, const char* obj_name, int obj_len,
+                  double uniq_rate) {
+  return ingest_ssf_span(
+      static_cast<Ctx*>(p), std::string_view(buf, len),
+      std::string_view(ind_name, ind_len), std::string_view(obj_name, obj_len),
+      uniq_rate);
+}
+
+long long vn_ssf_spans(void* p) { return static_cast<Ctx*>(p)->ssf_spans; }
+long long vn_ssf_invalid(void* p) {
+  return static_cast<Ctx*>(p)->ssf_invalid;
+}
+
+// Drain the per-service span counters as "service\tcount\n" lines.
+// Output beyond cap stays buffered for the next call (like
+// vn_drain_other) — truncating after clearing would lose counts and
+// could hand Python a cut mid-line.
+int vn_drain_ssf_services(void* p, char* buf, int cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  for (const auto& e : ctx->ssf_services) {
+    ctx->ssf_services_out.append(e.first);
+    ctx->ssf_services_out.push_back('\t');
+    ctx->ssf_services_out.append(std::to_string(e.second));
+    ctx->ssf_services_out.push_back('\n');
+  }
+  ctx->ssf_services.clear();
+  // cut on a line boundary so the consumer never sees a partial record
+  int n = std::min<int>(cap, static_cast<int>(ctx->ssf_services_out.size()));
+  while (n > 0 && ctx->ssf_services_out[n - 1] != '\n') --n;
+  std::memcpy(buf, ctx->ssf_services_out.data(), n);
+  ctx->ssf_services_out.erase(0, n);
+  return n;
 }
 
 // Drain the buffered event/service-check lines (newline separated).

@@ -422,3 +422,53 @@ def test_trace_proxy_routes_by_trace_id():
         tp.stop()
         rx1.close()
         rx2.close()
+
+
+def test_three_tier_local_proxy_global_end_to_end():
+    """Full pipeline fixture (reference newForwardingFixture,
+    forward_test.go:18-60 / forward_grpc_test.go:19-65): four locals
+    forward through a gRPC proxy that ring-routes over two globals. Each
+    series must land wholly on one global, histograms must merge to the
+    percentiles of the union, and counters must sum across locals."""
+    g1, imp1, port1 = _global_server()
+    g2, imp2, port2 = _global_server()
+    proxy = ProxyServer([f"127.0.0.1:{port1}", f"127.0.0.1:{port2}"])
+    pport = proxy.start_grpc()
+    locals_ = [_local_server(pport) for _ in range(4)]
+    try:
+        rng = np.random.default_rng(5)
+        all_vals: list[float] = []
+        for i, local in enumerate(locals_):
+            vals = rng.gamma(2.0, 50.0, 1500)
+            all_vals.extend(vals.tolist())
+            _ingest_histo(local, "e2e.lat", vals)
+            # plain counters flush locally and do NOT forward (mixed-scope
+            # rules, flusher.go:61-74); veneurglobalonly opts this one into
+            # the global tier so it must sum across all four locals
+            m = parse_metric(b"e2e.requests:10|c|#veneurglobalonly")
+            local.workers[m.digest % len(local.workers)].process_metric(m)
+        for local in locals_:
+            local.flush()
+        assert _wait_until(
+            lambda: imp1.received_metrics + imp2.received_metrics >= 8)
+
+        by1 = _flush_global(g1)
+        by2 = _flush_global(g2)
+        key_p50 = ("e2e.lat.50percentile", MetricType.GAUGE)
+        key_p99 = ("e2e.lat.99percentile", MetricType.GAUGE)
+        key_cnt = ("e2e.requests", MetricType.COUNTER)
+        # consistent hashing: each series is owned by exactly one global
+        assert (key_p50 in by1) != (key_p50 in by2)
+        assert (key_cnt in by1) != (key_cnt in by2)
+        byk = by1 if key_p50 in by1 else by2
+        exact = np.asarray(all_vals)
+        assert abs(byk[key_p50].value - np.quantile(exact, 0.5)) \
+            / np.quantile(exact, 0.5) < 0.01
+        assert abs(byk[key_p99].value - np.quantile(exact, 0.99)) \
+            / np.quantile(exact, 0.99) < 0.02
+        byc = by1 if key_cnt in by1 else by2
+        assert byc[key_cnt].value == 40.0
+    finally:
+        proxy.stop()
+        imp1.stop()
+        imp2.stop()

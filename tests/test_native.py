@@ -189,3 +189,167 @@ def test_native_parse_errors_counted():
     srv.flush()
     assert srv.workers[0].parse_errors >= 1
     srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Native SSF span fast path
+
+
+def _make_span_bytes(**kw):
+    from veneur_tpu.gen import ssf_pb2
+
+    pb = ssf_pb2.SSFSpan()
+    for k, v in kw.pop("tags", {}).items():
+        pb.tags[k] = v
+    for s in kw.pop("metrics", []):
+        m = pb.metrics.add()
+        for f, fv in s.items():
+            if f == "tags":
+                for tk, tv in fv.items():
+                    m.tags[tk] = tv
+            else:
+                setattr(m, f, fv)
+    for k, v in kw.items():
+        setattr(pb, k, v)
+    return pb.SerializeToString()
+
+
+def test_native_ssf_extraction_matches_python():
+    """The C++ span→metric extraction must produce the same series
+    (names, tags, scope, values) as the Python MetricExtractionSink."""
+    from veneur_tpu.core.spans import (
+        convert_indicator_metrics, convert_metrics)
+    from veneur_tpu.protocol.ssf_wire import parse_ssf
+
+    payload = _make_span_bytes(
+        trace_id=42, id=43, start_timestamp=10**9,
+        end_timestamp=10**9 + 5_000_000, service="api", name="req",
+        indicator=True, error=True,
+        tags={"ssf_objective": "checkout"},
+        metrics=[
+            {"metric": 0, "name": "hits", "value": 3.0,
+             "tags": {"env": "prod"}},
+            {"metric": 2, "name": "lat", "value": 12.5, "sample_rate": 0.5},
+            {"metric": 3, "name": "users", "message": "u1",
+             "tags": {"veneurglobalonly": "true"}},
+            {"metric": 1, "name": "temp", "value": 20.0},
+        ])
+
+    ni = native_mod.NativeIngest()
+    rc = ni.ingest_ssf(payload, b"ind.timer", b"obj.timer")
+    assert rc == 1
+    assert ni.ssf_spans == 1
+    assert ni.ssf_invalid == 0
+
+    got = {(p, k, name, joined, scope)
+           for p, _row, k, scope, name, joined in ni.drain_new_series()}
+
+    # expected series via the Python path
+    span = parse_ssf(payload)
+    pymetrics, invalid = convert_metrics(span)
+    assert invalid == 0
+    pymetrics += convert_indicator_metrics(span, "ind.timer", "obj.timer")
+    from veneur_tpu.core.directory import classify as pyclassify
+    want = set()
+    pool_by_type = {"histogram": 0, "timer": 0, "set": 1, "counter": 2,
+                    "gauge": 3}
+    for m in pymetrics:
+        cls = int(pyclassify(m.key.type, m.scope))
+        want.add((pool_by_type[m.key.type],
+                  native_mod.NativeIngest.KIND_BY_TYPE[m.key.type],
+                  m.key.name, m.key.joined_tags, cls))
+    assert got == want
+
+    # values: counter contribution, histo batch, set registers
+    rows, contribs = ni.drain_counter(16)
+    assert list(contribs) == [3.0]
+    rows, vals, wts = ni.drain_histo(16)
+    # lat (rate .5 => weight 2) + two derived 5ms indicator timers
+    assert sorted(zip(vals.tolist(), wts.tolist())) == [
+        (12.5, 2.0), (5e6, 1.0), (5e6, 1.0)]
+    srv = ni.drain_ssf_services()
+    assert srv == {"api": 1}
+
+
+def test_native_ssf_status_sample_falls_back():
+    payload = _make_span_bytes(
+        trace_id=1, id=1, start_timestamp=1, end_timestamp=2,
+        service="s", name="n",
+        metrics=[{"metric": 4, "name": "check", "status": 2,
+                  "message": "bad"}])
+    ni = native_mod.NativeIngest()
+    assert ni.ingest_ssf(payload, b"", b"") == -1
+    assert ni.ssf_spans == 0  # nothing ingested
+
+
+def test_native_ssf_decode_error():
+    ni = native_mod.NativeIngest()
+    assert ni.ingest_ssf(b"\xff\xff\xff\xff", b"", b"") == 0
+
+
+def test_native_ssf_name_tag_fallback():
+    """Empty span name falls back to the 'name' tag (wire normalization,
+    protocol/ssf_wire.normalize_span)."""
+    payload = _make_span_bytes(
+        trace_id=7, id=8, start_timestamp=1, end_timestamp=2_000_001,
+        service="svc", indicator=True, tags={"name": "from-tag"})
+    ni = native_mod.NativeIngest()
+    assert ni.ingest_ssf(payload, b"ind.t", b"obj.t") == 1
+    series = ni.drain_new_series()
+    objs = [(name, joined) for _p, _r, _k, _s, name, joined in series
+            if name == "obj.t"]
+    assert objs and "objective:from-tag" in objs[0][1]
+
+
+def test_server_native_ssf_end_to_end():
+    """Server with native mode: SSF datagram → native extraction →
+    flushed metrics, matching a Python-path server's output."""
+    payload = _make_span_bytes(
+        trace_id=9, id=10, start_timestamp=10**9,
+        end_timestamp=10**9 + 2_000_000, service="web", name="h",
+        indicator=True,
+        metrics=[{"metric": 2, "name": "spanlat", "value": 7.0}])
+
+    def run(native: bool):
+        cfg = Config(interval="10s", num_workers=1,
+                     tpu_native_ingest=native,
+                     indicator_span_timer_name="ind.t",
+                     percentiles=[0.5])
+        srv = Server(cfg)
+        if native and not srv.native_mode:
+            pytest.skip("native library unavailable")
+        srv.handle_trace_packet(payload)
+        if not native:
+            # Python path goes through the async span worker; pump it
+            srv.span_worker.start()
+            time.sleep(0.3)
+            srv.span_worker.stop()
+        out = srv.flush()
+        return {(m.name, round(m.value, 3)) for m in out}
+
+    got_native = run(True)
+    got_python = run(False)
+    assert got_native == got_python
+    assert any(n == "spanlat.50percentile" for n, _ in got_native)
+    assert any(n.startswith("ind.t") for n, _ in got_native)
+
+
+def test_native_ssf_non_ascii_tag_order_matches_python():
+    """Tag bytes >= 0x80 must sort identically in C++ (unsigned compare)
+    and Python (code-point sort) or one series would get two digests."""
+    from veneur_tpu.protocol.dogstatsd import parse_metric_ssf
+    from veneur_tpu import ssf as ssf_model
+
+    tags = {"Ωmega": "1", "alpha": "2", "zz": "3"}
+    payload = _make_span_bytes(
+        trace_id=1, id=2, start_timestamp=1, end_timestamp=2,
+        service="s", name="n",
+        metrics=[{"metric": 2, "name": "m", "value": 1.0, "tags": tags}])
+    ni = native_mod.NativeIngest()
+    assert ni.ingest_ssf(payload, b"", b"") == 1
+    (_, _, _, _, _name, joined), = ni.drain_new_series()
+
+    pym = parse_metric_ssf(ssf_model.SSFSample(
+        metric=ssf_model.SSFMetricType.HISTOGRAM, name="m", value=1.0,
+        tags=dict(tags)))
+    assert joined == pym.key.joined_tags

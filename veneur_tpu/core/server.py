@@ -166,6 +166,16 @@ class Server:
             if self.native_mode:
                 log.info("native C++ ingest pipeline enabled")
 
+        # native SSF span fast path: only when the extraction sink is the
+        # sole span consumer (other span sinks need the Python span object)
+        self._native_ssf = (self.native_mode and not self.span_sinks)
+        self._native_ssf_indicator = (
+            cfg.indicator_span_timer_name.encode())
+        self._native_ssf_objective = (
+            cfg.objective_span_timer_name.encode())
+        if self._native_ssf:
+            log.info("native SSF span extraction enabled")
+
     @property
     def is_local(self) -> bool:
         return self.config.is_local()
@@ -227,6 +237,18 @@ class Server:
         if not packet:
             self.parse_errors += 1
             return
+        if self._native_ssf:
+            # native decode + span→metric extraction in one C++ pass;
+            # rc -1 = span carries STATUS samples → Python path below
+            with self._worker_locks[0]:
+                rc = self.workers[0].ingest_ssf_packet(
+                    packet, self._native_ssf_indicator,
+                    self._native_ssf_objective)
+            if rc == 1:
+                return
+            if rc == 0:
+                self.parse_errors += 1
+                return
         try:
             span = ssf_wire.parse_ssf(packet)
         except ssf_wire.FramingError as e:
@@ -557,6 +579,19 @@ class Server:
 
         self.span_worker.flush()
 
+        # per-service span counters (reference handleSSF sync.Map counters
+        # reported at flush, server.go:1088-1101); drained BEFORE the
+        # worker flush — the native worker flush resets the C++ context,
+        # taking its counters with it
+        with self._ssf_stats_lock:
+            span_counts = self.ssf_spans_received
+            self.ssf_spans_received = {}
+        if self._native_ssf:
+            with self._worker_locks[0]:
+                for svc, n in self.workers[0]._native.drain_ssf_services(
+                        ).items():
+                    span_counts[svc] = span_counts.get(svc, 0) + n
+
         qs = device_quantiles(self.percentiles, self.aggregates)
         snaps: list[FlushSnapshot] = []
         for worker, lock in zip(self.workers, self._worker_locks):
@@ -604,6 +639,9 @@ class Server:
                 "flush.unique_timeseries_total", self._tally_timeseries(snaps),
                 tags=[f"global_veneur:{str(not self.is_local).lower()}"])
         self.stats.count("flush.post_metrics_total", len(final))
+        for svc, n in span_counts.items():
+            self.stats.count("ssf.received_total", n,
+                             tags=[f"service:{svc}"])
         # statsd counters are per-interval increments: report the delta,
         # covering both the Python parser and the native C++ parser
         errors_now = self.parse_errors + sum(

@@ -359,6 +359,19 @@ class DeviceWorker:
             self.drain_native()
         return n
 
+    def ingest_ssf_packet(self, packet: bytes, indicator_name: bytes,
+                          objective_name: bytes) -> int:
+        """Native-path SSF span ingest (decode + span→metric extraction in
+        C++). Returns the vn_ingest_ssf rc: 1 ok, 0 decode error, -1 the
+        caller must take the Python path (STATUS samples aboard)."""
+        rc = self._native.ingest_ssf(packet, indicator_name, objective_name)
+        if rc == 1:
+            self.processed += 1
+            if (self._native.pending_histo >= self.batch_size
+                    or self._native.pending_set >= self.batch_size):
+                self.drain_native()
+        return rc
+
     def _sync_native_series(self) -> None:
         from veneur_tpu.native import NativeIngest
 

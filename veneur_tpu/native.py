@@ -83,6 +83,16 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.vn_upsert.argtypes = [
             c.c_void_p, c.c_char_p, c.c_int, c.c_int, c.c_char_p, c.c_int,
             c.c_int]
+        lib.vn_ingest_ssf.restype = c.c_int
+        lib.vn_ingest_ssf.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_int, c.c_char_p, c.c_int,
+            c.c_char_p, c.c_int, c.c_double]
+        lib.vn_ssf_spans.restype = c.c_longlong
+        lib.vn_ssf_spans.argtypes = [c.c_void_p]
+        lib.vn_ssf_invalid.restype = c.c_longlong
+        lib.vn_ssf_invalid.argtypes = [c.c_void_p]
+        lib.vn_drain_ssf_services.restype = c.c_int
+        lib.vn_drain_ssf_services.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
         _lib = lib
         return _lib
 
@@ -213,6 +223,42 @@ class NativeIngest:
         return self._lib.vn_upsert(
             self._ctx, nb, len(nb), self.KIND_BY_TYPE[mtype], tb, len(tb),
             scope_class)
+
+    def ingest_ssf(self, packet: bytes, indicator_name: bytes = b"",
+                   objective_name: bytes = b"",
+                   uniqueness_rate: float = 0.0) -> int:
+        """Native SSF span fast path: decode + span→metric extraction.
+        Returns 1 on success, 0 on decode error, -1 when the span carries
+        STATUS samples (caller must take the Python path)."""
+        return self._lib.vn_ingest_ssf(
+            self._ctx, packet, len(packet),
+            indicator_name, len(indicator_name),
+            objective_name, len(objective_name),
+            float(uniqueness_rate))
+
+    @property
+    def ssf_spans(self) -> int:
+        return self._lib.vn_ssf_spans(self._ctx)
+
+    @property
+    def ssf_invalid(self) -> int:
+        return self._lib.vn_ssf_invalid(self._ctx)
+
+    def drain_ssf_services(self) -> dict[str, int]:
+        cap = 1 << 18
+        buf = ctypes.create_string_buffer(cap)
+        out: dict[str, int] = {}
+        while True:
+            n = self._lib.vn_drain_ssf_services(self._ctx, buf, cap)
+            if n <= 0:
+                break
+            for line in buf.raw[:n].split(b"\n"):
+                if not line:
+                    continue
+                svc, _, cnt = line.partition(b"\t")
+                svc_s = svc.decode("utf-8", "replace")
+                out[svc_s] = out.get(svc_s, 0) + int(cnt)
+        return out
 
     def drain_other(self) -> list[bytes]:
         cap = 1 << 20
