@@ -221,6 +221,7 @@ struct Ctx {
   long long ssf_invalid = 0;
   std::unordered_map<std::string, long long> ssf_services;
   std::string ssf_services_out;  // drained lines awaiting pickup
+  uint64_t uniq_rng = 0x9E3779B97F4A7C15ull;
 
   // scratch reused across lines
   std::vector<std::string_view> tags;
@@ -691,7 +692,8 @@ bool ingest_sample(Ctx* ctx, SampleView& s) {
 }
 
 // xorshift64* for uniqueness sampling — statistical, parity not required
-// (the Python path uses random.random(), ssf/samples.go RandomlySample)
+// (the Python path uses random.random(), ssf/samples.go RandomlySample).
+// State lives per-Ctx (no shared mutable global → no cross-context race).
 inline double uniform01(uint64_t* state) {
   uint64_t x = *state;
   x ^= x >> 12;
@@ -702,11 +704,17 @@ inline double uniform01(uint64_t* state) {
          static_cast<double>(1ull << 53);
 }
 
-uint64_t g_uniq_rng = 0x9E3779B97F4A7C15ull;
-
 void bump_service_count(Ctx* ctx, std::string_view service) {
   if (service.empty()) service = "unknown";
-  ++ctx->ssf_services[std::string(service)];
+  // service names are untrusted payload bytes: bound the length (so one
+  // huge name can't wedge the line-framed drain) and replace the drain
+  // framing bytes themselves
+  if (service.size() > 256) service = service.substr(0, 256);
+  std::string key(service);
+  for (char& c : key) {
+    if (c == '\t' || c == '\n') c = '_';
+  }
+  ++ctx->ssf_services[std::move(key)];
 }
 
 // returns 1 ok, 0 decode error, -1 span carries STATUS samples (take the
@@ -750,7 +758,7 @@ int ingest_ssf_span(Ctx* ctx, std::string_view buf,
   }
 
   if (uniq_rate > 0 && !sp.service.empty() &&
-      (uniq_rate >= 1.0 || uniform01(&g_uniq_rng) < uniq_rate)) {
+      (uniq_rate >= 1.0 || uniform01(&ctx->uniq_rng) < uniq_rate)) {
     std::vector<TagPair> tags{
         {"indicator", sp.indicator ? "true" : "false"},
         {"service", sp.service},

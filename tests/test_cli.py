@@ -268,3 +268,18 @@ def test_veneur_main_sighup_graceful_restart(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_example_configs_load_strict():
+    """example.yaml / example_proxy.yaml must stay loadable under strict
+    parsing (the reference generates config.go FROM example.yaml; here the
+    example files are generated from Config and validated in CI)."""
+    import os
+
+    from veneur_tpu.core.config import load_config, load_proxy_config
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    cfg = load_config(os.path.join(root, "example.yaml"), strict=True)
+    assert cfg.interval == "10s"
+    pcfg = load_proxy_config(os.path.join(root, "example_proxy.yaml"))
+    assert pcfg is not None

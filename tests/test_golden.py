@@ -1,0 +1,74 @@
+"""Golden wire-format fixtures.
+
+The forwarding codec IS the framework's persistence/checkpoint format
+(SURVEY.md §5.4): these checked-in blobs pin the protobuf sketch wire
+format so a future change that silently breaks cross-version forwarding
+(local on version N → global on version N+1) fails here first. Mirrors the
+reference's checked-in gob blob (tdigest/testdata) and import.deflate
+fixtures (http_test.go).
+"""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.flusher import device_quantiles, generate_inter_metrics
+from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+from veneur_tpu.core.server import Server
+from veneur_tpu.distributed import codec
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
+
+# the exact distribution used to generate the fixture
+_VALS = np.random.default_rng(123).gamma(2.0, 50.0, 500)
+
+
+def _load_batch() -> pb.MetricBatch:
+    with open(os.path.join(TESTDATA, "forward_batch.pb"), "rb") as f:
+        batch = pb.MetricBatch()
+        batch.ParseFromString(f.read())
+    return batch
+
+
+def test_golden_batch_decodes():
+    batch = _load_batch()
+    by_name = {m.name: m for m in batch.metrics}
+    assert set(by_name) == {"golden.lat", "golden.count", "golden.set"}
+    assert by_name["golden.count"].counter.value == 41
+    assert list(by_name["golden.lat"].tags) == ["svc:gold"]
+
+
+def test_golden_deflate_matches_pb():
+    with open(os.path.join(TESTDATA, "forward_batch.deflate"), "rb") as f:
+        deflated = f.read()
+    with open(os.path.join(TESTDATA, "forward_batch.pb"), "rb") as f:
+        raw = f.read()
+    assert zlib.decompress(deflated) == raw
+
+
+def test_golden_batch_imports_and_flushes():
+    """A global server importing the fixture must reproduce the original
+    aggregates: the wire format carries enough to merge correctly."""
+    cfg = Config(interval="10s", percentiles=[0.5], num_workers=1)
+    srv = Server(cfg)
+    w = srv.workers[0]
+    for m in _load_batch().metrics:
+        codec.apply_to_worker(w, m)
+    qs = device_quantiles([0.5],
+                          HistogramAggregates.from_names(
+                              ["min", "max", "count"]))
+    snap = w.flush(qs, 10.0)
+    out = {(m.name, m.type): m
+           for m in generate_inter_metrics(
+               snap, False, [0.5],
+               HistogramAggregates.from_names(["min", "max", "count"]))}
+    assert out[("golden.count", MetricType.COUNTER)].value == 41.0
+    p50 = out[("golden.lat.50percentile", MetricType.GAUGE)].value
+    exact = float(np.quantile(_VALS, 0.5))
+    assert abs(p50 - exact) / exact < 0.01
+    est = out[("golden.set", MetricType.GAUGE)].value
+    assert abs(est - 100) / 100 < 0.05

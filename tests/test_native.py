@@ -353,3 +353,27 @@ def test_native_ssf_non_ascii_tag_order_matches_python():
         metric=ssf_model.SSFMetricType.HISTOGRAM, name="m", value=1.0,
         tags=dict(tags)))
     assert joined == pym.key.joined_tags
+
+
+def test_native_ssf_hostile_service_name():
+    """Tabs/newlines in an untrusted service name must not corrupt the
+    service-counter drain framing or inject statsd lines."""
+    payload = _make_span_bytes(
+        trace_id=1, id=2, start_timestamp=1, end_timestamp=2,
+        service="evil\tsvc\nx", name="n",
+        metrics=[{"metric": 0, "name": "c", "value": 1.0}])
+    ni = native_mod.NativeIngest()
+    assert ni.ingest_ssf(payload, b"", b"") == 1
+    counts = ni.drain_ssf_services()
+    assert counts == {"evil_svc_x": 1}
+
+
+def test_scopedstatsd_injection_sanitized():
+    from veneur_tpu import scopedstatsd
+
+    cap = scopedstatsd.CaptureSender()
+    cli = scopedstatsd.ScopedClient(cap, namespace="v.")
+    cli.count("m", 1, tags=["service:x|#fake\nforged:999|g"])
+    assert len(cap.lines) == 1
+    assert "\n" not in cap.lines[0]
+    assert cap.lines[0].count("|#") == 1

@@ -580,22 +580,24 @@ class Server:
         self.span_worker.flush()
 
         # per-service span counters (reference handleSSF sync.Map counters
-        # reported at flush, server.go:1088-1101); drained BEFORE the
-        # worker flush — the native worker flush resets the C++ context,
-        # taking its counters with it
+        # reported at flush, server.go:1088-1101)
         with self._ssf_stats_lock:
             span_counts = self.ssf_spans_received
             self.ssf_spans_received = {}
-        if self._native_ssf:
-            with self._worker_locks[0]:
-                for svc, n in self.workers[0]._native.drain_ssf_services(
-                        ).items():
-                    span_counts[svc] = span_counts.get(svc, 0) + n
 
         qs = device_quantiles(self.percentiles, self.aggregates)
         snaps: list[FlushSnapshot] = []
-        for worker, lock in zip(self.workers, self._worker_locks):
+        for i, (worker, lock) in enumerate(
+                zip(self.workers, self._worker_locks)):
             with lock:
+                if i == 0 and self._native_ssf:
+                    # drained in the SAME lock hold as the worker flush —
+                    # the flush resets the C++ context, and a span landing
+                    # between a separate drain and the reset would lose
+                    # its service count
+                    for svc, n in (
+                            worker._native.drain_ssf_services().items()):
+                        span_counts[svc] = span_counts.get(svc, 0) + n
                 snaps.append(worker.flush(qs, self.interval))
 
         final: list[InterMetric] = []

@@ -255,7 +255,13 @@ class NativeIngest:
             for line in buf.raw[:n].split(b"\n"):
                 if not line:
                     continue
-                svc, _, cnt = line.partition(b"\t")
+                # rpartition: the count is the field after the LAST tab,
+                # so a malformed line can't turn into a bad int() (the C++
+                # side also sanitizes framing bytes out of service names)
+                svc, sep, cnt = line.rpartition(b"\t")
+                if not sep or not cnt.isdigit():
+                    log.warning("malformed ssf service-count line %r", line)
+                    continue
                 svc_s = svc.decode("utf-8", "replace")
                 out[svc_s] = out.get(svc_s, 0) + int(cnt)
         return out

@@ -32,13 +32,23 @@ _SCOPE_TAG = {
 }
 
 
+_INJECT = str.maketrans({"|": "_", "\n": "_"})
+
+
+def _clean(s: str) -> str:
+    """Strip statsd framing bytes from untrusted name/tag content —
+    without this, a hostile tag value (e.g. an SSF service name) forges
+    extra metric lines in the outgoing stats stream."""
+    return s.translate(_INJECT) if ("|" in s or "\n" in s) else s
+
+
 def _format_line(name: str, value, mtype: str, tags: Iterable[str],
                  rate: float) -> str:
     """Render one DogStatsD line: ``name:value|type[|@rate][|#t1,t2]``."""
-    parts = [f"{name}:{value}|{mtype}"]
+    parts = [f"{_clean(name)}:{value}|{mtype}"]
     if rate != 1.0:
         parts.append(f"@{rate}")
-    tags = [t for t in tags if t]
+    tags = [_clean(t) for t in tags if t]
     if tags:
         parts.append("#" + ",".join(tags))
     return "|".join(parts)
