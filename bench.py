@@ -66,7 +66,10 @@ def timer_replay() -> dict:
 
     series = int(os.environ.get("VENEUR_BENCH_SERIES", 16384))
     batch = int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 22))
-    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 20))
+    # CPU fallback (accelerator unavailable): fewer iterations so the
+    # bench still finishes in a couple of minutes
+    default_iters = 5 if os.environ.get("_VENEUR_BENCH_REEXEC") else 20
+    iters = int(os.environ.get("VENEUR_BENCH_ITERS", default_iters))
 
     rng = np.random.default_rng(42)
     pool = td.init_pool(series, td.DEFAULT_CAPACITY)

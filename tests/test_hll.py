@@ -144,3 +144,20 @@ def test_segment_gauge_last_device():
     assert float(out[0]) == 7.0
     assert float(out[1]) == 5.0
     assert bool(present[0]) and bool(present[1]) and not bool(present[2])
+
+
+def test_insert_batch_variants_agree():
+    """The sorted-unique-scatter insert must equal the plain scatter-max."""
+    import numpy as np
+
+    rng = np.random.default_rng(17)
+    s, p = 7, 8
+    m = hll.num_registers(p)
+    regs = jnp.asarray(rng.integers(0, 5, (s, m)).astype(np.int8))
+    n = 5000
+    rows = jnp.asarray(rng.integers(0, s, n).astype(np.int32))
+    idx = jnp.asarray(rng.integers(0, m, n).astype(np.int32))
+    rank = jnp.asarray(rng.integers(0, 50, n).astype(np.int8))
+    a = hll.insert_batch(regs, rows, idx, rank)
+    b = hll.insert_batch_scatter(regs, rows, idx, rank)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -74,11 +74,40 @@ def insert_batch(
     reg_idx: jax.Array,
     rank: jax.Array,
 ) -> jax.Array:
-    """Scatter-max a batch of (row, register, rank) into the pool.
+    """Batch-max a set of (row, register, rank) updates into the pool.
 
     rows: i32[N] sketch row per sample (padding: rank 0 — a no-op since
     registers are >= 0).
+
+    TPU-first formulation: a raw scatter-max with duplicate (row, register)
+    indices serializes on TPU. Instead, sort by (flat register slot, rank);
+    the LAST element of each equal-slot run then holds that slot's max, so
+    one scatter with unique, sorted indices applies the whole batch
+    (non-run-end elements are dropped via an out-of-range index).
     """
+    s, m = registers.shape
+    n = rows.shape[0]
+    flat = rows * m + reg_idx  # fits i32 for s·m < 2^31 (s ≤ 2^17 at p=14)
+    rank32 = rank.astype(jnp.int32)
+    sflat, srank = jax.lax.sort((flat, rank32), dimension=0, num_keys=2)
+    is_end = jnp.concatenate(
+        [sflat[1:] != sflat[:-1], jnp.ones((1,), bool)])
+    target = jnp.where(is_end, sflat, s * m)  # OOB → dropped
+    out = registers.reshape(-1).at[target].max(
+        srank.astype(registers.dtype), mode="drop",
+        indices_are_sorted=True, unique_indices=True)
+    return out.reshape(s, m)
+
+
+@jax.jit
+def insert_batch_scatter(
+    registers: jax.Array,
+    rows: jax.Array,
+    reg_idx: jax.Array,
+    rank: jax.Array,
+) -> jax.Array:
+    """Plain duplicate-index scatter-max variant (kept for A/B against
+    `insert_batch` on hardware)."""
     return registers.at[rows, reg_idx].max(rank, mode="drop")
 
 
