@@ -9,10 +9,12 @@ aggregation kernel, the analog of the reference's ingest packets/sec
 (README.md:309: >60k packets/sec/instance in production — the vs_baseline
 denominator).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line per workload: {"metric", "value", "unit",
+"vs_baseline"}. With no VENEUR_BENCH_WORKLOAD set, all five BASELINE
+workloads run and the headline (timer_replay) line prints last.
 
-VENEUR_BENCH_WORKLOAD selects among the BASELINE.json configs:
-  timer_replay (default) — t-digest-only ingest throughput
+VENEUR_BENCH_WORKLOAD selects a single BASELINE.json config:
+  timer_replay — t-digest-only ingest throughput (the headline)
   mixed         — counters + HLL sets + histos over 100k series
   global_merge  — 8 local pools -> 1 global cross-host t-digest merge
   ssf_histo     — SSF spans -> derived latency histograms end to end
@@ -37,25 +39,57 @@ import numpy as np
 def _ensure_live_backend() -> None:
     """Probe device-backend init in a subprocess; if the accelerator path
     is wedged (e.g. its network relay is down, which blocks init forever),
-    re-exec on CPU so the bench always produces a number."""
+    re-exec on CPU so the bench always produces a number.
+
+    The probe retries (default 2 attempts × 240s) and reports the root
+    cause — the captured stderr of the failed init, or "timed out" — so a
+    fallback artifact says WHY the accelerator was unavailable."""
     if os.environ.get("_VENEUR_BENCH_REEXEC"):
         return
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=int(os.environ.get("VENEUR_BENCH_PROBE_TIMEOUT", 120)),
-            capture_output=True, check=True)
-        return
-    except Exception:
-        pass
+    timeout = int(os.environ.get("VENEUR_BENCH_PROBE_TIMEOUT", 240))
+    attempts = int(os.environ.get("VENEUR_BENCH_PROBE_ATTEMPTS", 2))
+    reason = "unknown"
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices(), flush=True)"],
+                timeout=timeout, capture_output=True, check=True)
+            print(f"bench: accelerator backend live: "
+                  f"{r.stdout.decode(errors='replace').strip()}",
+                  file=sys.stderr)
+            return
+        except subprocess.TimeoutExpired as e:
+            err = (e.stderr or b"").decode(errors="replace").strip()
+            reason = (f"attempt {i + 1}/{attempts}: backend init timed out"
+                      f" after {timeout}s"
+                      + (f"; partial stderr: {err[-500:]}" if err else ""))
+        except subprocess.CalledProcessError as e:
+            err = (e.stderr or b"").decode(errors="replace").strip()
+            reason = (f"attempt {i + 1}/{attempts}: init exited"
+                      f" rc={e.returncode}: {err[-500:]}")
+        except Exception as e:  # pragma: no cover
+            reason = f"attempt {i + 1}/{attempts}: {type(e).__name__}: {e}"
+        print(f"bench: accelerator probe failed — {reason}", file=sys.stderr)
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["_VENEUR_BENCH_REEXEC"] = "1"
-    print("bench: accelerator backend unavailable; falling back to CPU",
-          file=sys.stderr)
+    print(f"bench: accelerator backend unavailable ({reason}); "
+          "falling back to CPU", file=sys.stderr)
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
               env)
+
+
+def _envint(name: str, default: int, cpu_default: int | None = None) -> int:
+    """Env-overridable size knob; CPU-fallback mode gets a smaller default
+    so all five workloads still finish in minutes without a chip."""
+    v = os.environ.get(name)
+    if v:
+        return int(v)
+    if cpu_default is not None and os.environ.get("_VENEUR_BENCH_REEXEC"):
+        return cpu_default
+    return default
 
 
 def timer_replay() -> dict:
@@ -64,12 +98,11 @@ def timer_replay() -> dict:
 
     from veneur_tpu.ops import tdigest as td
 
-    series = int(os.environ.get("VENEUR_BENCH_SERIES", 16384))
-    batch = int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 22))
-    # CPU fallback (accelerator unavailable): fewer iterations so the
+    series = _envint("VENEUR_BENCH_SERIES", 16384, 4096)
+    batch = _envint("VENEUR_BENCH_BATCH", 1 << 22, 1 << 19)
+    # CPU fallback (accelerator unavailable): smaller sizes so the
     # bench still finishes in a couple of minutes
-    default_iters = 5 if os.environ.get("_VENEUR_BENCH_REEXEC") else 20
-    iters = int(os.environ.get("VENEUR_BENCH_ITERS", default_iters))
+    iters = _envint("VENEUR_BENCH_ITERS", 20, 5)
 
     rng = np.random.default_rng(42)
     pool = td.init_pool(series, td.DEFAULT_CAPACITY)
@@ -135,9 +168,9 @@ def mixed() -> dict:
     from veneur_tpu.ops import hll, scalars, tdigest as td
     from veneur_tpu.utils.hashing import fnv1a_64
 
-    series = int(os.environ.get("VENEUR_BENCH_SERIES", 100_000))
-    batch = int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 22))
-    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 10))
+    series = _envint("VENEUR_BENCH_SERIES", 100_000, 20_000)
+    batch = _envint("VENEUR_BENCH_BATCH", 1 << 22, 1 << 18)
+    iters = _envint("VENEUR_BENCH_ITERS", 10, 3)
     s_counter, s_set = series // 2, series // 4
     s_histo = series - s_counter - s_set
 
@@ -202,9 +235,9 @@ def global_merge() -> dict:
 
     from veneur_tpu.ops import tdigest as td
 
-    series = int(os.environ.get("VENEUR_BENCH_SERIES", 65536))
-    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 10))
-    fill = min(int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 20)), 1 << 20)
+    series = _envint("VENEUR_BENCH_SERIES", 65536, 8192)
+    iters = _envint("VENEUR_BENCH_ITERS", 10, 3)
+    fill = min(_envint("VENEUR_BENCH_BATCH", 1 << 20, 1 << 17), 1 << 20)
     hosts = 8
     rng = np.random.default_rng(2)
 
@@ -258,8 +291,8 @@ def ssf_histo() -> dict:
     from veneur_tpu.gen import ssf_pb2
     from veneur_tpu.ops import tdigest as td
 
-    n_spans = int(os.environ.get("VENEUR_BENCH_BATCH", 50_000))
-    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 5))
+    n_spans = _envint("VENEUR_BENCH_BATCH", 50_000, 10_000)
+    iters = _envint("VENEUR_BENCH_ITERS", 5, 2)
     rng = np.random.default_rng(3)
     services = [f"svc{i}" for i in range(64)]
     base = int(time.time() * 1e9)
@@ -344,9 +377,9 @@ def prometheus_1m() -> dict:
 
     from veneur_tpu.ops import tdigest as td
 
-    series = int(os.environ.get("VENEUR_BENCH_SERIES", 1 << 20))
-    batch = int(os.environ.get("VENEUR_BENCH_BATCH", 1 << 22))
-    iters = int(os.environ.get("VENEUR_BENCH_ITERS", 5))
+    series = _envint("VENEUR_BENCH_SERIES", 1 << 20, 1 << 17)
+    batch = _envint("VENEUR_BENCH_BATCH", 1 << 22, 1 << 19)
+    iters = _envint("VENEUR_BENCH_ITERS", 5, 2)
     rng = np.random.default_rng(4)
     pool = td.init_pool(series, td.DEFAULT_CAPACITY)
     state = (pool.means, pool.weights, pool.min, pool.max, pool.recip)
@@ -393,12 +426,24 @@ WORKLOADS = {
 
 
 def main() -> None:
-    name = os.environ.get("VENEUR_BENCH_WORKLOAD", "timer_replay")
-    workload = WORKLOADS.get(name)
-    if workload is None:
-        sys.exit(f"unknown VENEUR_BENCH_WORKLOAD {name!r}; "
-                 f"valid: {', '.join(sorted(WORKLOADS))}")
-    print(json.dumps(workload()))
+    name = os.environ.get("VENEUR_BENCH_WORKLOAD")
+    if name:
+        workload = WORKLOADS.get(name)
+        if workload is None:
+            sys.exit(f"unknown VENEUR_BENCH_WORKLOAD {name!r}; "
+                     f"valid: {', '.join(sorted(WORKLOADS))}")
+        print(json.dumps(workload()), flush=True)
+        return
+    # No selector: run ALL five BASELINE workloads, one JSON line each.
+    # The headline metric (timer_replay) prints LAST so a tail-capturing
+    # driver records it as the primary number.
+    for wname in ("mixed", "global_merge", "ssf_histo", "prometheus_1m",
+                  "timer_replay"):
+        try:
+            print(json.dumps(WORKLOADS[wname]()), flush=True)
+        except Exception as e:  # one bad workload must not hide the rest
+            print(json.dumps({"metric": wname, "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
 
 
 if __name__ == "__main__":

@@ -1055,12 +1055,14 @@ int vn_ingest_ssf(void* p, const char* buf, int len, const char* ind_name,
 // Returns the number of spans ingested; decode errors are counted in
 // *errors_out, spans needing the Python fallback are APPENDED to
 // fallback_off/fallback_len (caller-provided arrays of capacity
-// fallback_cap) as offsets into buf.
+// fallback_cap; pass 0 to count-as-error instead) as offsets into buf,
+// with the appended count written to *nfall_out.
 int vn_ingest_ssf_many(void* p, const char* buf, long long len,
                        const char* ind_name, int ind_len,
                        const char* obj_name, int obj_len, double uniq_rate,
                        int* errors_out, int* fallback_off,
-                       int* fallback_len, int fallback_cap) {
+                       int* fallback_len, int fallback_cap,
+                       int* nfall_out) {
   Ctx* ctx = static_cast<Ctx*>(p);
   std::string_view ind(ind_name, ind_len), obj(obj_name, obj_len);
   long long pos = 0;
@@ -1089,10 +1091,8 @@ int vn_ingest_ssf_many(void* p, const char* buf, long long len,
     pos += flen;
   }
   *errors_out = errs;
-  fallback_off[fallback_cap > nfall ? nfall : fallback_cap - 1] =
-      nfall;  // unused slot convention not relied upon; count returned below
-  fallback_len[0] = fallback_len[0];  // no-op
-  return (ok << 16) | nfall;
+  *nfall_out = nfall;
+  return ok;
 }
 
 long long vn_ssf_spans(void* p) { return static_cast<Ctx*>(p)->ssf_spans; }
@@ -1104,6 +1104,12 @@ long long vn_ssf_invalid(void* p) {
 // Output beyond cap stays buffered for the next call (like
 // vn_drain_other) — truncating after clearing would lose counts and
 // could hand Python a cut mid-line.
+//
+// CAP CONTRACT: cap must be >= one full line (service names are
+// truncated to 256 bytes at ingest, so 256 + 1 tab + 20 digit count +
+// newline = 278; callers must pass cap >= 512). With a smaller cap a
+// line that doesn't fit returns 0 while data stays buffered, and a
+// `while n > 0` drain loop would stall until the next flush.
 int vn_drain_ssf_services(void* p, char* buf, int cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
   for (const auto& e : ctx->ssf_services) {

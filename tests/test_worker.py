@@ -255,3 +255,39 @@ def test_unique_timeseries_counting():
     from veneur_tpu.ops import hll as hll_ops
     est = float(hll_ops.estimate(jnp.asarray(regs[None, :]))[0])
     assert abs(est - 200) / 200 < 0.05
+
+
+def test_scalar_accumulators_survive_large_counts():
+    """Compensated-f32 scalar accumulators (VERDICT r1 #10): after the
+    running count passes 2^24, bare f32 adds silently drop small batch
+    increments (2^25 + 1 == 2^25 in f32). The reference keeps these in
+    float64 (tdigest/merging_digest.go scalars); here the _comp_add
+    two-float sum must carry them."""
+    w = DeviceWorker()
+    w.process_metric(parse_metric(b"big:3|h"))
+    row = w._ph_rows[0]
+    w._flush_pending_histos()
+
+    big = float(2 ** 25)
+    # seed one enormous-weight sample (its own device batch)
+    w._ph_rows.append(row)
+    w._ph_vals.append(3.0)
+    w._ph_wts.append(big - 1.0)
+    w._flush_pending_histos()
+
+    # then 512 separate unit batches — each add is below f32 resolution
+    # at the accumulator's magnitude
+    for _ in range(512):
+        w._ph_rows.append(row)
+        w._ph_vals.append(3.0)
+        w._ph_wts.append(1.0)
+        w._flush_pending_histos()
+
+    snap = w.flush(device_quantiles(PCTS, AGGS))
+    count = float(snap.lweight[0])
+    total = float(snap.lsum[0])
+    recip = float(snap.lrecip[0])
+    expect_n = big + 512.0
+    assert abs(count - expect_n) / expect_n < 1e-6, count
+    assert abs(total - 3.0 * expect_n) / (3.0 * expect_n) < 1e-6, total
+    assert abs(recip - expect_n / 3.0) / (expect_n / 3.0) < 1e-6, recip

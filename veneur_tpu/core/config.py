@@ -135,6 +135,9 @@ class Config:
     # spans → derived metrics
     indicator_span_timer_name: str = ""
     objective_span_timer_name: str = ""
+    # span-name uniqueness Set sampling rate; the reference hardcodes 0.01
+    # (sinks/ssfmetrics/metrics.go ConvertSpanUniquenessMetrics)
+    ssf_span_uniqueness_rate: float = 0.01
 
     # sink: datadog
     datadog_api_hostname: str = ""

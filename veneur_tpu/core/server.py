@@ -105,6 +105,7 @@ class Server:
             route_metric=self._route,
             indicator_timer_name=cfg.indicator_span_timer_name,
             objective_timer_name=cfg.objective_span_timer_name,
+            uniqueness_rate=cfg.ssf_span_uniqueness_rate,
         )
         common_tags = dict(
             t.split(":", 1) for t in self.tags if ":" in t)
@@ -243,7 +244,8 @@ class Server:
             with self._worker_locks[0]:
                 rc = self.workers[0].ingest_ssf_packet(
                     packet, self._native_ssf_indicator,
-                    self._native_ssf_objective)
+                    self._native_ssf_objective,
+                    self.config.ssf_span_uniqueness_rate)
             if rc == 1:
                 return
             if rc == 0:

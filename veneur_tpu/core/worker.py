@@ -60,10 +60,28 @@ def _next_pow2(n: int, floor: int = 1) -> int:
 # Jitted device steps
 
 
-@functools.partial(jax.jit, static_argnames=("compression",), donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _comp_add(s, c, x):
+    """Neumaier compensated add: (sum, compensation) += x, in f32.
+
+    Long-running scalar accumulators (sum/count/reciprocal-sum) see 10^8+
+    samples per series; a bare f32 add loses increments once the running
+    value passes 2^24. The reference keeps these in float64
+    (tdigest/merging_digest.go scalars); TPUs have no fast f64, so a
+    two-float compensated sum carries the residual instead — the true
+    value is s + c, reconstructed at flush extraction."""
+    t = s + x
+    # pick the larger-magnitude operand as the base of the residual;
+    # on overflow (t = ±inf) the residual is inf-inf = NaN — drop it so
+    # the accumulator saturates at inf like a bare f32 add would
+    resid = jnp.where(jnp.abs(s) >= jnp.abs(x), (s - t) + x, (x - t) + s)
+    resid = jnp.where(jnp.isfinite(t), resid, 0.0)
+    return t, c + resid
+
+
+@functools.partial(jax.jit, static_argnames=("compression",), donate_argnums=tuple(range(14)))
 def _histo_ingest_step(
-    means, weights, dmin, dmax, drecip,
-    lmin, lmax, lsum, lweight, lrecip,
+    means, weights, dmin, dmax, drecip, drecip_c,
+    lmin, lmax, lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c,
     active, lids, values, wts,
     compression: float = td.DEFAULT_COMPRESSION,
 ):
@@ -71,6 +89,10 @@ def _histo_ingest_step(
 
     active: i32[K] pool rows (padded with a scratch row); lids index into
     `active`. Also updates the sampler-local scalar arrays for those rows.
+    Scalar accumulators use compensated f32 (see _comp_add); `active`'s
+    padding duplicates all point at the scratch row with zero-weight
+    stats, so the gather→compensate→set round trip writes identical
+    values at every duplicate.
     """
     g_means = means[active]
     g_w = weights[active]
@@ -78,7 +100,7 @@ def _histo_ingest_step(
     g_max = dmax[active]
     g_recip = drecip[active]
 
-    n_means, n_w, n_min, n_max, n_recip, stats = td.add_batch(
+    n_means, n_w, n_min, n_max, _, stats = td.add_batch(
         g_means, g_w, g_min, g_max, g_recip, lids, values, wts,
         compression=compression,
     )
@@ -87,19 +109,28 @@ def _histo_ingest_step(
     weights = weights.at[active].set(n_w, mode="drop")
     dmin = dmin.at[active].set(n_min, mode="drop")
     dmax = dmax.at[active].set(n_max, mode="drop")
+    n_recip, n_recip_c = _comp_add(g_recip, drecip_c[active], stats.recip)
     drecip = drecip.at[active].set(n_recip, mode="drop")
+    drecip_c = drecip_c.at[active].set(n_recip_c, mode="drop")
 
     lmin = lmin.at[active].min(stats.min, mode="drop")
     lmax = lmax.at[active].max(stats.max, mode="drop")
-    lsum = lsum.at[active].add(stats.sum, mode="drop")
-    lweight = lweight.at[active].add(stats.weight, mode="drop")
-    lrecip = lrecip.at[active].add(stats.recip, mode="drop")
-    return means, weights, dmin, dmax, drecip, lmin, lmax, lsum, lweight, lrecip
+    n_lsum, n_lsum_c = _comp_add(lsum[active], lsum_c[active], stats.sum)
+    lsum = lsum.at[active].set(n_lsum, mode="drop")
+    lsum_c = lsum_c.at[active].set(n_lsum_c, mode="drop")
+    n_lw, n_lw_c = _comp_add(lweight[active], lweight_c[active], stats.weight)
+    lweight = lweight.at[active].set(n_lw, mode="drop")
+    lweight_c = lweight_c.at[active].set(n_lw_c, mode="drop")
+    n_lr, n_lr_c = _comp_add(lrecip[active], lrecip_c[active], stats.recip)
+    lrecip = lrecip.at[active].set(n_lr, mode="drop")
+    lrecip_c = lrecip_c.at[active].set(n_lr_c, mode="drop")
+    return (means, weights, dmin, dmax, drecip, drecip_c,
+            lmin, lmax, lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c)
 
 
-@functools.partial(jax.jit, static_argnames=("compression",), donate_argnums=(0, 1, 2, 3, 4))
+@functools.partial(jax.jit, static_argnames=("compression",), donate_argnums=(0, 1, 2, 3, 4, 5))
 def _histo_import_step(
-    means, weights, dmin, dmax, drecip,
+    means, weights, dmin, dmax, drecip, drecip_c,
     rows, imp_means, imp_w, imp_min, imp_max, imp_recip,
     compression: float = td.DEFAULT_COMPRESSION,
 ):
@@ -114,19 +145,25 @@ def _histo_import_step(
     weights = weights.at[rows].set(n_w, mode="drop")
     dmin = dmin.at[rows].min(imp_min, mode="drop")
     dmax = dmax.at[rows].max(imp_max, mode="drop")
-    drecip = drecip.at[rows].add(imp_recip, mode="drop")
-    return means, weights, dmin, dmax, drecip
+    n_recip, n_recip_c = _comp_add(drecip[rows], drecip_c[rows], imp_recip)
+    drecip = drecip.at[rows].set(n_recip, mode="drop")
+    drecip_c = drecip_c.at[rows].set(n_recip_c, mode="drop")
+    return means, weights, dmin, dmax, drecip, drecip_c
 
 
 @jax.jit
-def _histo_flush_extract(means, weights, dmin, dmax, drecip,
-                         lmin, lmax, lsum, lweight, lrecip, qs):
-    """One program extracting everything the flusher needs from all rows."""
+def _histo_flush_extract(means, weights, dmin, dmax, drecip, drecip_c,
+                         lmin, lmax, lsum, lsum_c, lweight, lweight_c,
+                         lrecip, lrecip_c, qs):
+    """One program extracting everything the flusher needs from all rows.
+
+    Compensated accumulators resolve to their true value (s + c) here."""
     quantiles = td.quantile(means, weights, dmin, dmax, qs)
     dsum = td.row_sum(means, weights)
     dcount = td.row_count(weights)
-    return (quantiles, dmin, dmax, dsum, dcount, drecip,
-            lmin, lmax, lsum, lweight, lrecip)
+    return (quantiles, dmin, dmax, dsum, dcount, drecip + drecip_c,
+            lmin, lmax, lsum + lsum_c, lweight + lweight_c,
+            lrecip + lrecip_c)
 
 
 @functools.partial(jax.jit, static_argnames=("new_rows",), donate_argnums=(0,))
@@ -223,11 +260,17 @@ class HistoDeviceState:
     dmin: jax.Array
     dmax: jax.Array
     drecip: jax.Array
+    # compensation halves of the compensated-f32 scalar accumulators
+    # (see _comp_add); true value = base + _c, resolved at flush extract
+    drecip_c: jax.Array
     lmin: jax.Array
     lmax: jax.Array
     lsum: jax.Array
+    lsum_c: jax.Array
     lweight: jax.Array
+    lweight_c: jax.Array
     lrecip: jax.Array
+    lrecip_c: jax.Array
 
     @classmethod
     def create(cls, rows: int, capacity: int) -> "HistoDeviceState":
@@ -240,9 +283,10 @@ class HistoDeviceState:
 
         return cls(
             means=pool.means, weights=pool.weights, dmin=pool.min,
-            dmax=pool.max, drecip=pool.recip,
+            dmax=pool.max, drecip=pool.recip, drecip_c=_full(0.0),
             lmin=_full(jnp.inf), lmax=_full(-jnp.inf), lsum=_full(0.0),
-            lweight=_full(0.0), lrecip=_full(0.0),
+            lsum_c=_full(0.0), lweight=_full(0.0), lweight_c=_full(0.0),
+            lrecip=_full(0.0), lrecip_c=_full(0.0),
         )
 
     @property
@@ -259,11 +303,15 @@ class HistoDeviceState:
             dmin=_grow_1d(self.dmin, new_rows, inf),
             dmax=_grow_1d(self.dmax, new_rows, -inf),
             drecip=_grow_1d(self.drecip, new_rows, 0.0),
+            drecip_c=_grow_1d(self.drecip_c, new_rows, 0.0),
             lmin=_grow_1d(self.lmin, new_rows, inf),
             lmax=_grow_1d(self.lmax, new_rows, -inf),
             lsum=_grow_1d(self.lsum, new_rows, 0.0),
+            lsum_c=_grow_1d(self.lsum_c, new_rows, 0.0),
             lweight=_grow_1d(self.lweight, new_rows, 0.0),
+            lweight_c=_grow_1d(self.lweight_c, new_rows, 0.0),
             lrecip=_grow_1d(self.lrecip, new_rows, 0.0),
+            lrecip_c=_grow_1d(self.lrecip_c, new_rows, 0.0),
         )
 
 
@@ -360,11 +408,13 @@ class DeviceWorker:
         return n
 
     def ingest_ssf_packet(self, packet: bytes, indicator_name: bytes,
-                          objective_name: bytes) -> int:
+                          objective_name: bytes,
+                          uniqueness_rate: float = 0.0) -> int:
         """Native-path SSF span ingest (decode + span→metric extraction in
         C++). Returns the vn_ingest_ssf rc: 1 ok, 0 decode error, -1 the
         caller must take the Python path (STATUS samples aboard)."""
-        rc = self._native.ingest_ssf(packet, indicator_name, objective_name)
+        rc = self._native.ingest_ssf(packet, indicator_name, objective_name,
+                                     uniqueness_rate)
         if rc == 1:
             self.processed += 1
             if (self._native.pending_histo >= self.batch_size
@@ -635,13 +685,15 @@ class DeviceWorker:
         w[: len(vals)] = wts
 
         out = _histo_ingest_step(
-            h.means, h.weights, h.dmin, h.dmax, h.drecip,
-            h.lmin, h.lmax, h.lsum, h.lweight, h.lrecip,
+            h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
+            h.lmin, h.lmax, h.lsum, h.lsum_c, h.lweight, h.lweight_c,
+            h.lrecip, h.lrecip_c,
             jnp.asarray(active), jnp.asarray(lids), jnp.asarray(v),
             jnp.asarray(w), compression=self.compression,
         )
-        (h.means, h.weights, h.dmin, h.dmax, h.drecip,
-         h.lmin, h.lmax, h.lsum, h.lweight, h.lrecip) = out
+        (h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
+         h.lmin, h.lmax, h.lsum, h.lsum_c, h.lweight, h.lweight_c,
+         h.lrecip, h.lrecip_c) = out
 
     def _flush_pending_sets(self) -> None:
         if not self._ps_rows:
@@ -739,13 +791,14 @@ class DeviceWorker:
                     imp_recip[i] += rc
             self._imp_digests = {}
             out = _histo_import_step(
-                h.means, h.weights, h.dmin, h.dmax, h.drecip,
+                h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
                 jnp.asarray(arows), jnp.asarray(imp_means),
                 jnp.asarray(imp_w), jnp.asarray(imp_min),
                 jnp.asarray(imp_max), jnp.asarray(imp_recip),
                 compression=self.compression,
             )
-            h.means, h.weights, h.dmin, h.dmax, h.drecip = out
+            (h.means, h.weights, h.dmin, h.dmax, h.drecip,
+             h.drecip_c) = out
 
         if self._imp_hll:
             regs = self._sets
@@ -775,14 +828,18 @@ class DeviceWorker:
                 quant, dsum, dcount = pk.flush_extract(
                     histo.means, histo.weights, histo.dmin, histo.dmax, qs)
                 return (quant, histo.dmin, histo.dmax, dsum, dcount,
-                        histo.drecip, histo.lmin, histo.lmax, histo.lsum,
-                        histo.lweight, histo.lrecip)
+                        histo.drecip + histo.drecip_c,
+                        histo.lmin, histo.lmax,
+                        histo.lsum + histo.lsum_c,
+                        histo.lweight + histo.lweight_c,
+                        histo.lrecip + histo.lrecip_c)
             except Exception:  # pragma: no cover - TPU-only path
                 DeviceWorker._pallas_ok = False
         return _histo_flush_extract(
             histo.means, histo.weights, histo.dmin, histo.dmax,
-            histo.drecip, histo.lmin, histo.lmax, histo.lsum,
-            histo.lweight, histo.lrecip, qs,
+            histo.drecip, histo.drecip_c, histo.lmin, histo.lmax,
+            histo.lsum, histo.lsum_c, histo.lweight, histo.lweight_c,
+            histo.lrecip, histo.lrecip_c, qs,
         )
 
     # -- flush --------------------------------------------------------------

@@ -245,6 +245,9 @@ class NativeIngest:
         return self._lib.vn_ssf_invalid(self._ctx)
 
     def drain_ssf_services(self) -> dict[str, int]:
+        # cap contract (see vn_drain_ssf_services): must hold at least one
+        # full "service\tcount\n" line (<= 278 bytes) or the drain loop
+        # below would exit with counts stuck buffered until next flush
         cap = 1 << 18
         buf = ctypes.create_string_buffer(cap)
         out: dict[str, int] = {}

@@ -82,20 +82,22 @@ def insert_batch(
     TPU-first formulation: a raw scatter-max with duplicate (row, register)
     indices serializes on TPU. Instead, sort by (flat register slot, rank);
     the LAST element of each equal-slot run then holds that slot's max, so
-    one scatter with unique, sorted indices applies the whole batch
-    (non-run-end elements are dropped via an out-of-range index).
+    a scatter against the sorted index vector applies the whole batch.
+    Non-run-end elements keep their (sorted, duplicate) index but have
+    their rank zeroed — max with 0 is a no-op since registers are >= 0 —
+    so the indices_are_sorted=True promise to XLA holds exactly
+    (duplicates allowed, hence unique_indices=False).
     """
     s, m = registers.shape
-    n = rows.shape[0]
     flat = rows * m + reg_idx  # fits i32 for s·m < 2^31 (s ≤ 2^17 at p=14)
     rank32 = rank.astype(jnp.int32)
     sflat, srank = jax.lax.sort((flat, rank32), dimension=0, num_keys=2)
     is_end = jnp.concatenate(
         [sflat[1:] != sflat[:-1], jnp.ones((1,), bool)])
-    target = jnp.where(is_end, sflat, s * m)  # OOB → dropped
-    out = registers.reshape(-1).at[target].max(
-        srank.astype(registers.dtype), mode="drop",
-        indices_are_sorted=True, unique_indices=True)
+    vals = jnp.where(is_end, srank, 0)  # non-run-end → no-op max(·, 0)
+    out = registers.reshape(-1).at[sflat].max(
+        vals.astype(registers.dtype), mode="drop",
+        indices_are_sorted=True, unique_indices=False)
     return out.reshape(s, m)
 
 
