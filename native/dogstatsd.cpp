@@ -58,6 +58,77 @@ inline uint64_t fmix64(uint64_t h) {
   return h;
 }
 
+// MetroHash64 — the Go fleet's set-element hash (vendored
+// axiomhq/hyperloglog hashes with metro64 seed=1337; see
+// utils/hashing.py metro_hash64 for the Python twin and the interop
+// rationale). Enabled per-context via vn_ctx_set_metro.
+inline uint64_t rotr64(uint64_t v, int k) { return (v >> k) | (v << (64 - k)); }
+
+inline uint64_t load_le(const char* p, int n) {
+  uint64_t v = 0;
+  std::memcpy(&v, p, n);  // little-endian hosts only (x86/ARM LE)
+  return v;
+}
+
+uint64_t metro_hash64(std::string_view s, uint64_t seed) {
+  constexpr uint64_t k0 = 0xD6D018F5, k1 = 0xA2AA033B, k2 = 0x62992FC1,
+                     k3 = 0x30BC5B29;
+  const char* p = s.data();
+  size_t n = s.size();
+  uint64_t h = (seed + k2) * k0;
+  if (n >= 32) {
+    uint64_t v0 = h, v1 = h, v2 = h, v3 = h;
+    while (n >= 32) {
+      v0 += load_le(p, 8) * k0; v0 = rotr64(v0, 29) + v2;
+      v1 += load_le(p + 8, 8) * k1; v1 = rotr64(v1, 29) + v3;
+      v2 += load_le(p + 16, 8) * k2; v2 = rotr64(v2, 29) + v0;
+      v3 += load_le(p + 24, 8) * k3; v3 = rotr64(v3, 29) + v1;
+      p += 32;
+      n -= 32;
+    }
+    v2 ^= rotr64((v0 + v3) * k0 + v1, 37) * k1;
+    v3 ^= rotr64((v1 + v2) * k1 + v0, 37) * k0;
+    v0 ^= rotr64((v0 + v2) * k0 + v3, 37) * k1;
+    v1 ^= rotr64((v1 + v3) * k1 + v2, 37) * k0;
+    h += v0 ^ v1;
+  }
+  if (n >= 16) {
+    uint64_t v0 = h + load_le(p, 8) * k2; v0 = rotr64(v0, 29) * k3;
+    uint64_t v1 = h + load_le(p + 8, 8) * k2; v1 = rotr64(v1, 29) * k3;
+    v0 ^= rotr64(v0 * k0, 21) + v1;
+    v1 ^= rotr64(v1 * k3, 21) + v0;
+    h += v1;
+    p += 16;
+    n -= 16;
+  }
+  if (n >= 8) {
+    h += load_le(p, 8) * k3;
+    h ^= rotr64(h, 55) * k1;
+    p += 8;
+    n -= 8;
+  }
+  if (n >= 4) {
+    h += load_le(p, 4) * k3;
+    h ^= rotr64(h, 26) * k1;
+    p += 4;
+    n -= 4;
+  }
+  if (n >= 2) {
+    h += load_le(p, 2) * k3;
+    h ^= rotr64(h, 48) * k1;
+    p += 2;
+    n -= 2;
+  }
+  if (n >= 1) {
+    h += static_cast<unsigned char>(*p) * k3;
+    h ^= rotr64(h, 37) * k1;
+  }
+  h ^= rotr64(h, 28);
+  h *= k0;
+  h ^= rotr64(h, 29);
+  return h;
+}
+
 // Strict float parse matching the Python/Go rules: full consumption, no
 // whitespace or underscores, finite. Fast path decodes the overwhelmingly
 // common statsd shapes ([-]digits[.digits], ≤15 significant digits)
@@ -226,6 +297,7 @@ struct Directory {
 
 struct Ctx {
   int hll_precision = 14;
+  bool set_hash_metro = false;
 
   Directory dir;
   int32_t next_histo_row = 0;
@@ -410,7 +482,8 @@ bool route_metric(Ctx* ctx, std::string_view name, MetricKind kind,
       pool = 1;
       row = ctx->dir.upsert(key_hash, ctx->key, ctx->next_set_row, &created);
       if (created) ++ctx->next_set_row;
-      uint64_t h = fmix64(fnv1a64(set_value));
+      uint64_t h = ctx->set_hash_metro ? metro_hash64(set_value, 1337)
+                                       : fmix64(fnv1a64(set_value));
       int p = ctx->hll_precision;
       uint32_t idx = static_cast<uint32_t>(h >> (64 - p));
       uint64_t w = h << p;
@@ -822,6 +895,16 @@ void* vn_ctx_new(int hll_precision) {
 }
 
 void vn_ctx_free(void* p) { delete static_cast<Ctx*>(p); }
+
+// Switch the set-element hash to metro64(seed=1337) for Go-fleet interop
+// (must match every other inserter of the same set series).
+void vn_ctx_set_metro(void* p, int enable) {
+  static_cast<Ctx*>(p)->set_hash_metro = enable != 0;
+}
+
+uint64_t vn_metro_hash64(const char* data, int len, uint64_t seed) {
+  return metro_hash64(std::string_view(data, static_cast<size_t>(len)), seed);
+}
 
 void vn_ctx_reset(void* p) {
   Ctx* ctx = static_cast<Ctx*>(p);

@@ -1,0 +1,334 @@
+"""Go-fleet wire-interop tests (distributed/interop.py).
+
+The decoding side is validated three independent ways: against
+hand-encoded axiomhq blobs built from the published format
+(vendor/github.com/axiomhq/hyperloglog hyperloglog.go:273-360), against a
+byte-level hand-encoded protobuf MetricList (no pb2 involved in the
+encode, so the generated schema itself is under test), and end-to-end
+through a real gRPC hop on the reference's /forwardrpc.Forward/SendMetrics
+method path.
+"""
+
+import struct
+
+import grpc
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.flusher import device_quantiles, generate_inter_metrics
+from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+from veneur_tpu.core.server import Server
+from veneur_tpu.distributed import interop
+from veneur_tpu.distributed.import_server import ImportServer
+from veneur_tpu.gen import forwardrpc_pb2 as fpb
+from veneur_tpu.gen import metricpb_pb2 as mpb
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+from veneur_tpu.utils.hashing import metro_hash64
+
+P = 14
+M = 1 << P
+PCTS = [0.5, 0.99]
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+
+
+# ---------------------------------------------------------------------------
+# metro hash
+
+
+def test_metro_hash64_canonical_vector():
+    # The canonical metrohash 63-byte test vector, quoted as the
+    # little-endian byte serialization of the u64 result.
+    v = b"012345678901234567890123456789012345678901234567890123456789012"
+    assert metro_hash64(v, 0).to_bytes(8, "little").hex() == \
+        "6b753dae06704bad"
+
+
+def test_metro_hash64_native_agreement():
+    from veneur_tpu.native import load_library
+
+    lib = load_library()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(3)
+    for n in [0, 1, 5, 8, 15, 16, 23, 32, 64, 257]:
+        data = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        assert metro_hash64(data, 1337) == lib.vn_metro_hash64(data, n, 1337)
+
+
+# ---------------------------------------------------------------------------
+# axiomhq HLL binary codec
+
+
+def _go_insert(regs: np.ndarray, h: int) -> None:
+    """What the Go fleet's dense sketch does with one 64-bit hash
+    (utils.go getPosVal: top-p index, rho of the rest)."""
+    idx = h >> (64 - P)
+    w = ((h << P) | (1 << (P - 1))) & 0xFFFFFFFFFFFFFFFF
+    rank = 64 - w.bit_length() + 1
+    if rank > regs[idx]:
+        regs[idx] = rank
+
+
+def _dense_blob(regs: np.ndarray, b: int = 0) -> bytes:
+    """Hand-build an axiomhq dense MarshalBinary blob (stored nibbles are
+    relative to base b)."""
+    stored = np.maximum(regs.astype(np.int16) - b, 0)
+    stored = np.minimum(stored, 15).astype(np.uint8)
+    packed = ((stored[0::2] << 4) | stored[1::2]).astype(np.uint8)
+    return bytes([1, P, b, 0]) + struct.pack(">I", M // 2) + packed.tobytes()
+
+
+def _encode_sparse_key(h: int) -> int:
+    """Twin of the Go encodeHash(x, p=14, pp=25) (sparse.go)."""
+    pp = 25
+    idx = (h >> (64 - pp)) & ((1 << pp) - 1)
+    between = (h >> (64 - pp)) & ((1 << (pp - P)) - 1)
+    if between == 0:
+        tail = ((h & ((1 << (64 - pp)) - 1)) << pp) | ((1 << pp) - 1)
+        zeros = 64 - tail.bit_length() + 1
+        return (idx << 7) | (zeros << 1) | 1
+    return idx << 1
+
+
+def _sparse_blob(hashes: list[int], split: int) -> bytes:
+    """Hand-build a sparse blob: first `split` hashes in the tmpSet, the
+    rest in the sorted delta-varint compressed list."""
+    keys = [_encode_sparse_key(h) for h in hashes]
+    tmp, listed = keys[:split], sorted(set(keys[split:]))
+    out = bytes([1, P, 0, 1]) + struct.pack(">I", len(tmp))
+    for k in tmp:
+        out += struct.pack(">I", k)
+    body = b""
+    last = 0
+    for k in listed:
+        delta = k - last
+        last = k
+        while delta >= 0x80:
+            body += bytes([(delta & 0x7F) | 0x80])
+            delta >>= 7
+        body += bytes([delta])
+    out += struct.pack(">I", len(listed)) + struct.pack(">I", last)
+    out += struct.pack(">I", len(body)) + body
+    return out
+
+
+def test_hll_dense_decode_roundtrip():
+    rng = np.random.default_rng(5)
+    regs = rng.integers(0, 16, M, dtype=np.uint8)
+    p, got = interop.decode_hll(_dense_blob(regs))
+    assert p == P
+    np.testing.assert_array_equal(got, regs)
+    # our encoder emits the same bytes back
+    assert interop.encode_hll(regs, P) == _dense_blob(regs)
+
+
+def test_hll_dense_decode_with_base():
+    regs = np.zeros(M, dtype=np.uint8)
+    regs[0] = 7
+    regs[1] = 3
+    # b=2: stored nibbles are value-2; every register's effective value
+    # includes the base (hyperloglog.go sumAndZeros semantics)
+    p, got = interop.decode_hll(_dense_blob(regs, b=2))
+    assert got[0] == 7 and got[1] == 3
+    assert got[2] == 2  # empty register still carries the base
+
+
+def test_hll_sparse_decode_matches_direct_insert():
+    rng = np.random.default_rng(11)
+    hashes = [int(x) for x in rng.integers(0, 2**64, 400, dtype=np.uint64)]
+    # force some rank-bearing keys (top pp-P bits zero => flagged encoding)
+    hashes += [int(x) & ((1 << (64 - 25)) - 1) | (7 << (64 - P))
+               for x in rng.integers(0, 2**64, 20, dtype=np.uint64)]
+    expect = np.zeros(M, dtype=np.uint8)
+    for h in hashes:
+        _go_insert(expect, h)
+    p, got = interop.decode_hll(_sparse_blob(hashes, split=150))
+    assert p == P
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_hll_estimate_survives_go_wire():
+    """N distinct metro-hashed members → Go-style dense sketch → wire →
+    our estimator, within the sketch's error envelope."""
+    import veneur_tpu.ops.hll as hll_ops
+
+    n = 20000
+    regs = np.zeros(M, dtype=np.uint8)
+    for i in range(n):
+        _go_insert(regs, metro_hash64(f"member-{i}".encode(), 1337))
+    _, decoded = interop.decode_hll(_dense_blob(regs))
+    est = float(np.asarray(hll_ops.estimate(
+        decoded.astype(np.int8)[None, :], P))[0])
+    assert abs(est - n) / n < 3 * 1.04 / np.sqrt(M)
+
+
+# ---------------------------------------------------------------------------
+# metricpb conversion
+
+
+def _compat_metric_list() -> fpb.MetricList:
+    lst = fpb.MetricList()
+
+    c = lst.metrics.add()
+    c.name = "go.count"
+    c.tags.append("env:prod")
+    c.type = mpb.Counter
+    c.scope = mpb.Global
+    c.counter.value = 42
+
+    g = lst.metrics.add()
+    g.name = "go.gauge"
+    g.type = mpb.Gauge
+    g.gauge.value = 2.5
+
+    h = lst.metrics.add()
+    h.name = "go.lat"
+    h.type = mpb.Timer
+    h.scope = mpb.Mixed
+    d = h.histogram.t_digest
+    vals = np.linspace(1.0, 100.0, 100)
+    for v in vals:
+        cent = d.main_centroids.add()
+        cent.mean = float(v)
+        cent.weight = 1.0
+    d.compression = 100.0
+    d.min = 1.0
+    d.max = 100.0
+    d.reciprocalSum = float(np.sum(1.0 / vals))
+
+    s = lst.metrics.add()
+    s.name = "go.users"
+    s.type = mpb.Set
+    regs = np.zeros(M, dtype=np.uint8)
+    for i in range(1000):
+        _go_insert(regs, metro_hash64(f"u{i}".encode(), 1337))
+    s.set.hyper_log_log = _dense_blob(regs)
+    return lst
+
+
+def _assert_merged(by_key):
+    assert by_key[("go.count", MetricType.COUNTER)].value == 42.0
+    assert by_key[("go.gauge", MetricType.GAUGE)].value == 2.5
+    p50 = by_key[("go.lat.50percentile", MetricType.GAUGE)].value
+    assert abs(p50 - 50.5) < 2.0
+    est = by_key[("go.users", MetricType.GAUGE)].value
+    assert abs(est - 1000) / 1000 < 0.05
+
+
+def _flush(srv: Server):
+    qs = device_quantiles(PCTS, AGGS)
+    metrics = []
+    for w, lock in zip(srv.workers, srv._worker_locks):
+        with lock:
+            snap = w.flush(qs, 10.0)
+        metrics.extend(generate_inter_metrics(snap, False, PCTS, AGGS))
+    return {(m.name, m.type): m for m in metrics}
+
+
+def test_compat_conversion_and_merge():
+    srv = Server(Config(interval="10s", percentiles=PCTS, num_workers=2,
+                        set_hash="metro"))
+    imp = ImportServer(srv)
+    batch = pb.MetricBatch()
+    for m in _compat_metric_list().metrics:
+        batch.metrics.append(interop.compat_to_internal(m))
+    imp.handle_batch(batch)
+    _assert_merged(_flush(srv))
+
+
+def test_internal_to_compat_roundtrip():
+    for m in _compat_metric_list().metrics:
+        internal = interop.compat_to_internal(m)
+        back = interop.compat_to_internal(interop.internal_to_compat(internal))
+        assert back.name == internal.name
+        assert back.kind == internal.kind
+        assert list(back.tags) == list(internal.tags)
+        which = internal.WhichOneof("value")
+        if which == "counter":
+            assert back.counter.value == internal.counter.value
+        elif which == "gauge":
+            assert back.gauge.value == internal.gauge.value
+        elif which == "digest":
+            np.testing.assert_allclose(
+                np.asarray(back.digest.centroids.means),
+                np.asarray(internal.digest.centroids.means), rtol=1e-6)
+        elif which == "hll":
+            assert back.hll.registers == internal.hll.registers
+
+
+def test_forwardrpc_grpc_end_to_end():
+    """A raw gRPC call on the reference's method path — exactly what a
+    stock Go veneur local dials (forwardrpc/forward.proto:9-17)."""
+    srv = Server(Config(interval="10s", percentiles=PCTS, num_workers=2,
+                        set_hash="metro"))
+    imp = ImportServer(srv)
+    port = imp.start_grpc()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = channel.unary_unary(
+            "/forwardrpc.Forward/SendMetrics",
+            request_serializer=fpb.MetricList.SerializeToString,
+            response_deserializer=lambda b: b,
+        )
+        call(_compat_metric_list(), timeout=10)
+        channel.close()
+        _assert_merged(_flush(srv))
+    finally:
+        imp.stop()
+
+
+# ---------------------------------------------------------------------------
+# golden wire fixture, byte-level (independent of the generated pb2)
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while n >= 0x80:
+        out += bytes([(n & 0x7F) | 0x80])
+        n >>= 7
+    return out + bytes([n])
+
+
+def _len_field(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def test_golden_wire_bytes_decode():
+    """Hand-encode a MetricList per the reference .proto field numbers
+    (metric.proto:9-59, tdigest.proto:9-24) without touching pb2, then
+    decode through the full compat path."""
+    # tdigest.Centroid {mean=12.0(f1) weight=3.0(f2)}
+    cent = (bytes([0x09]) + struct.pack("<d", 12.0)
+            + bytes([0x11]) + struct.pack("<d", 3.0))
+    # MergingDigestData {main_centroids(f1) compression(f2)=100 min(f3)=12
+    #                    max(f4)=12 reciprocalSum(f5)=0.25}
+    digest = (_len_field(1, cent)
+              + bytes([0x11]) + struct.pack("<d", 100.0)
+              + bytes([0x19]) + struct.pack("<d", 12.0)
+              + bytes([0x21]) + struct.pack("<d", 12.0)
+              + bytes([0x29]) + struct.pack("<d", 0.25))
+    # Metric {name(f1)="golden.h" tags(f2)="a:b" type(f3)=Histogram(2)
+    #         histogram(f7){t_digest(f1)} scope(f9)=Mixed(0)}
+    metric = (_len_field(1, b"golden.h") + _len_field(2, b"a:b")
+              + _varint((3 << 3) | 0) + _varint(2)
+              + _len_field(7, _len_field(1, digest)))
+    # Metric {name="golden.c" type=Counter(0) counter(f5){value(f1)=7}}
+    counter = (_len_field(1, b"golden.c")
+               + _len_field(5, _varint(1 << 3) + _varint(7))
+               + _varint((9 << 3) | 0) + _varint(2))  # scope=Global
+    blob = _len_field(1, metric) + _len_field(1, counter)
+
+    lst = fpb.MetricList.FromString(blob)
+    assert [m.name for m in lst.metrics] == ["golden.h", "golden.c"]
+
+    srv = Server(Config(interval="10s", percentiles=PCTS, num_workers=1))
+    imp = ImportServer(srv)
+    batch = pb.MetricBatch()
+    for m in lst.metrics:
+        batch.metrics.append(interop.compat_to_internal(m))
+    imp.handle_batch(batch)
+    by_key = _flush(srv)
+    assert by_key[("golden.c", MetricType.COUNTER)].value == 7.0
+    p50 = by_key[("golden.h.50percentile", MetricType.GAUGE)].value
+    assert abs(p50 - 12.0) < 1e-3

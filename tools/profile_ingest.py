@@ -1,0 +1,122 @@
+"""Stage-by-stage TPU timing of the t-digest ingest path (add_batch).
+
+Run on hardware: python tools/profile_ingest.py
+Each stage is jitted separately with a scalar force-read so the timing
+reflects real execution, not dispatch (see bench.py `force` note).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.ops import segments, tdigest as td
+
+S = 16384
+N = 1 << 22
+C = td.DEFAULT_CAPACITY
+ITERS = 10
+
+rng = np.random.default_rng(0)
+rows = jnp.asarray(rng.integers(0, S, N).astype(np.int32))
+vals = jnp.asarray(rng.gamma(2.0, 50.0, N).astype(np.float32))
+wts = jnp.ones(N, np.float32)
+pool = td.init_pool(S, C)
+
+
+def bench(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # force: pull one scalar
+    def scalar(o):
+        leaves = jax.tree_util.tree_leaves(o)
+        return float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:1])[None][0])
+    scalar(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+    scalar(out)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:34s} {dt*1e3:9.2f} ms   {N/dt/1e6:8.1f} Msamp/s")
+    return out
+
+
+@jax.jit
+def full(pool, rows, vals, wts):
+    return td.add_batch(pool.means, pool.weights, pool.min, pool.max,
+                        pool.recip, rows, vals, wts)
+
+
+@jax.jit
+def sort3(rows, vals, wts):
+    return jax.lax.sort((rows, vals, wts), dimension=0, num_keys=2)
+
+
+@jax.jit
+def sort_single_key(keys, wts):
+    return jax.lax.sort((keys, wts), dimension=0, num_keys=1)
+
+
+@jax.jit
+def segcum(sw, starts):
+    return segments.segmented_cumsum(sw, starts)
+
+
+@jax.jit
+def runsums(seg_id, sw, mw):
+    return segments.sorted_run_sums(seg_id, sw, mw)
+
+
+@jax.jit
+def compress(means, weights):
+    cat_m = jnp.concatenate([means, means], axis=-1)
+    cat_w = jnp.concatenate([weights, weights], axis=-1)
+    return td._compress_rows(cat_m, cat_w, 100.0, C)
+
+
+@jax.jit
+def quant(means, weights, dmin, dmax, qs):
+    return td.quantile(means, weights, dmin, dmax, qs)
+
+
+print("device:", jax.devices()[0])
+out = bench("add_batch (full)", full, pool, rows, vals, wts)
+
+srows, svals, sw = bench("lax.sort 2-key + payload", sort3, rows, vals, wts)
+
+# single fused key: row in high bits, value-as-sortable-u32 in low bits,
+# packed into f64 (53-bit mantissa holds 14+32 bits exactly? no — 46 bits)
+v_bits = jax.lax.bitcast_convert_type(vals, jnp.uint32)
+key64 = rows.astype(jnp.float64) * 4294967296.0 + v_bits.astype(jnp.float64)
+bench("lax.sort 1 f64 key + payload", sort_single_key, key64, wts)
+
+starts = jnp.concatenate([jnp.ones((1,), bool), srows[1:] != srows[:-1]])
+bench("segmented_cumsum", segcum, sw, starts)
+
+seg_id = srows * C + jnp.clip(
+    jnp.floor(td._k_scale(jnp.linspace(0, 1, N), 100.0)).astype(jnp.int32),
+    0, C - 1)
+rs = bench("sorted_run_sums", runsums, seg_id, sw, svals * sw)
+
+bench("_compress_rows (2C cand)", compress, pool.means, pool.weights)
+
+qs = jnp.asarray(np.array([0.5, 0.9, 0.99], np.float32))
+bench("quantile x3", quant, pool.means, pool.weights, pool.min, pool.max, qs)
+
+# 1M-series shapes for the flush-latency budget
+S2 = 1 << 20
+pool2 = td.init_pool(S2, C)
+N2 = N
+
+
+@jax.jit
+def compress_1m(means, weights):
+    cat_m = jnp.concatenate([means, means], axis=-1)
+    cat_w = jnp.concatenate([weights, weights], axis=-1)
+    return td._compress_rows(cat_m, cat_w, 100.0, C)
+
+
+bench("_compress_rows 1M series", compress_1m, pool2.means, pool2.weights)
+bench("quantile x3 1M series", quant, pool2.means, pool2.weights,
+      pool2.min, pool2.max, qs)

@@ -109,6 +109,16 @@ class Config:
     # forwarding
     forward_address: str = ""
     forward_use_grpc: bool = False
+    # wire format for gRPC forwarding: "veneurtpu" (this framework's own
+    # proto) or "forwardrpc" (the reference Go fleet's
+    # forwardrpc.Forward/SendMetrics + metricpb wire, for forwarding into
+    # a stock veneur global — see distributed/interop.py)
+    forward_format: str = "veneurtpu"
+    # set-element hash: "fnv" (this framework's own, utils/hashing.hll_hash)
+    # or "metro" (metro64 seed=1337, what the Go fleet inserts with —
+    # REQUIRED on any instance that shares set series with Go veneur
+    # instances, since HLL unions are only valid under one element hash)
+    set_hash: str = "fnv"
 
     # device / TPU execution
     tpu_native_ingest: bool = True
@@ -404,5 +414,9 @@ def validate_config(cfg: Config) -> None:
             raise ValueError(f"percentile {p} out of [0,1]")
     if cfg.num_workers < 1 or cfg.num_readers < 1:
         raise ValueError("num_workers and num_readers must be >= 1")
+    if cfg.forward_format not in ("veneurtpu", "forwardrpc"):
+        raise ValueError("forward_format must be 'veneurtpu' or 'forwardrpc'")
+    if cfg.set_hash not in ("fnv", "metro"):
+        raise ValueError("set_hash must be 'fnv' or 'metro'")
     if not (4 <= cfg.tpu_hll_precision <= 18):
         raise ValueError("tpu_hll_precision must be in [4,18]")

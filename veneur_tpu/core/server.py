@@ -89,6 +89,7 @@ class Server:
                 initial_set_rows=cfg.tpu_initial_set_rows,
                 count_unique_timeseries=cfg.count_unique_timeseries,
                 is_local=self.is_local,
+                set_hash=cfg.set_hash,
             )
             for _ in range(cfg.num_workers)
         ]

@@ -367,11 +367,19 @@ class DeviceWorker:
         initial_set_rows: int = 256,
         count_unique_timeseries: bool = False,
         is_local: bool = True,
+        set_hash: str = "fnv",
     ) -> None:
         self.batch_size = batch_size
         self.compression = compression
         self.capacity = capacity
         self.hll_precision = hll_precision
+        self.set_hash = set_hash
+        if set_hash == "metro":
+            from veneur_tpu.utils.hashing import metro_hash64
+
+            self._set_hash64 = metro_hash64
+        else:
+            self._set_hash64 = hll_hash
         self._initial_histo_rows = initial_histo_rows
         self._initial_set_rows = initial_set_rows
         self.count_unique_timeseries = count_unique_timeseries
@@ -391,7 +399,8 @@ class DeviceWorker:
         try:
             from veneur_tpu.native import NativeIngest
 
-            self._native = NativeIngest(self.hll_precision)
+            self._native = NativeIngest(self.hll_precision,
+                                        set_hash=self.set_hash)
         except (RuntimeError, OSError):
             return False
         return True
@@ -553,7 +562,7 @@ class DeviceWorker:
         elif mtype == "set":
             row = self._upsert_set(m.key, scope_class, m.tags)
             self._ensure_sets(self.directory.num_set_rows)
-            h = hll_hash(str(m.value).encode("utf-8"))
+            h = self._set_hash64(str(m.value).encode("utf-8"))
             idx, rank = hll_ops.split_hashes(
                 np.array([h], dtype=np.uint64), self.hll_precision
             )

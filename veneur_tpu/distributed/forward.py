@@ -109,8 +109,15 @@ def install_forwarder(server, compression: Optional[float] = None,
         for prefix in ("grpc://", "http://", "https://"):
             if addr.startswith(prefix):
                 addr = addr[len(prefix):]
-        server.forwarder = GRPCForwarder(
-            addr, timeout, compression, hll_precision)
+        if cfg.forward_format == "forwardrpc":
+            # upstream is a stock Go veneur global: speak its wire
+            from veneur_tpu.distributed.interop import CompatForwarder
+
+            server.forwarder = CompatForwarder(
+                addr, timeout, compression, hll_precision)
+        else:
+            server.forwarder = GRPCForwarder(
+                addr, timeout, compression, hll_precision)
     else:
         server.forwarder = HTTPForwarder(
             cfg.forward_address, timeout, compression, hll_precision)

@@ -1,0 +1,321 @@
+"""Go-fleet wire interop: speak the reference's forward protocol.
+
+A veneur-tpu global can terminate traffic from stock Go veneur locals, and
+a veneur-tpu local can forward into a Go global. Three pieces:
+
+* ``decode_hll`` / ``encode_hll`` — the axiomhq/hyperloglog MarshalBinary
+  blob carried in metricpb.SetValue (reference
+  vendor/github.com/axiomhq/hyperloglog/hyperloglog.go:273-360). Both the
+  sparse encoding (tmpSet of u32 encoded hashes + delta-varint compressed
+  list, pp=25) and the dense encoding (4-bit tailcut registers with base
+  offset ``b``) decode to a flat register row; we emit the dense form.
+
+* ``compat_to_internal`` / ``internal_to_compat`` — metricpb.Metric
+  (reference samplers/metricpb/metric.proto:9-59) ↔ this framework's own
+  Metric message, so the compat path rejoins the normal import/merge flow
+  unchanged. Centroids travel f64 on the reference wire and live f32 in
+  the device pool; the conversion is lossy at ~1e-7 relative, far inside
+  the 1% quantile budget asserted by the t-digest tests.
+
+* ``add_compat_service`` / ``CompatForwarder`` — the gRPC service twin of
+  forwardrpc.Forward/SendMetrics (reference forwardrpc/forward.proto:9-17)
+  for both directions.
+
+Hash caveat (documented in example.yaml): HLL unions are only valid when
+every inserter uses the same element hash. The Go fleet hashes set members
+with metro64(seed=1337); set ``set_hash: metro`` on veneur-tpu instances
+that share set series with Go instances (utils/hashing.metro_hash64).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+import grpc
+import numpy as np
+
+from veneur_tpu.gen import forwardrpc_pb2 as fpb
+from veneur_tpu.gen import metricpb_pb2 as mpb
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+log = logging.getLogger("veneur_tpu.interop")
+
+SERVICE_NAME = "forwardrpc.Forward"
+SEND_METRICS = f"/{SERVICE_NAME}/SendMetrics"
+
+_SPARSE_PP = 25  # the sparse encoding's fixed high precision ("pp")
+
+
+# ---------------------------------------------------------------------------
+# axiomhq/hyperloglog binary codec
+
+
+def _clz32(w: int) -> int:
+    if w == 0:
+        return 32
+    return 32 - w.bit_length()
+
+
+def _decode_sparse_key(k: int, p: int) -> tuple[int, int]:
+    """One sparse-encoded hash → (register index, rank) at precision p.
+
+    Keys store the top pp=25 hash bits (plus, when those can't determine
+    the rank, an explicit 6-bit rank field flagged by bit 0) — reference
+    sparse.go encodeHash/decodeHash.
+    """
+    if k & 1:
+        rank = ((k >> 1) & 0x3F) + _SPARSE_PP - p
+        idx = (k >> (32 - p)) & ((1 << p) - 1)
+    else:
+        w = (k << (32 - _SPARSE_PP + p - 1)) & 0xFFFFFFFF
+        rank = _clz32(w) + 1
+        idx = (k >> (_SPARSE_PP - p + 1)) & ((1 << p) - 1)
+    return idx, rank
+
+
+def decode_hll(data: bytes) -> tuple[int, np.ndarray]:
+    """axiomhq MarshalBinary blob → (precision, uint8[2^p] registers).
+
+    Register value semantics: effective rank = stored value (+ base ``b``
+    for dense blobs); 0 = never written. The flat row merges into the
+    device pool with elementwise max like any native row.
+    """
+    if len(data) < 8:
+        raise ValueError("HLL blob too short")
+    p = data[1]
+    if not 4 <= p <= 18:
+        raise ValueError(f"HLL precision {p} out of range")
+    b = data[2]
+    m = 1 << p
+    regs = np.zeros(m, dtype=np.uint8)
+    if data[3] == 1:  # sparse: tmpSet then compressed delta-varint list
+        n_tmp = int.from_bytes(data[4:8], "big")
+        off = 8
+        for _ in range(n_tmp):
+            k = int.from_bytes(data[off:off + 4], "big")
+            off += 4
+            idx, rank = _decode_sparse_key(k, p)
+            if rank > regs[idx]:
+                regs[idx] = rank
+        # compressedList: count, last (both ignored for decode), then the
+        # variable-length byte list of deltas (7-bit groups, 0x80 continues)
+        off += 8
+        size = int.from_bytes(data[off:off + 4], "big")
+        off += 4
+        buf = data[off:off + size]
+        i = 0
+        last = 0
+        while i < len(buf):
+            x = 0
+            shift = 0
+            while buf[i] & 0x80:
+                x |= (buf[i] & 0x7F) << shift
+                shift += 7
+                i += 1
+            x |= buf[i] << shift
+            i += 1
+            last = (last + x) & 0xFFFFFFFF
+            idx, rank = _decode_sparse_key(last, p)
+            if rank > regs[idx]:
+                regs[idx] = rank
+        return p, regs
+    # dense: u32 byte count then packed 4-bit register pairs
+    # (register 2j = high nibble of byte j, 2j+1 = low nibble), all offset
+    # by base b (registers.go tailcut scheme)
+    nbytes = int.from_bytes(data[4:8], "big")
+    packed = np.frombuffer(data[8:8 + nbytes], dtype=np.uint8)
+    if packed.shape[0] != m // 2:
+        raise ValueError(
+            f"dense HLL blob has {packed.shape[0]} bytes, expected {m // 2}")
+    regs[0::2] = packed >> 4
+    regs[1::2] = packed & 0x0F
+    if b:
+        # b > 0 means every register's effective value includes the base,
+        # even stored zeros (hyperloglog.go sumAndZeros)
+        regs = (regs.astype(np.uint16) + b).clip(max=255).astype(np.uint8)
+    return p, regs
+
+
+def encode_hll(registers: np.ndarray, precision: int) -> bytes:
+    """uint8 register row → axiomhq dense MarshalBinary blob.
+
+    Emitted with base b=0 and ranks clamped to the 4-bit tailcut capacity
+    (15). At p=14 the chance a random element's rank exceeds 15 is 2^-15
+    per register write, so the clamp's effect on the harmonic sum is far
+    below the sketch's 1.04/√m intrinsic error.
+    """
+    regs = np.asarray(registers, dtype=np.uint8)
+    m = 1 << precision
+    if regs.shape[0] != m:
+        raise ValueError(f"register row has {regs.shape[0]} != 2^{precision}")
+    clamped = np.minimum(regs, 15)
+    packed = ((clamped[0::2] << 4) | clamped[1::2]).astype(np.uint8)
+    header = bytes([1, precision, 0, 0]) + (m // 2).to_bytes(4, "big")
+    return header + packed.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# metricpb.Metric ↔ internal Metric
+
+
+_TYPE_TO_KIND = {
+    mpb.Counter: pb.KIND_COUNTER,
+    mpb.Gauge: pb.KIND_GAUGE,
+    mpb.Histogram: pb.KIND_HISTOGRAM,
+    mpb.Set: pb.KIND_SET,
+    mpb.Timer: pb.KIND_TIMER,
+}
+_KIND_TO_TYPE = {v: k for k, v in _TYPE_TO_KIND.items()}
+
+_SCOPE_TO_INTERNAL = {
+    mpb.Mixed: pb.SCOPE_MIXED,
+    mpb.Local: pb.SCOPE_LOCAL,
+    mpb.Global: pb.SCOPE_GLOBAL,
+}
+_SCOPE_FROM_INTERNAL = {v: k for k, v in _SCOPE_TO_INTERNAL.items()}
+
+
+def compat_to_internal(m: mpb.Metric) -> pb.Metric:
+    """Reference-wire metric → internal metric (merge-ready)."""
+    out = pb.Metric()
+    out.name = m.name
+    out.tags.extend(m.tags)
+    out.kind = _TYPE_TO_KIND[m.type]
+    out.scope = _SCOPE_TO_INTERNAL.get(m.scope, pb.SCOPE_MIXED)
+    which = m.WhichOneof("value")
+    if which == "counter":
+        out.counter.value = m.counter.value
+    elif which == "gauge":
+        out.gauge.value = m.gauge.value
+    elif which == "histogram":
+        d = m.histogram.t_digest
+        for c in d.main_centroids:
+            if c.weight > 0:
+                out.digest.centroids.means.append(c.mean)
+                out.digest.centroids.weights.append(c.weight)
+        out.digest.min = d.min
+        out.digest.max = d.max
+        out.digest.reciprocal_sum = d.reciprocalSum
+        out.digest.compression = d.compression or 100.0
+    elif which == "set":
+        p, regs = decode_hll(m.set.hyper_log_log)
+        out.hll.registers = regs.astype(np.int8).tobytes()
+        out.hll.precision = p
+    else:
+        raise ValueError(f"metric {m.name!r} carries no value")
+    return out
+
+
+def internal_to_compat(m: pb.Metric) -> mpb.Metric:
+    """Internal metric → reference-wire metric (forwardable to a Go
+    global — the twin of the reference's own ForwardableMetrics encode,
+    worker.go:181-209)."""
+    out = mpb.Metric()
+    out.name = m.name
+    out.tags.extend(m.tags)
+    out.type = _KIND_TO_TYPE[m.kind]
+    out.scope = _SCOPE_FROM_INTERNAL.get(m.scope, mpb.Mixed)
+    which = m.WhichOneof("value")
+    if which == "counter":
+        out.counter.value = m.counter.value
+    elif which == "gauge":
+        out.gauge.value = m.gauge.value
+    elif which == "digest":
+        d = out.histogram.t_digest
+        for mean, weight in zip(m.digest.centroids.means,
+                                m.digest.centroids.weights):
+            c = d.main_centroids.add()
+            c.mean = float(mean)
+            c.weight = float(weight)
+        d.compression = m.digest.compression or 100.0
+        d.min = m.digest.min
+        d.max = m.digest.max
+        d.reciprocalSum = m.digest.reciprocal_sum
+    elif which == "hll":
+        regs = np.frombuffer(m.hll.registers, dtype=np.int8).astype(np.uint8)
+        out.set.hyper_log_log = encode_hll(regs, m.hll.precision or 14)
+    else:
+        raise ValueError(f"metric {m.name!r} carries no value")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# gRPC service + client (forwardrpc.Forward)
+
+
+def _empty_bytes(_msg=None) -> bytes:
+    return b""  # google.protobuf.Empty serializes to zero bytes
+
+
+def add_compat_service(server: grpc.Server,
+                       handler: Callable[[pb.MetricBatch], None]) -> None:
+    """Register /forwardrpc.Forward/SendMetrics on an existing gRPC
+    server. Incoming MetricLists are converted and handed to the same
+    batch handler as the native service, so both wires share one merge
+    path."""
+
+    def send_metrics(request: fpb.MetricList, context) -> bytes:
+        batch = pb.MetricBatch()
+        for m in request.metrics:
+            try:
+                batch.metrics.append(compat_to_internal(m))
+            except ValueError as e:
+                log.debug("skipping compat metric %s: %s", m.name, e)
+        handler(batch)
+        return b""
+
+    rpc_handlers = grpc.method_handlers_generic_handler(
+        SERVICE_NAME,
+        {
+            "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                send_metrics,
+                request_deserializer=fpb.MetricList.FromString,
+                response_serializer=_empty_bytes,
+            )
+        },
+    )
+    server.add_generic_rpc_handlers((rpc_handlers,))
+
+
+class CompatForwarder:
+    """Forward snapshots to a stock Go veneur global over its own wire
+    (the local side of reference flusher.forwardGRPC, flusher.go:474-534).
+    Errors are counted, never retried."""
+
+    def __init__(self, address: str, timeout_s: float = 10.0,
+                 compression: float = 100.0, hll_precision: int = 14) -> None:
+        self.address = address
+        self.timeout_s = timeout_s
+        self.compression = compression
+        self.hll_precision = hll_precision
+        self.errors = 0
+        self.sent_batches = 0
+        self.channel = grpc.insecure_channel(address)
+        self._call = self.channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=fpb.MetricList.SerializeToString,
+            response_deserializer=lambda b: None,
+        )
+
+    def __call__(self, snapshots) -> None:
+        from veneur_tpu.distributed import codec
+
+        out = fpb.MetricList()
+        for snap in snapshots:
+            batch = codec.snapshot_to_batch(
+                snap, self.compression, self.hll_precision)
+            for m in batch.metrics:
+                out.metrics.append(internal_to_compat(m))
+        if not out.metrics:
+            return
+        try:
+            self._call(out, timeout=self.timeout_s)
+            self.sent_batches += 1
+        except grpc.RpcError as e:
+            self.errors += 1
+            log.warning("compat forward to %s failed: %s",
+                        self.address, e.code())
+
+    def close(self) -> None:
+        self.channel.close()

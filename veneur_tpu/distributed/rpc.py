@@ -21,10 +21,14 @@ SEND_METRICS = f"/{SERVICE_NAME}/SendMetrics"
 
 def make_server(handler: Callable[[pb.MetricBatch], None],
                 address: str = "127.0.0.1:0",
-                max_workers: int = 4) -> tuple[grpc.Server, int]:
+                max_workers: int = 4,
+                compat: bool = True) -> tuple[grpc.Server, int]:
     """Start a Forward gRPC server; returns (server, bound_port).
 
     handler receives each MetricBatch; exceptions become INTERNAL errors.
+    With compat=True (the default) the same port also serves the reference
+    Go fleet's /forwardrpc.Forward/SendMetrics wire (distributed/interop),
+    feeding the same handler.
     """
 
     def send_metrics(request: pb.MetricBatch, context) -> pb.SendResponse:
@@ -43,6 +47,10 @@ def make_server(handler: Callable[[pb.MetricBatch], None],
     )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((rpc_handlers,))
+    if compat:
+        from veneur_tpu.distributed.interop import add_compat_service
+
+        add_compat_service(server, handler)
     port = server.add_insecure_port(address)
     server.start()
     return server, port

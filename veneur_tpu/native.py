@@ -40,7 +40,9 @@ def load_library() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build():
+        # make is dependency-checked, so this is a no-op when the .so is
+        # current and a rebuild when dogstatsd.cpp changed underneath it
+        if not _build() and not os.path.exists(_LIB_PATH):
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         c = ctypes
@@ -93,6 +95,9 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.vn_ssf_invalid.argtypes = [c.c_void_p]
         lib.vn_drain_ssf_services.restype = c.c_int
         lib.vn_drain_ssf_services.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+        lib.vn_ctx_set_metro.argtypes = [c.c_void_p, c.c_int]
+        lib.vn_metro_hash64.restype = c.c_uint64
+        lib.vn_metro_hash64.argtypes = [c.c_char_p, c.c_int, c.c_uint64]
         _lib = lib
         return _lib
 
@@ -104,12 +109,15 @@ def _ptr(arr: np.ndarray):
 class NativeIngest:
     """One epoch-scoped native parser+directory context."""
 
-    def __init__(self, hll_precision: int = 14) -> None:
+    def __init__(self, hll_precision: int = 14,
+                 set_hash: str = "fnv") -> None:
         lib = load_library()
         if lib is None:
             raise RuntimeError("native library unavailable")
         self._lib = lib
         self._ctx = lib.vn_ctx_new(hll_precision)
+        if set_hash == "metro":
+            lib.vn_ctx_set_metro(self._ctx, 1)
 
     def __del__(self):
         if getattr(self, "_ctx", None):

@@ -85,3 +85,90 @@ def hll_hash_batch(values: list[bytes]) -> np.ndarray:
     for i, v in enumerate(values):
         out[i] = fmix64(fnv1a_64(v))
     return out
+
+
+# ---------------------------------------------------------------------------
+# MetroHash64 — the Go fleet's set-element hash.
+#
+# The reference's HLL inserts hash set members with metro64 seed=1337
+# (vendored axiomhq/hyperloglog utils.go:68-70 → dgryski/go-metro). HLL
+# unions are only valid when every inserter uses the same element hash, so
+# interop deployments (set series shared between Go and tpu instances)
+# must hash with this instead of hll_hash — config knob set_hash: metro.
+
+_M_K0 = 0xD6D018F5
+_M_K1 = 0xA2AA033B
+_M_K2 = 0x62992FC1
+_M_K3 = 0x30BC5B29
+
+
+def _rotr(v: int, k: int) -> int:
+    return ((v >> k) | (v << (64 - k))) & _U64
+
+
+def metro_hash64(data: bytes, seed: int = 1337) -> int:
+    """64-bit MetroHash of ``data`` (matches dgryski/go-metro Hash64)."""
+    h = ((seed + _M_K2) * _M_K0) & _U64
+    n = len(data)
+    off = 0
+    if n >= 32:
+        v = [h, h, h, h]
+        while n - off >= 32:
+            v[0] = (v[0] + int.from_bytes(data[off:off + 8], "little")
+                    * _M_K0) & _U64
+            v[0] = (_rotr(v[0], 29) + v[2]) & _U64
+            v[1] = (v[1] + int.from_bytes(data[off + 8:off + 16], "little")
+                    * _M_K1) & _U64
+            v[1] = (_rotr(v[1], 29) + v[3]) & _U64
+            v[2] = (v[2] + int.from_bytes(data[off + 16:off + 24], "little")
+                    * _M_K2) & _U64
+            v[2] = (_rotr(v[2], 29) + v[0]) & _U64
+            v[3] = (v[3] + int.from_bytes(data[off + 24:off + 32], "little")
+                    * _M_K3) & _U64
+            v[3] = (_rotr(v[3], 29) + v[1]) & _U64
+            off += 32
+        v[2] ^= (_rotr(((v[0] + v[3]) * _M_K0 + v[1]) & _U64, 37)
+                 * _M_K1) & _U64
+        v[3] ^= (_rotr(((v[1] + v[2]) * _M_K1 + v[0]) & _U64, 37)
+                 * _M_K0) & _U64
+        v[0] ^= (_rotr(((v[0] + v[2]) * _M_K0 + v[3]) & _U64, 37)
+                 * _M_K1) & _U64
+        v[1] ^= (_rotr(((v[1] + v[3]) * _M_K1 + v[2]) & _U64, 37)
+                 * _M_K0) & _U64
+        h = (h + (v[0] ^ v[1])) & _U64
+    if n - off >= 16:
+        v0 = (h + int.from_bytes(data[off:off + 8], "little") * _M_K2) & _U64
+        v0 = (_rotr(v0, 29) * _M_K3) & _U64
+        v1 = (h + int.from_bytes(data[off + 8:off + 16], "little")
+              * _M_K2) & _U64
+        v1 = (_rotr(v1, 29) * _M_K3) & _U64
+        v0 ^= (_rotr((v0 * _M_K0) & _U64, 21) + v1) & _U64
+        v1 ^= (_rotr((v1 * _M_K3) & _U64, 21) + v0) & _U64
+        h = (h + v1) & _U64
+        off += 16
+    if n - off >= 8:
+        h = (h + int.from_bytes(data[off:off + 8], "little") * _M_K3) & _U64
+        h ^= (_rotr(h, 55) * _M_K1) & _U64
+        off += 8
+    if n - off >= 4:
+        h = (h + int.from_bytes(data[off:off + 4], "little") * _M_K3) & _U64
+        h ^= (_rotr(h, 26) * _M_K1) & _U64
+        off += 4
+    if n - off >= 2:
+        h = (h + int.from_bytes(data[off:off + 2], "little") * _M_K3) & _U64
+        h ^= (_rotr(h, 48) * _M_K1) & _U64
+        off += 2
+    if n - off >= 1:
+        h = (h + data[off] * _M_K3) & _U64
+        h ^= (_rotr(h, 37) * _M_K1) & _U64
+    h ^= _rotr(h, 28)
+    h = (h * _M_K0) & _U64
+    h ^= _rotr(h, 29)
+    return h
+
+
+def metro_hash64_batch(values: list[bytes], seed: int = 1337) -> np.ndarray:
+    out = np.empty(len(values), dtype=np.uint64)
+    for i, v in enumerate(values):
+        out[i] = metro_hash64(v, seed)
+    return out
