@@ -20,6 +20,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <string_view>
 #include <unordered_map>
@@ -299,6 +300,16 @@ struct Ctx {
   int hll_precision = 14;
   bool set_hash_metro = false;
 
+  // Guards every mutation; taken by all exported entry points so readers
+  // calling vn_ingest_routed can commit into any shard while the Python
+  // flush path drains another. Parsing never holds it (thread-local
+  // scratch), so it only covers the short directory-upsert + SoA append.
+  // Recursive so the flush path can hold it across its whole multi-call
+  // drain→sync→reset sequence (vn_lock/vn_unlock) — otherwise a routed
+  // commit slipping between the last drain and the reset would be
+  // destroyed with the old epoch.
+  std::recursive_mutex mu;
+
   Directory dir;
   int32_t next_histo_row = 0;
   int32_t next_set_row = 0;
@@ -332,8 +343,8 @@ struct Ctx {
   std::string ssf_services_out;  // drained lines awaiting pickup
   uint64_t uniq_rng = 0x9E3779B97F4A7C15ull;
 
-  // scratch reused across lines
-  std::vector<std::string_view> tags;
+  // scratch reused across lines (SSF extraction builds `joined` itself;
+  // DogStatsD tag parsing uses the thread-local Scratch instead)
   std::string joined;
   std::string key;
 };
@@ -342,8 +353,29 @@ bool route_metric(Ctx* ctx, std::string_view name, MetricKind kind,
                   double value, std::string_view set_value,
                   double sample_rate, int scope);
 
-// Parse one metric line; returns false on parse error.
-bool handle_line(Ctx* ctx, std::string_view line) {
+// Parse-phase scratch, one per reader thread: parsing (tag sort/join —
+// the expensive part of a line) runs with no lock held; only the commit
+// into the target shard takes that shard's mutex.
+struct Scratch {
+  std::vector<std::string_view> tags;
+  std::string joined;
+};
+
+struct Parsed {
+  std::string_view name;
+  MetricKind kind = KIND_COUNTER;
+  double value = 0;
+  std::string_view set_value;
+  double sample_rate = 1.0;
+  int scope = 0;
+  uint32_t digest = 0;  // worker-routing digest (fnv1a32 of identity)
+};
+
+bool commit_metric(Ctx* ctx, const Parsed& p, const std::string& joined);
+
+// Parse one metric line into `out` (tags normalized into sc->joined);
+// returns false on parse error. No ctx access — safe concurrently.
+bool parse_line(Scratch* sc, std::string_view line, Parsed* out) {
   size_t colon = line.find(':');
   if (colon == std::string_view::npos || colon == 0) return false;
   std::string_view name = line.substr(0, colon);
@@ -379,8 +411,8 @@ bool handle_line(Ctx* ctx, std::string_view line) {
   double sample_rate = 1.0;
   bool found_rate = false, found_tags = false;
   int scope = 0;
-  ctx->tags.clear();
-  ctx->joined.clear();
+  sc->tags.clear();
+  sc->joined.clear();
 
   size_t pos = pipe2;
   while (pos != std::string_view::npos) {
@@ -401,30 +433,30 @@ bool handle_line(Ctx* ctx, std::string_view line) {
       std::string_view rest = chunk.substr(1);
       while (true) {
         size_t comma = rest.find(',');
-        ctx->tags.push_back(rest.substr(0, comma));
+        sc->tags.push_back(rest.substr(0, comma));
         if (comma == std::string_view::npos) break;
         rest = rest.substr(comma + 1);
       }
-      std::sort(ctx->tags.begin(), ctx->tags.end());
+      std::sort(sc->tags.begin(), sc->tags.end());
       // first magic scope tag (prefix match) is consumed
       // (samplers/parser.go:394-408)
-      for (size_t i = 0; i < ctx->tags.size(); ++i) {
+      for (size_t i = 0; i < sc->tags.size(); ++i) {
         constexpr std::string_view kLocal = "veneurlocalonly";
         constexpr std::string_view kGlobal = "veneurglobalonly";
-        if (ctx->tags[i].substr(0, kLocal.size()) == kLocal) {
+        if (sc->tags[i].substr(0, kLocal.size()) == kLocal) {
           scope = 1;
-          ctx->tags.erase(ctx->tags.begin() + i);
+          sc->tags.erase(sc->tags.begin() + i);
           break;
         }
-        if (ctx->tags[i].substr(0, kGlobal.size()) == kGlobal) {
+        if (sc->tags[i].substr(0, kGlobal.size()) == kGlobal) {
           scope = 2;
-          ctx->tags.erase(ctx->tags.begin() + i);
+          sc->tags.erase(sc->tags.begin() + i);
           break;
         }
       }
-      for (size_t i = 0; i < ctx->tags.size(); ++i) {
-        if (i) ctx->joined.push_back(',');
-        ctx->joined.append(ctx->tags[i]);
+      for (size_t i = 0; i < sc->tags.size(); ++i) {
+        if (i) sc->joined.push_back(',');
+        sc->joined.append(sc->tags[i]);
       }
     } else {
       return false;
@@ -432,23 +464,60 @@ bool handle_line(Ctx* ctx, std::string_view line) {
     pos = next;
   }
 
-  return route_metric(ctx, name, kind, value, set_value, sample_rate, scope);
+  out->name = name;
+  out->kind = kind;
+  out->value = value;
+  out->set_value = set_value;
+  out->sample_rate = sample_rate;
+  out->scope = scope;
+  // identity digest: fnv1a32 over name, type, joined tags (parse-time
+  // digest, samplers/parser.go:325-420); doubles as the shard router
+  uint32_t digest = fnv1a32(name);
+  digest = fnv1a32(kind_type_string(kind), digest);
+  digest = fnv1a32(sc->joined, digest);
+  out->digest = digest;
+  return true;
+}
+
+// Parse one metric line and commit it into ctx (single-shard path).
+bool handle_line(Ctx* ctx, std::string_view line) {
+  thread_local Scratch sc;
+  Parsed p;
+  if (!parse_line(&sc, line, &p)) return false;
+  return commit_metric(ctx, p, sc.joined);
 }
 
 // Route one parsed/converted sample into the pools. Expects ctx->joined to
-// hold the sorted, magic-stripped tag string. Shared by the DogStatsD text
-// parser above and the SSF span extraction below.
+// hold the sorted, magic-stripped tag string. Used by the SSF span
+// extraction below (which builds ctx->joined itself); the DogStatsD text
+// path goes parse_line → commit_metric.
 bool route_metric(Ctx* ctx, std::string_view name, MetricKind kind,
                   double value, std::string_view set_value,
                   double sample_rate, int scope) {
-  const char* type_str = kind_type_string(kind);
-  ScopeClass cls = classify(kind, scope);
-
-  // identity digest: fnv1a32 over name, type, joined tags (parse-time
-  // digest, samplers/parser.go:325-420)
+  Parsed p;
+  p.name = name;
+  p.kind = kind;
+  p.value = value;
+  p.set_value = set_value;
+  p.sample_rate = sample_rate;
+  p.scope = scope;
   uint32_t digest = fnv1a32(name);
-  digest = fnv1a32(type_str, digest);
+  digest = fnv1a32(kind_type_string(kind), digest);
   digest = fnv1a32(ctx->joined, digest);
+  p.digest = digest;
+  return commit_metric(ctx, p, ctx->joined);
+}
+
+// Commit one parsed metric into a shard's directory + SoA buffers.
+// Caller holds ctx->mu (or owns the ctx exclusively).
+bool commit_metric(Ctx* ctx, const Parsed& p, const std::string& joined) {
+  std::string_view name = p.name;
+  MetricKind kind = p.kind;
+  double value = p.value;
+  std::string_view set_value = p.set_value;
+  double sample_rate = p.sample_rate;
+  const char* type_str = kind_type_string(kind);
+  ScopeClass cls = classify(kind, p.scope);
 
   // directory key spans identity + scope class (the same MetricKey can
   // legally live in two scope maps)
@@ -457,11 +526,11 @@ bool route_metric(Ctx* ctx, std::string_view name, MetricKind kind,
   ctx->key.push_back('\x1f');
   ctx->key.append(type_str);
   ctx->key.push_back('\x1f');
-  ctx->key.append(ctx->joined);
+  ctx->key.append(joined);
   ctx->key.push_back('\x1f');
   ctx->key.push_back(static_cast<char>('0' + cls));
   uint64_t key_hash =
-      fmix64((static_cast<uint64_t>(digest) << 32) ^ fnv1a64(ctx->key));
+      fmix64((static_cast<uint64_t>(p.digest) << 32) ^ fnv1a64(ctx->key));
 
   bool created = false;
   int32_t row;
@@ -523,7 +592,7 @@ bool route_metric(Ctx* ctx, std::string_view name, MetricKind kind,
     ns.kind = kind;
     ns.scope_class = cls;
     ns.name.assign(name);
-    ns.joined_tags = ctx->joined;
+    ns.joined_tags = joined;
     ctx->new_series.push_back(std::move(ns));
   }
   return true;
@@ -902,12 +971,21 @@ void vn_ctx_set_metro(void* p, int enable) {
   static_cast<Ctx*>(p)->set_hash_metro = enable != 0;
 }
 
+// Hold the context lock across a multi-call sequence (the mutex is
+// recursive, so the individual exports still work while held). The flush
+// path wraps its drain→sync→reset in this so no routed commit can land
+// between the last drain and the reset and be destroyed with the epoch.
+// ctypes releases the GIL, so blocking here cannot deadlock Python.
+void vn_lock(void* p) { static_cast<Ctx*>(p)->mu.lock(); }
+void vn_unlock(void* p) { static_cast<Ctx*>(p)->mu.unlock(); }
+
 uint64_t vn_metro_hash64(const char* data, int len, uint64_t seed) {
   return metro_hash64(std::string_view(data, static_cast<size_t>(len)), seed);
 }
 
 void vn_ctx_reset(void* p) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   ctx->dir.reset();
   ctx->next_histo_row = ctx->next_set_row = 0;
   ctx->next_counter_row = ctx->next_gauge_row = 0;
@@ -935,6 +1013,7 @@ void vn_ctx_reset(void* p) {
 // Returns the number of metric lines accepted.
 int vn_ingest(void* p, const char* buf, int len) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   std::string_view data(buf, static_cast<size_t>(len));
   int accepted = 0;
   while (!data.empty()) {
@@ -959,31 +1038,84 @@ int vn_ingest(void* p, const char* buf, int len) {
   return accepted;
 }
 
-int vn_pending_histo(void* p) {
-  return static_cast<int>(static_cast<Ctx*>(p)->h_rows.size());
+// Sharded ingest: parse each line lock-free (thread-local scratch), then
+// commit into shard digest % nctx under only that shard's mutex — the
+// native twin of the reference's contention-free Digest%N worker routing
+// (server.go:1028-1039). Multiple SO_REUSEPORT readers call this
+// concurrently; ctypes drops the GIL, so parsing genuinely parallelizes.
+// Events/service checks and parse errors land on shard 0.
+int vn_ingest_routed(void** ctxps, int nctx, const char* buf, int len) {
+  thread_local Scratch sc;
+  Ctx** ctxs = reinterpret_cast<Ctx**>(ctxps);
+  std::string_view data(buf, static_cast<size_t>(len));
+  int accepted = 0;
+  while (!data.empty()) {
+    size_t nl = data.find('\n');
+    std::string_view line =
+        nl == std::string_view::npos ? data : data.substr(0, nl);
+    data = nl == std::string_view::npos ? std::string_view()
+                                        : data.substr(nl + 1);
+    if (line.empty()) continue;
+    if (line.substr(0, 3) == "_e{" || line.substr(0, 3) == "_sc") {
+      std::lock_guard<std::recursive_mutex> g(ctxs[0]->mu);
+      ctxs[0]->other_lines.append(line);
+      ctxs[0]->other_lines.push_back('\n');
+      continue;
+    }
+    Parsed parsed;
+    if (!parse_line(&sc, line, &parsed)) {
+      std::lock_guard<std::recursive_mutex> g(ctxs[0]->mu);
+      ++ctxs[0]->errors;
+      continue;
+    }
+    Ctx* target = ctxs[parsed.digest % static_cast<uint32_t>(nctx)];
+    std::lock_guard<std::recursive_mutex> g(target->mu);
+    if (commit_metric(target, parsed, sc.joined)) {
+      ++target->processed;
+      ++accepted;
+    } else {
+      ++target->errors;
+    }
+  }
+  return accepted;
 }
-int vn_pending_set(void* p) {
-  return static_cast<int>(static_cast<Ctx*>(p)->s_rows.size());
+
+static int locked_size(void* p, const std::vector<int32_t> Ctx::* field) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> g(ctx->mu);
+  return static_cast<int>((ctx->*field).size());
 }
-int vn_pending_counter(void* p) {
-  return static_cast<int>(static_cast<Ctx*>(p)->c_rows.size());
+
+static int locked_i32(void* p, int32_t Ctx::* field) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> g(ctx->mu);
+  return ctx->*field;
 }
-int vn_pending_gauge(void* p) {
-  return static_cast<int>(static_cast<Ctx*>(p)->g_rows.size());
-}
-int vn_num_histo_rows(void* p) {
-  return static_cast<Ctx*>(p)->next_histo_row;
-}
-int vn_num_set_rows(void* p) { return static_cast<Ctx*>(p)->next_set_row; }
+
+int vn_pending_histo(void* p) { return locked_size(p, &Ctx::h_rows); }
+int vn_pending_set(void* p) { return locked_size(p, &Ctx::s_rows); }
+int vn_pending_counter(void* p) { return locked_size(p, &Ctx::c_rows); }
+int vn_pending_gauge(void* p) { return locked_size(p, &Ctx::g_rows); }
+int vn_num_histo_rows(void* p) { return locked_i32(p, &Ctx::next_histo_row); }
+int vn_num_set_rows(void* p) { return locked_i32(p, &Ctx::next_set_row); }
 int vn_num_counter_rows(void* p) {
-  return static_cast<Ctx*>(p)->next_counter_row;
+  return locked_i32(p, &Ctx::next_counter_row);
 }
-int vn_num_gauge_rows(void* p) { return static_cast<Ctx*>(p)->next_gauge_row; }
-long long vn_processed(void* p) { return static_cast<Ctx*>(p)->processed; }
-long long vn_errors(void* p) { return static_cast<Ctx*>(p)->errors; }
+int vn_num_gauge_rows(void* p) { return locked_i32(p, &Ctx::next_gauge_row); }
+long long vn_processed(void* p) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> g(ctx->mu);
+  return ctx->processed;
+}
+long long vn_errors(void* p) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> g(ctx->mu);
+  return ctx->errors;
+}
 
 int vn_drain_histo(void* p, int32_t* rows, float* vals, float* wts, int cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   int n = std::min<int>(cap, static_cast<int>(ctx->h_rows.size()));
   std::memcpy(rows, ctx->h_rows.data(), n * sizeof(int32_t));
   std::memcpy(vals, ctx->h_vals.data(), n * sizeof(float));
@@ -997,6 +1129,7 @@ int vn_drain_histo(void* p, int32_t* rows, float* vals, float* wts, int cap) {
 int vn_drain_set(void* p, int32_t* rows, int32_t* idx, int8_t* rank,
                  int cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   int n = std::min<int>(cap, static_cast<int>(ctx->s_rows.size()));
   std::memcpy(rows, ctx->s_rows.data(), n * sizeof(int32_t));
   std::memcpy(idx, ctx->s_idx.data(), n * sizeof(int32_t));
@@ -1009,6 +1142,7 @@ int vn_drain_set(void* p, int32_t* rows, int32_t* idx, int8_t* rank,
 
 int vn_drain_counter(void* p, int32_t* rows, double* contribs, int cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   int n = std::min<int>(cap, static_cast<int>(ctx->c_rows.size()));
   std::memcpy(rows, ctx->c_rows.data(), n * sizeof(int32_t));
   std::memcpy(contribs, ctx->c_contribs.data(), n * sizeof(double));
@@ -1020,6 +1154,7 @@ int vn_drain_counter(void* p, int32_t* rows, double* contribs, int cap) {
 
 int vn_drain_gauge(void* p, int32_t* rows, double* vals, int cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   int n = std::min<int>(cap, static_cast<int>(ctx->g_rows.size()));
   std::memcpy(rows, ctx->g_rows.data(), n * sizeof(int32_t));
   std::memcpy(vals, ctx->g_vals.data(), n * sizeof(double));
@@ -1035,6 +1170,7 @@ int vn_drain_new_series(void* p, int32_t* pools, int32_t* rows,
                         int32_t* kinds, int32_t* scopes, char* strbuf,
                         int strcap, int* strlen_out, int max) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   int n = 0;
   int off = 0;
   while (n < max && n < static_cast<int>(ctx->new_series.size())) {
@@ -1066,6 +1202,7 @@ int vn_drain_new_series(void* p, int32_t* pools, int32_t* rows,
 int vn_upsert(void* p, const char* name, int name_len, int kind,
               const char* joined_tags, int tags_len, int scope_class) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   std::string_view name_sv(name, static_cast<size_t>(name_len));
   std::string_view tags_sv(joined_tags, static_cast<size_t>(tags_len));
   MetricKind k = static_cast<MetricKind>(kind);
@@ -1147,6 +1284,7 @@ int vn_ingest_ssf_many(void* p, const char* buf, long long len,
                        int* fallback_len, int fallback_cap,
                        int* nfall_out) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   std::string_view ind(ind_name, ind_len), obj(obj_name, obj_len);
   long long pos = 0;
   int ok = 0, errs = 0, nfall = 0;
@@ -1195,6 +1333,7 @@ long long vn_ssf_invalid(void* p) {
 // `while n > 0` drain loop would stall until the next flush.
 int vn_drain_ssf_services(void* p, char* buf, int cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   for (const auto& e : ctx->ssf_services) {
     ctx->ssf_services_out.append(e.first);
     ctx->ssf_services_out.push_back('\t');
@@ -1213,6 +1352,7 @@ int vn_drain_ssf_services(void* p, char* buf, int cap) {
 // Drain the buffered event/service-check lines (newline separated).
 int vn_drain_other(void* p, char* buf, int cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   int n = std::min<int>(cap, static_cast<int>(ctx->other_lines.size()));
   std::memcpy(buf, ctx->other_lines.data(), n);
   ctx->other_lines.erase(0, n);

@@ -332,3 +332,61 @@ def test_golden_wire_bytes_decode():
     assert by_key[("golden.c", MetricType.COUNTER)].value == 7.0
     p50 = by_key[("golden.h.50percentile", MetricType.GAUGE)].value
     assert abs(p50 - 12.0) < 1e-3
+
+
+def test_hll_hostile_blobs_rejected():
+    """Attacker-controlled length fields must raise ValueError (skipping
+    the one metric), never loop for hours or escape as IndexError."""
+    # tmpSet count of 0xFFFFFFFF in a 16-byte blob
+    evil = bytes([1, 14, 0, 1]) + b"\xff\xff\xff\xff" + b"\x00" * 8
+    with pytest.raises(ValueError):
+        interop.decode_hll(evil)
+    # truncated before the compressed list
+    with pytest.raises(ValueError):
+        interop.decode_hll(bytes([1, 14, 0, 1]) + struct.pack(">I", 0))
+    # list size larger than the blob
+    blob = (bytes([1, 14, 0, 1]) + struct.pack(">I", 0)
+            + struct.pack(">I", 1) + struct.pack(">I", 0)
+            + struct.pack(">I", 999))
+    with pytest.raises(ValueError):
+        interop.decode_hll(blob)
+    # varint with endless continuation bit
+    blob = (bytes([1, 14, 0, 1]) + struct.pack(">I", 0)
+            + struct.pack(">I", 1) + struct.pack(">I", 0)
+            + struct.pack(">I", 4) + b"\x80\x80\x80\x80")
+    with pytest.raises(ValueError):
+        interop.decode_hll(blob)
+    # dense blob with wrong register count
+    with pytest.raises(ValueError):
+        interop.decode_hll(bytes([1, 14, 0, 0]) + struct.pack(">I", 3)
+                           + b"\x00" * 3)
+
+
+def test_unknown_metric_type_skipped_not_fatal():
+    lst = fpb.MetricList()
+    bad = lst.metrics.add()
+    bad.name = "future.type"
+    bad.type = 99  # unknown enum value (proto3 preserves the int)
+    good = lst.metrics.add()
+    good.name = "ok.c"
+    good.type = mpb.Counter
+    good.counter.value = 3
+    with pytest.raises(ValueError):
+        interop.compat_to_internal(bad)
+    # the service path skips the bad one and keeps the batch
+    srv = Server(Config(interval="10s", percentiles=PCTS, num_workers=1))
+    imp = ImportServer(srv)
+    port = imp.start_grpc()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = channel.unary_unary(
+            "/forwardrpc.Forward/SendMetrics",
+            request_serializer=fpb.MetricList.SerializeToString,
+            response_deserializer=lambda b: b,
+        )
+        call(lst, timeout=10)
+        channel.close()
+        by_key = _flush(srv)
+        assert by_key[("ok.c", MetricType.COUNTER)].value == 3.0
+    finally:
+        imp.stop()

@@ -377,3 +377,71 @@ def test_scopedstatsd_injection_sanitized():
     assert len(cap.lines) == 1
     assert "\n" not in cap.lines[0]
     assert cap.lines[0].count("|#") == 1
+
+
+# ---------------------------------------------------------------------------
+# sharded router (vn_ingest_routed)
+
+
+def test_router_shards_by_digest():
+    """Series must land on shard digest % N — the same shard the Python
+    parser would route to — so mixed native/Python ingest of one series
+    always shares a row."""
+    from veneur_tpu.protocol.dogstatsd import parse_metric
+
+    ctxs = [native_mod.NativeIngest() for _ in range(4)]
+    router = native_mod.NativeRouter(ctxs)
+    lines = [f"shard.m{i}:1|c|#t:{i % 7}" for i in range(200)]
+    for ln in lines:
+        router.ingest(ln.encode())
+    assert sum(c.processed for c in ctxs) == 200
+
+    per_shard = [0] * 4
+    for ln in lines:
+        m = parse_metric(ln.encode())
+        per_shard[m.digest % 4] += 1
+    got = [c.processed for c in ctxs]
+    assert got == per_shard
+
+
+def test_router_concurrent_ingest_exact_totals():
+    import threading
+
+    ctxs = [native_mod.NativeIngest() for _ in range(4)]
+    router = native_mod.NativeRouter(ctxs)
+    n_threads, per_thread = 4, 2000
+
+    def work(t):
+        for i in range(per_thread):
+            # same series set from every thread → heavy cross-shard traffic
+            router.ingest(
+                f"conc.c{i % 50}:2|c\nconc.h{i % 31}:{i}|ms".encode())
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    total = n_threads * per_thread
+    assert sum(c.processed for c in ctxs) == 2 * total
+    assert sum(c.errors for c in ctxs) == 0
+    csum = 0.0
+    hcount = 0
+    for c in ctxs:
+        rows, contribs = c.drain_counter(1 << 20)
+        csum += contribs.sum()
+        r, v, w = c.drain_histo(1 << 20)
+        hcount += len(r)
+    assert csum == 2.0 * total
+    assert hcount == total
+
+
+def test_router_events_and_errors_land_on_shard_zero():
+    ctxs = [native_mod.NativeIngest() for _ in range(2)]
+    router = native_mod.NativeRouter(ctxs)
+    router.ingest(b"_e{5,5}:title|hello\nnot-a-metric\nok.c:1|c")
+    assert ctxs[0].drain_other() == [b"_e{5,5}:title|hello"]
+    assert ctxs[0].errors + ctxs[1].errors == 1
+    assert ctxs[0].processed + ctxs[1].processed == 1

@@ -121,6 +121,12 @@ class Config:
     set_hash: str = "fnv"
 
     # device / TPU execution
+    # mesh sharding (global aggregation tier): >1 shards histogram state
+    # over a (tpu_mesh_hosts × series-shards) device mesh; imported
+    # digests merge via ICI collectives at flush (distributed/mesh.py).
+    # Requires num_workers: 1 (the mesh IS the sharding).
+    tpu_mesh_devices: int = 0
+    tpu_mesh_hosts: int = 0  # 0 = auto (2 when the device count is even)
     tpu_native_ingest: bool = True
     tpu_batch_size: int = 16384
     tpu_compression: float = 100.0
@@ -416,6 +422,14 @@ def validate_config(cfg: Config) -> None:
         raise ValueError("num_workers and num_readers must be >= 1")
     if cfg.forward_format not in ("veneurtpu", "forwardrpc"):
         raise ValueError("forward_format must be 'veneurtpu' or 'forwardrpc'")
+    if cfg.tpu_mesh_devices > 1 and cfg.num_workers != 1:
+        raise ValueError(
+            "tpu_mesh_devices requires num_workers: 1 (the mesh shards"
+            " series; in-process worker sharding would double it)")
+    if cfg.tpu_mesh_devices > 1 and cfg.tpu_mesh_hosts:
+        if cfg.tpu_mesh_devices % cfg.tpu_mesh_hosts:
+            raise ValueError("tpu_mesh_devices must be divisible by"
+                             " tpu_mesh_hosts")
     if cfg.set_hash not in ("fnv", "metro"):
         raise ValueError("set_hash must be 'fnv' or 'metro'")
     if not (4 <= cfg.tpu_hll_precision <= 18):

@@ -248,6 +248,11 @@ def forwardable_rows(snap: FlushSnapshot):
     for row, meta in enumerate(snap.directory.histo.rows):
         if meta.scope_class == ScopeClass.LOCAL:
             continue
+        if snap.digest_means is None:
+            # mesh-mode snapshots don't materialize per-row centroid
+            # arrays host-side; a mesh global is a terminal aggregator
+            # (chained-global forwarding needs the single-device path)
+            break
         yield (
             meta.key.type, meta.key, meta.tags, meta.scope_class,
             snap.digest_means[row], snap.digest_weights[row],

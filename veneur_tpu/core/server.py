@@ -94,6 +94,21 @@ class Server:
             for _ in range(cfg.num_workers)
         ]
         self._worker_locks = [threading.Lock() for _ in self.workers]
+        if cfg.tpu_mesh_devices > 1:
+            # config-driven mesh sharding for the aggregation state (the
+            # global tier's import merge rides ICI collectives; see
+            # distributed/mesh.py)
+            from veneur_tpu.distributed.mesh import MeshHistoPool, make_mesh
+
+            mesh = make_mesh(cfg.tpu_mesh_devices,
+                             cfg.tpu_mesh_hosts or None)
+            self.mesh = mesh
+            self.workers[0].attach_mesh_pool(MeshHistoPool(
+                mesh, compression=cfg.tpu_compression,
+                batch_size=cfg.tpu_batch_size))
+            log.info("mesh aggregation enabled: %s", dict(mesh.shape))
+        else:
+            self.mesh = None
         self.event_worker = EventWorker()
 
         self.metric_sinks: list[MetricSink] = list(metric_sinks or [])
@@ -159,18 +174,31 @@ class Server:
             namespace="veneur.",
         )
 
-        # native C++ ingest path: one worker owns the whole series space
-        # (the device is the parallelism); multi-worker sharding keeps the
-        # per-metric Python path
+        # native C++ ingest path: each worker gets its own parser context;
+        # readers parse lock-free and commit to shard digest % N under
+        # per-shard C++ mutexes (contention-free like the reference's
+        # Digest%N channel routing, server.go:1028-1039)
         self.native_mode = False
-        if cfg.tpu_native_ingest and cfg.num_workers == 1:
-            self.native_mode = self.workers[0].attach_native()
+        self._native_router = None
+        self._native_ingest_tick = 0
+        if cfg.tpu_native_ingest:
+            self.native_mode = all(w.attach_native() for w in self.workers)
             if self.native_mode:
-                log.info("native C++ ingest pipeline enabled")
+                from veneur_tpu.native import NativeRouter
+
+                self._native_router = NativeRouter(
+                    [w._native for w in self.workers])
+                log.info("native C++ ingest pipeline enabled"
+                         " (%d shards)", len(self.workers))
 
         # native SSF span fast path: only when the extraction sink is the
-        # sole span consumer (other span sinks need the Python span object)
-        self._native_ssf = (self.native_mode and not self.span_sinks)
+        # sole span consumer (other span sinks need the Python span
+        # object), and single-shard only — the C++ extractor commits into
+        # one context, so with several workers the Python path (which
+        # routes each derived metric by digest) keeps series on their
+        # home shard
+        self._native_ssf = (self.native_mode and not self.span_sinks
+                            and len(self.workers) == 1)
         self._native_ssf_indicator = (
             cfg.indicator_span_timer_name.encode())
         self._native_ssf_objective = (
@@ -217,13 +245,26 @@ class Server:
             log.debug("overlong metric datagram (%d bytes)", len(datagram))
             return
         if self.native_mode:
-            worker = self.workers[0]
-            with self._worker_locks[0]:
-                worker.ingest_datagram(datagram)
+            # no Python lock here: the C++ router parses lock-free and
+            # commits under per-shard mutexes, so concurrent readers scale
+            self._native_router.ingest(datagram)
+            # pending-drain check is strided: each check is a ctypes call
+            # per shard, which at line rate would rival the parse cost.
+            # The counter is racy across readers — that only skews WHICH
+            # packet triggers the check; buffers are bounded by
+            # batch_size + stride·lines_per_packet and always drain at
+            # flush.
+            self._native_ingest_tick += 1
+            if self._native_ingest_tick % 64 == 0:
+                for i, w in enumerate(self.workers):
+                    if (w._native.pending_histo >= w.batch_size
+                            or w._native.pending_set >= w.batch_size):
+                        with self._worker_locks[i]:
+                            w.drain_native()
             # events and service checks come back for the Python parser
             if b"_e{" in datagram or b"_sc" in datagram:
                 with self._worker_locks[0]:
-                    others = worker._native.drain_other()
+                    others = self.workers[0]._native.drain_other()
                 for line in others:
                     self.handle_metric_packet(line)
             return

@@ -384,10 +384,41 @@ class DeviceWorker:
         self._initial_set_rows = initial_set_rows
         self.count_unique_timeseries = count_unique_timeseries
         self.is_local = is_local
-        self.processed = 0
+        self._processed_py = 0
+        self._native_proc_seen = 0
         self.imported = 0
         self._native = None
+        self._mesh_pool = None
         self._reset_epoch()
+
+    def attach_mesh_pool(self, pool) -> None:
+        """Shard histogram state over a device mesh
+        (distributed/mesh.MeshHistoPool): raw samples and imported
+        centroids route to mesh shards instead of the single-device
+        pool; the cross-host merge rides ICI collectives at flush.
+        Intended for the global tier (config tpu_mesh_devices); local
+        scalar aggregates (.min/.max of mixed-scope rows emitted by
+        locals) are not tracked on the mesh path."""
+        self._mesh_pool = pool
+
+    @property
+    def processed(self) -> int:
+        """Samples accepted this epoch. In native mode the router commits
+        into the C++ context off the Python path, so the native counter's
+        delta since the last rebase is folded in live."""
+        n = self._processed_py
+        if self._native is not None:
+            n += int(self._native.processed) - self._native_proc_seen
+        return n
+
+    @processed.setter
+    def processed(self, v: int) -> None:
+        # preserves `self.processed += k` semantics: the native delta read
+        # by the getter is subtracted back out so it isn't double-counted
+        nd = 0
+        if self._native is not None:
+            nd = int(self._native.processed) - self._native_proc_seen
+        self._processed_py = v - nd
 
     # -- native front-end ----------------------------------------------------
 
@@ -410,7 +441,6 @@ class DeviceWorker:
         Returns leftover event/service-check lines via drain_other on the
         caller's schedule."""
         n = self._native.ingest(datagram)
-        self.processed += n
         if (self._native.pending_histo >= self.batch_size
                 or self._native.pending_set >= self.batch_size):
             self.drain_native()
@@ -459,29 +489,55 @@ class DeviceWorker:
 
     def drain_native(self) -> None:
         """Move everything pending in the native pipeline into device/host
-        state."""
+        state. Holds the context lock across the whole raw-drain so routed
+        commits from reader threads can't interleave between calls."""
         if self._native is None:
             return
+        self._native.lock()
+        try:
+            raw = self._drain_native_raw()
+        finally:
+            self._native.unlock()
+        self._apply_native_raw(raw)
+
+    def _drain_native_raw(self):
+        """Pull raw sample buffers + bookkeeping out of the C++ context.
+        Caller holds the context lock. Samples drain BEFORE the new-series
+        sync: a sample's series record is committed at-or-before the
+        sample itself (same C++ critical section), so syncing afterwards
+        can only over-adopt rows with no samples yet — never leave a
+        drained sample without directory metadata."""
         errs = int(self._native.errors)
         self.parse_errors += errs - self._native_errs_seen
         self._native_errs_seen = errs
-        self._sync_native_series()
         n = self._native.pending_histo
-        if n:
-            rows, vals, wts = self._native.drain_histo(n)
-            self._ensure_histo(self.directory.num_histo_rows)
-            self._device_histo_step(rows, vals, wts)
+        h = self._native.drain_histo(n) if n else None
         n = self._native.pending_set
-        if n:
-            rows, idx, rank = self._native.drain_set(n)
+        s = self._native.drain_set(n) if n else None
+        c = self._native.drain_counter(1 << 22)
+        g = self._native.drain_gauge(1 << 22)
+        self._sync_native_series()
+        return h, s, c, g
+
+    def _apply_native_raw(self, raw) -> None:
+        """Apply drained buffers to device/host pools (no context lock —
+        device dispatch must not stall reader commits)."""
+        h, s, c, g = raw
+        if h is not None and len(h[0]):
+            if self._mesh_pool is not None:
+                self._mesh_pool.add_samples_bulk(*h)
+            else:
+                self._ensure_histo(self.directory.num_histo_rows)
+                self._device_histo_step(*h)
+        if s is not None and len(s[0]):
             self._ensure_sets(self.directory.num_set_rows)
-            self._device_set_step(rows, idx, rank)
-        rows, contribs = self._native.drain_counter(1 << 22)
+            self._device_set_step(*s)
+        rows, contribs = c
         if len(rows):
             pool = self.scalars.counters
             np.add.at(pool.values, rows, contribs)
             pool.present[rows] = True
-        rows, vals = self._native.drain_gauge(1 << 22)
+        rows, vals = g
         if len(rows):
             pool = self.scalars.gauges
             pool.values[rows] = vals  # in-order: last write wins
@@ -490,9 +546,17 @@ class DeviceWorker:
     # -- epoch lifecycle ----------------------------------------------------
 
     def _reset_epoch(self) -> None:
-        if self._native is not None:
-            self._native.reset()
-        self._native_errs_seen = 0
+        if getattr(self, "_native_epoch_closed", False):
+            # flush already reset the context atomically with its drain;
+            # resetting again here would destroy new-epoch commits that
+            # routed readers landed in the meantime
+            self._native_epoch_closed = False
+        else:
+            if self._native is not None:
+                self._native.reset()
+            self._native_errs_seen = 0
+            self._native_proc_seen = 0
+        self._processed_py = 0
         self.parse_errors = getattr(self, "parse_errors", 0)
         self.directory = SeriesDirectory()
         self.scalars = HostScalars()
@@ -553,6 +617,11 @@ class DeviceWorker:
             self._host_gauge(m.key, scope_class, m.tags, float(m.value))
         elif mtype in ("histogram", "timer"):
             row = self._upsert_histo(m.key, scope_class, m.tags)
+            if self._mesh_pool is not None:
+                self._mesh_pool.add_sample(
+                    row, float(m.value), 1.0 / m.sample_rate,
+                    host_slot=m.digest)
+                return
             self._ensure_histo(self.directory.num_histo_rows)
             self._ph_rows.append(row)
             self._ph_vals.append(float(m.value))
@@ -740,6 +809,15 @@ class DeviceWorker:
         (reference Histo.Merge path, worker.go:438-495)."""
         self.imported += 1
         row = self._upsert_histo(key, scope_class, tags)
+        if self._mesh_pool is not None:
+            # mesh path: centroids re-ingest as weighted samples — the
+            # reference's own Merge semantics (merging_digest.go:374-389:
+            # min/max evolve from centroid means, reciprocalSum carried
+            # exactly)
+            self._mesh_pool.add_centroids(
+                row, np.asarray(means, np.float32),
+                np.asarray(weights, np.float32), float(drecip))
+            return
         self._ensure_histo(self.directory.num_histo_rows)
         self._imp_digests.setdefault(row, []).append(
             (np.asarray(means, np.float32), np.asarray(weights, np.float32),
@@ -860,7 +938,20 @@ class DeviceWorker:
         quantiles: the percentile set to evaluate on device (the flusher
         decides which rows' values are actually emitted).
         """
-        self.drain_native()
+        if self._native is not None:
+            # drain and close the native epoch under one lock hold: a
+            # routed commit can otherwise land between the last drain and
+            # the reset and be destroyed with the old epoch
+            self._native.lock()
+            try:
+                raw = self._drain_native_raw()
+                self._native.reset()
+                self._native_errs_seen = 0
+                self._native_proc_seen = 0
+                self._native_epoch_closed = True
+            finally:
+                self._native.unlock()
+            self._apply_native_raw(raw)
         self._flush_pending_histos()
         self._flush_pending_sets()
         self._merge_imports()
@@ -892,6 +983,25 @@ class DeviceWorker:
             snap.lsum, snap.lweight, snap.lrecip = lsum[:n], lweight[:n], lrecip[:n]
             snap.digest_means = np.asarray(histo.means)[:n]
             snap.digest_weights = np.asarray(histo.weights)[:n]
+        if self._mesh_pool is not None and directory.num_histo_rows:
+            mout = self._mesh_pool.extract(quantiles,
+                                           directory.num_histo_rows)
+            self._mesh_pool.reset()
+            if mout is not None:
+                n = directory.num_histo_rows
+                snap.quantile_values = mout["quant"]
+                snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
+                snap.dmin, snap.dmax = mout["dmin"], mout["dmax"]
+                snap.dsum = mout["dsum"]
+                snap.dcount = mout["dcount"]
+                snap.drecip = mout["drecip"]
+                # mesh rows carry no host-local scalar aggregates (global
+                # tier emits digest-derived values; see attach_mesh_pool)
+                snap.lmin = np.full(n, np.inf, np.float32)
+                snap.lmax = np.full(n, -np.inf, np.float32)
+                snap.lsum = np.zeros(n, np.float64)
+                snap.lweight = np.zeros(n, np.float64)
+                snap.lrecip = np.zeros(n, np.float64)
         if sets is not None and directory.num_set_rows:
             n = directory.num_set_rows
             snap.set_estimates = np.asarray(

@@ -90,7 +90,15 @@ def decode_hll(data: bytes) -> tuple[int, np.ndarray]:
     m = 1 << p
     regs = np.zeros(m, dtype=np.uint8)
     if data[3] == 1:  # sparse: tmpSet then compressed delta-varint list
+        # every length field is attacker-controlled (this decodes network
+        # payloads on /forwardrpc.Forward/SendMetrics): validate against
+        # the actual blob size before looping, and surface truncation as
+        # ValueError so one bad metric is skipped, not a thread pinned
         n_tmp = int.from_bytes(data[4:8], "big")
+        if 8 + 4 * n_tmp > len(data):
+            raise ValueError(
+                f"sparse HLL tmpSet claims {n_tmp} keys, blob is"
+                f" {len(data)} bytes")
         off = 8
         for _ in range(n_tmp):
             k = int.from_bytes(data[off:off + 4], "big")
@@ -101,8 +109,14 @@ def decode_hll(data: bytes) -> tuple[int, np.ndarray]:
         # compressedList: count, last (both ignored for decode), then the
         # variable-length byte list of deltas (7-bit groups, 0x80 continues)
         off += 8
+        if off + 4 > len(data):
+            raise ValueError("sparse HLL blob truncated before list")
         size = int.from_bytes(data[off:off + 4], "big")
         off += 4
+        if off + size > len(data):
+            raise ValueError(
+                f"sparse HLL list claims {size} bytes, blob has"
+                f" {len(data) - off}")
         buf = data[off:off + size]
         i = 0
         last = 0
@@ -113,6 +127,8 @@ def decode_hll(data: bytes) -> tuple[int, np.ndarray]:
                 x |= (buf[i] & 0x7F) << shift
                 shift += 7
                 i += 1
+                if i >= len(buf) or shift > 28:
+                    raise ValueError("sparse HLL varint truncated")
             x |= buf[i] << shift
             i += 1
             last = (last + x) & 0xFFFFFFFF
@@ -178,10 +194,15 @@ _SCOPE_FROM_INTERNAL = {v: k for k, v in _SCOPE_TO_INTERNAL.items()}
 
 def compat_to_internal(m: mpb.Metric) -> pb.Metric:
     """Reference-wire metric → internal metric (merge-ready)."""
+    kind = _TYPE_TO_KIND.get(m.type)
+    if kind is None:
+        # proto3 preserves unknown enum ints; one unmapped type must skip
+        # that metric, not fail the whole forwarded batch
+        raise ValueError(f"metric {m.name!r} has unsupported type {m.type}")
     out = pb.Metric()
     out.name = m.name
     out.tags.extend(m.tags)
-    out.kind = _TYPE_TO_KIND[m.type]
+    out.kind = kind
     out.scope = _SCOPE_TO_INTERNAL.get(m.scope, pb.SCOPE_MIXED)
     which = m.WhichOneof("value")
     if which == "counter":

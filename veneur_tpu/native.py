@@ -98,6 +98,11 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.vn_ctx_set_metro.argtypes = [c.c_void_p, c.c_int]
         lib.vn_metro_hash64.restype = c.c_uint64
         lib.vn_metro_hash64.argtypes = [c.c_char_p, c.c_int, c.c_uint64]
+        lib.vn_ingest_routed.restype = c.c_int
+        lib.vn_ingest_routed.argtypes = [
+            c.POINTER(c.c_void_p), c.c_int, c.c_char_p, c.c_int]
+        lib.vn_lock.argtypes = [c.c_void_p]
+        lib.vn_unlock.argtypes = [c.c_void_p]
         _lib = lib
         return _lib
 
@@ -126,6 +131,14 @@ class NativeIngest:
 
     def reset(self) -> None:
         self._lib.vn_ctx_reset(self._ctx)
+
+    def lock(self) -> None:
+        """Hold the context's (recursive) lock across a multi-call
+        sequence, excluding routed commits from other threads."""
+        self._lib.vn_lock(self._ctx)
+
+    def unlock(self) -> None:
+        self._lib.vn_unlock(self._ctx)
 
     def ingest(self, datagram: bytes) -> int:
         return self._lib.vn_ingest(self._ctx, datagram, len(datagram))
@@ -293,3 +306,24 @@ class NativeIngest:
 
 def available() -> bool:
     return load_library() is not None
+
+
+class NativeRouter:
+    """Sharded ingest over several workers' native contexts: lines are
+    parsed lock-free in C++ and committed to shard digest % N under that
+    shard's own mutex (native twin of the reference's Digest%N routing,
+    server.go:1028-1039). One router is shared by all reader threads —
+    ctypes releases the GIL, so readers parse in parallel."""
+
+    def __init__(self, contexts: list["NativeIngest"]) -> None:
+        if not contexts:
+            raise ValueError("router needs at least one context")
+        self._lib = contexts[0]._lib
+        self._contexts = contexts  # keep alive
+        self._arr = (ctypes.c_void_p * len(contexts))(
+            *[c._ctx for c in contexts])
+        self._n = len(contexts)
+
+    def ingest(self, datagram: bytes) -> int:
+        return self._lib.vn_ingest_routed(
+            self._arr, self._n, datagram, len(datagram))

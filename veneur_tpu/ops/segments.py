@@ -91,6 +91,28 @@ def segmented_cumsum(values: jax.Array, starts: jax.Array) -> jax.Array:
     return out.reshape(-1)[:n]
 
 
+def last_marked_carry(mask: jax.Array, *values: jax.Array
+                      ) -> tuple[jax.Array, ...]:
+    """Along the last axis, carry each payload forward from the most
+    recent *strictly earlier* position where ``mask`` is True (exclusive
+    scan; positions before any mark carry 0).
+
+    mask: bool[..., L]; values: f32[..., L] each. Returns one array per
+    payload. log2(L) elementwise select steps — no gathers, no scatters.
+    """
+    pad = [(0, 0)] * (mask.ndim - 1) + [(1, 0)]
+    mask = jnp.pad(mask, pad)[..., :-1]
+    values = tuple(jnp.pad(v, pad)[..., :-1] for v in values)
+
+    def combine(a, b):
+        ma, *va = a
+        mb, *vb = b
+        return (ma | mb, *[jnp.where(mb, y, x) for x, y in zip(va, vb)])
+
+    out = jax.lax.associative_scan(combine, (mask, *values), axis=-1)
+    return tuple(out[1:])
+
+
 class RunSums(NamedTuple):
     """Per-run sums of a sorted id array, addressed by global run index.
 

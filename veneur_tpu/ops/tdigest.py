@@ -123,25 +123,35 @@ def _compress_rows(
     w_cum = jnp.cumsum(sorted_w, axis=-1)
     total = w_cum[:, -1:]
     q_left = (w_cum - sorted_w) / jnp.maximum(total, 1e-30)
-    # 3. Quantize to k-function buckets. (Zero-weight padding contributes
-    #    zero weight in step 4 regardless of its bucket.)
+    # 3. Quantize to k-function buckets. (Zero-weight padding slots land in
+    #    whatever bucket q=1 maps to; they only ever extend a run with zero
+    #    weight, so the sums below are unaffected.)
     bucket = jnp.floor(_k_scale(q_left, compression)).astype(jnp.int32)
     bucket = jnp.clip(bucket, 0, capacity - 1)
-    # 4. Bucket accumulation as a masked broadcast-reduce over [S, M, C].
-    #    TPU-first: scatters serialize and large vmapped binary searches are
-    #    gather chains; a compare+select+reduce streams through the VPU and
-    #    XLA fuses it without materializing the [S, M, C] intermediate
-    #    (measured ~6x faster than the per-row searchsorted formulation).
-    mw = jnp.where(sorted_w > 0, sorted_means * sorted_w, 0.0)
-    cbins = jnp.arange(capacity, dtype=jnp.int32)
-    eq = bucket[:, :, None] == cbins[None, None, :]  # [S, M, C], fused
-    new_w = jnp.sum(jnp.where(eq, sorted_w[:, :, None], 0.0), axis=1)
-    new_mw = jnp.sum(jnp.where(eq, mw[:, :, None], 0.0), axis=1)
-    new_means = jnp.where(new_w > 0, new_mw / jnp.maximum(new_w, 1e-30), _INF)
-    # 5. Empty buckets are interleaved; re-sort rows to restore the
-    #    contiguous sorted-prefix invariant.
-    new_means, new_w = jax.lax.sort((new_means, new_w), dimension=-1, num_keys=1)
-    return new_means, new_w
+    # 4. Bucket accumulation, scatter- AND broadcast-free: buckets are
+    #    non-decreasing along a sorted row, so each bucket is one
+    #    contiguous run; its sum is a difference of row-prefix sums at the
+    #    run ends. Run placement is irrelevant — step 5 re-sorts by mean —
+    #    so results stay where the run ends and a sort compacts them.
+    #    (The previous [S, M, C] compare+select+reduce formulation was
+    #    fused but compute-bound: ~34G lane-ops at S=1M; this is O(S·M).)
+    mw_cum = jnp.cumsum(
+        jnp.where(sorted_w > 0, sorted_means * sorted_w, 0.0), axis=-1)
+    nxt = jnp.concatenate(
+        [bucket[:, 1:], jnp.full((s, 1), -1, jnp.int32)], axis=-1)
+    is_end = bucket != nxt  # last slot of each bucket run (row end included)
+    w_before, mw_before = segments.last_marked_carry(is_end, w_cum, mw_cum)
+    seg_w = w_cum - w_before
+    seg_mw = mw_cum - mw_before
+    live = is_end & (seg_w > 0)
+    new_means = jnp.where(live, seg_mw / jnp.maximum(seg_w, 1e-30), _INF)
+    new_w = jnp.where(live, seg_w, 0.0)
+    # 5. Sort by mean (empties keyed +inf sort last) and keep the first
+    #    `capacity` slots — the k-function emits ≤ δ+1 ≤ capacity buckets,
+    #    so the slice only ever drops padding.
+    new_means, new_w = jax.lax.sort((new_means, new_w), dimension=-1,
+                                    num_keys=1)
+    return new_means[:, :capacity], new_w[:, :capacity]
 
 
 @functools.partial(jax.jit, static_argnames=("compression", "capacity"))
@@ -237,11 +247,14 @@ def add_batch(
     stats = BatchStats(seg_w, seg_min, seg_max, seg_sum, seg_recip)
 
     # --- 3. Batch digest: segmented cumulative weight → k-bucket per
-    #        sample → per-(row, bucket) sums. Entirely scatter-free and
-    #        gather-light: XLA's sorted-scatter segment_sum and N-sized
-    #        gathers both run ~10x under VPU peak on TPU (see ops/segments
-    #        for measurements); segmented scans + chunked run sums replace
-    #        them.
+    #        sample → per-(row, bucket) run sums. Scatter-free and
+    #        gather-light: each (row, bucket) is one contiguous run of the
+    #        sorted batch, so its sum is a difference of the global prefix
+    #        sums at the run's end positions; run-start positions compact
+    #        into a dense per-run table with one single-key sort. (The
+    #        previous run-sum scheme resolved runs with a searchsorted over
+    #        chunk offsets — a [K·C]-sized gather-chain binary search that
+    #        alone cost ~80% of add_batch on v5e.)
     row_starts = jnp.concatenate(
         [jnp.ones((1,), bool), srows[1:] != srows[:-1]])
     seg_cum = segments.segmented_cumsum(sw, row_starts)
@@ -252,22 +265,32 @@ def add_batch(
     bucket = jnp.clip(
         jnp.floor(_k_scale(q_left, compression)).astype(jnp.int32), 0, c - 1
     )
-    # Padding (row k) is clipped into the last segment; it carries zero
-    # weight so the sums are unaffected.
-    seg_id = jnp.minimum(srows * c + bucket, k * c - 1)  # non-decreasing
-    rs = segments.sorted_run_sums(seg_id, sw, svals * sw)
-    # Each row's runs are contiguous in global-run-index space and number
-    # at most c (distinct buckets per row ≤ c), so the dense [K, C] batch
-    # digest is a gather of each row's run-index window.
-    safe_lower = jnp.minimum(row_lower, n - 1)
-    run_lo = jnp.take(rs.grank, safe_lower)  # [K]
-    run_hi = jnp.take(rs.grank, jnp.maximum(row_upper - 1, 0)) + 1
+    # Non-decreasing run id; padding (row k) forms its own tail runs that
+    # no real row's run window reaches.
+    seg_id = srows * c + bucket
+    starts = jnp.concatenate(
+        [jnp.ones((1,), bool), seg_id[1:] != seg_id[:-1]])
+    grank = jnp.cumsum(starts.astype(jnp.int32)) - 1  # global run index [N]
+    # Dense run-start position table: ascending sort compacts the R true
+    # start positions to the front, sentinel n after — so pos_ext[r] is
+    # run r's first element and pos_ext[r+1] its end (the next run's
+    # start, or n for the last run).
+    pos = jnp.where(starts, jnp.arange(n, dtype=jnp.int32), n)
+    pos_ext = jnp.concatenate(
+        [jax.lax.sort(pos), jnp.full((1,), n, jnp.int32)])
+    run_lo = jnp.take(grank, jnp.clip(row_lower, 0, n - 1))  # [K]
+    run_hi = jnp.take(grank, jnp.maximum(row_upper - 1, 0)) + 1
     n_runs_row = jnp.where(has, run_hi - run_lo, 0)  # [K]
-    m = run_lo[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
-    valid = jnp.arange(c, dtype=jnp.int32)[None, :] < n_runs_row[:, None]
-    g_w, g_mw = segments.gather_runs(rs, m)
-    bd_w = jnp.where(valid, g_w, 0.0)
-    bd_mw = jnp.where(valid, g_mw, 0.0)
+    j = jnp.arange(c, dtype=jnp.int32)
+    runs = jnp.clip(run_lo[:, None] + j[None, :], 0, n - 1)  # [K, C]
+    valid = j[None, :] < n_runs_row[:, None]
+    r_start = jnp.take(pos_ext, runs)
+    r_end = jnp.take(pos_ext, runs + 1)
+    bd_w = jnp.where(valid, jnp.take(pre_w, r_end) - jnp.take(pre_w, r_start),
+                     0.0)
+    bd_mw = jnp.where(valid,
+                      jnp.take(pre_vw, r_end) - jnp.take(pre_vw, r_start),
+                      0.0)
     bd_means = jnp.where(bd_w > 0, bd_mw / jnp.maximum(bd_w, 1e-30), _INF)
 
     # --- 4. Merge with the existing rows and recompress.
@@ -362,14 +385,21 @@ def quantile(
 
     target = qs[None, :] * total[:, None]  # [S, P]
     # first slot whose cumulative weight reaches the target
-    # (reference: q <= weightSoFar + c.Weight)
+    # (reference: q <= weightSoFar + c.Weight). One-hot that slot and
+    # read the per-slot values with masked reduces — at S=1M the
+    # [S, P]-shaped take_along_axis gathers are the slow path on TPU,
+    # while select+reduce over [S, C, P] streams through the VPU.
     reached = target[:, None, :] <= w_cum[:, :, None]  # [S, C, P]
-    idx = jnp.argmax(reached, axis=1)  # [S, P]
+    first = reached & ~jnp.pad(
+        reached[:, :-1, :], ((0, 0), (1, 0), (0, 0)))  # one-hot along C
 
-    w_at = jnp.take_along_axis(weights, idx, axis=1)  # [S, P]
-    w_before = jnp.take_along_axis(w_cum, idx, axis=1) - w_at
-    lb_at = jnp.take_along_axis(lb, idx, axis=1)
-    ub_at = jnp.take_along_axis(ub, idx, axis=1)
+    def _at(x):  # [S, C] → [S, P] value at the one-hot slot
+        return jnp.sum(jnp.where(first, x[:, :, None], 0.0), axis=1)
+
+    w_at = _at(weights)
+    w_before = _at(w_cum) - w_at
+    lb_at = _at(lb)
+    ub_at = _at(ub)
     proportion = (target - w_before) / jnp.maximum(w_at, 1e-30)
     out = lb_at + proportion * (ub_at - lb_at)
     return jnp.where((total[:, None] > 0) & (count[:, None] > 0), out, jnp.nan)
