@@ -161,3 +161,69 @@ def test_insert_batch_variants_agree():
     a = hll.insert_batch(regs, rows, idx, rank)
     b = hll.insert_batch_scatter(regs, rows, idx, rank)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# staged (sparse host / dense device) store
+
+
+def test_staged_store_matches_dense_estimates():
+    from veneur_tpu.ops.staged_sets import StagedSetStore
+
+    rng = np.random.default_rng(7)
+    store = StagedSetStore(promote_entries=128, compact_every=512)
+    pool = hll.init_pool(8)
+    # rows 0..7 with wildly different cardinalities; row 3 crosses the
+    # promotion threshold
+    counts = [5, 40, 90, 5000, 200, 1, 17, 300]
+    for row, n in enumerate(counts):
+        hashes = np.array([hll_hash(f"r{row}-m{i}".encode())
+                           for i in range(n)], dtype=np.uint64)
+        idx, rank = hll.split_hashes(hashes)
+        rows = np.full(n, row, np.int32)
+        store.insert(rows, idx, rank)
+        pool = hll.insert_batch(pool, jnp.asarray(rows), jnp.asarray(idx),
+                                jnp.asarray(rank))
+    assert store.dense_rows >= 1  # row 3 promoted
+    got = store.estimates(8)
+    want = np.asarray(hll.estimate(pool))
+    # f64 host estimator vs f32 device kernel: same formula, tiny drift
+    np.testing.assert_allclose(got, want, rtol=1e-3)
+    # register materialization identical to the dense pool
+    np.testing.assert_array_equal(store.registers(8), np.asarray(pool))
+
+
+def test_staged_store_import_dense_merges():
+    from veneur_tpu.ops.staged_sets import StagedSetStore
+
+    store = StagedSetStore()
+    hashes = np.array([hll_hash(f"a{i}".encode()) for i in range(500)],
+                      dtype=np.uint64)
+    idx, rank = hll.split_hashes(hashes)
+    store.insert(np.zeros(500, np.int32), idx, rank)
+    # imported registers for the same row covering different members
+    regs = np.zeros(hll.num_registers(), np.int8)
+    h2 = np.array([hll_hash(f"b{i}".encode()) for i in range(500)],
+                  dtype=np.uint64)
+    i2, r2 = hll.split_hashes(h2)
+    np.maximum.at(regs, i2, r2)
+    store.import_dense(0, regs)
+    est = store.estimates(1)[0]
+    assert abs(est - 1000) / 1000 < 0.05
+
+
+def test_staged_store_memory_stays_sparse_for_small_sets():
+    from veneur_tpu.ops.staged_sets import StagedSetStore
+
+    rng = np.random.default_rng(3)
+    store = StagedSetStore()
+    n_series, per = 5000, 30
+    rows = np.repeat(np.arange(n_series, dtype=np.int32), per)
+    hashes = rng.integers(0, 2**64, n_series * per, dtype=np.uint64)
+    idx, rank = hll.split_hashes(hashes)
+    store.insert(rows, idx, rank)
+    assert store.dense_rows == 0  # nothing promoted
+    assert store.sparse_entries <= n_series * per
+    est = store.estimates(n_series)
+    # every series ~30 distinct members
+    assert np.all(np.abs(est - per) / per < 0.35)

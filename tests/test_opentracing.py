@@ -1,0 +1,184 @@
+"""OpenTracing tracer tests (trace/opentracing.py).
+
+Mirrors the reference's usage: StartSpan options, TextMap/HTTPHeaders/
+Binary carriers both directions, multi-format header negotiation, and the
+cross-hop propagation through the HTTP forward → import path
+(trace/opentracing.go usage in handlers_global.go:81,125).
+"""
+
+import io
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.trace import opentracing as ot
+
+
+class _CaptureClient:
+    def __init__(self):
+        self.spans = []
+
+    def record(self, span):
+        self.spans.append(span)
+
+
+def test_start_span_root_and_child():
+    t = ot.Tracer(service="svc")
+    root = t.start_span("parent")
+    child = t.start_span("child", child_of=root)
+    assert child.span.trace_id == root.span.trace_id
+    assert child.span.parent_id == root.span.id
+    assert child.resource == "parent"  # resource propagates from the root
+    g = t.start_span("follows", references=[ot.follows_from(root)])
+    assert g.span.trace_id == root.span.trace_id
+
+
+def test_start_span_options():
+    t = ot.Tracer()
+    s = t.start_span("op", start_time=1234.5, tags={"k": "v", "n": 7})
+    assert s.span.start_ns == int(1234.5e9)
+    assert s.span.tags["k"] == "v"
+    assert s.span.tags["n"] == "7"  # non-strings stringify
+    s.set_operation_name("renamed")
+    assert s.span.name == "renamed"
+    s.set_tag("name", "tag-named")
+    assert s.span.name == "tag-named"
+
+
+def test_finish_records_once():
+    cap = _CaptureClient()
+    t = ot.Tracer(client=cap)
+    s = t.start_span("op")
+    s.finish()
+    s.finish()
+    assert len(cap.spans) == 1
+    assert cap.spans[0].name == "op"
+    assert cap.spans[0].end_timestamp >= cap.spans[0].start_timestamp
+
+
+def test_context_manager_sets_error():
+    cap = _CaptureClient()
+    t = ot.Tracer(client=cap)
+    with pytest.raises(RuntimeError):
+        with t.start_span("boom"):
+            raise RuntimeError("x")
+    assert cap.spans[0].error
+
+
+def test_http_headers_round_trip_envoy_hex():
+    t = ot.Tracer()
+    s = t.start_span("op")
+    headers: dict = {}
+    t.inject(s.context(), ot.HTTP_HEADERS, headers)
+    # default (Envoy/Lightstep) format: hex ids + sampled flag
+    assert headers["ot-tracer-traceid"] == format(s.span.trace_id, "x")
+    assert headers["ot-tracer-sampled"] == "true"
+    ctx = t.extract(ot.HTTP_HEADERS, headers)
+    assert ctx.trace_id == s.span.trace_id
+    assert ctx.span_id == s.span.id
+
+
+@pytest.mark.parametrize("names,base", [
+    (("Trace-Id", "Span-Id"), 10),         # OpenTracing format
+    (("X-Trace-Id", "X-Span-Id"), 10),     # Ruby format
+    (("Traceid", "Spanid"), 10),           # Veneur format
+    (("OT-TRACER-TRACEID", "OT-TRACER-SPANID"), 16),  # case-insensitive
+])
+def test_extract_negotiates_header_formats(names, base):
+    t = ot.Tracer()
+    tid, sid = 123456789, 987654321
+    fmt = (lambda v: format(v, "x")) if base == 16 else str
+    ctx = t.extract(ot.HTTP_HEADERS, {names[0]: fmt(tid), names[1]: fmt(sid)})
+    assert ctx.trace_id == tid
+    assert ctx.span_id == sid
+
+
+def test_extract_no_headers_raises():
+    t = ot.Tracer()
+    with pytest.raises(ot.SpanExtractionError):
+        t.extract(ot.HTTP_HEADERS, {"unrelated": "1"})
+    assert ot.start_span_from_headers({}, "x") is None
+
+
+def test_text_map_carries_baggage():
+    t = ot.Tracer()
+    s = t.start_span("op")
+    s.set_baggage_item("tenant", "acme")
+    carrier: dict = {}
+    t.inject(s.context(), ot.TEXT_MAP, carrier)
+    assert carrier["tenant"] == "acme"
+    assert carrier["traceid"] == str(s.span.trace_id)
+    ctx = t.extract(ot.TEXT_MAP, carrier)
+    assert ctx.trace_id == s.span.trace_id
+    assert ctx.baggage["tenant"] == "acme"
+
+
+def test_binary_round_trip():
+    t = ot.Tracer()
+    s = t.start_span("op")
+    s.resource = "res-x"
+    buf = io.BytesIO()
+    t.inject(s.context(), ot.BINARY, buf)
+    buf.seek(0)
+    ctx = t.extract(ot.BINARY, buf)
+    assert ctx.trace_id == s.span.trace_id
+    assert ctx.span_id == s.span.id
+    assert ctx.resource == "res-x"
+
+
+def test_extract_request_child():
+    t = ot.Tracer()
+    parent = t.start_span("origin")
+    headers: dict = {}
+    t.inject_header(parent.context(), headers)
+    child = t.extract_request_child("/import", headers, "serve")
+    assert child.span.trace_id == parent.span.trace_id
+    assert child.span.parent_id == parent.span.id
+    assert child.span.tags["resource"] == "/import"
+
+
+def test_http_hop_propagation_end_to_end():
+    """Local HTTP forward → global /import: the import-side span must
+    continue the forwarder's trace and rejoin the global's span
+    pipeline (reference handlers_global.go:81,125)."""
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.distributed.forward import HTTPForwarder
+    from veneur_tpu.distributed.import_server import (
+        ImportHTTPServer, ImportServer,
+    )
+    from veneur_tpu.protocol.dogstatsd import parse_metric
+
+    gsrv = Server(Config(interval="10s", percentiles=[0.5], num_workers=1))
+    captured = []
+    gsrv.span_worker.ingest = captured.append  # tap the span pipeline
+    imp = ImportServer(gsrv)
+    http = ImportHTTPServer(imp)
+    port = http.start()
+    try:
+        lsrv = Server(Config(interval="10s", percentiles=[0.5]))
+        local_spans = []
+        lsrv.span_worker.ingest = local_spans.append
+        fwd = HTTPForwarder(f"http://127.0.0.1:{port}",
+                            tracer=lsrv.tracer)
+        m = parse_metric(b"hop.lat:5|h")
+        lsrv.workers[0].process_metric(m)
+        snap = lsrv.workers[0].flush(np.array([0.5]), 10.0)
+        fwd([snap])
+        assert fwd.sent_batches == 1
+        deadline = time.time() + 5
+        while not captured and time.time() < deadline:
+            time.sleep(0.02)
+        import_spans = [s for s in captured if s.name == "veneur.import"]
+        assert import_spans, [s.name for s in captured]
+        fwd_spans = [s for s in local_spans if s.name == "flush.forward"]
+        assert fwd_spans
+        # the import-side span continues the forwarder's trace
+        assert import_spans[0].trace_id == fwd_spans[0].trace_id
+        assert import_spans[0].parent_id == fwd_spans[0].id
+        assert imp.received_metrics >= 1
+    finally:
+        http.stop()
+        imp.stop()

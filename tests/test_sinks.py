@@ -379,3 +379,67 @@ def test_build_server_from_config():
     assert "scoped" in srv.sink_excluded_tags["datadog"]
     assert "scoped" not in srv.sink_excluded_tags.get("signalfx", set())
     srv.shutdown()
+
+
+def test_splunk_stop_drains_and_joins():
+    from veneur_tpu.sinks.splunk import SplunkSpanSink
+
+    opener = FakeOpener()
+    sink = SplunkSpanSink("https://splunk:8088", "tok", batch_size=1000,
+                          opener=opener)
+    sink.start()
+    for i in range(7):
+        sink.ingest(_span(id=i + 1))
+    sink.stop()  # batch far below batch_size: only stop() flushes it
+    assert sink.spans_flushed == 7
+    assert not sink._threads
+    sink.ingest(_span(id=99))  # post-stop ingest drops, never blocks
+    assert sink.spans_dropped >= 1
+    sink.stop()  # idempotent
+
+
+def test_splunk_session_rotation_lifetime():
+    import time as _t
+
+    from veneur_tpu.sinks.splunk import _RotatingSession
+
+    class _Srv:
+        pass
+
+    import http.server
+    import threading
+
+    hits = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            hits.append(self.headers.get("X-N"))
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_port}/services/collector/event"
+        s = _RotatingSession(url, lifetime_s=0.05, jitter_s=0.0,
+                             timeout_s=5.0)
+        st, _ = s.post(b"{}", {"X-N": "1", "Content-Type": "a/b"})
+        assert st == 200
+        assert s.rotations == 1
+        _t.sleep(0.1)  # past the lifetime → next post rotates
+        st, _ = s.post(b"{}", {"X-N": "2", "Content-Type": "a/b"})
+        assert st == 200
+        assert s.rotations == 2
+        st, _ = s.post(b"{}", {"X-N": "3", "Content-Type": "a/b"})
+        assert st == 200
+        assert s.rotations == 2  # within lifetime: same session reused
+        s.close()
+    finally:
+        httpd.shutdown()

@@ -1,0 +1,79 @@
+"""Probe which piece of the reworked add_batch stalls TPU compilation.
+
+Compiles each suspect in isolation with wall-clock prints so a hang is
+attributable. Run: python tools/probe_compile.py [sizes]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 22
+S = 16384
+C = 128
+
+print("device:", jax.devices()[0], flush=True)
+rows = jnp.asarray(np.random.default_rng(0).integers(0, S, N).astype(np.int32))
+vals = jnp.asarray(np.random.default_rng(1).gamma(2, 50, N).astype(np.float32))
+wts = jnp.ones(N, jnp.float32)
+
+
+def timed(name, fn, *args):
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(jax.jit(fn).lower(*args).compile()(*args))
+    t1 = time.perf_counter()
+    print(f"{name:28s} compile+run {t1 - t0:7.1f}s", flush=True)
+    return out
+
+
+# 1. the single-key positions sort
+def pos_sort(rows):
+    starts = jnp.concatenate([jnp.ones((1,), bool), rows[1:] != rows[:-1]])
+    pos = jnp.where(starts, jnp.arange(N, dtype=jnp.int32), N)
+    return jax.lax.sort(pos)
+
+
+timed("lax.sort single i32", pos_sort, rows)
+
+
+# 2. associative-scan last-marked-carry at [S, 2C]
+def carry(means):
+    from veneur_tpu.ops import segments
+
+    mask = means > 50.0
+    a, b = segments.last_marked_carry(mask, means, means * 2.0)
+    return a + b
+
+
+m2 = jnp.asarray(np.random.default_rng(2).gamma(2, 50, (S, 2 * C))
+                 .astype(np.float32))
+timed("last_marked_carry [S,2C]", carry, m2)
+
+
+# 3. compress_rows
+def comp(means):
+    from veneur_tpu.ops import tdigest as td
+
+    w = jnp.where(jnp.isfinite(means), 1.0, 0.0)
+    return td._compress_rows(means, w, 100.0, C)
+
+
+timed("_compress_rows [S,2C]", comp, m2)
+
+
+# 4. full add_batch
+def full(rows, vals, wts):
+    from veneur_tpu.ops import tdigest as td
+
+    pool = td.init_pool(S, C)
+    return td.add_batch(pool.means, pool.weights, pool.min, pool.max,
+                        pool.recip, rows, vals, wts)
+
+
+timed("add_batch full", full, rows, vals, wts)
+print("all done", flush=True)

@@ -1,0 +1,94 @@
+"""Bisect the _compress_rows TPU compile stall."""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.ops import segments
+from veneur_tpu.ops.tdigest import _k_scale
+
+S = 16384
+C = 128
+M = 2 * C
+INF = jnp.inf
+
+print("device:", jax.devices()[0], flush=True)
+m0 = jnp.asarray(np.random.default_rng(2).gamma(2, 50, (S, M))
+                 .astype(np.float32))
+w0 = jnp.asarray((np.random.default_rng(3).uniform(0, 1, (S, M)) > 0.3)
+                 .astype(np.float32))
+
+
+def timed(name, fn, *args):
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(jax.jit(fn)(*args))
+    t1 = time.perf_counter()
+    print(f"{name:30s} {t1 - t0:7.1f}s", flush=True)
+    return out
+
+
+def front(means, weights):
+    sort_keys = jnp.where(weights > 0, means, INF)
+    sm, sw = jax.lax.sort((sort_keys, weights), dimension=-1, num_keys=1)
+    w_cum = jnp.cumsum(sw, axis=-1)
+    total = w_cum[:, -1:]
+    q_left = (w_cum - sw) / jnp.maximum(total, 1e-30)
+    bucket = jnp.clip(
+        jnp.floor(_k_scale(q_left, 100.0)).astype(jnp.int32), 0, C - 1)
+    return sm, sw, w_cum, bucket
+
+
+timed("front (sort+cum+bucket)", front, m0, w0)
+
+
+def with_ends(means, weights):
+    sm, sw, w_cum, bucket = front(means, weights)
+    mw_cum = jnp.cumsum(jnp.where(sw > 0, sm * sw, 0.0), axis=-1)
+    nxt = jnp.concatenate(
+        [bucket[:, 1:], jnp.full((S, 1), -1, jnp.int32)], axis=-1)
+    is_end = bucket != nxt
+    return is_end, w_cum, mw_cum
+
+
+timed("ends (no carry)", with_ends, m0, w0)
+
+
+def with_carry(means, weights):
+    is_end, w_cum, mw_cum = with_ends(means, weights)
+    w_b, mw_b = segments.last_marked_carry(is_end, w_cum, mw_cum)
+    return w_b + mw_b
+
+
+timed("carry (no out sort)", with_carry, m0, w0)
+
+
+def full_no_slice(means, weights):
+    sm, sw, w_cum, bucket = front(means, weights)
+    mw_cum = jnp.cumsum(jnp.where(sw > 0, sm * sw, 0.0), axis=-1)
+    nxt = jnp.concatenate(
+        [bucket[:, 1:], jnp.full((S, 1), -1, jnp.int32)], axis=-1)
+    is_end = bucket != nxt
+    w_b, mw_b = segments.last_marked_carry(is_end, w_cum, mw_cum)
+    seg_w = w_cum - w_b
+    seg_mw = mw_cum - mw_b
+    live = is_end & (seg_w > 0)
+    nm = jnp.where(live, seg_mw / jnp.maximum(seg_w, 1e-30), INF)
+    nw = jnp.where(live, seg_w, 0.0)
+    return jax.lax.sort((nm, nw), dimension=-1, num_keys=1)
+
+
+timed("full (no slice)", full_no_slice, m0, w0)
+
+
+def full_slice(means, weights):
+    nm, nw = full_no_slice(means, weights)
+    return nm[:, :C], nw[:, :C]
+
+
+timed("full + slice", full_slice, m0, w0)
+print("all done", flush=True)

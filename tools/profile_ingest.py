@@ -83,6 +83,27 @@ def quant(means, weights, dmin, dmax, qs):
 print("device:", jax.devices()[0])
 out = bench("add_batch (full)", full, pool, rows, vals, wts)
 
+# larger batches amortize the [K, C]-shaped fixed cost (gathers + final
+# compress scale with series, not samples)
+N4 = N * 4
+rows4 = jnp.asarray(np.random.default_rng(7).integers(0, S, N4)
+                    .astype(np.int32))
+vals4 = jnp.asarray(np.random.default_rng(8).gamma(2.0, 50.0, N4)
+                    .astype(np.float32))
+wts4 = jnp.ones(N4, np.float32)
+
+
+@jax.jit
+def full4(pool, rows, vals, wts):
+    return td.add_batch(pool.means, pool.weights, pool.min, pool.max,
+                        pool.recip, rows, vals, wts)
+
+
+_saveN = N
+N = N4
+bench("add_batch (4x batch)", full4, pool, rows4, vals4, wts4)
+N = _saveN
+
 srows, svals, sw = bench("lax.sort 2-key + payload", sort3, rows, vals, wts)
 
 # single fused key: row in high bits, value-as-sortable-u32 in low bits,

@@ -131,6 +131,12 @@ class Config:
     tpu_batch_size: int = 16384
     tpu_compression: float = 100.0
     tpu_hll_precision: int = 14
+    # set-sketch storage: "staged" keeps small sets host-side sparse and
+    # promotes rows past 2^p/8 distinct registers to dense device rows
+    # (the scalable default — 1M small-set series costs ~MBs instead of
+    # 16GB of HBM; see ops/staged_sets.py for the crossover math);
+    # "dense" keeps the all-dense device pool
+    tpu_set_store: str = "staged"
     tpu_initial_histo_rows: int = 4096
     tpu_initial_set_rows: int = 512
 
@@ -432,5 +438,7 @@ def validate_config(cfg: Config) -> None:
                              " tpu_mesh_hosts")
     if cfg.set_hash not in ("fnv", "metro"):
         raise ValueError("set_hash must be 'fnv' or 'metro'")
+    if cfg.tpu_set_store not in ("staged", "dense"):
+        raise ValueError("tpu_set_store must be 'staged' or 'dense'")
     if not (4 <= cfg.tpu_hll_precision <= 18):
         raise ValueError("tpu_hll_precision must be in [4,18]")

@@ -259,6 +259,8 @@ def forwardable_rows(snap: FlushSnapshot):
             float(snap.dmin[row]), float(snap.dmax[row]),
             float(snap.drecip[row]),
         )
-    for row, meta in enumerate(snap.directory.sets.rows):
-        if meta.scope_class == ScopeClass.MIXED:
-            yield ("set", meta.key, meta.tags, snap.set_registers[row])
+    if snap.set_registers is not None:
+        # terminal (global) snapshots skip register materialization
+        for row, meta in enumerate(snap.directory.sets.rows):
+            if meta.scope_class == ScopeClass.MIXED:
+                yield ("set", meta.key, meta.tags, snap.set_registers[row])

@@ -66,6 +66,17 @@ def calculate_tick_delay(interval_s: float, now: float) -> float:
     return interval_s - (now % interval_s)
 
 
+class _SpanPipelineClient:
+    """Trace-client adapter: finished internal spans re-enter the owning
+    server's span pipeline (sinks + ssfmetrics extraction)."""
+
+    def __init__(self, server: "Server") -> None:
+        self._server = server
+
+    def record(self, span) -> None:
+        self._server.ingest_internal_span(span)
+
+
 class Server:
     """One veneur_tpu instance (local or global)."""
 
@@ -90,6 +101,7 @@ class Server:
                 count_unique_timeseries=cfg.count_unique_timeseries,
                 is_local=self.is_local,
                 set_hash=cfg.set_hash,
+                set_store=cfg.tpu_set_store,
             )
             for _ in range(cfg.num_workers)
         ]
@@ -199,6 +211,14 @@ class Server:
         # home shard
         self._native_ssf = (self.native_mode and not self.span_sinks
                             and len(self.workers) == 1)
+
+        # OpenTracing tracer for cross-hop propagation: spans it finishes
+        # rejoin this server's own span pipeline (the reference's internal
+        # spans flow through SpanChan the same way, server.go:310-317)
+        from veneur_tpu.trace.opentracing import Tracer as _OTTracer
+
+        self.tracer = _OTTracer(client=_SpanPipelineClient(self),
+                                service="veneur-tpu")
         self._native_ssf_indicator = (
             cfg.indicator_span_timer_name.encode())
         self._native_ssf_objective = (
@@ -299,6 +319,11 @@ class Server:
             self.parse_errors += 1
             log.debug("bad SSF packet: %s", e)
             return
+        self.handle_ssf(span)
+
+    def ingest_internal_span(self, span) -> None:
+        """Self-tracing entry: a finished internal span enters the same
+        pipeline external SSF spans do."""
         self.handle_ssf(span)
 
     def handle_ssf(self, span) -> None:
@@ -786,6 +811,11 @@ class Server:
             self._profile_dir = None
         self.stats.close()
         self.span_worker.stop()
+        for sink in list(self.metric_sinks) + list(self.span_sinks):
+            try:
+                sink.stop()
+            except Exception:
+                log.exception("sink %s failed to stop", sink.name())
         if self.import_server is not None:
             self.import_server.stop()
         if self.import_http is not None:

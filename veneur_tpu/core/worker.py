@@ -368,6 +368,7 @@ class DeviceWorker:
         count_unique_timeseries: bool = False,
         is_local: bool = True,
         set_hash: str = "fnv",
+        set_store: str = "staged",
     ) -> None:
         self.batch_size = batch_size
         self.compression = compression
@@ -384,6 +385,7 @@ class DeviceWorker:
         self._initial_set_rows = initial_set_rows
         self.count_unique_timeseries = count_unique_timeseries
         self.is_local = is_local
+        self.set_store = set_store
         self._processed_py = 0
         self._native_proc_seen = 0
         self.imported = 0
@@ -562,6 +564,14 @@ class DeviceWorker:
         self.scalars = HostScalars()
         self._histo: Optional[HistoDeviceState] = None
         self._sets: Optional[jax.Array] = None
+        # staged (sparse-host / dense-device) set store — the scalable
+        # default; tpu_set_store: dense keeps the all-dense pool
+        if self.set_store == "staged":
+            from veneur_tpu.ops.staged_sets import StagedSetStore
+
+            self._staged_sets = StagedSetStore(self.hll_precision)
+        else:
+            self._staged_sets = None
         # pending SoA buffers (host)
         self._ph_rows: list[int] = []
         self._ph_vals: list[float] = []
@@ -590,6 +600,8 @@ class DeviceWorker:
             )
 
     def _ensure_sets(self, needed_rows: int) -> None:
+        if self._staged_sets is not None:
+            return  # the staged store sizes itself
         if self._sets is None:
             rows = _next_pow2(needed_rows + 1, self._initial_set_rows)
             self._sets = hll_ops.init_pool(rows, self.hll_precision)
@@ -784,6 +796,9 @@ class DeviceWorker:
 
     def _device_set_step(self, rows: np.ndarray, idx: np.ndarray,
                          rank: np.ndarray) -> None:
+        if self._staged_sets is not None:
+            self._staged_sets.insert(rows, idx, rank)
+            return
         regs = self._sets
         assert regs is not None
         n = _next_pow2(len(rows), 256)
@@ -828,6 +843,9 @@ class DeviceWorker:
                    scope_class: ScopeClass, registers: np.ndarray) -> None:
         self.imported += 1
         row = self._upsert_set(key, scope_class, tags)
+        if self._staged_sets is not None:
+            self._staged_sets.import_dense(row, registers)
+            return
         self._ensure_sets(self.directory.num_set_rows)
         prev = self._imp_hll.get(row)
         regs = np.asarray(registers, np.int8)
@@ -960,6 +978,7 @@ class DeviceWorker:
         scalars = self.scalars
         histo = self._histo
         sets = self._sets
+        staged_sets = self._staged_sets
         umts = self._umts
         self.processed = 0
         self.imported = 0
@@ -1002,7 +1021,15 @@ class DeviceWorker:
                 snap.lsum = np.zeros(n, np.float64)
                 snap.lweight = np.zeros(n, np.float64)
                 snap.lrecip = np.zeros(n, np.float64)
-        if sets is not None and directory.num_set_rows:
+        if staged_sets is not None and directory.num_set_rows:
+            n = directory.num_set_rows
+            snap.set_estimates = staged_sets.estimates(n)
+            # register materialization is [n, 2^p] host bytes — only pay
+            # it where forwarding can read it (locals forward mixed sets;
+            # a global is a terminal aggregator for them)
+            if self.is_local:
+                snap.set_registers = staged_sets.registers(n)
+        elif sets is not None and directory.num_set_rows:
             n = directory.num_set_rows
             snap.set_estimates = np.asarray(
                 hll_ops.estimate(sets, self.hll_precision)

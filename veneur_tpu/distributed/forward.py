@@ -51,14 +51,20 @@ class GRPCForwarder:
 
 
 class HTTPForwarder:
-    """POST /import with a deflate JSON body (the v1 forwarding path)."""
+    """POST /import with a deflate JSON body (the v1 forwarding path).
+
+    With a tracer attached, each forward runs under a span whose context
+    is injected into the request headers — the cross-hop propagation of
+    reference flushForward + PostHelper (flusher.go:338, http/http.go)."""
 
     def __init__(self, base_url: str, timeout_s: float = 10.0,
-                 compression: float = 100.0, hll_precision: int = 14) -> None:
+                 compression: float = 100.0, hll_precision: int = 14,
+                 tracer=None) -> None:
         self.url = base_url.rstrip("/") + "/import"
         self.timeout_s = timeout_s
         self.compression = compression
         self.hll_precision = hll_precision
+        self.tracer = tracer
         self.errors = 0
         self.sent_batches = 0
 
@@ -78,20 +84,28 @@ class HTTPForwarder:
         if not items:
             return
         body = zlib.compress(json.dumps(items).encode("utf-8"))
+        headers = {
+            "Content-Type": "application/json",
+            "Content-Encoding": "deflate",
+        }
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start_span("flush.forward")
+            self.tracer.inject_header(span.context(), headers)
         req = urllib.request.Request(
-            self.url, data=body, method="POST",
-            headers={
-                "Content-Type": "application/json",
-                "Content-Encoding": "deflate",
-            },
-        )
+            self.url, data=body, method="POST", headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 resp.read()
             self.sent_batches += 1
         except Exception as e:
             self.errors += 1
+            if span is not None:
+                span.set_error()
             log.warning("http forward to %s failed: %s", self.url, e)
+        finally:
+            if span is not None:
+                span.finish()
 
 
 def install_forwarder(server, compression: Optional[float] = None,
@@ -120,4 +134,5 @@ def install_forwarder(server, compression: Optional[float] = None,
                 addr, timeout, compression, hll_precision)
     else:
         server.forwarder = HTTPForwarder(
-            cfg.forward_address, timeout, compression, hll_precision)
+            cfg.forward_address, timeout, compression, hll_precision,
+            tracer=getattr(server, "tracer", None))

@@ -128,15 +128,32 @@ class ImportHTTPServer:
                 if self.path != "/import":
                     self._respond(404, b"not found")
                     return
+                # cross-hop trace propagation: continue the forwarder's
+                # trace when headers carry one (reference handleImport via
+                # ExtractRequestChild, handlers_global.go:60-72,81)
+                span = None
+                if srv is not None:
+                    from veneur_tpu.trace.opentracing import (
+                        start_span_from_headers,
+                    )
+
+                    span = start_span_from_headers(
+                        dict(self.headers), "veneur.import",
+                        resource="/import", tracer=srv.tracer)
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 try:
                     batch = decode_http_import_body(
                         body, self.headers.get("Content-Encoding", ""))
                 except Exception as e:
+                    if span is not None:
+                        span.set_error()
+                        span.finish()
                     self._respond(400, f"bad import body: {e}".encode())
                     return
                 imp.handle_batch(batch)
+                if span is not None:
+                    span.finish()
                 self._respond(200, b"accepted")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)

@@ -99,18 +99,30 @@ def last_marked_carry(mask: jax.Array, *values: jax.Array
 
     mask: bool[..., L]; values: f32[..., L] each. Returns one array per
     payload. log2(L) elementwise select steps — no gathers, no scatters.
+    (Hand-rolled Hillis-Steele jumps rather than lax.associative_scan:
+    the scan's recursive slicing stalls the TPU compiler when fused into
+    a larger program — observed >30min on v5e for _compress_rows — while
+    this loop, the same shape as segmented_cumsum's, compiles in
+    seconds.)
     """
     pad = [(0, 0)] * (mask.ndim - 1) + [(1, 0)]
-    mask = jnp.pad(mask, pad)[..., :-1]
-    values = tuple(jnp.pad(v, pad)[..., :-1] for v in values)
+    m = jnp.pad(mask, pad)[..., :-1]
+    vs = [jnp.pad(v, pad)[..., :-1] for v in values]
+    n = m.shape[-1]
 
-    def combine(a, b):
-        ma, *va = a
-        mb, *vb = b
-        return (ma | mb, *[jnp.where(mb, y, x) for x, y in zip(va, vb)])
+    def shift_right(x, k, fill=False):
+        p = [(0, 0)] * (x.ndim - 1) + [(k, 0)]
+        return jnp.pad(x, p, constant_values=fill)[..., :n]
 
-    out = jax.lax.associative_scan(combine, (mask, *values), axis=-1)
-    return tuple(out[1:])
+    shift = 1
+    while shift < n:
+        # invariant: (m, vs) at i reflect the last mark in (i-2^k, i]
+        m_s = shift_right(m, shift)
+        vs = [jnp.where(m, v, shift_right(v, shift, 0))
+              for v in vs]
+        m = m | m_s
+        shift *= 2
+    return tuple(vs)
 
 
 class RunSums(NamedTuple):

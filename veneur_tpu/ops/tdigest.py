@@ -284,13 +284,24 @@ def add_batch(
     j = jnp.arange(c, dtype=jnp.int32)
     runs = jnp.clip(run_lo[:, None] + j[None, :], 0, n - 1)  # [K, C]
     valid = j[None, :] < n_runs_row[:, None]
+    # every [K, C]-shaped gather below is ~2M probes at fixed per-element
+    # cost — the dominant fixed cost of this function on TPU — so: fetch
+    # run starts once; run ends are the NEXT run's start (shift within
+    # the row window), and the last run of a row ends where the row does
+    # (row_upper — already known, no gather)
     r_start = jnp.take(pos_ext, runs)
-    r_end = jnp.take(pos_ext, runs + 1)
-    bd_w = jnp.where(valid, jnp.take(pre_w, r_end) - jnp.take(pre_w, r_start),
-                     0.0)
-    bd_mw = jnp.where(valid,
-                      jnp.take(pre_vw, r_end) - jnp.take(pre_vw, r_start),
-                      0.0)
+    last = j[None, :] == (n_runs_row - 1)[:, None]
+    r_next = jnp.concatenate(
+        [r_start[:, 1:], jnp.zeros((k, 1), jnp.int32)], axis=-1)
+    r_end = jnp.where(last, row_upper[:, None], r_next)
+    # prefix sums fetched as 2-lane pairs: one gather of [K, C, 2]
+    # instead of two of [K, C] per endpoint
+    pre = jnp.stack([pre_w, pre_vw], axis=-1)  # [N+1, 2]
+    at_end = jnp.take(pre, r_end, axis=0)  # [K, C, 2]
+    at_start = jnp.take(pre, r_start, axis=0)
+    diff = at_end - at_start
+    bd_w = jnp.where(valid, diff[..., 0], 0.0)
+    bd_mw = jnp.where(valid, diff[..., 1], 0.0)
     bd_means = jnp.where(bd_w > 0, bd_mw / jnp.maximum(bd_w, 1e-30), _INF)
 
     # --- 4. Merge with the existing rows and recompress.

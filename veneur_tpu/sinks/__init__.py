@@ -35,6 +35,10 @@ class MetricSink(abc.ABC):
         """Receive 'other' samples (events, service checks carried as SSF);
         sinks that can't represent them drop them."""
 
+    def stop(self) -> None:
+        """Graceful shutdown: flush buffered data, stop worker threads.
+        Default no-op; sinks with background submitters override."""
+
 
 class SpanSink(abc.ABC):
     """A destination for trace spans (reference sinks/sinks.go:85-103)."""
@@ -48,6 +52,10 @@ class SpanSink(abc.ABC):
     def ingest(self, span: SSFSpan) -> None: ...
 
     def flush(self) -> None: ...
+
+    def stop(self) -> None:
+        """Graceful shutdown: flush buffered data, stop worker threads.
+        Default no-op; sinks with background submitters override."""
 
 
 def filter_routed(metrics: Iterable[InterMetric], sink_name: str

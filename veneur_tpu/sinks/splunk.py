@@ -2,23 +2,97 @@
 
 Parity: reference sinks/splunk/splunk.go — batched HEC submissions from a
 bounded ingest queue drained by N submission workers, probabilistic span
-sampling (1/N keep with the trace id as the sampling unit), connection
-lifetime jitter approximated by periodically rotating the HTTP session.
+sampling (1/N keep with the trace id as the sampling unit), and real
+connection-lifetime jitter: each worker holds a keep-alive HTTP session
+and rotates it after a randomized lifetime so a fleet's connections don't
+recycle (and re-balance across an LB) in lockstep. stop() performs a
+bounded drain (see its docstring) — reference: Stop + hecSubmissionWorker
+exit.
 """
 
 from __future__ import annotations
 
+import http.client
+import json
 import logging
 import queue
+import random
+import ssl
 import threading
 import time
+import urllib.parse
 from typing import Optional
 
 from veneur_tpu.sinks import SpanSink
 from veneur_tpu.ssf import SSFSpan
-from veneur_tpu.utils.http import default_opener, post_json
 
 log = logging.getLogger("veneur_tpu.sinks.splunk")
+
+
+
+class _RotatingSession:
+    """Keep-alive HTTP(S) connection that re-establishes itself after a
+    jittered lifetime (reference connection lifetime jitter,
+    sinks/splunk/splunk.go hecConnectionLifetimeJitter)."""
+
+    def __init__(self, url: str, lifetime_s: float,
+                 jitter_s: float, timeout_s: float) -> None:
+        parsed = urllib.parse.urlsplit(url)
+        self.scheme = parsed.scheme
+        self.host = parsed.hostname or "localhost"
+        self.port = parsed.port
+        self.path = parsed.path or "/"
+        self.lifetime_s = lifetime_s
+        self.jitter_s = jitter_s
+        self.timeout_s = timeout_s
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self._expires = 0.0
+        self.rotations = 0
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if self.scheme == "https":
+            conn = http.client.HTTPSConnection(
+                self.host, self.port, timeout=self.timeout_s,
+                context=ssl.create_default_context())
+        else:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout_s)
+        self._expires = (time.monotonic() + self.lifetime_s
+                         + random.uniform(0, self.jitter_s))
+        return conn
+
+    def post(self, body: bytes, headers: dict[str, str]) -> tuple[int, bytes]:
+        if self._conn is None or time.monotonic() >= self._expires:
+            self.close()
+            self._conn = self._connect()
+            self.rotations += 1
+        try:
+            self._conn.request("POST", self.path, body=body, headers=headers)
+        except Exception:
+            # send-path failure (stale keep-alive): the server never got a
+            # complete request, so one resend cannot duplicate events
+            self.close()
+            self._conn = self._connect()
+            self.rotations += 1
+            self._conn.request("POST", self.path, body=body, headers=headers)
+        try:
+            resp = self._conn.getresponse()
+            return resp.status, resp.read()
+        except Exception:
+            # response-path failure: the server may already have indexed
+            # the batch — resending would duplicate it, so surface the
+            # error and let the caller count it (per-flush data is
+            # expendable; duplication is not)
+            self.close()
+            raise
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+            self._conn = None
 
 
 class SplunkSpanSink(SpanSink):
@@ -32,7 +106,9 @@ class SplunkSpanSink(SpanSink):
         span_sample_rate: int = 100,  # percent of traces kept
         ingest_timeout_s: float = 0.0,
         send_timeout_s: float = 10.0,
-        opener=default_opener,
+        connection_lifetime_s: float = 60.0,
+        connection_lifetime_jitter_s: float = 30.0,
+        opener=None,
     ) -> None:
         self.url = hec_address.rstrip("/") + "/services/collector/event"
         self.token = token
@@ -41,14 +117,17 @@ class SplunkSpanSink(SpanSink):
         self.span_sample_rate = span_sample_rate
         self.ingest_timeout_s = ingest_timeout_s
         self.send_timeout_s = send_timeout_s
-        self.opener = opener
-        self.queue: "queue.Queue[Optional[SSFSpan]]" = queue.Queue(
-            maxsize=batch_size * 16)
+        self.connection_lifetime_s = connection_lifetime_s
+        self.connection_lifetime_jitter_s = connection_lifetime_jitter_s
+        self.opener = opener  # test injection; None = rotating sessions
+        self.queue: "queue.Queue" = queue.Queue(maxsize=batch_size * 16)
         self.spans_flushed = 0
         self.spans_dropped = 0
         self.flush_errors = 0
+        self.session_rotations = 0
         self._workers = submission_workers
         self._threads: list[threading.Thread] = []
+        self._stopping = threading.Event()
 
     def name(self) -> str:
         return "splunk"
@@ -60,7 +139,28 @@ class SplunkSpanSink(SpanSink):
             t.start()
             self._threads.append(t)
 
+    def stop(self) -> None:
+        """Bounded-drain shutdown: workers flush what they can within
+        ~2 send timeouts; anything still queued after that is counted as
+        dropped (per-flush data is expendable) and the workers, being
+        daemons, die with the process."""
+        if self._stopping.is_set():
+            return
+        self._stopping.set()
+        deadline = time.monotonic() + max(self.send_timeout_s, 1.0) * 2
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        undrained = self.queue.qsize()
+        if undrained or any(t.is_alive() for t in self._threads):
+            self.spans_dropped += undrained
+            log.warning("splunk stop: %d spans undrained at deadline",
+                        undrained)
+        self._threads.clear()
+
     def ingest(self, span: SSFSpan) -> None:
+        if self._stopping.is_set():
+            self.spans_dropped += 1
+            return
         # sample on trace id so all spans of a trace share a fate
         if self.span_sample_rate < 100 and (
             span.trace_id % 100 >= self.span_sample_rate
@@ -73,22 +173,33 @@ class SplunkSpanSink(SpanSink):
             self.spans_dropped += 1
 
     def _submit_loop(self) -> None:
+        session = _RotatingSession(
+            self.url, self.connection_lifetime_s,
+            self.connection_lifetime_jitter_s, self.send_timeout_s)
         batch: list[SSFSpan] = []
         last_send = time.time()
         while True:
             try:
-                span = self.queue.get(timeout=1.0)
+                item = self.queue.get(timeout=0.2)
             except queue.Empty:
-                span = None
-            if span is not None:
-                batch.append(span)
-            if batch and (len(batch) >= self.batch_size
+                item = None
+            if item is not None:
+                batch.append(item)
+            # exit condition checked directly (not via a sentinel that a
+            # full queue could drop): stopping and nothing left to read
+            done = (item is None and self._stopping.is_set()
+                    and self.queue.empty())
+            if batch and (done or len(batch) >= self.batch_size
                           or time.time() - last_send > 5.0):
-                self._send(batch)
+                self._send(batch, session)
                 batch = []
                 last_send = time.time()
+            if done:
+                break
+        session.close()
+        self.session_rotations += session.rotations
 
-    def _send(self, batch: list[SSFSpan]) -> None:
+    def _send(self, batch: list[SSFSpan], session: _RotatingSession) -> None:
         events = []
         for s in batch:
             events.append({
@@ -109,13 +220,25 @@ class SplunkSpanSink(SpanSink):
                     "tags": dict(s.tags),
                 },
             })
+        headers = {
+            "Authorization": f"Splunk {self.token}",
+            "Content-Type": "application/json",
+        }
         try:
             # HEC accepts newline-concatenated JSON events; a JSON array
             # body carries the same content for our purposes
-            post_json(
-                self.url, events,
-                headers={"Authorization": f"Splunk {self.token}"},
-                timeout=self.send_timeout_s, opener=self.opener)
+            if self.opener is not None:
+                import urllib.request
+
+                from veneur_tpu.utils.http import post_json
+
+                post_json(self.url, events, headers=headers,
+                          timeout=self.send_timeout_s, opener=self.opener)
+            else:
+                status, body = session.post(
+                    json.dumps(events).encode("utf-8"), headers)
+                if status >= 400:
+                    raise RuntimeError(f"HEC status {status}: {body[:200]!r}")
             self.spans_flushed += len(batch)
         except Exception as e:
             self.flush_errors += 1
