@@ -365,3 +365,36 @@ def test_ingest_not_blocked_during_flush_extraction():
         assert post > 0
     finally:
         srv.shutdown()
+
+
+def test_listener_fd_handoff_keeps_datagrams():
+    """Zero-downtime restart (reference einhorn handoff,
+    server.go:1401-1429): datagrams sent between the old server's
+    quiesce and the new server's start must queue in the kernel socket
+    buffer and be delivered to the successor, not dropped."""
+    srv_a, _sink_a, ports = _server(num_workers=1, interval="600s")
+    spec = next(iter(ports))
+    port = ports[spec]
+    try:
+        _send_udp(port, b"gen1.c:1|c")
+        assert _wait_for(lambda: sum(w.processed for w in srv_a.workers) >= 1)
+
+        manifest = srv_a.prepare_handoff()
+        assert manifest[spec]  # the udp listener fd is in the manifest
+        # readers are quiesced: these datagrams queue in the kernel buffer
+        for i in range(5):
+            _send_udp(port, b"gen2.c:1|c")
+        srv_a.shutdown()
+
+        cfg = Config(statsd_listen_addresses=[spec], num_workers=1,
+                     interval="600s", num_readers=1)
+        srv_b = Server(cfg, inherited_fds=manifest)
+        ports_b = srv_b.start()
+        try:
+            assert ports_b[spec] == port  # same socket, same port
+            assert _wait_for(
+                lambda: sum(w.processed for w in srv_b.workers) >= 5)
+        finally:
+            srv_b.shutdown()
+    finally:
+        srv_a.shutdown()

@@ -7,7 +7,9 @@ and signal-driven graceful shutdown.
 
 from __future__ import annotations
 
+import json
 import argparse
+import os
 import logging
 import signal
 import sys
@@ -45,7 +47,39 @@ def main(argv=None) -> int:
         logging.getLogger("veneur_tpu").debug(
             "config: %s", redacted_dict(cfg))
 
-    server = build_server(cfg)
+    # zero-downtime restart: adopt listener fds handed off by the
+    # previous process image (datagrams queued in their kernel buffers
+    # during the exec are delivered, not dropped)
+    inherited = None
+    manifest_env = os.environ.pop("VENEUR_INHERITED_FDS", "")
+    if manifest_env:
+        try:
+            raw = json.loads(manifest_env)
+            inherited = {str(k): [int(fd) for fd in v]
+                         for k, v in raw.items()}
+            logging.getLogger("veneur_tpu").info(
+                "adopting inherited listener fds: %s", inherited)
+        except Exception:
+            logging.getLogger("veneur_tpu").warning(
+                "bad VENEUR_INHERITED_FDS manifest; binding fresh")
+            inherited = None
+            # close whatever fds the malformed manifest names: leaving
+            # them open keeps the old sockets bound alongside the fresh
+            # ones and splits datagram delivery between them
+            try:
+                raw = json.loads(manifest_env)
+                values = raw.values() if isinstance(raw, dict) else []
+                for v in values:
+                    for fd in (v if isinstance(v, list) else [v]):
+                        if isinstance(fd, int) and fd > 2:
+                            try:
+                                os.close(fd)
+                            except OSError:
+                                pass
+            except Exception:
+                pass
+
+    server = build_server(cfg, inherited_fds=inherited)
     ports = server.start()
     server.start_watchdog()
     logging.getLogger("veneur_tpu").info(
@@ -75,10 +109,14 @@ def main(argv=None) -> int:
     # server._shutdown; the process must exit too, reference http.go:37-44)
     while not stop.is_set() and not server._shutdown.is_set():
         stop.wait(0.5)
+    manifest = None
     if restart.is_set():
-        # final best-effort flush so the partial interval survives the
-        # restart (the reference accepts losing it, README.md:133-141;
-        # draining is strictly better and cheap here)
+        # quiesce readers FIRST — from here, datagrams queue in the
+        # kernel socket buffers and ride the handoff to the successor —
+        # then drain the partial interval with a final flush (the
+        # reference accepts losing it, README.md:133-141; draining is
+        # strictly better and cheap here)
+        manifest = server.prepare_handoff()
         try:
             server.flush()
         except Exception:
@@ -86,13 +124,15 @@ def main(argv=None) -> int:
                 "final flush before restart failed")
     server.shutdown()
     if restart.is_set():
-        import os
-
         logging.getLogger("veneur_tpu").info(
-            "graceful restart: drained, re-executing")
-        os.execv(sys.executable, [sys.executable, "-m",
-                                  "veneur_tpu.cli.veneur_main",
-                                  *(argv or sys.argv[1:])])
+            "graceful restart: drained, re-executing with %d listener"
+            " fds", sum(len(v) for v in (manifest or {}).values()))
+        env = dict(os.environ)
+        if manifest:
+            env["VENEUR_INHERITED_FDS"] = json.dumps(manifest)
+        os.execve(sys.executable, [sys.executable, "-m",
+                                   "veneur_tpu.cli.veneur_main",
+                                   *(argv or sys.argv[1:])], env)
     return 0
 
 

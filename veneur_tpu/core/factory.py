@@ -17,10 +17,12 @@ log = logging.getLogger("veneur_tpu.factory")
 
 
 def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
-                 opener=None) -> Server:
+                 opener=None, inherited_fds=None) -> Server:
     """Construct a fully wired Server from configuration.
 
     opener (optional) is injected into every HTTP-based sink for tests.
+    inherited_fds carries listener fds across a zero-downtime re-exec
+    (see Server.prepare_handoff).
     """
     metric_sinks = list(extra_metric_sinks or [])
     span_sinks = list(extra_span_sinks or [])
@@ -180,7 +182,8 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
 
         span_sinks.append(DebugSpanSink())
 
-    server = Server(cfg, metric_sinks=metric_sinks, span_sinks=span_sinks)
+    server = Server(cfg, metric_sinks=metric_sinks, span_sinks=span_sinks,
+                    inherited_fds=inherited_fds)
 
     # plugins (reference server.go:737-785)
     if cfg.flush_file:

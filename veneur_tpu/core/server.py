@@ -82,7 +82,9 @@ class Server:
 
     def __init__(self, cfg: Config,
                  metric_sinks: Optional[list[MetricSink]] = None,
-                 span_sinks: Optional[list[SpanSink]] = None) -> None:
+                 span_sinks: Optional[list[SpanSink]] = None,
+                 inherited_fds: Optional[dict[str, list[int]]] = None
+                 ) -> None:
         self.config = cfg
         self.interval = cfg.interval_seconds()
         self.hostname = cfg.hostname or (
@@ -159,6 +161,15 @@ class Server:
         self._threads: list[threading.Thread] = []
         self._sockets: list[socket.socket] = []
         self._socket_locks: list[int] = []
+        # zero-downtime restart (einhorn-style fd handoff): listener fds
+        # inherited from the previous process image, keyed by listener
+        # spec; datagrams queue in the kernel socket buffers across the
+        # re-exec instead of being dropped (reference server.go:1401-1429)
+        self._inherited: dict[str, list[int]] = dict(inherited_fds or {})
+        self._listener_fds: dict[str, list[int]] = {}
+        self._adopt: list[int] = []
+        self._handoff = False
+        self._quiesce = threading.Event()
         self._shutdown = threading.Event()
         self._shutdown_once_lock = threading.Lock()
         self._shutdown_done = False
@@ -336,16 +347,21 @@ class Server:
         self.span_worker.ingest(span)
 
     def start_ssf_udp(self, addr: str, port: int) -> int:
-        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((addr, port))
+        sock = self._adopt_fd()
+        if sock is None:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((addr, port))
         bound_port = sock.getsockname()[1]
         self._sockets.append(sock)
 
         def loop():
-            while not self._shutdown.is_set():
+            sock.settimeout(0.5)  # quiesce-able without closing (handoff)
+            while not (self._shutdown.is_set() or self._quiesce.is_set()):
                 try:
                     data = sock.recv(ssf_wire.MAX_SSF_PACKET_LENGTH)
+                except socket.timeout:
+                    continue
                 except OSError:
                     return
                 self.handle_trace_packet(data)
@@ -411,9 +427,14 @@ class Server:
         for spec in self.config.ssf_listen_addresses:
             proto, _, rest = spec.partition("://")
             if proto == "udp":
+                self._adopt = list(self._inherited.pop(spec, []))
+                before = len(self._sockets)
                 host, _, port = rest.rpartition(":")
                 ports[spec] = self.start_ssf_udp(host or "127.0.0.1",
                                                  int(port))
+                self._listener_fds[spec] = [
+                    s.fileno() for s in self._sockets[before:]]
+                self._close_unused_adopted()
             elif proto in ("unix", "unixstream"):
                 self.start_ssf_unix(rest)
             elif proto == "unixgram":
@@ -437,19 +458,44 @@ class Server:
         t.start()
         self._threads.append(t)
 
+    def _adopt_fd(self) -> Optional[socket.socket]:
+        """Take one inherited listener fd (if the previous process image
+        handed one off for the listener being started)."""
+        while self._adopt:
+            fd = self._adopt.pop(0)
+            try:
+                return socket.socket(fileno=fd)
+            except OSError:
+                log.warning("inherited fd %d unusable; binding fresh", fd)
+        return None
+
     def start_statsd_udp(self, addr: str, port: int) -> int:
         """N reader threads sharing the port via SO_REUSEPORT
         (reference networking.go:41-91, socket_linux.go)."""
+        if self._adopt and len(self._adopt) != self.config.num_readers:
+            # num_readers changed across the restart: a mixed
+            # adopted/fresh set can't share the port (the old sockets'
+            # SO_REUSEPORT state is fixed at their bind), so fall back to
+            # an all-fresh bind — a brief re-bind window, logged, instead
+            # of an EADDRINUSE crash
+            log.warning(
+                "num_readers changed across restart (%d inherited fds,"
+                " %d readers); re-binding fresh", len(self._adopt),
+                self.config.num_readers)
+            self._close_unused_adopted()
         bound_port = port
         for i in range(self.config.num_readers):
-            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            if self.config.num_readers > 1:
-                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
-            if self.config.read_buffer_size_bytes:
-                sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
-                                self.config.read_buffer_size_bytes)
-            sock.bind((addr, bound_port))
+            sock = self._adopt_fd()
+            if sock is None:
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                if self.config.num_readers > 1:
+                    sock.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEPORT, 1)
+                if self.config.read_buffer_size_bytes:
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                    self.config.read_buffer_size_bytes)
+                sock.bind((addr, bound_port))
             bound_port = sock.getsockname()[1]  # resolve port 0 once
             self._sockets.append(sock)
             self._spawn(
@@ -458,13 +504,25 @@ class Server:
             )
         return bound_port
 
-    def _read_metric_socket(self, sock: socket.socket) -> None:
+    def _read_metric_socket(self, sock: socket.socket,
+                            handoff_capable: bool = True) -> None:
         """reference ReadMetricSocket (server.go:1123): tight recv loop.
-        Reads max_length+1 so overlong datagrams are detectable."""
+        Reads max_length+1 so overlong datagrams are detectable. The
+        periodic timeout lets a handoff quiesce readers WITHOUT closing
+        the socket — once quiesced, datagrams queue in the kernel buffer
+        for the next process image instead of being consumed here.
+        handoff_capable=False (path-based unixgram sockets, which re-bind
+        instead of riding the exec) keeps consuming until shutdown —
+        quiescing a socket that is about to be closed would destroy
+        whatever queued behind it."""
         bufsize = self.config.metric_max_length + 1
-        while not self._shutdown.is_set():
+        sock.settimeout(0.5)
+        while not (self._shutdown.is_set()
+                   or (handoff_capable and self._quiesce.is_set())):
             try:
                 data = sock.recv(bufsize)
+            except socket.timeout:
+                continue
             except OSError:
                 return  # socket closed during shutdown
             self.process_metric_packet(data)
@@ -472,10 +530,12 @@ class Server:
     def start_statsd_tcp(self, addr: str, port: int) -> int:
         """Line-delimited TCP statsd, optional (mutual) TLS
         (reference server.go:1254-1335, TLS setup :438-472)."""
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((addr, port))
-        sock.listen(128)
+        sock = self._adopt_fd()  # inherited fds are already listening
+        if sock is None:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((addr, port))
+            sock.listen(128)
         bound_port = sock.getsockname()[1]
         self._sockets.append(sock)
 
@@ -490,11 +550,15 @@ class Server:
                 ssl_ctx.verify_mode = ssl.CERT_REQUIRED
 
         def accept_loop():
-            while not self._shutdown.is_set():
+            sock.settimeout(0.5)  # quiesce-able for handoff (see below)
+            while not (self._shutdown.is_set() or self._quiesce.is_set()):
                 try:
                     conn, peer = sock.accept()
+                except socket.timeout:
+                    continue
                 except OSError:
                     return
+                conn.settimeout(None)
                 self._spawn(
                     lambda c=conn, p=peer: self._handle_tcp_conn(c, p, ssl_ctx),
                     "statsd-tcp-conn",
@@ -566,7 +630,9 @@ class Server:
         """Datagram unix socket statsd (reference networking.go:144-196),
         with flock exclusivity and abstract-socket (@name) support."""
         sock = self._bind_unix_socket(path, socket.SOCK_DGRAM)
-        self._spawn(lambda: self._read_metric_socket(sock), "statsd-unixgram")
+        self._spawn(
+            lambda: self._read_metric_socket(sock, handoff_capable=False),
+            "statsd-unixgram")
 
     def start_listeners(self) -> dict[str, int]:
         """Start every configured statsd listener; returns resolved ports
@@ -574,6 +640,8 @@ class Server:
         ports = {}
         for spec in self.config.statsd_listen_addresses:
             proto, _, rest = spec.partition("://")
+            self._adopt = list(self._inherited.pop(spec, []))
+            before = len(self._sockets)
             if proto == "udp":
                 host, _, port = rest.rpartition(":")
                 ports[spec] = self.start_statsd_udp(host or "127.0.0.1",
@@ -583,10 +651,51 @@ class Server:
                 ports[spec] = self.start_statsd_tcp(host or "127.0.0.1",
                                                     int(port))
             elif proto == "unixgram":
+                # path-based sockets re-bind (flock exclusivity); no fd
+                # handoff
                 self.start_statsd_unixgram(rest)
             else:
                 raise ValueError(f"unsupported statsd listener {spec!r}")
+            if proto in ("udp", "tcp"):
+                self._listener_fds[spec] = [
+                    s.fileno() for s in self._sockets[before:]]
+            self._close_unused_adopted()
         return ports
+
+    def _close_unused_adopted(self) -> None:
+        # config (e.g. num_readers) shrank across a restart: surplus
+        # inherited fds must not leak
+        for fd in self._adopt:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._adopt = []
+
+    def prepare_handoff(self) -> dict[str, list[int]]:
+        """Mark every network listener fd inheritable and return the
+        spec→fds manifest for the next process image (einhorn-style
+        zero-downtime restart, reference server.go:1401-1429). After this,
+        shutdown() leaves those fds open so queued datagrams survive the
+        re-exec."""
+        self._handoff = True
+        # stop the reader/accept loops first (without closing the
+        # sockets) so datagrams queue in kernel buffers and TCP
+        # connections wait in the listen backlog for the successor
+        self._quiesce.set()
+        deadline = time.time() + 2.0
+        for t in self._threads:
+            if t.name.startswith(("statsd-udp", "ssf-udp",
+                                  "statsd-tcp-accept")):
+                t.join(timeout=max(0.0, deadline - time.time()))
+        for fds in self._listener_fds.values():
+            for fd in fds:
+                try:
+                    os.set_inheritable(fd, True)
+                except OSError:
+                    log.warning("fd %d not inheritable; it will re-bind",
+                                fd)
+        return dict(self._listener_fds)
 
     # -- flush loop ---------------------------------------------------------
 
@@ -613,6 +722,18 @@ class Server:
         self.span_worker.start()
         ports = self.start_listeners()
         ports.update(self.start_ssf_listeners())
+        # inherited fds whose listener spec left the config: close them,
+        # or the old port stays bound with no reader and blackholes
+        # traffic silently (clients get no ICMP error)
+        for spec, fds in self._inherited.items():
+            log.warning("closing %d inherited fds for removed listener %s",
+                        len(fds), spec)
+            for fd in fds:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        self._inherited.clear()
         self._spawn(self._flush_loop, "flush-ticker")
         return ports
 
@@ -820,9 +941,18 @@ class Server:
             self.import_server.stop()
         if self.import_http is not None:
             self.import_http.stop()
+        handoff_fds = set()
+        if self._handoff:
+            for fds in self._listener_fds.values():
+                handoff_fds.update(fds)
         for sock in self._sockets:
             try:
-                sock.close()
+                if sock.fileno() in handoff_fds:
+                    # fd rides through the re-exec; the kernel keeps
+                    # queuing datagrams for the next process image
+                    sock.detach()
+                else:
+                    sock.close()
             except OSError:
                 pass
         for fd in self._socket_locks:
