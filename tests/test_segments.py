@@ -1,4 +1,4 @@
-"""Scatter-free sorted-segment primitives vs. a numpy oracle.
+"""Scatter-free segmented-scan primitives vs. a numpy oracle.
 
 These primitives replace XLA scatter lowerings in the t-digest ingest hot
 path; correctness here is what keeps the kernel's bucket sums exact."""
@@ -22,82 +22,45 @@ def np_segmented_cumsum(values, starts):
     return out
 
 
-@pytest.mark.parametrize("n", [1, 5, 127, 128, 129, 1000, 4096])
-@pytest.mark.parametrize("p_start", [0.0, 0.02, 0.3, 1.0])
+@pytest.mark.parametrize("n,p_start", [
+    (1, 1.0), (7, 0.5), (128, 0.1), (129, 0.02), (1000, 0.01),
+    (4096, 0.3), (5000, 0.0),
+])
 def test_segmented_cumsum(n, p_start):
-    rng = np.random.default_rng(n * 7 + int(p_start * 10))
-    values = rng.random(n).astype(np.float32)
-    starts = rng.random(n) < p_start
+    rng = np.random.default_rng(n)
+    values = rng.uniform(0, 10, n).astype(np.float32)
+    starts = rng.uniform(0, 1, n) < p_start
     got = np.asarray(segments.segmented_cumsum(
         jnp.asarray(values), jnp.asarray(starts)))
     want = np_segmented_cumsum(values, starts)
-    np.testing.assert_allclose(got, want, rtol=1e-5)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
 
 
-def np_run_sums(ids, w, v):
-    """Return (run_ids, run_w, run_v, grank)."""
-    run_ids, run_w, run_v, grank = [], [], [], []
-    for i, x in enumerate(ids):
-        if i == 0 or x != ids[i - 1]:
-            run_ids.append(x)
-            run_w.append(0.0)
-            run_v.append(0.0)
-        run_w[-1] += w[i]
-        run_v[-1] += v[i]
-        grank.append(len(run_ids) - 1)
-    return run_ids, run_w, run_v, grank
+def np_last_marked_carry(mask, *values):
+    outs = [np.zeros_like(v) for v in values]
+    carried = [0.0] * len(values)
+    have = False
+    for i in range(len(mask)):
+        for j in range(len(values)):
+            outs[j][i] = carried[j] if have else 0.0
+        if mask[i]:
+            have = True
+            carried = [v[i] for v in values]
+    return outs
 
 
-def _check_case(ids, seed=0):
-    ids = np.asarray(ids, np.int32)
-    n = len(ids)
-    rng = np.random.default_rng(seed)
-    w = rng.random(n).astype(np.float32)
-    v = rng.random(n).astype(np.float32)
-    rs = segments.sorted_run_sums(
-        jnp.asarray(ids), jnp.asarray(w), jnp.asarray(v))
-    run_ids, run_w, run_v, grank = np_run_sums(ids, w, v)
-    assert int(rs.num_runs) == len(run_ids)
-    np.testing.assert_array_equal(np.asarray(rs.grank), grank)
-    m = jnp.arange(len(run_ids), dtype=jnp.int32)
-    got_w, got_v = segments.gather_runs(rs, m)
-    np.testing.assert_allclose(np.asarray(got_w), run_w, rtol=1e-4)
-    np.testing.assert_allclose(np.asarray(got_v), run_v, rtol=1e-4)
-
-
-def test_run_sums_single_run():
-    _check_case(np.zeros(1000, np.int32))
-
-
-def test_run_sums_all_distinct():
-    _check_case(np.arange(1000))
-
-
-def test_run_sums_run_spanning_many_chunks():
-    # one run covering 5 chunks, then short runs
-    ids = np.concatenate([np.zeros(700), np.array([1, 1, 2, 3, 3, 3])])
-    _check_case(ids)
-
-
-def test_run_sums_boundary_at_chunk_edge():
-    # run boundary exactly at a 128 multiple
-    ids = np.concatenate([np.zeros(128), np.ones(128), np.full(44, 2)])
-    _check_case(ids)
-
-
-def test_run_sums_sparse_ids():
-    rng = np.random.default_rng(3)
-    ids = np.sort(rng.integers(0, 10**6, 5000)).astype(np.int32)
-    _check_case(ids, seed=3)
-
-
-def test_run_sums_random_runs():
-    rng = np.random.default_rng(11)
-    ids = np.sort(rng.integers(0, 200, 3333)).astype(np.int32)
-    _check_case(ids, seed=11)
-
-
-def test_run_sums_tiny():
-    _check_case([7])
-    _check_case([3, 3])
-    _check_case([3, 4])
+@pytest.mark.parametrize("shape,p_mark", [
+    ((1, 1), 1.0), ((3, 7), 0.5), ((4, 128), 0.1), ((2, 256), 0.02),
+    ((5, 96), 0.0), ((1, 512), 0.9),
+])
+def test_last_marked_carry(shape, p_mark):
+    rng = np.random.default_rng(shape[1])
+    mask = rng.uniform(0, 1, shape) < p_mark
+    a = rng.uniform(-5, 5, shape).astype(np.float32)
+    b = rng.uniform(0, 10, shape).astype(np.float32)
+    got_a, got_b = segments.last_marked_carry(
+        jnp.asarray(mask), jnp.asarray(a), jnp.asarray(b))
+    for r in range(shape[0]):
+        want_a, want_b = np_last_marked_carry(mask[r], a[r], b[r])
+        np.testing.assert_allclose(np.asarray(got_a)[r], want_a, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_b)[r], want_b, rtol=1e-5)

@@ -64,11 +64,6 @@ def segcum(sw, starts):
 
 
 @jax.jit
-def runsums(seg_id, sw, mw):
-    return segments.sorted_run_sums(seg_id, sw, mw)
-
-
-@jax.jit
 def compress(means, weights):
     cat_m = jnp.concatenate([means, means], axis=-1)
     cat_w = jnp.concatenate([weights, weights], axis=-1)
@@ -115,10 +110,6 @@ bench("lax.sort 1 f64 key + payload", sort_single_key, key64, wts)
 starts = jnp.concatenate([jnp.ones((1,), bool), srows[1:] != srows[:-1]])
 bench("segmented_cumsum", segcum, sw, starts)
 
-seg_id = srows * C + jnp.clip(
-    jnp.floor(td._k_scale(jnp.linspace(0, 1, N), 100.0)).astype(jnp.int32),
-    0, C - 1)
-rs = bench("sorted_run_sums", runsums, seg_id, sw, svals * sw)
 
 bench("_compress_rows (2C cand)", compress, pool.means, pool.weights)
 

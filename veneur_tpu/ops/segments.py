@@ -1,25 +1,23 @@
-"""Scatter-free sorted-segment primitives for TPU.
+"""Scatter-free segmented-scan primitives for TPU.
 
 XLA lowers `segment_sum`/`segment_min` on TPU to scatters and large
-`searchsorted` calls to gather-chain binary searches; both run far below VPU
-peak (measured ~9ns/element on v5e — the dominant cost of sketch ingest).
-The primitives here reformulate sorted-segment reductions as:
+`searchsorted` calls to gather-chain binary searches; both run far below
+VPU peak (measured ~9ns/element on v5e). These primitives keep segmented
+reductions in cumsum/select territory instead:
 
-    reshape to [G, L=128] chunks → per-chunk run ranks (cumsum of boundary
-    flags) → per-run partial sums as a fused compare+select+reduce over
-    [G, L, L] (streams through the VPU; XLA fuses without materializing)
-    → cross-chunk run stitching with tiny affine scans over [G]
-    → results addressed by *global run index*, resolved by gathers.
+* `segmented_cumsum` — chunked Hillis-Steele scan with an affine
+  cross-chunk carry stitch; no scatter, no per-segment loop.
+* `last_marked_carry` — exclusive "value at the last marked position"
+  scan, the building block that turns per-run sums into differences of
+  prefix sums at run boundaries (ops/tdigest.py uses it for t-digest
+  bucket accumulation).
 
-Everything is gathers, cumsums and elementwise ops — no scatter anywhere.
 Used by the t-digest batch ingest (ops/tdigest.py); the reference's
 equivalent inner loop is the per-centroid Go walk in
 tdigest/merging_digest.go:140-224, which has no batched analog.
 """
 
 from __future__ import annotations
-
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -123,100 +121,3 @@ def last_marked_carry(mask: jax.Array, *values: jax.Array
         m = m | m_s
         shift *= 2
     return tuple(vs)
-
-
-class RunSums(NamedTuple):
-    """Per-run sums of a sorted id array, addressed by global run index.
-
-    val_w/val_v: f32[G*L] — finalized payload sums laid out per
-        (chunk, local-run) slot; slots not resolved by `gather_runs`
-        addressing contain partial garbage.
-    offset: i32[G] — global run index of each chunk's local run 0.
-    grank:  i32[N] — global run index of each element.
-    num_runs: i32[] — total number of distinct runs.
-    """
-
-    val_w: jax.Array
-    val_v: jax.Array
-    offset: jax.Array
-    grank: jax.Array
-    num_runs: jax.Array
-
-
-def sorted_run_sums(seg_id: jax.Array, w: jax.Array,
-                    v: jax.Array) -> RunSums:
-    """Sum `w` and `v` over each run of equal ids in the sorted i32[N]
-    `seg_id`. Scatter-free; see module docstring for the scheme."""
-    n = seg_id.shape[0]
-    ids2 = _pad_to_chunks(seg_id, -1)
-    # pad joins the final run (id -1 can't equal a real id? it can't — pad
-    # uses the last real id instead so it merges with zero contribution).
-    if ids2.size != n:
-        last = seg_id[-1]
-        flat = ids2.reshape(-1)
-        flat = jnp.where(jnp.arange(flat.shape[0]) < n, flat, last)
-        ids2 = flat.reshape(ids2.shape)
-    w2 = _pad_to_chunks(w, 0.0)
-    v2 = _pad_to_chunks(v, 0.0)
-    g, l = ids2.shape
-
-    prev = jnp.pad(ids2.reshape(-1), (1, 0))[:-1].reshape(g, l)
-    starts = ids2 != prev  # [G, L]; element (0,0) False — forced below
-    starts_forced = starts.at[:, 0].set(True)
-
-    r_local = jnp.cumsum(starts_forced.astype(jnp.int32), axis=1) - 1
-    n_runs = r_local[:, -1] + 1  # [G]
-    # head of chunk g continues the tail run of g-1
-    continues = jnp.concatenate(
-        [jnp.zeros((1,), bool),
-         ids2[1:, 0] == ids2[:-1, -1]])
-
-    # per-(chunk, local run) partial sums: fused masked broadcast-reduce
-    rbins = jnp.arange(l, dtype=jnp.int32)
-    eq = r_local[:, :, None] == rbins[None, None, :]  # [G, L, L]
-    pw = jnp.sum(jnp.where(eq, w2[:, :, None], 0.0), axis=1)  # [G, L]
-    pv = jnp.sum(jnp.where(eq, v2[:, :, None], 0.0), axis=1)
-
-    # stitch runs spanning chunk boundaries: open[g] is the accumulated
-    # tail-run value at the end of chunk g.
-    tail_idx = n_runs - 1
-    tw = jnp.take_along_axis(pw, tail_idx[:, None], axis=1)[:, 0]
-    tv = jnp.take_along_axis(pv, tail_idx[:, None], axis=1)[:, 0]
-    a = (continues & (n_runs == 1)).astype(w.dtype)
-    open_w, open_v = _affine_carry(a, tw, tv)
-    carry_w = jnp.where(continues, _shift_right(open_w, 0.0), 0.0)
-    carry_v = jnp.where(continues, _shift_right(open_v, 0.0), 0.0)
-    pw = pw.at[:, 0].add(carry_w)
-    pv = pv.at[:, 0].add(carry_v)
-
-    # global run index of each chunk's local run 0: runs before it minus
-    # boundary merges
-    cont_i = continues.astype(jnp.int32)
-    offset = (jnp.cumsum(n_runs) - n_runs
-              - jnp.cumsum(cont_i)).astype(jnp.int32)
-    total = (jnp.sum(n_runs) - jnp.sum(cont_i)).astype(jnp.int32)
-
-    grank2 = offset[:, None] + r_local
-    return RunSums(
-        val_w=pw.reshape(-1),
-        val_v=pv.reshape(-1),
-        offset=offset,
-        grank=grank2.reshape(-1)[:n],
-        num_runs=total,
-    )
-
-
-def gather_runs(rs: RunSums, m: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Fetch the finalized (w, v) sums of global run indices `m` (i32[...]).
-    Out-of-range m returns arbitrary values — mask at the call site.
-
-    For a run spanning several chunks the finalized value lives in the
-    *last* chunk of the span (earlier partials were folded forward), which
-    is exactly the last chunk whose offset ≤ m.
-    """
-    l = CHUNK
-    g = jnp.searchsorted(rs.offset, m, side="right").astype(jnp.int32) - 1
-    g = jnp.maximum(g, 0)
-    slot = g * l + (m - jnp.take(rs.offset, g))
-    slot = jnp.clip(slot, 0, rs.val_w.shape[0] - 1)
-    return jnp.take(rs.val_w, slot), jnp.take(rs.val_v, slot)
