@@ -1296,6 +1296,13 @@ int vn_ingest_ssf_many(void* p, const char* buf, long long len,
       ++errs;
       break;
     }
+    if (flen == 0) {
+      // empty datagram: proto3 decodes it as an all-default span, which
+      // would count as processed; match the single-packet path's
+      // empty-packet parse error (server.py handle_trace_packet)
+      ++errs;
+      continue;
+    }
     int rc = ingest_ssf_span(ctx, std::string_view(buf + pos, flen), ind,
                              obj, uniq_rate);
     if (rc == 1) {

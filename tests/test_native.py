@@ -445,3 +445,48 @@ def test_router_events_and_errors_land_on_shard_zero():
     assert ctxs[0].drain_other() == [b"_e{5,5}:title|hello"]
     assert ctxs[0].errors + ctxs[1].errors == 1
     assert ctxs[0].processed + ctxs[1].processed == 1
+
+
+def test_ingest_ssf_many_matches_single():
+    payloads = [
+        _make_span_bytes(
+            trace_id=i + 1, id=i + 1, start_timestamp=100 + i,
+            end_timestamp=200 + i * 3, service=f"s{i % 3}", name="op",
+            indicator=True)
+        for i in range(50)
+    ]
+    single = native_mod.NativeIngest()
+    for p in payloads:
+        assert single.ingest_ssf(p, b"ind", b"obj") == 1
+    batched = native_mod.NativeIngest()
+    ok, errs, fallbacks = batched.ingest_ssf_many(payloads, b"ind", b"obj")
+    assert (ok, errs, fallbacks) == (50, 0, [])
+    r1 = single.drain_histo(1 << 16)
+    r2 = batched.drain_histo(1 << 16)
+    for a, b in zip(r1, r2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_ingest_ssf_many_mixed_outcomes():
+    good = _make_span_bytes(trace_id=1, id=2, start_timestamp=1,
+                            end_timestamp=5, service="s", name="n",
+                            indicator=True)
+    status = _make_span_bytes(
+        trace_id=3, id=4, start_timestamp=1, end_timestamp=5, service="s",
+        name="n", metrics=[{"metric": 4, "name": "chk", "value": 0.0}])
+    ni = native_mod.NativeIngest()
+    ok, errs, fallbacks = ni.ingest_ssf_many(
+        [good, b"\xff\xff garbage", status], b"i", b"o")
+    assert ok == 1
+    assert errs == 1
+    assert fallbacks == [status]  # STATUS spans come back for Python
+    assert ni.ingest_ssf_many([], b"", b"") == (0, 0, [])
+
+
+def test_ingest_ssf_many_empty_frame_is_error():
+    ni = native_mod.NativeIngest()
+    good = _make_span_bytes(trace_id=1, id=2, start_timestamp=1,
+                            end_timestamp=5, service="s", name="n",
+                            indicator=True)
+    ok, errs, fallbacks = ni.ingest_ssf_many([b"", good], b"i", b"o")
+    assert (ok, errs, fallbacks) == (1, 1, [])

@@ -321,3 +321,51 @@ def test_span_lineage_and_headers():
     cont = extract_request_child(headers, "next-hop")
     assert cont.trace_id == root.trace_id
     assert cont.parent_id == root.id
+
+
+def test_ssf_udp_burst_batched_native():
+    """A burst of SSF datagrams exercises the batched native decode
+    (handle_trace_packets_native): all spans' derived metrics and
+    per-service counters must survive, with STATUS spans taking the
+    Python path."""
+    cfg = Config(
+        ssf_listen_addresses=["udp://127.0.0.1:0"],
+        interval="10s",
+        percentiles=[0.5],
+        indicator_span_timer_name="svc.indicator",
+    )
+    srv = Server(cfg)
+    ports = srv.start()
+    try:
+        if not srv._native_ssf:
+            pytest.skip("native SSF path unavailable")
+        port = ports["udp://127.0.0.1:0"]
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        n = 60
+        for i in range(n):
+            span = _span(indicator=True,
+                         metrics=[ssf.count("burst.counter", 1)])
+            s.sendto(ssf_wire.encode_datagram(span), ("127.0.0.1", port))
+        status_span = _span(metrics=[ssf.status("burst.check", 1, "warn")])
+        s.sendto(ssf_wire.encode_datagram(status_span), ("127.0.0.1", port))
+        s.sendto(b"not-a-span", ("127.0.0.1", port))
+        s.close()
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            # the status span (python pipeline) and the garbage datagram
+            # (parse error) are not in `processed`; wait for all three
+            # signals or the flush assertions race the listener
+            if (sum(w.processed for w in srv.workers) >= n
+                    and srv.parse_errors >= 1
+                    and sum(srv.ssf_spans_received.values()) >= 1):
+                break
+            time.sleep(0.02)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("burst.counter", MetricType.COUNTER)].value == n
+        assert ("svc.indicator.max", MetricType.GAUGE) in by_key
+        # STATUS span fell back to the Python pipeline
+        assert ("burst.check", MetricType.STATUS) in by_key
+        assert srv.parse_errors >= 1  # the garbage datagram
+    finally:
+        srv.shutdown()

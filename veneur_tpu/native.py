@@ -103,6 +103,11 @@ def load_library() -> Optional[ctypes.CDLL]:
             c.POINTER(c.c_void_p), c.c_int, c.c_char_p, c.c_int]
         lib.vn_lock.argtypes = [c.c_void_p]
         lib.vn_unlock.argtypes = [c.c_void_p]
+        lib.vn_ingest_ssf_many.restype = c.c_int
+        lib.vn_ingest_ssf_many.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_longlong, c.c_char_p, c.c_int,
+            c.c_char_p, c.c_int, c.c_double, c.POINTER(c.c_int),
+            c.c_void_p, c.c_void_p, c.c_int, c.POINTER(c.c_int)]
         _lib = lib
         return _lib
 
@@ -256,6 +261,36 @@ class NativeIngest:
             indicator_name, len(indicator_name),
             objective_name, len(objective_name),
             float(uniqueness_rate))
+
+    def ingest_ssf_many(self, packets: list[bytes],
+                        indicator_name: bytes = b"",
+                        objective_name: bytes = b"",
+                        uniqueness_rate: float = 0.0
+                        ) -> tuple[int, int, list[bytes]]:
+        """Batched SSF ingest: one C call for many spans (amortizes the
+        per-call ctypes overhead, ~1/3 of the per-span cost). Returns
+        (accepted, decode_errors, fallback_packets) where
+        fallback_packets carry STATUS samples and need the Python path."""
+        if not packets:
+            return 0, 0, []
+        buf = b"".join(
+            len(pkt).to_bytes(4, "little") + pkt for pkt in packets)
+        errors = ctypes.c_int(0)
+        nfall = ctypes.c_int(0)
+        cap = len(packets)
+        fb_off = np.empty(cap, np.int32)
+        fb_len = np.empty(cap, np.int32)
+        ok = self._lib.vn_ingest_ssf_many(
+            self._ctx, buf, len(buf),
+            indicator_name, len(indicator_name),
+            objective_name, len(objective_name),
+            float(uniqueness_rate), ctypes.byref(errors),
+            _ptr(fb_off), _ptr(fb_len), cap, ctypes.byref(nfall))
+        fallbacks = [
+            buf[fb_off[i]:fb_off[i] + fb_len[i]]
+            for i in range(int(nfall.value))
+        ]
+        return int(ok), int(errors.value), fallbacks
 
     @property
     def ssf_spans(self) -> int:
