@@ -398,3 +398,42 @@ def test_listener_fd_handoff_keeps_datagrams():
             srv_b.shutdown()
     finally:
         srv_a.shutdown()
+
+
+def test_canonical_self_telemetry_names():
+    """The canonical telemetry surface (reference README.md:282-296,
+    sinks/sinks.go constants) must appear in a flush's self-metrics."""
+    from veneur_tpu import scopedstatsd
+    from veneur_tpu.sinks.blackhole import BlackholeSpanSink
+
+    srv, sink, ports = _server(num_workers=2, interval="600s")
+    try:
+        span_sink = BlackholeSpanSink()
+        srv.span_sinks.append(span_sink)
+        cap = scopedstatsd.CaptureSender()
+        srv.stats = scopedstatsd.ScopedClient(cap, namespace="veneur.")
+        port = next(iter(ports.values()))
+        for i in range(20):
+            _send_udp(port, f"tele.h:{i}|ms\ntele.c:1|c\ntele.s:x{i}|s"
+                      .encode())
+        _send_udp(port, b"tele.g:2|g")
+        assert _wait_for(lambda: sum(w.processed for w in srv.workers) >= 61)
+        srv.flush()
+        lines = "\n".join(cap.lines)
+        for name in (
+            "veneur.worker.metrics_processed_total",
+            "veneur.worker.metrics_flushed_total",
+            "veneur.worker.metrics_imported_total",
+            "veneur.flush.post_metrics_total",
+            "veneur.flush.total_duration_ns",
+            "veneur.packet.error_total",
+            "veneur.sink.metrics_flushed_total",
+            "veneur.sink.metric_flush_total_duration_ns",
+            "veneur.gc.number",
+            "veneur.mem.rss_bytes",
+        ):
+            assert name in lines, f"missing {name}"
+        assert "metric_type:histogram" in lines
+        assert "worker:0" in lines
+    finally:
+        srv.shutdown()

@@ -14,6 +14,7 @@ The reference shards series across N workers by Digest%N (server.go:1028,
 
 from __future__ import annotations
 
+import gc
 import logging
 import os
 import socket
@@ -64,6 +65,16 @@ def calculate_tick_delay(interval_s: float, now: float) -> float:
     """Seconds until the next interval-aligned tick
     (reference CalculateTickDelay, server.go:1517)."""
     return interval_s - (now % interval_s)
+
+
+def _current_rss_bytes() -> Optional[int]:
+    """Current resident set size (Linux /proc; None where unavailable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
 
 
 class _SpanPipelineClient:
@@ -180,6 +191,7 @@ class Server:
         self.packets_received = 0
         self.parse_errors = 0
         self._errors_reported = 0
+        self._span_sink_reported: dict[tuple[str, str], int] = {}
 
         # scoped self-telemetry statsd client (reference server.go:298-308
         # builds a datadog-go client with namespace "veneur." wrapped by
@@ -828,7 +840,25 @@ class Server:
                     for svc, n in (
                             worker._native.drain_ssf_services().items()):
                         span_counts[svc] = span_counts.get(svc, 0) + n
+                # canonical per-worker tallies (README.md:292-294),
+                # captured before flush resets the epoch counters
+                self.stats.count("worker.metrics_processed_total",
+                                 worker.processed, tags=[f"worker:{i}"])
+                self.stats.count("worker.metrics_imported_total",
+                                 worker.imported, tags=[f"worker:{i}"])
                 snaps.append(worker.flush(qs, self.interval))
+        for snap in snaps:
+            # per-type flushed-series counts (README.md:293)
+            d = snap.directory
+            for mtype, n in (
+                ("counter", len(snap.scalars.counter_meta)),
+                ("gauge", len(snap.scalars.gauge_meta)),
+                ("histogram", d.num_histo_rows),
+                ("set", d.num_set_rows),
+            ):
+                if n:
+                    self.stats.count("worker.metrics_flushed_total", n,
+                                     tags=[f"metric_type:{mtype}"])
 
         final: list[InterMetric] = []
         for snap in snaps:
@@ -881,6 +911,28 @@ class Server:
         self.stats.count("packet.error_total",
                          errors_now - self._errors_reported)
         self._errors_reported = errors_now
+        # span-sink delta counters (reference sinks/sinks.go:60-78;
+        # sinks track cumulative attributes, telemetry reports deltas)
+        for sink in self.span_sinks:
+            tags = [f"sink:{sink.name()}"]
+            for attr, metric in (("spans_flushed", "sink.spans_flushed_total"),
+                                 ("spans_dropped", "sink.spans_dropped_total")):
+                total = getattr(sink, attr, None)
+                if total is None:
+                    continue
+                key = (sink.name(), attr)
+                delta = total - self._span_sink_reported.get(key, 0)
+                self._span_sink_reported[key] = total
+                if delta:
+                    self.stats.count(metric, delta, tags=tags)
+        # runtime gauges (analog of the Go runtime stats, flusher.go:32-47;
+        # gc.number is cumulative completed collections, mem.rss_bytes is
+        # CURRENT resident set from /proc — not the misleading peak)
+        self.stats.gauge("gc.number", float(
+            sum(s["collections"] for s in gc.get_stats())))
+        rss = _current_rss_bytes()
+        if rss is not None:
+            self.stats.gauge("mem.rss_bytes", float(rss))
         self.stats.time_in_nanoseconds(
             "flush.total_duration_ns", (time.time() - flush_start) * 1e9)
         return final
@@ -919,6 +971,7 @@ class Server:
             sink.flush(metrics)
         except Exception:
             log.exception("sink %s flush failed", sink.name())
+            self.stats.count("flush.error_total", 1, tags=tags)
         else:
             self.stats.count(
                 "sink.metrics_flushed_total", len(metrics), tags=tags)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import time
 import urllib.request
 import zlib
 from typing import Optional
@@ -23,12 +24,27 @@ from veneur_tpu.gen import veneur_tpu_pb2 as pb
 log = logging.getLogger("veneur_tpu.forward")
 
 
+def _report_forward(stats, n_metrics: int, started: float,
+                    cause: Optional[str]) -> None:
+    """Canonical forwarding telemetry (README.md:268-269,284-288:
+    forward.post_metrics_total / duration_ns / error_total+cause)."""
+    if stats is None:
+        return
+    stats.count("forward.post_metrics_total", n_metrics)
+    stats.time_in_nanoseconds("forward.duration_ns",
+                              (time.time() - started) * 1e9)
+    if cause:
+        stats.count("forward.error_total", 1, tags=[f"cause:{cause}"])
+
+
 class GRPCForwarder:
     def __init__(self, address: str, timeout_s: float = 10.0,
-                 compression: float = 100.0, hll_precision: int = 14) -> None:
+                 compression: float = 100.0, hll_precision: int = 14,
+                 stats=None) -> None:
         self.client = ForwardClient(address, timeout_s)
         self.compression = compression
         self.hll_precision = hll_precision
+        self.stats = stats
 
     def __call__(self, snapshots) -> None:
         batch = pb.MetricBatch()
@@ -40,11 +56,15 @@ class GRPCForwarder:
             )
         if not batch.metrics:
             return
-        if not self.client.send(batch):
+        started = time.time()
+        ok = self.client.send(batch)
+        if not ok:
             log.warning(
                 "forward to %s failed (errors so far: %s)",
                 self.client.address, self.client.errors,
             )
+        _report_forward(self.stats, len(batch.metrics), started,
+                        None if ok else self.client.last_error_cause)
 
     def close(self) -> None:
         self.client.close()
@@ -59,12 +79,13 @@ class HTTPForwarder:
 
     def __init__(self, base_url: str, timeout_s: float = 10.0,
                  compression: float = 100.0, hll_precision: int = 14,
-                 tracer=None) -> None:
+                 tracer=None, stats=None) -> None:
         self.url = base_url.rstrip("/") + "/import"
         self.timeout_s = timeout_s
         self.compression = compression
         self.hll_precision = hll_precision
         self.tracer = tracer
+        self.stats = stats
         self.errors = 0
         self.sent_batches = 0
 
@@ -94,16 +115,20 @@ class HTTPForwarder:
             self.tracer.inject_header(span.context(), headers)
         req = urllib.request.Request(
             self.url, data=body, method="POST", headers=headers)
+        started = time.time()
+        cause = None
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 resp.read()
             self.sent_batches += 1
         except Exception as e:
             self.errors += 1
+            cause = "send"
             if span is not None:
                 span.set_error()
             log.warning("http forward to %s failed: %s", self.url, e)
         finally:
+            _report_forward(self.stats, len(items), started, cause)
             if span is not None:
                 span.finish()
 
@@ -128,11 +153,14 @@ def install_forwarder(server, compression: Optional[float] = None,
             from veneur_tpu.distributed.interop import CompatForwarder
 
             server.forwarder = CompatForwarder(
-                addr, timeout, compression, hll_precision)
+                addr, timeout, compression, hll_precision,
+                stats=getattr(server, "stats", None))
         else:
             server.forwarder = GRPCForwarder(
-                addr, timeout, compression, hll_precision)
+                addr, timeout, compression, hll_precision,
+                stats=getattr(server, "stats", None))
     else:
         server.forwarder = HTTPForwarder(
             cfg.forward_address, timeout, compression, hll_precision,
-            tracer=getattr(server, "tracer", None))
+            tracer=getattr(server, "tracer", None),
+            stats=getattr(server, "stats", None))

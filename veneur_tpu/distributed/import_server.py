@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 import threading
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -37,6 +38,7 @@ class ImportServer:
         self.import_errors = 0
 
     def handle_batch(self, batch: pb.MetricBatch) -> None:
+        started = time.time()
         workers = self.server.workers
         locks = self.server._worker_locks
         # pre-sort into per-worker chunks so each lock is taken once
@@ -53,6 +55,14 @@ class ImportServer:
                     except ValueError as e:
                         self.import_errors += 1
                         log.debug("rejected import %s: %s", m.name, e)
+        stats = getattr(self.server, "stats", None)
+        if stats is not None:
+            # canonical import telemetry (README.md:295: the merge part
+            # of response_duration_ns; request decode is timed by the
+            # HTTP handler)
+            stats.time_in_nanoseconds(
+                "import.response_duration_ns",
+                (time.time() - started) * 1e9, tags=["part:merge"])
 
     def start_grpc(self, address: str = "127.0.0.1:0") -> int:
         self.grpc_server, self.port = rpc.make_server(
@@ -140,17 +150,27 @@ class ImportHTTPServer:
                     span = start_span_from_headers(
                         dict(self.headers), "veneur.import",
                         resource="/import", tracer=srv.tracer)
+                req_start = time.time()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                stats = getattr(srv, "stats", None) if srv else None
                 try:
                     batch = decode_http_import_body(
                         body, self.headers.get("Content-Encoding", ""))
                 except Exception as e:
+                    if stats is not None:
+                        stats.count("import.request_error_total", 1,
+                                    tags=["cause:decode"])
                     if span is not None:
                         span.set_error()
                         span.finish()
                     self._respond(400, f"bad import body: {e}".encode())
                     return
+                if stats is not None:
+                    stats.time_in_nanoseconds(
+                        "import.response_duration_ns",
+                        (time.time() - req_start) * 1e9,
+                        tags=["part:request"])
                 imp.handle_batch(batch)
                 if span is not None:
                     span.finish()

@@ -29,6 +29,7 @@ that share set series with Go instances (utils/hashing.metro_hash64).
 
 from __future__ import annotations
 
+import time
 import logging
 from typing import Callable, Optional
 
@@ -305,11 +306,13 @@ class CompatForwarder:
     Errors are counted, never retried."""
 
     def __init__(self, address: str, timeout_s: float = 10.0,
-                 compression: float = 100.0, hll_precision: int = 14) -> None:
+                 compression: float = 100.0, hll_precision: int = 14,
+                 stats=None) -> None:
         self.address = address
         self.timeout_s = timeout_s
         self.compression = compression
         self.hll_precision = hll_precision
+        self.stats = stats
         self.errors = 0
         self.sent_batches = 0
         self.channel = grpc.insecure_channel(address)
@@ -330,13 +333,32 @@ class CompatForwarder:
                 out.metrics.append(internal_to_compat(m))
         if not out.metrics:
             return
+        from veneur_tpu.distributed.forward import _report_forward
+
+        started = time.time()
+        cause = None
         try:
             self._call(out, timeout=self.timeout_s)
             self.sent_batches += 1
         except grpc.RpcError as e:
             self.errors += 1
+            # same three-way cause taxonomy as rpc.ForwardClient so
+            # compat-mode deployments alert on the same series
+            code = e.code()
+            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                cause = "deadline_exceeded"
+            elif code == grpc.StatusCode.UNAVAILABLE:
+                cause = "unavailable"
+            else:
+                cause = "send"
             log.warning("compat forward to %s failed: %s",
-                        self.address, e.code())
+                        self.address, code)
+        except Exception:
+            self.errors += 1
+            cause = "send"
+            log.exception("compat forward to %s failed", self.address)
+        finally:
+            _report_forward(self.stats, len(out.metrics), started, cause)
 
     def close(self) -> None:
         self.channel.close()

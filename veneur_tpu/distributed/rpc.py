@@ -73,6 +73,7 @@ class ForwardClient:
         self.errors: dict[str, int] = {
             "deadline_exceeded": 0, "unavailable": 0, "send": 0,
         }
+        self.last_error_cause: Optional[str] = None
         self.sent_batches = 0
         self.sent_metrics = 0
 
@@ -83,11 +84,13 @@ class ForwardClient:
         except grpc.RpcError as e:
             code = e.code()
             if code == grpc.StatusCode.DEADLINE_EXCEEDED:
-                self.errors["deadline_exceeded"] += 1
+                cause = "deadline_exceeded"
             elif code == grpc.StatusCode.UNAVAILABLE:
-                self.errors["unavailable"] += 1
+                cause = "unavailable"
             else:
-                self.errors["send"] += 1
+                cause = "send"
+            self.errors[cause] += 1
+            self.last_error_cause = cause
             return False
         self.sent_batches += 1
         self.sent_metrics += len(batch.metrics)
