@@ -182,3 +182,47 @@ def test_http_hop_propagation_end_to_end():
     finally:
         http.stop()
         imp.stop()
+
+
+def test_proxy_import_hop_continues_trace_and_ring_routes_span():
+    """The proxy's /import hop continues the incoming trace; its own span
+    ring-routes downstream via the trace proxy (reference handleProxy →
+    ExtractRequestChild, handlers_global.go:28-58)."""
+    import socket
+    import urllib.request
+
+    from veneur_tpu.distributed.proxy import (
+        ProxyHTTPServer, ProxyServer, TraceProxy,
+    )
+    from veneur_tpu.protocol import ssf_wire
+
+    # downstream "collector": a UDP socket capturing ring-routed spans
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5.0)
+    dest = f"127.0.0.1:{rx.getsockname()[1]}"
+
+    proxy = ProxyServer(destinations=["127.0.0.1:1"])
+    tp = TraceProxy(destinations=[dest])
+    front = ProxyHTTPServer(proxy, trace_proxy=tp)
+    port = front.start()
+    try:
+        t = ot.Tracer()
+        parent = t.start_span("origin")
+        headers = {"Content-Type": "application/json"}
+        t.inject_header(parent.context(), headers)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/import", data=b"[]",
+            method="POST", headers=headers)
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+        data = rx.recv(65536)
+        span = ssf_wire.parse_ssf(data)
+        assert span.name == "veneur.proxy"
+        assert span.trace_id == parent.span.trace_id
+        assert span.parent_id == parent.span.id
+    finally:
+        front.stop()
+        tp.stop()
+        proxy.stop()
+        rx.close()

@@ -141,40 +141,35 @@ class ImportHTTPServer:
                 # cross-hop trace propagation: continue the forwarder's
                 # trace when headers carry one (reference handleImport via
                 # ExtractRequestChild, handlers_global.go:60-72,81)
-                span = None
-                if srv is not None:
-                    from veneur_tpu.trace.opentracing import (
-                        start_span_from_headers,
-                    )
+                from veneur_tpu.trace.opentracing import traced_server_hop
 
-                    span = start_span_from_headers(
+                with traced_server_hop(
                         dict(self.headers), "veneur.import",
-                        resource="/import", tracer=srv.tracer)
-                req_start = time.time()
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
-                stats = getattr(srv, "stats", None) if srv else None
-                try:
-                    batch = decode_http_import_body(
-                        body, self.headers.get("Content-Encoding", ""))
-                except Exception as e:
+                        resource="/import",
+                        tracer=srv.tracer if srv else None) as span:
+                    req_start = time.time()
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    stats = getattr(srv, "stats", None) if srv else None
+                    try:
+                        batch = decode_http_import_body(
+                            body, self.headers.get("Content-Encoding", ""))
+                    except Exception as e:
+                        if stats is not None:
+                            stats.count("import.request_error_total", 1,
+                                        tags=["cause:decode"])
+                        if span is not None:
+                            span.set_error()
+                        self._respond(400,
+                                      f"bad import body: {e}".encode())
+                        return
                     if stats is not None:
-                        stats.count("import.request_error_total", 1,
-                                    tags=["cause:decode"])
-                    if span is not None:
-                        span.set_error()
-                        span.finish()
-                    self._respond(400, f"bad import body: {e}".encode())
-                    return
-                if stats is not None:
-                    stats.time_in_nanoseconds(
-                        "import.response_duration_ns",
-                        (time.time() - req_start) * 1e9,
-                        tags=["part:request"])
-                imp.handle_batch(batch)
-                if span is not None:
-                    span.finish()
-                self._respond(200, b"accepted")
+                        stats.time_in_nanoseconds(
+                            "import.response_duration_ns",
+                            (time.time() - req_start) * 1e9,
+                            tags=["part:request"])
+                    imp.handle_batch(batch)
+                    self._respond(200, b"accepted")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_port

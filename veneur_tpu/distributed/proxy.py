@@ -142,6 +142,24 @@ class TraceProxy:
         self._sock.close()
 
 
+class _TraceProxySpanClient:
+    """Finished proxy spans ring-route to the downstream collector owning
+    their trace id, like every other span the proxy handles."""
+
+    def __init__(self, trace_proxy: "TraceProxy") -> None:
+        self._tp = trace_proxy
+
+    def record(self, span) -> None:
+        self._tp.handle_spans([span])
+
+
+def _proxy_tracer(trace_proxy: "TraceProxy"):
+    from veneur_tpu.trace.opentracing import Tracer
+
+    return Tracer(client=_TraceProxySpanClient(trace_proxy),
+                  service="veneur-tpu-proxy")
+
+
 class ProxyHTTPServer:
     """HTTP face of the proxy tier (reference veneur-proxy, proxy.go:40-74:
     POST /import ring-splits metrics, POST /spans ring-routes traces,
@@ -170,6 +188,10 @@ class ProxyHTTPServer:
 
         proxy = self.proxy
         trace_proxy = self.trace_proxy
+        # one long-lived tracer per server, not per request; spans it
+        # finishes ring-route downstream via the trace proxy
+        tracer = (_proxy_tracer(trace_proxy)
+                  if trace_proxy is not None else None)
 
         class Handler(APIHandlerBase, BaseHTTPRequestHandler):
             version_string_body = __version__
@@ -182,14 +204,29 @@ class ProxyHTTPServer:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 if self.path == "/import":
-                    try:
-                        batch = decode_http_import_body(
-                            body, self.headers.get("Content-Encoding", ""))
-                    except Exception as e:
-                        self._respond(400, f"bad import body: {e}".encode())
-                        return
-                    proxy.handle_batch(batch)
-                    self._respond(200, b"accepted")
+                    # continue the forwarder's trace through the proxy hop
+                    # (reference handleProxy → ExtractRequestChild,
+                    # handlers_global.go:28-58); the proxy's own spans
+                    # ring-route downstream with the trace proxy
+                    from veneur_tpu.trace.opentracing import (
+                        traced_server_hop,
+                    )
+
+                    with traced_server_hop(
+                            dict(self.headers), "veneur.proxy",
+                            resource="/import", tracer=tracer) as span:
+                        try:
+                            batch = decode_http_import_body(
+                                body,
+                                self.headers.get("Content-Encoding", ""))
+                        except Exception as e:
+                            if span is not None:
+                                span.set_error()
+                            self._respond(
+                                400, f"bad import body: {e}".encode())
+                            return
+                        proxy.handle_batch(batch)
+                        self._respond(200, b"accepted")
                 elif self.path == "/spans" and trace_proxy is not None:
                     spans = []
                     stream = io.BytesIO(body)

@@ -340,3 +340,25 @@ def start_span_from_headers(headers, name: str, resource: str = "",
         return t.extract_request_child(resource or name, headers, name)
     except (SpanExtractionError, UnsupportedFormatError):
         return None
+
+
+class traced_server_hop:
+    """Context manager for an HTTP handler continuing an incoming trace:
+    starts a child span from the request headers (None when untraced),
+    marks it errored on exception, finishes it either way. Shared by the
+    import and proxy /import handlers (reference ExtractRequestChild
+    call sites, handlers_global.go:28-58,60-72)."""
+
+    def __init__(self, headers, name: str, resource: str = "",
+                 tracer: Optional[Tracer] = None) -> None:
+        self.span = start_span_from_headers(headers, name,
+                                            resource=resource, tracer=tracer)
+
+    def __enter__(self) -> Optional[OTSpan]:
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.span is not None:
+            if exc_type is not None:
+                self.span.set_error()
+            self.span.finish()
