@@ -437,3 +437,38 @@ def test_canonical_self_telemetry_names():
         assert "worker:0" in lines
     finally:
         srv.shutdown()
+
+
+def test_listener_fd_handoff_ssf_listener():
+    """SSF UDP listeners ride the handoff too."""
+    cfg = Config(ssf_listen_addresses=["udp://127.0.0.1:0"],
+                 interval="600s", num_workers=1)
+    srv_a = Server(cfg)
+    ports = srv_a.start()
+    spec = "udp://127.0.0.1:0"
+    port = ports[spec]
+    try:
+        manifest = srv_a.prepare_handoff()
+        assert manifest.get(spec), manifest
+        # queued while no reader is consuming
+        from veneur_tpu import ssf
+        from veneur_tpu.protocol import ssf_wire
+
+        span = ssf.SSFSpan(trace_id=1, id=2, start_timestamp=1,
+                           end_timestamp=2, service="hs", name="n")
+        _send_udp(port, ssf_wire.encode_datagram(span))
+        srv_a.shutdown()
+
+        srv_b = Server(Config(ssf_listen_addresses=[spec],
+                              interval="600s", num_workers=1),
+                       inherited_fds=manifest)
+        ports_b = srv_b.start()
+        try:
+            assert ports_b[spec] == port
+            assert _wait_for(
+                lambda: srv_b.ssf_spans_received.get("hs", 0) >= 1
+                or sum(w.processed for w in srv_b.workers) >= 1)
+        finally:
+            srv_b.shutdown()
+    finally:
+        srv_a.shutdown()
