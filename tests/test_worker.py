@@ -291,3 +291,78 @@ def test_scalar_accumulators_survive_large_counts():
     assert abs(count - expect_n) / expect_n < 1e-6, count
     assert abs(total - 3.0 * expect_n) / (3.0 * expect_n) < 1e-6, total
     assert abs(recip - expect_n / 3.0) / (expect_n / 3.0) < 1e-6, recip
+
+
+def test_swap_then_extract_two_phase_flush():
+    """swap() closes the epoch without device readback; ingest landing
+    between swap and extract_snapshot goes to the NEW epoch and the old
+    snapshot is unaffected (map-swap intent of worker.go:498-517)."""
+    w = DeviceWorker()
+    for v in [1, 2, 3]:
+        w.process_metric(parse_metric(f"t:{v}|ms".encode()))
+    qs = device_quantiles(PCTS, AGGS)
+    sw = w.swap(qs)
+
+    # next-interval ingest proceeds while the old epoch awaits extraction
+    for v in [10, 20]:
+        w.process_metric(parse_metric(f"t:{v}|ms".encode()))
+    w.process_metric(parse_metric(b"c:7|c"))
+
+    snap_old = w.extract_snapshot(sw, qs, interval_s=10.0)
+    assert float(snap_old.lweight[0]) == 3.0
+    assert float(snap_old.lmin[0]) == 1.0
+    assert float(snap_old.lmax[0]) == 3.0
+    assert len(snap_old.scalars.counter_meta) == 0
+
+    snap_new = w.flush(qs)
+    assert float(snap_new.lweight[0]) == 2.0
+    assert float(snap_new.lmin[0]) == 10.0
+    assert float(snap_new.lmax[0]) == 20.0
+    assert len(snap_new.scalars.counter_meta) == 1
+
+
+def test_server_flush_does_not_hold_ingest_lock_during_extraction():
+    """The server flush loop must release the per-worker ingest lock
+    before extraction: with extraction artificially blocked, a reader
+    thread can still acquire the lock and ingest (VERDICT r1 weak #5)."""
+    import threading
+
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.factory import build_server
+
+    cfg = Config(statsd_listen_addresses=[], interval="10s",
+                 percentiles=[0.5], aggregates=["min", "max", "count"])
+    server = build_server(cfg)
+    try:
+        worker = server.workers[0]
+        worker.process_metric(parse_metric(b"t:1|ms"))
+
+        gate = threading.Event()
+        entered = threading.Event()
+        orig = worker._extract
+
+        def blocked_extract(histo, qs):
+            entered.set()
+            assert gate.wait(10.0), "test deadlock"
+            return orig(histo, qs)
+
+        worker._extract = blocked_extract
+        t = threading.Thread(target=server.flush, daemon=True)
+        t.start()
+        assert entered.wait(10.0), "flush never reached extraction"
+        # extraction is mid-flight; ingest must not block on the lock
+        got_lock = server._worker_locks[0].acquire(timeout=5.0)
+        assert got_lock, "ingest lock held across extraction"
+        try:
+            worker.process_metric(parse_metric(b"t:2|ms"))
+        finally:
+            server._worker_locks[0].release()
+        gate.set()
+        t.join(30.0)
+        assert not t.is_alive()
+        # the concurrently ingested sample is alive in the new epoch
+        snap = worker.flush(device_quantiles([0.5], AGGS))
+        assert float(snap.lweight[0]) == 1.0
+        assert float(snap.lmin[0]) == 2.0
+    finally:
+        server.shutdown()

@@ -828,13 +828,18 @@ class Server:
             self.ssf_spans_received = {}
 
         qs = device_quantiles(self.percentiles, self.aggregates)
-        snaps: list[FlushSnapshot] = []
+        # Two-phase flush: the per-worker ingest lock is held only across
+        # swap() (epoch close + device dispatches — the map-swap analog of
+        # worker.go:498-517); the device readback in extract_snapshot()
+        # runs unlocked, so next-interval ingest proceeds concurrently
+        # with a large extraction (SURVEY §7 "Latency budget").
+        swapped = []
         for i, (worker, lock) in enumerate(
                 zip(self.workers, self._worker_locks)):
             with lock:
                 if i == 0 and self._native_ssf:
-                    # drained in the SAME lock hold as the worker flush —
-                    # the flush resets the C++ context, and a span landing
+                    # drained in the SAME lock hold as the worker swap —
+                    # the swap resets the C++ context, and a span landing
                     # between a separate drain and the reset would lose
                     # its service count
                     for svc, n in (
@@ -846,7 +851,16 @@ class Server:
                                  worker.processed, tags=[f"worker:{i}"])
                 self.stats.count("worker.metrics_imported_total",
                                  worker.imported, tags=[f"worker:{i}"])
-                snaps.append(worker.flush(qs, self.interval))
+                swapped.append(worker.swap(qs))
+        snaps: list[FlushSnapshot] = []
+        for i, (worker, sw) in enumerate(zip(self.workers, swapped)):
+            try:
+                snaps.append(worker.extract_snapshot(sw, qs, self.interval))
+            except Exception:
+                # per-flush data is expendable by design (README.md:135-137)
+                # but a readback failure on one worker must not destroy the
+                # already-swapped intervals of the others
+                log.exception("flush extraction failed for worker %d", i)
         for snap in snaps:
             # per-type flushed-series counts (README.md:293)
             d = snap.directory

@@ -347,6 +347,22 @@ class FlushSnapshot:
     unique_timeseries_registers: Optional[np.ndarray] = None
 
 
+@dataclass
+class SwappedEpoch:
+    """A closed interval's state, detached from the live worker by
+    DeviceWorker.swap(). Holds device arrays (histo/sets) plus host
+    directories; extract_snapshot() turns it into a FlushSnapshot without
+    touching the worker's new epoch."""
+
+    directory: SeriesDirectory
+    scalars: HostScalars
+    histo: Optional["HistoDeviceState"]
+    sets: Optional[jax.Array]
+    staged_sets: object
+    umts: Optional[np.ndarray]
+    mesh_out: Optional[dict]
+
+
 class DeviceWorker:
     """Batched aggregation engine for one shard of the metric space.
 
@@ -949,12 +965,19 @@ class DeviceWorker:
 
     # -- flush --------------------------------------------------------------
 
-    def flush(self, quantiles: np.ndarray, interval_s: float = 10.0
-              ) -> FlushSnapshot:
-        """Swap state and extract the finished interval.
+    def swap(self, quantiles: np.ndarray) -> "SwappedEpoch":
+        """Close the current epoch and return the old-interval state.
 
-        quantiles: the percentile set to evaluate on device (the flusher
-        decides which rows' values are actually emitted).
+        The map-swap analog of worker.go:498-517, split from extraction so
+        the caller's ingest lock is held only across this method: native
+        drain/reset, pending device *dispatches* (async on TPU), import
+        merges, and the epoch reset — no device readback. Next-interval
+        ingest proceeds while extract_snapshot() reads the old buffers.
+
+        The mesh path (global tier) is the one exception: MeshHistoPool
+        state is not double-buffered, so its extract+reset happens here,
+        under the lock. The overlap-critical 1M-series local path never
+        takes it.
         """
         if self._native is not None:
             # drain and close the native epoch under one lock hold: a
@@ -974,19 +997,38 @@ class DeviceWorker:
         self._flush_pending_sets()
         self._merge_imports()
 
-        directory = self.directory
-        scalars = self.scalars
-        histo = self._histo
-        sets = self._sets
-        staged_sets = self._staged_sets
-        umts = self._umts
+        mesh_out = None
+        if self._mesh_pool is not None and self.directory.num_histo_rows:
+            mesh_out = self._mesh_pool.extract(
+                quantiles, self.directory.num_histo_rows)
+            self._mesh_pool.reset()
+
+        swapped = SwappedEpoch(
+            directory=self.directory, scalars=self.scalars,
+            histo=self._histo, sets=self._sets,
+            staged_sets=self._staged_sets, umts=self._umts,
+            mesh_out=mesh_out,
+        )
         self.processed = 0
         self.imported = 0
         self._reset_epoch()
+        return swapped
+
+    def extract_snapshot(self, swapped: "SwappedEpoch",
+                         quantiles: np.ndarray,
+                         interval_s: float = 10.0) -> FlushSnapshot:
+        """Device readback for a swapped epoch. Safe to run outside the
+        ingest lock — it touches only the swapped objects (plus immutable
+        worker config), never the live epoch."""
+        directory = swapped.directory
+        scalars = swapped.scalars
+        histo = swapped.histo
+        sets = swapped.sets
+        staged_sets = swapped.staged_sets
 
         snap = FlushSnapshot(
             directory=directory, scalars=scalars, interval_s=interval_s,
-            unique_timeseries_registers=umts,
+            unique_timeseries_registers=swapped.umts,
         )
         if histo is not None and directory.num_histo_rows:
             qs = jnp.asarray(np.asarray(quantiles, dtype=np.float32))
@@ -1002,25 +1044,22 @@ class DeviceWorker:
             snap.lsum, snap.lweight, snap.lrecip = lsum[:n], lweight[:n], lrecip[:n]
             snap.digest_means = np.asarray(histo.means)[:n]
             snap.digest_weights = np.asarray(histo.weights)[:n]
-        if self._mesh_pool is not None and directory.num_histo_rows:
-            mout = self._mesh_pool.extract(quantiles,
-                                           directory.num_histo_rows)
-            self._mesh_pool.reset()
-            if mout is not None:
-                n = directory.num_histo_rows
-                snap.quantile_values = mout["quant"]
-                snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
-                snap.dmin, snap.dmax = mout["dmin"], mout["dmax"]
-                snap.dsum = mout["dsum"]
-                snap.dcount = mout["dcount"]
-                snap.drecip = mout["drecip"]
-                # mesh rows carry no host-local scalar aggregates (global
-                # tier emits digest-derived values; see attach_mesh_pool)
-                snap.lmin = np.full(n, np.inf, np.float32)
-                snap.lmax = np.full(n, -np.inf, np.float32)
-                snap.lsum = np.zeros(n, np.float64)
-                snap.lweight = np.zeros(n, np.float64)
-                snap.lrecip = np.zeros(n, np.float64)
+        if swapped.mesh_out is not None:
+            mout = swapped.mesh_out
+            n = directory.num_histo_rows
+            snap.quantile_values = mout["quant"]
+            snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
+            snap.dmin, snap.dmax = mout["dmin"], mout["dmax"]
+            snap.dsum = mout["dsum"]
+            snap.dcount = mout["dcount"]
+            snap.drecip = mout["drecip"]
+            # mesh rows carry no host-local scalar aggregates (global
+            # tier emits digest-derived values; see attach_mesh_pool)
+            snap.lmin = np.full(n, np.inf, np.float32)
+            snap.lmax = np.full(n, -np.inf, np.float32)
+            snap.lsum = np.zeros(n, np.float64)
+            snap.lweight = np.zeros(n, np.float64)
+            snap.lrecip = np.zeros(n, np.float64)
         if staged_sets is not None and directory.num_set_rows:
             n = directory.num_set_rows
             snap.set_estimates = staged_sets.estimates(n)
@@ -1036,3 +1075,15 @@ class DeviceWorker:
             )[:n]
             snap.set_registers = np.asarray(sets)[:n]
         return snap
+
+    def flush(self, quantiles: np.ndarray, interval_s: float = 10.0
+              ) -> FlushSnapshot:
+        """Swap state and extract the finished interval in one call.
+
+        Callers that want ingest to continue during extraction (the server
+        flush loop) use swap() under the ingest lock and extract_snapshot()
+        outside it; this composition is for tests/tools and the import
+        paths where overlap doesn't matter.
+        """
+        return self.extract_snapshot(self.swap(quantiles), quantiles,
+                                     interval_s)
