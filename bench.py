@@ -74,13 +74,20 @@ def _ensure_live_backend() -> None:
             reason = f"attempt {i + 1}/{attempts}: {type(e).__name__}: {e}"
         print(f"bench: accelerator probe failed — {reason}", file=sys.stderr)
     env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["_VENEUR_BENCH_REEXEC"] = "1"
+    _force_cpu_env(env)
     print(f"bench: accelerator backend unavailable ({reason}); "
           "falling back to CPU", file=sys.stderr)
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
               env)
+
+
+def _force_cpu_env(env: dict) -> None:
+    """The one recipe for steering a (child) interpreter off the tunnelled
+    accelerator: drop the relay pool var, pin the CPU platform, and mark
+    the process so workload sizes shrink to CPU scale."""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_VENEUR_BENCH_REEXEC"] = "1"
 
 
 def _envint(name: str, default: int, cpu_default: int | None = None) -> int:
@@ -431,6 +438,27 @@ WORKLOADS = {
 }
 
 
+def _run_workload_subprocess(wname: str, timeout_s: float,
+                             cpu: bool = False) -> dict:
+    """One workload in an isolated child process. Isolation matters on the
+    tunnelled TPU backend: a wedged in-process backend init is not
+    interruptible, so running it in a child lets the orchestrator enforce
+    a timeout, retry, and still produce the other workloads' numbers."""
+    env = dict(os.environ)
+    env["VENEUR_BENCH_WORKLOAD"] = wname
+    env["_VENEUR_BENCH_CHILD"] = "1"  # skip the probe; parent did it
+    if cpu:
+        _force_cpu_env(env)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, timeout=timeout_s, capture_output=True)
+    err_tail = r.stderr.decode(errors="replace").strip()[-800:]
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"workload child rc={r.returncode}: {err_tail}")
+    line = r.stdout.decode(errors="replace").strip().splitlines()[-1]
+    return json.loads(line)
+
+
 def main() -> None:
     name = os.environ.get("VENEUR_BENCH_WORKLOAD")
     if name:
@@ -440,18 +468,54 @@ def main() -> None:
                      f"valid: {', '.join(sorted(WORKLOADS))}")
         print(json.dumps(workload()), flush=True)
         return
-    # No selector: run ALL five BASELINE workloads, one JSON line each.
-    # The headline metric (timer_replay) prints LAST so a tail-capturing
+    # No selector: run ALL five BASELINE workloads, one JSON line each,
+    # each in its own child process with a timeout + one retry (the
+    # tunnelled TPU backend wedges transiently; an uninterruptible hung
+    # init in-process would otherwise stall the entire artifact). The
+    # headline metric (timer_replay) prints LAST so a tail-capturing
     # driver records it as the primary number.
+    per_workload_s = float(os.environ.get("VENEUR_BENCH_WORKLOAD_TIMEOUT",
+                                          900))
+    deadline = time.time() + float(
+        os.environ.get("VENEUR_BENCH_DEADLINE", 3600))
+    on_cpu = bool(os.environ.get("_VENEUR_BENCH_REEXEC"))
     for wname in ("mixed", "global_merge", "ssf_histo", "prometheus_1m",
                   "timer_replay"):
-        try:
-            print(json.dumps(WORKLOADS[wname]()), flush=True)
-        except Exception as e:  # one bad workload must not hide the rest
-            print(json.dumps({"metric": wname, "error": f"{type(e).__name__}: {e}"}),
-                  flush=True)
+        result = None
+        reason = ""
+        attempts = 1 if on_cpu else 2
+        for attempt in range(attempts):
+            remaining = deadline - time.time()
+            if remaining < 60 and attempt > 0:
+                reason += "; retry skipped (deadline)"
+                break
+            budget = min(per_workload_s, max(60.0, remaining))
+            try:
+                result = _run_workload_subprocess(wname, budget)
+                break
+            except Exception as e:
+                reason = f"{type(e).__name__}: {e}"
+                print(f"bench: {wname} attempt {attempt + 1}/{attempts} "
+                      f"failed — {reason}", file=sys.stderr)
+                if time.time() + 60 < deadline and attempt + 1 < attempts:
+                    time.sleep(30)
+        if result is None and not on_cpu:
+            # accelerator path kept failing: record a CPU number for this
+            # workload rather than nothing, and say why — but never blow
+            # far past the caller's deadline doing it
+            try:
+                budget = min(600.0, max(120.0, deadline - time.time()))
+                result = _run_workload_subprocess(wname, budget, cpu=True)
+                result["note"] = (f"cpu fallback (accelerator failed: "
+                                  f"{reason[:300]})")
+            except Exception as e:
+                reason += f"; cpu fallback also failed: {e}"
+        if result is None:
+            result = {"metric": wname, "error": reason[-500:]}
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    _ensure_live_backend()
+    if not os.environ.get("_VENEUR_BENCH_CHILD"):
+        _ensure_live_backend()
     main()
