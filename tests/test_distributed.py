@@ -472,3 +472,54 @@ def test_three_tier_local_proxy_global_end_to_end():
         proxy.stop()
         imp1.stop()
         imp2.stop()
+
+
+def test_proxy_runtime_reporter_emits_deltas():
+    """Proxy self-telemetry (reference RuntimeMetricsInterval,
+    proxy.go:210-216): counters report as per-interval deltas under the
+    veneur_proxy. namespace, plus ring size and RSS gauges."""
+    from veneur_tpu import scopedstatsd
+    from veneur_tpu.distributed.proxy import ProxyRuntimeReporter
+
+    cap = scopedstatsd.CaptureSender()
+    stats = scopedstatsd.ScopedClient(cap, namespace="veneur_proxy.")
+    proxy = ProxyServer(["127.0.0.1:1"])
+    proxy.proxied_metrics = 10
+    proxy.drops = 3
+    rep = ProxyRuntimeReporter(proxy, stats, interval_s=60.0)
+    rep.report_once()
+    proxy.proxied_metrics = 25
+    rep.report_once()
+    lines = cap.lines
+    by_dest = [l for l in lines
+               if l.startswith("veneur_proxy.metrics_by_destination")]
+    assert by_dest[0].split("|")[0].endswith(":10")
+    assert by_dest[1].split("|")[0].endswith(":15")  # delta, not total
+    assert any(l.startswith("veneur_proxy.destinations_total:1") for l in lines)
+    assert any(l.startswith("veneur_proxy.mem.rss_bytes") for l in lines)
+
+
+def test_proxy_main_refuses_empty_destinations(tmp_path):
+    """reference proxy.go:190-199: no discovery names and no static
+    addresses is a startup error."""
+    from veneur_tpu.cli.proxy_main import main as proxy_main
+
+    p = tmp_path / "proxy.yaml"
+    p.write_text("grpc_address: 127.0.0.1:0\n")
+    assert proxy_main(["-f", str(p)]) == 1
+
+
+def test_forward_client_idle_timeout_option():
+    """idle_connection_timeout (reference proxy.go:107-114) plumbs into
+    the downstream channel options without breaking sends."""
+    from veneur_tpu.distributed import rpc
+    from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+    server, port = rpc.make_server(lambda b: None, "127.0.0.1:0")
+    try:
+        client = rpc.ForwardClient(f"127.0.0.1:{port}", 5.0,
+                                   idle_timeout_s=30.0)
+        assert client.send(pb.MetricBatch())
+        client.close()
+    finally:
+        server.stop(grace=0.5)

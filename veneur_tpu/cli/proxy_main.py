@@ -17,6 +17,7 @@ from veneur_tpu.core.config import load_proxy_config, parse_duration
 from veneur_tpu.distributed.proxy import (
     DestinationRefresher,
     ProxyHTTPServer,
+    ProxyRuntimeReporter,
     ProxyServer,
     TraceProxy,
 )
@@ -45,9 +46,42 @@ def main(argv=None) -> int:
     if cfg.debug:
         logging.getLogger().setLevel(logging.DEBUG)
 
-    static = [cfg.forward_address] if cfg.forward_address else []
+    # this proxy forwards downstream over one (gRPC) ring, so the
+    # reference's separate HTTP and gRPC forward rings (proxy.go:163-166,
+    # 184-187) unify. When both static addresses are set they almost
+    # certainly name the same downstream pool — ring both and half the
+    # keys would dial a dead member — so the gRPC one wins.
+    if cfg.forward_address and cfg.grpc_forward_address:
+        log.warning("forward_address %r ignored: this proxy routes all "
+                    "forwards over one gRPC ring, using "
+                    "grpc_forward_address %r",
+                    cfg.forward_address, cfg.grpc_forward_address)
+        static = [cfg.grpc_forward_address]
+    else:
+        static = [a for a in (cfg.forward_address,
+                              cfg.grpc_forward_address) if a]
+    forward_service = (cfg.consul_forward_service_name
+                       or cfg.consul_forward_grpc_service_name)
+    accepting_forwards = bool(static or forward_service
+                              or cfg.kubernetes_forward_service_name)
+    accepting_traces = bool(cfg.trace_address
+                            or cfg.consul_trace_service_name)
+    if not accepting_forwards and not accepting_traces:
+        # reference proxy.go:190-199: refusing to start with no discovery
+        # service names and no static addresses is an error, not a warning
+        print("refusing to start with no discovery service names or"
+              " static addresses in config", file=sys.stderr)
+        return 1
+    if not accepting_forwards:
+        log.warning("no forward destinations configured: the forward "
+                    "endpoints will drop every batch (trace proxying "
+                    "only)")
+
+    idle_s = (parse_duration(cfg.idle_connection_timeout)
+              if cfg.idle_connection_timeout else 0.0)
     proxy = ProxyServer(static,
-                        timeout_s=parse_duration(cfg.forward_timeout))
+                        timeout_s=parse_duration(cfg.forward_timeout),
+                        idle_timeout_s=idle_s)
     address = cfg.grpc_address or "127.0.0.1:8128"
     port = proxy.start_grpc(address)
     log.info("proxy serving gRPC on %s (port %s)", address, port)
@@ -83,12 +117,19 @@ def main(argv=None) -> int:
             cfg.consul_trace_service_name,
             parse_duration(cfg.consul_refresh_interval))
         trace_refresher.start()
-    if cfg.consul_forward_service_name:
+    if (cfg.consul_forward_service_name
+            and cfg.consul_forward_grpc_service_name
+            and cfg.consul_forward_grpc_service_name != forward_service):
+        log.warning("consul_forward_grpc_service_name %r ignored: this "
+                    "proxy routes HTTP and gRPC forwards over one ring, "
+                    "discovered from consul_forward_service_name %r",
+                    cfg.consul_forward_grpc_service_name, forward_service)
+    if forward_service:
         from veneur_tpu.distributed.discovery import ConsulDiscoverer
 
         refresher = DestinationRefresher(
             proxy, ConsulDiscoverer(cfg.consul_url),
-            cfg.consul_forward_service_name,
+            forward_service,
             parse_duration(cfg.consul_refresh_interval))
     elif cfg.kubernetes_forward_service_name:
         from veneur_tpu.distributed.discovery import KubernetesDiscoverer
@@ -99,14 +140,26 @@ def main(argv=None) -> int:
             parse_duration(cfg.consul_refresh_interval))
     if refresher is not None:
         refresher.start()
-    elif not static:
-        log.warning("no destinations configured: set forward_address or a"
-                    " discovery service name")
+
+    reporter = None
+    if cfg.stats_address:
+        from veneur_tpu import scopedstatsd
+
+        stats = scopedstatsd.ScopedClient(
+            scopedstatsd.UDPSender(cfg.stats_address),
+            namespace="veneur_proxy.")
+        reporter = ProxyRuntimeReporter(
+            proxy, stats,
+            interval_s=parse_duration(cfg.runtime_metrics_interval),
+            trace_proxy=trace_proxy)
+        reporter.start()
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if reporter is not None:
+        reporter.stop()
     if refresher is not None:
         refresher.stop()
     if trace_refresher is not None:

@@ -268,10 +268,13 @@ class Config:
 class ProxyConfig:
     """veneur-proxy configuration (reference config_proxy.go:3-27)."""
 
+    consul_forward_grpc_service_name: str = ""
     consul_forward_service_name: str = ""
     consul_refresh_interval: str = "30s"
     consul_trace_service_name: str = ""
     consul_url: str = "http://127.0.0.1:8500"
+    idle_connection_timeout: str = ""  # downstream conn idle timeout
+    runtime_metrics_interval: str = "10s"
     kubernetes_forward_service_name: str = ""
     kubernetes_namespace: str = "default"
     debug: bool = False

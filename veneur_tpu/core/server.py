@@ -38,6 +38,7 @@ from veneur_tpu.sinks import (
     strip_excluded_tags,
 )
 from veneur_tpu.ssf import SSFSample
+from veneur_tpu.utils.proc import current_rss_bytes as _current_rss_bytes
 
 log = logging.getLogger("veneur_tpu.server")
 
@@ -65,16 +66,6 @@ def calculate_tick_delay(interval_s: float, now: float) -> float:
     """Seconds until the next interval-aligned tick
     (reference CalculateTickDelay, server.go:1517)."""
     return interval_s - (now % interval_s)
-
-
-def _current_rss_bytes() -> Optional[int]:
-    """Current resident set size (Linux /proc; None where unavailable)."""
-    try:
-        with open("/proc/self/statm") as f:
-            pages = int(f.read().split()[1])
-        return pages * os.sysconf("SC_PAGE_SIZE")
-    except (OSError, ValueError, IndexError):
-        return None
 
 
 class _SpanPipelineClient:

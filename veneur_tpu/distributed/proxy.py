@@ -30,9 +30,11 @@ class ProxyServer:
     instance owning its key on the consistent ring."""
 
     def __init__(self, destinations: Optional[list[str]] = None,
-                 timeout_s: float = 10.0) -> None:
+                 timeout_s: float = 10.0,
+                 idle_timeout_s: float = 0.0) -> None:
         self.ring = ConsistentRing(destinations or [])
         self.timeout_s = timeout_s
+        self.idle_timeout_s = idle_timeout_s
         self._conns: dict[str, rpc.ForwardClient] = {}
         self._lock = threading.Lock()
         self.grpc_server: Optional[grpc.Server] = None
@@ -55,7 +57,8 @@ class ProxyServer:
         with self._lock:
             client = self._conns.get(dest)
             if client is None:
-                client = rpc.ForwardClient(dest, self.timeout_s)
+                client = rpc.ForwardClient(dest, self.timeout_s,
+                                           idle_timeout_s=self.idle_timeout_s)
                 self._conns[dest] = client
             return client
 
@@ -292,6 +295,57 @@ class DestinationRefresher:
 
         threading.Thread(target=loop, daemon=True,
                          name="discovery-refresh").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ProxyRuntimeReporter:
+    """Periodic proxy self-telemetry to stats_address
+    (reference proxy.go:210-216 RuntimeMetricsInterval + the veneur_proxy.*
+    statsd namespace set in proxy.go:224-228): routed/dropped counters as
+    deltas, ring size, and process RSS every interval."""
+
+    def __init__(self, proxy: ProxyServer, stats,
+                 interval_s: float = 10.0,
+                 trace_proxy: Optional["TraceProxy"] = None) -> None:
+        self.proxy = proxy
+        self.stats = stats
+        self.trace_proxy = trace_proxy
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._last = {"proxied": 0, "drops": 0, "spans": 0}
+
+    def report_once(self) -> None:
+        from veneur_tpu.utils.proc import current_rss_bytes
+
+        proxied, drops = self.proxy.proxied_metrics, self.proxy.drops
+        self.stats.count("metrics_by_destination",
+                         proxied - self._last["proxied"],
+                         tags=["protocol:grpc"])
+        self.stats.count("dropped_metrics",
+                         drops - self._last["drops"])
+        self._last["proxied"], self._last["drops"] = proxied, drops
+        self.stats.gauge("destinations_total", float(len(self.proxy.ring)))
+        if self.trace_proxy is not None:
+            spans = self.trace_proxy.proxied_spans
+            self.stats.count("spans_proxied",
+                             spans - self._last["spans"])
+            self._last["spans"] = spans
+        rss = current_rss_bytes()
+        if rss is not None:
+            self.stats.gauge("mem.rss_bytes", float(rss))
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.report_once()
+                except Exception:  # pragma: no cover - telemetry best-effort
+                    log.exception("proxy runtime metrics report failed")
+
+        threading.Thread(target=loop, daemon=True,
+                         name="proxy-runtime-metrics").start()
 
     def stop(self) -> None:
         self._stop.set()

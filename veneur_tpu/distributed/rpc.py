@@ -61,10 +61,18 @@ class ForwardClient:
     classification (flusher.go:511-527: deadline / transient / send —
     counted, never retried; per-flush data is expendable by design)."""
 
-    def __init__(self, address: str, timeout_s: float = 10.0) -> None:
+    def __init__(self, address: str, timeout_s: float = 10.0,
+                 idle_timeout_s: float = 0.0) -> None:
         self.address = address
         self.timeout_s = timeout_s
-        self.channel = grpc.insecure_channel(address)
+        options = []
+        if idle_timeout_s > 0:
+            # reference proxies set an idle timeout on downstream
+            # connections (proxy.go:107-114 IdleConnTimeout); gRPC's
+            # analog moves an idle channel to IDLE, closing transports
+            options.append(
+                ("grpc.client_idle_timeout_ms", int(idle_timeout_s * 1000)))
+        self.channel = grpc.insecure_channel(address, options=options or None)
         self._call = self.channel.unary_unary(
             SEND_METRICS,
             request_serializer=pb.MetricBatch.SerializeToString,
