@@ -443,3 +443,80 @@ def test_splunk_session_rotation_lifetime():
         s.close()
     finally:
         httpd.shutdown()
+
+
+def test_signalfx_dynamic_key_refresh_pages_token_api():
+    """Dynamic per-tag API keys (reference clientByTagUpdater +
+    fetchAPIKeys, sinks/signalfx/signalfx.go:250-342): page through
+    /v2/token with the default key until an empty page, then merge
+    name->secret into the per-tag key map."""
+    import json as _json
+    import urllib.parse
+
+    from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+
+    # offsets advance by the number of items actually returned (the API
+    # may clamp below the requested limit), so 0 -> 2 -> 3 -> done
+    pages = {
+        0: [{"name": "team-a", "secret": "key-a"},
+            {"name": "team-b", "secret": "key-b"}],
+        2: [{"name": "team-c", "secret": "key-c"}],
+        3: [],
+    }
+    seen_headers = {}
+
+    def opener(req, timeout):
+        q = urllib.parse.parse_qs(urllib.parse.urlsplit(req.full_url).query)
+        seen_headers.update(req.headers)
+        off = int(q["offset"][0])
+        return _json.dumps({"results": pages[off]}).encode()
+
+    sink = SignalFxMetricSink(
+        api_key="default-key", hostname="h",
+        per_tag_api_keys={"team-a": "stale"},
+        vary_key_by="team", dynamic_per_tag_keys=True,
+        api_endpoint="https://api.example.com", opener=opener)
+    sink.refresh_keys_once()
+    assert sink.per_tag_api_keys == {
+        "team-a": "key-a", "team-b": "key-b", "team-c": "key-c"}
+    assert sink.key_refreshes == 1
+    assert seen_headers.get("X-sf-token") == "default-key"
+
+
+def test_signalfx_dynamic_key_refresh_failure_keeps_old_keys():
+    from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+
+    def opener(req, timeout):
+        raise OSError("api down")
+
+    sink = SignalFxMetricSink(
+        api_key="k", hostname="h", per_tag_api_keys={"a": "old"},
+        dynamic_per_tag_keys=True, opener=opener)
+    sink.refresh_keys_once()
+    assert sink.per_tag_api_keys == {"a": "old"}
+    assert sink.key_refreshes == 0
+
+
+def test_splunk_factory_plumbs_hec_tuning(tmp_path):
+    """splunk_hec_* tuning keys reach the sink (reference server.go:645)."""
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.factory import build_server
+
+    cfg = Config(
+        statsd_listen_addresses=[], interval="10s",
+        splunk_hec_address="https://hec.example.com:8088",
+        splunk_hec_token="tok",
+        splunk_hec_ingest_timeout="2s",
+        splunk_hec_max_connection_lifetime="90s",
+        splunk_hec_connection_lifetime_jitter="15s",
+        splunk_hec_tls_validate_hostname="hec.internal",
+    )
+    server = build_server(cfg)
+    try:
+        splunk = [s for s in server.span_sinks if s.name() == "splunk"][0]
+        assert splunk.ingest_timeout_s == 2.0
+        assert splunk.connection_lifetime_s == 90.0
+        assert splunk.connection_lifetime_jitter_s == 15.0
+        assert splunk.tls_validate_hostname == "hec.internal"
+    finally:
+        server.shutdown()

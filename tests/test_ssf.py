@@ -369,3 +369,61 @@ def test_ssf_udp_burst_batched_native():
         assert srv.parse_errors >= 1  # the garbage datagram
     finally:
         srv.shutdown()
+
+
+def test_read_ssf_respects_trace_max_length():
+    """trace_max_length_bytes caps accepted frame sizes below the
+    protocol ceiling (reference config trace_max_length_bytes)."""
+    import io
+
+    import pytest
+
+    from veneur_tpu.protocol import ssf_wire
+    from veneur_tpu.ssf import SSFSpan
+
+    span = SSFSpan(trace_id=1, id=2, service="s", name="n",
+                   start_timestamp=1, end_timestamp=2)
+    buf = io.BytesIO()
+    ssf_wire.write_ssf(buf, span)
+    frame = buf.getvalue()
+    # a generous cap admits the frame
+    got = ssf_wire.read_ssf(io.BytesIO(frame), max_length=1 << 20)
+    assert got is not None and got.trace_id == 1
+    # a cap below the frame's body length poisons the stream
+    body_len = len(frame) - 5
+    with pytest.raises(ssf_wire.FramingError):
+        ssf_wire.read_ssf(io.BytesIO(frame), max_length=body_len - 1)
+
+
+def test_span_worker_multiple_consumers():
+    """num_span_workers > 1 (reference server.go:842-850): N consumers
+    drain one channel; every span reaches the sinks exactly once."""
+    import threading
+
+    from veneur_tpu.core.spans import SpanWorker
+    from veneur_tpu.ssf import SSFSpan
+
+    seen = []
+    lock = threading.Lock()
+
+    class Sink:
+        def name(self):
+            return "cap"
+
+        def ingest(self, span):
+            with lock:
+                seen.append(span.id)
+
+        def flush(self):
+            pass
+
+    w = SpanWorker([Sink()], capacity=1000, workers=4)
+    assert len(w._threads) == 0
+    w.start()
+    assert len(w._threads) == 4
+    for i in range(200):
+        w.ingest(SSFSpan(trace_id=1, id=i, service="s", name="n",
+                         start_timestamp=1, end_timestamp=2))
+    w.stop()
+    assert sorted(seen) == list(range(200))
+    assert w.spans_ingested == 200

@@ -10,7 +10,7 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
-from veneur_tpu.core.config import Config
+from veneur_tpu.core.config import Config, parse_duration
 from veneur_tpu.core.server import Server
 
 log = logging.getLogger("veneur_tpu.factory")
@@ -77,6 +77,15 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
             metric_name_prefix_drops=cfg.signalfx_metric_name_prefix_drops,
             metric_tag_prefix_drops=cfg.signalfx_metric_tag_prefix_drops,
             flush_max_per_body=cfg.signalfx_flush_max_per_body,
+            dynamic_per_tag_keys=(
+                cfg.signalfx_dynamic_per_tag_api_keys_enable),
+            dynamic_key_refresh_period_s=(
+                parse_duration(
+                    cfg.signalfx_dynamic_per_tag_api_keys_refresh_period)
+                if cfg.signalfx_dynamic_per_tag_api_keys_refresh_period
+                else 300.0),
+            api_endpoint=(cfg.signalfx_endpoint_api
+                          or "https://api.signalfx.com"),
             **kw,
         ))
 
@@ -112,17 +121,35 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
         from veneur_tpu.sinks.kafka import (
             KafkaMetricSink, KafkaSpanSink, default_producer)
 
+        def _buf_ms(spec: str) -> float:
+            return parse_duration(spec) * 1000.0 if spec else 0.0
+
+        # one producer per sink, each with its own ack/buffer tuning
+        # (reference builds a sarama config per sink,
+        # sinks/kafka/kafka.go:83,264)
         try:
-            producer = default_producer(
-                cfg.kafka_broker, cfg.kafka_retry_max,
-                cfg.kafka_metric_require_acks)
             if cfg.kafka_metric_topic or cfg.kafka_check_topic:
+                producer = default_producer(
+                    cfg.kafka_broker, cfg.kafka_retry_max,
+                    cfg.kafka_metric_require_acks,
+                    buffer_bytes=cfg.kafka_metric_buffer_bytes,
+                    buffer_ms=_buf_ms(cfg.kafka_metric_buffer_frequency),
+                    buffer_messages=cfg.kafka_metric_buffer_messages,
+                    partitioner=cfg.kafka_partitioner or "hash")
                 metric_sinks.append(KafkaMetricSink(
                     producer, cfg.kafka_check_topic, cfg.kafka_event_topic,
                     cfg.kafka_metric_topic))
             if cfg.kafka_span_topic:
+                span_producer = default_producer(
+                    cfg.kafka_broker, cfg.kafka_retry_max,
+                    (cfg.kafka_span_require_acks
+                     or cfg.kafka_metric_require_acks),
+                    buffer_bytes=cfg.kafka_span_buffer_bytes,
+                    buffer_ms=_buf_ms(cfg.kafka_span_buffer_frequency),
+                    buffer_messages=cfg.kafka_span_buffer_mesages,
+                    partitioner=cfg.kafka_partitioner or "hash")
                 span_sinks.append(KafkaSpanSink(
-                    producer, cfg.kafka_span_topic,
+                    span_producer, cfg.kafka_span_topic,
                     cfg.kafka_span_serialization_format,
                     cfg.kafka_span_sample_rate_percent,
                     cfg.kafka_span_sample_tag))
@@ -130,7 +157,6 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
             log.warning("kafka sink disabled: %s", e)
 
     if cfg.splunk_hec_address and cfg.splunk_hec_token:
-        from veneur_tpu.core.config import parse_duration
         from veneur_tpu.sinks.splunk import SplunkSpanSink
 
         span_sinks.append(SplunkSpanSink(
@@ -142,6 +168,16 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
             span_sample_rate=cfg.splunk_span_sample_rate,
             send_timeout_s=(parse_duration(cfg.splunk_hec_send_timeout)
                             if cfg.splunk_hec_send_timeout else 10.0),
+            ingest_timeout_s=(
+                parse_duration(cfg.splunk_hec_ingest_timeout)
+                if cfg.splunk_hec_ingest_timeout else 0.0),
+            connection_lifetime_s=(
+                parse_duration(cfg.splunk_hec_max_connection_lifetime)
+                if cfg.splunk_hec_max_connection_lifetime else 60.0),
+            connection_lifetime_jitter_s=(
+                parse_duration(cfg.splunk_hec_connection_lifetime_jitter)
+                if cfg.splunk_hec_connection_lifetime_jitter else 30.0),
+            tls_validate_hostname=cfg.splunk_hec_tls_validate_hostname,
             **kw,
         ))
 
@@ -165,6 +201,11 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
                          or cfg.trace_lightstep_num_clients or 1),
             maximum_spans=(cfg.lightstep_maximum_spans
                            or cfg.trace_lightstep_maximum_spans or 100000),
+            reconnect_period_s=(
+                parse_duration(cfg.lightstep_reconnect_period
+                               or cfg.trace_lightstep_reconnect_period)
+                if (cfg.lightstep_reconnect_period
+                    or cfg.trace_lightstep_reconnect_period) else 0.0),
             **kw,
         ))
 

@@ -145,6 +145,7 @@ class Server:
             [self._extraction_sink] + self.span_sinks,
             common_tags=common_tags,
             capacity=cfg.span_channel_capacity,
+            workers=cfg.num_span_workers,
         )
         # per-service span ingest counters (reference server.go:1088-1101)
         self.ssf_spans_received: dict[str, int] = {}
@@ -195,10 +196,19 @@ class Server:
             sender = scopedstatsd.NullSender()
         self.stats = scopedstatsd.ScopedClient(
             sender,
-            add_tags=self.tags,
+            # self-telemetry carries the common tags plus the dedicated
+            # veneur_metrics_additional_tags (reference server.go:300-307)
+            add_tags=self.tags + list(cfg.veneur_metrics_additional_tags),
             scopes=cfg.veneur_metrics_scopes,
             namespace="veneur.",
         )
+        if cfg.block_profile_rate or cfg.mutex_profile_fraction:
+            # accepted for config compatibility (server.go:334-347); these
+            # tune the Go runtime's profilers, which have no analog here —
+            # enable_profiling drives the XLA profiler instead
+            log.info("block_profile_rate/mutex_profile_fraction have no "
+                     "effect in veneur-tpu (Go runtime knobs); see "
+                     "enable_profiling for the XLA profiler")
 
         # native C++ ingest path: each worker gets its own parser context;
         # readers parse lock-free and commit to shard digest % N under
@@ -384,7 +394,11 @@ class Server:
 
         def loop():
             sock.settimeout(0.5)  # quiesce-able without closing (handoff)
-            max_len = ssf_wire.MAX_SSF_PACKET_LENGTH
+            # per-datagram read buffer (reference ssf_buffer_size,
+            # networking.go pool sizing). As in the reference, a datagram
+            # larger than the buffer is truncated by recv and the remnant
+            # fails proto parse -> counted as a parse error
+            max_len = self.config.ssf_buffer_size
             while not (self._shutdown.is_set() or self._quiesce.is_set()):
                 try:
                     data = sock.recv(max_len)
@@ -435,7 +449,8 @@ class Server:
         f = conn.makefile("rb")
         try:
             while not self._shutdown.is_set():
-                span = ssf_wire.read_ssf(f)
+                span = ssf_wire.read_ssf(
+                    f, max_length=self.config.trace_max_length_bytes)
                 if span is None:
                     return
                 self.handle_ssf(span)
@@ -458,7 +473,7 @@ class Server:
         def loop():
             while not self._shutdown.is_set():
                 try:
-                    data = sock.recv(ssf_wire.MAX_SSF_PACKET_LENGTH)
+                    data = sock.recv(self.config.ssf_buffer_size)
                 except OSError:
                     return
                 self.handle_trace_packet(data)

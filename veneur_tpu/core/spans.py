@@ -139,7 +139,8 @@ class SpanWorker:
     (reference SpanWorker.Work, worker.go:611-695)."""
 
     def __init__(self, span_sinks: list, common_tags: Optional[dict] = None,
-                 capacity: int = 100, sink_timeout_s: float = 9.0) -> None:
+                 capacity: int = 100, sink_timeout_s: float = 9.0,
+                 workers: int = 1) -> None:
         self.span_sinks = span_sinks
         self.common_tags = common_tags or {}
         self.chan: "queue.Queue[Optional[ssf.SSFSpan]]" = queue.Queue(capacity)
@@ -147,7 +148,11 @@ class SpanWorker:
         self.spans_ingested = 0
         self.spans_dropped = 0
         self.sink_errors: dict[str, int] = {}
-        self._thread: Optional[threading.Thread] = None
+        # N consumers off one channel (reference num_span_workers,
+        # server.go:842-850)
+        self.workers = max(1, workers)
+        self._threads: list[threading.Thread] = []
+        self._stats_lock = threading.Lock()
 
     def ingest(self, span: ssf.SSFSpan) -> None:
         """Non-blocking enqueue; drops when full (backpressure policy of
@@ -158,21 +163,25 @@ class SpanWorker:
             self.spans_dropped += 1
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self.work, daemon=True, name="span-worker")
-        self._thread.start()
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=self.work, daemon=True, name=f"span-worker-{i}")
+            t.start()
+            self._threads.append(t)
 
     def stop(self) -> None:
-        self.chan.put(None)
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        for _ in self._threads or [None]:
+            self.chan.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
 
     def work(self) -> None:
         while True:
             span = self.chan.get()
             if span is None:
                 return
-            self.spans_ingested += 1
+            with self._stats_lock:
+                self.spans_ingested += 1
             # common tags fill in missing span tags (worker.go:627-634)
             for k, v in self.common_tags.items():
                 span.tags.setdefault(k, v)
@@ -180,8 +189,9 @@ class SpanWorker:
                 try:
                     sink.ingest(span)
                 except Exception as e:
-                    self.sink_errors[sink.name()] = (
-                        self.sink_errors.get(sink.name(), 0) + 1)
+                    with self._stats_lock:
+                        self.sink_errors[sink.name()] = (
+                            self.sink_errors.get(sink.name(), 0) + 1)
                     log.debug("span sink %s ingest failed: %s",
                               sink.name(), e)
 

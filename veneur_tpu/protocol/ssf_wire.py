@@ -113,11 +113,15 @@ def parse_ssf(packet: bytes) -> ssf_model.SSFSpan:
     return normalize_span(pb_to_span(pb))
 
 
-def read_ssf(stream: BinaryIO) -> Optional[ssf_model.SSFSpan]:
+def read_ssf(stream: BinaryIO,
+             max_length: int = MAX_SSF_PACKET_LENGTH
+             ) -> Optional[ssf_model.SSFSpan]:
     """Read one framed span from a stream.
 
     Returns None on clean EOF at a frame boundary. Raises FramingError on
-    any framing violation (fatal for the stream).
+    any framing violation (fatal for the stream). max_length caps the
+    accepted frame size (config trace_max_length_bytes; the protocol's
+    hard ceiling stays MAX_SSF_PACKET_LENGTH).
     """
     header = stream.read(1)
     if not header:
@@ -127,9 +131,10 @@ def read_ssf(stream: BinaryIO) -> Optional[ssf_model.SSFSpan]:
         raise FramingError(f"unknown SSF frame version {version}")
     length_bytes = _read_exact(stream, 4)
     (length,) = struct.unpack(">I", length_bytes)
-    if length > MAX_SSF_PACKET_LENGTH:
+    limit = min(max_length, MAX_SSF_PACKET_LENGTH)
+    if length > limit:
         raise FramingError(
-            f"frame length {length} exceeds {MAX_SSF_PACKET_LENGTH}")
+            f"frame length {length} exceeds {limit}")
     body = _read_exact(stream, length)
     return parse_ssf(body)
 

@@ -31,7 +31,16 @@ class Producer(Protocol):
 
 
 def default_producer(broker: str, retry_max: int = 3,
-                     require_acks: str = "all") -> Producer:
+                     require_acks: str = "all",
+                     buffer_bytes: int = 0,
+                     buffer_ms: float = 0.0,
+                     buffer_messages: int = 0,
+                     partitioner: str = "hash") -> Producer:
+    """Producer with the reference's per-sink tuning surface
+    (sinks/kafka/kafka.go newProducerConfig :109-141): ack requirement,
+    hash/random partitioner, and flush thresholds by bytes
+    (batch_size), time (linger_ms), and message count (an explicit
+    flush every N sends)."""
     try:
         from kafka import KafkaProducer  # type: ignore
     except ImportError as e:
@@ -39,15 +48,43 @@ def default_producer(broker: str, retry_max: int = 3,
             "no kafka client library available; inject a producer"
         ) from e
     acks = {"none": 0, "local": 1, "all": -1}.get(require_acks, -1)
+    kwargs = {}
+    if buffer_bytes:
+        kwargs["batch_size"] = buffer_bytes
+    if buffer_ms:
+        kwargs["linger_ms"] = int(buffer_ms)
+    if partitioner == "random":
+        import random as _random
+
+        kwargs["partitioner"] = (
+            lambda key, all_parts, avail: _random.choice(
+                avail or all_parts))
     prod = KafkaProducer(bootstrap_servers=broker, retries=retry_max,
-                         acks=acks)
+                         acks=acks, **kwargs)
+
+    import threading
 
     class _Wrap:
+        def __init__(self) -> None:
+            self._since_flush = 0
+            # sends may arrive from several span workers concurrently
+            self._lock = threading.Lock()
+
         def send(self, topic, key, value):
             prod.send(topic, key=key, value=value)
+            if buffer_messages:
+                with self._lock:
+                    self._since_flush += 1
+                    due = self._since_flush >= buffer_messages
+                    if due:
+                        self._since_flush = 0
+                if due:
+                    prod.flush()
 
         def flush(self):
             prod.flush()
+            with self._lock:
+                self._since_flush = 0
 
     return _Wrap()
 

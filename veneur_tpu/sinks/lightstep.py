@@ -13,6 +13,7 @@ JSON report endpoint.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Callable, Optional
 
 from veneur_tpu.sinks import SpanSink
@@ -27,16 +28,25 @@ class LightStepSpanSink(SpanSink):
                  collector_host: str = "https://collector.lightstep.com",
                  num_clients: int = 1,
                  maximum_spans: int = 100000,
+                 reconnect_period_s: float = 0.0,
                  transport: Optional[Callable[[int, list[dict]], None]] = None,
                  opener=default_opener) -> None:
         self.access_token = access_token
         self.collector_host = collector_host.rstrip("/")
         self.num_clients = max(1, num_clients)
         self.maximum_spans = maximum_spans
+        # reference lightstep.go sets ReconnectPeriod on its persistent
+        # collector connections; this HTTP transport dials per report, so
+        # every report already reconnects — the knob is an accepted upper
+        # bound rather than a behavior change
+        self.reconnect_period_s = reconnect_period_s
         self.opener = opener
         self.transport = transport or self._http_report
-        # per-client span buffers
+        # per-client span buffers; ingest may run from several span
+        # workers concurrently (num_span_workers), so buffer mutation
+        # and the cap check share one lock
         self._buffers: list[list[dict]] = [[] for _ in range(self.num_clients)]
+        self._lock = threading.Lock()
         self.spans_flushed = 0
         self.spans_dropped = 0
         self.flush_errors = 0
@@ -47,11 +57,16 @@ class LightStepSpanSink(SpanSink):
     def ingest(self, span: SSFSpan) -> None:
         # one trace → one client (reference round-robins on trace id)
         client = span.trace_id % self.num_clients
-        buf = self._buffers[client]
-        if len(buf) >= self.maximum_spans // self.num_clients:
-            self.spans_dropped += 1
-            return
-        buf.append({
+        with self._lock:
+            buf = self._buffers[client]
+            if len(buf) >= self.maximum_spans // self.num_clients:
+                self.spans_dropped += 1
+                return
+            buf.append(self._convert(span))
+
+    @staticmethod
+    def _convert(span: SSFSpan) -> dict:
+        return {
             "span_guid": str(span.id),
             "trace_guid": str(span.trace_id),
             "parent_guid": str(span.parent_id) if span.parent_id else "",
@@ -64,13 +79,15 @@ class LightStepSpanSink(SpanSink):
                 {"Key": "component", "Value": span.service},
                 {"Key": "error", "Value": str(span.error).lower()},
             ],
-        })
+        }
 
     def flush(self) -> None:
-        for client, buf in enumerate(self._buffers):
-            if not buf:
-                continue
-            self._buffers[client] = []
+        for client in range(self.num_clients):
+            with self._lock:
+                buf = self._buffers[client]
+                if not buf:
+                    continue
+                self._buffers[client] = []
             try:
                 self.transport(client, buf)
                 self.spans_flushed += len(buf)

@@ -9,8 +9,10 @@ via FlushOtherSamples.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
+import urllib.request
 from typing import Optional
 
 from veneur_tpu.core.metrics import InterMetric, MetricType
@@ -34,6 +36,9 @@ class SignalFxMetricSink(MetricSink):
         metric_name_prefix_drops: Optional[list[str]] = None,
         metric_tag_prefix_drops: Optional[list[str]] = None,
         flush_max_per_body: int = 0,
+        dynamic_per_tag_keys: bool = False,
+        dynamic_key_refresh_period_s: float = 300.0,
+        api_endpoint: str = "https://api.signalfx.com",
         opener=default_opener,
     ) -> None:
         self.api_key = api_key
@@ -45,14 +50,78 @@ class SignalFxMetricSink(MetricSink):
         self.name_drops = metric_name_prefix_drops or []
         self.tag_drops = metric_tag_prefix_drops or []
         self.flush_max_per_body = flush_max_per_body or 5000
+        self.dynamic_per_tag_keys = dynamic_per_tag_keys
+        self.dynamic_key_refresh_period_s = dynamic_key_refresh_period_s
+        self.api_endpoint = api_endpoint.rstrip("/")
         self.opener = opener
         self.flushed_metrics = 0
         self.flush_errors = 0
+        self.key_refreshes = 0
+        self._keys_lock = threading.Lock()
+        self._refresh_stop = threading.Event()
 
     def name(self) -> str:
         return "signalfx"
 
-    def _convert(self, m: InterMetric) -> Optional[tuple[str, dict]]:
+    # -- dynamic per-tag API keys (reference clientByTagUpdater,
+    # sinks/signalfx/signalfx.go:250-270: poll the token API on a period,
+    # swapping in a client per named token) ------------------------------
+
+    def fetch_api_keys(self) -> dict[str, str]:
+        """Page through GET {api_endpoint}/v2/token (auth: default key)
+        until an empty page; returns {token name: secret}
+        (reference fetchAPIKeys, signalfx.go:321-342)."""
+        out: dict[str, str] = {}
+        offset = 0
+        while True:
+            url = (f"{self.api_endpoint}/v2/token"
+                   f"?limit=200&name=&offset={offset}")
+            req = urllib.request.Request(
+                url, headers={"X-SF-TOKEN": self.api_key,
+                              "Content-Type": "application/json"})
+            body = json.loads(self.opener(req, 10.0))
+            results = body.get("results")
+            if not isinstance(results, list):
+                raise ValueError("unknown results structure from "
+                                 "signalfx api")
+            for r in results:
+                if isinstance(r, dict) and "name" in r and "secret" in r:
+                    out[str(r["name"])] = str(r["secret"])
+            if not results:
+                return out
+            # advance by what actually arrived: the API may clamp the
+            # page size below the requested limit
+            offset += len(results)
+
+    def refresh_keys_once(self) -> None:
+        try:
+            keys = self.fetch_api_keys()
+        except Exception as e:
+            log.warning("signalfx token refresh failed: %s", e)
+            return
+        with self._keys_lock:
+            self.per_tag_api_keys.update(keys)
+        self.key_refreshes += 1
+
+    def start(self, trace_client=None) -> None:
+        if (not self.dynamic_per_tag_keys
+                or self.dynamic_key_refresh_period_s <= 0):
+            return
+
+        def loop():
+            while not self._refresh_stop.wait(
+                    self.dynamic_key_refresh_period_s):
+                self.refresh_keys_once()
+
+        threading.Thread(target=loop, daemon=True,
+                         name="signalfx-key-refresh").start()
+
+    def stop(self) -> None:
+        self._refresh_stop.set()
+
+    def _convert(self, m: InterMetric,
+                 keys: Optional[dict[str, str]] = None
+                 ) -> Optional[tuple[str, dict]]:
         if any(m.name.startswith(p) for p in self.name_drops):
             return None
         dims = {self.hostname_tag: m.hostname or self.hostname}
@@ -82,14 +151,20 @@ class SignalFxMetricSink(MetricSink):
             "timestamp": m.timestamp * 1000,
             "dimensions": dims,
         }
-        api_key = self.per_tag_api_keys.get(vary_value, self.api_key)
+        if keys is None:
+            with self._keys_lock:
+                keys = self.per_tag_api_keys
+        api_key = keys.get(vary_value, self.api_key)
         return api_key, {kind: point}
 
     def flush(self, metrics: list[InterMetric]) -> None:
-        # group by API key (per-tag clients)
+        # group by API key (per-tag clients); snapshot the key map once —
+        # the refresh thread may swap entries mid-flush
+        with self._keys_lock:
+            keys = dict(self.per_tag_api_keys)
         by_key: dict[str, dict[str, list]] = {}
         for m in metrics:
-            conv = self._convert(m)
+            conv = self._convert(m, keys)
             if conv is None:
                 continue
             api_key, kinds = conv

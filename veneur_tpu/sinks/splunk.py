@@ -30,13 +30,29 @@ log = logging.getLogger("veneur_tpu.sinks.splunk")
 
 
 
+class _SNIHTTPSConnection(http.client.HTTPSConnection):
+    """HTTPS connection that validates the certificate against a
+    configured name instead of the dialed host (reference
+    splunk.go:111-113: tlsCfg.ServerName = validateServerName)."""
+
+    def __init__(self, *args, server_name: str = "", **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._server_name = server_name
+
+    def connect(self) -> None:
+        http.client.HTTPConnection.connect(self)
+        self.sock = self._context.wrap_socket(
+            self.sock, server_hostname=self._server_name or self.host)
+
+
 class _RotatingSession:
     """Keep-alive HTTP(S) connection that re-establishes itself after a
     jittered lifetime (reference connection lifetime jitter,
     sinks/splunk/splunk.go hecConnectionLifetimeJitter)."""
 
     def __init__(self, url: str, lifetime_s: float,
-                 jitter_s: float, timeout_s: float) -> None:
+                 jitter_s: float, timeout_s: float,
+                 server_name: str = "") -> None:
         parsed = urllib.parse.urlsplit(url)
         self.scheme = parsed.scheme
         self.host = parsed.hostname or "localhost"
@@ -45,15 +61,17 @@ class _RotatingSession:
         self.lifetime_s = lifetime_s
         self.jitter_s = jitter_s
         self.timeout_s = timeout_s
+        self.server_name = server_name
         self._conn: Optional[http.client.HTTPConnection] = None
         self._expires = 0.0
         self.rotations = 0
 
     def _connect(self) -> http.client.HTTPConnection:
         if self.scheme == "https":
-            conn = http.client.HTTPSConnection(
+            conn = _SNIHTTPSConnection(
                 self.host, self.port, timeout=self.timeout_s,
-                context=ssl.create_default_context())
+                context=ssl.create_default_context(),
+                server_name=self.server_name)
         else:
             conn = http.client.HTTPConnection(
                 self.host, self.port, timeout=self.timeout_s)
@@ -108,6 +126,7 @@ class SplunkSpanSink(SpanSink):
         send_timeout_s: float = 10.0,
         connection_lifetime_s: float = 60.0,
         connection_lifetime_jitter_s: float = 30.0,
+        tls_validate_hostname: str = "",
         opener=None,
     ) -> None:
         self.url = hec_address.rstrip("/") + "/services/collector/event"
@@ -119,6 +138,7 @@ class SplunkSpanSink(SpanSink):
         self.send_timeout_s = send_timeout_s
         self.connection_lifetime_s = connection_lifetime_s
         self.connection_lifetime_jitter_s = connection_lifetime_jitter_s
+        self.tls_validate_hostname = tls_validate_hostname
         self.opener = opener  # test injection; None = rotating sessions
         self.queue: "queue.Queue" = queue.Queue(maxsize=batch_size * 16)
         self.spans_flushed = 0
@@ -168,14 +188,20 @@ class SplunkSpanSink(SpanSink):
             self.spans_dropped += 1
             return
         try:
-            self.queue.put_nowait(span)
+            if self.ingest_timeout_s > 0:
+                # bounded wait before surrendering the span (reference
+                # ingestTimeout: block up to the timeout, then drop)
+                self.queue.put(span, timeout=self.ingest_timeout_s)
+            else:
+                self.queue.put_nowait(span)
         except queue.Full:
             self.spans_dropped += 1
 
     def _submit_loop(self) -> None:
         session = _RotatingSession(
             self.url, self.connection_lifetime_s,
-            self.connection_lifetime_jitter_s, self.send_timeout_s)
+            self.connection_lifetime_jitter_s, self.send_timeout_s,
+            server_name=self.tls_validate_hostname)
         batch: list[SSFSpan] = []
         last_send = time.time()
         while True:
