@@ -283,3 +283,30 @@ def test_example_configs_load_strict():
     assert cfg.interval == "10s"
     pcfg = load_proxy_config(os.path.join(root, "example_proxy.yaml"))
     assert pcfg is not None
+
+
+def test_emit_mode_specific_tags_and_span_times():
+    """Mode-specific tag flags and explicit span times (reference
+    cmd/veneur-emit/main.go: -e_event_tags/-sc_tags/-span_tags,
+    -span_starttime/-span_endtime)."""
+    from veneur_tpu.protocol import ssf_wire
+    from veneur_tpu.protocol.dogstatsd import parse_service_check
+
+    sock, port = _udp_receiver()
+    rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                    "-mode", "sc", "-sc_name", "db.ok", "-sc_status", "0",
+                    "-tag", "env:dev", "-sc_tags", "shard:3"])
+    assert rc == 0
+    sc = parse_service_check(sock.recv(4096))
+    assert sorted(sc.tags) == ["env:dev", "shard:3"]
+
+    rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}", "-ssf",
+                    "-name", "op", "-span_service", "svc",
+                    "-span_tags", "widget:a",
+                    "-span_starttime", "100", "-span_endtime", "101.5"])
+    assert rc == 0
+    span = ssf_wire.parse_ssf(sock.recv(65536))
+    assert span.tags.get("widget") == "a"
+    assert span.start_timestamp == 100 * 10**9
+    assert span.end_timestamp == int(101.5 * 10**9)
+    sock.close()

@@ -65,6 +65,30 @@ def _tag_arg_to_dict(tag_args: list[str]) -> dict[str, str]:
     return tags
 
 
+def _parse_time_ns(spec: str) -> int:
+    """Accepts unix seconds (int/float) or an ISO-8601 datetime
+    (reference -span_starttime/-span_endtime take free-form dates).
+    Raises SystemExit(2) with a usage message on unparseable input."""
+    try:
+        secs = float(spec)
+        if secs != secs or secs in (float("inf"), float("-inf")):
+            raise ValueError(spec)
+        return int(secs * 1e9)
+    except ValueError:
+        pass
+    import datetime
+
+    try:
+        dt = datetime.datetime.fromisoformat(spec)
+    except ValueError:
+        print(f"invalid time {spec!r}: pass unix seconds or an ISO-8601 "
+              "datetime", file=sys.stderr)
+        raise SystemExit(2) from None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return int(dt.timestamp() * 1e9)
+
+
 def build_statsd_lines(args, timing_ms=None) -> list[bytes]:
     tags = ""
     tag_map = _tag_arg_to_dict(args.tag)
@@ -101,6 +125,8 @@ def main(argv=None) -> int:
                         help="emit over SSF instead of statsd")
     parser.add_argument("-mode", default="metric",
                         choices=["metric", "event", "sc"])
+    parser.add_argument("-debug", action="store_true",
+                        help="print what gets emitted")
     # event fields
     parser.add_argument("-e_title", default="")
     parser.add_argument("-e_text", default="")
@@ -110,16 +136,26 @@ def main(argv=None) -> int:
     parser.add_argument("-e_priority", default="")
     parser.add_argument("-e_source_type", default="")
     parser.add_argument("-e_alert_type", default="")
+    parser.add_argument("-e_event_tags", default="",
+                        help="event-only tags, comma separated")
     # service-check fields
     parser.add_argument("-sc_name", default="")
     parser.add_argument("-sc_status", type=int, default=None)
     parser.add_argument("-sc_time", type=int, default=None)
     parser.add_argument("-sc_hostname", default="")
     parser.add_argument("-sc_msg", default="")
+    parser.add_argument("-sc_tags", default="",
+                        help="service-check-only tags, comma separated")
     # span fields (SSF mode)
     parser.add_argument("-trace_id", type=int, default=None)
     parser.add_argument("-parent_span_id", type=int, default=None)
     parser.add_argument("-span_service", default="veneur-emit")
+    parser.add_argument("-span_starttime", default="",
+                        help="span start (unix seconds or RFC3339)")
+    parser.add_argument("-span_endtime", default="",
+                        help="span end; same formats as -span_starttime")
+    parser.add_argument("-span_tags", default="",
+                        help="span-only tags, comma separated")
     parser.add_argument("-indicator", action="store_true")
     parser.add_argument("-error", action="store_true")
     parser.add_argument("-command", nargs=argparse.REMAINDER, default=None,
@@ -142,6 +178,13 @@ def main(argv=None) -> int:
         exit_code = proc.returncode
         cmd_error = exit_code != 0
 
+    def _emit_statsd(lines: list[bytes]) -> None:
+        if args.debug:
+            for ln in lines:
+                print(f"emitting: {ln.decode(errors='replace')}",
+                      file=sys.stderr)
+        _send_statsd(address, lines)
+
     if args.mode == "event":
         title, text = args.e_title, args.e_text
         packet = f"_e{{{len(title)},{len(text)}}}:{title}|{text}"
@@ -152,11 +195,12 @@ def main(argv=None) -> int:
         ]:
             if flag:
                 packet += f"|{prefix}{flag}"
-        tag_map = _tag_arg_to_dict(args.tag)
+        # global -tag applies everywhere; -e_event_tags only to the event
+        tag_map = _tag_arg_to_dict(args.tag + [args.e_event_tags])
         if tag_map:
             packet += "|#" + ",".join(
                 f"{k}:{v}" if v else k for k, v in tag_map.items())
-        _send_statsd(address, [packet.encode()])
+        _emit_statsd([packet.encode()])
         return exit_code
 
     if args.mode == "sc":
@@ -165,13 +209,13 @@ def main(argv=None) -> int:
             packet += f"|d:{args.sc_time}"
         if args.sc_hostname:
             packet += f"|h:{args.sc_hostname}"
-        tag_map = _tag_arg_to_dict(args.tag)
+        tag_map = _tag_arg_to_dict(args.tag + [args.sc_tags])
         if tag_map:
             packet += "|#" + ",".join(
                 f"{k}:{v}" if v else k for k, v in tag_map.items())
         if args.sc_msg:
             packet += f"|m:{args.sc_msg}"
-        _send_statsd(address, [packet.encode()])
+        _emit_statsd([packet.encode()])
         return exit_code
 
     if args.ssf:
@@ -180,12 +224,19 @@ def main(argv=None) -> int:
         span = ssf.SSFSpan(
             trace_id=trace_id, id=span_id,
             parent_id=args.parent_span_id or 0,
-            start_timestamp=start_ns, end_timestamp=time.time_ns(),
+            start_timestamp=(_parse_time_ns(args.span_starttime)
+                             if args.span_starttime else start_ns),
+            end_timestamp=(_parse_time_ns(args.span_endtime)
+                           if args.span_endtime else time.time_ns()),
             error=args.error or cmd_error,
             service=args.span_service, name=args.name or "veneur-emit",
             indicator=args.indicator,
-            tags=_tag_arg_to_dict(args.tag),
+            tags=_tag_arg_to_dict(args.tag + [args.span_tags]),
         )
+        if args.debug:
+            print(f"emitting span: trace_id={span.trace_id} "
+                  f"id={span.id} service={span.service} "
+                  f"tags={span.tags}", file=sys.stderr)
         tag_map = _tag_arg_to_dict(args.tag)
         if args.count is not None:
             span.metrics.append(ssf.count(args.name, args.count, tag_map))
@@ -208,7 +259,7 @@ def main(argv=None) -> int:
         print("nothing to emit: pass -count/-gauge/-timing/-set or -command",
               file=sys.stderr)
         return exit_code or 1
-    _send_statsd(address, lines)
+    _emit_statsd(lines)
     return exit_code
 
 

@@ -109,6 +109,8 @@ class MetricExtractionSink:
         self.objective_timer_name = objective_timer_name
         self.uniqueness_rate = uniqueness_rate
         self.invalid_samples = 0
+        # ingest runs concurrently under num_span_workers > 1
+        self._stats_lock = threading.Lock()
 
     def name(self) -> str:
         return "metric_extraction"
@@ -118,12 +120,15 @@ class MetricExtractionSink:
 
     def ingest(self, span: ssf.SSFSpan) -> None:
         metrics, invalid = convert_metrics(span)
-        self.invalid_samples += invalid
+        if invalid:
+            with self._stats_lock:
+                self.invalid_samples += invalid
         try:
             metrics.extend(convert_indicator_metrics(
                 span, self.indicator_timer_name, self.objective_timer_name))
         except ParseError:
-            self.invalid_samples += 1
+            with self._stats_lock:
+                self.invalid_samples += 1
         if self.uniqueness_rate > 0:
             metrics.extend(
                 convert_span_uniqueness_metrics(span, self.uniqueness_rate))

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 from typing import Optional, Protocol
 
 from veneur_tpu.core.metrics import InterMetric
@@ -61,8 +62,6 @@ def default_producer(broker: str, retry_max: int = 3,
                 avail or all_parts))
     prod = KafkaProducer(bootstrap_servers=broker, retries=retry_max,
                          acks=acks, **kwargs)
-
-    import threading
 
     class _Wrap:
         def __init__(self) -> None:
@@ -141,6 +140,8 @@ class KafkaSpanSink(SpanSink):
         self.sample_tag = sample_tag
         self.spans_flushed = 0
         self.spans_dropped = 0
+        # ingest runs concurrently under num_span_workers > 1
+        self._stats_lock = threading.Lock()
 
     def name(self) -> str:
         return "kafka"
@@ -151,7 +152,8 @@ class KafkaSpanSink(SpanSink):
             unit = (span.tags.get(self.sample_tag, "")
                     if self.sample_tag else str(span.trace_id))
             if (hash(unit) % 10000) >= self.sample_rate_percent * 100:
-                self.spans_dropped += 1
+                with self._stats_lock:
+                    self.spans_dropped += 1
                 return
         if self.serialization == "json":
             value = json.dumps({
@@ -168,9 +170,11 @@ class KafkaSpanSink(SpanSink):
             self.producer.send(self.span_topic,
                                key=str(span.trace_id).encode("ascii"),
                                value=value)
-            self.spans_flushed += 1
+            with self._stats_lock:
+                self.spans_flushed += 1
         except Exception as e:
-            self.spans_dropped += 1
+            with self._stats_lock:
+                self.spans_dropped += 1
             log.warning("kafka span produce failed: %s", e)
 
     def flush(self) -> None:

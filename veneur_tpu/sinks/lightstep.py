@@ -43,10 +43,12 @@ class LightStepSpanSink(SpanSink):
         self.opener = opener
         self.transport = transport or self._http_report
         # per-client span buffers; ingest may run from several span
-        # workers concurrently (num_span_workers), so buffer mutation
-        # and the cap check share one lock
+        # workers concurrently (num_span_workers). One lock per client:
+        # spans hash to disjoint buffers, so cross-client ingest never
+        # contends
         self._buffers: list[list[dict]] = [[] for _ in range(self.num_clients)]
-        self._lock = threading.Lock()
+        self._locks = [threading.Lock() for _ in range(self.num_clients)]
+        self._drop_lock = threading.Lock()
         self.spans_flushed = 0
         self.spans_dropped = 0
         self.flush_errors = 0
@@ -57,10 +59,11 @@ class LightStepSpanSink(SpanSink):
     def ingest(self, span: SSFSpan) -> None:
         # one trace → one client (reference round-robins on trace id)
         client = span.trace_id % self.num_clients
-        with self._lock:
+        with self._locks[client]:
             buf = self._buffers[client]
             if len(buf) >= self.maximum_spans // self.num_clients:
-                self.spans_dropped += 1
+                with self._drop_lock:
+                    self.spans_dropped += 1
                 return
             buf.append(self._convert(span))
 
@@ -83,7 +86,7 @@ class LightStepSpanSink(SpanSink):
 
     def flush(self) -> None:
         for client in range(self.num_clients):
-            with self._lock:
+            with self._locks[client]:
                 buf = self._buffers[client]
                 if not buf:
                     continue

@@ -46,6 +46,11 @@ class SignalFxMetricSink(MetricSink):
         self.hostname_tag = hostname_tag or "host"
         self.endpoint_base = endpoint_base.rstrip("/")
         self.per_tag_api_keys = dict(per_tag_api_keys or {})
+        # statically-configured entries survive dynamic refresh; entries
+        # absent from a successful token fetch are otherwise dropped so a
+        # revoked token stops being used (the reference rebuilds the
+        # client map from each poll)
+        self._static_keys = dict(per_tag_api_keys or {})
         self.vary_key_by = vary_key_by
         self.name_drops = metric_name_prefix_drops or []
         self.tag_drops = metric_tag_prefix_drops or []
@@ -97,10 +102,14 @@ class SignalFxMetricSink(MetricSink):
         try:
             keys = self.fetch_api_keys()
         except Exception as e:
+            # failure keeps the last-good key set
             log.warning("signalfx token refresh failed: %s", e)
             return
         with self._keys_lock:
-            self.per_tag_api_keys.update(keys)
+            # fetched tokens override static config (the reference
+            # overwrites the client per fetched token); dynamic entries
+            # absent from this poll drop, static ones remain as fallback
+            self.per_tag_api_keys = {**self._static_keys, **keys}
         self.key_refreshes += 1
 
     def start(self, trace_client=None) -> None:
@@ -109,6 +118,9 @@ class SignalFxMetricSink(MetricSink):
             return
 
         def loop():
+            # fetch immediately: per-tag routing should not wait a full
+            # period after startup
+            self.refresh_keys_once()
             while not self._refresh_stop.wait(
                     self.dynamic_key_refresh_period_s):
                 self.refresh_keys_once()
