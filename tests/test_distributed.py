@@ -523,3 +523,43 @@ def test_forward_client_idle_timeout_option():
         client.close()
     finally:
         server.stop(grace=0.5)
+
+
+def test_http_import_rejects_bad_bodies():
+    """Import body validation mirrors the reference http tests:
+    gzip encoding, empty bodies, empty lists, and junk entries are 400s
+    (TestServerImportGzip / TestServerImportEmpty*Error)."""
+    import gzip as _gzip
+    import urllib.error
+    import urllib.request
+
+    from veneur_tpu.distributed.import_server import ImportHTTPServer
+
+    class _Imp:
+        server = None
+
+        def handle_batch(self, batch):
+            pass
+
+    front = ImportHTTPServer(_Imp())
+    port = front.start("127.0.0.1", 0)
+    url = f"http://127.0.0.1:{port}/import"
+
+    def post(body, encoding=""):
+        req = urllib.request.Request(url, data=body, method="POST")
+        if encoding:
+            req.add_header("Content-Encoding", encoding)
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    try:
+        assert post(_gzip.compress(b"[]"), "gzip") == 400
+        assert post(b"") == 400
+        assert post(b"[]") == 400
+        assert post(b'[{"Bad": "Foo"}, {"Bad": "Bar"}]') == 400
+        assert post(b"{}") == 400
+    finally:
+        front.stop()

@@ -36,6 +36,9 @@ class ImportServer:
         self.port: Optional[int] = None
         self.received_metrics = 0
         self.import_errors = 0
+        # concurrent imports (one thread per HTTP request + gRPC handlers)
+        # hold different worker locks; the tallies need their own
+        self._stats_lock = threading.Lock()
 
     def handle_batch(self, batch: pb.MetricBatch) -> None:
         started = time.time()
@@ -46,15 +49,19 @@ class ImportServer:
         for m in batch.metrics:
             i = codec.routing_digest(m) % len(workers)
             chunks.setdefault(i, []).append(m)
+        received = errors = 0
         for i, metrics in chunks.items():
             with locks[i]:
                 for m in metrics:
                     try:
                         codec.apply_to_worker(workers[i], m)
-                        self.received_metrics += 1
+                        received += 1
                     except ValueError as e:
-                        self.import_errors += 1
+                        errors += 1
                         log.debug("rejected import %s: %s", m.name, e)
+        with self._stats_lock:
+            self.received_metrics += received
+            self.import_errors += errors
         stats = getattr(self.server, "stats", None)
         if stats is not None:
             # canonical import telemetry (README.md:295: the merge part
@@ -86,16 +93,31 @@ def decode_http_import_body(body: bytes, content_encoding: str
     """
     if content_encoding == "deflate":
         body = zlib.decompress(body)
+    elif content_encoding:
+        # reference returns 400 for any other encoding (gzip included,
+        # TestServerImportGzip)
+        raise ValueError(f"unsupported Content-Encoding {content_encoding!r}")
+    if not body:
+        raise ValueError("empty import body")
     if body[:1] in (b"[", b"{"):
         import base64
 
         items = json.loads(body.decode("utf-8"))
+        if not isinstance(items, list) or not items:
+            # an empty list is usually the sign of a client bug
+            # (TestServerImportEmptyListError)
+            raise ValueError("import body must be a non-empty metric list")
         batch = pb.MetricBatch()
         for item in items:
+            if "value" not in item:
+                raise ValueError("metric entry lacks a value field")
             m = pb.Metric.FromString(base64.b64decode(item["value"]))
             batch.metrics.append(m)
         return batch
-    return pb.MetricBatch.FromString(body)
+    batch = pb.MetricBatch.FromString(body)
+    if not batch.metrics:
+        raise ValueError("import batch contains no metrics")
+    return batch
 
 
 class ImportHTTPServer:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import zlib
 from typing import Optional, Protocol
 
 from veneur_tpu.core.metrics import InterMetric
@@ -148,10 +149,14 @@ class KafkaSpanSink(SpanSink):
 
     def ingest(self, span: SSFSpan) -> None:
         if self.sample_rate_percent < 100.0:
-            # hash the sampling unit (a tag value, or the trace id)
+            # hash the sampling unit (a tag value, or the trace id) with a
+            # process-independent hash: the keep/drop decision for a unit
+            # must agree across instances and restarts (the reference
+            # fnv-hashes the tag value, sinks/kafka/kafka.go)
             unit = (span.tags.get(self.sample_tag, "")
                     if self.sample_tag else str(span.trace_id))
-            if (hash(unit) % 10000) >= self.sample_rate_percent * 100:
+            if (zlib.crc32(unit.encode()) % 10000) >= (
+                    self.sample_rate_percent * 100):
                 with self._stats_lock:
                     self.spans_dropped += 1
                 return
