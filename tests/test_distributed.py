@@ -563,3 +563,26 @@ def test_http_import_rejects_bad_bodies():
         assert post(b"{}") == 400
     finally:
         front.stop()
+
+
+def test_forward_telemetry_includes_content_length():
+    """Canonical forward telemetry (README.md:284-288) includes the POST
+    body size histogram forward.content_length_bytes."""
+    from veneur_tpu import scopedstatsd
+
+    gsrv, imp, port = _global_server()
+    try:
+        local = _local_server(port)
+        cap = scopedstatsd.CaptureSender()
+        local.forwarder.stats = scopedstatsd.ScopedClient(
+            cap, namespace="veneur.")
+        _ingest_histo(local, "ct.lat", [1.0, 2.0, 3.0])
+        qs = device_quantiles(PCTS, AGGS)
+        snaps = [w.flush(qs, 10.0) for w in local.workers]
+        local.forwarder(snaps)
+        lines = "\n".join(cap.lines)
+        assert "veneur.forward.post_metrics_total" in lines
+        assert "veneur.forward.duration_ns" in lines
+        assert "veneur.forward.content_length_bytes" in lines
+    finally:
+        imp.stop()

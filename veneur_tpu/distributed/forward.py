@@ -25,14 +25,19 @@ log = logging.getLogger("veneur_tpu.forward")
 
 
 def _report_forward(stats, n_metrics: int, started: float,
-                    cause: Optional[str]) -> None:
+                    cause: Optional[str],
+                    content_length: Optional[int] = None) -> None:
     """Canonical forwarding telemetry (README.md:268-269,284-288:
-    forward.post_metrics_total / duration_ns / error_total+cause)."""
+    forward.post_metrics_total / duration_ns / error_total+cause /
+    content_length_bytes)."""
     if stats is None:
         return
     stats.count("forward.post_metrics_total", n_metrics)
     stats.time_in_nanoseconds("forward.duration_ns",
                               (time.time() - started) * 1e9)
+    if content_length is not None:
+        stats.histogram("forward.content_length_bytes",
+                        float(content_length))
     if cause:
         stats.count("forward.error_total", 1, tags=[f"cause:{cause}"])
 
@@ -64,7 +69,8 @@ class GRPCForwarder:
                 self.client.address, self.client.errors,
             )
         _report_forward(self.stats, len(batch.metrics), started,
-                        None if ok else self.client.last_error_cause)
+                        None if ok else self.client.last_error_cause,
+                        content_length=batch.ByteSize())
 
     def close(self) -> None:
         self.client.close()
@@ -128,7 +134,8 @@ class HTTPForwarder:
                 span.set_error()
             log.warning("http forward to %s failed: %s", self.url, e)
         finally:
-            _report_forward(self.stats, len(items), started, cause)
+            _report_forward(self.stats, len(items), started, cause,
+                            content_length=len(body))
             if span is not None:
                 span.finish()
 

@@ -358,7 +358,8 @@ class CompatForwarder:
             cause = "send"
             log.exception("compat forward to %s failed", self.address)
         finally:
-            _report_forward(self.stats, len(out.metrics), started, cause)
+            _report_forward(self.stats, len(out.metrics), started, cause,
+                            content_length=out.ByteSize())
 
     def close(self) -> None:
         self.channel.close()
