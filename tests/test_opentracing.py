@@ -207,12 +207,24 @@ def test_proxy_import_hop_continues_trace_and_ring_routes_span():
     front = ProxyHTTPServer(proxy, trace_proxy=tp)
     port = front.start()
     try:
+        import base64
+        import json as _json
+
+        from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+        m = pb.Metric(name="hop.count", kind=pb.KIND_COUNTER)
+        m.counter.value = 1
+        body = _json.dumps([{
+            "name": m.name, "type": "counter", "tags": [],
+            "value": base64.b64encode(m.SerializeToString()).decode(),
+        }]).encode()
+
         t = ot.Tracer()
         parent = t.start_span("origin")
         headers = {"Content-Type": "application/json"}
         t.inject_header(parent.context(), headers)
         req = urllib.request.Request(
-            f"http://127.0.0.1:{port}/import", data=b"[]",
+            f"http://127.0.0.1:{port}/import", data=body,
             method="POST", headers=headers)
         with urllib.request.urlopen(req, timeout=5) as resp:
             assert resp.status == 200
