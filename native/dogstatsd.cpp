@@ -139,6 +139,11 @@ uint64_t metro_hash64(std::string_view s, uint64_t seed) {
 bool parse_value_slow(std::string_view s, double* out) {
   for (char c : s) {
     if (c == '_' || std::isspace(static_cast<unsigned char>(c))) return false;
+    // strtod accepts C hex floats ("0x1f"); the Python parser rejects
+    // them all, and Go's ParseFloat rejects the p-less form ("0x1f")
+    // while accepting "0x1p3" — a form no statsd client emits, so
+    // rejecting every hex literal keeps the two in-repo parsers exact
+    if (c == 'x' || c == 'X') return false;
   }
   std::string buf(s);
   char* end = nullptr;

@@ -490,3 +490,44 @@ def test_ingest_ssf_many_empty_frame_is_error():
                             indicator=True)
     ok, errs, fallbacks = ni.ingest_ssf_many([b"", good], b"i", b"o")
     assert (ok, errs, fallbacks) == (1, 1, [])
+
+
+def test_parser_parity_fuzz():
+    """Seeded random fuzz over generated + mutated DogStatsD lines: the
+    C++ and Python parsers must agree on accept/reject for every input
+    (the property behind parser_test.go's exhaustive malformed table,
+    checked over a much wider space)."""
+    import random
+
+    rng = random.Random(0xC0FFEE)
+    types = [b"c", b"g", b"ms", b"h", b"d", b"s", b"zz", b""]
+    names = [b"a.b.c", b"x", b"", b"with space", b"uni\xc3\xa9"]
+    values = [b"1", b"2.5", b"-3", b"+4", b"1e3", b"nan", b"bar", b"",
+              b"0x1f", b"1_0"]
+    rates = [b"", b"|@0.5", b"|@1", b"|@0", b"|@2", b"|@x"]
+    tagsets = [b"", b"|#a:1", b"|#b:2,a:1", b"|#veneurlocalonly",
+               b"|#veneursinkonly:kafka", b"|#", b"|#a:1|#b:2"]
+
+    ni = native_mod.NativeIngest()
+    checked = 0
+    for _ in range(2500):
+        line = (rng.choice(names) + b":" + rng.choice(values) + b"|"
+                + rng.choice(types) + rng.choice(rates)
+                + rng.choice(tagsets))
+        if rng.random() < 0.3 and line:
+            # byte-level mutation
+            pos = rng.randrange(len(line))
+            line = (line[:pos]
+                    + bytes([rng.randrange(33, 127)])
+                    + line[pos + 1:])
+        try:
+            parse_metric(line)
+            py_ok = True
+        except ParseError:
+            py_ok = False
+        before = ni.processed
+        ni.ingest(line)
+        native_ok = ni.processed > before
+        assert native_ok == py_ok, line
+        checked += 1
+    assert checked == 2500

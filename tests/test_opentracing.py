@@ -233,6 +233,12 @@ def test_proxy_import_hop_continues_trace_and_ring_routes_span():
         assert span.name == "veneur.proxy"
         assert span.trace_id == parent.span.trace_id
         assert span.parent_id == parent.span.id
+        # the body's metric was decoded and ring-routed (to the
+        # unreachable destination, where it counts as a drop)
+        deadline = time.time() + 5.0
+        while proxy.drops < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert proxy.drops == 1
     finally:
         front.stop()
         tp.stop()

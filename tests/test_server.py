@@ -512,6 +512,9 @@ def test_flush_ingest_soak_no_loss_no_crash():
         while flushes < 3 and time.time() < deadline:
             srv.flush()
             flushes += 1
+        if flushes < 3:
+            pytest.fail(f"only {flushes} flushes completed inside the 30s "
+                        "cap: runner too slow to race epoch boundaries")
         stop.set()
         for t in threads:
             t.join(5.0)
@@ -532,7 +535,6 @@ def test_flush_ingest_soak_no_loss_no_crash():
         while not sink.queue.empty():
             got += sum(m.value for m in sink.queue.get_nowait()
                        if m.name == "soak.count")
-        assert flushes >= 3
         assert sum(sent) > 0 and total_ingested > 0
         assert got == total_ingested, (got, total_ingested, flushes)
     finally:
