@@ -539,3 +539,35 @@ def test_flush_ingest_soak_no_loss_no_crash():
         assert got == total_ingested, (got, total_ingested, flushes)
     finally:
         srv.shutdown()
+
+
+def test_flush_is_self_traced():
+    """Every flush emits an internal span that rejoins the server's own
+    span pipeline (reference flusher.go:29 StartSpan("flush") via the
+    internal SpanChan client, server.go:310-317)."""
+    captured = []
+
+    class _CapSpanSink:
+        def name(self):
+            return "cap"
+
+        def start(self, trace_client=None):
+            pass
+
+        def ingest(self, span):
+            captured.append(span)
+
+        def flush(self):
+            pass
+
+    srv, sink, ports = _server(interval="600s")
+    try:
+        srv.span_worker.span_sinks.append(_CapSpanSink())
+        srv.flush()
+        assert _wait_for(
+            lambda: any(s.name == "flush" for s in captured))
+        span = [s for s in captured if s.name == "flush"][0]
+        assert span.service == "veneur-tpu"
+        assert span.end_timestamp > span.start_timestamp
+    finally:
+        srv.shutdown()

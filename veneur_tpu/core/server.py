@@ -812,7 +812,16 @@ class Server:
                 log.exception("flush failed")
 
     def flush(self) -> list[InterMetric]:
-        """One flush pass (reference Server.Flush, flusher.go:28-134)."""
+        """One flush pass (reference Server.Flush, flusher.go:28-134).
+
+        Self-traced: every flush is a span (reference
+        tracer.StartSpan("flush"), flusher.go:29) that rejoins this
+        server's own span pipeline and surfaces as derived metrics on
+        the NEXT interval."""
+        with self.tracer.start_span("flush"):
+            return self._flush_inner()
+
+    def _flush_inner(self) -> list[InterMetric]:
         flush_start = time.time()
         self.last_flush_unix = flush_start
         self.flush_count += 1
