@@ -427,3 +427,57 @@ def test_span_worker_multiple_consumers():
     w.stop()
     assert sorted(seen) == list(range(200))
     assert w.spans_ingested == 200
+
+
+def test_wedged_sink_does_not_stall_others():
+    """Per-sink lanes (the reference's per-span 9s sink-ingest timeout,
+    worker.go:612,650-688): a sink whose ingest wedges loses its own
+    spans while the healthy sink keeps receiving everything."""
+    import threading
+    import time as _time
+
+    from veneur_tpu.core.spans import SpanWorker
+
+    gate = threading.Event()
+    healthy = []
+
+    class Wedged:
+        def name(self):
+            return "wedged"
+
+        def ingest(self, span):
+            gate.wait(30.0)
+
+        def flush(self):
+            pass
+
+    class Healthy:
+        def name(self):
+            return "healthy"
+
+        def ingest(self, span):
+            healthy.append(span.id)
+
+        def flush(self):
+            pass
+
+    w = SpanWorker([Wedged(), Healthy()], capacity=8,
+                   sink_timeout_s=0.2, workers=1)
+    w.start()
+    try:
+        n = 40
+        for i in range(n):
+            w.ingest(_span(id=i + 1))
+            _time.sleep(0.01)  # let the worker fan out each span
+        deadline = _time.time() + 10.0
+        while len(healthy) < n and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert len(healthy) == n  # healthy sink got every span
+        # the wedged sink's lane overflowed; once its consumer had been
+        # stuck past sink_timeout_s, overflow counts as ingest timeouts
+        assert (w.lane_drops.get("wedged", 0)
+                + w.ingest_timeouts.get("wedged", 0)) > 0
+        assert w.ingest_timeouts.get("wedged", 0) > 0
+    finally:
+        gate.set()
+        w.stop()

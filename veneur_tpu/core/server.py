@@ -940,6 +940,31 @@ class Server:
         self.stats.count("packet.error_total",
                          errors_now - self._errors_reported)
         self._errors_reported = errors_now
+        # span-pipeline counters (reference worker.go:688,716-717:
+        # ingest_timeout_total per sink, hit_chan_cap for channel drops)
+        for name, total in list(self.span_worker.ingest_timeouts.items()):
+            key = ("__span_worker__", f"timeout:{name}")
+            delta = total - self._span_sink_reported.get(key, 0)
+            self._span_sink_reported[key] = total
+            if delta:
+                self.stats.count("worker.span.ingest_timeout_total", delta,
+                                 tags=[f"sink:{name}"])
+        for name, total in list(self.span_worker.lane_drops.items()):
+            key = ("__span_worker__", f"lane:{name}")
+            delta = total - self._span_sink_reported.get(key, 0)
+            self._span_sink_reported[key] = total
+            if delta:
+                # burst overflow of a sink's lane (no reference analog:
+                # upstream blocks per span instead; this is the
+                # loss-over-stall counterpart)
+                self.stats.count("worker.span.lane_drop_total", delta,
+                                 tags=[f"sink:{name}"])
+        key = ("__span_worker__", "chan_cap")
+        delta = self.span_worker.spans_dropped - self._span_sink_reported.get(
+            key, 0)
+        self._span_sink_reported[key] = self.span_worker.spans_dropped
+        if delta:
+            self.stats.count("worker.span.hit_chan_cap", delta)
         # span-sink delta counters (reference sinks/sinks.go:60-78;
         # sinks track cumulative attributes, telemetry reports deltas)
         for sink in self.span_sinks:

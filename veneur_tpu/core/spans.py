@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 from veneur_tpu import ssf
@@ -139,8 +140,86 @@ class MetricExtractionSink:
         pass
 
 
+class _SinkLane:
+    """One consumer thread + bounded queue per span sink.
+
+    The isolation guarantee behind the reference's per-span 9s sink
+    timeout (worker.go:612,650-688: ingest in a goroutine, stop waiting
+    after the timeout): a wedged sink fills its own lane and loses spans
+    (loss-over-stall) while every other sink keeps flowing — without a
+    thread per (span, sink)."""
+
+    def __init__(self, sink, capacity: int, consumers: int = 1) -> None:
+        self.sink = sink
+        self.q: "queue.Queue" = queue.Queue(capacity)
+        self.consumers = max(1, consumers)
+        # monotonic start of the oldest in-flight ingest; 0 when every
+        # consumer is idle (approximation: last consumer to start wins,
+        # good enough for the is-it-stuck classification)
+        self.busy_since = 0.0
+        self.errors = 0
+        self._err_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        for i in range(self.consumers):
+            t = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"span-sink-{self.sink.name()}-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def put(self, span) -> bool:
+        try:
+            self.q.put_nowait(span)
+            return True
+        except queue.Full:
+            return False
+
+    def take_errors(self) -> int:
+        with self._err_lock:
+            n = self.errors
+            self.errors = 0
+        return n
+
+    def _run(self) -> None:
+        while True:
+            span = self.q.get()
+            if span is None:
+                return
+            self.busy_since = time.monotonic()
+            try:
+                self.sink.ingest(span)
+            except Exception as e:
+                with self._err_lock:
+                    self.errors += 1
+                log.debug("span sink %s ingest failed: %s",
+                          self.sink.name(), e)
+            finally:
+                self.busy_since = 0.0
+
+    def stop(self) -> None:
+        # sentinel delivery must not block on a full lane (the lane being
+        # full of a wedged sink's spans is exactly the shutdown scenario
+        # this design survives): make room by discarding queued spans —
+        # per-flush span data is expendable at shutdown
+        for _ in self._threads:
+            while True:
+                try:
+                    self.q.put_nowait(None)
+                    break
+                except queue.Full:
+                    try:
+                        self.q.get_nowait()
+                    except queue.Empty:
+                        pass
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+
+
 class SpanWorker:
-    """Fans ingested spans out to every span sink
+    """Fans ingested spans out to every span sink through per-sink lanes
     (reference SpanWorker.Work, worker.go:611-695)."""
 
     def __init__(self, span_sinks: list, common_tags: Optional[dict] = None,
@@ -149,15 +228,22 @@ class SpanWorker:
         self.span_sinks = span_sinks
         self.common_tags = common_tags or {}
         self.chan: "queue.Queue[Optional[ssf.SSFSpan]]" = queue.Queue(capacity)
+        self.capacity = capacity
         self.sink_timeout_s = sink_timeout_s
         self.spans_ingested = 0
         self.spans_dropped = 0
         self.sink_errors: dict[str, int] = {}
+        # per-sink lane-full drops, split by whether the sink's consumer
+        # had been stuck past sink_timeout_s (the reference's
+        # worker.span.ingest_timeout_total vs a plain burst overflow)
+        self.lane_drops: dict[str, int] = {}
+        self.ingest_timeouts: dict[str, int] = {}
         # N consumers off one channel (reference num_span_workers,
         # server.go:842-850)
         self.workers = max(1, workers)
         self._threads: list[threading.Thread] = []
         self._stats_lock = threading.Lock()
+        self._lanes: dict[int, _SinkLane] = {}
 
     def ingest(self, span: ssf.SSFSpan) -> None:
         """Non-blocking enqueue; drops when full (backpressure policy of
@@ -166,6 +252,21 @@ class SpanWorker:
             self.chan.put_nowait(span)
         except queue.Full:
             self.spans_dropped += 1
+
+    def _lane_for(self, sink) -> _SinkLane:
+        lane = self._lanes.get(id(sink))
+        if lane is None:
+            with self._stats_lock:
+                lane = self._lanes.get(id(sink))
+                if lane is None:
+                    # as many consumers as span workers, so a sink that
+                    # scaled with num_span_workers before lanes existed
+                    # still does (sinks must stay ingest-thread-safe)
+                    lane = _SinkLane(sink, self.capacity,
+                                     consumers=self.workers)
+                    lane.start()
+                    self._lanes[id(sink)] = lane
+        return lane
 
     def start(self) -> None:
         for i in range(self.workers):
@@ -179,6 +280,8 @@ class SpanWorker:
             self.chan.put(None)
         for t in self._threads:
             t.join(timeout=5)
+        for lane in list(self._lanes.values()):
+            lane.stop()
 
     def work(self) -> None:
         while True:
@@ -190,17 +293,30 @@ class SpanWorker:
             # common tags fill in missing span tags (worker.go:627-634)
             for k, v in self.common_tags.items():
                 span.tags.setdefault(k, v)
-            for sink in self.span_sinks:
-                try:
-                    sink.ingest(span)
-                except Exception as e:
-                    with self._stats_lock:
-                        self.sink_errors[sink.name()] = (
-                            self.sink_errors.get(sink.name(), 0) + 1)
-                    log.debug("span sink %s ingest failed: %s",
-                              sink.name(), e)
+            for sink in list(self.span_sinks):
+                lane = self._lane_for(sink)
+                if lane.put(span):
+                    continue
+                busy = lane.busy_since
+                name = sink.name()
+                with self._stats_lock:
+                    if (busy and time.monotonic() - busy
+                            > self.sink_timeout_s):
+                        self.ingest_timeouts[name] = (
+                            self.ingest_timeouts.get(name, 0) + 1)
+                    else:
+                        self.lane_drops[name] = (
+                            self.lane_drops.get(name, 0) + 1)
 
     def flush(self) -> None:
+        # fold lane-level ingest errors into the per-sink error tally
+        with self._stats_lock:
+            for lane in list(self._lanes.values()):
+                n = lane.take_errors()
+                if n:
+                    name = lane.sink.name()
+                    self.sink_errors[name] = (
+                        self.sink_errors.get(name, 0) + n)
         for sink in self.span_sinks:
             try:
                 sink.flush()
