@@ -10,6 +10,7 @@ method path.
 """
 
 import struct
+import time
 
 import grpc
 import numpy as np
@@ -390,3 +391,195 @@ def test_unknown_metric_type_skipped_not_fatal():
         assert by_key[("ok.c", MetricType.COUNTER)].value == 3.0
     finally:
         imp.stop()
+
+
+# ---------------------------------------------------------------------------
+# Legacy HTTP v1 (JSONMetric + gob) interop
+
+
+REF_TESTDATA = "/root/reference/testdata"
+
+
+@pytest.mark.parametrize("fixture,encoding", [
+    ("import.uncompressed", ""),
+    ("import.deflate", "deflate"),
+])
+def test_go_http_import_fixture_merges(fixture, encoding):
+    """The reference's own /import golden bodies (http_test.go
+    TestServerImportCompressed/Uncompressed) decode into a correct digest
+    merge: a real Go-gob MergingDigest lands in our global's pool."""
+    import os
+    import urllib.request
+
+    path = os.path.join(REF_TESTDATA, fixture)
+    if not os.path.exists(path):
+        pytest.skip("reference testdata unavailable")
+    body = open(path, "rb").read()
+
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.flusher import (
+        device_quantiles, generate_inter_metrics,
+    )
+    from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.distributed.import_server import (
+        ImportHTTPServer, ImportServer,
+    )
+
+    srv = Server(Config(interval="10s", percentiles=[0.5]))
+    imp = ImportServer(srv)
+    front = ImportHTTPServer(imp)
+    port = front.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/import", data=body, method="POST")
+        if encoding:
+            req.add_header("Content-Encoding", encoding)
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+        deadline = time.time() + 5
+        while imp.received_metrics < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert imp.received_metrics == 1
+
+        aggs = HistogramAggregates.from_names(["min", "max", "count"])
+        qs = device_quantiles([0.5], aggs)
+        metrics = []
+        for w in srv.workers:
+            snap = w.flush(qs, 10.0)
+            metrics.extend(generate_inter_metrics(snap, False, [0.5], aggs))
+        by_key = {(m.name, m.type): m for m in metrics}
+        # fixture digest: centroids (1,2,7,8,100) each weight 1. A global
+        # emits ONLY percentiles for mixed-scope histos (the local that
+        # forwarded already emitted min/max/count — flusher.go:61-74)
+        p50 = by_key[("a.b.c.50percentile", MetricType.GAUGE)].value
+        assert 2.0 <= p50 <= 8.0
+        assert ("a.b.c.min", MetricType.GAUGE) not in by_key
+        assert ("a.b.c.count", MetricType.COUNTER) not in by_key
+    finally:
+        front.stop()
+        imp.stop()
+
+
+def test_go_jsonmetric_roundtrip_all_types():
+    """internal → Go JSONMetric → internal preserves every value kind
+    (counter int64, gauge f64, set HLL registers, digest centroids)."""
+    import numpy as np
+
+    from veneur_tpu.distributed.interop import (
+        go_jsonmetric_to_internal, internal_to_go_jsonmetric,
+    )
+    from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+    c = pb.Metric(name="c", kind=pb.KIND_COUNTER, tags=["a:1"])
+    c.counter.value = -42
+    g = pb.Metric(name="g", kind=pb.KIND_GAUGE)
+    g.gauge.value = 2.5
+    h = pb.Metric(name="h", kind=pb.KIND_HISTOGRAM)
+    h.digest.centroids.means.extend([1.0, 5.0, 9.0])
+    h.digest.centroids.weights.extend([2.0, 1.0, 4.0])
+    h.digest.min, h.digest.max = 1.0, 9.0
+    h.digest.reciprocal_sum = 0.5
+    h.digest.compression = 100.0
+    s = pb.Metric(name="s", kind=pb.KIND_SET)
+    regs = np.zeros(1 << 14, np.int8)
+    regs[7] = 3
+    regs[100] = 1
+    s.hll.registers = regs.tobytes()
+    s.hll.precision = 14
+
+    for m in (c, g, h, s):
+        item = internal_to_go_jsonmetric(m)
+        back = go_jsonmetric_to_internal(item)
+        assert back.name == m.name
+        assert list(back.tags) == list(m.tags)
+        which = m.WhichOneof("value")
+        if which == "counter":
+            assert back.counter.value == -42
+            assert back.scope == pb.SCOPE_GLOBAL  # import scope fixup
+        elif which == "gauge":
+            assert back.gauge.value == 2.5
+        elif which == "digest":
+            assert list(back.digest.centroids.means) == [1.0, 5.0, 9.0]
+            assert list(back.digest.centroids.weights) == [2.0, 1.0, 4.0]
+            assert back.digest.reciprocal_sum == 0.5
+        else:
+            got = np.frombuffer(back.hll.registers, np.int8)
+            assert got[7] == 3 and got[100] == 1 and got.sum() == 4
+
+
+def test_jsonmetric_http_forward_end_to_end():
+    """forward_format: jsonmetric — a veneur-tpu local posts legacy
+    JSONMetric bodies; the global's /import (which also accepts stock Go
+    veneur bodies) merges them. Full e2e over real HTTP."""
+    from veneur_tpu.distributed.forward import install_forwarder
+    from veneur_tpu.distributed.import_server import (
+        ImportHTTPServer, ImportServer,
+    )
+    from veneur_tpu.protocol.dogstatsd import parse_metric
+
+    gsrv = Server(Config(interval="10s", percentiles=[0.5]))
+    imp = ImportServer(gsrv)
+    front = ImportHTTPServer(imp)
+    port = front.start()
+    try:
+        local = Server(Config(
+            interval="10s", percentiles=[0.5],
+            forward_address=f"http://127.0.0.1:{port}",
+            forward_use_grpc=False, forward_format="jsonmetric"))
+        install_forwarder(local)
+        for v in [1, 2, 3, 4, 5]:
+            m = parse_metric(f"jm.lat:{v}|h".encode())
+            local.workers[m.digest % len(local.workers)].process_metric(m)
+        local.workers[0].process_metric(
+            parse_metric(b"jm.count:7|c|#veneurglobalonly"))
+        for i in range(100):
+            m = parse_metric(f"jm.set:u{i}|s".encode())
+            local.workers[m.digest % len(local.workers)].process_metric(m)
+
+        aggs = HistogramAggregates.from_names(["min", "max", "count"])
+        qs = device_quantiles([0.5], aggs)
+        snaps = [w.flush(qs, 10.0) for w in local.workers]
+        local.forwarder(snaps)  # synchronous
+
+        deadline = time.time() + 5
+        while imp.received_metrics < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        assert imp.import_errors == 0
+
+        metrics = []
+        for w in gsrv.workers:
+            snap = w.flush(qs, 10.0)
+            metrics.extend(generate_inter_metrics(snap, False, [0.5], aggs))
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("jm.count", MetricType.COUNTER)].value == 7.0
+        p50 = by_key[("jm.lat.50percentile", MetricType.GAUGE)].value
+        assert 2.0 <= p50 <= 4.0
+        est = by_key[("jm.set", MetricType.GAUGE)].value
+        assert abs(est - 100) / 100 < 0.06
+    finally:
+        front.stop()
+        imp.stop()
+
+
+def test_go_jsonmetric_bad_entry_skipped_not_fatal():
+    """One corrupt Go entry must not 400 the batch (reference
+    worker.go:430-432 logs and continues per metric)."""
+    import base64
+    import json as _json
+
+    from veneur_tpu.distributed.gob import encode_counter
+    from veneur_tpu.distributed.import_server import decode_http_import_body
+
+    body = _json.dumps([
+        {"name": "bad.type", "type": "wat", "tagstring": "", "tags": None,
+         "value": base64.b64encode(b"x").decode()},
+        {"name": "bad.gob", "type": "histogram", "tagstring": "",
+         "tags": None, "value": base64.b64encode(b"\xff\x01").decode()},
+        {"name": "ok.count", "type": "counter", "tagstring": "",
+         "tags": ["a:1"],
+         "value": base64.b64encode(encode_counter(5)).decode()},
+    ]).encode()
+    batch = decode_http_import_body(body, "")
+    assert [m.name for m in batch.metrics] == ["ok.count"]
+    assert batch.metrics[0].counter.value == 5

@@ -429,8 +429,15 @@ def validate_config(cfg: Config) -> None:
             raise ValueError(f"percentile {p} out of [0,1]")
     if cfg.num_workers < 1 or cfg.num_readers < 1:
         raise ValueError("num_workers and num_readers must be >= 1")
-    if cfg.forward_format not in ("veneurtpu", "forwardrpc"):
-        raise ValueError("forward_format must be 'veneurtpu' or 'forwardrpc'")
+    if cfg.forward_format not in ("veneurtpu", "forwardrpc", "jsonmetric"):
+        raise ValueError("forward_format must be 'veneurtpu', 'forwardrpc'"
+                         " or 'jsonmetric'")
+    if cfg.forward_format == "forwardrpc" and not cfg.forward_use_grpc:
+        raise ValueError("forward_format: forwardrpc requires"
+                         " forward_use_grpc: true")
+    if cfg.forward_format == "jsonmetric" and cfg.forward_use_grpc:
+        raise ValueError("forward_format: jsonmetric is the legacy HTTP"
+                         " body; set forward_use_grpc: false")
     if cfg.tpu_mesh_devices > 1 and cfg.num_workers != 1:
         raise ValueError(
             "tpu_mesh_devices requires num_workers: 1 (the mesh shards"

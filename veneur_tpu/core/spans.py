@@ -153,18 +153,24 @@ class _SinkLane:
         self.sink = sink
         self.q: "queue.Queue" = queue.Queue(capacity)
         self.consumers = max(1, consumers)
-        # monotonic start of the oldest in-flight ingest; 0 when every
-        # consumer is idle (approximation: last consumer to start wins,
-        # good enough for the is-it-stuck classification)
-        self.busy_since = 0.0
+        # per-consumer monotonic start of its in-flight ingest (0 = idle):
+        # the oldest nonzero slot tells whether ANY consumer is wedged,
+        # even while the others keep finishing work
+        self._busy = [0.0] * self.consumers
         self.errors = 0
         self._err_lock = threading.Lock()
         self._threads: list[threading.Thread] = []
 
+    def oldest_busy(self) -> float:
+        """Monotonic start time of the longest-running in-flight ingest,
+        or 0.0 when all consumers are idle."""
+        stuck = [b for b in self._busy if b]
+        return min(stuck) if stuck else 0.0
+
     def start(self) -> None:
         for i in range(self.consumers):
             t = threading.Thread(
-                target=self._run, daemon=True,
+                target=self._run, args=(i,), daemon=True,
                 name=f"span-sink-{self.sink.name()}-{i}")
             t.start()
             self._threads.append(t)
@@ -182,12 +188,12 @@ class _SinkLane:
             self.errors = 0
         return n
 
-    def _run(self) -> None:
+    def _run(self, slot: int) -> None:
         while True:
             span = self.q.get()
             if span is None:
                 return
-            self.busy_since = time.monotonic()
+            self._busy[slot] = time.monotonic()
             try:
                 self.sink.ingest(span)
             except Exception as e:
@@ -196,7 +202,7 @@ class _SinkLane:
                 log.debug("span sink %s ingest failed: %s",
                           self.sink.name(), e)
             finally:
-                self.busy_since = 0.0
+                self._busy[slot] = 0.0
 
     def stop(self) -> None:
         # sentinel delivery must not block on a full lane (the lane being
@@ -297,7 +303,7 @@ class SpanWorker:
                 lane = self._lane_for(sink)
                 if lane.put(span):
                     continue
-                busy = lane.busy_since
+                busy = lane.oldest_busy()
                 name = sink.name()
                 with self._stats_lock:
                     if (busy and time.monotonic() - busy

@@ -85,13 +85,17 @@ class HTTPForwarder:
 
     def __init__(self, base_url: str, timeout_s: float = 10.0,
                  compression: float = 100.0, hll_precision: int = 14,
-                 tracer=None, stats=None) -> None:
+                 tracer=None, stats=None, go_format: bool = False) -> None:
         self.url = base_url.rstrip("/") + "/import"
         self.timeout_s = timeout_s
         self.compression = compression
         self.hll_precision = hll_precision
         self.tracer = tracer
         self.stats = stats
+        # forward_format: jsonmetric — emit the reference's JSONMetric
+        # entries (gob/LE/HLL values) so a stock Go veneur global can
+        # Combine them (flusher.go:338-433 wire, samplers.go Export)
+        self.go_format = go_format
         self.errors = 0
         self.sent_batches = 0
 
@@ -100,6 +104,14 @@ class HTTPForwarder:
         for snap in snapshots:
             batch = codec.snapshot_to_batch(
                 snap, self.compression, self.hll_precision)
+            if self.go_format:
+                from veneur_tpu.distributed.interop import (
+                    internal_to_go_jsonmetric,
+                )
+
+                items.extend(
+                    internal_to_go_jsonmetric(m) for m in batch.metrics)
+                continue
             for m in batch.metrics:
                 items.append({
                     "name": m.name,
@@ -170,4 +182,5 @@ def install_forwarder(server, compression: Optional[float] = None,
         server.forwarder = HTTPForwarder(
             cfg.forward_address, timeout, compression, hll_precision,
             tracer=getattr(server, "tracer", None),
-            stats=getattr(server, "stats", None))
+            stats=getattr(server, "stats", None),
+            go_format=(cfg.forward_format == "jsonmetric"))

@@ -1,0 +1,303 @@
+"""Scoped Go `encoding/gob` codec for the v1 HTTP forwarding payloads.
+
+The reference's legacy forward path (flusher.go:338-433 → POST /import)
+carries sampler state as gob/binary blobs inside JSONMetric entries
+(samplers/samplers.go Export/Combine):
+
+  counter    little-endian int64          (samplers.go:161-193)
+  gauge      little-endian float64        (samplers.go:245-277)
+  status     little-endian float64        (samplers.go:327-359)
+  set        axiomhq HLL MarshalBinary    (samplers.go:406-436; decoded
+                                          by distributed/interop.py)
+  histogram  gob MergingDigest            (tdigest/merging_digest.go:
+                                          393-454: []Centroid,
+                                          compression, min, max,
+                                          [reciprocalSum])
+
+This module implements exactly the gob subset those histogram blobs
+need — not a general gob library. The wire grammar (from the
+encoding/gob spec):
+
+  stream   := message*
+  message  := uvarint(len) payload
+  payload  := signed(typeid) value          typeid > 0
+            | signed(-typeid) wireType      type definition
+  value    := 0x00 concrete                 top-level non-struct types
+  struct   := (uvarint(fieldDelta) field)* 0x00
+  uvarint  := one byte < 0x80, or (256-n) then n big-endian bytes
+  signed   := uvarint(u) where u = i<<1 (i>=0) / ^(i<<1) (i<0)
+  float64  := uvarint of the byte-reversed IEEE bits
+
+Type definitions are length-prefixed messages, so the decoder skips
+them wholesale; the encoder emits correct wireType definitions for
+[]Centroid / Centroid / []float64 so a stock Go veneur can decode our
+exports. Decode is validated against real Go-encoded bytes
+(/root/reference/testdata/import.uncompressed); encode is validated by
+round-trip.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+
+class GobError(ValueError):
+    pass
+
+
+# -- primitive readers -------------------------------------------------------
+
+
+class _Reader:
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+
+    def eof(self) -> bool:
+        return self.pos >= len(self.data)
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise GobError("truncated gob stream")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def uvarint(self) -> int:
+        b = self.take(1)[0]
+        if b < 0x80:
+            return b
+        n = 256 - b
+        if not 1 <= n <= 8:
+            raise GobError(f"bad uint byte count {n}")
+        return int.from_bytes(self.take(n), "big")
+
+    def svarint(self) -> int:
+        u = self.uvarint()
+        if u & 1:
+            return ~(u >> 1)
+        return u >> 1
+
+    def float64(self) -> float:
+        # gob sends ReverseBytes64(float bits) as an unsigned int, so
+        # the uint's little-endian expansion is the big-endian float
+        u = self.uvarint()
+        return struct.unpack(">d", u.to_bytes(8, "little"))[0]
+
+
+# -- primitive writers -------------------------------------------------------
+
+
+def _uvarint(u: int) -> bytes:
+    if u < 0x80:
+        return bytes([u])
+    raw = u.to_bytes((u.bit_length() + 7) // 8, "big")
+    return bytes([256 - len(raw)]) + raw
+
+
+def _svarint(i: int) -> bytes:
+    u = (i << 1) if i >= 0 else ~(i << 1)
+    return _uvarint(u)
+
+
+def _float64(v: float) -> bytes:
+    return _uvarint(int.from_bytes(struct.pack("<d", v), "big"))
+
+
+def _message(payload: bytes) -> bytes:
+    return _uvarint(len(payload)) + payload
+
+
+def _string(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    return _uvarint(len(raw)) + raw
+
+
+# -- MergingDigest decode ----------------------------------------------------
+
+
+@dataclass
+class GobDigest:
+    """The decoded payload of tdigest.MergingDigest.GobEncode."""
+
+    means: list = field(default_factory=list)
+    weights: list = field(default_factory=list)
+    compression: float = 100.0
+    min: float = float("inf")
+    max: float = float("-inf")
+    reciprocal_sum: float = 0.0
+
+
+def _decode_centroid(r: _Reader) -> tuple[float, float]:
+    """Centroid struct {1: Mean f64, 2: Weight f64, 3: Samples []f64}."""
+    mean = weight = 0.0
+    fieldnum = -1
+    while True:
+        delta = r.uvarint()
+        if delta == 0:
+            return mean, weight
+        fieldnum += delta
+        if fieldnum == 0:
+            mean = r.float64()
+        elif fieldnum == 1:
+            weight = r.float64()
+        elif fieldnum == 2:
+            # debug-mode retained samples; decode and discard
+            for _ in range(r.uvarint()):
+                r.float64()
+        else:
+            raise GobError(f"unexpected Centroid field {fieldnum}")
+
+
+def decode_merging_digest(data: bytes) -> GobDigest:
+    """Decode a MergingDigest gob blob (merging_digest.go:417-438
+    semantics, including the reciprocalSum-absent backward-compat
+    form and the pre-scalars []Centroid-only form)."""
+    r = _Reader(data)
+    out = GobDigest()
+    values = []  # top-level values in Encode order
+    while not r.eof() and len(values) < 5:
+        length = r.uvarint()
+        end = r.pos + length
+        typeid = r.svarint()
+        if typeid < 0:
+            r.pos = end  # a type definition: skip the whole message
+            continue
+        if r.take(1) != b"\x00":
+            raise GobError("expected leading zero before top-level value")
+        if not values:
+            # first value: []Centroid
+            count = r.uvarint()
+            for _ in range(count):
+                mean, weight = _decode_centroid(r)
+                out.means.append(mean)
+                out.weights.append(weight)
+            values.append("centroids")
+        else:
+            values.append(r.float64())
+        if r.pos != end:
+            raise GobError("trailing bytes inside gob message")
+    scalars = values[1:]
+    if scalars:
+        out.compression = scalars[0]
+    if len(scalars) > 1:
+        out.min = scalars[1]
+    if len(scalars) > 2:
+        out.max = scalars[2]
+    if len(scalars) > 3:
+        out.reciprocal_sum = scalars[3]
+    if out.means and len(scalars) < 3:
+        # digest without explicit min/max: derive from centroids
+        out.min = min(out.means)
+        out.max = max(out.means)
+    return out
+
+
+# -- MergingDigest encode ----------------------------------------------------
+
+# type ids are ours to assign (Go's decoder accepts any ids defined
+# before use); these mirror the order Go itself assigns for this schema
+_ID_SLICE_CENTROID = 65
+_ID_CENTROID = 66
+_ID_SLICE_F64 = 67
+_FLOAT64 = 8  # predefined
+
+# wireType struct field indices (encoding/gob/type.go):
+#   1 ArrayT, 2 SliceT, 3 StructT, 4 MapT, ...
+# sliceType  = {1: CommonType, 2: Elem typeid}
+# structType = {1: CommonType, 2: Field []fieldType}
+# fieldType  = {1: Name string, 2: Id typeid}
+# CommonType = {1: Name string, 2: Id typeid}
+
+
+def _common_type(name: str, tid: int) -> bytes:
+    out = b""
+    if name:
+        out += _uvarint(1) + _string(name)
+        out += _uvarint(1) + _svarint(tid)
+    else:
+        out += _uvarint(2) + _svarint(tid)
+    return out + b"\x00"
+
+
+def _slice_typedef(tid: int, name: str, elem: int) -> bytes:
+    slice_type = (_uvarint(1) + _common_type(name, tid)
+                  + _uvarint(1) + _svarint(elem) + b"\x00")
+    wire = _uvarint(2) + slice_type + b"\x00"
+    return _message(_svarint(-tid) + wire)
+
+
+def _field_type(name: str, tid: int) -> bytes:
+    return (_uvarint(1) + _string(name)
+            + _uvarint(1) + _svarint(tid) + b"\x00")
+
+
+def _struct_typedef(tid: int, name: str, fields: list) -> bytes:
+    fieldlist = _uvarint(len(fields)) + b"".join(
+        _field_type(n, t) for n, t in fields)
+    struct_type = (_uvarint(1) + _common_type(name, tid)
+                   + _uvarint(1) + fieldlist + b"\x00")
+    wire = _uvarint(3) + struct_type + b"\x00"
+    return _message(_svarint(-tid) + wire)
+
+
+def _encode_float_value(v: float) -> bytes:
+    return _message(_svarint(_FLOAT64) + b"\x00" + _float64(v))
+
+
+def encode_merging_digest(means, weights, compression: float,
+                          dmin: float, dmax: float,
+                          reciprocal_sum: float) -> bytes:
+    """Produce bytes a stock Go veneur's Histo.Combine can decode
+    (the inverse of merging_digest.go GobEncode :393-415)."""
+    out = b""
+    out += _slice_typedef(_ID_SLICE_CENTROID, "", _ID_CENTROID)
+    out += _struct_typedef(_ID_CENTROID, "Centroid", [
+        ("Mean", _FLOAT64), ("Weight", _FLOAT64),
+        ("Samples", _ID_SLICE_F64),
+    ])
+    out += _slice_typedef(_ID_SLICE_F64, "[]float64", _FLOAT64)
+
+    body = _svarint(_ID_SLICE_CENTROID) + b"\x00" + _uvarint(len(means))
+    for m, w in zip(means, weights):
+        centroid = b""
+        if m:  # gob omits zero-valued fields
+            centroid += _uvarint(1) + _float64(float(m))
+            centroid += _uvarint(1) + _float64(float(w))
+        else:
+            centroid += _uvarint(2) + _float64(float(w))
+        centroid += b"\x00"
+        body += centroid
+    out += _message(body)
+
+    out += _encode_float_value(float(compression))
+    out += _encode_float_value(float(dmin))
+    out += _encode_float_value(float(dmax))
+    out += _encode_float_value(float(reciprocal_sum))
+    return out
+
+
+# -- the little-endian scalar forms ------------------------------------------
+
+
+def decode_counter(data: bytes) -> int:
+    """samplers.go:181-193 — little-endian int64."""
+    if len(data) != 8:
+        raise GobError(f"counter payload must be 8 bytes, got {len(data)}")
+    return struct.unpack("<q", data)[0]
+
+
+def encode_counter(value: int) -> bytes:
+    return struct.pack("<q", int(value))
+
+
+def decode_float_le(data: bytes) -> float:
+    """samplers.go:265-277 (gauge) / :347-359 (status)."""
+    if len(data) != 8:
+        raise GobError(f"float payload must be 8 bytes, got {len(data)}")
+    return struct.unpack("<d", data)[0]
+
+
+def encode_float_le(value: float) -> bytes:
+    return struct.pack("<d", float(value))

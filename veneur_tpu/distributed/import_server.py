@@ -111,6 +111,25 @@ def decode_http_import_body(body: bytes, content_encoding: str
         for item in items:
             if "value" not in item:
                 raise ValueError("metric entry lacks a value field")
+            if "tagstring" in item:
+                # a stock Go veneur local's JSONMetric body
+                # (samplers.go:102-108; gob/LE/HLL value encodings).
+                # One bad entry skips, it does not fail the batch — the
+                # reference logs and continues per metric
+                # (worker.go:430-432 unknown type, per-Combine errors)
+                from veneur_tpu.distributed.interop import (
+                    go_jsonmetric_to_internal,
+                )
+
+                try:
+                    m = go_jsonmetric_to_internal(item)
+                except (ValueError, KeyError) as e:
+                    log.debug("skipping bad JSONMetric entry %r: %s",
+                              item.get("name"), e)
+                    continue
+                if m is not None:
+                    batch.metrics.append(m)
+                continue
             m = pb.Metric.FromString(base64.b64decode(item["value"]))
             batch.metrics.append(m)
         return batch

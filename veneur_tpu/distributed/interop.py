@@ -229,6 +229,90 @@ def compat_to_internal(m: mpb.Metric) -> pb.Metric:
     return out
 
 
+def go_jsonmetric_to_internal(item: dict) -> Optional[pb.Metric]:
+    """One Go JSONMetric entry (the legacy HTTP /import body,
+    samplers.go:102-108 + per-type Export encodings) → internal metric.
+
+    Value encodings per samplers.go: counter = little-endian int64
+    (:161-193), gauge = little-endian float64 (:245-277), set = axiomhq
+    HLL MarshalBinary (:406-436), histogram/timer = gob MergingDigest
+    (tdigest/merging_digest.go:393-454). Scope fixup mirrors
+    Worker.ImportMetric (worker.go:401-405): imported counters/gauges
+    are global. Returns None for an empty digest (carries no state)."""
+    import base64
+
+    from veneur_tpu.distributed import codec as _codec
+    from veneur_tpu.distributed import gob
+
+    mtype = item.get("type", "")
+    kind = _codec._TYPE_TO_KIND.get(mtype)
+    if kind is None:
+        raise ValueError(f"unknown JSONMetric type {mtype!r}")
+    data = base64.b64decode(item["value"])
+    out = pb.Metric()
+    out.name = item["name"]
+    out.tags.extend(item.get("tags") or [])
+    out.kind = kind
+    out.scope = (pb.SCOPE_GLOBAL if mtype in ("counter", "gauge")
+                 else pb.SCOPE_MIXED)
+    if mtype == "counter":
+        out.counter.value = gob.decode_counter(data)
+    elif mtype == "gauge":
+        out.gauge.value = gob.decode_float_le(data)
+    elif mtype == "set":
+        p, regs = decode_hll(data)
+        out.hll.registers = regs.astype(np.int8).tobytes()
+        out.hll.precision = p
+    else:  # histogram / timer
+        d = gob.decode_merging_digest(data)
+        if not d.means:
+            return None
+        for mean, weight in zip(d.means, d.weights):
+            if weight > 0:
+                out.digest.centroids.means.append(mean)
+                out.digest.centroids.weights.append(weight)
+        out.digest.min = d.min
+        out.digest.max = d.max
+        out.digest.reciprocal_sum = d.reciprocal_sum
+        out.digest.compression = d.compression or 100.0
+    return out
+
+
+def internal_to_go_jsonmetric(m: pb.Metric) -> dict:
+    """Internal metric → a Go JSONMetric entry a stock veneur global's
+    /import endpoint can Combine (the inverse of
+    go_jsonmetric_to_internal; the v1 analog of internal_to_compat)."""
+    import base64
+
+    from veneur_tpu.distributed import codec as _codec
+    from veneur_tpu.distributed import gob
+
+    mtype = _codec._KIND_TO_TYPE[m.kind]
+    which = m.WhichOneof("value")
+    if which == "counter":
+        data = gob.encode_counter(m.counter.value)
+    elif which == "gauge":
+        data = gob.encode_float_le(m.gauge.value)
+    elif which == "hll":
+        regs = np.frombuffer(m.hll.registers, dtype=np.int8)
+        data = encode_hll(regs, m.hll.precision)
+    elif which == "digest":
+        data = gob.encode_merging_digest(
+            list(m.digest.centroids.means),
+            list(m.digest.centroids.weights),
+            m.digest.compression or 100.0,
+            m.digest.min, m.digest.max, m.digest.reciprocal_sum)
+    else:
+        raise ValueError(f"metric {m.name!r} carries no value")
+    return {
+        "name": m.name,
+        "type": mtype,
+        "tagstring": ",".join(m.tags),
+        "tags": list(m.tags),
+        "value": base64.b64encode(data).decode("ascii"),
+    }
+
+
 def internal_to_compat(m: pb.Metric) -> mpb.Metric:
     """Internal metric → reference-wire metric (forwardable to a Go
     global — the twin of the reference's own ForwardableMetrics encode,
