@@ -583,3 +583,53 @@ def test_go_jsonmetric_bad_entry_skipped_not_fatal():
     batch = decode_http_import_body(body, "")
     assert [m.name for m in batch.metrics] == ["ok.count"]
     assert batch.metrics[0].counter.value == 5
+
+
+def test_go_body_through_proxy_ring_to_globals():
+    """A stock Go local can POST its /import body at OUR proxy tier: the
+    body decodes, ring-splits by metric key, and reaches the owning
+    global (reference handleProxy -> ProxyMetrics, proxy.go:587-628)."""
+    import os
+    import urllib.request
+
+    from veneur_tpu.distributed.import_server import (
+        ImportHTTPServer, ImportServer,
+    )
+    from veneur_tpu.distributed.proxy import ProxyHTTPServer, ProxyServer
+
+    path = os.path.join(REF_TESTDATA, "import.uncompressed")
+    if not os.path.exists(path):
+        pytest.skip("reference testdata unavailable")
+    body = open(path, "rb").read()
+
+    g1 = Server(Config(interval="10s", percentiles=[0.5]))
+    g2 = Server(Config(interval="10s", percentiles=[0.5]))
+    imp1, imp2 = ImportServer(g1), ImportServer(g2)
+    p1, p2 = imp1.start_grpc(), imp2.start_grpc()
+    proxy = ProxyServer([f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"])
+    front = ProxyHTTPServer(proxy)
+    port = front.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/import", data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+        deadline = time.time() + 5
+        while (imp1.received_metrics + imp2.received_metrics) < 1 \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        # exactly one global owns a.b.c on the ring
+        assert imp1.received_metrics + imp2.received_metrics == 1
+        owner = g1 if imp1.received_metrics else g2
+        qs = device_quantiles([0.5], AGGS)
+        metrics = []
+        for w in owner.workers:
+            snap = w.flush(qs, 10.0)
+            metrics.extend(generate_inter_metrics(snap, False, [0.5], AGGS))
+        names = {m.name for m in metrics}
+        assert "a.b.c.50percentile" in names
+    finally:
+        front.stop()
+        proxy.stop()
+        imp1.stop()
+        imp2.stop()
