@@ -592,9 +592,7 @@ def test_go_body_through_proxy_ring_to_globals():
     import os
     import urllib.request
 
-    from veneur_tpu.distributed.import_server import (
-        ImportHTTPServer, ImportServer,
-    )
+    from veneur_tpu.distributed.import_server import ImportServer
     from veneur_tpu.distributed.proxy import ProxyHTTPServer, ProxyServer
 
     path = os.path.join(REF_TESTDATA, "import.uncompressed")
@@ -621,12 +619,7 @@ def test_go_body_through_proxy_ring_to_globals():
         # exactly one global owns a.b.c on the ring
         assert imp1.received_metrics + imp2.received_metrics == 1
         owner = g1 if imp1.received_metrics else g2
-        qs = device_quantiles([0.5], AGGS)
-        metrics = []
-        for w in owner.workers:
-            snap = w.flush(qs, 10.0)
-            metrics.extend(generate_inter_metrics(snap, False, [0.5], AGGS))
-        names = {m.name for m in metrics}
+        names = {k[0] for k in _flush(owner)}
         assert "a.b.c.50percentile" in names
     finally:
         front.stop()
