@@ -146,11 +146,11 @@ def test_prometheus_poller_end_to_end():
     sock, port = _udp_receiver()
     try:
         rc = prometheus_poller.main([
-            "-p", f"http://127.0.0.1:{httpd.server_port}/metrics",
-            "-s", f"127.0.0.1:{port}", "-once"])
+            "-h", f"http://127.0.0.1:{httpd.server_port}/metrics",
+            "-s", f"127.0.0.1:{port}", "-p", "svc.", "-once"])
         assert rc == 0
         data = sock.recv(65536)
-        assert b"temp_gauge:21.5|g" in data
+        assert b"svc.temp_gauge:21.5|g" in data
     finally:
         httpd.shutdown()
         sock.close()
@@ -310,3 +310,43 @@ def test_emit_mode_specific_tags_and_span_times():
     assert span.start_timestamp == 100 * 10**9
     assert span.end_timestamp == int(101.5 * 10**9)
     sock.close()
+
+
+def test_prometheus_poller_label_filter_and_unix_socket(tmp_path):
+    """-ignored-labels drops matching label names from tags;
+    -socket scrapes over a unix stream (reference -socket transport)."""
+    import socketserver
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            # a gauge: emitted on every scrape (counters need two scrapes
+            # within one process to produce a delta)
+            body = (b"# TYPE req_depth gauge\n"
+                    b'req_depth{path="/x",internal_id="abc"} 5\n')
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    class UDSServer(socketserver.ThreadingUnixStreamServer):
+        pass
+
+    sock_path = str(tmp_path / "prom.sock")
+    httpd = UDSServer(sock_path, Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    rx, port = _udp_receiver()
+    try:
+        argv = ["-h", "http://prom/metrics", "-s", f"127.0.0.1:{port}",
+                "-socket", sock_path, "-ignored-labels", "internal_.*",
+                "-once"]
+        assert prometheus_poller.main(argv) == 0
+        data = rx.recv(65536)
+        assert b"req_depth:5" in data
+        assert b"path:/x" in data
+        assert b"internal_id" not in data
+    finally:
+        httpd.shutdown()
+        rx.close()

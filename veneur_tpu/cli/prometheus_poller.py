@@ -17,6 +17,7 @@ import socket
 import ssl
 import sys
 import time
+import urllib.parse
 import urllib.request
 from typing import Optional
 
@@ -79,11 +80,19 @@ class CountCache:
 
 def translate(types: dict[str, str], samples: list[tuple],
               cache: CountCache, added_tags: list[str],
-              ignored: Optional[re.Pattern] = None) -> list[bytes]:
-    """Prometheus samples → statsd lines (reference translate.go)."""
+              ignored: Optional[list] = None,
+              ignored_labels: Optional[list] = None,
+              prefix: str = "") -> list[bytes]:
+    """Prometheus samples → statsd lines (reference translate.go).
+
+    ignored / ignored_labels: lists of compiled regexes — metric names
+    matching any `ignored` entry are skipped, labels whose NAME matches
+    any `ignored_labels` entry are dropped from the tag set (reference
+    -ignored-metrics / -ignored-labels). prefix is prepended verbatim
+    (reference -p, e.g. "myservice.")."""
     lines = []
     for name, labels, value in samples:
-        if ignored is not None and ignored.search(name):
+        if ignored and any(rx.search(name) for rx in ignored):
             continue
         base = name
         mtype = types.get(name)
@@ -94,29 +103,61 @@ def translate(types: dict[str, str], samples: list[tuple],
                     base = name[: -len(suffix)]
                     mtype = types.get(base)
                     break
-        tags = [f"{k}:{v}" for k, v in sorted(labels.items())] + added_tags
+        kept_labels = {
+            k: v for k, v in labels.items()
+            if not (ignored_labels
+                    and any(rx.search(k) for rx in ignored_labels))
+        }
+        tags = [f"{k}:{v}" for k, v in sorted(kept_labels.items())]
+        tags += added_tags
         tag_part = ("|#" + ",".join(tags)) if tags else ""
         key = (name, tuple(sorted(labels.items())))
+        out_name = prefix + name
 
         if mtype == "counter":
             d = cache.delta(key, value)
             if d is not None and d != 0:
-                lines.append(f"{name}:{d}|c{tag_part}".encode())
+                lines.append(f"{out_name}:{d}|c{tag_part}".encode())
         elif mtype == "gauge" or mtype is None:
-            lines.append(f"{name}:{value}|g{tag_part}".encode())
+            lines.append(f"{out_name}:{value}|g{tag_part}".encode())
         elif mtype in ("histogram", "summary"):
             if name.endswith(("_bucket", "_count", "_sum")):
                 d = cache.delta(key, value)
                 if d is not None and d != 0:
-                    lines.append(f"{name}:{d}|c{tag_part}".encode())
+                    lines.append(f"{out_name}:{d}|c{tag_part}".encode())
             else:
                 # summary quantile series: instantaneous gauge
-                lines.append(f"{name}:{value}|g{tag_part}".encode())
+                lines.append(f"{out_name}:{value}|g{tag_part}".encode())
     return lines
 
 
 def scrape(url: str, cert: str = "", key: str = "", cacert: str = "",
-           timeout: float = 10.0) -> str:
+           timeout: float = 10.0, unix_socket: str = "") -> str:
+    if unix_socket:
+        # scrape over a unix socket (reference -socket: proxy-style
+        # transports); plain HTTP semantics over an AF_UNIX stream
+        import http.client
+
+        class _UDSConn(http.client.HTTPConnection):
+            def connect(self):
+                self.sock = socket.socket(socket.AF_UNIX,
+                                          socket.SOCK_STREAM)
+                self.sock.settimeout(timeout)
+                self.sock.connect(unix_socket)
+
+        parts = urllib.parse.urlsplit(url)
+        path = parts.path or "/metrics"
+        if parts.query:
+            path += "?" + parts.query
+        conn = _UDSConn("localhost", timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise RuntimeError(f"scrape returned {resp.status}")
+            return resp.read().decode("utf-8")
+        finally:
+            conn.close()
     ctx = None
     if url.startswith("https"):
         ctx = ssl.create_default_context(cafile=cacert or None)
@@ -142,44 +183,73 @@ def send_statsd(address: str, lines: list[bytes],
 
 
 def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(prog="veneur-tpu-prometheus")
-    parser.add_argument("-p", dest="prometheus_host",
+    # -h is the metrics URL (matching the reference's flag surface,
+    # cmd/veneur-prometheus/main.go:12-24), so argparse's automatic -h
+    # help is disabled; --help still works
+    parser = argparse.ArgumentParser(prog="veneur-tpu-prometheus",
+                                     add_help=False)
+    parser.add_argument("--help", "-help", action="help",
+                        help="show this help message and exit")
+    parser.add_argument("-h", "--host", dest="prometheus_host",
                         default="http://localhost:9090/metrics",
-                        help="prometheus metrics endpoint")
+                        help="prometheus metrics endpoint URL")
     parser.add_argument("-s", dest="statsd_host",
                         default="127.0.0.1:8126",
                         help="statsd destination host:port")
     parser.add_argument("-i", dest="interval", default="10s")
+    parser.add_argument("-p", dest="prefix", default="",
+                        help="prefix prepended to every metric name "
+                             "(include the trailing period)")
+    parser.add_argument("-d", dest="debug", action="store_true")
     parser.add_argument("-t", dest="tags", action="append", default=[],
                         help="tag to add to every metric")
     parser.add_argument("-ignored-metrics", default="",
-                        help="regex of metric names to skip")
+                        help="comma-separated metric-name regexes to skip")
+    parser.add_argument("-ignored-labels", default="",
+                        help="comma-separated label-name regexes to drop")
     parser.add_argument("-cert", default="")
     parser.add_argument("-key", default="")
     parser.add_argument("-cacert", default="")
+    parser.add_argument("-socket", default="",
+                        help="unix socket path for the scrape transport")
     parser.add_argument("-once", action="store_true",
                         help="scrape once and exit (for testing)")
     args = parser.parse_args(argv)
 
-    logging.basicConfig(level=logging.INFO)
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO)
     from veneur_tpu.core.config import parse_duration
 
     interval = parse_duration(args.interval)
-    ignored = re.compile(args.ignored_metrics) if args.ignored_metrics else None
+
+    def _regexes(spec: str):
+        # comma-separated regex list (the reference splits the same way,
+        # so comma-containing regexes are inexpressible there too)
+        try:
+            return [re.compile(s) for s in spec.split(",") if s] or None
+        except re.error as e:
+            parser.error(f"bad regex in {spec!r}: {e}")
+
+    ignored = _regexes(args.ignored_metrics)
+    ignored_labels = _regexes(args.ignored_labels)
     cache = CountCache()
 
     while True:
         try:
             body = scrape(args.prometheus_host, args.cert, args.key,
-                          args.cacert)
+                          args.cacert, unix_socket=args.socket)
             types, samples = parse_prometheus_text(body)
-            lines = translate(types, samples, cache, args.tags, ignored)
+            lines = translate(types, samples, cache, args.tags, ignored,
+                              ignored_labels=ignored_labels,
+                              prefix=args.prefix)
             if lines:
                 send_statsd(args.statsd_host, lines)
             log.info("scraped %d samples → %d statsd lines",
                      len(samples), len(lines))
         except Exception as e:
             log.warning("scrape failed: %s", e)
+            if args.once:
+                return 1
         if args.once:
             return 0
         time.sleep(interval)
