@@ -641,6 +641,33 @@ struct SpanView {
   bool has_status = false;
 };
 
+// proto3 `string` fields must be valid UTF-8; the stock protobuf
+// decoders (Python, Go) reject violations, so the fast path must too
+// or corrupted packets would diverge between the two pipelines.
+bool valid_utf8(std::string_view s) {
+  size_t i = 0, n = s.size();
+  while (i < n) {
+    uint8_t c = static_cast<uint8_t>(s[i]);
+    if (c < 0x80) { i++; continue; }
+    int len;
+    uint32_t cp, min_cp;
+    if ((c >> 5) == 0x6) { len = 2; cp = c & 0x1F; min_cp = 0x80; }
+    else if ((c >> 4) == 0xE) { len = 3; cp = c & 0x0F; min_cp = 0x800; }
+    else if ((c >> 3) == 0x1E) { len = 4; cp = c & 0x07; min_cp = 0x10000; }
+    else return false;
+    if (i + static_cast<size_t>(len) > n) return false;
+    for (int j = 1; j < len; j++) {
+      uint8_t cc = static_cast<uint8_t>(s[i + j]);
+      if ((cc >> 6) != 0x2) return false;
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    if (cp < min_cp || cp > 0x10FFFF) return false;
+    if (cp >= 0xD800 && cp <= 0xDFFF) return false;  // surrogate range
+    i += len;
+  }
+  return true;
+}
+
 struct ProtoReader {
   const uint8_t* p;
   const uint8_t* end;
@@ -668,6 +695,13 @@ struct ProtoReader {
     std::string_view s(reinterpret_cast<const char*>(p),
                        static_cast<size_t>(n));
     p += n;
+    return s;
+  }
+
+  // a `string`-typed field: length-delimited AND valid UTF-8
+  std::string_view str() {
+    std::string_view s = bytes();
+    if (ok && !valid_utf8(s)) ok = false;
     return s;
   }
 
@@ -707,13 +741,16 @@ bool decode_tag_entry(std::string_view buf, TagPair* out) {
   while (r.ok && r.p < r.end) {
     uint64_t tag = r.varint();
     if (!r.ok) return false;
+    // protobuf field numbers are 1..2^29-1; 0 or overflow is a corrupt
+    // stream the stock decoders reject
+    if ((tag >> 3) == 0 || (tag >> 3) > 0x1FFFFFFFull) return false;
     int field = static_cast<int>(tag >> 3), wt = static_cast<int>(tag & 7);
     if (field == 1) {
       VN_EXPECT_WT(2);
-      out->k = r.bytes();
+      out->k = r.str();
     } else if (field == 2) {
       VN_EXPECT_WT(2);
-      out->v = r.bytes();
+      out->v = r.str();
     } else {
       r.skip(wt);
     }
@@ -727,13 +764,16 @@ bool decode_sample(std::string_view buf, SampleView* s) {
   while (r.ok && r.p < r.end) {
     uint64_t tag = r.varint();
     if (!r.ok) return false;
+    // protobuf field numbers are 1..2^29-1; 0 or overflow is a corrupt
+    // stream the stock decoders reject
+    if ((tag >> 3) == 0 || (tag >> 3) > 0x1FFFFFFFull) return false;
     int field = static_cast<int>(tag >> 3), wt = static_cast<int>(tag & 7);
     switch (field) {
       case 1: VN_EXPECT_WT(0); s->metric = static_cast<int>(r.varint());
         break;
-      case 2: VN_EXPECT_WT(2); s->name = r.bytes(); break;
+      case 2: VN_EXPECT_WT(2); s->name = r.str(); break;
       case 3: VN_EXPECT_WT(5); s->value = r.fixed32f(); break;
-      case 5: VN_EXPECT_WT(2); s->message = r.bytes(); break;
+      case 5: VN_EXPECT_WT(2); s->message = r.str(); break;
       case 6: VN_EXPECT_WT(0); s->status = static_cast<int>(r.varint());
         break;
       case 7: VN_EXPECT_WT(5); s->sample_rate = r.fixed32f(); break;
@@ -744,6 +784,9 @@ bool decode_sample(std::string_view buf, SampleView* s) {
         s->tags.push_back(t);
         break;
       }
+      // unit (field 9) is unused here but is a proto3 string: its bytes
+      // must still be valid UTF-8 or the stock decoders reject the span
+      case 9: VN_EXPECT_WT(2); r.str(); break;
       case 10: VN_EXPECT_WT(0); s->scope = static_cast<int>(r.varint());
         break;
       default: r.skip(wt);
@@ -759,6 +802,9 @@ bool decode_span(std::string_view buf, SpanView* sp) {
   while (r.ok && r.p < r.end) {
     uint64_t tag = r.varint();
     if (!r.ok) return false;
+    // protobuf field numbers are 1..2^29-1; 0 or overflow is a corrupt
+    // stream the stock decoders reject
+    if ((tag >> 3) == 0 || (tag >> 3) > 0x1FFFFFFFull) return false;
     int field = static_cast<int>(tag >> 3), wt = static_cast<int>(tag & 7);
     switch (field) {
       case 2: VN_EXPECT_WT(0);
@@ -776,7 +822,7 @@ bool decode_span(std::string_view buf, SpanView* sp) {
         sp->end_ts = static_cast<int64_t>(r.varint());
         break;
       case 7: VN_EXPECT_WT(0); sp->error = r.varint() != 0; break;
-      case 8: VN_EXPECT_WT(2); sp->service = r.bytes(); break;
+      case 8: VN_EXPECT_WT(2); sp->service = r.str(); break;
       case 10: {
         VN_EXPECT_WT(2);
         SampleView s;
@@ -793,7 +839,7 @@ bool decode_span(std::string_view buf, SpanView* sp) {
         break;
       }
       case 12: VN_EXPECT_WT(0); sp->indicator = r.varint() != 0; break;
-      case 13: VN_EXPECT_WT(2); sp->name = r.bytes(); break;
+      case 13: VN_EXPECT_WT(2); sp->name = r.str(); break;
       default: r.skip(wt);
     }
   }

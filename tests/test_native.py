@@ -531,3 +531,63 @@ def test_parser_parity_fuzz():
         assert native_ok == py_ok, line
         checked += 1
     assert checked == 2500
+
+
+def test_native_ssf_decode_fuzz_agrees_with_python():
+    """Seeded fuzz over valid, mutated, and random SSF payloads: the
+    hand-written C++ proto decoder and the Python wire parser must agree
+    on accept/reject, and neither may crash. (Acceptance for the native
+    path = decodes AND is a valid trace span with samples to extract —
+    rc 1/-1; Python's parse_ssf accepts any decodable proto, so only
+    native-accepts-what-python-rejects is a divergence.)"""
+    import random
+
+    from veneur_tpu.protocol import ssf_wire
+
+    rng = random.Random(0xBEEF)
+    seeds = []
+    for i in range(40):
+        metrics = []
+        for j in range(i % 3):
+            sample = {"name": f"m{j}", "value": float(j) + 0.5,
+                      "sample_rate": 1.0, "message": f"msg{j}",
+                      "unit": "ms", "tags": {"a": "b"}}
+            metrics.append(sample)
+        seeds.append(_make_span_bytes(
+            trace_id=rng.randrange(1, 1 << 60),
+            id=rng.randrange(1, 1 << 60),
+            start_timestamp=rng.randrange(1, 1 << 60),
+            end_timestamp=rng.randrange(1, 1 << 60),
+            service=f"svc{i}", name=f"op{i}",
+            indicator=bool(i % 2),
+            metrics=metrics,
+            tags={f"k{j}": f"v{j}" for j in range(i % 4)}))
+
+    ni = native_mod.NativeIngest()
+    checked = 0
+    for _ in range(3000):
+        base = bytearray(rng.choice(seeds))
+        roll = rng.random()
+        if roll < 0.35 and base:
+            # point mutation
+            for _ in range(rng.randrange(1, 4)):
+                base[rng.randrange(len(base))] = rng.randrange(256)
+        elif roll < 0.5:
+            # truncation
+            del base[rng.randrange(len(base)):]
+        elif roll < 0.6:
+            base = bytearray(rng.randbytes(rng.randrange(0, 80)))
+        payload = bytes(base)
+
+        try:
+            span = ssf_wire.parse_ssf(payload)
+            py_ok = True
+        except Exception:
+            py_ok = False
+        rc = ni.ingest_ssf(payload, b"ind.t", b"obj.t")
+        assert rc in (-1, 0, 1), (rc, payload)
+        if rc in (1, -1):
+            # native accepted: python must also decode it
+            assert py_ok, payload
+        checked += 1
+    assert checked == 3000
