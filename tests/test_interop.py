@@ -626,3 +626,63 @@ def test_go_body_through_proxy_ring_to_globals():
         proxy.stop()
         imp1.stop()
         imp2.stop()
+
+
+def test_hll_decode_fuzz_never_crashes():
+    """decode_hll consumes network payloads: mutated and random blobs
+    must either raise ValueError or yield a well-formed register row —
+    never crash, hang, or return garbage shapes."""
+    import random
+
+    rng = random.Random(0xA11)
+    regs = np.zeros(M, dtype=np.uint8)
+    for i in range(500):
+        _go_insert(regs, metro_hash64(f"x{i}".encode(), 1337))
+    seeds = [_dense_blob(regs), _dense_blob(regs, b=2),
+             _sparse_blob([metro_hash64(f"y{i}".encode(), 1337)
+                           for i in range(300)], split=100)]
+    for _ in range(1500):
+        base = bytearray(rng.choice(seeds))
+        roll = rng.random()
+        if roll < 0.4 and base:
+            for _ in range(rng.randrange(1, 6)):
+                base[rng.randrange(len(base))] = rng.randrange(256)
+        elif roll < 0.6:
+            del base[rng.randrange(len(base)):]
+        elif roll < 0.7:
+            base = bytearray(rng.randbytes(rng.randrange(0, 64)))
+        try:
+            p, out = interop.decode_hll(bytes(base))
+        except ValueError:
+            continue
+        assert 4 <= p <= 18
+        assert out.shape == (1 << p,)
+        assert out.dtype == np.uint8
+
+
+def test_gob_digest_decode_fuzz_never_crashes():
+    """decode_merging_digest consumes legacy /import payloads: mutated
+    gob must raise GobError/ValueError or decode cleanly — never hang or
+    index out of bounds."""
+    import random
+
+    from veneur_tpu.distributed import gob
+
+    rng = random.Random(0xD16)
+    seed = gob.encode_merging_digest(
+        [1.0, 5.0, 9.0], [2.0, 1.0, 4.0], 100.0, 1.0, 9.0, 0.5)
+    for _ in range(1500):
+        base = bytearray(seed)
+        roll = rng.random()
+        if roll < 0.5:
+            for _ in range(rng.randrange(1, 5)):
+                base[rng.randrange(len(base))] = rng.randrange(256)
+        elif roll < 0.75:
+            del base[rng.randrange(len(base)):]
+        else:
+            base = bytearray(rng.randbytes(rng.randrange(0, 48)))
+        try:
+            d = gob.decode_merging_digest(bytes(base))
+        except ValueError:  # GobError subclasses ValueError
+            continue
+        assert len(d.means) == len(d.weights)
