@@ -644,12 +644,12 @@ def test_hll_decode_fuzz_never_crashes():
     for _ in range(1500):
         base = bytearray(rng.choice(seeds))
         roll = rng.random()
-        if roll < 0.4 and base:
+        if roll < 0.5 and base:
             for _ in range(rng.randrange(1, 6)):
                 base[rng.randrange(len(base))] = rng.randrange(256)
-        elif roll < 0.6:
+        elif roll < 0.8:
             del base[rng.randrange(len(base)):]
-        elif roll < 0.7:
+        else:
             base = bytearray(rng.randbytes(rng.randrange(0, 64)))
         try:
             p, out = interop.decode_hll(bytes(base))
