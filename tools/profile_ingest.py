@@ -5,11 +5,15 @@ Each stage is jitted separately with a scalar force-read so the timing
 reflects real execution, not dispatch (see bench.py `force` note).
 """
 
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from veneur_tpu.ops import segments, tdigest as td
 
