@@ -278,3 +278,38 @@ def test_digest_stability_across_sources():
     from_ssf = parse_metric_ssf(s)
     assert dog.digest == from_ssf.digest
     assert dog.key == from_ssf.key
+
+
+def test_event_and_service_check_fuzz_no_crashes():
+    """Mutated event/service-check packets must raise ParseError or
+    parse cleanly — never raise anything else (these stay on the Python
+    path even in native mode, fed straight from the UDP socket)."""
+    import random
+
+    rng = random.Random(0x5EED)
+    seeds = [
+        b"_e{5,4}:title|text|d:123|h:host|k:agg|p:low|s:src|t:error|#a:1",
+        b"_e{1,1}:a|b",
+        b"_sc|name|0|d:12|h:host|#a:1,b:2|m:all good",
+        b"_sc|svc|2|m:broken",
+    ]
+    for _ in range(3000):
+        base = bytearray(rng.choice(seeds))
+        roll = rng.random()
+        if roll < 0.5:
+            for _ in range(rng.randrange(1, 5)):
+                base[rng.randrange(len(base))] = rng.randrange(1, 256)
+        elif roll < 0.8:
+            del base[rng.randrange(len(base)):]
+        else:
+            base = bytearray(
+                rng.choice([b"_e{", b"_sc|"])
+                + rng.randbytes(rng.randrange(0, 40)))
+        pkt = bytes(base)
+        try:
+            if pkt.startswith(b"_e{"):
+                parse_event(pkt)
+            else:
+                parse_service_check(pkt)
+        except ParseError:
+            pass
