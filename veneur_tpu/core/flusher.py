@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import math
 import time
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
@@ -64,25 +65,53 @@ def generate_inter_metrics(
     mixed_percentiles: list[float] = [] if is_local else list(percentiles)
 
     # -- histogram/timer rows ---------------------------------------------
+    # This loop runs once per series per flush (1M+ rows in the
+    # prometheus_1m scenario); per-element numpy indexing costs ~µs each,
+    # so every column is materialized to a plain Python list up front
+    # (tolist is one C pass) and rows touch only list indexing.
     hrows = snap.directory.histo.rows
     if hrows:
         q_index = {
             float(q): i for i, q in enumerate(np.asarray(snap.quantile_qs))
         }
+        quant = {float(q): snap.quantile_values[:, i].tolist()
+                 for q, i in q_index.items()}
+        # digest-side columns are read only on the global instance
+        # (use_global rows are skipped on locals): don't box 5M floats
+        # a local flush never touches
+        empty: list = []
+        cols = _HistoCols(
+            lmin=snap.lmin.tolist(), lmax=snap.lmax.tolist(),
+            lsum=snap.lsum.tolist(), lweight=snap.lweight.tolist(),
+            lrecip=snap.lrecip.tolist(),
+            dmin=empty if is_local else snap.dmin.tolist(),
+            dmax=empty if is_local else snap.dmax.tolist(),
+            dsum=empty if is_local else snap.dsum.tolist(),
+            dcount=empty if is_local else snap.dcount.tolist(),
+            drecip=empty if is_local else snap.drecip.tolist(),
+            quant=quant,
+            pcols=[(_percentile_name("", p), quant[float(p)])
+                   for p in percentiles],
+            want_max=bool(aggregates.value & Aggregate.MAX),
+            want_min=bool(aggregates.value & Aggregate.MIN),
+            want_sum=bool(aggregates.value & Aggregate.SUM),
+            want_avg=bool(aggregates.value & Aggregate.AVERAGE),
+            want_count=bool(aggregates.value & Aggregate.COUNT),
+            want_median=bool(aggregates.value & Aggregate.MEDIAN),
+            want_hmean=bool(aggregates.value & Aggregate.HARMONIC_MEAN),
+        )
         for row, meta in enumerate(hrows):
             cls = meta.scope_class
             if cls == ScopeClass.MIXED:
-                ps, use_global = mixed_percentiles, False
+                # locals forward mixed digests and emit no percentiles
+                ps, use_global = bool(mixed_percentiles), False
             elif cls == ScopeClass.LOCAL:
-                ps, use_global = list(percentiles), False
+                ps, use_global = bool(percentiles), False
             else:  # GLOBAL: flushed only by the global instance, from digest
                 if is_local:
                     continue
-                ps, use_global = list(percentiles), True
-            out.extend(
-                _flush_histo_row(snap, row, meta, ts, ps, aggregates,
-                                 use_global, q_index)
-            )
+                ps, use_global = bool(percentiles), True
+            _flush_histo_row(cols, row, meta, ts, ps, use_global, out)
 
     # -- set rows ----------------------------------------------------------
     srows = snap.directory.sets.rows
@@ -145,77 +174,102 @@ def generate_inter_metrics(
     return out
 
 
+@dataclass
+class _HistoCols:
+    """Snapshot columns pre-materialized as Python lists for the per-row
+    emission loop."""
+
+    lmin: list
+    lmax: list
+    lsum: list
+    lweight: list
+    lrecip: list
+    dmin: list
+    dmax: list
+    dsum: list
+    dcount: list
+    drecip: list
+    quant: dict  # percentile -> per-row list
+    # (suffix, per-row values) per configured percentile, precomputed so
+    # the row loop does one concat instead of number formatting
+    pcols: list = None
+    # aggregate-flag membership tested once (Flag-enum `&` costs ~1µs a
+    # call; at 7 tests × 1M rows that alone was most of the loop)
+    want_max: bool = False
+    want_min: bool = False
+    want_sum: bool = False
+    want_avg: bool = False
+    want_count: bool = False
+    want_median: bool = False
+    want_hmean: bool = False
+
+
 def _flush_histo_row(
-    snap: FlushSnapshot,
+    cols: _HistoCols,
     row: int,
     meta,
     ts: int,
-    percentiles: list[float],
-    aggregates: HistogramAggregates,
+    emit_percentiles: bool,
     use_global: bool,
-    q_index: dict[float, int],
-) -> list[InterMetric]:
+    out: list,
+) -> None:
     """One histogram/timer row → aggregate + percentile series
-    (reference Histo.Flush, samplers.go:511-675)."""
+    (reference Histo.Flush, samplers.go:511-675). Appends to `out`.
+
+    The tags list is shared across this row's metrics — InterMetric
+    consumers never mutate tags (exclusion builds new lists)."""
     name = meta.key.name
-    tags = list(meta.tags)
+    tags = meta.tags
     sinks = meta.sinks
-    agg = aggregates.value
-    out: list[InterMetric] = []
+    append = out.append
+    GAUGE = MetricType.GAUGE
 
-    lmin = float(snap.lmin[row])
-    lmax = float(snap.lmax[row])
-    lsum = float(snap.lsum[row])
-    lweight = float(snap.lweight[row])
-    lrecip = float(snap.lrecip[row])
+    lmin = cols.lmin[row]
+    lmax = cols.lmax[row]
+    lsum = cols.lsum[row]
+    lweight = cols.lweight[row]
+    lrecip = cols.lrecip[row]
 
-    def gauge(metric_name: str, value: float) -> InterMetric:
-        return InterMetric(name=metric_name, timestamp=ts, value=value,
-                           tags=list(tags), type=MetricType.GAUGE, sinks=sinks)
-
-    if agg & Aggregate.MAX and (not math.isinf(lmax) or use_global):
-        val = float(snap.dmax[row]) if use_global else lmax
-        out.append(gauge(f"{name}.max", val))
-    if agg & Aggregate.MIN and (not math.isinf(lmin) or use_global):
-        val = float(snap.dmin[row]) if use_global else lmin
-        out.append(gauge(f"{name}.min", val))
-    if agg & Aggregate.SUM and (lsum != 0 or use_global):
-        val = float(snap.dsum[row]) if use_global else lsum
-        out.append(gauge(f"{name}.sum", val))
-    if agg & Aggregate.AVERAGE and (use_global or (lsum != 0 and lweight != 0)):
+    if cols.want_max and (not math.isinf(lmax) or use_global):
+        append(InterMetric(name + ".max", ts,
+                           cols.dmax[row] if use_global else lmax,
+                           tags, GAUGE, sinks=sinks))
+    if cols.want_min and (not math.isinf(lmin) or use_global):
+        append(InterMetric(name + ".min", ts,
+                           cols.dmin[row] if use_global else lmin,
+                           tags, GAUGE, sinks=sinks))
+    if cols.want_sum and (lsum != 0 or use_global):
+        append(InterMetric(name + ".sum", ts,
+                           cols.dsum[row] if use_global else lsum,
+                           tags, GAUGE, sinks=sinks))
+    if cols.want_avg and (use_global or (lsum != 0 and lweight != 0)):
         if use_global:
-            val = float(snap.dsum[row]) / float(snap.dcount[row])
+            val = cols.dsum[row] / cols.dcount[row]
         else:
             val = lsum / lweight
-        out.append(gauge(f"{name}.avg", val))
-    if agg & Aggregate.COUNT and (lweight != 0 or use_global):
-        val = float(snap.dcount[row]) if use_global else lweight
-        out.append(
-            InterMetric(name=f"{name}.count", timestamp=ts, value=val,
-                        tags=list(tags), type=MetricType.COUNTER, sinks=sinks)
-        )
-    if agg & Aggregate.MEDIAN:
+        append(InterMetric(name + ".avg", ts, val, tags, GAUGE, sinks=sinks))
+    if cols.want_count and (lweight != 0 or use_global):
+        append(InterMetric(name + ".count", ts,
+                           cols.dcount[row] if use_global else lweight,
+                           tags, MetricType.COUNTER, sinks=sinks))
+    if cols.want_median:
         # always emitted when configured; the value comes from the digest
-        out.append(
-            gauge(f"{name}.median",
-                  float(snap.quantile_values[row, q_index[0.5]]))
-        )
-    if agg & Aggregate.HARMONIC_MEAN and (
+        append(InterMetric(name + ".median", ts, cols.quant[0.5][row],
+                           tags, GAUGE, sinks=sinks))
+    if cols.want_hmean and (
         use_global or (lrecip != 0 and lweight != 0)
     ):
         if use_global:
-            val = float(snap.dcount[row]) / float(snap.drecip[row])
+            val = cols.dcount[row] / cols.drecip[row]
         else:
             val = lweight / lrecip
-        out.append(gauge(f"{name}.hmean", val))
+        append(InterMetric(name + ".hmean", ts, val, tags, GAUGE,
+                           sinks=sinks))
 
-    for p in percentiles:
-        out.append(
-            gauge(_percentile_name(name, p),
-                  float(snap.quantile_values[row, q_index[float(p)]]))
-        )
-
-    return out
+    if emit_percentiles:
+        for suffix, col in cols.pcols:
+            append(InterMetric(name + suffix, ts, col[row], tags, GAUGE,
+                               sinks=sinks))
 
 
 # ---------------------------------------------------------------------------

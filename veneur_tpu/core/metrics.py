@@ -126,10 +126,12 @@ def route_to(sinks: Optional[frozenset[str]], sink_name: str) -> bool:
     return sinks is None or sink_name in sinks
 
 
-@dataclass
+@dataclass(slots=True)
 class InterMetric:
     """A completed metric ready for sink flushing
-    (reference samplers/samplers.go:48-61)."""
+    (reference samplers/samplers.go:48-61). slots: a flush materializes
+    millions of these; slots cut per-instance memory ~3x and speed
+    construction."""
 
     name: str
     timestamp: int
