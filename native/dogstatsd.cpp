@@ -1214,6 +1214,15 @@ int vn_drain_gauge(void* p, int32_t* rows, double* vals, int cap) {
   return n;
 }
 
+// Cheap emptiness probe so Python-side upsert loops (the global tier
+// imports one series at a time) can skip the buffer-allocating drain
+// when nothing is pending.
+int vn_pending_new_series(void* p) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
+  return static_cast<int>(ctx->new_series.size());
+}
+
 // Drain new-series records: fills parallel arrays plus a packed string
 // buffer of "name\x1fjoined_tags\x1e" records. Returns the count drained
 // (0 if strbuf is too small for the next record).

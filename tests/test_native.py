@@ -591,3 +591,21 @@ def test_native_ssf_decode_fuzz_agrees_with_python():
             assert py_ok, payload
         checked += 1
     assert checked == 3000
+
+
+def test_drain_new_series_survives_full_string_buffer():
+    """A drain round that fills the 1MB string scratch mid-batch must
+    keep going until the queue is empty — stranded records would leave
+    device rows without directory metadata."""
+    ni = native_mod.NativeIngest()
+    long_tag = "env:" + "x" * 400
+    n = 4000  # ~1.6MB of packed records: forces >1 drain round
+    for i in range(n):
+        ni.upsert(f"long.series.{i}", "histogram", long_tag, 0)
+    assert ni.pending_new_series == n
+    records = ni.drain_new_series()
+    assert len(records) == n
+    assert ni.pending_new_series == 0
+    assert records[0][4] == "long.series.0"
+    assert records[-1][4] == f"long.series.{n - 1}"
+    assert records[0][5] == long_tag

@@ -482,6 +482,8 @@ class DeviceWorker:
     def _sync_native_series(self) -> None:
         from veneur_tpu.native import NativeIngest
 
+        if not self._native.pending_new_series:
+            return
         for pool, row, kind, scope, name, joined in (
             self._native.drain_new_series()
         ):
@@ -650,7 +652,8 @@ class DeviceWorker:
                     row, float(m.value), 1.0 / m.sample_rate,
                     host_slot=m.digest)
                 return
-            self._ensure_histo(self.directory.num_histo_rows)
+            self._ensure_histo(
+                max(self.directory.num_histo_rows, row + 1))
             self._ph_rows.append(row)
             self._ph_vals.append(float(m.value))
             self._ph_wts.append(1.0 / m.sample_rate)
@@ -658,7 +661,7 @@ class DeviceWorker:
                 self._flush_pending_histos()
         elif mtype == "set":
             row = self._upsert_set(m.key, scope_class, m.tags)
-            self._ensure_sets(self.directory.num_set_rows)
+            self._ensure_sets(max(self.directory.num_set_rows, row + 1))
             h = self._set_hash64(str(m.value).encode("utf-8"))
             idx, rank = hll_ops.split_hashes(
                 np.array([h], dtype=np.uint64), self.hll_precision
@@ -676,7 +679,12 @@ class DeviceWorker:
         if self._native is not None:
             row = self._native.upsert(key.name, key.type, key.joined_tags,
                                       int(scope_class))
-            self._sync_native_series()
+            # adoption is deferred and batched: metadata drains every
+            # 1024 new series and always before extraction (swap's
+            # native drain syncs) — a per-upsert drain dominated the
+            # global tier's import cost
+            if self._native.pending_new_series >= 1024:
+                self._sync_native_series()
             return row
         row, _ = self.directory.upsert_histo(key, scope_class, tags)
         return row
@@ -686,7 +694,8 @@ class DeviceWorker:
         if self._native is not None:
             row = self._native.upsert(key.name, "set", key.joined_tags,
                                       int(scope_class))
-            self._sync_native_series()
+            if self._native.pending_new_series >= 1024:
+                self._sync_native_series()
             return row
         row, _ = self.directory.upsert_set(key, scope_class, tags)
         return row
@@ -849,7 +858,7 @@ class DeviceWorker:
                 row, np.asarray(means, np.float32),
                 np.asarray(weights, np.float32), float(drecip))
             return
-        self._ensure_histo(self.directory.num_histo_rows)
+        self._ensure_histo(max(self.directory.num_histo_rows, row + 1))
         self._imp_digests.setdefault(row, []).append(
             (np.asarray(means, np.float32), np.asarray(weights, np.float32),
              float(dmin), float(dmax), float(drecip))
@@ -862,7 +871,7 @@ class DeviceWorker:
         if self._staged_sets is not None:
             self._staged_sets.import_dense(row, registers)
             return
-        self._ensure_sets(self.directory.num_set_rows)
+        self._ensure_sets(max(self.directory.num_set_rows, row + 1))
         prev = self._imp_hll.get(row)
         regs = np.asarray(registers, np.int8)
         self._imp_hll[row] = regs if prev is None else np.maximum(prev, regs)

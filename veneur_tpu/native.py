@@ -79,6 +79,8 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.vn_drain_new_series.argtypes = [
             c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
             c.c_char_p, c.c_int, c.POINTER(c.c_int), c.c_int]
+        lib.vn_pending_new_series.restype = c.c_int
+        lib.vn_pending_new_series.argtypes = [c.c_void_p]
         lib.vn_drain_other.restype = c.c_int
         lib.vn_drain_other.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
         lib.vn_upsert.restype = c.c_int
@@ -128,6 +130,15 @@ class NativeIngest:
         self._ctx = lib.vn_ctx_new(hll_precision)
         if set_hash == "metro":
             lib.vn_ctx_set_metro(self._ctx, 1)
+        # drain_new_series scratch, allocated once: the import path calls
+        # it per upsert, and a fresh 1MB ctypes buffer per call was most
+        # of the global tier's per-metric cost
+        self._ns_pools = np.empty(4096, np.int32)
+        self._ns_rows = np.empty(4096, np.int32)
+        self._ns_kinds = np.empty(4096, np.int32)
+        self._ns_scopes = np.empty(4096, np.int32)
+        self._ns_strcap = 1 << 20
+        self._ns_strbuf = ctypes.create_string_buffer(self._ns_strcap)
 
     def __del__(self):
         if getattr(self, "_ctx", None):
@@ -204,15 +215,22 @@ class NativeIngest:
         n = self._lib.vn_drain_gauge(self._ctx, _ptr(rows), _ptr(vals), cap)
         return rows[:n], vals[:n]
 
+    @property
+    def pending_new_series(self) -> int:
+        """Count of undrained new-series records (cheap C call; the
+        per-upsert sync skips the drain entirely when 0)."""
+        return self._lib.vn_pending_new_series(self._ctx)
+
     def drain_new_series(self, max_records: int = 4096):
         """Returns list of (pool, row, kind, scope_class, name, joined_tags).
         pool: 0 histo, 1 set, 2 counter, 3 gauge; kind: MetricKind int."""
-        pools = np.empty(max_records, np.int32)
-        rows = np.empty(max_records, np.int32)
-        kinds = np.empty(max_records, np.int32)
-        scopes = np.empty(max_records, np.int32)
-        strcap = 1 << 20
-        strbuf = ctypes.create_string_buffer(strcap)
+        max_records = min(max_records, 4096)
+        pools = self._ns_pools
+        rows = self._ns_rows
+        kinds = self._ns_kinds
+        scopes = self._ns_scopes
+        strcap = self._ns_strcap
+        strbuf = self._ns_strbuf
         strlen = ctypes.c_int(0)
         out = []
         while True:
@@ -222,7 +240,8 @@ class NativeIngest:
                 max_records)
             if n == 0:
                 break
-            packed = strbuf.raw[:strlen.value]
+            # copy only the used bytes, not the whole scratch buffer
+            packed = ctypes.string_at(strbuf, strlen.value)
             records = packed.split(b"\x1e")[:n]
             for i, rec in enumerate(records):
                 name, _, joined = rec.partition(b"\x1f")
@@ -232,7 +251,17 @@ class NativeIngest:
                     name.decode("utf-8", "replace"),
                     joined.decode("utf-8", "replace"),
                 ))
-            if n < max_records:
+            # n < max_records can mean the string buffer filled mid-batch,
+            # not queue-empty: keep draining until the queue reports empty
+            if self._lib.vn_pending_new_series(self._ctx) == 0:
+                break
+            if n == 0:
+                # a single record larger than the 1MB scratch cannot make
+                # progress; drop the drain rather than spin (series names
+                # and tag sets are bounded far below this in practice)
+                log.error("new-series record exceeds drain buffer; "
+                          "%d records stranded until reset",
+                          self._lib.vn_pending_new_series(self._ctx))
                 break
         return out
 
