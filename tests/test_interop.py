@@ -585,6 +585,27 @@ def test_go_jsonmetric_bad_entry_skipped_not_fatal():
     assert batch.metrics[0].counter.value == 5
 
 
+def test_go_jsonmetric_missing_value_skipped_not_fatal():
+    """A JSONMetric entry with no 'value' field is skipped per-metric, not
+    a batch-wide 400 (ADVICE r2: the value-presence check must come after
+    the tagstring dispatch)."""
+    import base64
+    import json as _json
+
+    from veneur_tpu.distributed.gob import encode_counter
+    from veneur_tpu.distributed.import_server import decode_http_import_body
+
+    body = _json.dumps([
+        {"name": "no.value", "type": "counter", "tagstring": "",
+         "tags": None},
+        {"name": "ok.count", "type": "counter", "tagstring": "",
+         "tags": ["a:1"],
+         "value": base64.b64encode(encode_counter(5)).decode()},
+    ]).encode()
+    batch = decode_http_import_body(body, "")
+    assert [m.name for m in batch.metrics] == ["ok.count"]
+
+
 def test_go_body_through_proxy_ring_to_globals():
     """A stock Go local can POST its /import body at OUR proxy tier: the
     body decodes, ring-splits by metric key, and reaches the owning

@@ -232,6 +232,21 @@ def test_load_config_yaml_env_overlay(tmp_path):
     assert red["datadog_api_key"] == "REDACTED"
 
 
+def test_load_config_deprecated_aliases(tmp_path):
+    """ssf_buffer_size / flush_max_per_body are deprecated aliases for the
+    datadog_* knobs (reference config_parse.go:172-183); they fill the new
+    key only when it was left at its default."""
+    p = tmp_path / "cfg.yaml"
+    p.write_text("ssf_buffer_size: 999\nflush_max_per_body: 1234\n")
+    cfg = load_config(str(p))
+    assert cfg.datadog_span_buffer_size == 999
+    assert cfg.datadog_flush_max_per_body == 1234
+    # explicit new-key value wins over the alias
+    p.write_text("ssf_buffer_size: 999\ndatadog_span_buffer_size: 777\n")
+    cfg = load_config(str(p))
+    assert cfg.datadog_span_buffer_size == 777
+
+
 def test_load_config_strict_rejects_unknown(tmp_path):
     p = tmp_path / "cfg.yaml"
     p.write_text("no_such_key: true\n")

@@ -90,6 +90,10 @@ class Config:
     tags: list[str] = field(default_factory=list)
     tags_exclude: list[str] = field(default_factory=list)
     span_channel_capacity: int = 100
+    # accepted for config compatibility only: upstream this is a
+    # deprecated alias for datadog_span_buffer_size (config_parse.go:
+    # 172-176), a span-count knob — NOT a recv-buffer size. SSF recv
+    # buffers are sized from trace_max_length_bytes (server.go:859-863).
     ssf_buffer_size: int = 16 * 1024
     read_buffer_size_bytes: int = 2 * 1048576
 
@@ -415,6 +419,19 @@ def load_config(path: Optional[str] = None, data: Optional[dict] = None,
                     cfg, name, _coerce(env[candidate], getattr(cfg, name), name)
                 )
                 break
+
+    # deprecated-alias fixups (reference config_parse.go:172-183)
+    if cfg.ssf_buffer_size != Config.ssf_buffer_size:
+        log.warning("ssf_buffer_size has been replaced by"
+                    " datadog_span_buffer_size")
+        if cfg.datadog_span_buffer_size == Config.datadog_span_buffer_size:
+            cfg.datadog_span_buffer_size = cfg.ssf_buffer_size
+    if cfg.flush_max_per_body != Config.flush_max_per_body:
+        log.warning("flush_max_per_body has been replaced by"
+                    " datadog_flush_max_per_body")
+        if (cfg.datadog_flush_max_per_body
+                == Config.datadog_flush_max_per_body):
+            cfg.datadog_flush_max_per_body = cfg.flush_max_per_body
 
     validate_config(cfg)
     return cfg

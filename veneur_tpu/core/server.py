@@ -394,14 +394,19 @@ class Server:
 
         def loop():
             sock.settimeout(0.5)  # quiesce-able without closing (handoff)
-            # per-datagram read buffer (reference ssf_buffer_size,
-            # networking.go pool sizing). As in the reference, a datagram
-            # larger than the buffer is truncated by recv and the remnant
-            # fails proto parse -> counted as a parse error
-            max_len = self.config.ssf_buffer_size
+            # per-datagram read buffer sized from trace_max_length_bytes,
+            # matching the reference's tracePool (server.go:859-863) — NOT
+            # ssf_buffer_size, which upstream is a deprecated span-count
+            # alias (config_parse.go:172-176). Inet UDP datagrams cap at
+            # 65507B, so clamp there; a datagram larger than the buffer is
+            # truncated by recv and fails proto parse -> parse error, as
+            # in the reference.
+            max_len = min(self.config.trace_max_length_bytes, 65536)
+            buf = bytearray(max_len)
             while not (self._shutdown.is_set() or self._quiesce.is_set()):
                 try:
-                    data = sock.recv(max_len)
+                    n = sock.recv_into(buf, max_len)
+                    data = bytes(buf[:n])
                 except socket.timeout:
                     continue
                 except OSError:
@@ -471,12 +476,17 @@ class Server:
         sock = self._bind_unix_socket(path, socket.SOCK_DGRAM)
 
         def loop():
+            # unix datagrams are not bound by the inet 64KiB limit, so the
+            # buffer is the full trace_max_length_bytes (reference
+            # tracePool, server.go:859-863), allocated once per listener
+            max_len = self.config.trace_max_length_bytes
+            buf = bytearray(max_len)
             while not self._shutdown.is_set():
                 try:
-                    data = sock.recv(self.config.ssf_buffer_size)
+                    n = sock.recv_into(buf, max_len)
                 except OSError:
                     return
-                self.handle_trace_packet(data)
+                self.handle_trace_packet(bytes(buf[:n]))
 
         self._spawn(loop, "ssf-unixgram")
 

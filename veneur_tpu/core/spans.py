@@ -182,6 +182,21 @@ class _SinkLane:
         except queue.Full:
             return False
 
+    def drain(self, deadline: float) -> bool:
+        """Wait briefly (until monotonic `deadline`) for the lane to go
+        idle so spans accepted this interval make the flush they arrived
+        in rather than the next one (reference ingests synchronously in
+        Work, worker.go:611-695, so never observes this skew). Idleness is
+        tracked with the queue's unfinished-task counter, which only drops
+        after ingest completes — immune to the get()-returned-but-not-yet-
+        busy window. Bounded: a wedged sink costs at most the deadline,
+        never a stall."""
+        while time.monotonic() < deadline:
+            if self.q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.001)
+        return False
+
     def take_errors(self) -> int:
         with self._err_lock:
             n = self.errors
@@ -192,6 +207,7 @@ class _SinkLane:
         while True:
             span = self.q.get()
             if span is None:
+                self.q.task_done()
                 return
             self._busy[slot] = time.monotonic()
             try:
@@ -203,6 +219,7 @@ class _SinkLane:
                           self.sink.name(), e)
             finally:
                 self._busy[slot] = 0.0
+                self.q.task_done()
 
     def stop(self) -> None:
         # sentinel delivery must not block on a full lane (the lane being
@@ -323,7 +340,15 @@ class SpanWorker:
                     name = lane.sink.name()
                     self.sink_errors[name] = (
                         self.sink_errors.get(name, 0) + n)
+        # give the lanes a moment to finish spans already accepted this
+        # interval, so they ship in this flush instead of the next; one
+        # shared deadline bounds the whole pass at 0.5s no matter how
+        # many sinks are backed up
+        drain_deadline = time.monotonic() + 0.5
         for sink in self.span_sinks:
+            lane = self._lanes.get(id(sink))
+            if lane is not None:
+                lane.drain(drain_deadline)
             try:
                 sink.flush()
             except Exception:

@@ -109,8 +109,6 @@ def decode_http_import_body(body: bytes, content_encoding: str
             raise ValueError("import body must be a non-empty metric list")
         batch = pb.MetricBatch()
         for item in items:
-            if "value" not in item:
-                raise ValueError("metric entry lacks a value field")
             if "tagstring" in item:
                 # a stock Go veneur local's JSONMetric body
                 # (samplers.go:102-108; gob/LE/HLL value encodings).
@@ -130,6 +128,12 @@ def decode_http_import_body(body: bytes, content_encoding: str
                 if m is not None:
                     batch.metrics.append(m)
                 continue
+            # native JSON entries (not Go JSONMetric) still fail the whole
+            # batch on a missing value: there is no reference per-metric
+            # skip contract for our own format, and a 400 surfaces the
+            # client bug immediately
+            if "value" not in item:
+                raise ValueError("metric entry lacks a value field")
             m = pb.Metric.FromString(base64.b64decode(item["value"]))
             batch.metrics.append(m)
         return batch

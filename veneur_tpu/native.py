@@ -239,6 +239,14 @@ class NativeIngest:
                 _ptr(scopes), strbuf, strcap, ctypes.byref(strlen),
                 max_records)
             if n == 0:
+                stranded = self._lib.vn_pending_new_series(self._ctx)
+                if stranded:
+                    # a single record larger than the 1MB scratch cannot
+                    # make progress; drop the drain rather than spin
+                    # (series names and tag sets are bounded far below
+                    # this in practice)
+                    log.error("new-series record exceeds drain buffer; "
+                              "%d records stranded until reset", stranded)
                 break
             # copy only the used bytes, not the whole scratch buffer
             packed = ctypes.string_at(strbuf, strlen.value)
@@ -254,14 +262,6 @@ class NativeIngest:
             # n < max_records can mean the string buffer filled mid-batch,
             # not queue-empty: keep draining until the queue reports empty
             if self._lib.vn_pending_new_series(self._ctx) == 0:
-                break
-            if n == 0:
-                # a single record larger than the 1MB scratch cannot make
-                # progress; drop the drain rather than spin (series names
-                # and tag sets are bounded far below this in practice)
-                log.error("new-series record exceeds drain buffer; "
-                          "%d records stranded until reset",
-                          self._lib.vn_pending_new_series(self._ctx))
                 break
         return out
 

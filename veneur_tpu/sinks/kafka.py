@@ -73,6 +73,12 @@ def default_producer(broker: str, retry_max: int = 3,
         def send(self, topic, key, value):
             prod.send(topic, key=key, value=value)
             if buffer_messages:
+                # deliberate approximation of sarama's message-count
+                # window: the counter is exact (locked), but the flush
+                # itself runs outside the lock so a slow broker ack never
+                # serializes the other span workers' sends. Every send
+                # counted toward a window reached prod.send() before the
+                # window's flush starts, so nothing is left behind.
                 with self._lock:
                     self._since_flush += 1
                     due = self._since_flush >= buffer_messages
