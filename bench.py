@@ -27,6 +27,7 @@ Env knobs: VENEUR_BENCH_SERIES (default 16384), VENEUR_BENCH_BATCH (default
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import subprocess
@@ -34,6 +35,25 @@ import sys
 import time
 
 import numpy as np
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+BENCH_CACHE = os.path.join(_REPO, "BENCH_CACHE.json")
+# the tunnelled accelerator relay is effectively single-client: two
+# processes initializing the backend concurrently wedge each other AND
+# the relay (observed round 2: 25-min init hang, then an hour-plus
+# wedge). Every backend probe and every on-accelerator child takes this
+# exclusive lock; tools/bench_capture.py takes the same one.
+_AXON_LOCK = "/tmp/veneur_tpu_axon.lock"
+
+
+class _axon_lock:
+    def __enter__(self):
+        self._f = open(_AXON_LOCK, "w")
+        fcntl.flock(self._f, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
 
 
 def _ensure_live_backend() -> None:
@@ -53,10 +73,11 @@ def _ensure_live_backend() -> None:
     reason = "unknown"
     for i in range(attempts):
         try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices(), flush=True)"],
-                timeout=timeout, capture_output=True, check=True)
+            with _axon_lock():
+                r = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; print(jax.devices(), flush=True)"],
+                    timeout=timeout, capture_output=True, check=True)
             print(f"bench: accelerator backend live: "
                   f"{r.stdout.decode(errors='replace').strip()}",
                   file=sys.stderr)
@@ -449,14 +470,38 @@ def _run_workload_subprocess(wname: str, timeout_s: float,
     env["_VENEUR_BENCH_CHILD"] = "1"  # skip the probe; parent did it
     if cpu:
         _force_cpu_env(env)
-    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                       env=env, timeout=timeout_s, capture_output=True)
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, timeout=timeout_s, capture_output=True)
+    else:
+        with _axon_lock():
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, timeout=timeout_s,
+                               capture_output=True)
     err_tail = r.stderr.decode(errors="replace").strip()[-800:]
     if r.returncode != 0:
         raise RuntimeError(
             f"workload child rc={r.returncode}: {err_tail}")
     line = r.stdout.decode(errors="replace").strip().splitlines()[-1]
     return json.loads(line)
+
+
+def _cached_result(wname: str) -> dict | None:
+    """Last good ON-CHIP number for this workload, captured earlier by
+    tools/bench_capture.py while the flaky relay was in a live window.
+    Emitted with a staleness marker when the live run falls back to CPU:
+    a dated on-chip record beats a fresh number from the wrong platform."""
+    try:
+        cache = json.load(open(BENCH_CACHE))
+    except (OSError, ValueError):
+        return None
+    res = cache.get("results", {}).get(wname)
+    if not res or res.get("platform") != "tpu":
+        return None
+    res = dict(res)
+    res["cached"] = True
+    res["captured_at"] = cache.get("captured_at")
+    res["captured_rev"] = cache.get("git_rev")
+    return res
 
 
 def main() -> None:
@@ -466,7 +511,11 @@ def main() -> None:
         if workload is None:
             sys.exit(f"unknown VENEUR_BENCH_WORKLOAD {name!r}; "
                      f"valid: {', '.join(sorted(WORKLOADS))}")
-        print(json.dumps(workload()), flush=True)
+        result = workload()
+        import jax
+
+        result["platform"] = jax.default_backend()
+        print(json.dumps(result), flush=True)
         return
     # No selector: run ALL five BASELINE workloads, one JSON line each,
     # each in its own child process with a timeout + one retry (the
@@ -499,17 +548,37 @@ def main() -> None:
                       f"failed — {reason}", file=sys.stderr)
                 if time.time() + 60 < deadline and attempt + 1 < attempts:
                     time.sleep(30)
+        if result is not None and result.get("platform") != "tpu":
+            # the child ran but not on the chip (backend fell back
+            # somewhere): prefer a cached on-chip record over it
+            cached = _cached_result(wname)
+            if cached is not None:
+                cached["note"] = ("cached on-chip result; live run was "
+                                  f"platform={result.get('platform')}")
+                result = cached
         if result is None and not on_cpu:
-            # accelerator path kept failing: record a CPU number for this
-            # workload rather than nothing, and say why — but never blow
-            # far past the caller's deadline doing it
-            try:
-                budget = min(600.0, max(120.0, deadline - time.time()))
-                result = _run_workload_subprocess(wname, budget, cpu=True)
-                result["note"] = (f"cpu fallback (accelerator failed: "
-                                  f"{reason[:300]})")
-            except Exception as e:
-                reason += f"; cpu fallback also failed: {e}"
+            # accelerator path kept failing: emit the last good on-chip
+            # number if one was captured earlier in the round, else a CPU
+            # number rather than nothing — and say why
+            cached = _cached_result(wname)
+            if cached is not None:
+                cached["note"] = (f"cached on-chip result; live run "
+                                  f"failed: {reason[:200]}")
+                result = cached
+            else:
+                try:
+                    budget = min(600.0, max(120.0, deadline - time.time()))
+                    result = _run_workload_subprocess(wname, budget,
+                                                      cpu=True)
+                    result["note"] = (f"cpu fallback (accelerator failed: "
+                                      f"{reason[:300]})")
+                except Exception as e:
+                    reason += f"; cpu fallback also failed: {e}"
+        elif result is None and on_cpu:
+            cached = _cached_result(wname)
+            if cached is not None:
+                cached["note"] = "cached on-chip result (cpu re-exec run)"
+                result = cached
         if result is None:
             result = {"metric": wname, "error": reason[-500:]}
         print(json.dumps(result), flush=True)

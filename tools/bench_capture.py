@@ -1,0 +1,156 @@
+"""Resilient on-TPU bench capture loop.
+
+The tunnelled TPU relay wedges transiently (observed in rounds 1-3:
+``jax.devices()`` hangs >300s, then heals within tens of minutes to
+hours). Round 1 and 2 bench artifacts were CPU fallbacks because
+bench.py only probed for ~15 minutes at the end of the round. This tool
+inverts the strategy: run it in the background for the WHOLE round; it
+probes the backend every few minutes, and the moment the relay is live
+it captures all five BASELINE workloads on-chip and writes them to
+``BENCH_CACHE.json`` at the repo root. bench.py then emits the cached
+on-chip numbers (with a staleness marker) whenever its own live run
+would otherwise fall back to CPU.
+
+Single-client discipline: the relay wedges when two processes
+initialize the TPU backend concurrently, so this loop takes an
+exclusive flock on ``/tmp/veneur_tpu_axon.lock`` around every probe and
+every workload child. Anything else that touches the TPU should take
+the same lock (bench.py does).
+
+Usage:
+    python tools/bench_capture.py [--once] [--interval 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import fcntl
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CACHE = os.path.join(REPO, "BENCH_CACHE.json")
+LOCK_PATH = "/tmp/veneur_tpu_axon.lock"
+WORKLOADS = ("mixed", "global_merge", "ssf_histo", "prometheus_1m",
+             "timer_replay")
+
+
+def axon_lock():
+    f = open(LOCK_PATH, "w")
+    fcntl.flock(f, fcntl.LOCK_EX)
+    return f
+
+
+def probe(timeout: float = 240.0) -> str | None:
+    """Return the live platform name, or None if the backend is wedged."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            timeout=timeout, capture_output=True, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    return r.stdout.decode().strip() or None
+
+
+def run_workload(name: str, timeout: float = 900.0) -> dict | None:
+    env = dict(os.environ)
+    env["VENEUR_BENCH_WORKLOAD"] = name
+    env["_VENEUR_BENCH_CHILD"] = "1"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, timeout=timeout, capture_output=True, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"capture: {name} timed out after {timeout}s", file=sys.stderr)
+        return None
+    if r.returncode != 0:
+        tail = r.stderr.decode(errors="replace")[-500:]
+        print(f"capture: {name} rc={r.returncode}: {tail}", file=sys.stderr)
+        return None
+    try:
+        line = r.stdout.decode(errors="replace").strip().splitlines()[-1]
+        return json.loads(line)
+    except (IndexError, ValueError) as e:
+        print(f"capture: {name} bad output: {e}", file=sys.stderr)
+        return None
+
+
+def git_rev() -> str:
+    try:
+        return subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              capture_output=True, cwd=REPO, timeout=10
+                              ).stdout.decode().strip()
+    except Exception:
+        return "unknown"
+
+
+def capture_all() -> bool:
+    """One full on-chip capture pass. Returns True if every workload
+    produced an on-TPU number (partial results are still cached)."""
+    existing: dict = {}
+    if os.path.exists(CACHE):
+        try:
+            existing = json.load(open(CACHE)).get("results", {})
+        except Exception:
+            existing = {}
+    results = dict(existing)
+    complete = True
+    for name in WORKLOADS:
+        with axon_lock():
+            res = run_workload(name)
+        if res is None or res.get("platform") != "tpu":
+            complete = False
+            print(f"capture: {name}: no on-chip result this pass "
+                  f"(got {res and res.get('platform')})", file=sys.stderr)
+            continue
+        results[name] = res
+        # persist incrementally: a wedge mid-pass must not lose the
+        # workloads already captured
+        json.dump({
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "captured_unix": time.time(),
+            "git_rev": git_rev(),
+            "platform": "tpu",
+            "results": results,
+        }, open(CACHE, "w"), indent=1)
+        print(f"capture: {name}: {res}", file=sys.stderr)
+    return complete and all(n in results for n in WORKLOADS)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--once", action="store_true",
+                    help="one probe+capture attempt, then exit")
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between probes while wedged")
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    while time.time() < deadline:
+        with axon_lock():
+            plat = probe()
+        if plat == "tpu":
+            print("capture: TPU live — capturing all workloads",
+                  file=sys.stderr)
+            if capture_all():
+                print("capture: complete on-chip artifact cached",
+                      file=sys.stderr)
+                return
+        else:
+            print(f"capture: backend not live (platform={plat}); "
+                  f"retrying in {args.interval:.0f}s", file=sys.stderr)
+        if args.once:
+            return
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
