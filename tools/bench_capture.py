@@ -124,6 +124,38 @@ def capture_all() -> bool:
     return complete and all(n in results for n in WORKLOADS)
 
 
+def capture_auxiliary() -> None:
+    """On-chip OVERLAP.json and PALLAS_AB.json (verdict r2 items 2): run
+    the overlap harness and the Pallas-vs-XLA A/B once the relay is live.
+    Each tool writes its artifact itself; failures are logged, not fatal."""
+    for script, artifact, timeout in (
+            ("tools/bench_overlap.py", "OVERLAP.json", 1200),
+            ("tools/bench_pallas_ab.py", "PALLAS_AB.json", 1200)):
+        # skip if the artifact is already an on-TPU capture
+        path = os.path.join(REPO, artifact)
+        try:
+            if json.load(open(path)).get("platform") == "tpu":
+                continue
+        except (OSError, ValueError):
+            pass
+        with axon_lock():
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.join(REPO, script)],
+                    timeout=timeout, capture_output=True, cwd=REPO)
+            except subprocess.TimeoutExpired:
+                print(f"capture: {script} timed out", file=sys.stderr)
+                continue
+        if r.returncode != 0:
+            print(f"capture: {script} rc={r.returncode}: "
+                  f"{r.stderr.decode(errors='replace')[-400:]}",
+                  file=sys.stderr)
+        else:
+            print(f"capture: {script} -> {artifact}: "
+                  f"{r.stdout.decode(errors='replace').strip()[-300:]}",
+                  file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--once", action="store_true",
@@ -140,7 +172,9 @@ def main() -> None:
         if plat == "tpu":
             print("capture: TPU live — capturing all workloads",
                   file=sys.stderr)
-            if capture_all():
+            done = capture_all()
+            capture_auxiliary()
+            if done:
                 print("capture: complete on-chip artifact cached",
                       file=sys.stderr)
                 return

@@ -1,0 +1,144 @@
+"""Pallas-vs-XLA flush-extraction A/B: correctness + latency on the
+current backend.
+
+The fused Pallas kernel (ops/pallas_kernels.flush_extract) is the TPU
+flush hot path; until round 3 it had only ever run in interpret mode
+(tests/test_pallas.py). This harness runs BOTH implementations over the
+same realistically-filled digest pool and records:
+
+* correctness — max |Δ| between the kernel's quantiles/sums/counts and
+  the XLA oracle (flush_extract_reference), NaN agreement included;
+* latency — median + p90 wall time of each path over N timed runs,
+  forced with a scalar fetch (block_until_ready is unreliable through
+  the relay).
+
+Writes PALLAS_AB.json at the repo root and prints one JSON line. On a
+non-TPU backend the kernel runs in interpret mode: correctness is still
+meaningful, latency is not (and is marked as such).
+
+Env: VENEUR_AB_SERIES (default 2^20 on TPU, 2^14 elsewhere),
+VENEUR_AB_ITERS (default 10).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_pool(series: int):
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import tdigest as td
+
+    rng = np.random.default_rng(11)
+    pool = td.init_pool(series, td.DEFAULT_CAPACITY)
+    batch = min(series * 8, 1 << 23)
+    rows = ((np.arange(batch, dtype=np.int64) * 2654435761) % series
+            ).astype(np.int32)
+    vals = rng.gamma(2.0, 50.0, batch).astype(np.float32)
+    m, w, a, b, r, _ = td.add_batch(
+        pool.means, pool.weights, pool.min, pool.max, pool.recip,
+        jnp.asarray(rows), jnp.asarray(vals),
+        jnp.ones(batch, np.float32))
+    return m, w, a, b
+
+
+def time_path(fn, means, weights, dmin, dmax, qs, iters: int,
+              bump_means) -> dict:
+    import jax.numpy as jnp
+
+    # warmup/compile
+    out = fn(means, weights, dmin, dmax, qs)
+    float(jnp.sum(jnp.where(jnp.isnan(out[0]), 0.0, out[0]))
+          + jnp.sum(out[1]))
+    lat = []
+    for i in range(iters):
+        # perturb inputs so the relay can't dedupe identical executions
+        m = bump_means(means, i)
+        t0 = time.perf_counter()
+        out = fn(m, weights, dmin, dmax, qs)
+        float(jnp.sum(jnp.where(jnp.isnan(out[0]), 0.0, out[0]))
+              + jnp.sum(out[1]))
+        lat.append(time.perf_counter() - t0)
+    return {
+        "median_s": round(float(np.median(lat)), 5),
+        "p90_s": round(float(np.percentile(lat, 90)), 5),
+        "iters": iters,
+    }
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import pallas_kernels as pk
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    series = int(os.environ.get("VENEUR_AB_SERIES",
+                                1 << 20 if on_tpu else 1 << 14))
+    iters = int(os.environ.get("VENEUR_AB_ITERS", 10))
+    qs = jnp.asarray(np.array([0.5, 0.9, 0.99], np.float32))
+
+    means, weights, dmin, dmax = build_pool(series)
+
+    def pallas_fn(m, w, a, b, q):
+        return pk.flush_extract(m, w, a, b, q, interpret=not on_tpu)
+
+    # correctness: kernel vs XLA oracle on identical inputs
+    kq, ks, kc = pallas_fn(means, weights, dmin, dmax, qs)
+    oq, osum, ocount = pk.flush_extract_reference(
+        means, weights, dmin, dmax, qs)
+    kq_n, oq_n = np.asarray(kq), np.asarray(oq)
+    nan_agree = bool(np.array_equal(np.isnan(kq_n), np.isnan(oq_n)))
+    mask = ~np.isnan(oq_n)
+    scale = max(1.0, float(np.nanmax(np.abs(oq_n))))
+    max_dq = float(np.max(np.abs(kq_n[mask] - oq_n[mask]))) if mask.any() \
+        else 0.0
+    max_ds = float(np.max(np.abs(np.asarray(ks) - np.asarray(osum))))
+    max_dc = float(np.max(np.abs(np.asarray(kc) - np.asarray(ocount))))
+
+    def bump(m, i):
+        return m + np.float32((i + 1) * 1e-6)
+
+    out = {
+        "platform": backend,
+        "series": series,
+        "interpret_mode": not on_tpu,
+        "correctness": {
+            "nan_pattern_agrees": nan_agree,
+            "max_abs_dq": round(max_dq, 6),
+            "max_rel_dq": round(max_dq / scale, 9),
+            "max_abs_dsum": round(max_ds, 4),
+            "max_abs_dcount": round(max_dc, 6),
+        },
+        "pallas": time_path(pallas_fn, means, weights, dmin, dmax, qs,
+                            iters, bump),
+        "xla": time_path(pk.flush_extract_reference, means, weights,
+                         dmin, dmax, qs, iters, bump),
+    }
+    if not on_tpu:
+        out["note"] = ("non-TPU backend: kernel ran in interpret mode; "
+                       "latency numbers are not meaningful, correctness "
+                       "is")
+    out["speedup_pallas_vs_xla"] = round(
+        out["xla"]["median_s"] / max(out["pallas"]["median_s"], 1e-9), 3)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "PALLAS_AB.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"platform": backend,
+                      "max_rel_dq": out["correctness"]["max_rel_dq"],
+                      "pallas_median_s": out["pallas"]["median_s"],
+                      "xla_median_s": out["xla"]["median_s"],
+                      "speedup": out["speedup_pallas_vs_xla"]}))
+
+
+if __name__ == "__main__":
+    main()

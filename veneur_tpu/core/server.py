@@ -940,6 +940,14 @@ class Server:
                 "flush.unique_timeseries_total", self._tally_timeseries(snaps),
                 tags=[f"global_veneur:{str(not self.is_local).lower()}"])
         self.stats.count("flush.post_metrics_total", len(final))
+        from veneur_tpu.core.worker import DeviceWorker as _DW
+
+        if _DW.pallas_fallbacks:
+            # nonzero means the fused TPU kernel raised and extraction
+            # was demoted to the XLA path for the process lifetime
+            self.stats.count("flush.pallas_fallback_total",
+                             _DW.pallas_fallbacks)
+            _DW.pallas_fallbacks = 0
         for svc, n in span_counts.items():
             self.stats.count("ssf.received_total", n,
                              tags=[f"service:{svc}"])

@@ -34,6 +34,7 @@ program at flush; HLLs fold with np.maximum and one scatter-max
 from __future__ import annotations
 
 import functools
+import logging
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -47,6 +48,8 @@ from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import tdigest as td
 from veneur_tpu.ops.scalars import counter_contribution
 from veneur_tpu.utils.hashing import hll_hash, fmix64, metric_digest
+
+log = logging.getLogger("veneur_tpu.core.worker")
 
 
 def _next_pow2(n: int, floor: int = 1) -> int:
@@ -943,6 +946,11 @@ class DeviceWorker:
             )
 
     _pallas_ok: Optional[bool] = None
+    # process-lifetime count of Pallas->XLA demotions, surfaced in the
+    # flush self-telemetry (veneur.flush.pallas_fallback_total) so a
+    # TPU-side kernel bug can't silently demote every flush to the slow
+    # path with no signal
+    pallas_fallbacks: int = 0
 
     def _extract(self, histo: "HistoDeviceState", qs):
         """Flush extraction: the fused Pallas kernel on TPU, the XLA
@@ -965,6 +973,11 @@ class DeviceWorker:
                         histo.lrecip + histo.lrecip_c)
             except Exception:  # pragma: no cover - TPU-only path
                 DeviceWorker._pallas_ok = False
+                DeviceWorker.pallas_fallbacks += 1
+                log.error(
+                    "pallas flush_extract failed; demoting to the XLA "
+                    "extraction path for the process lifetime",
+                    exc_info=True)
         return _histo_flush_extract(
             histo.means, histo.weights, histo.dmin, histo.dmax,
             histo.drecip, histo.drecip_c, histo.lmin, histo.lmax,
