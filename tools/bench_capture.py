@@ -130,7 +130,8 @@ def capture_auxiliary() -> None:
     Each tool writes its artifact itself; failures are logged, not fatal."""
     for script, artifact, timeout in (
             ("tools/bench_overlap.py", "OVERLAP.json", 1200),
-            ("tools/bench_pallas_ab.py", "PALLAS_AB.json", 1200)):
+            ("tools/bench_pallas_ab.py", "PALLAS_AB.json", 1200),
+            ("tools/bench_e2e_flush.py", "E2E_FLUSH.json", 1800)):
         # skip if the artifact is already an on-TPU capture
         path = os.path.join(REPO, artifact)
         try:

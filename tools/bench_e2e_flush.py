@@ -1,0 +1,125 @@
+"""End-to-end 1M-series Server.flush() latency, with phase breakdown.
+
+The kernel benches (bench.py prometheus_1m) time the raw t-digest
+extraction; this harness times the PRODUCT: a real Server with native
+C++ ingest, S unique histogram series driven through the DogStatsD
+packet path (parse -> directory -> device pool), then one full
+Server.flush() — swap, device extraction, InterMetric generation, sink
+fan-out to a blackhole sink — against the reference's 10s interval
+budget (flusher.go:28-131; the north-star latency metric of
+BASELINE.md).
+
+Writes E2E_FLUSH.json at the repo root and prints one JSON line.
+
+Env: VENEUR_E2E_SERIES (default 2^20 on TPU, 2^17 elsewhere),
+VENEUR_E2E_SAMPLES_PER_SERIES (default 4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_datagrams(series: int, samples_per_series: int,
+                    max_len: int) -> list[bytes]:
+    """Multi-line DogStatsD datagrams covering `series` unique timer
+    series (name + one tag varied), each series hit
+    `samples_per_series` times."""
+    datagrams = []
+    lines = []
+    size = 0
+    for rep in range(samples_per_series):
+        for i in range(series):
+            line = b"e2e.m%d:%d|ms|#shard:%d" % (i, (i * 7 + rep) % 1000,
+                                                 i % 64)
+            if size + len(line) + 1 > max_len:
+                datagrams.append(b"\n".join(lines))
+                lines, size = [], 0
+            lines.append(line)
+            size += len(line) + 1
+    if lines:
+        datagrams.append(b"\n".join(lines))
+    return datagrams
+
+
+def main() -> None:
+    import jax
+
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    series = int(os.environ.get("VENEUR_E2E_SERIES",
+                                1 << 20 if on_tpu else 1 << 16))
+    per = int(os.environ.get("VENEUR_E2E_SAMPLES_PER_SERIES", 4))
+
+    cfg = Config(interval="10s", percentiles=[0.5, 0.9, 0.99],
+                 aggregates=["min", "max", "count"],
+                 tpu_native_ingest=True, num_workers=1, num_readers=1)
+    srv = Server(cfg, metric_sinks=[BlackholeMetricSink()])
+    if not srv.native_mode:
+        print("warning: native ingest unavailable; using Python parser",
+              file=sys.stderr)
+
+    t0 = time.perf_counter()
+    datagrams = build_datagrams(series, per, cfg.metric_max_length)
+    gen_s = time.perf_counter() - t0
+
+    # round 1 is the cold pass: the pool grows to its full shape and XLA
+    # compiles the ingest/extraction programs for it. Round 2 is the
+    # steady state being measured — the reference's world, where every
+    # 10s interval sees the same series again and reuses everything
+    # (metrics expire at flush, README.md:135-137, so each round
+    # re-registers all series in a fresh epoch).
+    rounds = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for d in datagrams:
+            srv.process_metric_packet(d)
+        ingest_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        final = srv.flush()
+        flush_s = time.perf_counter() - t0
+        rounds.append((ingest_s, flush_s, dict(srv.last_flush_phases),
+                       len(final)))
+    cold_ingest_s, cold_flush_s, _, _ = rounds[0]
+    ingest_s, flush_s, phases, n_final = rounds[1]
+    final_count = n_final
+
+    n_samples = series * per
+    out = {
+        "platform": backend,
+        "series": series,
+        "samples": n_samples,
+        "datagram_gen_s": round(gen_s, 3),
+        "cold_ingest_s": round(cold_ingest_s, 3),
+        "cold_flush_s": round(cold_flush_s, 3),
+        "ingest_s": round(ingest_s, 3),
+        "ingest_samples_per_s": round(n_samples / ingest_s, 1),
+        "flush_total_s": round(flush_s, 3),
+        "flush_phases": {k: round(v, 3) for k, v in phases.items()},
+        "inter_metrics": final_count,
+        "inter_metrics_per_series": round(final_count / series, 2),
+        "budget_s": 10.0,
+        "fits_interval": flush_s < 10.0,
+        "vs_baseline": round(10.0 / flush_s, 2),
+    }
+    srv.shutdown()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "E2E_FLUSH.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"metric": "e2e_flush_latency_s",
+                      "value": out["flush_total_s"], "unit": "s",
+                      "vs_baseline": out["vs_baseline"],
+                      "platform": backend}))
+
+
+if __name__ == "__main__":
+    main()

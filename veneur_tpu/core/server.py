@@ -177,6 +177,7 @@ class Server:
         self._shutdown_once_lock = threading.Lock()
         self._shutdown_done = False
         self.last_flush_unix = time.time()
+        self.last_flush_phases: dict[str, float] = {}
         self.flush_count = 0
 
         # ingest counters (self-telemetry)
@@ -836,6 +837,12 @@ class Server:
         self.last_flush_unix = flush_start
         self.flush_count += 1
         self.stats.gauge("flush.flush_timestamp_ns", flush_start * 1e9)
+        # per-phase wall times of this flush (reference tallyMetrics/
+        # generateInterMetrics timing samples, flusher.go:169-298);
+        # read by tools/bench_e2e_flush.py for the 1M-series artifact
+        phases: dict[str, float] = {}
+        self.last_flush_phases = phases
+        _t = time.perf_counter()
 
         other_samples = self.event_worker.flush()
         for sink in self.metric_sinks:
@@ -877,6 +884,8 @@ class Server:
                 self.stats.count("worker.metrics_imported_total",
                                  worker.imported, tags=[f"worker:{i}"])
                 swapped.append(worker.swap(qs))
+        phases["swap_s"] = time.perf_counter() - _t
+        _t = time.perf_counter()
         snaps: list[FlushSnapshot] = []
         for i, (worker, sw) in enumerate(zip(self.workers, swapped)):
             try:
@@ -899,6 +908,8 @@ class Server:
                     self.stats.count("worker.metrics_flushed_total", n,
                                      tags=[f"metric_type:{mtype}"])
 
+        phases["extract_s"] = time.perf_counter() - _t
+        _t = time.perf_counter()
         final: list[InterMetric] = []
         for snap in snaps:
             final.extend(
@@ -906,6 +917,8 @@ class Server:
                     snap, self.is_local, self.percentiles, self.aggregates
                 )
             )
+        phases["generate_s"] = time.perf_counter() - _t
+        _t = time.perf_counter()
 
         if self.is_local and self.forwarder is not None:
             fwd_thread = threading.Thread(
@@ -928,6 +941,7 @@ class Server:
                 threads.append(t)
             for t in threads:
                 t.join(timeout=self.interval)
+            phases["sink_flush_s"] = time.perf_counter() - _t
             if self.plugins:
                 threading.Thread(
                     target=self._flush_plugins, args=(final,), daemon=True,
