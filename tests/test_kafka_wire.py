@@ -1,0 +1,314 @@
+"""Kafka wire-protocol producer tests against a scripted fake broker.
+
+The fake broker speaks real Kafka frames: it parses Metadata v0 and
+Produce v1 requests byte-for-byte (including CRC validation of every
+message) and responds with real response frames — so a producer that
+passes here emits bytes an actual broker would accept (reference sink:
+sarama producer, sinks/kafka/kafka.go).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import zlib
+
+import pytest
+
+from veneur_tpu.sinks.kafka_wire import (
+    KafkaWireProducer, _fnv1a32, _Reader, enc_string,
+)
+
+
+class FakeBroker:
+    """Minimal scripted broker: one node, N partitions per topic.
+
+    `produce_errors` is a queue of error codes: each produce REQUEST
+    consumes one entry and returns it for every partition in that
+    request (0 = success). Messages are CRC-checked and recorded on
+    success only, like a real broker's log append.
+    """
+
+    def __init__(self, partitions: int = 4) -> None:
+        self.partitions = partitions
+        self.node_id = 0
+        self.received: list[tuple[str, int, bytes | None, bytes | None]] = []
+        self.metadata_requests = 0
+        self.produce_requests = 0
+        self.produce_errors: list[int] = []
+        self.acks_seen: list[int] = []
+        self._lock = threading.Lock()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(8)
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- framing -------------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                head = self._read_exact(conn, 4)
+                if head is None:
+                    return
+                (size,) = struct.unpack(">i", head)
+                frame = self._read_exact(conn, size)
+                if frame is None:
+                    return
+                resp = self._dispatch(frame)
+                if resp is not None:
+                    conn.sendall(struct.pack(">i", len(resp)) + resp)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = conn.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # -- request handling ---------------------------------------------
+
+    def _dispatch(self, frame: bytes) -> bytes | None:
+        r = _Reader(frame)
+        api_key = r.i16()
+        api_version = r.i16()
+        corr = r.i32()
+        r.string()  # client_id
+        if api_key == 3:  # Metadata
+            assert api_version == 0
+            with self._lock:
+                self.metadata_requests += 1
+            return self._metadata_response(r, corr)
+        if api_key == 0:  # Produce
+            assert api_version == 1
+            return self._produce_response(r, corr)
+        raise AssertionError(f"unexpected api_key {api_key}")
+
+    def _metadata_response(self, r: _Reader, corr: int) -> bytes:
+        topics = [r.string() for _ in range(r.i32())]
+        out = [struct.pack(">i", corr)]
+        # brokers: just me
+        out.append(struct.pack(">i", 1))
+        out.append(struct.pack(">i", self.node_id))
+        out.append(enc_string("127.0.0.1"))
+        out.append(struct.pack(">i", self.port))
+        # topics
+        out.append(struct.pack(">i", len(topics)))
+        for t in topics:
+            out.append(struct.pack(">h", 0))
+            out.append(enc_string(t))
+            out.append(struct.pack(">i", self.partitions))
+            for pid in range(self.partitions):
+                out.append(struct.pack(">hii", 0, pid, self.node_id))
+                out.append(struct.pack(">ii", 1, self.node_id))  # replicas
+                out.append(struct.pack(">ii", 1, self.node_id))  # isr
+        return b"".join(out)
+
+    def _parse_message_set(self, topic: str, part: int, mset: bytes):
+        """Decode and CRC-check every message; a real broker rejects a
+        corrupt batch."""
+        r = _Reader(mset)
+        msgs = []
+        while r.pos < len(mset):
+            r.i64()  # producer-side offset placeholder
+            msize = r.i32()
+            msg = r._take(msize)
+            (crc,) = struct.unpack(">I", msg[:4])
+            assert crc == (zlib.crc32(msg[4:]) & 0xFFFFFFFF), "bad CRC"
+            mr = _Reader(msg[4:])
+            magic = mr._take(1)[0]
+            assert magic == 1, f"expected magic 1, got {magic}"
+            mr._take(1)  # attributes
+            mr.i64()  # timestamp
+            klen = mr.i32()
+            key = mr._take(klen) if klen >= 0 else None
+            vlen = mr.i32()
+            value = mr._take(vlen) if vlen >= 0 else None
+            msgs.append((topic, part, key, value))
+        return msgs
+
+    def _produce_response(self, r: _Reader, corr: int) -> bytes | None:
+        acks = r.i16()
+        r.i32()  # timeout
+        with self._lock:
+            self.produce_requests += 1
+            self.acks_seen.append(acks)
+            err = self.produce_errors.pop(0) if self.produce_errors else 0
+        resp_topics = []
+        for _ in range(r.i32()):
+            topic = r.string() or ""
+            parts = []
+            for _ in range(r.i32()):
+                pid = r.i32()
+                msize = r.i32()
+                mset = r._take(msize)
+                msgs = self._parse_message_set(topic, pid, mset)
+                if err == 0:
+                    with self._lock:
+                        self.received.extend(msgs)
+                parts.append(pid)
+            resp_topics.append((topic, parts))
+        if acks == 0:
+            return None
+        out = [struct.pack(">i", corr), struct.pack(">i", len(resp_topics))]
+        for topic, parts in resp_topics:
+            out.append(enc_string(topic))
+            out.append(struct.pack(">i", len(parts)))
+            for pid in parts:
+                out.append(struct.pack(">ihq", pid, err, 0))
+        out.append(struct.pack(">i", 0))  # throttle_time (v1)
+        return b"".join(out)
+
+
+@pytest.fixture
+def broker():
+    b = FakeBroker()
+    yield b
+    b.stop()
+
+
+def producer_for(broker: FakeBroker, **kw) -> KafkaWireProducer:
+    return KafkaWireProducer(f"127.0.0.1:{broker.port}", retry_max=3, **kw)
+
+
+def test_produce_roundtrip(broker):
+    prod = producer_for(broker)
+    for i in range(20):
+        prod.send("spans", b"key%d" % i, b"value%d" % i)
+    prod.flush()
+    assert len(broker.received) == 20
+    got = {(k, v) for (_t, _p, k, v) in broker.received}
+    assert (b"key7", b"value7") in got
+    assert all(t == "spans" for (t, _p, _k, _v) in broker.received)
+    prod.close()
+
+
+def test_hash_partitioning_matches_sarama(broker):
+    """Same key -> same partition, computed as sarama's hash
+    partitioner does (fnv1a-32, int32 wrap, abs, mod)."""
+    prod = producer_for(broker)
+    for _ in range(3):
+        prod.send("t", b"stable-key", b"v")
+    prod.flush()
+    parts = {p for (_t, p, _k, _v) in broker.received}
+    assert len(parts) == 1
+    h = _fnv1a32(b"stable-key")
+    if h >= 1 << 31:
+        h -= 1 << 32
+    assert parts == {abs(h) % broker.partitions}
+    prod.close()
+
+
+def test_null_key_and_value(broker):
+    prod = producer_for(broker)
+    prod.send("t", None, b"no-key")
+    prod.send("t", b"no-value", None)
+    prod.flush()
+    assert (len(broker.received)) == 2
+    vals = {(k, v) for (_t, _p, k, v) in broker.received}
+    assert (None, b"no-key") in vals
+    assert (b"no-value", None) in vals
+    prod.close()
+
+
+def test_retriable_error_refreshes_metadata_and_retries(broker):
+    broker.produce_errors = [6]  # NOT_LEADER_FOR_PARTITION once
+    prod = producer_for(broker)
+    prod.send("t", b"k", b"v")
+    prod.flush()
+    assert [(k, v) for (_t, _p, k, v) in broker.received] == [(b"k", b"v")]
+    assert prod.delivered == 1
+    assert prod.dropped == 0
+    assert broker.produce_requests == 2
+    assert broker.metadata_requests >= 2  # initial + post-error refresh
+    prod.close()
+
+
+def test_fatal_error_drops(broker):
+    broker.produce_errors = [2]  # INVALID_MESSAGE (not retriable)
+    prod = producer_for(broker)
+    prod.send("t", b"k", b"v")
+    prod.flush()
+    assert broker.produce_requests == 1
+    assert prod.dropped == 1
+    prod.close()
+
+
+def test_acks_none_fire_and_forget(broker):
+    prod = producer_for(broker, require_acks="none")
+    prod.send("t", b"k", b"v")
+    prod.flush()
+    # no response is read; give the broker a beat to record
+    import time
+
+    deadline = time.time() + 2
+    while time.time() < deadline and not broker.received:
+        time.sleep(0.01)
+    assert broker.acks_seen == [0]
+    assert [(k, v) for (_t, _p, k, v) in broker.received] == [(b"k", b"v")]
+    prod.close()
+
+
+def test_buffer_messages_threshold_autoflushes(broker):
+    prod = producer_for(broker, buffer_messages=5)
+    for i in range(5):
+        prod.send("t", b"k%d" % i, b"v")
+    # crossed the threshold: delivered without an explicit flush
+    assert len(broker.received) == 5
+    prod.close()
+
+
+def test_sink_over_real_wire(broker):
+    """The span and metric sinks produce through the wire producer
+    end to end."""
+    from veneur_tpu.core.metrics import InterMetric, MetricType
+    from veneur_tpu.sinks.kafka import KafkaMetricSink, KafkaSpanSink
+    from veneur_tpu.ssf import SSFSpan
+
+    prod = producer_for(broker)
+    span_sink = KafkaSpanSink(prod, "spans", serialization="json")
+    span_sink.ingest(SSFSpan(trace_id=1, id=2, service="svc", name="op",
+                             start_timestamp=1, end_timestamp=2))
+    span_sink.flush()
+    metric_sink = KafkaMetricSink(prod, metric_topic="metrics")
+    metric_sink.flush([InterMetric(name="m", timestamp=1, value=2.0,
+                                   tags=["a:1"], type=MetricType.COUNTER)])
+    topics = {t for (t, _p, _k, _v) in broker.received}
+    assert topics == {"spans", "metrics"}
+    import json as _json
+
+    span_payload = next(v for (t, _p, _k, v) in broker.received
+                        if t == "spans")
+    assert _json.loads(span_payload)["service"] == "svc"
+    prod.close()

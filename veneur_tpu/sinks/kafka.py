@@ -4,10 +4,11 @@ Parity: reference sinks/kafka/kafka.go — sarama async producer with
 configurable topics, acks, retries, partitioner, and span serialization
 (protobuf or json), plus percentage-based span sampling on trace id.
 
-The producer is injectable: the environment has no Kafka client library,
-so the default producer raises at construction unless `kafka-python` is
-importable; tests (and embedders) supply their own producer with a
-``send(topic, key, value) -> None`` method.
+The producer is injectable; the default is the from-scratch wire
+producer (kafka_wire.KafkaWireProducer) speaking the real broker
+protocol — Metadata v0 + Produce v1 with CRC'd magic-1 message sets —
+so the sink emits bytes an actual broker accepts. Tests (and embedders)
+may supply their own producer with a ``send(topic, key, value)`` method.
 """
 
 from __future__ import annotations
@@ -40,59 +41,20 @@ def default_producer(broker: str, retry_max: int = 3,
                      partitioner: str = "hash") -> Producer:
     """Producer with the reference's per-sink tuning surface
     (sinks/kafka/kafka.go newProducerConfig :109-141): ack requirement,
-    hash/random partitioner, and flush thresholds by bytes
-    (batch_size), time (linger_ms), and message count (an explicit
-    flush every N sends)."""
-    try:
-        from kafka import KafkaProducer  # type: ignore
-    except ImportError as e:
-        raise RuntimeError(
-            "no kafka client library available; inject a producer"
-        ) from e
-    acks = {"none": 0, "local": 1, "all": -1}.get(require_acks, -1)
-    kwargs = {}
-    if buffer_bytes:
-        kwargs["batch_size"] = buffer_bytes
-    if buffer_ms:
-        kwargs["linger_ms"] = int(buffer_ms)
-    if partitioner == "random":
-        import random as _random
+    hash/random partitioner, retry max, and flush thresholds by bytes,
+    time, and message count — served by the from-scratch wire producer
+    (kafka_wire.py), which speaks the actual broker protocol."""
+    from veneur_tpu.sinks.kafka_wire import KafkaWireProducer
 
-        kwargs["partitioner"] = (
-            lambda key, all_parts, avail: _random.choice(
-                avail or all_parts))
-    prod = KafkaProducer(bootstrap_servers=broker, retries=retry_max,
-                         acks=acks, **kwargs)
-
-    class _Wrap:
-        def __init__(self) -> None:
-            self._since_flush = 0
-            # sends may arrive from several span workers concurrently
-            self._lock = threading.Lock()
-
-        def send(self, topic, key, value):
-            prod.send(topic, key=key, value=value)
-            if buffer_messages:
-                # deliberate approximation of sarama's message-count
-                # window: the counter is exact (locked), but the flush
-                # itself runs outside the lock so a slow broker ack never
-                # serializes the other span workers' sends. Every send
-                # counted toward a window reached prod.send() before the
-                # window's flush starts, so nothing is left behind.
-                with self._lock:
-                    self._since_flush += 1
-                    due = self._since_flush >= buffer_messages
-                    if due:
-                        self._since_flush = 0
-                if due:
-                    prod.flush()
-
-        def flush(self):
-            prod.flush()
-            with self._lock:
-                self._since_flush = 0
-
-    return _Wrap()
+    return KafkaWireProducer(
+        broker,
+        require_acks=require_acks,
+        retry_max=retry_max,
+        partitioner=partitioner,
+        buffer_bytes=buffer_bytes,
+        buffer_messages=buffer_messages,
+        buffer_ms=buffer_ms,
+    )
 
 
 class KafkaMetricSink(MetricSink):
