@@ -318,6 +318,93 @@ def test_lightstep_client_pool():
     assert sorted(r[0] for r in reports) == [0, 1]
 
 
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def test_lightstep_span_wire_fixture():
+    """The collector Span serialization matches a hand-encoded protobuf
+    wire fixture built independently of the generated code — field
+    numbers and wire types exactly as the public collector protocol
+    (reference vendor collectorpb/collector.pb.go)."""
+    from veneur_tpu.sinks.lightstep import span_to_collector
+
+    span = SSFSpan(trace_id=7, id=8, parent_id=2,
+                   start_timestamp=1_500_000_123, end_timestamp=3_000_000_123,
+                   service="svc", name="op", tags={})
+    got = span_to_collector(span).SerializeToString()
+
+    # SpanContext{trace_id=7 (f1 varint), span_id=8 (f2 varint)}
+    ctx = b"\x08" + _varint(7) + b"\x10" + _varint(8)
+    parent_ctx = b"\x08" + _varint(7) + b"\x10" + _varint(2)
+    # Reference{relationship=CHILD_OF(0, default: omitted),
+    #           span_context (f2 len-delim)}
+    ref = b"\x12" + _varint(len(parent_ctx)) + parent_ctx
+    # Timestamp{seconds=1 (f1), nanos=500000123 (f2)}
+    ts = b"\x08" + _varint(1) + b"\x10" + _varint(500_000_123)
+    # component tag: KeyValue{key="component" (f1), string_value (f2)}
+    comp = (b"\x0a" + _varint(9) + b"component"
+            + b"\x12" + _varint(3) + b"svc")
+    expected = (
+        b"\x0a" + _varint(len(ctx)) + ctx              # f1 span_context
+        + b"\x12" + _varint(2) + b"op"                 # f2 operation_name
+        + b"\x1a" + _varint(len(ref)) + ref            # f3 references
+        + b"\x22" + _varint(len(ts)) + ts              # f4 start_timestamp
+        + b"\x28" + _varint(1_500_000)                 # f5 duration_micros
+        + b"\x32" + _varint(len(comp)) + comp          # f6 tags
+    )
+    assert got == expected
+
+
+def test_lightstep_http_report_carrier():
+    """Full report path: versioned endpoint, auth header + Auth block,
+    binary ReportRequest body that round-trips."""
+    from veneur_tpu.gen import lightstep_collector_pb2 as lspb
+    from veneur_tpu.sinks.lightstep import LightStepSpanSink
+
+    opener = FakeOpener()
+    sink = LightStepSpanSink("sekrit-token", opener=opener)
+    sink.ingest(_span(trace_id=11, id=12, tags={"k": "v"}, error=True))
+    sink.flush()
+    assert len(opener.requests) == 1
+    req = opener.requests[0]
+    assert req["url"].endswith("/api/v2/reports")
+    assert req["headers"]["Lightstep-access-token"] == "sekrit-token"
+    assert req["headers"]["Content-type"] == "application/octet-stream"
+    rep = lspb.ReportRequest.FromString(req["body"])
+    assert rep.auth.access_token == "sekrit-token"
+    assert rep.reporter.reporter_id > 0
+    assert len(rep.spans) == 1
+    s = rep.spans[0]
+    assert s.span_context.trace_id == 11 and s.span_context.span_id == 12
+    tag_map = {t.key: t for t in s.tags}
+    assert tag_map["k"].string_value == "v"
+    assert tag_map["component"].string_value == "svc"
+    assert tag_map["error"].bool_value is True
+    assert sink.spans_flushed == 1
+
+
+def test_lightstep_report_chunking():
+    from veneur_tpu.sinks.lightstep import LightStepSpanSink
+
+    reports = []
+    sink = LightStepSpanSink(
+        "tok", max_spans_per_report=2,
+        transport=lambda client, spans: reports.append(len(spans)))
+    for i in range(5):
+        sink.ingest(_span(trace_id=0, id=i + 1))
+    sink.flush()
+    assert reports == [2, 2, 1]
+    assert sink.spans_flushed == 5
+
+
 # ---------------------------------------------------------------------------
 # Plugins
 
