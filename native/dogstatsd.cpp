@@ -15,7 +15,9 @@
 // Exposed as a C ABI for ctypes (no pybind11 in this environment).
 
 #include <algorithm>
+#include <atomic>
 #include <cctype>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
@@ -27,6 +29,16 @@
 #include <vector>
 
 namespace {
+
+// Commit-path lock instrumentation gate (vn_set_lock_stats): off by
+// default so the per-line clock reads never tax production ingest.
+std::atomic<bool> g_lock_stats{false};
+
+inline int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 constexpr uint32_t kFnv32Offset = 2166136261u;
 constexpr uint32_t kFnv32Prime = 16777619u;
@@ -338,6 +350,19 @@ struct Ctx {
 
   long long processed = 0;
   long long errors = 0;
+
+  // Commit-path lock contention stats (vn_lock_stats; recorded only
+  // while vn_set_lock_stats(1) — the try_lock probe and clock reads cost
+  // ~10-20% of per-line budget, so the hot path skips them by default).
+  // Sample rings keep the most recent waits/holds for true percentiles.
+  long long lk_acquisitions = 0;
+  long long lk_contended = 0;
+  long long lk_wait_ns_total = 0;
+  long long lk_hold_ns_total = 0;
+  static constexpr int kLockRing = 4096;
+  int32_t lk_ring_n = 0;  // total samples ever (ring index = n % kLockRing)
+  int64_t lk_wait_ring[kLockRing] = {0};
+  int64_t lk_hold_ring[kLockRing] = {0};
 
   // SSF span ingest stats (native span→metric fast path). Service names
   // come from untrusted payloads — keyed by hash map so per-span cost
@@ -1008,6 +1033,14 @@ int ingest_ssf_span(Ctx* ctx, std::string_view buf,
 
 extern "C" {
 
+// Build stamp: the Makefile injects the sha256 prefix of this source
+// file, so tests can detect a stale committed .so (one that no longer
+// matches dogstatsd.cpp) instead of silently testing old code.
+#ifndef VN_SOURCE_HASH
+#define VN_SOURCE_HASH "unstamped"
+#endif
+const char* vn_source_hash() { return VN_SOURCE_HASH; }
+
 void* vn_ctx_new(int hll_precision) {
   Ctx* ctx = new Ctx();
   ctx->hll_precision = hll_precision;
@@ -1120,15 +1153,82 @@ int vn_ingest_routed(void** ctxps, int nctx, const char* buf, int len) {
       continue;
     }
     Ctx* target = ctxs[parsed.digest % static_cast<uint32_t>(nctx)];
-    std::lock_guard<std::recursive_mutex> g(target->mu);
+    if (!g_lock_stats.load(std::memory_order_relaxed)) {
+      std::lock_guard<std::recursive_mutex> g(target->mu);
+      if (commit_metric(target, parsed, sc.joined)) {
+        ++target->processed;
+        ++accepted;
+      } else {
+        ++target->errors;
+      }
+      continue;
+    }
+    // instrumented commit: wait time (blocked acquire) and hold time of
+    // this shard's mutex, with sample rings for percentiles
+    int64_t t0 = now_ns();
+    bool contended = !target->mu.try_lock();
+    if (contended) target->mu.lock();
+    int64_t t1 = now_ns();
     if (commit_metric(target, parsed, sc.joined)) {
       ++target->processed;
       ++accepted;
     } else {
       ++target->errors;
     }
+    int64_t t2 = now_ns();
+    ++target->lk_acquisitions;
+    if (contended) ++target->lk_contended;
+    int64_t wait = contended ? (t1 - t0) : 0;
+    target->lk_wait_ns_total += wait;
+    target->lk_hold_ns_total += t2 - t1;
+    int slot = target->lk_ring_n % Ctx::kLockRing;
+    target->lk_wait_ring[slot] = wait;
+    target->lk_hold_ring[slot] = t2 - t1;
+    ++target->lk_ring_n;
+    target->mu.unlock();
   }
   return accepted;
+}
+
+// Enable/disable commit-path lock timing (global; affects all contexts).
+void vn_set_lock_stats(int enabled) {
+  g_lock_stats.store(enabled != 0, std::memory_order_relaxed);
+}
+
+// Totals: [acquisitions, contended, wait_ns_total, hold_ns_total,
+// ring_samples]. Ring samples (most recent min(ring_samples, 4096)
+// waits/holds, ns) land in wait_out/hold_out when non-null; returns the
+// number of ring entries written.
+int vn_lock_stats(void* p, long long out[5], long long* wait_out,
+                  long long* hold_out, int cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> g(ctx->mu);
+  out[0] = ctx->lk_acquisitions;
+  out[1] = ctx->lk_contended;
+  out[2] = ctx->lk_wait_ns_total;
+  out[3] = ctx->lk_hold_ns_total;
+  int n = static_cast<int>(
+      std::min<int64_t>(ctx->lk_ring_n, Ctx::kLockRing));
+  out[4] = n;
+  int wrote = 0;
+  if (wait_out != nullptr && hold_out != nullptr) {
+    wrote = std::min(n, cap);
+    for (int i = 0; i < wrote; ++i) {
+      wait_out[i] = ctx->lk_wait_ring[i];
+      hold_out[i] = ctx->lk_hold_ring[i];
+    }
+  }
+  return wrote;
+}
+
+void vn_lock_stats_reset(void* p) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> g(ctx->mu);
+  ctx->lk_acquisitions = 0;
+  ctx->lk_contended = 0;
+  ctx->lk_wait_ns_total = 0;
+  ctx->lk_hold_ns_total = 0;
+  ctx->lk_ring_n = 0;
 }
 
 static int locked_size(void* p, const std::vector<int32_t> Ctx::* field) {

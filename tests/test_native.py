@@ -22,6 +22,43 @@ from veneur_tpu.protocol.dogstatsd import ParseError, parse_metric
 from veneur_tpu.utils.hashing import hll_hash
 
 
+def test_lock_stats_instrumentation():
+    """Commit-path mutex timing: off by default, accurate when enabled,
+    resettable (tools/bench_lock_contention.py relies on this API)."""
+    ctxs = [native_mod.NativeIngest() for _ in range(2)]
+    router = native_mod.NativeRouter(ctxs)
+    router.ingest(b"lk.a:1|c\nlk.b:2|ms")
+    st = router.lock_stats(0)
+    assert st["acquisitions"] == 0  # disabled: nothing recorded
+    router.set_lock_stats(True)
+    try:
+        router.ingest(b"lk.a:1|c\nlk.b:2|ms\nlk.c:3|g")
+        total = sum(router.lock_stats(s)["acquisitions"] for s in range(2))
+        assert total == 3
+        st = router.lock_stats(0)
+        assert len(st["hold_ns_samples"]) == st["acquisitions"]
+        assert all(h > 0 for h in st["hold_ns_samples"])
+        assert st["contended"] == 0  # single thread never blocks
+    finally:
+        router.set_lock_stats(False)
+    router.reset_lock_stats()
+    assert router.lock_stats(0)["acquisitions"] == 0
+
+
+def test_library_matches_source():
+    """The loaded .so's build stamp equals the sha256 prefix of the
+    current dogstatsd.cpp — a stale committed binary (library no longer
+    built from the checked-in source) fails here instead of silently
+    testing old code."""
+    import hashlib
+    import os
+
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "dogstatsd.cpp")
+    want = hashlib.sha256(open(src, "rb").read()).hexdigest()[:16]
+    assert native_mod.source_hash() == want
+
+
 def test_parser_parity_property():
     """Every accepted line must produce the same (type, tags, scope, value)
     as the Python parser; every rejected line must be rejected by both."""

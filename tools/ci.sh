@@ -1,0 +1,19 @@
+#!/bin/sh
+# One-command local CI: native build from source (stale-.so check via the
+# stamp test), full suite on the virtual 8-device CPU mesh, multi-chip
+# dryrun. Mirrors .github/workflows/ci.yml; the reference's analog is
+# `go test -race ./...` (.circleci/config.yml:104-112).
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== native build =="
+make -C native clean all
+
+echo "== test suite =="
+python -m pytest tests/ -q
+
+echo "== multi-chip dryrun (8 virtual devices) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "CI GREEN"

@@ -46,6 +46,17 @@ def load_library() -> Optional[ctypes.CDLL]:
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         c = ctypes
+        try:
+            lib.vn_source_hash.restype = c.c_char_p
+            lib.vn_source_hash.argtypes = []
+        except AttributeError:  # pre-stamp library
+            pass
+        lib.vn_set_lock_stats.argtypes = [c.c_int]
+        lib.vn_lock_stats.restype = c.c_int
+        lib.vn_lock_stats.argtypes = [
+            c.c_void_p, c.POINTER(c.c_longlong),
+            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong), c.c_int]
+        lib.vn_lock_stats_reset.argtypes = [c.c_void_p]
         lib.vn_ctx_new.restype = c.c_void_p
         lib.vn_ctx_new.argtypes = [c.c_int]
         lib.vn_ctx_free.argtypes = [c.c_void_p]
@@ -372,6 +383,19 @@ def available() -> bool:
     return load_library() is not None
 
 
+def source_hash() -> str:
+    """Build stamp of the loaded library (sha256 prefix of
+    dogstatsd.cpp at build time); '' when no library is loadable,
+    'unstamped' for a pre-stamp build."""
+    lib = load_library()
+    if lib is None:
+        return ""
+    try:
+        return lib.vn_source_hash().decode()
+    except AttributeError:
+        return "unstamped"
+
+
 class NativeRouter:
     """Sharded ingest over several workers' native contexts: lines are
     parsed lock-free in C++ and committed to shard digest % N under that
@@ -391,3 +415,29 @@ class NativeRouter:
     def ingest(self, datagram: bytes) -> int:
         return self._lib.vn_ingest_routed(
             self._arr, self._n, datagram, len(datagram))
+
+    def set_lock_stats(self, enabled: bool) -> None:
+        """Toggle commit-path mutex wait/hold timing (global; ~10-20%
+        per-line overhead while on — diagnostics, not production)."""
+        self._lib.vn_set_lock_stats(1 if enabled else 0)
+
+    def lock_stats(self, shard: int) -> dict:
+        """Contention record for one shard's mutex: totals plus the most
+        recent (up to 4096) wait/hold samples in ns."""
+        totals = (ctypes.c_longlong * 5)()
+        wait = (ctypes.c_longlong * 4096)()
+        hold = (ctypes.c_longlong * 4096)()
+        n = self._lib.vn_lock_stats(
+            self._contexts[shard]._ctx, totals, wait, hold, 4096)
+        return {
+            "acquisitions": int(totals[0]),
+            "contended": int(totals[1]),
+            "wait_ns_total": int(totals[2]),
+            "hold_ns_total": int(totals[3]),
+            "wait_ns_samples": [int(wait[i]) for i in range(n)],
+            "hold_ns_samples": [int(hold[i]) for i in range(n)],
+        }
+
+    def reset_lock_stats(self) -> None:
+        for c in self._contexts:
+            self._lib.vn_lock_stats_reset(c._ctx)
