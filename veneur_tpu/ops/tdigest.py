@@ -291,14 +291,18 @@ def add_batch(
     # (row_upper — already known, no gather)
     r_start = jnp.take(pos_ext, runs)
     last = j[None, :] == (n_runs_row - 1)[:, None]
-    r_next = jnp.concatenate(
-        [r_start[:, 1:], jnp.zeros((k, 1), jnp.int32)], axis=-1)
-    r_end = jnp.where(last, row_upper[:, None], r_next)
     # prefix sums fetched as 2-lane pairs: one gather of [K, C, 2]
     # instead of two of [K, C] per endpoint
     pre = jnp.stack([pre_w, pre_vw], axis=-1)  # [N+1, 2]
-    at_end = jnp.take(pre, r_end, axis=0)  # [K, C, 2]
-    at_start = jnp.take(pre, r_start, axis=0)
+    at_start = jnp.take(pre, r_start, axis=0)  # [K, C, 2]
+    # run ends need no second [K, C, 2] gather: a run ends where the NEXT
+    # run starts, so at_end is at_start shifted one lane left — except a
+    # row's last run, which ends at the row end (pre[row_upper], a plain
+    # [K, 2] gather). Halves the dominant gather volume of this step.
+    at_row_end = jnp.take(pre, row_upper, axis=0)  # [K, 2]
+    at_next = jnp.concatenate(
+        [at_start[:, 1:, :], jnp.zeros((k, 1, 2), at_start.dtype)], axis=1)
+    at_end = jnp.where(last[:, :, None], at_row_end[:, None, :], at_next)
     diff = at_end - at_start
     bd_w = jnp.where(valid, diff[..., 0], 0.0)
     bd_mw = jnp.where(valid, diff[..., 1], 0.0)
