@@ -131,11 +131,15 @@ def capture_auxiliary() -> None:
     for script, artifact, timeout in (
             ("tools/bench_overlap.py", "OVERLAP.json", 1200),
             ("tools/bench_pallas_ab.py", "PALLAS_AB.json", 1200),
-            ("tools/bench_e2e_flush.py", "E2E_FLUSH.json", 1800)):
+            ("tools/bench_e2e_flush.py", "E2E_FLUSH.json", 1800),
+            ("tools/profile_ingest.py", "PROFILE_INGEST_TPU.txt", 1200)):
         # skip if the artifact is already an on-TPU capture
         path = os.path.join(REPO, artifact)
         try:
-            if json.load(open(path)).get("platform") == "tpu":
+            if artifact.endswith(".json"):
+                if json.load(open(path)).get("platform") == "tpu":
+                    continue
+            elif os.path.exists(path):
                 continue
         except (OSError, ValueError):
             pass
@@ -151,10 +155,13 @@ def capture_auxiliary() -> None:
             print(f"capture: {script} rc={r.returncode}: "
                   f"{r.stderr.decode(errors='replace')[-400:]}",
                   file=sys.stderr)
-        else:
-            print(f"capture: {script} -> {artifact}: "
-                  f"{r.stdout.decode(errors='replace').strip()[-300:]}",
-                  file=sys.stderr)
+            continue
+        if artifact.endswith(".txt"):
+            with open(path, "w") as f:
+                f.write(r.stdout.decode(errors="replace"))
+        print(f"capture: {script} -> {artifact}: "
+              f"{r.stdout.decode(errors='replace').strip()[-300:]}",
+              file=sys.stderr)
 
 
 def main() -> None:
