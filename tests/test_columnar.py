@@ -1,0 +1,167 @@
+"""Columnar flush path: exact equivalence with the object generator.
+
+The SoA batch (core/columnar.py) must emit the identical metric multiset
+as generate_inter_metrics for every scope/type/aggregate combination —
+these tests pin that, plus the columnar sink consumers.
+"""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.flusher import (
+    device_quantiles,
+    generate_columnar,
+    generate_inter_metrics,
+)
+from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+from veneur_tpu.core.server import Server
+from veneur_tpu.core.worker import DeviceWorker
+from veneur_tpu.protocol.dogstatsd import parse_metric
+
+ALL_AGGS = HistogramAggregates.from_names(
+    ["min", "max", "count", "sum", "average", "median", "hmean"])
+PCTS = [0.5, 0.9, 0.99]
+
+
+def _key(m):
+    return (m.name, m.type, round(m.value, 9) if m.value == m.value
+            else "nan", tuple(m.tags), m.sinks)
+
+
+def _mixed_workload(w: DeviceWorker):
+    rng = np.random.default_rng(5)
+    for i in range(40):
+        for v in rng.gamma(2.0, 50.0, 20):
+            w.process_metric(parse_metric(f"h{i}:{v:.3f}|ms|#k:{i}".encode()))
+    for i in range(10):
+        w.process_metric(
+            parse_metric(f"hl{i}:{i}|h|#veneurlocalonly".encode()))
+        w.process_metric(
+            parse_metric(f"hg{i}:{i}|ms|#veneurglobalonly".encode()))
+    for i in range(25):
+        w.process_metric(parse_metric(f"c{i}:3|c|#a:{i}".encode()))
+        w.process_metric(
+            parse_metric(f"cg{i}:2|c|#veneurglobalonly".encode()))
+        w.process_metric(parse_metric(f"g{i}:7|g".encode()))
+    for i in range(15):
+        for j in range(30):
+            w.process_metric(parse_metric(f"s{i}:item{j}|s".encode()))
+        w.process_metric(
+            parse_metric(f"sl{i}:only{i}|s|#veneurlocalonly".encode()))
+    # routed + status
+    from veneur_tpu.protocol.dogstatsd import parse_service_check
+
+    w.process_metric(parse_metric(b"routed:1|c|#veneursinkonly:datadog"))
+    w.process_metric(parse_service_check(b"_sc|svc.check|1|m:all good"))
+
+
+@pytest.mark.parametrize("is_local", [True, False])
+@pytest.mark.parametrize("percentiles,aggs", [
+    (PCTS, ALL_AGGS),
+    ([], HistogramAggregates.from_names(["min", "max", "count"])),
+    ([0.99], HistogramAggregates.from_names(["median", "hmean", "sum"])),
+])
+def test_columnar_equals_object_path(is_local, percentiles, aggs):
+    w = DeviceWorker()
+    _mixed_workload(w)
+    qs = device_quantiles(percentiles, aggs)
+    snap = w.flush(qs, interval_s=10.0)
+
+    objs = generate_inter_metrics(snap, is_local, percentiles, aggs,
+                                  now=1234)
+    batch = generate_columnar(snap, is_local, percentiles, aggs, now=1234)
+    mats = batch.materialize()
+
+    assert batch.count() == len(objs)
+    assert len(batch) == len(objs)
+    assert sorted(map(_key, mats)) == sorted(map(_key, objs))
+
+
+def test_iter_rows_routing_and_exclusion():
+    w = DeviceWorker()
+    w.process_metric(parse_metric(b"routed:1|c|#veneursinkonly:datadog"))
+    w.process_metric(parse_metric(b"open:1|c|#env:prod,team:x"))
+    qs = device_quantiles([], HistogramAggregates.from_names(["count"]))
+    snap = w.flush(qs, interval_s=10.0)
+    batch = generate_columnar(
+        snap, True, [], HistogramAggregates.from_names(["count"]), now=1)
+
+    names_dd = {r[0] for r in batch.iter_rows("datadog")}
+    assert names_dd == {"routed", "open"}
+    names_px = {r[0] for r in batch.iter_rows("prometheus")}
+    assert names_px == {"open"}  # veneursinkonly:datadog excludes others
+    rows = [r for r in batch.iter_rows("prometheus", {"env"})]
+    assert rows[0][2] == ["team:x"]  # env tag stripped
+
+
+def test_server_columnar_path_engages_and_counts():
+    """With only columnar sinks, the server flush returns a batch whose
+    len() matches the object path's count, and the blackhole sink is
+    driven through flush_columnar."""
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    cfg = Config(interval="10s", percentiles=[0.5],
+                 aggregates=["min", "max", "count"])
+    srv = Server(cfg, metric_sinks=[BlackholeMetricSink()])
+    try:
+        for i in range(20):
+            srv.process_metric_packet(f"t{i}:5|ms".encode())
+            srv.process_metric_packet(f"c{i}:1|c".encode())
+        out = srv.flush()
+        # columnar path engaged: the result is a batch, not a list
+        assert not isinstance(out, list)
+        # 20 timers x (min+max+count+p50) + 20 counters
+        assert len(out) == 20 * 4 + 20
+        mats = out.materialize()
+        assert len(mats) == len(out)
+        assert {m.name for m in mats if m.type == MetricType.COUNTER} >= {
+            "c0", "t0.count"}
+    finally:
+        srv.shutdown()
+
+
+def test_server_object_path_with_legacy_sink():
+    """A legacy (non-columnar) sink keeps the object path — flush
+    returns the list as before."""
+    from veneur_tpu.sinks.channel import ChannelMetricSink
+
+    cfg = Config(interval="10s", percentiles=[],
+                 aggregates=["count"])
+    sink = ChannelMetricSink()
+    srv = Server(cfg, metric_sinks=[sink])
+    try:
+        srv.process_metric_packet(b"t:5|ms")
+        out = srv.flush()
+        assert isinstance(out, list)
+        got = sink.queue.get_nowait()
+        assert got and got[0].name == "t.count"
+    finally:
+        srv.shutdown()
+
+
+def test_prometheus_columnar_lines(monkeypatch):
+    """The prometheus repeater formats identical statsd lines from the
+    columnar batch and from the object list."""
+    from veneur_tpu.sinks.prometheus import PrometheusMetricSink
+
+    w = DeviceWorker()
+    _mixed_workload(w)
+    aggs = HistogramAggregates.from_names(["min", "max", "count"])
+    qs = device_quantiles(PCTS, aggs)
+    snap = w.flush(qs, interval_s=10.0)
+    objs = generate_inter_metrics(snap, True, PCTS, aggs, now=7)
+    batch = generate_columnar(snap, True, PCTS, aggs, now=7)
+
+    sent: list[list[bytes]] = []
+
+    def fake_send(self, lines):
+        sent.append(lines)
+
+    monkeypatch.setattr(PrometheusMetricSink, "_send", fake_send)
+    sink = PrometheusMetricSink("127.0.0.1:9125")
+    from veneur_tpu.sinks import filter_routed
+
+    sink.flush(filter_routed(objs, "prometheus"))
+    sink.flush_columnar(batch)
+    assert sorted(sent[0]) == sorted(sent[1])

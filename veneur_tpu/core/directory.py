@@ -16,6 +16,7 @@ worker.go:498-517 becomes a directory+buffer swap).
 from __future__ import annotations
 
 import enum
+from array import array
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -71,6 +72,12 @@ class RowMeta:
 class _Pool:
     index: dict[tuple[MetricKey, ScopeClass], int] = field(default_factory=dict)
     rows: list[RowMeta] = field(default_factory=list)
+    # per-row scope codes as a packed byte array (zero-copy numpy view for
+    # the columnar flush — no O(rows) attribute walk at flush time), plus
+    # a count of rows carrying veneursinkonly routing so the common
+    # no-routing case skips per-row checks entirely
+    scope_codes: array = field(default_factory=lambda: array("b"))
+    routed_rows: int = 0
 
     def upsert(self, key: MetricKey, scope_class: ScopeClass, tags: list[str]
                ) -> tuple[int, bool]:
@@ -88,12 +95,16 @@ class _Pool:
         directory assigns rows in the same append order)."""
         assert row == len(self.rows), "rows must be adopted in order"
         self.index[(key, scope_class)] = row
+        sinks = route_info(tags)
+        if sinks is not None:
+            self.routed_rows += 1
+        self.scope_codes.append(int(scope_class))
         self.rows.append(
             RowMeta(
                 key=key,
                 tags=tags,
                 scope_class=scope_class,
-                sinks=route_info(tags),
+                sinks=sinks,
             )
         )
 

@@ -910,13 +910,39 @@ class Server:
 
         phases["extract_s"] = time.perf_counter() - _t
         _t = time.perf_counter()
+        # Columnar fast path: when every metric sink consumes columns
+        # (and no plugin needs objects), the flush never materializes
+        # per-metric Python objects — at 1M series the object loop alone
+        # is seconds of host time (core/columnar.py).
+        use_columnar = bool(self.metric_sinks) and not self.plugins and all(
+            getattr(s, "supports_columnar", False)
+            for s in self.metric_sinks)
         final: list[InterMetric] = []
-        for snap in snaps:
-            final.extend(
-                generate_inter_metrics(
-                    snap, self.is_local, self.percentiles, self.aggregates
+        batch = None
+        n_flushed = 0
+        if use_columnar:
+            from veneur_tpu.core.flusher import generate_columnar
+
+            ts_now = int(time.time())
+            for snap in snaps:
+                b = generate_columnar(
+                    snap, self.is_local, self.percentiles,
+                    self.aggregates, now=ts_now)
+                if batch is None:
+                    batch = b
+                else:
+                    batch.groups.extend(b.groups)
+                    batch.extras.extend(b.extras)
+            n_flushed = batch.count() if batch is not None else 0
+        else:
+            for snap in snaps:
+                final.extend(
+                    generate_inter_metrics(
+                        snap, self.is_local, self.percentiles,
+                        self.aggregates
+                    )
                 )
-            )
+            n_flushed = len(final)
         phases["generate_s"] = time.perf_counter() - _t
         _t = time.perf_counter()
 
@@ -927,7 +953,21 @@ class Server:
             )
             fwd_thread.start()
 
-        if final:
+        if batch is not None and n_flushed:
+            threads = []
+            for sink in self.metric_sinks:
+                t = threading.Thread(
+                    target=self._flush_sink_columnar,
+                    args=(sink, batch,
+                          self.sink_excluded_tags.get(sink.name())),
+                    daemon=True, name=f"flush-{sink.name()}",
+                )
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=self.interval)
+            phases["sink_flush_s"] = time.perf_counter() - _t
+        elif final:
             threads = []
             for sink in self.metric_sinks:
                 routed = filter_routed(final, sink.name())
@@ -953,7 +993,7 @@ class Server:
             self.stats.count(
                 "flush.unique_timeseries_total", self._tally_timeseries(snaps),
                 tags=[f"global_veneur:{str(not self.is_local).lower()}"])
-        self.stats.count("flush.post_metrics_total", len(final))
+        self.stats.count("flush.post_metrics_total", n_flushed)
         from veneur_tpu.core.worker import DeviceWorker as _DW
 
         if _DW.pallas_fallbacks:
@@ -1021,6 +1061,10 @@ class Server:
             self.stats.gauge("mem.rss_bytes", float(rss))
         self.stats.time_in_nanoseconds(
             "flush.total_duration_ns", (time.time() - flush_start) * 1e9)
+        if batch is not None:
+            # columnar flush: the batch supports len(); callers needing
+            # objects use .materialize()
+            return batch
         return final
 
     @staticmethod
@@ -1048,6 +1092,23 @@ class Server:
                 plugin.flush(metrics, self.hostname)
             except Exception:
                 log.exception("plugin %s flush failed", plugin.name())
+
+    def _flush_sink_columnar(self, sink: MetricSink, batch,
+                             excluded_tags) -> None:
+        start = time.time()
+        tags = [f"sink:{sink.name()}"]
+        try:
+            sink.flush_columnar(batch, excluded_tags)
+        except Exception:
+            log.exception("sink %s columnar flush failed", sink.name())
+            self.stats.count("flush.error_total", 1, tags=tags)
+        else:
+            self.stats.count(
+                "sink.metrics_flushed_total", batch.count(), tags=tags)
+        finally:
+            self.stats.time_in_nanoseconds(
+                "sink.metric_flush_total_duration_ns",
+                (time.time() - start) * 1e9, tags=tags)
 
     def _flush_sink(self, sink: MetricSink,
                     metrics: list[InterMetric]) -> None:

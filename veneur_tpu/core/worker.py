@@ -195,6 +195,12 @@ class ScalarPool:
     def __init__(self, initial: int = 256) -> None:
         self.index: dict = {}  # (key, class) → row (python path only)
         self.meta: list = []  # (key, tags, scope_class, sinks)
+        # packed per-row scope codes + routed-row count, maintained
+        # incrementally for the columnar flush (see directory._Pool)
+        from array import array as _array
+
+        self.scope_codes = _array("b")
+        self.routed_rows = 0
         self.values = np.zeros(initial, np.float64)
         self.present = np.zeros(initial, bool)
         self.used = 0
@@ -223,6 +229,9 @@ class ScalarPool:
         """Register metadata for a row assigned externally (native path)."""
         assert row == len(self.meta), "rows must be adopted in order"
         self.meta.append((key, tags, scope_class, sinks))
+        self.scope_codes.append(int(scope_class))
+        if sinks is not None:
+            self.routed_rows += 1
         self.used = row + 1
         self.ensure(self.used)
 

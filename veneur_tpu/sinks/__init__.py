@@ -31,6 +31,18 @@ class MetricSink(abc.ABC):
     @abc.abstractmethod
     def flush(self, metrics: list[InterMetric]) -> None: ...
 
+    # Columnar flush path (core/columnar.py): sinks that can consume the
+    # SoA batch directly set supports_columnar = True and override
+    # flush_columnar — the server then never materializes per-metric
+    # objects. The default here exists so an override-less sink still
+    # behaves correctly if handed a batch.
+    supports_columnar = False
+
+    def flush_columnar(self, batch, excluded_tags: Optional[set] = None
+                       ) -> None:
+        metrics = filter_routed(batch.materialize(), self.name())
+        self.flush(strip_excluded_tags(metrics, excluded_tags))
+
     def flush_other_samples(self, samples: list[SSFSample]) -> None:
         """Receive 'other' samples (events, service checks carried as SSF);
         sinks that can't represent them drop them."""

@@ -9,10 +9,15 @@ from veneur_tpu.sinks import MetricSink, SpanSink
 
 
 class BlackholeMetricSink(MetricSink):
+    supports_columnar = True
+
     def name(self) -> str:
         return "blackhole"
 
     def flush(self, metrics) -> None:
+        pass
+
+    def flush_columnar(self, batch, excluded_tags=None) -> None:
         pass
 
     def flush_other_samples(self, samples) -> None:

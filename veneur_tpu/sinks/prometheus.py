@@ -31,6 +31,8 @@ def sanitize_tag(tag: str) -> str:
 
 
 class PrometheusMetricSink(MetricSink):
+    supports_columnar = True
+
     def __init__(self, repeater_address: str, network_type: str = "tcp"
                  ) -> None:
         host, _, port = repeater_address.rpartition(":")
@@ -66,8 +68,32 @@ class PrometheusMetricSink(MetricSink):
         return line.encode("utf-8")
 
     def flush(self, metrics: list[InterMetric]) -> None:
-        lines = [ln for ln in (self._statsd_line(m) for m in metrics)
-                 if ln is not None]
+        self._send([ln for ln in (self._statsd_line(m) for m in metrics)
+                    if ln is not None])
+
+    def flush_columnar(self, batch, excluded_tags=None) -> None:
+        """Columnar path: statsd lines straight from the batch columns —
+        the per-metric work here is the wire format itself, no
+        InterMetric objects in between (core/columnar.py)."""
+        lines = []
+        append = lines.append
+        counter = MetricType.COUNTER
+        gauge = MetricType.GAUGE
+        for name, value, tags, mtype, _ts in batch.iter_rows(
+                self.name(), excluded_tags):
+            if mtype == counter:
+                kind = "c"
+            elif mtype == gauge:
+                kind = "g"
+            else:
+                continue
+            line = f"{sanitize_name(name)}:{value}|{kind}"
+            if tags:
+                line += "|#" + ",".join(sanitize_tag(t) for t in tags)
+            append(line.encode("utf-8"))
+        self._send(lines)
+
+    def _send(self, lines: list[bytes]) -> None:
         if not lines:
             return
         try:
