@@ -140,6 +140,44 @@ def test_server_object_path_with_legacy_sink():
         srv.shutdown()
 
 
+def test_datadog_columnar_bodies(monkeypatch):
+    """The datadog sink finalizes identical wire dicts from the columnar
+    batch and from the object list (rates, tags, host extraction,
+    status checks included)."""
+    from veneur_tpu.sinks import filter_routed
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    w = DeviceWorker()
+    _mixed_workload(w)
+    aggs = HistogramAggregates.from_names(["min", "max", "count"])
+    qs = device_quantiles(PCTS, aggs)
+    snap = w.flush(qs, interval_s=10.0)
+    objs = generate_inter_metrics(snap, True, PCTS, aggs, now=7)
+    batch = generate_columnar(snap, True, PCTS, aggs, now=7)
+
+    posted: list[tuple] = []
+
+    def fake_post(self, dd_metrics, checks):
+        posted.append((dd_metrics, checks))
+
+    monkeypatch.setattr(DatadogMetricSink, "_post_all", fake_post)
+    sink = DatadogMetricSink(
+        interval=10.0, flush_max_per_body=1000, hostname="h0",
+        tags=["common:1"], dd_hostname="https://dd", api_key="k")
+    sink.flush(filter_routed(objs, "datadog"))
+    sink.flush_columnar(batch)
+    (dd_obj, ck_obj), (dd_col, ck_col) = posted
+
+    import json
+
+    def norm(ds):
+        return sorted(json.dumps(d, sort_keys=True) for d in ds)
+
+    assert norm(dd_obj) == norm(dd_col)
+    assert norm(ck_obj) == norm(ck_col)
+    assert ck_obj  # the workload includes a status check
+
+
 def test_prometheus_columnar_lines(monkeypatch):
     """The prometheus repeater formats identical statsd lines from the
     columnar batch and from the object list."""

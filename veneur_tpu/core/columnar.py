@@ -107,11 +107,14 @@ class ColumnarMetrics:
         return out
 
     def iter_rows(self, sink_name: Optional[str] = None,
-                  excluded_tags: Optional[set] = None):
+                  excluded_tags: Optional[set] = None,
+                  include_extras: bool = True):
         """Yield (name, value, tags, type, ts) per emitted metric —
         the per-row feed for columnar sinks that format per metric.
         Applies veneursinkonly routing for ``sink_name`` and per-sink
-        tag exclusion."""
+        tag exclusion. Sinks that need the extras' message/hostname
+        fields (status checks) pass include_extras=False and consume
+        ``self.extras`` (full InterMetric objects) themselves."""
         ts = self.timestamp
         for g in self.groups:
             meta_at = g.meta_at
@@ -130,6 +133,8 @@ class ColumnarMetrics:
                                 if t.split(":", 1)[0] not in excluded_tags]
                     yield (name + suffix if suffix else name,
                            vals[i], tags, mtype, ts)
+        if not include_extras:
+            return
         for m in self.extras:
             if sink_name is not None and m.sinks is not None \
                     and sink_name not in m.sinks:

@@ -62,78 +62,107 @@ class DatadogMetricSink(MetricSink):
 
     # -- conversion (reference finalizeMetrics :256-384) --------------------
 
+    def _finalize_one(self, name: str, value: float, mtags: list[str],
+                      mtype, ts: int, message: str,
+                      dd_metrics: list, checks: list) -> None:
+        if any(name.startswith(p) for p in self.metric_name_prefix_drops):
+            return
+        per_metric_excludes: list[str] = []
+        for prefix, extags in (
+            self.exclude_tags_prefix_by_prefix_metric.items()
+        ):
+            if name.startswith(prefix):
+                per_metric_excludes = list(extags)
+                break
+
+        tags = [
+            t for t in self.tags
+            if not any(t.startswith(e) for e in self.excluded_tags)
+        ]
+        hostname = ""
+        devicename = ""
+        for tag in mtags:
+            if tag.startswith("host:"):
+                hostname = tag[5:]
+            elif tag.startswith("device:"):
+                devicename = tag[7:]
+            elif any(tag.startswith(e) for e in self.excluded_tags):
+                continue
+            elif any(tag.startswith(e) for e in per_metric_excludes):
+                continue
+            else:
+                tags.append(tag)
+        if not hostname:
+            hostname = self.hostname
+
+        if mtype == MetricType.STATUS:
+            checks.append({
+                "check": name,
+                "message": message,
+                "timestamp": ts,
+                "tags": tags,
+                "status": int(value),
+                "host_name": hostname,
+            })
+            return
+
+        if mtype == MetricType.COUNTER:
+            # counters are reported to Datadog as rates
+            metric_type = "rate"
+            value = value / self.interval
+        elif mtype == MetricType.GAUGE:
+            metric_type = "gauge"
+        else:
+            return
+
+        dd_metrics.append({
+            "metric": name,
+            "points": [[ts, value]],
+            "tags": tags,
+            "type": metric_type,
+            "interval": int(self.interval),
+            "host": hostname,
+            "device_name": devicename,
+        })
+
     def _finalize(self, metrics: list[InterMetric]
                   ) -> tuple[list[dict], list[dict]]:
-        dd_metrics = []
-        checks = []
+        dd_metrics: list[dict] = []
+        checks: list[dict] = []
         for m in metrics:
-            if any(m.name.startswith(p)
-                   for p in self.metric_name_prefix_drops):
-                continue
-            per_metric_excludes: list[str] = []
-            for prefix, extags in (
-                self.exclude_tags_prefix_by_prefix_metric.items()
-            ):
-                if m.name.startswith(prefix):
-                    per_metric_excludes = list(extags)
-                    break
-
-            tags = [
-                t for t in self.tags
-                if not any(t.startswith(e) for e in self.excluded_tags)
-            ]
-            hostname = ""
-            devicename = ""
-            for tag in m.tags:
-                if tag.startswith("host:"):
-                    hostname = tag[5:]
-                elif tag.startswith("device:"):
-                    devicename = tag[7:]
-                elif any(tag.startswith(e) for e in self.excluded_tags):
-                    continue
-                elif any(tag.startswith(e) for e in per_metric_excludes):
-                    continue
-                else:
-                    tags.append(tag)
-            if not hostname:
-                hostname = self.hostname
-
-            if m.type == MetricType.STATUS:
-                checks.append({
-                    "check": m.name,
-                    "message": m.message,
-                    "timestamp": m.timestamp,
-                    "tags": tags,
-                    "status": int(m.value),
-                    "host_name": hostname,
-                })
-                continue
-
-            if m.type == MetricType.COUNTER:
-                # counters are reported to Datadog as rates
-                metric_type = "rate"
-                value = m.value / self.interval
-            elif m.type == MetricType.GAUGE:
-                metric_type = "gauge"
-                value = m.value
-            else:
-                continue
-
-            dd_metrics.append({
-                "metric": m.name,
-                "points": [[m.timestamp, value]],
-                "tags": tags,
-                "type": metric_type,
-                "interval": int(self.interval),
-                "host": hostname,
-                "device_name": devicename,
-            })
+            self._finalize_one(m.name, m.value, m.tags, m.type,
+                               m.timestamp, m.message, dd_metrics, checks)
         return dd_metrics, checks
 
     # -- flushing (reference Flush :112-160, chunked parallel posts) --------
 
+    supports_columnar = True
+
+    def flush_columnar(self, batch, excluded_tags=None) -> None:
+        """Columnar path (core/columnar.py): Datadog wire dicts built
+        straight from the batch columns, no InterMetric objects between
+        the device arrays and the JSON bodies."""
+        from veneur_tpu.sinks import filter_routed, strip_excluded_tags
+
+        dd_metrics: list[dict] = []
+        checks: list[dict] = []
+        for name, value, tags, mtype, ts in batch.iter_rows(
+                self.name(), excluded_tags, include_extras=False):
+            self._finalize_one(name, value, tags, mtype, ts, "",
+                               dd_metrics, checks)
+        # extras (status checks) need message/hostname fields
+        for m in strip_excluded_tags(
+                filter_routed(batch.extras, self.name()),
+                excluded_tags):
+            self._finalize_one(m.name, m.value, m.tags, m.type,
+                               m.timestamp, m.message, dd_metrics, checks)
+        self._post_all(dd_metrics, checks)
+
     def flush(self, metrics: list[InterMetric]) -> None:
         dd_metrics, checks = self._finalize(metrics)
+        self._post_all(dd_metrics, checks)
+
+    def _post_all(self, dd_metrics: list[dict], checks: list[dict]) -> None:
         threads = []
         for i in range(0, len(dd_metrics), self.flush_max_per_body):
             chunk = dd_metrics[i:i + self.flush_max_per_body]
