@@ -93,6 +93,10 @@ def test_iter_rows_routing_and_exclusion():
     assert names_px == {"open"}  # veneursinkonly:datadog excludes others
     rows = [r for r in batch.iter_rows("prometheus", {"env"})]
     assert rows[0][2] == ["team:x"]  # env tag stripped
+    # per-sink flushed counts honor routing (server telemetry parity)
+    assert batch.count_for("datadog") == 2
+    assert batch.count_for("prometheus") == 1
+    assert batch.count() == 2
 
 
 def test_server_columnar_path_engages_and_counts():

@@ -32,6 +32,7 @@ class FakeBroker:
 
     def __init__(self, partitions: int = 4) -> None:
         self.partitions = partitions
+        self.unknown_topics: set[str] = set()
         self.node_id = 0
         self.received: list[tuple[str, int, bytes | None, bytes | None]] = []
         self.metadata_requests = 0
@@ -126,6 +127,11 @@ class FakeBroker:
         # topics
         out.append(struct.pack(">i", len(topics)))
         for t in topics:
+            if t in self.unknown_topics:
+                out.append(struct.pack(">h", 3))  # UNKNOWN_TOPIC_OR_PART
+                out.append(enc_string(t))
+                out.append(struct.pack(">i", 0))
+                continue
             out.append(struct.pack(">h", 0))
             out.append(enc_string(t))
             out.append(struct.pack(">i", self.partitions))
@@ -286,6 +292,53 @@ def test_buffer_messages_threshold_autoflushes(broker):
         prod.send("t", b"k%d" % i, b"v")
     # crossed the threshold: delivered without an explicit flush
     assert len(broker.received) == 5
+    prod.close()
+
+
+def test_unknown_topic_drops_with_backoff(broker):
+    """Sends to a topic the cluster doesn't have are dropped (counted)
+    and metadata is NOT re-fetched per send — one fetch per backoff
+    window (ADVICE: a missing topic must not stall every sender on
+    per-send metadata round trips)."""
+    broker.unknown_topics.add("ghost")
+    prod = producer_for(broker)
+    for _ in range(50):
+        prod.send("ghost", b"k", b"v")
+    assert prod.dropped == 50
+    assert broker.metadata_requests <= 2  # not one per send
+    # a known topic still works on the same producer
+    prod.send("real", b"k", b"v")
+    prod.flush()
+    assert [(t, k) for (t, _p, k, _v) in broker.received] == [
+        ("real", b"k")]
+    prod.close()
+
+
+def test_concurrent_senders_one_socket(broker):
+    """Concurrent send()/flush() callers must never interleave frames on
+    a broker socket (the produce path is serialized on the IO lock)."""
+    import threading as _threading
+
+    prod = producer_for(broker, buffer_messages=3)
+    errs: list[Exception] = []
+
+    def worker(n):
+        try:
+            for i in range(60):
+                prod.send("t", b"w%d-%d" % (n, i), b"v")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [_threading.Thread(target=worker, args=(n,))
+               for n in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    prod.flush()
+    assert not errs
+    assert len(broker.received) == 240
+    assert prod.delivered == 240 and prod.dropped == 0
     prod.close()
 
 

@@ -86,6 +86,27 @@ class ColumnarMetrics:
     def __len__(self) -> int:
         return self.count()
 
+    def count_for(self, sink_name: str) -> int:
+        """Metrics actually routed to one sink (veneursinkonly rules) —
+        the per-sink flushed-total the object path reports. Groups with
+        no routed rows (the common case) contribute their full count
+        without any per-row walk."""
+        total = 0
+        for g in self.groups:
+            if not g.has_routing:
+                total += g.count()
+                continue
+            meta_at = g.meta_at
+            for fam in g.families:
+                for i in g.rows_for(fam).tolist():
+                    sinks = meta_at(i)[2]
+                    if sinks is None or sink_name in sinks:
+                        total += 1
+        for m in self.extras:
+            if m.sinks is None or sink_name in m.sinks:
+                total += 1
+        return total
+
     def materialize(self) -> list[InterMetric]:
         """The compatibility path: the same InterMetric multiset the
         object generator emits, family-major."""

@@ -822,8 +822,12 @@ class Server:
             except Exception:
                 log.exception("flush failed")
 
-    def flush(self) -> list[InterMetric]:
+    def flush(self):
         """One flush pass (reference Server.Flush, flusher.go:28-134).
+
+        Returns list[InterMetric] on the object path, or a
+        ColumnarMetrics batch (len() works; call .materialize() for
+        objects) when every sink consumed columns.
 
         Self-traced: every flush is a span (reference
         tracer.StartSpan("flush"), flusher.go:29) that rejoins this
@@ -832,7 +836,7 @@ class Server:
         with self.tracer.start_span("flush"):
             return self._flush_inner()
 
-    def _flush_inner(self) -> list[InterMetric]:
+    def _flush_inner(self):
         flush_start = time.time()
         self.last_flush_unix = flush_start
         self.flush_count += 1
@@ -1104,7 +1108,8 @@ class Server:
             self.stats.count("flush.error_total", 1, tags=tags)
         else:
             self.stats.count(
-                "sink.metrics_flushed_total", batch.count(), tags=tags)
+                "sink.metrics_flushed_total", batch.count_for(sink.name()),
+                tags=tags)
         finally:
             self.stats.time_in_nanoseconds(
                 "sink.metric_flush_total_duration_ns",

@@ -46,17 +46,22 @@ def load_library() -> Optional[ctypes.CDLL]:
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         c = ctypes
+        # optional symbols (absent from a pre-round-3 library): their
+        # absence degrades the feature, never the load
         try:
             lib.vn_source_hash.restype = c.c_char_p
             lib.vn_source_hash.argtypes = []
         except AttributeError:  # pre-stamp library
             pass
-        lib.vn_set_lock_stats.argtypes = [c.c_int]
-        lib.vn_lock_stats.restype = c.c_int
-        lib.vn_lock_stats.argtypes = [
-            c.c_void_p, c.POINTER(c.c_longlong),
-            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong), c.c_int]
-        lib.vn_lock_stats_reset.argtypes = [c.c_void_p]
+        try:
+            lib.vn_set_lock_stats.argtypes = [c.c_int]
+            lib.vn_lock_stats.restype = c.c_int
+            lib.vn_lock_stats.argtypes = [
+                c.c_void_p, c.POINTER(c.c_longlong),
+                c.POINTER(c.c_longlong), c.POINTER(c.c_longlong), c.c_int]
+            lib.vn_lock_stats_reset.argtypes = [c.c_void_p]
+        except AttributeError:  # pre-instrumentation library
+            pass
         lib.vn_ctx_new.restype = c.c_void_p
         lib.vn_ctx_new.argtypes = [c.c_int]
         lib.vn_ctx_free.argtypes = [c.c_void_p]

@@ -264,7 +264,14 @@ class KafkaWireProducer:
         self.ack_timeout_ms = ack_timeout_ms
         self.connect_timeout = connect_timeout
 
+        # _lock guards the message buffer only (held for appends, never
+        # across network I/O); _io_lock serializes every network path —
+        # metadata refresh, broker connections, produce requests — so
+        # concurrent send()/flush() callers can never interleave frames
+        # on one socket. self._meta is replaced atomically and may be
+        # READ without a lock; it is only written under _io_lock.
         self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
         # (topic, partition) -> list of (key, value, ts_ms)
         self._buf: dict[tuple[str, int],
                         list[tuple[Optional[bytes], Optional[bytes], int]]] \
@@ -274,6 +281,10 @@ class KafkaWireProducer:
         self._last_flush = time.monotonic()
         self._conns: dict[int, BrokerConnection] = {}
         self._meta: Optional[ClusterMetadata] = None
+        # topic -> monotonic deadline before which we won't re-fetch
+        # metadata for a topic that wasn't there (avoids a per-send
+        # metadata storm against a nonexistent topic)
+        self._topic_retry_at: dict[str, float] = {}
         self.delivered = 0
         self.dropped = 0
 
@@ -293,6 +304,7 @@ class KafkaWireProducer:
                               + "; ".join(errs))
 
     def refresh_metadata(self, topics: list[str]) -> ClusterMetadata:
+        """Fetch cluster metadata. Callers must hold _io_lock."""
         body = struct.pack(">i", len(topics)) + b"".join(
             enc_string(t) for t in topics)
         conn = self._bootstrap_conn()
@@ -304,6 +316,29 @@ class KafkaWireProducer:
             conn.close()
         self._meta = md
         return md
+
+    def _ensure_topic(self, topic: str) -> Optional[ClusterMetadata]:
+        """Metadata containing `topic`, refreshing at most once per
+        backoff window for topics the cluster doesn't have. Returns None
+        when the topic is (still) unknown."""
+        with self._io_lock:
+            meta = self._meta
+            if meta is not None and topic in meta.partitions:
+                return meta  # another thread already refreshed
+            now = time.monotonic()
+            if now < self._topic_retry_at.get(topic, 0.0):
+                return None
+            try:
+                meta = self.refresh_metadata([topic])
+            except (OSError, ValueError, ConnectionError) as e:
+                log.warning("kafka metadata refresh failed: %s", e)
+                self._topic_retry_at[topic] = now + 5.0
+                return None
+            if topic not in meta.partitions:
+                self._topic_retry_at[topic] = now + 5.0
+                return None
+            self._topic_retry_at.pop(topic, None)
+            return meta
 
     def _leader_conn(self, node: int) -> BrokerConnection:
         conn = self._conns.get(node)
@@ -317,9 +352,9 @@ class KafkaWireProducer:
 
     # -- partitioning --------------------------------------------------
 
-    def _partition_for(self, topic: str, key: Optional[bytes]) -> int:
-        assert self._meta is not None
-        n = self._meta.partitions.get(topic, 0)
+    def _partition_for(self, meta: ClusterMetadata, topic: str,
+                       key: Optional[bytes]) -> int:
+        n = meta.partitions.get(topic, 0)
         if n <= 0:
             raise ValueError(f"topic {topic!r} has no available partitions")
         if self.partitioner == "random" or not key:
@@ -336,11 +371,17 @@ class KafkaWireProducer:
     def send(self, topic: str, key: Optional[bytes],
              value: Optional[bytes]) -> None:
         ts = int(time.time() * 1000)
+        meta = self._meta  # atomic read; written only under _io_lock
+        if meta is None or topic not in meta.partitions:
+            meta = self._ensure_topic(topic)
+            if meta is None:
+                # unknown topic (backoff window active): count the drop
+                # rather than stall every sender on metadata round trips
+                with self._lock:
+                    self.dropped += 1
+                return
+        part = self._partition_for(meta, topic, key)
         with self._lock:
-            if self._meta is None or topic not in (
-                    self._meta.partitions if self._meta else {}):
-                self.refresh_metadata([topic])
-            part = self._partition_for(topic, key)
             self._buf.setdefault((topic, part), []).append((key, value, ts))
             self._buf_msgs += 1
             self._buf_bytes += (len(key or b"") + len(value or b"") + 34)
@@ -363,7 +404,7 @@ class KafkaWireProducer:
 
     def close(self) -> None:
         self.flush()
-        with self._lock:
+        with self._io_lock:
             for conn in self._conns.values():
                 conn.close()
             self._conns.clear()
@@ -379,7 +420,14 @@ class KafkaWireProducer:
 
     def _produce(self, batches) -> None:
         """Send buffered message sets to their partition leaders,
-        refreshing metadata and retrying retriable failures."""
+        refreshing metadata and retrying retriable failures. All network
+        I/O (including broker connections shared in self._conns) runs
+        under _io_lock so concurrent send()/flush() callers can never
+        interleave frames on a socket."""
+        with self._io_lock:
+            self._produce_locked(batches)
+
+    def _produce_locked(self, batches) -> None:
         attempt = 0
         while batches and attempt <= self.retry_max:
             if attempt:
