@@ -182,6 +182,38 @@ def test_datadog_columnar_bodies(monkeypatch):
     assert ck_obj  # the workload includes a status check
 
 
+def test_signalfx_columnar_datapoints(monkeypatch):
+    """SignalFx builds identical datapoint payloads from the columnar
+    batch and the object list."""
+    from veneur_tpu.sinks import filter_routed
+    from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+
+    w = DeviceWorker()
+    _mixed_workload(w)
+    aggs = HistogramAggregates.from_names(["min", "max", "count"])
+    qs = device_quantiles(PCTS, aggs)
+    snap = w.flush(qs, interval_s=10.0)
+    objs = generate_inter_metrics(snap, True, PCTS, aggs, now=7)
+    batch = generate_columnar(snap, True, PCTS, aggs, now=7)
+
+    posted: list[dict] = []
+    monkeypatch.setattr(
+        SignalFxMetricSink, "_post_buckets",
+        lambda self, by_key: posted.append(by_key))
+    sink = SignalFxMetricSink(api_key="k", hostname="h0")
+    sink.flush(filter_routed(objs, "signalfx"))
+    sink.flush_columnar(batch)
+    import json
+
+    def norm(by_key):
+        return json.dumps(
+            {k: {kind: sorted(json.dumps(p, sort_keys=True) for p in pts)
+                 for kind, pts in v.items()} for k, v in by_key.items()},
+            sort_keys=True)
+
+    assert norm(posted[0]) == norm(posted[1])
+
+
 def test_prometheus_columnar_lines(monkeypatch):
     """The prometheus repeater formats identical statsd lines from the
     columnar batch and from the object list."""

@@ -134,12 +134,17 @@ class SignalFxMetricSink(MetricSink):
     def _convert(self, m: InterMetric,
                  keys: Optional[dict[str, str]] = None
                  ) -> Optional[tuple[str, dict]]:
-        if any(m.name.startswith(p) for p in self.name_drops):
+        return self._convert_fields(m.name, m.value, m.tags, m.type,
+                                    m.timestamp, m.hostname, keys)
+
+    def _convert_fields(self, name, value, tags, mtype, ts, hostname,
+                        keys) -> Optional[tuple[str, dict]]:
+        if any(name.startswith(p) for p in self.name_drops):
             return None
-        dims = {self.hostname_tag: m.hostname or self.hostname}
+        dims = {self.hostname_tag: hostname or self.hostname}
         vary_value = ""
         drop = False
-        for tag in m.tags:
+        for tag in tags:
             if any(tag.startswith(p) for p in self.tag_drops):
                 drop = True
                 break
@@ -149,18 +154,16 @@ class SignalFxMetricSink(MetricSink):
                 vary_value = v
         if drop:
             return None
-        if m.type == MetricType.COUNTER:
+        if mtype == MetricType.COUNTER:
             kind = "counter"
-            value = m.value
-        elif m.type == MetricType.GAUGE:
+        elif mtype == MetricType.GAUGE:
             kind = "gauge"
-            value = m.value
         else:
             return None
         point = {
-            "metric": m.name,
+            "metric": name,
             "value": value,
-            "timestamp": m.timestamp * 1000,
+            "timestamp": ts * 1000,
             "dimensions": dims,
         }
         if keys is None:
@@ -168,6 +171,28 @@ class SignalFxMetricSink(MetricSink):
                 keys = self.per_tag_api_keys
         api_key = keys.get(vary_value, self.api_key)
         return api_key, {kind: point}
+
+    supports_columnar = True
+
+    def flush_columnar(self, batch, excluded_tags=None) -> None:
+        """Columnar path (core/columnar.py): datapoints built straight
+        from the batch columns. Only counter/gauge rows are convertible
+        (as in _convert), and group rows never carry a hostname field,
+        so the per-row feed loses nothing."""
+        with self._keys_lock:
+            keys = dict(self.per_tag_api_keys)
+        by_key: dict[str, dict[str, list]] = {}
+        for name, value, tags, mtype, ts in batch.iter_rows(
+                self.name(), excluded_tags, include_extras=False):
+            conv = self._convert_fields(name, value, tags, mtype, ts,
+                                        "", keys)
+            if conv is None:
+                continue
+            api_key, kinds = conv
+            bucket = by_key.setdefault(api_key, {"counter": [], "gauge": []})
+            for kind, point in kinds.items():
+                bucket[kind].append(point)
+        self._post_buckets(by_key)
 
     def flush(self, metrics: list[InterMetric]) -> None:
         # group by API key (per-tag clients); snapshot the key map once —
@@ -183,6 +208,9 @@ class SignalFxMetricSink(MetricSink):
             bucket = by_key.setdefault(api_key, {"counter": [], "gauge": []})
             for kind, point in kinds.items():
                 bucket[kind].append(point)
+        self._post_buckets(by_key)
+
+    def _post_buckets(self, by_key: dict[str, dict[str, list]]) -> None:
         threads = []
         for api_key, payload in by_key.items():
             body = {k: v for k, v in payload.items() if v}
