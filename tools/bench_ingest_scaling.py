@@ -93,6 +93,12 @@ def main():
                  "scales with readers."),
         "results": results,
     }
+    if (os.cpu_count() or 1) < 4:
+        out["see_also"] = (
+            "wall-clock scaling cannot be shown on this host; the direct "
+            "contention evidence (per-shard mutex hold/wait percentiles "
+            "under concurrent readers) is INGEST_CONTENTION.json, from "
+            "tools/bench_lock_contention.py")
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "INGEST_SCALING.json")
     with open(path, "w") as f:
