@@ -405,15 +405,19 @@ def ssf_histo() -> dict:
 def prometheus_1m() -> dict:
     """BASELINE config 5 + the north-star latency metric: one flush over
     1M unique histogram series — giant ingest + full percentile
-    extraction; reports the flush latency (budget: the 10s interval)."""
+    extraction; reports the flush latency (budget: the 10s interval).
+    Extraction uses the product's flush path: the fused Pallas kernel on
+    TPU (core/worker._extract), the XLA program elsewhere."""
     import jax
     import jax.numpy as jnp
 
+    from veneur_tpu.ops import pallas_kernels as pk
     from veneur_tpu.ops import tdigest as td
 
     series = _envint("VENEUR_BENCH_SERIES", 1 << 20, 1 << 17)
     batch = _envint("VENEUR_BENCH_BATCH", 1 << 22, 1 << 19)
     iters = _envint("VENEUR_BENCH_ITERS", 5, 2)
+    use_pallas = pk.supported()
     rng = np.random.default_rng(4)
     pool = td.init_pool(series, td.DEFAULT_CAPACITY)
     state = (pool.means, pool.weights, pool.min, pool.max, pool.recip)
@@ -427,9 +431,13 @@ def prometheus_1m() -> dict:
         m, w, a, b, r, _ = td.add_batch(
             state[0], state[1], state[2], state[3], state[4],
             rows, vals + bump, ones)
-        quant = td.quantile(m, w, a, b, qs)
-        return (m, w, a, b, r), jnp.sum(jnp.where(
-            jnp.isnan(quant), 0.0, quant))
+        if use_pallas:
+            quant, dsum, _dcount = pk.flush_extract(m, w, a, b, qs)
+        else:
+            quant = td.quantile(m, w, a, b, qs)
+            dsum = td.row_sum(m, w)
+        return (m, w, a, b, r), (jnp.sum(jnp.where(
+            jnp.isnan(quant), 0.0, quant)) + jnp.sum(dsum))
 
     state, s = flush_pass(state, 0.0)
     float(s)
