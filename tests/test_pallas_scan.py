@@ -1,0 +1,145 @@
+"""Fused ingest-scan kernel (ops/pallas_scan.py) vs the XLA scan stack.
+
+Runs the Pallas kernels in interpret mode (no TPU needed) against the
+exact XLA formulations add_batch uses, across tile-boundary-crossing
+runs, empty weights, and degenerate shapes.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from veneur_tpu.ops import pallas_scan, tdigest as td
+
+
+def xla_reference(srows, svals, sw):
+    n = srows.shape[0]
+    return [np.asarray(a) for a in
+            td._prefix_scans_xla(jnp.asarray(srows), jnp.asarray(svals),
+                                 jnp.asarray(sw), n)]
+
+
+def fused(srows, svals, sw):
+    n = srows.shape[0]
+    pre_w, pre_vw, pre_recip, seg, suffix = td._prefix_scans_fused(
+        jnp.asarray(srows), jnp.asarray(svals), jnp.asarray(sw), n,
+        interpret=True)
+    return [np.asarray(a) for a in (pre_w, pre_vw, pre_recip, seg, suffix)]
+
+
+def compare(srows, svals, sw, rtol=1e-4, atol=1e-2):
+    # atol covers f32 summation-order differences: both stacks derive
+    # segment values from prefix-sum differences, so they agree to
+    # ~eps(total weight), not exactly
+    ref = xla_reference(srows, svals, sw)
+    got = fused(srows, svals, sw)
+    names = ("pre_w", "pre_vw", "pre_recip", "seg_cum", "suffix")
+    for name, r, g in zip(names, ref, got):
+        np.testing.assert_allclose(
+            g, r, rtol=rtol, atol=atol, err_msg=name)
+
+
+def make_sorted(n, k, seed=0, zero_frac=0.0):
+    rng = np.random.default_rng(seed)
+    rows = np.sort(rng.integers(0, k, n)).astype(np.int32)
+    vals = rng.gamma(2.0, 50.0, n).astype(np.float32)
+    # sort values within rows (add_batch's order)
+    order = np.lexsort((vals, rows))
+    rows, vals = rows[order], vals[order]
+    w = np.ones(n, np.float32)
+    if zero_frac:
+        w[rng.random(n) < zero_frac] = 0.0
+    return rows, vals, w
+
+
+def test_single_tile():
+    compare(*make_sorted(8192, 50, seed=1))
+
+
+def test_multi_tile_runs_cross_boundaries():
+    # 130 lane-rows -> odd block count; long runs (k small) guarantee
+    # runs crossing both lane-row and grid-block boundaries
+    compare(*make_sorted(128 * 130, 7, seed=2))
+
+
+def test_every_element_its_own_row():
+    n = 128 * 16
+    rows = np.arange(n, dtype=np.int32)
+    vals = np.random.default_rng(3).gamma(2.0, 50.0, n).astype(np.float32)
+    compare(rows, vals, np.ones(n, np.float32))
+
+
+def test_one_giant_run():
+    n = 128 * 24
+    compare(np.zeros(n, np.int32),
+            np.sort(np.random.default_rng(4).gamma(2.0, 50.0, n)
+                    ).astype(np.float32),
+            np.ones(n, np.float32))
+
+
+def test_zero_weights_sprinkled():
+    compare(*make_sorted(128 * 40, 33, seed=5, zero_frac=0.3))
+
+
+def test_unpadded_length():
+    # n not a multiple of 128: the tdigest wrapper pads and slices
+    compare(*make_sorted(1000, 11, seed=6))
+
+
+def test_non_unit_weights():
+    rows, vals, w = make_sorted(128 * 33, 19, seed=7)
+    w = np.random.default_rng(8).uniform(0.5, 4.0, len(w)
+                                         ).astype(np.float32)
+    compare(rows, vals, w)
+
+
+@pytest.mark.parametrize("n,k", [(1 << 14, 100), (1 << 15, 1024)])
+def test_add_batch_equivalence_through_fused_scans(n, k, monkeypatch):
+    """add_batch yields statistically identical digests whichever scan
+    stack runs. Raw centroid layouts may differ (a borderline sample can
+    flip k-buckets under f32 summation-order differences — the
+    reference's own merge order is randomized), so equivalence is judged
+    where it matters: quantiles, totals, and scalar stats."""
+    rng = np.random.default_rng(9)
+    rows = jnp.asarray(rng.integers(0, k, n).astype(np.int32))
+    vals = jnp.asarray(rng.gamma(2.0, 50.0, n).astype(np.float32))
+    wts = jnp.ones(n, np.float32)
+    pool = td.init_pool(k, td.DEFAULT_CAPACITY)
+
+    out_ref = td.add_batch.__wrapped__(
+        pool.means, pool.weights, pool.min, pool.max, pool.recip,
+        rows, vals, wts)
+
+    monkeypatch.setattr(td, "_use_fused_scans", lambda: True)
+    monkeypatch.setattr(td, "_prefix_scans_fused", _fused_interp)
+    out_fused = td.add_batch.__wrapped__(
+        pool.means, pool.weights, pool.min, pool.max, pool.recip,
+        rows, vals, wts)
+
+    qs = jnp.asarray(np.array([0.25, 0.5, 0.9, 0.99], np.float32))
+
+    def summarize(out):
+        m, w, dmin, dmax, drecip, stats = out
+        return (np.asarray(td.quantile(m, w, dmin, dmax, qs)),
+                np.asarray(td.row_count(w)),
+                np.asarray(td.row_sum(m, w)),
+                np.asarray(dmin), np.asarray(dmax), np.asarray(drecip),
+                np.asarray(stats.weight), np.asarray(stats.sum))
+
+    ref_s, fused_s = summarize(out_ref), summarize(out_fused)
+    scale = float(np.nanmax(np.abs(ref_s[0])))
+    # quantiles agree within a sliver of the distribution scale
+    np.testing.assert_allclose(fused_s[0], ref_s[0], rtol=0.02,
+                               atol=scale * 5e-3)
+    for r, g in zip(ref_s[1:], fused_s[1:]):
+        # sums/recips are f32 accumulations over differently-grouped
+        # centroids; counts and min/max agree tightly
+        np.testing.assert_allclose(g, r, rtol=1e-3, atol=0.1)
+
+
+_orig_fused = td._prefix_scans_fused
+
+
+def _fused_interp(srows, svals, sw, n):
+    return _orig_fused(srows, svals, sw, n, interpret=True)

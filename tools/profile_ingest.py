@@ -136,3 +136,35 @@ def compress_1m(means, weights):
 bench("_compress_rows 1M series", compress_1m, pool2.means, pool2.weights)
 bench("quantile x3 1M series", quant, pool2.means, pool2.weights,
       pool2.min, pool2.max, qs)
+
+# A/B: XLA scan stack vs the fused two-pass Pallas scan kernel
+# (ops/pallas_scan.py). The flag is read at trace time, so each variant
+# gets its own freshly-traced jit wrapper around the unjitted body.
+os.environ["VENEUR_FUSED_SCANS"] = "0"
+
+
+@jax.jit
+def full_xla_scans(pool, rows, vals, wts):
+    return td.add_batch.__wrapped__(
+        pool.means, pool.weights, pool.min, pool.max, pool.recip,
+        rows, vals, wts)
+
+
+bench("add_batch (xla scans)", full_xla_scans, pool, rows, vals, wts)
+os.environ["VENEUR_FUSED_SCANS"] = "1"
+
+
+@jax.jit
+def full_fused_scans(pool, rows, vals, wts):
+    return td.add_batch.__wrapped__(
+        pool.means, pool.weights, pool.min, pool.max, pool.recip,
+        rows, vals, wts)
+
+
+try:
+    bench("add_batch (fused scans)", full_fused_scans, pool, rows, vals,
+          wts)
+except Exception as e:  # pragma: no cover - TPU-only path
+    print(f"add_batch (fused scans) failed: {e}")
+finally:
+    del os.environ["VENEUR_FUSED_SCANS"]

@@ -1,0 +1,249 @@
+"""Fused prefix/segmented scans for the t-digest ingest path.
+
+ops/tdigest.add_batch needs, over the (row, value)-sorted sample stream:
+
+* three plain inclusive prefix sums — weight, value*weight,
+  weight/value,
+* a row-segmented inclusive prefix sum of weight (restarting at row
+  changes), and
+* the same segmented sum taken from the row's other end (the suffix),
+  which yields each sample's row total.
+
+As separate XLA ops these are five multi-pass scans, each re-reading
+its [N] inputs from HBM (segments.segmented_cumsum alone is ~7
+shift+select sweeps). This module computes all of them in TWO linear
+HBM passes — one forward, one reverse — as Pallas TPU kernels: a grid
+of row-major [R, 128] tiles walked sequentially (TPU grids are
+sequential), lane-level scans done as lower-triangular matmuls (MXU)
+and log-step shift+max sweeps, with the cross-tile running state
+carried in SMEM scratch.
+
+Correctness is pinned against the XLA formulations in
+tests/test_pallas_scan.py (interpret mode off-TPU); add_batch switches
+to this path on TPU via VENEUR_FUSED_SCANS (see ops/tdigest.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+DEFAULT_ROWS = 64  # tile = [64, 128] = 8192 elements
+
+_NEG = -3.0e38  # "-inf" stand-in that survives f32 arithmetic
+
+
+def _tril(n: int) -> jnp.ndarray:
+    col = jax.lax.broadcasted_iota(jnp.float32, (n, n), 0)
+    row = jax.lax.broadcasted_iota(jnp.float32, (n, n), 1)
+    return (col <= row).astype(jnp.float32)
+
+
+def _lane_cumsum(x, tril):
+    """Inclusive cumsum along the 128-lane axis via MXU matmul."""
+    return jnp.dot(x, tril, preferred_element_type=jnp.float32)
+
+
+def _lane_cummax(x):
+    """Inclusive running max along the lane axis (log2(128) = 7 steps)."""
+    r, l = x.shape
+    shift = 1
+    while shift < l:
+        shifted = jnp.pad(x, ((0, 0), (shift, 0)),
+                          constant_values=_NEG)[:, :l]
+        x = jnp.maximum(x, shifted)
+        shift *= 2
+    return x
+
+
+def _row_exclusive(x_last, neutral, combine):
+    """Exclusive scan down the sublane axis of a [R, 1] column via
+    log-step shifts (R is small: 64)."""
+    r = x_last.shape[0]
+    inc = x_last
+    shift = 1
+    while shift < r:
+        shifted = jnp.pad(inc, ((shift, 0), (0, 0)),
+                          constant_values=neutral)[:r]
+        inc = combine(inc, shifted)
+        shift *= 2
+    # exclusive = inclusive shifted down one row
+    return jnp.pad(inc, ((1, 0), (0, 0)), constant_values=neutral)[:r]
+
+
+def _scan_fwd_kernel(rows_ref, w_ref, vw_ref, recip_ref,
+                     cw_ref, cvw_ref, crecip_ref, seg_ref,
+                     carry_ref, rowcarry_ref):
+    """One [R, 128] tile of the forward pass.
+
+    carry_ref: SMEM f32[4] = running (w, vw, recip, seg) totals.
+    rowcarry_ref: SMEM i32[1] = row id of the previous element.
+    """
+    step = pl.program_id(0)
+    rows = rows_ref[...]
+    w = w_ref[...]
+    vw = vw_ref[...]
+    recip = recip_ref[...]
+    r, l = w.shape
+    tril = _tril(l)
+
+    @pl.when(step == 0)
+    def _init():
+        carry_ref[0] = 0.0
+        carry_ref[1] = 0.0
+        carry_ref[2] = 0.0
+        carry_ref[3] = 0.0
+        rowcarry_ref[0] = rows[0, 0]  # element -1 joins the first run
+
+    # --- plain prefix sums: lane cumsum + exclusive row offsets + carry
+    cw_l = _lane_cumsum(w, tril)
+    cvw_l = _lane_cumsum(vw, tril)
+    crec_l = _lane_cumsum(recip, tril)
+
+    def _tot(c):  # [R, 1] per-tile-row totals
+        return c[:, l - 1:l]
+
+    add = lambda a, b: a + b  # noqa: E731
+    cw = cw_l + _row_exclusive(_tot(cw_l), 0.0, add) + carry_ref[0]
+    cvw = cvw_l + _row_exclusive(_tot(cvw_l), 0.0, add) + carry_ref[1]
+    crec = crec_l + _row_exclusive(_tot(crec_l), 0.0, add) + carry_ref[2]
+    cw_ref[...] = cw
+    cvw_ref[...] = cvw
+    crecip_ref[...] = crec
+
+    # --- segmented prefix sum of w, restarting at row changes ---------
+    # previous element's row id, across the flattened row-major order
+    prev_last = jnp.concatenate(
+        [jnp.full((1, 1), rowcarry_ref[0], rows.dtype), rows[:-1, l - 1:l]],
+        axis=0)
+    prev = jnp.concatenate([prev_last, rows[:, :l - 1]], axis=1)
+    starts = rows != prev
+
+    # within each tile row: value of cw_excl at the latest start
+    cw_excl = cw - w
+    marked = jnp.where(starts, cw_excl, _NEG)
+    lane_start = _lane_cummax(marked)  # [R, L]
+    # carry the latest start value down tile rows (rows with no start
+    # pass the previous rows' value through)
+    row_best = lane_start[:, l - 1:l]  # [R, 1]
+    row_carry = _row_exclusive(row_best, _NEG, jnp.maximum)
+    start_val = jnp.maximum(lane_start, row_carry)
+    # elements before ANY start in the whole array continue the carry run
+    base = jnp.where(start_val > _NEG / 2, start_val,
+                     carry_ref[0] - carry_ref[3])
+    seg = cw - base
+    seg_ref[...] = seg
+
+    carry_ref[0] = cw[r - 1, l - 1]
+    carry_ref[1] = cvw[r - 1, l - 1]
+    carry_ref[2] = crec[r - 1, l - 1]
+    carry_ref[3] = seg[r - 1, l - 1]
+    rowcarry_ref[0] = rows[r - 1, l - 1]
+
+
+def _scan_rev_kernel(rows_ref, w_ref, suf_ref, carry_ref, rowcarry_ref):
+    """One tile of the reverse pass: row-segmented suffix sum of w.
+    The grid walks tiles back to front; within a tile the scan runs
+    right to left (implemented by flipping, scanning, flipping back)."""
+    step = pl.program_id(0)
+    rows = rows_ref[...]
+    w = w_ref[...]
+    r, l = w.shape
+
+    @pl.when(step == 0)
+    def _init():
+        carry_ref[0] = 0.0
+        rowcarry_ref[0] = rows[r - 1, l - 1]
+
+    # flip both axes: suffix scan becomes prefix scan on the flipped tile
+    fr = rows[::-1, ::-1]
+    fw = w[::-1, ::-1]
+    tril = _tril(l)
+    cw_l = _lane_cumsum(fw, tril)
+    add = lambda a, b: a + b  # noqa: E731
+    cw = cw_l + _row_exclusive(cw_l[:, l - 1:l], 0.0, add)
+
+    prev_last = jnp.concatenate(
+        [jnp.full((1, 1), rowcarry_ref[0], fr.dtype), fr[:-1, l - 1:l]],
+        axis=0)
+    prev = jnp.concatenate([prev_last, fr[:, :l - 1]], axis=1)
+    starts = fr != prev
+
+    cw_excl = cw - fw
+    marked = jnp.where(starts, cw_excl, _NEG)
+    lane_start = _lane_cummax(marked)
+    row_best = lane_start[:, l - 1:l]
+    row_carry = _row_exclusive(row_best, _NEG, jnp.maximum)
+    start_val = jnp.maximum(lane_start, row_carry)
+    base = jnp.where(start_val > _NEG / 2, start_val, -carry_ref[0])
+    seg = cw - base
+    suf_ref[...] = seg[::-1, ::-1]
+
+    carry_ref[0] = seg[r - 1, l - 1]
+    rowcarry_ref[0] = fr[r - 1, l - 1]
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def fused_prefix_scans(srows, svals, sw, block_rows: int = DEFAULT_ROWS,
+                       interpret: bool = False):
+    """All ingest scans in two HBM passes.
+
+    srows: i32[N] sorted row ids; svals/sw: f32[N] (value, weight) in
+    the same order. N must be a multiple of 128; the caller pads (pad
+    with w=0 and the last row id, which extends the final run
+    harmlessly).
+
+    Returns (cw, cvw, crecip, seg, suffix): all f32[N], inclusive;
+    `seg` restarts at row changes, `suffix` is the same from the row's
+    other end (so row_total = seg + suffix - sw).
+    """
+    n = srows.shape[0]
+    assert n % LANES == 0, "caller pads to a lane multiple"
+    rows_needed = n // LANES
+    while rows_needed % block_rows:
+        block_rows //= 2
+    grid = (rows_needed // block_rows,)
+    shape2 = (rows_needed, LANES)
+    rows2 = srows.reshape(shape2)
+    vw2 = (jnp.where(sw > 0, svals * sw, 0.0)).reshape(shape2)
+    recip2 = jnp.where(sw > 0, sw / svals, 0.0).reshape(shape2)
+    w2 = sw.reshape(shape2)
+
+    spec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))
+    out4 = [jax.ShapeDtypeStruct(shape2, jnp.float32)] * 4
+    cw, cvw, crecip, seg = pl.pallas_call(
+        _scan_fwd_kernel,
+        grid=grid,
+        in_specs=[spec, spec, spec, spec],
+        out_specs=[spec, spec, spec, spec],
+        out_shape=out4,
+        scratch_shapes=[pltpu.SMEM((4,), jnp.float32),
+                        pltpu.SMEM((1,), jnp.int32)],
+        interpret=interpret,
+    )(rows2, w2, vw2, recip2)
+
+    nblocks = grid[0]
+    rev_spec = pl.BlockSpec((block_rows, LANES),
+                            lambda i, nb=nblocks: (nb - 1 - i, 0))
+    (suffix,) = pl.pallas_call(
+        _scan_rev_kernel,
+        grid=grid,
+        in_specs=[rev_spec, rev_spec],
+        out_specs=[rev_spec],
+        out_shape=[jax.ShapeDtypeStruct(shape2, jnp.float32)],
+        scratch_shapes=[pltpu.SMEM((1,), jnp.float32),
+                        pltpu.SMEM((1,), jnp.int32)],
+        interpret=interpret,
+    )(rows2, w2)
+
+    flat = lambda a: a.reshape(-1)  # noqa: E731
+    return (flat(cw), flat(cvw), flat(crecip), flat(seg), flat(suffix))
+
+
+def supported() -> bool:
+    return jax.default_backend() == "tpu"

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import NamedTuple
 
 import jax
@@ -46,6 +47,55 @@ import jax.numpy as jnp
 import numpy as np
 
 from veneur_tpu.ops import segments
+
+
+def _use_fused_scans() -> bool:
+    """The ingest prefix scans run as the fused two-pass Pallas kernel
+    (ops/pallas_scan.py) on TPU; VENEUR_FUSED_SCANS=0/1 overrides for
+    A/B measurement (read at trace time)."""
+    env = os.environ.get("VENEUR_FUSED_SCANS", "").strip()
+    if env:
+        return env not in ("0", "false", "no")
+    return jax.default_backend() == "tpu"
+
+
+def _prefix_scans_xla(srows, svals, sw, n):
+    """The XLA scan stack: three prefix sums + forward/backward
+    segmented sums (see add_batch for what each feeds)."""
+    zero1 = jnp.zeros((1,), sw.dtype)
+    pre_w = jnp.concatenate([zero1, jnp.cumsum(sw)])  # [N+1]
+    pre_vw = jnp.concatenate([zero1, jnp.cumsum(svals * sw)])
+    pre_recip = jnp.concatenate(
+        [zero1, jnp.cumsum(jnp.where(sw > 0, sw / svals, 0.0))])
+    row_starts = jnp.concatenate(
+        [jnp.ones((1,), bool), srows[1:] != srows[:-1]])
+    seg_cum = segments.segmented_cumsum(sw, row_starts)
+    row_ends = jnp.concatenate([row_starts[1:], jnp.ones((1,), bool)])
+    suffix = segments.segmented_cumsum(sw[::-1], row_ends[::-1])[::-1]
+    return pre_w, pre_vw, pre_recip, seg_cum, suffix
+
+
+def _prefix_scans_fused(srows, svals, sw, n, interpret: bool = False):
+    """Same five arrays from the two-pass Pallas kernel."""
+    from veneur_tpu.ops import pallas_scan
+
+    pad = (-n) % pallas_scan.LANES
+    if pad:
+        # pad extends the final run with zero weight — harmless to every
+        # scan, and sliced off below
+        srows_p = jnp.concatenate(
+            [srows, jnp.broadcast_to(srows[n - 1], (pad,))])
+        svals_p = jnp.concatenate([svals, jnp.ones((pad,), svals.dtype)])
+        sw_p = jnp.concatenate([sw, jnp.zeros((pad,), sw.dtype)])
+    else:
+        srows_p, svals_p, sw_p = srows, svals, sw
+    cw, cvw, crecip, seg, suffix = pallas_scan.fused_prefix_scans(
+        srows_p, svals_p, sw_p, interpret=interpret)
+    zero1 = jnp.zeros((1,), sw.dtype)
+    pre_w = jnp.concatenate([zero1, cw[:n]])
+    pre_vw = jnp.concatenate([zero1, cvw[:n]])
+    pre_recip = jnp.concatenate([zero1, crecip[:n]])
+    return pre_w, pre_vw, pre_recip, seg[:n], suffix[:n]
 
 DEFAULT_COMPRESSION = 100.0
 # Capacity per row: δ+1 buckets can be produced by the k-function; round up
@@ -222,12 +272,15 @@ def add_batch(
     #        runs in the sorted order, so every per-row reduction is either
     #        a prefix-sum difference at run boundaries or — because values
     #        sort ascending within a row — a boundary gather (min = first
-    #        live element, max = last).
-    zero1 = jnp.zeros((1,), sw.dtype)
-    pre_w = jnp.concatenate([zero1, jnp.cumsum(sw)])  # [N+1]
-    pre_vw = jnp.concatenate([zero1, jnp.cumsum(svals * sw)])
-    pre_recip = jnp.concatenate(
-        [zero1, jnp.cumsum(jnp.where(sw > 0, sw / svals, 0.0))])
+    #        live element, max = last). All five scans over the sorted
+    #        stream come from one fused two-pass Pallas kernel on TPU
+    #        (ops/pallas_scan.py), the XLA scan stack elsewhere.
+    if _use_fused_scans():
+        pre_w, pre_vw, pre_recip, seg_cum, suffix = _prefix_scans_fused(
+            srows, svals, sw, n)
+    else:
+        pre_w, pre_vw, pre_recip, seg_cum, suffix = _prefix_scans_xla(
+            srows, svals, sw, n)
 
     kbins = jnp.arange(k, dtype=jnp.int32)
     row_upper = jnp.searchsorted(srows, kbins, side="right").astype(jnp.int32)
@@ -255,11 +308,6 @@ def add_batch(
     #        previous run-sum scheme resolved runs with a searchsorted over
     #        chunk offsets — a [K·C]-sized gather-chain binary search that
     #        alone cost ~80% of add_batch on v5e.)
-    row_starts = jnp.concatenate(
-        [jnp.ones((1,), bool), srows[1:] != srows[:-1]])
-    seg_cum = segments.segmented_cumsum(sw, row_starts)
-    row_ends = jnp.concatenate([row_starts[1:], jnp.ones((1,), bool)])
-    suffix = segments.segmented_cumsum(sw[::-1], row_ends[::-1])[::-1]
     row_total = seg_cum + suffix - sw  # per-sample total weight of its row
     q_left = (seg_cum - sw) / jnp.maximum(row_total, 1e-30)
     bucket = jnp.clip(
