@@ -50,13 +50,13 @@ from veneur_tpu.ops import segments
 
 
 def _use_fused_scans() -> bool:
-    """The ingest prefix scans run as the fused two-pass Pallas kernel
-    (ops/pallas_scan.py) on TPU; VENEUR_FUSED_SCANS=0/1 overrides for
-    A/B measurement (read at trace time)."""
+    """The ingest prefix scans can run as the fused two-pass Pallas
+    kernel (ops/pallas_scan.py) instead of the XLA scan stack.
+    Opt-in (VENEUR_FUSED_SCANS=1, read at trace time) until the on-chip
+    A/B (tools/profile_ingest.py) validates compile + win on real TPU —
+    a trace-time kernel failure here would break every flush."""
     env = os.environ.get("VENEUR_FUSED_SCANS", "").strip()
-    if env:
-        return env not in ("0", "false", "no")
-    return jax.default_backend() == "tpu"
+    return bool(env) and env not in ("0", "false", "no")
 
 
 def _prefix_scans_xla(srows, svals, sw, n):
