@@ -175,6 +175,10 @@ class BrokerConnection:
             return None
         raw = self._read_exact(4)
         (size,) = struct.unpack(">i", raw)
+        if not 0 <= size <= 100 * 1024 * 1024:
+            # response sizes beyond any sane broker config mean a
+            # corrupt/hostile peer; don't allocate on its say-so
+            raise ValueError(f"implausible kafka response size {size}")
         payload = self._read_exact(size)
         r = _Reader(payload)
         got_corr = r.i32()
