@@ -55,9 +55,10 @@ def _local_server(forward_port: int, use_grpc=True) -> Server:
     return srv
 
 
-def _ingest_histo(srv: Server, name: str, values) -> None:
+def _ingest_histo(srv: Server, name: str, values, tags=None) -> None:
+    suffix = "|#" + ",".join(tags) if tags else ""
     for v in values:
-        m = parse_metric(f"{name}:{v}|h".encode())
+        m = parse_metric(f"{name}:{v}|h{suffix}".encode())
         srv.workers[m.digest % len(srv.workers)].process_metric(m)
 
 
@@ -328,6 +329,79 @@ def test_quitquitquit_disabled_by_default():
     finally:
         http.stop()
         imp.stop()
+
+
+def test_snapshot_to_wire_matches_python_encoder():
+    """The native C++ wire encoder (snapshot_to_wire fast path) emits
+    bytes that decode to exactly the metrics the Python protobuf path
+    builds — per-metric deterministic-serialization equality, mixed
+    scopes and sets included."""
+    from veneur_tpu.core.metrics import MetricKey
+    from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+    local = _local_server(1, use_grpc=True)
+    for i in range(60):
+        _ingest_histo(local, f"wm{i}", [float(i + j) for j in range(9)],
+                      tags=[f"shard:{i % 4}", "env:prod"])
+    for i in range(10):
+        local.process_metric_packet(
+            f"wl{i}:{i}|h|#veneurlocalonly".encode())
+        local.process_metric_packet(
+            f"wg{i}:{i}|ms|#veneurglobalonly".encode())
+        local.process_metric_packet(
+            f"wc{i}:3|c|#veneurglobalonly".encode())
+        local.process_metric_packet(f"ws{i}:item{i}|s".encode())
+    qs = device_quantiles(PCTS, AGGS)
+    w = local.workers[0]
+    with local._worker_locks[0]:
+        snap = w.flush(qs, 10.0)
+    blob, n = codec.snapshot_to_wire(snap, 100.0, 14)
+    ref = codec.snapshot_to_batch(snap, 100.0, 14)
+    got = pb.MetricBatch.FromString(blob)
+    assert n == len(ref.metrics) == len(got.metrics)
+    ref_by_key = {(m.name, m.kind): m for m in ref.metrics}
+    for m in got.metrics:
+        r = ref_by_key[(m.name, m.kind)]
+        assert (m.SerializeToString(deterministic=True)
+                == r.SerializeToString(deterministic=True)), m.name
+    # local-only histo rows must not be forwarded
+    names = {m.name for m in got.metrics}
+    assert not any(name.startswith("wl") for name in names)
+    assert "wg3" in names and "wc3" in names and "ws3" in names
+
+
+def test_snapshot_to_wire_separator_handling():
+    """ASCII unit separators in names can't break any framing: the
+    native directory sanitizes them at ingest (its drain protocol uses
+    \\x1e/\\x1f), and the pure-Python directory path keeps the raw name
+    by falling back to the Python encoder."""
+    from veneur_tpu.core.worker import DeviceWorker
+    from veneur_tpu.gen import veneur_tpu_pb2 as pb
+    from veneur_tpu.protocol.dogstatsd import parse_metric
+
+    # python-directory worker: codec falls back, raw name survives
+    w = DeviceWorker()
+    for v in (1.0, 2.0):
+        w.process_metric(parse_metric(f"odd\x1fname:{v}|h".encode()))
+    qs = device_quantiles(PCTS, AGGS)
+    snap = w.flush(qs, 10.0)
+    assert codec._histo_wire_native(snap, 100.0) is None
+    blob, n = codec.snapshot_to_wire(snap, 100.0, 14)
+    got = pb.MetricBatch.FromString(blob)
+    assert n == len(got.metrics) == 1
+    assert got.metrics[0].name == "odd\x1fname"
+
+    # native-mode server: the name is sanitized at the boundary and the
+    # series survives intact through drain + forward encode
+    local = _local_server(1, use_grpc=True)
+    _ingest_histo(local, "odd\x1fname", [1.0, 2.0])
+    with local._worker_locks[0]:
+        snap2 = local.workers[0].flush(qs, 10.0)
+    blob2, n2 = codec.snapshot_to_wire(snap2, 100.0, 14)
+    got2 = pb.MetricBatch.FromString(blob2)
+    assert n2 == len(got2.metrics) == 1
+    assert got2.metrics[0].name == "odd_name"
+    assert len(got2.metrics[0].digest.centroids.means) == 2
 
 
 def test_proxy_http_import_ring_splits():

@@ -52,25 +52,30 @@ class GRPCForwarder:
         self.stats = stats
 
     def __call__(self, snapshots) -> None:
-        batch = pb.MetricBatch()
+        # serialized MetricBatch blobs concatenate into one merged batch
+        # (repeated field append) — each snapshot encodes independently
+        # (histo rows through the native C++ wire encoder when available)
+        parts = []
+        total = 0
         for snap in snapshots:
-            batch.metrics.extend(
-                codec.snapshot_to_batch(
-                    snap, self.compression, self.hll_precision
-                ).metrics
-            )
-        if not batch.metrics:
+            blob, n = codec.snapshot_to_wire(
+                snap, self.compression, self.hll_precision)
+            if n:
+                parts.append(blob)
+                total += n
+        if not total:
             return
+        payload = b"".join(parts)
         started = time.time()
-        ok = self.client.send(batch)
+        ok = self.client.send_raw(payload, total)
         if not ok:
             log.warning(
                 "forward to %s failed (errors so far: %s)",
                 self.client.address, self.client.errors,
             )
-        _report_forward(self.stats, len(batch.metrics), started,
+        _report_forward(self.stats, total, started,
                         None if ok else self.client.last_error_cause,
-                        content_length=batch.ByteSize())
+                        content_length=len(payload))
 
     def close(self) -> None:
         self.client.close()

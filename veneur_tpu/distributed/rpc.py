@@ -78,6 +78,15 @@ class ForwardClient:
             request_serializer=pb.MetricBatch.SerializeToString,
             response_deserializer=pb.SendResponse.FromString,
         )
+        # raw-bytes variant: the native wire encoder (distributed/codec.
+        # snapshot_to_wire) produces serialized MetricBatch bytes
+        # directly, so re-serializing through the message class would
+        # waste the work — identity serializer instead
+        self._call_raw = self.channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=lambda b: b,
+            response_deserializer=pb.SendResponse.FromString,
+        )
         self.errors: dict[str, int] = {
             "deadline_exceeded": 0, "unavailable": 0, "send": 0,
         }
@@ -87,8 +96,17 @@ class ForwardClient:
 
     def send(self, batch: pb.MetricBatch,
              timeout_s: Optional[float] = None) -> bool:
+        return self._send(self._call, batch, len(batch.metrics), timeout_s)
+
+    def send_raw(self, blob: bytes, n_metrics: int,
+                 timeout_s: Optional[float] = None) -> bool:
+        """Send pre-serialized MetricBatch bytes (native encoder path)."""
+        return self._send(self._call_raw, blob, n_metrics, timeout_s)
+
+    def _send(self, call, payload, n_metrics: int,
+              timeout_s: Optional[float]) -> bool:
         try:
-            self._call(batch, timeout=timeout_s or self.timeout_s)
+            call(payload, timeout=timeout_s or self.timeout_s)
         except grpc.RpcError as e:
             code = e.code()
             if code == grpc.StatusCode.DEADLINE_EXCEEDED:
@@ -101,7 +119,7 @@ class ForwardClient:
             self.last_error_cause = cause
             return False
         self.sent_batches += 1
-        self.sent_metrics += len(batch.metrics)
+        self.sent_metrics += n_metrics
         return True
 
     def close(self) -> None:

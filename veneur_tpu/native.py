@@ -54,6 +54,16 @@ def load_library() -> Optional[ctypes.CDLL]:
         except AttributeError:  # pre-stamp library
             pass
         try:
+            lib.vn_encode_histo_batch.restype = c.c_longlong
+            lib.vn_encode_histo_batch.argtypes = [
+                c.c_char_p, c.c_longlong,
+                c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+                c.c_void_p, c.c_int, c.c_int,
+                c.c_void_p, c.c_void_p, c.c_void_p, c.c_double,
+                c.POINTER(c.c_char_p)]
+        except AttributeError:  # pre-encoder library
+            pass
+        try:
             lib.vn_set_lock_stats.argtypes = [c.c_int]
             lib.vn_lock_stats.restype = c.c_int
             lib.vn_lock_stats.argtypes = [
@@ -288,7 +298,18 @@ class NativeIngest:
     def upsert(self, name: str, mtype: str, joined_tags: str,
                scope_class: int) -> int:
         """Directory upsert for Python-side ingest (shares row space with
-        parsed traffic)."""
+        parsed traffic).
+
+        The native new-series drain protocol frames records with the
+        \\x1e/\\x1f unit separators, so those control bytes cannot travel
+        through it verbatim — they are replaced with '_' here (no
+        legitimate metric name or tag contains ASCII unit separators;
+        series identity is preserved up to that substitution)."""
+        if "\x1e" in name or "\x1f" in name:
+            name = name.replace("\x1e", "_").replace("\x1f", "_")
+        if "\x1e" in joined_tags or "\x1f" in joined_tags:
+            joined_tags = joined_tags.replace(
+                "\x1e", "_").replace("\x1f", "_")
         nb = name.encode("utf-8")
         tb = joined_tags.encode("utf-8")
         return self._lib.vn_upsert(
@@ -386,6 +407,38 @@ class NativeIngest:
 
 def available() -> bool:
     return load_library() is not None
+
+
+def encode_histo_batch(meta_blob: bytes, kinds: np.ndarray,
+                       scopes: np.ndarray, emit: np.ndarray,
+                       means: np.ndarray, weights: np.ndarray,
+                       dmin: np.ndarray, dmax: np.ndarray,
+                       drecip: np.ndarray,
+                       compression: float) -> Optional[bytes]:
+    """Histogram rows -> veneurtpu.MetricBatch wire bytes at C++ speed
+    (see native/dogstatsd.cpp vn_encode_histo_batch). Returns None when
+    the native library (or the symbol) is unavailable."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_encode_histo_batch"):
+        return None
+    rows, cap = means.shape
+    means = np.ascontiguousarray(means, np.float32)
+    weights = np.ascontiguousarray(weights, np.float32)
+    kinds = np.ascontiguousarray(kinds, np.int8)
+    scopes = np.ascontiguousarray(scopes, np.int8)
+    emit = np.ascontiguousarray(emit, np.uint8)
+    dmin = np.ascontiguousarray(dmin, np.float64)
+    dmax = np.ascontiguousarray(dmax, np.float64)
+    drecip = np.ascontiguousarray(drecip, np.float64)
+    out_ptr = ctypes.c_char_p()
+    n = lib.vn_encode_histo_batch(
+        meta_blob, len(meta_blob), _ptr(kinds), _ptr(scopes), _ptr(emit),
+        _ptr(means), _ptr(weights), rows, cap, _ptr(dmin), _ptr(dmax),
+        _ptr(drecip), ctypes.c_double(compression),
+        ctypes.byref(out_ptr))
+    if n < 0:
+        return None
+    return ctypes.string_at(out_ptr, n)
 
 
 def source_hash() -> str:
