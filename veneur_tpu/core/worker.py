@@ -876,6 +876,81 @@ class DeviceWorker:
              float(dmin), float(dmax), float(drecip))
         )
 
+    def import_digests_soa(self, rows: np.ndarray, lo: np.ndarray,
+                           hi: np.ndarray, means_flat: np.ndarray,
+                           weights_flat: np.ndarray, dmin: np.ndarray,
+                           dmax: np.ndarray, drecip: np.ndarray) -> None:
+        """Batched digest import from a decoded wire batch: rows were
+        already assigned by the native batched upsert (vn_upsert_many),
+        so no per-metric directory work remains — only buffering views
+        of the flat centroid arrays for the flush-time merge."""
+        k = len(rows)
+        if not k:
+            return
+        self.imported += k
+        if self._mesh_pool is not None:
+            for i in range(k):
+                self._mesh_pool.add_centroids(
+                    int(rows[i]), means_flat[lo[i]:hi[i]],
+                    weights_flat[lo[i]:hi[i]], float(drecip[i]))
+            return
+        self._ensure_histo(max(self.directory.num_histo_rows,
+                               int(rows.max()) + 1))
+        imp = self._imp_digests
+        setdefault = imp.setdefault
+        rl = rows.tolist()
+        lol = lo.tolist()
+        hil = hi.tolist()
+        mnl = dmin.tolist()
+        mxl = dmax.tolist()
+        rcl = drecip.tolist()
+        for i in range(k):
+            setdefault(rl[i], []).append(
+                (means_flat[lol[i]:hil[i]], weights_flat[lol[i]:hil[i]],
+                 mnl[i], mxl[i], rcl[i]))
+
+    def import_counter_rows(self, rows: np.ndarray,
+                            values: np.ndarray) -> None:
+        """Batched counter import by pre-assigned rows (forced-global
+        semantics were applied at upsert)."""
+        k = len(rows)
+        if not k:
+            return
+        self.imported += k
+        pool = self.scalars.counters
+        pool.ensure(int(rows.max()) + 1)
+        np.add.at(pool.values, rows, values.astype(np.int64))
+        pool.present[rows] = True
+
+    def import_gauge_rows(self, rows: np.ndarray,
+                          values: np.ndarray) -> None:
+        """Batched gauge import: duplicates resolve arbitrarily, which
+        is the reference's own semantics for global gauges
+        (random-write-wins, README.md:262)."""
+        k = len(rows)
+        if not k:
+            return
+        self.imported += k
+        pool = self.scalars.gauges
+        pool.ensure(int(rows.max()) + 1)
+        pool.values[rows] = values
+        pool.present[rows] = True
+
+    def import_hll_row(self, row: int, registers: np.ndarray) -> None:
+        """Register import by pre-assigned row."""
+        self.imported += 1
+        if len(registers) != (1 << self.hll_precision):
+            raise ValueError(
+                f"HLL payload has {len(registers)} registers, expected"
+                f" {1 << self.hll_precision}")
+        if self._staged_sets is not None:
+            self._staged_sets.import_dense(row, registers)
+            return
+        self._ensure_sets(max(self.directory.num_set_rows, row + 1))
+        prev = self._imp_hll.get(row)
+        regs = np.asarray(registers, np.int8)
+        self._imp_hll[row] = regs if prev is None else np.maximum(prev, regs)
+
     def import_hll(self, key: MetricKey, tags: list[str],
                    scope_class: ScopeClass, registers: np.ndarray) -> None:
         self.imported += 1

@@ -22,25 +22,40 @@ SEND_METRICS = f"/{SERVICE_NAME}/SendMetrics"
 def make_server(handler: Callable[[pb.MetricBatch], None],
                 address: str = "127.0.0.1:0",
                 max_workers: int = 4,
-                compat: bool = True) -> tuple[grpc.Server, int]:
+                compat: bool = True,
+                raw_handler: Optional[Callable[[bytes], None]] = None
+                ) -> tuple[grpc.Server, int]:
     """Start a Forward gRPC server; returns (server, bound_port).
 
     handler receives each MetricBatch; exceptions become INTERNAL errors.
-    With compat=True (the default) the same port also serves the reference
-    Go fleet's /forwardrpc.Forward/SendMetrics wire (distributed/interop),
-    feeding the same handler.
+    With raw_handler set, the request bytes skip gRPC-side protobuf
+    deserialization and go to raw_handler directly (the native wire
+    decoder path — see ImportServer.handle_wire). With compat=True (the
+    default) the same port also serves the reference Go fleet's
+    /forwardrpc.Forward/SendMetrics wire (distributed/interop), feeding
+    the message handler.
     """
 
-    def send_metrics(request: pb.MetricBatch, context) -> pb.SendResponse:
-        handler(request)
-        return pb.SendResponse()
+    if raw_handler is not None:
+        def send_metrics(request: bytes, context) -> pb.SendResponse:
+            raw_handler(request)
+            return pb.SendResponse()
+
+        deserializer = lambda b: b  # noqa: E731
+    else:
+        def send_metrics(request: pb.MetricBatch,
+                         context) -> pb.SendResponse:
+            handler(request)
+            return pb.SendResponse()
+
+        deserializer = pb.MetricBatch.FromString
 
     rpc_handlers = grpc.method_handlers_generic_handler(
         SERVICE_NAME,
         {
             "SendMetrics": grpc.unary_unary_rpc_method_handler(
                 send_metrics,
-                request_deserializer=pb.MetricBatch.FromString,
+                request_deserializer=deserializer,
                 response_serializer=pb.SendResponse.SerializeToString,
             )
         },

@@ -64,6 +64,26 @@ def load_library() -> Optional[ctypes.CDLL]:
         except AttributeError:  # pre-encoder library
             pass
         try:
+            P = c.POINTER
+            lib.vn_decode_metric_batch.restype = c.c_longlong
+            lib.vn_decode_metric_batch.argtypes = [
+                c.c_char_p, c.c_longlong,
+                P(c.c_char_p), P(c.c_longlong),          # meta
+                P(c.c_void_p), P(c.c_void_p),            # kinds, scopes
+                P(c.c_void_p), P(c.c_void_p),            # value_kind, digests
+                P(c.c_void_p),                           # scalars
+                P(c.c_void_p), P(c.c_void_p), P(c.c_void_p),  # dmin/max/rec
+                P(c.c_void_p),                           # compression
+                P(c.c_void_p), P(c.c_void_p), P(c.c_void_p),  # centroids
+                P(c.c_void_p), P(c.c_char_p), P(c.c_void_p)]  # hll
+            lib.vn_upsert_many.restype = c.c_longlong
+            lib.vn_upsert_many.argtypes = [
+                c.c_void_p, c.c_char_p, c.c_longlong,
+                c.c_void_p, c.c_void_p, c.c_void_p, c.c_longlong,
+                c.c_void_p]
+        except AttributeError:  # pre-import-decoder library
+            pass
+        try:
             lib.vn_set_lock_stats.argtypes = [c.c_int]
             lib.vn_lock_stats.restype = c.c_int
             lib.vn_lock_stats.argtypes = [
@@ -439,6 +459,88 @@ def encode_histo_batch(meta_blob: bytes, kinds: np.ndarray,
     if n < 0:
         return None
     return ctypes.string_at(out_ptr, n)
+
+
+class DecodedBatch:
+    """SoA view of one decoded MetricBatch (copies out of the C++
+    thread-local buffers, so the object outlives further decodes)."""
+
+    __slots__ = ("n", "meta", "kinds", "scopes", "value_kind", "digests",
+                 "scalars", "dmin", "dmax", "drecip", "compression",
+                 "cent_off", "cent_means", "cent_weights", "hll_off",
+                 "hll_bytes", "hll_precision")
+
+
+def _copy_arr(ptr: "ctypes.c_void_p", count: int, dtype) -> np.ndarray:
+    if count == 0 or not ptr.value:
+        return np.zeros(0, dtype)
+    ctype = np.ctypeslib.as_ctypes_type(dtype)
+    view = np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctype)), shape=(count,))
+    return view.copy()
+
+
+def decode_metric_batch(blob: bytes) -> Optional[DecodedBatch]:
+    """Parse serialized veneurtpu.MetricBatch wire bytes into SoA arrays
+    via the C++ decoder (native/dogstatsd.cpp vn_decode_metric_batch).
+    Returns None when the library lacks the symbol or the input is
+    malformed (callers fall back to the Python protobuf path)."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_decode_metric_batch"):
+        return None
+    c = ctypes
+    meta = c.c_char_p()
+    meta_len = c.c_longlong()
+    (kinds, scopes, value_kind, digests, scalars, dmin, dmax, drecip,
+     compression, cent_off, cent_means, cent_weights,
+     hll_off, hll_precision) = [c.c_void_p() for _ in range(14)]
+    hll_bytes = c.c_char_p()
+    n = lib.vn_decode_metric_batch(
+        blob, len(blob), c.byref(meta), c.byref(meta_len),
+        c.byref(kinds), c.byref(scopes), c.byref(value_kind),
+        c.byref(digests), c.byref(scalars), c.byref(dmin), c.byref(dmax),
+        c.byref(drecip), c.byref(compression), c.byref(cent_off),
+        c.byref(cent_means), c.byref(cent_weights), c.byref(hll_off),
+        c.byref(hll_bytes), c.byref(hll_precision))
+    if n < 0:
+        return None
+    d = DecodedBatch()
+    d.n = n
+    d.meta = ctypes.string_at(meta, meta_len.value) if meta_len.value \
+        else b""
+    d.kinds = _copy_arr(kinds, n, np.uint8)
+    d.scopes = _copy_arr(scopes, n, np.uint8)
+    d.value_kind = _copy_arr(value_kind, n, np.uint8)
+    d.digests = _copy_arr(digests, n, np.uint32)
+    d.scalars = _copy_arr(scalars, n, np.float64)
+    d.dmin = _copy_arr(dmin, n, np.float64)
+    d.dmax = _copy_arr(dmax, n, np.float64)
+    d.drecip = _copy_arr(drecip, n, np.float64)
+    d.compression = _copy_arr(compression, n, np.float64)
+    d.cent_off = _copy_arr(cent_off, n + 1, np.int64)
+    ncent = int(d.cent_off[-1]) if n else 0
+    d.cent_means = _copy_arr(cent_means, ncent, np.float32)
+    d.cent_weights = _copy_arr(cent_weights, ncent, np.float32)
+    d.hll_off = _copy_arr(hll_off, n + 1, np.int64)
+    nhll = int(d.hll_off[-1]) if n else 0
+    d.hll_bytes = ctypes.string_at(hll_bytes, nhll) if nhll else b""
+    d.hll_precision = _copy_arr(hll_precision, n, np.int32)
+    return d
+
+
+def upsert_many(ctx: "NativeIngest", meta: bytes, kinds: np.ndarray,
+                scopes: np.ndarray, sel: np.ndarray) -> np.ndarray:
+    """Batch directory upsert under one native lock hold. Returns row
+    ids (i32[n], -1 where unselected)."""
+    lib = ctx._lib
+    n = len(kinds)
+    out = np.empty(n, np.int32)
+    kinds = np.ascontiguousarray(kinds, np.uint8)
+    scopes = np.ascontiguousarray(scopes, np.uint8)
+    sel = np.ascontiguousarray(sel, np.uint8)
+    lib.vn_upsert_many(ctx._ctx, meta, len(meta), _ptr(kinds),
+                       _ptr(scopes), _ptr(sel), n, _ptr(out))
+    return out
 
 
 def source_hash() -> str:
