@@ -1,0 +1,110 @@
+"""Global-tier import throughput: forward-encode + wire-decode + merge
+application, end to end in-process.
+
+A local's flush forwards its digests; the global must decode and merge
+them within its own flush interval. This harness builds a realistic
+S-series forwarded batch (native wire encoder), then measures the
+global side: handle_wire (C++ decode + batched upsert + SoA buffering)
+vs the Python protobuf path, plus the flush-time device merge that
+consumes the buffered digests. Writes IMPORT_SCALING.json.
+
+Env: VENEUR_IMPORT_SERIES (default 50000), VENEUR_IMPORT_ROUNDS (2).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.directory import ScopeClass
+    from veneur_tpu.core.flusher import device_quantiles
+    from veneur_tpu.core.metrics import HistogramAggregates, MetricKey
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.core.worker import DeviceWorker
+    from veneur_tpu.distributed import codec
+    from veneur_tpu.distributed.import_server import ImportServer
+    from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+    series = int(os.environ.get("VENEUR_IMPORT_SERIES", 50_000))
+    rounds = int(os.environ.get("VENEUR_IMPORT_ROUNDS", 2))
+
+    w = DeviceWorker(initial_histo_rows=series)
+    for i in range(series):
+        w.directory.upsert_histo(
+            MetricKey(name=f"m{i}", type="timer", joined_tags="env:prod"),
+            ScopeClass.MIXED, ["env:prod"])
+    w._ensure_histo(series)
+    rng = np.random.default_rng(0)
+    rows = ((np.arange(series * 4, dtype=np.int64)) % series
+            ).astype(np.int32)
+    w._device_histo_step(rows, rng.gamma(2.0, 50.0, series * 4
+                                         ).astype(np.float32),
+                         np.ones(series * 4, np.float32))
+    qs = device_quantiles([0.5], HistogramAggregates.from_names(["count"]))
+    snap = w.flush(qs, interval_s=10.0)
+
+    t0 = time.perf_counter()
+    blob, n = codec.snapshot_to_wire(snap)
+    encode_s = time.perf_counter() - t0
+
+    results = {}
+    for name, fn in (
+            ("wire_native", lambda imp: imp.handle_wire(blob)),
+            ("python_pb", lambda imp: imp.handle_batch(
+                pb.MetricBatch.FromString(blob)))):
+        best = None
+        for r in range(rounds):
+            g = Server(Config(interval="10s", percentiles=[0.5]))
+            imp = ImportServer(g)
+            t0 = time.perf_counter()
+            fn(imp)
+            dt = time.perf_counter() - t0
+            assert imp.received_metrics == n, (
+                name, imp.received_metrics, n)
+            if r == rounds - 1:
+                # merge cost: the buffered digests land on device at
+                # the global's flush
+                t0 = time.perf_counter()
+                gsnap = g.workers[0].flush(qs, 10.0)
+                merge_s = time.perf_counter() - t0
+                assert gsnap.directory.num_histo_rows == series
+            best = dt if best is None else min(best, dt)
+            g.shutdown()
+        results[name] = {"apply_s": round(best, 3),
+                         "metrics_per_s": round(n / best, 1)}
+    results["device_merge_flush_s"] = round(merge_s, 3)
+
+    out = {
+        "platform": jax.default_backend(),
+        "series": series,
+        "batch_bytes": len(blob),
+        "forward_encode_s": round(encode_s, 3),
+        "results": results,
+        "speedup_native_vs_python": round(
+            results["python_pb"]["apply_s"]
+            / results["wire_native"]["apply_s"], 2),
+    }
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "IMPORT_SCALING.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({
+        "metric": "import_apply_metrics_per_s",
+        "value": results["wire_native"]["metrics_per_s"],
+        "unit": "metrics/s",
+        "vs_baseline": out["speedup_native_vs_python"],
+        "platform": out["platform"]}))
+
+
+if __name__ == "__main__":
+    main()
