@@ -63,26 +63,33 @@ def main() -> None:
             ("wire_native", lambda imp: imp.handle_wire(blob)),
             ("python_pb", lambda imp: imp.handle_batch(
                 pb.MetricBatch.FromString(blob)))):
+        g = Server(Config(interval="10s", percentiles=[0.5]))
+        imp = ImportServer(g)
+        # round 1: cold — every series is new to the process
+        t0 = time.perf_counter()
+        fn(imp)
+        cold = time.perf_counter() - t0
+        assert imp.received_metrics == n, (name, imp.received_metrics, n)
+        # the flush closes the epoch (directory reset) and merges the
+        # buffered digests on device
+        t0 = time.perf_counter()
+        gsnap = g.workers[0].flush(qs, 10.0)
+        merge_s = time.perf_counter() - t0
+        assert gsnap.directory.num_histo_rows == series
+        # steady state: the reference's world — the same series arrive
+        # again next interval; re-adoption hits the cross-epoch cache
         best = None
-        for r in range(rounds):
-            g = Server(Config(interval="10s", percentiles=[0.5]))
-            imp = ImportServer(g)
+        for _ in range(rounds):
             t0 = time.perf_counter()
             fn(imp)
             dt = time.perf_counter() - t0
-            assert imp.received_metrics == n, (
-                name, imp.received_metrics, n)
-            if r == rounds - 1:
-                # merge cost: the buffered digests land on device at
-                # the global's flush
-                t0 = time.perf_counter()
-                gsnap = g.workers[0].flush(qs, 10.0)
-                merge_s = time.perf_counter() - t0
-                assert gsnap.directory.num_histo_rows == series
+            g.workers[0].flush(qs, 10.0)
             best = dt if best is None else min(best, dt)
-            g.shutdown()
-        results[name] = {"apply_s": round(best, 3),
-                         "metrics_per_s": round(n / best, 1)}
+        results[name] = {
+            "cold_apply_s": round(cold, 3),
+            "apply_s": round(best, 3),
+            "metrics_per_s": round(n / best, 1)}
+        g.shutdown()
     results["device_merge_flush_s"] = round(merge_s, 3)
 
     out = {

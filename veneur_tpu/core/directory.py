@@ -93,20 +93,21 @@ class _Pool:
               tags: list[str]) -> None:
         """Register metadata for a row assigned externally (the native
         directory assigns rows in the same append order)."""
+        self.adopt_meta(row, RowMeta(
+            key=key, tags=tags, scope_class=scope_class,
+            sinks=route_info(tags)))
+
+    def adopt_meta(self, row: int, meta: RowMeta) -> None:
+        """Adopt with prebuilt metadata (the worker's cross-epoch adopt
+        cache reuses one RowMeta per series: the same series re-registers
+        every interval, and rebuilding key/tags/routing per epoch was
+        the global tier's import bottleneck)."""
         assert row == len(self.rows), "rows must be adopted in order"
-        self.index[(key, scope_class)] = row
-        sinks = route_info(tags)
-        if sinks is not None:
+        self.index[(meta.key, meta.scope_class)] = row
+        if meta.sinks is not None:
             self.routed_rows += 1
-        self.scope_codes.append(int(scope_class))
-        self.rows.append(
-            RowMeta(
-                key=key,
-                tags=tags,
-                scope_class=scope_class,
-                sinks=sinks,
-            )
-        )
+        self.scope_codes.append(int(meta.scope_class))
+        self.rows.append(meta)
 
 
 class SeriesDirectory:

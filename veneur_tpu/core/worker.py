@@ -419,6 +419,10 @@ class DeviceWorker:
         self.imported = 0
         self._native = None
         self._mesh_pool = None
+        # cross-epoch series-metadata cache (see _sync_native_series);
+        # deliberately NOT in _reset_epoch — surviving the per-flush
+        # directory swap is its whole purpose
+        self._adopt_cache: dict = {}
         self._reset_epoch()
 
     def attach_mesh_pool(self, pool) -> None:
@@ -492,32 +496,50 @@ class DeviceWorker:
         return rc
 
     def _sync_native_series(self) -> None:
+        from veneur_tpu.core.directory import RowMeta
         from veneur_tpu.native import NativeIngest
 
         if not self._native.pending_new_series:
             return
+        # cross-epoch adopt cache: every flush resets the directory and
+        # the same series re-register next interval; their RowMeta
+        # (key, tags, routing) is identical every time, so build it once
+        # per series lifetime instead of per epoch — the dominant cost
+        # of the global tier's steady-state import before this cache
+        cache = self._adopt_cache
         for pool, row, kind, scope, name, joined in (
             self._native.drain_new_series()
         ):
-            mtype = NativeIngest.TYPE_BY_KIND[kind]
-            key = MetricKey(name=name, type=mtype, joined_tags=joined)
-            tags = joined.split(",") if joined else []
-            cls = ScopeClass(scope)
+            ck = (pool, kind, scope, name, joined)
+            meta = cache.get(ck)
+            if meta is None:
+                mtype = NativeIngest.TYPE_BY_KIND[kind]
+                key = MetricKey(name=name, type=mtype, joined_tags=joined)
+                tags = joined.split(",") if joined else []
+                meta = RowMeta(key=key, tags=tags,
+                               scope_class=ScopeClass(scope),
+                               sinks=route_info(tags))
+                if len(cache) >= 4_000_000:
+                    # unbounded series churn: drop the cache rather than
+                    # grow without limit (steady workloads never hit it)
+                    cache.clear()
+                cache[ck] = meta
             if self.count_unique_timeseries:
                 # feed the unique-timeseries HLL once per new series; the
                 # HLL insert is idempotent so per-sample feeding (the Python
                 # path, worker.go:300-341) and per-series feeding agree
-                self._sample_timeseries_key(name, mtype, joined, cls)
+                self._sample_timeseries_key(name, meta.key.type, joined,
+                                            meta.scope_class)
             if pool == 0:
-                self.directory.histo.adopt(row, key, cls, tags)
+                self.directory.histo.adopt_meta(row, meta)
             elif pool == 1:
-                self.directory.sets.adopt(row, key, cls, tags)
+                self.directory.sets.adopt_meta(row, meta)
             elif pool == 2:
-                self.scalars.counters.adopt_row(row, key, tags, cls,
-                                                route_info(tags))
+                self.scalars.counters.adopt_row(
+                    row, meta.key, meta.tags, meta.scope_class, meta.sinks)
             else:
-                self.scalars.gauges.adopt_row(row, key, tags, cls,
-                                              route_info(tags))
+                self.scalars.gauges.adopt_row(
+                    row, meta.key, meta.tags, meta.scope_class, meta.sinks)
 
     def drain_native(self) -> None:
         """Move everything pending in the native pipeline into device/host
