@@ -404,6 +404,38 @@ def test_snapshot_to_wire_separator_handling():
     assert len(got2.metrics[0].digest.centroids.means) == 2
 
 
+def test_handle_wire_rejects_kind_value_mismatch():
+    """A metric whose kind disagrees with its value oneof (hostile or
+    buggy peer) must be rejected by the native import path, not applied
+    to a row in the wrong pool."""
+    from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+    g, imp, _port = _global_server()
+    try:
+        # a legitimate counter occupying counter row 0
+        batch = pb.MetricBatch()
+        ok = batch.metrics.add()
+        ok.name = "legit"
+        ok.kind = pb.KIND_COUNTER
+        ok.scope = pb.SCOPE_GLOBAL
+        ok.counter.value = 5
+        # hostile: kind=SET but a counter value (would alias counter
+        # pool rows if applied by value without the kind check)
+        evil = batch.metrics.add()
+        evil.name = "evil"
+        evil.kind = pb.KIND_SET
+        evil.scope = pb.SCOPE_MIXED
+        evil.counter.value = 999
+        imp.handle_wire(batch.SerializeToString())
+        assert imp.received_metrics == 1
+        assert imp.import_errors == 1
+        w = g.workers[0]
+        vals = w.scalars.counters.values[:w.scalars.counters.used]
+        assert list(vals) == [5.0]
+    finally:
+        imp.stop()
+
+
 def test_proxy_http_import_ring_splits():
     """HTTP face of the proxy: POST /import is ring-split across globals
     (reference veneur-proxy ProxyMetrics, proxy.go:587-628)."""

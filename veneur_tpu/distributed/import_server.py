@@ -101,7 +101,17 @@ class ImportServer:
         scopes = d.scopes.copy()
         scopes[(vk == 1) | (vk == 2)] = int(ScopeClass.GLOBAL)
         scopes[vk == 4] = int(ScopeClass.MIXED)
-        bad = (vk == 0) | ((vk == 3) & (scopes == int(ScopeClass.LOCAL)))
+        # the batched upsert pools rows by KIND while values apply by
+        # VALUE type, so a metric whose kind disagrees with its value
+        # would alias a row in the wrong pool — reject the mismatch
+        # (our forwarders never produce one; wire input is untrusted)
+        kinds = d.kinds
+        kind_ok = (((vk == 1) & (kinds == 0))
+                   | ((vk == 2) & (kinds == 1))
+                   | ((vk == 3) & ((kinds == 2) | (kinds == 3)))
+                   | ((vk == 4) & (kinds == 4)))
+        bad = (vk == 0) | ~kind_ok | (
+            (vk == 3) & (scopes == int(ScopeClass.LOCAL)))
         errors = int(bad.sum())
         ok = ~bad
         shard = d.digests % np.uint32(len(workers))
