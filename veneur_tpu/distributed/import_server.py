@@ -71,13 +71,14 @@ class ImportServer:
                 "import.response_duration_ns",
                 (time.time() - started) * 1e9, tags=["part:merge"])
 
-    def handle_wire(self, blob: bytes) -> None:
-        """Apply a serialized MetricBatch. Fast path: the C++ wire
-        decoder + batched native directory upsert (one lock hold per
-        worker chunk) — no per-metric Python protobuf objects. Falls
-        back to the Python path when the native library is unavailable,
-        any worker lacks a native context, or the blob needs the
-        lenient per-metric handling."""
+    def handle_wire(self, blob: bytes) -> int:
+        """Apply a serialized MetricBatch; returns the metric count seen
+        (applied + rejected). Fast path: the C++ wire decoder + batched
+        native directory upsert (one lock hold per worker chunk) — no
+        per-metric Python protobuf objects. Falls back to the Python
+        path (which raises DecodeError on malformed bytes) when the
+        native library is unavailable, any worker lacks a native
+        context, or the blob needs the lenient per-metric handling."""
         import numpy as np
 
         from veneur_tpu.core.directory import ScopeClass
@@ -88,10 +89,11 @@ class ImportServer:
         if getattr(self.server, "native_mode", False):
             d = native_mod.decode_metric_batch(blob)
         if d is None:
-            self.handle_batch(pb.MetricBatch.FromString(blob))
-            return
+            batch = pb.MetricBatch.FromString(blob)
+            self.handle_batch(batch)
+            return len(batch.metrics)
         if d.n == 0:
-            return
+            return 0
         started = time.time()
         locks = self.server._worker_locks
         vk = d.value_kind
@@ -163,6 +165,7 @@ class ImportServer:
             stats.time_in_nanoseconds(
                 "import.response_duration_ns",
                 (time.time() - started) * 1e9, tags=["part:merge"])
+        return int(d.n)
 
     def start_grpc(self, address: str = "127.0.0.1:0") -> int:
         self.grpc_server, self.port = rpc.make_server(
@@ -290,8 +293,24 @@ class ImportHTTPServer:
                     body = self.rfile.read(length)
                     stats = getattr(srv, "stats", None) if srv else None
                     try:
-                        batch = decode_http_import_body(
-                            body, self.headers.get("Content-Encoding", ""))
+                        enc = self.headers.get("Content-Encoding", "")
+                        if enc == "deflate":
+                            body = zlib.decompress(body)
+                            enc = ""
+                        if body and body[:1] not in (b"[", b"{"):
+                            # binary protobuf body: the native wire path
+                            # decodes and applies it; malformed bytes
+                            # raise (DecodeError from the fallback) and
+                            # an empty batch is the client bug the
+                            # reference 400s
+                            if imp.handle_wire(body) == 0:
+                                raise ValueError(
+                                    "import batch contains no metrics")
+                        else:
+                            # JSON bodies keep the lenient per-metric
+                            # decode path
+                            imp.handle_batch(decode_http_import_body(
+                                body, enc))
                     except Exception as e:
                         if stats is not None:
                             stats.count("import.request_error_total", 1,
@@ -306,7 +325,6 @@ class ImportHTTPServer:
                             "import.response_duration_ns",
                             (time.time() - req_start) * 1e9,
                             tags=["part:request"])
-                    imp.handle_batch(batch)
                     self._respond(200, b"accepted")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
