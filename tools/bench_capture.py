@@ -44,7 +44,10 @@ def axon_lock():
     return f
 
 
-def probe(timeout: float = 240.0) -> str | None:
+def probe(timeout: float = 480.0) -> str | None:
+    """Longer than the bench's own probe: a healing relay can take
+    minutes to complete a first init, and aborting a would-succeed init
+    both wastes the window and can re-wedge the relay."""
     """Return the live platform name, or None if the backend is wedged."""
     try:
         r = subprocess.run(
