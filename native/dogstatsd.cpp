@@ -1639,6 +1639,11 @@ struct Decoded {
   std::vector<long long> hll_off;  // [n+1]
   std::string hll_bytes;
   std::vector<int32_t> hll_precision;
+  // byte range of each metric's length-prefixed record in the source
+  // buffer (tag byte through body end): lets a proxy ring-split a batch
+  // by slicing the original bytes, no re-encode (protobuf repeated
+  // records concatenate)
+  std::vector<long long> rec_off, rec_len;
 
   void clear() {
     meta.clear();
@@ -1657,6 +1662,8 @@ struct Decoded {
     hll_off.assign(1, 0);
     hll_bytes.clear();
     hll_precision.clear();
+    rec_off.clear();
+    rec_len.clear();
   }
 };
 
@@ -1944,12 +1951,15 @@ long long vn_decode_metric_batch(
     const double** drecip, const double** compression,
     const long long** cent_off, const float** cent_means,
     const float** cent_weights, const long long** hll_off,
-    const char** hll_bytes, const int32_t** hll_precision) {
+    const char** hll_bytes, const int32_t** hll_precision,
+    const long long** rec_off, const long long** rec_len) {
   Decoded& d = g_decoded;
   d.clear();
   WireCursor c{reinterpret_cast<const uint8_t*>(buf),
                reinterpret_cast<const uint8_t*>(buf + len)};
+  const uint8_t* base = reinterpret_cast<const uint8_t*>(buf);
   while (c.p < c.end) {
+    const uint8_t* tag_start = c.p;
     uint64_t tag;
     if (!c.varint(&tag)) return -1;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
@@ -1957,6 +1967,8 @@ long long vn_decode_metric_batch(
     if (field == 1 && wt == 2) {
       std::string_view body;
       if (!c.len_view(&body) || !decode_metric(body, &d)) return -1;
+      d.rec_off.push_back(static_cast<long long>(tag_start - base));
+      d.rec_len.push_back(static_cast<long long>(c.p - tag_start));
     } else if (!c.skip(wt)) {
       return -1;
     }
@@ -1978,6 +1990,8 @@ long long vn_decode_metric_batch(
   *hll_off = d.hll_off.data();
   *hll_bytes = d.hll_bytes.data();
   *hll_precision = d.hll_precision.data();
+  *rec_off = d.rec_off.data();
+  *rec_len = d.rec_len.data();
   return static_cast<long long>(d.kinds.size());
 }
 

@@ -404,6 +404,50 @@ def test_snapshot_to_wire_separator_handling():
     assert len(got2.metrics[0].digest.centroids.means) == 2
 
 
+def test_proxy_wire_split_matches_python_ring_placement():
+    """The byte-slicing proxy path places every metric on the same ring
+    destination the Python path picks, and the concatenated slices
+    decode into exactly the routed metrics."""
+    from veneur_tpu import native as native_mod
+    from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+    local = _local_server(1, use_grpc=True)
+    for i in range(40):
+        _ingest_histo(local, f"pr{i}", [float(i)], tags=[f"t:{i % 5}"])
+        local.process_metric_packet(f"pc{i}:1|c|#veneurglobalonly".encode())
+        local.process_metric_packet(f"ps{i}:x{i}|s".encode())
+    qs = device_quantiles(PCTS, AGGS)
+    with local._worker_locks[0]:
+        snap = local.workers[0].flush(qs, 10.0)
+    blob, n = codec.snapshot_to_wire(snap, 100.0, 14)
+    batch = pb.MetricBatch.FromString(blob)
+
+    proxy = ProxyServer(["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"])
+    sent: dict[str, bytes] = {}
+
+    class FakeConn:
+        def __init__(self, dest):
+            self.dest = dest
+
+        def send_raw(self, payload, count):
+            sent[self.dest] = payload
+            return True
+
+    proxy._conn = lambda dest: FakeConn(dest)
+    proxy._route_wire(blob)
+    assert proxy.proxied_metrics == n
+
+    expect: dict[str, list] = {}
+    for m in batch.metrics:
+        dest = proxy.ring.get(codec.metric_key(m).key_string())
+        expect.setdefault(dest, []).append(m.name)
+    got = {}
+    for dest, payload in sent.items():
+        sub = pb.MetricBatch.FromString(payload)
+        got[dest] = [m.name for m in sub.metrics]
+    assert got == expect
+
+
 def test_handle_wire_rejects_kind_value_mismatch():
     """A metric whose kind disagrees with its value oneof (hostile or
     buggy peer) must be rejected by the native import path, not applied

@@ -72,6 +72,54 @@ class ProxyServer:
             name="proxy-route",
         ).start()
 
+    def handle_wire(self, blob: bytes) -> None:
+        threading.Thread(
+            target=self._route_wire, args=(blob,), daemon=True,
+            name="proxy-route",
+        ).start()
+
+    def _route_wire(self, blob: bytes) -> None:
+        """Ring-split a serialized batch by BYTE SLICING: the native
+        decoder reports each metric's record range in the source bytes,
+        and protobuf repeated records concatenate — so the per-dest
+        payloads are joins of slices of the original buffer, nothing
+        re-encoded (the reference re-marshals per destination,
+        proxysrv/server.go:286-305)."""
+        from veneur_tpu import native as native_mod
+
+        d = native_mod.decode_metric_batch(blob)
+        if d is None:
+            self._route_batch(pb.MetricBatch.FromString(blob))
+            return
+        if not d.n:
+            return
+        _TYPE = ("counter", "gauge", "histogram", "timer", "set")
+        recs = d.meta.split(b"\x1e")
+        off = d.rec_off.tolist()
+        ln = d.rec_len.tolist()
+        by_dest: dict[str, list] = {}
+        counts: dict[str, int] = {}
+        get = self.ring.get
+        try:
+            for i, rec in enumerate(recs):
+                name, _, joined = rec.partition(b"\x1f")
+                key_string = (name.decode("utf-8", "replace")
+                              + _TYPE[d.kinds[i]]
+                              + joined.decode("utf-8", "replace"))
+                dest = get(key_string)
+                by_dest.setdefault(dest, []).append(
+                    blob[off[i]:off[i] + ln[i]])
+                counts[dest] = counts.get(dest, 0) + 1
+        except LookupError:
+            self.drops += d.n
+            log.warning("no destinations; dropping batch")
+            return
+        for dest, parts in by_dest.items():
+            if self._conn(dest).send_raw(b"".join(parts), counts[dest]):
+                self.proxied_metrics += counts[dest]
+            else:
+                self.drops += counts[dest]
+
     def _route_batch(self, batch: pb.MetricBatch) -> None:
         by_dest: dict[str, pb.MetricBatch] = {}
         for m in batch.metrics:
@@ -91,7 +139,7 @@ class ProxyServer:
 
     def start_grpc(self, address: str = "127.0.0.1:0") -> int:
         self.grpc_server, self.port = rpc.make_server(
-            self.handle_batch, address)
+            self.handle_batch, address, raw_handler=self.handle_wire)
         return self.port
 
     def stop(self) -> None:
