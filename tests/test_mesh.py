@@ -193,6 +193,14 @@ def test_config_driven_mesh_global_end_to_end():
         with gsrv._worker_locks[0]:
             snap = gsrv.workers[0].flush(qs, 10.0)
         metrics = generate_inter_metrics(snap, False, pcts, aggs)
+        # the columnar path must agree on mesh snapshots too (the mesh
+        # fills the host-local columns with neutral values)
+        from veneur_tpu.core.flusher import generate_columnar
+
+        batch = generate_columnar(snap, False, pcts, aggs)
+        assert sorted((m.name, round(m.value, 6))
+                      for m in batch.materialize()) == sorted(
+            (m.name, round(m.value, 6)) for m in metrics)
         by_key = {(m.name, m.type): m for m in metrics}
         union = np.concatenate(all_vals)
         p50 = by_key[("mesh.lat.50percentile", MetricType.GAUGE)].value
