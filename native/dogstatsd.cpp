@@ -53,13 +53,16 @@ inline uint32_t fnv1a32(std::string_view s, uint32_t h = kFnv32Offset) {
   return h;
 }
 
-inline uint64_t fnv1a64(std::string_view s) {
-  uint64_t h = kFnv64Offset;
+inline uint64_t fnv1a64_continue(std::string_view s, uint64_t h) {
   for (unsigned char c : s) {
     h ^= c;
     h *= kFnv64Prime;
   }
   return h;
+}
+
+inline uint64_t fnv1a64(std::string_view s) {
+  return fnv1a64_continue(s, kFnv64Offset);
 }
 
 inline uint64_t fmix64(uint64_t h) {
@@ -1644,6 +1647,10 @@ struct Decoded {
   // by slicing the original bytes, no re-encode (protobuf repeated
   // records concatenate)
   std::vector<long long> rec_off, rec_len;
+  // consistent-ring key hash: fmix64(fnv1a64(name + type + joined)) —
+  // the proxy's per-metric placement hash, computed here so the Python
+  // tier never hashes per metric (distributed/ring.owners_for_hashes)
+  std::vector<uint64_t> ring_hash;
 
   void clear() {
     meta.clear();
@@ -1664,6 +1671,7 @@ struct Decoded {
     hll_precision.clear();
     rec_off.clear();
     rec_len.clear();
+    ring_hash.clear();
   }
 };
 
@@ -1916,6 +1924,10 @@ bool decode_metric(std::string_view body, Decoded* d) {
   uint32_t digest = fnv1a32(name);
   digest = fnv1a32(type_str, digest);
   digest = fnv1a32(joined, digest);
+  uint64_t rh = fnv1a64_continue(name, kFnv64Offset);
+  rh = fnv1a64_continue(type_str, rh);
+  rh = fmix64(fnv1a64_continue(joined, rh));
+  d->ring_hash.push_back(rh);
 
   if (!d->meta.empty()) d->meta.push_back('\x1e');
   d->meta.append(name);
@@ -1952,7 +1964,8 @@ long long vn_decode_metric_batch(
     const long long** cent_off, const float** cent_means,
     const float** cent_weights, const long long** hll_off,
     const char** hll_bytes, const int32_t** hll_precision,
-    const long long** rec_off, const long long** rec_len) {
+    const long long** rec_off, const long long** rec_len,
+    const uint64_t** ring_hash) {
   Decoded& d = g_decoded;
   d.clear();
   WireCursor c{reinterpret_cast<const uint8_t*>(buf),
@@ -1992,6 +2005,7 @@ long long vn_decode_metric_batch(
   *hll_precision = d.hll_precision.data();
   *rec_off = d.rec_off.data();
   *rec_len = d.rec_len.data();
+  *ring_hash = d.ring_hash.data();
   return static_cast<long long>(d.kinds.size());
 }
 

@@ -93,27 +93,22 @@ class ProxyServer:
             return
         if not d.n:
             return
-        _TYPE = ("counter", "gauge", "histogram", "timer", "set")
-        recs = d.meta.split(b"\x1e")
         off = d.rec_off.tolist()
         ln = d.rec_len.tolist()
         by_dest: dict[str, list] = {}
         counts: dict[str, int] = {}
-        get = self.ring.get
         try:
-            for i, rec in enumerate(recs):
-                name, _, joined = rec.partition(b"\x1f")
-                key_string = (name.decode("utf-8", "replace")
-                              + _TYPE[d.kinds[i]]
-                              + joined.decode("utf-8", "replace"))
-                dest = get(key_string)
-                by_dest.setdefault(dest, []).append(
-                    blob[off[i]:off[i] + ln[i]])
-                counts[dest] = counts.get(dest, 0) + 1
+            # placement hashes came out of the decoder; one vectorized
+            # searchsorted places the whole batch on the ring
+            dests = self.ring.owners_for_hashes(d.ring_hash)
         except LookupError:
             self.drops += d.n
             log.warning("no destinations; dropping batch")
             return
+        for i, dest in enumerate(dests):
+            by_dest.setdefault(dest, []).append(
+                blob[off[i]:off[i] + ln[i]])
+            counts[dest] = counts.get(dest, 0) + 1
         for dest, parts in by_dest.items():
             if self._conn(dest).send_raw(b"".join(parts), counts[dest]):
                 self.proxied_metrics += counts[dest]

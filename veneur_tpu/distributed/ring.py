@@ -78,5 +78,21 @@ class ConsistentRing:
             idx = 0
         return self._owners[self._hashes[idx]]
 
+    def owners_for_hashes(self, hashes) -> list:
+        """Vectorized placement for pre-hashed keys (the native wire
+        decoder emits fmix64(fnv1a64(key)) per metric): one searchsorted
+        over the ring points instead of a Python hash + bisect per key.
+        Returns one owner per input hash."""
+        import numpy as np
+
+        if not self._hashes:
+            raise LookupError("empty ring")
+        arr = np.asarray(self._hashes, dtype=np.uint64)
+        owners = [self._owners[h] for h in self._hashes]
+        idx = np.searchsorted(arr, np.asarray(hashes, np.uint64),
+                              side="right")
+        idx[idx == len(arr)] = 0
+        return [owners[i] for i in idx.tolist()]
+
     def __len__(self) -> int:
         return len(self._members)

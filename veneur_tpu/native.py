@@ -76,7 +76,8 @@ def load_library() -> Optional[ctypes.CDLL]:
                 P(c.c_void_p),                           # compression
                 P(c.c_void_p), P(c.c_void_p), P(c.c_void_p),  # centroids
                 P(c.c_void_p), P(c.c_char_p), P(c.c_void_p),  # hll
-                P(c.c_void_p), P(c.c_void_p)]  # record byte ranges
+                P(c.c_void_p), P(c.c_void_p),  # record byte ranges
+                P(c.c_void_p)]  # ring hashes
             lib.vn_upsert_many.restype = c.c_longlong
             lib.vn_upsert_many.argtypes = [
                 c.c_void_p, c.c_char_p, c.c_longlong,
@@ -469,7 +470,8 @@ class DecodedBatch:
     __slots__ = ("n", "meta", "kinds", "scopes", "value_kind", "digests",
                  "scalars", "dmin", "dmax", "drecip", "compression",
                  "cent_off", "cent_means", "cent_weights", "hll_off",
-                 "hll_bytes", "hll_precision", "rec_off", "rec_len")
+                 "hll_bytes", "hll_precision", "rec_off", "rec_len",
+                 "ring_hash")
 
 
 def _copy_arr(ptr: "ctypes.c_void_p", count: int, dtype) -> np.ndarray:
@@ -494,8 +496,8 @@ def decode_metric_batch(blob: bytes) -> Optional[DecodedBatch]:
     meta_len = c.c_longlong()
     (kinds, scopes, value_kind, digests, scalars, dmin, dmax, drecip,
      compression, cent_off, cent_means, cent_weights,
-     hll_off, hll_precision, rec_off, rec_len) = [
-        c.c_void_p() for _ in range(16)]
+     hll_off, hll_precision, rec_off, rec_len, ring_hash) = [
+        c.c_void_p() for _ in range(17)]
     hll_bytes = c.c_char_p()
     n = lib.vn_decode_metric_batch(
         blob, len(blob), c.byref(meta), c.byref(meta_len),
@@ -504,7 +506,7 @@ def decode_metric_batch(blob: bytes) -> Optional[DecodedBatch]:
         c.byref(drecip), c.byref(compression), c.byref(cent_off),
         c.byref(cent_means), c.byref(cent_weights), c.byref(hll_off),
         c.byref(hll_bytes), c.byref(hll_precision), c.byref(rec_off),
-        c.byref(rec_len))
+        c.byref(rec_len), c.byref(ring_hash))
     if n < 0:
         return None
     d = DecodedBatch()
@@ -530,6 +532,7 @@ def decode_metric_batch(blob: bytes) -> Optional[DecodedBatch]:
     d.hll_precision = _copy_arr(hll_precision, n, np.int32)
     d.rec_off = _copy_arr(rec_off, n, np.int64)
     d.rec_len = _copy_arr(rec_len, n, np.int64)
+    d.ring_hash = _copy_arr(ring_hash, n, np.uint64)
     return d
 
 
