@@ -92,6 +92,30 @@ def main() -> None:
         g.shutdown()
     results["device_merge_flush_s"] = round(merge_s, 3)
 
+    # proxy tier: ring-split the same batch across 3 destinations —
+    # byte-slicing wire path vs per-metric python protobuf path
+    from veneur_tpu.distributed.proxy import ProxyServer
+
+    class _Sink:
+        def send_raw(self, payload, count):
+            return True
+
+        def send(self, sub):
+            return True
+
+    for pname, route_attr, arg in (
+            ("proxy_wire", "_route_wire", blob),
+            ("proxy_python", "_route_batch",
+             pb.MetricBatch.FromString(blob))):
+        proxy = ProxyServer(["a:1", "b:2", "c:3"])
+        proxy._conn = lambda dest: _Sink()
+        getattr(proxy, route_attr)(arg)  # warm
+        t0 = time.perf_counter()
+        getattr(proxy, route_attr)(arg)
+        dt = time.perf_counter() - t0
+        results[pname] = {"route_s": round(dt, 3),
+                          "metrics_per_s": round(n / dt, 1)}
+
     out = {
         "platform": jax.default_backend(),
         "series": series,
