@@ -47,10 +47,26 @@ _AXON_LOCK = "/tmp/veneur_tpu_axon.lock"
 
 
 class _axon_lock:
+    """Bounded exclusive lock: if another process (the background
+    capture loop) holds the relay mid-capture, wait a while — but never
+    forever. Proceeding after the timeout risks a concurrent-init wedge,
+    which is still better than the driver killing a bench that never
+    started."""
+
     def __enter__(self):
         self._f = open(_AXON_LOCK, "w")
-        fcntl.flock(self._f, fcntl.LOCK_EX)
-        return self
+        deadline = time.time() + float(
+            os.environ.get("VENEUR_AXON_LOCK_TIMEOUT", 600))
+        while True:
+            try:
+                fcntl.flock(self._f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return self
+            except OSError:
+                if time.time() >= deadline:
+                    print("bench: axon lock busy past deadline; "
+                          "proceeding without it", file=sys.stderr)
+                    return self
+                time.sleep(2.0)
 
     def __exit__(self, *exc):
         self._f.close()
