@@ -66,6 +66,27 @@ class RowMeta:
     tags: list[str]
     scope_class: ScopeClass
     sinks: Optional[frozenset[str]]  # from veneursinkonly: tags
+    # lazily-built wire fragment for the native encoders
+    # ("name \x1f tag \x1f tag ..." utf-8); False = not yet built,
+    # None = contains the separators, use the Python path
+    _frag: object = False
+
+    def wire_frag(self):
+        """Cached blob record for the native batch encoders. RowMeta
+        objects outlive epochs (the worker's adopt cache), so this
+        builds once per series lifetime."""
+        frag = self._frag
+        if frag is False:
+            name = self.key.name
+            rec = (name + "\x1f" + "\x1f".join(self.tags)
+                   if self.tags else name)
+            if "\x1e" in rec or "\x1f" in name or any(
+                    "\x1f" in t or "\x1e" in t for t in self.tags):
+                frag = None
+            else:
+                frag = rec.encode("utf-8")
+            self._frag = frag
+        return frag
 
 
 @dataclass

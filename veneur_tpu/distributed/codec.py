@@ -144,19 +144,14 @@ def _histo_wire_native(snap: FlushSnapshot, compression: float
     for row, meta in enumerate(hrows):
         if not emit[row]:
             continue
-        name = meta.key.name
-        if meta.tags:
-            rec = name + "\x1f" + "\x1f".join(meta.tags)
-        else:
-            rec = name
-        if "\x1e" in rec or ("\x1f" in name) or any(
-                "\x1f" in t or "\x1e" in t for t in meta.tags):
+        frag = meta.wire_frag()  # cached across epochs
+        if frag is None:
             return None  # separators inside the data: python path
-        append(rec)
+        append(frag)
         kinds[row] = _PB_KIND_CODE[meta.key.type]
         count += 1
     blob = native_mod.encode_histo_batch(
-        "\x1e".join(parts).encode("utf-8"), kinds, scopes, emit,
+        b"\x1e".join(parts), kinds, scopes, emit,
         np.asarray(snap.digest_means, np.float32),
         np.asarray(snap.digest_weights, np.float32),
         np.asarray(snap.dmin, np.float64),
