@@ -144,10 +144,19 @@ def test_server_object_path_with_legacy_sink():
         srv.shutdown()
 
 
+def _dd_norm_entry(d):
+    ts, value = d["points"][0]
+    return (d["metric"], d["type"], d["interval"], d["host"],
+            d.get("device_name", ""), tuple(sorted(d["tags"])),
+            int(ts), round(float(value), 9))
+
+
 def test_datadog_columnar_bodies(monkeypatch):
-    """The datadog sink finalizes identical wire dicts from the columnar
-    batch and from the object list (rates, tags, host extraction,
-    status checks included)."""
+    """The datadog sink produces the same wire series from the columnar
+    batch (native C++ JSON emitter + python remainder) as the object
+    path (rates, tags, host extraction, status checks included)."""
+    import json
+
     from veneur_tpu.sinks import filter_routed
     from veneur_tpu.sinks.datadog import DatadogMetricSink
 
@@ -161,8 +170,8 @@ def test_datadog_columnar_bodies(monkeypatch):
 
     posted: list[tuple] = []
 
-    def fake_post(self, dd_metrics, checks):
-        posted.append((dd_metrics, checks))
+    def fake_post(self, dd_metrics, checks, raw_bodies=None, raw_count=0):
+        posted.append((dd_metrics, checks, raw_bodies or [], raw_count))
 
     monkeypatch.setattr(DatadogMetricSink, "_post_all", fake_post)
     sink = DatadogMetricSink(
@@ -170,16 +179,68 @@ def test_datadog_columnar_bodies(monkeypatch):
         tags=["common:1"], dd_hostname="https://dd", api_key="k")
     sink.flush(filter_routed(objs, "datadog"))
     sink.flush_columnar(batch)
-    (dd_obj, ck_obj), (dd_col, ck_col) = posted
+    (dd_obj, ck_obj, rb_obj, _), (dd_col, ck_col, rb_col, n_col) = posted
+    assert not rb_obj  # object path never emits raw bodies
 
+    col_entries = list(dd_col)
+    for body in rb_col:
+        parsed = json.loads(body)
+        col_entries.extend(parsed["series"])
+    assert sorted(map(_dd_norm_entry, dd_obj)) == sorted(
+        map(_dd_norm_entry, col_entries))
+    assert sorted(json.dumps(d, sort_keys=True) for d in ck_obj) == sorted(
+        json.dumps(d, sort_keys=True) for d in ck_col)
+    assert ck_obj  # the workload includes a status check
+    if rb_col:
+        assert n_col == len(col_entries) - len(dd_col)
+
+
+def test_datadog_columnar_native_chunking_and_rules(monkeypatch):
+    """Native emitter specifics: chunk boundaries, name-prefix drops,
+    sink excluded-tag prefixes, server excluded keys, host/device
+    extraction — compared against the object path under the same
+    config."""
     import json
 
-    def norm(ds):
-        return sorted(json.dumps(d, sort_keys=True) for d in ds)
+    from veneur_tpu.sinks import filter_routed, strip_excluded_tags
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
 
-    assert norm(dd_obj) == norm(dd_col)
-    assert norm(ck_obj) == norm(ck_col)
-    assert ck_obj  # the workload includes a status check
+    w = DeviceWorker()
+    for i in range(30):
+        w.process_metric(parse_metric(
+            f"dd{i}:{i}|c|#env:prod,secret:x{i},host:h{i % 3},"
+            f"device:d{i % 2}".encode()))
+        w.process_metric(parse_metric(f"drop.me{i}:{i}|c".encode()))
+    aggs = HistogramAggregates.from_names(["count"])
+    qs = device_quantiles([], aggs)
+    snap = w.flush(qs, interval_s=10.0)
+    objs = generate_inter_metrics(snap, True, [], aggs, now=9)
+    batch = generate_columnar(snap, True, [], aggs, now=9)
+
+    posted: list[tuple] = []
+
+    def fake_post(self, dd_metrics, checks, raw_bodies=None, raw_count=0):
+        posted.append((dd_metrics, checks, raw_bodies or [], raw_count))
+
+    monkeypatch.setattr(DatadogMetricSink, "_post_all", fake_post)
+    kw = dict(interval=10.0, flush_max_per_body=7, hostname="hd",
+              tags=["c:1", "private:2"], dd_hostname="https://dd",
+              api_key="k", metric_name_prefix_drops=["drop."],
+              excluded_tags=["secret", "private"])
+    sink = DatadogMetricSink(**kw)
+    sink.flush(strip_excluded_tags(
+        filter_routed(objs, "datadog"), {"env"}))
+    sink.flush_columnar(batch, excluded_tags={"env"})
+    (dd_obj, _, _, _), (dd_col, _, rb_col, _) = posted
+    col_entries = list(dd_col)
+    for body in rb_col:
+        parsed = json.loads(body)
+        assert len(parsed["series"]) <= 7  # chunking respected
+        col_entries.extend(parsed["series"])
+    assert sorted(map(_dd_norm_entry, dd_obj)) == sorted(
+        map(_dd_norm_entry, col_entries))
+    assert col_entries and not any(
+        e["metric"].startswith("drop.") for e in col_entries)
 
 
 def test_signalfx_columnar_datapoints(monkeypatch):

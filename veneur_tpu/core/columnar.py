@@ -61,6 +61,10 @@ class ColumnGroup:
     # rows carrying veneursinkonly routing exist in this group (when
     # False, consumers skip all per-row routing checks)
     has_routing: bool = False
+    # optional per-row wire fragment ("name \x1f tag \x1f ..." bytes)
+    # accessor for native emitters; None entry = row needs the Python
+    # path (separators in the data)
+    frag_at: Optional[Callable[[int], Optional[bytes]]] = None
 
     def count(self) -> int:
         return sum(f.count(self.nrows) for f in self.families)

@@ -388,7 +388,8 @@ def generate_columnar(
 
         batch.groups.append(ColumnGroup(
             nrows=len(hrows), meta_at=histo_meta, families=fams,
-            has_routing=pool.routed_rows > 0))
+            has_routing=pool.routed_rows > 0,
+            frag_at=lambda i, _rows=hrows: _rows[i].wire_frag()))
 
     # -- set rows ----------------------------------------------------------
     srows = snap.directory.sets.rows
@@ -406,7 +407,8 @@ def generate_columnar(
             families=[MetricFamily(
                 "", GAUGE, np.asarray(snap.set_estimates, np.float64),
                 smask)],
-            has_routing=snap.directory.sets.routed_rows > 0))
+            has_routing=snap.directory.sets.routed_rows > 0,
+            frag_at=lambda i, _rows=srows: _rows[i].wire_frag()))
 
     # -- counters / gauges -------------------------------------------------
     for pool, mtype in ((snap.scalars.counters, MetricType.COUNTER),
@@ -421,12 +423,22 @@ def generate_columnar(
             key, tags, _cls, sinks = _meta[i]
             return key.name, tags, sinks
 
+        def scalar_frag(i, _meta=pool.meta):
+            key, tags, _cls, _sinks = _meta[i]
+            rec = (key.name + "\x1f" + "\x1f".join(tags)
+                   if tags else key.name)
+            if "\x1e" in rec or "\x1f" in key.name or any(
+                    "\x1f" in t or "\x1e" in t for t in tags):
+                return None
+            return rec.encode("utf-8")
+
         batch.groups.append(ColumnGroup(
             nrows=n, meta_at=scalar_meta,
             families=[MetricFamily(
                 "", mtype, np.asarray(pool.values[:n], np.float64),
                 cmask)],
-            has_routing=pool.routed_rows > 0))
+            has_routing=pool.routed_rows > 0,
+            frag_at=scalar_frag))
 
     # -- status checks (rare; objects) -------------------------------------
     for (key, tags, _cls, sinks), sv in zip(

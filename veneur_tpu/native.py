@@ -86,6 +86,24 @@ def load_library() -> Optional[ctypes.CDLL]:
         except AttributeError:  # pre-import-decoder library
             pass
         try:
+            lib.vn_encode_datadog_series.restype = c.c_longlong
+            lib.vn_encode_datadog_series.argtypes = [
+                c.c_char_p, c.c_longlong, c.c_longlong,       # meta
+                c.c_char_p, c.c_longlong,                     # suffixes
+                c.c_void_p, c.c_int,                          # types, nfam
+                c.c_void_p, c.c_void_p,                       # values, masks
+                c.c_longlong, c.c_double,                     # ts, interval
+                c.c_char_p, c.c_longlong,                     # hostname
+                c.c_char_p, c.c_longlong,                     # common tags
+                c.c_char_p, c.c_longlong,                     # excl keys
+                c.c_char_p, c.c_longlong,                     # excl prefixes
+                c.c_char_p, c.c_longlong,                     # drop prefixes
+                c.c_longlong,                                 # max_per_body
+                c.POINTER(c.c_void_p), c.POINTER(c.c_char_p),
+                c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+        except AttributeError:  # pre-datadog-emitter library
+            pass
+        try:
             lib.vn_set_lock_stats.argtypes = [c.c_int]
             lib.vn_lock_stats.restype = c.c_int
             lib.vn_lock_stats.argtypes = [
@@ -549,6 +567,51 @@ def upsert_many(ctx: "NativeIngest", meta: bytes, kinds: np.ndarray,
     lib.vn_upsert_many(ctx._ctx, meta, len(meta), _ptr(kinds),
                        _ptr(scopes), _ptr(sel), n, _ptr(out))
     return out
+
+
+def encode_datadog_series(meta_blob: bytes, nrows: int,
+                          suffixes: list[str], family_types: np.ndarray,
+                          values: np.ndarray, masks: np.ndarray,
+                          ts: int, interval: float, hostname: str,
+                          common_tags_json: bytes,
+                          excluded_keys: list[str],
+                          excluded_prefixes: list[str],
+                          drop_prefixes: list[str],
+                          max_per_body: int
+                          ) -> "Optional[tuple[list[bytes], int]]":
+    """Chunked Datadog {"series": [...]} bodies straight from columnar
+    arrays (native/dogstatsd.cpp vn_encode_datadog_series). Returns
+    (bodies, emitted_count), or None when the library lacks the
+    symbol."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_encode_datadog_series"):
+        return None
+    c = ctypes
+    values = np.ascontiguousarray(values, np.float64)
+    masks = np.ascontiguousarray(masks, np.uint8)
+    family_types = np.ascontiguousarray(family_types, np.int8)
+    suffix_blob = "\x1f".join(suffixes).encode("utf-8")
+    ek = "\x1f".join(excluded_keys).encode("utf-8")
+    ep = "\x1f".join(excluded_prefixes).encode("utf-8")
+    dp = "\x1f".join(drop_prefixes).encode("utf-8")
+    host = hostname.encode("utf-8")
+    chunk_off = c.c_void_p()
+    out = c.c_char_p()
+    out_len = c.c_longlong()
+    entries = c.c_longlong()
+    n_chunks = lib.vn_encode_datadog_series(
+        meta_blob, len(meta_blob), nrows, suffix_blob, len(suffix_blob),
+        _ptr(family_types), len(suffixes), _ptr(values), _ptr(masks),
+        ts, float(interval), host, len(host), common_tags_json,
+        len(common_tags_json), ek, len(ek), ep, len(ep), dp, len(dp),
+        max_per_body, c.byref(chunk_off), c.byref(out),
+        c.byref(out_len), c.byref(entries))
+    if n_chunks < 0:
+        return None
+    offs = _copy_arr(chunk_off, n_chunks + 1, np.int64).tolist()
+    whole = ctypes.string_at(out, out_len.value)
+    return ([whole[offs[i]:offs[i + 1]] for i in range(n_chunks)],
+            int(entries.value))
 
 
 def source_hash() -> str:

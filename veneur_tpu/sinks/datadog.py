@@ -15,6 +15,8 @@ import logging
 import threading
 from typing import Optional
 
+import numpy as np
+
 from veneur_tpu.core.metrics import InterMetric, MetricType
 from veneur_tpu.protocol import dogstatsd as ddproto
 from veneur_tpu.sinks import MetricSink, SpanSink
@@ -139,31 +141,122 @@ class DatadogMetricSink(MetricSink):
     supports_columnar = True
 
     def flush_columnar(self, batch, excluded_tags=None) -> None:
-        """Columnar path (core/columnar.py): Datadog wire dicts built
-        straight from the batch columns, no InterMetric objects between
-        the device arrays and the JSON bodies."""
+        """Columnar path (core/columnar.py): the native emitter builds
+        the chunked {"series": [...]} JSON bodies straight from the
+        batch columns and the cached wire fragments — no InterMetric
+        objects, no Python dicts, no json.dumps on the hot rows
+        (native/dogstatsd.cpp vn_encode_datadog_series). Groups the
+        native path can't serve (routing, separator-laden names, absent
+        library) fall back to the per-row Python path; status checks
+        always take it (message field)."""
+        import json as _json
+
+        from veneur_tpu import native as native_mod
+        from veneur_tpu.core.metrics import MetricType as _MT
         from veneur_tpu.sinks import filter_routed, strip_excluded_tags
 
         dd_metrics: list[dict] = []
         checks: list[dict] = []
-        for name, value, tags, mtype, ts in batch.iter_rows(
-                self.name(), excluded_tags, include_extras=False):
-            self._finalize_one(name, value, tags, mtype, ts, "",
-                               dd_metrics, checks)
+        bodies: list[bytes] = []
+        native_count = 0
+
+        common = ",".join(
+            _json.dumps(t) for t in self.tags
+            if not any(t.startswith(e) for e in self.excluded_tags)
+        ).encode("utf-8")
+        excl_keys = sorted(excluded_tags) if excluded_tags else []
+
+        for g in batch.groups:
+            frag_at = g.frag_at
+            native_ok = (frag_at is not None and not g.has_routing
+                         and not self.exclude_tags_prefix_by_prefix_metric
+                         and native_mod.available())
+            frags = None
+            if native_ok:
+                frags = []
+                for i in range(g.nrows):
+                    f = frag_at(i)
+                    if f is None:
+                        frags = None
+                        break
+                    frags.append(f)
+            if frags is None:
+                # python path for this group
+                mats_ts = batch.timestamp
+                for fam in g.families:
+                    suffix = fam.suffix
+                    vals = fam.values.tolist()
+                    for i in g.rows_for(fam).tolist():
+                        name, tags, sinks = g.meta_at(i)
+                        if g.has_routing and sinks is not None \
+                                and self.name() not in sinks:
+                            continue
+                        if excluded_tags:
+                            tags = [t for t in tags
+                                    if t.split(":", 1)[0]
+                                    not in excluded_tags]
+                        self._finalize_one(
+                            name + suffix if suffix else name, vals[i],
+                            tags, fam.type, mats_ts, "", dd_metrics,
+                            checks)
+                continue
+            meta_blob = b"\x1e".join(frags)
+            suffixes = [fam.suffix for fam in g.families]
+            ftypes = np.asarray(
+                [0 if fam.type == _MT.COUNTER else 1
+                 for fam in g.families], np.int8)
+            values = np.stack([fam.values for fam in g.families])
+            masks = np.stack([
+                fam.mask.astype(np.uint8) if fam.mask is not None
+                else np.ones(g.nrows, np.uint8) for fam in g.families])
+            out = native_mod.encode_datadog_series(
+                meta_blob, g.nrows, suffixes, ftypes, values, masks,
+                batch.timestamp, self.interval, self.hostname, common,
+                excl_keys, self.excluded_tags,
+                self.metric_name_prefix_drops, self.flush_max_per_body)
+            if out is None:
+                # library raced away: python path
+                for fam in g.families:
+                    vals = fam.values.tolist()
+                    for i in g.rows_for(fam).tolist():
+                        name, tags, _s = g.meta_at(i)
+                        self._finalize_one(
+                            name + fam.suffix if fam.suffix else name,
+                            vals[i], tags, fam.type, batch.timestamp,
+                            "", dd_metrics, checks)
+                continue
+            body_chunks, emitted = out
+            bodies.extend(body_chunks)
+            native_count += emitted
+
         # extras (status checks) need message/hostname fields
         for m in strip_excluded_tags(
                 filter_routed(batch.extras, self.name()),
                 excluded_tags):
             self._finalize_one(m.name, m.value, m.tags, m.type,
                                m.timestamp, m.message, dd_metrics, checks)
-        self._post_all(dd_metrics, checks)
+        self._post_all(dd_metrics, checks, bodies, native_count)
 
     def flush(self, metrics: list[InterMetric]) -> None:
         dd_metrics, checks = self._finalize(metrics)
         self._post_all(dd_metrics, checks)
 
-    def _post_all(self, dd_metrics: list[dict], checks: list[dict]) -> None:
+    def _post_all(self, dd_metrics: list[dict], checks: list[dict],
+                  raw_bodies: Optional[list[bytes]] = None,
+                  raw_count: int = 0) -> None:
         threads = []
+        if raw_bodies:
+            # bodies are chunked at flush_max_per_body, so every body but
+            # the last is full
+            per = self.flush_max_per_body
+            for bi, body in enumerate(raw_bodies):
+                share = (per if bi < len(raw_bodies) - 1
+                         else raw_count - per * (len(raw_bodies) - 1))
+                t = threading.Thread(
+                    target=self._post_raw_body, args=(body, share),
+                    daemon=True)
+                t.start()
+                threads.append(t)
         for i in range(0, len(dd_metrics), self.flush_max_per_body):
             chunk = dd_metrics[i:i + self.flush_max_per_body]
             t = threading.Thread(
@@ -181,6 +274,26 @@ class DatadogMetricSink(MetricSink):
                 log.warning("datadog check_run post failed: %s", e)
         for t in threads:
             t.join(timeout=30)
+
+    def _post_raw_body(self, body: bytes, count: int) -> None:
+        """POST one pre-built {"series": [...]} JSON body (the native
+        emitter's output), deflate-compressed like post_json does."""
+        import urllib.request
+        import zlib as _zlib
+
+        try:
+            req = urllib.request.Request(
+                f"{self.dd_hostname}/api/v1/series?api_key={self.api_key}",
+                data=_zlib.compress(body),
+                method="POST",
+                headers={"Content-Type": "application/json",
+                         "Content-Encoding": "deflate"},
+            )
+            self.opener(req, 10.0)
+            self.flushed_metrics += count
+        except Exception as e:
+            self.flush_errors += 1
+            log.warning("datadog series post failed: %s", e)
 
     def _post_series(self, chunk: list[dict]) -> None:
         try:
