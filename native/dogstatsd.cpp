@@ -2386,6 +2386,135 @@ long long vn_encode_datadog_series(
   return static_cast<long long>(o.chunk_off.size()) - 1;
 }
 
+// ---------------------------------------------------------------------------
+// Prometheus statsd-repeater line emitter: "name:value|kind|#tag,..."
+// lines from the columnar arrays + meta blob, with the exporter's
+// character sanitization (sinks/prometheus.py sanitize_name/tag).
+
+namespace {
+
+inline bool prom_name_ok(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_' || c == ':' || c == '.';
+}
+
+inline bool prom_tag_ok(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_' || c == ':' || c == ',' ||
+         c == '=' || c == '.';
+}
+
+void prom_append(std::string* out, std::string_view s, bool name_rules) {
+  for (unsigned char c : s)
+    out->push_back((name_rules ? prom_name_ok(c) : prom_tag_ok(c))
+                       ? static_cast<char>(c)
+                       : '_');
+}
+
+}  // namespace
+
+// Emits newline-separated statsd lines into a thread-local buffer.
+// family_types: 0 counter ("|c"), 1 gauge ("|g"). excl_keys: \x1f-joined
+// exact tag keys to drop (server-level exclusion). Returns the emitted
+// line count; *out/*out_len carry the buffer.
+long long vn_encode_prometheus_lines(
+    const char* meta, long long meta_len, long long nrows,
+    const char* suffixes_blob, long long suffixes_len,
+    const signed char* family_types, int nfam, const double* values,
+    const unsigned char* masks, const char* excl_keys_blob,
+    long long excl_keys_len, const char** out, long long* out_len) {
+  thread_local std::string buf;
+  buf.clear();
+  buf.reserve(static_cast<size_t>(nrows) * nfam * 48);
+
+  std::vector<std::string_view> suffixes =
+      split_us(std::string_view(suffixes_blob,
+                                static_cast<size_t>(suffixes_len)));
+  while (static_cast<int>(suffixes.size()) < nfam)
+    suffixes.push_back(std::string_view());
+  std::vector<std::string_view> excl_keys = split_us(
+      std::string_view(excl_keys_blob, static_cast<size_t>(excl_keys_len)));
+
+  std::string_view blob(meta, static_cast<size_t>(meta_len));
+  std::vector<std::string_view> recs;
+  recs.reserve(static_cast<size_t>(nrows));
+  {
+    size_t pos = 0;
+    for (long long i = 0; i < nrows; ++i) {
+      size_t e = blob.find('\x1e', pos);
+      if (e == std::string_view::npos) e = blob.size();
+      recs.push_back(blob.substr(pos, e - pos));
+      pos = e + 1;
+    }
+  }
+
+  long long emitted = 0;
+  for (int f = 0; f < nfam; ++f) {
+    std::string_view suffix = suffixes[f];
+    const char kind = family_types[f] == 0 ? 'c' : 'g';
+    const double* vals = values + static_cast<size_t>(f) * nrows;
+    const unsigned char* mask = masks + static_cast<size_t>(f) * nrows;
+    for (long long r = 0; r < nrows; ++r) {
+      if (!mask[r]) continue;
+      std::string_view rec = recs[static_cast<size_t>(r)];
+      size_t nend = rec.find('\x1f');
+      std::string_view name =
+          nend == std::string_view::npos ? rec : rec.substr(0, nend);
+      prom_append(&buf, name, true);
+      prom_append(&buf, suffix, true);
+      buf.push_back(':');
+      {
+        // match python str(float): integral values carry a ".0"
+        size_t vstart = buf.size();
+        json_number_append(&buf, vals[r]);
+        bool plain_int = true;
+        for (size_t i = vstart; i < buf.size(); ++i) {
+          char ch = buf[i];
+          if (!(ch == '-' || (ch >= '0' && ch <= '9'))) {
+            plain_int = false;
+            break;
+          }
+        }
+        if (plain_int) buf.append(".0");
+      }
+      buf.push_back('|');
+      buf.push_back(kind);
+      bool first_tag = true;
+      if (nend != std::string_view::npos) {
+        std::string_view rest = rec.substr(nend + 1);
+        for (;;) {
+          size_t e = rest.find('\x1f');
+          std::string_view tag =
+              e == std::string_view::npos ? rest : rest.substr(0, e);
+          bool skip = false;
+          size_t colon = tag.find(':');
+          std::string_view key =
+              colon == std::string_view::npos ? tag : tag.substr(0, colon);
+          for (std::string_view k : excl_keys) {
+            if (key == k) {
+              skip = true;
+              break;
+            }
+          }
+          if (!skip) {
+            buf.append(first_tag ? "|#" : ",");
+            prom_append(&buf, tag, false);
+            first_tag = false;
+          }
+          if (e == std::string_view::npos) break;
+          rest = rest.substr(e + 1);
+        }
+      }
+      buf.push_back('\n');
+      ++emitted;
+    }
+  }
+  if (!buf.empty()) buf.pop_back();  // no trailing newline
+  *out = buf.data();
+  *out_len = static_cast<long long>(buf.size());
+  return emitted;
+}
+
 // SSF span fast path. Returns 1 ok, 0 decode error, -1 fallback needed
 // (span carries STATUS samples; nothing was ingested).
 int vn_ingest_ssf(void* p, const char* buf, int len, const char* ind_name,

@@ -101,6 +101,13 @@ def load_library() -> Optional[ctypes.CDLL]:
                 c.c_longlong,                                 # max_per_body
                 c.POINTER(c.c_void_p), c.POINTER(c.c_char_p),
                 c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+            lib.vn_encode_prometheus_lines.restype = c.c_longlong
+            lib.vn_encode_prometheus_lines.argtypes = [
+                c.c_char_p, c.c_longlong, c.c_longlong,
+                c.c_char_p, c.c_longlong,
+                c.c_void_p, c.c_int, c.c_void_p, c.c_void_p,
+                c.c_char_p, c.c_longlong,
+                c.POINTER(c.c_char_p), c.POINTER(c.c_longlong)]
         except AttributeError:  # pre-datadog-emitter library
             pass
         try:
@@ -612,6 +619,34 @@ def encode_datadog_series(meta_blob: bytes, nrows: int,
     whole = ctypes.string_at(out, out_len.value)
     return ([whole[offs[i]:offs[i + 1]] for i in range(n_chunks)],
             int(entries.value))
+
+
+def encode_prometheus_lines(meta_blob: bytes, nrows: int,
+                            suffixes: list[str],
+                            family_types: np.ndarray,
+                            values: np.ndarray, masks: np.ndarray,
+                            excluded_keys: list[str]
+                            ) -> "Optional[tuple[bytes, int]]":
+    """statsd repeater lines from columnar arrays (one newline-joined
+    buffer + line count); None when the library lacks the symbol."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_encode_prometheus_lines"):
+        return None
+    c = ctypes
+    values = np.ascontiguousarray(values, np.float64)
+    masks = np.ascontiguousarray(masks, np.uint8)
+    family_types = np.ascontiguousarray(family_types, np.int8)
+    suffix_blob = "\x1f".join(suffixes).encode("utf-8")
+    ek = "\x1f".join(excluded_keys).encode("utf-8")
+    out = c.c_char_p()
+    out_len = c.c_longlong()
+    n = lib.vn_encode_prometheus_lines(
+        meta_blob, len(meta_blob), nrows, suffix_blob, len(suffix_blob),
+        _ptr(family_types), len(suffixes), _ptr(values), _ptr(masks),
+        ek, len(ek), c.byref(out), c.byref(out_len))
+    if n < 0:
+        return None
+    return ctypes.string_at(out, out_len.value), int(n)
 
 
 def source_hash() -> str:

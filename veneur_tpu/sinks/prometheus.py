@@ -73,37 +73,125 @@ class PrometheusMetricSink(MetricSink):
 
     def flush_columnar(self, batch, excluded_tags=None) -> None:
         """Columnar path: statsd lines straight from the batch columns —
-        the per-metric work here is the wire format itself, no
+        built by the native line emitter (vn_encode_prometheus_lines)
+        when available, per-row Python otherwise. Either way no
         InterMetric objects in between (core/columnar.py)."""
+        import numpy as np
+
+        from veneur_tpu import native as native_mod
+        from veneur_tpu.core.metrics import MetricType as _MT
+
         lines = []
         append = lines.append
         counter = MetricType.COUNTER
         gauge = MetricType.GAUGE
-        for name, value, tags, mtype, _ts in batch.iter_rows(
-                self.name(), excluded_tags):
-            if mtype == counter:
+        excl = sorted(excluded_tags) if excluded_tags else []
+        for g in batch.groups:
+            frags = None
+            if g.frag_at is not None and not g.has_routing \
+                    and native_mod.available():
+                frags = []
+                for i in range(g.nrows):
+                    f = g.frag_at(i)
+                    if f is None:
+                        frags = None
+                        break
+                    frags.append(f)
+            if frags is not None:
+                fams = [fam for fam in g.families
+                        if fam.type in (counter, gauge)]
+                if not fams:
+                    continue
+                out = native_mod.encode_prometheus_lines(
+                    b"\x1e".join(frags), g.nrows,
+                    [fam.suffix for fam in fams],
+                    np.asarray([0 if fam.type == _MT.COUNTER else 1
+                                for fam in fams], np.int8),
+                    np.stack([fam.values for fam in fams]),
+                    np.stack([
+                        fam.mask.astype(np.uint8) if fam.mask is not None
+                        else np.ones(g.nrows, np.uint8)
+                        for fam in fams]),
+                    excl)
+                if out is not None:
+                    blob, n = out
+                    if n:
+                        append(blob)
+                    continue
+            # python path for this group
+            for fam in g.families:
+                if fam.type == counter:
+                    kind = "c"
+                elif fam.type == gauge:
+                    kind = "g"
+                else:
+                    continue
+                vals = fam.values.tolist()
+                suffix = fam.suffix
+                for i in g.rows_for(fam).tolist():
+                    name, tags, sinks = g.meta_at(i)
+                    if g.has_routing and sinks is not None \
+                            and self.name() not in sinks:
+                        continue
+                    if excluded_tags:
+                        tags = [t for t in tags
+                                if t.split(":", 1)[0] not in excluded_tags]
+                    line = (f"{sanitize_name(name + suffix if suffix else name)}"
+                            f":{vals[i]}|{kind}")
+                    if tags:
+                        line += "|#" + ",".join(
+                            sanitize_tag(t) for t in tags)
+                    append(line.encode("utf-8"))
+        for m in batch.extras:
+            if m.sinks is not None and self.name() not in m.sinks:
+                continue
+            if m.type == counter:
                 kind = "c"
-            elif mtype == gauge:
+            elif m.type == gauge:
                 kind = "g"
             else:
                 continue
-            line = f"{sanitize_name(name)}:{value}|{kind}"
+            tags = m.tags
+            if excluded_tags:
+                tags = [t for t in tags
+                        if t.split(":", 1)[0] not in excluded_tags]
+            line = f"{sanitize_name(m.name)}:{m.value}|{kind}"
             if tags:
                 line += "|#" + ",".join(sanitize_tag(t) for t in tags)
             append(line.encode("utf-8"))
         self._send(lines)
 
+    # max UDP datagram payload: statsd exporters accept multi-line
+    # datagrams; stay under a jumbo-frame-safe size
+    UDP_DATAGRAM_BYTES = 8192
+
     def _send(self, lines: list[bytes]) -> None:
         if not lines:
             return
+        sent_lines = sum(e.count(b"\n") + 1 for e in lines)
         try:
             sock = self._connect()
             if self.network_type == "udp":
-                for ln in lines:
-                    sock.send(ln)
+                # entries may be multi-line blobs (native emitter);
+                # repack into datagram-sized, line-aligned chunks
+                for entry in lines:
+                    if len(entry) <= self.UDP_DATAGRAM_BYTES:
+                        sock.send(entry)
+                        continue
+                    start = 0
+                    n = len(entry)
+                    while start < n:
+                        end = min(start + self.UDP_DATAGRAM_BYTES, n)
+                        if end < n:
+                            nl = entry.rfind(b"\n", start, end)
+                            if nl > start:
+                                end = nl
+                        sock.send(entry[start:end])
+                        start = end + (1 if end < n and
+                                       entry[end:end + 1] == b"\n" else 0)
             else:
                 sock.sendall(b"\n".join(lines) + b"\n")
-            self.flushed_metrics += len(lines)
+            self.flushed_metrics += sent_lines
         except OSError as e:
             self.flush_errors += 1
             self._sock = None
