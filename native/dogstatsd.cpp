@@ -1755,6 +1755,7 @@ bool decode_centroids(std::string_view body, std::vector<float>* means,
     if (!c.varint(&tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
     uint32_t wt = static_cast<uint32_t>(tag & 7);
+    if (field == 0) return false;  // protobuf forbids field number 0
     if (field == 1 || field == 2) {
       std::vector<float>* dst = field == 1 ? means : weights;
       if (wt == 2) {  // packed
@@ -1782,6 +1783,46 @@ void sanitize_seps(std::string* s) {
     if (ch == '\x1e' || ch == '\x1f') ch = '_';
 }
 
+// protobuf rejects `string` fields that aren't valid UTF-8; the native
+// decoder must agree (strictness parity with the Python fallback —
+// pinned by the decoder fuzz test)
+bool utf8_valid(std::string_view s) {
+  size_t i = 0, n = s.size();
+  while (i < n) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    size_t len;
+    uint32_t cp;
+    if (c < 0x80) {
+      ++i;
+      continue;
+    } else if ((c & 0xE0) == 0xC0) {
+      len = 2;
+      cp = c & 0x1F;
+    } else if ((c & 0xF0) == 0xE0) {
+      len = 3;
+      cp = c & 0x0F;
+    } else if ((c & 0xF8) == 0xF0) {
+      len = 4;
+      cp = c & 0x07;
+    } else {
+      return false;
+    }
+    if (i + len > n) return false;
+    for (size_t j = 1; j < len; ++j) {
+      unsigned char cc = static_cast<unsigned char>(s[i + j]);
+      if ((cc & 0xC0) != 0x80) return false;
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    // overlong / surrogate / out-of-range
+    if (len == 2 && cp < 0x80) return false;
+    if (len == 3 && (cp < 0x800 || (cp >= 0xD800 && cp <= 0xDFFF)))
+      return false;
+    if (len == 4 && (cp < 0x10000 || cp > 0x10FFFF)) return false;
+    i += len;
+  }
+  return true;
+}
+
 // one Metric submessage → appended SoA entry; false on malformed
 bool decode_metric(std::string_view body, Decoded* d) {
   WireCursor c{reinterpret_cast<const uint8_t*>(body.data()),
@@ -1799,6 +1840,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
     if (!c.varint(&tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
     uint32_t wt = static_cast<uint32_t>(tag & 7);
+    if (field == 0) return false;  // protobuf forbids field number 0
     switch (field) {
       case 1: {  // name
         std::string_view v;
@@ -1828,6 +1870,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
         while (ic.p < ic.end) {
           uint64_t it;
           if (!ic.varint(&it)) return false;
+          if ((it >> 3) == 0) return false;
           if ((it >> 3) == 1 && (it & 7) == 1) {
             int64_t sv;
             if (ic.end - ic.p < 8) return false;
@@ -1849,6 +1892,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
         while (ic.p < ic.end) {
           uint64_t it;
           if (!ic.varint(&it)) return false;
+          if ((it >> 3) == 0) return false;
           if ((it >> 3) == 1 && (it & 7) == 1) {
             if (!ic.f64(&scalar)) return false;
           } else if (!ic.skip(static_cast<uint32_t>(it & 7))) {
@@ -1866,6 +1910,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
         while (ic.p < ic.end) {
           uint64_t it;
           if (!ic.varint(&it)) return false;
+          if ((it >> 3) == 0) return false;
           uint32_t f = static_cast<uint32_t>(it >> 3);
           uint32_t w = static_cast<uint32_t>(it & 7);
           if (f == 1 && w == 2) {
@@ -1895,6 +1940,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
         while (ic.p < ic.end) {
           uint64_t it;
           if (!ic.varint(&it)) return false;
+          if ((it >> 3) == 0) return false;
           uint32_t f = static_cast<uint32_t>(it >> 3);
           uint32_t w = static_cast<uint32_t>(it & 7);
           if (f == 1 && w == 2) {
@@ -1916,6 +1962,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
     }
   }
   if (kind > 4 || scope > 2) return false;
+  if (!utf8_valid(name) || !utf8_valid(joined)) return false;
   // centroid means/weights must pair up
   if (d->cent_means.size() - cent_means_base !=
       d->cent_weights.size() - cent_w_base)
@@ -1979,6 +2026,7 @@ long long vn_decode_metric_batch(
     if (!c.varint(&tag)) return -1;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
     uint32_t wt = static_cast<uint32_t>(tag & 7);
+    if (field == 0) return -1;  // protobuf forbids field number 0
     if (field == 1 && wt == 2) {
       std::string_view body;
       if (!c.len_view(&body) || !decode_metric(body, &d)) return -1;
