@@ -448,6 +448,68 @@ def test_proxy_wire_split_matches_python_ring_placement():
     assert got == expect
 
 
+def test_import_flush_soak_no_loss():
+    """Race the native import path against rapid global flushes: every
+    forwarded counter increment must be accounted for exactly once
+    across all flush outputs (guards the cross-epoch adopt cache and
+    the batched-upsert/flush lock interplay)."""
+    import threading
+
+    from veneur_tpu.core.flusher import generate_inter_metrics
+    from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+    from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+    g, imp, _port = _global_server()
+    aggs = HistogramAggregates.from_names(["count"])
+    try:
+        batch = pb.MetricBatch()
+        for i in range(50):
+            m = batch.metrics.add()
+            m.name = f"soak{i}"
+            m.kind = pb.KIND_COUNTER
+            m.scope = pb.SCOPE_GLOBAL
+            m.counter.value = 3
+        blob = batch.SerializeToString()
+
+        stop = threading.Event()
+        sent = [0]
+
+        def importer():
+            while not stop.is_set():
+                imp.handle_wire(blob)
+                sent[0] += 50 * 3
+
+        t = threading.Thread(target=importer, daemon=True)
+        t.start()
+        got = 0.0
+        qs = device_quantiles([], aggs)
+        for _ in range(8):
+            metrics = []
+            for w, lock in zip(g.workers, g._worker_locks):
+                with lock:
+                    sw = w.swap(qs)
+                snap = w.extract_snapshot(sw, qs, 10.0)
+                metrics.extend(
+                    generate_inter_metrics(snap, False, [], aggs))
+            got += sum(m.value for m in metrics
+                       if m.type == MetricType.COUNTER)
+        stop.set()
+        t.join(10)
+        # final flush picks up anything still buffered
+        for w, lock in zip(g.workers, g._worker_locks):
+            with lock:
+                sw = w.swap(qs)
+            snap = w.extract_snapshot(sw, qs, 10.0)
+            got += sum(m.value
+                       for m in generate_inter_metrics(snap, False, [],
+                                                       aggs)
+                       if m.type == MetricType.COUNTER)
+        assert sent[0] > 0
+        assert got == sent[0], (got, sent[0])
+    finally:
+        imp.stop()
+
+
 def test_handle_wire_rejects_kind_value_mismatch():
     """A metric whose kind disagrees with its value oneof (hostile or
     buggy peer) must be rejected by the native import path, not applied
