@@ -2563,6 +2563,201 @@ long long vn_encode_prometheus_lines(
   return emitted;
 }
 
+// ---------------------------------------------------------------------------
+// SignalFx datapoint-body emitter: {"counter":[...],"gauge":[...]}
+// from the columnar arrays + meta blob. Dimensions are a JSON object
+// built from "k:v" tags (last duplicate key wins, as a Python dict
+// does); the hostname dimension key is configurable. Tag-prefix drops
+// reject the whole metric (sinks/signalfx.py _convert_fields). The
+// single-API-key case only — vary_key_by routing stays in Python.
+
+// Emits ONE body. family_types: 0 counter, 1 gauge. Returns emitted
+// count; -1 on malformed meta.
+long long vn_encode_signalfx_body(
+    const char* meta, long long meta_len, long long nrows,
+    const char* suffixes_blob, long long suffixes_len,
+    const signed char* family_types, int nfam, const double* values,
+    const unsigned char* masks, long long ts_ms,
+    const char* hostname_tag, long long hostname_tag_len,
+    const char* hostname, long long hostname_len,
+    const char* name_drop_blob, long long name_drop_len,
+    const char* tag_drop_blob, long long tag_drop_len,
+    const char* excl_keys_blob, long long excl_keys_len,
+    const char** out, long long* out_len) {
+  thread_local std::string buf;
+  thread_local std::string counters_part;
+  thread_local std::string gauges_part;
+  buf.clear();
+  counters_part.clear();
+  gauges_part.clear();
+
+  std::vector<std::string_view> suffixes =
+      split_us(std::string_view(suffixes_blob,
+                                static_cast<size_t>(suffixes_len)));
+  while (static_cast<int>(suffixes.size()) < nfam)
+    suffixes.push_back(std::string_view());
+  std::vector<std::string_view> name_drops = split_us(
+      std::string_view(name_drop_blob, static_cast<size_t>(name_drop_len)));
+  std::vector<std::string_view> tag_drops = split_us(
+      std::string_view(tag_drop_blob, static_cast<size_t>(tag_drop_len)));
+  std::vector<std::string_view> excl_keys = split_us(
+      std::string_view(excl_keys_blob, static_cast<size_t>(excl_keys_len)));
+  std::string_view host_tag(hostname_tag,
+                            static_cast<size_t>(hostname_tag_len));
+  std::string_view host_val(hostname, static_cast<size_t>(hostname_len));
+
+  std::string_view blob(meta, static_cast<size_t>(meta_len));
+  std::vector<std::string_view> recs;
+  recs.reserve(static_cast<size_t>(nrows));
+  {
+    size_t pos = 0;
+    for (long long i = 0; i < nrows; ++i) {
+      size_t e = blob.find('\x1e', pos);
+      if (e == std::string_view::npos) e = blob.size();
+      recs.push_back(blob.substr(pos, e - pos));
+      pos = e + 1;
+    }
+  }
+
+  char tsbuf[24];
+  std::snprintf(tsbuf, sizeof tsbuf, "%lld", ts_ms);
+  long long emitted = 0;
+  std::vector<std::pair<std::string_view, std::string_view>> dims;
+  for (int f = 0; f < nfam; ++f) {
+    std::string_view suffix = suffixes[f];
+    std::string& part = family_types[f] == 0 ? counters_part : gauges_part;
+    const double* vals = values + static_cast<size_t>(f) * nrows;
+    const unsigned char* mask = masks + static_cast<size_t>(f) * nrows;
+    for (long long r = 0; r < nrows; ++r) {
+      if (!mask[r]) continue;
+      std::string_view rec = recs[static_cast<size_t>(r)];
+      size_t nend = rec.find('\x1f');
+      std::string_view name =
+          nend == std::string_view::npos ? rec : rec.substr(0, nend);
+      bool dropped = false;
+      for (std::string_view p : name_drops) {
+        if (name.size() >= p.size() &&
+            name.compare(0, p.size(), p) == 0) {
+          dropped = true;
+          break;
+        }
+        if (p.size() > name.size()) {
+          std::string full(name);
+          full.append(suffix);
+          if (full.compare(0, p.size(), p) == 0) {
+            dropped = true;
+            break;
+          }
+        }
+      }
+      if (dropped) continue;
+
+      // dimensions: k:v tags, last duplicate key wins (python dict)
+      dims.clear();
+      if (nend != std::string_view::npos) {
+        std::string_view rest = rec.substr(nend + 1);
+        for (;;) {
+          size_t e = rest.find('\x1f');
+          std::string_view tag =
+              e == std::string_view::npos ? rest : rest.substr(0, e);
+          for (std::string_view p : tag_drops) {
+            if (tag.size() >= p.size() &&
+                tag.compare(0, p.size(), p) == 0) {
+              dropped = true;
+              break;
+            }
+          }
+          if (dropped) break;
+          size_t colon = tag.find(':');
+          std::string_view key =
+              colon == std::string_view::npos ? tag : tag.substr(0, colon);
+          std::string_view val =
+              colon == std::string_view::npos ? std::string_view()
+                                              : tag.substr(colon + 1);
+          bool excl = false;
+          for (std::string_view k : excl_keys) {
+            if (key == k) {
+              excl = true;
+              break;
+            }
+          }
+          if (!excl) {
+            bool replaced = false;
+            for (auto& kv : dims) {
+              if (kv.first == key) {
+                kv.second = val;
+                replaced = true;
+                break;
+              }
+            }
+            if (!replaced) dims.emplace_back(key, val);
+          }
+          if (e == std::string_view::npos) break;
+          rest = rest.substr(e + 1);
+        }
+      }
+      if (dropped) continue;
+
+      if (!part.empty()) part.push_back(',');
+      part.append("{\"metric\":\"");
+      json_escape_append(&part, name);
+      json_escape_append(&part, suffix);
+      part.append("\",\"value\":");
+      json_number_append(&part, vals[r]);
+      part.append(",\"timestamp\":");
+      part.append(tsbuf);
+      part.append(",\"dimensions\":{");
+      // a tag with the hostname key overrides the default host dim
+      // (python seeds dims with it, then tags overwrite)
+      bool host_overridden = false;
+      for (auto& kv : dims) {
+        if (kv.first == host_tag) {
+          host_overridden = true;
+          break;
+        }
+      }
+      bool first_dim = true;
+      if (!host_overridden) {
+        part.push_back('"');
+        json_escape_append(&part, host_tag);
+        part.append("\":\"");
+        json_escape_append(&part, host_val);
+        part.push_back('"');
+        first_dim = false;
+      }
+      for (auto& kv : dims) {
+        if (!first_dim) part.push_back(',');
+        first_dim = false;
+        part.push_back('"');
+        json_escape_append(&part, kv.first);
+        part.append("\":\"");
+        json_escape_append(&part, kv.second);
+        part.push_back('"');
+      }
+      part.append("}}");
+      ++emitted;
+    }
+  }
+  buf.push_back('{');
+  bool any = false;
+  if (!counters_part.empty()) {
+    buf.append("\"counter\":[");
+    buf.append(counters_part);
+    buf.push_back(']');
+    any = true;
+  }
+  if (!gauges_part.empty()) {
+    if (any) buf.push_back(',');
+    buf.append("\"gauge\":[");
+    buf.append(gauges_part);
+    buf.push_back(']');
+  }
+  buf.push_back('}');
+  *out = buf.data();
+  *out_len = static_cast<long long>(buf.size());
+  return emitted;
+}
+
 // SSF span fast path. Returns 1 ok, 0 decode error, -1 fallback needed
 // (span carries STATUS samples; nothing was ingested).
 int vn_ingest_ssf(void* p, const char* buf, int len, const char* ind_name,

@@ -257,22 +257,37 @@ def test_signalfx_columnar_datapoints(monkeypatch):
     objs = generate_inter_metrics(snap, True, PCTS, aggs, now=7)
     batch = generate_columnar(snap, True, PCTS, aggs, now=7)
 
-    posted: list[dict] = []
+    posted: list[tuple] = []
     monkeypatch.setattr(
         SignalFxMetricSink, "_post_buckets",
-        lambda self, by_key: posted.append(by_key))
+        lambda self, by_key, raw_bodies=None: posted.append(
+            (by_key, raw_bodies or [])))
     sink = SignalFxMetricSink(api_key="k", hostname="h0")
     sink.flush(filter_routed(objs, "signalfx"))
     sink.flush_columnar(batch)
     import json
 
-    def norm(by_key):
+    def norm(by_key, raw):
+        merged: dict = {}
+        for k, v in by_key.items():
+            for kind, pts in v.items():
+                merged.setdefault(k, {}).setdefault(kind, []).extend(pts)
+        for body, _count in raw:
+            parsed = json.loads(body)
+            for kind, pts in parsed.items():
+                merged.setdefault("k", {}).setdefault(kind, []).extend(pts)
+        def normpt(p):
+            p = dict(p)
+            p["value"] = round(float(p["value"]), 9)
+            return json.dumps(p, sort_keys=True)
         return json.dumps(
-            {k: {kind: sorted(json.dumps(p, sort_keys=True) for p in pts)
-                 for kind, pts in v.items()} for k, v in by_key.items()},
+            {k: {kind: sorted(normpt(p) for p in pts)
+                 for kind, pts in v.items() if pts}
+             for k, v in merged.items()},
             sort_keys=True)
 
-    assert norm(posted[0]) == norm(posted[1])
+    assert norm(*posted[0]) == norm(*posted[1])
+    assert posted[1][1], "native emitter should have produced bodies"
 
 
 def test_prometheus_columnar_lines(monkeypatch):

@@ -101,6 +101,16 @@ def load_library() -> Optional[ctypes.CDLL]:
                 c.c_longlong,                                 # max_per_body
                 c.POINTER(c.c_void_p), c.POINTER(c.c_char_p),
                 c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+            lib.vn_encode_signalfx_body.restype = c.c_longlong
+            lib.vn_encode_signalfx_body.argtypes = [
+                c.c_char_p, c.c_longlong, c.c_longlong,
+                c.c_char_p, c.c_longlong,
+                c.c_void_p, c.c_int, c.c_void_p, c.c_void_p,
+                c.c_longlong,
+                c.c_char_p, c.c_longlong, c.c_char_p, c.c_longlong,
+                c.c_char_p, c.c_longlong, c.c_char_p, c.c_longlong,
+                c.c_char_p, c.c_longlong,
+                c.POINTER(c.c_char_p), c.POINTER(c.c_longlong)]
             lib.vn_encode_prometheus_lines.restype = c.c_longlong
             lib.vn_encode_prometheus_lines.argtypes = [
                 c.c_char_p, c.c_longlong, c.c_longlong,
@@ -619,6 +629,40 @@ def encode_datadog_series(meta_blob: bytes, nrows: int,
     whole = ctypes.string_at(out, out_len.value)
     return ([whole[offs[i]:offs[i + 1]] for i in range(n_chunks)],
             int(entries.value))
+
+
+def encode_signalfx_body(meta_blob: bytes, nrows: int,
+                         suffixes: list[str], family_types: np.ndarray,
+                         values: np.ndarray, masks: np.ndarray,
+                         ts_ms: int, hostname_tag: str, hostname: str,
+                         name_drops: list[str], tag_drops: list[str],
+                         excluded_keys: list[str]
+                         ) -> "Optional[tuple[bytes, int]]":
+    """One SignalFx {"counter":[...],"gauge":[...]} body from columnar
+    arrays; (body, emitted_count), or None when unavailable."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_encode_signalfx_body"):
+        return None
+    c = ctypes
+    values = np.ascontiguousarray(values, np.float64)
+    masks = np.ascontiguousarray(masks, np.uint8)
+    family_types = np.ascontiguousarray(family_types, np.int8)
+    sb = "\x1f".join(suffixes).encode("utf-8")
+    nd = "\x1f".join(name_drops).encode("utf-8")
+    td_ = "\x1f".join(tag_drops).encode("utf-8")
+    ek = "\x1f".join(excluded_keys).encode("utf-8")
+    ht = hostname_tag.encode("utf-8")
+    hv = hostname.encode("utf-8")
+    out = c.c_char_p()
+    out_len = c.c_longlong()
+    n = lib.vn_encode_signalfx_body(
+        meta_blob, len(meta_blob), nrows, sb, len(sb),
+        _ptr(family_types), len(suffixes), _ptr(values), _ptr(masks),
+        ts_ms, ht, len(ht), hv, len(hv), nd, len(nd), td_, len(td_),
+        ek, len(ek), c.byref(out), c.byref(out_len))
+    if n < 0:
+        return None
+    return ctypes.string_at(out, out_len.value), int(n)
 
 
 def encode_prometheus_lines(meta_blob: bytes, nrows: int,

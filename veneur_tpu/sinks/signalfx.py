@@ -176,23 +176,78 @@ class SignalFxMetricSink(MetricSink):
 
     def flush_columnar(self, batch, excluded_tags=None) -> None:
         """Columnar path (core/columnar.py): datapoints built straight
-        from the batch columns. Only counter/gauge rows are convertible
-        (as in _convert), and group rows never carry a hostname field,
-        so the per-row feed loses nothing."""
+        from the batch columns — via the native body emitter
+        (vn_encode_signalfx_body) when no per-tag key routing is
+        configured, per-row Python otherwise. Only counter/gauge rows
+        are convertible (as in _convert), and group rows never carry a
+        hostname field, so the per-row feed loses nothing."""
+        import numpy as np
+
+        from veneur_tpu import native as native_mod
+        from veneur_tpu.core.metrics import MetricType as _MT
+
         with self._keys_lock:
             keys = dict(self.per_tag_api_keys)
         by_key: dict[str, dict[str, list]] = {}
-        for name, value, tags, mtype, ts in batch.iter_rows(
-                self.name(), excluded_tags, include_extras=False):
-            conv = self._convert_fields(name, value, tags, mtype, ts,
-                                        "", keys)
-            if conv is None:
-                continue
-            api_key, kinds = conv
-            bucket = by_key.setdefault(api_key, {"counter": [], "gauge": []})
-            for kind, point in kinds.items():
-                bucket[kind].append(point)
-        self._post_buckets(by_key)
+        raw_bodies: list[bytes] = []
+        excl = sorted(excluded_tags) if excluded_tags else []
+        native_ok = not self.vary_key_by and native_mod.available()
+        for g in batch.groups:
+            frags = None
+            if native_ok and g.frag_at is not None and not g.has_routing:
+                frags = []
+                for i in range(g.nrows):
+                    f = g.frag_at(i)
+                    if f is None:
+                        frags = None
+                        break
+                    frags.append(f)
+            if frags is not None:
+                fams = [fam for fam in g.families
+                        if fam.type in (_MT.COUNTER, _MT.GAUGE)]
+                if not fams:
+                    continue
+                out = native_mod.encode_signalfx_body(
+                    b"\x1e".join(frags), g.nrows,
+                    [fam.suffix for fam in fams],
+                    np.asarray([0 if fam.type == _MT.COUNTER else 1
+                                for fam in fams], np.int8),
+                    np.stack([fam.values for fam in fams]),
+                    np.stack([
+                        fam.mask.astype(np.uint8) if fam.mask is not None
+                        else np.ones(g.nrows, np.uint8)
+                        for fam in fams]),
+                    batch.timestamp * 1000, self.hostname_tag,
+                    self.hostname, self.name_drops, self.tag_drops,
+                    excl)
+                if out is not None:
+                    body, n = out
+                    if n:
+                        raw_bodies.append((body, n))
+                    continue
+            # python path for this group
+            for fam in g.families:
+                vals = fam.values.tolist()
+                suffix = fam.suffix
+                for i in g.rows_for(fam).tolist():
+                    name, tags, sinks = g.meta_at(i)
+                    if g.has_routing and sinks is not None \
+                            and self.name() not in sinks:
+                        continue
+                    if excluded_tags:
+                        tags = [t for t in tags
+                                if t.split(":", 1)[0] not in excluded_tags]
+                    conv = self._convert_fields(
+                        name + suffix if suffix else name, vals[i],
+                        tags, fam.type, batch.timestamp, "", keys)
+                    if conv is None:
+                        continue
+                    api_key, kinds = conv
+                    bucket = by_key.setdefault(
+                        api_key, {"counter": [], "gauge": []})
+                    for kind, point in kinds.items():
+                        bucket[kind].append(point)
+        self._post_buckets(by_key, raw_bodies)
 
     def flush(self, metrics: list[InterMetric]) -> None:
         # group by API key (per-tag clients); snapshot the key map once —
@@ -210,8 +265,15 @@ class SignalFxMetricSink(MetricSink):
                 bucket[kind].append(point)
         self._post_buckets(by_key)
 
-    def _post_buckets(self, by_key: dict[str, dict[str, list]]) -> None:
+    def _post_buckets(self, by_key: dict[str, dict[str, list]],
+                      raw_bodies=None) -> None:
         threads = []
+        for body, count in raw_bodies or ():
+            t = threading.Thread(
+                target=self._post_raw, args=(self.api_key, body, count),
+                daemon=True)
+            t.start()
+            threads.append(t)
         for api_key, payload in by_key.items():
             body = {k: v for k, v in payload.items() if v}
             t = threading.Thread(
@@ -227,6 +289,22 @@ class SignalFxMetricSink(MetricSink):
                 f"{self.endpoint_base}/v2/datapoint", body,
                 headers={"X-SF-Token": api_key}, opener=self.opener)
             self.flushed_metrics += sum(len(v) for v in body.values())
+        except Exception as e:
+            self.flush_errors += 1
+            log.warning("signalfx datapoint post failed: %s", e)
+
+    def _post_raw(self, api_key: str, body: bytes, count: int) -> None:
+        """POST one pre-built JSON body (the native emitter's output)."""
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                f"{self.endpoint_base}/v2/datapoint", data=body,
+                method="POST",
+                headers={"Content-Type": "application/json",
+                         "X-SF-Token": api_key})
+            self.opener(req, 10.0)
+            self.flushed_metrics += count
         except Exception as e:
             self.flush_errors += 1
             log.warning("signalfx datapoint post failed: %s", e)
