@@ -2349,16 +2349,11 @@ long long vn_encode_datadog_series(
           size_t e = rest.find('\x1f');
           std::string_view tag =
               e == std::string_view::npos ? rest : rest.substr(0, e);
+          // server-level key exclusion removes the tag before the sink
+          // ever sees it (strip_excluded_tags runs first on the Python
+          // paths) — including before host:/device: extraction
           bool skip = false;
-          if (tag.size() >= 5 && tag.compare(0, 5, "host:") == 0) {
-            if (tag.size() > 5) host = tag.substr(5);
-            skip = true;
-          } else if (tag.size() >= 7 &&
-                     tag.compare(0, 7, "device:") == 0) {
-            device = tag.substr(7);
-            skip = true;
-          }
-          if (!skip) {
+          {
             size_t colon = tag.find(':');
             std::string_view key =
                 colon == std::string_view::npos ? tag
@@ -2368,6 +2363,16 @@ long long vn_encode_datadog_series(
                 skip = true;
                 break;
               }
+            }
+          }
+          if (!skip) {
+            if (tag.size() >= 5 && tag.compare(0, 5, "host:") == 0) {
+              if (tag.size() > 5) host = tag.substr(5);
+              skip = true;
+            } else if (tag.size() >= 7 &&
+                       tag.compare(0, 7, "device:") == 0) {
+              device = tag.substr(7);
+              skip = true;
             }
           }
           if (!skip) {
