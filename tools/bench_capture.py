@@ -58,7 +58,9 @@ def probe(timeout: float = 480.0) -> str | None:
         return None
     if r.returncode != 0:
         return None
-    return r.stdout.decode().strip() or None
+    plat = r.stdout.decode().strip() or None
+    # the tunnelled chip may report its experimental plugin name
+    return "tpu" if plat in ("tpu", "axon") else plat
 
 
 def run_workload(name: str, timeout: float = 900.0) -> dict | None:
