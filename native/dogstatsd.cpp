@@ -2852,21 +2852,49 @@ int vn_drain_ssf_services(void* p, char* buf, int cap) {
   }
   ctx->ssf_services.clear();
   // cut on a line boundary so the consumer never sees a partial record
-  int n = std::min<int>(cap, static_cast<int>(ctx->ssf_services_out.size()));
+  // (cap clamped: a negative cap must not become a huge memcpy size)
+  size_t n = cap < 0 ? 0
+                     : std::min(static_cast<size_t>(cap),
+                                ctx->ssf_services_out.size());
   while (n > 0 && ctx->ssf_services_out[n - 1] != '\n') --n;
   std::memcpy(buf, ctx->ssf_services_out.data(), n);
   ctx->ssf_services_out.erase(0, n);
-  return n;
+  return static_cast<int>(n);
 }
 
 // Drain the buffered event/service-check lines (newline separated).
+// Cuts on a line boundary like vn_drain_ssf_services so a full buffer
+// never severs a record across two drains.
+//
+// CAP CONTRACT: cap should be >= metric_max_length + 1 (events are
+// length-capped at ingest); an oversize first record is dropped whole,
+// counted in vn_errors, and the drain continues with the records
+// behind it — so a `while n > 0` loop never stalls on one bad record.
 int vn_drain_other(void* p, char* buf, int cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
   std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
-  int n = std::min<int>(cap, static_cast<int>(ctx->other_lines.size()));
+  size_t n;
+  for (;;) {
+    n = cap < 0 ? 0
+                : std::min(static_cast<size_t>(cap), ctx->other_lines.size());
+    while (n > 0 && ctx->other_lines[n - 1] != '\n') --n;
+    if (n == 0 && cap > 0 && !ctx->other_lines.empty()) {
+      // degenerate: first record alone exceeds the caller's buffer — drop
+      // it whole (counted as an error so the loss is observable) and
+      // retry, so complete records queued behind it still drain this call
+      // rather than emitting a severed fragment the consumer would
+      // misparse as two records
+      size_t nl = ctx->other_lines.find('\n');
+      ctx->other_lines.erase(
+          0, nl == std::string::npos ? ctx->other_lines.size() : nl + 1);
+      ++ctx->errors;
+      continue;
+    }
+    break;
+  }
   std::memcpy(buf, ctx->other_lines.data(), n);
   ctx->other_lines.erase(0, n);
-  return n;
+  return static_cast<int>(n);
 }
 
 }  // extern "C"

@@ -1,0 +1,253 @@
+// ThreadSanitizer soak for the native ingest/commit path.
+//
+// The Go reference's race-correctness strategy is running its whole test
+// suite under `go test -race` (reference .circleci/config.yml:104-112).
+// This driver is the equivalent gate for OUR native hot path: it links
+// dogstatsd.cpp directly, spins up the same thread topology the Python
+// runtime creates (multiple UDP readers calling vn_ingest_routed over
+// shared shard contexts, SSF span readers on one shared span context, a
+// flush thread draining every context, a telemetry thread reading the
+// stats counters, an import thread upserting series), and runs them all
+// concurrently under -fsanitize=thread. Any data race on the shard
+// mutex discipline aborts the build (TSan exits non-zero).
+//
+// Built+run by `make -C native tsan` (tools/ci.sh runs it).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* vn_ctx_new(int hll_precision);
+void vn_ctx_free(void* p);
+int vn_ingest(void* p, const char* buf, int len);
+int vn_ingest_routed(void** ctxps, int nctx, const char* buf, int len);
+int vn_ingest_ssf_many(void* p, const char* buf, long long len,
+                       const char* ind_name, int ind_len, const char* obj_name,
+                       int obj_len, double uniq_rate, int* errors_out,
+                       int* fallback_off, int* fallback_len, int fallback_cap,
+                       int* nfall_out);
+int vn_drain_histo(void* p, int32_t* rows, float* vals, float* wts, int cap);
+int vn_drain_set(void* p, int32_t* rows, int32_t* idx, int8_t* rank, int cap);
+int vn_drain_counter(void* p, int32_t* rows, double* contribs, int cap);
+int vn_drain_gauge(void* p, int32_t* rows, double* vals, int cap);
+int vn_drain_new_series(void* p, int32_t* pools, int32_t* rows,
+                        int32_t* kinds, int32_t* scopes, char* strbuf,
+                        int strcap, int* strlen_out, int max);
+int vn_drain_ssf_services(void* p, char* buf, int cap);
+int vn_drain_other(void* p, char* buf, int cap);
+int vn_upsert(void* p, const char* name, int name_len, int kind,
+              const char* joined_tags, int tags_len, int scope_class);
+long long vn_processed(void* p);
+long long vn_errors(void* p);
+int vn_pending_histo(void* p);
+int vn_pending_set(void* p);
+int vn_pending_counter(void* p);
+int vn_pending_gauge(void* p);
+void vn_set_lock_stats(int enabled);
+int vn_lock_stats(void* p, long long out[5], long long* wait_out,
+                  long long* hold_out);
+}
+
+namespace {
+
+constexpr int kShards = 4;
+constexpr int kReaders = 4;
+constexpr int kPacketsPerReader = 39996;  // divisible by the 6-case rotation
+constexpr int kSsfThreads = 2;
+constexpr int kSsfBatches = 200;
+constexpr int kSpansPerBatch = 64;
+
+std::atomic<bool> done{false};
+std::atomic<long long> sent_ok{0}, sent_bad{0}, sent_evt{0};
+
+void put_varint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+// Minimal wire-format SSFSpan (proto/ssf.proto fields: trace_id=2 id=3
+// start=5 end=6 service=8 indicator=12 name=13), framed [u32 LE len].
+std::string make_ssf_batch(int seed) {
+  std::string out;
+  for (int i = 0; i < kSpansPerBatch; ++i) {
+    std::string span;
+    put_varint(&span, (2 << 3) | 0);  // trace_id
+    put_varint(&span, 1000 + seed);
+    put_varint(&span, (3 << 3) | 0);  // id
+    put_varint(&span, 1 + i);
+    put_varint(&span, (5 << 3) | 0);  // start_timestamp
+    put_varint(&span, 1700000000000000000ull + i);
+    put_varint(&span, (6 << 3) | 0);  // end_timestamp
+    put_varint(&span, 1700000000000000000ull + i + 5000000);
+    const char* svc = (i % 2) ? "svc-a" : "svc-b";
+    put_varint(&span, (8 << 3) | 2);  // service
+    put_varint(&span, std::strlen(svc));
+    span += svc;
+    put_varint(&span, (12 << 3) | 0);  // indicator
+    put_varint(&span, 1);
+    put_varint(&span, (13 << 3) | 2);  // name
+    put_varint(&span, 2);
+    span += "op";
+    uint32_t len = static_cast<uint32_t>(span.size());
+    char hdr[4];
+    std::memcpy(hdr, &len, 4);
+    out.append(hdr, 4);
+    out += span;
+  }
+  return out;
+}
+
+void reader_thread(std::vector<void*>* ctxs, int tid) {
+  char line[128];
+  for (int i = 0; i < kPacketsPerReader; ++i) {
+    int n;
+    int kind = i % 6;
+    switch (kind) {
+      case 0:
+        n = std::snprintf(line, sizeof line, "soak.timer%d:%d|ms|#t:%d",
+                          i % 64, i % 1000, tid);
+        break;
+      case 1:
+        n = std::snprintf(line, sizeof line, "soak.count:%d|c|@0.5", i % 7);
+        break;
+      case 2:
+        n = std::snprintf(line, sizeof line, "soak.gauge%d:%d|g", tid, i);
+        break;
+      case 3:
+        n = std::snprintf(line, sizeof line, "soak.set:user%d|s", i % 997);
+        break;
+      case 4:  // malformed: exercises the error path under contention
+        n = std::snprintf(line, sizeof line, "soak.bad:%d|q", i);
+        break;
+      default:  // event: races the other_lines append in vn_ingest_routed
+                // against the drain thread's vn_drain_other boundary cut
+        n = std::snprintf(line, sizeof line, "_e{9,2}:soaktitle|hi|#t:%d",
+                          tid);
+        break;
+    }
+    int rc = vn_ingest_routed(ctxs->data(), kShards, line, n);
+    if (kind == 5)
+      sent_evt.fetch_add(1, std::memory_order_relaxed);
+    else if (rc > 0)
+      sent_ok.fetch_add(rc, std::memory_order_relaxed);
+    else
+      sent_bad.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+void ssf_thread(void* ctx, int tid) {
+  std::string batch = make_ssf_batch(tid);
+  for (int i = 0; i < kSsfBatches; ++i) {
+    int errs = 0, nfall = 0;
+    vn_ingest_ssf_many(ctx, batch.data(),
+                       static_cast<long long>(batch.size()), "ind", 3, "obj",
+                       3, 0.0, &errs, nullptr, nullptr, 0, &nfall);
+  }
+}
+
+// The flush loop: drain every pool of every context while readers are
+// still committing — the exact overlap the two-phase flush runs.
+void drain_thread(std::vector<void*>* all_ctxs) {
+  constexpr int kCap = 8192;
+  std::vector<int32_t> rows(kCap), idx(kCap), pools(kCap), kinds(kCap),
+      scopes(kCap);
+  std::vector<float> vals(kCap), wts(kCap);
+  std::vector<double> dvals(kCap);
+  std::vector<int8_t> rank(kCap);
+  std::vector<char> namebuf(kCap * 64);
+  int stroff = 0;
+  while (!done.load(std::memory_order_acquire)) {
+    for (void* c : *all_ctxs) {
+      vn_drain_histo(c, rows.data(), vals.data(), wts.data(), kCap);
+      vn_drain_set(c, rows.data(), idx.data(), rank.data(), kCap);
+      vn_drain_counter(c, rows.data(), dvals.data(), kCap);
+      vn_drain_gauge(c, rows.data(), dvals.data(), kCap);
+      vn_drain_new_series(c, pools.data(), rows.data(), kinds.data(),
+                          scopes.data(), namebuf.data(),
+                          static_cast<int>(namebuf.size()), &stroff, kCap);
+      vn_drain_ssf_services(c, namebuf.data(),
+                            static_cast<int>(namebuf.size()));
+      vn_drain_other(c, namebuf.data(), static_cast<int>(namebuf.size()));
+    }
+  }
+}
+
+// Self-telemetry: reads the counters the scopedstatsd reporter polls.
+void stats_thread(std::vector<void*>* all_ctxs) {
+  long long out[5], wait = 0, hold = 0;
+  while (!done.load(std::memory_order_acquire)) {
+    for (void* c : *all_ctxs) {
+      (void)vn_processed(c);
+      (void)vn_errors(c);
+      (void)vn_pending_histo(c);
+      (void)vn_pending_set(c);
+      (void)vn_pending_counter(c);
+      (void)vn_pending_gauge(c);
+      (void)vn_lock_stats(c, out, &wait, &hold);
+    }
+  }
+}
+
+// The import path: registers series directly, racing the parser's own
+// directory upserts on the same contexts.
+void upsert_thread(std::vector<void*>* ctxs) {
+  char name[64];
+  for (int i = 0; i < 20000; ++i) {
+    int n = std::snprintf(name, sizeof name, "import.series%d", i % 512);
+    vn_upsert((*ctxs)[i % kShards], name, n, i % 4, "env:prod", 8, 0);
+  }
+}
+
+}  // namespace
+
+int main() {
+  vn_set_lock_stats(1);
+  std::vector<void*> shard_ctxs;
+  for (int i = 0; i < kShards; ++i) shard_ctxs.push_back(vn_ctx_new(12));
+  void* ssf_ctx = vn_ctx_new(12);
+  std::vector<void*> all_ctxs = shard_ctxs;
+  all_ctxs.push_back(ssf_ctx);
+
+  std::vector<std::thread> threads;
+  threads.emplace_back(drain_thread, &all_ctxs);
+  threads.emplace_back(stats_thread, &all_ctxs);
+  threads.emplace_back(upsert_thread, &shard_ctxs);
+  for (int t = 0; t < kReaders; ++t)
+    threads.emplace_back(reader_thread, &shard_ctxs, t);
+  for (int t = 0; t < kSsfThreads; ++t)
+    threads.emplace_back(ssf_thread, ssf_ctx, t);
+
+  for (size_t i = 2; i < threads.size(); ++i) threads[i].join();
+  done.store(true, std::memory_order_release);
+  threads[0].join();
+  threads[1].join();
+
+  // conservation: every accepted datagram was counted exactly once
+  long long processed = 0, errors = 0;
+  for (void* c : shard_ctxs) {
+    processed += vn_processed(c);
+    errors += vn_errors(c);
+  }
+  long long want_ok = sent_ok.load(), want_bad = sent_bad.load();
+  long long want_bad_expect = (long long)kReaders * (kPacketsPerReader / 6);
+  std::printf("tsan_soak: processed=%lld errors=%lld sent_ok=%lld "
+              "sent_bad=%lld events=%lld\n",
+              processed, errors, want_ok, want_bad, sent_evt.load());
+  bool ok = processed == want_ok && errors == want_bad &&
+            want_bad == want_bad_expect;
+  for (void* c : all_ctxs) vn_ctx_free(c);
+  if (!ok) {
+    std::fprintf(stderr, "tsan_soak: conservation FAILED\n");
+    return 1;
+  }
+  std::puts("tsan_soak: OK");
+  return 0;
+}

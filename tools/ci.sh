@@ -9,6 +9,9 @@ cd "$(dirname "$0")/.."
 echo "== native build =="
 make -C native clean all
 
+echo "== race-detection gate (ThreadSanitizer soak) =="
+make -C native tsan
+
 echo "== test suite =="
 python -m pytest tests/ -q
 

@@ -180,9 +180,18 @@ class Server:
         self.last_flush_phases: dict[str, float] = {}
         self.flush_count = 0
 
-        # ingest counters (self-telemetry)
-        self.packets_received = 0
-        self.parse_errors = 0
+        # ingest counters (self-telemetry). Incremented from every reader
+        # thread: a bare `self.x += 1` loses increments at GIL switches
+        # (LOAD/ADD/STORE interleave), so each thread gets its own cell
+        # and the public counters are sums over the cells — single-writer
+        # per cell, so no increment can be lost. Cells of dead threads
+        # (per-connection stream readers exit constantly) are folded into
+        # _ctr_base on read so the cell list stays bounded by the number
+        # of LIVE threads.
+        self._ctr_lock = threading.Lock()
+        self._ctr_base = [0, 0]
+        self._ctr_cells: list[tuple[threading.Thread, list[int]]] = []
+        self._ctr_local = threading.local()
         self._errors_reported = 0
         self._span_sink_reported: dict[tuple[str, str], int] = {}
 
@@ -273,8 +282,45 @@ class Server:
                 metric = dogstatsd.parse_metric(packet)
                 self._route(metric)
         except dogstatsd.ParseError as e:
-            self.parse_errors += 1
+            self._bump_errors()
             log.debug("bad metric packet %r: %s", packet[:128], e)
+
+    def _ctr_cell(self) -> list:
+        """This thread's [packets, errors] counter cell."""
+        c = getattr(self._ctr_local, "cell", None)
+        if c is None:
+            c = self._ctr_local.cell = [0, 0]
+            with self._ctr_lock:
+                self._ctr_cells.append((threading.current_thread(), c))
+        return c
+
+    def _ctr_sum(self, i: int) -> int:
+        """Sum counter column i, reclaiming dead threads' cells. A dead
+        thread can never increment again, so folding its cell into the
+        base is exact; a live thread racing an increment is at worst off
+        by the in-flight bump, same as any snapshot read."""
+        with self._ctr_lock:
+            if any(not t.is_alive() for t, _ in self._ctr_cells):
+                live = []
+                for t, c in self._ctr_cells:
+                    if t.is_alive():
+                        live.append((t, c))
+                    else:
+                        self._ctr_base[0] += c[0]
+                        self._ctr_base[1] += c[1]
+                self._ctr_cells = live
+            return self._ctr_base[i] + sum(c[i] for _, c in self._ctr_cells)
+
+    @property
+    def packets_received(self) -> int:
+        return self._ctr_sum(0)
+
+    @property
+    def parse_errors(self) -> int:
+        return self._ctr_sum(1)
+
+    def _bump_errors(self, n: int = 1) -> None:
+        self._ctr_cell()[1] += n
 
     def _route(self, metric) -> None:
         i = metric.digest % len(self.workers)
@@ -284,9 +330,9 @@ class Server:
     def process_metric_packet(self, datagram: bytes) -> None:
         """Split a datagram on newlines and handle each line
         (reference processMetricPacket, server.go:1136)."""
-        self.packets_received += 1
+        self._ctr_cell()[0] += 1
         if len(datagram) > self.config.metric_max_length:
-            self.parse_errors += 1
+            self._bump_errors()
             log.debug("overlong metric datagram (%d bytes)", len(datagram))
             return
         if self.native_mode:
@@ -323,7 +369,7 @@ class Server:
         """One unframed SSF datagram → span pipeline
         (reference HandleTracePacket, server.go:1046)."""
         if not packet:
-            self.parse_errors += 1
+            self._bump_errors()
             return
         if self._native_ssf:
             # native decode + span→metric extraction in one C++ pass;
@@ -336,12 +382,12 @@ class Server:
             if rc == 1:
                 return
             if rc == 0:
-                self.parse_errors += 1
+                self._bump_errors()
                 return
         try:
             span = ssf_wire.parse_ssf(packet)
         except ssf_wire.FramingError as e:
-            self.parse_errors += 1
+            self._bump_errors()
             log.debug("bad SSF packet: %s", e)
             return
         self.handle_ssf(span)
@@ -365,12 +411,12 @@ class Server:
             if (worker._native.pending_histo >= worker.batch_size
                     or worker._native.pending_set >= worker.batch_size):
                 worker.drain_native()
-        self.parse_errors += errs
+        self._bump_errors(errs)
         for pkt in fallbacks:
             try:
                 span = ssf_wire.parse_ssf(pkt)
             except ssf_wire.FramingError as e:
-                self.parse_errors += 1
+                self._bump_errors()
                 log.debug("bad SSF packet: %s", e)
                 continue
             self.handle_ssf(span)
@@ -461,7 +507,7 @@ class Server:
                     return
                 self.handle_ssf(span)
         except ssf_wire.FramingError as e:
-            self.parse_errors += 1
+            self._bump_errors()
             log.debug("SSF stream framing error, closing: %s", e)
         except OSError:
             pass
@@ -651,7 +697,7 @@ class Server:
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
                     if len(line) > self.config.metric_max_length:
-                        self.parse_errors += 1
+                        self._bump_errors()
                         continue
                     if line:
                         self.handle_metric_packet(line)

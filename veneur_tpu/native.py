@@ -453,12 +453,12 @@ class NativeIngest:
         buf = ctypes.create_string_buffer(cap)
         out = []
         while True:
+            # chunks are cut on line boundaries (so n < cap does NOT
+            # mean drained); loop until the buffer reports empty
             n = self._lib.vn_drain_other(self._ctx, buf, cap)
             if n == 0:
                 break
             out.extend(ln for ln in buf.raw[:n].split(b"\n") if ln)
-            if n < cap:
-                break
         return out
 
 
