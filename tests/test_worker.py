@@ -366,3 +366,94 @@ def test_server_flush_does_not_hold_ingest_lock_during_extraction():
         assert float(snap.lmin[0]) == 2.0
     finally:
         server.shutdown()
+
+
+# -- staged-ingest plane (worker._device_histo_step / _histo_fold_staged) ---
+
+
+def _histo_aggs(w, name="t"):
+    _, by_key, _ = _flush(w, is_local=False,
+                          aggregates=HistogramAggregates.from_names(
+                              ["min", "max", "count", "sum", "avg"]))
+    return {
+        "min": by_key[(f"{name}.min", MetricType.GAUGE)].value,
+        "max": by_key[(f"{name}.max", MetricType.GAUGE)].value,
+        "count": by_key[(f"{name}.count", MetricType.COUNTER)].value,
+        "sum": by_key[(f"{name}.sum", MetricType.GAUGE)].value,
+        "p50": by_key[("t.50percentile", MetricType.GAUGE)].value,
+    }
+
+
+def test_staged_spill_boundary_exact():
+    """Aggregates stay exact when one batch exactly fills, then crosses,
+    the staging plane (fit boundary at slots == stage_depth)."""
+    for n in (4, 5, 9):  # == B, B+1, 2B+1 with B=4
+        w = DeviceWorker(stage_depth=4, batch_size=1 << 20)
+        vals = list(range(1, n + 1))
+        for v in vals:
+            w.process_metric(parse_metric(f"t:{v}|ms".encode()))
+        a = _histo_aggs(w)
+        assert a["count"] == float(n), (n, a)
+        assert a["min"] == 1.0 and a["max"] == float(n)
+        assert a["sum"] == float(sum(vals))
+
+
+def test_staged_multi_batch_accumulation():
+    """Counts accumulate across many small device batches: each batch's
+    slot base must continue where the previous one stopped."""
+    w = DeviceWorker(stage_depth=8, batch_size=1 << 20)
+    total = 0
+    for batch in range(5):
+        for v in range(3):  # 3 samples per batch -> crosses B=8 at batch 3
+            w.process_metric(parse_metric(f"t:{batch * 3 + v}|ms".encode()))
+            total += 1
+        w._flush_pending_histos()
+    a = _histo_aggs(w)
+    assert a["count"] == float(total)
+    assert a["min"] == 0.0 and a["max"] == float(total - 1)
+    assert a["sum"] == float(sum(range(total)))
+
+
+def test_staged_growth_preserves_planes():
+    """Pool growth mid-interval (past initial_histo_rows) must carry the
+    already-staged samples into the resized planes."""
+    w = DeviceWorker(stage_depth=16, initial_histo_rows=4,
+                     batch_size=1 << 20)
+    # stage a sample on an early row, then register enough series to
+    # force _ensure_histo growth (4 -> bigger), then flush
+    w.process_metric(parse_metric(b"t:7|ms"))
+    w._flush_pending_histos()
+    for i in range(12):
+        w.process_metric(parse_metric(f"grow{i}:1|ms".encode()))
+    a = _histo_aggs(w)
+    assert a["count"] == 1.0 and a["min"] == 7.0 and a["max"] == 7.0
+
+
+def test_staged_matches_direct_fold():
+    """The staged path and the per-batch direct device fold agree exactly
+    on scalar aggregates and closely on quantiles."""
+    rng = np.random.default_rng(7)
+    vals = rng.gamma(2.0, 10.0, size=300).astype(np.float32)
+
+    staged = DeviceWorker(stage_depth=512, batch_size=1 << 20)
+    direct = DeviceWorker(stage_depth=512, batch_size=1 << 20)
+    rows = []
+    for v in vals:
+        staged.process_metric(parse_metric(b"t:%.4f|ms" % v))
+        direct.process_metric(parse_metric(b"t:%.4f|ms" % v))
+        rows.append(0)
+    # route the direct worker's pending samples through the spill fold
+    direct._ensure_histo(direct.directory.num_histo_rows)
+    pv = np.asarray(direct._ph_vals, np.float32)
+    pw = np.asarray(direct._ph_wts, np.float32)
+    pr = np.asarray(direct._ph_rows, np.int32)
+    direct._ph_rows, direct._ph_vals, direct._ph_wts = [], [], []
+    direct._fold_batch_direct(pr, pv, pw)
+
+    sa = _histo_aggs(staged)
+    da = _histo_aggs(direct)
+    assert sa["count"] == da["count"]
+    assert sa["min"] == da["min"] and sa["max"] == da["max"]
+    assert abs(sa["sum"] - da["sum"]) <= 1e-3 * abs(da["sum"])
+    # both digests see the same samples; p50 agrees within digest error
+    assert abs(sa["p50"] - da["p50"]) <= 0.05 * max(1.0, abs(da["p50"]))

@@ -133,6 +133,11 @@ class Config:
     tpu_mesh_hosts: int = 0  # 0 = auto (2 when the device count is even)
     tpu_native_ingest: bool = True
     tpu_batch_size: int = 16384
+    # raw-sample staging slots per histogram row: ingest stores samples
+    # into a host [rows, depth] plane and the digest compress runs once
+    # per interval (worker._histo_fold_staged); rows that fill their
+    # staging mid-interval spill through the direct device fold
+    tpu_stage_depth: int = 64
     tpu_compression: float = 100.0
     tpu_hll_precision: int = 14
     # set-sketch storage: "staged" keeps small sets host-side sparse and
@@ -469,3 +474,5 @@ def validate_config(cfg: Config) -> None:
         raise ValueError("tpu_set_store must be 'staged' or 'dense'")
     if not (4 <= cfg.tpu_hll_precision <= 18):
         raise ValueError("tpu_hll_precision must be in [4,18]")
+    if cfg.tpu_stage_depth < 1:
+        raise ValueError("tpu_stage_depth must be >= 1")

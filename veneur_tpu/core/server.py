@@ -98,6 +98,7 @@ class Server:
         self.workers = [
             DeviceWorker(
                 batch_size=cfg.tpu_batch_size,
+                stage_depth=cfg.tpu_stage_depth,
                 compression=cfg.tpu_compression,
                 hll_precision=cfg.tpu_hll_precision,
                 initial_histo_rows=cfg.tpu_initial_histo_rows,

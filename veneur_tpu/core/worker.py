@@ -131,6 +131,53 @@ def _histo_ingest_step(
             lmin, lmax, lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c)
 
 
+@functools.partial(jax.jit, static_argnames=("compression",),
+                   donate_argnums=tuple(range(14)))
+def _histo_fold_staged(
+    means, weights, dmin, dmax, drecip, drecip_c,
+    lmin, lmax, lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c,
+    svals, swts,
+    compression: float = td.DEFAULT_COMPRESSION,
+):
+    """Fold the staged raw-sample plane [S, B] into the digest pool.
+
+    The TPU-first half of staged ingest: samples land in a host-side
+    [S, B] plane at O(1) numpy-store cost per sample, and this ONE
+    program pays the digest compress once per row per interval — the
+    batched analog of the reference's deferred tempCentroids merge
+    (tdigest/merging_digest.go:115-137 buffers raw samples, :140-224
+    merges on overflow). Replaces per-batch gather→add_batch→scatter,
+    whose [K, 2C] sort per batch dominated ingest compute.
+
+    The staged plane is already row-dense, so no batch sort, run
+    detection, or prefix-sum gathers are needed: per-row scalar stats
+    are masked [S, B] reductions and the merge is one compress over
+    [S, C+B]. Empty slots carry weight 0 (value ignored).
+    """
+    c = means.shape[1]
+    live = swts > 0
+    s_w = jnp.sum(swts, axis=-1)
+    s_sum = jnp.sum(jnp.where(live, svals * swts, 0.0), axis=-1)
+    s_recip = jnp.sum(jnp.where(live, swts / svals, 0.0), axis=-1)
+    s_min = jnp.min(jnp.where(live, svals, jnp.inf), axis=-1)
+    s_max = jnp.max(jnp.where(live, svals, -jnp.inf), axis=-1)
+
+    cat_means = jnp.concatenate([means, svals], axis=-1)
+    cat_w = jnp.concatenate([weights, swts], axis=-1)
+    means, weights = td._compress_rows(cat_means, cat_w, compression, c)
+
+    dmin = jnp.minimum(dmin, s_min)
+    dmax = jnp.maximum(dmax, s_max)
+    drecip, drecip_c = _comp_add(drecip, drecip_c, s_recip)
+    lmin = jnp.minimum(lmin, s_min)
+    lmax = jnp.maximum(lmax, s_max)
+    lsum, lsum_c = _comp_add(lsum, lsum_c, s_sum)
+    lweight, lweight_c = _comp_add(lweight, lweight_c, s_w)
+    lrecip, lrecip_c = _comp_add(lrecip, lrecip_c, s_recip)
+    return (means, weights, dmin, dmax, drecip, drecip_c,
+            lmin, lmax, lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c)
+
+
 @functools.partial(jax.jit, static_argnames=("compression",), donate_argnums=(0, 1, 2, 3, 4, 5))
 def _histo_import_step(
     means, weights, dmin, dmax, drecip, drecip_c,
@@ -373,6 +420,9 @@ class SwappedEpoch:
     staged_sets: object
     umts: Optional[np.ndarray]
     mesh_out: Optional[dict]
+    # host [S, B] raw-sample staging planes (vals, wts) still unfolded at
+    # swap; extract_snapshot folds them into `histo` off the ingest lock
+    staged_histo: Optional[tuple] = None
 
 
 class DeviceWorker:
@@ -397,8 +447,13 @@ class DeviceWorker:
         is_local: bool = True,
         set_hash: str = "fnv",
         set_store: str = "staged",
+        stage_depth: int = 64,
     ) -> None:
         self.batch_size = batch_size
+        # raw-sample staging slots per digest row (B in _histo_fold_staged);
+        # rows whose staged count hits B spill through the direct per-batch
+        # device fold — cheap there, since hot rows make K small
+        self.stage_depth = stage_depth
         self.compression = compression
         self.capacity = capacity
         self.hll_precision = hll_precision
@@ -624,6 +679,11 @@ class DeviceWorker:
             self._staged_sets = StagedSetStore(self.hll_precision)
         else:
             self._staged_sets = None
+        # host raw-sample staging planes (see _device_histo_step); created
+        # lazily alongside _histo
+        self._stage_vals: Optional[np.ndarray] = None
+        self._stage_wts: Optional[np.ndarray] = None
+        self._stage_count: Optional[np.ndarray] = None
         # pending SoA buffers (host)
         self._ph_rows: list[int] = []
         self._ph_vals: list[float] = []
@@ -816,8 +876,70 @@ class DeviceWorker:
         self._ph_rows, self._ph_vals, self._ph_wts = [], [], []
         self._device_histo_step(rows, vals, wts)
 
+    def _ensure_stage(self) -> None:
+        """Size the host staging planes to the digest pool's row count."""
+        rows = self._histo.num_rows
+        if self._stage_count is None:
+            self._stage_vals = np.zeros((rows, self.stage_depth), np.float32)
+            self._stage_wts = np.zeros((rows, self.stage_depth), np.float32)
+            self._stage_count = np.zeros(rows, np.int32)
+        elif len(self._stage_count) < rows:
+            old = len(self._stage_count)
+            nv = np.zeros((rows, self.stage_depth), np.float32)
+            nw = np.zeros((rows, self.stage_depth), np.float32)
+            nc = np.zeros(rows, np.int32)
+            nv[:old] = self._stage_vals
+            nw[:old] = self._stage_wts
+            nc[:old] = self._stage_count
+            self._stage_vals, self._stage_wts, self._stage_count = nv, nw, nc
+
     def _device_histo_step(self, rows: np.ndarray, vals: np.ndarray,
                            wts: np.ndarray) -> None:
+        """Stage a raw-sample batch host-side; the digest compress is paid
+        once per interval in _histo_fold_staged (see its docstring).
+
+        Pure vectorized numpy — no device dispatch on the common path, so
+        ingest throughput is bounded by parse + store, not by per-batch
+        [K, 2C] sorts. Rows whose staging is full spill through the direct
+        per-batch device fold; a row with sustained volume stays full, so
+        its samples keep taking the spill path, where a hot batch's K
+        (unique rows) is small and the gathered fold is cheap."""
+        n = len(rows)
+        if n == 0:
+            return
+        B = self.stage_depth
+        self._ensure_stage()
+        order = np.argsort(rows, kind="stable")
+        srows = rows[order]
+        svals = vals[order]
+        swts = wts[order]
+        newrun = np.empty(n, bool)
+        newrun[0] = True
+        np.not_equal(srows[1:], srows[:-1], out=newrun[1:])
+        starts = np.flatnonzero(newrun)
+        runid = np.cumsum(newrun) - 1
+        # rank of each sample within its row's run → its staging slot
+        slots = self._stage_count[srows] + (np.arange(n) - starts[runid])
+        run_rows = srows[starts]
+        run_len = np.diff(np.append(starts, n))
+        fit = slots < B
+        if fit.all():
+            self._stage_vals[srows, slots] = svals
+            self._stage_wts[srows, slots] = swts
+            self._stage_count[run_rows] += run_len.astype(np.int32)
+            return
+        keep = fit
+        self._stage_vals[srows[keep], slots[keep]] = svals[keep]
+        self._stage_wts[srows[keep], slots[keep]] = swts[keep]
+        self._stage_count[run_rows] = np.minimum(
+            self._stage_count[run_rows] + run_len, B).astype(np.int32)
+        spill = ~keep
+        self._fold_batch_direct(srows[spill], svals[spill], swts[spill])
+
+    def _fold_batch_direct(self, rows: np.ndarray, vals: np.ndarray,
+                           wts: np.ndarray) -> None:
+        """Gather→add_batch→scatter device fold of one sample batch — the
+        spill path for rows whose staging plane is full."""
         h = self._histo
         assert h is not None
         uniq, inverse = np.unique(rows, return_inverse=True)
@@ -1058,9 +1180,14 @@ class DeviceWorker:
     # path with no signal
     pallas_fallbacks: int = 0
 
-    def _extract(self, histo: "HistoDeviceState", qs):
+    def _extract(self, fields: tuple, qs):
         """Flush extraction: the fused Pallas kernel on TPU, the XLA
-        program elsewhere (ops/pallas_kernels.py)."""
+        program elsewhere (ops/pallas_kernels.py). `fields` is the
+        14-tuple of (possibly row-sliced, possibly staged-folded) digest
+        arrays in HistoDeviceState order."""
+        (means, weights, dmin, dmax, drecip, drecip_c,
+         lmin, lmax, lsum, lsum_c, lweight, lweight_c,
+         lrecip, lrecip_c) = fields
         if DeviceWorker._pallas_ok is None:
             from veneur_tpu.ops import pallas_kernels as pk
 
@@ -1070,13 +1197,13 @@ class DeviceWorker:
 
             try:
                 quant, dsum, dcount = pk.flush_extract(
-                    histo.means, histo.weights, histo.dmin, histo.dmax, qs)
-                return (quant, histo.dmin, histo.dmax, dsum, dcount,
-                        histo.drecip + histo.drecip_c,
-                        histo.lmin, histo.lmax,
-                        histo.lsum + histo.lsum_c,
-                        histo.lweight + histo.lweight_c,
-                        histo.lrecip + histo.lrecip_c)
+                    means, weights, dmin, dmax, qs)
+                return (quant, dmin, dmax, dsum, dcount,
+                        drecip + drecip_c,
+                        lmin, lmax,
+                        lsum + lsum_c,
+                        lweight + lweight_c,
+                        lrecip + lrecip_c)
             except Exception:  # pragma: no cover - TPU-only path
                 DeviceWorker._pallas_ok = False
                 DeviceWorker.pallas_fallbacks += 1
@@ -1085,10 +1212,8 @@ class DeviceWorker:
                     "extraction path for the process lifetime",
                     exc_info=True)
         return _histo_flush_extract(
-            histo.means, histo.weights, histo.dmin, histo.dmax,
-            histo.drecip, histo.drecip_c, histo.lmin, histo.lmax,
-            histo.lsum, histo.lsum_c, histo.lweight, histo.lweight_c,
-            histo.lrecip, histo.lrecip_c, qs,
+            means, weights, dmin, dmax, drecip, drecip_c, lmin, lmax,
+            lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c, qs,
         )
 
     # -- flush --------------------------------------------------------------
@@ -1131,11 +1256,17 @@ class DeviceWorker:
                 quantiles, self.directory.num_histo_rows)
             self._mesh_pool.reset()
 
+        staged_histo = None
+        if self._stage_count is not None and self._stage_count.any():
+            # hand the host staging planes to the closed epoch; the fold
+            # into the digest runs in extract_snapshot, OFF the ingest lock
+            self._ensure_stage()  # pool may have grown since the last stage
+            staged_histo = (self._stage_vals, self._stage_wts)
         swapped = SwappedEpoch(
             directory=self.directory, scalars=self.scalars,
             histo=self._histo, sets=self._sets,
             staged_sets=self._staged_sets, umts=self._umts,
-            mesh_out=mesh_out,
+            mesh_out=mesh_out, staged_histo=staged_histo,
         )
         self.processed = 0
         self.imported = 0
@@ -1159,19 +1290,37 @@ class DeviceWorker:
             unique_timeseries_registers=swapped.umts,
         )
         if histo is not None and directory.num_histo_rows:
+            n = directory.num_histo_rows
+            # fold + extract over the USED rows only: the pool is up to 2x
+            # oversized from power-of-two growth, and both programs' cost
+            # is linear in rows. Pow2 bucketing bounds compile variants.
+            s_eff = min(histo.num_rows, _next_pow2(n, 1024))
+            fields = tuple(
+                a if a.shape[0] == s_eff else a[:s_eff]
+                for a in (histo.means, histo.weights, histo.dmin,
+                          histo.dmax, histo.drecip, histo.drecip_c,
+                          histo.lmin, histo.lmax, histo.lsum, histo.lsum_c,
+                          histo.lweight, histo.lweight_c, histo.lrecip,
+                          histo.lrecip_c))
+            if swapped.staged_histo is not None:
+                sv, sw = swapped.staged_histo
+                fields = _histo_fold_staged(
+                    *fields, jnp.asarray(sv[:s_eff]), jnp.asarray(sw[:s_eff]),
+                    compression=self.compression,
+                )
+                swapped.staged_histo = None
             qs = jnp.asarray(np.asarray(quantiles, dtype=np.float32))
-            out = self._extract(histo, qs)
+            out = self._extract(fields, qs)
             (qv, dmin, dmax, dsum, dcount, drecip,
              lmin, lmax, lsum, lweight, lrecip) = [np.asarray(a) for a in out]
-            n = directory.num_histo_rows
             snap.quantile_values = qv[:n]
             snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
             snap.dmin, snap.dmax = dmin[:n], dmax[:n]
             snap.dsum, snap.dcount, snap.drecip = dsum[:n], dcount[:n], drecip[:n]
             snap.lmin, snap.lmax = lmin[:n], lmax[:n]
             snap.lsum, snap.lweight, snap.lrecip = lsum[:n], lweight[:n], lrecip[:n]
-            snap.digest_means = np.asarray(histo.means)[:n]
-            snap.digest_weights = np.asarray(histo.weights)[:n]
+            snap.digest_means = np.asarray(fields[0])[:n]
+            snap.digest_weights = np.asarray(fields[1])[:n]
         if swapped.mesh_out is not None:
             mout = swapped.mesh_out
             n = directory.num_histo_rows

@@ -169,6 +169,12 @@ def _compress_rows(
     sorted_means, sorted_w = jax.lax.sort(
         (sort_keys, weights), dimension=-1, num_keys=1
     )
+    # Stage barriers: each stage's outputs feed several consumers below;
+    # without them XLA's fusion duplicates whole producer chains into
+    # every consumer (measured 1.8x end-to-end at S=262k on CPU, and the
+    # same recompute heuristic exists on TPU).
+    sorted_means, sorted_w = jax.lax.optimization_barrier(
+        (sorted_means, sorted_w))
     # 2. Per-row cumulative weight and left-edge quantile.
     w_cum = jnp.cumsum(sorted_w, axis=-1)
     total = w_cum[:, -1:]
@@ -178,6 +184,7 @@ def _compress_rows(
     #    weight, so the sums below are unaffected.)
     bucket = jnp.floor(_k_scale(q_left, compression)).astype(jnp.int32)
     bucket = jnp.clip(bucket, 0, capacity - 1)
+    w_cum, bucket = jax.lax.optimization_barrier((w_cum, bucket))
     # 4. Bucket accumulation, scatter- AND broadcast-free: buckets are
     #    non-decreasing along a sorted row, so each bucket is one
     #    contiguous run; its sum is a difference of row-prefix sums at the
@@ -196,6 +203,7 @@ def _compress_rows(
     live = is_end & (seg_w > 0)
     new_means = jnp.where(live, seg_mw / jnp.maximum(seg_w, 1e-30), _INF)
     new_w = jnp.where(live, seg_w, 0.0)
+    new_means, new_w = jax.lax.optimization_barrier((new_means, new_w))
     # 5. Sort by mean (empties keyed +inf sort last) and keep the first
     #    `capacity` slots — the k-function emits ≤ δ+1 ≤ capacity buckets,
     #    so the slice only ever drops padding.
