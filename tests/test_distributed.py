@@ -798,3 +798,91 @@ def test_forward_telemetry_includes_content_length():
         assert "veneur.forward.content_length_bytes" in lines
     finally:
         imp.stop()
+
+
+def test_trace_proxy_datadog_json_spans():
+    """A stock Datadog-format JSON span array POSTed to the proxy's
+    /spans is ring-routed by trace_id and re-POSTed as JSON to the
+    owning destination (reference ProxyTraces proxy.go:543-586,
+    handleSpans handlers_global.go:45-56, datadog_trace_span.go:1)."""
+    import json
+    import threading
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from veneur_tpu.distributed.proxy import ProxyHTTPServer, TraceProxy
+
+    received: dict[int, list] = {}
+    rx_lock = threading.Lock()
+
+    def make_rx(label):
+        class Rx(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                assert self.path == "/spans"
+                with rx_lock:
+                    received.setdefault(label, []).extend(json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Rx)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    rx1, rx2 = make_rx(0), make_rx(1)
+    dests = [f"http://127.0.0.1:{rx1.server_port}",
+             f"http://127.0.0.1:{rx2.server_port}"]
+    tp = TraceProxy(dests)
+    front = ProxyHTTPServer(ProxyServer([]), trace_proxy=tp)
+    fport = front.start()
+    try:
+        traces = []
+        for trace_id in (11, 22, 33, 44, 55, 66):
+            for span_id in (1, 2):
+                traces.append({
+                    "trace_id": trace_id, "span_id": span_id,
+                    "parent_id": span_id - 1, "name": "op",
+                    "resource": "GET /", "service": "svc",
+                    "start": 1700000000000000000, "duration": 5000,
+                    "error": 0, "meta": {"k": "v"},
+                    "metrics": {"m": 1.5}, "type": "web"})
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fport}/spans",
+            data=json.dumps(traces).encode(),
+            headers={"Content-Type": "application/json"})
+        assert urllib.request.urlopen(req).status == 202
+        assert _wait_until(lambda: tp.proxied_spans >= 12)
+
+        with rx_lock:
+            where = {}
+            for label, spans in received.items():
+                for sp in spans:
+                    where.setdefault(sp["trace_id"], set()).add(label)
+        assert len(where) == 6  # every trace arrived somewhere
+        for _, labels in where.items():
+            assert len(labels) == 1  # never split across destinations
+        # span payload survives the hop intact
+        with rx_lock:
+            sample = next(iter(received.values()))[0]
+        assert sample["service"] == "svc" and sample["meta"] == {"k": "v"}
+        assert tp.drops == 0
+
+        # empty array and non-array bodies are rejected like the reference
+        for bad in (b"[]", b"{}"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fport}/spans", data=bad,
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+    finally:
+        front.stop()
+        tp.stop()
+        rx1.shutdown()
+        rx2.shutdown()

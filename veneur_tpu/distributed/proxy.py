@@ -152,9 +152,10 @@ class TraceProxy:
     downstream collectors by consistent hash of the trace ID, so every
     span of one trace lands on the same host).
 
-    Spans leave over UDP SSF datagrams — the ingest path every
-    destination server already listens on — so the proxy works against
-    plain veneur-tpu globals with no extra endpoint."""
+    Two span formats ride the same ring: framed SSF leaves over UDP
+    datagrams — the ingest path every destination server already listens
+    on — and Datadog-format JSON arrays (datadog_trace_span.go:1) POST
+    to each destination's /spans like the reference proxy does."""
 
     def __init__(self, destinations: Optional[list[str]] = None) -> None:
         self.ring = ConsistentRing(destinations or [])
@@ -184,6 +185,45 @@ class TraceProxy:
                 self.drops += 1
                 log.debug("span forward to %s failed: %s", dest, e)
 
+    def handle_datadog_spans(self, traces: list) -> None:
+        """Ring-route Datadog-format JSON trace spans by trace_id and POST
+        each destination its batch as a JSON array (reference ProxyTraces,
+        proxy.go:543-586; span schema datadog_trace_span.go:1): a stock
+        Datadog tracer can point straight at this proxy. The downstream
+        endpoint takes an undocumented array and no deflate
+        (proxy.go:566-568), so bodies go out plain."""
+        import json
+        import urllib.request
+
+        by_dest: dict[str, list] = {}
+        for t in traces:
+            try:
+                trace_id = int(t.get("trace_id", 0))
+            except (TypeError, ValueError, AttributeError):
+                self.drops += 1
+                continue
+            try:
+                with self._lock:
+                    dest = self.ring.get(str(trace_id))
+            except LookupError:
+                self.drops += 1
+                continue
+            by_dest.setdefault(dest, []).append(t)
+        for dest, batch in by_dest.items():
+            url = dest if "://" in dest else f"http://{dest}"
+            req = urllib.request.Request(
+                url.rstrip("/") + "/spans",
+                data=json.dumps(batch).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    resp.read()
+                self.proxied_spans += len(batch)
+            except (OSError, ValueError) as e:
+                self.drops += len(batch)
+                log.debug("datadog span batch to %s failed: %s", dest, e)
+
     def stop(self) -> None:
         self._sock.close()
 
@@ -212,8 +252,10 @@ class ProxyHTTPServer:
     plus /healthcheck /version /debug/pprof).
 
     /import takes the same bodies as the global import endpoint (protobuf
-    MetricBatch, JSON+base64, optionally deflate). /spans takes a framed
-    SSF stream (any number of frames back-to-back)."""
+    MetricBatch, JSON+base64, optionally deflate). /spans takes either a
+    Datadog-format JSON span array (the reference proxy's span body,
+    handlers_global.go:74-110) or a framed SSF stream (any number of
+    frames back-to-back)."""
 
     def __init__(self, proxy: ProxyServer,
                  trace_proxy: Optional[TraceProxy] = None) -> None:
@@ -274,6 +316,30 @@ class ProxyHTTPServer:
                         proxy.handle_batch(batch)
                         self._respond(200, b"accepted")
                 elif self.path == "/spans" and trace_proxy is not None:
+                    # Datadog-format JSON array (the reference proxy's only
+                    # span body, handlers_global.go:74-110) or a framed SSF
+                    # stream (the veneur-tpu native format) — sniffed by
+                    # content type / leading byte
+                    ctype = self.headers.get("Content-Type", "")
+                    if "json" in ctype or body.lstrip()[:1] == b"[":
+                        import json as _json
+
+                        try:
+                            traces = _json.loads(body)
+                            if not isinstance(traces, list):
+                                raise ValueError("expected a JSON array")
+                        except ValueError as e:
+                            self._respond(
+                                400, f"bad /spans body: {e}".encode())
+                            return
+                        if not traces:
+                            # reference handleTraceRequest rejects empties
+                            self._respond(
+                                400, b"Received empty /spans request")
+                            return
+                        self._respond(202, b"accepted")
+                        trace_proxy.handle_datadog_spans(traces)
+                        return
                     spans = []
                     stream = io.BytesIO(body)
                     try:
