@@ -2,12 +2,13 @@
 
 Default workload: the DogStatsD timer-replay configuration (BASELINE.md) —
 S histogram series, every interval each series receives a stream of timer
-samples; the chip folds fixed-size batches into the t-digest pool (sort +
-arcsine-bucket compress over all series at once) and extracts the percentile
-set at flush. The reported metric is raw-sample throughput through the
-aggregation kernel, the analog of the reference's ingest packets/sec
-(README.md:309: >60k packets/sec/instance in production — the vs_baseline
-denominator).
+samples. Since round 4 the product stages raw samples host-side and the
+chip pays ONE fold per interval: upload the [S, B] staging plane, compress
+it into the t-digest pool, extract percentiles at flush — the timed loop
+measures exactly that path. The reported metric is raw-sample throughput
+through the aggregation kernel, the analog of the reference's ingest
+packets/sec (README.md:309: >60k packets/sec/instance in production — the
+vs_baseline denominator).
 
 Prints ONE JSON line per workload: {"metric", "value", "unit",
 "vs_baseline"}. With no VENEUR_BENCH_WORKLOAD set, all five BASELINE
@@ -199,38 +200,46 @@ def _roofline(result: dict, bytes_moved: float, elapsed: float,
 
 
 def timer_replay() -> dict:
+    """Headline: staged-ingest aggregation throughput, the PRODUCT's
+    device-side path since round 4. Ingest stores raw samples into a host
+    [S, B] staging plane at numpy-store cost; the chip's work per
+    interval is one fold — upload the plane, compress it into the digest
+    pool, update the scalar aggregates (core/worker._histo_fold_staged).
+    Each timed pass is upload + fold over S·B samples; extraction runs
+    once at the end and is force-fetched so the whole chain provably
+    executed."""
     import jax
     import jax.numpy as jnp
 
+    from veneur_tpu.core.worker import _histo_fold_staged
     from veneur_tpu.ops import tdigest as td
 
-    series = _envint("VENEUR_BENCH_SERIES", 16384, 4096)
-    batch = _envint("VENEUR_BENCH_BATCH", 1 << 22, 1 << 18)
+    series = _envint("VENEUR_BENCH_SERIES", 65536, 8192)
+    depth = _envint("VENEUR_BENCH_STAGE_DEPTH", 64)
     # CPU fallback (accelerator unavailable): smaller sizes so the
     # bench still finishes in a couple of minutes
     iters = _envint("VENEUR_BENCH_ITERS", 20, 5)
 
     rng = np.random.default_rng(42)
     pool = td.init_pool(series, td.DEFAULT_CAPACITY)
-    state = [pool.means, pool.weights, pool.min, pool.max, pool.recip]
 
-    # two pre-staged input batches, alternated so no result is ever reused
-    batches = []
+    def _full(v):
+        # distinct buffers: the fold donates every arg, and donating one
+        # buffer twice is an error (same rule as HistoDeviceState.create)
+        return jnp.full((series,), v, jnp.float32)
+
+    state = [pool.means, pool.weights, pool.min, pool.max, pool.recip,
+             _full(0.0), _full(np.inf), _full(-np.inf), _full(0.0),
+             _full(0.0), _full(0.0), _full(0.0), _full(0.0), _full(0.0)]
+
+    # two pre-staged HOST planes, alternated so no result is ever reused;
+    # the timed loop pays the host→device upload like the product does
+    planes = []
     for _ in range(2):
-        rows = rng.integers(0, series, batch).astype(np.int32)
-        vals = rng.gamma(2.0, 50.0, batch).astype(np.float32)
-        wts = np.ones(batch, np.float32)
-        batches.append(
-            (jnp.asarray(rows), jnp.asarray(vals), jnp.asarray(wts))
-        )
+        sv = rng.gamma(2.0, 50.0, (series, depth)).astype(np.float32)
+        sw = np.ones((series, depth), np.float32)
+        planes.append((sv, sw))
     qs = jnp.asarray(np.array([0.5, 0.9, 0.99], np.float32))
-
-    def ingest(state, b):
-        means, weights, dmin, dmax, drecip, _ = td.add_batch(
-            state[0], state[1], state[2], state[3], state[4],
-            b[0], b[1], b[2],
-        )
-        return [means, weights, dmin, dmax, drecip]
 
     @jax.jit
     def force(state, quant):
@@ -242,28 +251,35 @@ def timer_replay() -> dict:
         return (jnp.sum(state[1]) + jnp.sum(quant)
                 + jnp.sum(jnp.where(jnp.isfinite(state[0]), state[0], 0.0)))
 
+    def fold(state, plane):
+        # donation chains naturally: each fold's outputs are fresh
+        # buffers that the next fold consumes
+        sv, sw = plane
+        return list(_histo_fold_staged(
+            *state, jnp.asarray(sv), jnp.asarray(sw)))
+
     # warmup / compile
-    state = ingest(state, batches[0])
-    state = ingest(state, batches[1])
+    state = fold(state, planes[0])
     quant = td.quantile(state[0], state[1], state[2], state[3], qs)
     float(force(state, quant))
 
     t0 = time.perf_counter()
     for i in range(iters):
-        state = ingest(state, batches[i % 2])
+        state = fold(state, planes[i % 2])
     quant = td.quantile(state[0], state[1], state[2], state[3], qs)
     float(force(state, quant))
     elapsed = time.perf_counter() - t0
 
-    total_samples = iters * batch
+    total_samples = iters * series * depth
     rate = total_samples / elapsed
     baseline = 60000.0  # reference production ingest packets/sec
+    plane_bytes = planes[0][0].nbytes + planes[0][1].nbytes
     return _roofline({
         "metric": "histo_samples_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": "samples/s",
         "vs_baseline": round(rate / baseline, 2),
-    }, iters * (_nbytes(batches[0]) + 2 * _nbytes(state)), elapsed)
+    }, iters * (plane_bytes + 2 * _nbytes(state)), elapsed)
 
 
 def mixed() -> dict:
@@ -486,51 +502,65 @@ def ssf_histo() -> dict:
 
 
 def prometheus_1m() -> dict:
-    """BASELINE config 5 + the north-star latency metric: one flush over
-    1M unique histogram series — giant ingest + full percentile
-    extraction; reports the flush latency (budget: the 10s interval).
-    Extraction uses the product's flush path: the fused Pallas kernel on
-    TPU (core/worker._extract), the XLA program elsewhere."""
+    """BASELINE config 5 + the north-star latency metric: one full flush
+    over 1M unique histogram series through the PRODUCT's round-4 path —
+    upload the interval's staged raw-sample plane, fold it into the
+    digest pool (core/worker._histo_fold_staged), and extract the
+    percentile set (the fused Pallas kernel on TPU, the XLA program
+    elsewhere). Reports worst-case flush latency vs the 10s interval."""
     import jax
     import jax.numpy as jnp
 
+    from veneur_tpu.core.worker import _histo_fold_staged
     from veneur_tpu.ops import pallas_kernels as pk
     from veneur_tpu.ops import tdigest as td
 
     series = _envint("VENEUR_BENCH_SERIES", 1 << 20, 1 << 16)
-    batch = _envint("VENEUR_BENCH_BATCH", 1 << 22, 1 << 18)
+    depth = _envint("VENEUR_BENCH_STAGE_DEPTH", 8)  # ~8 samples/series/10s
     iters = _envint("VENEUR_BENCH_ITERS", 5, 2)
     use_pallas = pk.supported()
     rng = np.random.default_rng(4)
     pool = td.init_pool(series, td.DEFAULT_CAPACITY)
-    state = (pool.means, pool.weights, pool.min, pool.max, pool.recip)
-    rows = jnp.asarray(np.arange(batch, dtype=np.int32) % series)
-    vals = jnp.asarray(rng.gamma(2.0, 50.0, batch).astype(np.float32))
-    ones = jnp.ones(batch, np.float32)
+
+    def _full(v):
+        return jnp.full((series,), v, jnp.float32)
+
+    state = [pool.means, pool.weights, pool.min, pool.max, pool.recip,
+             _full(0.0), _full(np.inf), _full(-np.inf), _full(0.0),
+             _full(0.0), _full(0.0), _full(0.0), _full(0.0), _full(0.0)]
+    planes = []
+    for _ in range(2):
+        sv = rng.gamma(2.0, 50.0, (series, depth)).astype(np.float32)
+        sw = np.ones((series, depth), np.float32)
+        planes.append((sv, sw))
     qs = jnp.asarray(np.array([0.5, 0.9, 0.99], np.float32))
 
     @jax.jit
-    def flush_pass(state, bump):
-        m, w, a, b, r, _ = td.add_batch(
-            state[0], state[1], state[2], state[3], state[4],
-            rows, vals + bump, ones)
+    def extract(m, w, a, b):
         if use_pallas:
             quant, dsum, _dcount = pk.flush_extract(m, w, a, b, qs)
         else:
             quant = td.quantile(m, w, a, b, qs)
             dsum = td.row_sum(m, w)
-        return (m, w, a, b, r), (jnp.sum(jnp.where(
-            jnp.isnan(quant), 0.0, quant)) + jnp.sum(dsum))
+        return jnp.sum(jnp.where(jnp.isnan(quant), 0.0, quant)) + jnp.sum(
+            dsum)
 
-    state, s = flush_pass(state, 0.0)
+    def flush_pass(state, plane):
+        sv, sw = plane
+        state = list(_histo_fold_staged(
+            *state, jnp.asarray(sv), jnp.asarray(sw)))
+        return state, extract(state[0], state[1], state[2], state[3])
+
+    state, s = flush_pass(state, planes[0])
     float(s)
     lat = []
     for i in range(iters):
         t0 = time.perf_counter()
-        state, s = flush_pass(state, 1e-6 * (i + 1))
+        state, s = flush_pass(state, planes[i % 2])
         float(s)
         lat.append(time.perf_counter() - t0)
     worst = max(lat)
+    plane_bytes = planes[0][0].nbytes + planes[0][1].nbytes
     return _roofline({
         "metric": "flush_latency_s_1m_series",
         "value": round(worst, 4),
@@ -538,7 +568,7 @@ def prometheus_1m() -> dict:
         # budget = the reference's 10s default flush interval; >1 means
         # the 1M-series flush fits in the interval with headroom
         "vs_baseline": round(10.0 / worst, 2),
-    }, _nbytes((rows, vals, ones)) + 2 * _nbytes(state), worst)
+    }, plane_bytes + 2 * _nbytes(state), worst)
 
 
 WORKLOADS = {
