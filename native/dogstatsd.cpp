@@ -338,6 +338,25 @@ struct Ctx {
   int32_t next_counter_row = 0;
   int32_t next_gauge_row = 0;
 
+  // Raw-sample staging plane (round-4 staged ingest): histo/timer
+  // samples land here at parse time and Python detaches the whole plane
+  // once per flush (vn_stage_detach) — zero per-batch Python work. Rows
+  // whose staging is full spill into the h_* SoA batch below, which
+  // Python drains mid-interval and folds directly (hot rows keep the
+  // gathered per-batch fold cheap). Heap-allocated so detach is a
+  // pointer handoff: Python wraps the vectors' memory as numpy, uploads,
+  // then vn_stage_free()s the plane.
+  struct StagePlane {
+    int32_t rows = 0;   // allocated rows (pow2-grown)
+    int32_t depth = 0;  // slots per row (B)
+    long long total = 0;  // staged samples since allocation
+    std::vector<float> vals;     // [rows * depth]
+    std::vector<float> wts;      // [rows * depth]
+    std::vector<int32_t> count;  // [rows]
+  };
+  int stage_depth = 0;  // 0 = staging disabled (legacy SoA only)
+  StagePlane* stage = nullptr;
+
   // pending SoA batches
   std::vector<int32_t> h_rows;
   std::vector<float> h_vals;
@@ -545,6 +564,37 @@ bool route_metric(Ctx* ctx, std::string_view name, MetricKind kind,
 
 // Commit one parsed metric into a shard's directory + SoA buffers.
 // Caller holds ctx->mu (or owns the ctx exclusively).
+// Store one histo/timer sample into the staging plane. Returns false if
+// staging is disabled or the row's slots are full (caller spills to the
+// SoA batch). Caller holds the ctx mutex.
+bool stage_histo_sample(Ctx* ctx, int32_t row, double value,
+                        double sample_rate) {
+  if (ctx->stage_depth <= 0) return false;
+  Ctx::StagePlane* sp = ctx->stage;
+  if (sp == nullptr) {
+    sp = ctx->stage = new Ctx::StagePlane();
+    sp->depth = ctx->stage_depth;
+  }
+  if (row >= sp->rows) {
+    int32_t nr = sp->rows > 0 ? sp->rows : 4096;
+    while (nr <= row) nr *= 2;
+    // resize appends zeroed slots; row-major [rows, depth] layout means
+    // existing rows keep their offsets
+    sp->vals.resize(static_cast<size_t>(nr) * sp->depth, 0.0f);
+    sp->wts.resize(static_cast<size_t>(nr) * sp->depth, 0.0f);
+    sp->count.resize(nr, 0);
+    sp->rows = nr;
+  }
+  int32_t& c = sp->count[row];
+  if (c >= sp->depth) return false;
+  size_t at = static_cast<size_t>(row) * sp->depth + c;
+  sp->vals[at] = static_cast<float>(value);
+  sp->wts[at] = static_cast<float>(1.0 / sample_rate);
+  ++c;
+  ++sp->total;
+  return true;
+}
+
 bool commit_metric(Ctx* ctx, const Parsed& p, const std::string& joined) {
   std::string_view name = p.name;
   MetricKind kind = p.kind;
@@ -577,9 +627,13 @@ bool commit_metric(Ctx* ctx, const Parsed& p, const std::string& joined) {
       row = ctx->dir.upsert(key_hash, ctx->key, ctx->next_histo_row,
                             &created);
       if (created) ++ctx->next_histo_row;
-      ctx->h_rows.push_back(row);
-      ctx->h_vals.push_back(static_cast<float>(value));
-      ctx->h_wts.push_back(static_cast<float>(1.0 / sample_rate));
+      if (!stage_histo_sample(ctx, row, value, sample_rate)) {
+        // staging disabled, or this row's plane slots are full: spill
+        // into the SoA batch for the direct per-batch device fold
+        ctx->h_rows.push_back(row);
+        ctx->h_vals.push_back(static_cast<float>(value));
+        ctx->h_wts.push_back(static_cast<float>(1.0 / sample_rate));
+      }
       break;
     }
     case KIND_SET: {
@@ -1059,7 +1113,50 @@ void* vn_ctx_new(int hll_precision) {
   return ctx;
 }
 
-void vn_ctx_free(void* p) { delete static_cast<Ctx*>(p); }
+void vn_ctx_free(void* p) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  delete ctx->stage;
+  delete ctx;
+}
+
+// Enable the raw-sample staging plane with B slots per histogram row
+// (0 disables; takes effect for subsequent samples).
+void vn_set_stage_depth(void* p, int depth) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
+  ctx->stage_depth = depth;
+}
+
+// Detach the staging plane for flush: hands ownership of the [rows,
+// depth] vals/wts planes and the per-row counts to the caller and
+// installs a fresh (lazily allocated) plane for the next epoch. Returns
+// an opaque handle to free with vn_stage_free AFTER the caller is done
+// with the pointers, or NULL when nothing is staged.
+void* vn_stage_detach(void* p, float** vals, float** wts, int32_t** counts,
+                      int32_t* rows_out, int32_t* depth_out) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
+  Ctx::StagePlane* sp = ctx->stage;
+  if (sp == nullptr || sp->total == 0) return nullptr;
+  ctx->stage = nullptr;
+  *vals = sp->vals.data();
+  *wts = sp->wts.data();
+  *counts = sp->count.data();
+  *rows_out = sp->rows;
+  *depth_out = sp->depth;
+  return sp;
+}
+
+void vn_stage_free(void* plane) {
+  delete static_cast<Ctx::StagePlane*>(plane);
+}
+
+// Staged-sample count (telemetry / drain-threshold checks).
+long long vn_stage_total(void* p) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
+  return ctx->stage == nullptr ? 0 : ctx->stage->total;
+}
 
 // Switch the set-element hash to metro64(seed=1337) for Go-fleet interop
 // (must match every other inserter of the same set series).
@@ -1273,6 +1370,11 @@ void vn_ctx_reset(void* p) {
   ctx->dir.reset();
   ctx->next_histo_row = ctx->next_set_row = 0;
   ctx->next_counter_row = ctx->next_gauge_row = 0;
+  // drop the staging plane wholesale: rows re-register next epoch and a
+  // fresh plane comes back zeroed (slot validity is gated on wts > 0, so
+  // stale values must never survive a reset)
+  delete ctx->stage;
+  ctx->stage = nullptr;
   ctx->h_rows.clear();
   ctx->h_vals.clear();
   ctx->h_wts.clear();

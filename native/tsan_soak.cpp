@@ -51,6 +51,11 @@ int vn_pending_gauge(void* p);
 void vn_set_lock_stats(int enabled);
 int vn_lock_stats(void* p, long long out[5], long long* wait_out,
                   long long* hold_out);
+void vn_set_stage_depth(void* p, int depth);
+void* vn_stage_detach(void* p, float** vals, float** wts, int32_t** counts,
+                      int32_t* rows_out, int32_t* depth_out);
+void vn_stage_free(void* plane);
+long long vn_stage_total(void* p);
 }
 
 namespace {
@@ -164,8 +169,22 @@ void drain_thread(std::vector<void*>* all_ctxs) {
   std::vector<int8_t> rank(kCap);
   std::vector<char> namebuf(kCap * 64);
   int stroff = 0;
+  long long detaches = 0;
   while (!done.load(std::memory_order_acquire)) {
     for (void* c : *all_ctxs) {
+      // periodic staged-plane detach races the readers' staging stores
+      // (the per-flush handoff under the ctx mutex)
+      float *sv, *sw;
+      int32_t* scnt;
+      int32_t srows, sdepth;
+      void* plane = vn_stage_detach(c, &sv, &sw, &scnt, &srows, &sdepth);
+      if (plane != nullptr) {
+        // read the handed-off memory like the uploader does
+        volatile float probe = sv[0] + sw[0] + (float)scnt[0];
+        (void)probe;
+        ++detaches;
+        vn_stage_free(plane);
+      }
       vn_drain_histo(c, rows.data(), vals.data(), wts.data(), kCap);
       vn_drain_set(c, rows.data(), idx.data(), rank.data(), kCap);
       vn_drain_counter(c, rows.data(), dvals.data(), kCap);
@@ -211,7 +230,13 @@ void upsert_thread(std::vector<void*>* ctxs) {
 int main() {
   vn_set_lock_stats(1);
   std::vector<void*> shard_ctxs;
-  for (int i = 0; i < kShards; ++i) shard_ctxs.push_back(vn_ctx_new(12));
+  for (int i = 0; i < kShards; ++i) {
+    void* c = vn_ctx_new(12);
+    // small depth so both the staging store AND the full-row spill path
+    // run under the sanitizer
+    vn_set_stage_depth(c, 8);
+    shard_ctxs.push_back(c);
+  }
   void* ssf_ctx = vn_ctx_new(12);
   std::vector<void*> all_ctxs = shard_ctxs;
   all_ctxs.push_back(ssf_ctx);

@@ -420,9 +420,12 @@ class SwappedEpoch:
     staged_sets: object
     umts: Optional[np.ndarray]
     mesh_out: Optional[dict]
-    # host [S, B] raw-sample staging planes (vals, wts) still unfolded at
-    # swap; extract_snapshot folds them into `histo` off the ingest lock
-    staged_histo: Optional[tuple] = None
+    # raw-sample staging planes still unfolded at swap, each a
+    # (vals[S, B], wts[S, B], free_or_None) tuple — the Python plane
+    # and/or the detached native C++ plane (whose memory `free` releases
+    # once uploaded); extract_snapshot folds them into `histo` off the
+    # ingest lock
+    staged_histo: Optional[list] = None
 
 
 class DeviceWorker:
@@ -489,6 +492,13 @@ class DeviceWorker:
         scalar aggregates (.min/.max of mixed-scope rows emitted by
         locals) are not tracked on the mesh path."""
         self._mesh_pool = pool
+        if self._native is not None:
+            # staging would divert samples from the mesh pool: mesh rows
+            # route through add_samples_bulk, not the staged fold
+            try:
+                self._native.set_stage_depth(0)
+            except AttributeError:
+                pass
 
     @property
     def processed(self) -> int:
@@ -513,9 +523,9 @@ class DeviceWorker:
 
     def attach_native(self) -> bool:
         """Attach the C++ ingest pipeline (native/dogstatsd.cpp): parsing,
-        tag normalization, and row assignment move off the Python path;
-        this worker's Python-side paths (SSF-derived metrics, imports)
-        share the native directory through upsert."""
+        tag normalization, row assignment AND raw-sample staging move off
+        the Python path; this worker's Python-side paths (SSF-derived
+        metrics, imports) share the native directory through upsert."""
         try:
             from veneur_tpu.native import NativeIngest
 
@@ -523,6 +533,11 @@ class DeviceWorker:
                                         set_hash=self.set_hash)
         except (RuntimeError, OSError):
             return False
+        if self._mesh_pool is None and self.stage_depth > 0:
+            try:
+                self._native.set_stage_depth(self.stage_depth)
+            except AttributeError:  # stale .so without the staging API
+                pass
         return True
 
     def ingest_datagram(self, datagram: bytes) -> int:
@@ -609,13 +624,17 @@ class DeviceWorker:
             self._native.unlock()
         self._apply_native_raw(raw)
 
-    def _drain_native_raw(self):
+    def _drain_native_raw(self, detach_stage: bool = False):
         """Pull raw sample buffers + bookkeeping out of the C++ context.
         Caller holds the context lock. Samples drain BEFORE the new-series
         sync: a sample's series record is committed at-or-before the
         sample itself (same C++ critical section), so syncing afterwards
         can only over-adopt rows with no samples yet — never leave a
-        drained sample without directory metadata."""
+        drained sample without directory metadata.
+
+        detach_stage (flush only): also detach the C++ staging plane —
+        must happen in the same critical section as the epoch close so no
+        staged sample is destroyed by the reset."""
         errs = int(self._native.errors)
         self.parse_errors += errs - self._native_errs_seen
         self._native_errs_seen = errs
@@ -625,19 +644,34 @@ class DeviceWorker:
         s = self._native.drain_set(n) if n else None
         c = self._native.drain_counter(1 << 22)
         g = self._native.drain_gauge(1 << 22)
+        st = None
+        if detach_stage:
+            try:
+                st = self._native.detach_stage()
+            except AttributeError:  # stale .so without the staging API
+                st = None
         self._sync_native_series()
-        return h, s, c, g
+        return h, s, c, g, st
 
     def _apply_native_raw(self, raw) -> None:
         """Apply drained buffers to device/host pools (no context lock —
-        device dispatch must not stall reader commits)."""
-        h, s, c, g = raw
+        device dispatch must not stall reader commits). The detached
+        staging plane (raw[4], flush only) is the caller's to hand to the
+        swapped epoch."""
+        h, s, c, g, _st = raw
         if h is not None and len(h[0]):
             if self._mesh_pool is not None:
                 self._mesh_pool.add_samples_bulk(*h)
             else:
                 self._ensure_histo(self.directory.num_histo_rows)
-                self._device_histo_step(*h)
+                if self._native is not None and self.stage_depth > 0:
+                    # with native staging on, the SoA batch holds only
+                    # hot-row spill: fold it directly (K is small there;
+                    # re-staging it in the Python plane would just add a
+                    # second fold)
+                    self._fold_batch_direct(*h)
+                else:
+                    self._device_histo_step(*h)
         if s is not None and len(s[0]):
             self._ensure_sets(self.directory.num_set_rows)
             self._device_set_step(*s)
@@ -1232,13 +1266,16 @@ class DeviceWorker:
         under the lock. The overlap-critical 1M-series local path never
         takes it.
         """
+        native_stage = None
         if self._native is not None:
-            # drain and close the native epoch under one lock hold: a
-            # routed commit can otherwise land between the last drain and
-            # the reset and be destroyed with the old epoch
+            # drain, detach the staging plane, and close the native epoch
+            # under one lock hold: a routed commit can otherwise land
+            # between the last drain and the reset and be destroyed with
+            # the old epoch
             self._native.lock()
             try:
-                raw = self._drain_native_raw()
+                raw = self._drain_native_raw(detach_stage=True)
+                native_stage = raw[4]
                 self._native.reset()
                 self._native_errs_seen = 0
                 self._native_proc_seen = 0
@@ -1246,6 +1283,25 @@ class DeviceWorker:
             finally:
                 self._native.unlock()
             self._apply_native_raw(raw)
+            if native_stage is not None and self._mesh_pool is not None:
+                # samples staged before attach_mesh_pool() disabled
+                # staging belong to the mesh shards, not the local fold
+                # (extract would overwrite the local output with mesh_out,
+                # silently dropping them)
+                sv, sw, counts, free = native_stage
+                mask = (np.arange(sv.shape[1])[None, :]
+                        < counts[:, None])
+                rows = np.repeat(
+                    np.arange(len(counts), dtype=np.int32),
+                    np.minimum(counts, sv.shape[1]))
+                vals, wts = sv[mask], sw[mask]  # copies; plane can go
+                free()
+                native_stage = None
+                self._mesh_pool.add_samples_bulk(rows, vals, wts)
+            if native_stage is not None:
+                # all samples may be staged: the device pool must still
+                # exist for the fold to land in
+                self._ensure_histo(self.directory.num_histo_rows)
         self._flush_pending_histos()
         self._flush_pending_sets()
         self._merge_imports()
@@ -1256,12 +1312,16 @@ class DeviceWorker:
                 quantiles, self.directory.num_histo_rows)
             self._mesh_pool.reset()
 
-        staged_histo = None
+        staged_histo = []
         if self._stage_count is not None and self._stage_count.any():
             # hand the host staging planes to the closed epoch; the fold
             # into the digest runs in extract_snapshot, OFF the ingest lock
             self._ensure_stage()  # pool may have grown since the last stage
-            staged_histo = (self._stage_vals, self._stage_wts)
+            staged_histo.append((self._stage_vals, self._stage_wts, None))
+        if native_stage is not None:
+            sv, sw, _counts, free = native_stage
+            staged_histo.append((sv, sw, free))
+        staged_histo = staged_histo or None
         swapped = SwappedEpoch(
             directory=self.directory, scalars=self.scalars,
             histo=self._histo, sets=self._sets,
@@ -1302,13 +1362,34 @@ class DeviceWorker:
                           histo.lmin, histo.lmax, histo.lsum, histo.lsum_c,
                           histo.lweight, histo.lweight_c, histo.lrecip,
                           histo.lrecip_c))
-            if swapped.staged_histo is not None:
-                sv, sw = swapped.staged_histo
+            for sv, sw, free in (swapped.staged_histo or ()):
+                if free is not None:
+                    # the numpy views alias C++ plane memory. copy=True is
+                    # load-bearing: on the CPU backend device_put ZERO-
+                    # COPIES aligned numpy arrays, so freeing the plane
+                    # under an aliasing buffer is a use-after-free (bitten
+                    # in round 4 — garbage quantiles under heap churn).
+                    svj = jnp.array(sv[:s_eff], copy=True)
+                    swj = jnp.array(sw[:s_eff], copy=True)
+                    svj.block_until_ready()
+                    swj.block_until_ready()
+                    free()
+                else:
+                    svj = jnp.asarray(sv[:s_eff])
+                    swj = jnp.asarray(sw[:s_eff])
+                if svj.shape[0] < s_eff:
+                    # the native plane grows by its own pow2 schedule and
+                    # can trail the pool's: pad on device (rows past the
+                    # plane's end hold no staged data by construction)
+                    pad = s_eff - svj.shape[0]
+                    svj = jnp.concatenate(
+                        [svj, jnp.zeros((pad, svj.shape[1]), jnp.float32)])
+                    swj = jnp.concatenate(
+                        [swj, jnp.zeros((pad, swj.shape[1]), jnp.float32)])
                 fields = _histo_fold_staged(
-                    *fields, jnp.asarray(sv[:s_eff]), jnp.asarray(sw[:s_eff]),
-                    compression=self.compression,
+                    *fields, svj, swj, compression=self.compression,
                 )
-                swapped.staged_histo = None
+            swapped.staged_histo = None
             qs = jnp.asarray(np.asarray(quantiles, dtype=np.float32))
             out = self._extract(fields, qs)
             (qv, dmin, dmax, dsum, dcount, drecip,

@@ -193,6 +193,22 @@ def load_library() -> Optional[ctypes.CDLL]:
             c.c_void_p, c.c_char_p, c.c_longlong, c.c_char_p, c.c_int,
             c.c_char_p, c.c_int, c.c_double, c.POINTER(c.c_int),
             c.c_void_p, c.c_void_p, c.c_int, c.POINTER(c.c_int)]
+        try:
+            # optional: a stale prebuilt .so may predate the staging API;
+            # callers degrade to the SoA drain path (worker guards the
+            # AttributeError raised at call time)
+            lib.vn_set_stage_depth.argtypes = [c.c_void_p, c.c_int]
+            lib.vn_stage_detach.restype = c.c_void_p
+            lib.vn_stage_detach.argtypes = [
+                c.c_void_p, c.POINTER(c.POINTER(c.c_float)),
+                c.POINTER(c.POINTER(c.c_float)),
+                c.POINTER(c.POINTER(c.c_int32)),
+                c.POINTER(c.c_int32), c.POINTER(c.c_int32)]
+            lib.vn_stage_free.argtypes = [c.c_void_p]
+            lib.vn_stage_total.restype = c.c_longlong
+            lib.vn_stage_total.argtypes = [c.c_void_p]
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -266,6 +282,47 @@ class NativeIngest:
                 self._lib.vn_num_set_rows(self._ctx),
                 self._lib.vn_num_counter_rows(self._ctx),
                 self._lib.vn_num_gauge_rows(self._ctx))
+
+    # staging plane ----------------------------------------------------------
+
+    def set_stage_depth(self, depth: int) -> None:
+        """Enable the C++ raw-sample staging plane with B slots per
+        histogram row (0 disables). Staged samples bypass the per-batch
+        SoA drain entirely; detach_stage() pulls the whole plane at
+        flush."""
+        self._lib.vn_set_stage_depth(self._ctx, depth)
+
+    @property
+    def stage_total(self) -> int:
+        return int(self._lib.vn_stage_total(self._ctx))
+
+    def detach_stage(self):
+        """Detach the staged plane: returns (vals[rows, depth],
+        wts[rows, depth], counts[rows], free) — the numpy arrays alias
+        C++ memory owned by the detached plane; call free() only after
+        the data has been uploaded/copied. None when nothing is staged.
+        A fresh zeroed plane takes over for subsequent samples."""
+        c = ctypes
+        pv = c.POINTER(c.c_float)()
+        pw = c.POINTER(c.c_float)()
+        pc = c.POINTER(c.c_int32)()
+        rows = c.c_int32()
+        depth = c.c_int32()
+        handle = self._lib.vn_stage_detach(
+            self._ctx, c.byref(pv), c.byref(pw), c.byref(pc),
+            c.byref(rows), c.byref(depth))
+        if not handle:
+            return None
+        r, d = rows.value, depth.value
+        vals = np.ctypeslib.as_array(pv, shape=(r, d))
+        wts = np.ctypeslib.as_array(pw, shape=(r, d))
+        counts = np.ctypeslib.as_array(pc, shape=(r,))
+        lib = self._lib
+
+        def free(_h=handle, _lib=lib):
+            _lib.vn_stage_free(_h)
+
+        return vals, wts, counts, free
 
     # drains -----------------------------------------------------------------
 
