@@ -137,34 +137,28 @@ bench("_compress_rows 1M series", compress_1m, pool2.means, pool2.weights)
 bench("quantile x3 1M series", quant, pool2.means, pool2.weights,
       pool2.min, pool2.max, qs)
 
-# A/B: XLA scan stack vs the fused two-pass Pallas scan kernel
-# (ops/pallas_scan.py). The flag is read at trace time, so each variant
-# gets its own freshly-traced jit wrapper around the unjitted body.
-os.environ["VENEUR_FUSED_SCANS"] = "0"
+# The product's round-4 hot path: one staged-plane fold per interval
+# (core/worker._histo_fold_staged). add_batch above remains the spill /
+# import-merge path. (The fused Pallas scan kernel that used to be A/B'd
+# here was deleted with the staged redesign — see _prefix_scans_xla's
+# docstring in ops/tdigest.py.)
+from veneur_tpu.core.worker import _histo_fold_staged  # noqa: E402
+
+B = 64
+sv = jnp.asarray(rng.gamma(2.0, 50.0, (S, B)).astype(np.float32))
+sw_plane = jnp.asarray(np.ones((S, B), np.float32))
 
 
-@jax.jit
-def full_xla_scans(pool, rows, vals, wts):
-    return td.add_batch.__wrapped__(
-        pool.means, pool.weights, pool.min, pool.max, pool.recip,
-        rows, vals, wts)
+def staged_fold(pool, sv, sw_plane):
+    def _full(v):
+        return jnp.full((S,), v, jnp.float32)
+
+    return _histo_fold_staged(
+        jnp.array(pool.means), jnp.array(pool.weights),
+        jnp.array(pool.min), jnp.array(pool.max), jnp.array(pool.recip),
+        _full(0.0), _full(np.inf), _full(-np.inf), _full(0.0), _full(0.0),
+        _full(0.0), _full(0.0), _full(0.0), _full(0.0), sv, sw_plane)
 
 
-bench("add_batch (xla scans)", full_xla_scans, pool, rows, vals, wts)
-os.environ["VENEUR_FUSED_SCANS"] = "1"
-
-
-@jax.jit
-def full_fused_scans(pool, rows, vals, wts):
-    return td.add_batch.__wrapped__(
-        pool.means, pool.weights, pool.min, pool.max, pool.recip,
-        rows, vals, wts)
-
-
-try:
-    bench("add_batch (fused scans)", full_fused_scans, pool, rows, vals,
-          wts)
-except Exception as e:  # pragma: no cover - TPU-only path
-    print(f"add_batch (fused scans) failed: {e}")
-finally:
-    del os.environ["VENEUR_FUSED_SCANS"]
+bench(f"staged fold [S={S}, B={B}] (={S * B} samples)", staged_fold,
+      pool, sv, sw_plane)

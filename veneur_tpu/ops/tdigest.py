@@ -49,19 +49,19 @@ import numpy as np
 from veneur_tpu.ops import segments
 
 
-def _use_fused_scans() -> bool:
-    """The ingest prefix scans can run as the fused two-pass Pallas
-    kernel (ops/pallas_scan.py) instead of the XLA scan stack.
-    Opt-in (VENEUR_FUSED_SCANS=1, read at trace time) until the on-chip
-    A/B (tools/profile_ingest.py) validates compile + win on real TPU —
-    a trace-time kernel failure here would break every flush."""
-    env = os.environ.get("VENEUR_FUSED_SCANS", "").strip()
-    return bool(env) and env not in ("0", "false", "no")
-
-
 def _prefix_scans_xla(srows, svals, sw, n):
     """The XLA scan stack: three prefix sums + forward/backward
-    segmented sums (see add_batch for what each feeds)."""
+    segmented sums (see add_batch for what each feeds).
+
+    RESOLVED (round 4): a fused two-pass Pallas kernel for these five
+    scans (ops/pallas_scan.py, gated behind VENEUR_FUSED_SCANS) was
+    deleted rather than enabled. The staged-ingest redesign
+    (core/worker._histo_fold_staged) moved add_batch off the hot ingest
+    path — samples stage host-side and the per-interval fold never runs
+    these scans — so the kernel's only remaining callers are the hot-row
+    spill and import merge paths, whose batches are too small for a
+    custom kernel to pay for itself. The Pallas kernel that remains on a
+    hot path is flush_extract (ops/pallas_kernels.py)."""
     zero1 = jnp.zeros((1,), sw.dtype)
     pre_w = jnp.concatenate([zero1, jnp.cumsum(sw)])  # [N+1]
     pre_vw = jnp.concatenate([zero1, jnp.cumsum(svals * sw)])
@@ -74,28 +74,6 @@ def _prefix_scans_xla(srows, svals, sw, n):
     suffix = segments.segmented_cumsum(sw[::-1], row_ends[::-1])[::-1]
     return pre_w, pre_vw, pre_recip, seg_cum, suffix
 
-
-def _prefix_scans_fused(srows, svals, sw, n, interpret: bool = False):
-    """Same five arrays from the two-pass Pallas kernel."""
-    from veneur_tpu.ops import pallas_scan
-
-    pad = (-n) % pallas_scan.LANES
-    if pad:
-        # pad extends the final run with zero weight — harmless to every
-        # scan, and sliced off below
-        srows_p = jnp.concatenate(
-            [srows, jnp.broadcast_to(srows[n - 1], (pad,))])
-        svals_p = jnp.concatenate([svals, jnp.ones((pad,), svals.dtype)])
-        sw_p = jnp.concatenate([sw, jnp.zeros((pad,), sw.dtype)])
-    else:
-        srows_p, svals_p, sw_p = srows, svals, sw
-    cw, cvw, crecip, seg, suffix = pallas_scan.fused_prefix_scans(
-        srows_p, svals_p, sw_p, interpret=interpret)
-    zero1 = jnp.zeros((1,), sw.dtype)
-    pre_w = jnp.concatenate([zero1, cw[:n]])
-    pre_vw = jnp.concatenate([zero1, cvw[:n]])
-    pre_recip = jnp.concatenate([zero1, crecip[:n]])
-    return pre_w, pre_vw, pre_recip, seg[:n], suffix[:n]
 
 DEFAULT_COMPRESSION = 100.0
 # Capacity per row: δ+1 buckets can be produced by the k-function; round up
@@ -280,15 +258,9 @@ def add_batch(
     #        runs in the sorted order, so every per-row reduction is either
     #        a prefix-sum difference at run boundaries or — because values
     #        sort ascending within a row — a boundary gather (min = first
-    #        live element, max = last). All five scans over the sorted
-    #        stream come from one fused two-pass Pallas kernel on TPU
-    #        (ops/pallas_scan.py), the XLA scan stack elsewhere.
-    if _use_fused_scans():
-        pre_w, pre_vw, pre_recip, seg_cum, suffix = _prefix_scans_fused(
-            srows, svals, sw, n)
-    else:
-        pre_w, pre_vw, pre_recip, seg_cum, suffix = _prefix_scans_xla(
-            srows, svals, sw, n)
+    #        live element, max = last).
+    pre_w, pre_vw, pre_recip, seg_cum, suffix = _prefix_scans_xla(
+        srows, svals, sw, n)
 
     kbins = jnp.arange(k, dtype=jnp.int32)
     row_upper = jnp.searchsorted(srows, kbins, side="right").astype(jnp.int32)
