@@ -708,3 +708,66 @@ def test_drain_new_series_survives_full_string_buffer():
     assert records[0][4] == "long.series.0"
     assert records[-1][4] == f"long.series.{n - 1}"
     assert records[0][5] == long_tag
+
+
+# -- raw-sample staging plane (vn_set_stage_depth / vn_stage_detach) --------
+
+
+def test_native_staging_plane_detach():
+    """Staged samples land in the [rows, depth] plane in commit order;
+    detach hands the plane over and installs a fresh one."""
+    ni = native_mod.NativeIngest()
+    ni.set_stage_depth(4)
+    ni.ingest(b"st.a:1|ms\nst.a:2|ms\nst.b:7|ms|@0.5")
+    assert ni.stage_total == 3
+    assert ni.pending_histo == 0  # nothing spilled
+    st = ni.detach_stage()
+    assert st is not None
+    vals, wts, counts, free = st
+    try:
+        assert vals.shape == wts.shape and vals.shape[1] == 4
+        assert counts[0] == 2 and counts[1] == 1
+        assert vals[0, 0] == 1.0 and vals[0, 1] == 2.0
+        assert wts[0, 0] == 1.0
+        assert vals[1, 0] == 7.0 and wts[1, 0] == 2.0  # 1/0.5
+        assert wts[0, 2] == 0.0  # unused slot stays zero-weight
+    finally:
+        free()
+    # fresh plane: nothing staged until new samples arrive
+    assert ni.stage_total == 0
+    assert ni.detach_stage() is None
+    ni.ingest(b"st.a:9|ms")
+    assert ni.stage_total == 1
+
+
+def test_native_staging_spills_past_depth():
+    """Slots past the depth spill into the SoA batch (the direct-fold
+    path) — no sample is dropped either side."""
+    ni = native_mod.NativeIngest()
+    ni.set_stage_depth(2)
+    for v in range(5):
+        ni.ingest(b"sp.hot:%d|ms" % v)
+    assert ni.stage_total == 2
+    assert ni.pending_histo == 3
+    rows, vals, wts = ni.drain_histo(16)
+    assert list(vals) == [2.0, 3.0, 4.0]
+    st = ni.detach_stage()
+    vals2, _wts2, counts, free = st
+    try:
+        assert counts[0] == 2 and vals2[0, 0] == 0.0 and vals2[0, 1] == 1.0
+    finally:
+        free()
+
+
+def test_native_staging_reset_drops_plane():
+    """vn_ctx_reset must not leak staged samples into the next epoch."""
+    ni = native_mod.NativeIngest()
+    ni.set_stage_depth(4)
+    ni.ingest(b"rs.x:3|ms")
+    assert ni.stage_total == 1
+    ni.reset()
+    assert ni.stage_total == 0
+    assert ni.detach_stage() is None
+    # staging stays enabled across epochs
+    ni.ingest(b"rs.x:5|ms")
+    assert ni.stage_total == 1

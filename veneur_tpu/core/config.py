@@ -148,6 +148,11 @@ class Config:
     tpu_set_store: str = "staged"
     tpu_initial_histo_rows: int = 4096
     tpu_initial_set_rows: int = 512
+    # persistent XLA compilation cache: first compile of each flush/fold
+    # program shape costs ~20-40s on TPU; with a cache dir set, restarts
+    # (watchdog, fd-handoff upgrades) reuse compiled programs instead of
+    # re-paying it. Empty = disabled.
+    tpu_compilation_cache_dir: str = ""
 
     # self-telemetry & debugging
     debug: bool = False

@@ -89,6 +89,14 @@ class Server:
                  ) -> None:
         self.config = cfg
         self.interval = cfg.interval_seconds()
+        if cfg.tpu_compilation_cache_dir:
+            # restarts (watchdog, fd-handoff upgrade) reuse compiled
+            # flush/fold programs instead of re-paying the 20-40s
+            # first-compile per shape on TPU
+            import jax as _jax
+
+            _jax.config.update("jax_compilation_cache_dir",
+                               cfg.tpu_compilation_cache_dir)
         self.hostname = cfg.hostname or (
             "" if cfg.omit_empty_hostname else socket.gethostname())
         self.tags = list(cfg.tags)
@@ -935,6 +943,10 @@ class Server:
                 self.stats.count("worker.metrics_imported_total",
                                  worker.imported, tags=[f"worker:{i}"])
                 swapped.append(worker.swap(qs))
+                n_staged = getattr(worker, "staged_samples_swapped", 0)
+                if n_staged:
+                    self.stats.count("worker.samples_staged_total",
+                                     n_staged, tags=[f"worker:{i}"])
         phases["swap_s"] = time.perf_counter() - _t
         _t = time.perf_counter()
         snaps: list[FlushSnapshot] = []

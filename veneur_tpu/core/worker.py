@@ -1312,8 +1312,12 @@ class DeviceWorker:
                 quantiles, self.directory.num_histo_rows)
             self._mesh_pool.reset()
 
+        staged = 0
+        if native_stage is not None:
+            staged += int(native_stage[2].sum())
         staged_histo = []
         if self._stage_count is not None and self._stage_count.any():
+            staged += int(self._stage_count.sum())
             # hand the host staging planes to the closed epoch; the fold
             # into the digest runs in extract_snapshot, OFF the ingest lock
             self._ensure_stage()  # pool may have grown since the last stage
@@ -1322,6 +1326,8 @@ class DeviceWorker:
             sv, sw, _counts, free = native_stage
             staged_histo.append((sv, sw, free))
         staged_histo = staged_histo or None
+        # flush self-telemetry (veneur.worker.samples_staged_total)
+        self.staged_samples_swapped = staged
         swapped = SwappedEpoch(
             directory=self.directory, scalars=self.scalars,
             histo=self._histo, sets=self._sets,
