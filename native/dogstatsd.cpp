@@ -14,6 +14,11 @@
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this environment).
 
+#include <errno.h>
+#include <fcntl.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+
 #include <algorithm>
 #include <atomic>
 #include <cctype>
@@ -27,6 +32,7 @@
 #include <mutex>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -1490,6 +1496,89 @@ int vn_ingest_routed(void** ctxps, int nctx, const char* buf, int len) {
     target->mu.unlock();
   }
   return accepted;
+}
+
+// ---------------------------------------------------------------------------
+// Native UDP reader: a C++ thread owning the recv loop — datagram to
+// staged sample with no Python (and no GIL) anywhere on the path. The
+// Python reference loop is Server._read_metric_socket (the reference's
+// ReadMetricSocket, server.go:1123); this replaces it when
+// tpu_native_readers is on. Stop leaves the fd OPEN so queued datagrams
+// survive an fd-handoff restart, mirroring the quiesce semantics.
+
+namespace {
+
+struct Reader {
+  std::thread th;
+  std::atomic<bool> stop{false};
+  std::atomic<long long> packets{0};
+  int fd = -1;
+  int max_len = 0;
+  std::vector<Ctx*> ctxs;
+};
+
+void reader_loop(Reader* r) {
+  std::vector<char> buf(static_cast<size_t>(r->max_len) + 1);
+  while (!r->stop.load(std::memory_order_acquire)) {
+    ssize_t n = recv(r->fd, buf.data(), buf.size(), 0);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+        continue;  // SO_RCVTIMEO tick: poll the stop flag
+      break;  // fd closed under us (shutdown)
+    }
+    r->packets.fetch_add(1, std::memory_order_relaxed);
+    if (n > r->max_len) {
+      std::lock_guard<std::recursive_mutex> g(r->ctxs[0]->mu);
+      ++r->ctxs[0]->errors;
+      continue;
+    }
+    vn_ingest_routed(reinterpret_cast<void**>(r->ctxs.data()),
+                     static_cast<int>(r->ctxs.size()), buf.data(),
+                     static_cast<int>(n));
+  }
+}
+
+}  // namespace
+
+// Start a reader thread on an already-bound datagram fd. The fd is
+// switched to blocking with a 500ms SO_RCVTIMEO so the stop flag is
+// polled; ownership of the fd stays with the caller. Returns NULL if
+// the timeout cannot be applied — a reader whose recv never times out
+// could not be stopped, and would hang shutdown/handoff in join().
+void* vn_reader_start(void** ctxps, int nctx, int fd, int max_len) {
+  int fl = fcntl(fd, F_GETFL);
+  if (fl < 0) return nullptr;
+  if ((fl & O_NONBLOCK) && fcntl(fd, F_SETFL, fl & ~O_NONBLOCK) < 0)
+    return nullptr;
+  struct timeval tv;
+  tv.tv_sec = 0;
+  tv.tv_usec = 500000;
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv) != 0)
+    return nullptr;
+  Reader* r = new Reader();
+  r->fd = fd;
+  r->max_len = max_len;
+  for (int i = 0; i < nctx; ++i)
+    r->ctxs.push_back(static_cast<Ctx*>(ctxps[i]));
+  r->th = std::thread(reader_loop, r);
+  return r;
+}
+
+long long vn_reader_packets(void* p) {
+  return static_cast<Reader*>(p)->packets.load(std::memory_order_relaxed);
+}
+
+// Stop and join the reader, then free it. Does NOT close the fd.
+// Returns the FINAL packet count, read after the join — the thread
+// keeps ingesting for up to one SO_RCVTIMEO tick after the stop flag
+// is set, and a count snapshotted before the join would lose those.
+long long vn_reader_stop(void* p) {
+  Reader* r = static_cast<Reader*>(p);
+  r->stop.store(true, std::memory_order_release);
+  if (r->th.joinable()) r->th.join();
+  long long final_count = r->packets.load(std::memory_order_relaxed);
+  delete r;
+  return final_count;
 }
 
 // Enable/disable commit-path lock timing (global; affects all contexts).

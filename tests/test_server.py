@@ -726,3 +726,31 @@ def test_flush_is_self_traced():
         assert span.end_timestamp > span.start_timestamp
     finally:
         srv.shutdown()
+
+
+@pytest.mark.parametrize("native_readers", [True, False])
+def test_udp_reader_modes_equivalent(native_readers):
+    """The C++ reader thread (vn_reader_start) and the Python recv loop
+    deliver identical flush results — and the Python path stays covered
+    now that native readers are the default."""
+    srv, sink, ports = _server(tpu_native_readers=native_readers)
+    try:
+        if native_readers:
+            assert srv.native_mode  # readers only exist in native mode
+            assert srv._native_readers, "native reader thread not started"
+        port = next(iter(ports.values()))
+        for v in range(1, 51):
+            _send_udp(port, b"rm.t:%d|ms" % v)
+        _send_udp(port, b"rm.c:2|c\nrm.c:3|c")
+        _send_udp(port, b"x" * 5000)  # overlong: counted, dropped
+        assert _wait_for(lambda: srv.packets_received >= 52)
+        assert _wait_for(lambda: srv.parse_errors >= 1)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("rm.c", MetricType.COUNTER)].value == 5.0
+        assert by_key[("rm.t.count", MetricType.COUNTER)].value == 50.0
+        assert by_key[("rm.t.max", MetricType.GAUGE)].value == 50.0
+    finally:
+        srv.shutdown()
+        # counters survive reader stop (folded into the stopped tally)
+        assert srv.packets_received >= 52

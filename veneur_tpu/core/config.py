@@ -132,6 +132,10 @@ class Config:
     tpu_mesh_devices: int = 0
     tpu_mesh_hosts: int = 0  # 0 = auto (2 when the device count is even)
     tpu_native_ingest: bool = True
+    # C++ reader threads own the UDP recv loop (datagram -> parse ->
+    # staged sample, no Python/GIL on the path); requires
+    # tpu_native_ingest. Python readers remain for TCP/TLS/unixgram/SSF.
+    tpu_native_readers: bool = True
     tpu_batch_size: int = 16384
     # raw-sample staging slots per histogram row: ingest stores samples
     # into a host [rows, depth] plane and the digest compress runs once

@@ -236,6 +236,11 @@ class Server:
         self.native_mode = False
         self._native_router = None
         self._native_ingest_tick = 0
+        # C++ reader-thread handles (vn_reader_start) + their retained
+        # packet counts after stop (the handle dies with the thread)
+        self._native_readers: list = []
+        self._native_reader_packets_stopped = 0
+        self._native_reader_lock = threading.Lock()
         if cfg.tpu_native_ingest:
             self.native_mode = all(w.attach_native() for w in self.workers)
             if self.native_mode:
@@ -322,11 +327,27 @@ class Server:
 
     @property
     def packets_received(self) -> int:
-        return self._ctr_sum(0)
+        n = self._ctr_sum(0) + self._native_reader_packets_stopped
+        router = self._native_router
+        if router is not None:
+            with self._native_reader_lock:
+                for h in self._native_readers:
+                    n += router.reader_packets(h)
+        return n
 
     @property
     def parse_errors(self) -> int:
-        return self._ctr_sum(1)
+        """Total parse/overlong errors: Python-side cells, each worker's
+        drained-and-attributed count, and the not-yet-drained native
+        delta. Monotonic — a drain only MOVES the native delta into the
+        worker's cumulative count (reset per process, not per epoch)."""
+        n = self._ctr_sum(1)
+        for w in self.workers:
+            n += getattr(w, "parse_errors", 0)
+            native = getattr(w, "_native", None)
+            if native is not None:
+                n += int(native.errors) - w._native_errs_seen
+        return n
 
     def _bump_errors(self, n: int = 1) -> None:
         self._ctr_cell()[1] += n
@@ -356,21 +377,34 @@ class Server:
             # flush.
             self._native_ingest_tick += 1
             if self._native_ingest_tick % 64 == 0:
-                for i, w in enumerate(self.workers):
-                    if (w._native.pending_histo >= w.batch_size
-                            or w._native.pending_set >= w.batch_size):
-                        with self._worker_locks[i]:
-                            w.drain_native()
+                self._drain_native_thresholds()
             # events and service checks come back for the Python parser
             if b"_e{" in datagram or b"_sc" in datagram:
-                with self._worker_locks[0]:
-                    others = self.workers[0]._native.drain_other()
-                for line in others:
-                    self.handle_metric_packet(line)
+                self._drain_native_events()
             return
         for line in datagram.split(b"\n"):
             if line:
                 self.handle_metric_packet(line)
+
+    def _drain_native_thresholds(self) -> None:
+        """Drain any worker whose native SoA spill/set/scalar batches
+        crossed batch_size (shared by the strided ingest check and the
+        native-reader pump)."""
+        for i, w in enumerate(self.workers):
+            if (w._native.pending_histo >= w.batch_size
+                    or w._native.pending_set >= w.batch_size):
+                with self._worker_locks[i]:
+                    w.drain_native()
+
+    def _drain_native_events(self) -> None:
+        """Pull buffered event/service-check lines out of the C++ context
+        and parse them on the Python path. MUST NOT be called while
+        holding a worker lock — the parsed lines re-enter _route, which
+        takes them."""
+        with self._worker_locks[0]:
+            others = self.workers[0]._native.drain_other()
+        for line in others:
+            self.handle_metric_packet(line)
 
     # -- SSF ingest ---------------------------------------------------------
 
@@ -622,11 +656,63 @@ class Server:
                 sock.bind((addr, bound_port))
             bound_port = sock.getsockname()[1]  # resolve port 0 once
             self._sockets.append(sock)
+            if self.native_mode and self.config.tpu_native_readers:
+                # C++ recv loop: datagram → parse → staged sample with no
+                # Python (or GIL) on the path. The Python socket object
+                # stays in self._sockets so the fd outlives the thread
+                # (handoff keeps it open for the successor).
+                try:
+                    sock.setblocking(True)
+                    h = self._native_router.start_reader(
+                        sock.fileno(), self.config.metric_max_length)
+                    self._native_readers.append(h)
+                    self._start_native_pump()
+                    continue
+                except (AttributeError, RuntimeError) as e:
+                    log.warning("native reader unavailable (%s); using the"
+                                " Python reader", e)
             self._spawn(
                 lambda s=sock: self._read_metric_socket(s),
                 f"statsd-udp-{i}",
             )
         return bound_port
+
+    def _start_native_pump(self) -> None:
+        """With C++ readers, no Python code sees datagrams — this thread
+        takes over the strided duties of process_metric_packet: threshold
+        drains of the spill/set/scalar SoA batches and the event/service-
+        check handback (both also run at every flush)."""
+        if getattr(self, "_native_pump_started", False):
+            return
+        self._native_pump_started = True
+
+        def pump() -> None:
+            while not (self._shutdown.is_set() or self._quiesce.is_set()):
+                time.sleep(0.1)
+                try:
+                    self._drain_native_thresholds()
+                    self._drain_native_events()
+                except Exception:
+                    if self._shutdown.is_set():
+                        return
+                    raise
+
+        self._spawn(pump, "native-pump")
+
+    def _stop_native_readers(self) -> None:
+        """Join the C++ reader threads WITHOUT closing their fds (handoff
+        leaves queued datagrams for the successor). Idempotent."""
+        with self._native_reader_lock:
+            readers, self._native_readers = self._native_readers, []
+            for h in readers:
+                try:
+                    # stop_reader returns the FINAL count (post-join);
+                    # reading before the join would lose the packets of
+                    # the thread's last recv-timeout window
+                    self._native_reader_packets_stopped += (
+                        self._native_router.stop_reader(h))
+                except Exception:
+                    log.exception("native reader stop failed")
 
     def _read_metric_socket(self, sock: socket.socket,
                             handoff_capable: bool = True) -> None:
@@ -807,6 +893,7 @@ class Server:
         # sockets) so datagrams queue in kernel buffers and TCP
         # connections wait in the listen backlog for the successor
         self._quiesce.set()
+        self._stop_native_readers()  # joins; fds stay open for handoff
         deadline = time.time() + 2.0
         for t in self._threads:
             if t.name.startswith(("statsd-udp", "ssf-udp",
@@ -903,6 +990,15 @@ class Server:
         self.last_flush_phases = phases
         _t = time.perf_counter()
 
+        if self.native_mode:
+            # events/service checks buffered in C++ (native readers have
+            # no Python on the datagram path; the pump drains every 100ms
+            # but this flush must see everything received before it).
+            # Lines landing AFTER this drain are caught at epoch close —
+            # worker.swap drains other_lines in the same critical section
+            # as the context reset — and parsed into the next epoch below.
+            self._drain_native_events()
+
         other_samples = self.event_worker.flush()
         for sink in self.metric_sinks:
             try:
@@ -947,6 +1043,15 @@ class Server:
                 if n_staged:
                     self.stats.count("worker.samples_staged_total",
                                      n_staged, tags=[f"worker:{i}"])
+        # event lines the swap caught at epoch close (would otherwise be
+        # destroyed by the context reset): parse them into the NEW epoch,
+        # OUTSIDE the worker locks — parsing re-enters _route
+        for worker in self.workers:
+            lines = getattr(worker, "pending_other_lines", None)
+            if lines:
+                worker.pending_other_lines = []
+                for line in lines:
+                    self.handle_metric_packet(line)
         phases["swap_s"] = time.perf_counter() - _t
         _t = time.perf_counter()
         snaps: list[FlushSnapshot] = []
@@ -1068,10 +1173,10 @@ class Server:
         for svc, n in span_counts.items():
             self.stats.count("ssf.received_total", n,
                              tags=[f"service:{svc}"])
-        # statsd counters are per-interval increments: report the delta,
-        # covering both the Python parser and the native C++ parser
-        errors_now = self.parse_errors + sum(
-            getattr(w, "parse_errors", 0) for w in self.workers)
+        # statsd counters are per-interval increments: report the delta
+        # (the property already totals the Python cells, the workers'
+        # attributed counts, and the undrained native delta)
+        errors_now = self.parse_errors
         self.stats.count("packet.error_total",
                          errors_now - self._errors_reported)
         self._errors_reported = errors_now
@@ -1225,6 +1330,7 @@ class Server:
             if self._shutdown_done:
                 return
             self._shutdown_done = True
+        self._stop_native_readers()
         if getattr(self, "_profile_dir", None):
             try:
                 import jax.profiler

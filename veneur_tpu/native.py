@@ -207,6 +207,13 @@ def load_library() -> Optional[ctypes.CDLL]:
             lib.vn_stage_free.argtypes = [c.c_void_p]
             lib.vn_stage_total.restype = c.c_longlong
             lib.vn_stage_total.argtypes = [c.c_void_p]
+            lib.vn_reader_start.restype = c.c_void_p
+            lib.vn_reader_start.argtypes = [
+                c.POINTER(c.c_void_p), c.c_int, c.c_int, c.c_int]
+            lib.vn_reader_packets.restype = c.c_longlong
+            lib.vn_reader_packets.argtypes = [c.c_void_p]
+            lib.vn_reader_stop.restype = c.c_longlong
+            lib.vn_reader_stop.argtypes = [c.c_void_p]
         except AttributeError:
             pass
         _lib = lib
@@ -782,6 +789,27 @@ class NativeRouter:
     def ingest(self, datagram: bytes) -> int:
         return self._lib.vn_ingest_routed(
             self._arr, self._n, datagram, len(datagram))
+
+    # native reader threads (C++ recv loop; no Python on the path) -----------
+
+    def start_reader(self, fd: int, max_len: int):
+        """Spawn a C++ reader thread on an already-bound datagram fd.
+        The fd stays owned by the caller (keep the Python socket object
+        alive); stop_reader() joins without closing it, preserving
+        fd-handoff semantics."""
+        h = self._lib.vn_reader_start(self._arr, self._n, fd, max_len)
+        if not h:
+            raise RuntimeError("vn_reader_start failed")
+        return h
+
+    def reader_packets(self, handle) -> int:
+        return int(self._lib.vn_reader_packets(handle))
+
+    def stop_reader(self, handle) -> int:
+        """Join the reader and return its FINAL packet count (the thread
+        keeps ingesting up to one recv-timeout tick after the stop flag;
+        a pre-join snapshot would undercount)."""
+        return int(self._lib.vn_reader_stop(handle))
 
     def set_lock_stats(self, enabled: bool) -> None:
         """Toggle commit-path mutex wait/hold timing (global; ~10-20%
