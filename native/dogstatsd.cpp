@@ -401,6 +401,11 @@ struct Ctx {
   long long ssf_invalid = 0;
   std::unordered_map<std::string, long long> ssf_services;
   std::string ssf_services_out;  // drained lines awaiting pickup
+  // raw SSF payloads the native reader could not ingest (STATUS samples
+  // aboard -> Python path). Bounded; overflow counts into ssf_invalid.
+  std::vector<std::string> ssf_fallback;
+  size_t ssf_fallback_bytes = 0;
+  static constexpr size_t kSsfFallbackCap = 1 << 22;
   uint64_t uniq_rng = 0x9E3779B97F4A7C15ull;
 
   // scratch reused across lines (SSF extraction builds `joined` itself;
@@ -1399,6 +1404,8 @@ void vn_ctx_reset(void* p) {
   ctx->ssf_invalid = 0;
   ctx->ssf_services.clear();
   ctx->ssf_services_out.clear();
+  ctx->ssf_fallback.clear();
+  ctx->ssf_fallback_bytes = 0;
 }
 
 // Ingest a datagram (possibly multiple newline-separated lines).
@@ -1538,6 +1545,55 @@ void reader_loop(Reader* r) {
   }
 }
 
+// SSF datagram reader: one unframed span per datagram, decoded +
+// span->metric extracted in C++. Spans carrying STATUS samples buffer
+// raw for the Python fallback (drained by the pump / epoch close).
+struct SsfReader {
+  std::thread th;
+  std::atomic<bool> stop{false};
+  std::atomic<long long> packets{0};
+  int fd = -1;
+  int max_len = 0;
+  Ctx* ctx = nullptr;
+  std::string ind, obj;
+  double uniq_rate = 0.0;
+};
+
+void ssf_reader_loop(SsfReader* r) {
+  std::vector<char> buf(static_cast<size_t>(r->max_len) + 1);
+  while (!r->stop.load(std::memory_order_acquire)) {
+    ssize_t n = recv(r->fd, buf.data(), buf.size(), 0);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+        continue;
+      break;
+    }
+    r->packets.fetch_add(1, std::memory_order_relaxed);
+    std::lock_guard<std::recursive_mutex> g(r->ctx->mu);
+    if (n == 0 || n > r->max_len) {
+      ++r->ctx->errors;
+      continue;
+    }
+    int rc = ingest_ssf_span(r->ctx, std::string_view(buf.data(), n),
+                             r->ind, r->obj, r->uniq_rate);
+    if (rc == 1) {
+      // one accepted span = one processed unit, matching the Python
+      // path's worker.ingest_ssf_packet accounting
+      ++r->ctx->processed;
+    } else if (rc == 0) {
+      ++r->ctx->errors;
+    } else if (rc == -1) {
+      Ctx* c = r->ctx;
+      if (c->ssf_fallback_bytes + n > Ctx::kSsfFallbackCap) {
+        ++c->ssf_invalid;  // fallback buffer full: drop, visibly
+      } else {
+        c->ssf_fallback.emplace_back(buf.data(), n);
+        c->ssf_fallback_bytes += n;
+      }
+    }
+  }
+}
+
 }  // namespace
 
 // Start a reader thread on an already-bound datagram fd. The fd is
@@ -1579,6 +1635,66 @@ long long vn_reader_stop(void* p) {
   long long final_count = r->packets.load(std::memory_order_relaxed);
   delete r;
   return final_count;
+}
+
+// SSF variant of vn_reader_start: one unframed span per datagram on the
+// fd, decoded and extracted in C++; STATUS spans buffer for the Python
+// fallback (vn_drain_ssf_fallback). Same stop/timeout contract.
+void* vn_ssf_reader_start(void* ctxp, int fd, int max_len,
+                          const char* ind, int ind_len, const char* obj,
+                          int obj_len, double uniq_rate) {
+  int fl = fcntl(fd, F_GETFL);
+  if (fl < 0) return nullptr;
+  if ((fl & O_NONBLOCK) && fcntl(fd, F_SETFL, fl & ~O_NONBLOCK) < 0)
+    return nullptr;
+  struct timeval tv;
+  tv.tv_sec = 0;
+  tv.tv_usec = 500000;
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv) != 0)
+    return nullptr;
+  SsfReader* r = new SsfReader();
+  r->fd = fd;
+  r->max_len = max_len;
+  r->ctx = static_cast<Ctx*>(ctxp);
+  r->ind.assign(ind, static_cast<size_t>(ind_len));
+  r->obj.assign(obj, static_cast<size_t>(obj_len));
+  r->uniq_rate = uniq_rate;
+  r->th = std::thread(ssf_reader_loop, r);
+  return r;
+}
+
+long long vn_ssf_reader_stop(void* p) {
+  SsfReader* r = static_cast<SsfReader*>(p);
+  r->stop.store(true, std::memory_order_release);
+  if (r->th.joinable()) r->th.join();
+  long long final_count = r->packets.load(std::memory_order_relaxed);
+  delete r;
+  return final_count;
+}
+
+// Drain buffered Python-fallback SSF payloads as [u32 LE len][bytes]
+// frames. Only whole frames are written; leftovers stay buffered.
+int vn_drain_ssf_fallback(void* p, char* buf, int cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
+  int written = 0;
+  size_t taken = 0;
+  for (const std::string& pkt : ctx->ssf_fallback) {
+    size_t need = 4 + pkt.size();
+    if (cap < 0 || static_cast<size_t>(cap) - written < need) break;
+    uint32_t len32 = static_cast<uint32_t>(pkt.size());
+    std::memcpy(buf + written, &len32, 4);
+    std::memcpy(buf + written + 4, pkt.data(), pkt.size());
+    written += static_cast<int>(need);
+    ++taken;
+  }
+  if (taken) {
+    for (size_t i = 0; i < taken; ++i)
+      ctx->ssf_fallback_bytes -= ctx->ssf_fallback[i].size();
+    ctx->ssf_fallback.erase(ctx->ssf_fallback.begin(),
+                            ctx->ssf_fallback.begin() + taken);
+  }
+  return written;
 }
 
 // Enable/disable commit-path lock timing (global; affects all contexts).

@@ -771,3 +771,44 @@ def test_native_staging_reset_drops_plane():
     # staging stays enabled across epochs
     ni.ingest(b"rs.x:5|ms")
     assert ni.stage_total == 1
+
+
+def test_native_ssf_reader_end_to_end():
+    """The C++ SSF datagram reader (vn_ssf_reader_start): indicator
+    spans extract in C++ with no Python on the path; STATUS spans ride
+    the fallback buffer to the Python pipeline — nothing lost."""
+    cfg = Config(ssf_listen_addresses=["udp://127.0.0.1:0"],
+                 interval="600s", num_workers=1,
+                 indicator_span_timer_name="ind.t", percentiles=[0.5])
+    srv = Server(cfg)
+    if not srv.native_mode:
+        srv.shutdown()
+        pytest.skip("native library unavailable")
+    ports = srv.start()
+    try:
+        assert srv._native_ssf_readers, "native SSF reader not started"
+        port = next(iter(ports.values()))
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # indicator span: fully native
+        s.sendto(_make_span_bytes(
+            trace_id=5, id=6, start_timestamp=10**9,
+            end_timestamp=10**9 + 3_000_000, service="rdr", name="op",
+            indicator=True), ("127.0.0.1", port))
+        # STATUS span: must fall back to Python
+        s.sendto(_make_span_bytes(
+            trace_id=7, id=8, start_timestamp=10**9,
+            end_timestamp=10**9 + 1, service="rdr", name="op",
+            metrics=[{"metric": 4, "name": "svc.ok", "value": 0.0}]),
+            ("127.0.0.1", port))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sum(w.processed for w in srv.workers) >= 2:
+                break
+            time.sleep(0.05)
+        metrics = srv.flush()
+        names = {m.name for m in metrics}
+        assert any(n.startswith("ind.t") for n in names), names
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("svc.ok", MetricType.STATUS)].value == 0.0
+    finally:
+        srv.shutdown()

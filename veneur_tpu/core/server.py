@@ -239,6 +239,7 @@ class Server:
         # C++ reader-thread handles (vn_reader_start) + their retained
         # packet counts after stop (the handle dies with the thread)
         self._native_readers: list = []
+        self._native_ssf_readers: list = []
         self._native_reader_packets_stopped = 0
         self._native_reader_lock = threading.Lock()
         if cfg.tpu_native_ingest:
@@ -406,6 +407,16 @@ class Server:
         for line in others:
             self.handle_metric_packet(line)
 
+    def _drain_native_ssf_fallbacks(self) -> None:
+        """Raw SSF payloads the C++ SSF reader handed back (STATUS spans
+        need the Python pipeline). Same no-lock-held rule as events."""
+        if not self._native_ssf_readers:
+            return
+        with self._worker_locks[0]:
+            pkts = self.workers[0]._native.drain_ssf_fallback()
+        for pkt in pkts:
+            self.handle_trace_packet(pkt)
+
     # -- SSF ingest ---------------------------------------------------------
 
     def handle_trace_packet(self, packet: bytes) -> None:
@@ -481,6 +492,25 @@ class Server:
             sock.bind((addr, port))
         bound_port = sock.getsockname()[1]
         self._sockets.append(sock)
+
+        if (self._native_ssf and self.config.tpu_native_readers
+                and self._native_router is not None):
+            # C++ SSF reader: datagram -> proto decode -> span->metric
+            # extraction with no Python on the path; STATUS spans buffer
+            # for the pump's fallback drain
+            try:
+                sock.setblocking(True)
+                h = self._native_router.start_ssf_reader(
+                    self.workers[0]._native, sock.fileno(),
+                    min(self.config.trace_max_length_bytes, 65536),
+                    self._native_ssf_indicator, self._native_ssf_objective,
+                    self.config.ssf_span_uniqueness_rate)
+                self._native_ssf_readers.append(h)
+                self._start_native_pump()
+                return bound_port
+            except (AttributeError, RuntimeError) as e:
+                log.warning("native SSF reader unavailable (%s); using the"
+                            " Python reader", e)
 
         def loop():
             sock.settimeout(0.5)  # quiesce-able without closing (handoff)
@@ -692,6 +722,7 @@ class Server:
                 try:
                     self._drain_native_thresholds()
                     self._drain_native_events()
+                    self._drain_native_ssf_fallbacks()
                 except Exception:
                     if self._shutdown.is_set():
                         return
@@ -713,6 +744,15 @@ class Server:
                         self._native_router.stop_reader(h))
                 except Exception:
                     log.exception("native reader stop failed")
+            ssf_readers = self._native_ssf_readers
+            self._native_ssf_readers = []
+            for h in ssf_readers:
+                try:
+                    # SSF packets are spans, not statsd packets: counted
+                    # via the ssf.received_total pipeline, not here
+                    self._native_router.stop_ssf_reader(h)
+                except Exception:
+                    log.exception("native SSF reader stop failed")
 
     def _read_metric_socket(self, sock: socket.socket,
                             handoff_capable: bool = True) -> None:
@@ -998,6 +1038,7 @@ class Server:
             # worker.swap drains other_lines in the same critical section
             # as the context reset — and parsed into the next epoch below.
             self._drain_native_events()
+            self._drain_native_ssf_fallbacks()
 
         other_samples = self.event_worker.flush()
         for sink in self.metric_sinks:
@@ -1052,6 +1093,11 @@ class Server:
                 worker.pending_other_lines = []
                 for line in lines:
                     self.handle_metric_packet(line)
+            pkts = getattr(worker, "pending_ssf_fallback", None)
+            if pkts:
+                worker.pending_ssf_fallback = []
+                for pkt in pkts:
+                    self.handle_trace_packet(pkt)
         phases["swap_s"] = time.perf_counter() - _t
         _t = time.perf_counter()
         snaps: list[FlushSnapshot] = []

@@ -646,25 +646,31 @@ class DeviceWorker:
         g = self._native.drain_gauge(1 << 22)
         st = None
         others: list = []
+        ssf_fb: list = []
         if detach_stage:
             try:
                 st = self._native.detach_stage()
             except AttributeError:  # stale .so without the staging API
                 st = None
-            # epoch close: pull buffered event/service-check lines in the
-            # SAME critical section — the reset right after this drain
-            # clears other_lines, and a line landing between a separate
-            # drain and the reset would be destroyed
+            # epoch close: pull buffered event/service-check lines and
+            # Python-fallback SSF payloads in the SAME critical section —
+            # the reset right after this drain clears both buffers, and
+            # anything landing between a separate drain and the reset
+            # would be destroyed
             others = self._native.drain_other()
+            try:
+                ssf_fb = self._native.drain_ssf_fallback()
+            except AttributeError:  # stale .so without the SSF reader API
+                pass
         self._sync_native_series()
-        return h, s, c, g, st, others
+        return h, s, c, g, st, others, ssf_fb
 
     def _apply_native_raw(self, raw) -> None:
         """Apply drained buffers to device/host pools (no context lock —
         device dispatch must not stall reader commits). The detached
         staging plane (raw[4]) and event lines (raw[5], both flush only)
         are the caller's to hand to the swapped epoch."""
-        h, s, c, g, _st, _others = raw
+        h, s, c, g, _st, _others, _ssf_fb = raw
         if h is not None and len(h[0]):
             if self._mesh_pool is not None:
                 self._mesh_pool.add_samples_bulk(*h)
@@ -1282,9 +1288,11 @@ class DeviceWorker:
             try:
                 raw = self._drain_native_raw(detach_stage=True)
                 native_stage = raw[4]
-                # event/service-check lines caught at epoch close; the
-                # server parses them into the NEW epoch after swap
+                # event/service-check lines + fallback SSF payloads caught
+                # at epoch close; the server parses them into the NEW
+                # epoch after swap
                 self.pending_other_lines = raw[5]
+                self.pending_ssf_fallback = raw[6]
                 self._native.reset()
                 self._native_errs_seen = 0
                 self._native_proc_seen = 0

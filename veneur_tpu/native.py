@@ -214,6 +214,15 @@ def load_library() -> Optional[ctypes.CDLL]:
             lib.vn_reader_packets.argtypes = [c.c_void_p]
             lib.vn_reader_stop.restype = c.c_longlong
             lib.vn_reader_stop.argtypes = [c.c_void_p]
+            lib.vn_ssf_reader_start.restype = c.c_void_p
+            lib.vn_ssf_reader_start.argtypes = [
+                c.c_void_p, c.c_int, c.c_int, c.c_char_p, c.c_int,
+                c.c_char_p, c.c_int, c.c_double]
+            lib.vn_ssf_reader_stop.restype = c.c_longlong
+            lib.vn_ssf_reader_stop.argtypes = [c.c_void_p]
+            lib.vn_drain_ssf_fallback.restype = c.c_int
+            lib.vn_drain_ssf_fallback.argtypes = [
+                c.c_void_p, c.c_char_p, c.c_int]
         except AttributeError:
             pass
         _lib = lib
@@ -510,6 +519,23 @@ class NativeIngest:
                     continue
                 svc_s = svc.decode("utf-8", "replace")
                 out[svc_s] = out.get(svc_s, 0) + int(cnt)
+        return out
+
+    def drain_ssf_fallback(self, cap: int = 1 << 20) -> list[bytes]:
+        """Raw SSF payloads the native reader handed back for the Python
+        path (STATUS samples aboard), as whole packets."""
+        buf = ctypes.create_string_buffer(cap)
+        out = []
+        while True:
+            n = self._lib.vn_drain_ssf_fallback(self._ctx, buf, cap)
+            if n == 0:
+                break
+            raw = buf.raw[:n]
+            pos = 0
+            while pos + 4 <= n:
+                ln = int.from_bytes(raw[pos:pos + 4], "little")
+                out.append(raw[pos + 4:pos + 4 + ln])
+                pos += 4 + ln
         return out
 
     def drain_other(self) -> list[bytes]:
@@ -810,6 +836,21 @@ class NativeRouter:
         keeps ingesting up to one recv-timeout tick after the stop flag;
         a pre-join snapshot would undercount)."""
         return int(self._lib.vn_reader_stop(handle))
+
+    def start_ssf_reader(self, ctx_owner: "NativeIngest", fd: int,
+                         max_len: int, indicator: bytes, objective: bytes,
+                         uniq_rate: float):
+        """Spawn a C++ SSF datagram reader committing into ctx_owner's
+        context (single-shard: the native SSF path requires one worker)."""
+        h = self._lib.vn_ssf_reader_start(
+            ctx_owner._ctx, fd, max_len, indicator, len(indicator),
+            objective, len(objective), uniq_rate)
+        if not h:
+            raise RuntimeError("vn_ssf_reader_start failed")
+        return h
+
+    def stop_ssf_reader(self, handle) -> int:
+        return int(self._lib.vn_ssf_reader_stop(handle))
 
     def set_lock_stats(self, enabled: bool) -> None:
         """Toggle commit-path mutex wait/hold timing (global; ~10-20%
