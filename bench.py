@@ -283,22 +283,25 @@ def timer_replay() -> dict:
 
 
 def mixed() -> dict:
-    """BASELINE config 2: counters + Set(HLL) + histos, 100k series."""
+    """BASELINE config 2: counters + Set(HLL) + histos over 100k series,
+    through the product's round-4 device paths — HLL register inserts
+    and counter segment-sums per batch, plus ONE staged-plane fold per
+    interval for the histogram half (core/worker._histo_fold_staged)."""
     import jax
     import jax.numpy as jnp
 
+    from veneur_tpu.core.worker import _histo_fold_staged
     from veneur_tpu.ops import hll, scalars, tdigest as td
-    from veneur_tpu.utils.hashing import fnv1a_64
 
     series = _envint("VENEUR_BENCH_SERIES", 100_000, 20_000)
     batch = _envint("VENEUR_BENCH_BATCH", 1 << 22, 1 << 17)
+    depth = _envint("VENEUR_BENCH_STAGE_DEPTH", 64)
     iters = _envint("VENEUR_BENCH_ITERS", 10, 3)
     s_counter, s_set = series // 2, series // 4
     s_histo = series - s_counter - s_set
 
     rng = np.random.default_rng(1)
     n_c, n_s = batch // 2, batch // 4
-    n_h = batch - n_c - n_s
     c_rows = jnp.asarray(rng.integers(0, s_counter, n_c).astype(np.int32))
     c_vals = jnp.asarray(rng.poisson(3, n_c).astype(np.float32))
     # set inserts arrive as pre-hashed 64-bit member hashes (strings are
@@ -308,46 +311,63 @@ def mixed() -> dict:
     reg_idx_np, rank_np = hll.split_hashes(set_hash)
     set_reg = jnp.asarray(reg_idx_np)
     set_rank = jnp.asarray(rank_np)
-    h_rows = jnp.asarray(rng.integers(0, s_histo, n_h).astype(np.int32))
-    h_vals = jnp.asarray(rng.gamma(2.0, 50.0, n_h).astype(np.float32))
-    ones_h = jnp.ones(n_h, np.float32)
+    n_h = s_histo * depth  # one staged plane per iteration
+    planes = []
+    for _ in range(2):
+        sv = rng.gamma(2.0, 50.0, (s_histo, depth)).astype(np.float32)
+        sw = np.ones((s_histo, depth), np.float32)
+        planes.append((sv, sw))
 
     counters = jnp.zeros(s_counter, jnp.float32)
     regs = hll.init_pool(s_set)
     pool = td.init_pool(s_histo, td.DEFAULT_CAPACITY)
-    state = (counters, regs,
-             (pool.means, pool.weights, pool.min, pool.max, pool.recip))
+
+    def _full(v):
+        return jnp.full((s_histo,), v, jnp.float32)
+
+    hstate = [pool.means, pool.weights, pool.min, pool.max, pool.recip,
+              _full(0.0), _full(np.inf), _full(-np.inf), _full(0.0),
+              _full(0.0), _full(0.0), _full(0.0), _full(0.0), _full(0.0)]
+    state = (counters, regs, hstate)
 
     @jax.jit
-    def step(state):
-        counters, regs, hstate = state
+    def scalar_step(counters, regs):
         counters = counters + scalars.segment_counter_sum(
             c_rows, c_vals, s_counter)
         regs = hll.insert_batch(regs, set_rows, set_reg, set_rank)
-        m, w, a, b, r, _ = td.add_batch(*hstate, h_rows, h_vals, ones_h)
-        return (counters, regs, (m, w, a, b, r))
+        return counters, regs
+
+    def step(state, plane):
+        counters, regs, hstate = state
+        counters, regs = scalar_step(counters, regs)
+        sv, sw = plane
+        hstate = list(_histo_fold_staged(
+            *hstate, jnp.asarray(sv), jnp.asarray(sw)))
+        return (counters, regs, hstate)
 
     @jax.jit
     def force(state):
         return (jnp.sum(state[0]) + jnp.sum(state[1].astype(jnp.int32))
                 + jnp.sum(state[2][1]))
 
-    state = step(state)
+    state = step(state, planes[0])
     float(force(state))
     t0 = time.perf_counter()
-    for _ in range(iters):
-        state = step(state)
+    for i in range(iters):
+        state = step(state, planes[i % 2])
     float(force(state))
     elapsed = time.perf_counter() - t0
-    rate = iters * batch / elapsed
-    inputs = (c_rows, c_vals, set_rows, set_reg, set_rank,
-              h_rows, h_vals, ones_h)
+    per_iter = n_c + n_s + n_h
+    rate = iters * per_iter / elapsed
+    inputs = (c_rows, c_vals, set_rows, set_reg, set_rank)
+    plane_bytes = planes[0][0].nbytes + planes[0][1].nbytes
     return _roofline({
         "metric": "mixed_samples_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": "samples/s",
         "vs_baseline": round(rate / 60000.0, 2),
-    }, iters * (_nbytes(inputs) + 2 * _nbytes(state)), elapsed)
+    }, iters * (_nbytes(inputs) + plane_bytes + 2 * _nbytes(state)),
+        elapsed)
 
 
 def global_merge() -> dict:

@@ -880,6 +880,19 @@ class Server:
         """Datagram unix socket statsd (reference networking.go:144-196),
         with flock exclusivity and abstract-socket (@name) support."""
         sock = self._bind_unix_socket(path, socket.SOCK_DGRAM)
+        if self.native_mode and self.config.tpu_native_readers:
+            # same datagram semantics as UDP: the C++ reader works on any
+            # bound datagram fd
+            try:
+                sock.setblocking(True)
+                h = self._native_router.start_reader(
+                    sock.fileno(), self.config.metric_max_length)
+                self._native_readers.append(h)
+                self._start_native_pump()
+                return
+            except (AttributeError, RuntimeError) as e:
+                log.warning("native unixgram reader unavailable (%s); "
+                            "using the Python reader", e)
         self._spawn(
             lambda: self._read_metric_socket(sock, handoff_capable=False),
             "statsd-unixgram")
