@@ -74,20 +74,26 @@ def run_phase(w, lock, batch_arrays, qs, seconds: float, overlapped: bool,
     the extraction window, plus swap/extract durations."""
     rows, vals, wts = batch_arrays
     stop = threading.Event()
-    spans: list[tuple[float, float]] = []
+    spans: list[tuple[float, float, float]] = []
 
     def ingester():
         i = 0
         while not stop.is_set():
             t0 = time.perf_counter()
             with lock:
+                t_acq = time.perf_counter()
                 # the swap resets the pool; real ingest recreates it on
                 # first use (_upsert_histo -> _ensure_histo)
                 w._ensure_histo(series)
                 # jitter values so the relay/runtime can't dedupe work
                 w._device_histo_step(rows, vals + np.float32(i * 1e-6), wts)
-            spans.append((t0, time.perf_counter()))
+            spans.append((t0, t_acq, time.perf_counter()))
             i += 1
+            # paced like real traffic (a batch every ~20ms), not a busy
+            # loop — on a 1-core host a spinning ingester fights the
+            # extraction compute for the core and the contention would
+            # masquerade as lock stalls
+            stop.wait(0.02)
 
     t = threading.Thread(target=ingester, daemon=True)
     t.start()
@@ -120,9 +126,12 @@ def run_phase(w, lock, batch_arrays, qs, seconds: float, overlapped: bool,
             "this phase would be unreliable — aborting instead")
     # classify each ingest batch by whether its wall-time interval
     # overlaps the flush window (so a batch that blocked on the lock for
-    # the whole extraction is counted against it)
-    before = [e - s for s, e in spans if e <= flush_start]
-    during = [e - s for s, e in spans
+    # the whole extraction is counted against it). The LOCK WAIT is the
+    # design property under test (the two-phase flush exists so ingest
+    # never waits on an extraction); total batch time additionally
+    # carries CPU contention on a shared-core host.
+    before = [(a - s, e - s) for s, a, e in spans if e <= flush_start]
+    during = [(a - s, e - s) for s, a, e in spans
               if e > flush_start and s < flush_end]
     return before, during, swap_s, extract_s
 
@@ -170,23 +179,33 @@ def main() -> None:
 
         before, during, swap_s, extract_s = run_phase(
             w, lock, batch_arrays, qs, seconds, overlapped, series)
+        waits_b = [x[0] for x in before]
+        totals_b = [x[1] for x in before]
+        waits_d = [x[0] for x in during]
+        totals_d = [x[1] for x in during]
         out[name] = {
             "swap_s": round(swap_s, 4),
             "extract_s": round(extract_s, 4),
             "ingest_batches_during_extract": len(during),
-            "ingest_batch_p50_baseline_s": pctile(before, 50),
-            "ingest_batch_p99_baseline_s": pctile(before, 99),
-            "ingest_batch_p50_during_extract_s": pctile(during, 50),
-            "ingest_batch_max_during_extract_s": pctile(during, 100),
+            "lock_wait_p99_baseline_s": pctile(waits_b, 99),
+            "lock_wait_p50_during_extract_s": pctile(waits_d, 50),
+            "lock_wait_max_during_extract_s": pctile(waits_d, 100),
+            "ingest_batch_p50_baseline_s": pctile(totals_b, 50),
+            "ingest_batch_p99_baseline_s": pctile(totals_b, 99),
+            "ingest_batch_p50_during_extract_s": pctile(totals_d, 50),
+            "ingest_batch_max_during_extract_s": pctile(totals_d, 100),
         }
 
     ov, lk = out["overlapped"], out["locked_extract"]
     out["verdict"] = {
-        # the headline: with the two-phase flush, the worst ingest stall
-        # during extraction should be far below the extraction itself
-        "max_ingest_stall_overlapped_s":
-            ov["ingest_batch_max_during_extract_s"],
-        "max_ingest_stall_locked_s": lk["ingest_batch_max_during_extract_s"],
+        # the headline: with the two-phase flush, ingest's worst LOCK
+        # WAIT during extraction should be far below the extraction
+        # itself (total batch time additionally carries shared-core CPU
+        # contention; see the lock_wait_* fields for the design property)
+        "max_ingest_lock_wait_overlapped_s":
+            ov["lock_wait_max_during_extract_s"],
+        "max_ingest_lock_wait_locked_s":
+            lk["lock_wait_max_during_extract_s"],
         "extract_s": ov["extract_s"],
         "ingest_proceeds_during_extract":
             ov["ingest_batches_during_extract"] > 0,
