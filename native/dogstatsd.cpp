@@ -356,6 +356,11 @@ struct Ctx {
     int32_t rows = 0;   // allocated rows (pow2-grown)
     int32_t depth = 0;  // slots per row (B)
     long long total = 0;  // staged samples since allocation
+    // true while every staged weight is exactly 1.0 (unsampled metrics,
+    // the overwhelmingly common case): the consumer can then skip the
+    // weights plane entirely and rebuild it on device from `count` —
+    // halving the host->device upload at flush
+    bool unit_wts = true;
     std::vector<float> vals;     // [rows * depth]
     std::vector<float> wts;      // [rows * depth]
     std::vector<int32_t> count;  // [rows]
@@ -599,8 +604,10 @@ bool stage_histo_sample(Ctx* ctx, int32_t row, double value,
   int32_t& c = sp->count[row];
   if (c >= sp->depth) return false;
   size_t at = static_cast<size_t>(row) * sp->depth + c;
+  float w = static_cast<float>(1.0 / sample_rate);
   sp->vals[at] = static_cast<float>(value);
-  sp->wts[at] = static_cast<float>(1.0 / sample_rate);
+  sp->wts[at] = w;
+  if (w != 1.0f) sp->unit_wts = false;
   ++c;
   ++sp->total;
   return true;
@@ -1156,6 +1163,12 @@ void* vn_stage_detach(void* p, float** vals, float** wts, int32_t** counts,
   *rows_out = sp->rows;
   *depth_out = sp->depth;
   return sp;
+}
+
+// Whether every weight in a detached plane is exactly 1.0 (see
+// StagePlane.unit_wts). Takes the DETACHED handle, not the ctx.
+int vn_stage_unit_wts(void* plane) {
+  return static_cast<Ctx::StagePlane*>(plane)->unit_wts ? 1 : 0;
 }
 
 void vn_stage_free(void* plane) {

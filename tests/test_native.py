@@ -723,7 +723,8 @@ def test_native_staging_plane_detach():
     assert ni.pending_histo == 0  # nothing spilled
     st = ni.detach_stage()
     assert st is not None
-    vals, wts, counts, free = st
+    vals, wts, counts, unit, free = st
+    assert not unit  # the @0.5 sample makes weights non-unit
     try:
         assert vals.shape == wts.shape and vals.shape[1] == 4
         assert counts[0] == 2 and counts[1] == 1
@@ -752,7 +753,8 @@ def test_native_staging_spills_past_depth():
     rows, vals, wts = ni.drain_histo(16)
     assert list(vals) == [2.0, 3.0, 4.0]
     st = ni.detach_stage()
-    vals2, _wts2, counts, free = st
+    vals2, _wts2, counts, unit, free = st
+    assert unit  # every sample unweighted
     try:
         assert counts[0] == 2 and vals2[0, 0] == 0.0 and vals2[0, 1] == 1.0
     finally:

@@ -131,6 +131,16 @@ def _histo_ingest_step(
             lmin, lmax, lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c)
 
 
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _unit_wts_plane(counts, depth: int):
+    """Rebuild a unit-weights staging plane from per-row staged counts:
+    slot j of row r weighs 1.0 iff j < counts[r]. Uploading [S] i32
+    instead of [S, B] f32 halves the flush's host→device bytes when no
+    sampled (@rate) metric arrived — the common case."""
+    return (jnp.arange(depth, dtype=jnp.int32)[None, :]
+            < counts[:, None]).astype(jnp.float32)
+
+
 @functools.partial(jax.jit, static_argnames=("compression",),
                    donate_argnums=tuple(range(14)))
 def _histo_fold_staged(
@@ -1305,7 +1315,7 @@ class DeviceWorker:
                 # staging belong to the mesh shards, not the local fold
                 # (extract would overwrite the local output with mesh_out,
                 # silently dropping them)
-                sv, sw, counts, free = native_stage
+                sv, sw, counts, _unit, free = native_stage
                 mask = (np.arange(sv.shape[1])[None, :]
                         < counts[:, None])
                 rows = np.repeat(
@@ -1338,10 +1348,14 @@ class DeviceWorker:
             # hand the host staging planes to the closed epoch; the fold
             # into the digest runs in extract_snapshot, OFF the ingest lock
             self._ensure_stage()  # pool may have grown since the last stage
-            staged_histo.append((self._stage_vals, self._stage_wts, None))
+            staged_histo.append(
+                (self._stage_vals, self._stage_wts, None, None))
         if native_stage is not None:
-            sv, sw, _counts, free = native_stage
-            staged_histo.append((sv, sw, free))
+            sv, sw, counts, unit, free = native_stage
+            # unit weights (no sampled metrics this epoch): skip the
+            # weights plane upload; the fold rebuilds it from counts
+            staged_histo.append(
+                (sv, None if unit else sw, counts, free))
         staged_histo = staged_histo or None
         # flush self-telemetry (veneur.worker.samples_staged_total)
         self.staged_samples_swapped = staged
@@ -1385,7 +1399,7 @@ class DeviceWorker:
                           histo.lmin, histo.lmax, histo.lsum, histo.lsum_c,
                           histo.lweight, histo.lweight_c, histo.lrecip,
                           histo.lrecip_c))
-            for sv, sw, free in (swapped.staged_histo or ()):
+            for sv, sw, counts, free in (swapped.staged_histo or ()):
                 if free is not None:
                     # the numpy views alias C++ plane memory. copy=True is
                     # load-bearing: on the CPU backend device_put ZERO-
@@ -1393,10 +1407,20 @@ class DeviceWorker:
                     # under an aliasing buffer is a use-after-free (bitten
                     # in round 4 — garbage quantiles under heap churn).
                     svj = jnp.array(sv[:s_eff], copy=True)
-                    swj = jnp.array(sw[:s_eff], copy=True)
-                    svj.block_until_ready()
-                    swj.block_until_ready()
-                    free()
+                    if sw is None:
+                        # unit weights: upload the tiny counts vector and
+                        # rebuild the plane on device — halves the
+                        # host->device bytes of the flush
+                        cj = jnp.array(counts[:s_eff], copy=True)
+                        svj.block_until_ready()
+                        cj.block_until_ready()
+                        free()
+                        swj = _unit_wts_plane(cj, sv.shape[1])
+                    else:
+                        swj = jnp.array(sw[:s_eff], copy=True)
+                        svj.block_until_ready()
+                        swj.block_until_ready()
+                        free()
                 else:
                     svj = jnp.asarray(sv[:s_eff])
                     swj = jnp.asarray(sw[:s_eff])

@@ -207,6 +207,8 @@ def load_library() -> Optional[ctypes.CDLL]:
             lib.vn_stage_free.argtypes = [c.c_void_p]
             lib.vn_stage_total.restype = c.c_longlong
             lib.vn_stage_total.argtypes = [c.c_void_p]
+            lib.vn_stage_unit_wts.restype = c.c_int
+            lib.vn_stage_unit_wts.argtypes = [c.c_void_p]
             lib.vn_reader_start.restype = c.c_void_p
             lib.vn_reader_start.argtypes = [
                 c.POINTER(c.c_void_p), c.c_int, c.c_int, c.c_int]
@@ -314,10 +316,13 @@ class NativeIngest:
 
     def detach_stage(self):
         """Detach the staged plane: returns (vals[rows, depth],
-        wts[rows, depth], counts[rows], free) — the numpy arrays alias
-        C++ memory owned by the detached plane; call free() only after
-        the data has been uploaded/copied. None when nothing is staged.
-        A fresh zeroed plane takes over for subsequent samples."""
+        wts[rows, depth], counts[rows], unit_wts, free) — the numpy
+        arrays alias C++ memory owned by the detached plane; call free()
+        only after the data has been uploaded/copied. None when nothing
+        is staged. unit_wts=True means every weight is exactly 1.0, so
+        the consumer can rebuild the weights plane on device from
+        `counts` instead of uploading it. A fresh zeroed plane takes
+        over for subsequent samples."""
         c = ctypes
         pv = c.POINTER(c.c_float)()
         pw = c.POINTER(c.c_float)()
@@ -333,12 +338,16 @@ class NativeIngest:
         vals = np.ctypeslib.as_array(pv, shape=(r, d))
         wts = np.ctypeslib.as_array(pw, shape=(r, d))
         counts = np.ctypeslib.as_array(pc, shape=(r,))
+        try:
+            unit = bool(self._lib.vn_stage_unit_wts(handle))
+        except AttributeError:
+            unit = False
         lib = self._lib
 
         def free(_h=handle, _lib=lib):
             _lib.vn_stage_free(_h)
 
-        return vals, wts, counts, free
+        return vals, wts, counts, unit, free
 
     # drains -----------------------------------------------------------------
 
