@@ -125,15 +125,46 @@ def test_server_columnar_path_engages_and_counts():
         srv.shutdown()
 
 
-def test_server_object_path_with_legacy_sink():
-    """A legacy (non-columnar) sink keeps the object path — flush
-    returns the list as before."""
+def test_server_columnar_with_legacy_sink():
+    """A legacy (non-columnar) sink no longer demotes the flush to the
+    object path: it receives the identical objects through the base
+    flush_columnar's shared materialization, and flush's return is
+    iterable either way."""
     from veneur_tpu.sinks.channel import ChannelMetricSink
 
     cfg = Config(interval="10s", percentiles=[],
                  aggregates=["count"])
     sink = ChannelMetricSink()
     srv = Server(cfg, metric_sinks=[sink])
+    try:
+        srv.process_metric_packet(b"t:5|ms")
+        out = srv.flush()
+        names = {m.name for m in out}  # iterable like the object list
+        assert names == {"t.count"}
+        got = sink.queue.get_nowait()
+        assert got and got[0].name == "t.count"
+    finally:
+        srv.shutdown()
+
+
+def test_server_object_path_with_plugin():
+    """Plugins still need the object list, so their presence keeps the
+    legacy path (flush returns the list itself)."""
+    from veneur_tpu.sinks.channel import ChannelMetricSink
+
+    class _Plugin:
+        def name(self):
+            return "p"
+
+        flushed = None
+
+        def flush(self, metrics, hostname=""):
+            _Plugin.flushed = list(metrics)
+
+    cfg = Config(interval="10s", percentiles=[], aggregates=["count"])
+    sink = ChannelMetricSink()
+    srv = Server(cfg, metric_sinks=[sink])
+    srv.plugins.append(_Plugin())
     try:
         srv.process_metric_packet(b"t:5|ms")
         out = srv.flush()

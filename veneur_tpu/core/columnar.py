@@ -90,6 +90,11 @@ class ColumnarMetrics:
     def __len__(self) -> int:
         return self.count()
 
+    def __iter__(self):
+        # drop-in for the object-path list (tests and embedders iterate
+        # Server.flush()'s return); memoized, so iterating twice is cheap
+        return iter(self.materialize())
+
     def count_for(self, sink_name: str) -> int:
         """Metrics actually routed to one sink (veneursinkonly rules) —
         the per-sink flushed-total the object path reports. Groups with
@@ -113,7 +118,12 @@ class ColumnarMetrics:
 
     def materialize(self) -> list[InterMetric]:
         """The compatibility path: the same InterMetric multiset the
-        object generator emits, family-major."""
+        object generator emits, family-major. Memoized — in a mixed sink
+        set every non-columnar sink shares ONE materialization (the base
+        MetricSink.flush_columnar routes/filters per sink on top of it)."""
+        cached = getattr(self, "_materialized", None)
+        if cached is not None:
+            return cached
         out: list[InterMetric] = []
         append = out.append
         ts = self.timestamp
@@ -129,6 +139,7 @@ class ColumnarMetrics:
                         name + suffix if suffix else name, ts,
                         vals[i], tags, mtype, sinks=sinks))
         out.extend(self.extras)
+        self._materialized = out
         return out
 
     def iter_rows(self, sink_name: Optional[str] = None,

@@ -1137,13 +1137,14 @@ class Server:
 
         phases["extract_s"] = time.perf_counter() - _t
         _t = time.perf_counter()
-        # Columnar fast path: when every metric sink consumes columns
-        # (and no plugin needs objects), the flush never materializes
-        # per-metric Python objects — at 1M series the object loop alone
-        # is seconds of host time (core/columnar.py).
-        use_columnar = bool(self.metric_sinks) and not self.plugins and all(
-            getattr(s, "supports_columnar", False)
-            for s in self.metric_sinks)
+        # Columnar fast path: the flush never materializes per-metric
+        # Python objects up front — at 1M series the object loop alone is
+        # seconds of host time (core/columnar.py). Columnar-capable sinks
+        # consume the SoA batch directly; the rest share ONE memoized
+        # materialization via the base flush_columnar, so a single legacy
+        # sink no longer demotes every sink to the object path. Plugins
+        # still need the object list, so they keep the legacy path.
+        use_columnar = bool(self.metric_sinks) and not self.plugins
         final: list[InterMetric] = []
         batch = None
         n_flushed = 0
