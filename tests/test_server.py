@@ -754,3 +754,24 @@ def test_udp_reader_modes_equivalent(native_readers):
         srv.shutdown()
         # counters survive reader stop (folded into the stopped tally)
         assert srv.packets_received >= 52
+
+
+def test_sampled_timers_weighted_through_native_plane():
+    """|@rate timers flow through the native staging plane with their
+    1/rate weights (the non-unit-weights upload branch): count reflects
+    the estimated population, not the sample count."""
+    srv, _, ports = _server(num_workers=1)
+    try:
+        port = next(iter(ports.values()))
+        for v in range(1, 41):
+            _send_udp(port, b"sr.t:%d|ms|@0.5" % v)
+        assert _wait_for(lambda: srv.packets_received >= 40)
+        assert _wait_for(
+            lambda: sum(w.processed for w in srv.workers) >= 40)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        # 40 samples at rate 0.5 -> weight 2 each -> estimated count 80
+        assert by_key[("sr.t.count", MetricType.COUNTER)].value == 80.0
+        assert by_key[("sr.t.max", MetricType.GAUGE)].value == 40.0
+    finally:
+        srv.shutdown()
