@@ -1399,44 +1399,67 @@ class DeviceWorker:
                           histo.lmin, histo.lmax, histo.lsum, histo.lsum_c,
                           histo.lweight, histo.lweight_c, histo.lrecip,
                           histo.lrecip_c))
-            for sv, sw, counts, free in (swapped.staged_histo or ()):
-                if free is not None:
-                    # the numpy views alias C++ plane memory. copy=True is
-                    # load-bearing: on the CPU backend device_put ZERO-
-                    # COPIES aligned numpy arrays, so freeing the plane
-                    # under an aliasing buffer is a use-after-free (bitten
-                    # in round 4 — garbage quantiles under heap churn).
-                    svj = jnp.array(sv[:s_eff], copy=True)
-                    if sw is None:
-                        # unit weights: upload the tiny counts vector and
-                        # rebuild the plane on device — halves the
-                        # host->device bytes of the flush
-                        cj = jnp.array(counts[:s_eff], copy=True)
-                        svj.block_until_ready()
-                        cj.block_until_ready()
-                        free()
-                        swj = _unit_wts_plane(cj, sv.shape[1])
-                    else:
-                        swj = jnp.array(sw[:s_eff], copy=True)
-                        svj.block_until_ready()
-                        swj.block_until_ready()
-                        free()
-                else:
-                    svj = jnp.asarray(sv[:s_eff])
-                    swj = jnp.asarray(sw[:s_eff])
-                if svj.shape[0] < s_eff:
-                    # the native plane grows by its own pow2 schedule and
-                    # can trail the pool's: pad on device (rows past the
-                    # plane's end hold no staged data by construction)
-                    pad = s_eff - svj.shape[0]
-                    svj = jnp.concatenate(
-                        [svj, jnp.zeros((pad, svj.shape[1]), jnp.float32)])
-                    swj = jnp.concatenate(
-                        [swj, jnp.zeros((pad, swj.shape[1]), jnp.float32)])
-                fields = _histo_fold_staged(
-                    *fields, svj, swj, compression=self.compression,
-                )
+            pending = list(swapped.staged_histo or ())
             swapped.staged_histo = None
+            try:
+                while pending:
+                    sv, sw, counts, free = pending[0]
+                    swj = None
+                    if free is not None:
+                        # the numpy views alias C++ plane memory. copy=True
+                        # is load-bearing: on the CPU backend device_put
+                        # ZERO-COPIES aligned numpy arrays, so freeing the
+                        # plane under an aliasing buffer is a use-after-free
+                        # (bitten in round 4 — garbage quantiles under heap
+                        # churn).
+                        svj = jnp.array(sv[:s_eff], copy=True)
+                        if sw is None:
+                            # unit weights: upload the tiny counts vector
+                            # and rebuild the plane on device — halves the
+                            # host->device bytes of the flush
+                            cj = jnp.array(counts[:s_eff], copy=True)
+                            svj.block_until_ready()
+                            cj.block_until_ready()
+                        else:
+                            swj = jnp.array(sw[:s_eff], copy=True)
+                            svj.block_until_ready()
+                            swj.block_until_ready()
+                        free()
+                        # freed: the cleanup below must not free it again
+                        pending[0] = (sv, sw, counts, None)
+                        if swj is None:
+                            swj = _unit_wts_plane(cj, sv.shape[1])
+                    else:
+                        svj = jnp.asarray(sv[:s_eff])
+                        swj = jnp.asarray(sw[:s_eff])
+                    if svj.shape[0] < s_eff:
+                        # the native plane grows by its own pow2 schedule
+                        # and can trail the pool's: pad on device (rows
+                        # past the plane's end hold no staged data by
+                        # construction)
+                        pad = s_eff - svj.shape[0]
+                        svj = jnp.concatenate(
+                            [svj,
+                             jnp.zeros((pad, svj.shape[1]), jnp.float32)])
+                        swj = jnp.concatenate(
+                            [swj,
+                             jnp.zeros((pad, swj.shape[1]), jnp.float32)])
+                    fields = _histo_fold_staged(
+                        *fields, svj, swj, compression=self.compression,
+                    )
+                    pending.pop(0)
+            finally:
+                # an upload/fold failure must not leak the C++ planes: a
+                # repeated failing flush at 1M rows would otherwise leak
+                # hundreds of MB per interval. Data loss here is fine
+                # (per-flush data is expendable, README.md:135-137);
+                # leaked native memory is not.
+                for item in pending:
+                    if item[3] is not None:
+                        try:
+                            item[3]()
+                        except Exception:  # pragma: no cover
+                            log.exception("staged plane free failed")
             qs = jnp.asarray(np.asarray(quantiles, dtype=np.float32))
             out = self._extract(fields, qs)
             (qv, dmin, dmax, dsum, dcount, drecip,
@@ -1449,6 +1472,16 @@ class DeviceWorker:
             snap.lsum, snap.lweight, snap.lrecip = lsum[:n], lweight[:n], lrecip[:n]
             snap.digest_means = np.asarray(fields[0])[:n]
             snap.digest_weights = np.asarray(fields[1])[:n]
+        if swapped.staged_histo:
+            # histo block skipped (no rows): planes can hold nothing
+            # meaningful, but C++ memory must still be released
+            for item in swapped.staged_histo:
+                if item[3] is not None:
+                    try:
+                        item[3]()
+                    except Exception:  # pragma: no cover
+                        log.exception("staged plane free failed")
+            swapped.staged_histo = None
         if swapped.mesh_out is not None:
             mout = swapped.mesh_out
             n = directory.num_histo_rows
