@@ -686,26 +686,34 @@ class Server:
                 sock.bind((addr, bound_port))
             bound_port = sock.getsockname()[1]  # resolve port 0 once
             self._sockets.append(sock)
-            if self.native_mode and self.config.tpu_native_readers:
-                # C++ recv loop: datagram → parse → staged sample with no
-                # Python (or GIL) on the path. The Python socket object
-                # stays in self._sockets so the fd outlives the thread
-                # (handoff keeps it open for the successor).
-                try:
-                    sock.setblocking(True)
-                    h = self._native_router.start_reader(
-                        sock.fileno(), self.config.metric_max_length)
-                    self._native_readers.append(h)
-                    self._start_native_pump()
-                    continue
-                except (AttributeError, RuntimeError) as e:
-                    log.warning("native reader unavailable (%s); using the"
-                                " Python reader", e)
+            if self._start_native_metric_reader(sock):
+                continue
             self._spawn(
                 lambda s=sock: self._read_metric_socket(s),
                 f"statsd-udp-{i}",
             )
         return bound_port
+
+    def _start_native_metric_reader(self, sock: socket.socket) -> bool:
+        """Hand a bound datagram fd to a C++ reader thread: datagram →
+        parse → staged sample with no Python (or GIL) on the path. The
+        Python socket object stays in self._sockets so the fd outlives
+        the thread (handoff keeps it open for the successor). Returns
+        False when native readers are off/unavailable — the caller spawns
+        the Python reader instead."""
+        if not (self.native_mode and self.config.tpu_native_readers):
+            return False
+        try:
+            sock.setblocking(True)
+            h = self._native_router.start_reader(
+                sock.fileno(), self.config.metric_max_length)
+            self._native_readers.append(h)
+            self._start_native_pump()
+            return True
+        except (AttributeError, RuntimeError) as e:
+            log.warning("native reader unavailable (%s); using the"
+                        " Python reader", e)
+            return False
 
     def _start_native_pump(self) -> None:
         """With C++ readers, no Python code sees datagrams — this thread
@@ -880,19 +888,11 @@ class Server:
         """Datagram unix socket statsd (reference networking.go:144-196),
         with flock exclusivity and abstract-socket (@name) support."""
         sock = self._bind_unix_socket(path, socket.SOCK_DGRAM)
-        if self.native_mode and self.config.tpu_native_readers:
-            # same datagram semantics as UDP: the C++ reader works on any
-            # bound datagram fd
-            try:
-                sock.setblocking(True)
-                h = self._native_router.start_reader(
-                    sock.fileno(), self.config.metric_max_length)
-                self._native_readers.append(h)
-                self._start_native_pump()
-                return
-            except (AttributeError, RuntimeError) as e:
-                log.warning("native unixgram reader unavailable (%s); "
-                            "using the Python reader", e)
+        # same datagram semantics as UDP: the C++ reader works on any
+        # bound datagram fd
+        if self._start_native_metric_reader(sock):
+            self._sockets.append(sock)
+            return
         self._spawn(
             lambda: self._read_metric_socket(sock, handoff_capable=False),
             "statsd-unixgram")

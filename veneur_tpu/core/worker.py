@@ -36,7 +36,7 @@ from __future__ import annotations
 import functools
 import logging
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -129,6 +129,28 @@ def _histo_ingest_step(
     lrecip_c = lrecip_c.at[active].set(n_lr_c, mode="drop")
     return (means, weights, dmin, dmax, drecip, drecip_c,
             lmin, lmax, lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c)
+
+
+class StagedPlane(NamedTuple):
+    """One raw-sample staging plane handed to the flush: host arrays
+    (vals/wts [S, B], counts [S]) plus the native-memory release hook.
+    wts is None when every weight is 1.0 (rebuilt on device from counts);
+    free is None for Python-owned planes."""
+
+    vals: np.ndarray
+    wts: Optional[np.ndarray]
+    counts: Optional[np.ndarray]
+    free: Optional[object]
+
+
+def _free_staged_planes(planes) -> None:
+    """Release the native memory of any not-yet-freed planes."""
+    for p in planes or ():
+        if p.free is not None:
+            try:
+                p.free()
+            except Exception:  # pragma: no cover
+                log.exception("staged plane free failed")
 
 
 @functools.partial(jax.jit, static_argnames=("depth",))
@@ -1349,13 +1371,13 @@ class DeviceWorker:
             # into the digest runs in extract_snapshot, OFF the ingest lock
             self._ensure_stage()  # pool may have grown since the last stage
             staged_histo.append(
-                (self._stage_vals, self._stage_wts, None, None))
+                StagedPlane(self._stage_vals, self._stage_wts, None, None))
         if native_stage is not None:
             sv, sw, counts, unit, free = native_stage
             # unit weights (no sampled metrics this epoch): skip the
             # weights plane upload; the fold rebuilds it from counts
             staged_histo.append(
-                (sv, None if unit else sw, counts, free))
+                StagedPlane(sv, None if unit else sw, counts, free))
         staged_histo = staged_histo or None
         # flush self-telemetry (veneur.worker.samples_staged_total)
         self.staged_samples_swapped = staged
@@ -1369,6 +1391,53 @@ class DeviceWorker:
         self.imported = 0
         self._reset_epoch()
         return swapped
+
+    def _fold_one_plane(self, fields: tuple, pending: list, s_eff: int
+                        ) -> tuple:
+        """Upload pending[0], release its native memory, fold it into the
+        digest fields, and pop it. The caller owns cleanup of whatever is
+        left in `pending` on failure."""
+        plane: StagedPlane = pending[0]
+        swj = None
+        if plane.free is not None:
+            # the numpy views alias C++ plane memory. copy=True is
+            # load-bearing: on the CPU backend device_put ZERO-COPIES
+            # aligned numpy arrays, so freeing the plane under an
+            # aliasing buffer is a use-after-free (bitten in round 4 —
+            # garbage quantiles under heap churn).
+            svj = jnp.array(plane.vals[:s_eff], copy=True)
+            if plane.wts is None:
+                # unit weights: upload the tiny counts vector and rebuild
+                # the plane on device — halves the host->device bytes of
+                # the flush
+                cj = jnp.array(plane.counts[:s_eff], copy=True)
+                svj.block_until_ready()
+                cj.block_until_ready()
+            else:
+                swj = jnp.array(plane.wts[:s_eff], copy=True)
+                svj.block_until_ready()
+                swj.block_until_ready()
+            plane.free()
+            # freed: the caller's cleanup must not free it again
+            pending[0] = plane._replace(free=None)
+            if swj is None:
+                swj = _unit_wts_plane(cj, plane.vals.shape[1])
+        else:
+            svj = jnp.asarray(plane.vals[:s_eff])
+            swj = jnp.asarray(plane.wts[:s_eff])
+        if svj.shape[0] < s_eff:
+            # the native plane grows by its own pow2 schedule and can
+            # trail the pool's: pad on device (rows past the plane's end
+            # hold no staged data by construction)
+            pad = s_eff - svj.shape[0]
+            svj = jnp.concatenate(
+                [svj, jnp.zeros((pad, svj.shape[1]), jnp.float32)])
+            swj = jnp.concatenate(
+                [swj, jnp.zeros((pad, swj.shape[1]), jnp.float32)])
+        fields = _histo_fold_staged(
+            *fields, svj, swj, compression=self.compression)
+        pending.pop(0)
+        return fields
 
     def extract_snapshot(self, swapped: "SwappedEpoch",
                          quantiles: np.ndarray,
@@ -1403,63 +1472,14 @@ class DeviceWorker:
             swapped.staged_histo = None
             try:
                 while pending:
-                    sv, sw, counts, free = pending[0]
-                    swj = None
-                    if free is not None:
-                        # the numpy views alias C++ plane memory. copy=True
-                        # is load-bearing: on the CPU backend device_put
-                        # ZERO-COPIES aligned numpy arrays, so freeing the
-                        # plane under an aliasing buffer is a use-after-free
-                        # (bitten in round 4 — garbage quantiles under heap
-                        # churn).
-                        svj = jnp.array(sv[:s_eff], copy=True)
-                        if sw is None:
-                            # unit weights: upload the tiny counts vector
-                            # and rebuild the plane on device — halves the
-                            # host->device bytes of the flush
-                            cj = jnp.array(counts[:s_eff], copy=True)
-                            svj.block_until_ready()
-                            cj.block_until_ready()
-                        else:
-                            swj = jnp.array(sw[:s_eff], copy=True)
-                            svj.block_until_ready()
-                            swj.block_until_ready()
-                        free()
-                        # freed: the cleanup below must not free it again
-                        pending[0] = (sv, sw, counts, None)
-                        if swj is None:
-                            swj = _unit_wts_plane(cj, sv.shape[1])
-                    else:
-                        svj = jnp.asarray(sv[:s_eff])
-                        swj = jnp.asarray(sw[:s_eff])
-                    if svj.shape[0] < s_eff:
-                        # the native plane grows by its own pow2 schedule
-                        # and can trail the pool's: pad on device (rows
-                        # past the plane's end hold no staged data by
-                        # construction)
-                        pad = s_eff - svj.shape[0]
-                        svj = jnp.concatenate(
-                            [svj,
-                             jnp.zeros((pad, svj.shape[1]), jnp.float32)])
-                        swj = jnp.concatenate(
-                            [swj,
-                             jnp.zeros((pad, swj.shape[1]), jnp.float32)])
-                    fields = _histo_fold_staged(
-                        *fields, svj, swj, compression=self.compression,
-                    )
-                    pending.pop(0)
+                    fields = self._fold_one_plane(fields, pending, s_eff)
             finally:
                 # an upload/fold failure must not leak the C++ planes: a
                 # repeated failing flush at 1M rows would otherwise leak
                 # hundreds of MB per interval. Data loss here is fine
                 # (per-flush data is expendable, README.md:135-137);
                 # leaked native memory is not.
-                for item in pending:
-                    if item[3] is not None:
-                        try:
-                            item[3]()
-                        except Exception:  # pragma: no cover
-                            log.exception("staged plane free failed")
+                _free_staged_planes(pending)
             qs = jnp.asarray(np.asarray(quantiles, dtype=np.float32))
             out = self._extract(fields, qs)
             (qv, dmin, dmax, dsum, dcount, drecip,
@@ -1475,12 +1495,7 @@ class DeviceWorker:
         if swapped.staged_histo:
             # histo block skipped (no rows): planes can hold nothing
             # meaningful, but C++ memory must still be released
-            for item in swapped.staged_histo:
-                if item[3] is not None:
-                    try:
-                        item[3]()
-                    except Exception:  # pragma: no cover
-                        log.exception("staged plane free failed")
+            _free_staged_planes(swapped.staged_histo)
             swapped.staged_histo = None
         if swapped.mesh_out is not None:
             mout = swapped.mesh_out
