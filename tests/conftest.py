@@ -13,6 +13,13 @@ init, so setting it here (before the first jax computation) is early enough.
 
 import os
 
+# Skip the startup flush-program warmup in CLI subprocess tests (env
+# overlay reaches them through load_config): each fresh process would
+# otherwise pay the full XLA compile, blowing restart-test deadlines on
+# a loaded single-core runner. In-process test servers share the jit
+# cache, so warmup is nearly free there and stays on.
+os.environ.setdefault("VENEUR_TPU_WARMUP_COMPILE", "false")
+
 if not os.environ.get("VENEUR_TPU_TEST_REAL"):
     _want = "--xla_force_host_platform_device_count=8"
     flags = os.environ.get("XLA_FLAGS", "")

@@ -157,6 +157,10 @@ class Config:
     # (watchdog, fd-handoff upgrades) reuse compiled programs instead of
     # re-paying it. Empty = disabled.
     tpu_compilation_cache_dir: str = ""
+    # precompile the flush programs at startup (background thread, first
+    # row bucket) so the first real flush doesn't pay the per-shape XLA
+    # compile inside the interval
+    tpu_warmup_compile: bool = True
 
     # self-telemetry & debugging
     debug: bool = False

@@ -998,8 +998,38 @@ class Server:
                 except OSError:
                     pass
         self._inherited.clear()
+        if self.config.tpu_warmup_compile:
+            self._spawn(self._warmup_compile, "warmup-compile")
         self._spawn(self._flush_loop, "flush-ticker")
         return ports
+
+    def _warmup_compile(self) -> None:
+        """Precompile the flush programs (staged fold + extraction) on a
+        throwaway worker at the first pow2 row bucket, concurrent with
+        startup. Without this the FIRST real flush pays the 20-40s
+        per-shape XLA compile on TPU inside the interval — enough to trip
+        a tight flush watchdog on a perfectly healthy server. Later
+        growth buckets still compile lazily (and land in the persistent
+        cache when tpu_compilation_cache_dir is set)."""
+        try:
+            from veneur_tpu.core.flusher import device_quantiles
+
+            w = DeviceWorker(
+                batch_size=self.config.tpu_batch_size,
+                stage_depth=self.config.tpu_stage_depth,
+                compression=self.config.tpu_compression,
+                hll_precision=self.config.tpu_hll_precision,
+                is_local=self.is_local,
+            )
+            w.process_metric(
+                dogstatsd.parse_metric(b"veneur.warmup:1|ms"))
+            qs = device_quantiles(self.percentiles, self.aggregates)
+            w.flush(qs, interval_s=self.interval)
+            log.debug("flush programs warm (first row bucket)")
+        except Exception:
+            # warmup is best-effort: a failure only restores the lazy
+            # first-flush compile
+            log.debug("flush warmup failed", exc_info=True)
 
     def _flush_loop(self) -> None:
         """Interval ticker, optionally aligned to the wall clock
