@@ -889,9 +889,9 @@ class Server:
         with flock exclusivity and abstract-socket (@name) support."""
         sock = self._bind_unix_socket(path, socket.SOCK_DGRAM)
         # same datagram semantics as UDP: the C++ reader works on any
-        # bound datagram fd
+        # bound datagram fd (_bind_unix_socket already registered the
+        # socket in self._sockets, keeping the fd alive for the thread)
         if self._start_native_metric_reader(sock):
-            self._sockets.append(sock)
             return
         self._spawn(
             lambda: self._read_metric_socket(sock, handoff_capable=False),
@@ -1019,6 +1019,9 @@ class Server:
                 stage_depth=self.config.tpu_stage_depth,
                 compression=self.config.tpu_compression,
                 hll_precision=self.config.tpu_hll_precision,
+                # must mirror the real workers' initial pool size or the
+                # warmed shapes differ from the first real flush's
+                initial_histo_rows=self.config.tpu_initial_histo_rows,
                 is_local=self.is_local,
             )
             w.process_metric(
@@ -1265,8 +1268,12 @@ class Server:
                              tags=[f"service:{svc}"])
         # statsd counters are per-interval increments: report the delta
         # (the property already totals the Python cells, the workers'
-        # attributed counts, and the undrained native delta)
-        errors_now = self.parse_errors
+        # attributed counts, and the undrained native delta). The
+        # property's reads aren't atomic vs a concurrent pump drain, so a
+        # snapshot can transiently run BEHIND the last report — clamp so
+        # a negative increment is never emitted; the next interval's
+        # delta absorbs it.
+        errors_now = max(self.parse_errors, self._errors_reported)
         self.stats.count("packet.error_total",
                          errors_now - self._errors_reported)
         self._errors_reported = errors_now
@@ -1356,7 +1363,15 @@ class Server:
         start = time.time()
         tags = [f"sink:{sink.name()}"]
         try:
-            sink.flush_columnar(batch, excluded_tags)
+            fn = getattr(sink, "flush_columnar", None)
+            if fn is not None:
+                fn(batch, excluded_tags)
+            else:
+                # duck-typed sink (name()/flush() without the MetricSink
+                # base): hand it the shared materialization, routed and
+                # tag-stripped like the object path would
+                metrics = filter_routed(batch.materialize(), sink.name())
+                sink.flush(strip_excluded_tags(metrics, excluded_tags))
         except Exception:
             log.exception("sink %s columnar flush failed", sink.name())
             self.stats.count("flush.error_total", 1, tags=tags)

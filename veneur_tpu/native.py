@@ -530,10 +530,22 @@ class NativeIngest:
                 out[svc_s] = out.get(svc_s, 0) + int(cnt)
         return out
 
-    def drain_ssf_fallback(self, cap: int = 1 << 20) -> list[bytes]:
+    def _drain_buf(self) -> ctypes.Array:
+        """Persistent 1 MiB drain scratch: the native pump polls
+        drain_other/drain_ssf_fallback 10x/s per context, and a fresh
+        zero-filled ctypes buffer per call was ~20 MiB/s of allocation
+        churn at idle. Callers run under the worker lock, which
+        serializes access."""
+        buf = getattr(self, "_drain_scratch", None)
+        if buf is None:
+            buf = self._drain_scratch = ctypes.create_string_buffer(1 << 20)
+        return buf
+
+    def drain_ssf_fallback(self) -> list[bytes]:
         """Raw SSF payloads the native reader handed back for the Python
         path (STATUS samples aboard), as whole packets."""
-        buf = ctypes.create_string_buffer(cap)
+        buf = self._drain_buf()
+        cap = len(buf)
         out = []
         while True:
             n = self._lib.vn_drain_ssf_fallback(self._ctx, buf, cap)
@@ -548,8 +560,8 @@ class NativeIngest:
         return out
 
     def drain_other(self) -> list[bytes]:
-        cap = 1 << 20
-        buf = ctypes.create_string_buffer(cap)
+        buf = self._drain_buf()
+        cap = len(buf)
         out = []
         while True:
             # chunks are cut on line boundaries (so n < cap does NOT
