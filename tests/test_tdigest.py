@@ -222,3 +222,45 @@ def test_incremental_vs_bulk():
     for q, b, i, t in zip(qs, eb, ei, truth):
         assert abs(b - t) / t < 0.02, f"bulk q={q}"
         assert abs(i - t) / t < 0.02, f"incremental q={q}"
+
+
+def test_staged_fold_quantile_accuracy():
+    """The <1% q-space error budget holds through the round-4 cadence —
+    one staged-plane fold per interval (fewer compressions than the
+    per-batch path, so accuracy should be at least as good)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from veneur_tpu.core.worker import _histo_fold_staged
+
+    rng = np.random.default_rng(11)
+    S, B, intervals = 64, 256, 4
+    pool = td.init_pool(S, td.DEFAULT_CAPACITY)
+
+    def _full(v):
+        return jnp.full((S,), v, jnp.float32)
+
+    fields = [pool.means, pool.weights, pool.min, pool.max, pool.recip,
+              _full(0.0), _full(np.inf), _full(-np.inf), _full(0.0),
+              _full(0.0), _full(0.0), _full(0.0), _full(0.0), _full(0.0)]
+    all_vals = [[] for _ in range(S)]
+    for _ in range(intervals):
+        sv = rng.gamma(2.0, 50.0, (S, B)).astype(np.float32)
+        sw = np.ones((S, B), np.float32)
+        for r in range(S):
+            all_vals[r].extend(sv[r])
+        fields = list(_histo_fold_staged(
+            *fields, jnp.asarray(sv), jnp.asarray(sw)))
+
+    qs = jnp.asarray(np.array([0.25, 0.5, 0.9, 0.99], np.float32))
+    quant = np.asarray(td.quantile(fields[0], fields[1], fields[2],
+                                   fields[3], qs))
+    worst = 0.0
+    for r in range(S):
+        vals = np.sort(np.asarray(all_vals[r]))
+        n = len(vals)
+        for j, q in enumerate((0.25, 0.5, 0.9, 0.99)):
+            # q-space error: where the reported value actually sits in
+            # the empirical distribution vs where it should
+            pos = np.searchsorted(vals, quant[r, j]) / n
+            worst = max(worst, abs(pos - q))
+    assert worst < 0.01, f"q-space error {worst:.4f} exceeds the 1% budget"
