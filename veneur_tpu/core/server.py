@@ -1255,6 +1255,13 @@ class Server:
                 "flush.unique_timeseries_total", self._tally_timeseries(snaps),
                 tags=[f"global_veneur:{str(not self.is_local).lower()}"])
         self.stats.count("flush.post_metrics_total", n_flushed)
+        # per-phase wall times as self-metrics (the reference samples its
+        # flush phases via ssf.Timing in tallyMetrics/generateInterMetrics,
+        # flusher.go:169-298; ours are exact phase boundaries)
+        for phase_name, secs in phases.items():
+            self.stats.time_in_nanoseconds(
+                "flush.phase_duration_ns", secs * 1e9,
+                tags=[f"phase:{phase_name.removesuffix('_s')}"])
         from veneur_tpu.core.worker import DeviceWorker as _DW
 
         if _DW.pallas_fallbacks:
