@@ -477,6 +477,31 @@ def build_hll_merge(mesh: Mesh):
     ))
 
 
+def build_sharded_staged_fold(mesh: Mesh, compression: float = 100.0):
+    """The round-4 local-tier flush program over a device mesh: digest
+    pool rows AND the raw-sample staging plane shard over every device
+    (hosts × series — the local tier's series space is flat over the
+    mesh), each shard folding its own [S_loc, B] plane independently.
+    Embarrassingly parallel: no collectives; cross-host digest MERGING
+    is the global tier's job (build_sharded_flush_step).
+
+    Returns fn(fields14..., svals, swts) -> fields14, all arrays row-
+    sharded."""
+    from veneur_tpu.core.worker import _histo_fold_staged
+
+    rows = P(("hosts", "series"))
+    spec2 = NamedSharding(mesh, P(("hosts", "series"), None))
+    spec1 = NamedSharding(mesh, rows)
+
+    def _fold(*args):
+        return _histo_fold_staged.__wrapped__(
+            *args, compression=compression)
+
+    in_sh = tuple([spec2, spec2] + [spec1] * 12 + [spec2, spec2])
+    out_sh = tuple([spec2, spec2] + [spec1] * 12)
+    return jax.jit(_fold, in_shardings=in_sh, out_shardings=out_sh)
+
+
 def build_counter_merge(mesh: Mesh):
     """Counter sum across hosts (the trivial segment-sum analog)."""
 
