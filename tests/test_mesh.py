@@ -252,3 +252,36 @@ def test_mesh_pool_bulk_matches_scalar_path():
     np.testing.assert_array_equal(oa["dcount"], ob["dcount"])
     # identical samples, same shard layout → near-identical quantiles
     np.testing.assert_allclose(oa["quant"], ob["quant"], rtol=0.05)
+
+
+def test_sharded_staged_fold_matches_single_device(mesh8):
+    """The mesh-sharded round-4 fold produces exactly the single-device
+    fold's digests (row-parallel program, sharding must be a no-op on
+    values)."""
+    from veneur_tpu.core.worker import _histo_fold_staged
+
+    s_total, b = 32, 8
+    rng = np.random.default_rng(3)
+    sv = rng.gamma(2.0, 50.0, (s_total, b)).astype(np.float32)
+    sw = np.ones((s_total, b), np.float32)
+
+    def fresh_fields():
+        pool = td.init_pool(s_total, td.DEFAULT_CAPACITY)
+
+        def _full(v):
+            return jnp.full((s_total,), v, jnp.float32)
+
+        return [pool.means, pool.weights, pool.min, pool.max, pool.recip,
+                _full(0.0), _full(np.inf), _full(-np.inf), _full(0.0),
+                _full(0.0), _full(0.0), _full(0.0), _full(0.0), _full(0.0)]
+
+    sharded = mesh_mod.build_sharded_staged_fold(mesh8)(
+        *fresh_fields(), sv, sw)
+    single = _histo_fold_staged(
+        *fresh_fields(), jnp.asarray(sv), jnp.asarray(sw))
+    np.testing.assert_allclose(np.asarray(sharded[0]),
+                               np.asarray(single[0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sharded[1]),
+                               np.asarray(single[1]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sharded[2]),
+                               np.asarray(single[2]), rtol=1e-6)
