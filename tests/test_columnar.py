@@ -354,3 +354,35 @@ def test_prometheus_columnar_lines(monkeypatch):
 
     # the native emitter sends one newline-joined blob; line sets match
     assert flat(sent[0]) == flat(sent[1])
+
+
+def test_server_duck_typed_sink_still_fed():
+    """A metric sink that implements only name()/flush() (no MetricSink
+    base, no flush_columnar) still receives the flush through the
+    shared materialization."""
+    got = []
+
+    class DuckSink:
+        def name(self):
+            return "duck"
+
+        def start(self, trace_client=None):
+            pass
+
+        def flush(self, metrics):
+            got.extend(metrics)
+
+        def flush_other_samples(self, samples):
+            pass
+
+        def stop(self):
+            pass
+
+    cfg = Config(interval="10s", percentiles=[], aggregates=["count"])
+    srv = Server(cfg, metric_sinks=[DuckSink()])
+    try:
+        srv.process_metric_packet(b"d:3|ms")
+        srv.flush()
+        assert [m.name for m in got] == ["d.count"]
+    finally:
+        srv.shutdown()
