@@ -777,3 +777,26 @@ def test_sampled_timers_weighted_through_native_plane():
         assert by_key[("sr.t.max", MetricType.GAUGE)].value == 40.0
     finally:
         srv.shutdown()
+
+
+def test_pool_growth_under_native_staging():
+    """Series count far past tpu_initial_histo_rows: the device pool and
+    the C++ staging plane grow on their own pow2 schedules and the
+    extract reconciles them (slice/pad) without losing samples."""
+    srv, _, ports = _server(num_workers=1, tpu_initial_histo_rows=256)
+    try:
+        port = next(iter(ports.values()))
+        n_series = 2000
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for i in range(n_series):
+            s.sendto(b"gr.t%d:%d|ms" % (i, i % 100), ("127.0.0.1", port))
+        s.close()
+        assert _wait_for(lambda: srv.packets_received >= n_series, 10.0)
+        assert _wait_for(
+            lambda: sum(w.processed for w in srv.workers) >= n_series, 10.0)
+        metrics = srv.flush()
+        counts = [m for m in metrics if m.name.endswith(".count")]
+        assert len(counts) == n_series
+        assert all(m.value == 1.0 for m in counts)
+    finally:
+        srv.shutdown()
