@@ -800,3 +800,31 @@ def test_pool_growth_under_native_staging():
         assert all(m.value == 1.0 for m in counts)
     finally:
         srv.shutdown()
+
+
+def test_native_reader_survives_garbage_fuzz():
+    """Random bytes straight into the C++ reader: no crash, every
+    datagram accounted (accepted or counted as parse error), server
+    flushes normally afterwards."""
+    import os as _os
+
+    srv, _, ports = _server(num_workers=1)
+    try:
+        port = next(iter(ports.values()))
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rng = __import__("random").Random(7)
+        n = 300
+        for i in range(n):
+            size = rng.choice((0, 1, 7, 63, 512, 1400))
+            s.sendto(bytes(rng.getrandbits(8) for _ in range(size)),
+                     ("127.0.0.1", port))
+        s.sendto(b"fz.ok:1|c", ("127.0.0.1", port))
+        s.close()
+        assert _wait_for(lambda: srv.packets_received >= n + 1, 10.0)
+        metrics = srv.flush()
+        assert any(m.name == "fz.ok" for m in metrics)
+        # garbage was counted, not silently swallowed (newline-split
+        # lines can each count, so >= is the right bound)
+        assert srv.parse_errors >= 1
+    finally:
+        srv.shutdown()
