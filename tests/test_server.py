@@ -464,7 +464,7 @@ def test_listener_fd_handoff_ssf_listener():
     port = ports[spec]
     try:
         manifest = srv_a.prepare_handoff()
-        assert manifest.get(spec), manifest
+        assert manifest.get("ssf:" + spec), manifest
         # queued while no reader is consuming
         from veneur_tpu import ssf
         from veneur_tpu.protocol import ssf_wire

@@ -614,13 +614,19 @@ class Server:
         ports = {}
         for spec in self.config.ssf_listen_addresses:
             proto, _, rest = spec.partition("://")
+            # fd-manifest key is namespaced: a statsd listener with the
+            # IDENTICAL spec string (e.g. both "udp://127.0.0.1:0") must
+            # not cross-wire its handed-off fds with this one's
+            key = "ssf:" + spec
             if proto == "udp":
-                self._adopt = list(self._inherited.pop(spec, []))
+                self._adopt = list(
+                    self._inherited.pop(key, None)
+                    or self._inherited.pop(spec, []))  # pre-ns manifests
                 before = len(self._sockets)
                 host, _, port = rest.rpartition(":")
                 ports[spec] = self.start_ssf_udp(host or "127.0.0.1",
                                                  int(port))
-                self._listener_fds[spec] = [
+                self._listener_fds[key] = [
                     s.fileno() for s in self._sockets[before:]]
                 self._close_unused_adopted()
             elif proto in ("unix", "unixstream"):
@@ -985,7 +991,11 @@ class Server:
             sink.start()
         self.span_worker.start()
         ports = self.start_listeners()
-        ports.update(self.start_ssf_listeners())
+        for spec, port in self.start_ssf_listeners().items():
+            # identical spec on both listener lists (e.g. two ephemeral
+            # "udp://127.0.0.1:0" binds): don't let the SSF port shadow
+            # the statsd one in the report
+            ports["ssf:" + spec if spec in ports else spec] = port
         # inherited fds whose listener spec left the config: close them,
         # or the old port stays bound with no reader and blackholes
         # traffic silently (clients get no ICMP error)
