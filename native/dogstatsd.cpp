@@ -18,6 +18,7 @@
 #include <fcntl.h>
 #include <sys/socket.h>
 #include <sys/time.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <atomic>
@@ -1648,6 +1649,113 @@ long long vn_reader_stop(void* p) {
   long long final_count = r->packets.load(std::memory_order_relaxed);
   delete r;
   return final_count;
+}
+
+// Line-delimited TCP stream reader: one C++ thread per plain (non-TLS)
+// statsd connection. Reassembles newline-split lines across reads and
+// routes them like the datagram readers; an overlong partial line is
+// dropped (counted) and the reader skips to the next newline. The
+// reader OWNS the fd and closes it on exit — the Python side dup()s the
+// accepted socket before handing it over.
+void* vn_stream_reader_start(void** ctxps, int nctx, int fd, int max_len);
+long long vn_stream_reader_stop(void* p);
+
+namespace {
+
+struct StreamReader {
+  std::thread th;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> finished{false};  // loop exited (peer closed/error)
+  std::atomic<long long> lines{0};
+  int fd = -1;
+  int max_len = 0;
+  std::vector<Ctx*> ctxs;
+};
+
+void stream_reader_loop(StreamReader* r) {
+  std::vector<char> chunk(64 << 10);
+  std::string buf;
+  bool skipping = false;  // inside an overlong line, waiting for \n
+  while (!r->stop.load(std::memory_order_acquire)) {
+    ssize_t n = recv(r->fd, chunk.data(), chunk.size(), 0);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+        continue;  // SO_RCVTIMEO tick: poll the stop flag
+      break;
+    }
+    if (n == 0) break;  // peer closed
+    buf.append(chunk.data(), static_cast<size_t>(n));
+    size_t start = 0, nl;
+    while ((nl = buf.find('\n', start)) != std::string::npos) {
+      size_t len = nl - start;
+      if (skipping) {
+        skipping = false;  // tail of the dropped overlong line
+      } else if (len > 0) {
+        if (len > static_cast<size_t>(r->max_len)) {
+          std::lock_guard<std::recursive_mutex> g(r->ctxs[0]->mu);
+          ++r->ctxs[0]->errors;
+        } else {
+          vn_ingest_routed(reinterpret_cast<void**>(r->ctxs.data()),
+                           static_cast<int>(r->ctxs.size()),
+                           buf.data() + start, static_cast<int>(len));
+          r->lines.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+      start = nl + 1;
+    }
+    buf.erase(0, start);
+    if (!skipping && buf.size() > static_cast<size_t>(r->max_len)) {
+      // partial line already too long: drop it now (bounded memory;
+      // the Python path buffers unboundedly here)
+      std::lock_guard<std::recursive_mutex> g(r->ctxs[0]->mu);
+      ++r->ctxs[0]->errors;
+      buf.clear();
+      skipping = true;
+    }
+  }
+  close(r->fd);
+  r->finished.store(true, std::memory_order_release);
+}
+
+}  // namespace
+
+// True once the reader's loop exited (peer closed / error): the handle
+// should be reaped with vn_stream_reader_stop — an unjoined dead thread
+// pins its stack for the process lifetime.
+int vn_stream_reader_done(void* p) {
+  return static_cast<StreamReader*>(p)->finished.load(
+             std::memory_order_acquire)
+             ? 1
+             : 0;
+}
+
+void* vn_stream_reader_start(void** ctxps, int nctx, int fd, int max_len) {
+  int fl = fcntl(fd, F_GETFL);
+  if (fl < 0) return nullptr;
+  if ((fl & O_NONBLOCK) && fcntl(fd, F_SETFL, fl & ~O_NONBLOCK) < 0)
+    return nullptr;
+  struct timeval tv;
+  tv.tv_sec = 0;
+  tv.tv_usec = 500000;
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv) != 0)
+    return nullptr;
+  StreamReader* r = new StreamReader();
+  r->fd = fd;
+  r->max_len = max_len;
+  for (int i = 0; i < nctx; ++i)
+    r->ctxs.push_back(static_cast<Ctx*>(ctxps[i]));
+  r->th = std::thread(stream_reader_loop, r);
+  return r;
+}
+
+// Join and free; returns lines ingested. The reader closes its fd.
+long long vn_stream_reader_stop(void* p) {
+  StreamReader* r = static_cast<StreamReader*>(p);
+  r->stop.store(true, std::memory_order_release);
+  if (r->th.joinable()) r->th.join();
+  long long total = r->lines.load(std::memory_order_relaxed);
+  delete r;
+  return total;
 }
 
 // SSF variant of vn_reader_start: one unframed span per datagram on the

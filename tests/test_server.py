@@ -828,3 +828,38 @@ def test_native_reader_survives_garbage_fuzz():
         assert srv.parse_errors >= 1
     finally:
         srv.shutdown()
+
+
+def test_tcp_native_stream_reader_fragmentation():
+    """The C++ stream reader reassembles lines across arbitrary send
+    boundaries, drops overlong lines whole (counted), and its reader is
+    reaped after the peer closes."""
+    srv, _, ports = _server(
+        statsd_listen_addresses=["tcp://127.0.0.1:0"], num_workers=1)
+    try:
+        if not srv.native_mode:
+            pytest.skip("native library unavailable")
+        port = next(iter(ports.values()))
+        c = socket.create_connection(("127.0.0.1", port))
+        # a line split across three sends
+        c.sendall(b"frag.c")
+        time.sleep(0.05)
+        c.sendall(b":4")
+        time.sleep(0.05)
+        c.sendall(b"|c\n")
+        # two lines in one send + an overlong line + a good trailer
+        c.sendall(b"frag.c:1|c\nfrag.t:9|ms\n")
+        c.sendall(b"x" * 5000 + b"\n")
+        c.sendall(b"frag.c:2|c\n")
+        c.close()
+        assert _wait_for(
+            lambda: sum(w.processed for w in srv.workers) >= 4, 10.0)
+        assert _wait_for(lambda: srv.parse_errors >= 1, 10.0)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        assert by_key[("frag.c", MetricType.COUNTER)].value == 7.0
+        assert by_key[("frag.t.count", MetricType.COUNTER)].value == 1.0
+        # reap: the closed connection's reader is joined by the pump
+        assert _wait_for(lambda: not srv._native_stream_readers, 5.0)
+    finally:
+        srv.shutdown()

@@ -240,6 +240,7 @@ class Server:
         # packet counts after stop (the handle dies with the thread)
         self._native_readers: list = []
         self._native_ssf_readers: list = []
+        self._native_stream_readers: list = []
         self._native_reader_packets_stopped = 0
         self._native_reader_lock = threading.Lock()
         if cfg.tpu_native_ingest:
@@ -737,12 +738,29 @@ class Server:
                     self._drain_native_thresholds()
                     self._drain_native_events()
                     self._drain_native_ssf_fallbacks()
+                    self._reap_stream_readers()
                 except Exception:
                     if self._shutdown.is_set():
                         return
                     raise
 
         self._spawn(pump, "native-pump")
+
+    def _reap_stream_readers(self) -> None:
+        """Join C++ stream readers whose connection ended — an unjoined
+        dead thread pins its stack for the process lifetime, and TCP
+        connection churn would accumulate them."""
+        with self._native_reader_lock:
+            live = []
+            for h in self._native_stream_readers:
+                try:
+                    if self._native_router.stream_reader_done(h):
+                        self._native_router.stop_stream_reader(h)
+                    else:
+                        live.append(h)
+                except Exception:
+                    log.exception("stream reader reap failed")
+            self._native_stream_readers = live
 
     def _stop_native_readers(self) -> None:
         """Join the C++ reader threads WITHOUT closing their fds (handoff
@@ -767,6 +785,15 @@ class Server:
                     self._native_router.stop_ssf_reader(h)
                 except Exception:
                     log.exception("native SSF reader stop failed")
+            stream_readers = self._native_stream_readers
+            self._native_stream_readers = []
+            for h in stream_readers:
+                try:
+                    # stream readers own their (dup'd) conn fds and close
+                    # them; TCP connections don't ride the handoff
+                    self._native_router.stop_stream_reader(h)
+                except Exception:
+                    log.exception("native stream reader stop failed")
 
     def _read_metric_socket(self, sock: socket.socket,
                             handoff_capable: bool = True) -> None:
@@ -823,6 +850,28 @@ class Server:
                 except OSError:
                     return
                 conn.settimeout(None)
+                if (ssl_ctx is None and self.native_mode
+                        and self.config.tpu_native_readers):
+                    # plain TCP: a C++ line-stream reader owns the
+                    # connection (TLS must stay Python — ssl wraps the
+                    # socket object). Reader gets its own dup so the
+                    # Python socket can be closed here; the pump reaps
+                    # finished readers.
+                    fd = None
+                    try:
+                        fd = os.dup(conn.fileno())
+                        h = self._native_router.start_stream_reader(
+                            fd, self.config.metric_max_length)
+                        with self._native_reader_lock:
+                            self._native_stream_readers.append(h)
+                        conn.close()
+                        self._start_native_pump()
+                        continue
+                    except (AttributeError, RuntimeError) as e:
+                        if fd is not None:
+                            os.close(fd)
+                        log.warning("native stream reader unavailable "
+                                    "(%s); using the Python handler", e)
                 self._spawn(
                     lambda c=conn, p=peer: self._handle_tcp_conn(c, p, ssl_ctx),
                     "statsd-tcp-conn",

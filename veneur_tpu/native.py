@@ -216,6 +216,13 @@ def load_library() -> Optional[ctypes.CDLL]:
             lib.vn_reader_packets.argtypes = [c.c_void_p]
             lib.vn_reader_stop.restype = c.c_longlong
             lib.vn_reader_stop.argtypes = [c.c_void_p]
+            lib.vn_stream_reader_start.restype = c.c_void_p
+            lib.vn_stream_reader_start.argtypes = [
+                c.POINTER(c.c_void_p), c.c_int, c.c_int, c.c_int]
+            lib.vn_stream_reader_stop.restype = c.c_longlong
+            lib.vn_stream_reader_stop.argtypes = [c.c_void_p]
+            lib.vn_stream_reader_done.restype = c.c_int
+            lib.vn_stream_reader_done.argtypes = [c.c_void_p]
             lib.vn_ssf_reader_start.restype = c.c_void_p
             lib.vn_ssf_reader_start.argtypes = [
                 c.c_void_p, c.c_int, c.c_int, c.c_char_p, c.c_int,
@@ -857,6 +864,22 @@ class NativeRouter:
         keeps ingesting up to one recv-timeout tick after the stop flag;
         a pre-join snapshot would undercount)."""
         return int(self._lib.vn_reader_stop(handle))
+
+    def start_stream_reader(self, fd: int, max_len: int):
+        """Spawn a C++ line-stream reader for a plain TCP connection.
+        The reader OWNS fd (pass a dup) and closes it on exit; reap
+        finished readers with stream_reader_done + stop_stream_reader."""
+        h = self._lib.vn_stream_reader_start(self._arr, self._n, fd,
+                                             max_len)
+        if not h:
+            raise RuntimeError("vn_stream_reader_start failed")
+        return h
+
+    def stream_reader_done(self, handle) -> bool:
+        return bool(self._lib.vn_stream_reader_done(handle))
+
+    def stop_stream_reader(self, handle) -> int:
+        return int(self._lib.vn_stream_reader_stop(handle))
 
     def start_ssf_reader(self, ctx_owner: "NativeIngest", fd: int,
                          max_len: int, indicator: bytes, objective: bytes,
