@@ -863,3 +863,19 @@ def test_tcp_native_stream_reader_fragmentation():
         assert _wait_for(lambda: not srv._native_stream_readers, 5.0)
     finally:
         srv.shutdown()
+
+
+def test_shutdown_with_live_tcp_connection_is_prompt():
+    """shutdown() must join an ACTIVE C++ stream reader promptly (the
+    500ms recv timeout polls the stop flag) without waiting for the
+    peer to close."""
+    srv, _, ports = _server(
+        statsd_listen_addresses=["tcp://127.0.0.1:0"], num_workers=1)
+    port = next(iter(ports.values()))
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(b"live.c:1|c\n")
+    assert _wait_for(lambda: sum(w.processed for w in srv.workers) >= 1)
+    t0 = time.time()
+    srv.shutdown()  # connection still open, reader mid-recv
+    assert time.time() - t0 < 5.0
+    c.close()
