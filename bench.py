@@ -64,9 +64,10 @@ def _remaining() -> float:
 class _axon_lock:
     """Bounded exclusive lock: if another process (the background
     capture loop) holds the relay mid-capture, wait a little — but never
-    long. Lock wait counts against the caller's budget; proceeding
-    without the lock risks a concurrent-init wedge, which is still
-    better than the driver killing a bench that never started."""
+    long. Lock wait counts against the caller's budget. On timeout the
+    lock is NOT acquired (``acquired=False``) and callers must fall back
+    to cached/CPU results: proceeding lockless would concurrently init
+    the relay against the holder and wedge both (round-2 failure)."""
 
     def __init__(self, timeout: float | None = None):
         self._timeout = (float(os.environ.get("VENEUR_AXON_LOCK_TIMEOUT",
@@ -76,6 +77,7 @@ class _axon_lock:
 
     def __enter__(self):
         self._f = open(_AXON_LOCK, "w")
+        self.acquired = True
         t0 = time.time()
         deadline = t0 + min(self._timeout, max(0.0, _remaining()))
         while True:
@@ -86,8 +88,12 @@ class _axon_lock:
             except OSError:
                 if time.time() >= deadline:
                     self.waited = time.time() - t0
-                    print("bench: axon lock busy past deadline; "
-                          "proceeding without it", file=sys.stderr)
+                    # do NOT proceed lockless: the holder (a capture
+                    # all-pass can own the relay for most of an hour) is
+                    # mid-flight on the chip, and a concurrent backend
+                    # init wedges BOTH (round-2 failure mode). Callers
+                    # fall back to cached/CPU results instead.
+                    self.acquired = False
                     return self
                 time.sleep(2.0)
 
@@ -113,6 +119,10 @@ def _ensure_live_backend() -> None:
     try:
         lock = _axon_lock(timeout=budget / 2)
         with lock:
+            if not lock.acquired:
+                raise RuntimeError(
+                    "axon relay lock busy (a capture pass owns the chip); "
+                    "not probing — cached on-chip numbers will be used")
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; print(jax.devices(), flush=True)"],
@@ -594,6 +604,14 @@ WORKLOADS = {
     "prometheus_1m": prometheus_1m,
 }
 
+# THE canonical run order (ascending host->device upload volume, headline
+# last so a tail-capturing driver records it as the primary number).
+# bench_capture.py derives its workload set from this — add new workloads
+# here exactly once.
+WORKLOAD_ORDER = ("ssf_histo", "global_merge", "mixed", "prometheus_1m",
+                  "timer_replay")
+assert set(WORKLOAD_ORDER) == set(WORKLOADS)
+
 
 def _run_workload_subprocess(wname: str, timeout_s: float,
                              cpu: bool = False) -> dict:
@@ -614,6 +632,10 @@ def _run_workload_subprocess(wname: str, timeout_s: float,
     else:
         lock = _axon_lock()
         with lock:
+            if not lock.acquired:
+                raise RuntimeError(
+                    "axon relay lock busy (capture pass in flight); "
+                    "skipping live on-chip run for this workload")
             # lock wait counts against this workload's budget, same as
             # the probe's — otherwise a busy capture loop silently adds
             # up to 90s per workload on top of the planned schedule
@@ -648,23 +670,46 @@ def _cached_result(wname: str) -> dict | None:
     return res
 
 
+def _emit(result: dict) -> None:
+    import jax
+
+    backend = jax.default_backend()
+    # normalize so cache checks and the judge's platform filter both
+    # see "tpu" for the tunnelled chip
+    result["platform"] = _normalize_backend(backend)
+    if backend != result["platform"]:
+        result["backend"] = backend
+    print(json.dumps(result), flush=True)
+
+
 def main() -> None:
     name = os.environ.get("VENEUR_BENCH_WORKLOAD")
+    if name == "all":
+        # all five workloads in THIS process: ONE backend init amortized
+        # across the pass. Over the tunnelled relay a cold init can take
+        # minutes (TPU_BACKEND.md), so one-child-per-workload pays that
+        # price five times — this mode pays it once. Lines stream as each
+        # workload completes, so a kill mid-pass keeps earlier results;
+        # order is by ascending host->device upload volume so a timeout
+        # preserves the most workloads (headline still last).
+        import faulthandler
+
+        faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
+        for wname in WORKLOAD_ORDER:
+            try:
+                result = WORKLOADS[wname]()
+                result["workload"] = wname
+                _emit(result)
+            except Exception as e:  # keep going: later workloads still run
+                print(f"bench: {wname} failed in-process: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+        return
     if name:
         workload = WORKLOADS.get(name)
         if workload is None:
             sys.exit(f"unknown VENEUR_BENCH_WORKLOAD {name!r}; "
                      f"valid: {', '.join(sorted(WORKLOADS))}")
-        result = workload()
-        import jax
-
-        backend = jax.default_backend()
-        # normalize so cache checks and the judge's platform filter both
-        # see "tpu" for the tunnelled chip
-        result["platform"] = _normalize_backend(backend)
-        if backend != result["platform"]:
-            result["backend"] = backend
-        print(json.dumps(result), flush=True)
+        _emit(workload())
         return
     # No selector: run ALL five BASELINE workloads, one JSON line each,
     # each in its own child process under a budget derived from the hard
@@ -676,8 +721,7 @@ def main() -> None:
     per_workload_s = float(os.environ.get("VENEUR_BENCH_WORKLOAD_TIMEOUT",
                                           300))
     on_cpu = bool(os.environ.get("_VENEUR_BENCH_REEXEC"))
-    order = ("mixed", "global_merge", "ssf_histo", "prometheus_1m",
-             "timer_replay")
+    order = WORKLOAD_ORDER
     for i, wname in enumerate(order):
         left = len(order) - i
         result = None

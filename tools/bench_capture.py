@@ -27,15 +27,18 @@ import argparse
 import fcntl
 import json
 import os
+import signal
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CACHE = os.path.join(REPO, "BENCH_CACHE.json")
 LOCK_PATH = "/tmp/veneur_tpu_axon.lock"
-WORKLOADS = ("mixed", "global_merge", "ssf_histo", "prometheus_1m",
-             "timer_replay")
+sys.path.insert(0, REPO)
+from bench import WORKLOAD_ORDER as WORKLOADS  # noqa: E402  single source
 
 
 def axon_lock():
@@ -63,27 +66,65 @@ def probe(timeout: float = 480.0) -> str | None:
     return "tpu" if plat in ("tpu", "axon") else plat
 
 
-def run_workload(name: str, timeout: float = 900.0) -> dict | None:
+_current_child: subprocess.Popen | None = None
+
+
+def run_all_workloads(on_result, timeout: float = 3300.0) -> None:
+    """ONE child runs every workload (VENEUR_BENCH_WORKLOAD=all): the
+    relay's minutes-long cold backend init is paid once per pass instead
+    of once per workload (round 4 observed a single-workload child burn
+    its whole 900s budget inside init). The child streams one JSON line
+    per completed workload; each line is handed to ``on_result``
+    IMMEDIATELY so the caller can persist it — a kill of the child OR of
+    this process mid-pass loses at most the workload in flight."""
+    global _current_child
     env = dict(os.environ)
-    env["VENEUR_BENCH_WORKLOAD"] = name
+    env["VENEUR_BENCH_WORKLOAD"] = "all"
     env["_VENEUR_BENCH_CHILD"] = "1"
-    try:
-        r = subprocess.run(
+    # stderr to a file, not a pipe: the child's periodic faulthandler
+    # dumps could fill a pipe buffer and deadlock it mid-workload
+    with tempfile.TemporaryFile() as errf:
+        proc = subprocess.Popen(
             [sys.executable, os.path.join(REPO, "bench.py")],
-            env=env, timeout=timeout, capture_output=True, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        print(f"capture: {name} timed out after {timeout}s", file=sys.stderr)
-        return None
-    if r.returncode != 0:
-        tail = r.stderr.decode(errors="replace")[-500:]
-        print(f"capture: {name} rc={r.returncode}: {tail}", file=sys.stderr)
-        return None
-    try:
-        line = r.stdout.decode(errors="replace").strip().splitlines()[-1]
-        return json.loads(line)
-    except (IndexError, ValueError) as e:
-        print(f"capture: {name} bad output: {e}", file=sys.stderr)
-        return None
+            env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=errf)
+        _current_child = proc
+        timed_out = False
+
+        def _kill():
+            nonlocal timed_out
+            timed_out = True
+            proc.kill()
+
+        killer = threading.Timer(timeout, _kill)
+        killer.start()
+        try:
+            for raw in proc.stdout:
+                line = raw.decode(errors="replace").strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    on_result(json.loads(line))
+                except ValueError:
+                    continue
+            proc.wait()
+        finally:
+            killer.cancel()
+            # an exception escaping on_result (e.g. disk-full in the
+            # persist) must not orphan a child that is still using the
+            # relay: the lock releases as this unwinds, and the next
+            # probe would concurrently init against the orphan
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            _current_child = None
+        if timed_out or proc.returncode != 0:
+            errf.seek(0, os.SEEK_END)
+            errf.seek(max(0, errf.tell() - 1500))
+            tail = errf.read().decode(errors="replace")
+            why = (f"timed out after {timeout}s" if timed_out
+                   else f"rc={proc.returncode}")
+            print(f"capture: all-pass {why}; stderr tail:\n{tail}",
+                  file=sys.stderr)
 
 
 def git_rev() -> str:
@@ -105,28 +146,38 @@ def capture_all() -> bool:
         except Exception:
             existing = {}
     results = dict(existing)
-    complete = True
-    for name in WORKLOADS:
-        with axon_lock():
-            res = run_workload(name)
-        if res is None or res.get("platform") != "tpu":
-            complete = False
-            print(f"capture: {name}: no on-chip result this pass "
-                  f"(got {res and res.get('platform')})", file=sys.stderr)
-            continue
+    fresh: set = set()
+
+    def on_result(res: dict) -> None:
+        name = res.get("workload")
+        if name not in WORKLOADS or res.get("platform") != "tpu":
+            print(f"capture: skipping line (workload={name}, "
+                  f"platform={res.get('platform')})", file=sys.stderr)
+            return
         results[name] = res
-        # persist incrementally: a wedge mid-pass must not lose the
-        # workloads already captured
-        json.dump({
-            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                         time.gmtime()),
-            "captured_unix": time.time(),
-            "git_rev": git_rev(),
-            "platform": "tpu",
-            "results": results,
-        }, open(CACHE, "w"), indent=1)
+        fresh.add(name)
+        # persist the moment each workload lands: a wedge or kill
+        # mid-pass must not lose the workloads already captured.
+        # Atomic write (temp + rename): a signal mid-dump must not
+        # leave a truncated cache that loses every earlier capture.
+        tmp = CACHE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+                "captured_unix": time.time(),
+                "git_rev": git_rev(),
+                "platform": "tpu",
+                "results": results,
+            }, f, indent=1)
+        os.replace(tmp, CACHE)
         print(f"capture: {name}: {res}", file=sys.stderr)
-    return complete and all(n in results for n in WORKLOADS)
+
+    with axon_lock():
+        run_all_workloads(on_result)
+    # "complete" means THIS pass captured everything fresh — a stale
+    # pre-existing cache must not stop the loop from recapturing
+    return all(n in fresh for n in WORKLOADS)
 
 
 def capture_auxiliary() -> None:
@@ -177,6 +228,18 @@ def main() -> None:
                     help="seconds between probes while wedged")
     ap.add_argument("--max-hours", type=float, default=12.0)
     args = ap.parse_args()
+
+    def _reap(signum, frame):
+        # a SIGTERM'd loop must not leave an orphan bench child touching
+        # the relay: the next loop's probe would concurrently init the
+        # backend against it and wedge both
+        child = _current_child
+        if child is not None:
+            child.kill()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _reap)
+    signal.signal(signal.SIGINT, _reap)
 
     deadline = time.time() + args.max_hours * 3600
     while time.time() < deadline:
