@@ -55,6 +55,10 @@ def main() -> None:
     from veneur_tpu.sinks.blackhole import BlackholeMetricSink
 
     backend = jax.default_backend()
+    # the tunnelled chip may register as the experimental "axon"
+    # plugin but IS the real TPU; normalize so sizes and the
+    # artifact platform field treat it as one
+    backend = "tpu" if backend in ("tpu", "axon") else backend
     on_tpu = backend == "tpu"
     series = int(os.environ.get("VENEUR_E2E_SERIES",
                                 1 << 20 if on_tpu else 1 << 16))

@@ -151,7 +151,9 @@ def main() -> None:
 
     import jax
 
-    on_cpu = jax.default_backend() == "cpu"
+    backend = jax.default_backend()
+    backend = "tpu" if backend in ("tpu", "axon") else backend
+    on_cpu = backend == "cpu"
     series = int(os.environ.get(
         "VENEUR_OVERLAP_SERIES", 1 << 16 if on_cpu else 1 << 20))
     seconds = float(os.environ.get("VENEUR_OVERLAP_SECONDS", 6.0))
@@ -160,7 +162,7 @@ def main() -> None:
 
     lock = threading.Lock()
     out = {"series": series, "unit": "seconds",
-           "platform": jax.default_backend(),
+           "platform": backend,
            "device": str(jax.devices()[0])}
     if on_cpu:
         out["note"] = ("CPU run: the single shared core serializes the "

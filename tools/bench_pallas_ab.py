@@ -81,6 +81,7 @@ def main() -> None:
     from veneur_tpu.ops import pallas_kernels as pk
 
     backend = jax.default_backend()
+    backend = "tpu" if backend in ("tpu", "axon") else backend
     on_tpu = backend == "tpu"
     series = int(os.environ.get("VENEUR_AB_SERIES",
                                 1 << 20 if on_tpu else 1 << 14))

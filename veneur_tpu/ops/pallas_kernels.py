@@ -123,4 +123,8 @@ def flush_extract_reference(means, weights, dmin, dmax, qs):
 
 
 def supported() -> bool:
-    return jax.default_backend() == "tpu"
+    # the tunnelled chip may register under its experimental plugin name
+    # ("axon") while being a real TPU; if Pallas lowering nevertheless
+    # fails there, DeviceWorker._extract demotes to the XLA path and
+    # counts it in veneur.flush.pallas_fallback_total
+    return jax.default_backend() in ("tpu", "axon")
