@@ -188,6 +188,7 @@ def capture_auxiliary() -> None:
             ("tools/bench_overlap.py", "OVERLAP.json", 1200),
             ("tools/bench_pallas_ab.py", "PALLAS_AB.json", 1200),
             ("tools/bench_e2e_flush.py", "E2E_FLUSH.json", 1800),
+            ("tools/bench_e2e_flush.py --scaling", "E2E_SCALING.json", 2400),
             ("tools/profile_ingest.py", "PROFILE_INGEST_TPU.txt", 1200)):
         # skip if the artifact is already an on-TPU capture
         path = os.path.join(REPO, artifact)
@@ -199,10 +200,11 @@ def capture_auxiliary() -> None:
                 continue
         except (OSError, ValueError):
             pass
+        prog, *args = script.split()
         with axon_lock():
             try:
                 r = subprocess.run(
-                    [sys.executable, os.path.join(REPO, script)],
+                    [sys.executable, os.path.join(REPO, prog), *args],
                     timeout=timeout, capture_output=True, cwd=REPO)
             except subprocess.TimeoutExpired:
                 print(f"capture: {script} timed out", file=sys.stderr)

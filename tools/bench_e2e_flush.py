@@ -1,4 +1,4 @@
-"""End-to-end 1M-series Server.flush() latency, with phase breakdown.
+"""End-to-end Server.flush() latency, with phase breakdown.
 
 The kernel benches (bench.py prometheus_1m) time the raw t-digest
 extraction; this harness times the PRODUCT: a real Server with native
@@ -9,9 +9,10 @@ fan-out to a blackhole sink — against the reference's 10s interval
 budget (flusher.go:28-131; the north-star latency metric of
 BASELINE.md).
 
-Writes E2E_FLUSH.json at the repo root and prints one JSON line.
+Default: one size, written to E2E_FLUSH.json. With --scaling: a curve
+of sizes up to 1M series (on TPU), written to E2E_SCALING.json.
 
-Env: VENEUR_E2E_SERIES (default 2^20 on TPU, 2^17 elsewhere),
+Env: VENEUR_E2E_SERIES (default 2^20 on TPU, 2^16 elsewhere),
 VENEUR_E2E_SAMPLES_PER_SERIES (default 4).
 """
 
@@ -47,22 +48,25 @@ def build_datagrams(series: int, samples_per_series: int,
     return datagrams
 
 
-def main() -> None:
+def _backend() -> str:
     import jax
-
-    from veneur_tpu.core.config import Config
-    from veneur_tpu.core.server import Server
-    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
 
     backend = jax.default_backend()
     # the tunnelled chip may register as the experimental "axon"
     # plugin but IS the real TPU; normalize so sizes and the
     # artifact platform field treat it as one
-    backend = "tpu" if backend in ("tpu", "axon") else backend
-    on_tpu = backend == "tpu"
-    series = int(os.environ.get("VENEUR_E2E_SERIES",
-                                1 << 20 if on_tpu else 1 << 16))
-    per = int(os.environ.get("VENEUR_E2E_SAMPLES_PER_SERIES", 4))
+    return "tpu" if backend in ("tpu", "axon") else backend
+
+
+def run_one(series: int, per: int) -> dict:
+    """Cold pass (pool growth + XLA compile) then one steady-state
+    ingest+flush round — the reference's world, where every 10s interval
+    sees the same series again and reuses everything (metrics expire at
+    flush, README.md:135-137, so each round re-registers all series in a
+    fresh epoch). Returns the steady-state measurements."""
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
 
     cfg = Config(interval="10s", percentiles=[0.5, 0.9, 0.99],
                  aggregates=["min", "max", "count"],
@@ -76,12 +80,6 @@ def main() -> None:
     datagrams = build_datagrams(series, per, cfg.metric_max_length)
     gen_s = time.perf_counter() - t0
 
-    # round 1 is the cold pass: the pool grows to its full shape and XLA
-    # compiles the ingest/extraction programs for it. Round 2 is the
-    # steady state being measured — the reference's world, where every
-    # 10s interval sees the same series again and reuses everything
-    # (metrics expire at flush, README.md:135-137, so each round
-    # re-registers all series in a fresh epoch).
     rounds = []
     for _ in range(2):
         t0 = time.perf_counter()
@@ -93,13 +91,12 @@ def main() -> None:
         flush_s = time.perf_counter() - t0
         rounds.append((ingest_s, flush_s, dict(srv.last_flush_phases),
                        len(final)))
+    srv.shutdown()
     cold_ingest_s, cold_flush_s, _, _ = rounds[0]
     ingest_s, flush_s, phases, n_final = rounds[1]
-    final_count = n_final
 
     n_samples = series * per
-    out = {
-        "platform": backend,
+    return {
         "series": series,
         "samples": n_samples,
         "datagram_gen_s": round(gen_s, 3),
@@ -109,14 +106,55 @@ def main() -> None:
         "ingest_samples_per_s": round(n_samples / ingest_s, 1),
         "flush_total_s": round(flush_s, 3),
         "flush_phases": {k: round(v, 3) for k, v in phases.items()},
-        "inter_metrics": final_count,
-        "inter_metrics_per_series": round(final_count / series, 2),
+        "inter_metrics": n_final,
+        "inter_metrics_per_series": round(n_final / series, 2),
         "budget_s": 10.0,
         "fits_interval": flush_s < 10.0,
         "vs_baseline": round(10.0 / flush_s, 2),
     }
-    srv.shutdown()
+
+
+def main() -> None:
+    backend = _backend()
+    on_tpu = backend == "tpu"
+    per = int(os.environ.get("VENEUR_E2E_SAMPLES_PER_SERIES", 4))
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    if "--scaling" in sys.argv[1:]:
+        env_sizes = os.environ.get("VENEUR_E2E_SCALING_SIZES")
+        if env_sizes:
+            sizes = tuple(int(s) for s in env_sizes.split(","))
+        else:
+            sizes = ((1 << 16, 1 << 18, 1 << 20) if on_tpu
+                     else (1 << 14, 1 << 16, 1 << 17))
+        rows = []
+        for s in sizes:
+            row = run_one(s, per)
+            rows.append(row)
+            print(json.dumps({"series": s,
+                              "flush_total_s": row["flush_total_s"],
+                              "fits_interval": row["fits_interval"]}),
+                  flush=True)
+        out = {
+            "platform": backend,
+            "note": ("end-to-end Server.flush latency vs series count; "
+                     "the flush programs are O(series)"),
+            "samples_per_series": per,
+            "budget_s": 10.0,
+            "rows": [{k: r[k] for k in
+                      ("series", "ingest_samples_per_s", "flush_total_s",
+                       "flush_phases", "fits_interval")} for r in rows],
+            "scaling_largest_vs_smallest": round(
+                rows[-1]["flush_total_s"] / max(rows[0]["flush_total_s"],
+                                                1e-9), 2),
+        }
+        with open(os.path.join(root, "E2E_SCALING.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        return
+
+    series = int(os.environ.get("VENEUR_E2E_SERIES",
+                                1 << 20 if on_tpu else 1 << 16))
+    out = {"platform": backend, **run_one(series, per)}
     with open(os.path.join(root, "E2E_FLUSH.json"), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"metric": "e2e_flush_latency_s",
