@@ -12,7 +12,7 @@ import time
 import numpy as np
 import pytest
 
-from veneur_tpu.core.config import Config
+from veneur_tpu.core.config import Config, load_proxy_config
 from veneur_tpu.core.flusher import device_quantiles
 from veneur_tpu.core.metrics import HistogramAggregates, MetricType
 from veneur_tpu.core.server import Server
@@ -162,6 +162,45 @@ def test_proxy_unreachable_destination_counts_drops():
     proxy._route_batch(batch)
     assert proxy.drops == 1
     proxy.stop()
+
+
+def test_proxy_max_idle_conns_evicts_lru():
+    # reference config_proxy.go:16 MaxIdleConns: the proxy keeps at most
+    # N downstream connections alive, evicting least-recently-used
+    proxy = ProxyServer(max_idle_conns=2)
+    closed = []
+
+    class FakeClient:
+        def __init__(self, dest):
+            self.dest = dest
+
+        def close(self):
+            closed.append(self.dest)
+
+    import veneur_tpu.distributed.proxy as proxy_mod
+    real = proxy_mod.rpc.ForwardClient
+    proxy_mod.rpc.ForwardClient = lambda dest, *a, **k: FakeClient(dest)
+    try:
+        proxy._conn("a")
+        proxy._conn("b")
+        proxy._conn("a")          # refresh a: LRU order is now b, a
+        proxy._conn("c")          # over cap: b (least recent) evicted
+        assert closed == ["b"]
+        assert list(proxy._conns) == ["a", "c"]
+        proxy._conn("b")          # b comes back as a fresh conn
+        assert closed == ["b", "a"]
+    finally:
+        proxy_mod.rpc.ForwardClient = real
+
+
+def test_proxy_config_accepts_reference_keys():
+    # a stock reference example_proxy.yaml must parse without unknown-key
+    # warnings: max_idle_conns is consumed, trace_api_address is accepted
+    # for compatibility (nothing reads it in the reference either)
+    cfg = load_proxy_config(data={"max_idle_conns": 7,
+                                  "trace_api_address": "http://x:7777"})
+    assert cfg.max_idle_conns == 7
+    assert cfg.trace_api_address == "http://x:7777"
 
 
 def test_forward_bad_address_counts_errors():

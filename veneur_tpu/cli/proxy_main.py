@@ -81,7 +81,8 @@ def main(argv=None) -> int:
               if cfg.idle_connection_timeout else 0.0)
     proxy = ProxyServer(static,
                         timeout_s=parse_duration(cfg.forward_timeout),
-                        idle_timeout_s=idle_s)
+                        idle_timeout_s=idle_s,
+                        max_idle_conns=cfg.max_idle_conns)
     address = cfg.grpc_address or "127.0.0.1:8128"
     port = proxy.start_grpc(address)
     log.info("proxy serving gRPC on %s (port %s)", address, port)

@@ -306,8 +306,16 @@ class ProxyConfig:
     grpc_address: str = ""
     grpc_forward_address: str = ""
     http_address: str = ""
+    # total cap on kept-alive downstream connections across all
+    # destinations (reference config_proxy.go:16 -> http.Transport
+    # MaxIdleConns); 0 = unlimited, matching the Go zero value
+    max_idle_conns: int = 0
     max_idle_conns_per_host: int = 100
     sentry_dsn: str = ""
+    # accepted for YAML compatibility with reference proxy configs;
+    # nothing consumes it there either (config_proxy.go:23 has no
+    # reader outside the config struct)
+    trace_api_address: str = ""
     ssf_destination_address: str = ""
     stats_address: str = ""
     trace_address: str = ""  # static trace destination (no discovery)

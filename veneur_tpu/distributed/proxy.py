@@ -12,6 +12,7 @@ import logging
 import socket
 import threading
 import time
+from collections import OrderedDict
 from typing import Optional
 
 import grpc
@@ -31,11 +32,16 @@ class ProxyServer:
 
     def __init__(self, destinations: Optional[list[str]] = None,
                  timeout_s: float = 10.0,
-                 idle_timeout_s: float = 0.0) -> None:
+                 idle_timeout_s: float = 0.0,
+                 max_idle_conns: int = 0) -> None:
         self.ring = ConsistentRing(destinations or [])
         self.timeout_s = timeout_s
         self.idle_timeout_s = idle_timeout_s
-        self._conns: dict[str, rpc.ForwardClient] = {}
+        # LRU bound on kept-alive downstream conns (reference
+        # config_proxy.go:16 MaxIdleConns on the shared http.Transport);
+        # 0 = unlimited
+        self.max_idle_conns = max_idle_conns
+        self._conns: "OrderedDict[str, rpc.ForwardClient]" = OrderedDict()
         self._lock = threading.Lock()
         self.grpc_server: Optional[grpc.Server] = None
         self.port: Optional[int] = None
@@ -60,6 +66,12 @@ class ProxyServer:
                 client = rpc.ForwardClient(dest, self.timeout_s,
                                            idle_timeout_s=self.idle_timeout_s)
                 self._conns[dest] = client
+                while (self.max_idle_conns > 0
+                       and len(self._conns) > self.max_idle_conns):
+                    _, evicted = self._conns.popitem(last=False)
+                    evicted.close()
+            else:
+                self._conns.move_to_end(dest)
             return client
 
     # -- forwarding (reference SendMetrics :180 / sendMetrics :190)
