@@ -457,3 +457,26 @@ def test_staged_matches_direct_fold():
     assert abs(sa["sum"] - da["sum"]) <= 1e-3 * abs(da["sum"])
     # both digests see the same samples; p50 agrees within digest error
     assert abs(sa["p50"] - da["p50"]) <= 0.05 * max(1.0, abs(da["p50"]))
+
+
+def test_scalar_pool_growth_at_capacity_boundary():
+    """Regression: adopting the row that crosses the pool's capacity
+    (row == initial capacity) crashed in ensure() because `used` was
+    bumped before the grow — and np.resize's recycled data leaked into
+    the new row's value slot (caught by tools/soak_topology.py at >256
+    counter series per worker)."""
+    from veneur_tpu.core.worker import ScalarPool
+
+    pool = ScalarPool(initial=8)
+    for i in range(20):  # crosses capacity at rows 8 and 16
+        row = pool.upsert(f"c{i}", ScopeClass.LOCAL, (), None)
+        assert row == i
+        # the freshly adopted row must start zeroed even after np.resize
+        # recycles old contents into the grown tail
+        assert pool.values[row] == 0.0
+        assert not pool.present[row]
+        pool.values[row] = float(i + 1)
+        pool.present[row] = True
+    assert pool.used == 20
+    assert list(pool.values[:20]) == [float(i + 1) for i in range(20)]
+    assert pool.present[:20].all()

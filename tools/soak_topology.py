@@ -1,0 +1,199 @@
+"""Cluster-topology soak: local -> proxy -> N globals with ring churn.
+
+The reference's multi-node story is tested in-process (SURVEY.md §4:
+real servers on loopback, no cluster fixture); this soak does the same
+at soak length for the TPU build's distributed tier: one local Server
+forwards every interval through a ProxyServer (consistent ring) to
+global Servers ingesting over real gRPC, while the ring membership
+CHURNS mid-run (a global joins, another leaves — the discovery-refresh
+path of reference proxy.go:491-515 / proxysrv SetDestinations
+:148-176).
+
+Conservation is the pass criterion, checked with exactly-summable
+metrics: every veneurglobalonly counter increment and every histogram
+sample sent by the local must be accounted for in the final cross-
+global flush — a series may migrate between globals at a churn point,
+but its pieces must add up, and a clean membership change must drop
+nothing (proxy.drops == 0).
+
+Writes TOPOLOGY_SOAK.json at the repo root and prints one JSON line.
+
+Env knobs: VENEUR_SOAK_INTERVALS (default 30), VENEUR_SOAK_HISTO_SERIES
+(default 1500), VENEUR_SOAK_COUNTER_SERIES (default 500).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main() -> None:
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.flusher import device_quantiles, \
+        generate_inter_metrics
+    from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.distributed.forward import install_forwarder
+    from veneur_tpu.distributed.import_server import ImportServer
+    from veneur_tpu.distributed.proxy import ProxyServer
+
+    intervals = int(os.environ.get("VENEUR_SOAK_INTERVALS", 30))
+    s_histo = int(os.environ.get("VENEUR_SOAK_HISTO_SERIES", 1500))
+    s_counter = int(os.environ.get("VENEUR_SOAK_COUNTER_SERIES", 500))
+    pcts = [0.5, 0.99]
+    aggs = ["min", "max", "count"]
+
+    rss0 = rss_mb()
+    t_start = time.perf_counter()
+
+    globals_ = []
+    for _ in range(3):
+        cfg = Config(interval="10s", percentiles=pcts, aggregates=aggs,
+                     num_workers=2)
+        srv = Server(cfg)
+        imp = ImportServer(srv)
+        port = imp.start_grpc()
+        globals_.append((srv, imp, port))
+
+    def dests(idxs):
+        return [f"127.0.0.1:{globals_[i][2]}" for i in idxs]
+
+    # start with globals 0+1 in the ring; 2 joins mid-run, 1 leaves later
+    proxy = ProxyServer(dests([0, 1]), max_idle_conns=8)
+    pport = proxy.start_grpc()
+
+    lcfg = Config(interval="10s", percentiles=pcts, aggregates=aggs,
+                  forward_address=f"127.0.0.1:{pport}",
+                  forward_use_grpc=True)
+    local = Server(lcfg)
+    install_forwarder(local)
+
+    def received_total() -> int:
+        return sum(imp.received_metrics for _, imp, _ in globals_)
+
+    join_at = intervals // 3
+    leave_at = 2 * intervals // 3
+    churn_events = []
+    forward_waits = []
+    per_interval = s_histo + s_counter
+    stalled_intervals = 0
+
+    for it in range(intervals):
+        if it == join_at:
+            proxy.set_destinations(dests([0, 1, 2]))
+            churn_events.append({"interval": it, "event": "join",
+                                 "members": 3})
+        elif it == leave_at:
+            proxy.set_destinations(dests([0, 2]))
+            churn_events.append({"interval": it, "event": "leave",
+                                 "members": 2})
+        # the packet path end to end: multi-metric datagrams through the
+        # parser, not direct worker injection
+        # veneurglobalonly so the GLOBAL side emits the .count aggregate
+        # (mixed scope would emit it locally — flusher.go:61-74's
+        # double-count avoidance — leaving nothing exactly-summable on
+        # the global end of the pipeline)
+        lines = []
+        for i in range(s_histo):
+            lines.append(b"soak.h%d:%d|ms|#shard:%d,veneurglobalonly"
+                         % (i, (i * 31 + it) % 997, i % 16))
+        for i in range(s_counter):
+            lines.append(b"soak.c%d:2|c|#veneurglobalonly" % i)
+        max_len = lcfg.metric_max_length
+        batch, size = [], 0
+        for line in lines:
+            if size + len(line) + 1 > max_len and batch:
+                local.process_metric_packet(b"\n".join(batch))
+                batch, size = [], 0
+            batch.append(line)
+            size += len(line) + 1
+        if batch:
+            local.process_metric_packet(b"\n".join(batch))
+        before = received_total()
+        t0 = time.perf_counter()
+        local.flush()
+        ok = False
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if received_total() - before >= per_interval:
+                ok = True
+                break
+            time.sleep(0.02)
+        forward_waits.append(round(time.perf_counter() - t0, 3))
+        if not ok:
+            stalled_intervals += 1
+
+    # final accounting: flush every global (including the one that left
+    # the ring — its accumulated state still exists) and sum
+    qs = device_quantiles(pcts, HistogramAggregates.from_names(aggs))
+    counter_total = 0.0
+    histo_count_total = 0.0
+    for srv, _, _ in globals_:
+        metrics = []
+        for w, lock in zip(srv.workers, srv._worker_locks):
+            with lock:
+                snap = w.flush(qs, 10.0)
+            metrics.extend(generate_inter_metrics(snap, False, pcts,
+                                                  HistogramAggregates
+                                                  .from_names(aggs)))
+        for m in metrics:
+            if m.type == MetricType.COUNTER and m.name.startswith("soak.c"):
+                counter_total += m.value
+            if m.name.endswith(".count") and m.name.startswith("soak.h"):
+                histo_count_total += m.value
+
+    expected_counter = 2.0 * s_counter * intervals
+    expected_histo = float(s_histo * intervals)
+    wall_s = time.perf_counter() - t_start
+
+    out = {
+        "intervals": intervals,
+        "histo_series": s_histo,
+        "counter_series": s_counter,
+        "churn_events": churn_events,
+        "samples_sent": per_interval * intervals,
+        "counter_total_expected": expected_counter,
+        "counter_total_observed": counter_total,
+        "histo_count_expected": expected_histo,
+        "histo_count_observed": histo_count_total,
+        "conservation_ok": (counter_total == expected_counter
+                            and histo_count_total == expected_histo),
+        "proxy_drops": proxy.drops,
+        "stalled_intervals": stalled_intervals,
+        "forward_wait_p50_s": sorted(forward_waits)[len(forward_waits) // 2],
+        "forward_wait_max_s": max(forward_waits),
+        "wall_s": round(wall_s, 1),
+        "rss_start_mb": round(rss0, 1),
+        "rss_end_mb": round(rss_mb(), 1),
+    }
+
+    local.shutdown()
+    proxy.stop()
+    for srv, imp, _ in globals_:
+        imp.stop()
+        srv.shutdown()
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "TOPOLOGY_SOAK.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"metric": "topology_soak_conservation",
+                      "value": 1.0 if out["conservation_ok"] else 0.0,
+                      "unit": "bool",
+                      "drops": out["proxy_drops"],
+                      "stalled_intervals": out["stalled_intervals"]}))
+    if not out["conservation_ok"] or out["proxy_drops"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -311,8 +311,12 @@ class ScalarPool:
         self.scope_codes.append(int(scope_class))
         if sinks is not None:
             self.routed_rows += 1
+        # grow BEFORE bumping used: ensure() copies/zeroes relative to
+        # self.used, and with used already including the new row it
+        # copies one element past the old arrays (crash at a capacity
+        # boundary) and leaves np.resize's recycled junk in the new row
+        self.ensure(row + 1)
         self.used = row + 1
-        self.ensure(self.used)
 
 
 @dataclass
