@@ -879,3 +879,50 @@ def test_shutdown_with_live_tcp_connection_is_prompt():
     srv.shutdown()  # connection still open, reader mid-recv
     assert time.time() - t0 < 5.0
     c.close()
+
+
+def test_high_cardinality_all_types_cross_pool_boundaries():
+    """600 series of EVERY metric class through the packet path in one
+    worker: counters and gauges cross the scalar pools' 256/512
+    capacity boundaries (the soak-caught adopt_row bug lived exactly
+    there), histos/sets cross the device-pool growth schedule, and the
+    flush must still be exact."""
+    srv, _, ports = _server(num_workers=1)
+    try:
+        port = next(iter(ports.values()))
+        n = 600
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        lines = []
+        for i in range(n):
+            lines.append(b"hc.c%d:3|c" % i)
+            lines.append(b"hc.g%d:%d|g" % (i, i))
+            lines.append(b"hc.t%d:%d|ms" % (i, i % 250))
+            lines.append(b"hc.s%d:member%d|s" % (i, i))
+        # ~8 lines per datagram keeps packets under the default max
+        for off in range(0, len(lines), 8):
+            s.sendto(b"\n".join(lines[off:off + 8]), ("127.0.0.1", port))
+        s.close()
+        assert _wait_for(lambda: srv.packets_received >= len(lines) // 8,
+                         15.0)
+        assert _wait_for(
+            lambda: sum(w.processed for w in srv.workers) >= 4 * n, 15.0)
+        metrics = srv.flush()
+        by_key = {(m.name, m.type): m for m in metrics}
+        from veneur_tpu.core.metrics import MetricType
+        for i in range(n):
+            assert by_key[(f"hc.c{i}", MetricType.COUNTER)].value == 3.0
+            assert by_key[(f"hc.g{i}", MetricType.GAUGE)].value == float(i)
+        t_counts = [m for m in metrics
+                    if m.name.startswith("hc.t") and
+                    m.name.endswith(".count")]
+        assert len(t_counts) == n
+        assert all(m.value == 1.0 for m in t_counts)
+        set_gauges = [m for m in metrics
+                      if m.name.startswith("hc.s") and
+                      m.type == MetricType.GAUGE and "." not in
+                      m.name[len("hc.s"):]]
+        assert len(set_gauges) == n
+        # HLL small-range estimate of a single member is ~1.00003
+        assert all(abs(m.value - 1.0) < 0.01 for m in set_gauges)
+    finally:
+        srv.shutdown()
