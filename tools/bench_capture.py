@@ -1,24 +1,27 @@
 """Resilient on-TPU bench capture loop.
 
-The tunnelled TPU relay wedges transiently (observed in rounds 1-3:
-``jax.devices()`` hangs >300s, then heals within tens of minutes to
-hours). Round 1 and 2 bench artifacts were CPU fallbacks because
-bench.py only probed for ~15 minutes at the end of the round. This tool
-inverts the strategy: run it in the background for the WHOLE round; it
-probes the backend every few minutes, and the moment the relay is live
-it captures all five BASELINE workloads on-chip and writes them to
-``BENCH_CACHE.json`` at the repo root. bench.py then emits the cached
-on-chip numbers (with a staleness marker) whenever its own live run
-would otherwise fall back to CPU.
+The tunnelled TPU relay wedges transiently (rounds 1-4: ``jax.devices()``
+hangs >300s inside the PJRT client constructor, then heals minutes to
+hours later — TPU_BACKEND.md). This loop runs in the background for the
+WHOLE round. Every cycle it launches tools/onchip_suite.py: ONE child
+process whose backend init doubles as the probe — the round-4 live
+window showed a successful probe init followed by a hung init in the
+very next child, so the suite pays exactly one init and runs everything
+(all five BASELINE workloads + every auxiliary artifact) inside it.
+
+The child streams line-framed JSON; each workload result is persisted to
+``BENCH_CACHE.json`` atomically the moment it arrives, so a wedge or a
+kill — of the child or of this loop — loses at most the stage in
+flight. bench.py emits the cached on-chip numbers (with a staleness
+marker) whenever its own live run would otherwise fall back to CPU.
 
 Single-client discipline: the relay wedges when two processes
 initialize the TPU backend concurrently, so this loop takes an
-exclusive flock on ``/tmp/veneur_tpu_axon.lock`` around every probe and
-every workload child. Anything else that touches the TPU should take
-the same lock (bench.py does).
+exclusive flock on ``/tmp/veneur_tpu_axon.lock`` for the whole suite;
+bench.py takes the same lock and fails closed to cached/CPU results.
 
 Usage:
-    python tools/bench_capture.py [--once] [--interval 300]
+    python tools/bench_capture.py [--once] [--interval 240]
 """
 
 from __future__ import annotations
@@ -40,91 +43,16 @@ LOCK_PATH = "/tmp/veneur_tpu_axon.lock"
 sys.path.insert(0, REPO)
 from bench import WORKLOAD_ORDER as WORKLOADS  # noqa: E402  single source
 
+AUX_ARTIFACTS = ("E2E_FLUSH.json", "E2E_SCALING.json", "OVERLAP.json",
+                 "PALLAS_AB.json")
+
+_current_child: subprocess.Popen | None = None
+
 
 def axon_lock():
     f = open(LOCK_PATH, "w")
     fcntl.flock(f, fcntl.LOCK_EX)
     return f
-
-
-def probe(timeout: float = 480.0) -> str | None:
-    """Longer than the bench's own probe: a healing relay can take
-    minutes to complete a first init, and aborting a would-succeed init
-    both wastes the window and can re-wedge the relay."""
-    """Return the live platform name, or None if the backend is wedged."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            timeout=timeout, capture_output=True, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        return None
-    if r.returncode != 0:
-        return None
-    plat = r.stdout.decode().strip() or None
-    # the tunnelled chip may report its experimental plugin name
-    return "tpu" if plat in ("tpu", "axon") else plat
-
-
-_current_child: subprocess.Popen | None = None
-
-
-def run_all_workloads(on_result, timeout: float = 3300.0) -> None:
-    """ONE child runs every workload (VENEUR_BENCH_WORKLOAD=all): the
-    relay's minutes-long cold backend init is paid once per pass instead
-    of once per workload (round 4 observed a single-workload child burn
-    its whole 900s budget inside init). The child streams one JSON line
-    per completed workload; each line is handed to ``on_result``
-    IMMEDIATELY so the caller can persist it — a kill of the child OR of
-    this process mid-pass loses at most the workload in flight."""
-    global _current_child
-    env = dict(os.environ)
-    env["VENEUR_BENCH_WORKLOAD"] = "all"
-    env["_VENEUR_BENCH_CHILD"] = "1"
-    # stderr to a file, not a pipe: the child's periodic faulthandler
-    # dumps could fill a pipe buffer and deadlock it mid-workload
-    with tempfile.TemporaryFile() as errf:
-        proc = subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "bench.py")],
-            env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=errf)
-        _current_child = proc
-        timed_out = False
-
-        def _kill():
-            nonlocal timed_out
-            timed_out = True
-            proc.kill()
-
-        killer = threading.Timer(timeout, _kill)
-        killer.start()
-        try:
-            for raw in proc.stdout:
-                line = raw.decode(errors="replace").strip()
-                if not line.startswith("{"):
-                    continue
-                try:
-                    on_result(json.loads(line))
-                except ValueError:
-                    continue
-            proc.wait()
-        finally:
-            killer.cancel()
-            # an exception escaping on_result (e.g. disk-full in the
-            # persist) must not orphan a child that is still using the
-            # relay: the lock releases as this unwinds, and the next
-            # probe would concurrently init against the orphan
-            if proc.poll() is None:
-                proc.kill()
-                proc.wait()
-            _current_child = None
-        if timed_out or proc.returncode != 0:
-            errf.seek(0, os.SEEK_END)
-            errf.seek(max(0, errf.tell() - 1500))
-            tail = errf.read().decode(errors="replace")
-            why = (f"timed out after {timeout}s" if timed_out
-                   else f"rc={proc.returncode}")
-            print(f"capture: all-pass {why}; stderr tail:\n{tail}",
-                  file=sys.stderr)
 
 
 def git_rev() -> str:
@@ -136,9 +64,77 @@ def git_rev() -> str:
         return "unknown"
 
 
-def capture_all() -> bool:
-    """One full on-chip capture pass. Returns True if every workload
-    produced an on-TPU number (partial results are still cached)."""
+def run_suite(on_result, marker_timeout: float = 600.0,
+              timeout: float = 5400.0) -> bool:
+    """One suite child: backend init IS the probe. Returns True iff the
+    backend came up (the child emitted its backend_live marker). Each
+    streamed workload line goes to ``on_result`` immediately; auxiliary
+    artifacts are written by the child itself as stages complete."""
+    global _current_child
+    # stderr to a file, not a pipe: the child's periodic faulthandler
+    # dumps could fill a pipe buffer and deadlock it mid-stage
+    with tempfile.TemporaryFile() as errf:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tools", "onchip_suite.py")],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=errf)
+        _current_child = proc
+        marker = threading.Event()
+        killed_why = []
+
+        def _kill(why: str):
+            killed_why.append(why)
+            proc.kill()
+
+        def _marker_watchdog():
+            if not marker.is_set():
+                _kill(f"no backend_live marker within {marker_timeout:.0f}s "
+                      "(relay wedged)")
+
+        t_marker = threading.Timer(marker_timeout, _marker_watchdog)
+        t_total = threading.Timer(timeout, _kill,
+                                  args=(f"suite exceeded {timeout:.0f}s",))
+        t_marker.start()
+        t_total.start()
+        try:
+            for raw in proc.stdout:
+                line = raw.decode(errors="replace").strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if obj.get("event") == "backend_live":
+                    if obj.get("platform") == "tpu":
+                        marker.set()
+                    print(f"capture: backend live: {obj}", file=sys.stderr)
+                elif obj.get("event"):
+                    print(f"capture: {obj}", file=sys.stderr)
+                elif "workload" in obj:
+                    on_result(obj)
+            proc.wait()
+        finally:
+            t_marker.cancel()
+            t_total.cancel()
+            # an exception escaping on_result must not orphan a child
+            # still using the relay: the lock releases as this unwinds,
+            # and the next cycle's init would wedge against the orphan
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            _current_child = None
+        if killed_why or proc.returncode != 0:
+            errf.seek(0, os.SEEK_END)
+            errf.seek(max(0, errf.tell() - 1500))
+            tail = errf.read().decode(errors="replace")
+            why = killed_why[0] if killed_why else f"rc={proc.returncode}"
+            print(f"capture: suite ended: {why}; stderr tail:\n{tail}",
+                  file=sys.stderr)
+        return marker.is_set()
+
+
+def capture_pass() -> tuple[bool, set]:
+    """One full suite pass. Returns (backend_was_live, fresh_workloads)."""
     existing: dict = {}
     if os.path.exists(CACHE):
         try:
@@ -156,10 +152,8 @@ def capture_all() -> bool:
             return
         results[name] = res
         fresh.add(name)
-        # persist the moment each workload lands: a wedge or kill
-        # mid-pass must not lose the workloads already captured.
-        # Atomic write (temp + rename): a signal mid-dump must not
-        # leave a truncated cache that loses every earlier capture.
+        # persist the moment each workload lands — atomically (temp +
+        # rename), so a signal mid-dump can't truncate the cache
         tmp = CACHE + ".tmp"
         with open(tmp, "w") as f:
             json.dump({
@@ -174,67 +168,35 @@ def capture_all() -> bool:
         print(f"capture: {name}: {res}", file=sys.stderr)
 
     with axon_lock():
-        run_all_workloads(on_result)
-    # "complete" means THIS pass captured everything fresh — a stale
-    # pre-existing cache must not stop the loop from recapturing
-    return all(n in fresh for n in WORKLOADS)
+        live = run_suite(on_result)
+    return live, fresh
 
 
-def capture_auxiliary() -> None:
-    """On-chip OVERLAP.json and PALLAS_AB.json (verdict r2 items 2): run
-    the overlap harness and the Pallas-vs-XLA A/B once the relay is live.
-    Each tool writes its artifact itself; failures are logged, not fatal."""
-    for script, artifact, timeout in (
-            ("tools/bench_overlap.py", "OVERLAP.json", 1200),
-            ("tools/bench_pallas_ab.py", "PALLAS_AB.json", 1200),
-            ("tools/bench_e2e_flush.py", "E2E_FLUSH.json", 1800),
-            ("tools/bench_e2e_flush.py --scaling", "E2E_SCALING.json", 2400),
-            ("tools/profile_ingest.py", "PROFILE_INGEST_TPU.txt", 1200)):
-        # skip if the artifact is already an on-TPU capture
-        path = os.path.join(REPO, artifact)
+def all_captured(fresh: set) -> bool:
+    if not all(n in fresh for n in WORKLOADS):
+        return False
+    for name in AUX_ARTIFACTS:
         try:
-            if artifact.endswith(".json"):
-                if json.load(open(path)).get("platform") == "tpu":
-                    continue
-            elif os.path.exists(path):
-                continue
+            if json.load(open(os.path.join(REPO, name))
+                         ).get("platform") != "tpu":
+                return False
         except (OSError, ValueError):
-            pass
-        prog, *args = script.split()
-        with axon_lock():
-            try:
-                r = subprocess.run(
-                    [sys.executable, os.path.join(REPO, prog), *args],
-                    timeout=timeout, capture_output=True, cwd=REPO)
-            except subprocess.TimeoutExpired:
-                print(f"capture: {script} timed out", file=sys.stderr)
-                continue
-        if r.returncode != 0:
-            print(f"capture: {script} rc={r.returncode}: "
-                  f"{r.stderr.decode(errors='replace')[-400:]}",
-                  file=sys.stderr)
-            continue
-        if artifact.endswith(".txt"):
-            with open(path, "w") as f:
-                f.write(r.stdout.decode(errors="replace"))
-        print(f"capture: {script} -> {artifact}: "
-              f"{r.stdout.decode(errors='replace').strip()[-300:]}",
-              file=sys.stderr)
+            return False
+    return os.path.exists(os.path.join(REPO, "PROFILE_INGEST_TPU.txt"))
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--once", action="store_true",
-                    help="one probe+capture attempt, then exit")
-    ap.add_argument("--interval", type=float, default=300.0,
-                    help="seconds between probes while wedged")
+                    help="one suite attempt, then exit")
+    ap.add_argument("--interval", type=float, default=240.0,
+                    help="seconds between attempts while wedged")
     ap.add_argument("--max-hours", type=float, default=12.0)
     args = ap.parse_args()
 
     def _reap(signum, frame):
-        # a SIGTERM'd loop must not leave an orphan bench child touching
-        # the relay: the next loop's probe would concurrently init the
-        # backend against it and wedge both
+        # a SIGTERM'd loop must not leave an orphan suite child touching
+        # the relay: the next cycle's init would wedge against it
         child = _current_child
         if child is not None:
             child.kill()
@@ -245,20 +207,14 @@ def main() -> None:
 
     deadline = time.time() + args.max_hours * 3600
     while time.time() < deadline:
-        with axon_lock():
-            plat = probe()
-        if plat == "tpu":
-            print("capture: TPU live — capturing all workloads",
+        live, fresh = capture_pass()
+        if live and all_captured(fresh):
+            print("capture: complete on-chip artifact set captured",
                   file=sys.stderr)
-            done = capture_all()
-            capture_auxiliary()
-            if done:
-                print("capture: complete on-chip artifact cached",
-                      file=sys.stderr)
-                return
-        else:
-            print(f"capture: backend not live (platform={plat}); "
-                  f"retrying in {args.interval:.0f}s", file=sys.stderr)
+            return
+        if not live:
+            print(f"capture: backend not live; retrying in "
+                  f"{args.interval:.0f}s", file=sys.stderr)
         if args.once:
             return
         time.sleep(args.interval)
