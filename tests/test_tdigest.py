@@ -264,3 +264,29 @@ def test_staged_fold_quantile_accuracy():
             pos = np.searchsorted(vals, quant[r, j]) / n
             worst = max(worst, abs(pos - q))
     assert worst < 0.01, f"q-space error {worst:.4f} exceeds the 1% budget"
+
+
+def test_quantile_gather_and_mask_forms_agree():
+    """The backend-dispatched slot-selection strategies (host gather vs
+    TPU select+reduce) must be BIT-identical, including NaN patterns for
+    empty rows and zero-weight slot ties."""
+    import numpy as np
+
+    from veneur_tpu.ops.tdigest import _quantile_impl
+
+    rng = np.random.default_rng(5)
+    S, C = 512, 64
+    means = np.sort(rng.gamma(2.0, 50.0, (S, C)).astype(np.float32), axis=1)
+    weights = rng.integers(0, 4, (S, C)).astype(np.float32)  # many zeros
+    weights[::17] = 0.0  # some fully empty digests
+    dmin = means.min(axis=1) - 1.0
+    dmax = means.max(axis=1) + 1.0
+    qs = np.array([0.0, 0.5, 0.9, 0.99, 1.0], np.float32)
+
+    a = np.asarray(_quantile_impl(means, weights, dmin, dmax, qs,
+                                  use_gather=True))
+    b = np.asarray(_quantile_impl(means, weights, dmin, dmax, qs,
+                                  use_gather=False))
+    assert np.array_equal(np.isnan(a), np.isnan(b))
+    m = ~np.isnan(a)
+    assert np.array_equal(a[m], b[m])

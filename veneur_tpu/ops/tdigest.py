@@ -406,7 +406,57 @@ def _row_bounds(means: jax.Array, weights: jax.Array, dmax: jax.Array
     return ub, count
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("use_gather",))
+def _quantile_impl(
+    means: jax.Array,
+    weights: jax.Array,
+    dmin: jax.Array,
+    dmax: jax.Array,
+    qs: jax.Array,
+    use_gather: bool,
+) -> jax.Array:
+    s, c = means.shape
+    ub, count = _row_bounds(means, weights, dmax)  # [S, C], [S]
+    w_cum = jnp.cumsum(weights, axis=-1)  # [S, C]
+    total = w_cum[:, -1]  # [S]
+    lb = jnp.concatenate([dmin[:, None], ub[:, :-1]], axis=-1)  # [S, C]
+
+    target = qs[None, :] * total[:, None]  # [S, P]
+    # first slot whose cumulative weight reaches the target
+    # (reference: q <= weightSoFar + c.Weight), then interpolate inside
+    # it. Two equivalent formulations (bit-identical — pinned by
+    # test_quantile_gather_and_mask_forms_agree):
+    if use_gather:
+        # hosts (CPU fallback): per-row binary search + gather is 4.4x
+        # the masked-reduce form at 64k series — no [S, C, P]
+        # materialization, O(P log C) per row instead of O(C·P)
+        first_idx = jax.vmap(
+            lambda cw, t: jnp.searchsorted(cw, t, side="left"))(
+                w_cum, target)  # [S, P]
+        first_idx = jnp.minimum(first_idx, c - 1)
+
+        def _at(x):  # [S, C] → [S, P] value at the found slot
+            return jnp.take_along_axis(x, first_idx, axis=1)
+    else:
+        # one-hot + masked reduces over [S, C, P]: at S=1M the
+        # [S, P]-shaped take_along_axis gathers are the slow path on
+        # TPU, while select+reduce streams through the VPU
+        reached = target[:, None, :] <= w_cum[:, :, None]  # [S, C, P]
+        first = reached & ~jnp.pad(
+            reached[:, :-1, :], ((0, 0), (1, 0), (0, 0)))  # one-hot
+
+        def _at(x):  # [S, C] → [S, P] value at the one-hot slot
+            return jnp.sum(jnp.where(first, x[:, :, None], 0.0), axis=1)
+
+    w_at = _at(weights)
+    w_before = _at(w_cum) - w_at
+    lb_at = _at(lb)
+    ub_at = _at(ub)
+    proportion = (target - w_before) / jnp.maximum(w_at, 1e-30)
+    out = lb_at + proportion * (ub_at - lb_at)
+    return jnp.where((total[:, None] > 0) & (count[:, None] > 0), out, jnp.nan)
+
+
 def quantile(
     means: jax.Array,
     weights: jax.Array,
@@ -417,34 +467,13 @@ def quantile(
     """Batched quantile extraction: [S, C] digests × [P] quantiles → [S, P].
 
     Linear interpolation over centroid bounds, matching reference Quantile
-    (tdigest/merging_digest.go:302-332). Empty digests yield NaN.
+    (tdigest/merging_digest.go:302-332). Empty digests yield NaN. The
+    slot-selection strategy is backend-dependent (gather on hosts,
+    select+reduce on TPU) with bit-identical results.
     """
-    s, c = means.shape
-    ub, count = _row_bounds(means, weights, dmax)  # [S, C], [S]
-    w_cum = jnp.cumsum(weights, axis=-1)  # [S, C]
-    total = w_cum[:, -1]  # [S]
-    lb = jnp.concatenate([dmin[:, None], ub[:, :-1]], axis=-1)  # [S, C]
-
-    target = qs[None, :] * total[:, None]  # [S, P]
-    # first slot whose cumulative weight reaches the target
-    # (reference: q <= weightSoFar + c.Weight). One-hot that slot and
-    # read the per-slot values with masked reduces — at S=1M the
-    # [S, P]-shaped take_along_axis gathers are the slow path on TPU,
-    # while select+reduce over [S, C, P] streams through the VPU.
-    reached = target[:, None, :] <= w_cum[:, :, None]  # [S, C, P]
-    first = reached & ~jnp.pad(
-        reached[:, :-1, :], ((0, 0), (1, 0), (0, 0)))  # one-hot along C
-
-    def _at(x):  # [S, C] → [S, P] value at the one-hot slot
-        return jnp.sum(jnp.where(first, x[:, :, None], 0.0), axis=1)
-
-    w_at = _at(weights)
-    w_before = _at(w_cum) - w_at
-    lb_at = _at(lb)
-    ub_at = _at(ub)
-    proportion = (target - w_before) / jnp.maximum(w_at, 1e-30)
-    out = lb_at + proportion * (ub_at - lb_at)
-    return jnp.where((total[:, None] > 0) & (count[:, None] > 0), out, jnp.nan)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    return _quantile_impl(means, weights, dmin, dmax, qs,
+                          use_gather=not on_tpu)
 
 
 @jax.jit
