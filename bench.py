@@ -546,9 +546,27 @@ def prometheus_1m() -> dict:
     series = _envint("VENEUR_BENCH_SERIES", 1 << 20, 1 << 16)
     depth = _envint("VENEUR_BENCH_STAGE_DEPTH", 8)  # ~8 samples/series/10s
     iters = _envint("VENEUR_BENCH_ITERS", 5, 2)
-    use_pallas = pk.supported()
     rng = np.random.default_rng(4)
     pool = td.init_pool(series, td.DEFAULT_CAPACITY)
+
+    # prove the Pallas kernel lowers on THIS backend before betting the
+    # workload on it — DeviceWorker._extract demotes to XLA the same way;
+    # a kernel that fails only on real hardware must not zero the round's
+    # headline latency number (round-4 live window lost it exactly so)
+    use_pallas = pk.supported()
+    if use_pallas:
+        try:
+            # probe with the SAME qs the workload compiles with — Mosaic
+            # lowering failures can be shape-dependent, so a P=1 probe
+            # would not prove the P=3 specialization lowers
+            probe = td.init_pool(256, td.DEFAULT_CAPACITY)
+            jax.block_until_ready(pk.flush_extract(
+                probe.means, probe.weights, probe.min, probe.max,
+                jnp.asarray(np.array([0.5, 0.9, 0.99], np.float32))))
+        except Exception as e:
+            print(f"bench: pallas flush_extract demoted to XLA: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            use_pallas = False
 
     def _full(v):
         return jnp.full((series,), v, jnp.float32)
@@ -593,6 +611,7 @@ def prometheus_1m() -> dict:
         # budget = the reference's 10s default flush interval; >1 means
         # the 1M-series flush fits in the interval with headroom
         "vs_baseline": round(10.0 / worst, 2),
+        "extract_kernel": "pallas" if use_pallas else "xla",
     }, plane_bytes + 2 * _nbytes(state), worst)
 
 

@@ -158,7 +158,11 @@ def test_http_hop_propagation_end_to_end():
     http = ImportHTTPServer(imp)
     port = http.start()
     try:
-        lsrv = Server(Config(interval="10s", percentiles=[0.5]))
+        # forward_address makes the server a local tier (config.is_local),
+        # so its workers materialize digest centroids for forwarding —
+        # terminal servers skip that readback entirely
+        lsrv = Server(Config(interval="10s", percentiles=[0.5],
+                             forward_address=f"http://127.0.0.1:{port}"))
         local_spans = []
         lsrv.span_worker.ingest = local_spans.append
         fwd = HTTPForwarder(f"http://127.0.0.1:{port}",

@@ -321,6 +321,29 @@ def test_swap_then_extract_two_phase_flush():
     assert len(snap_new.scalars.counter_meta) == 1
 
 
+def test_terminal_worker_skips_digest_pool_readback():
+    """Only a forwarding (local) worker materializes the [S,C] centroid
+    pools host-side — they exist solely for the forward codec, and at 1M
+    series they are ~1GB of device→host traffic per flush (the round-4
+    on-chip E2E run measured them at >90% of a 105s extract phase). A
+    terminal worker (global or standalone) must leave them on device."""
+    qs = device_quantiles(PCTS, AGGS)
+
+    term = DeviceWorker(is_local=False)
+    term.process_metric(parse_metric(b"t:5|ms"))
+    snap = term.flush(qs)
+    assert snap.digest_means is None
+    assert snap.digest_weights is None
+    # the extraction itself is unaffected: quantiles still come back
+    assert snap.quantile_values is not None
+
+    fwd = DeviceWorker(is_local=True)
+    fwd.process_metric(parse_metric(b"t:5|ms"))
+    snap = fwd.flush(qs)
+    assert snap.digest_means is not None
+    assert float(snap.digest_weights.sum()) == 1.0
+
+
 def test_server_flush_does_not_hold_ingest_lock_during_extraction():
     """The server flush loop must release the per-worker ingest lock
     before extraction: with extraction artificially blocked, a reader

@@ -36,14 +36,6 @@ def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def artifact_on_tpu(name: str) -> bool:
-    try:
-        return json.load(open(os.path.join(REPO, name))
-                         ).get("platform") == "tpu"
-    except (OSError, ValueError):
-        return False
-
-
 def run_stage(name: str, fn) -> None:
     t0 = time.time()
     try:
@@ -95,16 +87,16 @@ def main() -> None:
 
     run_stage("bench_all", bench.main)
 
-    # 2. auxiliary artifacts, skipping ones already captured on-TPU
-    if not artifact_on_tpu("E2E_FLUSH.json"):
-        run_stage("e2e_flush", lambda: run_tool("bench_e2e_flush.py"))
-    if not artifact_on_tpu("E2E_SCALING.json"):
-        run_stage("e2e_scaling",
-                  lambda: run_tool("bench_e2e_flush.py", ["--scaling"]))
-    if not artifact_on_tpu("OVERLAP.json"):
-        run_stage("overlap", lambda: run_tool("bench_overlap.py"))
-    if not artifact_on_tpu("PALLAS_AB.json"):
-        run_stage("pallas_ab", lambda: run_tool("bench_pallas_ab.py"))
+    # 2. auxiliary artifacts. Always refreshed on a live window — an
+    # on-chip artifact from an older code state is a staleness trap
+    # (the first window captured E2E_FLUSH with the pre-fix 105s
+    # readback extract; the skip-if-on-tpu gate would have pinned that
+    # number forever). profile_ingest alone is capture-once.
+    run_stage("e2e_flush", lambda: run_tool("bench_e2e_flush.py"))
+    run_stage("e2e_scaling",
+              lambda: run_tool("bench_e2e_flush.py", ["--scaling"]))
+    run_stage("overlap", lambda: run_tool("bench_overlap.py"))
+    run_stage("pallas_ab", lambda: run_tool("bench_pallas_ab.py"))
     prof = os.path.join(REPO, "PROFILE_INGEST_TPU.txt")
     if not os.path.exists(prof):
         def _profile():

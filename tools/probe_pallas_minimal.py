@@ -1,0 +1,124 @@
+"""Minimal Mosaic-lowering probe: which individual patterns used by the
+flush-extract kernel fail to lower on the real TPU?  One backend init,
+one tiny pallas_call per pattern, one verdict line each.
+
+Run holding /tmp/veneur_tpu_axon.lock.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+B, C, P = 256, 128, 3
+
+
+def tryk(name, kernel, out_shape):
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(B, C)).astype(np.float32))
+    q = jnp.asarray(np.array([[0.5, 0.9, 0.99]], np.float32))
+    try:
+        out = pl.pallas_call(
+            kernel,
+            in_specs=[pl.BlockSpec((B, C), lambda: (0, 0)),
+                      pl.BlockSpec((1, P), lambda: (0, 0))],
+            out_specs=pl.BlockSpec(out_shape, lambda: tuple(
+                0 for _ in out_shape)),
+            out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
+        )(x, q)
+        jax.block_until_ready(out)
+        print(f"OK   {name}", flush=True)
+        return True
+    except Exception as e:
+        print(f"FAIL {name}: {type(e).__name__}: "
+              f"{str(e).splitlines()[0][:160]}", flush=True)
+        return False
+
+
+def main():
+    print(f"backend: {jax.default_backend()} {jax.devices()[0]}", flush=True)
+
+    def k_copy(x_ref, q_ref, o_ref):
+        o_ref[...] = x_ref[...]
+    tryk("plain copy", k_copy, (B, C))
+
+    def k_col0(x_ref, q_ref, o_ref):
+        o_ref[...] = x_ref[...][:, 0][:, None]
+    tryk("x[:, 0] column extract", k_col0, (B, 1))
+
+    def k_lastcol(x_ref, q_ref, o_ref):
+        o_ref[...] = x_ref[...][:, -1][:, None]
+    tryk("x[:, -1] last column", k_lastcol, (B, 1))
+
+    def k_row0(x_ref, q_ref, o_ref):
+        qs = q_ref[...][0, :]
+        o_ref[...] = jnp.zeros((B, C), jnp.float32) + qs[0]
+    tryk("q[0,:] then qs[0] scalar", k_row0, (B, C))
+
+    def k_scalar_2d(x_ref, q_ref, o_ref):
+        o_ref[...] = jnp.zeros((B, C), jnp.float32) + q_ref[0, 0]
+    tryk("q_ref[0,0] direct scalar load", k_scalar_2d, (B, C))
+
+    def k_argmax(x_ref, q_ref, o_ref):
+        a = jnp.argmax(x_ref[...] > 0, axis=-1)
+        o_ref[...] = a.astype(jnp.float32)[:, None]
+    tryk("argmax over lanes", k_argmax, (B, 1))
+
+    def k_tril(x_ref, q_ref, o_ref):
+        col = jax.lax.broadcasted_iota(jnp.float32, (C, C), 0)
+        row = jax.lax.broadcasted_iota(jnp.float32, (C, C), 1)
+        tril = (col <= row).astype(jnp.float32)
+        o_ref[...] = jnp.dot(x_ref[...], tril,
+                             preferred_element_type=jnp.float32)
+    tryk("tril matmul cumsum", k_tril, (B, C))
+
+    def k_where_shift(x_ref, q_ref, o_ref):
+        from jax.experimental.pallas import tpu as pltpu
+        x = x_ref[...]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+        o_ref[...] = jnp.where(idx == C - 1, jnp.inf,
+                               pltpu.roll(x, C - 1, 1))
+    tryk("pltpu.roll left-by-one", k_where_shift, (B, C))
+
+    def k_concat(x_ref, q_ref, o_ref):
+        x = x_ref[...]
+        o_ref[...] = jnp.concatenate(
+            [x[:, 1:], jnp.full((B, 1), jnp.inf, x.dtype)], axis=-1)
+    tryk("lane concatenate", k_concat, (B, C))
+
+    def k_sum_keep(x_ref, q_ref, o_ref):
+        o_ref[...] = jnp.sum(x_ref[...], axis=-1, keepdims=True)
+    tryk("sum keepdims", k_sum_keep, (B, 1))
+
+    def k_colwrite(x_ref, q_ref, o_ref):
+        x = x_ref[...]
+        for j in range(P):
+            o_ref[:, j] = jnp.sum(x, axis=-1) * (j + 1)
+    tryk("o_ref[:, j] column writes", k_colwrite, (B, P))
+
+    def k_stack(x_ref, q_ref, o_ref):
+        x = x_ref[...]
+        cols = [jnp.sum(x, axis=-1) * (j + 1) for j in range(P)]
+        o_ref[...] = jnp.stack(cols, axis=-1)
+    tryk("jnp.stack P columns", k_stack, (B, P))
+
+    def k_onehot_p(x_ref, q_ref, o_ref):
+        x = x_ref[...]
+        pj = jax.lax.broadcasted_iota(jnp.int32, (B, P), 1)
+        acc = jnp.zeros((B, P), jnp.float32)
+        for j in range(P):
+            acc = acc + jnp.where(pj == j, jnp.sum(x, axis=-1)[:, None], 0.0)
+        o_ref[...] = acc
+    tryk("one-hot accumulate [B,P]", k_onehot_p, (B, P))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""On-chip probe: which formulations of the flush-extract kernel lower
+through Mosaic on the real TPU?
+
+The round-4 live window showed interpret-mode green is NOT lowering
+green: rank-1 memrefs and (after fixing those) a `dynamic_slice` from
+lane-dim `jnp.stack`/`jnp.concatenate` both fail only on hardware. This
+probe pays ONE backend init and tries each candidate formulation on a
+tiny pool, printing a verdict line per variant; the winner becomes
+ops/pallas_kernels.flush_extract.
+
+Run holding /tmp/veneur_tpu_axon.lock (single-client discipline,
+TPU_BACKEND.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from veneur_tpu.ops import tdigest as td
+from veneur_tpu.ops import pallas_kernels as pk
+
+
+def _bounds_concat(means, dmin, dmax, count, idx):
+    """lb/ub via lane-dim concatenate (the current formulation)."""
+    b, c = means.shape
+    next_means = jnp.concatenate(
+        [means[:, 1:], jnp.full((b, 1), jnp.inf, means.dtype)], axis=-1)
+    mid = (means + next_means) * 0.5
+    is_last = idx == (count.astype(jnp.int32) - 1)[:, None]
+    ub = jnp.where(is_last, dmax[:, None], mid)
+    lb = jnp.concatenate([dmin[:, None], ub[:, :-1]], axis=-1)
+    return lb, ub
+
+
+def _bounds_roll(means, dmin, dmax, count, idx):
+    """lb/ub via pltpu.roll — no concatenate, no pad/slice lowering."""
+    from jax.experimental.pallas import tpu as pltpu
+    b, c = means.shape
+    # pltpu.roll requires a non-negative shift; rolling left by one is
+    # rolling right by c-1
+    next_means = jnp.where(idx == c - 1, jnp.inf,
+                           pltpu.roll(means, c - 1, 1))
+    mid = (means + next_means) * 0.5
+    is_last = idx == (count.astype(jnp.int32) - 1)[:, None]
+    ub = jnp.where(is_last, dmax[:, None], mid)
+    lb = jnp.where(idx == 0, dmin[:, None], pltpu.roll(ub, 1, 1))
+    return lb, ub
+
+
+def make_kernel(bounds_fn, write_mode):
+    def kernel(means_ref, weights_ref, dmin_ref, dmax_ref, qs_ref,
+               quant_ref, dsum_ref, dcount_ref):
+        means = means_ref[...]
+        weights = weights_ref[...]
+        dmin = dmin_ref[...][:, 0]
+        dmax = dmax_ref[...][:, 0]
+        qs = qs_ref[...][0, :]
+        b, c = means.shape
+        p = qs.shape[0]
+        col = jax.lax.broadcasted_iota(jnp.float32, (c, c), 0)
+        row = jax.lax.broadcasted_iota(jnp.float32, (c, c), 1)
+        tril = (col <= row).astype(jnp.float32)
+        w_cum = jnp.dot(weights, tril, preferred_element_type=jnp.float32)
+        total = w_cum[:, -1]
+        nonempty = weights > 0
+        count = jnp.sum(nonempty.astype(jnp.float32), axis=-1)
+        idx = jax.lax.broadcasted_iota(jnp.int32, (b, c), 1)
+        lb, ub = bounds_fn(means, dmin, dmax, count, idx)
+        dsum_ref[...] = jnp.sum(jnp.where(nonempty, means * weights, 0.0),
+                                axis=-1, keepdims=True)
+        dcount_ref[...] = total[:, None]
+        w_before = w_cum - weights
+        safe_w = jnp.maximum(weights, 1e-30)
+        empty_row = (total <= 0) | (count <= 0)
+        cols = []
+        for j in range(p):
+            target = qs[j] * total
+            reached = target[:, None] <= w_cum
+            first = jnp.argmax(reached, axis=-1)
+            sel = idx == first[:, None]
+            proportion = (target[:, None] - w_before) / safe_w
+            val_all = lb + proportion * (ub - lb)
+            val = jnp.sum(jnp.where(sel, val_all, 0.0), axis=-1)
+            val = jnp.where(empty_row, jnp.nan, val)
+            if write_mode == "column":
+                quant_ref[:, j] = val
+            else:
+                cols.append(val)
+        if write_mode == "stack":
+            quant_ref[...] = jnp.stack(cols, axis=-1)
+        elif write_mode == "padded":
+            # lane-pad P up to the block's lane tile by summing one-hot
+            # outer products: quant[b, j] = Σ_j onehot_j ⊙ val — pure
+            # elementwise/broadcast, no concatenate
+            pj = jax.lax.broadcasted_iota(jnp.int32, (b, quant_ref.shape[1]),
+                                          1)
+            acc = jnp.zeros((b, quant_ref.shape[1]), jnp.float32)
+            for j, val in enumerate(cols):
+                acc = acc + jnp.where(pj == j, val[:, None], 0.0)
+            quant_ref[...] = acc
+    return kernel
+
+
+def run_variant(name, bounds_fn, write_mode, pad_lanes=False):
+    s, c, p = 512, td.DEFAULT_CAPACITY, 3
+    rows = 256
+    pool = td.init_pool(s, c)
+    rng = np.random.default_rng(0)
+    means = jnp.asarray(rng.normal(100.0, 10.0, (s, c)).astype(np.float32))
+    weights = jnp.asarray(rng.uniform(0.0, 4.0, (s, c)).astype(np.float32))
+    dmin = jnp.min(means, axis=-1)
+    dmax = jnp.max(means, axis=-1)
+    qs = jnp.asarray(np.array([0.5, 0.9, 0.99], np.float32))
+    pq = 128 if pad_lanes else p
+    kern = make_kernel(bounds_fn, write_mode)
+    t0 = time.time()
+    try:
+        quant, dsum, dcount = pl.pallas_call(
+            kern,
+            grid=(s // rows,),
+            in_specs=[
+                pl.BlockSpec((rows, c), lambda i: (i, 0)),
+                pl.BlockSpec((rows, c), lambda i: (i, 0)),
+                pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+                pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+                pl.BlockSpec((1, p), lambda i: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((rows, pq), lambda i: (i, 0)),
+                pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+                pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((s, pq), jnp.float32),
+                jax.ShapeDtypeStruct((s, 1), jnp.float32),
+                jax.ShapeDtypeStruct((s, 1), jnp.float32),
+            ],
+        )(means, weights, dmin[:, None], dmax[:, None], qs[None, :])
+        jax.block_until_ready(quant)
+        ref = td.quantile(means, weights, dmin, dmax, qs)
+        err = float(jnp.nanmax(jnp.abs(quant[:, :p] - ref)))
+        print(f"VARIANT {name}: OK lower+run in {time.time()-t0:.1f}s, "
+              f"max |Δ| vs XLA oracle = {err:.3e}", flush=True)
+        return True
+    except Exception as e:
+        msg = str(e).split("\n")[0][:300]
+        print(f"VARIANT {name}: FAIL {type(e).__name__}: {msg}", flush=True)
+        return False
+
+
+def main():
+    print(f"backend: {jax.default_backend()} {jax.devices()[0]}", flush=True)
+    run_variant("concat+stack   (current)", _bounds_concat, "stack")
+    run_variant("concat+colwrite", _bounds_concat, "column")
+    run_variant("roll+stack", _bounds_roll, "stack")
+    run_variant("roll+colwrite", _bounds_roll, "column")
+    run_variant("roll+padded128", _bounds_roll, "padded", pad_lanes=True)
+    run_variant("concat+padded128", _bounds_concat, "padded", pad_lanes=True)
+
+
+if __name__ == "__main__":
+    main()

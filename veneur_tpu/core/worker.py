@@ -248,6 +248,16 @@ def _histo_flush_extract(means, weights, dmin, dmax, drecip, drecip_c,
             lrecip + lrecip_c)
 
 
+@jax.jit
+def _pack_extract_columns(qv, *cols):
+    """[S,P] quantiles + ten [S] aggregates → one [S,P+10] f32 array, so
+    extract_snapshot pays a single device→host transfer instead of
+    eleven synchronous ones (the round-trips, not the bytes, dominate on
+    a remote-device link)."""
+    return jnp.concatenate(
+        [qv] + [c[:, None].astype(jnp.float32) for c in cols], axis=1)
+
+
 @functools.partial(jax.jit, static_argnames=("new_rows",), donate_argnums=(0,))
 def _grow_2d(old, new_rows: int):
     s, c = old.shape
@@ -1486,16 +1496,33 @@ class DeviceWorker:
                 _free_staged_planes(pending)
             qs = jnp.asarray(np.asarray(quantiles, dtype=np.float32))
             out = self._extract(fields, qs)
-            (qv, dmin, dmax, dsum, dcount, drecip,
-             lmin, lmax, lsum, lweight, lrecip) = [np.asarray(a) for a in out]
+            # ONE device→host transfer for the whole extraction: eleven
+            # per-array np.asarray calls are eleven synchronous D2H
+            # round-trips, and on a link with per-transfer latency (the
+            # tunnelled relay; any remote-device setup) the round-trips
+            # dominate the bytes at 1M rows
+            packed = np.asarray(_pack_extract_columns(*out))
+            p = out[0].shape[1]
+            qv = packed[:, :p]
+            (dmin, dmax, dsum, dcount, drecip, lmin, lmax, lsum, lweight,
+             lrecip) = (packed[:, p + i] for i in range(10))
             snap.quantile_values = qv[:n]
             snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
             snap.dmin, snap.dmax = dmin[:n], dmax[:n]
             snap.dsum, snap.dcount, snap.drecip = dsum[:n], dcount[:n], drecip[:n]
             snap.lmin, snap.lmax = lmin[:n], lmax[:n]
             snap.lsum, snap.lweight, snap.lrecip = lsum[:n], lweight[:n], lrecip[:n]
-            snap.digest_means = np.asarray(fields[0])[:n]
-            snap.digest_weights = np.asarray(fields[1])[:n]
+            # the [S,C] centroid pools are read back ONLY where forwarding
+            # can consume them (a local tier serializes digests upstream;
+            # reference flusher.go:338-433). A terminal server — global or
+            # standalone, forward_address unset — never touches them, and
+            # at 1M series the two arrays are ~1GB of device→host traffic
+            # that round-4's on-chip E2E run measured at >90s of the 105s
+            # extract phase. Consumers (codec.py, flusher.forward
+            # iterator) already handle digest_means is None.
+            if self.is_local:
+                snap.digest_means = np.asarray(fields[0])[:n]
+                snap.digest_weights = np.asarray(fields[1])[:n]
         if swapped.staged_histo:
             # histo block skipped (no rows): planes can hold nothing
             # meaningful, but C++ memory must still be released

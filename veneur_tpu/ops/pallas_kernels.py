@@ -25,6 +25,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 from veneur_tpu.ops import tdigest as td
@@ -33,26 +34,35 @@ DEFAULT_BLOCK_ROWS = 256
 
 
 def _extract_kernel(means_ref, weights_ref, dmin_ref, dmax_ref, qs_ref,
-                    quant_ref, dsum_ref, dcount_ref):
+                    tril_ref, quant_ref, dsum_ref, dcount_ref):
+    # Mosaic lowering constraints, all verified on the real chip by
+    # tools/probe_pallas_minimal.py (interpret mode can't see them):
+    #   * every ref is rank-2 — rank-1 memrefs don't tile onto the
+    #     (sublane, lane) register layout
+    #   * no negative static indices (x[:, -1] lowers to dynamic_slice,
+    #     unimplemented) — use the explicit positive index
+    #   * no argmax (int reductions unsupported) — one-hot via a float
+    #     min-reduce over a lane iota instead
+    #   * no sublane-axis iota inside the kernel — the lower-triangular
+    #     cumsum matmul matrix arrives as an operand
     means = means_ref[...]  # [B, C]
     weights = weights_ref[...]  # [B, C]
-    dmin = dmin_ref[...]  # [B]
-    dmax = dmax_ref[...]  # [B]
-    qs = qs_ref[...]  # [P]
+    dmin = dmin_ref[...][:, 0]  # [B, 1] -> [B]
+    dmax = dmax_ref[...][:, 0]
+    qs = qs_ref[...][0, :]  # [1, P] -> [P]
     b, c = means.shape
     p = qs.shape[0]
 
     # cumulative weight via lower-triangular matmul (rides the MXU)
-    col = jax.lax.broadcasted_iota(jnp.float32, (c, c), 0)
-    row = jax.lax.broadcasted_iota(jnp.float32, (c, c), 1)
-    tril = (col <= row).astype(jnp.float32)  # [C, C]; cum[j] = Σ_{i<=j} w_i
-    w_cum = jnp.dot(weights, tril, preferred_element_type=jnp.float32)
-    total = w_cum[:, -1]  # [B]
+    w_cum = jnp.dot(weights, tril_ref[...],
+                    preferred_element_type=jnp.float32)
+    total = w_cum[:, c - 1]  # [B]
 
     nonempty = weights > 0
     count = jnp.sum(nonempty.astype(jnp.float32), axis=-1)  # [B]
 
     idx = jax.lax.broadcasted_iota(jnp.int32, (b, c), 1)
+    idxf = idx.astype(jnp.float32)  # tpu.iota only produces integers
     # next-slot means: shift left, +inf in the last lane
     next_means = jnp.concatenate(
         [means[:, 1:], jnp.full((b, 1), jnp.inf, means.dtype)], axis=-1)
@@ -63,21 +73,24 @@ def _extract_kernel(means_ref, weights_ref, dmin_ref, dmax_ref, qs_ref,
 
     # aggregates from the same load
     dsum_ref[...] = jnp.sum(jnp.where(nonempty, means * weights, 0.0),
-                            axis=-1)
-    dcount_ref[...] = total
+                            axis=-1, keepdims=True)
+    dcount_ref[...] = total[:, None]
 
     w_before = w_cum - weights
     safe_w = jnp.maximum(weights, 1e-30)
     empty_row = (total <= 0) | (count <= 0)
+    cols = []
     for j in range(p):
         target = qs[j] * total  # [B]
         reached = target[:, None] <= w_cum  # [B, C]
-        first = jnp.argmax(reached, axis=-1)  # [B]
-        sel = idx == first[:, None]  # one-hot [B, C]
+        # first reached slot, argmax-free: min lane index where reached
+        first = jnp.min(jnp.where(reached, idxf, jnp.inf), axis=-1)  # [B]
+        sel = idxf == first[:, None]  # one-hot [B, C]
         proportion = (target[:, None] - w_before) / safe_w
         val_all = lb + proportion * (ub - lb)
         val = jnp.sum(jnp.where(sel, val_all, 0.0), axis=-1)
-        quant_ref[:, j] = jnp.where(empty_row, jnp.nan, val)
+        cols.append(jnp.where(empty_row, jnp.nan, val))
+    quant_ref[...] = jnp.stack(cols, axis=-1)
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
@@ -92,28 +105,35 @@ def flush_extract(means, weights, dmin, dmax, qs,
         while s % block_rows:
             block_rows //= 2
     grid = (s // block_rows,)
-    return pl.pallas_call(
+    # cum[j] = Σ_{i<=j} w_i as a [C,C] matmul operand (in-kernel sublane
+    # iota fails Mosaic verification; see _extract_kernel header)
+    tril = jnp.asarray(
+        (np.arange(c)[:, None] <= np.arange(c)[None, :])
+        .astype(np.float32))
+    quant, dsum, dcount = pl.pallas_call(
         _extract_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
             pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
-            pl.BlockSpec((p,), lambda i: (0,)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, p), lambda i: (0, 0)),
+            pl.BlockSpec((c, c), lambda i: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((block_rows, p), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
-            pl.BlockSpec((block_rows,), lambda i: (i,)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((s, p), jnp.float32),
-            jax.ShapeDtypeStruct((s,), jnp.float32),
-            jax.ShapeDtypeStruct((s,), jnp.float32),
+            jax.ShapeDtypeStruct((s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((s, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(means, weights, dmin, dmax, qs)
+    )(means, weights, dmin[:, None], dmax[:, None], qs[None, :], tril)
+    return quant, dsum[:, 0], dcount[:, 0]
 
 
 def flush_extract_reference(means, weights, dmin, dmax, qs):
