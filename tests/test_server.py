@@ -342,6 +342,37 @@ def test_enable_profiling_writes_xla_trace(tmp_path):
     assert any(f.is_file() for f in files), "no profiler artifacts written"
 
 
+def test_mid_epoch_series_sync_preserves_flush_output():
+    """New-series adoption can run any number of times mid-epoch
+    (Server._series_sync_loop does it on a sub-interval cadence so the
+    per-series Python work doesn't all land in swap, under the ingest
+    lock) without changing what the flush emits or double-adopting."""
+    srv, sink, ports = _server(num_workers=2, interval="600s")
+    try:
+        if not srv.native_mode:
+            pytest.skip("native library unavailable")
+        for i in range(200):
+            srv._native_router.ingest(
+                f"sync.t{i}:{i % 31}|ms\nsync.c{i}:2|c".encode())
+            if i % 40 == 0:
+                srv.sync_native_series_once()
+        srv.sync_native_series_once()
+        srv.sync_native_series_once()  # idempotent when nothing pending
+        adopted_before = sum(
+            w.directory.num_histo_rows for w in srv.workers)
+        assert adopted_before == 200  # all series visible pre-flush
+        final = srv.flush()
+        ms = {m.name: m for m in
+              (final.materialize() if hasattr(final, "materialize")
+               else final)}
+        # one .count per timer series + one counter series each
+        assert sum(1 for n in ms if n.endswith(".count")) == 200
+        assert ms["sync.c7"].value == 2.0
+        assert ms["sync.t7.max"].value == 7.0
+    finally:
+        srv.shutdown()
+
+
 def test_ingest_not_blocked_during_flush_extraction():
     """SURVEY §7 latency budget: next-interval ingest must keep flowing
     while the flush extracts. Routed native ingest takes no Python lock

@@ -1060,6 +1060,8 @@ class Server:
         if self.config.tpu_warmup_compile:
             self._spawn(self._warmup_compile, "warmup-compile")
         self._spawn(self._flush_loop, "flush-ticker")
+        if self.native_mode:
+            self._spawn(self._series_sync_loop, "series-sync")
         return ports
 
     def _warmup_compile(self) -> None:
@@ -1092,6 +1094,27 @@ class Server:
             # warmup is best-effort: a failure only restores the lazy
             # first-flush compile
             log.debug("flush warmup failed", exc_info=True)
+
+    def sync_native_series_once(self) -> None:
+        """One locked new-series adoption sweep across all workers."""
+        for i, worker in enumerate(self.workers):
+            with self._worker_locks[i]:
+                worker.sync_native_series()
+
+    def _series_sync_loop(self) -> None:
+        """Adopt new-series registrations from the C++ contexts as they
+        arrive instead of all at once inside flush's swap phase — at 1M
+        fresh series per interval the adoption is ~7s of Python work
+        that would otherwise sit under the ingest lock (profiled:
+        _sync_native_series was 0.88s of a 0.99s swap at 131k series).
+        Cadence is a fraction of the interval so the swap-time tail is
+        small; the sweep early-returns when nothing is pending."""
+        cadence = max(0.1, min(1.0, self.interval / 8.0))
+        while not self._shutdown.wait(cadence):
+            try:
+                self.sync_native_series_once()
+            except Exception:
+                log.exception("series sync sweep failed")
 
     def _flush_loop(self) -> None:
         """Interval ticker, optionally aligned to the wall clock

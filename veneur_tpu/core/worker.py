@@ -657,6 +657,25 @@ class DeviceWorker:
                 self.scalars.gauges.adopt_row(
                     row, meta.key, meta.tags, meta.scope_class, meta.sinks)
 
+    def sync_native_series(self) -> None:
+        """Adopt pending new-series registrations mid-epoch.
+
+        Directory adoption is per-series Python work — ~0.9s per 131k
+        fresh series — and every interval re-registers every series
+        (metrics expire at flush, reference README.md:135-137). Left to
+        epoch close it all lands in swap(), UNDER the server's ingest
+        lock; called periodically (Server._series_sync_loop) it spreads
+        across the interval and swap only adopts the last cadence
+        window's tail. Caller holds the worker lock; takes the native
+        context lock itself."""
+        if self._native is None:
+            return
+        self._native.lock()
+        try:
+            self._sync_native_series()
+        finally:
+            self._native.unlock()
+
     def drain_native(self) -> None:
         """Move everything pending in the native pipeline into device/host
         state. Holds the context lock across the whole raw-drain so routed
