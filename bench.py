@@ -760,6 +760,16 @@ def _emit(result: dict) -> None:
 
 
 def main() -> None:
+    # persistent XLA compile cache for every bench child (and this
+    # process in all-mode): live relay windows are scarce, and the
+    # first-compile at each workload shape costs tens of seconds on the
+    # chip — pay once across windows, not per window. Env (not
+    # jax.config) so subprocess workloads inherit it.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     name = os.environ.get("VENEUR_BENCH_WORKLOAD")
     if name == "all":
         # all five workloads in THIS process: ONE backend init amortized

@@ -68,6 +68,14 @@ def main() -> None:
 
     import jax
 
+    # persistent compile cache: live windows are scarce (TPU_BACKEND.md
+    # logs one in four rounds) and XLA first-compiles at bench shapes
+    # cost tens of seconds each through the relay — pay them in the
+    # FIRST window, not every window
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     plat = jax.devices()[0].platform
     plat = "tpu" if plat in ("tpu", "axon") else plat
     emit({"event": "backend_live", "platform": plat,
@@ -92,6 +100,7 @@ def main() -> None:
     # (the first window captured E2E_FLUSH with the pre-fix 105s
     # readback extract; the skip-if-on-tpu gate would have pinned that
     # number forever). profile_ingest alone is capture-once.
+    run_stage("relay_link", lambda: run_tool("probe_relay_link.py"))
     run_stage("e2e_flush", lambda: run_tool("bench_e2e_flush.py"))
     run_stage("e2e_scaling",
               lambda: run_tool("bench_e2e_flush.py", ["--scaling"]))

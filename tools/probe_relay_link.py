@@ -1,0 +1,117 @@
+"""Measure the tunnelled relay's host↔device link: bandwidth each way
+and per-transfer latency, plus dispatch round-trip time.
+
+PERF_MODEL.md's round-4 addendum claims the dev rig's binding roof is
+this link (~11MB/s inferred from the pre-fix 1GB extract readback);
+this probe measures it directly so the roofline context in every bench
+line rests on data. Writes RELAY_LINK.json at the repo root.
+
+Measurement rules learned the hard way on this rig (TPU_BACKEND.md,
+bench.py force-read comment): the relay dedupes repeated identical
+payloads, so every timed transfer must move a buffer the link has
+never seen — in BOTH directions (jax.Array also caches its host copy
+after the first np.asarray, so a repeated readback times a dict hit).
+
+Run on a live backend (tools/onchip_suite.py runs it inside the
+single-init pass; standalone runs must hold /tmp/veneur_tpu_axon.lock).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _normalize_backend  # noqa: E402  one place for axon->tpu
+
+
+def median(xs):
+    return float(np.median(np.asarray(xs)))
+
+
+def slope_mb_s(times_by_size: dict[int, float]) -> float | None:
+    """Bandwidth from the slope between the two largest sizes (cancels
+    the fixed per-call cost). None — not a fantasy number — when the
+    delta is non-positive (timer noise or a caching bug upstream)."""
+    sizes = sorted(times_by_size)
+    dt = times_by_size[sizes[-1]] - times_by_size[sizes[-2]]
+    if dt <= 0:
+        return None
+    return round((sizes[-1] - sizes[-2]) / dt / 1e6, 1)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    out = {"platform": _normalize_backend(dev.platform),
+           "device": str(dev)}
+
+    # dispatch round-trip: scalar computation + 4-byte fetch, the
+    # minimum unit of work the relay can do. Fresh operand each time —
+    # the relay dedupes repeated identical executions.
+    f = jax.jit(lambda v: v * 2.0 + 1.0)
+    float(f(jnp.float32(0.5)))  # compile
+    rtts = []
+    for i in range(9):
+        x = jnp.float32(1.5 + i)
+        t0 = time.perf_counter()
+        float(f(x))
+        rtts.append(time.perf_counter() - t0)
+    out["dispatch_rtt_ms"] = round(median(rtts) * 1e3, 2)
+
+    timed_reps = 3
+    sizes = [1 << 20, 8 << 20, 32 << 20]
+
+    # H2D: a NEVER-before-seen host buffer per timed upload, forced
+    # device-side by a scalar fetch of a content-dependent reduction
+    g = jax.jit(lambda a: jnp.sum(a))
+    h2d = {}
+    rng = np.random.default_rng(0)
+    for nbytes in sizes:
+        n = nbytes // 4
+        bufs = [rng.random(n, np.float32) for _ in range(timed_reps + 1)]
+        float(g(jnp.asarray(bufs[-1])))  # compile at shape
+        ts = []
+        for i in range(timed_reps):
+            t0 = time.perf_counter()
+            float(g(jnp.asarray(bufs[i])))
+            ts.append(time.perf_counter() - t0)
+        h2d[nbytes] = median(ts)
+    out["h2d_mb_s"] = slope_mb_s(h2d)
+    out["h2d_s_by_size"] = {str(k): round(v, 3) for k, v in h2d.items()}
+
+    # D2H: a fresh device-resident buffer per timed readback (np.asarray
+    # of a previously-read array returns its cached host copy)
+    d2h = {}
+    for nbytes in sizes:
+        n = nbytes // 4
+        keys = [jax.random.uniform(jax.random.PRNGKey(17 * len(d2h) + i),
+                                   (n,)) for i in range(timed_reps)]
+        jax.block_until_ready(keys)
+        ts = []
+        for a in keys:
+            t0 = time.perf_counter()
+            np.asarray(a)
+            ts.append(time.perf_counter() - t0)
+        d2h[nbytes] = median(ts)
+    out["d2h_mb_s"] = slope_mb_s(d2h)
+    out["d2h_s_by_size"] = {str(k): round(v, 3) for k, v in d2h.items()}
+
+    out["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    path = os.path.join(REPO, "RELAY_LINK.json")
+    with open(path + ".tmp", "w") as f2:
+        json.dump(out, f2, indent=1)
+    os.replace(path + ".tmp", path)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
