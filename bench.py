@@ -770,6 +770,16 @@ def main() -> None:
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_cache"))
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    if "jax" in sys.modules:
+        # the axon site hook imports jax at interpreter start, and jax
+        # reads these env vars at import — set the config directly too
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
     name = os.environ.get("VENEUR_BENCH_WORKLOAD")
     if name == "all":
         # all five workloads in THIS process: ONE backend init amortized

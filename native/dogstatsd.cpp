@@ -377,6 +377,12 @@ struct Ctx {
   std::vector<double> c_contribs;
   std::vector<int32_t> g_rows;
   std::vector<double> g_vals;
+  // built lazily the first time g_rows hits the spill cap: gauges are
+  // last-write-wins, so a capped batch must UPDATE a row's pending
+  // entry in place rather than shed the newest value (a shed gauge
+  // would flush an actively wrong early-interval value). Cleared on
+  // drain/reset; rows absent from the capped batch still shed+count.
+  std::unordered_map<int32_t, size_t> g_last;
   std::vector<int32_t> s_rows;
   std::vector<int32_t> s_idx;
   std::vector<int8_t> s_rank;
@@ -386,6 +392,8 @@ struct Ctx {
 
   long long processed = 0;
   long long errors = 0;
+  long long overload_dropped = 0;  // samples shed at the SoA spill caps
+  size_t spill_cap = size_t{1} << 22;  // entries per pending SoA batch
 
   // Commit-path lock contention stats (vn_lock_stats; recorded only
   // while vn_set_lock_stats(1) — the try_lock probe and clock reads cost
@@ -639,6 +647,18 @@ bool commit_metric(Ctx* ctx, const Parsed& p, const std::string& joined) {
   bool created = false;
   int32_t row;
   int32_t pool;
+  // Overload shedding: the pending SoA batches are normally drained
+  // every ~100ms (Server's native pump / strided ingest checks), but a
+  // host whose aggregate throughput is below the offered load can't
+  // drain them at arrival rate, and an unbounded vector here is an OOM
+  // waiting for a traffic spike (observed: multi-GB RSS in an overload
+  // soak). Beyond the cap the SAMPLE is dropped and counted
+  // (overload_dropped -> veneur.ingest.overload_dropped_total); the
+  // series registration above the drop still happens, so cardinality
+  // bookkeeping stays exact. Mirrors the reference's bounded worker
+  // channels, where the kernel socket buffer sheds the excess
+  // (worker.go:31-48 PacketChan; drop-don't-block per README backpressure).
+  const size_t kSpillCap = ctx->spill_cap;
   switch (kind) {
     case KIND_HISTOGRAM:
     case KIND_TIMER: {
@@ -649,9 +669,13 @@ bool commit_metric(Ctx* ctx, const Parsed& p, const std::string& joined) {
       if (!stage_histo_sample(ctx, row, value, sample_rate)) {
         // staging disabled, or this row's plane slots are full: spill
         // into the SoA batch for the direct per-batch device fold
-        ctx->h_rows.push_back(row);
-        ctx->h_vals.push_back(static_cast<float>(value));
-        ctx->h_wts.push_back(static_cast<float>(1.0 / sample_rate));
+        if (ctx->h_rows.size() < kSpillCap) {
+          ctx->h_rows.push_back(row);
+          ctx->h_vals.push_back(static_cast<float>(value));
+          ctx->h_wts.push_back(static_cast<float>(1.0 / sample_rate));
+        } else {
+          ++ctx->overload_dropped;
+        }
       }
       break;
     }
@@ -666,9 +690,13 @@ bool commit_metric(Ctx* ctx, const Parsed& p, const std::string& joined) {
       uint64_t w = h << p;
       int rank = w == 0 ? (64 - p + 1) : (__builtin_clzll(w) + 1);
       if (rank > 64 - p + 1) rank = 64 - p + 1;
-      ctx->s_rows.push_back(row);
-      ctx->s_idx.push_back(static_cast<int32_t>(idx));
-      ctx->s_rank.push_back(static_cast<int8_t>(rank));
+      if (ctx->s_rows.size() < kSpillCap) {
+        ctx->s_rows.push_back(row);
+        ctx->s_idx.push_back(static_cast<int32_t>(idx));
+        ctx->s_rank.push_back(static_cast<int8_t>(rank));
+      } else {
+        ++ctx->overload_dropped;
+      }
       break;
     }
     case KIND_COUNTER: {
@@ -676,11 +704,15 @@ bool commit_metric(Ctx* ctx, const Parsed& p, const std::string& joined) {
       row = ctx->dir.upsert(key_hash, ctx->key, ctx->next_counter_row,
                             &created);
       if (created) ++ctx->next_counter_row;
-      // Go semantics: int64(sample) * int64(1/rate)
-      ctx->c_rows.push_back(row);
-      ctx->c_contribs.push_back(
-          static_cast<double>(static_cast<long long>(value) *
-                              static_cast<long long>(1.0 / sample_rate)));
+      if (ctx->c_rows.size() < kSpillCap) {
+        // Go semantics: int64(sample) * int64(1/rate)
+        ctx->c_rows.push_back(row);
+        ctx->c_contribs.push_back(
+            static_cast<double>(static_cast<long long>(value) *
+                                static_cast<long long>(1.0 / sample_rate)));
+      } else {
+        ++ctx->overload_dropped;
+      }
       break;
     }
     case KIND_GAUGE: {
@@ -688,8 +720,22 @@ bool commit_metric(Ctx* ctx, const Parsed& p, const std::string& joined) {
       row = ctx->dir.upsert(key_hash, ctx->key, ctx->next_gauge_row,
                             &created);
       if (created) ++ctx->next_gauge_row;
-      ctx->g_rows.push_back(row);
-      ctx->g_vals.push_back(value);
+      if (ctx->g_rows.size() < kSpillCap) {
+        ctx->g_rows.push_back(row);
+        ctx->g_vals.push_back(value);
+      } else {
+        if (ctx->g_last.empty()) {
+          // overload onset: index the batch once (last occurrence wins)
+          for (size_t i = 0; i < ctx->g_rows.size(); ++i)
+            ctx->g_last[ctx->g_rows[i]] = i;
+        }
+        auto it = ctx->g_last.find(row);
+        if (it != ctx->g_last.end()) {
+          ctx->g_vals[it->second] = value;  // last write wins, in place
+        } else {
+          ++ctx->overload_dropped;
+        }
+      }
       break;
     }
   }
@@ -1407,6 +1453,7 @@ void vn_ctx_reset(void* p) {
   ctx->c_contribs.clear();
   ctx->g_rows.clear();
   ctx->g_vals.clear();
+  ctx->g_last.clear();
   ctx->s_rows.clear();
   ctx->s_idx.clear();
   ctx->s_rank.clear();
@@ -1414,6 +1461,7 @@ void vn_ctx_reset(void* p) {
   ctx->other_lines.clear();
   ctx->processed = 0;
   ctx->errors = 0;
+  ctx->overload_dropped = 0;
   ctx->ssf_spans = 0;
   ctx->ssf_invalid = 0;
   ctx->ssf_services.clear();
@@ -1892,6 +1940,18 @@ long long vn_errors(void* p) {
   return ctx->errors;
 }
 
+long long vn_overload_dropped(void* p) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> g(ctx->mu);
+  return ctx->overload_dropped;
+}
+
+void vn_set_spill_cap(void* p, long long cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> g(ctx->mu);
+  if (cap > 0) ctx->spill_cap = static_cast<size_t>(cap);
+}
+
 int vn_drain_histo(void* p, int32_t* rows, float* vals, float* wts, int cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
   std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
@@ -1939,6 +1999,7 @@ int vn_drain_gauge(void* p, int32_t* rows, double* vals, int cap) {
   std::memcpy(vals, ctx->g_vals.data(), n * sizeof(double));
   ctx->g_rows.erase(ctx->g_rows.begin(), ctx->g_rows.begin() + n);
   ctx->g_vals.erase(ctx->g_vals.begin(), ctx->g_vals.begin() + n);
+  ctx->g_last.clear();  // indices into the batch are invalid after erase
   return n;
 }
 

@@ -761,6 +761,59 @@ def test_native_staging_spills_past_depth():
         free()
 
 
+def test_native_spill_cap_sheds_with_exact_count():
+    """Beyond the pending-batch cap the sample is dropped and counted
+    (overload shedding, drop-don't-block): an overloaded host must stay
+    memory-bounded like the reference's fixed worker channels
+    (worker.go:31-48), never OOM. Counter/gauge/set batches cap too;
+    drains and later ingest keep working after shedding."""
+    ni = native_mod.NativeIngest()
+    ni.set_stage_depth(2)
+    ni.set_spill_cap(4)
+    # one hot histo row: 2 staged + 4 spilled + 3 shed
+    for v in range(9):
+        ni.ingest(b"cap.hot:%d|ms" % v)
+    assert ni.stage_total == 2
+    assert ni.pending_histo == 4
+    assert ni.overload_dropped == 3
+    # counters shed beyond the cap too (value preserved up to the cap)
+    for v in range(6):
+        ni.ingest(b"cap.c:1|c")
+    assert ni.pending_counter == 4
+    assert ni.overload_dropped == 5
+    # sets: cap applies per sample
+    for v in range(6):
+        ni.ingest(b"cap.s:%d|s" % v)
+    assert ni.pending_set == 4
+    assert ni.overload_dropped == 7
+    # gauges are last-write-wins: at the cap, a row already in the
+    # batch UPDATES in place (a shed gauge would flush an actively
+    # wrong early-interval value); only rows absent from the capped
+    # batch shed
+    for v in range(4):
+        ni.ingest(b"cap.g:%d|g" % v)  # fills the batch to the cap
+    ni.ingest(b"cap.gnew:1|g")  # new row while capped: sheds
+    assert ni.overload_dropped == 8
+    ni.ingest(b"cap.g:99|g")  # known row while capped: in-place update
+    assert ni.pending_gauge == 4
+    assert ni.overload_dropped == 8
+    _rows, gvals = ni.drain_gauge(8)
+    assert 99.0 in list(gvals)
+    # shedding is not sticky: a drain frees the batch and ingest resumes
+    rows, vals, _wts = ni.drain_histo(16)
+    assert list(vals) == [2.0, 3.0, 4.0, 5.0]
+    ni.ingest(b"cap.hot:42|ms")
+    assert ni.pending_histo == 1
+    assert ni.overload_dropped == 8
+    # the in-place gauge index is invalidated by the drain: the same
+    # row appends fresh entries afterwards (no stale-index writes)
+    ni.ingest(b"cap.g:7|g")
+    assert ni.pending_gauge == 1
+    # epoch reset clears the tally (per-interval self-metric semantics)
+    ni.reset()
+    assert ni.overload_dropped == 0
+
+
 def test_native_staging_reset_drops_plane():
     """vn_ctx_reset must not leak staged samples into the next epoch."""
     ni = native_mod.NativeIngest()

@@ -49,6 +49,61 @@ def test_pallas_empty_rows_nan():
     assert np.allclose(np.asarray(dcount), 0.0)
 
 
+def test_pallas_mixed_occupancy_rows():
+    """Rows at every occupancy extreme in one pool: empty, a single
+    centroid, two centroids, full — the one-hot select and the
+    last-centroid upper-bound logic all have edge behavior here."""
+    s, c = 8, td.DEFAULT_CAPACITY
+    means = np.zeros((s, c), np.float32)
+    weights = np.zeros((s, c), np.float32)
+    # row 1: single centroid; row 2: two; row 3: full, uniform
+    means[1, 0], weights[1, 0] = 42.0, 5.0
+    means[2, :2], weights[2, :2] = [10.0, 20.0], [1.0, 3.0]
+    means[3], weights[3] = np.linspace(0, 127, c), 1.0
+    # row 4: heavily skewed weights (q lands inside the huge centroid)
+    means[4, :3], weights[4, :3] = [1.0, 2.0, 3.0], [1.0, 1e6, 1.0]
+    dmin = np.where(weights.sum(1) > 0, np.min(
+        np.where(weights > 0, means, np.inf), axis=1), np.inf)
+    dmax = np.where(weights.sum(1) > 0, np.max(
+        np.where(weights > 0, means, -np.inf), axis=1), -np.inf)
+    args = [jnp.asarray(a.astype(np.float32))
+            for a in (means, weights, dmin, dmax)]
+    qs = jnp.asarray([0.01, 0.5, 0.99], dtype=jnp.float32)
+    quant_p, dsum_p, dcount_p = pk.flush_extract(
+        *args, qs, block_rows=8, interpret=True)
+    quant_x, dsum_x, dcount_x = pk.flush_extract_reference(*args, qs)
+    np.testing.assert_allclose(np.asarray(quant_p), np.asarray(quant_x),
+                               rtol=1e-5, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dsum_p), np.asarray(dsum_x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dcount_p), np.asarray(dcount_x),
+                               rtol=1e-6)
+    # empty rows NaN, occupied rows finite
+    assert np.isnan(np.asarray(quant_p)[0]).all()
+    assert np.isfinite(np.asarray(quant_p)[1:5]).all()
+    # single-centroid row: every quantile inside [dmin, dmax]
+    assert (np.asarray(quant_p)[1] >= 42.0 - 1e-3).all()
+    assert (np.asarray(quant_p)[1] <= 42.0 + 1e-3).all()
+
+
+def test_pallas_many_quantiles_and_seeds():
+    """Sweep P (the lane-minor output dim the Mosaic rewrite stacks) and
+    random pools; the kernel must track the oracle for every shape."""
+    for p in (1, 2, 5, 8):
+        pool = _pool_with_data(s=32, seed=p)
+        qs = jnp.asarray(np.linspace(0.05, 0.95, p).astype(np.float32))
+        quant_p, dsum_p, dcount_p = pk.flush_extract(
+            pool.means, pool.weights, pool.min, pool.max, qs,
+            block_rows=16, interpret=True)
+        quant_x, dsum_x, dcount_x = pk.flush_extract_reference(
+            pool.means, pool.weights, pool.min, pool.max, qs)
+        np.testing.assert_allclose(np.asarray(quant_p),
+                                   np.asarray(quant_x),
+                                   rtol=1e-5, atol=1e-3, err_msg=f"P={p}")
+        np.testing.assert_allclose(np.asarray(dcount_p),
+                                   np.asarray(dcount_x), rtol=1e-6)
+
+
 def test_pallas_uneven_rows_fall_back_to_smaller_blocks():
     pool = _pool_with_data(s=24, seed=3)  # 24 % 16 != 0 → halves to 8
     qs = jnp.asarray([0.5], dtype=jnp.float32)

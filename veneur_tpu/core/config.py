@@ -142,6 +142,11 @@ class Config:
     # per interval (worker._histo_fold_staged); rows that fill their
     # staging mid-interval spill through the direct device fold
     tpu_stage_depth: int = 64
+    # entries per pending-batch (SoA) class before ingest sheds samples
+    # (drop-don't-block under overload; counted in
+    # veneur.ingest.overload_dropped_total). Bounds native ingest memory
+    # the way the reference's fixed worker channels do (worker.go:31-48)
+    tpu_spill_cap: int = 1 << 22
     tpu_compression: float = 100.0
     tpu_hll_precision: int = 14
     # set-sketch storage: "staged" keeps small sets host-side sparse and

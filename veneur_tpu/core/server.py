@@ -115,6 +115,7 @@ class Server:
                 is_local=self.is_local,
                 set_hash=cfg.set_hash,
                 set_store=cfg.tpu_set_store,
+                spill_cap=cfg.tpu_spill_cap,
             )
             for _ in range(cfg.num_workers)
         ]
@@ -1207,6 +1208,14 @@ class Server:
                                  worker.processed, tags=[f"worker:{i}"])
                 self.stats.count("worker.metrics_imported_total",
                                  worker.imported, tags=[f"worker:{i}"])
+                dropped = getattr(worker, "overload_dropped", 0)
+                if dropped:
+                    # samples shed at the native spill caps (overload;
+                    # drop-don't-block) — loud in self-telemetry, since
+                    # sustained nonzero means the host can't keep up
+                    self.stats.count("ingest.overload_dropped_total",
+                                     dropped, tags=[f"worker:{i}"])
+                    worker.overload_dropped = 0
                 swapped.append(worker.swap(qs))
                 n_staged = getattr(worker, "staged_samples_swapped", 0)
                 if n_staged:
@@ -1525,6 +1534,21 @@ class Server:
                 return
             self._shutdown_done = True
         self._stop_native_readers()
+        # join the COMPUTE threads (bounded): a daemon thread still
+        # inside XLA/C++ when the interpreter finalizes is force-unwound
+        # mid-frame — glibc's "FATAL: exception not rethrown" abort
+        # (reproduced by the overload soak exiting during a long flush).
+        # Only threads that run device programs are joined; listener
+        # threads block in plain C syscalls (their sockets close below)
+        # and joining them here would stall every shutdown instead.
+        me = threading.current_thread()
+        compute = {"flush-ticker", "series-sync", "native-pump",
+                   "warmup-compile"}
+        deadline = time.time() + 10.0
+        for t in self._threads:
+            if t is me or t.name not in compute or not t.is_alive():
+                continue
+            t.join(timeout=max(0.1, deadline - time.time()))
         if getattr(self, "_profile_dir", None):
             try:
                 import jax.profiler

@@ -497,8 +497,12 @@ class DeviceWorker:
         set_hash: str = "fnv",
         set_store: str = "staged",
         stage_depth: int = 64,
+        spill_cap: int = 1 << 22,
     ) -> None:
         self.batch_size = batch_size
+        # native pending-batch bound; beyond it samples shed, counted in
+        # overload_dropped (drop-don't-block under overload)
+        self.spill_cap = spill_cap
         # raw-sample staging slots per digest row (B in _histo_fold_staged);
         # rows whose staged count hits B spill through the direct per-batch
         # device fold — cheap there, since hot rows make K small
@@ -583,6 +587,11 @@ class DeviceWorker:
             try:
                 self._native.set_stage_depth(self.stage_depth)
             except AttributeError:  # stale .so without the staging API
+                pass
+        if self.spill_cap:
+            try:
+                self._native.set_spill_cap(self.spill_cap)
+            except AttributeError:  # stale .so without the cap API
                 pass
         return True
 
@@ -703,12 +712,26 @@ class DeviceWorker:
         errs = int(self._native.errors)
         self.parse_errors += errs - self._native_errs_seen
         self._native_errs_seen = errs
+        dropped = int(getattr(self._native, "overload_dropped", 0))
+        delta = dropped - self._native_drop_seen
+        self.overload_dropped = getattr(self, "overload_dropped", 0) + delta
+        # lifetime tally (never reset): self-telemetry consumes the
+        # per-interval field above; soaks/operators read this one
+        self.overload_dropped_total = (
+            getattr(self, "overload_dropped_total", 0) + delta)
+        self._native_drop_seen = dropped
         n = self._native.pending_histo
         h = self._native.drain_histo(n) if n else None
         n = self._native.pending_set
         s = self._native.drain_set(n) if n else None
-        c = self._native.drain_counter(1 << 22)
-        g = self._native.drain_gauge(1 << 22)
+        # sized by the actual pending counts: a fixed 4M-entry drain both
+        # allocated ~50MB of scratch per (100ms-cadence) pump call and
+        # silently destroyed anything beyond it at the epoch reset when
+        # tpu_spill_cap is raised above the old constant
+        n = self._native.pending_counter
+        c = self._native.drain_counter(n)
+        n = self._native.pending_gauge
+        g = self._native.drain_gauge(n)
         st = None
         others: list = []
         ssf_fb: list = []
@@ -745,8 +768,18 @@ class DeviceWorker:
                     # with native staging on, the SoA batch holds only
                     # hot-row spill: fold it directly (K is small there;
                     # re-staging it in the Python plane would just add a
-                    # second fold)
-                    self._fold_batch_direct(*h)
+                    # second fold). Chunked: a drain after a stall can
+                    # hold millions of spilled samples, and one fold's
+                    # padded [N] arrays at that size are ~100MB — eight
+                    # in flight was most of the RSS in the overload
+                    # soak. Bounded chunks × the in-flight window keeps
+                    # drain memory O(chunk), not O(backlog).
+                    rows, vals, wts = h
+                    chunk = 1 << 18
+                    for i in range(0, len(rows), chunk):
+                        self._fold_batch_direct(
+                            rows[i:i + chunk], vals[i:i + chunk],
+                            wts[i:i + chunk])
                 else:
                     self._device_histo_step(*h)
         if s is not None and len(s[0]):
@@ -776,6 +809,7 @@ class DeviceWorker:
                 self._native.reset()
             self._native_errs_seen = 0
             self._native_proc_seen = 0
+            self._native_drop_seen = 0
         self._processed_py = 0
         self.parse_errors = getattr(self, "parse_errors", 0)
         self.directory = SeriesDirectory()
@@ -1076,6 +1110,18 @@ class DeviceWorker:
         (h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
          h.lmin, h.lmax, h.lsum, h.lsum_c, h.lweight, h.lweight_c,
          h.lrecip, h.lrecip_c) = out
+        # bound the async dispatch queue: an un-executed fold holds its
+        # input buffers, and a backend slower than the offered load
+        # would otherwise queue folds without limit (observed: 2.7GB RSS
+        # growth in a 10-min overload soak). Blocking the DRAINING
+        # thread here throttles drain to device speed — readers are C++
+        # and unaffected; backlog then accumulates in the C++ spill
+        # batches, which cap and shed load (drop-don't-block, the same
+        # policy as trace.Client backpressure).
+        self._inflight_folds = getattr(self, "_inflight_folds", 0) + 1
+        if self._inflight_folds >= 8:
+            h.means.block_until_ready()
+            self._inflight_folds = 0
 
     def _flush_pending_sets(self) -> None:
         if not self._ps_rows:
@@ -1361,6 +1407,7 @@ class DeviceWorker:
                 self._native.reset()
                 self._native_errs_seen = 0
                 self._native_proc_seen = 0
+                self._native_drop_seen = 0
                 self._native_epoch_closed = True
             finally:
                 self._native.unlock()

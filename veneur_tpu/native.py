@@ -146,6 +146,16 @@ def load_library() -> Optional[ctypes.CDLL]:
             fn = getattr(lib, name)
             fn.restype = c.c_longlong
             fn.argtypes = [c.c_void_p]
+        # round-4 overload-shedding API: absent in a stale prebuilt .so
+        # (load_library supports .so-without-toolchain hosts) — absence
+        # degrades the feature, never the load
+        try:
+            lib.vn_overload_dropped.restype = c.c_longlong
+            lib.vn_overload_dropped.argtypes = [c.c_void_p]
+            lib.vn_set_spill_cap.restype = None
+            lib.vn_set_spill_cap.argtypes = [c.c_void_p, c.c_longlong]
+        except AttributeError:
+            pass
         lib.vn_drain_histo.restype = c.c_int
         lib.vn_drain_histo.argtypes = [
             c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int]
@@ -294,12 +304,32 @@ class NativeIngest:
         return self._lib.vn_pending_set(self._ctx)
 
     @property
+    def pending_counter(self) -> int:
+        return self._lib.vn_pending_counter(self._ctx)
+
+    @property
+    def pending_gauge(self) -> int:
+        return self._lib.vn_pending_gauge(self._ctx)
+
+    @property
     def processed(self) -> int:
         return self._lib.vn_processed(self._ctx)
 
     @property
     def errors(self) -> int:
         return self._lib.vn_errors(self._ctx)
+
+    @property
+    def overload_dropped(self) -> int:
+        """Samples shed at the pending-batch spill caps (overload)."""
+        fn = getattr(self._lib, "vn_overload_dropped", None)
+        return int(fn(self._ctx)) if fn is not None else 0
+
+    def set_spill_cap(self, cap: int) -> None:
+        """Entries per pending SoA batch before samples shed (tests /
+        memory-constrained deployments; default 2^22). Raises
+        AttributeError on a stale .so (callers degrade)."""
+        self._lib.vn_set_spill_cap(self._ctx, int(cap))
 
     def num_rows(self) -> tuple[int, int, int, int]:
         """(histo, set, counter, gauge) row counts."""
