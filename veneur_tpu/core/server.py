@@ -172,6 +172,7 @@ class Server:
         self.span_handler = None
 
         self._threads: list[threading.Thread] = []
+        self._compute_threads: list[threading.Thread] = []
         self._sockets: list[socket.socket] = []
         self._socket_locks: list[int] = []
         # zero-downtime restart (einhorn-style fd handoff): listener fds
@@ -641,18 +642,24 @@ class Server:
 
     # -- listeners ----------------------------------------------------------
 
-    def _spawn(self, target, name: str) -> None:
+    def _spawn(self, target, name: str, compute: bool = False) -> None:
         """Every long-lived server thread is wrapped in panic capture
         (reference ConsumePanic around goroutines, sentry.go:22-60,
         server.go:395-400): report to sentry_dsn, then abort so process
         supervision restarts us. Exceptions during shutdown are routine
-        (sockets closed underneath readers) and are suppressed."""
+        (sockets closed underneath readers) and are suppressed.
+
+        compute=True marks a thread that runs device programs; shutdown
+        joins those (bounded) so the interpreter never finalizes while
+        one is inside XLA/C++ (see shutdown())."""
         t = threading.Thread(
             target=crash.guard(target, self.config.sentry_dsn, name,
                                suppress=self._shutdown.is_set),
             name=name, daemon=True)
         t.start()
         self._threads.append(t)
+        if compute:
+            self._compute_threads.append(t)
 
     def _adopt_fd(self) -> Optional[socket.socket]:
         """Take one inherited listener fd (if the previous process image
@@ -745,7 +752,7 @@ class Server:
                         return
                     raise
 
-        self._spawn(pump, "native-pump")
+        self._spawn(pump, "native-pump", compute=True)
 
     def _reap_stream_readers(self) -> None:
         """Join C++ stream readers whose connection ended — an unjoined
@@ -1059,10 +1066,12 @@ class Server:
                     pass
         self._inherited.clear()
         if self.config.tpu_warmup_compile:
-            self._spawn(self._warmup_compile, "warmup-compile")
-        self._spawn(self._flush_loop, "flush-ticker")
+            self._spawn(self._warmup_compile, "warmup-compile",
+                        compute=True)
+        self._spawn(self._flush_loop, "flush-ticker", compute=True)
         if self.native_mode:
-            self._spawn(self._series_sync_loop, "series-sync")
+            self._spawn(self._series_sync_loop, "series-sync",
+                        compute=True)
         return ports
 
     def _warmup_compile(self) -> None:
@@ -1097,8 +1106,14 @@ class Server:
             log.debug("flush warmup failed", exc_info=True)
 
     def sync_native_series_once(self) -> None:
-        """One locked new-series adoption sweep across all workers."""
+        """One locked new-series adoption sweep across all workers.
+
+        The pending probe is a lock-free C call, so an idle sweep costs
+        no worker-lock churn."""
         for i, worker in enumerate(self.workers):
+            n = worker._native
+            if n is None or not n.pending_new_series:
+                continue
             with self._worker_locks[i]:
                 worker.sync_native_series()
 
@@ -1208,7 +1223,7 @@ class Server:
                                  worker.processed, tags=[f"worker:{i}"])
                 self.stats.count("worker.metrics_imported_total",
                                  worker.imported, tags=[f"worker:{i}"])
-                dropped = getattr(worker, "overload_dropped", 0)
+                dropped = worker.overload_dropped
                 if dropped:
                     # samples shed at the native spill caps (overload;
                     # drop-don't-block) — loud in self-telemetry, since
@@ -1534,19 +1549,17 @@ class Server:
                 return
             self._shutdown_done = True
         self._stop_native_readers()
-        # join the COMPUTE threads (bounded): a daemon thread still
+        # join the compute threads (bounded): a daemon thread still
         # inside XLA/C++ when the interpreter finalizes is force-unwound
         # mid-frame — glibc's "FATAL: exception not rethrown" abort
         # (reproduced by the overload soak exiting during a long flush).
-        # Only threads that run device programs are joined; listener
+        # Only threads spawned with compute=True are joined; listener
         # threads block in plain C syscalls (their sockets close below)
         # and joining them here would stall every shutdown instead.
         me = threading.current_thread()
-        compute = {"flush-ticker", "series-sync", "native-pump",
-                   "warmup-compile"}
         deadline = time.time() + 10.0
-        for t in self._threads:
-            if t is me or t.name not in compute or not t.is_alive():
+        for t in self._compute_threads:
+            if t is me or not t.is_alive():
                 continue
             t.join(timeout=max(0.1, deadline - time.time()))
         if getattr(self, "_profile_dir", None):

@@ -525,6 +525,11 @@ class DeviceWorker:
         self._processed_py = 0
         self._native_proc_seen = 0
         self.imported = 0
+        # overload-shedding tallies: per-interval (consumed + reset by
+        # the server's flush telemetry) and lifetime (soaks/operators)
+        self.overload_dropped = 0
+        self.overload_dropped_total = 0
+        self._inflight_folds = 0
         self._native = None
         self._mesh_pool = None
         # cross-epoch series-metadata cache (see _sync_native_series);
@@ -712,13 +717,12 @@ class DeviceWorker:
         errs = int(self._native.errors)
         self.parse_errors += errs - self._native_errs_seen
         self._native_errs_seen = errs
-        dropped = int(getattr(self._native, "overload_dropped", 0))
+        dropped = int(self._native.overload_dropped)
         delta = dropped - self._native_drop_seen
-        self.overload_dropped = getattr(self, "overload_dropped", 0) + delta
+        self.overload_dropped += delta
         # lifetime tally (never reset): self-telemetry consumes the
         # per-interval field above; soaks/operators read this one
-        self.overload_dropped_total = (
-            getattr(self, "overload_dropped_total", 0) + delta)
+        self.overload_dropped_total += delta
         self._native_drop_seen = dropped
         n = self._native.pending_histo
         h = self._native.drain_histo(n) if n else None
@@ -1118,7 +1122,7 @@ class DeviceWorker:
         # and unaffected; backlog then accumulates in the C++ spill
         # batches, which cap and shed load (drop-don't-block, the same
         # policy as trace.Client backpressure).
-        self._inflight_folds = getattr(self, "_inflight_folds", 0) + 1
+        self._inflight_folds += 1
         if self._inflight_folds >= 8:
             h.means.block_until_ready()
             self._inflight_folds = 0
