@@ -156,6 +156,36 @@ def test_tcp_listener():
         srv.shutdown()
 
 
+def test_tcp_lifecycle_self_metrics():
+    """tcp.connects / tcp.disconnects mirror the reference's TCP
+    listener telemetry (server.go:1254-1335) on both the Python handler
+    and the C++ stream-reader path."""
+    from veneur_tpu import scopedstatsd
+
+    cfg = Config(statsd_listen_addresses=["tcp://127.0.0.1:0"],
+                 interval="10s")
+    sink = ChannelMetricSink()
+    srv = Server(cfg, metric_sinks=[sink])
+    cap = scopedstatsd.CaptureSender()
+    srv.stats = scopedstatsd.ScopedClient(cap, namespace="veneur.")
+    ports = srv.start()
+    try:
+        port = next(iter(ports.values()))
+        for _ in range(2):
+            c = socket.create_connection(("127.0.0.1", port))
+            c.sendall(b"tcplc.counter:5|c\n")
+            c.close()
+        assert _wait_for(lambda: sum(
+            1 for line in cap.lines if "tcp.connects" in line) >= 2)
+        # disconnects surface either immediately (Python handler) or at
+        # the pump's reap (native stream readers)
+        assert _wait_for(lambda: sum(
+            1 for line in cap.lines if "tcp.disconnects" in line) >= 2,
+            timeout=5)
+    finally:
+        srv.shutdown()
+
+
 def test_flush_ticker_runs():
     cfg = Config(
         statsd_listen_addresses=["udp://127.0.0.1:0"],

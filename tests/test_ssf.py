@@ -212,7 +212,7 @@ def test_ssf_udp_ingest_to_derived_metrics():
         by_key = {(m.name, m.type): m for m in metrics}
         assert by_key[("span.counter", MetricType.COUNTER)].value == 4.0
         assert ("svc.indicator.max", MetricType.GAUGE) in by_key
-        assert any("ssf.received_total" in line and "service:svc" in line
+        assert any("ssf.spans.received_total" in line and "service:svc" in line
                    for line in cap.lines)
     finally:
         srv.shutdown()

@@ -427,6 +427,8 @@ class Server:
         (reference HandleTracePacket, server.go:1046)."""
         if not packet:
             self._bump_errors()
+            self.stats.count("ssf.error_total", 1,
+                             tags=["ssf_format:packet", "reason:length"])
             return
         if self._native_ssf:
             # native decode + span→metric extraction in one C++ pass;
@@ -440,11 +442,17 @@ class Server:
                 return
             if rc == 0:
                 self._bump_errors()
+                self.stats.count("ssf.error_total", 1,
+                                 tags=["ssf_format:packet",
+                                       "reason:unmarshal"])
                 return
         try:
             span = ssf_wire.parse_ssf(packet)
         except ssf_wire.FramingError as e:
             self._bump_errors()
+            self.stats.count("ssf.error_total", 1,
+                             tags=["ssf_format:packet",
+                                   "reason:unmarshal"])
             log.debug("bad SSF packet: %s", e)
             return
         self.handle_ssf(span)
@@ -469,11 +477,18 @@ class Server:
                     or worker._native.pending_set >= worker.batch_size):
                 worker.drain_native()
         self._bump_errors(errs)
+        if errs:
+            self.stats.count("ssf.error_total", errs,
+                             tags=["ssf_format:packet",
+                                   "reason:unmarshal"])
         for pkt in fallbacks:
             try:
                 span = ssf_wire.parse_ssf(pkt)
             except ssf_wire.FramingError as e:
                 self._bump_errors()
+                self.stats.count("ssf.error_total", 1,
+                                 tags=["ssf_format:packet",
+                                       "reason:unmarshal"])
                 log.debug("bad SSF packet: %s", e)
                 continue
             self.handle_ssf(span)
@@ -584,6 +599,12 @@ class Server:
                 self.handle_ssf(span)
         except ssf_wire.FramingError as e:
             self._bump_errors()
+            # reference protocol/wire.go: a framing error poisons the
+            # stream; operators watch frames.disconnects for it
+            self.stats.count("frames.disconnects", 1)
+            self.stats.count("ssf.error_total", 1,
+                             tags=["ssf_format:framed",
+                                   "reason:framing"])
             log.debug("SSF stream framing error, closing: %s", e)
         except OSError:
             pass
@@ -737,7 +758,6 @@ class Server:
         check handback (both also run at every flush)."""
         if getattr(self, "_native_pump_started", False):
             return
-        self._native_pump_started = True
 
         def pump() -> None:
             while not (self._shutdown.is_set() or self._quiesce.is_set()):
@@ -753,6 +773,9 @@ class Server:
                     raise
 
         self._spawn(pump, "native-pump", compute=True)
+        # only after a successful spawn: a thread-creation failure must
+        # leave the flag unset so the next caller retries
+        self._native_pump_started = True
 
     def _reap_stream_readers(self) -> None:
         """Join C++ stream readers whose connection ended — an unjoined
@@ -764,6 +787,7 @@ class Server:
                 try:
                     if self._native_router.stream_reader_done(h):
                         self._native_router.stop_stream_reader(h)
+                        self.stats.count("tcp.disconnects", 1)
                     else:
                         live.append(h)
                 except Exception:
@@ -789,12 +813,14 @@ class Server:
             for h in ssf_readers:
                 try:
                     # SSF packets are spans, not statsd packets: counted
-                    # via the ssf.received_total pipeline, not here
+                    # via the ssf.spans.received_total pipeline, not here
                     self._native_router.stop_ssf_reader(h)
                 except Exception:
                     log.exception("native SSF reader stop failed")
             stream_readers = self._native_stream_readers
             self._native_stream_readers = []
+            if stream_readers:
+                self.stats.count("tcp.disconnects", len(stream_readers))
             for h in stream_readers:
                 try:
                     # stream readers own their (dup'd) conn fds and close
@@ -865,21 +891,34 @@ class Server:
                     # socket object). Reader gets its own dup so the
                     # Python socket can be closed here; the pump reaps
                     # finished readers.
+                    # the try covers ONLY dup+reader-start: once the C++
+                    # reader owns the fd, a later failure (e.g. pump
+                    # thread creation) must neither close the fd again
+                    # nor fall back to the Python handler on it
                     fd = None
+                    h = None
                     try:
                         fd = os.dup(conn.fileno())
                         h = self._native_router.start_stream_reader(
                             fd, self.config.metric_max_length)
-                        with self._native_reader_lock:
-                            self._native_stream_readers.append(h)
-                        conn.close()
-                        self._start_native_pump()
-                        continue
                     except (AttributeError, RuntimeError) as e:
                         if fd is not None:
                             os.close(fd)
                         log.warning("native stream reader unavailable "
                                     "(%s); using the Python handler", e)
+                    if h is not None:
+                        self.stats.count("tcp.connects", 1)
+                        with self._native_reader_lock:
+                            self._native_stream_readers.append(h)
+                        conn.close()
+                        try:
+                            self._start_native_pump()
+                        except RuntimeError:
+                            # thread creation failed; the reader is live
+                            # and the next start attempt (UDP reader
+                            # setup, next conn) retries the pump
+                            log.exception("native pump start failed")
+                        continue
                 self._spawn(
                     lambda c=conn, p=peer: self._handle_tcp_conn(c, p, ssl_ctx),
                     "statsd-tcp-conn",
@@ -890,9 +929,16 @@ class Server:
 
     def _handle_tcp_conn(self, conn: socket.socket, peer, ssl_ctx) -> None:
         """reference handleTCPGoroutine (server.go:1254-1335)."""
+        self.stats.count("tcp.connects", 1)
         try:
             if ssl_ctx is not None:
-                conn = ssl_ctx.wrap_socket(conn, server_side=True)
+                try:
+                    conn = ssl_ctx.wrap_socket(conn, server_side=True)
+                except (ssl.SSLError, OSError):
+                    # a peer resetting mid-handshake raises plain
+                    # ConnectionResetError, not ssl.SSLError
+                    self.stats.count("tcp.tls_handshake_failures", 1)
+                    raise
             conn.settimeout(10.0 * self.interval)
             buf = b""
             while not self._shutdown.is_set():
@@ -913,6 +959,7 @@ class Server:
         except (OSError, ssl.SSLError) as e:
             log.debug("tcp statsd conn from %s error: %s", peer, e)
         finally:
+            self.stats.count("tcp.disconnects", 1)
             try:
                 conn.close()
             except OSError:
@@ -1185,13 +1232,20 @@ class Server:
             self._drain_native_ssf_fallbacks()
 
         other_samples = self.event_worker.flush()
+        if other_samples:
+            self.stats.count("worker.other_samples_flushed_total",
+                             len(other_samples))
         for sink in self.metric_sinks:
             try:
                 sink.flush_other_samples(other_samples)
             except Exception:
                 log.exception("sink %s FlushOtherSamples failed", sink.name())
 
+        _t_span = time.perf_counter()
         self.span_worker.flush()
+        self.stats.time_in_nanoseconds(
+            "worker.span.flush_duration_ns",
+            (time.perf_counter() - _t_span) * 1e9)
 
         # per-service span counters (reference handleSSF sync.Map counters
         # reported at flush, server.go:1088-1101)
@@ -1377,7 +1431,7 @@ class Server:
                              _DW.pallas_fallbacks)
             _DW.pallas_fallbacks = 0
         for svc, n in span_counts.items():
-            self.stats.count("ssf.received_total", n,
+            self.stats.count("ssf.spans.received_total", n,
                              tags=[f"service:{svc}"])
         # statsd counters are per-interval increments: report the delta
         # (the property already totals the Python cells, the workers'
