@@ -806,7 +806,9 @@ def main() -> None:
         if workload is None:
             sys.exit(f"unknown VENEUR_BENCH_WORKLOAD {name!r}; "
                      f"valid: {', '.join(sorted(WORKLOADS))}")
-        _emit(workload())
+        result = workload()
+        result["workload"] = name  # every emitted line carries its id
+        _emit(result)
         return
     # No selector: run ALL five BASELINE workloads, one JSON line each.
     # On a (possibly) live accelerator: ONE all-mode child first — the
@@ -879,6 +881,7 @@ def main() -> None:
                         reason += f"; cpu fallback also failed: {e}"
         if result is None:
             result = {"metric": wname, "error": reason[-500:]}
+        result.setdefault("workload", wname)  # every line carries its id
         print(json.dumps(result), flush=True)
 
 
