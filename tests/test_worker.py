@@ -321,6 +321,45 @@ def test_swap_then_extract_two_phase_flush():
     assert len(snap_new.scalars.counter_meta) == 1
 
 
+def test_chunked_drain_fold_conserves_samples():
+    """A drain after a stall can hold far more spilled samples than one
+    fold batch should carry (each fold's padded arrays are O(batch));
+    _apply_native_raw folds in bounded chunks. Weight conservation
+    across the chunk boundary proves no sample is lost or doubled."""
+    w = DeviceWorker(stage_depth=2)
+    if not w.attach_native():
+        pytest.skip("native library unavailable")
+    total = 3000  # several chunks at the test-observable scale
+    per_row = total // 4
+    for i in range(per_row):
+        w._native.ingest(
+            b"\n".join(b"chunk.r%d:%d|ms" % (r, (i + r) % 97)
+                       for r in range(4)))
+    # shrink the chunk so this test crosses several boundaries
+    import veneur_tpu.core.worker as W
+    orig_chunk = W._FOLD_CHUNK
+    orig_fold = W.DeviceWorker._fold_batch_direct
+    calls = []
+
+    def counting(self, rows, vals, wts):
+        calls.append(len(rows))
+        return orig_fold(self, rows, vals, wts)
+
+    W._FOLD_CHUNK = 512
+    W.DeviceWorker._fold_batch_direct = counting
+    try:
+        w.drain_native()
+    finally:
+        W._FOLD_CHUNK = orig_chunk
+        W.DeviceWorker._fold_batch_direct = orig_fold
+    assert len(calls) > 1  # the drain really folded in chunks
+    assert all(c <= 512 for c in calls)
+    qs = device_quantiles(PCTS, AGGS)
+    snap = w.flush(qs)
+    # staged (2/row) + spilled samples all land: lweight == total
+    assert float(np.sum(snap.lweight[:4])) == float(total)
+
+
 def test_terminal_worker_skips_digest_pool_readback():
     """Only a forwarding (local) worker materializes the [S,C] centroid
     pools host-side — they exist solely for the forward codec, and at 1M

@@ -502,3 +502,5 @@ def validate_config(cfg: Config) -> None:
         raise ValueError("tpu_hll_precision must be in [4,18]")
     if cfg.tpu_stage_depth < 1:
         raise ValueError("tpu_stage_depth must be >= 1")
+    if cfg.tpu_spill_cap < 1:
+        raise ValueError("tpu_spill_cap must be >= 1")

@@ -52,6 +52,11 @@ from veneur_tpu.utils.hashing import hll_hash, fmix64, metric_digest
 log = logging.getLogger("veneur_tpu.core.worker")
 
 
+# max spilled samples per direct-fold dispatch (see _apply_native_raw);
+# bounds drain memory to O(chunk) x the in-flight window, not O(backlog)
+_FOLD_CHUNK = 1 << 18
+
+
 def _next_pow2(n: int, floor: int = 1) -> int:
     v = floor
     while v < n:
@@ -779,7 +784,7 @@ class DeviceWorker:
                     # soak. Bounded chunks × the in-flight window keeps
                     # drain memory O(chunk), not O(backlog).
                     rows, vals, wts = h
-                    chunk = 1 << 18
+                    chunk = _FOLD_CHUNK
                     for i in range(0, len(rows), chunk):
                         self._fold_batch_direct(
                             rows[i:i + chunk], vals[i:i + chunk],
