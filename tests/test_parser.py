@@ -313,3 +313,26 @@ def test_event_and_service_check_fuzz_no_crashes():
                 parse_service_check(pkt)
         except ParseError:
             pass
+
+
+def test_type_chunk_first_byte_switch_parity():
+    """The reference switches on only the FIRST byte of the type chunk
+    ("We can ignore the s in ms", parser.go:331-340): trailing bytes are
+    accepted, not errors. Both our parsers preserve the quirk — found by
+    the extended round-4 fuzz and pinned here so nobody 'fixes' one
+    parser into divergence."""
+    from veneur_tpu.protocol.dogstatsd import parse_metric
+
+    for line, expect_type in [
+        (b"q.t:1|mss", "timer"),   # 'm...' = ms
+        (b"q.c:1|cg", "counter"),  # 'c...' = c
+        (b"q.h:1|hq", "histogram"),
+        (b"q.d:1|dz", "histogram"),  # distribution -> histogram
+        (b"q.g:1|gx", "gauge"),
+        (b"q.s:1|sz", "set"),
+    ]:
+        assert parse_metric(line).key.type == expect_type, line
+    import pytest as _pytest
+
+    with _pytest.raises(ParseError):
+        parse_metric(b"q.z:1|zz")  # unknown first byte still rejects
