@@ -332,7 +332,5 @@ def test_type_chunk_first_byte_switch_parity():
         (b"q.s:1|sz", "set"),
     ]:
         assert parse_metric(line).key.type == expect_type, line
-    import pytest as _pytest
-
-    with _pytest.raises(ParseError):
+    with pytest.raises(ParseError):
         parse_metric(b"q.z:1|zz")  # unknown first byte still rejects

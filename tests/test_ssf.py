@@ -481,3 +481,28 @@ def test_wedged_sink_does_not_stall_others():
     finally:
         gate.set()
         w.stop()
+
+
+def test_unknown_enum_values_ride_through_decode():
+    """proto3: unknown enum values are data, not errors. A span carrying
+    a sample with an out-of-range metric type must still decode — its
+    valid samples extract, the unknown one counts as invalid (reference
+    ConvertMetrics' skip tally, samplers/parser.go:103-120). Found by
+    the round-4 extended SSF fuzz: the Python decoder rejected the whole
+    span where the Go reference and the C++ decoder accept it."""
+    from veneur_tpu.core.spans import convert_metrics
+    from veneur_tpu.gen import ssf_pb2
+    from veneur_tpu.protocol import ssf_wire
+
+    pb = ssf_pb2.SSFSpan(trace_id=1, id=2, start_timestamp=3,
+                         end_timestamp=4, service="svc", name="op")
+    good = pb.metrics.add(metric=0, name="ok.counter", value=2.0,
+                          sample_rate=1.0)
+    assert good is not None
+    bad = pb.metrics.add(name="weird", value=1.0, sample_rate=1.0)
+    bad.metric = 19  # not a valid SSFMetricType
+    span = ssf_wire.parse_ssf(pb.SerializeToString())
+    assert span.metrics[1].metric == 19  # preserved, not mangled
+    metrics, invalid = convert_metrics(span)
+    assert [m.key.name for m in metrics] == ["ok.counter"]
+    assert invalid == 1

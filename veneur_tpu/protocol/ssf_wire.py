@@ -29,6 +29,18 @@ class FramingError(Exception):
     """The stream is unrecoverably broken and must be closed."""
 
 
+def _enum_or_raw(enum_cls, v: int):
+    """proto3 semantics: unknown enum values are DATA, not errors — the
+    Go reference decodes them as plain ints and the per-sample converter
+    skips them (ConvertMetrics' invalid tally, samplers/parser.go:103).
+    Rejecting the whole span here dropped its valid samples too (found
+    by the round-4 extended SSF fuzz)."""
+    try:
+        return enum_cls(v)
+    except ValueError:
+        return v
+
+
 def pb_to_span(pb: ssf_pb2.SSFSpan) -> ssf_model.SSFSpan:
     return ssf_model.SSFSpan(
         version=pb.version,
@@ -44,16 +56,16 @@ def pb_to_span(pb: ssf_pb2.SSFSpan) -> ssf_model.SSFSpan:
         name=pb.name,
         metrics=[
             ssf_model.SSFSample(
-                metric=ssf_model.SSFMetricType(s.metric),
+                metric=_enum_or_raw(ssf_model.SSFMetricType, s.metric),
                 name=s.name,
                 value=s.value,
                 timestamp=s.timestamp,
                 message=s.message,
-                status=ssf_model.SSFStatus(s.status),
+                status=_enum_or_raw(ssf_model.SSFStatus, s.status),
                 sample_rate=s.sample_rate,
                 tags=dict(s.tags),
                 unit=s.unit,
-                scope=ssf_model.SSFScope(s.scope),
+                scope=_enum_or_raw(ssf_model.SSFScope, s.scope),
             )
             for s in pb.metrics
         ],

@@ -41,18 +41,25 @@ class SSFScope(enum.IntEnum):
 
 @dataclass
 class SSFSample:
-    """One measurement attached to a span (reference ssf/sample.proto)."""
+    """One measurement attached to a span (reference ssf/sample.proto).
 
-    metric: SSFMetricType = SSFMetricType.COUNTER
+    The enum-typed fields may carry RAW INTS for values outside the
+    known range: proto3 treats unknown enum values as data, and the
+    decode passthrough (protocol/ssf_wire._enum_or_raw) preserves them
+    so the per-sample converter can skip-and-count like the reference
+    (samplers/parser.go:103-120). Don't assume .name/.value exist on
+    them."""
+
+    metric: SSFMetricType | int = SSFMetricType.COUNTER
     name: str = ""
     value: float = 0.0
     timestamp: int = 0
     message: str = ""
-    status: SSFStatus = SSFStatus.OK
+    status: SSFStatus | int = SSFStatus.OK
     sample_rate: float = 1.0
     tags: dict[str, str] = field(default_factory=dict)
     unit: str = ""
-    scope: SSFScope = SSFScope.DEFAULT
+    scope: SSFScope | int = SSFScope.DEFAULT
 
 
 @dataclass
