@@ -2241,7 +2241,7 @@ bool decode_centroids(std::string_view body, std::vector<float>* means,
                reinterpret_cast<const uint8_t*>(body.data() + body.size())};
   while (c.p < c.end) {
     uint64_t tag;
-    if (!c.varint(&tag)) return false;
+    if (!c.varint(&tag) || tag > 0xFFFFFFFFull) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
     uint32_t wt = static_cast<uint32_t>(tag & 7);
     if (field == 0) return false;  // protobuf forbids field number 0
@@ -2275,43 +2275,6 @@ void sanitize_seps(std::string* s) {
 // protobuf rejects `string` fields that aren't valid UTF-8; the native
 // decoder must agree (strictness parity with the Python fallback —
 // pinned by the decoder fuzz test)
-bool utf8_valid(std::string_view s) {
-  size_t i = 0, n = s.size();
-  while (i < n) {
-    unsigned char c = static_cast<unsigned char>(s[i]);
-    size_t len;
-    uint32_t cp;
-    if (c < 0x80) {
-      ++i;
-      continue;
-    } else if ((c & 0xE0) == 0xC0) {
-      len = 2;
-      cp = c & 0x1F;
-    } else if ((c & 0xF0) == 0xE0) {
-      len = 3;
-      cp = c & 0x0F;
-    } else if ((c & 0xF8) == 0xF0) {
-      len = 4;
-      cp = c & 0x07;
-    } else {
-      return false;
-    }
-    if (i + len > n) return false;
-    for (size_t j = 1; j < len; ++j) {
-      unsigned char cc = static_cast<unsigned char>(s[i + j]);
-      if ((cc & 0xC0) != 0x80) return false;
-      cp = (cp << 6) | (cc & 0x3F);
-    }
-    // overlong / surrogate / out-of-range
-    if (len == 2 && cp < 0x80) return false;
-    if (len == 3 && (cp < 0x800 || (cp >= 0xD800 && cp <= 0xDFFF)))
-      return false;
-    if (len == 4 && (cp < 0x10000 || cp > 0x10FFFF)) return false;
-    i += len;
-  }
-  return true;
-}
-
 // one Metric submessage → appended SoA entry; false on malformed
 bool decode_metric(std::string_view body, Decoded* d) {
   WireCursor c{reinterpret_cast<const uint8_t*>(body.data()),
@@ -2326,20 +2289,20 @@ bool decode_metric(std::string_view body, Decoded* d) {
   int32_t precision = 0;
   while (c.p < c.end) {
     uint64_t tag;
-    if (!c.varint(&tag)) return false;
+    if (!c.varint(&tag) || tag > 0xFFFFFFFFull) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
     uint32_t wt = static_cast<uint32_t>(tag & 7);
     if (field == 0) return false;  // protobuf forbids field number 0
     switch (field) {
-      case 1: {  // name
+      case 1: {  // name (proto3 string: must be valid UTF-8)
         std::string_view v;
-        if (wt != 2 || !c.len_view(&v)) return false;
+        if (wt != 2 || !c.len_view(&v) || !valid_utf8(v)) return false;
         name.assign(v);
         break;
       }
-      case 2: {  // tags (repeated)
+      case 2: {  // tags (repeated proto3 string)
         std::string_view v;
-        if (wt != 2 || !c.len_view(&v)) return false;
+        if (wt != 2 || !c.len_view(&v) || !valid_utf8(v)) return false;
         if (!joined.empty()) joined.push_back(',');
         joined.append(v);
         break;
@@ -2358,7 +2321,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
                       reinterpret_cast<const uint8_t*>(v.data() + v.size())};
         while (ic.p < ic.end) {
           uint64_t it;
-          if (!ic.varint(&it)) return false;
+          if (!ic.varint(&it) || it > 0xFFFFFFFFull) return false;
           if ((it >> 3) == 0) return false;
           if ((it >> 3) == 1 && (it & 7) == 1) {
             int64_t sv;
@@ -2380,7 +2343,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
                       reinterpret_cast<const uint8_t*>(v.data() + v.size())};
         while (ic.p < ic.end) {
           uint64_t it;
-          if (!ic.varint(&it)) return false;
+          if (!ic.varint(&it) || it > 0xFFFFFFFFull) return false;
           if ((it >> 3) == 0) return false;
           if ((it >> 3) == 1 && (it & 7) == 1) {
             if (!ic.f64(&scalar)) return false;
@@ -2398,7 +2361,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
                       reinterpret_cast<const uint8_t*>(v.data() + v.size())};
         while (ic.p < ic.end) {
           uint64_t it;
-          if (!ic.varint(&it)) return false;
+          if (!ic.varint(&it) || it > 0xFFFFFFFFull) return false;
           if ((it >> 3) == 0) return false;
           uint32_t f = static_cast<uint32_t>(it >> 3);
           uint32_t w = static_cast<uint32_t>(it & 7);
@@ -2428,7 +2391,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
                       reinterpret_cast<const uint8_t*>(v.data() + v.size())};
         while (ic.p < ic.end) {
           uint64_t it;
-          if (!ic.varint(&it)) return false;
+          if (!ic.varint(&it) || it > 0xFFFFFFFFull) return false;
           if ((it >> 3) == 0) return false;
           uint32_t f = static_cast<uint32_t>(it >> 3);
           uint32_t w = static_cast<uint32_t>(it & 7);
@@ -2451,7 +2414,6 @@ bool decode_metric(std::string_view body, Decoded* d) {
     }
   }
   if (kind > 4 || scope > 2) return false;
-  if (!utf8_valid(name) || !utf8_valid(joined)) return false;
   // centroid means/weights must pair up
   if (d->cent_means.size() - cent_means_base !=
       d->cent_weights.size() - cent_w_base)
@@ -2512,7 +2474,7 @@ long long vn_decode_metric_batch(
   while (c.p < c.end) {
     const uint8_t* tag_start = c.p;
     uint64_t tag;
-    if (!c.varint(&tag)) return -1;
+    if (!c.varint(&tag) || tag > 0xFFFFFFFFull) return -1;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
     uint32_t wt = static_cast<uint32_t>(tag & 7);
     if (field == 0) return -1;  // protobuf forbids field number 0

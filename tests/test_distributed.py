@@ -443,6 +443,19 @@ def test_snapshot_to_wire_separator_handling():
     assert len(got2.metrics[0].digest.centroids.means) == 2
 
 
+def test_proxy_undecodable_wire_body_drops_counted():
+    """A forward body both decoders reject must not kill the routing
+    thread with a bare traceback: the proxy counts the drop and keeps
+    serving (found by the round-4 decoder-strictness review)."""
+    proxy = ProxyServer(["127.0.0.1:1", "127.0.0.1:2"])
+    before = proxy.drops
+    proxy._route_wire(b"\xfd\x17\xf4\xb7")  # oversized tag varint
+    assert proxy.drops == before + 1
+    # still functional afterwards
+    proxy._route_wire(b"")  # empty batch: decodes to n=0, no-op
+    assert proxy.drops == before + 1
+
+
 def test_proxy_wire_split_matches_python_ring_placement():
     """The byte-slicing proxy path places every metric on the same ring
     destination the Python path picks, and the concatenated slices

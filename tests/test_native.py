@@ -867,3 +867,37 @@ def test_native_ssf_reader_end_to_end():
         assert by_key[("svc.ok", MetricType.STATUS)].value == 0.0
     finally:
         srv.shutdown()
+
+
+def test_wire_decoder_strictness_matches_python_pb():
+    """Three malformation classes the round-4 decoder fuzz caught the
+    C++ wire decoder ACCEPTING where the protobuf spec (and the Python
+    parser) reject — each must now reject, or a half-corrupt forward
+    body would silently decode garbage into the global tier instead of
+    falling back / erroring visibly:
+      1. tag varints exceeding 32 bits (field numbers cap at 2^29-1),
+      2. the same inside nested submessages (counter/gauge/digest/hll),
+      3. invalid UTF-8 in proto3 `string` fields (name, tags)."""
+    from veneur_tpu.gen import veneur_tpu_pb2 as vpb
+
+    # oversized tag varint at the top level: 5 bytes, bits past 2^32
+    assert native_mod.decode_metric_batch(
+        b"\xfd\x17\xf4\xb7a'\xc5\xe9\xd8\xc8:\xe7\xaf\x0br") is None
+
+    # oversized tag varint inside a counter submessage
+    bad_inner = bytes.fromhex("0a120a054b7a2e6d0d2a09cdfaffff40ff82ffff")
+    assert native_mod.decode_metric_batch(bad_inner) is None
+
+    # invalid UTF-8 in the name string field
+    good = vpb.MetricBatch()
+    m = good.metrics.add()
+    m.name = "ok.name"
+    m.kind = vpb.KIND_COUNTER
+    m.counter.value = 3
+    blob = bytearray(good.SerializeToString())
+    idx = bytes(blob).find(b"ok.name")
+    blob[idx] = 0xD8  # lead byte with no continuation
+    assert native_mod.decode_metric_batch(bytes(blob)) is None
+    # the unmutated batch still decodes
+    d = native_mod.decode_metric_batch(bytes(good.SerializeToString()))
+    assert d is not None and d.n == 1

@@ -101,7 +101,18 @@ class ProxyServer:
 
         d = native_mod.decode_metric_batch(blob)
         if d is None:
-            self._route_batch(pb.MetricBatch.FromString(blob))
+            # native decoder rejected (malformed per protobuf spec since
+            # the round-4 strictness fixes, or stale .so): the Python
+            # parser gets a say, but ITS rejection must surface in the
+            # proxy's own telemetry, not as a bare daemon-thread
+            # traceback with the drop uncounted
+            try:
+                batch = pb.MetricBatch.FromString(blob)
+            except Exception as e:
+                self.drops += 1
+                log.warning("undecodable forward body dropped: %s", e)
+                return
+            self._route_batch(batch)
             return
         if not d.n:
             return
