@@ -458,8 +458,14 @@ bool parse_line(Scratch* sc, std::string_view line, Parsed* out) {
   size_t colon = line.find(':');
   if (colon == std::string_view::npos || colon == 0) return false;
   std::string_view name = line.substr(0, colon);
-  size_t pipe1 = line.find('|', colon + 1);
-  if (pipe1 == std::string_view::npos) return false;
+  // the reference tokenizes by splitting on '|' FIRST (pipeSplitter,
+  // samplers/parser.go:298-325): the first pipe chunk must be the full
+  // name:value, so a '|' before the first ':' means the first chunk
+  // has no colon — reject like the reference and the Python parser do
+  // (round-4 differential fuzz, tools/fuzz_differential.py). One scan:
+  // the global first '|' past the colon IS pipe1.
+  size_t pipe1 = line.find('|');
+  if (pipe1 == std::string_view::npos || pipe1 < colon) return false;
   std::string_view value_chunk = line.substr(colon + 1, pipe1 - colon - 1);
   size_t pipe2 = line.find('|', pipe1 + 1);
   std::string_view type_chunk =

@@ -74,6 +74,8 @@ def test_parser_parity_property():
         b"exp:1e3|c",
         b"plus:+4|g",
         # malformed — both should reject
+        b"aaa|bbb:1|c",  # '|' before the first ':' (pipe-split order)
+        b"a|b:1|ms",
         b"foo",
         b":1|c",
         b"foo:1",

@@ -1,0 +1,246 @@
+"""Differential fuzz harness: every hand-written codec vs its oracle.
+
+Four targets, each bounded-time, each a property the round-4 campaign
+used to find real bugs (3 fixed: SSF unknown-enum rejection in the
+Python decoder; 32-bit tag-bound and proto3-UTF-8 acceptance gaps in
+the C++ MetricBatch decoder):
+
+  dogstatsd  C++ parser vs Python parser — accept/reject parity per
+             LINE (newline-free inputs; the datagram API splits lines)
+  ssf        C++ decoder accepts => Python decodes (rc 1/-1 => parse)
+  metricpb   C++ wire decoder accepts => generated protobuf parses,
+             and metric counts agree
+  gob        round-trip identity + clean bounded-time GobError on
+             mutated bytes (untrusted peer input on /import)
+
+Usage: python tools/fuzz_differential.py [--seconds 30] [--seed N]
+Exit 0 = no divergence; 1 = divergence (repro printed with seed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import numpy as np
+
+
+def fuzz_dogstatsd(rng, t_end) -> int:
+    from veneur_tpu import native as native_mod
+    from veneur_tpu.protocol.dogstatsd import parse_metric, ParseError
+
+    types = [b"c", b"g", b"ms", b"h", b"d", b"s", b"zz", b"", b"cg", b"mss"]
+    names = [b"a.b.c", b"x", b"", b"with space", b"uni\xc3\xa9", b"a" * 64,
+             b"a:b"]
+    values = [b"1", b"2.5", b"-3", b"+4", b"1e3", b"nan", b"inf", b"bar",
+              b"", b"0x1f", b"1_0", b"9" * 30, b"1.2.3", b" 1"]
+    rates = [b"", b"|@0.5", b"|@1", b"|@0", b"|@2", b"|@x", b"|@-1"]
+    tagsets = [b"", b"|#a:1", b"|#b:2,a:1", b"|#veneurlocalonly", b"|#",
+               b"|#a:1|#b:2", b"|#" + b"t" * 200, b"|#a:1,a:1", b"|#,"]
+    ni = native_mod.NativeIngest()
+    n = 0
+    while time.time() < t_end:
+        for _ in range(2000):
+            line = (rng.choice(names) + b":" + rng.choice(values) + b"|"
+                    + rng.choice(types) + rng.choice(rates)
+                    + rng.choice(tagsets))
+            if rng.random() < 0.4 and line:
+                pos = rng.randrange(len(line))
+                b = rng.randrange(0, 256)  # NULs included
+                if b == 0x0A:  # newline splits datagrams; per-line scope
+                    b = 0x0B
+                line = line[:pos] + bytes([b]) + line[pos + 1:]
+            try:
+                parse_metric(line)
+                py_ok = True
+            except ParseError:
+                py_ok = False
+            before = ni.processed
+            ni.ingest(line)
+            if (ni.processed > before) != py_ok:
+                print(f"dogstatsd DIVERGE py={py_ok}: {line!r}")
+                return -1
+            n += 1
+    return n
+
+
+def fuzz_ssf(rng, t_end) -> int:
+    from test_native import _make_span_bytes
+    from veneur_tpu import native as native_mod
+    from veneur_tpu.protocol import ssf_wire
+
+    seeds = []
+    for i in range(60):
+        metrics = [{"name": f"m{j}", "value": j + 0.5, "sample_rate": 1.0,
+                    "message": "msg" * j, "unit": "ms",
+                    "tags": {f"t{k}": "v" * k for k in range(j)}}
+                   for j in range(i % 5)]
+        seeds.append(_make_span_bytes(
+            trace_id=rng.randrange(0, 1 << 63), id=rng.randrange(0, 1 << 63),
+            start_timestamp=rng.randrange(0, 1 << 63),
+            end_timestamp=rng.randrange(0, 1 << 63),
+            service=f"s{i}", name=f"op{i}", indicator=bool(i % 2),
+            metrics=metrics, tags={f"k{j}": f"v{j}" for j in range(i % 6)}))
+    ni = native_mod.NativeIngest()
+    n = 0
+    while time.time() < t_end:
+        for _ in range(2000):
+            base = bytearray(rng.choice(seeds))
+            roll = rng.random()
+            if roll < 0.4 and base:
+                for _ in range(rng.randrange(1, 8)):
+                    base[rng.randrange(len(base))] = rng.randrange(256)
+            elif roll < 0.55:
+                del base[rng.randrange(max(1, len(base))):]
+            elif roll < 0.65:
+                base = bytearray(rng.randbytes(rng.randrange(0, 300)))
+            payload = bytes(base)
+            try:
+                ssf_wire.parse_ssf(payload)
+                py_ok = True
+            except Exception:
+                py_ok = False
+            rc = ni.ingest_ssf(payload, b"ind.t", b"obj.t")
+            if rc not in (-1, 0, 1) or (rc in (1, -1) and not py_ok):
+                print(f"ssf DIVERGE rc={rc} py={py_ok}: {payload!r}")
+                return -1
+            n += 1
+    return n
+
+
+def fuzz_metricpb(rng, t_end) -> int:
+    from veneur_tpu import native as native_mod
+    from veneur_tpu.gen import veneur_tpu_pb2 as mpb
+
+    def make_batch(i):
+        b = mpb.MetricBatch()
+        for j in range(i % 5):
+            m = b.metrics.add()
+            m.name = f"fz.m{j}" * (1 + j % 3)
+            m.tags.extend([f"t{k}:v{k}" for k in range(j % 4)])
+            m.kind = [mpb.KIND_COUNTER, mpb.KIND_GAUGE, mpb.KIND_HISTOGRAM,
+                      mpb.KIND_SET, mpb.KIND_TIMER][j % 5]
+            m.scope = [mpb.SCOPE_MIXED, mpb.SCOPE_LOCAL,
+                       mpb.SCOPE_GLOBAL][j % 3]
+            if m.kind == mpb.KIND_COUNTER:
+                m.counter.value = int(j * 3 - 2)
+            elif m.kind == mpb.KIND_GAUGE:
+                m.gauge.value = float(j) * 1.5 - 2
+            elif m.kind in (mpb.KIND_HISTOGRAM, mpb.KIND_TIMER):
+                m.digest.compression = 100.0
+                m.digest.min = -1.0
+                m.digest.max = 99.0
+                m.digest.centroids.means.extend(
+                    [float(k) for k in range(j + 1)])
+                m.digest.centroids.weights.extend(
+                    [1.0 + k for k in range(j + 1)])
+            elif m.kind == mpb.KIND_SET:
+                m.hll.registers = bytes(range(16 + j))
+                m.hll.precision = 14
+        return b.SerializeToString()
+
+    seeds = [make_batch(i) for i in range(50)]
+    n = 0
+    while time.time() < t_end:
+        for _ in range(2000):
+            base = bytearray(rng.choice(seeds))
+            roll = rng.random()
+            if roll < 0.4 and base:
+                for _ in range(rng.randrange(1, 8)):
+                    base[rng.randrange(len(base))] = rng.randrange(256)
+            elif roll < 0.55 and base:
+                del base[rng.randrange(len(base)):]
+            elif roll < 0.65:
+                base = bytearray(rng.randbytes(rng.randrange(0, 200)))
+            blob = bytes(base)
+            d = native_mod.decode_metric_batch(blob)
+            if d is not None:
+                try:
+                    pb = mpb.MetricBatch.FromString(blob)
+                except Exception:
+                    print(f"metricpb DIVERGE C++ n={d.n} py=rej: {blob!r}")
+                    return -1
+                if d.n != len(pb.metrics):
+                    print(f"metricpb COUNT {d.n} != {len(pb.metrics)}: "
+                          f"{blob!r}")
+                    return -1
+            n += 1
+    return n
+
+
+def fuzz_gob(rng, t_end) -> int:
+    from veneur_tpu.distributed import gob
+
+    seeds = []
+    for i in range(20):
+        k = 1 + i % 15
+        means = np.sort(np.array([rng.uniform(-1e3, 1e3) for _ in range(k)]))
+        weights = np.array([1.0 + rng.random() * 5 for _ in range(k)])
+        blob = gob.encode_merging_digest(
+            means, weights, 100.0, float(means.min()), float(means.max()),
+            0.5)
+        d = gob.decode_merging_digest(blob)
+        assert np.allclose(d.means, means)
+        seeds.append(blob)
+    n = 0
+    while time.time() < t_end:
+        for _ in range(2000):
+            base = bytearray(rng.choice(seeds))
+            roll = rng.random()
+            if roll < 0.5 and base:
+                for _ in range(rng.randrange(1, 6)):
+                    base[rng.randrange(len(base))] = rng.randrange(256)
+            elif roll < 0.65 and base:
+                del base[rng.randrange(len(base)):]
+            elif roll < 0.75:
+                base = bytearray(rng.randbytes(rng.randrange(0, 150)))
+            blob = bytes(base)
+            t0 = time.perf_counter()
+            try:
+                gob.decode_merging_digest(blob)
+            except gob.GobError:
+                pass
+            except Exception as e:
+                print(f"gob CRASH {type(e).__name__}: {e} on {blob!r}")
+                return -1
+            if time.perf_counter() - t0 > 1.0:
+                print(f"gob SLOW on {len(blob)}B")
+                return -1
+            n += 1
+    return n
+
+
+TARGETS = {"dogstatsd": fuzz_dogstatsd, "ssf": fuzz_ssf,
+           "metricpb": fuzz_metricpb, "gob": fuzz_gob}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=30.0,
+                    help="budget per target")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--targets", default="dogstatsd,ssf,metricpb,gob")
+    args = ap.parse_args()
+    seed = args.seed if args.seed is not None else int(time.time())
+    print(f"seed {seed}", flush=True)
+    failed = False
+    for name in args.targets.split(","):
+        rng = random.Random(seed)
+        n = TARGETS[name](rng, time.time() + args.seconds)
+        if n < 0:
+            failed = True
+            print(f"{name}: DIVERGENCE (seed {seed})", flush=True)
+        else:
+            print(f"{name}: {n} cases clean", flush=True)
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()
