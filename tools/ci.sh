@@ -12,6 +12,10 @@ make -C native clean all
 echo "== race-detection gate (ThreadSanitizer soak) =="
 make -C native tsan
 
+echo "== differential codec fuzz (fixed seed, 10s/target) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python tools/fuzz_differential.py --seconds 10 --seed 7
+
 echo "== test suite =="
 python -m pytest tests/ -q
 
