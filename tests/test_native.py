@@ -360,8 +360,13 @@ def test_server_native_ssf_end_to_end():
         srv.handle_trace_packet(payload)
         if not native:
             # Python path goes through the async span worker; pump it
+            # until the extracted metrics land (a fixed sleep flakes
+            # under CPU contention from parallel jobs)
             srv.span_worker.start()
-            time.sleep(0.3)
+            deadline = time.time() + 10
+            while (sum(w.processed for w in srv.workers) < 2
+                   and time.time() < deadline):
+                time.sleep(0.02)
             srv.span_worker.stop()
         out = srv.flush()
         return {(m.name, round(m.value, 3)) for m in out}
