@@ -1,0 +1,82 @@
+"""Shared machinery for the soak harnesses (soak_burnin, soak_overload):
+the blaster workload, RSS sampling, tail draining, and atomic artifact
+writes — one definition so the soaks can't drift apart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rss_mb() -> int:
+    """CURRENT resident set (not ru_maxrss — that's a monotonic peak
+    that hides both recoveries and slow leaks under its high-water
+    mark)."""
+    with open("/proc/self/statm") as f:
+        pages = int(f.read().split()[1])
+    return pages * os.sysconf("SC_PAGE_SIZE") // (1 << 20)
+
+
+def make_blaster(port: int, tid: int, stop: threading.Event, sent: dict,
+                 lock: threading.Lock, pps: float | None = None):
+    """The canonical soak workload: 9-line datagrams of timers (800
+    series/thread) + counters + HLL sets, one garbage line per 400
+    packets. pps=None means unthrottled (overload mode); otherwise the
+    loop paces to the target without bursting after a stall."""
+
+    def blast() -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        i = p = l = g = 0
+        next_t = time.perf_counter()
+        while not stop.is_set():
+            lines = []
+            for j in range(3):
+                k = (i * 3 + j) % 800
+                lines.append(f"soak.t{tid}.timer{k}:{k % 97}|ms")
+                lines.append(f"soak.t{tid}.count:{1}|c")
+                lines.append(f"soak.set:{i % 5000}|s")
+            if i % 400 == 0:
+                lines.append("garbage###not-a-metric")
+                g += 1
+            s.sendto("\n".join(lines).encode(), ("127.0.0.1", port))
+            p += 1
+            l += len(lines)
+            i += 1
+            if pps is not None:
+                next_t += 1.0 / pps
+                delay = next_t - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                elif delay < -1.0:
+                    next_t = time.perf_counter()  # fell behind; no burst
+            elif i % 200 == 0:
+                time.sleep(0.002)  # overload mode: ~100k packets/s offered
+        with lock:
+            sent["packets"] += p
+            sent["lines"] += l
+            sent["garbage"] += g
+
+    return threading.Thread(target=blast, daemon=True)
+
+
+def drain_tail(srv) -> None:
+    """Roll the native pipelines' tail (trailing samples + error
+    counters) into the workers, under the worker locks — the flush tick
+    may not have run since the last packets landed."""
+    for i, w in enumerate(srv.workers):
+        if w._native is not None:
+            with srv._worker_locks[i]:
+                w.drain_native()
+
+
+def write_artifact(name: str, payload: dict) -> None:
+    path = os.path.join(REPO, name)
+    with open(path + ".tmp", "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(path + ".tmp", path)

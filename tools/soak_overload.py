@@ -26,14 +26,16 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import resource
-import socket
 import sys
 import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _soak_common import (  # noqa: E402
+    drain_tail, make_blaster, rss_mb, write_artifact)
 
 
 def main() -> None:
@@ -53,36 +55,11 @@ def main() -> None:
                  num_workers=2, num_readers=2)
     srv = Server(cfg, metric_sinks=[BlackholeMetricSink()])
     srv.start()
-    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    rss0 = rss_mb()
     stop = threading.Event()
     sent = {"packets": 0, "lines": 0, "garbage": 0}
     lock = threading.Lock()
-
-    def blast(tid: int) -> None:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        i = p = l = g = 0
-        while not stop.is_set():
-            lines = []
-            for j in range(3):
-                k = (i * 3 + j) % 800
-                lines.append(f"soak.t{tid}.timer{k}:{k % 97}|ms")
-                lines.append(f"soak.t{tid}.count:{1}|c")
-                lines.append(f"soak.set:{i % 5000}|s")
-            if i % 400 == 0:
-                lines.append("not a metric at all###")
-                g += 1
-            s.sendto("\n".join(lines).encode(), ("127.0.0.1", 19125))
-            p += 1
-            l += len(lines)
-            i += 1
-            if i % 200 == 0:
-                time.sleep(0.002)  # ~100k packets/s offered, per thread
-        with lock:
-            sent["packets"] += p
-            sent["lines"] += l
-            sent["garbage"] += g
-
-    threads = [threading.Thread(target=blast, args=(t,), daemon=True)
+    threads = [make_blaster(19125, t, stop, sent, lock, pps=None)
                for t in range(2)]
     for t in threads:
         t.start()
@@ -90,8 +67,7 @@ def main() -> None:
     t_end = time.time() + args.duration
     while time.time() < t_end:
         time.sleep(5)
-        rss_peak = max(rss_peak, resource.getrusage(
-            resource.RUSAGE_SELF).ru_maxrss // 1024)
+        rss_peak = max(rss_peak, rss_mb())
     stop.set()
     for t in threads:
         t.join(timeout=10)
@@ -100,14 +76,11 @@ def main() -> None:
     flushes = srv.flush_count
     # roll any not-yet-drained tail into the tally — under the worker
     # locks, since the flush ticker is still swapping epochs
-    for i, w in enumerate(srv.workers):
-        if w._native is not None:
-            with srv._worker_locks[i]:
-                w.drain_native()
+    drain_tail(srv)
     shed = sum(getattr(w, "overload_dropped_total", 0)
                for w in srv.workers)
     srv.shutdown()  # must not abort — compute threads join bounded
-    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    rss1 = rss_mb()
 
     out = {
         "platform": "cpu",
@@ -127,10 +100,7 @@ def main() -> None:
         "bounded": rss_peak < args.rss_bound_mb,
         "clean_shutdown": True,  # reaching this line at all
     }
-    with open(os.path.join(REPO, "OVERLOAD_SOAK.json.tmp"), "w") as f:
-        json.dump(out, f, indent=1)
-    os.replace(os.path.join(REPO, "OVERLOAD_SOAK.json.tmp"),
-               os.path.join(REPO, "OVERLOAD_SOAK.json"))
+    write_artifact("OVERLOAD_SOAK.json", out)
     print(json.dumps({"metric": "overload_rss_peak_mb", "value": rss_peak,
                       "unit": "MB", "bounded": out["bounded"],
                       "samples_shed": shed, "flushes": flushes}))
