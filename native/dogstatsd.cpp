@@ -840,6 +840,12 @@ struct ProtoReader {
     int shift = 0;
     while (p < end && shift < 64) {
       uint8_t b = *p++;
+      // overflow in the 10th byte (bits past 2^64) is malformed wire;
+      // see WireCursor::varint
+      if (shift == 63 && (b & 0xfe)) {
+        ok = false;
+        return 0;
+      }
       v |= static_cast<uint64_t>(b & 0x7f) << shift;
       if (!(b & 0x80)) return v;
       shift += 7;
@@ -2181,6 +2187,11 @@ struct WireCursor {
     int shift = 0;
     while (p < end && shift < 64) {
       uint8_t b = *p++;
+      // 10th byte holds bits 63..69 of which only bit 63 exists in a
+      // uint64: any higher bit (or a continuation bit demanding an
+      // 11th byte) is an overflow every spec parser rejects — silently
+      // truncating here made the decoder accept what peers refuse
+      if (shift == 63 && (b & 0xFE)) return false;
       v |= static_cast<uint64_t>(b & 0x7F) << shift;
       if (!(b & 0x80)) {
         *out = v;

@@ -891,6 +891,13 @@ def test_wire_decoder_strictness_matches_python_pb():
     assert native_mod.decode_metric_batch(
         b"\xfd\x17\xf4\xb7a'\xc5\xe9\xd8\xc8:\xe7\xaf\x0br") is None
 
+    # 10-byte varint whose final byte overflows uint64: every spec
+    # parser rejects; the SSF decoder must too (round-4 deep fuzz)
+    ni = native_mod.NativeIngest()
+    overflow_tid = b"\x10" + b"\xa1\xdd\x9f\x99\x8a\xba\x8e\xbc\xd5\x18"
+    assert ni.ingest_ssf(overflow_tid + b"J\x02ssR\x07\x12\x02m0\x1d\x00\x00\x00?",
+                         b"i", b"o") == 0
+
     # oversized tag varint inside a counter submessage
     bad_inner = bytes.fromhex("0a120a054b7a2e6d0d2a09cdfaffff40ff82ffff")
     assert native_mod.decode_metric_batch(bad_inner) is None

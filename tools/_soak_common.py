@@ -53,8 +53,11 @@ def make_blaster(port: int, tid: int, stop: threading.Event, sent: dict,
                 delay = next_t - time.perf_counter()
                 if delay > 0:
                     time.sleep(delay)
-                elif delay < -1.0:
-                    next_t = time.perf_counter()  # fell behind; no burst
+                elif delay < -0.05:
+                    # fell behind (scheduler stall): resync instead of
+                    # bursting the backlog — even a sub-second burst can
+                    # overflow the UDP socket buffer and drop datagrams
+                    next_t = time.perf_counter()
             elif i % 200 == 0:
                 time.sleep(0.002)  # overload mode: ~100k packets/s offered
         with lock:

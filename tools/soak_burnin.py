@@ -57,10 +57,10 @@ def main() -> None:
         t.start()
     # warmup window: pools grow and XLA compiles in the first intervals;
     # the leak baseline starts after they settle
-    warmup = min(60, max(10, args.duration // 10))
+    warmup = min(60, max(10, args.duration // 10), args.duration)
     time.sleep(warmup)
     rss_warm = rss_mb()
-    time.sleep(args.duration - warmup)
+    time.sleep(max(0, args.duration - warmup))
     stop.set()
     for t in threads:
         t.join(timeout=10)

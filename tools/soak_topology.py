@@ -26,15 +26,13 @@ from __future__ import annotations
 
 import json
 import os
-import resource
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-
-def rss_mb() -> float:
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+from _soak_common import rss_mb, write_artifact  # noqa: E402
 
 
 def main() -> None:
@@ -183,9 +181,7 @@ def main() -> None:
         imp.stop()
         srv.shutdown()
 
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    with open(os.path.join(root, "TOPOLOGY_SOAK.json"), "w") as f:
-        json.dump(out, f, indent=1)
+    write_artifact("TOPOLOGY_SOAK.json", out)
     print(json.dumps({"metric": "topology_soak_conservation",
                       "value": 1.0 if out["conservation_ok"] else 0.0,
                       "unit": "bool",
