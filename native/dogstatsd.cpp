@@ -854,6 +854,26 @@ struct ProtoReader {
     return 0;
   }
 
+  // a TAG varint: the wire grammar caps tags at 5 bytes (uint32);
+  // stock decoders reject longer encodings even when the value fits
+  // (e.g. zero-padded continuation bytes) — round-4 deep fuzz
+  uint64_t tag_varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 35) {
+      uint8_t b = *p++;
+      if (shift == 28 && (b & 0xF0)) {  // bits past 2^32 or a 6th byte
+        ok = false;
+        return 0;
+      }
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
   std::string_view bytes() {
     uint64_t n = varint();
     if (!ok || n > static_cast<uint64_t>(end - p)) {
@@ -907,11 +927,11 @@ bool decode_tag_entry(std::string_view buf, TagPair* out) {
   ProtoReader r{reinterpret_cast<const uint8_t*>(buf.data()),
                 reinterpret_cast<const uint8_t*>(buf.data() + buf.size())};
   while (r.ok && r.p < r.end) {
-    uint64_t tag = r.varint();
+    uint64_t tag = r.tag_varint();
     if (!r.ok) return false;
-    // protobuf field numbers are 1..2^29-1; 0 or overflow is a corrupt
-    // stream the stock decoders reject
-    if ((tag >> 3) == 0 || (tag >> 3) > 0x1FFFFFFFull) return false;
+    // field number 0 is forbidden (tag_varint already bounds the tag
+    // itself at uint32, i.e. field <= 2^29-1)
+    if ((tag >> 3) == 0) return false;
     int field = static_cast<int>(tag >> 3), wt = static_cast<int>(tag & 7);
     if (field == 1) {
       VN_EXPECT_WT(2);
@@ -930,11 +950,11 @@ bool decode_sample(std::string_view buf, SampleView* s) {
   ProtoReader r{reinterpret_cast<const uint8_t*>(buf.data()),
                 reinterpret_cast<const uint8_t*>(buf.data() + buf.size())};
   while (r.ok && r.p < r.end) {
-    uint64_t tag = r.varint();
+    uint64_t tag = r.tag_varint();
     if (!r.ok) return false;
-    // protobuf field numbers are 1..2^29-1; 0 or overflow is a corrupt
-    // stream the stock decoders reject
-    if ((tag >> 3) == 0 || (tag >> 3) > 0x1FFFFFFFull) return false;
+    // field number 0 is forbidden (tag_varint already bounds the tag
+    // itself at uint32, i.e. field <= 2^29-1)
+    if ((tag >> 3) == 0) return false;
     int field = static_cast<int>(tag >> 3), wt = static_cast<int>(tag & 7);
     switch (field) {
       case 1: VN_EXPECT_WT(0); s->metric = static_cast<int>(r.varint());
@@ -968,11 +988,11 @@ bool decode_span(std::string_view buf, SpanView* sp) {
   ProtoReader r{reinterpret_cast<const uint8_t*>(buf.data()),
                 reinterpret_cast<const uint8_t*>(buf.data() + buf.size())};
   while (r.ok && r.p < r.end) {
-    uint64_t tag = r.varint();
+    uint64_t tag = r.tag_varint();
     if (!r.ok) return false;
-    // protobuf field numbers are 1..2^29-1; 0 or overflow is a corrupt
-    // stream the stock decoders reject
-    if ((tag >> 3) == 0 || (tag >> 3) > 0x1FFFFFFFull) return false;
+    // field number 0 is forbidden (tag_varint already bounds the tag
+    // itself at uint32, i.e. field <= 2^29-1)
+    if ((tag >> 3) == 0) return false;
     int field = static_cast<int>(tag >> 3), wt = static_cast<int>(tag & 7);
     switch (field) {
       case 2: VN_EXPECT_WT(0);
@@ -2202,6 +2222,24 @@ struct WireCursor {
     return false;
   }
 
+  // TAG varints cap at 5 bytes (uint32 wire grammar); see
+  // ProtoReader::tag_varint
+  bool tag_varint(uint64_t* out) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 35) {
+      uint8_t b = *p++;
+      if (shift == 28 && (b & 0xF0)) return false;
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) {
+        *out = v;
+        return true;
+      }
+      shift += 7;
+    }
+    return false;
+  }
+
   bool skip(uint32_t wire_type) {
     uint64_t tmp;
     switch (wire_type) {
@@ -2258,7 +2296,7 @@ bool decode_centroids(std::string_view body, std::vector<float>* means,
                reinterpret_cast<const uint8_t*>(body.data() + body.size())};
   while (c.p < c.end) {
     uint64_t tag;
-    if (!c.varint(&tag) || tag > 0xFFFFFFFFull) return false;
+    if (!c.tag_varint(&tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
     uint32_t wt = static_cast<uint32_t>(tag & 7);
     if (field == 0) return false;  // protobuf forbids field number 0
@@ -2306,7 +2344,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
   int32_t precision = 0;
   while (c.p < c.end) {
     uint64_t tag;
-    if (!c.varint(&tag) || tag > 0xFFFFFFFFull) return false;
+    if (!c.tag_varint(&tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
     uint32_t wt = static_cast<uint32_t>(tag & 7);
     if (field == 0) return false;  // protobuf forbids field number 0
@@ -2338,7 +2376,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
                       reinterpret_cast<const uint8_t*>(v.data() + v.size())};
         while (ic.p < ic.end) {
           uint64_t it;
-          if (!ic.varint(&it) || it > 0xFFFFFFFFull) return false;
+          if (!ic.tag_varint(&it)) return false;
           if ((it >> 3) == 0) return false;
           if ((it >> 3) == 1 && (it & 7) == 1) {
             int64_t sv;
@@ -2360,7 +2398,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
                       reinterpret_cast<const uint8_t*>(v.data() + v.size())};
         while (ic.p < ic.end) {
           uint64_t it;
-          if (!ic.varint(&it) || it > 0xFFFFFFFFull) return false;
+          if (!ic.tag_varint(&it)) return false;
           if ((it >> 3) == 0) return false;
           if ((it >> 3) == 1 && (it & 7) == 1) {
             if (!ic.f64(&scalar)) return false;
@@ -2378,7 +2416,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
                       reinterpret_cast<const uint8_t*>(v.data() + v.size())};
         while (ic.p < ic.end) {
           uint64_t it;
-          if (!ic.varint(&it) || it > 0xFFFFFFFFull) return false;
+          if (!ic.tag_varint(&it)) return false;
           if ((it >> 3) == 0) return false;
           uint32_t f = static_cast<uint32_t>(it >> 3);
           uint32_t w = static_cast<uint32_t>(it & 7);
@@ -2408,7 +2446,7 @@ bool decode_metric(std::string_view body, Decoded* d) {
                       reinterpret_cast<const uint8_t*>(v.data() + v.size())};
         while (ic.p < ic.end) {
           uint64_t it;
-          if (!ic.varint(&it) || it > 0xFFFFFFFFull) return false;
+          if (!ic.tag_varint(&it)) return false;
           if ((it >> 3) == 0) return false;
           uint32_t f = static_cast<uint32_t>(it >> 3);
           uint32_t w = static_cast<uint32_t>(it & 7);
@@ -2491,7 +2529,7 @@ long long vn_decode_metric_batch(
   while (c.p < c.end) {
     const uint8_t* tag_start = c.p;
     uint64_t tag;
-    if (!c.varint(&tag) || tag > 0xFFFFFFFFull) return -1;
+    if (!c.tag_varint(&tag)) return -1;
     uint32_t field = static_cast<uint32_t>(tag >> 3);
     uint32_t wt = static_cast<uint32_t>(tag & 7);
     if (field == 0) return -1;  // protobuf forbids field number 0

@@ -898,6 +898,13 @@ def test_wire_decoder_strictness_matches_python_pb():
     assert ni.ingest_ssf(overflow_tid + b"J\x02ssR\x07\x12\x02m0\x1d\x00\x00\x00?",
                          b"i", b"o") == 0
 
+    # TAG varints cap at 5 bytes: a zero-padded 6-byte tag encoding is
+    # malformed even though its value fits uint32 (round-4 deep fuzz)
+    six_byte_tag = b"\x9d\xa5\xbb\x9f\x81\x00" + b"\xa5\xfc:P"
+    assert ni.ingest_ssf(b"\x10\x07" + six_byte_tag + b"J\x02ss",
+                         b"i", b"o") == 0
+    assert native_mod.decode_metric_batch(six_byte_tag) is None
+
     # oversized tag varint inside a counter submessage
     bad_inner = bytes.fromhex("0a120a054b7a2e6d0d2a09cdfaffff40ff82ffff")
     assert native_mod.decode_metric_batch(bad_inner) is None
