@@ -854,9 +854,11 @@ struct ProtoReader {
     return 0;
   }
 
-  // a TAG varint: the wire grammar caps tags at 5 bytes (uint32);
-  // stock decoders reject longer encodings even when the value fits
-  // (e.g. zero-padded continuation bytes) — round-4 deep fuzz
+  // a TAG varint: canonical wire caps tags at 5 bytes (uint32), as
+  // upstream protobuf parsers enforce. The reference's gogo-generated
+  // Unmarshal is looser (≤10 bytes, truncating) — deliberate
+  // spec-over-reference divergence, see PARITY.md "Deliberate
+  // wire-strictness divergences"
   uint64_t tag_varint() {
     uint64_t v = 0;
     int shift = 0;
@@ -1982,6 +1984,11 @@ void vn_set_spill_cap(void* p, long long cap) {
   Ctx* ctx = static_cast<Ctx*>(p);
   std::lock_guard<std::recursive_mutex> g(ctx->mu);
   if (cap > 0) ctx->spill_cap = static_cast<size_t>(cap);
+  // A raised cap lets g_rows resume push_back, so the onset-built
+  // last-write index no longer covers the batch tail; clear it so the
+  // next overload onset rebuilds it over the full batch (a stale entry
+  // would update an older-positioned duplicate, losing LWW at drain).
+  ctx->g_last.clear();
 }
 
 int vn_drain_histo(void* p, int32_t* rows, float* vals, float* wts, int cap) {

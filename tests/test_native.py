@@ -821,6 +821,33 @@ def test_native_spill_cap_sheds_with_exact_count():
     assert ni.overload_dropped == 0
 
 
+def test_native_spill_cap_raise_rebuilds_gauge_index():
+    """Raising the cap mid-overload invalidates the onset-built gauge
+    last-write index: rows appended after the raise must win LWW over
+    their pre-raise duplicates at the next overload onset (a stale
+    index would update the older-positioned entry, so the newer batch
+    entry — holding an older value — wins the fold)."""
+    ni = native_mod.NativeIngest()
+    ni.set_stage_depth(2)
+    ni.set_spill_cap(2)
+    ni.ingest(b"rg.a:1|g")
+    ni.ingest(b"rg.b:2|g")          # batch at cap
+    ni.ingest(b"rg.a:10|g")         # onset: index built, in-place update
+    assert ni.pending_gauge == 2
+    ni.set_spill_cap(4)             # raise: push_back resumes
+    ni.ingest(b"rg.c:3|g")
+    ni.ingest(b"rg.a:20|g")         # duplicate row, later position
+    assert ni.pending_gauge == 4    # back at (new) cap
+    ni.ingest(b"rg.a:30|g")         # 2nd onset: index must be rebuilt
+    dropped_before = ni.overload_dropped
+    ni.ingest(b"rg.d:9|g")          # genuinely absent row: sheds
+    assert ni.overload_dropped == dropped_before + 1
+    _rows, gvals = ni.drain_gauge(8)
+    # the LAST entry for row a carries 30 — with a stale index the 30
+    # lands at position 0 and the stale 20 wins the positional LWW fold
+    assert list(gvals) == [10.0, 2.0, 3.0, 30.0]
+
+
 def test_native_staging_reset_drops_plane():
     """vn_ctx_reset must not leak staged samples into the next epoch."""
     ni = native_mod.NativeIngest()

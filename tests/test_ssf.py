@@ -247,6 +247,77 @@ def test_ssf_unix_stream_ingest(tmp_path):
         srv.shutdown()
 
 
+def test_ssf_error_total_reference_tag_sets():
+    """ssf.error_total carries the reference's tag sets verbatim
+    (server.go:1052-1072, 1238-1246): zerolength/unmarshal/empty_id on
+    the packet path, processing/framing on the framed-stream path, and
+    frames.disconnects only on clean EOF."""
+    from veneur_tpu import scopedstatsd
+
+    cfg = Config(interval="10s")
+    # a span sink forces the Python SSF path (empty_id is counted there)
+    srv = Server(cfg, span_sinks=[ChannelSpanSink()])
+    cap = scopedstatsd.CaptureSender()
+    srv.stats = scopedstatsd.ScopedClient(cap, namespace="veneur.")
+
+    def err_lines():
+        return [line for line in cap.lines if "ssf.error_total" in line]
+
+    srv.handle_trace_packet(b"")
+    assert any("ssf_format:packet" in ln and "packet_type:unknown" in ln
+               and "reason:zerolength" in ln for ln in err_lines())
+
+    cap.lines.clear()
+    srv.handle_trace_packet(b"\xff\xff\xff\xff")
+    assert any("ssf_format:packet" in ln and "packet_type:ssf_metric" in ln
+               and "reason:unmarshal" in ln for ln in err_lines())
+
+    # zero span id: counted as a client problem but still handled
+    cap.lines.clear()
+    srv.handle_trace_packet(ssf_wire.encode_datagram(_span(id=0)))
+    assert any("packet_type:ssf_metric" in ln and "reason:empty_id" in ln
+               for ln in err_lines())
+
+    # framed stream: an unmarshalable payload inside a well-formed frame
+    # is recoverable (reason:processing, keep reading); a frame-level
+    # violation poisons the stream (reason:framing); clean EOF counts
+    # frames.disconnects
+    import struct
+    cap.lines.clear()
+    bad_payload = b"\xff\xff\xff\xff"
+    good_frame = io.BytesIO()
+    ssf_wire.write_ssf(good_frame, _span(metrics=[ssf.count("fr.c", 1)]))
+    stream = io.BytesIO(
+        struct.pack(">BI", 0, len(bad_payload)) + bad_payload
+        + good_frame.getvalue())
+    conn = _FakeConn(stream)
+    srv._read_ssf_stream(conn)
+    lns = err_lines()
+    assert any("ssf_format:framed" in ln and "packet_type:unknown" in ln
+               and "reason:processing" in ln for ln in lns)
+    assert not any("reason:framing" in ln for ln in lns)
+    assert any("frames.disconnects" in ln for ln in cap.lines)
+
+    cap.lines.clear()
+    srv._read_ssf_stream(_FakeConn(io.BytesIO(b"\x07garbage")))
+    assert any("ssf_format:framed" in ln and "packet_type:unknown" in ln
+               and "reason:framing" in ln for ln in err_lines())
+    assert not any("frames.disconnects" in ln for ln in cap.lines)
+
+
+class _FakeConn:
+    """Just enough socket for _read_ssf_stream."""
+
+    def __init__(self, stream: io.BytesIO) -> None:
+        self._stream = stream
+
+    def makefile(self, _mode: str):
+        return self._stream
+
+    def close(self) -> None:
+        pass
+
+
 # ---------------------------------------------------------------------------
 # Trace client
 

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Commits on-chip artifacts the moment the capture loop refreshes them.
+# The relay window is rare and short (one ~33min window in 4 rounds);
+# committing within seconds of each artifact landing means a wedge or
+# host reboot can't lose captured evidence.
+cd /root/repo || exit 1
+WATCH="BENCH_CACHE.json E2E_FLUSH.json E2E_SCALING.json OVERLAP.json PALLAS_AB.json RELAY_LINK.json PROFILE_INGEST_TPU.txt"
+while true; do
+    CHANGED=""
+    for f in $WATCH; do
+        # compare against HEAD (not the index) so a commit that failed on
+        # index.lock contention is retried next cycle; new files count too
+        if { [ -f "$f" ] && ! git ls-files --error-unmatch "$f" >/dev/null 2>&1; } \
+           || ! git diff --quiet HEAD -- "$f" 2>/dev/null; then
+            CHANGED="$CHANGED $f"
+        fi
+    done
+    if [ -n "$CHANGED" ]; then
+        # settle: let an in-flight atomic rename finish
+        sleep 2
+        git add $CHANGED
+        # pathspec-limited commit: never sweeps files another process staged
+        git commit -m "on-chip artifacts refreshed by capture loop:$CHANGED" --no-verify -- $CHANGED >/dev/null 2>&1 \
+            && echo "$(date -u +%H:%M:%S) committed:$CHANGED"
+    fi
+    sleep 20
+done

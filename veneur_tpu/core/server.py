@@ -427,8 +427,11 @@ class Server:
         (reference HandleTracePacket, server.go:1046)."""
         if not packet:
             self._bump_errors()
+            # reference tag set verbatim (server.go:1052)
             self.stats.count("ssf.error_total", 1,
-                             tags=["ssf_format:packet", "reason:length"])
+                             tags=["ssf_format:packet",
+                                   "packet_type:unknown",
+                                   "reason:zerolength"])
             return
         if self._native_ssf:
             # native decode + span→metric extraction in one C++ pass;
@@ -444,6 +447,7 @@ class Server:
                 self._bump_errors()
                 self.stats.count("ssf.error_total", 1,
                                  tags=["ssf_format:packet",
+                                       "packet_type:ssf_metric",
                                        "reason:unmarshal"])
                 return
         try:
@@ -452,9 +456,18 @@ class Server:
             self._bump_errors()
             self.stats.count("ssf.error_total", 1,
                              tags=["ssf_format:packet",
+                                   "packet_type:ssf_metric",
                                    "reason:unmarshal"])
             log.debug("bad SSF packet: %s", e)
             return
+        if span.id == 0:
+            # client problem, counted but the span is still handled
+            # (reference server.go:1067-1072)
+            self.stats.count("ssf.error_total", 1,
+                             tags=["ssf_format:packet",
+                                   "packet_type:ssf_metric",
+                                   "reason:empty_id"])
+            log.debug("trace packet has zero span id")
         self.handle_ssf(span)
 
     def ingest_internal_span(self, span) -> None:
@@ -480,6 +493,7 @@ class Server:
         if errs:
             self.stats.count("ssf.error_total", errs,
                              tags=["ssf_format:packet",
+                                   "packet_type:ssf_metric",
                                    "reason:unmarshal"])
         for pkt in fallbacks:
             try:
@@ -488,9 +502,16 @@ class Server:
                 self._bump_errors()
                 self.stats.count("ssf.error_total", 1,
                                  tags=["ssf_format:packet",
+                                       "packet_type:ssf_metric",
                                        "reason:unmarshal"])
                 log.debug("bad SSF packet: %s", e)
                 continue
+            if span.id == 0:
+                # same client-problem counter as the single-packet path
+                self.stats.count("ssf.error_total", 1,
+                                 tags=["ssf_format:packet",
+                                       "packet_type:ssf_metric",
+                                       "reason:empty_id"])
             self.handle_ssf(span)
 
     def handle_ssf(self, span) -> None:
@@ -592,18 +613,34 @@ class Server:
         f = conn.makefile("rb")
         try:
             while not self._shutdown.is_set():
-                span = ssf_wire.read_ssf(
-                    f, max_length=self.config.trace_max_length_bytes)
+                try:
+                    span = ssf_wire.read_ssf(
+                        f, max_length=self.config.trace_max_length_bytes)
+                except ssf_wire.SSFUnmarshalError as e:
+                    # the frame was consumed whole; the stream can keep
+                    # reading (reference ReadSSFStreamSocket continues on
+                    # non-framing errors, server.go:1243-1248)
+                    self._bump_errors()
+                    self.stats.count("ssf.error_total", 1,
+                                     tags=["ssf_format:framed",
+                                           "packet_type:unknown",
+                                           "reason:processing"])
+                    log.debug("bad SSF frame payload: %s", e)
+                    continue
                 if span is None:
+                    # clean client hangup at a frame boundary
+                    # (reference server.go:1229-1232)
+                    self.stats.count("frames.disconnects", 1)
                     return
                 self.handle_ssf(span)
         except ssf_wire.FramingError as e:
+            # a framing violation poisons the stream: close it
+            # (reference protocol/wire.go IsFramingError path,
+            # server.go:1234-1241)
             self._bump_errors()
-            # reference protocol/wire.go: a framing error poisons the
-            # stream; operators watch frames.disconnects for it
-            self.stats.count("frames.disconnects", 1)
             self.stats.count("ssf.error_total", 1,
                              tags=["ssf_format:framed",
+                                   "packet_type:unknown",
                                    "reason:framing"])
             log.debug("SSF stream framing error, closing: %s", e)
         except OSError:

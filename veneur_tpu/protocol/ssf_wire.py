@@ -29,6 +29,16 @@ class FramingError(Exception):
     """The stream is unrecoverably broken and must be closed."""
 
 
+class SSFUnmarshalError(FramingError):
+    """The frame was well-formed but its protobuf payload didn't parse.
+
+    Subclass of FramingError so packet-path callers keep one catch, but
+    the framed-stream reader treats it as recoverable: the frame's bytes
+    were fully consumed, so the connection can keep reading (reference
+    ReadSSF returns a non-framing error and ReadSSFStreamSocket
+    continues, server.go:1243-1248)."""
+
+
 def _enum_or_raw(enum_cls, v: int):
     """proto3 semantics: unknown enum values are DATA, not errors — the
     Go reference decodes them as plain ints and the per-sample converter
@@ -121,7 +131,7 @@ def parse_ssf(packet: bytes) -> ssf_model.SSFSpan:
     try:
         pb = ssf_pb2.SSFSpan.FromString(packet)
     except Exception as e:
-        raise FramingError(f"invalid SSF protobuf: {e}") from None
+        raise SSFUnmarshalError(f"invalid SSF protobuf: {e}") from None
     return normalize_span(pb_to_span(pb))
 
 
