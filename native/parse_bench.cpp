@@ -1,0 +1,160 @@
+// Per-core DogStatsD parse+stage throughput microbench.
+//
+// VERDICT r4 item 4a: the 50M samples/s/chip north star is host-parse
+// bound, and the round-4 artifacts only ever *extrapolated* per-core
+// parse throughput from end-to-end runs. This bench measures it
+// directly, phase by phase, with cycles/line (rdtsc):
+//
+//   parse    parse_line only (tokenize + value + tag normalize + digest)
+//   commit   handle_line (parse + directory upsert + stage/SoA commit)
+//   datagram vn_ingest over 25-line datagrams (the wire-facing API the
+//            C++ readers call — includes line splitting)
+//
+// The corpus mirrors the production mix the overload soak blasts
+// (timers with tags + sample rate, counters, gauges, HLL sets) plus a
+// no-tag fast-path variant. Single-threaded by design: multiply by the
+// deployment's reader-core budget (tools/bench_parse_percore.py runs
+// the multi-process SO_REUSEPORT scaling harness where cores exist).
+//
+// Output: one JSON line on stdout.
+//
+// Build/run: make -C native parse_bench && ./native/parse_bench
+
+#include "dogstatsd.cpp"
+
+#include <chrono>
+#include <cstdio>
+#include <x86intrin.h>
+
+namespace {
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::vector<std::string> build_corpus(int n) {
+  std::vector<std::string> lines;
+  lines.reserve(n);
+  char buf[256];
+  for (int i = 0; i < n; ++i) {
+    int series = i % 800;
+    switch (i % 10) {
+      case 0: case 1: case 2: case 3:  // 40% tagged timers
+        std::snprintf(buf, sizeof buf,
+                      "svc.req.latency.%d:%d.%02d|ms|@0.5|#env:prod,"
+                      "region:us-east-1,service:api%d",
+                      series, i % 300, i % 100, series % 16);
+        break;
+      case 4: case 5:  // 20% counters
+        std::snprintf(buf, sizeof buf,
+                      "svc.req.count.%d:%d|c|#env:prod,service:api%d",
+                      series, 1 + i % 5, series % 16);
+        break;
+      case 6:  // 10% gauges
+        std::snprintf(buf, sizeof buf, "svc.queue.depth.%d:%d|g|#env:prod",
+                      series, i % 10000);
+        break;
+      case 7:  // 10% sets
+        std::snprintf(buf, sizeof buf, "svc.users.%d:user%d|s|#env:prod",
+                      series, i % 65536);
+        break;
+      default:  // 20% untagged timers (fast path)
+        std::snprintf(buf, sizeof buf, "svc.db.time.%d:%d.%d|ms", series,
+                      i % 200, i % 10);
+        break;
+    }
+    lines.emplace_back(buf);
+  }
+  return lines;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int corpus_n = 4000;
+  long long target_lines = 8'000'000;
+  if (argc > 1) target_lines = std::atoll(argv[1]);
+
+  auto lines = build_corpus(corpus_n);
+  size_t total_bytes = 0;
+  for (auto& l : lines) total_bytes += l.size();
+
+  // -- phase 1: parse only ------------------------------------------------
+  Scratch sc;
+  Parsed p;
+  long long parsed = 0;
+  double sink = 0;  // defeat dead-code elimination
+  double t0 = now_s();
+  uint64_t c0 = __rdtsc();
+  for (long long it = 0; parsed < target_lines; ++it) {
+    const std::string& line = lines[it % corpus_n];
+    if (parse_line(&sc, line, &p)) sink += p.value + p.digest;
+    ++parsed;
+  }
+  uint64_t parse_cycles = __rdtsc() - c0;
+  double parse_s = now_s() - t0;
+
+  // -- phase 2: parse + commit (directory upsert + stage/SoA) -------------
+  void* ctx = vn_ctx_new(14);
+  vn_set_stage_depth(ctx, 64);
+  long long committed = 0;
+  t0 = now_s();
+  c0 = __rdtsc();
+  for (long long it = 0; committed < target_lines; ++it) {
+    const std::string& line = lines[it % corpus_n];
+    handle_line(static_cast<Ctx*>(ctx), line);
+    ++committed;
+    if ((it + 1) % 2'000'000 == 0) {
+      // periodic drain keeps the SoA/stage memory bounded like the
+      // runtime's pump does, at a realistic cadence
+      vn_ctx_reset(ctx);
+    }
+  }
+  uint64_t commit_cycles = __rdtsc() - c0;
+  double commit_s = now_s() - t0;
+  vn_ctx_free(ctx);
+
+  // -- phase 3: full datagram API (vn_ingest, 25 lines/datagram) ----------
+  std::vector<std::string> datagrams;
+  {
+    std::string d;
+    for (int i = 0; i < corpus_n; ++i) {
+      d += lines[i];
+      if ((i + 1) % 25 == 0) {
+        datagrams.push_back(d);
+        d.clear();
+      } else {
+        d.push_back('\n');
+      }
+    }
+    if (!d.empty()) datagrams.push_back(d);
+  }
+  ctx = vn_ctx_new(14);
+  vn_set_stage_depth(ctx, 64);
+  long long dg_lines = 0;
+  t0 = now_s();
+  c0 = __rdtsc();
+  for (long long it = 0; dg_lines < target_lines; ++it) {
+    const std::string& d = datagrams[it % datagrams.size()];
+    vn_ingest(ctx, d.data(), static_cast<int>(d.size()));
+    dg_lines += 25;
+    if ((it + 1) % 80'000 == 0) vn_ctx_reset(ctx);
+  }
+  uint64_t dg_cycles = __rdtsc() - c0;
+  double dg_s = now_s() - t0;
+  vn_ctx_free(ctx);
+
+  double avg_line = static_cast<double>(total_bytes) / corpus_n;
+  std::printf(
+      "{\"parse_lines_per_s\": %.0f, \"parse_cycles_per_line\": %.0f, "
+      "\"commit_lines_per_s\": %.0f, \"commit_cycles_per_line\": %.0f, "
+      "\"datagram_lines_per_s\": %.0f, \"datagram_cycles_per_line\": %.0f, "
+      "\"avg_line_bytes\": %.1f, \"lines_timed\": %lld, \"sink\": %.3g}\n",
+      parsed / parse_s, static_cast<double>(parse_cycles) / parsed,
+      committed / commit_s, static_cast<double>(commit_cycles) / committed,
+      dg_lines / dg_s, static_cast<double>(dg_cycles) / dg_lines, avg_line,
+      target_lines, sink);
+  return 0;
+}

@@ -491,6 +491,58 @@ def test_staged_growth_preserves_planes():
     assert a["count"] == 1.0 and a["min"] == 7.0 and a["max"] == 7.0
 
 
+def test_native_spill_fold_deferred_to_extract():
+    """The hot-row spill batch drained at epoch close is NOT folded in
+    swap() (which holds the ingest lock — round-5 overload measurement:
+    the backlog fold was 42s of a 44s flush); it rides the SwappedEpoch
+    and extract_snapshot folds it off the lock. Aggregates stay exact."""
+    import pytest
+
+    w = DeviceWorker(stage_depth=2, batch_size=1 << 20)
+    if not w.attach_native():
+        pytest.skip("native lib unavailable")
+    n = 9
+    for v in range(1, n + 1):
+        w.ingest_datagram(b"t:%d|ms" % v)
+    qs = device_quantiles([0.5], AGGS)
+    sw = w.swap(qs)
+    # 2 staged in the plane, 7 spilled — the spill is deferred, unfolded
+    assert sw.spill_histo is not None
+    assert len(sw.spill_histo[0]) == n - 2
+    snap = w.extract_snapshot(sw, qs, interval_s=10.0)
+    assert float(snap.lweight[0]) == float(n)
+    assert float(snap.lmin[0]) == 1.0
+    assert float(snap.lmax[0]) == float(n)
+    assert abs(float(snap.lsum[0]) - sum(range(1, n + 1))) < 1e-6
+
+
+def test_adaptive_spill_cap_controller():
+    """Flushes overrunning the interval halve the spill caps (shed
+    earlier, keep cadence); comfortable flushes grow them back toward
+    the configured ceiling. Floor and ceiling are respected."""
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.channel import ChannelMetricSink
+
+    cfg = Config(interval="10s", tpu_spill_cap=1 << 20)
+    srv = Server(cfg, metric_sinks=[ChannelMetricSink()])
+    try:
+        assert srv._spill_cap_now == 1 << 20
+        srv._adapt_spill_caps(9.5)          # overrun: halve
+        assert srv._spill_cap_now == 1 << 19
+        for _ in range(10):
+            srv._adapt_spill_caps(20.0)     # keep overrunning
+        assert srv._spill_cap_now == 1 << 16   # floor
+        assert srv.workers[0].spill_cap == 1 << 16
+        srv._adapt_spill_caps(5.0)          # mid-band: hold
+        assert srv._spill_cap_now == 1 << 16
+        for _ in range(10):
+            srv._adapt_spill_caps(0.5)      # fast: grow back
+        assert srv._spill_cap_now == 1 << 20   # ceiling
+    finally:
+        srv.shutdown()
+
+
 def test_staged_matches_direct_fold():
     """The staged path and the per-batch direct device fold agree exactly
     on scalar aggregates and closely on quantiles."""

@@ -4,7 +4,7 @@
 # committing within seconds of each artifact landing means a wedge or
 # host reboot can't lose captured evidence.
 cd /root/repo || exit 1
-WATCH="BENCH_CACHE.json E2E_FLUSH.json E2E_SCALING.json OVERLAP.json PALLAS_AB.json RELAY_LINK.json PROFILE_INGEST_TPU.txt"
+WATCH="BENCH_CACHE.json E2E_FLUSH.json E2E_SCALING.json OVERLAP.json PALLAS_AB.json RELAY_LINK.json PROFILE_INGEST_TPU.txt FUZZ_TALLY.json"
 while true; do
     CHANGED=""
     for f in $WATCH; do

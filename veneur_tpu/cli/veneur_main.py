@@ -122,7 +122,15 @@ def main(argv=None) -> int:
         except Exception:
             logging.getLogger("veneur_tpu").exception(
                 "final flush before restart failed")
-    server.shutdown()
+    clean = server.shutdown()
+    if not clean and not restart.is_set():
+        # a compute thread is still inside XLA/C++ after the bounded
+        # join — letting the interpreter finalize under it aborts the
+        # process (glibc "FATAL: exception not rethrown"). Everything
+        # is flushed; skip finalization.
+        logging.getLogger("veneur_tpu").warning(
+            "compute thread still in XLA at shutdown; fast-exiting")
+        os._exit(0)
     if restart.is_set():
         logging.getLogger("veneur_tpu").info(
             "graceful restart: drained, re-executing with %d listener"

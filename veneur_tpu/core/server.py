@@ -120,6 +120,14 @@ class Server:
             for _ in range(cfg.num_workers)
         ]
         self._worker_locks = [threading.Lock() for _ in self.workers]
+        # adaptive overload shedding starts at the configured ceiling and
+        # tightens when flushes overrun the interval (_adapt_spill_caps);
+        # each flush may inherit at most half an interval of spill-fold
+        # work (worker.swap sheds the excess, counted)
+        self._spill_cap_now = cfg.tpu_spill_cap
+        self.compute_threads_joined = True  # set by shutdown()
+        for w in self.workers:
+            w.fold_budget_s = 0.5 * self.interval
         if cfg.tpu_mesh_devices > 1:
             # config-driven mesh sharding for the aggregation state (the
             # global tier's import merge rides ICI collectives; see
@@ -1228,9 +1236,44 @@ class Server:
             if delay > 0 and self._shutdown.wait(delay):
                 return
             try:
+                _t0 = time.perf_counter()
                 self.flush()
+                self._adapt_spill_caps(time.perf_counter() - _t0)
             except Exception:
                 log.exception("flush failed")
+
+    def _adapt_spill_caps(self, flush_dur: float) -> None:
+        """Closed-loop overload shedding: bound the backlog one flush can
+        inherit so the flush fits the interval. The C++ spill caps bound
+        the direct-fold work a swap hands to extraction; when a flush
+        overruns most of the interval, halve them (shed earlier at the
+        parse boundary — cheap, counted — and keep the cadence); when
+        flushes run comfortably fast, grow back toward the configured
+        ceiling. The reference's equivalents are fixed-size worker
+        channels (worker.go:31-48) plus a watchdog that kills a stalled
+        flush (server.go:948-990); adapting the cap keeps the flush from
+        being the thing that stalls."""
+        ceiling = self.config.tpu_spill_cap
+        floor = min(1 << 16, ceiling)
+        cur = self._spill_cap_now
+        if flush_dur > 0.9 * self.interval:
+            new = max(floor, cur >> 1)
+        elif flush_dur < 0.3 * self.interval:
+            new = min(ceiling, cur << 1)
+        else:
+            return
+        if new == cur:
+            return
+        self._spill_cap_now = new
+        self.stats.gauge("ingest.spill_cap", new)
+        for w in self.workers:
+            w.spill_cap = new
+            native = getattr(w, "_native", None)
+            if native is not None:
+                try:
+                    native.set_spill_cap(new)
+                except AttributeError:  # stale .so without the cap API
+                    pass
 
     def flush(self):
         """One flush pass (reference Server.Flush, flusher.go:28-134).
@@ -1631,13 +1674,17 @@ class Server:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def shutdown(self) -> None:
+    def shutdown(self) -> bool:
         """reference Server.Shutdown (server.go:1473). Idempotent — the
-        /quitquitquit handler thread and the main loop may both call it."""
+        /quitquitquit handler thread and the main loop may both call it.
+
+        Returns False when a compute thread is still inside XLA/C++
+        after the bounded join: the caller should exit via os._exit so
+        interpreter finalization can't unwind it mid-frame."""
         self._shutdown.set()
         with self._shutdown_once_lock:
             if self._shutdown_done:
-                return
+                return self.compute_threads_joined
             self._shutdown_done = True
         self._stop_native_readers()
         # join the compute threads (bounded): a daemon thread still
@@ -1653,6 +1700,13 @@ class Server:
             if t is me or not t.is_alive():
                 continue
             t.join(timeout=max(0.1, deadline - time.time()))
+        # a compute thread that outlived the bounded join is still inside
+        # XLA/C++ (e.g. a starved multi-minute compile): the caller must
+        # NOT let the interpreter finalize under it (glibc "FATAL:
+        # exception not rethrown" / heap-corruption aborts at exit) —
+        # exit with os._exit instead. All flush data is already out.
+        self.compute_threads_joined = all(
+            (t is me or not t.is_alive()) for t in self._compute_threads)
         if getattr(self, "_profile_dir", None):
             try:
                 import jax.profiler
@@ -1693,6 +1747,7 @@ class Server:
             except OSError:
                 pass
         self._socket_locks.clear()
+        return self.compute_threads_joined
 
     @property
     def version(self) -> str:

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import NamedTuple, Optional
 
@@ -477,6 +478,13 @@ class SwappedEpoch:
     # once uploaded); extract_snapshot folds them into `histo` off the
     # ingest lock
     staged_histo: Optional[list] = None
+    # hot-row spill batch (rows, vals, wts numpy SoA) drained from the
+    # C++ context at epoch close but NOT yet folded: under overload the
+    # backlog fold is tens of seconds of device work, and running it in
+    # swap() held the ingest lock for the whole of it (round-5 overload
+    # measurement: swap 42s of a 44s flush, all in the spill fold).
+    # extract_snapshot folds it off the lock, like the staged planes.
+    spill_histo: Optional[tuple] = None
 
 
 class DeviceWorker:
@@ -535,6 +543,13 @@ class DeviceWorker:
         self.overload_dropped = 0
         self.overload_dropped_total = 0
         self._inflight_folds = 0
+        # per-flush spill-fold budget: seconds of fold work one flush may
+        # inherit (the server sets this to a fraction of its interval)
+        # and the measured fold throughput that converts it to samples.
+        # Backlog beyond budget sheds AT SWAP, counted — bounding flush
+        # wall time is what keeps the cadence under overload.
+        self.fold_budget_s: float = 5.0
+        self._fold_rate_ewma: float = 1e6  # samples/s, refined by extract
         self._native = None
         self._mesh_pool = None
         # cross-epoch series-metadata cache (see _sync_native_series);
@@ -762,12 +777,19 @@ class DeviceWorker:
         self._sync_native_series()
         return h, s, c, g, st, others, ssf_fb
 
-    def _apply_native_raw(self, raw) -> None:
+    def _apply_native_raw(self, raw, defer_histo_spill: bool = False):
         """Apply drained buffers to device/host pools (no context lock —
         device dispatch must not stall reader commits). The detached
         staging plane (raw[4]) and event lines (raw[5], both flush only)
-        are the caller's to hand to the swapped epoch."""
+        are the caller's to hand to the swapped epoch.
+
+        defer_histo_spill (swap only): skip the histo spill fold and
+        return the (rows, vals, wts) SoA for the caller to attach to the
+        SwappedEpoch — extract_snapshot runs the fold off the ingest
+        lock. Only the direct-fold path defers (mesh and plane-staging
+        paths are host-cheap); returns None when nothing was deferred."""
         h, s, c, g, _st, _others, _ssf_fb = raw
+        deferred = None
         if h is not None and len(h[0]):
             if self._mesh_pool is not None:
                 self._mesh_pool.add_samples_bulk(*h)
@@ -783,12 +805,15 @@ class DeviceWorker:
                     # in flight was most of the RSS in the overload
                     # soak. Bounded chunks × the in-flight window keeps
                     # drain memory O(chunk), not O(backlog).
-                    rows, vals, wts = h
-                    chunk = _FOLD_CHUNK
-                    for i in range(0, len(rows), chunk):
-                        self._fold_batch_direct(
-                            rows[i:i + chunk], vals[i:i + chunk],
-                            wts[i:i + chunk])
+                    if defer_histo_spill:
+                        deferred = h
+                    else:
+                        rows, vals, wts = h
+                        chunk = _FOLD_CHUNK
+                        for i in range(0, len(rows), chunk):
+                            self._fold_batch_direct(
+                                rows[i:i + chunk], vals[i:i + chunk],
+                                wts[i:i + chunk])
                 else:
                     self._device_histo_step(*h)
         if s is not None and len(s[0]):
@@ -804,6 +829,7 @@ class DeviceWorker:
             pool = self.scalars.gauges
             pool.values[rows] = vals  # in-order: last write wins
             pool.present[rows] = True
+        return deferred
 
     # -- epoch lifecycle ----------------------------------------------------
 
@@ -1132,6 +1158,34 @@ class DeviceWorker:
             h.means.block_until_ready()
             self._inflight_folds = 0
 
+    def _fold_spill_chunk(self, fields: tuple, rows: np.ndarray,
+                          vals: np.ndarray, wts: np.ndarray,
+                          pool_rows: int) -> tuple:
+        """_fold_batch_direct's twin for a SWAPPED epoch: folds one spill
+        chunk into the detached full-pool `fields` tuple instead of the
+        live self._histo — same shapes, same jit specialization, so the
+        compile _fold_batch_direct paid mid-interval is reused here.
+        Runs in extract_snapshot, off the ingest lock. Padding entries
+        carry weight 0, which the ingest step treats as absent (same
+        invariant _fold_batch_direct relies on for its scratch row)."""
+        uniq, inverse = np.unique(rows, return_inverse=True)
+        scratch = pool_rows - 1
+        k = _next_pow2(len(uniq), 64)
+        n = _next_pow2(len(vals), 256)
+        active = np.full(k, scratch, dtype=np.int32)
+        active[: len(uniq)] = uniq
+        lids = np.full(n, k - 1, dtype=np.int32)
+        lids[: len(vals)] = inverse
+        v = np.zeros(n, dtype=np.float32)
+        v[: len(vals)] = vals
+        w = np.zeros(n, dtype=np.float32)
+        w[: len(vals)] = wts
+        return _histo_ingest_step(
+            *fields,
+            jnp.asarray(active), jnp.asarray(lids), jnp.asarray(v),
+            jnp.asarray(w), compression=self.compression,
+        )
+
     def _flush_pending_sets(self) -> None:
         if not self._ps_rows:
             return
@@ -1399,6 +1453,7 @@ class DeviceWorker:
         takes it.
         """
         native_stage = None
+        spill_histo = None
         if self._native is not None:
             # drain, detach the staging plane, and close the native epoch
             # under one lock hold: a routed commit can otherwise land
@@ -1420,7 +1475,23 @@ class DeviceWorker:
                 self._native_epoch_closed = True
             finally:
                 self._native.unlock()
-            self._apply_native_raw(raw)
+            spill_histo = self._apply_native_raw(raw,
+                                                 defer_histo_spill=True)
+            if spill_histo is not None:
+                # bound the fold work this flush inherits: backlog past
+                # what the measured fold rate can absorb in the budget
+                # sheds here (newest samples kept — freshest values win),
+                # counted like every other overload drop. Without this a
+                # starved host hands a 40s+ backlog to every flush and
+                # the cadence collapses (round-5 overload measurement).
+                budget = max(_FOLD_CHUNK,
+                             int(self._fold_rate_ewma * self.fold_budget_s))
+                total = len(spill_histo[0])
+                if total > budget:
+                    shed = total - budget
+                    self.overload_dropped += shed
+                    self.overload_dropped_total += shed
+                    spill_histo = tuple(a[-budget:] for a in spill_histo)
             if native_stage is not None and self._mesh_pool is not None:
                 # samples staged before attach_mesh_pool() disabled
                 # staging belong to the mesh shards, not the local fold
@@ -1475,6 +1546,7 @@ class DeviceWorker:
             histo=self._histo, sets=self._sets,
             staged_sets=self._staged_sets, umts=self._umts,
             mesh_out=mesh_out, staged_histo=staged_histo,
+            spill_histo=spill_histo,
         )
         self.processed = 0
         self.imported = 0
@@ -1550,13 +1622,43 @@ class DeviceWorker:
             # oversized from power-of-two growth, and both programs' cost
             # is linear in rows. Pow2 bucketing bounds compile variants.
             s_eff = min(histo.num_rows, _next_pow2(n, 1024))
+            full = (histo.means, histo.weights, histo.dmin,
+                    histo.dmax, histo.drecip, histo.drecip_c,
+                    histo.lmin, histo.lmax, histo.lsum, histo.lsum_c,
+                    histo.lweight, histo.lweight_c, histo.lrecip,
+                    histo.lrecip_c)
+            spill = swapped.spill_histo
+            swapped.spill_histo = None
+            if spill is not None:
+                # hot-row spill backlog deferred by swap(): chunked fold
+                # off the ingest lock (plain numpy from drain_histo — no
+                # native memory to free). Folded at the FULL pool shape —
+                # the exact jit specialization _fold_batch_direct keeps
+                # warm all interval — because a fresh s_eff-shaped
+                # compile on a starved host stalls the flush for longer
+                # than the fold itself (observed: 40s+ XLA compile under
+                # 33x overload). Timed: the measured rate sizes the NEXT
+                # swap's fold budget (closed-loop shedding).
+                sp_rows, sp_vals, sp_wts = spill
+                t_fold = time.perf_counter()
+                inflight = 0
+                for i in range(0, len(sp_rows), _FOLD_CHUNK):
+                    full = self._fold_spill_chunk(
+                        full, sp_rows[i:i + _FOLD_CHUNK],
+                        sp_vals[i:i + _FOLD_CHUNK],
+                        sp_wts[i:i + _FOLD_CHUNK], histo.num_rows)
+                    inflight += 1
+                    if inflight >= 8:  # bound the dispatch queue's memory
+                        full[0].block_until_ready()
+                        inflight = 0
+                full[0].block_until_ready()
+                t_fold = time.perf_counter() - t_fold
+                if t_fold > 0.01:
+                    rate = len(sp_rows) / t_fold
+                    self._fold_rate_ewma = (
+                        0.5 * self._fold_rate_ewma + 0.5 * rate)
             fields = tuple(
-                a if a.shape[0] == s_eff else a[:s_eff]
-                for a in (histo.means, histo.weights, histo.dmin,
-                          histo.dmax, histo.drecip, histo.drecip_c,
-                          histo.lmin, histo.lmax, histo.lsum, histo.lsum_c,
-                          histo.lweight, histo.lweight_c, histo.lrecip,
-                          histo.lrecip_c))
+                a if a.shape[0] == s_eff else a[:s_eff] for a in full)
             pending = list(swapped.staged_histo or ())
             swapped.staged_histo = None
             try:
