@@ -174,10 +174,12 @@ def _envint(name: str, default: int, cpu_default: int | None = None) -> int:
 
 
 def _normalize_backend(name: str) -> str:
-    """The tunnelled chip registers as the experimental 'axon' PJRT
-    plugin but IS the real TPU (v5e) — one place to say so, used by the
-    roofline peak pick, the platform field, and the capture probe."""
-    return "tpu" if name in ("tpu", "axon") else name
+    """Rig-name collapse, delegated to the product's single adapter
+    (veneur_tpu.utils.backend) — used by the roofline peak pick, the
+    platform field, and the capture probe."""
+    from veneur_tpu.utils.backend import normalize_backend
+
+    return normalize_backend(name)
 
 
 def _nbytes(tree) -> int:

@@ -55,7 +55,9 @@ def _backend() -> str:
     # the tunnelled chip may register as the experimental "axon"
     # plugin but IS the real TPU; normalize so sizes and the
     # artifact platform field treat it as one
-    return "tpu" if backend in ("tpu", "axon") else backend
+    from veneur_tpu.utils.backend import normalize_backend
+
+    return normalize_backend(backend)
 
 
 def run_one(series: int, per: int) -> dict:

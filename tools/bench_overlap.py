@@ -152,7 +152,9 @@ def main() -> None:
     import jax
 
     backend = jax.default_backend()
-    backend = "tpu" if backend in ("tpu", "axon") else backend
+    from veneur_tpu.utils.backend import normalize_backend
+
+    backend = normalize_backend(backend)
     on_cpu = backend == "cpu"
     series = int(os.environ.get(
         "VENEUR_OVERLAP_SERIES", 1 << 16 if on_cpu else 1 << 20))

@@ -81,7 +81,9 @@ def main() -> None:
     from veneur_tpu.ops import pallas_kernels as pk
 
     backend = jax.default_backend()
-    backend = "tpu" if backend in ("tpu", "axon") else backend
+    from veneur_tpu.utils.backend import normalize_backend
+
+    backend = normalize_backend(backend)
     on_tpu = backend == "tpu"
     series = int(os.environ.get("VENEUR_AB_SERIES",
                                 1 << 20 if on_tpu else 1 << 14))

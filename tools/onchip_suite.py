@@ -77,7 +77,9 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     plat = jax.devices()[0].platform
-    plat = "tpu" if plat in ("tpu", "axon") else plat
+    from veneur_tpu.utils.backend import normalize_backend
+
+    plat = normalize_backend(plat)
     emit({"event": "backend_live", "platform": plat,
           "device": str(jax.devices()[0])})
     if plat != "tpu" and not os.environ.get("VENEUR_SUITE_FORCE"):

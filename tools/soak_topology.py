@@ -20,6 +20,14 @@ Writes TOPOLOGY_SOAK.json at the repo root and prints one JSON line.
 
 Env knobs: VENEUR_SOAK_INTERVALS (default 30), VENEUR_SOAK_HISTO_SERIES
 (default 1500), VENEUR_SOAK_COUNTER_SERIES (default 500).
+
+VENEUR_SOAK_MESH=1 (VERDICT r4 item 7): the global tier runs
+mesh-sharded — each global Server gets `tpu_mesh_devices: 8` over a
+virtual 8-device CPU mesh (xla_force_host_platform_device_count), so
+the imported digests merge through the shard_map collective path
+(distributed/mesh.py build_sharded_staged_fold) instead of the
+single-device pools, under the same ring churn and with the same exact
+conservation criterion. The artifact records `mesh_global: true`.
 """
 
 from __future__ import annotations
@@ -36,6 +44,28 @@ from _soak_common import rss_mb, write_artifact  # noqa: E402
 
 
 def main() -> None:
+    mesh_global = os.environ.get("VENEUR_SOAK_MESH") == "1"
+    if mesh_global and os.environ.get("_VENEUR_SOAK_REEXEC") != "1":
+        # the mesh globals shard over 8 virtual CPU devices, the same
+        # rig the multichip dryrun uses. This MUST be a re-exec with a
+        # scrubbed environment, not in-process env edits: the dev rig's
+        # site hook registers the (single-client, wedging) axon relay
+        # plugin at interpreter startup, before main() runs — verified
+        # in round 5 that popping PALLAS_AXON_POOL_IPS here still
+        # initializes axon and hangs. A fresh interpreter without the
+        # pool var never registers it (TPU_BACKEND.md recipe).
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count=8")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["_VENEUR_SOAK_REEXEC"] = "1"
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                  env)
+
     from veneur_tpu.core.config import Config
     from veneur_tpu.core.flusher import device_quantiles, \
         generate_inter_metrics
@@ -56,8 +86,15 @@ def main() -> None:
 
     globals_ = []
     for _ in range(3):
-        cfg = Config(interval="10s", percentiles=pcts, aggregates=aggs,
-                     num_workers=2)
+        if mesh_global:
+            # mesh sharding requires one worker (the mesh IS the
+            # parallelism; config.py validation)
+            cfg = Config(interval="10s", percentiles=pcts,
+                         aggregates=aggs, num_workers=1,
+                         tpu_mesh_devices=8)
+        else:
+            cfg = Config(interval="10s", percentiles=pcts,
+                         aggregates=aggs, num_workers=2)
         srv = Server(cfg)
         imp = ImportServer(srv)
         port = imp.start_grpc()
@@ -155,6 +192,7 @@ def main() -> None:
     wall_s = time.perf_counter() - t_start
 
     out = {
+        "mesh_global": mesh_global,
         "intervals": intervals,
         "histo_series": s_histo,
         "counter_series": s_counter,
@@ -181,7 +219,8 @@ def main() -> None:
         imp.stop()
         srv.shutdown()
 
-    write_artifact("TOPOLOGY_SOAK.json", out)
+    write_artifact("TOPOLOGY_SOAK_MESH.json" if mesh_global
+                   else "TOPOLOGY_SOAK.json", out)
     print(json.dumps({"metric": "topology_soak_conservation",
                       "value": 1.0 if out["conservation_ok"] else 0.0,
                       "unit": "bool",

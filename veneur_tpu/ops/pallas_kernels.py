@@ -143,8 +143,9 @@ def flush_extract_reference(means, weights, dmin, dmax, qs):
 
 
 def supported() -> bool:
-    # the tunnelled chip may register under its experimental plugin name
-    # ("axon") while being a real TPU; if Pallas lowering nevertheless
-    # fails there, DeviceWorker._extract demotes to the XLA path and
-    # counts it in veneur.flush.pallas_fallback_total
-    return jax.default_backend() in ("tpu", "axon")
+    # if Pallas lowering fails on a real TPU, DeviceWorker._extract
+    # demotes to the XLA path and counts it in
+    # veneur.flush.pallas_fallback_total
+    from veneur_tpu.utils.backend import is_tpu_backend
+
+    return is_tpu_backend()

@@ -471,9 +471,10 @@ def quantile(
     slot-selection strategy is backend-dependent (gather on hosts,
     select+reduce on TPU) with bit-identical results.
     """
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    from veneur_tpu.utils.backend import is_tpu_backend
+
     return _quantile_impl(means, weights, dmin, dmax, qs,
-                          use_gather=not on_tpu)
+                          use_gather=not is_tpu_backend())
 
 
 @jax.jit

@@ -1,0 +1,28 @@
+"""Device-backend identification — the ONE place rig-specific backend
+names are known.
+
+The dev rig's tunnelled TPU registers as the experimental "axon" PJRT
+plugin while being a real TPU (v5e); production TPUs register as
+"tpu". Product code asks :func:`is_tpu_backend` / uses
+:func:`normalize_backend` and never names the rig (round-5 cleanup:
+dev-rig leakage quarantined behind this adapter).
+"""
+
+from __future__ import annotations
+
+_TPU_BACKEND_NAMES = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend is a real TPU (under any
+    registration name)."""
+    import jax
+
+    return jax.default_backend() in _TPU_BACKEND_NAMES
+
+
+def normalize_backend(name: str) -> str:
+    """Collapse rig-specific registration names to the hardware truth
+    ("axon" IS a TPU); used by benches for the `platform` field and the
+    roofline peak pick."""
+    return "tpu" if name in _TPU_BACKEND_NAMES else name
