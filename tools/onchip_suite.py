@@ -90,24 +90,32 @@ def main() -> None:
         emit({"event": "suite_done", "skipped": f"platform={plat}"})
         return
 
-    # 1. the five BASELINE workloads, streamed by bench.py's all-mode
+    # Stage order = value-per-minute of a window that may close any
+    # time (round 4's closed mid pallas_ab; VERDICT r5 ranks the 1M
+    # fits_interval proof as the round's single deliverable):
+    #   1. relay_link    seconds, characterizes the link
+    #   2. e2e_flush     THE deliverable (post-readback-fix 1M flush)
+    #   3. pallas_ab     the open kernel question, still never run hot
+    #   4. bench_all     five BASELINE workloads incl. prometheus_1m
+    #   5. scaling/overlap/profile
+    # Aux artifacts always refresh on a live window — an on-chip
+    # artifact from an older code state is a staleness trap (the first
+    # window captured E2E_FLUSH with the pre-fix 105s readback extract;
+    # a skip-if-on-tpu gate would have pinned that number forever).
+    # profile_ingest alone is capture-once.
+    run_stage("relay_link", lambda: run_tool("probe_relay_link.py"))
+    run_stage("e2e_flush", lambda: run_tool("bench_e2e_flush.py"))
+    run_stage("pallas_ab", lambda: run_tool("bench_pallas_ab.py"))
+
     os.environ["VENEUR_BENCH_WORKLOAD"] = "all"
     os.environ["_VENEUR_BENCH_CHILD"] = "1"
     import bench
 
     run_stage("bench_all", bench.main)
 
-    # 2. auxiliary artifacts. Always refreshed on a live window — an
-    # on-chip artifact from an older code state is a staleness trap
-    # (the first window captured E2E_FLUSH with the pre-fix 105s
-    # readback extract; the skip-if-on-tpu gate would have pinned that
-    # number forever). profile_ingest alone is capture-once.
-    run_stage("relay_link", lambda: run_tool("probe_relay_link.py"))
-    run_stage("e2e_flush", lambda: run_tool("bench_e2e_flush.py"))
     run_stage("e2e_scaling",
               lambda: run_tool("bench_e2e_flush.py", ["--scaling"]))
     run_stage("overlap", lambda: run_tool("bench_overlap.py"))
-    run_stage("pallas_ab", lambda: run_tool("bench_pallas_ab.py"))
     prof = os.path.join(REPO, "PROFILE_INGEST_TPU.txt")
     if not os.path.exists(prof):
         def _profile():
