@@ -169,6 +169,34 @@ def _unit_wts_plane(counts, depth: int):
             < counts[:, None]).astype(jnp.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("depth", "unit"))
+def _expand_flat_planes(flat_v, flat_w, counts, depth: int, unit: bool):
+    """Rebuild the dense [S, depth] value+weight staging planes on
+    DEVICE from their row-major compacted form (filled slots only) +
+    per-row counts, in ONE dispatch sharing the offset/validity index.
+
+    The dense plane is O(S × depth) bytes regardless of fill — at 1M
+    series × depth 64 that is a 268 MB host→device transfer for ~17 MB
+    of actual samples, and on a transfer-bound link (the dev rig's
+    ~11 MB/s relay) the dense upload alone blows the 10s flush budget.
+    Uploading the compacted samples + counts and paying one gather here
+    makes the transfer O(samples), like the readback diet did for the
+    extract direction. unit=True ignores flat_w (pass flat_v; XLA DCEs
+    it) and uses the validity mask as the weights plane."""
+    b = jnp.arange(depth, dtype=jnp.int32)[None, :]
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(counts, dtype=jnp.int32)[:-1]])
+    idx = jnp.clip(offsets[:, None] + b, 0, flat_v.shape[0] - 1)
+    valid = b < counts[:, None]
+    sv = jnp.where(valid, flat_v[idx], jnp.float32(0))
+    if unit:
+        sw = valid.astype(jnp.float32)
+    else:
+        sw = jnp.where(valid, flat_w[idx], jnp.float32(0))
+    return sv, sw
+
+
 @functools.partial(jax.jit, static_argnames=("compression",),
                    donate_argnums=tuple(range(14)))
 def _histo_fold_staged(
@@ -1559,42 +1587,57 @@ class DeviceWorker:
         digest fields, and pop it. The caller owns cleanup of whatever is
         left in `pending` on failure."""
         plane: StagedPlane = pending[0]
-        swj = None
         if plane.free is not None:
-            # the numpy views alias C++ plane memory. copy=True is
-            # load-bearing: on the CPU backend device_put ZERO-COPIES
-            # aligned numpy arrays, so freeing the plane under an
-            # aliasing buffer is a use-after-free (bitten in round 4 —
-            # garbage quantiles under heap churn).
-            svj = jnp.array(plane.vals[:s_eff], copy=True)
-            if plane.wts is None:
-                # unit weights: upload the tiny counts vector and rebuild
-                # the plane on device — halves the host->device bytes of
-                # the flush
-                cj = jnp.array(plane.counts[:s_eff], copy=True)
-                svj.block_until_ready()
-                cj.block_until_ready()
+            # native C++ plane: COMPACT before upload. The dense
+            # [rows, B] plane is O(S×B) bytes regardless of fill; the
+            # filled slots are O(samples). Host-side fancy indexing
+            # copies them out of the C++ memory (so `free` is safe
+            # immediately after the tiny uploads land), the device
+            # rebuilds the dense plane from flat + counts
+            # (_expand_flat_plane), and the host→device transfer drops
+            # from 268 MB to ~17 MB at 1M series × depth 64 × 4
+            # samples/series — the difference between blowing and
+            # fitting the 10s budget on a transfer-bound link.
+            B = plane.vals.shape[1]
+            rows_avail = min(plane.vals.shape[0], s_eff)
+            counts_np = np.minimum(plane.counts[:rows_avail],
+                                   B).astype(np.int32)
+            mask = (np.arange(B, dtype=np.int32)[None, :]
+                    < counts_np[:, None])
+            flat_v = plane.vals[:rows_avail][mask]  # copies out of C++
+            if rows_avail < s_eff:
+                # the native plane grows by its own pow2 schedule and
+                # can trail the pool's; rows past its end are empty
+                counts_np = np.pad(counts_np, (0, s_eff - rows_avail))
+            n_pad = _next_pow2(max(len(flat_v), 1), 1024)
+            fv = np.zeros(n_pad, np.float32)
+            fv[:len(flat_v)] = flat_v
+            # fv/fw/counts_np are Python-owned copies (fancy indexing /
+            # np.minimum / np.pad) — nothing below aliases the C++
+            # plane, so free() needs no upload synchronization
+            fvj = jnp.asarray(fv)
+            cj = jnp.asarray(counts_np)
+            unit = plane.wts is None
+            if unit:
+                fwj = fvj  # ignored under unit=True (XLA DCEs it)
             else:
-                swj = jnp.array(plane.wts[:s_eff], copy=True)
-                svj.block_until_ready()
-                swj.block_until_ready()
+                flat_w = plane.wts[:rows_avail][mask]
+                fw = np.zeros(n_pad, np.float32)
+                fw[:len(flat_w)] = flat_w
+                fwj = jnp.asarray(fw)
             plane.free()
             # freed: the caller's cleanup must not free it again
             pending[0] = plane._replace(free=None)
-            if swj is None:
-                swj = _unit_wts_plane(cj, plane.vals.shape[1])
+            svj, swj = _expand_flat_planes(fvj, fwj, cj, B, unit)
         else:
             svj = jnp.asarray(plane.vals[:s_eff])
             swj = jnp.asarray(plane.wts[:s_eff])
-        if svj.shape[0] < s_eff:
-            # the native plane grows by its own pow2 schedule and can
-            # trail the pool's: pad on device (rows past the plane's end
-            # hold no staged data by construction)
-            pad = s_eff - svj.shape[0]
-            svj = jnp.concatenate(
-                [svj, jnp.zeros((pad, svj.shape[1]), jnp.float32)])
-            swj = jnp.concatenate(
-                [swj, jnp.zeros((pad, swj.shape[1]), jnp.float32)])
+            if svj.shape[0] < s_eff:
+                pad = s_eff - svj.shape[0]
+                svj = jnp.concatenate(
+                    [svj, jnp.zeros((pad, svj.shape[1]), jnp.float32)])
+                swj = jnp.concatenate(
+                    [swj, jnp.zeros((pad, swj.shape[1]), jnp.float32)])
         fields = _histo_fold_staged(
             *fields, svj, swj, compression=self.compression)
         pending.pop(0)
