@@ -543,6 +543,31 @@ def test_adaptive_spill_cap_controller():
         srv.shutdown()
 
 
+def test_native_staged_weighted_flat_upload_exact():
+    """A sampled (@rate) timer makes the staging plane non-unit: the
+    flush's compacted upload must carry the weights flat array and the
+    device rebuild must place every weight at its value's slot
+    (count = sum of weights, reference rate correction)."""
+    import pytest
+
+    w = DeviceWorker(stage_depth=8, batch_size=1 << 20)
+    if not w.attach_native():
+        pytest.skip("native lib unavailable")
+    w.ingest_datagram(b"wf.t:10|ms")
+    w.ingest_datagram(b"wf.t:20|ms|@0.5")   # weight 2
+    w.ingest_datagram(b"wf.t:30|ms|@0.25")  # weight 4
+    w.ingest_datagram(b"wf.u:5|ms")         # second row, unit
+    qs = device_quantiles([0.5], AGGS)
+    snap = w.flush(qs, interval_s=10.0)
+    by = {}
+    for m in generate_inter_metrics(snap, False, [0.5], AGGS):
+        by[(m.name, m.type)] = m.value
+    assert by[("wf.t.count", MetricType.COUNTER)] == 7.0  # 1+2+4
+    assert by[("wf.t.min", MetricType.GAUGE)] == 10.0
+    assert by[("wf.t.max", MetricType.GAUGE)] == 30.0
+    assert by[("wf.u.count", MetricType.COUNTER)] == 1.0
+
+
 def test_staged_matches_direct_fold():
     """The staged path and the per-batch direct device fold agree exactly
     on scalar aggregates and closely on quantiles."""
