@@ -219,8 +219,79 @@ def fuzz_gob(rng, t_end) -> int:
     return n
 
 
+def fuzz_ssf_stream(rng, t_end) -> int:
+    """Framed-stream reader invariants (round-5 semantics: an
+    unmarshalable payload inside a well-formed frame is RECOVERABLE —
+    reference ReadSSFStreamSocket continues on non-framing errors):
+
+      1. SSFUnmarshalError must consume exactly its frame: a valid
+         frame appended after a bad-payload frame always decodes.
+      2. Any byte stream terminates in bounded reads with FramingError,
+         SSFUnmarshalError, clean EOF (None), or decoded spans — no
+         other exception, no infinite loop.
+    """
+    import io
+    import struct
+
+    from test_native import _make_span_bytes
+    from veneur_tpu.protocol import ssf_wire
+
+    good_payload = _make_span_bytes(
+        trace_id=7, id=8, start_timestamp=1, end_timestamp=2,
+        service="fz", name="op")
+    good_frame = struct.pack(">BI", 0, len(good_payload)) + good_payload
+    n = 0
+    while time.time() < t_end:
+        for _ in range(2000):
+            roll = rng.random()
+            if roll < 0.5:
+                # bad payload in a well-formed frame + a good frame:
+                # the recoverability property
+                bad = rng.randbytes(rng.randrange(0, 64))
+                stream = (struct.pack(">BI", 0, len(bad)) + bad
+                          + good_frame)
+                f = io.BytesIO(stream)
+                try:
+                    first = ssf_wire.read_ssf(f)
+                    first_ok = True
+                except ssf_wire.SSFUnmarshalError:
+                    first_ok = False
+                except ssf_wire.FramingError:
+                    print("ssf_stream DIVERGE: well-formed frame raised "
+                          f"non-recoverable FramingError: {bad!r}")
+                    return -1
+                span = ssf_wire.read_ssf(f)
+                if span is None or span.service != "fz":
+                    print(f"ssf_stream DIVERGE: good frame lost after "
+                          f"{'decoded' if first_ok else 'unmarshal-err'} "
+                          f"frame: {bad!r}")
+                    return -1
+            else:
+                # arbitrary bytes: bounded reads, bounded error surface
+                base = bytearray(good_frame * rng.randrange(1, 3))
+                for _ in range(rng.randrange(1, 6)):
+                    base[rng.randrange(len(base))] = rng.randrange(256)
+                f = io.BytesIO(bytes(base))
+                for _ in range(8):  # > frames in the stream
+                    try:
+                        if ssf_wire.read_ssf(f) is None:
+                            break
+                    except ssf_wire.FramingError:
+                        break  # SSFUnmarshalError subclasses it: both ok
+                    except Exception as e:
+                        print(f"ssf_stream CRASH {type(e).__name__}: {e} "
+                              f"on {bytes(base)!r}")
+                        return -1
+                else:
+                    print(f"ssf_stream UNBOUNDED on {bytes(base)!r}")
+                    return -1
+            n += 1
+    return n
+
+
 TARGETS = {"dogstatsd": fuzz_dogstatsd, "ssf": fuzz_ssf,
-           "metricpb": fuzz_metricpb, "gob": fuzz_gob}
+           "metricpb": fuzz_metricpb, "gob": fuzz_gob,
+           "ssf_stream": fuzz_ssf_stream}
 
 
 def _git_rev() -> str:
@@ -273,7 +344,8 @@ def main() -> None:
     ap.add_argument("--seconds", type=float, default=30.0,
                     help="budget per target")
     ap.add_argument("--seed", type=int, default=None)
-    ap.add_argument("--targets", default="dogstatsd,ssf,metricpb,gob")
+    ap.add_argument("--targets",
+                    default="dogstatsd,ssf,metricpb,gob,ssf_stream")
     ap.add_argument("--tally", default=None, metavar="PATH",
                     help="accumulate results into this JSON artifact")
     ap.add_argument("--rounds", type=int, default=1,
