@@ -185,6 +185,40 @@ def all_captured(fresh: set) -> bool:
     return os.path.exists(os.path.join(REPO, "PROFILE_INGEST_TPU.txt"))
 
 
+def _local_listeners() -> set:
+    """Ports with a listener on this host (/proc/net/tcp{,6} state 0A).
+    The relay tunnel serves on loopback (PALLAS_AXON_POOL_IPS is
+    127.0.0.1, and while wedged its ports connection-refuse — round-5
+    diagnosis): a NEW listener appearing is the cheapest possible
+    window signal, so the wait loop polls this instead of sleeping
+    blind and probes the instant anything opens."""
+    ports = set()
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as f:
+                next(f)
+                for line in f:
+                    parts = line.split()
+                    if parts[3] == "0A":  # LISTEN
+                        ports.add(int(parts[1].rsplit(":", 1)[1], 16))
+        except (OSError, ValueError, IndexError):
+            pass
+    return ports
+
+
+def _wait_or_new_listener(seconds: float, baseline: set) -> None:
+    """Sleep up to `seconds`, returning early if a port not in
+    `baseline` starts listening (a possible relay revival)."""
+    end = time.time() + seconds
+    while time.time() < end:
+        time.sleep(min(10.0, max(0.0, end - time.time())))
+        new = _local_listeners() - baseline
+        if new:
+            print(f"capture: new local listener(s) {sorted(new)} — "
+                  "probing early", file=sys.stderr)
+            return
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--once", action="store_true",
@@ -217,7 +251,9 @@ def main() -> None:
                   f"{args.interval:.0f}s", file=sys.stderr)
         if args.once:
             return
-        time.sleep(args.interval)
+        # baseline refreshed each cycle: my own transient listeners
+        # (test servers etc.) age into it instead of re-triggering
+        _wait_or_new_listener(args.interval, _local_listeners())
 
 
 if __name__ == "__main__":
