@@ -1603,7 +1603,7 @@ class DeviceWorker:
             # copies them out of the C++ memory (so `free` is safe
             # immediately after the tiny uploads land), the device
             # rebuilds the dense plane from flat + counts
-            # (_expand_flat_plane), and the host→device transfer drops
+            # (_expand_flat_planes), and the host→device transfer drops
             # from 268 MB to ~17 MB at 1M series × depth 64 × 4
             # samples/series — the difference between blowing and
             # fitting the 10s budget on a transfer-bound link.
