@@ -42,6 +42,20 @@ from veneur_tpu.utils.proc import current_rss_bytes as _current_rss_bytes
 
 log = logging.getLogger("veneur_tpu.server")
 
+# ssf.error_total tag sets, verbatim from the reference
+# (server.go:1052-1072, 1238-1246); one definition so the five emit
+# sites cannot drift from dashboard parity
+_SSF_ERR_ZEROLENGTH = ["ssf_format:packet", "packet_type:unknown",
+                       "reason:zerolength"]
+_SSF_ERR_UNMARSHAL = ["ssf_format:packet", "packet_type:ssf_metric",
+                      "reason:unmarshal"]
+_SSF_ERR_EMPTY_ID = ["ssf_format:packet", "packet_type:ssf_metric",
+                     "reason:empty_id"]
+_SSF_ERR_PROCESSING = ["ssf_format:framed", "packet_type:unknown",
+                       "reason:processing"]
+_SSF_ERR_FRAMING = ["ssf_format:framed", "packet_type:unknown",
+                    "reason:framing"]
+
 
 class EventWorker:
     """Accumulates DogStatsD events (as SSF samples) until flush
@@ -437,9 +451,7 @@ class Server:
             self._bump_errors()
             # reference tag set verbatim (server.go:1052)
             self.stats.count("ssf.error_total", 1,
-                             tags=["ssf_format:packet",
-                                   "packet_type:unknown",
-                                   "reason:zerolength"])
+                             tags=_SSF_ERR_ZEROLENGTH)
             return
         if self._native_ssf:
             # native decode + span→metric extraction in one C++ pass;
@@ -454,27 +466,21 @@ class Server:
             if rc == 0:
                 self._bump_errors()
                 self.stats.count("ssf.error_total", 1,
-                                 tags=["ssf_format:packet",
-                                       "packet_type:ssf_metric",
-                                       "reason:unmarshal"])
+                                 tags=_SSF_ERR_UNMARSHAL)
                 return
         try:
             span = ssf_wire.parse_ssf(packet)
         except ssf_wire.FramingError as e:
             self._bump_errors()
             self.stats.count("ssf.error_total", 1,
-                             tags=["ssf_format:packet",
-                                   "packet_type:ssf_metric",
-                                   "reason:unmarshal"])
+                             tags=_SSF_ERR_UNMARSHAL)
             log.debug("bad SSF packet: %s", e)
             return
         if span.id == 0:
             # client problem, counted but the span is still handled
             # (reference server.go:1067-1072)
             self.stats.count("ssf.error_total", 1,
-                             tags=["ssf_format:packet",
-                                   "packet_type:ssf_metric",
-                                   "reason:empty_id"])
+                             tags=_SSF_ERR_EMPTY_ID)
             log.debug("trace packet has zero span id")
         self.handle_ssf(span)
 
@@ -500,26 +506,20 @@ class Server:
         self._bump_errors(errs)
         if errs:
             self.stats.count("ssf.error_total", errs,
-                             tags=["ssf_format:packet",
-                                   "packet_type:ssf_metric",
-                                   "reason:unmarshal"])
+                             tags=_SSF_ERR_UNMARSHAL)
         for pkt in fallbacks:
             try:
                 span = ssf_wire.parse_ssf(pkt)
             except ssf_wire.FramingError as e:
                 self._bump_errors()
                 self.stats.count("ssf.error_total", 1,
-                                 tags=["ssf_format:packet",
-                                       "packet_type:ssf_metric",
-                                       "reason:unmarshal"])
+                                 tags=_SSF_ERR_UNMARSHAL)
                 log.debug("bad SSF packet: %s", e)
                 continue
             if span.id == 0:
                 # same client-problem counter as the single-packet path
                 self.stats.count("ssf.error_total", 1,
-                                 tags=["ssf_format:packet",
-                                       "packet_type:ssf_metric",
-                                       "reason:empty_id"])
+                                 tags=_SSF_ERR_EMPTY_ID)
             self.handle_ssf(span)
 
     def handle_ssf(self, span) -> None:
@@ -630,9 +630,7 @@ class Server:
                     # non-framing errors, server.go:1243-1248)
                     self._bump_errors()
                     self.stats.count("ssf.error_total", 1,
-                                     tags=["ssf_format:framed",
-                                           "packet_type:unknown",
-                                           "reason:processing"])
+                                     tags=_SSF_ERR_PROCESSING)
                     log.debug("bad SSF frame payload: %s", e)
                     continue
                 if span is None:
@@ -647,9 +645,7 @@ class Server:
             # server.go:1234-1241)
             self._bump_errors()
             self.stats.count("ssf.error_total", 1,
-                             tags=["ssf_format:framed",
-                                   "packet_type:unknown",
-                                   "reason:framing"])
+                             tags=_SSF_ERR_FRAMING)
             log.debug("SSF stream framing error, closing: %s", e)
         except OSError:
             pass
@@ -1268,10 +1264,9 @@ class Server:
         self.stats.gauge("ingest.spill_cap", new)
         for w in self.workers:
             w.spill_cap = new
-            native = getattr(w, "_native", None)
-            if native is not None:
+            if w._native is not None:
                 try:
-                    native.set_spill_cap(new)
+                    w._native.set_spill_cap(new)
                 except AttributeError:  # stale .so without the cap API
                     pass
 

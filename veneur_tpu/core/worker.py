@@ -1153,14 +1153,14 @@ class DeviceWorker:
         spill = ~keep
         self._fold_batch_direct(srows[spill], svals[spill], swts[spill])
 
-    def _fold_batch_direct(self, rows: np.ndarray, vals: np.ndarray,
-                           wts: np.ndarray) -> None:
-        """Gather→add_batch→scatter device fold of one sample batch — the
-        spill path for rows whose staging plane is full."""
-        h = self._histo
-        assert h is not None
+    @staticmethod
+    def _pad_spill_batch(rows: np.ndarray, vals: np.ndarray,
+                         wts: np.ndarray, scratch: int):
+        """Pow2-pad one spill batch for the ingest step: padding sample
+        slots point at `scratch` with weight 0, which the step treats
+        as absent. Shared by the live-pool and swapped-epoch folds so
+        their jit shapes (and semantics) cannot drift."""
         uniq, inverse = np.unique(rows, return_inverse=True)
-        scratch = h.num_rows - 1
         k = _next_pow2(len(uniq), 64)
         n = _next_pow2(len(vals), 256)
         active = np.full(k, scratch, dtype=np.int32)
@@ -1171,6 +1171,16 @@ class DeviceWorker:
         v[: len(vals)] = vals
         w = np.zeros(n, dtype=np.float32)
         w[: len(vals)] = wts
+        return active, lids, v, w
+
+    def _fold_batch_direct(self, rows: np.ndarray, vals: np.ndarray,
+                           wts: np.ndarray) -> None:
+        """Gather→add_batch→scatter device fold of one sample batch — the
+        spill path for rows whose staging plane is full."""
+        h = self._histo
+        assert h is not None
+        active, lids, v, w = self._pad_spill_batch(
+            rows, vals, wts, h.num_rows - 1)
 
         out = _histo_ingest_step(
             h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
@@ -1205,18 +1215,8 @@ class DeviceWorker:
         Runs in extract_snapshot, off the ingest lock. Padding entries
         carry weight 0, which the ingest step treats as absent (same
         invariant _fold_batch_direct relies on for its scratch row)."""
-        uniq, inverse = np.unique(rows, return_inverse=True)
-        scratch = pool_rows - 1
-        k = _next_pow2(len(uniq), 64)
-        n = _next_pow2(len(vals), 256)
-        active = np.full(k, scratch, dtype=np.int32)
-        active[: len(uniq)] = uniq
-        lids = np.full(n, k - 1, dtype=np.int32)
-        lids[: len(vals)] = inverse
-        v = np.zeros(n, dtype=np.float32)
-        v[: len(vals)] = vals
-        w = np.zeros(n, dtype=np.float32)
-        w[: len(vals)] = wts
+        active, lids, v, w = self._pad_spill_batch(
+            rows, vals, wts, pool_rows - 1)
         return _histo_ingest_step(
             *fields,
             jnp.asarray(active), jnp.asarray(lids), jnp.asarray(v),
