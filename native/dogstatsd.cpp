@@ -2807,8 +2807,30 @@ void json_number_append(std::string* out, double v) {
     return;
   }
   char buf[32];
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
   auto res = std::to_chars(buf, buf + sizeof buf, v);
   out->append(buf, static_cast<size_t>(res.ptr - buf));
+#else
+  // libstdc++ < 11 has no floating-point to_chars: emulate its
+  // shortest-CHARACTERS round-trip guarantee by scanning %g precisions
+  // and keeping the shortest string that reads back equal (minimal
+  // precision alone is wrong — %.1g renders 20.0 as "2e+01", while
+  // to_chars and the emitters' plain-int detection expect "20")
+  int best = -1;
+  char bestbuf[32];
+  for (int prec = 1; prec <= 17; ++prec) {
+    int n = snprintf(buf, sizeof buf, "%.*g", prec, v);
+    if (n > 0 && n < static_cast<int>(sizeof buf) &&
+        strtod(buf, nullptr) == v && (best < 0 || n < best)) {
+      best = n;
+      memcpy(bestbuf, buf, static_cast<size_t>(n));
+    }
+  }
+  if (best < 0) {
+    best = snprintf(bestbuf, sizeof bestbuf, "%.17g", v);
+  }
+  out->append(bestbuf, static_cast<size_t>(best));
+#endif
 }
 
 std::vector<std::string_view> split_us(std::string_view blob) {

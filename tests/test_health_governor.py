@@ -1,0 +1,283 @@
+"""Flush-deadline governor: chunk schedule invariants, the watchdog
+deferral contract, chunked-vs-single-shot extraction equivalence, and
+the server wiring (veneur_tpu/health/).
+
+The schedule invariants pinned here are the compile-variant budget:
+every chunk is a power of two with a floor, sizes move by at most 2x
+between chunks, and a pow2 row space is always covered exactly — so
+the set of distinct (pool shape, chunk size) XLA executables stays
+O(log rows) no matter how the rate EWMA moves.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import Config, validate_config
+from veneur_tpu.core.flusher import device_quantiles
+from veneur_tpu.core.metrics import HistogramAggregates
+from veneur_tpu.core.server import Server
+from veneur_tpu.core.worker import DeviceWorker
+from veneur_tpu.health import FlushDeadlineGovernor
+from veneur_tpu.health.governor import MIN_CHUNK_ROWS, _floor_pow2
+from veneur_tpu.health.policy import stall_window_s, watchdog_should_defer
+from veneur_tpu.protocol.dogstatsd import parse_metric
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+PCTS = [0.5, 0.9, 0.99]
+
+
+def _drive(gov: FlushDeadlineGovernor, total: int, rate_rows_s: float):
+    """Run one extraction schedule, faking each chunk's wall time from a
+    constant extraction rate. Returns the chunk sizes in order."""
+    run = gov.begin_extract(total)
+    sizes = []
+    while (c := run.next_rows()):
+        run.note(c, c / rate_rows_s)
+        sizes.append(c)
+    return sizes
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+# -- ChunkRun schedule invariants -----------------------------------------
+
+
+def test_floor_pow2():
+    assert _floor_pow2(1) == 1
+    assert _floor_pow2(1024) == 1024
+    assert _floor_pow2(1025) == 1024
+    assert _floor_pow2(65535) == 32768
+
+
+@pytest.mark.parametrize("total", [1024, 2048, 8192, 65536])
+@pytest.mark.parametrize("rate", [1e3, 1e5, 1e7])
+def test_pow2_totals_covered_exactly(total, rate):
+    gov = FlushDeadlineGovernor(chunk_target_ms=200, interval_s=10.0)
+    sizes = _drive(gov, total, rate)
+    assert sum(sizes) == total
+    assert all(_is_pow2(s) for s in sizes)
+    assert all(s >= min(MIN_CHUNK_ROWS, total) for s in sizes)
+    # at most double or halve between consecutive chunks
+    for a, b in zip(sizes, sizes[1:]):
+        assert b / a in (0.5, 1.0, 2.0)
+
+
+def test_first_ever_chunk_is_the_floor_probe():
+    gov = FlushDeadlineGovernor(chunk_target_ms=200, interval_s=10.0)
+    run = gov.begin_extract(65536)
+    assert run.next_rows() == MIN_CHUNK_ROWS  # no rate yet: probe
+
+
+def test_small_or_nonpow2_totals_degenerate_to_one_chunk():
+    gov = FlushDeadlineGovernor(chunk_target_ms=200, interval_s=10.0)
+    for total in (1, 512, MIN_CHUNK_ROWS, 3000, 65537):
+        sizes = _drive(gov, total, 1e5)
+        assert sizes == [total]
+    assert _drive(gov, 0, 1e5) == []
+
+
+def test_chunks_grow_toward_rate_target():
+    # 40960 rows/s at a 200ms target -> 8192-row chunks once warmed up
+    gov = FlushDeadlineGovernor(chunk_target_ms=200, interval_s=10.0)
+    sizes = _drive(gov, 65536, 40960.0)
+    assert sizes[0] == MIN_CHUNK_ROWS
+    assert max(sizes) == 8192
+    assert sizes == sorted(sizes)  # monotone ramp, never overshoots
+    assert sum(sizes) == 65536
+
+
+def test_chunks_shrink_on_mid_flush_slowdown():
+    gov = FlushDeadlineGovernor(chunk_target_ms=100, interval_s=10.0)
+    gov._rate_ewma = 81920.0  # warmed up fast: wants 8192-row chunks
+    sizes = _drive(gov, 65536, 1000.0)  # but the host now does 1k rows/s
+    assert sizes[0] == 8192
+    assert sizes == sorted(sizes, reverse=True)
+    assert sizes[-1] == MIN_CHUNK_ROWS  # converged to the floor
+    assert sum(sizes) == 65536
+
+
+def test_rate_ewma_persists_across_flushes():
+    gov = FlushDeadlineGovernor(chunk_target_ms=200, interval_s=10.0)
+    _drive(gov, 8192, 40960.0)
+    # second flush skips the floor probe: first chunk is rate-sized
+    run = gov.begin_extract(65536)
+    assert run.next_rows() == 8192
+
+
+def test_last_report_summarizes_the_flush():
+    gov = FlushDeadlineGovernor(chunk_target_ms=200, interval_s=10.0)
+    gov.begin_flush()
+    assert gov.last_report == {}
+    sizes = _drive(gov, 8192, 40960.0)
+    rep = gov.last_report
+    assert rep["chunks"] == len(sizes)
+    assert rep["chunk_rows_max"] == max(sizes)
+    assert rep["chunk_target_ms"] == 200
+    assert rep["chunk_max_s"] >= rep["chunk_mean_s"] > 0
+    gov.begin_flush()  # next flush resets the report
+    assert gov.last_report == {}
+
+
+def test_disabled_governor_reports_disabled():
+    gov = FlushDeadlineGovernor(chunk_target_ms=0, interval_s=10.0)
+    assert not gov.enabled
+    assert FlushDeadlineGovernor(chunk_target_ms=250).enabled
+
+
+# -- watchdog deferral contract (health/policy.py) ------------------------
+
+
+def test_stall_window_floors_at_the_interval():
+    assert stall_window_s(10.0, 0.5) == 10.0  # interval dominates
+    assert stall_window_s(1.0, 0.5) == 2.0  # 4x chunk target dominates
+    assert stall_window_s(10.0, 0.0) == 10.0  # unchunked: one interval
+
+
+def test_no_flush_in_flight_never_defers():
+    gov = FlushDeadlineGovernor(chunk_target_ms=500, interval_s=10.0)
+    defer, why = watchdog_should_defer(time.time(), gov, 10.0)
+    assert not defer
+    assert why == "no flush in flight"
+
+
+def test_in_flight_flush_with_fresh_progress_defers():
+    gov = FlushDeadlineGovernor(chunk_target_ms=500, interval_s=10.0)
+    gov.begin_flush()
+    defer, why = watchdog_should_defer(time.time(), gov, 10.0)
+    assert defer
+    assert "in flight" in why
+    gov.end_flush()
+    defer, _ = watchdog_should_defer(time.time(), gov, 10.0)
+    assert not defer  # flush ended: back to the reference contract
+
+
+def test_stalled_chunk_does_not_defer():
+    gov = FlushDeadlineGovernor(chunk_target_ms=500, interval_s=10.0)
+    gov.begin_flush()
+    window = stall_window_s(10.0, gov.chunk_target_s)
+    defer, why = watchdog_should_defer(
+        time.time() + window + 1.0, gov, 10.0)
+    assert not defer
+    assert "stalled" in why
+    # a beat (chunk completion / phase progress) re-arms the deferral
+    gov.beat()
+    defer, _ = watchdog_should_defer(time.time(), gov, 10.0)
+    assert defer
+
+
+# -- config knob ----------------------------------------------------------
+
+
+def test_config_chunk_target_validation():
+    validate_config(Config(flush_chunk_target_ms=500))  # ok
+    validate_config(Config(flush_chunk_target_ms=0))  # disabled: ok
+    with pytest.raises(ValueError, match="flush_chunk_target_ms"):
+        validate_config(Config(flush_chunk_target_ms=-1))
+    with pytest.raises(ValueError, match="below the flush"):
+        validate_config(Config(interval="10s", flush_chunk_target_ms=10000))
+
+
+# -- chunked extraction equivalence ---------------------------------------
+
+
+def _fed_worker(governor) -> DeviceWorker:
+    w = DeviceWorker(initial_histo_rows=1024)
+    w.governor = governor
+    for i in range(3000):
+        for rep in range(2):
+            v = (i * 7 + rep) % 1000
+            w.process_metric(parse_metric(
+                f"chunk.t{i}:{v}|ms|#k:{i % 5}".encode()))
+        w.process_metric(parse_metric(f"chunk.c{i}:2|c".encode()))
+    return w
+
+
+def test_chunked_extract_matches_single_shot():
+    """The chunk schedule is a pure scheduling change: the snapshot it
+    produces must be bit-identical to the one-program extraction."""
+    qs = device_quantiles(PCTS, AGGS)
+    gov = FlushDeadlineGovernor(chunk_target_ms=50, interval_s=10.0)
+    ref = _fed_worker(None).flush(qs)
+    chunked = _fed_worker(gov).flush(qs)
+    assert gov.last_report["chunks"] > 1  # actually exercised chunking
+    for field in ("quantile_values", "dmin", "dmax", "dsum", "dcount",
+                  "drecip", "lmin", "lmax", "lsum", "lweight", "lrecip"):
+        a, b = getattr(ref, field), getattr(chunked, field)
+        assert (a is None) == (b is None), field
+        if a is not None:
+            np.testing.assert_allclose(a, b, rtol=0, atol=0,
+                                       err_msg=field)
+
+
+# -- server wiring --------------------------------------------------------
+
+
+def _server(**cfg_kwargs):
+    base = dict(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                num_workers=2, num_readers=1, interval="10s",
+                percentiles=[0.5, 0.99])
+    base.update(cfg_kwargs)
+    srv = Server(Config(**base), metric_sinks=[ChannelMetricSink()])
+    srv.start()
+    return srv
+
+
+def test_server_wires_one_governor_into_every_worker():
+    srv = _server(flush_chunk_target_ms=250)
+    try:
+        assert srv.flush_governor.enabled
+        assert srv.flush_governor.chunk_target_ms == 250
+        for w in srv.workers:
+            assert w.governor is srv.flush_governor
+    finally:
+        srv.shutdown()
+
+
+def test_server_flush_publishes_chunk_report():
+    srv = _server(flush_chunk_target_ms=250)
+    try:
+        srv.process_metric_packet(b"wire.t:3|ms")
+        srv.flush()
+        # tiny pool: a single sub-floor chunk, but the report exists
+        assert srv.last_flush_chunks.get("chunks", 0) >= 1
+        assert srv.last_flush_chunks["chunk_target_ms"] == 250
+    finally:
+        srv.shutdown()
+
+
+def test_shutdown_loser_waits_for_winner_verdict():
+    """Regression: a shutdown() caller losing the once-race must wait
+    for the winner's teardown and return the REAL join verdict — not
+    the pre-teardown True that told callers a live XLA thread was safe
+    to finalize under."""
+    srv = _server()
+    real = srv._shutdown_teardown
+    entered = threading.Event()
+
+    def slow_failing_teardown():
+        entered.set()
+        time.sleep(0.3)
+        real()
+        srv.compute_threads_joined = False  # simulate a stuck thread
+        return False
+
+    srv._shutdown_teardown = slow_failing_teardown
+    results = {}
+    t1 = threading.Thread(
+        target=lambda: results.__setitem__("winner", srv.shutdown()))
+    t1.start()
+    assert entered.wait(timeout=5.0)
+    # loser races in while the winner is mid-teardown
+    t2 = threading.Thread(
+        target=lambda: results.__setitem__("loser", srv.shutdown()))
+    t2.start()
+    t1.join(timeout=10.0)
+    t2.join(timeout=10.0)
+    assert results["winner"] is False
+    assert results["loser"] is False  # stale True is the regression

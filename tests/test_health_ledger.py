@@ -1,0 +1,131 @@
+"""Device-transfer ledger: per-flush byte accounting, and the O(samples)
+transfer-diet regression pin.
+
+The pinned claim (ROADMAP / PERF_MODEL): the per-flush host->device
+upload cost of the staged-histogram path is ~ samples*4 + counts*4
+bytes — INDEPENDENT of stage depth — because the compacted upload ships
+one flat f32 value plane plus one per-row count vector and rebuilds the
+dense [S, depth] staging matrix on device. A regression back to dense
+uploads (s_eff * depth * 4 bytes) multiplies flush transfer cost by the
+depth and shows up here as a depth-dependent byte count.
+"""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.flusher import device_quantiles
+from veneur_tpu.core.metrics import HistogramAggregates
+from veneur_tpu.core.server import Server
+from veneur_tpu.core.worker import DeviceWorker, _next_pow2
+from veneur_tpu.health.ledger import TransferLedger
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+PCTS = [0.5, 0.9, 0.99]
+
+
+# -- unit behavior --------------------------------------------------------
+
+
+def test_ledger_counts_and_resets_per_flush():
+    led = TransferLedger()
+    led.begin_flush()
+    arr = np.zeros(100, dtype=np.float32)
+    dev = led.h2d(arr, "staged_flat")
+    assert led.flush_h2d() == {"staged_flat": 400}
+    back = led.d2h(dev, "extract_packed")
+    assert isinstance(back, np.ndarray)
+    np.testing.assert_array_equal(back, arr)
+    assert led.flush_d2h() == {"extract_packed": 400}
+    led.count_h2d(100, "staged_flat")  # same kind accumulates
+    assert led.flush_h2d_bytes() == 500
+    led.begin_flush()  # per-flush view resets, lifetime totals persist
+    assert led.flush_h2d() == {} and led.flush_d2h() == {}
+    assert led.total_h2d_bytes == 500
+    assert led.total_d2h_bytes == 400
+    assert led.flushes == 2
+
+
+def test_worker_has_a_ledger_reset_by_swap():
+    w = DeviceWorker()
+    qs = device_quantiles(PCTS, AGGS)
+    from veneur_tpu.protocol.dogstatsd import parse_metric
+    w.process_metric(parse_metric(b"led.t:5|ms"))
+    w.flush(qs)
+    first = dict(w.ledger.flush_h2d())
+    assert first  # the staged upload was counted
+    w.flush(qs)  # empty interval: swap() reset the per-flush view
+    assert w.ledger.flush_h2d_bytes() <= first.get("quantiles", 12) + 64
+
+
+# -- the transfer-diet regression pin (tier-1) ----------------------------
+
+SERIES = 2048
+PER = 2  # samples per series -> samples == 4096, exactly pow2-aligned
+DEPTHS = (16, 64, 128)
+
+
+def _native_flush_ledger(depth: int):
+    """Ingest SERIES x PER timer samples through the native path at the
+    given stage depth; return (per-flush h2d, d2h, s_eff, P)."""
+    w = DeviceWorker(initial_histo_rows=1024, stage_depth=depth)
+    if not w.attach_native():
+        pytest.skip("native ingest library unavailable")
+    for i in range(SERIES):
+        for rep in range(PER):
+            w.ingest_datagram(b"diet.t%d:%d|ms|#a:%d"
+                              % (i, (i * 7 + rep) % 1000, i % 5))
+    w.sync_native_series()
+    snap = w.flush(device_quantiles(PCTS, AGGS))
+    s_eff = snap.dcount.shape[0]
+    p = snap.quantile_values.shape[1]
+    return dict(w.ledger.flush_h2d()), dict(w.ledger.flush_d2h()), s_eff, p
+
+
+def test_staged_upload_bytes_independent_of_depth():
+    samples = SERIES * PER
+    staged_totals = []
+    for depth in DEPTHS:
+        h2d, _, s_eff, _ = _native_flush_ledger(depth)
+        assert "staged_dense" not in h2d  # the compacted path ran
+        staged = h2d.get("staged_flat", 0) + h2d.get("staged_counts", 0)
+        assert staged > 0
+        # ~ samples*4 + counts*4: flat plane pow2-padded, one count per row
+        assert h2d["staged_flat"] <= 4 * _next_pow2(samples, 1024)
+        assert h2d["staged_counts"] <= 4 * s_eff
+        # dense staging would ship s_eff * depth * 4 bytes instead
+        assert staged < s_eff * depth * 4
+        staged_totals.append(staged)
+    assert len(set(staged_totals)) == 1, (
+        f"staged upload bytes vary with depth: {dict(zip(DEPTHS, staged_totals))}")
+
+
+def test_packed_readback_bytes_independent_of_depth():
+    packed = []
+    for depth in DEPTHS:
+        _, d2h, s_eff, p = _native_flush_ledger(depth)
+        # one [S, P+10] f32 array back per flush, regardless of depth
+        assert d2h["extract_packed"] == s_eff * (p + 10) * 4
+        packed.append(d2h["extract_packed"])
+    assert len(set(packed)) == 1
+
+
+# -- server surface -------------------------------------------------------
+
+
+def test_server_flush_reports_transfer_totals():
+    cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                 num_workers=2, num_readers=1, interval="10s",
+                 percentiles=[0.5, 0.99])
+    srv = Server(cfg, metric_sinks=[ChannelMetricSink()])
+    srv.start()
+    try:
+        srv.process_metric_packet(b"xfer.t:3|ms\nxfer.c:1|c")
+        srv.flush()
+        xfer = srv.last_flush_transfers
+        assert set(xfer) == {"h2d_bytes", "d2h_bytes"}
+        assert xfer["h2d_bytes"] > 0
+        assert xfer["d2h_bytes"] > 0
+    finally:
+        srv.shutdown()

@@ -19,8 +19,13 @@ while true; do
         # settle: let an in-flight atomic rename finish
         sleep 2
         git add $CHANGED
+        # label derives from the artifacts' OWN platform fields: a CPU
+        # capture must never land under an "on-chip" message (round-5
+        # postmortem; logic shared with tools/bench_capture.py)
+        LABEL=$(python3 tools/bench_capture.py --platform-label $CHANGED 2>/dev/null)
+        [ -n "$LABEL" ] || LABEL="capture artifacts (platform unknown)"
         # pathspec-limited commit: never sweeps files another process staged
-        git commit -m "on-chip artifacts refreshed by capture loop:$CHANGED" --no-verify -- $CHANGED >/dev/null 2>&1 \
+        git commit -m "$LABEL refreshed by capture loop:$CHANGED" --no-verify -- $CHANGED >/dev/null 2>&1 \
             && echo "$(date -u +%H:%M:%S) committed:$CHANGED"
     fi
     sleep 20

@@ -55,6 +55,30 @@ def axon_lock():
     return f
 
 
+def platform_label(paths: list[str]) -> str:
+    """Commit-message prefix derived from the artifacts' OWN platform
+    fields. A CPU-captured artifact committed as "on-chip" poisons the
+    evidence chain (round-5 postmortem: E2E_SCALING.json with
+    platform: cpu landed under an on-chip label) — so "on-chip" is only
+    claimed when every readable artifact says tpu; anything else names
+    the platforms actually present. Non-JSON artifacts and unreadable
+    files contribute nothing."""
+    plats: set[str] = set()
+    for p in paths:
+        try:
+            with open(p) as fh:
+                d = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(d, dict) and d.get("platform"):
+            plats.add(str(d["platform"]))
+    if plats == {"tpu"}:
+        return "on-chip capture artifacts"
+    if plats:
+        return "capture artifacts (platform: %s)" % ",".join(sorted(plats))
+    return "capture artifacts (platform unknown)"
+
+
 def git_rev() -> str:
     try:
         return subprocess.run(["git", "rev-parse", "--short", "HEAD"],
@@ -226,7 +250,15 @@ def main() -> None:
     ap.add_argument("--interval", type=float, default=240.0,
                     help="seconds between attempts while wedged")
     ap.add_argument("--max-hours", type=float, default=12.0)
+    ap.add_argument("--platform-label", nargs="+", metavar="FILE",
+                    help="print the platform-derived commit label for "
+                         "these artifact files and exit (used by "
+                         "tools/artifact_watch.sh)")
     args = ap.parse_args()
+
+    if args.platform_label:
+        print(platform_label(args.platform_label))
+        return
 
     def _reap(signum, frame):
         # a SIGTERM'd loop must not leave an orphan suite child touching

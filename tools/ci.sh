@@ -32,6 +32,17 @@ if [ -n "${VENEUR_FUZZ_LONG:-}" ]; then
       --tally FUZZ_TALLY.json
 fi
 
+# Tier-1 lane: the flush-deadline governor contract and the O(samples)
+# transfer-diet regression pin (tests/test_health_ledger.py asserts the
+# staged upload is ~ samples*4 + counts*4 bytes independent of depth —
+# a silent dense-upload regression is a 268 MB/flush mistake at 1M
+# series that no value-equality test can see). Runs first and alone so
+# a transfer or watchdog regression is named by its lane, not buried in
+# the full-suite output.
+echo "== tier-1 health lane (governor + transfer ledger) =="
+python -m pytest tests/test_health_governor.py tests/test_health_ledger.py \
+  -q -m 'not slow'
+
 echo "== test suite =="
 python -m pytest tests/ -q
 

@@ -83,6 +83,15 @@ class Config:
     num_span_workers: int = 1
     count_unique_timeseries: bool = False
     flush_watchdog_missed_flushes: int = 0
+    # flush-deadline governor (veneur_tpu/health/): >0 slices the flush
+    # extraction into power-of-two row chunks sized so each chunk takes
+    # about this long, giving an extraction-bound host (CPU fallback at
+    # high cardinality) longer-but-BOUNDED flushes with per-chunk
+    # progress — which the flush watchdog's deferral rule consumes
+    # instead of killing a flush that is demonstrably draining. 0 (the
+    # default, right for TPU) keeps the single-program extraction and
+    # the reference's unconditional watchdog behavior.
+    flush_chunk_target_ms: int = 0
     flush_max_per_body: int = 0
     flush_file: str = ""
     omit_empty_hostname: bool = False
@@ -500,6 +509,13 @@ def validate_config(cfg: Config) -> None:
         raise ValueError("tpu_set_store must be 'staged' or 'dense'")
     if not (4 <= cfg.tpu_hll_precision <= 18):
         raise ValueError("tpu_hll_precision must be in [4,18]")
+    if cfg.flush_chunk_target_ms < 0:
+        raise ValueError("flush_chunk_target_ms must be >= 0"
+                         " (0 disables chunked extraction)")
+    if (cfg.flush_chunk_target_ms
+            and cfg.flush_chunk_target_ms >= cfg.interval_seconds() * 1000):
+        raise ValueError("flush_chunk_target_ms must be below the flush"
+                         " interval (a chunk IS a sub-interval unit)")
     if cfg.tpu_stage_depth < 1:
         raise ValueError("tpu_stage_depth must be >= 1")
     if cfg.tpu_spill_cap < 1:

@@ -55,8 +55,14 @@ def generate_inter_metrics(
     percentiles: list[float],
     aggregates: HistogramAggregates,
     now: Optional[int] = None,
+    governor=None,
 ) -> list[InterMetric]:
     """Emit every InterMetric this interval owes its sinks."""
+    if governor is not None:
+        # liveness beat for the flush watchdog's deferral rule: at high
+        # cardinality the generate phase is seconds of host work, and a
+        # deferred-panic decision should see it as progress, not silence
+        governor.beat()
     ts = int(time.time()) if now is None else now
     out: list[InterMetric] = []
 
@@ -282,6 +288,7 @@ def generate_columnar(
     percentiles: list[float],
     aggregates: HistogramAggregates,
     now: Optional[int] = None,
+    governor=None,
 ):
     """Columnar twin of generate_inter_metrics: numpy masks instead of a
     per-row Python loop. Emits the identical metric multiset (pinned by
@@ -291,6 +298,10 @@ def generate_columnar(
         ColumnarMetrics, ColumnGroup, MetricFamily,
     )
 
+    if governor is not None:
+        # liveness beat for the flush watchdog's deferral rule (see
+        # generate_inter_metrics)
+        governor.beat()
     ts = int(time.time()) if now is None else now
     batch = ColumnarMetrics(timestamp=ts)
     GAUGE = MetricType.GAUGE

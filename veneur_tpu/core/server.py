@@ -140,8 +140,18 @@ class Server:
         # work (worker.swap sheds the excess, counted)
         self._spill_cap_now = cfg.tpu_spill_cap
         self.compute_threads_joined = True  # set by shutdown()
+        # flush-deadline governor (health/): chunked degraded-mode
+        # extraction + the progress signal the watchdog's deferral rule
+        # reads. Shared across workers — extraction is sequential within
+        # one flush, so one rate EWMA and one progress clock describe it.
+        from veneur_tpu.health import FlushDeadlineGovernor
+
+        self.flush_governor = FlushDeadlineGovernor(
+            chunk_target_ms=cfg.flush_chunk_target_ms,
+            interval_s=self.interval)
         for w in self.workers:
             w.fold_budget_s = 0.5 * self.interval
+            w.governor = self.flush_governor
         if cfg.tpu_mesh_devices > 1:
             # config-driven mesh sharding for the aggregation state (the
             # global tier's import merge rides ICI collectives; see
@@ -209,8 +219,16 @@ class Server:
         self._shutdown = threading.Event()
         self._shutdown_once_lock = threading.Lock()
         self._shutdown_done = False
+        # set once the WINNING shutdown() caller finishes its bounded
+        # join + teardown; losing callers wait on it so they report the
+        # real join outcome instead of the stale initial True
+        self._shutdown_complete = threading.Event()
         self.last_flush_unix = time.time()
         self.last_flush_phases: dict[str, float] = {}
+        # per-flush transfer-ledger totals and chunk report (health/),
+        # read by tools/bench_e2e_flush.py alongside the phase times
+        self.last_flush_transfers: dict[str, int] = {}
+        self.last_flush_chunks: dict = {}
         self.flush_count = 0
 
         # ingest counters (self-telemetry). Incremented from every reader
@@ -1262,13 +1280,18 @@ class Server:
             return
         self._spill_cap_now = new
         self.stats.gauge("ingest.spill_cap", new)
-        for w in self.workers:
-            w.spill_cap = new
-            if w._native is not None:
-                try:
-                    w._native.set_spill_cap(new)
-                except AttributeError:  # stale .so without the cap API
-                    pass
+        for i, w in enumerate(self.workers):
+            # under the worker's ingest lock (ADVICE item 3): _native is
+            # published by attach paths and read by every ingest call;
+            # the lock also orders the cap write against a concurrent
+            # swap's drain/reset critical section
+            with self._worker_locks[i]:
+                w.spill_cap = new
+                if w._native is not None:
+                    try:
+                        w._native.set_spill_cap(new)
+                    except AttributeError:  # stale .so without the cap API
+                        pass
 
     def flush(self):
         """One flush pass (reference Server.Flush, flusher.go:28-134).
@@ -1281,8 +1304,15 @@ class Server:
         tracer.StartSpan("flush"), flusher.go:29) that rejoins this
         server's own span pipeline and surfaces as derived metrics on
         the NEXT interval."""
-        with self.tracer.start_span("flush"):
-            return self._flush_inner()
+        # bracket the whole flush for the governor: in_flight + progress
+        # beats are what the watchdog's deferral rule reads, so end_flush
+        # must run even when a phase raises
+        self.flush_governor.begin_flush()
+        try:
+            with self.tracer.start_span("flush"):
+                return self._flush_inner()
+        finally:
+            self.flush_governor.end_flush()
 
     def _flush_inner(self):
         flush_start = time.time()
@@ -1381,6 +1411,7 @@ class Server:
                     self.handle_trace_packet(pkt)
         phases["swap_s"] = time.perf_counter() - _t
         _t = time.perf_counter()
+        self.flush_governor.beat()  # swap complete: flush is live
         snaps: list[FlushSnapshot] = []
         for i, (worker, sw) in enumerate(zip(self.workers, swapped)):
             try:
@@ -1390,6 +1421,7 @@ class Server:
                 # but a readback failure on one worker must not destroy the
                 # already-swapped intervals of the others
                 log.exception("flush extraction failed for worker %d", i)
+            self.flush_governor.beat()  # one worker's extraction done
         for snap in snaps:
             # per-type flushed-series counts (README.md:293)
             d = snap.directory
@@ -1404,6 +1436,23 @@ class Server:
                                      tags=[f"metric_type:{mtype}"])
 
         phases["extract_s"] = time.perf_counter() - _t
+        # per-flush transfer accounting (health/ledger.py): the byte
+        # counts that pin the O(samples) upload/readback diet, surfaced
+        # the same way the reference surfaces flush phase timings
+        h2d = sum(w.ledger.flush_h2d_bytes() for w in self.workers)
+        d2h = sum(w.ledger.flush_d2h_bytes() for w in self.workers)
+        self.last_flush_transfers = {"h2d_bytes": h2d, "d2h_bytes": d2h}
+        if h2d or d2h:
+            self.stats.count("flush.transfer_h2d_bytes", h2d)
+            self.stats.count("flush.transfer_d2h_bytes", d2h)
+        chunk_report = self.flush_governor.last_report
+        self.last_flush_chunks = chunk_report
+        if chunk_report:
+            self.stats.gauge("flush.extract_chunks",
+                             chunk_report["chunks"])
+            self.stats.time_in_nanoseconds(
+                "flush.extract_chunk_max_ns",
+                chunk_report["chunk_max_s"] * 1e9)
         _t = time.perf_counter()
         # Columnar fast path: the flush never materializes per-metric
         # Python objects up front — at 1M series the object loop alone is
@@ -1423,7 +1472,8 @@ class Server:
             for snap in snaps:
                 b = generate_columnar(
                     snap, self.is_local, self.percentiles,
-                    self.aggregates, now=ts_now)
+                    self.aggregates, now=ts_now,
+                    governor=self.flush_governor)
                 if batch is None:
                     batch = b
                 else:
@@ -1435,7 +1485,7 @@ class Server:
                 final.extend(
                     generate_inter_metrics(
                         snap, self.is_local, self.percentiles,
-                        self.aggregates
+                        self.aggregates, governor=self.flush_governor
                     )
                 )
             n_flushed = len(final)
@@ -1649,18 +1699,39 @@ class Server:
 
     def flush_watchdog(self) -> None:
         """Die if flushes stop happening, so process supervision restarts us
-        (reference FlushWatchdog, server.go:948-990)."""
+        (reference FlushWatchdog, server.go:948-990) — with one deliberate
+        departure, the progress-aware deferral contract (health/policy.py):
+
+        An overdue flush defers the panic WHILE ITS CHUNKS ARE COMPLETING.
+        Chunked degraded-mode extraction makes a slow flush legitimate —
+        bounded steps at the rate the hardware allows — and killing it
+        would lose both the interval and the progress; sustained overload
+        is the shedding layer's job (_adapt_spill_caps), not the
+        watchdog's. A STALLED flush (no progress beat within the stall
+        window) panics exactly as the reference would, as does a silent
+        flush loop with nothing in flight."""
         missed = self.config.flush_watchdog_missed_flushes
         if missed == 0:
             return
+        from veneur_tpu.health import watchdog_should_defer
+
         while not self._shutdown.is_set():
             if self._shutdown.wait(self.interval):
                 return
-            overdue = time.time() - self.last_flush_unix
+            now = time.time()
+            overdue = now - self.last_flush_unix
             if overdue > missed * self.interval:
+                defer, why = watchdog_should_defer(
+                    now, self.flush_governor, self.interval)
+                if defer:
+                    log.warning(
+                        "flush watchdog: flush %.1fs overdue but "
+                        "deferring (%s)", overdue, why)
+                    self.stats.count("flush.watchdog_deferred_total", 1)
+                    continue
                 log.critical(
-                    "flush watchdog: no flush for %.1fs (> %d intervals);"
-                    " aborting", overdue, missed,
+                    "flush watchdog: no flush for %.1fs (> %d intervals;"
+                    " %s); aborting", overdue, missed, why,
                 )
                 os._exit(2)
 
@@ -1679,8 +1750,26 @@ class Server:
         self._shutdown.set()
         with self._shutdown_once_lock:
             if self._shutdown_done:
+                # lost the once-race: the winner is mid-teardown, and
+                # compute_threads_joined still holds its INITIAL True —
+                # returning it now would tell the caller the join
+                # succeeded before it ran (the caller would then let
+                # interpreter finalization unwind a live XLA thread).
+                # Wait for the winner; on timeout report False, the
+                # conservative side (callers exit via os._exit).
+                if not self._shutdown_complete.wait(timeout=30.0):
+                    return False
                 return self.compute_threads_joined
             self._shutdown_done = True
+        try:
+            return self._shutdown_teardown()
+        finally:
+            # set even when teardown raises: a loser blocked in the
+            # wait above must not hang its full timeout on an exception
+            self._shutdown_complete.set()
+
+    def _shutdown_teardown(self) -> bool:
+        """The winning shutdown() caller's teardown body."""
         self._stop_native_readers()
         # join the compute threads (bounded): a daemon thread still
         # inside XLA/C++ when the interpreter finalizes is force-unwound

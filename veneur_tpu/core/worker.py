@@ -45,6 +45,7 @@ import numpy as np
 
 from veneur_tpu.core.directory import ScopeClass, SeriesDirectory, classify
 from veneur_tpu.core.metrics import MetricKey, UDPMetric, route_info
+from veneur_tpu.health.ledger import TransferLedger
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import tdigest as td
 from veneur_tpu.ops.scalars import counter_contribution
@@ -587,6 +588,13 @@ class DeviceWorker:
         # wall time is what keeps the cadence under overload.
         self.fold_budget_s: float = 5.0
         self._fold_rate_ewma: float = 1e6  # samples/s, refined by extract
+        # flush-path transfer byte accounting (health/ledger.py); reset
+        # each swap, read by the server's flush telemetry and pinned by
+        # the O(samples)-transfer regression test
+        self.ledger = TransferLedger()
+        # flush-deadline governor (health/governor.py), installed by the
+        # server; None (or disabled) keeps single-shot extraction
+        self.governor = None
         self._native = None
         self._mesh_pool = None
         # cross-epoch series-metadata cache (see _sync_native_series);
@@ -1217,10 +1225,12 @@ class DeviceWorker:
         invariant _fold_batch_direct relies on for its scratch row)."""
         active, lids, v, w = self._pad_spill_batch(
             rows, vals, wts, pool_rows - 1)
+        led = self.ledger
         return _histo_ingest_step(
             *fields,
-            jnp.asarray(active), jnp.asarray(lids), jnp.asarray(v),
-            jnp.asarray(w), compression=self.compression,
+            led.h2d(active, "spill"), led.h2d(lids, "spill"),
+            led.h2d(v, "spill"), led.h2d(w, "spill"),
+            compression=self.compression,
         )
 
     def _flush_pending_sets(self) -> None:
@@ -1489,6 +1499,10 @@ class DeviceWorker:
         under the lock. The overlap-critical 1M-series local path never
         takes it.
         """
+        # one swap == one flush for this worker: reset the per-flush
+        # transfer tallies so extract_snapshot's uploads/readbacks are
+        # attributed to the interval they serve
+        self.ledger.begin_flush()
         native_stage = None
         spill_histo = None
         if self._native is not None:
@@ -1623,9 +1637,12 @@ class DeviceWorker:
             fv[:len(flat_v)] = flat_v
             # fv/fw/counts_np are Python-owned copies (fancy indexing /
             # np.minimum / np.pad) — nothing below aliases the C++
-            # plane, so free() needs no upload synchronization
-            fvj = jnp.asarray(fv)
-            cj = jnp.asarray(counts_np)
+            # plane, so free() needs no upload synchronization. The
+            # ledger pins these uploads at O(samples) + O(rows) bytes:
+            # the whole point of the compaction, and what the
+            # test_health_ledger regression test asserts
+            fvj = self.ledger.h2d(fv, "staged_flat")
+            cj = self.ledger.h2d(counts_np, "staged_counts")
             unit = plane.wts is None
             if unit:
                 fwj = fvj  # ignored under unit=True (XLA DCEs it)
@@ -1633,14 +1650,18 @@ class DeviceWorker:
                 flat_w = plane.wts[:rows_avail][mask]
                 fw = np.zeros(n_pad, np.float32)
                 fw[:len(flat_w)] = flat_w
-                fwj = jnp.asarray(fw)
+                fwj = self.ledger.h2d(fw, "staged_flat")
             plane.free()
             # freed: the caller's cleanup must not free it again
             pending[0] = plane._replace(free=None)
             svj, swj = _expand_flat_planes(fvj, fwj, cj, B, unit)
         else:
-            svj = jnp.asarray(plane.vals[:s_eff])
-            swj = jnp.asarray(plane.wts[:s_eff])
+            # Python-owned plane: the dense upload IS O(rows x depth) —
+            # acceptable only because this path serves small non-native
+            # deployments; the ledger keeps it visible ("staged_dense"
+            # stays zero whenever native staging is attached)
+            svj = self.ledger.h2d(plane.vals[:s_eff], "staged_dense")
+            swj = self.ledger.h2d(plane.wts[:s_eff], "staged_dense")
             if svj.shape[0] < s_eff:
                 pad = s_eff - svj.shape[0]
                 svj = jnp.concatenate(
@@ -1668,6 +1689,18 @@ class DeviceWorker:
             directory=directory, scalars=scalars, interval_s=interval_s,
             unique_timeseries_registers=swapped.umts,
         )
+        # pop the deferred spill backlog UNCONDITIONALLY: when the histo
+        # block below is skipped (pool absent / zero rows) the batch is
+        # unfoldable and must be counted as shed, not silently discarded
+        # still attached to the swapped epoch
+        spill = swapped.spill_histo
+        swapped.spill_histo = None
+        # the fold loops below are the flush's other long-running stages:
+        # each bounded step publishes a progress beat so the watchdog's
+        # deferral rule (health/policy.py) sees a fold-bound flush as
+        # live, not stalled — chunked extraction alone would leave a
+        # multi-second fold silent for longer than the stall window
+        gov = self.governor
         if histo is not None and directory.num_histo_rows:
             n = directory.num_histo_rows
             # fold + extract over the USED rows only: the pool is up to 2x
@@ -1679,8 +1712,6 @@ class DeviceWorker:
                     histo.lmin, histo.lmax, histo.lsum, histo.lsum_c,
                     histo.lweight, histo.lweight_c, histo.lrecip,
                     histo.lrecip_c)
-            spill = swapped.spill_histo
-            swapped.spill_histo = None
             if spill is not None:
                 # hot-row spill backlog deferred by swap(): chunked fold
                 # off the ingest lock (plain numpy from drain_histo — no
@@ -1703,6 +1734,8 @@ class DeviceWorker:
                     if inflight >= 8:  # bound the dispatch queue's memory
                         full[0].block_until_ready()
                         inflight = 0
+                        if gov is not None:
+                            gov.beat()
                 full[0].block_until_ready()
                 t_fold = time.perf_counter() - t_fold
                 if t_fold > 0.01:
@@ -1716,6 +1749,8 @@ class DeviceWorker:
             try:
                 while pending:
                     fields = self._fold_one_plane(fields, pending, s_eff)
+                    if gov is not None:
+                        gov.beat()
             finally:
                 # an upload/fold failure must not leak the C++ planes: a
                 # repeated failing flush at 1M rows would otherwise leak
@@ -1723,15 +1758,43 @@ class DeviceWorker:
                 # (per-flush data is expendable, README.md:135-137);
                 # leaked native memory is not.
                 _free_staged_planes(pending)
-            qs = jnp.asarray(np.asarray(quantiles, dtype=np.float32))
-            out = self._extract(fields, qs)
-            # ONE device→host transfer for the whole extraction: eleven
-            # per-array np.asarray calls are eleven synchronous D2H
-            # round-trips, and on a link with per-transfer latency (the
-            # tunnelled relay; any remote-device setup) the round-trips
-            # dominate the bytes at 1M rows
-            packed = np.asarray(_pack_extract_columns(*out))
-            p = out[0].shape[1]
+            qs = self.ledger.h2d(
+                np.asarray(quantiles, dtype=np.float32), "quantiles")
+            run = (gov.begin_extract(s_eff)
+                   if gov is not None and gov.enabled else None)
+            if run is None:
+                out = self._extract(fields, qs)
+                # ONE device→host transfer for the whole extraction:
+                # eleven per-array np.asarray calls are eleven
+                # synchronous D2H round-trips, and on a link with
+                # per-transfer latency (the tunnelled relay; any
+                # remote-device setup) the round-trips dominate the
+                # bytes at 1M rows
+                packed = self.ledger.d2h(
+                    _pack_extract_columns(*out), "extract_packed")
+                p = out[0].shape[1]
+            else:
+                # governed degraded mode: extract in row chunks sized to
+                # flush_chunk_target_ms (health/governor.py) so an
+                # extraction-bound host produces a longer-but-BOUNDED
+                # flush with a progress beat per chunk (the watchdog
+                # deferral signal). dynamic_slice keeps one executable
+                # per (pool, chunk) shape pair — a static a[i:j] slice
+                # would compile per start offset.
+                parts = []
+                p = 0
+                while (c := run.next_rows()):
+                    t0 = time.perf_counter()
+                    sub = tuple(
+                        jax.lax.dynamic_slice_in_dim(a, run.start, c, 0)
+                        for a in fields)
+                    out = self._extract(sub, qs)
+                    parts.append(self.ledger.d2h(
+                        _pack_extract_columns(*out), "extract_packed"))
+                    p = out[0].shape[1]
+                    run.note(c, time.perf_counter() - t0)
+                packed = (parts[0] if len(parts) == 1
+                          else np.concatenate(parts, axis=0))
             qv = packed[:, :p]
             (dmin, dmax, dsum, dcount, drecip, lmin, lmax, lsum, lweight,
              lrecip) = (packed[:, p + i] for i in range(10))
@@ -1750,8 +1813,20 @@ class DeviceWorker:
             # extract phase. Consumers (codec.py, flusher.forward
             # iterator) already handle digest_means is None.
             if self.is_local:
-                snap.digest_means = np.asarray(fields[0])[:n]
-                snap.digest_weights = np.asarray(fields[1])[:n]
+                snap.digest_means = self.ledger.d2h(
+                    fields[0], "forward_digests")[:n]
+                snap.digest_weights = self.ledger.d2h(
+                    fields[1], "forward_digests")[:n]
+        elif spill is not None and len(spill[0]):
+            # deferred spill with nowhere to fold (ADVICE item 2): the
+            # samples are lost either way, but lost-and-counted — the
+            # overload_dropped tallies are how operators see shedding
+            n_lost = int(len(spill[0]))
+            self.overload_dropped += n_lost
+            self.overload_dropped_total += n_lost
+            log.warning(
+                "extract: dropped %d deferred spill samples — swapped "
+                "epoch has no histogram pool to fold them into", n_lost)
         if swapped.staged_histo:
             # histo block skipped (no rows): planes can hold nothing
             # meaningful, but C++ memory must still be released

@@ -1,0 +1,215 @@
+"""Flush-deadline governor: bounded-chunk extraction scheduling.
+
+The flush's dominant phase on an extraction-bound host is the one
+device program over all pool rows (E2E_SCALING.json: 11.9s of a 12.1s
+flush at 131k series on CPU, superlinear past the LLC cliff). Running
+it as ONE program means the flush is unbounded exactly when the host is
+slowest. The governor slices the row space into power-of-two chunks
+sized so each chunk lands near `flush_chunk_target_ms`, which buys two
+properties the single-shot extract cannot offer:
+
+- bounded degradation: a deployment past its hardware's cardinality
+  knee takes LONGER flushes, but in bounded steps — each chunk's
+  readback is a progress point, consumed by the watchdog deferral rule
+  (health/policy.py) and by operators via self-telemetry.
+- per-chunk deadline checks: the measured chunk rate feeds an EWMA that
+  re-sizes subsequent chunks, so a host that slows mid-flush (GC, CPU
+  contention) converges back toward the target instead of stalling.
+
+Chunk sizes are powers of two with a floor, for the same reason every
+other shape in this codebase is pow2-bucketed (_next_pow2): each
+distinct chunk shape is one XLA compile variant, and a compile costs
+20-40s on TPU — re-tuning chunk sizes freely would spend more time
+compiling than extracting. The schedule may at most double or halve
+between chunks, and only doubles when the remaining row count stays
+divisible by the new size, so a pow2 total is always covered exactly
+by pow2 chunks.
+
+Thread-safety: progress fields are read by the watchdog thread while
+the flush thread writes them; both go through one lock. Scheduling
+state (the rate EWMA) is only touched by the flush thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+MIN_CHUNK_ROWS = 1024  # matches the pool's pow2 floor (_next_pow2 floor)
+
+
+def _floor_pow2(n: int) -> int:
+    """Largest power of two <= n (n >= 1)."""
+    return 1 << (max(int(n), 1).bit_length() - 1)
+
+
+class ChunkRun:
+    """One flush extraction's chunk schedule over `total_rows` rows.
+
+    Usage (worker.extract_snapshot):
+
+        run = governor.begin_extract(total_rows)
+        while (c := run.next_rows()):
+            ... extract rows [run.start, run.start + c) ...
+            run.note(c, elapsed_s)
+
+    `next_rows` returns 0 when the row space is covered. A total that
+    is not a power of two (custom initial pool sizes) or is at most the
+    chunk floor degenerates to a single full-size chunk.
+    """
+
+    def __init__(self, governor: "FlushDeadlineGovernor",
+                 total_rows: int) -> None:
+        self._gov = governor
+        self.total = int(total_rows)
+        self.start = 0
+        self.chunks = 0
+        pow2 = self.total > 0 and (self.total & (self.total - 1)) == 0
+        if not pow2 or self.total <= MIN_CHUNK_ROWS:
+            self._next = self.total
+        else:
+            self._next = governor._initial_chunk(self.total)
+
+    def next_rows(self) -> int:
+        remaining = self.total - self.start
+        if remaining <= 0:
+            return 0
+        return min(self._next, remaining)
+
+    def note(self, rows: int, dt_s: float) -> None:
+        """Record a completed chunk: advances the cursor, publishes a
+        progress beat, and re-sizes the next chunk from the measured
+        rate (the per-chunk deadline check)."""
+        self.start += rows
+        self.chunks += 1
+        self._gov._note_chunk(rows, dt_s)
+        remaining = self.total - self.start
+        if remaining <= 0:
+            return
+        want = self._gov._target_chunk(remaining)
+        cur = self._next
+        if want > cur:
+            # at most double, and only while the remaining rows stay
+            # divisible by the doubled size (keeps pow2 coverage exact)
+            nxt = cur * 2
+            if nxt <= remaining and remaining % nxt == 0:
+                self._next = nxt
+        elif want < cur:
+            self._next = max(MIN_CHUNK_ROWS, cur // 2)
+        if self._next > remaining:
+            # remaining is a multiple of the previous size and smaller
+            # than the doubled one, hence itself the previous pow2
+            self._next = remaining
+
+
+class FlushDeadlineGovernor:
+    """Owns the chunk-size policy and the flush progress signal.
+
+    One instance per server, shared by all workers: extraction runs
+    per-worker sequentially inside one flush, so a shared rate EWMA and
+    a shared progress clock describe the flush as a whole.
+    """
+
+    def __init__(self, chunk_target_ms: int = 0,
+                 interval_s: float = 10.0) -> None:
+        self.chunk_target_ms = int(chunk_target_ms)
+        self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        # rows/s extraction rate, refined by every completed chunk;
+        # None until the first chunk is measured (first flush probes
+        # with the floor-size chunk)
+        self._rate_ewma: float | None = None
+        # progress signal, read by the watchdog thread
+        self._in_flight = False
+        self._last_beat_unix = 0.0
+        self._chunks_done = 0
+        # per-flush report (reset by begin_flush, read by telemetry)
+        self._chunk_times: list[float] = []
+        self._chunk_rows: list[int] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.chunk_target_ms > 0
+
+    @property
+    def chunk_target_s(self) -> float:
+        return self.chunk_target_ms / 1000.0
+
+    # -- flush lifecycle (called by the server) ---------------------------
+
+    def begin_flush(self) -> None:
+        with self._lock:
+            self._in_flight = True
+            self._last_beat_unix = time.time()
+            self._chunks_done = 0
+            self._chunk_times = []
+            self._chunk_rows = []
+
+    def end_flush(self) -> None:
+        with self._lock:
+            self._in_flight = False
+            self._last_beat_unix = time.time()
+
+    def beat(self) -> None:
+        """A generic liveness beat from a non-chunked flush phase
+        (swap, generate): progress the watchdog can trust without a
+        chunk completing."""
+        with self._lock:
+            self._last_beat_unix = time.time()
+
+    def progress(self) -> dict:
+        """Snapshot for the watchdog deferral decision."""
+        with self._lock:
+            return {
+                "in_flight": self._in_flight,
+                "last_beat_unix": self._last_beat_unix,
+                "chunks_done": self._chunks_done,
+            }
+
+    @property
+    def last_report(self) -> dict:
+        """Per-flush chunk summary for self-telemetry and benches."""
+        with self._lock:
+            times = list(self._chunk_times)
+            rows = list(self._chunk_rows)
+        if not times:
+            return {}
+        return {
+            "chunks": len(times),
+            "chunk_rows_max": max(rows),
+            "chunk_max_s": max(times),
+            "chunk_mean_s": sum(times) / len(times),
+            "chunk_target_ms": self.chunk_target_ms,
+        }
+
+    # -- extraction scheduling (called by workers) ------------------------
+
+    def begin_extract(self, total_rows: int) -> ChunkRun:
+        return ChunkRun(self, total_rows)
+
+    def _initial_chunk(self, total_rows: int) -> int:
+        """First chunk of a flush: the rate-derived target size, or the
+        floor when no rate has been measured yet (the floor chunk then
+        doubles as the probe that seeds the EWMA)."""
+        if self._rate_ewma is None:
+            return MIN_CHUNK_ROWS
+        return self._target_chunk(total_rows)
+
+    def _target_chunk(self, limit_rows: int) -> int:
+        """Pow2 chunk size whose predicted latency is ~ the target."""
+        if self._rate_ewma is None:
+            return MIN_CHUNK_ROWS
+        want = self._rate_ewma * self.chunk_target_s
+        return max(MIN_CHUNK_ROWS,
+                   min(_floor_pow2(max(want, 1.0)), _floor_pow2(limit_rows)))
+
+    def _note_chunk(self, rows: int, dt_s: float) -> None:
+        if dt_s > 1e-6:
+            rate = rows / dt_s
+            self._rate_ewma = (rate if self._rate_ewma is None
+                               else 0.5 * self._rate_ewma + 0.5 * rate)
+        with self._lock:
+            self._last_beat_unix = time.time()
+            self._chunks_done += 1
+            self._chunk_times.append(dt_s)
+            self._chunk_rows.append(rows)

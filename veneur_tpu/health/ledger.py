@@ -1,0 +1,98 @@
+"""Per-flush host<->device transfer byte accounting.
+
+The round-5 transfer diet made both flush boundaries O(samples): the
+staged upload compacts the native [S, depth] plane to flat samples +
+counts before device_put (worker._fold_one_plane ->
+_expand_flat_planes), and the extraction readback packs eleven columns
+into one [S, P+10] f32 array (_pack_extract_columns). Both invariants
+are easy to regress silently — one refactor that uploads the dense
+plane again is a 268 MB/flush mistake at 1M series x depth 64 that no
+unit test on VALUES can see, because the dense and compacted paths are
+numerically identical.
+
+The ledger makes bytes first-class: every flush-path transfer goes
+through `h2d`/`d2h`, which count the array's nbytes per kind before
+handing it to jnp.asarray / np.asarray. The per-flush totals surface
+as self-telemetry (veneur.flush.transfer_{h2d,d2h}_bytes) and are
+pinned by tests/test_health_ledger.py, which asserts the staged upload
+is ~ samples x 4 + counts x 4 bytes INDEPENDENT OF DEPTH.
+
+Counting sits host-side around the existing transfer calls rather than
+in a jax transfer-guard hook: guards can veto transfers but do not
+expose byte counts, and the flush path's transfers are few and known.
+
+Thread-safety: one ledger per worker; within a flush all writes come
+from the flush thread, but `begin_flush` (swap, under the ingest lock)
+and telemetry reads may race extraction, so mutation goes through a
+lock. Overhead is a dict update per transfer — nanoseconds against a
+millisecond-scale device round-trip.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class TransferLedger:
+    """Byte accounting for one worker's flush-path device transfers."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # per-kind byte tallies for the CURRENT flush (reset by
+        # begin_flush) and for the process lifetime
+        self._flush_h2d: dict[str, int] = {}
+        self._flush_d2h: dict[str, int] = {}
+        self.total_h2d_bytes = 0
+        self.total_d2h_bytes = 0
+        self.flushes = 0
+
+    def begin_flush(self) -> None:
+        with self._lock:
+            self._flush_h2d = {}
+            self._flush_d2h = {}
+            self.flushes += 1
+
+    # -- transfer wrappers ------------------------------------------------
+
+    def h2d(self, host_arr, kind: str):
+        """Count and perform one host->device upload."""
+        import jax.numpy as jnp
+
+        self.count_h2d(host_arr.nbytes, kind)
+        return jnp.asarray(host_arr)
+
+    def d2h(self, dev_arr, kind: str) -> np.ndarray:
+        """Count and perform one device->host readback."""
+        out = np.asarray(dev_arr)
+        self.count_d2h(out.nbytes, kind)
+        return out
+
+    def count_h2d(self, nbytes: int, kind: str) -> None:
+        with self._lock:
+            self._flush_h2d[kind] = self._flush_h2d.get(kind, 0) + int(nbytes)
+            self.total_h2d_bytes += int(nbytes)
+
+    def count_d2h(self, nbytes: int, kind: str) -> None:
+        with self._lock:
+            self._flush_d2h[kind] = self._flush_d2h.get(kind, 0) + int(nbytes)
+            self.total_d2h_bytes += int(nbytes)
+
+    # -- reads ------------------------------------------------------------
+
+    def flush_h2d(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._flush_h2d)
+
+    def flush_d2h(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._flush_d2h)
+
+    def flush_h2d_bytes(self) -> int:
+        with self._lock:
+            return sum(self._flush_h2d.values())
+
+    def flush_d2h_bytes(self) -> int:
+        with self._lock:
+            return sum(self._flush_d2h.values())

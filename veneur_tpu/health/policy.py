@@ -1,0 +1,70 @@
+"""The watchdog-vs-shedding contract.
+
+The reference's flush watchdog is absolute: no flush completion within
+`flush_watchdog_missed_flushes x interval` kills the process
+(server.go:948-990). Combined with bounded-degradation chunked
+extraction that rule is self-defeating — a CPU host legitimately
+grinding through a 40s chunked flush at high cardinality would be
+killed mid-progress, and the restart would re-pay pool growth and XLA
+compiles only to hit the same wall (OVERLOAD_SOAK.json measured a
+22.1s max flush that the reference's watchdog at 2 intervals would
+have tripped on).
+
+The documented contract, implemented by `watchdog_should_defer`:
+
+1. A flush that exceeds the watchdog budget WHILE CHUNKS ARE COMPLETING
+   defers the panic. Completing chunks are proof the flush is draining
+   at the rate the hardware allows; killing it would lose the interval
+   AND the progress. Overload control is the shedding layer's job
+   (Server._adapt_spill_caps halves the C++ spill caps when a flush
+   overruns 90% of the interval) — the watchdog is for WEDGED flushes,
+   not slow ones.
+2. A STALLED chunk does not defer. If no progress beat lands within the
+   stall window — max(interval, STALL_MULTIPLIER x chunk target) — the
+   flush is presumed wedged (deadlocked readback, hung device) and the
+   watchdog panics exactly as the reference would.
+3. With no flush in flight, the deferral never applies: a silent flush
+   loop (died ticker thread, scheduling wedge) panics on the reference
+   schedule.
+
+The stall window's floor is one interval so an UNCHUNKED deployment
+(flush_chunk_target_ms: 0, the TPU default) keeps the reference
+contract unchanged: its only beats are flush begin/end, so any flush
+overdue past the watchdog budget with more than an interval of silence
+panics just as before.
+"""
+
+from __future__ import annotations
+
+# A chunk this many targets late is stalled, not slow: the governor
+# sizes chunks to ~1 target and at most doubles, so a healthy chunk
+# can't legitimately take 4x its prediction plus an interval's slack.
+STALL_MULTIPLIER = 4
+
+
+def stall_window_s(interval_s: float, chunk_target_s: float) -> float:
+    """Maximum progress-beat age that still counts as a live flush."""
+    return max(float(interval_s), STALL_MULTIPLIER * float(chunk_target_s))
+
+
+def watchdog_should_defer(now_unix: float, governor,
+                          interval_s: float) -> tuple[bool, str]:
+    """Decide whether an overdue flush defers the watchdog panic.
+
+    Returns (defer, reason); the reason string is logged either way so
+    the postmortem of a panic (or of a long deferral) is self-reading.
+    """
+    prog = governor.progress()
+    if not prog["in_flight"]:
+        return False, "no flush in flight"
+    window = stall_window_s(interval_s, governor.chunk_target_s)
+    age = now_unix - prog["last_beat_unix"]
+    if age < window:
+        return True, (
+            f"flush in flight with progress {age:.1f}s ago "
+            f"({prog['chunks_done']} chunks done; stall window "
+            f"{window:.1f}s)")
+    return False, (
+        f"flush in flight but stalled: last progress {age:.1f}s ago "
+        f"(>= {window:.1f}s stall window, "
+        f"{prog['chunks_done']} chunks done)")
