@@ -1,0 +1,589 @@
+// Wire-rate load generator / capture / replay (ISSUE 2 tentpole).
+//
+// A *ring* is an immutable sequence of pre-built datagrams. The synth
+// path builds one from a declarative workload spec (metric-type mix,
+// Zipf-distributed key cardinality, tag shape); the capture path
+// records real datagrams off a socket; serialize/load round-trips a
+// ring through a length-prefixed blob bit-exactly, so a captured
+// incident can be replayed against the server byte-for-byte. The send
+// loop cycles the ring at a paced rate with zero Python per packet —
+// Python only starts/stops threads and reads counters, mirroring the
+// reader ABI in dogstatsd.cpp.
+//
+// Pacing uses absolute deadlines (next_ns += lines * ns_per_line) and
+// resyncs instead of bursting when it falls >50ms behind, the same
+// policy as tools/_soak_common.make_blaster: a stalled sender must not
+// follow the stall with an unrealistic packet burst.
+
+#include <atomic>
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------- RNG
+// splitmix64: deterministic across platforms/compilers (std::
+// distributions are implementation-defined, which would break the
+// fixed-seed differential tests).
+struct Rng {
+    uint64_t s;
+    explicit Rng(uint64_t seed) : s(seed) {}
+    uint64_t next() {
+        uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+        z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+        z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+        return z ^ (z >> 31);
+    }
+    double uniform() {  // [0, 1)
+        return (double)(next() >> 11) * (1.0 / 9007199254740992.0);
+    }
+    uint64_t below(uint64_t n) { return n ? next() % n : 0; }
+};
+
+static uint64_t fnv1a64(const void* data, size_t n, uint64_t h) {
+    const unsigned char* p = (const unsigned char*)data;
+    for (size_t i = 0; i < n; i++) {
+        h ^= p[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+static int64_t now_ns() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+// --------------------------------------------------------------- Ring
+struct Ring {
+    std::vector<std::string> dgrams;
+    std::vector<int32_t> lines;  // newline-delimited line count per dgram
+    int64_t total_lines = 0;
+    std::string blob;  // scratch for serialize (pointer stays valid
+                       // until the next serialize call on this ring)
+};
+
+static int32_t count_lines(const std::string& d) {
+    if (d.empty()) return 1;  // still a packet for pacing purposes
+    int32_t n = 0;
+    for (char c : d)
+        if (c == '\n') n++;
+    if (d.back() != '\n') n++;
+    return n;
+}
+
+// Blob format (also the capture file format — load(serialize(r)) is
+// bit-exact by construction): "VLG1" magic, u32le count, then per
+// datagram u32le length + raw bytes.
+static const uint32_t kMagic = 0x31474c56u;  // "VLG1" little-endian
+
+static void put_u32(std::string& out, uint32_t v) {
+    char b[4] = {(char)(v & 0xff), (char)((v >> 8) & 0xff),
+                 (char)((v >> 16) & 0xff), (char)((v >> 24) & 0xff)};
+    out.append(b, 4);
+}
+
+static bool get_u32(const unsigned char* p, size_t n, size_t& off,
+                    uint32_t& v) {
+    if (off + 4 > n) return false;
+    v = (uint32_t)p[off] | ((uint32_t)p[off + 1] << 8) |
+        ((uint32_t)p[off + 2] << 16) | ((uint32_t)p[off + 3] << 24);
+    off += 4;
+    return true;
+}
+
+// -------------------------------------------------------------- Synth
+// Workload spec knobs mirror config.py's loadgen_* keys. Metric type
+// order is fixed: c, g, ms, h, s — type_mix weights index into this.
+static const char* kTypeSuffix[] = {"c", "g", "ms", "h", "s"};
+static const int kNumTypes = 5;
+
+struct Synth {
+    Rng rng;
+    std::vector<double> type_cum;    // cumulative type-mix weights
+    std::vector<double> zipf_cum;    // cumulative Zipf key weights
+    int64_t n_keys;
+    int n_tags;
+    int64_t tag_card;
+    std::string prefix;
+
+    Synth(uint64_t seed, const double* mix, int64_t keys, double zipf_s,
+          int tags, int64_t tagc, const char* pfx, int pfx_len)
+        : rng(seed), n_keys(keys), n_tags(tags), tag_card(tagc),
+          prefix(pfx, (size_t)pfx_len) {
+        double acc = 0;
+        for (int i = 0; i < kNumTypes; i++) {
+            acc += (mix[i] > 0 ? mix[i] : 0);
+            type_cum.push_back(acc);
+        }
+        zipf_cum.reserve((size_t)keys);
+        double zacc = 0;
+        for (int64_t k = 0; k < keys; k++) {
+            zacc += 1.0 / std::pow((double)(k + 1), zipf_s);
+            zipf_cum.push_back(zacc);
+        }
+    }
+
+    int pick_type() {
+        double u = rng.uniform() * type_cum.back();
+        for (int i = 0; i < kNumTypes; i++)
+            if (u < type_cum[i]) return i;
+        return kNumTypes - 1;
+    }
+
+    int64_t pick_key() {
+        double u = rng.uniform() * zipf_cum.back();
+        size_t lo = 0, hi = zipf_cum.size();
+        while (lo < hi) {
+            size_t mid = (lo + hi) / 2;
+            if (zipf_cum[mid] <= u) lo = mid + 1; else hi = mid;
+        }
+        return (int64_t)(lo < zipf_cum.size() ? lo : zipf_cum.size() - 1);
+    }
+
+    // One DogStatsD line. Tag values are a deterministic function of
+    // (key, slot) so a key names ONE series: realized series
+    // cardinality equals realized key cardinality, not its product
+    // with tag_card^n_tags.
+    void emit_line(std::string& out) {
+        int t = pick_type();
+        int64_t key = pick_key();
+        char buf[64];
+        out += prefix;
+        snprintf(buf, sizeof buf, ".%s%lld:", kTypeSuffix[t],
+                 (long long)key);
+        out += buf;
+        switch (t) {
+        case 0:  // counter: small positive integer deltas
+            snprintf(buf, sizeof buf, "%llu",
+                     (unsigned long long)(rng.below(100) + 1));
+            break;
+        case 1:  // gauge
+            snprintf(buf, sizeof buf, "%llu.%02llu",
+                     (unsigned long long)rng.below(10000),
+                     (unsigned long long)rng.below(100));
+            break;
+        case 2:  // timer (ms)
+        case 3:  // histogram
+            snprintf(buf, sizeof buf, "%llu.%03llu",
+                     (unsigned long long)rng.below(2000),
+                     (unsigned long long)rng.below(1000));
+            break;
+        default:  // set: member id, cardinality bounded by tag_card
+            snprintf(buf, sizeof buf, "e%llu",
+                     (unsigned long long)rng.below(
+                         (uint64_t)(tag_card > 0 ? tag_card : 64)));
+            break;
+        }
+        out += buf;
+        out += '|';
+        out += kTypeSuffix[t];
+        if (n_tags > 0) {
+            out += "|#";
+            uint64_t h = fnv1a64(&key, sizeof key, 1469598103934665603ULL);
+            for (int i = 0; i < n_tags; i++) {
+                h = fnv1a64(&i, sizeof i, h);
+                snprintf(buf, sizeof buf, "%st%d:v%llu",
+                         i ? "," : "", i,
+                         (unsigned long long)(tag_card > 0
+                                                  ? h % (uint64_t)tag_card
+                                                  : 0));
+                out += buf;
+            }
+        }
+    }
+};
+
+// ------------------------------------------------------------- Sender
+struct Sender {
+    std::thread th;
+    std::atomic<bool> stop{false};
+    std::atomic<bool> done{false};
+    std::atomic<int64_t> sent_lines{0};
+    std::atomic<int64_t> sent_packets{0};
+    std::atomic<int64_t> send_errors{0};
+    std::atomic<int64_t> resyncs{0};
+    std::atomic<int64_t> elapsed_ns{0};
+    Ring* ring = nullptr;  // borrowed; caller keeps it alive
+    int fd = -1;
+    double lines_per_s = 0;
+    int64_t max_lines = 0;  // 0 = until stopped
+    bool stream_mode = false;
+};
+
+static void sender_loop(Sender* s) {
+    const size_t n = s->ring->dgrams.size();
+    const double ns_per_line =
+        s->lines_per_s > 0 ? 1e9 / s->lines_per_s : 0.0;
+    std::string scratch;
+    int64_t start = now_ns();
+    int64_t next_t = start;
+    size_t i = 0;
+    while (!s->stop.load(std::memory_order_relaxed)) {
+        if (s->max_lines > 0 &&
+            s->sent_lines.load(std::memory_order_relaxed) >= s->max_lines)
+            break;
+        const std::string& d = s->ring->dgrams[i];
+        const int32_t lines = s->ring->lines[i];
+        i = (i + 1 == n) ? 0 : i + 1;
+        const char* data = d.data();
+        size_t len = d.size();
+        if (s->stream_mode) {
+            // TCP framing: the stream reader splits on newlines, so a
+            // datagram becomes its lines plus a trailing newline
+            scratch.assign(d);
+            if (scratch.empty() || scratch.back() != '\n')
+                scratch += '\n';
+            data = scratch.data();
+            len = scratch.size();
+        }
+        ssize_t r = send(s->fd, data, len, 0);
+        if (r < 0) {
+            if (errno == EINTR) continue;  // retry same datagram
+            s->send_errors.fetch_add(1, std::memory_order_relaxed);
+        } else {
+            s->sent_packets.fetch_add(1, std::memory_order_relaxed);
+            s->sent_lines.fetch_add(lines, std::memory_order_relaxed);
+        }
+        if (ns_per_line > 0) {
+            next_t += (int64_t)(lines * ns_per_line);
+            int64_t now = now_ns();
+            if (next_t - now > 2000) {
+                struct timespec ts;
+                ts.tv_sec = next_t / 1000000000LL;
+                ts.tv_nsec = next_t % 1000000000LL;
+                clock_nanosleep(CLOCK_MONOTONIC, TIMER_ABSTIME, &ts,
+                                nullptr);
+            } else if (now - next_t > 50000000LL) {
+                // >50ms behind: resync, never burst the backlog
+                next_t = now;
+                s->resyncs.fetch_add(1, std::memory_order_relaxed);
+            }
+        }
+    }
+    s->elapsed_ns.store(now_ns() - start, std::memory_order_relaxed);
+    s->done.store(true, std::memory_order_release);
+}
+
+// ------------------------------------------------------------ Capture
+struct Capture {
+    std::thread th;
+    std::atomic<bool> stop{false};
+    std::atomic<int64_t> packets{0};
+    std::atomic<int64_t> bytes{0};
+    std::atomic<int64_t> truncated{0};
+    int fd = -1;
+    int max_len = 0;
+    int64_t max_packets = 0;  // 0 = unbounded
+    std::vector<std::string> dgrams;  // thread-private until joined
+};
+
+static void capture_loop(Capture* c) {
+    std::vector<char> buf((size_t)c->max_len + 1);
+    while (!c->stop.load(std::memory_order_relaxed)) {
+        if (c->max_packets > 0 &&
+            (int64_t)c->dgrams.size() >= c->max_packets)
+            break;
+        ssize_t n = recv(c->fd, buf.data(), buf.size(), 0);
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+                continue;  // SO_RCVTIMEO poll tick
+            break;
+        }
+        if (n > c->max_len) {
+            // oversized datagram cannot be replayed bit-exactly
+            c->truncated.fetch_add(1, std::memory_order_relaxed);
+            continue;
+        }
+        c->dgrams.emplace_back(buf.data(), (size_t)n);
+        c->packets.fetch_add(1, std::memory_order_relaxed);
+        c->bytes.fetch_add(n, std::memory_order_relaxed);
+    }
+}
+
+}  // namespace
+
+#ifndef LG_SOURCE_HASH
+#define LG_SOURCE_HASH "unstamped"
+#endif
+
+extern "C" {
+
+const char* vn_lg_source_hash() { return LG_SOURCE_HASH; }
+
+// ----- ring lifecycle -------------------------------------------------
+void* vn_lg_ring_new() { return new Ring(); }
+
+void vn_lg_ring_free(void* r) { delete (Ring*)r; }
+
+long long vn_lg_ring_count(void* r) {
+    return (long long)((Ring*)r)->dgrams.size();
+}
+
+long long vn_lg_ring_total_lines(void* r) {
+    return (long long)((Ring*)r)->total_lines;
+}
+
+long long vn_lg_ring_total_bytes(void* r) {
+    long long n = 0;
+    for (const auto& d : ((Ring*)r)->dgrams) n += (long long)d.size();
+    return n;
+}
+
+// Content hash over (length, bytes) pairs — the cheap bit-exactness
+// assertion for capture→replay round trips.
+unsigned long long vn_lg_ring_hash(void* r) {
+    uint64_t h = 1469598103934665603ULL;
+    for (const auto& d : ((Ring*)r)->dgrams) {
+        uint64_t len = d.size();
+        h = fnv1a64(&len, sizeof len, h);
+        h = fnv1a64(d.data(), d.size(), h);
+    }
+    return h;
+}
+
+// Borrowed pointer to datagram i (valid until the ring is mutated or
+// freed). Returns length, -1 if out of range.
+long long vn_lg_ring_datagram(void* r, long long i, const char** out) {
+    Ring* ring = (Ring*)r;
+    if (i < 0 || (size_t)i >= ring->dgrams.size()) return -1;
+    *out = ring->dgrams[(size_t)i].data();
+    return (long long)ring->dgrams[(size_t)i].size();
+}
+
+// Append one externally-built datagram (used for SSF rings, whose
+// payloads Python builds once at setup time via the generated
+// protobuf; the per-packet send path stays in C++).
+long long vn_lg_ring_append(void* r, const char* data, long long len,
+                            int lines) {
+    if (len < 0 || lines < 0) return -1;
+    Ring* ring = (Ring*)r;
+    ring->dgrams.emplace_back(data, (size_t)len);
+    ring->lines.push_back(lines > 0 ? lines : 1);
+    ring->total_lines += (lines > 0 ? lines : 1);
+    return (long long)ring->dgrams.size();
+}
+
+// ----- synth ----------------------------------------------------------
+// Build ~n_lines of DogStatsD traffic into the ring, packed into
+// datagrams of at most dgram_target bytes. type_mix is 5 weights in
+// fixed order {c, g, ms, h, s}. Returns datagram count, -1 on bad args.
+long long vn_lg_ring_synth(void* r, unsigned long long seed,
+                           long long n_keys, double zipf_s,
+                           const double* type_mix,
+                           int n_tags, long long tag_card,
+                           const char* prefix, int prefix_len,
+                           int dgram_target, long long n_lines) {
+    if (!r || !type_mix || !prefix || n_keys <= 0 ||
+        n_keys > (1LL << 24) || n_lines <= 0 || prefix_len <= 0 ||
+        n_tags < 0 || n_tags > 16 || dgram_target < 64 ||
+        dgram_target > 65507 || zipf_s < 0)
+        return -1;
+    double mix_sum = 0;
+    for (int i = 0; i < kNumTypes; i++) {
+        if (type_mix[i] < 0) return -1;
+        mix_sum += type_mix[i];
+    }
+    if (mix_sum <= 0) return -1;
+    Ring* ring = (Ring*)r;
+    Synth sy(seed, type_mix, n_keys, zipf_s, n_tags, tag_card, prefix,
+             prefix_len);
+    std::string dgram, line;
+    int32_t dlines = 0;
+    for (int64_t i = 0; i < n_lines; i++) {
+        line.clear();
+        sy.emit_line(line);
+        if (!dgram.empty() &&
+            dgram.size() + 1 + line.size() > (size_t)dgram_target) {
+            ring->dgrams.push_back(dgram);
+            ring->lines.push_back(dlines);
+            ring->total_lines += dlines;
+            dgram.clear();
+            dlines = 0;
+        }
+        if (!dgram.empty()) dgram += '\n';
+        dgram += line;
+        dlines++;
+    }
+    if (!dgram.empty()) {
+        ring->dgrams.push_back(dgram);
+        ring->lines.push_back(dlines);
+        ring->total_lines += dlines;
+    }
+    return (long long)ring->dgrams.size();
+}
+
+// ----- serialize / load ----------------------------------------------
+// Returns blob length and sets *out to a pointer owned by the ring
+// (valid until the next serialize call or free).
+long long vn_lg_ring_serialize(void* r, const char** out) {
+    Ring* ring = (Ring*)r;
+    ring->blob.clear();
+    put_u32(ring->blob, kMagic);
+    put_u32(ring->blob, (uint32_t)ring->dgrams.size());
+    for (const auto& d : ring->dgrams) {
+        put_u32(ring->blob, (uint32_t)d.size());
+        ring->blob += d;
+    }
+    *out = ring->blob.data();
+    return (long long)ring->blob.size();
+}
+
+// Replaces the ring's contents from a serialized blob. Returns
+// datagram count, -1 on malformed input (ring left empty).
+long long vn_lg_ring_load(void* r, const char* data, long long len) {
+    Ring* ring = (Ring*)r;
+    ring->dgrams.clear();
+    ring->lines.clear();
+    ring->total_lines = 0;
+    if (!data || len < 8) return -1;
+    const unsigned char* p = (const unsigned char*)data;
+    size_t n = (size_t)len, off = 0;
+    uint32_t magic = 0, count = 0;
+    if (!get_u32(p, n, off, magic) || magic != kMagic) return -1;
+    if (!get_u32(p, n, off, count)) return -1;
+    for (uint32_t i = 0; i < count; i++) {
+        uint32_t dlen = 0;
+        if (!get_u32(p, n, off, dlen) || off + dlen > n) {
+            ring->dgrams.clear();
+            ring->lines.clear();
+            ring->total_lines = 0;
+            return -1;
+        }
+        ring->dgrams.emplace_back((const char*)p + off, (size_t)dlen);
+        off += dlen;
+        int32_t lines = count_lines(ring->dgrams.back());
+        ring->lines.push_back(lines);
+        ring->total_lines += lines;
+    }
+    return (long long)ring->dgrams.size();
+}
+
+// ----- sender ---------------------------------------------------------
+// Starts the paced send thread over a connected socket fd. The fd and
+// the ring stay owned by the caller and must outlive the sender.
+// lines_per_s <= 0 means unpaced (max rate); max_lines 0 means run
+// until stop. stream_mode appends newline framing for TCP sockets.
+void* vn_lg_send_start(void* ring, int fd, double lines_per_s,
+                       long long max_lines, int stream_mode) {
+    Ring* rg = (Ring*)ring;
+    if (!rg || rg->dgrams.empty() || fd < 0) return nullptr;
+    Sender* s = new Sender();
+    s->ring = rg;
+    s->fd = fd;
+    s->lines_per_s = lines_per_s;
+    s->max_lines = max_lines;
+    s->stream_mode = stream_mode != 0;
+    s->th = std::thread(sender_loop, s);
+    return s;
+}
+
+long long vn_lg_send_lines(void* s) {
+    return ((Sender*)s)->sent_lines.load(std::memory_order_relaxed);
+}
+long long vn_lg_send_packets(void* s) {
+    return ((Sender*)s)->sent_packets.load(std::memory_order_relaxed);
+}
+long long vn_lg_send_errors(void* s) {
+    return ((Sender*)s)->send_errors.load(std::memory_order_relaxed);
+}
+long long vn_lg_send_resyncs(void* s) {
+    return ((Sender*)s)->resyncs.load(std::memory_order_relaxed);
+}
+int vn_lg_send_done(void* s) {
+    return ((Sender*)s)->done.load(std::memory_order_acquire) ? 1 : 0;
+}
+
+// Joins the thread (idempotent) and returns elapsed ns of the send
+// loop (0 if it never ran). The sender and its final counters stay
+// readable until vn_lg_send_free.
+long long vn_lg_send_stop(void* sp) {
+    Sender* s = (Sender*)sp;
+    s->stop.store(true, std::memory_order_relaxed);
+    if (s->th.joinable()) s->th.join();
+    return s->elapsed_ns.load(std::memory_order_relaxed);
+}
+
+void vn_lg_send_free(void* sp) {
+    Sender* s = (Sender*)sp;
+    s->stop.store(true, std::memory_order_relaxed);
+    if (s->th.joinable()) s->th.join();
+    delete s;
+}
+
+// ----- capture --------------------------------------------------------
+// Starts capturing datagrams from fd (made blocking with a 100ms
+// receive timeout, mirroring vn_reader_start). fd ownership stays with
+// the caller. max_packets 0 = unbounded.
+void* vn_lg_capture_start(int fd, int max_len, long long max_packets) {
+    if (fd < 0 || max_len <= 0 || max_len > (1 << 20)) return nullptr;
+    int flags = fcntl(fd, F_GETFL, 0);
+    if (flags < 0 || fcntl(fd, F_SETFL, flags & ~O_NONBLOCK) < 0)
+        return nullptr;
+    struct timeval tv;
+    tv.tv_sec = 0;
+    tv.tv_usec = 100000;
+    if (setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv) < 0)
+        return nullptr;
+    Capture* c = new Capture();
+    c->fd = fd;
+    c->max_len = max_len;
+    c->max_packets = max_packets;
+    c->th = std::thread(capture_loop, c);
+    return c;
+}
+
+long long vn_lg_capture_packets(void* c) {
+    return ((Capture*)c)->packets.load(std::memory_order_relaxed);
+}
+long long vn_lg_capture_truncated(void* c) {
+    return ((Capture*)c)->truncated.load(std::memory_order_relaxed);
+}
+
+// Stops the capture thread (joins it). Data stays in the capture
+// handle until detached or freed.
+long long vn_lg_capture_stop(void* cp) {
+    Capture* c = (Capture*)cp;
+    c->stop.store(true, std::memory_order_relaxed);
+    if (c->th.joinable()) c->th.join();
+    return (long long)c->dgrams.size();
+}
+
+// After stop: moves the captured datagrams into a NEW ring (the
+// capture handle is left empty). Replay is then capture → detach →
+// vn_lg_send_start on the ring.
+void* vn_lg_capture_detach_ring(void* cp) {
+    Capture* c = (Capture*)cp;
+    if (c->th.joinable()) return nullptr;  // must stop first
+    Ring* ring = new Ring();
+    ring->dgrams = std::move(c->dgrams);
+    c->dgrams.clear();
+    for (const auto& d : ring->dgrams) {
+        int32_t lines = count_lines(d);
+        ring->lines.push_back(lines);
+        ring->total_lines += lines;
+    }
+    return ring;
+}
+
+void vn_lg_capture_free(void* cp) {
+    Capture* c = (Capture*)cp;
+    c->stop.store(true, std::memory_order_relaxed);
+    if (c->th.joinable()) c->th.join();
+    delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,195 @@
+"""Loadgen subsystem tests: ring synthesis determinism, bit-exact
+capture→replay, parser parity of generated traffic, paced send rate,
+and the Server ingress-stats hook the sustained-pipeline controller
+reads (veneur_tpu/loadgen/, native/loadgen.cpp)."""
+
+import socket
+import time
+
+import pytest
+
+from veneur_tpu import native as native_mod
+
+if not native_mod.loadgen_available():  # pragma: no cover
+    pytest.skip("loadgen native library unavailable",
+                allow_module_level=True)
+
+from veneur_tpu.core.config import Config, validate_config
+from veneur_tpu.core.server import Server
+from veneur_tpu.loadgen.spec import WorkloadSpec
+from veneur_tpu.protocol import ssf_wire
+from veneur_tpu.protocol.dogstatsd import parse_metric
+
+
+def small_spec(**kw) -> WorkloadSpec:
+    base = dict(seed=11, num_keys=200, zipf_s=1.1, num_tags=2,
+                tag_cardinality=10, datagram_bytes=512, ring_lines=500)
+    base.update(kw)
+    return WorkloadSpec(**base)
+
+
+def test_synth_deterministic():
+    a = small_spec().build_ring()
+    b = small_spec().build_ring()
+    assert a.content_hash == b.content_hash
+    assert len(a) == len(b)
+    assert a.total_lines == b.total_lines == 500
+    assert small_spec(seed=12).build_ring().content_hash != a.content_hash
+
+
+def test_synth_respects_datagram_target():
+    ring = small_spec().build_ring()
+    for d in ring.datagrams():
+        assert 0 < len(d) <= 512
+        assert not d.endswith(b"\n")
+
+
+def test_serialize_load_bit_exact():
+    ring = small_spec().build_ring()
+    blob = ring.serialize()
+    other = native_mod.LoadgenRing()
+    assert other.load(blob) == len(ring)
+    assert other.content_hash == ring.content_hash
+    assert other.total_lines == ring.total_lines
+    assert other.datagram(0) == ring.datagram(0)
+    assert other.datagram(len(ring) - 1) == ring.datagram(len(ring) - 1)
+    # the capture format IS the serialize format, so load(serialize(x))
+    # re-serializes identically
+    assert other.serialize() == blob
+
+
+def test_ring_append():
+    ring = native_mod.LoadgenRing()
+    ring.append(b"x.a:1|c", lines=1)
+    ring.append(b"x.a:1|c\nx.b:2|g", lines=2)
+    assert len(ring) == 2
+    assert ring.total_lines == 3
+    assert ring.datagram(1) == b"x.a:1|c\nx.b:2|g"
+
+
+def test_spec_validation():
+    for bad in (dict(num_keys=0), dict(zipf_s=-1.0), dict(num_tags=17),
+                dict(type_mix=[0.0] * 5), dict(type_mix=[1.0]),
+                dict(datagram_bytes=10), dict(ring_lines=0),
+                dict(prefix="")):
+        with pytest.raises(ValueError):
+            small_spec(**bad).build_ring()
+
+
+def test_config_loadgen_validation():
+    # validation runs on load_config's path, same as the other keys
+    with pytest.raises(ValueError):
+        validate_config(Config(loadgen_num_keys=0))
+    with pytest.raises(ValueError):
+        validate_config(Config(loadgen_type_mix=[1.0, 1.0]))
+    with pytest.raises(ValueError):
+        validate_config(Config(loadgen_prefix="9bad"))
+    validate_config(Config())
+    spec = WorkloadSpec.from_config(Config())
+    spec.validate()
+
+
+def test_generated_lines_parse_both_parsers():
+    """Differential property (tools/fuzz_differential.py loadgen target
+    pins it at one spec here): Python parser, C++ parser and the ring's
+    own line tally agree on every generated datagram."""
+    ring = small_spec().build_ring()
+    ni = native_mod.NativeIngest()
+    py_total = 0
+    for dgram in ring.datagrams():
+        for line in dgram.split(b"\n"):
+            m = parse_metric(line)  # raises ParseError on divergence
+            assert m.key.name.startswith("lg.")
+            py_total += 1
+        ni.ingest(dgram)
+    assert py_total == ring.total_lines
+    assert ni.processed == ring.total_lines
+    assert ni.errors == 0
+
+
+def test_ssf_ring_parses_both_paths():
+    spec = small_spec()
+    ring = spec.build_ssf_ring(n_spans=25)
+    assert ring.total_lines == 25
+    ni = native_mod.NativeIngest()
+    for payload in ring.datagrams():
+        span = ssf_wire.parse_ssf(payload)
+        assert span.name.startswith("lg.")
+        assert ni.ingest_ssf(payload, b"ind.t", b"obj.t") == 1
+
+
+def test_capture_replay_bit_exact():
+    """The replay acceptance property: what the wire carried is what a
+    fresh sender will offer again — capture of a full ring pass hashes
+    identically to the source ring."""
+    ring = small_spec().build_ring()
+    a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_DGRAM)
+    try:
+        cap = native_mod.LoadgenCapture(a.fileno(), max_len=2048,
+                                        max_packets=len(ring))
+        sender = native_mod.LoadgenSender(ring, b.fileno(),
+                                          lines_per_s=2_000_000,
+                                          max_lines=ring.total_lines)
+        deadline = time.time() + 30
+        while cap.packets < len(ring) and time.time() < deadline:
+            time.sleep(0.01)
+        sender.stop()
+        assert cap.truncated == 0
+        assert cap.packets == len(ring)
+        cap.stop()
+        replay = cap.detach_ring()
+    finally:
+        a.close()
+        b.close()
+    assert replay.content_hash == ring.content_hash
+    assert replay.serialize() == ring.serialize()
+
+
+def test_sender_paces_and_stops_at_max_lines():
+    ring = small_spec().build_ring()
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    send = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    send.connect(recv.getsockname())
+    try:
+        sender = native_mod.LoadgenSender(ring, send.fileno(),
+                                          lines_per_s=25_000,
+                                          max_lines=5_000)
+        deadline = time.time() + 10
+        while not sender.done and time.time() < deadline:
+            time.sleep(0.01)
+        assert sender.done
+        elapsed = sender.stop()
+        assert sender.sent_lines == 5_000
+        assert sender.send_errors == 0
+        # 5k lines at 25k lines/s ≈ 0.2s; generous bounds for a loaded
+        # CI host, but tight enough to catch a broken pacer (instant
+        # blast or 10x stall)
+        assert 0.1 < elapsed < 2.0
+    finally:
+        send.close()
+        recv.close()
+
+
+def test_server_ingress_stats_survive_flush():
+    """samples_processed must be a lifetime counter: the per-epoch
+    `processed` resets at swap, so the controller's loss accounting
+    depends on Worker.processed_total accumulating across flushes."""
+    cfg = Config(interval="10s", num_workers=1, percentiles=[0.5])
+    srv = Server(cfg)
+    try:
+        for i in range(60):
+            srv.process_metric_packet(b"ig.c%d:1|c" % (i % 7))
+        st = srv.ingress_stats()
+        assert st["samples_processed"] == 60
+        assert st["overload_dropped"] == 0
+        srv.flush()
+        assert srv.ingress_stats()["samples_processed"] == 60
+        for _ in range(15):
+            srv.process_metric_packet(b"ig.more:2.5|ms")
+        srv.flush()
+        st = srv.ingress_stats()
+        assert st["samples_processed"] == 75
+        assert st["flush_count"] >= 2
+    finally:
+        srv.shutdown()

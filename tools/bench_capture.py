@@ -48,6 +48,69 @@ AUX_ARTIFACTS = ("E2E_FLUSH.json", "E2E_SCALING.json", "OVERLAP.json",
 
 _current_child: subprocess.Popen | None = None
 
+HEARTBEAT_PATH = "/tmp/veneur_bench_capture.hb.json"
+
+
+class Heartbeat:
+    """Self-watchdog for the capture loop itself.
+
+    The loop's own failure modes are silent: a flock() wait against an
+    orphan holding the axon lock, or a stdout read on a child whose
+    relay wedged AFTER the marker, produce no log lines at all — from
+    the outside a healthy-but-idle loop and a dead one look identical.
+    A daemon thread writes a phase-stamped heartbeat file every
+    ``period`` seconds (so `cat /tmp/veneur_bench_capture.hb.json`
+    answers "is it alive and where is it stuck"), and once no progress
+    has been recorded for ``stall_after`` seconds it starts shouting on
+    stderr each beat until progress resumes. It never kills anything —
+    run_suite's Timers own that; this only makes the stall visible."""
+
+    def __init__(self, period: float = 30.0, stall_after: float = 900.0):
+        self.period = period
+        self.stall_after = stall_after
+        self._lock = threading.Lock()
+        self._phase = "startup"
+        self._last_progress = time.time()
+        t = threading.Thread(target=self._run, daemon=True,
+                             name="capture-heartbeat")
+        t.start()
+
+    def beat(self, phase: str) -> None:
+        with self._lock:
+            self._phase = phase
+            self._last_progress = time.time()
+
+    def _run(self) -> None:
+        while True:
+            time.sleep(self.period)
+            with self._lock:
+                phase, last = self._phase, self._last_progress
+            age = time.time() - last
+            stalled = age > self.stall_after
+            try:
+                tmp = HEARTBEAT_PATH + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"pid": os.getpid(), "phase": phase,
+                               "last_progress_unix": last,
+                               "age_s": round(age, 1),
+                               "stalled": stalled}, f)
+                os.replace(tmp, HEARTBEAT_PATH)
+            except OSError:
+                pass
+            if stalled:
+                print(f"capture: WATCHDOG no progress for {age:.0f}s "
+                      f"(phase={phase}) — loop is stalled, likely a "
+                      "flock wait or a wedged post-marker child",
+                      file=sys.stderr)
+
+
+_hb: Heartbeat | None = None
+
+
+def _beat(phase: str) -> None:
+    if _hb is not None:
+        _hb.beat(phase)
+
 
 def axon_lock():
     f = open(LOCK_PATH, "w")
@@ -121,6 +184,7 @@ def run_suite(on_result, marker_timeout: float = 600.0,
         t_total.start()
         try:
             for raw in proc.stdout:
+                _beat("suite_output")
                 line = raw.decode(errors="replace").strip()
                 if not line.startswith("{"):
                     continue
@@ -191,8 +255,11 @@ def capture_pass() -> tuple[bool, set]:
         os.replace(tmp, CACHE)
         print(f"capture: {name}: {res}", file=sys.stderr)
 
+    _beat("axon_lock_wait")
     with axon_lock():
+        _beat("suite_start")
         live = run_suite(on_result)
+    _beat("suite_done")
     return live, fresh
 
 
@@ -235,6 +302,7 @@ def _wait_or_new_listener(seconds: float, baseline: set) -> None:
     `baseline` starts listening (a possible relay revival)."""
     end = time.time() + seconds
     while time.time() < end:
+        _beat("idle_wait")
         time.sleep(min(10.0, max(0.0, end - time.time())))
         new = _local_listeners() - baseline
         if new:
@@ -271,8 +339,12 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _reap)
     signal.signal(signal.SIGINT, _reap)
 
+    global _hb
+    _hb = Heartbeat(stall_after=max(900.0, 1.5 * args.interval))
+
     deadline = time.time() + args.max_hours * 3600
     while time.time() < deadline:
+        _beat("cycle_start")
         live, fresh = capture_pass()
         if live and all_captured(fresh):
             print("capture: complete on-chip artifact set captured",

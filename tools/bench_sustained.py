@@ -1,0 +1,190 @@
+"""Sustained-pipeline rate measurement: the standing load harness.
+
+Drives a live Server's real sockets with the C++ paced sender
+(native/loadgen.cpp — zero Python per packet) and either searches for
+the maximum sustained rate (default; writes SUSTAINED_PIPELINE.json at
+the repo root) or, with --smoke, validates that the pipeline holds one
+fixed floor rate across a few flush intervals (the bounded CI lane —
+exit 1 on failure).
+
+The north-star arithmetic in PERF_MODEL.md divides by THIS number, not
+the parse microbench: a reader core in production pays datagram
+syscalls, commit-mutex contention and its slice of flush work, all of
+which this harness includes and the microbench does not.
+
+Usage:
+    python tools/bench_sustained.py                       # full search
+    python tools/bench_sustained.py --smoke --rate 5e5    # CI floor gate
+    python tools/bench_sustained.py --save-ring ring.vlg  # persist ring
+    python tools/bench_sustained.py --replay ring.vlg     # bit-exact ring
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _reexec_scrubbed() -> None:
+    # Fresh interpreter without the axon pool var: the dev rig's site
+    # hook registers the wedging single-client TPU relay plugin at
+    # interpreter startup, so in-process env edits are too late
+    # (tools/soak_topology.py, TPU_BACKEND.md recipe).
+    if os.environ.get("_VENEUR_LG_REEXEC") == "1":
+        return
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["_VENEUR_LG_REEXEC"] = "1"
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+              env)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="single fixed-rate pass/fail run (CI lane)")
+    ap.add_argument("--rate", type=float, default=5e5,
+                    help="offered lines/s for --smoke / --replay")
+    ap.add_argument("--intervals", type=int, default=0,
+                    help="flush intervals per run (default: 3 smoke, "
+                         "10 confirm)")
+    ap.add_argument("--interval", default="2s",
+                    help="server flush interval (short keeps the "
+                         "bounded lanes bounded)")
+    ap.add_argument("--transport", default="udp",
+                    choices=["udp", "tcp", "unixgram"])
+    ap.add_argument("--max-loss", type=float, default=0.01)
+    ap.add_argument("--min-cadence", type=float, default=0.75,
+                    help="fraction of intervals whose flushes must land "
+                         "on time (--smoke/--replay; short runs need "
+                         "slack for one straggler flush)")
+    ap.add_argument("--start-rate", type=float, default=100e3)
+    ap.add_argument("--max-rate", type=float, default=20e6)
+    ap.add_argument("--ring-lines", type=int, default=0,
+                    help="override loadgen_ring_lines")
+    ap.add_argument("--keys", type=int, default=0,
+                    help="override loadgen_num_keys (the CI smoke uses "
+                         "a lighter series count so flush work fits a "
+                         "1-core rig's interval; the default workload "
+                         "is ~5x keys in series)")
+    ap.add_argument("--save-ring", metavar="PATH",
+                    help="serialize the synth ring to PATH and exit")
+    ap.add_argument("--replay", metavar="PATH",
+                    help="drive a previously saved ring blob bit-exactly"
+                         " instead of synthesizing")
+    ap.add_argument("--out", default="SUSTAINED_PIPELINE.json",
+                    help="artifact name (repo root; search mode only)")
+    args = ap.parse_args()
+    _reexec_scrubbed()
+
+    from _soak_common import write_artifact
+    from veneur_tpu import native
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.loadgen import LoadHarness, WorkloadSpec, run_trial
+    from veneur_tpu.loadgen.controller import (result_artifact,
+                                               search_sustained)
+
+    if not native.loadgen_available():
+        print("loadgen native library unavailable", file=sys.stderr)
+        sys.exit(2)
+
+    listen = {"udp": "udp://127.0.0.1:0",
+              "tcp": "tcp://127.0.0.1:0",
+              "unixgram": "unixgram:///tmp/veneur_lg_%d.sock"
+                          % os.getpid()}[args.transport]
+    cfg = Config(
+        statsd_listen_addresses=[listen],
+        interval=args.interval,
+        num_workers=1, num_readers=1,
+        percentiles=[0.5, 0.99],
+        # a serious rcvbuf: kernel drops are measured as loss, not
+        # hidden by a tiny default buffer
+        read_buffer_size_bytes=8 * 1048576,
+        **({"loadgen_ring_lines": args.ring_lines}
+           if args.ring_lines else {}),
+        **({"loadgen_num_keys": args.keys} if args.keys else {}),
+    )
+    spec = WorkloadSpec.from_config(cfg)
+
+    if args.save_ring:
+        ring = spec.build_ring()
+        with open(args.save_ring, "wb") as f:
+            f.write(ring.serialize())
+        print(json.dumps({"saved": args.save_ring,
+                          "datagrams": len(ring),
+                          "lines": ring.total_lines,
+                          "content_hash": "%016x" % ring.content_hash}))
+        return
+
+    ring = None
+    if args.replay:
+        ring = native.LoadgenRing()
+        with open(args.replay, "rb") as f:
+            ring.load(f.read())
+        print(json.dumps({"replay": args.replay,
+                          "datagrams": len(ring),
+                          "content_hash": "%016x" % ring.content_hash}),
+              file=sys.stderr)
+
+    try:
+        import jax
+        platform = jax.default_backend()
+    except Exception:
+        platform = "unknown"
+
+    harness = LoadHarness(cfg, spec, transport=args.transport, ring=ring)
+    try:
+        if not harness.warmup():
+            print("warmup: flush path never came up", file=sys.stderr)
+            sys.exit(1)
+        if args.smoke or args.replay:
+            n = args.intervals or 3
+            trial = run_trial(harness, args.rate, n,
+                              max_loss=args.max_loss,
+                              min_cadence=args.min_cadence)
+            print(json.dumps({
+                "metric": "sustained_smoke_lines_per_s",
+                "value": trial["accepted_lines_per_s"],
+                "unit": "lines/s",
+                "offered": args.rate,
+                "loss_frac": trial["loss_frac"],
+                "cadence_frac": trial["cadence_frac"],
+                "passed": trial["passed"],
+                "platform": platform,
+            }))
+            if not trial["passed"]:
+                sys.exit(1)
+            return
+        t0 = time.time()
+        search = search_sustained(
+            harness, start_rate=args.start_rate, max_rate=args.max_rate,
+            confirm_intervals=args.intervals or 10,
+            max_loss=args.max_loss)
+        out = result_artifact(spec, harness, search, platform)
+        out["wall_s"] = round(time.time() - t0, 1)
+        write_artifact(args.out, out)
+        print(json.dumps({
+            "metric": "sustained_pipeline_lines_per_s",
+            "value": out["sustained_pipeline_lines_per_s"],
+            "unit": "lines/s",
+            "confirmed": out["confirmed"],
+            "cores_needed_for_north_star":
+                out["cores_needed_for_north_star"],
+            "platform": platform,
+        }))
+        if not out["confirmed"]:
+            sys.exit(1)
+    finally:
+        harness.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,24 @@ echo "== tier-1 health lane (governor + transfer ledger) =="
 python -m pytest tests/test_health_governor.py tests/test_health_ledger.py \
   -q -m 'not slow'
 
+# Sustained-rate floor: the loadgen harness drives a live server's UDP
+# socket at a fixed offered rate for 5 flush intervals and fails on
+# loss or broken flush cadence. 30k lines/s is deliberately ~half the
+# 1-core dev rig's measured cadence-bound rate (57.6k,
+# SUSTAINED_PIPELINE.json) so host noise doesn't flake the lane, while
+# a real pipeline regression (parse slowdown, flush stall, shed storm)
+# still trips it; min-cadence 0.7 tolerates one straggler flush in 5
+# (XLA-CPU occasionally recompiles mid-run on this rig), two fail.
+# --keys 2000 (~10k series) keeps per-flush XLA work well inside the
+# 2s interval on one core — the default 10k-key workload's ~50k series
+# cost 2-4s per flush here, which gates the rig's flush latency, not
+# the packet path this lane is for. Bounded: warmup + 5×2s intervals
+# under a hard cap.
+echo "== sustained-rate smoke (loadgen floor gate) =="
+timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python tools/bench_sustained.py --smoke --rate 30000 --intervals 5 \
+    --interval 2s --min-cadence 0.7 --keys 2000
+
 echo "== test suite =="
 python -m pytest tests/ -q
 

@@ -289,9 +289,82 @@ def fuzz_ssf_stream(rng, t_end) -> int:
     return n
 
 
+def fuzz_loadgen(rng, t_end) -> int:
+    """Generated-traffic differential (the loadgen ring synthesizer is
+    a third codec): every DogStatsD line a randomized WorkloadSpec
+    synthesizes must be ACCEPTED by both the Python reference parser
+    and the C++ ingest parser, and the three line tallies — ring
+    metadata, Python parses, native processed — must agree exactly.
+    Same for SSF span rings through parse_ssf and the native span fast
+    path. A generator that emits unparseable traffic would silently
+    deflate every sustained-pipeline number (loss would be synthetic)."""
+    from veneur_tpu import native as native_mod
+    from veneur_tpu.loadgen.spec import WorkloadSpec
+    from veneur_tpu.protocol import ssf_wire
+    from veneur_tpu.protocol.dogstatsd import parse_metric, ParseError
+
+    if not native_mod.loadgen_available():
+        print("loadgen: native library unavailable — 0 cases")
+        return 0
+    ni = native_mod.NativeIngest()
+    n = 0
+    while time.time() < t_end:
+        mix = [rng.random() for _ in range(5)]
+        mix[rng.randrange(5)] += 0.2  # guarantee a positive sum
+        spec = WorkloadSpec(
+            seed=rng.randrange(1 << 30),
+            num_keys=rng.choice([1, 3, 97, 1000]),
+            zipf_s=rng.choice([0.0, 0.7, 1.1, 2.5]),
+            type_mix=mix,
+            num_tags=rng.randrange(0, 7),
+            tag_cardinality=rng.choice([1, 5, 50]),
+            prefix=rng.choice(["lg", "fz.deep.prefix", "a"]),
+            datagram_bytes=rng.choice([64, 512, 1400, 8192]),
+            ring_lines=2000)
+        ring = spec.build_ring()
+        py_total = native_total = 0
+        for i in range(len(ring)):
+            dgram = ring.datagram(i)
+            for line in dgram.split(b"\n"):
+                try:
+                    m = parse_metric(line)
+                except ParseError as e:
+                    print(f"loadgen DIVERGE py rejects generated line "
+                          f"({e}): {line!r} spec={spec.to_dict()}")
+                    return -1
+                if not m.key.name.startswith(spec.prefix + "."):
+                    print(f"loadgen DIVERGE name outside prefix: "
+                          f"{m.key.name!r} spec={spec.to_dict()}")
+                    return -1
+                py_total += 1
+            before = ni.processed
+            ni.ingest(dgram)
+            native_total += ni.processed - before
+        if not (py_total == native_total == ring.total_lines):
+            print(f"loadgen TALLY py={py_total} native={native_total} "
+                  f"ring={ring.total_lines} spec={spec.to_dict()}")
+            return -1
+        ssf_ring = spec.build_ssf_ring(n_spans=50)
+        for i in range(len(ssf_ring)):
+            payload = ssf_ring.datagram(i)
+            try:
+                ssf_wire.parse_ssf(payload)
+            except Exception as e:
+                print(f"loadgen DIVERGE py rejects generated span "
+                      f"({type(e).__name__}: {e}): {payload!r}")
+                return -1
+            rc = ni.ingest_ssf(payload, b"ind.t", b"obj.t")
+            if rc != 1:
+                print(f"loadgen DIVERGE native rc={rc} on generated "
+                      f"span: {payload!r}")
+                return -1
+        n += py_total + len(ssf_ring)
+    return n
+
+
 TARGETS = {"dogstatsd": fuzz_dogstatsd, "ssf": fuzz_ssf,
            "metricpb": fuzz_metricpb, "gob": fuzz_gob,
-           "ssf_stream": fuzz_ssf_stream}
+           "ssf_stream": fuzz_ssf_stream, "loadgen": fuzz_loadgen}
 
 
 def _git_rev() -> str:
@@ -345,7 +418,8 @@ def main() -> None:
                     help="budget per target")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--targets",
-                    default="dogstatsd,ssf,metricpb,gob,ssf_stream")
+                    default="dogstatsd,ssf,metricpb,gob,ssf_stream,"
+                            "loadgen")
     ap.add_argument("--tally", default=None, metavar="PATH",
                     help="accumulate results into this JSON artifact")
     ap.add_argument("--rounds", type=int, default=1,

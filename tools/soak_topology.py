@@ -18,7 +18,9 @@ nothing (proxy.drops == 0).
 
 Writes TOPOLOGY_SOAK.json at the repo root and prints one JSON line.
 
-Env knobs: VENEUR_SOAK_INTERVALS (default 30), VENEUR_SOAK_HISTO_SERIES
+Env knobs: VENEUR_SOAK_INTERVALS (default 30; 60 under mesh — the
+shard_map path's leak window needs the longer run to separate compile-
+cache warmup from steady-state growth), VENEUR_SOAK_HISTO_SERIES
 (default 1500), VENEUR_SOAK_COUNTER_SERIES (default 500).
 
 VENEUR_SOAK_MESH=1 (VERDICT r4 item 7): the global tier runs
@@ -75,7 +77,8 @@ def main() -> None:
     from veneur_tpu.distributed.import_server import ImportServer
     from veneur_tpu.distributed.proxy import ProxyServer
 
-    intervals = int(os.environ.get("VENEUR_SOAK_INTERVALS", 30))
+    intervals = int(os.environ.get("VENEUR_SOAK_INTERVALS",
+                                   60 if mesh_global else 30))
     s_histo = int(os.environ.get("VENEUR_SOAK_HISTO_SERIES", 1500))
     s_counter = int(os.environ.get("VENEUR_SOAK_COUNTER_SERIES", 500))
     pcts = [0.5, 0.99]
@@ -122,8 +125,17 @@ def main() -> None:
     forward_waits = []
     per_interval = s_histo + s_counter
     stalled_intervals = 0
+    # RSS snapshot once the compile caches have filled: the early
+    # intervals trace+compile every shard_map/flush specialization (the
+    # 166->553MB growth of the first mesh capture was front-loaded
+    # here), so the leak signal is rss_end - rss_after_warmup, not
+    # rss_end - rss_start
+    warmup_intervals = min(10, intervals)
+    rss_warm = None
 
     for it in range(intervals):
+        if it == warmup_intervals:
+            rss_warm = rss_mb()
         if it == join_at:
             proxy.set_destinations(dests([0, 1, 2]))
             churn_events.append({"interval": it, "event": "join",
@@ -210,6 +222,8 @@ def main() -> None:
         "forward_wait_max_s": max(forward_waits),
         "wall_s": round(wall_s, 1),
         "rss_start_mb": round(rss0, 1),
+        "rss_after_warmup_mb": (round(rss_warm, 1)
+                                if rss_warm is not None else None),
         "rss_end_mb": round(rss_mb(), 1),
     }
 

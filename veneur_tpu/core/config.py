@@ -158,6 +158,20 @@ class Config:
     tpu_spill_cap: int = 1 << 22
     tpu_compression: float = 100.0
     tpu_hll_precision: int = 14
+    # loadgen workload spec (veneur_tpu/loadgen): declarative shape of
+    # synthesized DogStatsD traffic — the standing load harness every
+    # ingest change is measured against (tools/bench_sustained.py).
+    # Type mix is {c, g, ms, h, s} weights in that fixed order.
+    loadgen_seed: int = 7
+    loadgen_num_keys: int = 10000
+    loadgen_zipf_s: float = 1.1  # 0 = uniform key popularity
+    loadgen_type_mix: list[float] = field(
+        default_factory=lambda: [0.35, 0.15, 0.25, 0.15, 0.10])
+    loadgen_num_tags: int = 3
+    loadgen_tag_cardinality: int = 50
+    loadgen_prefix: str = "lg"
+    loadgen_datagram_bytes: int = 1400  # pack target per datagram
+    loadgen_ring_lines: int = 200000  # distinct lines in the send ring
     # set-sketch storage: "staged" keeps small sets host-side sparse and
     # promotes rows past 2^p/8 distinct registers to dense device rows
     # (the scalable default — 1M small-set series costs ~MBs instead of
@@ -520,3 +534,23 @@ def validate_config(cfg: Config) -> None:
         raise ValueError("tpu_stage_depth must be >= 1")
     if cfg.tpu_spill_cap < 1:
         raise ValueError("tpu_spill_cap must be >= 1")
+    if not (1 <= cfg.loadgen_num_keys <= (1 << 24)):
+        raise ValueError("loadgen_num_keys must be in [1, 2^24]")
+    if cfg.loadgen_zipf_s < 0:
+        raise ValueError("loadgen_zipf_s must be >= 0")
+    if (len(cfg.loadgen_type_mix) != 5
+            or any(w < 0 for w in cfg.loadgen_type_mix)
+            or sum(cfg.loadgen_type_mix) <= 0):
+        raise ValueError("loadgen_type_mix must be 5 non-negative weights"
+                         " ({c,g,ms,h,s} order) with a positive sum")
+    if not (0 <= cfg.loadgen_num_tags <= 16):
+        raise ValueError("loadgen_num_tags must be in [0,16]")
+    if cfg.loadgen_tag_cardinality < 1:
+        raise ValueError("loadgen_tag_cardinality must be >= 1")
+    if not (64 <= cfg.loadgen_datagram_bytes <= 65507):
+        raise ValueError("loadgen_datagram_bytes must be in [64,65507]"
+                         " (a UDP datagram)")
+    if cfg.loadgen_ring_lines < 1:
+        raise ValueError("loadgen_ring_lines must be >= 1")
+    if not cfg.loadgen_prefix or cfg.loadgen_prefix[0] in "0123456789":
+        raise ValueError("loadgen_prefix must be a valid metric name stem")

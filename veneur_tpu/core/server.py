@@ -379,6 +379,38 @@ class Server:
                     n += router.reader_packets(h)
         return n
 
+    def ingress_stats(self) -> dict:
+        """Cumulative ingress counters for the loadgen controller
+        (veneur_tpu/loadgen): lifetime tallies that survive epoch swaps,
+        so sent-vs-accepted loss over a load run is a subtraction of two
+        snapshots. Every field is monotonic for the life of the process.
+
+        samples_processed sums each worker's swap-accumulated
+        processed_total plus its live in-epoch count; overload_dropped
+        likewise folds in the not-yet-drained native delta."""
+        processed = 0
+        dropped = 0
+        for i, w in enumerate(self.workers):
+            # per-worker lock: a swap moves `processed` into
+            # processed_total; reading the pair unlocked could miss a
+            # whole epoch mid-swap
+            with self._worker_locks[i]:
+                processed += getattr(w, "processed_total", 0) + w.processed
+                dropped += getattr(w, "overload_dropped_total", 0)
+                native = getattr(w, "_native", None)
+                if native is not None:
+                    dropped += (int(native.overload_dropped)
+                                - getattr(w, "_native_drop_seen", 0))
+        return {
+            "packets_received": self.packets_received,
+            "parse_errors": self.parse_errors,
+            "samples_processed": processed,
+            "overload_dropped": dropped,
+            "flush_count": self.flush_count,
+            "last_flush_unix": self.last_flush_unix,
+            "last_flush_phases": dict(self.last_flush_phases),
+        }
+
     @property
     def parse_errors(self) -> int:
         """Total parse/overlong errors: Python-side cells, each worker's

@@ -575,6 +575,9 @@ class DeviceWorker:
         self.set_store = set_store
         self._processed_py = 0
         self._native_proc_seen = 0
+        # lifetime samples accepted across epochs (accumulated at swap;
+        # per-epoch `processed` resets there)
+        self.processed_total = 0
         self.imported = 0
         # overload-shedding tallies: per-interval (consumed + reset by
         # the server's flush telemetry) and lifetime (soaks/operators)
@@ -1503,6 +1506,14 @@ class DeviceWorker:
         # transfer tallies so extract_snapshot's uploads/readbacks are
         # attributed to the interval they serve
         self.ledger.begin_flush()
+        # lifetime sample tally, taken BEFORE the native reset below
+        # destroys the per-epoch counter (the server's flush telemetry
+        # reads `processed` pre-swap; Server.ingress_stats reads this
+        # accumulator — same split as overload_dropped vs
+        # overload_dropped_total). The caller holds this worker's ingest
+        # lock across swap(), which is what keeps the pair (total,
+        # per-epoch) consistent for locked readers.
+        self.processed_total += self.processed
         native_stage = None
         spill_histo = None
         if self._native is not None:

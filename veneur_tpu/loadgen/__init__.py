@@ -1,0 +1,14 @@
+"""Wire-rate load generation / capture / replay (ISSUE 2 tentpole).
+
+The C++ half (native/loadgen.cpp, bound in veneur_tpu.native) owns the
+per-packet work: ring synthesis from a declarative workload spec, paced
+sending with absolute deadlines, and datagram capture for bit-exact
+replay. This package owns orchestration: the workload spec
+(spec.WorkloadSpec), the in-process server harness, and the closed-loop
+sustained-rate search that produces SUSTAINED_PIPELINE.json
+(controller.search_sustained via tools/bench_sustained.py).
+"""
+
+from veneur_tpu.loadgen.spec import WorkloadSpec  # noqa: F401
+from veneur_tpu.loadgen.controller import (  # noqa: F401
+    LoadHarness, run_trial, search_sustained)

@@ -24,6 +24,10 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libveneur_native.so")
 _lib = None
 _lib_lock = threading.Lock()
 
+_LOADGEN_PATH = os.path.join(_NATIVE_DIR, "libveneur_loadgen.so")
+_lg_lib = None
+_lg_lock = threading.Lock()
+
 
 def _build() -> bool:
     try:
@@ -951,3 +955,261 @@ class NativeRouter:
     def reset_lock_stats(self) -> None:
         for c in self._contexts:
             self._lib.vn_lock_stats_reset(c._ctx)
+
+
+# --------------------------------------------------------------------------
+# loadgen: wire-rate traffic generation / capture / replay
+# (native/loadgen.cpp — separate .so so the load harness can be absent
+# without touching the ingest library)
+
+
+def load_loadgen_library() -> Optional[ctypes.CDLL]:
+    global _lg_lib
+    with _lg_lock:
+        if _lg_lib is not None:
+            return _lg_lib
+        if not _build() and not os.path.exists(_LOADGEN_PATH):
+            return None
+        if not os.path.exists(_LOADGEN_PATH):
+            return None
+        lib = ctypes.CDLL(_LOADGEN_PATH)
+        c = ctypes
+        lib.vn_lg_source_hash.restype = c.c_char_p
+        lib.vn_lg_ring_new.restype = c.c_void_p
+        lib.vn_lg_ring_free.argtypes = [c.c_void_p]
+        lib.vn_lg_ring_count.restype = c.c_longlong
+        lib.vn_lg_ring_count.argtypes = [c.c_void_p]
+        lib.vn_lg_ring_total_lines.restype = c.c_longlong
+        lib.vn_lg_ring_total_lines.argtypes = [c.c_void_p]
+        lib.vn_lg_ring_total_bytes.restype = c.c_longlong
+        lib.vn_lg_ring_total_bytes.argtypes = [c.c_void_p]
+        lib.vn_lg_ring_hash.restype = c.c_uint64
+        lib.vn_lg_ring_hash.argtypes = [c.c_void_p]
+        lib.vn_lg_ring_datagram.restype = c.c_longlong
+        lib.vn_lg_ring_datagram.argtypes = [
+            c.c_void_p, c.c_longlong, c.POINTER(c.c_char_p)]
+        lib.vn_lg_ring_append.restype = c.c_longlong
+        lib.vn_lg_ring_append.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_longlong, c.c_int]
+        lib.vn_lg_ring_synth.restype = c.c_longlong
+        lib.vn_lg_ring_synth.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_longlong, c.c_double,
+            c.POINTER(c.c_double), c.c_int, c.c_longlong,
+            c.c_char_p, c.c_int, c.c_int, c.c_longlong]
+        lib.vn_lg_ring_serialize.restype = c.c_longlong
+        lib.vn_lg_ring_serialize.argtypes = [
+            c.c_void_p, c.POINTER(c.c_char_p)]
+        lib.vn_lg_ring_load.restype = c.c_longlong
+        lib.vn_lg_ring_load.argtypes = [c.c_void_p, c.c_char_p,
+                                        c.c_longlong]
+        lib.vn_lg_send_start.restype = c.c_void_p
+        lib.vn_lg_send_start.argtypes = [
+            c.c_void_p, c.c_int, c.c_double, c.c_longlong, c.c_int]
+        for name in ("vn_lg_send_lines", "vn_lg_send_packets",
+                     "vn_lg_send_errors", "vn_lg_send_resyncs",
+                     "vn_lg_send_stop"):
+            fn = getattr(lib, name)
+            fn.restype = c.c_longlong
+            fn.argtypes = [c.c_void_p]
+        lib.vn_lg_send_done.restype = c.c_int
+        lib.vn_lg_send_done.argtypes = [c.c_void_p]
+        lib.vn_lg_send_free.restype = None
+        lib.vn_lg_send_free.argtypes = [c.c_void_p]
+        lib.vn_lg_capture_start.restype = c.c_void_p
+        lib.vn_lg_capture_start.argtypes = [c.c_int, c.c_int, c.c_longlong]
+        for name in ("vn_lg_capture_packets", "vn_lg_capture_truncated",
+                     "vn_lg_capture_stop"):
+            fn = getattr(lib, name)
+            fn.restype = c.c_longlong
+            fn.argtypes = [c.c_void_p]
+        lib.vn_lg_capture_detach_ring.restype = c.c_void_p
+        lib.vn_lg_capture_detach_ring.argtypes = [c.c_void_p]
+        lib.vn_lg_capture_free.argtypes = [c.c_void_p]
+        _lg_lib = lib
+        return _lg_lib
+
+
+def loadgen_available() -> bool:
+    return load_loadgen_library() is not None
+
+
+def loadgen_source_hash() -> str:
+    lib = load_loadgen_library()
+    return lib.vn_lg_source_hash().decode() if lib is not None else ""
+
+
+# fixed metric-type order for the synth type-mix weights
+LOADGEN_TYPES = ("c", "g", "ms", "h", "s")
+
+
+class LoadgenRing:
+    """Pre-built datagram sequence: synthesize from a workload spec,
+    append externally-built payloads (SSF), or load a captured blob.
+    Immutable once handed to a sender."""
+
+    def __init__(self) -> None:
+        lib = load_loadgen_library()
+        if lib is None:
+            raise RuntimeError("loadgen library unavailable")
+        self._lib = lib
+        self._ring = lib.vn_lg_ring_new()
+
+    def __del__(self):
+        if getattr(self, "_ring", None):
+            self._lib.vn_lg_ring_free(self._ring)
+            self._ring = None
+
+    def __len__(self) -> int:
+        return int(self._lib.vn_lg_ring_count(self._ring))
+
+    @property
+    def total_lines(self) -> int:
+        return int(self._lib.vn_lg_ring_total_lines(self._ring))
+
+    @property
+    def total_bytes(self) -> int:
+        return int(self._lib.vn_lg_ring_total_bytes(self._ring))
+
+    @property
+    def content_hash(self) -> int:
+        """fnv1a64 over (length, bytes) pairs — the bit-exactness
+        witness for capture→replay round trips."""
+        return int(self._lib.vn_lg_ring_hash(self._ring))
+
+    def datagram(self, i: int) -> bytes:
+        out = ctypes.c_char_p()
+        n = self._lib.vn_lg_ring_datagram(self._ring, i,
+                                          ctypes.byref(out))
+        if n < 0:
+            raise IndexError(i)
+        return ctypes.string_at(out, n)
+
+    def datagrams(self) -> list[bytes]:
+        return [self.datagram(i) for i in range(len(self))]
+
+    def append(self, payload: bytes, lines: int = 1) -> None:
+        """Append one externally-built datagram (SSF spans are built in
+        Python once at setup; only the send loop is per-packet)."""
+        if self._lib.vn_lg_ring_append(self._ring, payload, len(payload),
+                                       lines) < 0:
+            raise ValueError("bad payload")
+
+    def synth(self, seed: int, n_keys: int, zipf_s: float,
+              type_mix: "list[float]", n_tags: int, tag_card: int,
+              prefix: bytes, dgram_target: int, n_lines: int) -> int:
+        """Build ~n_lines of DogStatsD traffic. type_mix is 5 weights
+        in LOADGEN_TYPES order. Returns the datagram count."""
+        mix = (ctypes.c_double * len(LOADGEN_TYPES))(*type_mix)
+        n = self._lib.vn_lg_ring_synth(
+            self._ring, seed, n_keys, float(zipf_s), mix, n_tags,
+            tag_card, prefix, len(prefix), dgram_target, n_lines)
+        if n < 0:
+            raise ValueError("invalid workload spec for synth")
+        return int(n)
+
+    def serialize(self) -> bytes:
+        out = ctypes.c_char_p()
+        n = self._lib.vn_lg_ring_serialize(self._ring, ctypes.byref(out))
+        return ctypes.string_at(out, n)
+
+    def load(self, blob: bytes) -> int:
+        n = self._lib.vn_lg_ring_load(self._ring, blob, len(blob))
+        if n < 0:
+            raise ValueError("malformed ring blob")
+        return int(n)
+
+
+class LoadgenSender:
+    """Paced C++ send thread cycling a ring over a connected socket.
+    The caller owns the socket and the ring; both must outlive the
+    sender (stop() joins the thread)."""
+
+    def __init__(self, ring: LoadgenRing, fd: int, lines_per_s: float,
+                 max_lines: int = 0, stream: bool = False) -> None:
+        self._lib = ring._lib
+        self._ring = ring  # keep alive
+        self._h = self._lib.vn_lg_send_start(
+            ring._ring, fd, float(lines_per_s), int(max_lines),
+            1 if stream else 0)
+        if not self._h:
+            raise RuntimeError("vn_lg_send_start failed (empty ring?)")
+
+    @property
+    def sent_lines(self) -> int:
+        return int(self._lib.vn_lg_send_lines(self._h))
+
+    @property
+    def sent_packets(self) -> int:
+        return int(self._lib.vn_lg_send_packets(self._h))
+
+    @property
+    def send_errors(self) -> int:
+        return int(self._lib.vn_lg_send_errors(self._h))
+
+    @property
+    def resyncs(self) -> int:
+        return int(self._lib.vn_lg_send_resyncs(self._h))
+
+    @property
+    def done(self) -> bool:
+        return bool(self._lib.vn_lg_send_done(self._h))
+
+    def stop(self) -> float:
+        """Join the send thread (idempotent); the final counters stay
+        readable afterwards. Returns the loop's elapsed seconds."""
+        if not self._h:
+            return 0.0
+        return self._lib.vn_lg_send_stop(self._h) / 1e9
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._h = None
+            self._lib.vn_lg_send_free(h)
+
+
+class LoadgenCapture:
+    """C++ capture thread recording datagrams off a bound socket for
+    bit-exact replay. The caller owns the fd (kept blocking with a
+    100ms receive timeout, like the ingest readers)."""
+
+    def __init__(self, fd: int, max_len: int = 65536,
+                 max_packets: int = 0) -> None:
+        lib = load_loadgen_library()
+        if lib is None:
+            raise RuntimeError("loadgen library unavailable")
+        self._lib = lib
+        self._h = lib.vn_lg_capture_start(fd, max_len, max_packets)
+        if not self._h:
+            raise RuntimeError("vn_lg_capture_start failed")
+        self._stopped = False
+
+    @property
+    def packets(self) -> int:
+        return int(self._lib.vn_lg_capture_packets(self._h))
+
+    @property
+    def truncated(self) -> int:
+        return int(self._lib.vn_lg_capture_truncated(self._h))
+
+    def stop(self) -> int:
+        if not self._stopped:
+            self._lib.vn_lg_capture_stop(self._h)
+            self._stopped = True
+        return self.packets
+
+    def detach_ring(self) -> LoadgenRing:
+        """Move the captured datagrams into a fresh ring (stop first)."""
+        self.stop()
+        handle = self._lib.vn_lg_capture_detach_ring(self._h)
+        if not handle:
+            raise RuntimeError("capture detach failed")
+        ring = LoadgenRing.__new__(LoadgenRing)
+        ring._lib = self._lib
+        ring._ring = handle
+        return ring
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.vn_lg_capture_free(self._h)
+            self._h = None

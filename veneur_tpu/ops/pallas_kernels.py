@@ -142,10 +142,36 @@ def flush_extract_reference(means, weights, dmin, dmax, qs):
     return quant, td.row_sum(means, weights), td.row_count(weights)
 
 
+def ab_verdict_ok() -> bool:
+    """The A/B gate (TPU_BACKEND.md): the Pallas extract path is only
+    the production default once PALLAS_AB.json proves it on the real
+    target — platform "tpu" AND >=1.0x over XLA. The committed artifact
+    is CPU interpret-mode (0.13x, latency not meaningful), so until an
+    on-chip capture lands, XLA extraction is the default on every
+    backend. VENEUR_PALLAS=1 overrides for benchmarking/bringup;
+    VENEUR_PALLAS=0 force-disables regardless of the artifact."""
+    import json
+    import os
+
+    force = os.environ.get("VENEUR_PALLAS")
+    if force is not None:
+        return force == "1"
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "PALLAS_AB.json")
+    try:
+        with open(path) as f:
+            ab = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return (ab.get("platform") == "tpu"
+            and float(ab.get("speedup_pallas_vs_xla", 0.0)) >= 1.0)
+
+
 def supported() -> bool:
     # if Pallas lowering fails on a real TPU, DeviceWorker._extract
     # demotes to the XLA path and counts it in
     # veneur.flush.pallas_fallback_total
     from veneur_tpu.utils.backend import is_tpu_backend
 
-    return is_tpu_backend()
+    return is_tpu_backend() and ab_verdict_ok()
