@@ -47,7 +47,7 @@ def test_ledger_counts_and_resets_per_flush():
     assert led.flushes == 2
 
 
-def test_worker_has_a_ledger_reset_by_swap():
+def test_worker_has_a_ledger_reset_per_extraction():
     w = DeviceWorker()
     qs = device_quantiles(PCTS, AGGS)
     from veneur_tpu.protocol.dogstatsd import parse_metric
@@ -55,7 +55,10 @@ def test_worker_has_a_ledger_reset_by_swap():
     w.flush(qs)
     first = dict(w.ledger.flush_h2d())
     assert first  # the staged upload was counted
-    w.flush(qs)  # empty interval: swap() reset the per-flush view
+    # empty interval: extract_snapshot() opens a fresh transfer window
+    # (the reset lives there, not in swap, so a pipelined tick's swap
+    # can't clobber the window a running extraction is filling)
+    w.flush(qs)
     assert w.ledger.flush_h2d_bytes() <= first.get("quantiles", 12) + 64
 
 

@@ -1,0 +1,186 @@
+"""Stage-parallel flush executor (core/pipeline.py) contracts.
+
+Three pinned behaviors:
+
+- Bit-identity: serial and pipelined servers fed identical packets over
+  three intervals emit byte-identical InterMetric streams per interval,
+  across every metric class (counters, gauges, timers/histograms, sets)
+  — the same contract the chunked extractor meets.
+- Bounded backpressure: a stalled sink fills the emit stage's queue and
+  further intervals are SHED (counted) instead of queued unboundedly;
+  in-flight intervals stay bounded by stages + backlog.
+- Shutdown drain: shutdown() drains every admitted interval through
+  sink emission before the sinks stop — the final interval is not lost.
+"""
+
+import threading
+import time
+
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.health.governor import FlushDeadlineGovernor
+from veneur_tpu.health.policy import pipeline_should_shed
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+T0 = 1_700_000_000
+
+
+def _mk(pipelined: bool, sink=None, **extra):
+    """A full Server wired to a channel sink, NOT started: tests drive
+    flushes by hand (serial flush(now=...) / pipeline.tick(now=...)),
+    so no sockets, ticker, or warmup races."""
+    cfg = Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        num_workers=2,
+        num_readers=1,
+        interval="10s",
+        percentiles=[0.5, 0.99],
+        flush_pipeline=pipelined,
+        **extra,
+    )
+    sink = sink if sink is not None else ChannelMetricSink()
+    srv = Server(cfg, metric_sinks=[sink])
+    if srv.flush_pipeline is not None:
+        srv.flush_pipeline.start()
+    return srv, sink
+
+
+def _interval_lines(i: int) -> list[bytes]:
+    """One interval's worth of traffic covering every metric class,
+    varied per interval so streams are distinguishable."""
+    lines = [
+        b"pl.count:%d|c" % (i + 1),
+        b"pl.count:%d|c|#env:prod,team:obs" % (2 * i + 3),
+        b"pl.gauge:%.2f|g" % (1.5 * (i + 1)),
+        b"pl.gauge:%d|g|#env:prod" % (10 * i),
+    ]
+    for v in range(1, 21):
+        lines.append(b"pl.timer:%d|ms" % (v * (i + 1)))
+        lines.append(b"pl.histo:%d|h|#env:prod" % (v + i))
+    for j in range(12 + i):
+        lines.append(b"pl.set:user%d|s" % j)
+        lines.append(b"pl.set:user%d|s|#env:prod" % (j * 7))
+    return lines
+
+
+def _canon(metrics):
+    """Total order over an InterMetric stream for exact comparison."""
+    return sorted(
+        (m.name, m.timestamp, repr(m.value), tuple(m.tags), m.type,
+         m.message, m.hostname,
+         tuple(sorted(m.sinks)) if m.sinks is not None else None)
+        for m in metrics)
+
+
+def test_serial_pipelined_bit_identical():
+    srv_s, sink_s = _mk(False)
+    srv_p, sink_p = _mk(True)
+    try:
+        for i in range(3):
+            for line in _interval_lines(i):
+                srv_s.handle_metric_packet(line)
+                srv_p.handle_metric_packet(line)
+            now = T0 + 10 * i
+            srv_s.flush(now=now)
+            assert srv_p.flush_pipeline.tick(now=now) == "ok"
+            got_s = sink_s.queue.get(timeout=30)
+            got_p = sink_p.queue.get(timeout=30)
+            # the stream is non-trivial: every class flushed something
+            names = {m.name for m in got_s}
+            assert {"pl.count", "pl.gauge", "pl.timer.count",
+                    "pl.set"} <= names
+            assert _canon(got_s) == _canon(got_p), (
+                f"interval {i}: pipelined stream diverged from serial")
+    finally:
+        srv_s.shutdown()
+        srv_p.shutdown()
+
+
+class _StallingSink(ChannelMetricSink):
+    """Blocks every flush until released — a wedged downstream."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.release = threading.Event()
+
+    def name(self) -> str:
+        return "stall"
+
+    def flush(self, metrics) -> None:
+        self.release.wait(timeout=60)
+        super().flush(metrics)
+
+
+def test_backpressure_sheds_when_sink_stalls():
+    sink = _StallingSink()
+    srv, _ = _mk(True, sink=sink)
+    try:
+        pl = srv.flush_pipeline
+        outcomes = []
+        for i in range(8):
+            srv.handle_metric_packet(b"bp.count:1|c")
+            outcomes.append(pl.tick(now=T0 + 10 * i))
+            time.sleep(0.25)  # let stages move jobs downstream
+        stats = pl.stats()
+        # the pipeline must have pushed back somewhere: either an
+        # interval was shed at a full stage queue or the tick itself
+        # was deferred — never unbounded queueing
+        assert sum(stats["shed"].values()) > 0 or "deferred" in outcomes
+        # bounded in-flight: one running + one queued per stage, max
+        assert stats["inflight"] <= 2 * len(pl._queues)
+        sink.release.set()
+        assert pl.drain(timeout=60), "pipeline failed to drain"
+        # the non-shed intervals all reached the sink
+        emitted = 0
+        while not sink.queue.empty():
+            sink.queue.get_nowait()
+            emitted += 1
+        admitted = len([o for o in outcomes if o == "ok"])
+        assert emitted == admitted - sum(stats["shed"].values())
+    finally:
+        sink.release.set()
+        srv.shutdown()
+
+
+def test_shutdown_drains_final_interval():
+    srv, sink = _mk(True)
+    try:
+        srv.handle_metric_packet(b"sd.count:5|c")
+        srv.handle_metric_packet(b"sd.timer:7|ms")
+        assert srv.flush_pipeline.tick(now=T0) == "ok"
+        # no sleep: shutdown must wait for the in-flight stages itself
+        assert srv.shutdown() is True
+        flushed = sink.queue.get_nowait()
+        names = {m.name for m in flushed}
+        assert "sd.count" in names and "sd.timer.count" in names
+    finally:
+        srv.shutdown()
+
+
+def test_governor_stage_refcount():
+    """Overlapped flushes keep the watchdog signal truthful: in_flight
+    stays set until the LAST overlapped flush ends, and a pipelined
+    admission (begin_stage_flush) never clobbers the chunk report an
+    in-flight extract is filling."""
+    gov = FlushDeadlineGovernor(chunk_target_ms=50, interval_s=10.0)
+    gov.begin_stage_flush()
+    gov.begin_report()
+    gov._note_chunk(2048, 0.01)
+    gov.begin_stage_flush()  # next interval admitted mid-extract
+    assert gov.progress()["in_flight"] is True
+    assert gov.last_report["chunks"] == 1  # report survived admission
+    gov.end_flush()
+    assert gov.progress()["in_flight"] is True  # one still in flight
+    gov.end_flush()
+    assert gov.progress()["in_flight"] is False
+    # serial begin_flush keeps its reset-the-report contract
+    gov.begin_flush()
+    assert gov.last_report == {}
+    gov.end_flush()
+
+
+def test_should_shed_contract():
+    assert not pipeline_should_shed(0, 1)
+    assert pipeline_should_shed(1, 1)
+    assert not pipeline_should_shed(1, 2)
+    assert pipeline_should_shed(2, 2)

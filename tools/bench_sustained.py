@@ -80,6 +80,15 @@ def main() -> None:
     ap.add_argument("--replay", metavar="PATH",
                     help="drive a previously saved ring blob bit-exactly"
                          " instead of synthesizing")
+    ap.add_argument("--flush-pipeline", action="store_true",
+                    help="run the server with the stage-parallel flush "
+                         "executor (core/pipeline.py) instead of the "
+                         "serial flush")
+    ap.add_argument("--ab", action="store_true",
+                    help="search mode only: run the full rate search "
+                         "twice — serial flush then pipelined flush — "
+                         "on the same ring, and write one artifact "
+                         "with both modes plus the speedup")
     ap.add_argument("--out", default="SUSTAINED_PIPELINE.json",
                     help="artifact name (repo root; search mode only)")
     args = ap.parse_args()
@@ -108,6 +117,7 @@ def main() -> None:
         # a serious rcvbuf: kernel drops are measured as loss, not
         # hidden by a tiny default buffer
         read_buffer_size_bytes=8 * 1048576,
+        flush_pipeline=args.flush_pipeline,
         **({"loadgen_ring_lines": args.ring_lines}
            if args.ring_lines else {}),
         **({"loadgen_num_keys": args.keys} if args.keys else {}),
@@ -139,6 +149,57 @@ def main() -> None:
         platform = jax.default_backend()
     except Exception:
         platform = "unknown"
+
+    if args.ab and not (args.smoke or args.replay):
+        # serial-vs-pipelined A/B: same ring, same rig, fresh server per
+        # mode. The headline fields come from the PIPELINED search so
+        # existing artifact consumers keep working; the serial run and
+        # the speedup live under "modes".
+        from dataclasses import replace as _cfg_replace
+
+        ab_ring = ring if ring is not None else spec.build_ring()
+        t0 = time.time()
+        modes: dict[str, dict] = {}
+        for mode_name, pipelined in (("serial", False),
+                                     ("pipelined", True)):
+            mcfg = _cfg_replace(cfg, flush_pipeline=pipelined)
+            h = LoadHarness(mcfg, spec, transport=args.transport,
+                            ring=ab_ring)
+            try:
+                if not h.warmup():
+                    print(f"{mode_name}: warmup never came up",
+                          file=sys.stderr)
+                    sys.exit(1)
+                search = search_sustained(
+                    h, start_rate=args.start_rate,
+                    max_rate=args.max_rate,
+                    confirm_intervals=args.intervals or 10,
+                    max_loss=args.max_loss)
+                modes[mode_name] = result_artifact(spec, h, search,
+                                                   platform)
+            finally:
+                h.close()
+        out = dict(modes["pipelined"])
+        out["schema"] = "sustained_pipeline_v2_ab"
+        out["modes"] = modes
+        serial_rate = modes["serial"]["sustained_pipeline_lines_per_s"]
+        pipe_rate = modes["pipelined"]["sustained_pipeline_lines_per_s"]
+        out["speedup_vs_serial"] = (round(pipe_rate / serial_rate, 3)
+                                    if serial_rate > 0 else None)
+        out["wall_s"] = round(time.time() - t0, 1)
+        write_artifact(args.out, out)
+        print(json.dumps({
+            "metric": "sustained_pipeline_lines_per_s",
+            "value": pipe_rate,
+            "unit": "lines/s",
+            "serial_lines_per_s": serial_rate,
+            "speedup_vs_serial": out["speedup_vs_serial"],
+            "confirmed": out["confirmed"],
+            "platform": platform,
+        }))
+        if not out["confirmed"]:
+            sys.exit(1)
+        return
 
     harness = LoadHarness(cfg, spec, transport=args.transport, ring=ring)
     try:

@@ -43,23 +43,36 @@ echo "== tier-1 health lane (governor + transfer ledger) =="
 python -m pytest tests/test_health_governor.py tests/test_health_ledger.py \
   -q -m 'not slow'
 
+# Pipelined-flush equality lane: the stage-parallel executor
+# (core/pipeline.py) must emit bit-identical InterMetric streams to the
+# serial flush, shed (not queue) under a stalled sink, and drain the
+# final interval on shutdown. Runs as its own lane so a pipeline
+# divergence is named here, not buried in the full suite.
+echo "== pipelined-flush equality lane (serial == pipelined) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_pipeline.py -q -m 'not slow'
+
 # Sustained-rate floor: the loadgen harness drives a live server's UDP
 # socket at a fixed offered rate for 5 flush intervals and fails on
-# loss or broken flush cadence. 30k lines/s is deliberately ~half the
-# 1-core dev rig's measured cadence-bound rate (57.6k,
+# loss or broken flush cadence. 50k lines/s with the pipelined flush
+# is deliberately well under half the 1-core dev rig's measured A/B
+# rates (serial 110k / pipelined 122.8k confirmed,
 # SUSTAINED_PIPELINE.json) so host noise doesn't flake the lane, while
 # a real pipeline regression (parse slowdown, flush stall, shed storm)
 # still trips it; min-cadence 0.7 tolerates one straggler flush in 5
 # (XLA-CPU occasionally recompiles mid-run on this rig), two fail.
+# --flush-pipeline exercises the stage-parallel executor end to end in
+# CI at a rate the old serial floor (30k) never could — the lane now
+# gates BOTH the packet path and the pipelined tick staying cheap.
 # --keys 2000 (~10k series) keeps per-flush XLA work well inside the
 # 2s interval on one core — the default 10k-key workload's ~50k series
 # cost 2-4s per flush here, which gates the rig's flush latency, not
 # the packet path this lane is for. Bounded: warmup + 5×2s intervals
 # under a hard cap.
-echo "== sustained-rate smoke (loadgen floor gate) =="
+echo "== sustained-rate smoke (loadgen floor gate, pipelined) =="
 timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-  python tools/bench_sustained.py --smoke --rate 30000 --intervals 5 \
-    --interval 2s --min-cadence 0.7 --keys 2000
+  python tools/bench_sustained.py --smoke --rate 50000 --intervals 5 \
+    --interval 2s --min-cadence 0.7 --keys 2000 --flush-pipeline
 
 echo "== test suite =="
 python -m pytest tests/ -q

@@ -38,6 +38,7 @@ import json
 import os
 import sys
 import time
+import tracemalloc
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -132,10 +133,28 @@ def main() -> None:
     # rss_end - rss_start
     warmup_intervals = min(10, intervals)
     rss_warm = None
+    # Python-heap attribution for the post-warmup accrual: the RSS
+    # delta alone can't name a retainer. Snapshot the traced heap at
+    # the warmup boundary and diff it against the end — the top
+    # growers (by file:line) go into the artifact as tracemalloc_top.
+    tracemalloc.start(10)
+    tm_warm = None
+    stall_events = []
+
+    def forward_path_stats() -> dict:
+        """Who's wedged: the local's forward client vs the proxy's
+        downstream clients (rpc.ForwardClient.stats on both hops)."""
+        out = {"proxy": proxy.forward_stats()}
+        fwd = getattr(local, "forwarder", None)
+        client = getattr(fwd, "client", None)
+        if client is not None:
+            out["local_forward"] = client.stats()
+        return out
 
     for it in range(intervals):
         if it == warmup_intervals:
             rss_warm = rss_mb()
+            tm_warm = tracemalloc.take_snapshot()
         if it == join_at:
             proxy.set_destinations(dests([0, 1, 2]))
             churn_events.append({"interval": it, "event": "join",
@@ -179,6 +198,38 @@ def main() -> None:
         forward_waits.append(round(time.perf_counter() - t0, 3))
         if not ok:
             stalled_intervals += 1
+            # name the wedged side instead of timing out silently:
+            # record both hops' client stats at the stall (per-attempt
+            # durations, error classes, consecutive failures,
+            # reconnects) — ROADMAP's 120-interval mesh stall item
+            stall_events.append({
+                "interval": it,
+                "received_delta": received_total() - before,
+                "expected": per_interval,
+                **forward_path_stats(),
+            })
+
+    # end-of-loop heap snapshot BEFORE the final accounting flushes
+    # below allocate their own transient state: the diff should show
+    # steady-state growth, not teardown noise
+    rss_end = rss_mb()
+    tracemalloc_top = []
+    if tm_warm is not None:
+        tm_end = tracemalloc.take_snapshot()
+        growth = [s for s in tm_end.compare_to(tm_warm, "lineno")
+                  if s.size_diff > 0]
+        traced_growth = sum(s.size_diff for s in growth)
+        for s in growth[:12]:
+            frame = s.traceback[0]
+            tracemalloc_top.append({
+                "where": f"{frame.filename}:{frame.lineno}",
+                "size_diff_kb": round(s.size_diff / 1024.0, 1),
+                "count_diff": s.count_diff,
+            })
+    else:
+        traced_growth = 0
+    tracemalloc.stop()
+    forward_path_final = forward_path_stats()
 
     # final accounting: flush every global (including the one that left
     # the ring — its accumulated state still exists) and sum
@@ -218,13 +269,27 @@ def main() -> None:
                             and histo_count_total == expected_histo),
         "proxy_drops": proxy.drops,
         "stalled_intervals": stalled_intervals,
+        "stall_events": stall_events,
+        "forward_path": forward_path_final,
         "forward_wait_p50_s": sorted(forward_waits)[len(forward_waits) // 2],
         "forward_wait_max_s": max(forward_waits),
         "wall_s": round(wall_s, 1),
         "rss_start_mb": round(rss0, 1),
         "rss_after_warmup_mb": (round(rss_warm, 1)
                                 if rss_warm is not None else None),
-        "rss_end_mb": round(rss_mb(), 1),
+        "rss_end_mb": round(rss_end, 1),
+        # post-warmup accrual, decomposed: how much of the RSS growth
+        # the Python allocator can even see (the remainder is native —
+        # XLA buffers, gRPC, malloc arenas — or tracemalloc's own
+        # bookkeeping overhead inflating RSS but not the diff)
+        "rss_growth_post_warmup_mb": (
+            round(rss_end - rss_warm, 1) if rss_warm is not None else None),
+        "rss_growth_per_interval_mb": (
+            round((rss_end - rss_warm)
+                  / max(1, intervals - warmup_intervals), 3)
+            if rss_warm is not None else None),
+        "traced_py_growth_mb": round(traced_growth / 1048576.0, 2),
+        "tracemalloc_top": tracemalloc_top,
     }
 
     local.shutdown()

@@ -107,6 +107,12 @@ def generate_inter_metrics(
             want_hmean=bool(aggregates.value & Aggregate.HARMONIC_MEAN),
         )
         for row, meta in enumerate(hrows):
+            if governor is not None and row and row % 200_000 == 0:
+                # the entry beat above covers small flushes; at 1M rows
+                # this loop is seconds of host work, and under the stage
+                # pipeline it overlaps the NEXT interval's extract — the
+                # watchdog must keep seeing progress, not entry-silence
+                governor.beat()
             cls = meta.scope_class
             if cls == ScopeClass.MIXED:
                 # locals forward mixed digests and emit no percentiles

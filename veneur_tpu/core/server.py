@@ -224,12 +224,30 @@ class Server:
         # real join outcome instead of the stale initial True
         self._shutdown_complete = threading.Event()
         self.last_flush_unix = time.time()
+        # when the most recent flush finished sink emission (== the tick
+        # time on the serial path; trails it under the stage pipeline)
+        self.last_emit_unix = 0.0
         self.last_flush_phases: dict[str, float] = {}
         # per-flush transfer-ledger totals and chunk report (health/),
         # read by tools/bench_e2e_flush.py alongside the phase times
         self.last_flush_transfers: dict[str, int] = {}
         self.last_flush_chunks: dict = {}
         self.flush_count = 0
+        # wall time the last flush tick held the ticker thread: the
+        # serial flush duration, or (pipelined) just the swap+enqueue —
+        # the cadence decomposition the loadgen controller reports
+        self.last_tick_s = 0.0
+        # stage-parallel flush executor (core/pipeline.py): extract,
+        # generate and emit for successive intervals overlap on
+        # dedicated stage threads while the tick stays a cheap swap.
+        # None = serial flush (the reference-shaped default).
+        if cfg.flush_pipeline:
+            from veneur_tpu.core.pipeline import FlushPipeline
+
+            self.flush_pipeline = FlushPipeline(
+                self, max_backlog=cfg.flush_pipeline_backlog)
+        else:
+            self.flush_pipeline = None
 
         # ingest counters (self-telemetry). Incremented from every reader
         # thread: a bare `self.x += 1` loses increments at GIL switches
@@ -401,15 +419,23 @@ class Server:
                 if native is not None:
                     dropped += (int(native.overload_dropped)
                                 - getattr(w, "_native_drop_seen", 0))
-        return {
+        out = {
             "packets_received": self.packets_received,
             "parse_errors": self.parse_errors,
             "samples_processed": processed,
             "overload_dropped": dropped,
             "flush_count": self.flush_count,
             "last_flush_unix": self.last_flush_unix,
+            "last_emit_unix": self.last_emit_unix,
             "last_flush_phases": dict(self.last_flush_phases),
+            # how long the last flush tick held the ticker thread: the
+            # ingest-stall component of the cadence decomposition (the
+            # loadgen controller reports it per interval)
+            "last_tick_s": self.last_tick_s,
         }
+        if self.flush_pipeline is not None:
+            out["pipeline"] = self.flush_pipeline.stats()
+        return out
 
     @property
     def parse_errors(self) -> int:
@@ -754,7 +780,8 @@ class Server:
 
     # -- listeners ----------------------------------------------------------
 
-    def _spawn(self, target, name: str, compute: bool = False) -> None:
+    def _spawn(self, target, name: str,
+               compute: bool = False) -> threading.Thread:
         """Every long-lived server thread is wrapped in panic capture
         (reference ConsumePanic around goroutines, sentry.go:22-60,
         server.go:395-400): report to sentry_dsn, then abort so process
@@ -772,6 +799,7 @@ class Server:
         self._threads.append(t)
         if compute:
             self._compute_threads.append(t)
+        return t
 
     def _adopt_fd(self) -> Optional[socket.socket]:
         """Take one inherited listener fd (if the previous process image
@@ -1206,6 +1234,9 @@ class Server:
         if self.config.tpu_warmup_compile:
             self._spawn(self._warmup_compile, "warmup-compile",
                         compute=True)
+        if self.flush_pipeline is not None:
+            # stage threads must exist before the first tick enqueues
+            self.flush_pipeline.start()
         self._spawn(self._flush_loop, "flush-ticker", compute=True)
         if self.native_mode:
             self._spawn(self._series_sync_loop, "series-sync",
@@ -1283,12 +1314,39 @@ class Server:
                 return
             try:
                 _t0 = time.perf_counter()
-                self.flush()
-                self._adapt_spill_caps(time.perf_counter() - _t0)
+                if self.flush_pipeline is not None:
+                    outcome = self.flush_pipeline.tick()
+                    self.last_tick_s = time.perf_counter() - _t0
+                    if outcome == "ok":
+                        # growth only: under overlap a stage may run
+                        # most of the interval and still keep pace, so
+                        # stage DURATION is not an overload signal —
+                        # BACKLOG is, and persistent backlog sheds via
+                        # _pipeline_overrun on the deferred/shed paths.
+                        # Duration-driven halving here was measured
+                        # shedding 94k lines of a 2.7M-line confirm run
+                        # that the pipeline was absorbing fine.
+                        self._adapt_spill_caps(
+                            max(self.last_tick_s,
+                                self.flush_pipeline.last_cycle_s),
+                            allow_shrink=False)
+                else:
+                    self.flush()
+                    self.last_tick_s = time.perf_counter() - _t0
+                    self._adapt_spill_caps(self.last_tick_s)
             except Exception:
                 log.exception("flush failed")
 
-    def _adapt_spill_caps(self, flush_dur: float) -> None:
+    def _pipeline_overrun(self) -> None:
+        """A flush-pipeline stage fell a full interval behind (deferred
+        tick or shed interval): treat it exactly like a flush that
+        consumed the whole interval, so the standing shedding loop
+        halves the spill caps instead of letting queues grow
+        (health/policy.py MAX_STAGE_BACKLOG documents the contract)."""
+        self._adapt_spill_caps(self.interval)
+
+    def _adapt_spill_caps(self, flush_dur: float,
+                          allow_shrink: bool = True) -> None:
         """Closed-loop overload shedding: bound the backlog one flush can
         inherit so the flush fits the interval. The C++ spill caps bound
         the direct-fold work a swap hands to extraction; when a flush
@@ -1302,7 +1360,7 @@ class Server:
         ceiling = self.config.tpu_spill_cap
         floor = min(1 << 16, ceiling)
         cur = self._spill_cap_now
-        if flush_dur > 0.9 * self.interval:
+        if allow_shrink and flush_dur > 0.9 * self.interval:
             new = max(floor, cur >> 1)
         elif flush_dur < 0.3 * self.interval:
             new = min(ceiling, cur << 1)
@@ -1325,12 +1383,15 @@ class Server:
                     except AttributeError:  # stale .so without the cap API
                         pass
 
-    def flush(self):
+    def flush(self, now: float | None = None):
         """One flush pass (reference Server.Flush, flusher.go:28-134).
 
         Returns list[InterMetric] on the object path, or a
         ColumnarMetrics batch (len() works; call .materialize() for
         objects) when every sink consumed columns.
+
+        `now` pins the interval's timestamp (tests compare serial and
+        pipelined output bit-for-bit by flushing both at one clock).
 
         Self-traced: every flush is a span (reference
         tracer.StartSpan("flush"), flusher.go:29) that rejoins this
@@ -1342,20 +1403,46 @@ class Server:
         self.flush_governor.begin_flush()
         try:
             with self.tracer.start_span("flush"):
-                return self._flush_inner()
+                return self._flush_inner(now=now)
         finally:
             self.flush_governor.end_flush()
 
-    def _flush_inner(self):
-        flush_start = time.time()
+    def _flush_inner(self, now: float | None = None):
+        # serial composition of the four flush phases; the stage-parallel
+        # executor (core/pipeline.py) runs the SAME methods on dedicated
+        # stage threads with up to an interval of overlap between them,
+        # which is what keeps pipelined output bit-identical to this path
+        job = self._flush_begin(now=now)
+        self._flush_extract(job)
+        self._flush_generate(job)
+        self._flush_emit(job)
+        if job.batch is not None:
+            # columnar flush: the batch supports len(); callers needing
+            # objects use .materialize()
+            return job.batch
+        return job.final
+
+    def _flush_begin(self, now: float | None = None):
+        """Tick-side flush phase: epoch close + device dispatches under
+        the per-worker ingest locks (the map-swap analog of
+        worker.go:498-517) — no device readback, so a pipelined tick
+        stays a fraction of the interval. Freezes the interval's
+        timestamp in job.ts: generation stamps InterMetrics from it on
+        both serial and pipelined paths, so output stays bit-identical
+        even when generation runs a full interval later."""
+        from veneur_tpu.core.pipeline import FlushJob
+
+        flush_start = time.time() if now is None else float(now)
         self.last_flush_unix = flush_start
         self.flush_count += 1
         self.stats.gauge("flush.flush_timestamp_ns", flush_start * 1e9)
         # per-phase wall times of this flush (reference tallyMetrics/
         # generateInterMetrics timing samples, flusher.go:169-298);
-        # read by tools/bench_e2e_flush.py for the 1M-series artifact
+        # read by tools/bench_e2e_flush.py for the 1M-series artifact.
+        # last_flush_phases rebinds only when _flush_emit COMPLETES:
+        # observers polling mid-flush (the loadgen cadence decomposition)
+        # must see the last finished flush, not a half-filled dict
         phases: dict[str, float] = {}
-        self.last_flush_phases = phases
         _t = time.perf_counter()
 
         if self.native_mode:
@@ -1442,12 +1529,21 @@ class Server:
                 for pkt in pkts:
                     self.handle_trace_packet(pkt)
         phases["swap_s"] = time.perf_counter() - _t
-        _t = time.perf_counter()
         self.flush_governor.beat()  # swap complete: flush is live
-        snaps: list[FlushSnapshot] = []
-        for i, (worker, sw) in enumerate(zip(self.workers, swapped)):
+        return FlushJob(ts=int(flush_start), flush_start=flush_start,
+                        qs=qs, swapped=swapped, span_counts=span_counts,
+                        phases=phases)
+
+    def _flush_extract(self, job) -> None:
+        """Device-readback flush phase: runs UNLOCKED, so next-interval
+        ingest proceeds concurrently with a large extraction
+        (SURVEY §7 "Latency budget")."""
+        _t = time.perf_counter()
+        snaps = job.snaps
+        for i, (worker, sw) in enumerate(zip(self.workers, job.swapped)):
             try:
-                snaps.append(worker.extract_snapshot(sw, qs, self.interval))
+                snaps.append(
+                    worker.extract_snapshot(sw, job.qs, self.interval))
             except Exception:
                 # per-flush data is expendable by design (README.md:135-137)
                 # but a readback failure on one worker must not destroy the
@@ -1467,7 +1563,7 @@ class Server:
                     self.stats.count("worker.metrics_flushed_total", n,
                                      tags=[f"metric_type:{mtype}"])
 
-        phases["extract_s"] = time.perf_counter() - _t
+        job.phases["extract_s"] = time.perf_counter() - _t
         # per-flush transfer accounting (health/ledger.py): the byte
         # counts that pin the O(samples) upload/readback diet, surfaced
         # the same way the reference surfaces flush phase timings
@@ -1485,7 +1581,13 @@ class Server:
             self.stats.time_in_nanoseconds(
                 "flush.extract_chunk_max_ns",
                 chunk_report["chunk_max_s"] * 1e9)
+
+    def _flush_generate(self, job) -> None:
+        """InterMetric-generation flush phase (host work over the
+        already-extracted snapshots). Stamps every metric with job.ts —
+        the tick-time clock — on both the columnar and object paths."""
         _t = time.perf_counter()
+        snaps = job.snaps
         # Columnar fast path: the flush never materializes per-metric
         # Python objects up front — at 1M series the object loop alone is
         # seconds of host time (core/columnar.py). Columnar-capable sinks
@@ -1494,17 +1596,16 @@ class Server:
         # sink no longer demotes every sink to the object path. Plugins
         # still need the object list, so they keep the legacy path.
         use_columnar = bool(self.metric_sinks) and not self.plugins
-        final: list[InterMetric] = []
+        final = job.final
         batch = None
         n_flushed = 0
         if use_columnar:
             from veneur_tpu.core.flusher import generate_columnar
 
-            ts_now = int(time.time())
             for snap in snaps:
                 b = generate_columnar(
                     snap, self.is_local, self.percentiles,
-                    self.aggregates, now=ts_now,
+                    self.aggregates, now=job.ts,
                     governor=self.flush_governor)
                 if batch is None:
                     batch = b
@@ -1517,12 +1618,14 @@ class Server:
                 final.extend(
                     generate_inter_metrics(
                         snap, self.is_local, self.percentiles,
-                        self.aggregates, governor=self.flush_governor
+                        self.aggregates, now=job.ts,
+                        governor=self.flush_governor
                     )
                 )
             n_flushed = len(final)
-        phases["generate_s"] = time.perf_counter() - _t
-        _t = time.perf_counter()
+        job.batch = batch
+        job.n_flushed = n_flushed
+        job.phases["generate_s"] = time.perf_counter() - _t
 
         if self.is_local and self.forwarder is not None:
             fwd_thread = threading.Thread(
@@ -1531,6 +1634,17 @@ class Server:
             )
             fwd_thread.start()
 
+    def _flush_emit(self, job) -> None:
+        """Sink-emission flush phase plus the flush's self-telemetry
+        tail. Rebinds last_flush_phases at the end so observers always
+        read the phases of the most recently COMPLETED flush."""
+        _t = time.perf_counter()
+        phases = job.phases
+        batch = job.batch
+        final = job.final
+        n_flushed = job.n_flushed
+        snaps = job.snaps
+        span_counts = job.span_counts
         if batch is not None and n_flushed:
             threads = []
             for sink in self.metric_sinks:
@@ -1648,13 +1762,14 @@ class Server:
         rss = _current_rss_bytes()
         if rss is not None:
             self.stats.gauge("mem.rss_bytes", float(rss))
+        # total duration from the tick-time clock: under the pipeline
+        # this includes inter-stage queue wait, which is the honest
+        # end-to-end latency of the interval's flush
         self.stats.time_in_nanoseconds(
-            "flush.total_duration_ns", (time.time() - flush_start) * 1e9)
-        if batch is not None:
-            # columnar flush: the batch supports len(); callers needing
-            # objects use .materialize()
-            return batch
-        return final
+            "flush.total_duration_ns",
+            (time.time() - job.flush_start) * 1e9)
+        self.last_flush_phases = phases
+        self.last_emit_unix = time.time()
 
     @staticmethod
     def _tally_timeseries(snaps: list[FlushSnapshot]) -> int:
@@ -1803,6 +1918,15 @@ class Server:
     def _shutdown_teardown(self) -> bool:
         """The winning shutdown() caller's teardown body."""
         self._stop_native_readers()
+        if self.flush_pipeline is not None:
+            # drain in-flight stages BEFORE sinks stop: the final
+            # admitted interval's metrics must reach the sinks (the
+            # shutdown contract tests/test_pipeline.py pins). Bounded —
+            # a wedged sink forfeits the drain rather than the shutdown.
+            if not self.flush_pipeline.stop(
+                    drain=True, timeout=max(10.0, 2.0 * self.interval)):
+                log.warning("flush pipeline did not drain within the "
+                            "shutdown budget; in-flight flush data lost")
         # join the compute threads (bounded): a daemon thread still
         # inside XLA/C++ when the interpreter finalizes is force-unwound
         # mid-frame — glibc's "FATAL: exception not rethrown" abort

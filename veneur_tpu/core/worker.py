@@ -1502,10 +1502,6 @@ class DeviceWorker:
         under the lock. The overlap-critical 1M-series local path never
         takes it.
         """
-        # one swap == one flush for this worker: reset the per-flush
-        # transfer tallies so extract_snapshot's uploads/readbacks are
-        # attributed to the interval they serve
-        self.ledger.begin_flush()
         # lifetime sample tally, taken BEFORE the native reset below
         # destroys the per-epoch counter (the server's flush telemetry
         # reads `processed` pre-swap; Server.ingress_stats reads this
@@ -1690,6 +1686,15 @@ class DeviceWorker:
         """Device readback for a swapped epoch. Safe to run outside the
         ingest lock — it touches only the swapped objects (plus immutable
         worker config), never the live epoch."""
+        # one extraction == one transfer window. The reset lives HERE,
+        # not in swap(): every ledger-counted transfer (staged-plane
+        # uploads, quantile upload, packed readback) happens inside this
+        # method, and under the stage pipeline the NEXT tick's swap runs
+        # on the ticker thread while this extraction is still counting —
+        # a swap-time reset would clobber the window mid-read. Extractions
+        # never overlap each other (single extract stage), so resetting
+        # on this thread keeps the windows tiling exactly.
+        self.ledger.begin_flush()
         directory = swapped.directory
         scalars = swapped.scalars
         histo = swapped.histo

@@ -155,6 +155,25 @@ class ProxyServer:
             else:
                 self.drops += len(sub.metrics)
 
+    def forward_stats(self) -> dict:
+        """Per-destination forward-path health (ForwardClient.stats):
+        attempt timings, error classes, consecutive failures and channel
+        reconnects — what the mesh soak reads to name the wedged side
+        of a forward-wait stall instead of timing out silently."""
+        with self._lock:
+            per_dest = {dest: c.stats() for dest, c in self._conns.items()}
+        return {
+            "proxied_metrics": self.proxied_metrics,
+            "drops": self.drops,
+            "destinations": per_dest,
+            "reconnects_total": sum(
+                d["reconnects"] for d in per_dest.values()),
+            "errors_total": {
+                cause: sum(d["errors"].get(cause, 0)
+                           for d in per_dest.values())
+                for cause in ("deadline_exceeded", "unavailable", "send")},
+        }
+
     def start_grpc(self, address: str = "127.0.0.1:0") -> int:
         self.grpc_server, self.port = rpc.make_server(
             self.handle_batch, address, raw_handler=self.handle_wire)

@@ -8,6 +8,8 @@ message codegen comes from protoc; see proto/veneur_tpu.proto).
 
 from __future__ import annotations
 
+import threading
+import time
 from concurrent import futures
 from typing import Callable, Optional
 
@@ -74,7 +76,22 @@ def make_server(handler: Callable[[pb.MetricBatch], None],
 class ForwardClient:
     """Client for the Forward service with the reference's error
     classification (flusher.go:511-527: deadline / transient / send —
-    counted, never retried; per-flush data is expendable by design)."""
+    counted, never retried; per-flush data is expendable by design).
+
+    Stall instrumentation (the ROADMAP 120-interval mesh-soak stall:
+    forward→import stops completing inside the deadline with near-zero
+    CPU — a wedged long-lived channel, not slowness): every attempt is
+    timed, consecutive failures are tracked, and after
+    RECONNECT_AFTER_FAILURES consecutive transport-shaped failures
+    (deadline/unavailable) the channel is REBUILT with exponential
+    backoff — a wedged HTTP/2 transport never heals by retrying the
+    same call object forever. stats() exposes all of it so the soak
+    can name the wedged side instead of timing out silently."""
+
+    # a single deadline can be a slow peer; two in a row on a
+    # long-lived channel is transport-shaped, so rebuild it
+    RECONNECT_AFTER_FAILURES = 2
+    RECONNECT_BACKOFF_MAX_S = 30.0
 
     def __init__(self, address: str, timeout_s: float = 10.0,
                  idle_timeout_s: float = 0.0) -> None:
@@ -87,7 +104,26 @@ class ForwardClient:
             # analog moves an idle channel to IDLE, closing transports
             options.append(
                 ("grpc.client_idle_timeout_ms", int(idle_timeout_s * 1000)))
-        self.channel = grpc.insecure_channel(address, options=options or None)
+        self._options = options
+        self._lock = threading.Lock()
+        self._build_channel()
+        self.errors: dict[str, int] = {
+            "deadline_exceeded": 0, "unavailable": 0, "send": 0,
+        }
+        self.last_error_cause: Optional[str] = None
+        self.sent_batches = 0
+        self.sent_metrics = 0
+        self.consecutive_failures = 0
+        self.reconnects = 0
+        self.last_send_s = 0.0
+        self.max_send_s = 0.0
+        self.last_ok_unix = 0.0
+        self._next_reconnect_unix = 0.0
+        self._reconnect_backoff_s = 1.0
+
+    def _build_channel(self) -> None:
+        self.channel = grpc.insecure_channel(
+            self.address, options=self._options or None)
         self._call = self.channel.unary_unary(
             SEND_METRICS,
             request_serializer=pb.MetricBatch.SerializeToString,
@@ -102,12 +138,6 @@ class ForwardClient:
             request_serializer=lambda b: b,
             response_deserializer=pb.SendResponse.FromString,
         )
-        self.errors: dict[str, int] = {
-            "deadline_exceeded": 0, "unavailable": 0, "send": 0,
-        }
-        self.last_error_cause: Optional[str] = None
-        self.sent_batches = 0
-        self.sent_metrics = 0
 
     def send(self, batch: pb.MetricBatch,
              timeout_s: Optional[float] = None) -> bool:
@@ -120,9 +150,11 @@ class ForwardClient:
 
     def _send(self, call, payload, n_metrics: int,
               timeout_s: Optional[float]) -> bool:
+        t0 = time.perf_counter()
         try:
             call(payload, timeout=timeout_s or self.timeout_s)
         except grpc.RpcError as e:
+            self._note_attempt(t0)
             code = e.code()
             if code == grpc.StatusCode.DEADLINE_EXCEEDED:
                 cause = "deadline_exceeded"
@@ -132,10 +164,61 @@ class ForwardClient:
                 cause = "send"
             self.errors[cause] += 1
             self.last_error_cause = cause
+            self.consecutive_failures += 1
+            if cause in ("deadline_exceeded", "unavailable"):
+                self._maybe_reconnect()
             return False
+        self._note_attempt(t0)
+        self.consecutive_failures = 0
+        self._reconnect_backoff_s = 1.0
+        self.last_ok_unix = time.time()
         self.sent_batches += 1
         self.sent_metrics += n_metrics
         return True
+
+    def _note_attempt(self, t0: float) -> None:
+        self.last_send_s = time.perf_counter() - t0
+        if self.last_send_s > self.max_send_s:
+            self.max_send_s = self.last_send_s
+
+    def _maybe_reconnect(self) -> None:
+        """Rebuild the channel after repeated transport-shaped failures,
+        at most once per backoff window (1s doubling to 30s). The old
+        channel is closed AFTER the swap so a concurrent sender fails
+        fast (classified "send") instead of hanging on it."""
+        if self.consecutive_failures < self.RECONNECT_AFTER_FAILURES:
+            return
+        now = time.time()
+        with self._lock:
+            if now < self._next_reconnect_unix:
+                return
+            backoff = self._reconnect_backoff_s
+            self._reconnect_backoff_s = min(
+                self.RECONNECT_BACKOFF_MAX_S, backoff * 2.0)
+            self._next_reconnect_unix = now + backoff
+            old = self.channel
+            self._build_channel()
+            self.reconnects += 1
+        try:
+            old.close()
+        except Exception:
+            pass
+
+    def stats(self) -> dict:
+        """Forward-path health snapshot (read by the proxy's
+        forward_stats and the mesh soak's stall diagnostics)."""
+        return {
+            "address": self.address,
+            "sent_batches": self.sent_batches,
+            "sent_metrics": self.sent_metrics,
+            "errors": dict(self.errors),
+            "consecutive_failures": self.consecutive_failures,
+            "reconnects": self.reconnects,
+            "last_send_s": round(self.last_send_s, 4),
+            "max_send_s": round(self.max_send_s, 4),
+            "last_ok_unix": self.last_ok_unix,
+            "last_error_cause": self.last_error_cause,
+        }
 
     def close(self) -> None:
         self.channel.close()

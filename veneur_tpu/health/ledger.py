@@ -21,11 +21,14 @@ Counting sits host-side around the existing transfer calls rather than
 in a jax transfer-guard hook: guards can veto transfers but do not
 expose byte counts, and the flush path's transfers are few and known.
 
-Thread-safety: one ledger per worker; within a flush all writes come
-from the flush thread, but `begin_flush` (swap, under the ingest lock)
-and telemetry reads may race extraction, so mutation goes through a
-lock. Overhead is a dict update per transfer — nanoseconds against a
-millisecond-scale device round-trip.
+Thread-safety: one ledger per worker. `begin_flush` runs at the start
+of extract_snapshot — the same stage that performs every counted
+transfer — so window reset, counting, and the server's end-of-extract
+reads are all serialized on the extract thread even under the stage
+pipeline (where the next tick's swap overlaps a running extraction).
+Telemetry reads from other threads may still race a count, so mutation
+goes through a lock. Overhead is a dict update per transfer —
+nanoseconds against a millisecond-scale device round-trip.
 """
 
 from __future__ import annotations

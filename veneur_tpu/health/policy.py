@@ -41,6 +41,27 @@ from __future__ import annotations
 # can't legitimately take 4x its prediction plus an interval's slack.
 STALL_MULTIPLIER = 4
 
+# Stage-parallel flush backpressure (core/pipeline.py): each stage
+# queue holds at most this many intervals beyond the one the stage is
+# working on. The bound is deliberately one, not a tunable depth — the
+# pipeline's whole point is overlap, not buffering. A stage more than
+# one interval behind means the host cannot keep cadence at this
+# cardinality, and the correct response is the shedding layer
+# (_adapt_spill_caps halving the C++ spill caps / the governor's chunk
+# ladder), not a growing queue that converts overload into unbounded
+# memory and staleness.
+MAX_STAGE_BACKLOG = 1
+
+
+def pipeline_should_shed(queue_depth: int,
+                         max_backlog: int = MAX_STAGE_BACKLOG) -> bool:
+    """The backpressure contract for the stage-parallel flush executor:
+    shed (drop the oldest pending interval and signal overload) instead
+    of enqueueing once a stage already has `max_backlog` intervals
+    waiting. Centralised here so the watchdog-vs-shedding contract
+    above and the pipeline's shed rule are documented as one policy."""
+    return queue_depth >= max(1, int(max_backlog))
+
 
 def stall_window_s(interval_s: float, chunk_target_s: float) -> float:
     """Maximum progress-beat age that still counts as a live flush."""

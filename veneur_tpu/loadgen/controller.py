@@ -169,6 +169,14 @@ class LoadHarness:
                        - prev["samples_processed"])
                 shed = (snap["overload_dropped"]
                         - prev["overload_dropped"])
+                # cadence decomposition: how long the tick held the
+                # ticker thread (the whole serial flush; just the
+                # swap+enqueue when pipelined), how long ingest was
+                # stalled under the worker locks (the swap phase), and
+                # the total flush work of the last COMPLETED flush —
+                # on a 1-core rig the gap between tick_block_ms and
+                # flush_ms is exactly what the stage pipeline buys
+                flush_phases = snap.get("last_flush_phases") or {}
                 intervals.append({
                     "duration_s": round(dt, 4),
                     "flushes": snap["flush_count"] - prev["flush_count"],
@@ -180,6 +188,12 @@ class LoadHarness:
                     "loss_frac": round(max(0.0, 1.0 - acc / sent), 5)
                     if sent > 0 else 0.0,
                     "cadence_ok": bool(ok and dt <= self.interval * 1.5),
+                    "tick_block_ms": round(
+                        snap.get("last_tick_s", 0.0) * 1e3, 2),
+                    "ingest_stall_ms": round(
+                        flush_phases.get("swap_s", 0.0) * 1e3, 2),
+                    "flush_ms": round(
+                        sum(flush_phases.values()) * 1e3, 2),
                 })
                 prev = snap
                 self._drain_sink()
@@ -192,7 +206,16 @@ class LoadHarness:
         total_acc = sum(i["accepted_lines"] for i in intervals)
         total_dt = sum(i["duration_s"] for i in intervals)
         n_ok = sum(1 for i in intervals if i["cadence_ok"])
+        n_iv = max(1, len(intervals))
+        pipeline_stats = self.server.ingress_stats().get("pipeline")
         return {
+            "tick_block_ms_mean": round(
+                sum(i["tick_block_ms"] for i in intervals) / n_iv, 2),
+            "ingest_stall_ms_mean": round(
+                sum(i["ingest_stall_ms"] for i in intervals) / n_iv, 2),
+            "flush_ms_mean": round(
+                sum(i["flush_ms"] for i in intervals) / n_iv, 2),
+            **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             "offered_lines_per_s": rate,
             "intervals": intervals,
             "total_sent": total_sent,
@@ -343,10 +366,23 @@ def result_artifact(spec: WorkloadSpec, harness: LoadHarness,
         "shed_lines": confirm.get("total_shed"),
         "cadence_frac": confirm.get("cadence_frac"),
         "flushed_series": harness.flushed_series,
+        # cadence decomposition of the confirmation run: how long the
+        # tick held the ticker thread vs how long ingest stalled under
+        # the worker locks vs the full flush work — on a 1-core rig
+        # tick_block ≈ flush is the cadence-bound serial signature,
+        # tick_block ≈ ingest_stall « flush is the pipelined one
+        "tick_block_ms_mean": confirm.get("tick_block_ms_mean"),
+        "ingest_stall_ms_mean": confirm.get("ingest_stall_ms_mean"),
+        "flush_ms_mean": confirm.get("flush_ms_mean"),
+        **({"pipeline": confirm["pipeline"]}
+           if confirm.get("pipeline") else {}),
         "search_trials": [
             {k: t[k] for k in ("offered_lines_per_s",
                                "accepted_lines_per_s", "loss_frac",
-                               "cadence_frac", "passed")}
+                               "cadence_frac", "passed",
+                               "tick_block_ms_mean",
+                               "ingest_stall_ms_mean", "flush_ms_mean",
+                               "total_shed")}
             for t in search["search_trials"]],
         "north_star_lines_per_s": NORTH_STAR_LINES_PER_S,
         "cores_needed_for_north_star":
