@@ -2751,643 +2751,9 @@ long long vn_upsert_many(void* p, const char* meta, long long meta_len,
 }
 
 // ---------------------------------------------------------------------------
-// Datadog series-body emitter: the flagship sink's JSON at columnar
-// speed. Python dict-building + json.dumps costs ~3us per emitted
-// metric (~18s at a 1M-series flush x 6 families); this emits the
-// chunked {"series":[...]} bodies straight from the columnar arrays +
-// the shared meta blob (same "name \x1f tag..." records the forward
-// encoder uses). Tag semantics mirror DatadogMetricSink._finalize_one:
-// host:/device: extraction, exact-key exclusion (server tags_exclude),
-// prefix exclusion (sink excluded_tags), metric-name prefix drops.
-
-namespace {
-
-void json_escape_append(std::string* out, std::string_view s) {
-  for (unsigned char c : s) {
-    switch (c) {
-      case '"':
-        out->append("\\\"");
-        break;
-      case '\\':
-        out->append("\\\\");
-        break;
-      case '\b':
-        out->append("\\b");
-        break;
-      case '\f':
-        out->append("\\f");
-        break;
-      case '\n':
-        out->append("\\n");
-        break;
-      case '\r':
-        out->append("\\r");
-        break;
-      case '\t':
-        out->append("\\t");
-        break;
-      default:
-        if (c < 0x20) {
-          char buf[8];
-          std::snprintf(buf, sizeof buf, "\\u%04x", c);
-          out->append(buf);
-        } else {
-          out->push_back(static_cast<char>(c));
-        }
-    }
-  }
-}
-
-void json_number_append(std::string* out, double v) {
-  // shortest round-trip via std::to_chars (like python repr); JSON
-  // forbids NaN/Inf — the python path would raise, we emit null to
-  // keep the body valid
-  if (!std::isfinite(v)) {
-    out->append("null");
-    return;
-  }
-  char buf[32];
-#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
-  auto res = std::to_chars(buf, buf + sizeof buf, v);
-  out->append(buf, static_cast<size_t>(res.ptr - buf));
-#else
-  // libstdc++ < 11 has no floating-point to_chars: emulate its
-  // shortest-CHARACTERS round-trip guarantee by scanning %g precisions
-  // and keeping the shortest string that reads back equal (minimal
-  // precision alone is wrong — %.1g renders 20.0 as "2e+01", while
-  // to_chars and the emitters' plain-int detection expect "20")
-  int best = -1;
-  char bestbuf[32];
-  for (int prec = 1; prec <= 17; ++prec) {
-    int n = snprintf(buf, sizeof buf, "%.*g", prec, v);
-    if (n > 0 && n < static_cast<int>(sizeof buf) &&
-        strtod(buf, nullptr) == v && (best < 0 || n < best)) {
-      best = n;
-      memcpy(bestbuf, buf, static_cast<size_t>(n));
-    }
-  }
-  if (best < 0) {
-    best = snprintf(bestbuf, sizeof bestbuf, "%.17g", v);
-  }
-  out->append(bestbuf, static_cast<size_t>(best));
-#endif
-}
-
-std::vector<std::string_view> split_us(std::string_view blob) {
-  std::vector<std::string_view> out;
-  if (blob.empty()) return out;
-  size_t pos = 0;
-  for (;;) {
-    size_t e = blob.find('\x1f', pos);
-    if (e == std::string_view::npos) {
-      out.push_back(blob.substr(pos));
-      return out;
-    }
-    out.push_back(blob.substr(pos, e - pos));
-    pos = e + 1;
-  }
-}
-
-struct DDOut {
-  std::string buf;
-  std::vector<long long> chunk_off;
-};
-thread_local DDOut g_dd;
-
-}  // namespace
-
-// Emits n_chunks bodies, each a complete {"series":[...]} JSON object
-// of at most max_per_body entries, concatenated in one buffer with
-// chunk offsets ([n_chunks+1]). Buffers are thread-local (valid until
-// the calling thread's next call). Returns n_chunks, or -1 on
-// malformed meta.
-long long vn_encode_datadog_series(
-    const char* meta, long long meta_len, long long nrows,
-    const char* suffixes_blob, long long suffixes_len,
-    const signed char* family_types, int nfam, const double* values,
-    const unsigned char* masks, long long ts, double interval,
-    const char* hostname, long long hostname_len, const char* common,
-    long long common_len, const char* excl_keys_blob,
-    long long excl_keys_len, const char* excl_prefix_blob,
-    long long excl_prefix_len, const char* drop_prefix_blob,
-    long long drop_prefix_len, long long max_per_body,
-    const long long** chunk_off_out, const char** out,
-    long long* out_len, long long* entries_out) {
-  DDOut& o = g_dd;
-  o.buf.clear();
-  o.chunk_off.clear();
-  o.buf.reserve(static_cast<size_t>(nrows) * nfam * 96);
-
-  std::vector<std::string_view> suffixes =
-      split_us(std::string_view(suffixes_blob,
-                                static_cast<size_t>(suffixes_len)));
-  // empty suffixes vanish in the join; pad back to nfam
-  while (static_cast<int>(suffixes.size()) < nfam)
-    suffixes.push_back(std::string_view());
-  std::vector<std::string_view> excl_keys = split_us(
-      std::string_view(excl_keys_blob, static_cast<size_t>(excl_keys_len)));
-  std::vector<std::string_view> excl_prefixes = split_us(std::string_view(
-      excl_prefix_blob, static_cast<size_t>(excl_prefix_len)));
-  std::vector<std::string_view> drop_prefixes = split_us(std::string_view(
-      drop_prefix_blob, static_cast<size_t>(drop_prefix_len)));
-  std::string_view host_default(hostname,
-                                static_cast<size_t>(hostname_len));
-  std::string_view common_frag(common, static_cast<size_t>(common_len));
-
-  // pre-split the meta records once
-  std::string_view blob(meta, static_cast<size_t>(meta_len));
-  std::vector<std::string_view> recs;
-  recs.reserve(static_cast<size_t>(nrows));
-  {
-    size_t pos = 0;
-    for (long long i = 0; i < nrows; ++i) {
-      size_t e = blob.find('\x1e', pos);
-      if (e == std::string_view::npos) e = blob.size();
-      recs.push_back(blob.substr(pos, e - pos));
-      pos = e + 1;
-    }
-  }
-
-  const char* interval_str_end = nullptr;
-  char interval_buf[24];
-  std::snprintf(interval_buf, sizeof interval_buf, "%lld",
-                static_cast<long long>(interval));
-  (void)interval_str_end;
-
-  long long in_chunk = 0;
-  long long entries_total = 0;
-  bool chunk_open = false;
-  auto open_chunk = [&]() {
-    o.chunk_off.push_back(static_cast<long long>(o.buf.size()));
-    o.buf.append("{\"series\":[");
-    in_chunk = 0;
-    chunk_open = true;
-  };
-  auto close_chunk = [&]() {
-    if (chunk_open) {
-      o.buf.append("]}");
-      chunk_open = false;
-    }
-  };
-
-  std::string tag_scratch;
-  for (int f = 0; f < nfam; ++f) {
-    std::string_view suffix = suffixes[f];
-    bool is_rate = family_types[f] == 0;
-    const double* vals = values + static_cast<size_t>(f) * nrows;
-    const unsigned char* mask = masks + static_cast<size_t>(f) * nrows;
-    for (long long r = 0; r < nrows; ++r) {
-      if (!mask[r]) continue;
-      std::string_view rec = recs[static_cast<size_t>(r)];
-      size_t nend = rec.find('\x1f');
-      std::string_view name =
-          nend == std::string_view::npos ? rec : rec.substr(0, nend);
-      // name drops apply to the FULL emitted name (base + suffix); the
-      // python path checks m.name which already carries the suffix
-      bool dropped = false;
-      for (std::string_view p : drop_prefixes) {
-        if (name.size() >= p.size() &&
-            name.compare(0, p.size(), p) == 0) {
-          dropped = true;
-          break;
-        }
-        // suffix may complete the prefix match only if prefix is
-        // longer than the base name; rare — handle by building the
-        // full name check below when p is longer
-        if (p.size() > name.size()) {
-          std::string full(name);
-          full.append(suffix);
-          if (full.compare(0, p.size(), p) == 0) {
-            dropped = true;
-            break;
-          }
-        }
-      }
-      if (dropped) continue;
-
-      // tags: host/device extraction + exclusions
-      std::string_view host = host_default;
-      std::string_view device;
-      tag_scratch.clear();
-      if (nend != std::string_view::npos) {
-        std::string_view rest = rec.substr(nend + 1);
-        for (;;) {
-          size_t e = rest.find('\x1f');
-          std::string_view tag =
-              e == std::string_view::npos ? rest : rest.substr(0, e);
-          // server-level key exclusion removes the tag before the sink
-          // ever sees it (strip_excluded_tags runs first on the Python
-          // paths) — including before host:/device: extraction
-          bool skip = false;
-          {
-            size_t colon = tag.find(':');
-            std::string_view key =
-                colon == std::string_view::npos ? tag
-                                                : tag.substr(0, colon);
-            for (std::string_view k : excl_keys) {
-              if (key == k) {
-                skip = true;
-                break;
-              }
-            }
-          }
-          if (!skip) {
-            if (tag.size() >= 5 && tag.compare(0, 5, "host:") == 0) {
-              if (tag.size() > 5) host = tag.substr(5);
-              skip = true;
-            } else if (tag.size() >= 7 &&
-                       tag.compare(0, 7, "device:") == 0) {
-              device = tag.substr(7);
-              skip = true;
-            }
-          }
-          if (!skip) {
-            for (std::string_view p : excl_prefixes) {
-              if (tag.size() >= p.size() &&
-                  tag.compare(0, p.size(), p) == 0) {
-                skip = true;
-                break;
-              }
-            }
-          }
-          if (!skip) {
-            tag_scratch.push_back(',');
-            tag_scratch.push_back('"');
-            json_escape_append(&tag_scratch, tag);
-            tag_scratch.push_back('"');
-          }
-          if (e == std::string_view::npos) break;
-          rest = rest.substr(e + 1);
-        }
-      }
-
-      if (!chunk_open) open_chunk();
-      if (in_chunk) o.buf.push_back(',');
-      o.buf.append("{\"metric\":\"");
-      json_escape_append(&o.buf, name);
-      json_escape_append(&o.buf, suffix);
-      o.buf.append("\",\"points\":[[");
-      char tsbuf[24];
-      std::snprintf(tsbuf, sizeof tsbuf, "%lld", ts);
-      o.buf.append(tsbuf);
-      o.buf.push_back(',');
-      json_number_append(&o.buf,
-                         is_rate ? vals[r] / interval : vals[r]);
-      o.buf.append("]],\"tags\":[");
-      bool any_common = common_frag.size() > 0;
-      if (any_common) o.buf.append(common_frag);
-      if (!tag_scratch.empty()) {
-        if (any_common)
-          o.buf.append(tag_scratch);  // starts with ','
-        else
-          o.buf.append(tag_scratch.data() + 1, tag_scratch.size() - 1);
-      }
-      o.buf.append("],\"type\":\"");
-      o.buf.append(is_rate ? "rate" : "gauge");
-      o.buf.append("\",\"interval\":");
-      o.buf.append(interval_buf);
-      o.buf.append(",\"host\":\"");
-      json_escape_append(&o.buf, host);
-      o.buf.append("\",\"device_name\":\"");
-      json_escape_append(&o.buf, device);
-      o.buf.append("\"}");
-      ++in_chunk;
-      ++entries_total;
-      if (in_chunk >= max_per_body) close_chunk();
-    }
-  }
-  close_chunk();
-  o.chunk_off.push_back(static_cast<long long>(o.buf.size()));
-  *entries_out = entries_total;
-  *chunk_off_out = o.chunk_off.data();
-  *out = o.buf.data();
-  *out_len = static_cast<long long>(o.buf.size());
-  return static_cast<long long>(o.chunk_off.size()) - 1;
-}
-
-// ---------------------------------------------------------------------------
-// Prometheus statsd-repeater line emitter: "name:value|kind|#tag,..."
-// lines from the columnar arrays + meta blob, with the exporter's
-// character sanitization (sinks/prometheus.py sanitize_name/tag).
-
-namespace {
-
-inline bool prom_name_ok(unsigned char c) {
-  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
-         (c >= '0' && c <= '9') || c == '_' || c == ':' || c == '.';
-}
-
-inline bool prom_tag_ok(unsigned char c) {
-  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
-         (c >= '0' && c <= '9') || c == '_' || c == ':' || c == ',' ||
-         c == '=' || c == '.';
-}
-
-void prom_append(std::string* out, std::string_view s, bool name_rules) {
-  for (unsigned char c : s)
-    out->push_back((name_rules ? prom_name_ok(c) : prom_tag_ok(c))
-                       ? static_cast<char>(c)
-                       : '_');
-}
-
-}  // namespace
-
-// Emits newline-separated statsd lines into a thread-local buffer.
-// family_types: 0 counter ("|c"), 1 gauge ("|g"). excl_keys: \x1f-joined
-// exact tag keys to drop (server-level exclusion). Returns the emitted
-// line count; *out/*out_len carry the buffer.
-long long vn_encode_prometheus_lines(
-    const char* meta, long long meta_len, long long nrows,
-    const char* suffixes_blob, long long suffixes_len,
-    const signed char* family_types, int nfam, const double* values,
-    const unsigned char* masks, const char* excl_keys_blob,
-    long long excl_keys_len, const char** out, long long* out_len) {
-  thread_local std::string buf;
-  buf.clear();
-  buf.reserve(static_cast<size_t>(nrows) * nfam * 48);
-
-  std::vector<std::string_view> suffixes =
-      split_us(std::string_view(suffixes_blob,
-                                static_cast<size_t>(suffixes_len)));
-  while (static_cast<int>(suffixes.size()) < nfam)
-    suffixes.push_back(std::string_view());
-  std::vector<std::string_view> excl_keys = split_us(
-      std::string_view(excl_keys_blob, static_cast<size_t>(excl_keys_len)));
-
-  std::string_view blob(meta, static_cast<size_t>(meta_len));
-  std::vector<std::string_view> recs;
-  recs.reserve(static_cast<size_t>(nrows));
-  {
-    size_t pos = 0;
-    for (long long i = 0; i < nrows; ++i) {
-      size_t e = blob.find('\x1e', pos);
-      if (e == std::string_view::npos) e = blob.size();
-      recs.push_back(blob.substr(pos, e - pos));
-      pos = e + 1;
-    }
-  }
-
-  long long emitted = 0;
-  for (int f = 0; f < nfam; ++f) {
-    std::string_view suffix = suffixes[f];
-    const char kind = family_types[f] == 0 ? 'c' : 'g';
-    const double* vals = values + static_cast<size_t>(f) * nrows;
-    const unsigned char* mask = masks + static_cast<size_t>(f) * nrows;
-    for (long long r = 0; r < nrows; ++r) {
-      if (!mask[r]) continue;
-      std::string_view rec = recs[static_cast<size_t>(r)];
-      size_t nend = rec.find('\x1f');
-      std::string_view name =
-          nend == std::string_view::npos ? rec : rec.substr(0, nend);
-      prom_append(&buf, name, true);
-      prom_append(&buf, suffix, true);
-      buf.push_back(':');
-      {
-        // match python str(float): integral values carry a ".0"
-        size_t vstart = buf.size();
-        json_number_append(&buf, vals[r]);
-        bool plain_int = true;
-        for (size_t i = vstart; i < buf.size(); ++i) {
-          char ch = buf[i];
-          if (!(ch == '-' || (ch >= '0' && ch <= '9'))) {
-            plain_int = false;
-            break;
-          }
-        }
-        if (plain_int) buf.append(".0");
-      }
-      buf.push_back('|');
-      buf.push_back(kind);
-      bool first_tag = true;
-      if (nend != std::string_view::npos) {
-        std::string_view rest = rec.substr(nend + 1);
-        for (;;) {
-          size_t e = rest.find('\x1f');
-          std::string_view tag =
-              e == std::string_view::npos ? rest : rest.substr(0, e);
-          bool skip = false;
-          size_t colon = tag.find(':');
-          std::string_view key =
-              colon == std::string_view::npos ? tag : tag.substr(0, colon);
-          for (std::string_view k : excl_keys) {
-            if (key == k) {
-              skip = true;
-              break;
-            }
-          }
-          if (!skip) {
-            buf.append(first_tag ? "|#" : ",");
-            prom_append(&buf, tag, false);
-            first_tag = false;
-          }
-          if (e == std::string_view::npos) break;
-          rest = rest.substr(e + 1);
-        }
-      }
-      buf.push_back('\n');
-      ++emitted;
-    }
-  }
-  if (!buf.empty()) buf.pop_back();  // no trailing newline
-  *out = buf.data();
-  *out_len = static_cast<long long>(buf.size());
-  return emitted;
-}
-
-// ---------------------------------------------------------------------------
-// SignalFx datapoint-body emitter: {"counter":[...],"gauge":[...]}
-// from the columnar arrays + meta blob. Dimensions are a JSON object
-// built from "k:v" tags (last duplicate key wins, as a Python dict
-// does); the hostname dimension key is configurable. Tag-prefix drops
-// reject the whole metric (sinks/signalfx.py _convert_fields). The
-// single-API-key case only — vary_key_by routing stays in Python.
-
-// Emits ONE body. family_types: 0 counter, 1 gauge. Returns emitted
-// count; -1 on malformed meta.
-long long vn_encode_signalfx_body(
-    const char* meta, long long meta_len, long long nrows,
-    const char* suffixes_blob, long long suffixes_len,
-    const signed char* family_types, int nfam, const double* values,
-    const unsigned char* masks, long long ts_ms,
-    const char* hostname_tag, long long hostname_tag_len,
-    const char* hostname, long long hostname_len,
-    const char* name_drop_blob, long long name_drop_len,
-    const char* tag_drop_blob, long long tag_drop_len,
-    const char* excl_keys_blob, long long excl_keys_len,
-    const char** out, long long* out_len) {
-  thread_local std::string buf;
-  thread_local std::string counters_part;
-  thread_local std::string gauges_part;
-  buf.clear();
-  counters_part.clear();
-  gauges_part.clear();
-
-  std::vector<std::string_view> suffixes =
-      split_us(std::string_view(suffixes_blob,
-                                static_cast<size_t>(suffixes_len)));
-  while (static_cast<int>(suffixes.size()) < nfam)
-    suffixes.push_back(std::string_view());
-  std::vector<std::string_view> name_drops = split_us(
-      std::string_view(name_drop_blob, static_cast<size_t>(name_drop_len)));
-  std::vector<std::string_view> tag_drops = split_us(
-      std::string_view(tag_drop_blob, static_cast<size_t>(tag_drop_len)));
-  std::vector<std::string_view> excl_keys = split_us(
-      std::string_view(excl_keys_blob, static_cast<size_t>(excl_keys_len)));
-  std::string_view host_tag(hostname_tag,
-                            static_cast<size_t>(hostname_tag_len));
-  std::string_view host_val(hostname, static_cast<size_t>(hostname_len));
-
-  std::string_view blob(meta, static_cast<size_t>(meta_len));
-  std::vector<std::string_view> recs;
-  recs.reserve(static_cast<size_t>(nrows));
-  {
-    size_t pos = 0;
-    for (long long i = 0; i < nrows; ++i) {
-      size_t e = blob.find('\x1e', pos);
-      if (e == std::string_view::npos) e = blob.size();
-      recs.push_back(blob.substr(pos, e - pos));
-      pos = e + 1;
-    }
-  }
-
-  char tsbuf[24];
-  std::snprintf(tsbuf, sizeof tsbuf, "%lld", ts_ms);
-  long long emitted = 0;
-  std::vector<std::pair<std::string_view, std::string_view>> dims;
-  for (int f = 0; f < nfam; ++f) {
-    std::string_view suffix = suffixes[f];
-    std::string& part = family_types[f] == 0 ? counters_part : gauges_part;
-    const double* vals = values + static_cast<size_t>(f) * nrows;
-    const unsigned char* mask = masks + static_cast<size_t>(f) * nrows;
-    for (long long r = 0; r < nrows; ++r) {
-      if (!mask[r]) continue;
-      std::string_view rec = recs[static_cast<size_t>(r)];
-      size_t nend = rec.find('\x1f');
-      std::string_view name =
-          nend == std::string_view::npos ? rec : rec.substr(0, nend);
-      bool dropped = false;
-      for (std::string_view p : name_drops) {
-        if (name.size() >= p.size() &&
-            name.compare(0, p.size(), p) == 0) {
-          dropped = true;
-          break;
-        }
-        if (p.size() > name.size()) {
-          std::string full(name);
-          full.append(suffix);
-          if (full.compare(0, p.size(), p) == 0) {
-            dropped = true;
-            break;
-          }
-        }
-      }
-      if (dropped) continue;
-
-      // dimensions: k:v tags, last duplicate key wins (python dict)
-      dims.clear();
-      if (nend != std::string_view::npos) {
-        std::string_view rest = rec.substr(nend + 1);
-        for (;;) {
-          size_t e = rest.find('\x1f');
-          std::string_view tag =
-              e == std::string_view::npos ? rest : rest.substr(0, e);
-          for (std::string_view p : tag_drops) {
-            if (tag.size() >= p.size() &&
-                tag.compare(0, p.size(), p) == 0) {
-              dropped = true;
-              break;
-            }
-          }
-          if (dropped) break;
-          size_t colon = tag.find(':');
-          std::string_view key =
-              colon == std::string_view::npos ? tag : tag.substr(0, colon);
-          std::string_view val =
-              colon == std::string_view::npos ? std::string_view()
-                                              : tag.substr(colon + 1);
-          bool excl = false;
-          for (std::string_view k : excl_keys) {
-            if (key == k) {
-              excl = true;
-              break;
-            }
-          }
-          if (!excl) {
-            bool replaced = false;
-            for (auto& kv : dims) {
-              if (kv.first == key) {
-                kv.second = val;
-                replaced = true;
-                break;
-              }
-            }
-            if (!replaced) dims.emplace_back(key, val);
-          }
-          if (e == std::string_view::npos) break;
-          rest = rest.substr(e + 1);
-        }
-      }
-      if (dropped) continue;
-
-      if (!part.empty()) part.push_back(',');
-      part.append("{\"metric\":\"");
-      json_escape_append(&part, name);
-      json_escape_append(&part, suffix);
-      part.append("\",\"value\":");
-      json_number_append(&part, vals[r]);
-      part.append(",\"timestamp\":");
-      part.append(tsbuf);
-      part.append(",\"dimensions\":{");
-      // a tag with the hostname key overrides the default host dim
-      // (python seeds dims with it, then tags overwrite)
-      bool host_overridden = false;
-      for (auto& kv : dims) {
-        if (kv.first == host_tag) {
-          host_overridden = true;
-          break;
-        }
-      }
-      bool first_dim = true;
-      if (!host_overridden) {
-        part.push_back('"');
-        json_escape_append(&part, host_tag);
-        part.append("\":\"");
-        json_escape_append(&part, host_val);
-        part.push_back('"');
-        first_dim = false;
-      }
-      for (auto& kv : dims) {
-        if (!first_dim) part.push_back(',');
-        first_dim = false;
-        part.push_back('"');
-        json_escape_append(&part, kv.first);
-        part.append("\":\"");
-        json_escape_append(&part, kv.second);
-        part.push_back('"');
-      }
-      part.append("}}");
-      ++emitted;
-    }
-  }
-  buf.push_back('{');
-  bool any = false;
-  if (!counters_part.empty()) {
-    buf.append("\"counter\":[");
-    buf.append(counters_part);
-    buf.push_back(']');
-    any = true;
-  }
-  if (!gauges_part.empty()) {
-    if (any) buf.push_back(',');
-    buf.append("\"gauge\":[");
-    buf.append(gauges_part);
-    buf.push_back(']');
-  }
-  buf.push_back('}');
-  *out = buf.data();
-  *out_len = static_cast<long long>(buf.size());
-  return emitted;
-}
+// Columnar emit serializers (vn_encode_datadog_series, the statsd line
+// emitters, vn_encode_signalfx_body, exposition text, deflate) live in
+// emit.cpp — the emit tier of the library (built into the same .so).
 
 // SSF span fast path. Returns 1 ok, 0 decode error, -1 fallback needed
 // (span carries STATUS samples; nothing was ingested).

@@ -1,0 +1,1001 @@
+// Native GIL-free columnar emit: finished sink wire payloads straight
+// from the flush arrays.
+//
+// The Python emit tier costs ~µs of dict/format work per emitted metric
+// under the GIL, which timeslices against ingest on shared cores
+// (PERF_MODEL.md cadence decomposition). Every serializer here is a
+// single C-speed pass over the ColumnarMetrics buffers — the \x1e-joined
+// meta blob ("name \x1f tag \x1f ..." records, the same fragments the
+// forward encoder uses) plus dense f64 value / u8 mask planes — called
+// through ctypes, so the GIL is released for the whole body build.
+//
+// Emitters:
+//   vn_encode_datadog_series       chunked {"series":[...]} JSON bodies
+//   vn_encode_signalfx_body        {"counter":[...],"gauge":[...]} body
+//   vn_encode_prometheus_lines     statsd-repeater lines (sanitized)
+//   vn_encode_forward_lines        DogStatsD forward lines (verbatim)
+//   vn_encode_prometheus_exposition  exposition text (pushgateway)
+//   vn_deflate / vn_deflate_chunks zlib deflate (== Python zlib.compress)
+//
+// Output is pinned byte-identical to the sinks' Python formatters by
+// tests/test_emit_parity.py. Buffers are thread-local: a result is valid
+// until the calling thread's next call into the same emitter.
+
+#include <zlib.h>
+
+#include <algorithm>
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace {
+
+void json_escape_append(std::string* out, std::string_view s) {
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"':
+        out->append("\\\"");
+        break;
+      case '\\':
+        out->append("\\\\");
+        break;
+      case '\b':
+        out->append("\\b");
+        break;
+      case '\f':
+        out->append("\\f");
+        break;
+      case '\n':
+        out->append("\\n");
+        break;
+      case '\r':
+        out->append("\\r");
+        break;
+      case '\t':
+        out->append("\\t");
+        break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out->append(buf);
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+}
+
+void json_number_append(std::string* out, double v) {
+  // shortest round-trip via std::to_chars (like python repr); JSON
+  // forbids NaN/Inf — the python path emits null too (parity), keeping
+  // the body valid
+  if (!std::isfinite(v)) {
+    out->append("null");
+    return;
+  }
+  char buf[32];
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  auto res = std::to_chars(buf, buf + sizeof buf, v);
+  out->append(buf, static_cast<size_t>(res.ptr - buf));
+#else
+  // libstdc++ < 11 has no floating-point to_chars: emulate its
+  // shortest-CHARACTERS round-trip guarantee by scanning %g precisions
+  // and keeping the shortest string that reads back equal (minimal
+  // precision alone is wrong — %.1g renders 20.0 as "2e+01", while
+  // to_chars and the emitters' plain-int detection expect "20")
+  int best = -1;
+  char bestbuf[32];
+  for (int prec = 1; prec <= 17; ++prec) {
+    int n = snprintf(buf, sizeof buf, "%.*g", prec, v);
+    if (n > 0 && n < static_cast<int>(sizeof buf) &&
+        strtod(buf, nullptr) == v && (best < 0 || n < best)) {
+      best = n;
+      memcpy(bestbuf, buf, static_cast<size_t>(n));
+    }
+  }
+  if (best < 0) {
+    best = snprintf(bestbuf, sizeof bestbuf, "%.17g", v);
+  }
+  out->append(bestbuf, static_cast<size_t>(best));
+#endif
+}
+
+// str(float) semantics for the line-oriented emitters, pinned
+// byte-identical to CPython's float repr (the Python formatters print
+// values with f-strings): find the SHORTEST significant-digit count
+// that round-trips (scan correctly-rounded %.*e — the minimal p that
+// reads back equal is exactly repr's digit string), then apply
+// CPython's notation rule: fixed for -4 <= exp10 < 16 (integral values
+// carry ".0"), otherwise scientific with a 2-digit signed exponent.
+// NOTE deliberately NOT shortest-STRING (std::to_chars / a %g scan):
+// those render 1e5 as "1e+05" where CPython prints "100000.0".
+void py_float_append(std::string* out, double v) {
+  if (std::isnan(v)) {
+    out->append("nan");
+    return;
+  }
+  if (std::isinf(v)) {
+    out->append(v > 0 ? "inf" : "-inf");
+    return;
+  }
+  if (v == 0.0) {
+    out->append(std::signbit(v) ? "-0.0" : "0.0");
+    return;
+  }
+  char buf[40];
+  int prec = 17;
+  for (int p = 1; p <= 17; ++p) {
+    snprintf(buf, sizeof buf, "%.*e", p - 1, v);
+    if (strtod(buf, nullptr) == v) {
+      prec = p;
+      break;
+    }
+  }
+  snprintf(buf, sizeof buf, "%.*e", prec - 1, v);
+  // parse "d.dddde±XX" back into digits + exponent
+  char digits[24];
+  int ndig = 0;
+  int exp10 = 0;
+  bool neg = false;
+  for (const char* c = buf; *c; ++c) {
+    if (*c == '-' && ndig == 0 && !neg) {
+      neg = true;
+    } else if (*c >= '0' && *c <= '9') {
+      digits[ndig++] = *c;
+    } else if (*c == 'e' || *c == 'E') {
+      exp10 = static_cast<int>(strtol(c + 1, nullptr, 10));
+      break;
+    }
+  }
+  if (neg) out->push_back('-');
+  if (exp10 >= -4 && exp10 < 16) {
+    if (exp10 >= ndig - 1) {
+      // integral: all digits, zero-pad, ".0"
+      out->append(digits, static_cast<size_t>(ndig));
+      out->append(static_cast<size_t>(exp10 - (ndig - 1)), '0');
+      out->append(".0");
+    } else if (exp10 >= 0) {
+      out->append(digits, static_cast<size_t>(exp10 + 1));
+      out->push_back('.');
+      out->append(digits + exp10 + 1,
+                  static_cast<size_t>(ndig - exp10 - 1));
+    } else {
+      out->append("0.");
+      out->append(static_cast<size_t>(-exp10 - 1), '0');
+      out->append(digits, static_cast<size_t>(ndig));
+    }
+  } else {
+    out->push_back(digits[0]);
+    if (ndig > 1) {
+      out->push_back('.');
+      out->append(digits + 1, static_cast<size_t>(ndig - 1));
+    }
+    out->push_back('e');
+    out->push_back(exp10 < 0 ? '-' : '+');
+    int ae = exp10 < 0 ? -exp10 : exp10;
+    if (ae < 10) out->push_back('0');
+    snprintf(buf, sizeof buf, "%d", ae);
+    out->append(buf);
+  }
+}
+
+// Prometheus exposition sample values: the format's own non-finite
+// literals, otherwise str(float)
+void expo_value_append(std::string* out, double v) {
+  if (std::isnan(v)) {
+    out->append("NaN");
+    return;
+  }
+  if (std::isinf(v)) {
+    out->append(v > 0 ? "+Inf" : "-Inf");
+    return;
+  }
+  py_float_append(out, v);
+}
+
+std::vector<std::string_view> split_us(std::string_view blob) {
+  std::vector<std::string_view> out;
+  if (blob.empty()) return out;
+  size_t pos = 0;
+  for (;;) {
+    size_t e = blob.find('\x1f', pos);
+    if (e == std::string_view::npos) {
+      out.push_back(blob.substr(pos));
+      return out;
+    }
+    out.push_back(blob.substr(pos, e - pos));
+    pos = e + 1;
+  }
+}
+
+std::vector<std::string_view> split_rs(std::string_view blob,
+                                       long long nrows) {
+  std::vector<std::string_view> recs;
+  recs.reserve(static_cast<size_t>(nrows));
+  size_t pos = 0;
+  for (long long i = 0; i < nrows; ++i) {
+    size_t e = blob.find('\x1e', pos);
+    if (e == std::string_view::npos) e = blob.size();
+    recs.push_back(blob.substr(pos, e - pos));
+    pos = e + 1;
+  }
+  return recs;
+}
+
+struct DDOut {
+  std::string buf;
+  std::vector<long long> chunk_off;
+};
+thread_local DDOut g_dd;
+
+}  // namespace
+
+extern "C" {
+
+// Emits n_chunks bodies, each a complete {"series":[...]} JSON object
+// of at most max_per_body entries, concatenated in one buffer with
+// chunk offsets ([n_chunks+1]). Buffers are thread-local (valid until
+// the calling thread's next call). Returns n_chunks, or -1 on
+// malformed meta.
+long long vn_encode_datadog_series(
+    const char* meta, long long meta_len, long long nrows,
+    const char* suffixes_blob, long long suffixes_len,
+    const signed char* family_types, int nfam, const double* values,
+    const unsigned char* masks, long long ts, double interval,
+    const char* hostname, long long hostname_len, const char* common,
+    long long common_len, const char* excl_keys_blob,
+    long long excl_keys_len, const char* excl_prefix_blob,
+    long long excl_prefix_len, const char* drop_prefix_blob,
+    long long drop_prefix_len, long long max_per_body,
+    const long long** chunk_off_out, const char** out,
+    long long* out_len, long long* entries_out) {
+  DDOut& o = g_dd;
+  o.buf.clear();
+  o.chunk_off.clear();
+  o.buf.reserve(static_cast<size_t>(nrows) * nfam * 96);
+
+  std::vector<std::string_view> suffixes =
+      split_us(std::string_view(suffixes_blob,
+                                static_cast<size_t>(suffixes_len)));
+  // empty suffixes vanish in the join; pad back to nfam
+  while (static_cast<int>(suffixes.size()) < nfam)
+    suffixes.push_back(std::string_view());
+  std::vector<std::string_view> excl_keys = split_us(
+      std::string_view(excl_keys_blob, static_cast<size_t>(excl_keys_len)));
+  std::vector<std::string_view> excl_prefixes = split_us(std::string_view(
+      excl_prefix_blob, static_cast<size_t>(excl_prefix_len)));
+  std::vector<std::string_view> drop_prefixes = split_us(std::string_view(
+      drop_prefix_blob, static_cast<size_t>(drop_prefix_len)));
+  std::string_view host_default(hostname,
+                                static_cast<size_t>(hostname_len));
+  std::string_view common_frag(common, static_cast<size_t>(common_len));
+
+  // pre-split the meta records once
+  std::vector<std::string_view> recs = split_rs(
+      std::string_view(meta, static_cast<size_t>(meta_len)), nrows);
+
+  char interval_buf[24];
+  std::snprintf(interval_buf, sizeof interval_buf, "%lld",
+                static_cast<long long>(interval));
+
+  long long in_chunk = 0;
+  long long entries_total = 0;
+  bool chunk_open = false;
+  auto open_chunk = [&]() {
+    o.chunk_off.push_back(static_cast<long long>(o.buf.size()));
+    o.buf.append("{\"series\":[");
+    in_chunk = 0;
+    chunk_open = true;
+  };
+  auto close_chunk = [&]() {
+    if (chunk_open) {
+      o.buf.append("]}");
+      chunk_open = false;
+    }
+  };
+
+  std::string tag_scratch;
+  for (int f = 0; f < nfam; ++f) {
+    std::string_view suffix = suffixes[f];
+    bool is_rate = family_types[f] == 0;
+    const double* vals = values + static_cast<size_t>(f) * nrows;
+    const unsigned char* mask = masks + static_cast<size_t>(f) * nrows;
+    for (long long r = 0; r < nrows; ++r) {
+      if (!mask[r]) continue;
+      std::string_view rec = recs[static_cast<size_t>(r)];
+      size_t nend = rec.find('\x1f');
+      std::string_view name =
+          nend == std::string_view::npos ? rec : rec.substr(0, nend);
+      // name drops apply to the FULL emitted name (base + suffix); the
+      // python path checks m.name which already carries the suffix
+      bool dropped = false;
+      for (std::string_view p : drop_prefixes) {
+        if (name.size() >= p.size() &&
+            name.compare(0, p.size(), p) == 0) {
+          dropped = true;
+          break;
+        }
+        // suffix may complete the prefix match only if prefix is
+        // longer than the base name; rare — handle by building the
+        // full name check below when p is longer
+        if (p.size() > name.size()) {
+          std::string full(name);
+          full.append(suffix);
+          if (full.compare(0, p.size(), p) == 0) {
+            dropped = true;
+            break;
+          }
+        }
+      }
+      if (dropped) continue;
+
+      // tags: host/device extraction + exclusions
+      std::string_view host = host_default;
+      std::string_view device;
+      tag_scratch.clear();
+      if (nend != std::string_view::npos) {
+        std::string_view rest = rec.substr(nend + 1);
+        for (;;) {
+          size_t e = rest.find('\x1f');
+          std::string_view tag =
+              e == std::string_view::npos ? rest : rest.substr(0, e);
+          // server-level key exclusion removes the tag before the sink
+          // ever sees it (strip_excluded_tags runs first on the Python
+          // paths) — including before host:/device: extraction
+          bool skip = false;
+          {
+            size_t colon = tag.find(':');
+            std::string_view key =
+                colon == std::string_view::npos ? tag
+                                                : tag.substr(0, colon);
+            for (std::string_view k : excl_keys) {
+              if (key == k) {
+                skip = true;
+                break;
+              }
+            }
+          }
+          if (!skip) {
+            if (tag.size() >= 5 && tag.compare(0, 5, "host:") == 0) {
+              if (tag.size() > 5) host = tag.substr(5);
+              skip = true;
+            } else if (tag.size() >= 7 &&
+                       tag.compare(0, 7, "device:") == 0) {
+              device = tag.substr(7);
+              skip = true;
+            }
+          }
+          if (!skip) {
+            for (std::string_view p : excl_prefixes) {
+              if (tag.size() >= p.size() &&
+                  tag.compare(0, p.size(), p) == 0) {
+                skip = true;
+                break;
+              }
+            }
+          }
+          if (!skip) {
+            tag_scratch.push_back(',');
+            tag_scratch.push_back('"');
+            json_escape_append(&tag_scratch, tag);
+            tag_scratch.push_back('"');
+          }
+          if (e == std::string_view::npos) break;
+          rest = rest.substr(e + 1);
+        }
+      }
+
+      if (!chunk_open) open_chunk();
+      if (in_chunk) o.buf.push_back(',');
+      o.buf.append("{\"metric\":\"");
+      json_escape_append(&o.buf, name);
+      json_escape_append(&o.buf, suffix);
+      o.buf.append("\",\"points\":[[");
+      char tsbuf[24];
+      std::snprintf(tsbuf, sizeof tsbuf, "%lld", ts);
+      o.buf.append(tsbuf);
+      o.buf.push_back(',');
+      json_number_append(&o.buf,
+                         is_rate ? vals[r] / interval : vals[r]);
+      o.buf.append("]],\"tags\":[");
+      bool any_common = common_frag.size() > 0;
+      if (any_common) o.buf.append(common_frag);
+      if (!tag_scratch.empty()) {
+        if (any_common)
+          o.buf.append(tag_scratch);  // starts with ','
+        else
+          o.buf.append(tag_scratch.data() + 1, tag_scratch.size() - 1);
+      }
+      o.buf.append("],\"type\":\"");
+      o.buf.append(is_rate ? "rate" : "gauge");
+      o.buf.append("\",\"interval\":");
+      o.buf.append(interval_buf);
+      o.buf.append(",\"host\":\"");
+      json_escape_append(&o.buf, host);
+      o.buf.append("\",\"device_name\":\"");
+      json_escape_append(&o.buf, device);
+      o.buf.append("\"}");
+      ++in_chunk;
+      ++entries_total;
+      if (in_chunk >= max_per_body) close_chunk();
+    }
+  }
+  close_chunk();
+  o.chunk_off.push_back(static_cast<long long>(o.buf.size()));
+  *entries_out = entries_total;
+  *chunk_off_out = o.chunk_off.data();
+  *out = o.buf.data();
+  *out_len = static_cast<long long>(o.buf.size());
+  return static_cast<long long>(o.chunk_off.size()) - 1;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// statsd line emitters: the prometheus statsd-repeater path (exporter
+// character sanitization) and the DogStatsD forward path (verbatim
+// names/tags a downstream veneur re-ingests) share one line builder.
+
+namespace {
+
+inline bool prom_name_ok(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_' || c == ':' || c == '.';
+}
+
+inline bool prom_tag_ok(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_' || c == ':' || c == ',' ||
+         c == '=' || c == '.';
+}
+
+// Sanitize like the sinks' Python regexes do: one '_' per CHARACTER
+// outside the accepted set. Input is UTF-8 from str.encode, so a
+// multibyte character (never in the ASCII accept sets) collapses to a
+// single '_' — not one per byte.
+template <typename OkFn>
+void sanitize_utf8_append(std::string* out, std::string_view s, OkFn ok) {
+  for (size_t i = 0; i < s.size();) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    if (c < 0x80) {
+      out->push_back(ok(c) ? static_cast<char>(c) : '_');
+      ++i;
+    } else {
+      out->push_back('_');
+      ++i;
+      while (i < s.size() &&
+             (static_cast<unsigned char>(s[i]) & 0xC0) == 0x80)
+        ++i;
+    }
+  }
+}
+
+void prom_append(std::string* out, std::string_view s, bool name_rules) {
+  if (name_rules)
+    sanitize_utf8_append(out, s, prom_name_ok);
+  else
+    sanitize_utf8_append(out, s, prom_tag_ok);
+}
+
+// One pass emitting "name:value|kind|#tag,..." lines for every masked
+// (family, row); sanitize=true applies the exporter character rules,
+// sanitize=false forwards names/tags verbatim (DogStatsD re-ingest).
+long long emit_statsd_lines(
+    std::string* outbuf, const char* meta, long long meta_len,
+    long long nrows, const char* suffixes_blob, long long suffixes_len,
+    const signed char* family_types, int nfam, const double* values,
+    const unsigned char* masks, const char* excl_keys_blob,
+    long long excl_keys_len, bool sanitize) {
+  std::string& buf = *outbuf;
+  buf.clear();
+  buf.reserve(static_cast<size_t>(nrows) * nfam * 48);
+
+  std::vector<std::string_view> suffixes =
+      split_us(std::string_view(suffixes_blob,
+                                static_cast<size_t>(suffixes_len)));
+  while (static_cast<int>(suffixes.size()) < nfam)
+    suffixes.push_back(std::string_view());
+  std::vector<std::string_view> excl_keys = split_us(
+      std::string_view(excl_keys_blob, static_cast<size_t>(excl_keys_len)));
+
+  std::vector<std::string_view> recs = split_rs(
+      std::string_view(meta, static_cast<size_t>(meta_len)), nrows);
+
+  long long emitted = 0;
+  for (int f = 0; f < nfam; ++f) {
+    std::string_view suffix = suffixes[f];
+    const char kind = family_types[f] == 0 ? 'c' : 'g';
+    const double* vals = values + static_cast<size_t>(f) * nrows;
+    const unsigned char* mask = masks + static_cast<size_t>(f) * nrows;
+    for (long long r = 0; r < nrows; ++r) {
+      if (!mask[r]) continue;
+      std::string_view rec = recs[static_cast<size_t>(r)];
+      size_t nend = rec.find('\x1f');
+      std::string_view name =
+          nend == std::string_view::npos ? rec : rec.substr(0, nend);
+      if (sanitize) {
+        prom_append(&buf, name, true);
+        prom_append(&buf, suffix, true);
+      } else {
+        buf.append(name);
+        buf.append(suffix);
+      }
+      buf.push_back(':');
+      py_float_append(&buf, vals[r]);
+      buf.push_back('|');
+      buf.push_back(kind);
+      bool first_tag = true;
+      if (nend != std::string_view::npos) {
+        std::string_view rest = rec.substr(nend + 1);
+        for (;;) {
+          size_t e = rest.find('\x1f');
+          std::string_view tag =
+              e == std::string_view::npos ? rest : rest.substr(0, e);
+          bool skip = false;
+          size_t colon = tag.find(':');
+          std::string_view key =
+              colon == std::string_view::npos ? tag : tag.substr(0, colon);
+          for (std::string_view k : excl_keys) {
+            if (key == k) {
+              skip = true;
+              break;
+            }
+          }
+          if (!skip) {
+            buf.append(first_tag ? "|#" : ",");
+            if (sanitize)
+              prom_append(&buf, tag, false);
+            else
+              buf.append(tag);
+            first_tag = false;
+          }
+          if (e == std::string_view::npos) break;
+          rest = rest.substr(e + 1);
+        }
+      }
+      buf.push_back('\n');
+      ++emitted;
+    }
+  }
+  if (!buf.empty()) buf.pop_back();  // no trailing newline
+  return emitted;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Emits newline-separated statsd lines into a thread-local buffer.
+// family_types: 0 counter ("|c"), 1 gauge ("|g"). excl_keys: \x1f-joined
+// exact tag keys to drop (server-level exclusion). Returns the emitted
+// line count; *out/*out_len carry the buffer.
+long long vn_encode_prometheus_lines(
+    const char* meta, long long meta_len, long long nrows,
+    const char* suffixes_blob, long long suffixes_len,
+    const signed char* family_types, int nfam, const double* values,
+    const unsigned char* masks, const char* excl_keys_blob,
+    long long excl_keys_len, const char** out, long long* out_len) {
+  thread_local std::string buf;
+  long long n = emit_statsd_lines(
+      &buf, meta, meta_len, nrows, suffixes_blob, suffixes_len,
+      family_types, nfam, values, masks, excl_keys_blob, excl_keys_len,
+      /*sanitize=*/true);
+  *out = buf.data();
+  *out_len = static_cast<long long>(buf.size());
+  return n;
+}
+
+// Verbatim DogStatsD forward lines (no sanitization): what a downstream
+// statsd/veneur re-ingests. Same contract as
+// vn_encode_prometheus_lines otherwise.
+long long vn_encode_forward_lines(
+    const char* meta, long long meta_len, long long nrows,
+    const char* suffixes_blob, long long suffixes_len,
+    const signed char* family_types, int nfam, const double* values,
+    const unsigned char* masks, const char* excl_keys_blob,
+    long long excl_keys_len, const char** out, long long* out_len) {
+  thread_local std::string buf;
+  long long n = emit_statsd_lines(
+      &buf, meta, meta_len, nrows, suffixes_blob, suffixes_len,
+      family_types, nfam, values, masks, excl_keys_blob, excl_keys_len,
+      /*sanitize=*/false);
+  *out = buf.data();
+  *out_len = static_cast<long long>(buf.size());
+  return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Prometheus exposition text: `name{label="value",...} value\n` samples
+// (the pushgateway body). Name keeps [a-zA-Z0-9_:], label keys keep
+// [a-zA-Z0-9_] (both '_'-substituted), label values are escaped per the
+// format (\\, \", \n). "k:v" tags become labels; duplicate sanitized
+// keys collapse last-wins at the first occurrence's position (what a
+// Python dict does).
+
+namespace {
+
+inline bool expo_name_ok(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_' || c == ':';
+}
+
+inline bool expo_label_ok(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_';
+}
+
+void expo_label_value_append(std::string* out, std::string_view s) {
+  for (char c : s) {
+    if (c == '\\')
+      out->append("\\\\");
+    else if (c == '"')
+      out->append("\\\"");
+    else if (c == '\n')
+      out->append("\\n");
+    else
+      out->push_back(c);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Same argument contract as vn_encode_prometheus_lines; family kinds do
+// not appear in the output (exposition samples are untyped without
+// TYPE comment lines, which a pushgateway body omits).
+long long vn_encode_prometheus_exposition(
+    const char* meta, long long meta_len, long long nrows,
+    const char* suffixes_blob, long long suffixes_len,
+    const signed char* family_types, int nfam, const double* values,
+    const unsigned char* masks, const char* excl_keys_blob,
+    long long excl_keys_len, const char** out, long long* out_len) {
+  (void)family_types;
+  thread_local std::string buf;
+  buf.clear();
+  buf.reserve(static_cast<size_t>(nrows) * nfam * 64);
+
+  std::vector<std::string_view> suffixes =
+      split_us(std::string_view(suffixes_blob,
+                                static_cast<size_t>(suffixes_len)));
+  while (static_cast<int>(suffixes.size()) < nfam)
+    suffixes.push_back(std::string_view());
+  std::vector<std::string_view> excl_keys = split_us(
+      std::string_view(excl_keys_blob, static_cast<size_t>(excl_keys_len)));
+
+  std::vector<std::string_view> recs = split_rs(
+      std::string_view(meta, static_cast<size_t>(meta_len)), nrows);
+
+  long long emitted = 0;
+  std::vector<std::pair<std::string, std::string_view>> labels;
+  for (int f = 0; f < nfam; ++f) {
+    std::string_view suffix = suffixes[f];
+    const double* vals = values + static_cast<size_t>(f) * nrows;
+    const unsigned char* mask = masks + static_cast<size_t>(f) * nrows;
+    for (long long r = 0; r < nrows; ++r) {
+      if (!mask[r]) continue;
+      std::string_view rec = recs[static_cast<size_t>(r)];
+      size_t nend = rec.find('\x1f');
+      std::string_view name =
+          nend == std::string_view::npos ? rec : rec.substr(0, nend);
+      labels.clear();
+      if (nend != std::string_view::npos) {
+        std::string_view rest = rec.substr(nend + 1);
+        for (;;) {
+          size_t e = rest.find('\x1f');
+          std::string_view tag =
+              e == std::string_view::npos ? rest : rest.substr(0, e);
+          size_t colon = tag.find(':');
+          std::string_view rawkey =
+              colon == std::string_view::npos ? tag : tag.substr(0, colon);
+          std::string_view val =
+              colon == std::string_view::npos ? std::string_view()
+                                              : tag.substr(colon + 1);
+          bool skip = false;
+          for (std::string_view k : excl_keys) {
+            if (rawkey == k) {
+              skip = true;
+              break;
+            }
+          }
+          if (!skip) {
+            std::string key;
+            key.reserve(rawkey.size());
+            sanitize_utf8_append(&key, rawkey, expo_label_ok);
+            bool replaced = false;
+            for (auto& kv : labels) {
+              if (kv.first == key) {
+                kv.second = val;
+                replaced = true;
+                break;
+              }
+            }
+            if (!replaced) labels.emplace_back(std::move(key), val);
+          }
+          if (e == std::string_view::npos) break;
+          rest = rest.substr(e + 1);
+        }
+      }
+      sanitize_utf8_append(&buf, name, expo_name_ok);
+      sanitize_utf8_append(&buf, suffix, expo_name_ok);
+      if (!labels.empty()) {
+        buf.push_back('{');
+        bool first = true;
+        for (auto& kv : labels) {
+          if (!first) buf.push_back(',');
+          first = false;
+          buf.append(kv.first);
+          buf.append("=\"");
+          expo_label_value_append(&buf, kv.second);
+          buf.push_back('"');
+        }
+        buf.push_back('}');
+      }
+      buf.push_back(' ');
+      expo_value_append(&buf, vals[r]);
+      buf.push_back('\n');
+      ++emitted;
+    }
+  }
+  *out = buf.data();
+  *out_len = static_cast<long long>(buf.size());
+  return emitted;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// SignalFx datapoint-body emitter: {"counter":[...],"gauge":[...]}
+// from the columnar arrays + meta blob. Dimensions are a JSON object
+// built from "k:v" tags (last duplicate key wins, as a Python dict
+// does); the hostname dimension key is configurable. Tag-prefix drops
+// reject the whole metric (sinks/signalfx.py _convert_fields). The
+// single-API-key case only — vary_key_by routing stays in Python.
+
+extern "C" {
+
+// Emits ONE body. family_types: 0 counter, 1 gauge. Returns emitted
+// count; -1 on malformed meta.
+long long vn_encode_signalfx_body(
+    const char* meta, long long meta_len, long long nrows,
+    const char* suffixes_blob, long long suffixes_len,
+    const signed char* family_types, int nfam, const double* values,
+    const unsigned char* masks, long long ts_ms,
+    const char* hostname_tag, long long hostname_tag_len,
+    const char* hostname, long long hostname_len,
+    const char* name_drop_blob, long long name_drop_len,
+    const char* tag_drop_blob, long long tag_drop_len,
+    const char* excl_keys_blob, long long excl_keys_len,
+    const char** out, long long* out_len) {
+  thread_local std::string buf;
+  thread_local std::string counters_part;
+  thread_local std::string gauges_part;
+  buf.clear();
+  counters_part.clear();
+  gauges_part.clear();
+
+  std::vector<std::string_view> suffixes =
+      split_us(std::string_view(suffixes_blob,
+                                static_cast<size_t>(suffixes_len)));
+  while (static_cast<int>(suffixes.size()) < nfam)
+    suffixes.push_back(std::string_view());
+  std::vector<std::string_view> name_drops = split_us(
+      std::string_view(name_drop_blob, static_cast<size_t>(name_drop_len)));
+  std::vector<std::string_view> tag_drops = split_us(
+      std::string_view(tag_drop_blob, static_cast<size_t>(tag_drop_len)));
+  std::vector<std::string_view> excl_keys = split_us(
+      std::string_view(excl_keys_blob, static_cast<size_t>(excl_keys_len)));
+  std::string_view host_tag(hostname_tag,
+                            static_cast<size_t>(hostname_tag_len));
+  std::string_view host_val(hostname, static_cast<size_t>(hostname_len));
+
+  std::vector<std::string_view> recs = split_rs(
+      std::string_view(meta, static_cast<size_t>(meta_len)), nrows);
+
+  char tsbuf[24];
+  std::snprintf(tsbuf, sizeof tsbuf, "%lld", ts_ms);
+  long long emitted = 0;
+  std::vector<std::pair<std::string_view, std::string_view>> dims;
+  for (int f = 0; f < nfam; ++f) {
+    std::string_view suffix = suffixes[f];
+    std::string& part = family_types[f] == 0 ? counters_part : gauges_part;
+    const double* vals = values + static_cast<size_t>(f) * nrows;
+    const unsigned char* mask = masks + static_cast<size_t>(f) * nrows;
+    for (long long r = 0; r < nrows; ++r) {
+      if (!mask[r]) continue;
+      std::string_view rec = recs[static_cast<size_t>(r)];
+      size_t nend = rec.find('\x1f');
+      std::string_view name =
+          nend == std::string_view::npos ? rec : rec.substr(0, nend);
+      bool dropped = false;
+      for (std::string_view p : name_drops) {
+        if (name.size() >= p.size() &&
+            name.compare(0, p.size(), p) == 0) {
+          dropped = true;
+          break;
+        }
+        if (p.size() > name.size()) {
+          std::string full(name);
+          full.append(suffix);
+          if (full.compare(0, p.size(), p) == 0) {
+            dropped = true;
+            break;
+          }
+        }
+      }
+      if (dropped) continue;
+
+      // dimensions: k:v tags, last duplicate key wins (python dict)
+      dims.clear();
+      if (nend != std::string_view::npos) {
+        std::string_view rest = rec.substr(nend + 1);
+        for (;;) {
+          size_t e = rest.find('\x1f');
+          std::string_view tag =
+              e == std::string_view::npos ? rest : rest.substr(0, e);
+          for (std::string_view p : tag_drops) {
+            if (tag.size() >= p.size() &&
+                tag.compare(0, p.size(), p) == 0) {
+              dropped = true;
+              break;
+            }
+          }
+          if (dropped) break;
+          size_t colon = tag.find(':');
+          std::string_view key =
+              colon == std::string_view::npos ? tag : tag.substr(0, colon);
+          std::string_view val =
+              colon == std::string_view::npos ? std::string_view()
+                                              : tag.substr(colon + 1);
+          bool excl = false;
+          for (std::string_view k : excl_keys) {
+            if (key == k) {
+              excl = true;
+              break;
+            }
+          }
+          if (!excl) {
+            bool replaced = false;
+            for (auto& kv : dims) {
+              if (kv.first == key) {
+                kv.second = val;
+                replaced = true;
+                break;
+              }
+            }
+            if (!replaced) dims.emplace_back(key, val);
+          }
+          if (e == std::string_view::npos) break;
+          rest = rest.substr(e + 1);
+        }
+      }
+      if (dropped) continue;
+
+      if (!part.empty()) part.push_back(',');
+      part.append("{\"metric\":\"");
+      json_escape_append(&part, name);
+      json_escape_append(&part, suffix);
+      part.append("\",\"value\":");
+      json_number_append(&part, vals[r]);
+      part.append(",\"timestamp\":");
+      part.append(tsbuf);
+      part.append(",\"dimensions\":{");
+      // a tag with the hostname key overrides the default host dim
+      // (python seeds dims with it, then tags overwrite)
+      bool host_overridden = false;
+      for (auto& kv : dims) {
+        if (kv.first == host_tag) {
+          host_overridden = true;
+          break;
+        }
+      }
+      bool first_dim = true;
+      if (!host_overridden) {
+        part.push_back('"');
+        json_escape_append(&part, host_tag);
+        part.append("\":\"");
+        json_escape_append(&part, host_val);
+        part.push_back('"');
+        first_dim = false;
+      }
+      for (auto& kv : dims) {
+        if (!first_dim) part.push_back(',');
+        first_dim = false;
+        part.push_back('"');
+        json_escape_append(&part, kv.first);
+        part.append("\":\"");
+        json_escape_append(&part, kv.second);
+        part.push_back('"');
+      }
+      part.append("}}");
+      ++emitted;
+    }
+  }
+  buf.push_back('{');
+  bool any = false;
+  if (!counters_part.empty()) {
+    buf.append("\"counter\":[");
+    buf.append(counters_part);
+    buf.push_back(']');
+    any = true;
+  }
+  if (!gauges_part.empty()) {
+    if (any) buf.push_back(',');
+    buf.append("\"gauge\":[");
+    buf.append(gauges_part);
+    buf.push_back(']');
+  }
+  buf.push_back('}');
+  *out = buf.data();
+  *out_len = static_cast<long long>(buf.size());
+  return emitted;
+}
+
+// ---------------------------------------------------------------------------
+// zlib deflate, pinned byte-identical to Python zlib.compress (both use
+// the system zlib at Z_DEFAULT_COMPRESSION with default deflateInit
+// parameters, so the streams match bit for bit — the parity test holds
+// the pin). Thread-local output; GIL released via ctypes like every
+// other emitter, so compressing a 25k-entry body no longer serializes
+// against ingest.
+
+long long vn_deflate(const char* buf, long long len, const char** out,
+                     long long* out_len) {
+  thread_local std::string zbuf;
+  uLong bound = compressBound(static_cast<uLong>(len));
+  zbuf.resize(bound);
+  uLongf dlen = bound;
+  if (compress2(reinterpret_cast<Bytef*>(&zbuf[0]), &dlen,
+                reinterpret_cast<const Bytef*>(buf),
+                static_cast<uLong>(len), Z_DEFAULT_COMPRESSION) != Z_OK)
+    return -1;
+  *out = zbuf.data();
+  *out_len = static_cast<long long>(dlen);
+  return static_cast<long long>(dlen);
+}
+
+// Deflate n_chunks slices of one buffer (the datadog emitter's chunked
+// bodies) in a single GIL-free call: offs is [n_chunks+1] input
+// offsets; *out_offs_out gets [n_chunks+1] offsets into the compressed
+// output buffer. Returns n_chunks, or -1 on a zlib error. Output
+// buffers are distinct from the emitters' (chaining
+// vn_encode_datadog_series -> vn_deflate_chunks on one thread is safe).
+long long vn_deflate_chunks(const char* buf, const long long* offs,
+                            long long n_chunks,
+                            const long long** out_offs_out,
+                            const char** out, long long* out_len) {
+  thread_local std::string zbuf;
+  thread_local std::vector<long long> zoffs;
+  zbuf.clear();
+  zoffs.clear();
+  for (long long i = 0; i < n_chunks; ++i) {
+    const char* src = buf + offs[i];
+    uLong slen = static_cast<uLong>(offs[i + 1] - offs[i]);
+    uLong bound = compressBound(slen);
+    size_t start = zbuf.size();
+    zoffs.push_back(static_cast<long long>(start));
+    zbuf.resize(start + bound);
+    uLongf dlen = bound;
+    if (compress2(reinterpret_cast<Bytef*>(&zbuf[start]), &dlen,
+                  reinterpret_cast<const Bytef*>(src), slen,
+                  Z_DEFAULT_COMPRESSION) != Z_OK)
+      return -1;
+    zbuf.resize(start + dlen);
+  }
+  zoffs.push_back(static_cast<long long>(zbuf.size()));
+  *out_offs_out = zoffs.data();
+  *out = zbuf.data();
+  *out_len = static_cast<long long>(zbuf.size());
+  return n_chunks;
+}
+
+}  // extern "C"

@@ -201,7 +201,8 @@ def test_datadog_columnar_bodies(monkeypatch):
 
     posted: list[tuple] = []
 
-    def fake_post(self, dd_metrics, checks, raw_bodies=None, raw_count=0):
+    def fake_post(self, dd_metrics, checks, raw_bodies=None, raw_count=0,
+                  precompressed=False):
         posted.append((dd_metrics, checks, raw_bodies or [], raw_count))
 
     monkeypatch.setattr(DatadogMetricSink, "_post_all", fake_post)
@@ -250,7 +251,8 @@ def test_datadog_columnar_native_chunking_and_rules(monkeypatch):
 
     posted: list[tuple] = []
 
-    def fake_post(self, dd_metrics, checks, raw_bodies=None, raw_count=0):
+    def fake_post(self, dd_metrics, checks, raw_bodies=None, raw_count=0,
+                  precompressed=False):
         posted.append((dd_metrics, checks, raw_bodies or [], raw_count))
 
     monkeypatch.setattr(DatadogMetricSink, "_post_all", fake_post)
@@ -261,11 +263,14 @@ def test_datadog_columnar_native_chunking_and_rules(monkeypatch):
     sink = DatadogMetricSink(**kw)
     sink.flush(strip_excluded_tags(
         filter_routed(objs, "datadog"), {"env"}))
-    sink.flush_columnar(batch, excluded_tags={"env"})
+    assert sink.flush_columnar_native(batch, excluded_tags={"env"})
     (dd_obj, _, _, _), (dd_col, _, rb_col, _) = posted
     col_entries = list(dd_col)
+    import zlib
+
     for body in rb_col:
-        parsed = json.loads(body)
+        # the native emit tier hands over pre-deflated bodies
+        parsed = json.loads(zlib.decompress(body))
         assert len(parsed["series"]) <= 7  # chunking respected
         col_entries.extend(parsed["series"])
     assert sorted(map(_dd_norm_entry, dd_obj)) == sorted(
@@ -295,7 +300,7 @@ def test_signalfx_columnar_datapoints(monkeypatch):
             (by_key, raw_bodies or [])))
     sink = SignalFxMetricSink(api_key="k", hostname="h0")
     sink.flush(filter_routed(objs, "signalfx"))
-    sink.flush_columnar(batch)
+    assert sink.flush_columnar_native(batch)
     import json
 
     def norm(by_key, raw):
@@ -344,7 +349,7 @@ def test_prometheus_columnar_lines(monkeypatch):
     from veneur_tpu.sinks import filter_routed
 
     sink.flush(filter_routed(objs, "prometheus"))
-    sink.flush_columnar(batch)
+    assert sink.flush_columnar_native(batch)
 
     def flat(entries):
         out = []

@@ -47,16 +47,19 @@ def test_lock_stats_instrumentation():
 
 def test_library_matches_source():
     """The loaded .so's build stamp equals the sha256 prefix of the
-    current dogstatsd.cpp — a stale committed binary (library no longer
-    built from the checked-in source) fails here instead of silently
-    testing old code."""
+    current sources (dogstatsd.cpp + emit.cpp, the two TUs of the
+    library) — a stale committed binary (library no longer built from
+    the checked-in source) fails here instead of silently testing old
+    code."""
     import hashlib
     import os
 
-    src = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "native", "dogstatsd.cpp")
-    want = hashlib.sha256(open(src, "rb").read()).hexdigest()[:16]
-    assert native_mod.source_hash() == want
+    ndir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    h = hashlib.sha256()
+    for fn in ("dogstatsd.cpp", "emit.cpp"):
+        h.update(open(os.path.join(ndir, fn), "rb").read())
+    assert native_mod.source_hash() == h.hexdigest()[:16]
 
 
 def test_parser_parity_property():

@@ -7,6 +7,7 @@ trace-client test backends (trace/testbackend)."""
 import io
 import queue
 import socket
+import threading
 import time
 
 import pytest
@@ -166,6 +167,28 @@ def test_span_worker_drops_when_full():
     w.ingest(_span())
     w.ingest(_span())
     assert w.spans_dropped == 1
+
+
+def test_span_worker_stop_never_blocks_on_full_channel():
+    # Regression: a server driven programmatically (flush() calls, never
+    # start()) has no span consumer, but its own internal flush spans
+    # still ingest into the channel. Once the channel fills — ~100 flush
+    # intervals — a blocking put(None) in stop() deadlocked shutdown
+    # forever (the 120-interval mesh soak wedge). stop() must return
+    # promptly with the channel full and zero worker threads.
+    w = SpanWorker([], capacity=4)  # never started
+    for _ in range(10):
+        w.ingest(_span())
+    assert w.chan.full()
+    done = threading.Event()
+
+    def _stop():
+        w.stop()
+        done.set()
+
+    t = threading.Thread(target=_stop, daemon=True)
+    t.start()
+    assert done.wait(timeout=5.0), "SpanWorker.stop() wedged on full chan"
 
 
 def test_extraction_sink_routes_metrics():

@@ -86,9 +86,25 @@ def main() -> None:
                          "serial flush")
     ap.add_argument("--ab", action="store_true",
                     help="search mode only: run the full rate search "
-                         "twice — serial flush then pipelined flush — "
-                         "on the same ring, and write one artifact "
-                         "with both modes plus the speedup")
+                         "twice — one per side of --ab-axis — on the "
+                         "same ring, and write one artifact with both "
+                         "modes plus the speedup")
+    ap.add_argument("--ab-axis", default="pipeline",
+                    choices=["pipeline", "emit-native"],
+                    help="what --ab compares: serial vs pipelined "
+                         "flush (default), or Python vs native emit "
+                         "serializers (forces --sink serialize; both "
+                         "sides use --flush-pipeline as given)")
+    ap.add_argument("--emit-native", default="on", choices=["on", "off"],
+                    help="native emit tier (native/emit.cpp) for "
+                         "non-AB runs; --ab --ab-axis emit-native "
+                         "sweeps both")
+    ap.add_argument("--sink", default="channel",
+                    choices=["channel", "serialize"],
+                    help="channel: no serialization (packet-path "
+                         "measurement); serialize: datadog formatter "
+                         "against a discarding opener, so flushes pay "
+                         "full emit serialization cost")
     ap.add_argument("--out", default="SUSTAINED_PIPELINE.json",
                     help="artifact name (repo root; search mode only)")
     args = ap.parse_args()
@@ -118,6 +134,7 @@ def main() -> None:
         # hidden by a tiny default buffer
         read_buffer_size_bytes=8 * 1048576,
         flush_pipeline=args.flush_pipeline,
+        flush_emit_native=(args.emit_native == "on"),
         **({"loadgen_ring_lines": args.ring_lines}
            if args.ring_lines else {}),
         **({"loadgen_num_keys": args.keys} if args.keys else {}),
@@ -151,20 +168,31 @@ def main() -> None:
         platform = "unknown"
 
     if args.ab and not (args.smoke or args.replay):
-        # serial-vs-pipelined A/B: same ring, same rig, fresh server per
-        # mode. The headline fields come from the PIPELINED search so
-        # existing artifact consumers keep working; the serial run and
-        # the speedup live under "modes".
+        # same-rig A/B: same ring, fresh server per mode. The headline
+        # fields come from the SECOND (improved-path) search so existing
+        # artifact consumers keep working; both runs and the speedup
+        # live under "modes".
         from dataclasses import replace as _cfg_replace
+
+        if args.ab_axis == "emit-native":
+            # python vs native emit serializers, serializing sink on
+            # both sides (the channel sink never serializes, so the
+            # emit tier is invisible through it)
+            sink_mode = "serialize"
+            mode_list = [("emit_python", {"flush_emit_native": False}),
+                         ("emit_native", {"flush_emit_native": True})]
+        else:
+            sink_mode = args.sink
+            mode_list = [("serial", {"flush_pipeline": False}),
+                         ("pipelined", {"flush_pipeline": True})]
 
         ab_ring = ring if ring is not None else spec.build_ring()
         t0 = time.time()
         modes: dict[str, dict] = {}
-        for mode_name, pipelined in (("serial", False),
-                                     ("pipelined", True)):
-            mcfg = _cfg_replace(cfg, flush_pipeline=pipelined)
+        for mode_name, overrides in mode_list:
+            mcfg = _cfg_replace(cfg, **overrides)
             h = LoadHarness(mcfg, spec, transport=args.transport,
-                            ring=ab_ring)
+                            ring=ab_ring, sink_mode=sink_mode)
             try:
                 if not h.warmup():
                     print(f"{mode_name}: warmup never came up",
@@ -179,29 +207,73 @@ def main() -> None:
                                                    platform)
             finally:
                 h.close()
-        out = dict(modes["pipelined"])
+        base_name, head_name = mode_list[0][0], mode_list[1][0]
+        out = dict(modes[head_name])
         out["schema"] = "sustained_pipeline_v2_ab"
+        out["ab_axis"] = args.ab_axis
+        out["sink_mode"] = sink_mode
         out["modes"] = modes
-        serial_rate = modes["serial"]["sustained_pipeline_lines_per_s"]
-        pipe_rate = modes["pipelined"]["sustained_pipeline_lines_per_s"]
-        out["speedup_vs_serial"] = (round(pipe_rate / serial_rate, 3)
-                                    if serial_rate > 0 else None)
-        out["wall_s"] = round(time.time() - t0, 1)
-        write_artifact(args.out, out)
-        print(json.dumps({
+        base_rate = modes[base_name]["sustained_pipeline_lines_per_s"]
+        head_rate = modes[head_name]["sustained_pipeline_lines_per_s"]
+        speedup = (round(head_rate / base_rate, 3)
+                   if base_rate > 0 else None)
+        summary = {
             "metric": "sustained_pipeline_lines_per_s",
-            "value": pipe_rate,
+            "value": head_rate,
             "unit": "lines/s",
-            "serial_lines_per_s": serial_rate,
-            "speedup_vs_serial": out["speedup_vs_serial"],
             "confirmed": out["confirmed"],
             "platform": platform,
-        }))
+        }
+        if args.ab_axis == "emit-native":
+            out["speedup_vs_python_emit"] = speedup
+
+            # emit+generate flush ms, python path over native path. The
+            # confirm runs land at different rates (the whole point —
+            # native sustains more), which skews per-stage wall time on
+            # a shared rig, so the apples-to-apples number comes from
+            # the two growth trials at the common start rate; the
+            # confirm-run means are recorded alongside. Both are wall
+            # time of the emit stage — on a busy rig ingest timeslices
+            # into them, and a python emit that outlives the stage
+            # join timeout (one flush interval) is clipped to it, so
+            # the python figure (hence the reduction) is a floor.
+            def _eg(trial):
+                return ((trial.get("generate_ms_mean") or 0.0)
+                        + (trial.get("emit_ms_mean") or 0.0))
+
+            def _at_start_rate(mode):
+                for t in mode["search_trials"]:
+                    if t["offered_lines_per_s"] == args.start_rate:
+                        return _eg(t)
+                return None
+
+            py_ms = _at_start_rate(modes["emit_python"])
+            nat_ms = _at_start_rate(modes["emit_native"])
+            out["emit_generate_ms"] = {
+                "matched_rate_lines_per_s": args.start_rate,
+                "python": round(py_ms, 2) if py_ms else None,
+                "native": round(nat_ms, 2) if nat_ms else None,
+                "reduction_x": (round(py_ms / nat_ms, 2)
+                                if py_ms and nat_ms else None),
+                "confirm_python": round(_eg(modes["emit_python"]), 2),
+                "confirm_native": round(_eg(modes["emit_native"]), 2),
+            }
+            summary["python_emit_lines_per_s"] = base_rate
+            summary["speedup_vs_python_emit"] = speedup
+            summary["emit_generate_ms"] = out["emit_generate_ms"]
+        else:
+            out["speedup_vs_serial"] = speedup
+            summary["serial_lines_per_s"] = base_rate
+            summary["speedup_vs_serial"] = speedup
+        out["wall_s"] = round(time.time() - t0, 1)
+        write_artifact(args.out, out)
+        print(json.dumps(summary))
         if not out["confirmed"]:
             sys.exit(1)
         return
 
-    harness = LoadHarness(cfg, spec, transport=args.transport, ring=ring)
+    harness = LoadHarness(cfg, spec, transport=args.transport, ring=ring,
+                          sink_mode=args.sink)
     try:
         if not harness.warmup():
             print("warmup: flush path never came up", file=sys.stderr)
@@ -230,6 +302,7 @@ def main() -> None:
             confirm_intervals=args.intervals or 10,
             max_loss=args.max_loss)
         out = result_artifact(spec, harness, search, platform)
+        out["sink_mode"] = args.sink
         out["wall_s"] = round(time.time() - t0, 1)
         write_artifact(args.out, out)
         print(json.dumps({

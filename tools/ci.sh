@@ -43,6 +43,18 @@ echo "== tier-1 health lane (governor + transfer ledger) =="
 python -m pytest tests/test_health_governor.py tests/test_health_ledger.py \
   -q -m 'not slow'
 
+# Emit-parity lane: the native emit serializers (native/emit.cpp) must
+# be byte-identical to the sinks' Python formatters (statsd lines,
+# exposition text, forward lines) and JSON-value-identical for the
+# datadog/signalfx bodies, deflate included. Runs twice: with the .so
+# live (parity pins) and with it masked (fallback negotiation pins) —
+# a drifted serializer or a broken fallback is named by this lane.
+echo "== emit parity lane (native on + native masked) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_emit_parity.py -q -m 'not slow'
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_EMIT_NATIVE=0 \
+  python -m pytest tests/test_emit_parity.py -q -m 'not slow'
+
 # Pipelined-flush equality lane: the stage-parallel executor
 # (core/pipeline.py) must emit bit-identical InterMetric streams to the
 # serial flush, shed (not queue) under a stalled sink, and drain the

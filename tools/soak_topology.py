@@ -188,6 +188,7 @@ def main() -> None:
         before = received_total()
         t0 = time.perf_counter()
         local.flush()
+        flush_s = time.perf_counter() - t0
         ok = False
         deadline = time.time() + 30.0
         while time.time() < deadline:
@@ -196,6 +197,19 @@ def main() -> None:
                 break
             time.sleep(0.02)
         forward_waits.append(round(time.perf_counter() - t0, 3))
+        # stream progress unbuffered: the artifact only lands at the
+        # END of the run, so a wedge that outlives the harness timeout
+        # (the 120-interval repro died at its 50-min cap with an empty
+        # log) must leave its last-known-good interval and the wedged
+        # side on stderr as it happens
+        if not ok or flush_s > 15.0 or it % 10 == 0:
+            print(json.dumps({
+                "interval": it, "flush_s": round(flush_s, 2),
+                "received_delta": received_total() - before,
+                "expected": per_interval, "ok": ok,
+                "rss_mb": round(rss_mb(), 1),
+                **({} if ok else forward_path_stats()),
+            }), file=sys.stderr, flush=True)
         if not ok:
             stalled_intervals += 1
             # name the wedged side instead of timing out silently:

@@ -65,6 +65,11 @@ class ColumnGroup:
     # accessor for native emitters; None entry = row needs the Python
     # path (separators in the data)
     frag_at: Optional[Callable[[int], Optional[bytes]]] = None
+    # the pool's incremental \x1e-joined frag arena covering rows
+    # [0, nrows) — handed to the native emit tier zero-copy (ctypes
+    # views the bytearray's buffer directly); None = some row needs
+    # the Python formatter
+    meta_blob: Optional[bytearray] = None
 
     def count(self) -> int:
         return sum(f.count(self.nrows) for f in self.families)
@@ -73,6 +78,21 @@ class ColumnGroup:
         if family.mask is None:
             return np.arange(self.nrows)
         return np.nonzero(family.mask)[0]
+
+
+@dataclass
+class EmitGroupPlan:
+    """One group's buffers packed for the native emit tier: the frag
+    arena plus family columns stacked C-contiguous. Built once per flush
+    and shared by every native-capable sink (each used to rebuild the
+    blob and restack the columns per flush)."""
+
+    nrows: int
+    meta_blob: bytearray  # \x1e-joined "name \x1f tag..." records
+    suffixes: list[str]
+    family_types: np.ndarray  # i8[F]: 0 = counter, 1 = gauge
+    values: np.ndarray  # f64[F, R] C-contiguous
+    masks: np.ndarray  # u8[F, R] C-contiguous
 
 
 @dataclass
@@ -115,6 +135,42 @@ class ColumnarMetrics:
             if m.sinks is None or sink_name in m.sinks:
                 total += 1
         return total
+
+    def emit_plan(self) -> list:
+        """Per-group native emit plans (EmitGroupPlan), aligned with
+        ``groups``; None entries mark groups the native serializers
+        can't take (no frag arena, veneursinkonly routing, or a family
+        type outside counter/gauge — those go through each sink's
+        Python formatter). Memoized: in a multi-sink set every
+        native-capable sink shares ONE stacking pass."""
+        cached = getattr(self, "_emit_plan", None)
+        if cached is not None:
+            return cached
+        from veneur_tpu.core.metrics import MetricType
+
+        plans: list = []
+        for g in self.groups:
+            if (g.meta_blob is None or g.has_routing or not g.families
+                    or any(f.type not in (MetricType.COUNTER,
+                                          MetricType.GAUGE)
+                           for f in g.families)):
+                plans.append(None)
+                continue
+            plans.append(EmitGroupPlan(
+                nrows=g.nrows,
+                meta_blob=g.meta_blob,
+                suffixes=[f.suffix for f in g.families],
+                family_types=np.asarray(
+                    [0 if f.type == MetricType.COUNTER else 1
+                     for f in g.families], np.int8),
+                values=np.stack([f.values for f in g.families]),
+                masks=np.stack([
+                    f.mask.astype(np.uint8) if f.mask is not None
+                    else np.ones(g.nrows, np.uint8)
+                    for f in g.families]),
+            ))
+        self._emit_plan = plans
+        return plans
 
     def materialize(self) -> list[InterMetric]:
         """The compatibility path: the same InterMetric multiset the

@@ -106,6 +106,13 @@ class Config:
     # before the tick sheds instead of enqueueing (health/policy.py
     # MAX_STAGE_BACKLOG documents why the default is one).
     flush_pipeline_backlog: int = 1
+    # native emit tier (native/emit.cpp): sinks that can hand their wire
+    # serialization (JSON bodies, exposition text, statsd lines, deflate)
+    # to the C++ serializers do so with the GIL released; per-sink
+    # negotiation falls back to the Python formatters automatically when
+    # the library is absent or a batch uses an uncovered feature. Off
+    # forces the Python columnar formatters everywhere.
+    flush_emit_native: bool = True
     flush_max_per_body: int = 0
     flush_file: str = ""
     omit_empty_hostname: bool = False
@@ -312,6 +319,13 @@ class Config:
     # sink: prometheus repeater
     prometheus_repeater_address: str = ""
     prometheus_network_type: str = "tcp"
+    # sink: prometheus pushgateway (exposition-text POST per flush)
+    prometheus_pushgateway_address: str = ""
+
+    # sink: forward-statsd (flushed series re-emitted as verbatim
+    # DogStatsD lines to a downstream aggregator)
+    forward_statsd_address: str = ""
+    forward_statsd_network: str = "udp"
 
     # plugins: s3
     aws_access_key_id: str = ""
@@ -547,6 +561,8 @@ def validate_config(cfg: Config) -> None:
     if cfg.flush_pipeline_backlog < 1:
         raise ValueError("flush_pipeline_backlog must be >= 1 (a stage"
                          " needs at least the in-progress interval)")
+    if cfg.forward_statsd_network not in ("udp", "tcp"):
+        raise ValueError("forward_statsd_network must be 'udp' or 'tcp'")
     if cfg.tpu_stage_depth < 1:
         raise ValueError("tpu_stage_depth must be >= 1")
     if cfg.tpu_spill_cap < 1:

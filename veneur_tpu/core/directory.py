@@ -57,6 +57,18 @@ def classify(mtype: str, scope: MetricScope) -> ScopeClass:
     return ScopeClass.MIXED
 
 
+def build_frag(name: str, tags: list[str]):
+    """One blob record for the native batch encoders:
+    "name \\x1f tag \\x1f tag ..." utf-8, or None when the data itself
+    contains the record/field separators (those rows need the Python
+    formatter)."""
+    rec = name + "\x1f" + "\x1f".join(tags) if tags else name
+    if "\x1e" in rec or "\x1f" in name or any(
+            "\x1f" in t or "\x1e" in t for t in tags):
+        return None
+    return rec.encode("utf-8")
+
+
 @dataclass
 class RowMeta:
     """Host-side metadata for one pool row (what the dense arrays can't
@@ -66,9 +78,8 @@ class RowMeta:
     tags: list[str]
     scope_class: ScopeClass
     sinks: Optional[frozenset[str]]  # from veneursinkonly: tags
-    # lazily-built wire fragment for the native encoders
-    # ("name \x1f tag \x1f tag ..." utf-8); False = not yet built,
-    # None = contains the separators, use the Python path
+    # lazily-built wire fragment for the native encoders; False = not
+    # yet built, None = contains the separators, use the Python path
     _frag: object = False
 
     def wire_frag(self):
@@ -77,14 +88,7 @@ class RowMeta:
         builds once per series lifetime."""
         frag = self._frag
         if frag is False:
-            name = self.key.name
-            rec = (name + "\x1f" + "\x1f".join(self.tags)
-                   if self.tags else name)
-            if "\x1e" in rec or "\x1f" in name or any(
-                    "\x1f" in t or "\x1e" in t for t in self.tags):
-                frag = None
-            else:
-                frag = rec.encode("utf-8")
+            frag = build_frag(self.key.name, self.tags)
             self._frag = frag
         return frag
 
@@ -99,6 +103,17 @@ class _Pool:
     # no-routing case skips per-row checks entirely
     scope_codes: array = field(default_factory=lambda: array("b"))
     routed_rows: int = 0
+    # \x1e-joined wire_frag arena over rows [0, len(rows)), maintained
+    # incrementally at adopt so the flush hands the native emit tier one
+    # contiguous buffer with zero per-row work; poisoned (frag_clean
+    # False, arena abandoned) the moment any row's frag is None
+    frag_arena: bytearray = field(default_factory=bytearray)
+    frag_clean: bool = True
+
+    def frag_blob(self) -> Optional[bytearray]:
+        """The native emitters' metadata buffer for this pool, or None
+        when some row needs the Python path."""
+        return self.frag_arena if self.frag_clean else None
 
     def upsert(self, key: MetricKey, scope_class: ScopeClass, tags: list[str]
                ) -> tuple[int, bool]:
@@ -129,6 +144,14 @@ class _Pool:
             self.routed_rows += 1
         self.scope_codes.append(int(meta.scope_class))
         self.rows.append(meta)
+        if self.frag_clean:
+            frag = meta.wire_frag()
+            if frag is None:
+                self.frag_clean = False
+            else:
+                if row:
+                    self.frag_arena += b"\x1e"
+                self.frag_arena += frag
 
 
 class SeriesDirectory:

@@ -95,6 +95,18 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
         metric_sinks.append(PrometheusMetricSink(
             cfg.prometheus_repeater_address, cfg.prometheus_network_type))
 
+    if cfg.prometheus_pushgateway_address:
+        from veneur_tpu.sinks.prometheus import PrometheusExpositionSink
+
+        metric_sinks.append(PrometheusExpositionSink(
+            cfg.prometheus_pushgateway_address, **kw))
+
+    if cfg.forward_statsd_address:
+        from veneur_tpu.sinks.forward_statsd import ForwardStatsdSink
+
+        metric_sinks.append(ForwardStatsdSink(
+            cfg.forward_statsd_address, cfg.forward_statsd_network))
+
     if cfg.newrelic_insert_key and cfg.newrelic_account_id:
         from veneur_tpu.sinks.newrelic import NewRelicMetricSink
 

@@ -406,7 +406,8 @@ def generate_columnar(
         batch.groups.append(ColumnGroup(
             nrows=len(hrows), meta_at=histo_meta, families=fams,
             has_routing=pool.routed_rows > 0,
-            frag_at=lambda i, _rows=hrows: _rows[i].wire_frag()))
+            frag_at=lambda i, _rows=hrows: _rows[i].wire_frag(),
+            meta_blob=pool.frag_blob()))
 
     # -- set rows ----------------------------------------------------------
     srows = snap.directory.sets.rows
@@ -425,7 +426,8 @@ def generate_columnar(
                 "", GAUGE, np.asarray(snap.set_estimates, np.float64),
                 smask)],
             has_routing=snap.directory.sets.routed_rows > 0,
-            frag_at=lambda i, _rows=srows: _rows[i].wire_frag()))
+            frag_at=lambda i, _rows=srows: _rows[i].wire_frag(),
+            meta_blob=snap.directory.sets.frag_blob()))
 
     # -- counters / gauges -------------------------------------------------
     for pool, mtype in ((snap.scalars.counters, MetricType.COUNTER),
@@ -455,7 +457,8 @@ def generate_columnar(
                 "", mtype, np.asarray(pool.values[:n], np.float64),
                 cmask)],
             has_routing=pool.routed_rows > 0,
-            frag_at=scalar_frag))
+            frag_at=scalar_frag,
+            meta_blob=pool.frag_blob()))
 
     # -- status checks (rare; objects) -------------------------------------
     for (key, tags, _cls, sinks), sv in zip(

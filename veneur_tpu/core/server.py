@@ -248,6 +248,11 @@ class Server:
                 self, max_backlog=cfg.flush_pipeline_backlog)
         else:
             self.flush_pipeline = None
+        # native emit tier (native/emit.cpp): sinks serialize their wire
+        # payloads GIL-free straight from the flush arrays; off = always
+        # use the Python columnar formatters
+        self.flush_emit_native = bool(
+            getattr(cfg, "flush_emit_native", True))
 
         # ingest counters (self-telemetry). Incremented from every reader
         # thread: a bare `self.x += 1` loses increments at GIL switches
@@ -1802,8 +1807,18 @@ class Server:
         start = time.time()
         tags = [f"sink:{sink.name()}"]
         try:
+            # per-sink capability negotiation: try the native emit tier
+            # first (native/emit.cpp serializers, GIL released); a False
+            # return means the sink couldn't take this batch natively
+            # and the Python columnar formatter runs instead
+            handled = False
+            if (self.flush_emit_native
+                    and getattr(sink, "supports_native_emit", False)):
+                handled = sink.flush_columnar_native(batch, excluded_tags)
             fn = getattr(sink, "flush_columnar", None)
-            if fn is not None:
+            if handled:
+                pass
+            elif fn is not None:
                 fn(batch, excluded_tags)
             else:
                 # duck-typed sink (name()/flush() without the MetricSink

@@ -299,8 +299,22 @@ class SpanWorker:
             self._threads.append(t)
 
     def stop(self) -> None:
+        # Non-blocking sentinel insert, same discipline as _SinkLane.stop:
+        # a server used programmatically (flush() driven, start() never
+        # called) has no consumer on this channel, yet internal flush
+        # spans still ingest into it — a blocking put(None) against the
+        # full 100-slot queue deadlocks shutdown forever once ~100
+        # intervals have run. Drop a queued span to make room instead.
         for _ in self._threads or [None]:
-            self.chan.put(None)
+            while True:
+                try:
+                    self.chan.put_nowait(None)
+                    break
+                except queue.Full:
+                    try:
+                        self.chan.get_nowait()
+                    except queue.Empty:
+                        pass
         for t in self._threads:
             t.join(timeout=5)
         for lane in list(self._lanes.values()):

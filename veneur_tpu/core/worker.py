@@ -334,9 +334,16 @@ class ScalarPool:
 
         self.scope_codes = _array("b")
         self.routed_rows = 0
+        # incremental \x1e-joined wire-frag arena (see directory._Pool):
+        # the native emit tier reads this buffer zero-copy at flush
+        self.frag_arena = bytearray()
+        self.frag_clean = True
         self.values = np.zeros(initial, np.float64)
         self.present = np.zeros(initial, bool)
         self.used = 0
+
+    def frag_blob(self):
+        return self.frag_arena if self.frag_clean else None
 
     def ensure(self, rows: int) -> None:
         if rows > len(self.values):
@@ -358,13 +365,27 @@ class ScalarPool:
             self.adopt_row(row, key, tags, scope_class, sinks)
         return row
 
-    def adopt_row(self, row: int, key, tags, scope_class, sinks) -> None:
-        """Register metadata for a row assigned externally (native path)."""
+    def adopt_row(self, row: int, key, tags, scope_class, sinks,
+                  frag=False) -> None:
+        """Register metadata for a row assigned externally (native path).
+        ``frag`` carries a prebuilt wire_frag (the worker's cross-epoch
+        RowMeta cache); False = build here (the Python upsert path)."""
         assert row == len(self.meta), "rows must be adopted in order"
         self.meta.append((key, tags, scope_class, sinks))
         self.scope_codes.append(int(scope_class))
         if sinks is not None:
             self.routed_rows += 1
+        if self.frag_clean:
+            if frag is False:
+                from veneur_tpu.core.directory import build_frag
+
+                frag = build_frag(getattr(key, "name", key), list(tags))
+            if frag is None:
+                self.frag_clean = False
+            else:
+                if row:
+                    self.frag_arena += b"\x1e"
+                self.frag_arena += frag
         # grow BEFORE bumping used: ensure() copies/zeroes relative to
         # self.used, and with used already including the new row it
         # copies one element past the old arrays (crash at a capacity
@@ -734,10 +755,12 @@ class DeviceWorker:
                 self.directory.sets.adopt_meta(row, meta)
             elif pool == 2:
                 self.scalars.counters.adopt_row(
-                    row, meta.key, meta.tags, meta.scope_class, meta.sinks)
+                    row, meta.key, meta.tags, meta.scope_class, meta.sinks,
+                    frag=meta.wire_frag())
             else:
                 self.scalars.gauges.adopt_row(
-                    row, meta.key, meta.tags, meta.scope_class, meta.sinks)
+                    row, meta.key, meta.tags, meta.scope_class, meta.sinks,
+                    frag=meta.wire_frag())
 
     def sync_native_series(self) -> None:
         """Adopt pending new-series registrations mid-epoch.

@@ -39,15 +39,34 @@ class LoadHarness:
 
     def __init__(self, cfg, spec: Optional[WorkloadSpec] = None,
                  transport: str = "udp",
-                 ring: Optional["native.LoadgenRing"] = None) -> None:
+                 ring: Optional["native.LoadgenRing"] = None,
+                 sink_mode: str = "channel") -> None:
         from veneur_tpu.core.server import Server
-        from veneur_tpu.sinks.channel import ChannelMetricSink
 
         self.spec = spec or WorkloadSpec.from_config(cfg)
         self.transport = transport
         self.interval = cfg.interval_seconds()
         self.ring = ring if ring is not None else self.spec.build_ring()
-        self.sink = ChannelMetricSink()
+        if sink_mode == "serialize":
+            # a real serializing sink: the datadog formatter builds the
+            # full chunked JSON series bodies (deflate included) against
+            # a discarding opener, so the emit stage pays its production
+            # serialization cost with zero network. This is the sink the
+            # --ab-axis emit-native A/B measures — the channel sink
+            # never serializes, so it can't see the native emit tier.
+            from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+            self.sink = DatadogMetricSink(
+                interval=self.interval, flush_max_per_body=25000,
+                hostname="loadgen", tags=["veneur:loadgen"],
+                dd_hostname="http://invalid.localdomain", api_key="x",
+                opener=lambda req, timeout: b"")
+        elif sink_mode == "channel":
+            from veneur_tpu.sinks.channel import ChannelMetricSink
+
+            self.sink = ChannelMetricSink()
+        else:
+            raise ValueError("sink_mode must be channel or serialize")
         self.server = Server(cfg, metric_sinks=[self.sink])
         ports = self.server.start()
         self._sock = self._connect(ports)
@@ -132,6 +151,10 @@ class LoadHarness:
     def _drain_sink(self) -> None:
         # keep the channel sink bounded over long runs; tally series so
         # the artifact can show the flush path really emitted
+        if not hasattr(self.sink, "queue"):
+            # serializing sinks tally their own emitted series
+            self.flushed_series = getattr(self.sink, "flushed_metrics", 0)
+            return
         while not self.sink.queue.empty():
             self.flushed_series += len(self.sink.queue.get_nowait())
         while not self.sink.other_samples.empty():
@@ -194,6 +217,12 @@ class LoadHarness:
                         flush_phases.get("swap_s", 0.0) * 1e3, 2),
                     "flush_ms": round(
                         sum(flush_phases.values()) * 1e3, 2),
+                    # the emit A/B's two phases of interest: columnar
+                    # batch assembly and sink serialization+emission
+                    "generate_ms": round(
+                        flush_phases.get("generate_s", 0.0) * 1e3, 2),
+                    "emit_ms": round(
+                        flush_phases.get("sink_flush_s", 0.0) * 1e3, 2),
                 })
                 prev = snap
                 self._drain_sink()
@@ -215,6 +244,10 @@ class LoadHarness:
                 sum(i["ingest_stall_ms"] for i in intervals) / n_iv, 2),
             "flush_ms_mean": round(
                 sum(i["flush_ms"] for i in intervals) / n_iv, 2),
+            "generate_ms_mean": round(
+                sum(i["generate_ms"] for i in intervals) / n_iv, 2),
+            "emit_ms_mean": round(
+                sum(i["emit_ms"] for i in intervals) / n_iv, 2),
             **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             "offered_lines_per_s": rate,
             "intervals": intervals,
@@ -374,6 +407,8 @@ def result_artifact(spec: WorkloadSpec, harness: LoadHarness,
         "tick_block_ms_mean": confirm.get("tick_block_ms_mean"),
         "ingest_stall_ms_mean": confirm.get("ingest_stall_ms_mean"),
         "flush_ms_mean": confirm.get("flush_ms_mean"),
+        "generate_ms_mean": confirm.get("generate_ms_mean"),
+        "emit_ms_mean": confirm.get("emit_ms_mean"),
         **({"pipeline": confirm["pipeline"]}
            if confirm.get("pipeline") else {}),
         "search_trials": [
@@ -382,6 +417,7 @@ def result_artifact(spec: WorkloadSpec, harness: LoadHarness,
                                "cadence_frac", "passed",
                                "tick_block_ms_mean",
                                "ingest_stall_ms_mean", "flush_ms_mean",
+                               "generate_ms_mean", "emit_ms_mean",
                                "total_shed")}
             for t in search["search_trials"]],
         "north_star_lines_per_s": NORTH_STAR_LINES_PER_S,

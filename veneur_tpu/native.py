@@ -125,6 +125,26 @@ def load_library() -> Optional[ctypes.CDLL]:
         except AttributeError:  # pre-datadog-emitter library
             pass
         try:
+            # emit tier (native/emit.cpp): forward lines, exposition
+            # text, and the GIL-free deflate pass
+            lib.vn_encode_forward_lines.restype = c.c_longlong
+            lib.vn_encode_forward_lines.argtypes = (
+                lib.vn_encode_prometheus_lines.argtypes)
+            lib.vn_encode_prometheus_exposition.restype = c.c_longlong
+            lib.vn_encode_prometheus_exposition.argtypes = (
+                lib.vn_encode_prometheus_lines.argtypes)
+            lib.vn_deflate.restype = c.c_longlong
+            lib.vn_deflate.argtypes = [
+                c.c_char_p, c.c_longlong,
+                c.POINTER(c.c_char_p), c.POINTER(c.c_longlong)]
+            lib.vn_deflate_chunks.restype = c.c_longlong
+            lib.vn_deflate_chunks.argtypes = [
+                c.c_char_p, c.c_void_p, c.c_longlong,
+                c.POINTER(c.c_void_p), c.POINTER(c.c_char_p),
+                c.POINTER(c.c_longlong)]
+        except AttributeError:  # pre-emit-tier library
+            pass
+        try:
             lib.vn_set_lock_stats.argtypes = [c.c_int]
             lib.vn_lock_stats.restype = c.c_int
             lib.vn_lock_stats.argtypes = [
@@ -618,6 +638,32 @@ def available() -> bool:
     return load_library() is not None
 
 
+def emit_available() -> bool:
+    """True when the native emit tier (native/emit.cpp) is loadable and
+    not masked out. VENEUR_EMIT_NATIVE=0 forces the Python formatters —
+    the CI parity lane and the bench --emit-native axis flip this
+    without touching the .so on disk."""
+    if os.environ.get("VENEUR_EMIT_NATIVE", "").lower() in (
+            "0", "false", "off", "no"):
+        return False
+    lib = load_library()
+    return lib is not None and hasattr(lib, "vn_deflate")
+
+
+def _blob_arg(blob) -> tuple:
+    """(c_char_p-compatible arg, length) for a meta blob that may be a
+    bytes object or a pool's live bytearray arena (zero-copy: the arena
+    is frozen after the epoch swap, so a borrowed pointer is safe for
+    the duration of the call)."""
+    if isinstance(blob, bytearray):
+        n = len(blob)
+        if n == 0:
+            return b"", 0
+        arr = (ctypes.c_char * n).from_buffer(blob)
+        return ctypes.cast(arr, ctypes.c_char_p), n
+    return blob, len(blob)
+
+
 def encode_histo_batch(meta_blob: bytes, kinds: np.ndarray,
                        scopes: np.ndarray, emit: np.ndarray,
                        means: np.ndarray, weights: np.ndarray,
@@ -746,14 +792,19 @@ def encode_datadog_series(meta_blob: bytes, nrows: int,
                           excluded_keys: list[str],
                           excluded_prefixes: list[str],
                           drop_prefixes: list[str],
-                          max_per_body: int
+                          max_per_body: int,
+                          compress: bool = False
                           ) -> "Optional[tuple[list[bytes], int]]":
     """Chunked Datadog {"series": [...]} bodies straight from columnar
-    arrays (native/dogstatsd.cpp vn_encode_datadog_series). Returns
+    arrays (native/emit.cpp vn_encode_datadog_series). Returns
     (bodies, emitted_count), or None when the library lacks the
-    symbol."""
+    symbol. compress=True deflates every chunk natively before it is
+    copied out (vn_deflate_chunks; byte-identical to zlib.compress),
+    so only compressed bytes cross back into Python."""
     lib = load_library()
     if lib is None or not hasattr(lib, "vn_encode_datadog_series"):
+        return None
+    if compress and not hasattr(lib, "vn_deflate_chunks"):
         return None
     c = ctypes
     values = np.ascontiguousarray(values, np.float64)
@@ -764,12 +815,13 @@ def encode_datadog_series(meta_blob: bytes, nrows: int,
     ep = "\x1f".join(excluded_prefixes).encode("utf-8")
     dp = "\x1f".join(drop_prefixes).encode("utf-8")
     host = hostname.encode("utf-8")
+    meta_arg, meta_len = _blob_arg(meta_blob)
     chunk_off = c.c_void_p()
     out = c.c_char_p()
     out_len = c.c_longlong()
     entries = c.c_longlong()
     n_chunks = lib.vn_encode_datadog_series(
-        meta_blob, len(meta_blob), nrows, suffix_blob, len(suffix_blob),
+        meta_arg, meta_len, nrows, suffix_blob, len(suffix_blob),
         _ptr(family_types), len(suffixes), _ptr(values), _ptr(masks),
         ts, float(interval), host, len(host), common_tags_json,
         len(common_tags_json), ek, len(ek), ep, len(ep), dp, len(dp),
@@ -777,6 +829,20 @@ def encode_datadog_series(meta_blob: bytes, nrows: int,
         c.byref(out_len), c.byref(entries))
     if n_chunks < 0:
         return None
+    if compress and n_chunks:
+        # chain the deflate pass on the still-live thread-local body
+        # buffer (same thread; the deflate output lives in its own
+        # buffers) — one more GIL-free call, zero Python-side copies of
+        # the uncompressed bodies
+        zoff = c.c_void_p()
+        zout = c.c_char_p()
+        zlen = c.c_longlong()
+        zn = lib.vn_deflate_chunks(out, chunk_off, n_chunks,
+                                   c.byref(zoff), c.byref(zout),
+                                   c.byref(zlen))
+        if zn < 0:
+            return None
+        chunk_off, out, out_len = zoff, zout, zlen
     offs = _copy_arr(chunk_off, n_chunks + 1, np.int64).tolist()
     whole = ctypes.string_at(out, out_len.value)
     return ([whole[offs[i]:offs[i + 1]] for i in range(n_chunks)],
@@ -805,10 +871,11 @@ def encode_signalfx_body(meta_blob: bytes, nrows: int,
     ek = "\x1f".join(excluded_keys).encode("utf-8")
     ht = hostname_tag.encode("utf-8")
     hv = hostname.encode("utf-8")
+    meta_arg, meta_len = _blob_arg(meta_blob)
     out = c.c_char_p()
     out_len = c.c_longlong()
     n = lib.vn_encode_signalfx_body(
-        meta_blob, len(meta_blob), nrows, sb, len(sb),
+        meta_arg, meta_len, nrows, sb, len(sb),
         _ptr(family_types), len(suffixes), _ptr(values), _ptr(masks),
         ts_ms, ht, len(ht), hv, len(hv), nd, len(nd), td_, len(td_),
         ek, len(ek), c.byref(out), c.byref(out_len))
@@ -817,16 +884,16 @@ def encode_signalfx_body(meta_blob: bytes, nrows: int,
     return ctypes.string_at(out, out_len.value), int(n)
 
 
-def encode_prometheus_lines(meta_blob: bytes, nrows: int,
-                            suffixes: list[str],
-                            family_types: np.ndarray,
-                            values: np.ndarray, masks: np.ndarray,
-                            excluded_keys: list[str]
-                            ) -> "Optional[tuple[bytes, int]]":
-    """statsd repeater lines from columnar arrays (one newline-joined
-    buffer + line count); None when the library lacks the symbol."""
+def _encode_lines(symbol: str, meta_blob, nrows: int,
+                  suffixes: list[str], family_types: np.ndarray,
+                  values: np.ndarray, masks: np.ndarray,
+                  excluded_keys: list[str]
+                  ) -> "Optional[tuple[bytes, int]]":
+    """Shared wrapper for the line-oriented emitters (statsd lines,
+    forward lines, exposition text): one newline-joined buffer plus the
+    emitted count; None when the library lacks the symbol."""
     lib = load_library()
-    if lib is None or not hasattr(lib, "vn_encode_prometheus_lines"):
+    if lib is None or not hasattr(lib, symbol):
         return None
     c = ctypes
     values = np.ascontiguousarray(values, np.float64)
@@ -834,10 +901,11 @@ def encode_prometheus_lines(meta_blob: bytes, nrows: int,
     family_types = np.ascontiguousarray(family_types, np.int8)
     suffix_blob = "\x1f".join(suffixes).encode("utf-8")
     ek = "\x1f".join(excluded_keys).encode("utf-8")
+    meta_arg, meta_len = _blob_arg(meta_blob)
     out = c.c_char_p()
     out_len = c.c_longlong()
-    n = lib.vn_encode_prometheus_lines(
-        meta_blob, len(meta_blob), nrows, suffix_blob, len(suffix_blob),
+    n = getattr(lib, symbol)(
+        meta_arg, meta_len, nrows, suffix_blob, len(suffix_blob),
         _ptr(family_types), len(suffixes), _ptr(values), _ptr(masks),
         ek, len(ek), c.byref(out), c.byref(out_len))
     if n < 0:
@@ -845,10 +913,63 @@ def encode_prometheus_lines(meta_blob: bytes, nrows: int,
     return ctypes.string_at(out, out_len.value), int(n)
 
 
+def encode_prometheus_lines(meta_blob, nrows: int,
+                            suffixes: list[str],
+                            family_types: np.ndarray,
+                            values: np.ndarray, masks: np.ndarray,
+                            excluded_keys: list[str]
+                            ) -> "Optional[tuple[bytes, int]]":
+    """statsd repeater lines from columnar arrays (one newline-joined
+    buffer + line count); None when the library lacks the symbol."""
+    return _encode_lines("vn_encode_prometheus_lines", meta_blob, nrows,
+                         suffixes, family_types, values, masks,
+                         excluded_keys)
+
+
+def encode_forward_lines(meta_blob, nrows: int, suffixes: list[str],
+                         family_types: np.ndarray, values: np.ndarray,
+                         masks: np.ndarray, excluded_keys: list[str]
+                         ) -> "Optional[tuple[bytes, int]]":
+    """Verbatim DogStatsD forward lines (no sanitization) from columnar
+    arrays; same contract as encode_prometheus_lines."""
+    return _encode_lines("vn_encode_forward_lines", meta_blob, nrows,
+                         suffixes, family_types, values, masks,
+                         excluded_keys)
+
+
+def encode_prometheus_exposition(meta_blob, nrows: int,
+                                 suffixes: list[str],
+                                 family_types: np.ndarray,
+                                 values: np.ndarray, masks: np.ndarray,
+                                 excluded_keys: list[str]
+                                 ) -> "Optional[tuple[bytes, int]]":
+    """Prometheus exposition text (`name{k="v"} value` samples, the
+    pushgateway body) from columnar arrays; (text, sample_count)."""
+    return _encode_lines("vn_encode_prometheus_exposition", meta_blob,
+                         nrows, suffixes, family_types, values, masks,
+                         excluded_keys)
+
+
+def deflate(data: bytes) -> Optional[bytes]:
+    """zlib deflate with the GIL released (native/emit.cpp vn_deflate);
+    byte-identical to zlib.compress(data) — both drive the system zlib
+    at default level. None when the library lacks the symbol."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_deflate"):
+        return None
+    c = ctypes
+    out = c.c_char_p()
+    out_len = c.c_longlong()
+    if lib.vn_deflate(data, len(data), c.byref(out),
+                      c.byref(out_len)) < 0:
+        return None
+    return ctypes.string_at(out, out_len.value)
+
+
 def source_hash() -> str:
     """Build stamp of the loaded library (sha256 prefix of
-    dogstatsd.cpp at build time); '' when no library is loadable,
-    'unstamped' for a pre-stamp build."""
+    dogstatsd.cpp + emit.cpp concatenated at build time); '' when no
+    library is loadable, 'unstamped' for a pre-stamp build."""
     lib = load_library()
     if lib is None:
         return ""

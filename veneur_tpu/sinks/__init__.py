@@ -43,6 +43,21 @@ class MetricSink(abc.ABC):
         metrics = filter_routed(batch.materialize(), self.name())
         self.flush(strip_excluded_tags(metrics, excluded_tags))
 
+    # Native emit path (native/emit.cpp): sinks whose wire format the
+    # native serializers produce set supports_native_emit = True and
+    # override flush_columnar_native. The contract is negotiation by
+    # return value: True = the batch was fully flushed (groups the
+    # native encoders couldn't take were routed through the sink's own
+    # Python formatter), False = nothing was flushed and the caller
+    # must fall back to flush_columnar — so a sink can refuse a whole
+    # batch when a configured feature (per-tag key routing, per-metric
+    # tag excludes) isn't covered natively.
+    supports_native_emit = False
+
+    def flush_columnar_native(self, batch,
+                              excluded_tags: Optional[set] = None) -> bool:
+        return False
+
     def flush_other_samples(self, samples: list[SSFSample]) -> None:
         """Receive 'other' samples (events, service checks carried as SSF);
         sinks that can't represent them drop them."""

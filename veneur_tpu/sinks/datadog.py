@@ -11,11 +11,11 @@ service checks unwound from their special SSF tags.
 from __future__ import annotations
 
 import collections
+import json
 import logging
+import math
 import threading
 from typing import Optional
-
-import numpy as np
 
 from veneur_tpu.core.metrics import InterMetric, MetricType
 from veneur_tpu.protocol import dogstatsd as ddproto
@@ -55,12 +55,24 @@ class DatadogMetricSink(MetricSink):
         self.opener = opener
         self.flushed_metrics = 0
         self.flush_errors = 0
+        # host tags are immutable per process: serialize them for the
+        # native body emitter once, not per flush
+        self._common_tags_json = self._build_common_tags()
 
     def name(self) -> str:
         return "datadog"
 
+    def _build_common_tags(self) -> bytes:
+        """The pre-serialized common-tag JSON run ("t1","t2",...) every
+        native series body shares."""
+        return ",".join(
+            json.dumps(t) for t in self.tags
+            if not any(t.startswith(e) for e in self.excluded_tags)
+        ).encode("utf-8")
+
     def set_excluded_tags(self, excluded: list[str]) -> None:
         self.excluded_tags = list(excluded)
+        self._common_tags_json = self._build_common_tags()
 
     # -- conversion (reference finalizeMetrics :256-384) --------------------
 
@@ -117,6 +129,11 @@ class DatadogMetricSink(MetricSink):
         else:
             return
 
+        if not math.isfinite(value):
+            # json.dumps would emit bare NaN/Infinity — invalid JSON the
+            # intake rejects; the native emitter writes null, match it
+            value = None
+
         dd_metrics.append({
             "metric": name,
             "points": [[ts, value]],
@@ -139,103 +156,97 @@ class DatadogMetricSink(MetricSink):
     # -- flushing (reference Flush :112-160, chunked parallel posts) --------
 
     supports_columnar = True
+    supports_native_emit = True
 
-    def flush_columnar(self, batch, excluded_tags=None) -> None:
-        """Columnar path (core/columnar.py): the native emitter builds
-        the chunked {"series": [...]} JSON bodies straight from the
-        batch columns and the cached wire fragments — no InterMetric
-        objects, no Python dicts, no json.dumps on the hot rows
-        (native/dogstatsd.cpp vn_encode_datadog_series). Groups the
-        native path can't serve (routing, separator-laden names, absent
-        library) fall back to the per-row Python path; status checks
-        always take it (message field)."""
-        import json as _json
+    def _finalize_group(self, g, ts: int, excluded_tags,
+                        dd_metrics: list, checks: list) -> None:
+        """Per-row Python formatter for one column group (the fallback
+        when the native emit tier can't take it)."""
+        for fam in g.families:
+            suffix = fam.suffix
+            vals = fam.values.tolist()
+            for i in g.rows_for(fam).tolist():
+                name, tags, sinks = g.meta_at(i)
+                if g.has_routing and sinks is not None \
+                        and self.name() not in sinks:
+                    continue
+                if excluded_tags:
+                    tags = [t for t in tags
+                            if t.split(":", 1)[0] not in excluded_tags]
+                self._finalize_one(
+                    name + suffix if suffix else name, vals[i],
+                    tags, fam.type, ts, "", dd_metrics, checks)
 
-        from veneur_tpu import native as native_mod
-        from veneur_tpu.core.metrics import MetricType as _MT
+    def _finalize_extras(self, batch, excluded_tags,
+                         dd_metrics: list, checks: list) -> None:
+        # extras (status checks) need message/hostname fields
         from veneur_tpu.sinks import filter_routed, strip_excluded_tags
 
-        dd_metrics: list[dict] = []
-        checks: list[dict] = []
-        bodies: list[bytes] = []
-        native_count = 0
-
-        common = ",".join(
-            _json.dumps(t) for t in self.tags
-            if not any(t.startswith(e) for e in self.excluded_tags)
-        ).encode("utf-8")
-        excl_keys = sorted(excluded_tags) if excluded_tags else []
-
-        for g in batch.groups:
-            frag_at = g.frag_at
-            native_ok = (frag_at is not None and not g.has_routing
-                         and not self.exclude_tags_prefix_by_prefix_metric
-                         and native_mod.available())
-            frags = None
-            if native_ok:
-                frags = []
-                for i in range(g.nrows):
-                    f = frag_at(i)
-                    if f is None:
-                        frags = None
-                        break
-                    frags.append(f)
-            if frags is None:
-                # python path for this group
-                mats_ts = batch.timestamp
-                for fam in g.families:
-                    suffix = fam.suffix
-                    vals = fam.values.tolist()
-                    for i in g.rows_for(fam).tolist():
-                        name, tags, sinks = g.meta_at(i)
-                        if g.has_routing and sinks is not None \
-                                and self.name() not in sinks:
-                            continue
-                        if excluded_tags:
-                            tags = [t for t in tags
-                                    if t.split(":", 1)[0]
-                                    not in excluded_tags]
-                        self._finalize_one(
-                            name + suffix if suffix else name, vals[i],
-                            tags, fam.type, mats_ts, "", dd_metrics,
-                            checks)
-                continue
-            meta_blob = b"\x1e".join(frags)
-            suffixes = [fam.suffix for fam in g.families]
-            ftypes = np.asarray(
-                [0 if fam.type == _MT.COUNTER else 1
-                 for fam in g.families], np.int8)
-            values = np.stack([fam.values for fam in g.families])
-            masks = np.stack([
-                fam.mask.astype(np.uint8) if fam.mask is not None
-                else np.ones(g.nrows, np.uint8) for fam in g.families])
-            out = native_mod.encode_datadog_series(
-                meta_blob, g.nrows, suffixes, ftypes, values, masks,
-                batch.timestamp, self.interval, self.hostname, common,
-                excl_keys, self.excluded_tags,
-                self.metric_name_prefix_drops, self.flush_max_per_body)
-            if out is None:
-                # library raced away: python path
-                for fam in g.families:
-                    vals = fam.values.tolist()
-                    for i in g.rows_for(fam).tolist():
-                        name, tags, _s = g.meta_at(i)
-                        self._finalize_one(
-                            name + fam.suffix if fam.suffix else name,
-                            vals[i], tags, fam.type, batch.timestamp,
-                            "", dd_metrics, checks)
-                continue
-            body_chunks, emitted = out
-            bodies.extend(body_chunks)
-            native_count += emitted
-
-        # extras (status checks) need message/hostname fields
         for m in strip_excluded_tags(
                 filter_routed(batch.extras, self.name()),
                 excluded_tags):
             self._finalize_one(m.name, m.value, m.tags, m.type,
                                m.timestamp, m.message, dd_metrics, checks)
-        self._post_all(dd_metrics, checks, bodies, native_count)
+
+    def flush_columnar(self, batch, excluded_tags=None) -> None:
+        """Columnar Python path (core/columnar.py): per-row dict
+        building straight off the batch columns — no InterMetric
+        objects. The native serializer path is flush_columnar_native;
+        the server negotiates between the two per flush."""
+        dd_metrics: list[dict] = []
+        checks: list[dict] = []
+        for g in batch.groups:
+            self._finalize_group(g, batch.timestamp, excluded_tags,
+                                 dd_metrics, checks)
+        self._finalize_extras(batch, excluded_tags, dd_metrics, checks)
+        self._post_all(dd_metrics, checks)
+
+    def flush_columnar_native(self, batch, excluded_tags=None) -> bool:
+        """Native emit path (native/emit.cpp): the chunked
+        {"series": [...]} JSON bodies — deflate included — are built by
+        vn_encode_datadog_series/vn_deflate_chunks straight from the
+        batch's frag arenas and value columns, GIL released throughout.
+        Groups the native tier can't take (routing, separator-laden
+        names) go through the Python formatter; returns False (nothing
+        flushed) when the whole path is unavailable or a configured
+        feature (per-metric-prefix tag excludes) isn't covered."""
+        from veneur_tpu import native as native_mod
+
+        if (self.exclude_tags_prefix_by_prefix_metric
+                or not native_mod.emit_available()):
+            return False
+        plans = batch.emit_plan()
+
+        dd_metrics: list[dict] = []
+        checks: list[dict] = []
+        bodies: list[bytes] = []
+        native_count = 0
+        excl_keys = sorted(excluded_tags) if excluded_tags else []
+
+        for g, plan in zip(batch.groups, plans):
+            out = None
+            if plan is not None:
+                out = native_mod.encode_datadog_series(
+                    plan.meta_blob, plan.nrows, plan.suffixes,
+                    plan.family_types, plan.values, plan.masks,
+                    batch.timestamp, self.interval, self.hostname,
+                    self._common_tags_json, excl_keys,
+                    self.excluded_tags, self.metric_name_prefix_drops,
+                    self.flush_max_per_body, compress=True)
+            if out is None:
+                # no plan for this group (or the library raced away):
+                # python formatter
+                self._finalize_group(g, batch.timestamp, excluded_tags,
+                                     dd_metrics, checks)
+                continue
+            body_chunks, emitted = out
+            bodies.extend(body_chunks)
+            native_count += emitted
+
+        self._finalize_extras(batch, excluded_tags, dd_metrics, checks)
+        self._post_all(dd_metrics, checks, bodies, native_count,
+                       precompressed=True)
+        return True
 
     def flush(self, metrics: list[InterMetric]) -> None:
         dd_metrics, checks = self._finalize(metrics)
@@ -243,7 +254,7 @@ class DatadogMetricSink(MetricSink):
 
     def _post_all(self, dd_metrics: list[dict], checks: list[dict],
                   raw_bodies: Optional[list[bytes]] = None,
-                  raw_count: int = 0) -> None:
+                  raw_count: int = 0, precompressed: bool = False) -> None:
         threads = []
         if raw_bodies:
             # bodies are chunked at flush_max_per_body, so every body but
@@ -253,7 +264,8 @@ class DatadogMetricSink(MetricSink):
                 share = (per if bi < len(raw_bodies) - 1
                          else raw_count - per * (len(raw_bodies) - 1))
                 t = threading.Thread(
-                    target=self._post_raw_body, args=(body, share),
+                    target=self._post_raw_body,
+                    args=(body, share, precompressed),
                     daemon=True)
                 t.start()
                 threads.append(t)
@@ -275,16 +287,19 @@ class DatadogMetricSink(MetricSink):
         for t in threads:
             t.join(timeout=30)
 
-    def _post_raw_body(self, body: bytes, count: int) -> None:
+    def _post_raw_body(self, body: bytes, count: int,
+                       precompressed: bool = False) -> None:
         """POST one pre-built {"series": [...]} JSON body (the native
-        emitter's output), deflate-compressed like post_json does."""
+        emitter's output), deflate-compressed like post_json does —
+        already compressed GIL-free by the native tier when
+        ``precompressed``."""
         import urllib.request
         import zlib as _zlib
 
         try:
             req = urllib.request.Request(
                 f"{self.dd_hostname}/api/v1/series?api_key={self.api_key}",
-                data=_zlib.compress(body),
+                data=body if precompressed else _zlib.compress(body),
                 method="POST",
                 headers={"Content-Type": "application/json",
                          "Content-Encoding": "deflate"},

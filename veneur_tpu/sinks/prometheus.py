@@ -20,6 +20,10 @@ log = logging.getLogger("veneur_tpu.sinks.prometheus")
 
 _INVALID_NAME = re.compile(r"[^a-zA-Z0-9_:.]")  # dots map to exporter paths
 _INVALID_TAG = re.compile(r"[^a-zA-Z0-9_:,=\.]")
+# exposition format: metric names allow [a-zA-Z0-9_:], label names
+# [a-zA-Z0-9_] (the exposition writer has no dot-to-path mapping)
+_INVALID_EXPO_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+_INVALID_EXPO_LABEL = re.compile(r"[^a-zA-Z0-9_]")
 
 
 def sanitize_name(name: str) -> str:
@@ -28,6 +32,40 @@ def sanitize_name(name: str) -> str:
 
 def sanitize_tag(tag: str) -> str:
     return _INVALID_TAG.sub("_", tag)
+
+
+def expo_value(v: float) -> str:
+    """Exposition sample value rendering (pinned == the native
+    emitter's expo_value_append)."""
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return str(v)
+
+
+def expo_sample(name: str, tags: list[str], value: float,
+                excluded_tags=None) -> str:
+    """One exposition text line: name{label="value",...} value\\n.
+    Label keys dedup by their SANITIZED form (last value wins, first
+    position kept); exclusion matches the RAW tag key. Pinned
+    byte-identical to vn_encode_prometheus_exposition."""
+    labels: dict[str, str] = {}
+    for tag in tags:
+        rawkey, _, val = tag.partition(":")
+        if excluded_tags and rawkey in excluded_tags:
+            continue
+        key = _INVALID_EXPO_LABEL.sub("_", rawkey)
+        labels[key] = val
+    line = _INVALID_EXPO_NAME.sub("_", name)
+    if labels:
+        line += "{" + ",".join(
+            '%s="%s"' % (k, v.replace("\\", "\\\\").replace('"', '\\"')
+                         .replace("\n", "\\n"))
+            for k, v in labels.items()) + "}"
+    return f"{line} {expo_value(value)}\n"
 
 
 class PrometheusMetricSink(MetricSink):
@@ -71,77 +109,38 @@ class PrometheusMetricSink(MetricSink):
         self._send([ln for ln in (self._statsd_line(m) for m in metrics)
                     if ln is not None])
 
-    def flush_columnar(self, batch, excluded_tags=None) -> None:
-        """Columnar path: statsd lines straight from the batch columns —
-        built by the native line emitter (vn_encode_prometheus_lines)
-        when available, per-row Python otherwise. Either way no
-        InterMetric objects in between (core/columnar.py)."""
-        import numpy as np
-
-        from veneur_tpu import native as native_mod
-        from veneur_tpu.core.metrics import MetricType as _MT
-
-        lines = []
-        append = lines.append
+    def _group_lines(self, g, excluded_tags, append) -> None:
+        """Per-row Python formatter for one column group (the fallback
+        when the native emit tier can't take it)."""
         counter = MetricType.COUNTER
         gauge = MetricType.GAUGE
-        excl = sorted(excluded_tags) if excluded_tags else []
-        for g in batch.groups:
-            frags = None
-            if g.frag_at is not None and not g.has_routing \
-                    and native_mod.available():
-                frags = []
-                for i in range(g.nrows):
-                    f = g.frag_at(i)
-                    if f is None:
-                        frags = None
-                        break
-                    frags.append(f)
-            if frags is not None:
-                fams = [fam for fam in g.families
-                        if fam.type in (counter, gauge)]
-                if not fams:
+        for fam in g.families:
+            if fam.type == counter:
+                kind = "c"
+            elif fam.type == gauge:
+                kind = "g"
+            else:
+                continue
+            vals = fam.values.tolist()
+            suffix = fam.suffix
+            for i in g.rows_for(fam).tolist():
+                name, tags, sinks = g.meta_at(i)
+                if g.has_routing and sinks is not None \
+                        and self.name() not in sinks:
                     continue
-                out = native_mod.encode_prometheus_lines(
-                    b"\x1e".join(frags), g.nrows,
-                    [fam.suffix for fam in fams],
-                    np.asarray([0 if fam.type == _MT.COUNTER else 1
-                                for fam in fams], np.int8),
-                    np.stack([fam.values for fam in fams]),
-                    np.stack([
-                        fam.mask.astype(np.uint8) if fam.mask is not None
-                        else np.ones(g.nrows, np.uint8)
-                        for fam in fams]),
-                    excl)
-                if out is not None:
-                    blob, n = out
-                    if n:
-                        append(blob)
-                    continue
-            # python path for this group
-            for fam in g.families:
-                if fam.type == counter:
-                    kind = "c"
-                elif fam.type == gauge:
-                    kind = "g"
-                else:
-                    continue
-                vals = fam.values.tolist()
-                suffix = fam.suffix
-                for i in g.rows_for(fam).tolist():
-                    name, tags, sinks = g.meta_at(i)
-                    if g.has_routing and sinks is not None \
-                            and self.name() not in sinks:
-                        continue
-                    if excluded_tags:
-                        tags = [t for t in tags
-                                if t.split(":", 1)[0] not in excluded_tags]
-                    line = (f"{sanitize_name(name + suffix if suffix else name)}"
-                            f":{vals[i]}|{kind}")
-                    if tags:
-                        line += "|#" + ",".join(
-                            sanitize_tag(t) for t in tags)
-                    append(line.encode("utf-8"))
+                if excluded_tags:
+                    tags = [t for t in tags
+                            if t.split(":", 1)[0] not in excluded_tags]
+                line = (f"{sanitize_name(name + suffix if suffix else name)}"
+                        f":{vals[i]}|{kind}")
+                if tags:
+                    line += "|#" + ",".join(
+                        sanitize_tag(t) for t in tags)
+                append(line.encode("utf-8"))
+
+    def _extra_lines(self, batch, excluded_tags, append) -> None:
+        counter = MetricType.COUNTER
+        gauge = MetricType.GAUGE
         for m in batch.extras:
             if m.sinks is not None and self.name() not in m.sinks:
                 continue
@@ -159,7 +158,48 @@ class PrometheusMetricSink(MetricSink):
             if tags:
                 line += "|#" + ",".join(sanitize_tag(t) for t in tags)
             append(line.encode("utf-8"))
+
+    def flush_columnar(self, batch, excluded_tags=None) -> None:
+        """Columnar Python path: statsd lines straight from the batch
+        columns, no InterMetric objects (core/columnar.py). The native
+        serializer path is flush_columnar_native; the server negotiates
+        between the two per flush."""
+        lines: list[bytes] = []
+        for g in batch.groups:
+            self._group_lines(g, excluded_tags, lines.append)
+        self._extra_lines(batch, excluded_tags, lines.append)
         self._send(lines)
+
+    supports_native_emit = True
+
+    def flush_columnar_native(self, batch, excluded_tags=None) -> bool:
+        """Native emit path: the whole line blob comes out of
+        vn_encode_prometheus_lines in one GIL-free pass over the batch's
+        frag arena and value columns. Groups without a plan (routing,
+        separator-laden names) fall back to the Python formatter;
+        returns False when the native tier is unavailable."""
+        from veneur_tpu import native as native_mod
+
+        if not native_mod.emit_available():
+            return False
+        plans = batch.emit_plan()
+        lines: list[bytes] = []
+        excl = sorted(excluded_tags) if excluded_tags else []
+        for g, plan in zip(batch.groups, plans):
+            out = None
+            if plan is not None:
+                out = native_mod.encode_prometheus_lines(
+                    plan.meta_blob, plan.nrows, plan.suffixes,
+                    plan.family_types, plan.values, plan.masks, excl)
+            if out is None:
+                self._group_lines(g, excluded_tags, lines.append)
+                continue
+            blob, n = out
+            if n:
+                lines.append(blob)
+        self._extra_lines(batch, excluded_tags, lines.append)
+        self._send(lines)
+        return True
 
     # max UDP datagram payload: statsd exporters accept multi-line
     # datagrams; stay under a jumbo-frame-safe size
@@ -196,3 +236,112 @@ class PrometheusMetricSink(MetricSink):
             self.flush_errors += 1
             self._sock = None
             log.warning("prometheus repeater send failed: %s", e)
+
+
+class PrometheusExpositionSink(MetricSink):
+    """Pushgateway-style exposition sink: each flush POSTs one
+    text-format body (`name{label="value",...} value` lines) to the
+    configured address. Samples are untyped (a pushgateway body carries
+    no TYPE/HELP comments); only counters and gauges are expressible.
+
+    The native emit tier (vn_encode_prometheus_exposition) builds the
+    whole body in one GIL-free pass; the Python formatter (expo_sample)
+    is pinned byte-identical by tests/test_emit_parity.py."""
+
+    supports_columnar = True
+    supports_native_emit = True
+
+    def __init__(self, address: str, opener=None) -> None:
+        from veneur_tpu.utils.http import default_opener
+
+        self.address = address
+        self.opener = opener or default_opener
+        self.flushed_metrics = 0
+        self.flush_errors = 0
+
+    def name(self) -> str:
+        return "prometheus"
+
+    def _group_samples(self, g, excluded_tags, append) -> None:
+        counter = MetricType.COUNTER
+        gauge = MetricType.GAUGE
+        for fam in g.families:
+            if fam.type not in (counter, gauge):
+                continue
+            vals = fam.values.tolist()
+            suffix = fam.suffix
+            for i in g.rows_for(fam).tolist():
+                name, tags, sinks = g.meta_at(i)
+                if g.has_routing and sinks is not None \
+                        and self.name() not in sinks:
+                    continue
+                append(expo_sample(name + suffix if suffix else name,
+                                   tags, vals[i], excluded_tags))
+
+    def _extra_samples(self, batch, excluded_tags, append) -> None:
+        for m in batch.extras:
+            if m.sinks is not None and self.name() not in m.sinks:
+                continue
+            if m.type not in (MetricType.COUNTER, MetricType.GAUGE):
+                continue
+            append(expo_sample(m.name, m.tags, m.value, excluded_tags))
+
+    def flush(self, metrics) -> None:
+        parts = []
+        for m in metrics:
+            if m.type in (MetricType.COUNTER, MetricType.GAUGE):
+                parts.append(expo_sample(m.name, m.tags, m.value))
+        self._post("".join(parts).encode("utf-8"), len(parts))
+
+    def flush_columnar(self, batch, excluded_tags=None) -> None:
+        parts: list[str] = []
+        for g in batch.groups:
+            self._group_samples(g, excluded_tags, parts.append)
+        self._extra_samples(batch, excluded_tags, parts.append)
+        self._post("".join(parts).encode("utf-8"), len(parts))
+
+    def flush_columnar_native(self, batch, excluded_tags=None) -> bool:
+        from veneur_tpu import native as native_mod
+
+        if not native_mod.emit_available():
+            return False
+        plans = batch.emit_plan()
+        chunks: list[bytes] = []
+        count = 0
+        excl = sorted(excluded_tags) if excluded_tags else []
+        for g, plan in zip(batch.groups, plans):
+            out = None
+            if plan is not None:
+                out = native_mod.encode_prometheus_exposition(
+                    plan.meta_blob, plan.nrows, plan.suffixes,
+                    plan.family_types, plan.values, plan.masks, excl)
+            if out is None:
+                parts: list[str] = []
+                self._group_samples(g, excluded_tags, parts.append)
+                chunks.append("".join(parts).encode("utf-8"))
+                count += len(parts)
+                continue
+            blob, n = out
+            chunks.append(blob)
+            count += n
+        parts = []
+        self._extra_samples(batch, excluded_tags, parts.append)
+        chunks.append("".join(parts).encode("utf-8"))
+        count += len(parts)
+        self._post(b"".join(chunks), count)
+        return True
+
+    def _post(self, body: bytes, count: int) -> None:
+        import urllib.request
+
+        if not count:
+            return
+        try:
+            req = urllib.request.Request(
+                self.address, data=body, method="POST",
+                headers={"Content-Type": "text/plain; version=0.0.4"})
+            self.opener(req, 10.0)
+            self.flushed_metrics += count
+        except Exception as e:
+            self.flush_errors += 1
+            log.warning("prometheus exposition post failed: %s", e)

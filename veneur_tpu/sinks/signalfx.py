@@ -173,81 +173,85 @@ class SignalFxMetricSink(MetricSink):
         return api_key, {kind: point}
 
     supports_columnar = True
+    supports_native_emit = True
+
+    def _convert_group(self, g, ts: int, excluded_tags, keys,
+                       by_key: dict) -> None:
+        """Per-row Python converter for one column group (the fallback
+        when the native emit tier can't take it)."""
+        for fam in g.families:
+            vals = fam.values.tolist()
+            suffix = fam.suffix
+            for i in g.rows_for(fam).tolist():
+                name, tags, sinks = g.meta_at(i)
+                if g.has_routing and sinks is not None \
+                        and self.name() not in sinks:
+                    continue
+                if excluded_tags:
+                    tags = [t for t in tags
+                            if t.split(":", 1)[0] not in excluded_tags]
+                conv = self._convert_fields(
+                    name + suffix if suffix else name, vals[i],
+                    tags, fam.type, ts, "", keys)
+                if conv is None:
+                    continue
+                api_key, kinds = conv
+                bucket = by_key.setdefault(
+                    api_key, {"counter": [], "gauge": []})
+                for kind, point in kinds.items():
+                    bucket[kind].append(point)
 
     def flush_columnar(self, batch, excluded_tags=None) -> None:
-        """Columnar path (core/columnar.py): datapoints built straight
-        from the batch columns — via the native body emitter
-        (vn_encode_signalfx_body) when no per-tag key routing is
-        configured, per-row Python otherwise. Only counter/gauge rows
-        are convertible (as in _convert), and group rows never carry a
-        hostname field, so the per-row feed loses nothing."""
-        import numpy as np
-
-        from veneur_tpu import native as native_mod
-        from veneur_tpu.core.metrics import MetricType as _MT
-
+        """Columnar Python path (core/columnar.py): datapoints built
+        straight from the batch columns. Only counter/gauge rows are
+        convertible (as in _convert), and group rows never carry a
+        hostname field, so the per-row feed loses nothing. The native
+        serializer path is flush_columnar_native; the server negotiates
+        between the two per flush."""
         with self._keys_lock:
             keys = dict(self.per_tag_api_keys)
         by_key: dict[str, dict[str, list]] = {}
-        raw_bodies: list[bytes] = []
-        excl = sorted(excluded_tags) if excluded_tags else []
-        native_ok = not self.vary_key_by and native_mod.available()
         for g in batch.groups:
-            frags = None
-            if native_ok and g.frag_at is not None and not g.has_routing:
-                frags = []
-                for i in range(g.nrows):
-                    f = g.frag_at(i)
-                    if f is None:
-                        frags = None
-                        break
-                    frags.append(f)
-            if frags is not None:
-                fams = [fam for fam in g.families
-                        if fam.type in (_MT.COUNTER, _MT.GAUGE)]
-                if not fams:
-                    continue
+            self._convert_group(g, batch.timestamp, excluded_tags, keys,
+                                by_key)
+        self._post_buckets(by_key)
+
+    def flush_columnar_native(self, batch, excluded_tags=None) -> bool:
+        """Native emit path: one {"counter":[...],"gauge":[...]} body
+        per group from vn_encode_signalfx_body, GIL released. Refuses
+        the batch (returns False) when per-tag key routing
+        (vary_key_by) is configured — key selection depends on tag
+        values the native body emitter doesn't route on — or the native
+        tier is unavailable; groups without a plan fall back to the
+        Python converter."""
+        from veneur_tpu import native as native_mod
+
+        if self.vary_key_by or not native_mod.emit_available():
+            return False
+        with self._keys_lock:
+            keys = dict(self.per_tag_api_keys)
+        by_key: dict[str, dict[str, list]] = {}
+        raw_bodies: list[tuple[bytes, int]] = []
+        excl = sorted(excluded_tags) if excluded_tags else []
+        plans = batch.emit_plan()
+        for g, plan in zip(batch.groups, plans):
+            out = None
+            if plan is not None:
                 out = native_mod.encode_signalfx_body(
-                    b"\x1e".join(frags), g.nrows,
-                    [fam.suffix for fam in fams],
-                    np.asarray([0 if fam.type == _MT.COUNTER else 1
-                                for fam in fams], np.int8),
-                    np.stack([fam.values for fam in fams]),
-                    np.stack([
-                        fam.mask.astype(np.uint8) if fam.mask is not None
-                        else np.ones(g.nrows, np.uint8)
-                        for fam in fams]),
+                    plan.meta_blob, plan.nrows, plan.suffixes,
+                    plan.family_types, plan.values, plan.masks,
                     batch.timestamp * 1000, self.hostname_tag,
                     self.hostname, self.name_drops, self.tag_drops,
                     excl)
-                if out is not None:
-                    body, n = out
-                    if n:
-                        raw_bodies.append((body, n))
-                    continue
-            # python path for this group
-            for fam in g.families:
-                vals = fam.values.tolist()
-                suffix = fam.suffix
-                for i in g.rows_for(fam).tolist():
-                    name, tags, sinks = g.meta_at(i)
-                    if g.has_routing and sinks is not None \
-                            and self.name() not in sinks:
-                        continue
-                    if excluded_tags:
-                        tags = [t for t in tags
-                                if t.split(":", 1)[0] not in excluded_tags]
-                    conv = self._convert_fields(
-                        name + suffix if suffix else name, vals[i],
-                        tags, fam.type, batch.timestamp, "", keys)
-                    if conv is None:
-                        continue
-                    api_key, kinds = conv
-                    bucket = by_key.setdefault(
-                        api_key, {"counter": [], "gauge": []})
-                    for kind, point in kinds.items():
-                        bucket[kind].append(point)
+            if out is None:
+                self._convert_group(g, batch.timestamp, excluded_tags,
+                                    keys, by_key)
+                continue
+            body, n = out
+            if n:
+                raw_bodies.append((body, n))
         self._post_buckets(by_key, raw_bodies)
+        return True
 
     def flush(self, metrics: list[InterMetric]) -> None:
         # group by API key (per-tag clients); snapshot the key map once —
