@@ -1,0 +1,418 @@
+"""Delivery-reliability layer (sinks/delivery.py): breaker state
+machine, retry/backoff classification, deadline clipping, bounded
+spill accounting, and the seeded fault harness (utils/faults.py) —
+all on injected clocks so every assertion is deterministic."""
+
+from __future__ import annotations
+
+import pytest
+
+from veneur_tpu.sinks.delivery import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    DeliveryManager,
+    DeliveryPolicy,
+    retryable,
+)
+from veneur_tpu.utils.faults import FaultPlan, FaultyOpener
+from veneur_tpu.utils.http import HTTPError
+
+
+class FakeClock:
+    """monotonic + sleep pair where sleeping IS advancing time."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def time(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+class MaxRng:
+    """uniform(a, b) -> b: the worst-case full-jitter draw."""
+
+    def uniform(self, a, b):
+        return b
+
+
+def make_mgr(clock=None, **policy_kw):
+    policy_kw.setdefault("backoff_base_s", 0.1)
+    policy_kw.setdefault("backoff_max_s", 1.0)
+    clock = clock or FakeClock()
+    mgr = DeliveryManager("test", DeliveryPolicy(**policy_kw),
+                          time_fn=clock.time, sleep_fn=clock.sleep,
+                          rng=MaxRng())
+    return mgr, clock
+
+
+class FlakySend:
+    """send closure failing per a script of exceptions (None = succeed)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+        self.timeouts = []
+
+    def __call__(self, timeout):
+        self.timeouts.append(timeout)
+        self.calls += 1
+        exc = self.script.pop(0) if self.script else None
+        if exc is not None:
+            raise exc
+
+
+# ---------------------------------------------------------------------------
+# classification
+
+
+def test_retryable_classification():
+    assert retryable(HTTPError(503, b""))
+    assert retryable(HTTPError(408, b""))
+    assert retryable(HTTPError(429, b""))
+    assert not retryable(HTTPError(400, b""))
+    assert not retryable(HTTPError(404, b""))
+    assert retryable(TimeoutError())
+    assert retryable(ConnectionRefusedError(111, "refused"))
+    assert retryable(ConnectionResetError(104, "reset"))
+    assert retryable(OSError(101, "unreachable"))
+    assert not retryable(ValueError("serializer bug"))
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine
+
+
+def test_breaker_opens_after_threshold_and_probe_cycle():
+    b = CircuitBreaker(threshold=2)
+    assert b.state == CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == CLOSED
+    b.record_failure()
+    assert b.state == OPEN and b.opened_total == 1
+    assert not b.allow() and not b.can_attempt()
+    # interval edge arms exactly one probe
+    b.begin_interval()
+    assert b.state == HALF_OPEN
+    assert b.allow()          # the probe
+    assert not b.allow()      # probe spent: everything else short-circuits
+    b.record_failure()        # probe verdict: still down
+    assert b.state == OPEN and b.opened_total == 2
+    b.begin_interval()
+    assert b.allow()
+    b.record_success()        # probe verdict: recovered
+    assert b.state == CLOSED and b.consecutive_failures == 0
+    assert list(b.transitions) == [OPEN, HALF_OPEN, OPEN, HALF_OPEN, CLOSED]
+
+
+def test_breaker_probe_accounting_non_consuming_peek():
+    b = CircuitBreaker(threshold=1)
+    b.record_failure()
+    b.begin_interval()
+    # can_attempt peeks without spending the probe
+    assert b.can_attempt() and b.can_attempt()
+    assert b.allow()
+    assert not b.can_attempt()
+
+
+def test_breaker_threshold_zero_disables():
+    b = CircuitBreaker(threshold=0)
+    for _ in range(10):
+        b.record_failure()
+    assert b.state == CLOSED and b.allow()
+
+
+def test_breaker_half_open_success_only_after_begin_interval():
+    b = CircuitBreaker(threshold=1)
+    b.record_failure()
+    assert b.state == OPEN
+    # without an interval edge the breaker stays open: no probes
+    assert not b.allow() and not b.allow()
+
+
+# ---------------------------------------------------------------------------
+# deliver(): retry / drop / deadline
+
+
+def test_deliver_success_counts():
+    mgr, _ = make_mgr()
+    send = FlakySend([None])
+    mgr.begin_flush()
+    assert mgr.deliver(send, 100) == "delivered"
+    s = mgr.stats()
+    assert s["accepted_payloads"] == 1 and s["delivered_payloads"] == 1
+    assert s["retries"] == 0 and mgr.conserved()
+
+
+def test_transient_failure_retries_then_succeeds():
+    mgr, clock = make_mgr(retry_max=2, deadline_s=60.0)
+    send = FlakySend([HTTPError(503, b""), ConnectionResetError(104, "r"),
+                      None])
+    mgr.begin_flush()
+    assert mgr.deliver(send, 10) == "delivered"
+    assert send.calls == 3
+    s = mgr.stats()
+    assert s["retries"] == 2 and s["delivered_payloads"] == 1
+    assert clock.sleeps  # backoff actually slept
+    assert mgr.conserved()
+
+
+def test_permanent_4xx_drops_without_retry():
+    mgr, _ = make_mgr(retry_max=5)
+    send = FlakySend([HTTPError(400, b"bad payload")])
+    mgr.begin_flush()
+    assert mgr.deliver(send, 77) == "dropped"
+    assert send.calls == 1  # never resent
+    s = mgr.stats()
+    assert s["dropped_payloads"] == 1 and s["dropped_bytes"] == 77
+    assert s["retries"] == 0 and mgr.conserved()
+
+
+def test_retry_budget_clipped_to_deadline():
+    # worst-case jitter draw is 10s against a 1s budget: the retry is
+    # abandoned BEFORE sleeping and the payload spills
+    mgr, clock = make_mgr(retry_max=5, deadline_s=1.0,
+                          backoff_base_s=10.0, backoff_max_s=10.0)
+    send = FlakySend([HTTPError(503, b"")] * 10)
+    mgr.begin_flush()
+    assert mgr.deliver(send, 10) == "deferred"
+    assert send.calls == 1
+    s = mgr.stats()
+    assert s["deadline_clipped"] == 1 and s["spilled_payloads"] == 1
+    assert not clock.sleeps  # clipped instead of sleeping past the tick
+    assert mgr.conserved()
+
+
+def test_expired_deadline_defers_without_attempt_only_when_armed():
+    # an armed-but-expired flush deadline does NOT starve a standalone
+    # delivery: it gets a fresh budget (events posted outside a funnel)
+    mgr, clock = make_mgr(deadline_s=5.0)
+    mgr.begin_flush()
+    clock.t += 100.0  # the armed deadline is long gone
+    send = FlakySend([None])
+    assert mgr.deliver(send, 1) == "delivered"
+    assert send.calls == 1
+
+
+def test_attempt_timeout_clamped_to_remaining_budget():
+    mgr, clock = make_mgr(timeout_s=10.0, deadline_s=3.0)
+    mgr.begin_flush()
+    clock.t += 2.0
+    send = FlakySend([None])
+    mgr.deliver(send, 1)
+    assert send.timeouts[0] == pytest.approx(1.0)  # 3.0 armed - 2.0 gone
+
+
+# ---------------------------------------------------------------------------
+# spill accounting
+
+
+def test_spill_bounded_oldest_dropped_first():
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=0,
+                      spill_max_payloads=2, spill_max_bytes=1 << 20)
+    sends = [FlakySend([ConnectionRefusedError(111, "r")] * 99)
+             for _ in range(3)]
+    mgr.begin_flush()
+    for i, send in enumerate(sends):
+        mgr.deliver(send, 10 + i)
+    s = mgr.stats()
+    # three deferrals, the first (oldest, 10 bytes) evicted
+    assert s["deferred_payloads"] == 3
+    assert s["spilled_payloads"] == 2
+    assert s["dropped_payloads"] == 1 and s["dropped_bytes"] == 10
+    assert mgr.conserved()
+
+
+def test_spill_byte_cap_evicts():
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=0,
+                      spill_max_payloads=100, spill_max_bytes=25)
+    mgr.begin_flush()
+    for _ in range(3):  # 3 x 10 bytes > 25: first evicted
+        mgr.deliver(FlakySend([TimeoutError()] * 9), 10)
+    s = mgr.stats()
+    assert s["spilled_payloads"] == 2 and s["spilled_bytes"] == 20
+    assert s["dropped_payloads"] == 1
+    assert mgr.conserved()
+
+
+def test_zero_spill_caps_turn_deferral_into_drop():
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=0,
+                      spill_max_payloads=0, spill_max_bytes=0)
+    mgr.begin_flush()
+    assert mgr.deliver(FlakySend([TimeoutError()]), 5) == "dropped"
+    s = mgr.stats()
+    assert s["dropped_payloads"] == 1 and s["spilled_payloads"] == 0
+    assert mgr.conserved()
+
+
+def test_retry_spill_delivers_ahead_of_fresh_data():
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=0)
+    order = []
+
+    def make_send(tag, script):
+        inner = FlakySend(script)
+
+        def send(timeout):
+            inner(timeout)
+            order.append(tag)
+        return send
+
+    mgr.begin_flush()
+    assert mgr.deliver(make_send("old", [TimeoutError()]), 5) == "deferred"
+    # next interval: the spilled payload goes out before fresh data
+    mgr.begin_flush()
+    assert mgr.retry_spill() == 1
+    assert mgr.deliver(make_send("fresh", []), 5) == "delivered"
+    assert order == ["old", "fresh"]
+    s = mgr.stats()
+    assert s["delivered_payloads"] == 2 and s["spilled_payloads"] == 0
+    assert mgr.conserved()
+
+
+def test_retry_spill_skipped_while_breaker_open():
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=1)
+    mgr.begin_flush()
+    mgr.deliver(FlakySend([TimeoutError()] * 9), 5)
+    assert mgr.breaker.state == OPEN
+    # no begin_flush: no probe armed, the spill must stay put
+    assert mgr.retry_spill() == 0
+    assert mgr.stats()["spilled_payloads"] == 1
+    assert mgr.conserved()
+
+
+def test_breaker_short_circuit_spills_payload():
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=1)
+    mgr.begin_flush()
+    mgr.deliver(FlakySend([ConnectionRefusedError(111, "r")] * 9), 5)
+    # breaker open, no interval edge: fresh payloads spill untried
+    send = FlakySend([None])
+    assert mgr.deliver(send, 5) == "deferred"
+    assert send.calls == 0
+    assert mgr.stats()["breaker_short_circuits"] == 1
+    assert mgr.conserved()
+
+
+def test_half_open_single_probe_spills_second_payload():
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=1)
+    mgr.begin_flush()
+    mgr.deliver(FlakySend([TimeoutError()] * 9), 5)
+    mgr.begin_flush()           # arms the single half-open probe
+    mgr.retry_spill()           # consumes it (and fails again)
+    probe_starved = FlakySend([None])
+    assert mgr.deliver(probe_starved, 5) == "deferred"
+    assert probe_starved.calls == 0
+
+
+def test_full_breaker_cycle_recorded_in_transitions():
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=1)
+    failing = FlakySend([TimeoutError()] * 3)  # heals on the 4th attempt
+    mgr.begin_flush()
+    mgr.deliver(failing, 5)                    # -> open, spilled
+    for _ in range(8):                         # probe-per-interval until heal
+        mgr.begin_flush()                      # -> half_open (single probe)
+        mgr.retry_spill()                      # probe = the spilled payload
+        if mgr.breaker.state == CLOSED:
+            break
+    assert mgr.breaker.state == CLOSED
+    assert failing.calls == 4
+    trans = list(mgr.breaker.transitions)
+    assert OPEN in trans and HALF_OPEN in trans and CLOSED in trans
+    assert mgr.conserved()
+
+
+# ---------------------------------------------------------------------------
+# seeded fault harness
+
+
+def test_faulty_opener_is_deterministic_per_seed():
+    plan = FaultPlan(seed=42, p_refuse=0.2, p_5xx=0.2, p_slow=0.1,
+                     p_reset=0.1, p_reject=0.1, slow_s=0.0)
+    runs = []
+    for _ in range(2):
+        op = FaultyOpener(plan, sleep_fn=lambda s: None)
+        kinds = []
+        for _ in range(200):
+            try:
+                op(None, 1.0)
+                kinds.append("ok")
+            except Exception as e:
+                kinds.append(type(e).__name__)
+        runs.append((kinds, dict(op.injected)))
+    assert runs[0] == runs[1]
+    # every configured fault kind actually fired at these probabilities
+    injected = runs[0][1]
+    for kind in ("refused", "http_5xx", "reset", "rejected", "passed"):
+        assert injected[kind] > 0, kind
+
+
+def test_faulty_opener_down_ranges_override():
+    plan = FaultPlan(seed=1, down_ranges=[(2, 5)])
+    op = FaultyOpener(plan)
+    outcomes = []
+    for _ in range(7):
+        try:
+            op(None, 1.0)
+            outcomes.append("ok")
+        except ConnectionRefusedError:
+            outcomes.append("refused")
+    assert outcomes == ["ok", "ok", "refused", "refused", "refused",
+                        "ok", "ok"]
+
+
+def test_faulty_opener_slow_past_timeout_raises():
+    plan = FaultPlan(seed=0, p_slow=1.0, slow_s=5.0)
+    slept = []
+    op = FaultyOpener(plan, sleep_fn=slept.append)
+    with pytest.raises(TimeoutError):
+        op(None, 0.5)
+    assert slept == [0.5]  # a real socket times out after exactly timeout
+
+
+def test_conservation_under_seeded_faults():
+    """The soak's core invariant at unit scale: every payload pushed
+    through a manager fed by a FaultyOpener is delivered, declared
+    dropped, or sitting in the bounded spill — exactly."""
+    plan = FaultPlan(seed=7, p_refuse=0.15, p_5xx=0.15, p_reset=0.1,
+                     p_reject=0.1, slow_s=0.0)
+    op = FaultyOpener(plan, sleep_fn=lambda s: None)
+    clock = FakeClock()
+    mgr = DeliveryManager(
+        "chaos",
+        DeliveryPolicy(retry_max=1, breaker_threshold=3, deadline_s=10.0,
+                       backoff_base_s=0.01, spill_max_payloads=8,
+                       spill_max_bytes=1 << 16),
+        time_fn=clock.time, sleep_fn=clock.sleep, rng=MaxRng())
+    delivered_sink_side = [0]
+    for i in range(300):
+        if i % 10 == 0:
+            mgr.begin_flush()
+            mgr.retry_spill()
+
+        def send(timeout):
+            op(None, timeout)
+            delivered_sink_side[0] += 1
+        mgr.deliver(send, 20)
+    assert mgr.conserved()
+    s = mgr.stats()
+    assert s["delivered_payloads"] == delivered_sink_side[0]
+    assert s["delivered_payloads"] > 0 and s["dropped_payloads"] > 0
+    assert s["retries"] > 0
+
+
+def test_policy_from_config_clamps_timeout_to_interval():
+    from veneur_tpu.core.config import Config
+
+    cfg = Config(interval="2s", flush_timeout_s=30.0, sink_retry_max=4,
+                 sink_breaker_threshold=7, sink_spill_max_bytes=1234,
+                 sink_spill_max_payloads=9)
+    pol = DeliveryPolicy.from_config(cfg, cfg.interval_seconds())
+    assert pol.timeout_s == 2.0       # per-attempt <= flush interval
+    assert pol.deadline_s == 2.0
+    assert pol.retry_max == 4 and pol.breaker_threshold == 7
+    assert pol.spill_max_bytes == 1234 and pol.spill_max_payloads == 9

@@ -607,3 +607,146 @@ def test_splunk_factory_plumbs_hec_tuning(tmp_path):
         assert splunk.tls_validate_hostname == "hec.internal"
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# delivery reliability at the sink boundary (sinks/delivery.py wiring)
+
+
+class FlakyNetOpener(FakeOpener):
+    """FakeOpener that refuses connections until healed."""
+
+    def __init__(self):
+        super().__init__()
+        self.fail = True
+        self.calls = 0
+
+    def __call__(self, req, timeout):
+        self.calls += 1
+        if self.fail:
+            raise ConnectionRefusedError(111, "down")
+        return super().__call__(req, timeout)
+
+
+def _fast_manager(name, **policy_kw):
+    from veneur_tpu.sinks.delivery import DeliveryManager, DeliveryPolicy
+
+    policy_kw.setdefault("backoff_base_s", 0.0)
+    policy_kw.setdefault("backoff_max_s", 0.0)
+    policy_kw.setdefault("timeout_s", 1.0)
+    policy_kw.setdefault("deadline_s", 10.0)
+    return DeliveryManager(name, DeliveryPolicy(**policy_kw))
+
+
+def test_datadog_breaker_short_circuits_then_recovers():
+    """Flush sequence against a dead endpoint: exactly one probe per
+    interval while open, spill drains in order on recovery, and every
+    series ultimately reaches the wire (counted at delivery time)."""
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    opener = FlakyNetOpener()
+    sink = DatadogMetricSink(
+        interval=10.0, flush_max_per_body=100, hostname="h", tags=[],
+        dd_hostname="https://dd", api_key="k", opener=opener,
+        delivery=_fast_manager("datadog", retry_max=0,
+                               breaker_threshold=1))
+
+    sink.flush([_metric("a", mtype=MetricType.GAUGE)])
+    assert opener.calls == 1                 # one attempt, no retries
+    assert sink.delivery.breaker.state == "open"
+    assert sink.flushed_metrics == 0 and sink.flush_errors == 1
+
+    sink.flush([_metric("b", mtype=MetricType.GAUGE)])
+    # half-open probe went to the spilled payload (1 call, failed);
+    # the fresh payload short-circuited without touching the network
+    assert opener.calls == 2
+    s = sink.delivery.stats()
+    assert s["breaker_short_circuits"] >= 1
+    assert s["spilled_payloads"] == 2
+
+    opener.fail = False
+    sink.flush([_metric("c", mtype=MetricType.GAUGE)])
+    # probe succeeds, breaker closes, both spilled bodies + fresh drain
+    assert sink.delivery.breaker.state == "closed"
+    assert sink.delivery.stats()["spilled_payloads"] == 0
+    assert sink.flushed_metrics == 3         # a, b, c all delivered late
+    series = [json.loads(r["body"])["series"][0]["metric"]
+              for r in opener.requests]
+    assert series == ["a", "b", "c"]         # spill drains ahead, in order
+    assert sink.delivery.conserved()
+    trans = list(sink.delivery.breaker.transitions)
+    assert "open" in trans and "half_open" in trans and "closed" in trans
+
+
+def test_datadog_retry_clipped_by_flush_deadline():
+    """A worst-case jitter draw that would sleep past the flush tick is
+    abandoned (payload spilled) instead of stalling the emit stage."""
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    from veneur_tpu.sinks.delivery import DeliveryManager, DeliveryPolicy
+
+    class Clock:
+        t = 0.0
+
+        def time(self):
+            return self.t
+
+        def sleep(self, s):
+            self.t += s
+
+    class MaxRng:
+        def uniform(self, a, b):
+            return b
+
+    class AlwaysDown(FakeOpener):
+        calls = 0
+
+        def __call__(self, req, timeout):
+            type(self).calls += 1
+            raise ConnectionResetError(104, "down")
+
+    clock = Clock()
+    mgr = DeliveryManager(
+        "datadog",
+        DeliveryPolicy(retry_max=5, breaker_threshold=0, deadline_s=1.0,
+                       backoff_base_s=10.0, backoff_max_s=10.0),
+        time_fn=clock.time, sleep_fn=clock.sleep, rng=MaxRng())
+    sink = DatadogMetricSink(
+        interval=1.0, flush_max_per_body=100, hostname="h", tags=[],
+        dd_hostname="https://dd", api_key="k", opener=AlwaysDown(),
+        delivery=mgr)
+    sink.flush([_metric("m", mtype=MetricType.GAUGE)])
+    assert AlwaysDown.calls == 1             # no second attempt
+    s = mgr.stats()
+    assert s["deadline_clipped"] == 1 and s["spilled_payloads"] == 1
+    assert clock.t < 1.0                     # never slept past the tick
+    assert mgr.conserved()
+
+
+def test_native_emit_survives_delivery_failure():
+    """Delivery failures must not poison native-emit negotiation: the
+    sink still reports the batch handled (True) and the next flush
+    stays on the native path."""
+    from test_emit_parity import standard_batch
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    from veneur_tpu import native as native_mod
+
+    if not native_mod.emit_available():
+        pytest.skip("native emit library unavailable")
+
+    opener = FlakyNetOpener()
+    sink = DatadogMetricSink(
+        interval=10.0, flush_max_per_body=100, hostname="h", tags=[],
+        dd_hostname="https://dd", api_key="k", opener=opener,
+        delivery=_fast_manager("datadog", retry_max=0,
+                               breaker_threshold=0,
+                               spill_max_bytes=0, spill_max_payloads=0))
+    batch = standard_batch()
+    assert sink.flush_columnar_native(batch) is True   # handled, not raised
+    assert sink.delivery.stats()["dropped_payloads"] >= 1
+    assert sink.delivery.conserved()
+
+    opener.fail = False
+    assert sink.flush_columnar_native(batch) is True   # path not poisoned
+    series_reqs = [r for r in opener.requests
+                   if "/api/v1/series" in r["url"]]
+    assert series_reqs, "healed flush must reach the wire natively"

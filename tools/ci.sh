@@ -64,6 +64,17 @@ echo "== pipelined-flush equality lane (serial == pipelined) =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python -m pytest tests/test_pipeline.py -q -m 'not slow'
 
+# Delivery chaos lane: a pipelined server flushing into HTTP sinks whose
+# openers inject seeded faults (utils/faults.py) — refusals, 5xx, slow
+# responses, mid-body resets, payload rejections, and a deterministic
+# outage window. Gates the delivery layer's three contracts
+# (sinks/delivery.py): exact payload conservation, flush deadlines held
+# under retry pressure, and a full breaker open→half-open→closed cycle.
+# Artifact: FAULT_SOAK.json.
+echo "== delivery chaos lane (seeded fault soak) =="
+timeout -k 10 120 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python tools/soak_faults.py --quick
+
 # Sustained-rate floor: the loadgen harness drives a live server's UDP
 # socket at a fixed offered rate for 5 flush intervals and fails on
 # loss or broken flush cadence. 50k lines/s with the pipelined flush

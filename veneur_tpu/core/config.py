@@ -113,6 +113,28 @@ class Config:
     # the library is absent or a batch uses an uncovered feature. Off
     # forces the Python columnar formatters everywhere.
     flush_emit_native: bool = True
+    # sink delivery reliability (sinks/delivery.py): every network sink
+    # posts through a shared retry/breaker/spill layer.
+    # flush_timeout_s is the per-attempt network timeout (connects and
+    # POSTs — the one knob that replaced the hardcoded 10s openers) and
+    # the unit of the retry deadline math: the whole retry budget for a
+    # flush is clipped to the remaining flush interval, so a sick sink
+    # can never stall the emit stage past its tick.
+    flush_timeout_s: float = 10.0
+    # retries after the first attempt on RETRYABLE failures only
+    # (connect refused/reset, timeouts, HTTP 408/429/5xx; other 4xx are
+    # payload errors and never retry), exponential backoff + full jitter
+    sink_retry_max: int = 2
+    # consecutive delivery failures before a sink's circuit breaker
+    # opens (then: one half-open probe per flush interval until the
+    # endpoint recovers). 0 disables the breaker.
+    sink_breaker_threshold: int = 3
+    # bounded per-sink spill of failed serialized payloads, retried
+    # ahead of fresh data next interval; when EITHER cap is exceeded the
+    # oldest payloads drop with honest delivery.dropped_payloads/_bytes
+    # counters — graceful degradation, never unbounded memory
+    sink_spill_max_bytes: int = 4194304
+    sink_spill_max_payloads: int = 256
     flush_max_per_body: int = 0
     flush_file: str = ""
     omit_empty_hostname: bool = False
@@ -561,6 +583,18 @@ def validate_config(cfg: Config) -> None:
     if cfg.flush_pipeline_backlog < 1:
         raise ValueError("flush_pipeline_backlog must be >= 1 (a stage"
                          " needs at least the in-progress interval)")
+    if cfg.flush_timeout_s <= 0:
+        raise ValueError("flush_timeout_s must be positive (it is the"
+                         " per-attempt network timeout)")
+    if cfg.sink_retry_max < 0:
+        raise ValueError("sink_retry_max must be >= 0 (0 means one"
+                         " attempt, no retries)")
+    if cfg.sink_breaker_threshold < 0:
+        raise ValueError("sink_breaker_threshold must be >= 0"
+                         " (0 disables the circuit breaker)")
+    if cfg.sink_spill_max_bytes < 0 or cfg.sink_spill_max_payloads < 0:
+        raise ValueError("sink spill caps must be >= 0 (0 drops failed"
+                         " payloads instead of spilling them)")
     if cfg.forward_statsd_network not in ("udp", "tcp"):
         raise ValueError("forward_statsd_network must be 'udp' or 'tcp'")
     if cfg.tpu_stage_depth < 1:

@@ -24,10 +24,18 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
     inherited_fds carries listener fds across a zero-downtime re-exec
     (see Server.prepare_handoff).
     """
+    from veneur_tpu.sinks.delivery import DeliveryPolicy
+
     metric_sinks = list(extra_metric_sinks or [])
     span_sinks = list(extra_span_sinks or [])
     interval = cfg.interval_seconds()
+    # one shared delivery policy: every network sink gets its own
+    # DeliveryManager built from it (sinks/delivery.py)
+    policy = DeliveryPolicy.from_config(cfg, interval)
     kw = {"opener": opener} if opener else {}
+    # sinks that have grown the delivery layer take the policy; the
+    # rest (kafka, xray, newrelic, lightstep) keep their own handling
+    dkw = {**kw, "delivery": policy}
 
     hostname = cfg.hostname
     if not hostname and not cfg.omit_empty_hostname:
@@ -50,7 +58,7 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
                 e.metric_prefix: e.tags
                 for e in cfg.datadog_exclude_tags_prefix_by_prefix_metric
             },
-            **kw,
+            **dkw,
         ))
     if cfg.datadog_trace_api_address:
         from veneur_tpu.sinks.datadog import DatadogSpanSink
@@ -58,7 +66,7 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
         span_sinks.append(DatadogSpanSink(
             cfg.datadog_trace_api_address,
             buffer_size=cfg.datadog_span_buffer_size,
-            **kw,
+            **dkw,
         ))
 
     if cfg.signalfx_api_key:
@@ -86,26 +94,28 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
                 else 300.0),
             api_endpoint=(cfg.signalfx_endpoint_api
                           or "https://api.signalfx.com"),
-            **kw,
+            **dkw,
         ))
 
     if cfg.prometheus_repeater_address:
         from veneur_tpu.sinks.prometheus import PrometheusMetricSink
 
         metric_sinks.append(PrometheusMetricSink(
-            cfg.prometheus_repeater_address, cfg.prometheus_network_type))
+            cfg.prometheus_repeater_address, cfg.prometheus_network_type,
+            flush_timeout_s=cfg.flush_timeout_s, delivery=policy))
 
     if cfg.prometheus_pushgateway_address:
         from veneur_tpu.sinks.prometheus import PrometheusExpositionSink
 
         metric_sinks.append(PrometheusExpositionSink(
-            cfg.prometheus_pushgateway_address, **kw))
+            cfg.prometheus_pushgateway_address, **dkw))
 
     if cfg.forward_statsd_address:
         from veneur_tpu.sinks.forward_statsd import ForwardStatsdSink
 
         metric_sinks.append(ForwardStatsdSink(
-            cfg.forward_statsd_address, cfg.forward_statsd_network))
+            cfg.forward_statsd_address, cfg.forward_statsd_network,
+            flush_timeout_s=cfg.flush_timeout_s, delivery=policy))
 
     if cfg.newrelic_insert_key and cfg.newrelic_account_id:
         from veneur_tpu.sinks.newrelic import NewRelicMetricSink
@@ -190,7 +200,7 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
                 parse_duration(cfg.splunk_hec_connection_lifetime_jitter)
                 if cfg.splunk_hec_connection_lifetime_jitter else 30.0),
             tls_validate_hostname=cfg.splunk_hec_tls_validate_hostname,
-            **kw,
+            **dkw,
         ))
 
     if cfg.xray_address:

@@ -110,6 +110,9 @@ class FlushPipeline:
         # pipeline's throughput bound, fed to _adapt_spill_caps in
         # place of the serial flush duration
         self.last_cycle_s = 0.0
+        # times the delivery layer reported a sink persistently behind
+        # (server delivery reporting via note_downstream_behind)
+        self.downstream_behind = 0
 
     def start(self) -> None:
         if self._threads:
@@ -239,6 +242,17 @@ class FlushPipeline:
                 self.last_cycle_s = max(job.stage_s.values())
             self._idle.notify_all()
 
+    def note_downstream_behind(self) -> None:
+        """Delivery layer signal (server._flush_emit): a sink has been
+        behind — open breaker or spill deferrals — for
+        DELIVERY_BEHIND_INTERVALS consecutive flushes. Treated like a
+        persistent stage backlog: kick the standing shedding loop so
+        the overload is attacked at the parse boundary instead of
+        accumulating in sink spills."""
+        with self._lock:
+            self.downstream_behind += 1
+        self._server._pipeline_overrun()
+
     # -- lifecycle ---------------------------------------------------------
 
     def drain(self, timeout: float | None = None) -> bool:
@@ -274,4 +288,5 @@ class FlushPipeline:
                 "shed": dict(self.shed),
                 "last_cycle_s": self.last_cycle_s,
                 "max_backlog": self.max_backlog,
+                "downstream_behind": self.downstream_behind,
             }

@@ -32,6 +32,7 @@ from veneur_tpu.core.spans import MetricExtractionSink, SpanWorker
 from veneur_tpu.core.worker import DeviceWorker, FlushSnapshot
 from veneur_tpu.protocol import dogstatsd, ssf_wire
 from veneur_tpu.sinks import (
+    DELIVERY_STAT_COUNTERS,
     MetricSink,
     SpanSink,
     filter_routed,
@@ -268,6 +269,11 @@ class Server:
         self._ctr_local = threading.local()
         self._errors_reported = 0
         self._span_sink_reported: dict[tuple[str, str], int] = {}
+        # delivery.* interval-delta bookkeeping + the consecutive
+        # behind-interval count gating the downstream-behind signal
+        # (health/policy.py delivery_should_signal_behind)
+        self._delivery_reported: dict[tuple[str, str], int] = {}
+        self._delivery_behind_consec = 0
 
         # scoped self-telemetry statsd client (reference server.go:298-308
         # builds a datadog-go client with namespace "veneur." wrapped by
@@ -440,6 +446,25 @@ class Server:
         }
         if self.flush_pipeline is not None:
             out["pipeline"] = self.flush_pipeline.stats()
+        delivery = {rname: man.stats()
+                    for rname, man in self._delivery_managers()}
+        if delivery:
+            out["delivery"] = delivery
+        return out
+
+    def _delivery_managers(self):
+        """(report name, DeliveryManager) for every sink that carries
+        one; span sinks report under <name>_spans so a metric/span sink
+        pair sharing a vendor name stays distinguishable."""
+        out = []
+        for sink in self.metric_sinks:
+            man = getattr(sink, "delivery", None)
+            if man is not None:
+                out.append((sink.name(), man))
+        for sink in self.span_sinks:
+            man = getattr(sink, "delivery", None)
+            if man is not None:
+                out.append((sink.name() + "_spans", man))
         return out
 
     @property
@@ -1759,6 +1784,44 @@ class Server:
                 self._span_sink_reported[key] = total
                 if delta:
                     self.stats.count(metric, delta, tags=tags)
+        # delivery-reliability telemetry (sinks/delivery.py): every
+        # manager's cumulative counters as interval deltas, breaker and
+        # spill occupancy as gauges. A sink behind — breaker not closed
+        # or fresh spill deferrals — for DELIVERY_BEHIND_INTERVALS
+        # consecutive flushes feeds the pipeline's downstream-behind
+        # shed signal; serial servers skip the signal (their emit stage
+        # already backpressures the tick, and shedding ingest for a
+        # dead backend would drop data the other sinks still take).
+        behind = False
+        for rname, man in self._delivery_managers():
+            dstats = man.stats()
+            tags = [f"sink:{rname}"]
+            for key in DELIVERY_STAT_COUNTERS:
+                total = dstats[key]
+                rkey = (rname, key)
+                delta = total - self._delivery_reported.get(rkey, 0)
+                self._delivery_reported[rkey] = total
+                if delta:
+                    self.stats.count(f"delivery.{key}", delta, tags=tags)
+                    if key == "deferred_payloads":
+                        behind = True
+            self.stats.gauge("delivery.circuit_state",
+                             float(dstats["circuit_state_code"]), tags=tags)
+            self.stats.gauge("delivery.spilled_payloads",
+                             float(dstats["spilled_payloads"]), tags=tags)
+            self.stats.gauge("delivery.spilled_bytes",
+                             float(dstats["spilled_bytes"]), tags=tags)
+            if dstats["circuit_state"] != "closed":
+                behind = True
+        self._delivery_behind_consec = (
+            self._delivery_behind_consec + 1 if behind else 0)
+        from veneur_tpu.health.policy import delivery_should_signal_behind
+
+        if (self.flush_pipeline is not None
+                and delivery_should_signal_behind(
+                    self._delivery_behind_consec)):
+            self.stats.count("flush.delivery_behind_total", 1)
+            self.flush_pipeline.note_downstream_behind()
         # runtime gauges (analog of the Go runtime stats, flusher.go:32-47;
         # gc.number is cumulative completed collections, mem.rss_bytes is
         # CURRENT resident set from /proc — not the misleading peak)

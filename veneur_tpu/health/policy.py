@@ -53,6 +53,27 @@ STALL_MULTIPLIER = 4
 MAX_STAGE_BACKLOG = 1
 
 
+# Delivery-behind gating (core/server.py delivery reporting): a sink
+# whose circuit breaker is not closed, or that deferred payloads to its
+# spill, for this many CONSECUTIVE flush intervals counts the backend
+# as behind and feeds the pipeline's downstream-behind shed signal. One
+# interval is deliberately not enough — a single transient 503 ends as
+# a successful retry, and shedding ingest for it would trade data the
+# backend will take for data it never sees (the same ≥2-consecutive
+# gating the pipeline applies to deferred ticks).
+DELIVERY_BEHIND_INTERVALS = 2
+
+
+def delivery_should_signal_behind(
+        consecutive_behind: int,
+        threshold: int = DELIVERY_BEHIND_INTERVALS) -> bool:
+    """True once a sink's delivery has been behind (open/half-open
+    breaker or fresh spill deferrals) for `threshold` consecutive flush
+    intervals — the gate between per-sink delivery stats and the
+    pipeline's downstream-behind overload response."""
+    return consecutive_behind >= max(1, int(threshold))
+
+
 def pipeline_should_shed(queue_depth: int,
                          max_backlog: int = MAX_STAGE_BACKLOG) -> bool:
     """The backpressure contract for the stage-parallel flush executor:

@@ -18,6 +18,18 @@ METRIC_KEY_TOTAL_SPANS_DROPPED = "sink.spans_dropped_total"
 METRIC_KEY_TOTAL_METRICS_FLUSHED = "sink.metrics_flushed_total"
 METRIC_KEY_TOTAL_METRICS_SKIPPED = "sink.metrics_skipped_total"
 
+# Canonical delivery-reliability counters (sinks/delivery.py): every
+# network sink exposes one DeliveryManager whose cumulative stats()
+# carry these keys; the server reports them as interval deltas under
+# "delivery.<key>" tagged sink:<name>, so one dashboard query covers
+# every sink. circuit_state_code (0 closed / 1 half-open / 2 open) and
+# the spill occupancy are point-in-time gauges, not deltas.
+DELIVERY_STAT_COUNTERS = (
+    "delivered_payloads", "dropped_payloads", "dropped_bytes",
+    "retries", "deferred_payloads", "deadline_clipped",
+    "breaker_short_circuits",
+)
+
 
 class MetricSink(abc.ABC):
     """A destination for flushed metrics (reference sinks/sinks.go:32-47)."""

@@ -20,8 +20,9 @@ from typing import Optional
 from veneur_tpu.core.metrics import InterMetric, MetricType
 from veneur_tpu.protocol import dogstatsd as ddproto
 from veneur_tpu.sinks import MetricSink, SpanSink
+from veneur_tpu.sinks.delivery import make_manager
 from veneur_tpu.ssf import SSFSample, SSFSpan
-from veneur_tpu.utils.http import default_opener, post_json
+from veneur_tpu.utils.http import default_opener, json_body, post_bytes
 
 log = logging.getLogger("veneur_tpu.sinks.datadog")
 
@@ -41,6 +42,7 @@ class DatadogMetricSink(MetricSink):
         exclude_tags_prefix_by_prefix_metric: Optional[dict] = None,
         excluded_tags: Optional[list[str]] = None,
         opener=default_opener,
+        delivery=None,
     ) -> None:
         self.interval = interval
         self.flush_max_per_body = flush_max_per_body or 25000
@@ -53,6 +55,7 @@ class DatadogMetricSink(MetricSink):
             exclude_tags_prefix_by_prefix_metric or {})
         self.excluded_tags = list(excluded_tags or [])
         self.opener = opener
+        self.delivery = make_manager("datadog", delivery)
         self.flushed_metrics = 0
         self.flush_errors = 0
         # host tags are immutable per process: serialize them for the
@@ -252,9 +255,24 @@ class DatadogMetricSink(MetricSink):
         dd_metrics, checks = self._finalize(metrics)
         self._post_all(dd_metrics, checks)
 
+    def _deliver(self, url: str, body: bytes, headers: dict,
+                 count: int, what: str) -> None:
+        """Hand one serialized body to the delivery layer; the sink's
+        own flushed counter advances inside the send closure so a
+        spilled body delivered a later interval still counts."""
+        def send(timeout: float) -> None:
+            post_bytes(url, body, headers, timeout, self.opener)
+            self.flushed_metrics += count
+
+        if self.delivery.deliver(send, len(body)) != "delivered":
+            self.flush_errors += 1
+            log.warning("datadog %s post not delivered this flush", what)
+
     def _post_all(self, dd_metrics: list[dict], checks: list[dict],
                   raw_bodies: Optional[list[bytes]] = None,
                   raw_count: int = 0, precompressed: bool = False) -> None:
+        self.delivery.begin_flush()
+        self.delivery.retry_spill()
         threads = []
         if raw_bodies:
             # bodies are chunked at flush_max_per_body, so every body but
@@ -276,14 +294,11 @@ class DatadogMetricSink(MetricSink):
             t.start()
             threads.append(t)
         for check in checks:
-            try:
-                post_json(
-                    f"{self.dd_hostname}/api/v1/check_run"
-                    f"?api_key={self.api_key}",
-                    check, opener=self.opener)
-            except Exception as e:
-                self.flush_errors += 1
-                log.warning("datadog check_run post failed: %s", e)
+            body, hdrs = json_body(check)
+            self._deliver(
+                f"{self.dd_hostname}/api/v1/check_run"
+                f"?api_key={self.api_key}",
+                body, hdrs, 0, "check_run")
         for t in threads:
             t.join(timeout=30)
 
@@ -293,32 +308,20 @@ class DatadogMetricSink(MetricSink):
         emitter's output), deflate-compressed like post_json does —
         already compressed GIL-free by the native tier when
         ``precompressed``."""
-        import urllib.request
         import zlib as _zlib
 
-        try:
-            req = urllib.request.Request(
-                f"{self.dd_hostname}/api/v1/series?api_key={self.api_key}",
-                data=body if precompressed else _zlib.compress(body),
-                method="POST",
-                headers={"Content-Type": "application/json",
-                         "Content-Encoding": "deflate"},
-            )
-            self.opener(req, 10.0)
-            self.flushed_metrics += count
-        except Exception as e:
-            self.flush_errors += 1
-            log.warning("datadog series post failed: %s", e)
+        self._deliver(
+            f"{self.dd_hostname}/api/v1/series?api_key={self.api_key}",
+            body if precompressed else _zlib.compress(body),
+            {"Content-Type": "application/json",
+             "Content-Encoding": "deflate"},
+            count, "series")
 
     def _post_series(self, chunk: list[dict]) -> None:
-        try:
-            post_json(
-                f"{self.dd_hostname}/api/v1/series?api_key={self.api_key}",
-                {"series": chunk}, compress=True, opener=self.opener)
-            self.flushed_metrics += len(chunk)
-        except Exception as e:
-            self.flush_errors += 1
-            log.warning("datadog series post failed: %s", e)
+        body, hdrs = json_body({"series": chunk}, compress=True)
+        self._deliver(
+            f"{self.dd_hostname}/api/v1/series?api_key={self.api_key}",
+            body, hdrs, len(chunk), "series")
 
     # -- events (reference FlushOtherSamples :162-253) ----------------------
 
@@ -356,13 +359,9 @@ class DatadogMetricSink(MetricSink):
             events.append(event)
         if not events:
             return
-        try:
-            post_json(
-                f"{self.dd_hostname}/intake?api_key={self.api_key}",
-                {"events": {"api": events}}, opener=self.opener)
-        except Exception as e:
-            self.flush_errors += 1
-            log.warning("datadog event post failed: %s", e)
+        body, hdrs = json_body({"events": {"api": events}})
+        self._deliver(f"{self.dd_hostname}/intake?api_key={self.api_key}",
+                      body, hdrs, 0, "event")
 
 
 class DatadogSpanSink(SpanSink):
@@ -371,12 +370,13 @@ class DatadogSpanSink(SpanSink):
 
     def __init__(self, trace_api_address: str,
                  buffer_size: int = DEFAULT_SPAN_BUFFER_SIZE,
-                 opener=default_opener) -> None:
+                 opener=default_opener, delivery=None) -> None:
         self.trace_api_address = trace_api_address.rstrip("/")
         self.buffer: "collections.deque[SSFSpan]" = collections.deque(
             maxlen=buffer_size)
         self._lock = threading.Lock()
         self.opener = opener
+        self.delivery = make_manager("datadog_spans", delivery)
         self.spans_flushed = 0
         self.flush_errors = 0
 
@@ -407,11 +407,15 @@ class DatadogSpanSink(SpanSink):
                 "error": 1 if s.error else 0,
                 "meta": dict(s.tags),
             })
-        try:
-            post_json(
-                f"{self.trace_api_address}/v0.3/traces",
-                list(traces.values()), opener=self.opener)
+        self.delivery.begin_flush()
+        self.delivery.retry_spill()
+        body, hdrs = json_body(list(traces.values()))
+
+        def send(timeout: float) -> None:
+            post_bytes(f"{self.trace_api_address}/v0.3/traces",
+                       body, hdrs, timeout, self.opener)
             self.spans_flushed += len(spans)
-        except Exception as e:
+
+        if self.delivery.deliver(send, len(body)) != "delivered":
             self.flush_errors += 1
-            log.warning("datadog trace post failed: %s", e)
+            log.warning("datadog trace post not delivered this flush")

@@ -1,0 +1,409 @@
+"""Shared sink delivery-reliability layer: retry, breaker, bounded spill.
+
+The reference treats backend flakiness as the normal case — its sinks
+carry retry-with-backoff (sinks/splunk resend-once on a stale
+keep-alive) and lifecycle-jittered reconnects; our HTTP sinks handled
+every delivery failure with a single log-and-drop, so one hung endpoint
+ate a third of the flush deadline and one transient 503 silently lost a
+whole interval of a sink's series. This module centralises bounded
+delivery for every network sink:
+
+1. Bounded retry with exponential backoff + FULL jitter
+   (delay ~ U[0, min(max, base*2^attempt)]), on retryable failures only:
+   connect refused/reset, timeouts, and HTTP 408/429/5xx. Other 4xx are
+   payload errors — a retry resends the same rejected bytes, so they
+   drop immediately with honest counters.
+2. The whole retry budget is clipped to the remaining flush-interval
+   deadline (armed per flush by begin_flush): a sick sink can never
+   stall the emit stage past its tick. A payload that runs out of
+   deadline is SPILLED, not lost.
+3. A per-sink circuit breaker: closed → open after N consecutive
+   delivery failures → half-open with a single probe per flush interval
+   → closed on probe success. A dead endpoint costs one cheap probe per
+   interval instead of serial connect timeouts.
+4. A bounded per-sink spill of failed *serialized* payloads (send
+   closures over already-built wire bytes), capped by bytes AND payload
+   count, oldest dropped first with `dropped_payloads`/`dropped_bytes`
+   counters. Spilled payloads are retried AHEAD of fresh data on the
+   next flush (retry_spill) — graceful degradation, never unbounded
+   memory.
+
+Accounting contract (the chaos soak's conservation invariant,
+tools/soak_faults.py):
+
+    accepted_payloads == delivered_payloads + dropped_payloads
+                         + spilled_payloads (still queued)
+
+holds exactly at any quiescent point: every payload handed to deliver()
+is eventually delivered, declared dropped, or sitting in the bounded
+spill. Nothing is silently lost.
+
+The clock, sleep, and jitter RNG are injectable so the breaker state
+machine and deadline math are unit-testable deterministically
+(tests/test_delivery.py) and the fault soak is seedable.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+log = logging.getLogger("veneur_tpu.sinks.delivery")
+
+# breaker states (circuit_state_code gauge: dashboards want a number)
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+# HTTP statuses worth retrying: timeout, throttle, and server-side
+# errors. Every other 4xx means the payload itself was rejected.
+RETRYABLE_STATUSES = frozenset({408, 429})
+
+
+def retryable(exc: BaseException) -> bool:
+    """Transient-vs-permanent failure classification.
+
+    Retryable: connection-level failures (refused, reset, broken pipe,
+    DNS/socket OSErrors), timeouts, and HTTP 408/429/5xx. NOT
+    retryable: other HTTP 4xx (the payload is bad; resending the same
+    bytes re-fails) and non-network exceptions (serializer bugs must
+    surface, not loop)."""
+    from veneur_tpu.utils.http import HTTPError
+
+    if isinstance(exc, HTTPError):
+        return exc.status in RETRYABLE_STATUSES or exc.status >= 500
+    if isinstance(exc, (TimeoutError, ConnectionError)):
+        # socket.timeout is TimeoutError; ConnectionRefusedError /
+        # ConnectionResetError / BrokenPipeError are ConnectionError
+        return True
+    if isinstance(exc, OSError):
+        return True
+    try:
+        import urllib.error
+
+        if isinstance(exc, urllib.error.URLError):
+            return True
+    except ImportError:  # pragma: no cover
+        pass
+    return False
+
+
+@dataclass
+class DeliveryPolicy:
+    """Per-sink delivery knobs (config: sink_retry_max,
+    sink_breaker_threshold, sink_spill_max_bytes/_payloads,
+    flush_timeout_s; deadline_s defaults to the flush interval)."""
+
+    retry_max: int = 2            # retries after the first attempt
+    breaker_threshold: int = 3    # consecutive failures to open; 0 = off
+    spill_max_bytes: int = 4 << 20
+    spill_max_payloads: int = 256
+    timeout_s: float = 10.0       # per-attempt network timeout
+    deadline_s: float = 10.0      # per-flush delivery budget
+    backoff_base_s: float = 0.25
+    backoff_max_s: float = 5.0
+
+    @classmethod
+    def from_config(cls, cfg, interval_s: float) -> "DeliveryPolicy":
+        # the per-attempt timeout can't usefully exceed the per-flush
+        # budget; the budget is the flush interval (the emit stage joins
+        # sink threads at exactly that horizon)
+        return cls(
+            retry_max=cfg.sink_retry_max,
+            breaker_threshold=cfg.sink_breaker_threshold,
+            spill_max_bytes=cfg.sink_spill_max_bytes,
+            spill_max_payloads=cfg.sink_spill_max_payloads,
+            timeout_s=min(cfg.flush_timeout_s, interval_s),
+            deadline_s=interval_s,
+        )
+
+
+class CircuitBreaker:
+    """closed → open after `threshold` consecutive failures → half-open
+    single-probe per interval → closed on probe success.
+
+    begin_interval() is the interval edge: an open breaker arms exactly
+    one probe credit. allow() consumes the credit in half-open; every
+    other caller short-circuits until the probe verdict. Transitions
+    are recorded (bounded) so the chaos soak can assert a full
+    open→half_open→closed cycle. Not thread-safe by itself — the
+    owning DeliveryManager serialises access under its lock."""
+
+    TRANSITION_LOG_MAX = 64
+
+    def __init__(self, threshold: int) -> None:
+        self.threshold = max(0, int(threshold))
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.opened_total = 0
+        self._probe_armed = False
+        self.transitions: collections.deque[str] = collections.deque(
+            maxlen=self.TRANSITION_LOG_MAX)
+
+    def _to(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            self.transitions.append(state)
+            if state == OPEN:
+                self.opened_total += 1
+
+    def begin_interval(self) -> None:
+        if self.state == OPEN:
+            self._probe_armed = True
+            self._to(HALF_OPEN)
+
+    def can_attempt(self) -> bool:
+        """Non-consuming peek (retry_spill uses it to leave the spill
+        untouched when nothing could be sent anyway)."""
+        if self.threshold == 0 or self.state == CLOSED:
+            return True
+        return self.state == HALF_OPEN and self._probe_armed
+
+    def allow(self) -> bool:
+        if self.threshold == 0 or self.state == CLOSED:
+            return True
+        if self.state == HALF_OPEN and self._probe_armed:
+            self._probe_armed = False  # the single probe
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.threshold and self.state != CLOSED:
+            self._to(CLOSED)
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if not self.threshold:
+            return
+        if self.state == HALF_OPEN:
+            self._to(OPEN)  # probe failed: re-open until next interval
+        elif (self.state == CLOSED
+              and self.consecutive_failures >= self.threshold):
+            self._to(OPEN)
+
+
+@dataclass
+class _SpillEntry:
+    send: Callable[[float], None]  # one attempt over serialized bytes
+    nbytes: int
+
+
+class SpillBuffer:
+    """Bounded FIFO of failed serialized payloads; oldest dropped first
+    when either cap is exceeded. push() returns the evicted entries so
+    the manager can count them as dropped — drops are declared, never
+    silent."""
+
+    def __init__(self, max_bytes: int, max_payloads: int) -> None:
+        self.max_bytes = max(0, int(max_bytes))
+        self.max_payloads = max(0, int(max_payloads))
+        self._q: collections.deque[_SpillEntry] = collections.deque()
+        self.bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, entry: _SpillEntry) -> list[_SpillEntry]:
+        self._q.append(entry)
+        self.bytes += entry.nbytes
+        evicted: list[_SpillEntry] = []
+        while self._q and (len(self._q) > self.max_payloads
+                           or self.bytes > self.max_bytes):
+            old = self._q.popleft()
+            self.bytes -= old.nbytes
+            evicted.append(old)
+        return evicted
+
+    def pop_all(self) -> list[_SpillEntry]:
+        out = list(self._q)
+        self._q.clear()
+        self.bytes = 0
+        return out
+
+
+class DeliveryManager:
+    """One per network sink: owns the breaker, the spill, and the
+    retry/deadline math. Thread-safe (sinks post payloads from parallel
+    threads); network sends run outside the lock.
+
+    deliver(send, nbytes) drives one payload to a terminal outcome for
+    this flush: "delivered", "dropped" (permanent — payload error or
+    spill eviction), or "deferred" (spilled for the next interval).
+    Sinks fold their own success counters inside the send closure so a
+    spilled payload delivered two intervals later still counts."""
+
+    def __init__(self, name: str,
+                 policy: Optional[DeliveryPolicy] = None,
+                 time_fn: Callable[[], float] = time.monotonic,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 rng: Optional[random.Random] = None) -> None:
+        self.sink_name = name
+        self.policy = policy or DeliveryPolicy()
+        self._time = time_fn
+        self._sleep = sleep_fn
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self.breaker = CircuitBreaker(self.policy.breaker_threshold)
+        self.spill = SpillBuffer(self.policy.spill_max_bytes,
+                                 self.policy.spill_max_payloads)
+        self._deadline: Optional[float] = None
+        # cumulative counters (server reports interval deltas)
+        self.accepted_payloads = 0
+        self.delivered_payloads = 0
+        self.dropped_payloads = 0
+        self.dropped_bytes = 0
+        self.retries = 0
+        self.deferred_payloads = 0   # deferral EVENTS (a payload may defer
+        self.deadline_clipped = 0    # across several intervals)
+        self.breaker_short_circuits = 0
+
+    # -- flush-edge hooks ---------------------------------------------------
+
+    def begin_flush(self, deadline_s: Optional[float] = None) -> None:
+        """Arm this flush's delivery deadline and advance the breaker
+        interval (an open breaker gets its single half-open probe).
+        Sinks call this once at the top of their flush funnel."""
+        with self._lock:
+            self._deadline = self._time() + (
+                self.policy.deadline_s if deadline_s is None
+                else float(deadline_s))
+            self.breaker.begin_interval()
+
+    def retry_spill(self) -> int:
+        """Re-deliver spilled payloads AHEAD of fresh data; returns how
+        many reached the wire. Skipped outright when the breaker can't
+        admit anything — the spill stays put instead of churning."""
+        with self._lock:
+            if not len(self.spill) or not self.breaker.can_attempt():
+                return 0
+            entries = self.spill.pop_all()
+        delivered = 0
+        for e in entries:
+            if self._deliver_entry(e) == "delivered":
+                delivered += 1
+        return delivered
+
+    # -- the payload path ---------------------------------------------------
+
+    def deliver(self, send: Callable[[float], None], nbytes: int) -> str:
+        """Drive one fresh serialized payload; see class docstring for
+        the outcome contract. `send(timeout_s)` performs exactly one
+        network attempt and raises on failure."""
+        with self._lock:
+            self.accepted_payloads += 1
+        return self._deliver_entry(_SpillEntry(send, int(nbytes)))
+
+    def _deliver_entry(self, entry: _SpillEntry) -> str:
+        with self._lock:
+            if not self.breaker.allow():
+                self.breaker_short_circuits += 1
+                return self._spill_locked(entry)
+            # the deadline armed by begin_flush, if still live; a
+            # standalone delivery (events posted outside the flush
+            # funnel) gets a fresh full budget without disturbing it
+            now = self._time()
+            deadline = self._deadline
+            if deadline is None or deadline <= now:
+                deadline = now + self.policy.deadline_s
+        attempt = 0
+        while True:
+            now = self._time()
+            remaining = deadline - now
+            if remaining <= 0:
+                with self._lock:
+                    self.deadline_clipped += 1
+                    return self._spill_locked(entry)
+            try:
+                entry.send(min(self.policy.timeout_s, remaining))
+            except Exception as e:  # noqa: BLE001 — classified below
+                transient = retryable(e)
+                with self._lock:
+                    self.breaker.record_failure()
+                    if not transient:
+                        self.dropped_payloads += 1
+                        self.dropped_bytes += entry.nbytes
+                        log.warning(
+                            "sink %s: permanent delivery failure, payload "
+                            "dropped (%d bytes): %s", self.sink_name,
+                            entry.nbytes, e)
+                        return "dropped"
+                    if (attempt >= self.policy.retry_max
+                            or not self.breaker.can_attempt()):
+                        return self._spill_locked(entry)
+                # full jitter: U[0, min(max, base * 2^attempt)]
+                delay = self._rng.uniform(0.0, min(
+                    self.policy.backoff_max_s,
+                    self.policy.backoff_base_s * (2 ** attempt)))
+                if self._time() + delay >= deadline:
+                    with self._lock:
+                        self.deadline_clipped += 1
+                        return self._spill_locked(entry)
+                attempt += 1
+                with self._lock:
+                    self.retries += 1
+                if delay > 0:
+                    self._sleep(delay)
+            else:
+                with self._lock:
+                    self.breaker.record_success()
+                    self.delivered_payloads += 1
+                return "delivered"
+
+    def _spill_locked(self, entry: _SpillEntry) -> str:
+        """Queue a payload for the next interval (caller holds _lock);
+        evictions — including the entry itself when the caps are 0 —
+        are declared dropped."""
+        self.deferred_payloads += 1
+        dropped_self = False
+        for old in self.spill.push(entry):
+            self.dropped_payloads += 1
+            self.dropped_bytes += old.nbytes
+            dropped_self = dropped_self or old is entry
+        if dropped_self:
+            # never made it into the spill: the deferral became a drop
+            return "dropped"
+        return "deferred"
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Cumulative counters + point-in-time breaker/spill state; the
+        canonical delivery.* names (sinks/__init__.py
+        DELIVERY_STAT_COUNTERS) every sink shares."""
+        with self._lock:
+            return {
+                "accepted_payloads": self.accepted_payloads,
+                "delivered_payloads": self.delivered_payloads,
+                "dropped_payloads": self.dropped_payloads,
+                "dropped_bytes": self.dropped_bytes,
+                "retries": self.retries,
+                "deferred_payloads": self.deferred_payloads,
+                "deadline_clipped": self.deadline_clipped,
+                "breaker_short_circuits": self.breaker_short_circuits,
+                "breaker_opened_total": self.breaker.opened_total,
+                "circuit_state": self.breaker.state,
+                "circuit_state_code": STATE_CODES[self.breaker.state],
+                "breaker_transitions": list(self.breaker.transitions),
+                "spilled_payloads": len(self.spill),
+                "spilled_bytes": self.spill.bytes,
+            }
+
+    def conserved(self) -> bool:
+        """The exact-conservation invariant (see module docstring)."""
+        with self._lock:
+            return (self.accepted_payloads
+                    == self.delivered_payloads + self.dropped_payloads
+                    + len(self.spill))
+
+
+def make_manager(name: str, delivery) -> DeliveryManager:
+    """Sink-ctor helper: accept a DeliveryPolicy (factory path), a
+    ready DeliveryManager (tests inject clocks/RNGs), or None."""
+    if isinstance(delivery, DeliveryManager):
+        return delivery
+    return DeliveryManager(name, delivery)

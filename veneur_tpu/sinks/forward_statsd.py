@@ -19,6 +19,7 @@ from typing import Optional
 
 from veneur_tpu.core.metrics import InterMetric, MetricType
 from veneur_tpu.sinks import MetricSink
+from veneur_tpu.sinks.delivery import make_manager
 
 log = logging.getLogger("veneur_tpu.sinks.forward_statsd")
 
@@ -35,26 +36,29 @@ class ForwardStatsdSink(MetricSink):
     supports_columnar = True
     supports_native_emit = True
 
-    def __init__(self, address: str, network_type: str = "udp") -> None:
+    def __init__(self, address: str, network_type: str = "udp",
+                 flush_timeout_s: float = 10.0, delivery=None) -> None:
         host, _, port = address.rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
         self.network_type = network_type
+        self.flush_timeout_s = flush_timeout_s
         self._sock: Optional[socket.socket] = None
+        self.delivery = make_manager("forward_statsd", delivery)
         self.flushed_metrics = 0
         self.flush_errors = 0
 
     def name(self) -> str:
         return "forward_statsd"
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, timeout: Optional[float] = None) -> socket.socket:
         if self._sock is None:
             if self.network_type == "udp":
                 self._sock = socket.socket(socket.AF_INET,
                                            socket.SOCK_DGRAM)
                 self._sock.connect(self.address)
             else:
-                self._sock = socket.create_connection(self.address,
-                                                      timeout=10)
+                self._sock = socket.create_connection(
+                    self.address, timeout=timeout or self.flush_timeout_s)
         return self._sock
 
     @staticmethod
@@ -142,31 +146,42 @@ class ForwardStatsdSink(MetricSink):
     def _send(self, lines: list[bytes]) -> None:
         if not lines:
             return
+        self.delivery.begin_flush()
+        self.delivery.retry_spill()
         sent_lines = sum(e.count(b"\n") + 1 for e in lines)
-        try:
-            sock = self._connect()
-            if self.network_type == "udp":
-                # entries may be multi-line blobs (native emitter);
-                # repack into datagram-sized, line-aligned chunks
-                for entry in lines:
-                    if len(entry) <= self.UDP_DATAGRAM_BYTES:
-                        sock.send(entry)
-                        continue
-                    start = 0
-                    n = len(entry)
-                    while start < n:
-                        end = min(start + self.UDP_DATAGRAM_BYTES, n)
-                        if end < n:
-                            nl = entry.rfind(b"\n", start, end)
-                            if nl > start:
-                                end = nl
-                        sock.send(entry[start:end])
-                        start = end + (1 if end < n and
-                                       entry[end:end + 1] == b"\n" else 0)
-            else:
-                sock.sendall(b"\n".join(lines) + b"\n")
-            self.flushed_metrics += sent_lines
-        except OSError as e:
+
+        def send(timeout: float) -> None:
+            try:
+                sock = self._connect(timeout)
+                if self.network_type == "udp":
+                    # entries may be multi-line blobs (native emitter);
+                    # repack into datagram-sized, line-aligned chunks
+                    for entry in lines:
+                        if len(entry) <= self.UDP_DATAGRAM_BYTES:
+                            sock.send(entry)
+                            continue
+                        start = 0
+                        n = len(entry)
+                        while start < n:
+                            end = min(start + self.UDP_DATAGRAM_BYTES, n)
+                            if end < n:
+                                nl = entry.rfind(b"\n", start, end)
+                                if nl > start:
+                                    end = nl
+                            sock.send(entry[start:end])
+                            start = end + (1 if end < n and
+                                           entry[end:end + 1] == b"\n"
+                                           else 0)
+                else:
+                    sock.settimeout(timeout)
+                    sock.sendall(b"\n".join(lines) + b"\n")
+                self.flushed_metrics += sent_lines
+            except OSError:
+                # stale socket: force a fresh connect on the next attempt
+                self._sock = None
+                raise
+
+        if self.delivery.deliver(send, sum(len(e) for e in lines)) \
+                != "delivered":
             self.flush_errors += 1
-            self._sock = None
-            log.warning("forward statsd send failed: %s", e)
+            log.warning("forward statsd send not delivered this flush")

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 import grpc
@@ -27,17 +28,29 @@ SEND_SPAN = f"/{SERVICE_NAME}/SendSpan"
 
 
 class GRPCSpanSink(SpanSink):
-    """Sends each span as one protobuf RPC to a remote span service."""
+    """Sends each span as one protobuf RPC to a remote span service.
+
+    A failing endpoint backs the sink off linearly (the
+    trace/client.py reconnect discipline: delay = backoff_s * failures,
+    capped at max_backoff_s): spans arriving inside the backoff window
+    are dropped cheaply instead of each eating a full RPC timeout."""
 
     def __init__(self, target: str, name: str = "grpc",
-                 timeout_s: float = 9.0) -> None:
+                 timeout_s: float = 9.0, backoff_s: float = 0.2,
+                 max_backoff_s: float = 5.0) -> None:
         self._name = name
         self.target = target
         self.timeout_s = timeout_s
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
         self.channel: Optional[grpc.Channel] = None
         self._call = None
         self.spans_flushed = 0
         self.spans_dropped = 0
+        self.backoff_dropped = 0
+        self.reconnects = 0
+        self._failures = 0
+        self._down_until = 0.0
         self._state_lock = threading.Lock()
         self.last_state: str = "IDLE"
 
@@ -63,10 +76,24 @@ class GRPCSpanSink(SpanSink):
         if self._call is None:
             self.spans_dropped += 1
             return
+        now = time.monotonic()
+        with self._state_lock:
+            if now < self._down_until:
+                self.backoff_dropped += 1
+                self.spans_dropped += 1
+                return
         try:
             self._call(ssf_wire.span_to_pb(span), timeout=self.timeout_s)
+            with self._state_lock:
+                if self._failures:
+                    self.reconnects += 1
+                    self._failures = 0
             self.spans_flushed += 1
         except grpc.RpcError as e:
+            with self._state_lock:
+                self._failures += 1
+                self._down_until = time.monotonic() + min(
+                    self.backoff_s * self._failures, self.max_backoff_s)
             self.spans_dropped += 1
             log.debug("span send to %s failed: %s", self.target, e.code())
 

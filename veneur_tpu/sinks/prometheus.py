@@ -15,6 +15,7 @@ from typing import Optional
 
 from veneur_tpu.core.metrics import InterMetric, MetricType
 from veneur_tpu.sinks import MetricSink
+from veneur_tpu.sinks.delivery import make_manager
 
 log = logging.getLogger("veneur_tpu.sinks.prometheus")
 
@@ -71,26 +72,28 @@ def expo_sample(name: str, tags: list[str], value: float,
 class PrometheusMetricSink(MetricSink):
     supports_columnar = True
 
-    def __init__(self, repeater_address: str, network_type: str = "tcp"
-                 ) -> None:
+    def __init__(self, repeater_address: str, network_type: str = "tcp",
+                 flush_timeout_s: float = 10.0, delivery=None) -> None:
         host, _, port = repeater_address.rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
         self.network_type = network_type
+        self.flush_timeout_s = flush_timeout_s
         self._sock: Optional[socket.socket] = None
+        self.delivery = make_manager("prometheus", delivery)
         self.flushed_metrics = 0
         self.flush_errors = 0
 
     def name(self) -> str:
         return "prometheus"
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, timeout: Optional[float] = None) -> socket.socket:
         if self._sock is None:
             if self.network_type == "udp":
                 self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
                 self._sock.connect(self.address)
             else:
-                self._sock = socket.create_connection(self.address,
-                                                      timeout=10)
+                self._sock = socket.create_connection(
+                    self.address, timeout=timeout or self.flush_timeout_s)
         return self._sock
 
     def _statsd_line(self, m: InterMetric) -> Optional[bytes]:
@@ -208,34 +211,45 @@ class PrometheusMetricSink(MetricSink):
     def _send(self, lines: list[bytes]) -> None:
         if not lines:
             return
+        self.delivery.begin_flush()
+        self.delivery.retry_spill()
         sent_lines = sum(e.count(b"\n") + 1 for e in lines)
-        try:
-            sock = self._connect()
-            if self.network_type == "udp":
-                # entries may be multi-line blobs (native emitter);
-                # repack into datagram-sized, line-aligned chunks
-                for entry in lines:
-                    if len(entry) <= self.UDP_DATAGRAM_BYTES:
-                        sock.send(entry)
-                        continue
-                    start = 0
-                    n = len(entry)
-                    while start < n:
-                        end = min(start + self.UDP_DATAGRAM_BYTES, n)
-                        if end < n:
-                            nl = entry.rfind(b"\n", start, end)
-                            if nl > start:
-                                end = nl
-                        sock.send(entry[start:end])
-                        start = end + (1 if end < n and
-                                       entry[end:end + 1] == b"\n" else 0)
-            else:
-                sock.sendall(b"\n".join(lines) + b"\n")
-            self.flushed_metrics += sent_lines
-        except OSError as e:
+
+        def send(timeout: float) -> None:
+            try:
+                sock = self._connect(timeout)
+                if self.network_type == "udp":
+                    # entries may be multi-line blobs (native emitter);
+                    # repack into datagram-sized, line-aligned chunks
+                    for entry in lines:
+                        if len(entry) <= self.UDP_DATAGRAM_BYTES:
+                            sock.send(entry)
+                            continue
+                        start = 0
+                        n = len(entry)
+                        while start < n:
+                            end = min(start + self.UDP_DATAGRAM_BYTES, n)
+                            if end < n:
+                                nl = entry.rfind(b"\n", start, end)
+                                if nl > start:
+                                    end = nl
+                            sock.send(entry[start:end])
+                            start = end + (1 if end < n and
+                                           entry[end:end + 1] == b"\n"
+                                           else 0)
+                else:
+                    sock.settimeout(timeout)
+                    sock.sendall(b"\n".join(lines) + b"\n")
+                self.flushed_metrics += sent_lines
+            except OSError:
+                # stale socket: force a fresh connect on the next attempt
+                self._sock = None
+                raise
+
+        if self.delivery.deliver(send, sum(len(e) for e in lines)) \
+                != "delivered":
             self.flush_errors += 1
-            self._sock = None
-            log.warning("prometheus repeater send failed: %s", e)
+            log.warning("prometheus repeater send not delivered this flush")
 
 
 class PrometheusExpositionSink(MetricSink):
@@ -251,11 +265,12 @@ class PrometheusExpositionSink(MetricSink):
     supports_columnar = True
     supports_native_emit = True
 
-    def __init__(self, address: str, opener=None) -> None:
+    def __init__(self, address: str, opener=None, delivery=None) -> None:
         from veneur_tpu.utils.http import default_opener
 
         self.address = address
         self.opener = opener or default_opener
+        self.delivery = make_manager("prometheus", delivery)
         self.flushed_metrics = 0
         self.flush_errors = 0
 
@@ -332,16 +347,20 @@ class PrometheusExpositionSink(MetricSink):
         return True
 
     def _post(self, body: bytes, count: int) -> None:
-        import urllib.request
+        from veneur_tpu.utils.http import post_bytes
 
+        self.delivery.begin_flush()
+        self.delivery.retry_spill()
         if not count:
             return
-        try:
-            req = urllib.request.Request(
-                self.address, data=body, method="POST",
-                headers={"Content-Type": "text/plain; version=0.0.4"})
-            self.opener(req, 10.0)
+
+        def send(timeout: float) -> None:
+            post_bytes(self.address, body,
+                       {"Content-Type": "text/plain; version=0.0.4"},
+                       timeout, self.opener)
             self.flushed_metrics += count
-        except Exception as e:
+
+        if self.delivery.deliver(send, len(body)) != "delivered":
             self.flush_errors += 1
-            log.warning("prometheus exposition post failed: %s", e)
+            log.warning("prometheus exposition post not delivered "
+                        "this flush")

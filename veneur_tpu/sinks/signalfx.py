@@ -18,8 +18,9 @@ from typing import Optional
 from veneur_tpu.core.metrics import InterMetric, MetricType
 from veneur_tpu.protocol import dogstatsd as ddproto
 from veneur_tpu.sinks import MetricSink
+from veneur_tpu.sinks.delivery import make_manager
 from veneur_tpu.ssf import SSFSample
-from veneur_tpu.utils.http import default_opener, post_json
+from veneur_tpu.utils.http import default_opener, json_body, post_bytes
 
 log = logging.getLogger("veneur_tpu.sinks.signalfx")
 
@@ -40,6 +41,7 @@ class SignalFxMetricSink(MetricSink):
         dynamic_key_refresh_period_s: float = 300.0,
         api_endpoint: str = "https://api.signalfx.com",
         opener=default_opener,
+        delivery=None,
     ) -> None:
         self.api_key = api_key
         self.hostname = hostname
@@ -59,6 +61,7 @@ class SignalFxMetricSink(MetricSink):
         self.dynamic_key_refresh_period_s = dynamic_key_refresh_period_s
         self.api_endpoint = api_endpoint.rstrip("/")
         self.opener = opener
+        self.delivery = make_manager("signalfx", delivery)
         self.flushed_metrics = 0
         self.flush_errors = 0
         self.key_refreshes = 0
@@ -269,8 +272,20 @@ class SignalFxMetricSink(MetricSink):
                 bucket[kind].append(point)
         self._post_buckets(by_key)
 
+    def _deliver(self, url: str, body: bytes, headers: dict,
+                 count: int, what: str) -> None:
+        def send(timeout: float) -> None:
+            post_bytes(url, body, headers, timeout, self.opener)
+            self.flushed_metrics += count
+
+        if self.delivery.deliver(send, len(body)) != "delivered":
+            self.flush_errors += 1
+            log.warning("signalfx %s post not delivered this flush", what)
+
     def _post_buckets(self, by_key: dict[str, dict[str, list]],
                       raw_bodies=None) -> None:
+        self.delivery.begin_flush()
+        self.delivery.retry_spill()
         threads = []
         for body, count in raw_bodies or ():
             t = threading.Thread(
@@ -288,30 +303,17 @@ class SignalFxMetricSink(MetricSink):
             t.join(timeout=30)
 
     def _post(self, api_key: str, body: dict) -> None:
-        try:
-            post_json(
-                f"{self.endpoint_base}/v2/datapoint", body,
-                headers={"X-SF-Token": api_key}, opener=self.opener)
-            self.flushed_metrics += sum(len(v) for v in body.values())
-        except Exception as e:
-            self.flush_errors += 1
-            log.warning("signalfx datapoint post failed: %s", e)
+        count = sum(len(v) for v in body.values())
+        raw, hdrs = json_body(body, headers={"X-SF-Token": api_key})
+        self._deliver(f"{self.endpoint_base}/v2/datapoint", raw, hdrs,
+                      count, "datapoint")
 
     def _post_raw(self, api_key: str, body: bytes, count: int) -> None:
         """POST one pre-built JSON body (the native emitter's output)."""
-        import urllib.request
-
-        try:
-            req = urllib.request.Request(
-                f"{self.endpoint_base}/v2/datapoint", data=body,
-                method="POST",
-                headers={"Content-Type": "application/json",
-                         "X-SF-Token": api_key})
-            self.opener(req, 10.0)
-            self.flushed_metrics += count
-        except Exception as e:
-            self.flush_errors += 1
-            log.warning("signalfx datapoint post failed: %s", e)
+        self._deliver(
+            f"{self.endpoint_base}/v2/datapoint", body,
+            {"Content-Type": "application/json", "X-SF-Token": api_key},
+            count, "datapoint")
 
     def flush_other_samples(self, samples: list[SSFSample]) -> None:
         events = []
@@ -331,10 +333,6 @@ class SignalFxMetricSink(MetricSink):
             })
         if not events:
             return
-        try:
-            post_json(
-                f"{self.endpoint_base}/v2/event", events,
-                headers={"X-SF-Token": self.api_key}, opener=self.opener)
-        except Exception as e:
-            self.flush_errors += 1
-            log.warning("signalfx event post failed: %s", e)
+        body, hdrs = json_body(events, headers={"X-SF-Token": self.api_key})
+        self._deliver(f"{self.endpoint_base}/v2/event", body, hdrs,
+                      0, "event")

@@ -23,8 +23,12 @@ import time
 import urllib.parse
 from typing import Optional
 
+from dataclasses import replace
+
 from veneur_tpu.sinks import SpanSink
+from veneur_tpu.sinks.delivery import DeliveryPolicy, make_manager
 from veneur_tpu.ssf import SSFSpan
+from veneur_tpu.utils.http import HTTPError, post_bytes
 
 log = logging.getLogger("veneur_tpu.sinks.splunk")
 
@@ -128,6 +132,7 @@ class SplunkSpanSink(SpanSink):
         connection_lifetime_jitter_s: float = 30.0,
         tls_validate_hostname: str = "",
         opener=None,
+        delivery=None,
     ) -> None:
         self.url = hec_address.rstrip("/") + "/services/collector/event"
         self.token = token
@@ -140,6 +145,15 @@ class SplunkSpanSink(SpanSink):
         self.connection_lifetime_jitter_s = connection_lifetime_jitter_s
         self.tls_validate_hostname = tls_validate_hostname
         self.opener = opener  # test injection; None = rotating sessions
+        if isinstance(delivery, DeliveryPolicy):
+            # resending a HEC batch the server may already have indexed
+            # would duplicate events (the response-path rule in
+            # _RotatingSession.post), so retry and spill are forced off:
+            # the delivery layer contributes the breaker and the shared
+            # delivery.* stats only
+            delivery = replace(delivery, retry_max=0,
+                               spill_max_bytes=0, spill_max_payloads=0)
+        self.delivery = make_manager("splunk", delivery)
         self.queue: "queue.Queue" = queue.Queue(maxsize=batch_size * 16)
         self.spans_flushed = 0
         self.spans_dropped = 0
@@ -250,25 +264,29 @@ class SplunkSpanSink(SpanSink):
             "Authorization": f"Splunk {self.token}",
             "Content-Type": "application/json",
         }
-        try:
-            # HEC accepts newline-concatenated JSON events; a JSON array
-            # body carries the same content for our purposes
+        # HEC accepts newline-concatenated JSON events; a JSON array
+        # body carries the same content for our purposes
+        body = json.dumps(events).encode("utf-8")
+        self.delivery.begin_flush()
+
+        def send(timeout: float) -> None:
             if self.opener is not None:
-                import urllib.request
-
-                from veneur_tpu.utils.http import post_json
-
-                post_json(self.url, events, headers=headers,
-                          timeout=self.send_timeout_s, opener=self.opener)
+                post_bytes(self.url, body, headers, timeout, self.opener)
             else:
-                status, body = session.post(
-                    json.dumps(events).encode("utf-8"), headers)
+                status, rbody = session.post(body, headers)
                 if status >= 400:
-                    raise RuntimeError(f"HEC status {status}: {body[:200]!r}")
+                    # typed so the delivery layer classifies it: 5xx/429
+                    # count against the breaker, other 4xx are permanent
+                    raise HTTPError(status, rbody)
             self.spans_flushed += len(batch)
-        except Exception as e:
+
+        if self.delivery.deliver(send, len(body)) != "delivered":
+            # retry/spill are off here (duplication risk): any
+            # non-delivered batch is gone, and says so
             self.flush_errors += 1
-            log.warning("splunk HEC post failed: %s", e)
+            self.spans_dropped += len(batch)
+            log.warning("splunk HEC post failed; %d spans dropped",
+                        len(batch))
 
     def flush(self) -> None:
         pass  # submission is continuous; flush is a no-op like the reference

@@ -1,0 +1,189 @@
+"""Deterministic seeded fault injection for sink delivery paths.
+
+FaultyOpener wraps the injectable `opener` every HTTP sink takes and
+FaultySocket stands in for the statsd-repeater sockets; both consult a
+seeded FaultPlan so every unit test and the chaos soak
+(tools/soak_faults.py) replays the exact same failure sequence for a
+given seed. Injected faults mirror the real failure modes the delivery
+layer (sinks/delivery.py) classifies:
+
+- refusal            → ConnectionRefusedError (retryable)
+- HTTP 5xx           → utils.http.HTTPError(status) (retryable)
+- slow response      → sleeps; past the caller's timeout it raises
+                       TimeoutError (retryable, eats deadline budget)
+- mid-body reset     → ConnectionResetError after a partial-write delay
+                       (retryable)
+- payload rejection  → HTTPError(400) (PERMANENT: never retried)
+- flap schedules     → down_ranges of call indices that hard-refuse,
+                       bracketed so breaker open→half-open→closed
+                       cycles are reproducible on demand
+
+Decisions are drawn from one random.Random(seed) under a lock: the
+aggregate fault sequence is deterministic; which concurrent payload
+lands on which decision depends on thread interleaving, which is fine —
+the invariants the harness drives (conservation, deadline, breaker
+cycle) are interleaving-independent.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from veneur_tpu.utils.http import HTTPError
+
+FAULT_KINDS = ("refused", "http_5xx", "slow", "reset", "rejected", "passed")
+
+
+@dataclass
+class FaultPlan:
+    """Probabilities are evaluated in the order refuse → 5xx → slow →
+    reset → reject (cumulative thresholds over one uniform draw);
+    down_ranges override everything for their call-index window."""
+
+    seed: int = 0
+    p_refuse: float = 0.0
+    p_5xx: float = 0.0
+    p_slow: float = 0.0
+    p_reset: float = 0.0
+    p_reject: float = 0.0
+    slow_s: float = 0.2
+    reset_after_s: float = 0.01   # partial body went out, then RST
+    status_5xx: int = 503
+    # [(start, end)) call-index windows that hard-refuse: a deterministic
+    # outage → recovery edge, the breaker-cycle driver
+    down_ranges: list[tuple[int, int]] = field(default_factory=list)
+
+    def total_p(self) -> float:
+        return (self.p_refuse + self.p_5xx + self.p_slow + self.p_reset
+                + self.p_reject)
+
+
+class _FaultBase:
+    def __init__(self, plan: FaultPlan,
+                 sleep_fn: Callable[[float], None] = time.sleep) -> None:
+        self.plan = plan
+        self._sleep = sleep_fn
+        self._lock = threading.Lock()
+        import random
+
+        self._rng = random.Random(plan.seed)
+        self.calls = 0
+        self.injected = {k: 0 for k in FAULT_KINDS}
+
+    def _decide(self) -> str:
+        with self._lock:
+            idx = self.calls
+            self.calls += 1
+            for start, end in self.plan.down_ranges:
+                if start <= idx < end:
+                    self.injected["refused"] += 1
+                    return "refused"
+            r = self._rng.random()
+            p = self.plan
+            edge = p.p_refuse
+            kind = "passed"
+            if r < edge:
+                kind = "refused"
+            elif r < (edge := edge + p.p_5xx):
+                kind = "http_5xx"
+            elif r < (edge := edge + p.p_slow):
+                kind = "slow"
+            elif r < (edge := edge + p.p_reset):
+                kind = "reset"
+            elif r < edge + p.p_reject:
+                kind = "rejected"
+            self.injected[kind] += 1
+            return kind
+
+    def _raise_for(self, kind: str, timeout: float) -> None:
+        """Apply one non-pass decision (caller handles 'passed' /
+        'slow'-then-success itself)."""
+        if kind == "refused":
+            raise ConnectionRefusedError(111, "injected: connection refused")
+        if kind == "http_5xx":
+            raise HTTPError(self.plan.status_5xx, b"injected 5xx")
+        if kind == "reset":
+            self._sleep(min(self.plan.reset_after_s, timeout))
+            raise ConnectionResetError(104, "injected: mid-body reset")
+        if kind == "rejected":
+            raise HTTPError(400, b"injected payload rejection")
+        raise AssertionError(kind)
+
+
+class FaultyOpener(_FaultBase):
+    """Drop-in for utils.http openers: (request, timeout) -> body.
+    `inner` is the real opener to delegate clean calls to; None
+    swallows them (the soak's discarding backend)."""
+
+    def __init__(self, plan: FaultPlan, inner: Optional[Callable] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep) -> None:
+        super().__init__(plan, sleep_fn)
+        self.inner = inner
+
+    def __call__(self, req, timeout: float) -> bytes:
+        kind = self._decide()
+        if kind == "slow":
+            if self.plan.slow_s >= timeout:
+                # slower than the caller's budget: a real socket would
+                # time out after exactly `timeout`
+                self._sleep(timeout)
+                raise TimeoutError("injected: response slower than timeout")
+            self._sleep(self.plan.slow_s)
+        elif kind != "passed":
+            self._raise_for(kind, timeout)
+        if self.inner is not None:
+            return self.inner(req, timeout)
+        return b"{}"
+
+
+class FaultySocket(_FaultBase):
+    """Stands in for the repeater sinks' socket (sink._sock): send and
+    sendall consult the plan; clean traffic is forwarded to `inner` or
+    discarded. Socket-level faults surface as OSErrors, like the real
+    thing."""
+
+    def __init__(self, plan: FaultPlan, inner=None,
+                 sleep_fn: Callable[[float], None] = time.sleep) -> None:
+        super().__init__(plan, sleep_fn)
+        self.inner = inner
+        self._timeout = 10.0
+
+    def settimeout(self, timeout) -> None:
+        if timeout is not None:
+            self._timeout = float(timeout)
+        if self.inner is not None:
+            self.inner.settimeout(timeout)
+
+    def _maybe_fail(self) -> None:
+        kind = self._decide()
+        if kind == "passed":
+            return
+        if kind == "slow":
+            if self.plan.slow_s >= self._timeout:
+                self._sleep(self._timeout)
+                raise TimeoutError("injected: send slower than timeout")
+            self._sleep(self.plan.slow_s)
+            return
+        if kind in ("http_5xx", "rejected"):
+            # no HTTP semantics on a raw socket: both degrade to a
+            # connection reset (still counted under their own kind)
+            raise ConnectionResetError(104, f"injected: {kind}")
+        self._raise_for(kind, self._timeout)
+
+    def send(self, data: bytes) -> int:
+        self._maybe_fail()
+        if self.inner is not None:
+            return self.inner.send(data)
+        return len(data)
+
+    def sendall(self, data: bytes) -> None:
+        self._maybe_fail()
+        if self.inner is not None:
+            self.inner.sendall(data)
+
+    def close(self) -> None:
+        if self.inner is not None:
+            self.inner.close()

@@ -23,6 +23,13 @@ class HTTPError(Exception):
         self.status = status
         self.body = body
 
+    @property
+    def retryable(self) -> bool:
+        """Timeout/throttle/server-side statuses are worth resending;
+        any other 4xx rejected the payload itself (the delivery layer,
+        sinks/delivery.py, drops those instead of looping)."""
+        return self.status in (408, 429) or self.status >= 500
+
 
 def default_opener(req: urllib.request.Request, timeout: float) -> bytes:
     try:
@@ -35,6 +42,32 @@ def default_opener(req: urllib.request.Request, timeout: float) -> bytes:
 Opener = Callable[[urllib.request.Request, float], bytes]
 
 
+def json_body(obj, headers: Optional[dict[str, str]] = None,
+              compress: bool = False) -> tuple[bytes, dict[str, str]]:
+    """Serialize a JSON POST once: (body bytes, headers). The delivery
+    layer (sinks/delivery.py) spills failed payloads as serialized
+    bytes, so sinks build the body up front and retries resend the
+    identical bytes."""
+    body = json.dumps(obj).encode("utf-8")
+    hdrs = {"Content-Type": "application/json"}
+    if compress:
+        body = zlib.compress(body)
+        hdrs["Content-Encoding"] = "deflate"
+    if headers:
+        hdrs.update(headers)
+    return body, hdrs
+
+
+def post_bytes(url: str, body: bytes, headers: dict[str, str],
+               timeout: float = 10.0,
+               opener: Opener = default_opener) -> bytes:
+    """One POST attempt of a pre-serialized body (no retry here — that
+    is the delivery layer's job)."""
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers=headers)
+    return opener(req, timeout)
+
+
 def post_json(
     url: str,
     obj,
@@ -43,15 +76,8 @@ def post_json(
     compress: bool = False,
     opener: Opener = default_opener,
 ) -> bytes:
-    body = json.dumps(obj).encode("utf-8")
-    hdrs = {"Content-Type": "application/json"}
-    if compress:
-        body = zlib.compress(body)
-        hdrs["Content-Encoding"] = "deflate"
-    if headers:
-        hdrs.update(headers)
-    req = urllib.request.Request(url, data=body, method="POST", headers=hdrs)
-    return opener(req, timeout)
+    body, hdrs = json_body(obj, headers, compress)
+    return post_bytes(url, body, hdrs, timeout, opener)
 
 
 def thread_stack_dump() -> bytes:
