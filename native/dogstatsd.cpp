@@ -403,6 +403,12 @@ struct Ctx {
     std::vector<float> vals;     // [rows * depth]
     std::vector<float> wts;      // [rows * depth]
     std::vector<int32_t> count;  // [rows]
+    // micro-fold watermark: slots [drained[r], count[r]) are staged but
+    // not yet copied out by vn_stage_drain_delta. `count` itself is
+    // never rewound by a drain — the per-epoch depth cap (and hence the
+    // spill partitioning) is identical whether or not micro-folds ran.
+    std::vector<int32_t> drained;  // [rows], lazily sized
+    long long drained_total = 0;
   };
   int stage_depth = 0;  // 0 = staging disabled (legacy SoA only)
   StagePlane* stage = nullptr;
@@ -1366,6 +1372,47 @@ long long vn_stage_total(void* p) {
   Ctx* ctx = static_cast<Ctx*>(p);
   std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
   return ctx->stage == nullptr ? 0 : ctx->stage->total;
+}
+
+// Staged samples not yet copied out by vn_stage_drain_delta
+// (micro-fold due-threshold checks).
+long long vn_stage_pending(void* p) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
+  Ctx::StagePlane* sp = ctx->stage;
+  return sp == nullptr ? 0 : sp->total - sp->drained_total;
+}
+
+// Copy up to `cap` not-yet-drained staged samples into the caller's COO
+// buffers as (row, absolute slot, val, wt) and advance the per-row
+// drained watermark. `count` is untouched: the depth cap — and hence
+// which samples spill to the SoA batch — is identical to a run with no
+// micro-folds, which is what makes micro==batch bit-identity hold.
+// Returns the number of entries written.
+int64_t vn_stage_drain_delta(void* p, int32_t* rows, int32_t* slots,
+                             float* vals, float* wts, int64_t cap) {
+  Ctx* ctx = static_cast<Ctx*>(p);
+  std::lock_guard<std::recursive_mutex> ctx_guard(ctx->mu);
+  Ctx::StagePlane* sp = ctx->stage;
+  if (sp == nullptr || sp->total == sp->drained_total || cap <= 0) return 0;
+  if (static_cast<int32_t>(sp->drained.size()) < sp->rows)
+    sp->drained.resize(sp->rows, 0);
+  int64_t n = 0;
+  for (int32_t r = 0; r < sp->rows && n < cap; ++r) {
+    int32_t d = sp->drained[r];
+    const int32_t c = sp->count[r];
+    if (d >= c) continue;
+    const size_t base = static_cast<size_t>(r) * sp->depth;
+    for (; d < c && n < cap; ++d, ++n) {
+      rows[n] = r;
+      slots[n] = d;
+      vals[n] = sp->vals[base + d];
+      wts[n] = sp->wts[base + d];
+    }
+    sp->drained_total += d - sp->drained[r];
+    sp->drained[r] = d;
+  }
+  return n;
 }
 
 // Switch the set-element hash to metro64(seed=1337) for Go-fleet interop

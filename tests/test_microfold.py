@@ -1,0 +1,377 @@
+"""Always-hot flush: micro-fold parity, transfer accounting, swap fence.
+
+The micro-fold path's contract is BIT-identity: a flush must produce
+byte-for-byte the same snapshot whether the staged epoch was folded once
+at the deadline or streamed to the device mirror across any number of
+sub-interval micro-folds (ops/microfold.py builds the mirror so the
+deadline fold consumes literally the same dense array either way).
+Pinned here for all three metric classes — t-digest planes, HLL/set
+registers, scalar planes — across >= 3 flush intervals with >= 4
+micro-folds per interval, on both the python staging plane and the
+native (C++) one, plus:
+
+- transfer-ledger equality: N micro-folds of the same stream cost the
+  same H2D bytes (+-0) as a single drain, independent of stage depth —
+  O(samples), never O(micro_folds x depth);
+- the epoch-swap fence: a swap landing between (or racing) micro-folds
+  loses no rows and double-folds none;
+- the loadgen controller's warmup/steady-state split (classify_warmup),
+  which keeps a first-interval XLA compile from being judged as a
+  cadence failure of the pipeline.
+
+CI runs this file twice — default (micro-folds on) and with
+VENEUR_MICRO_FOLD=0 (tools/ci.sh) — mirroring the emit-parity lane: the
+worker-level tests pin the mechanism explicitly, the server-level test
+honors the env overlay, so the second pass proves the escape hatch
+really disengages the path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import Config, load_config
+from veneur_tpu.core.flusher import device_quantiles
+from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+from veneur_tpu.core.worker import DeviceWorker
+from veneur_tpu.health.ledger import TransferLedger
+from veneur_tpu.loadgen.controller import classify_warmup
+from veneur_tpu.protocol.dogstatsd import parse_metric
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+PCTS = [0.5, 0.9, 0.99]
+QS = device_quantiles(PCTS, AGGS)
+
+INTERVALS = 3
+MIN_FOLDS_PER_INTERVAL = 4
+
+
+def _assert_snapshots_identical(a, b, path):
+    """Bitwise snapshot equality: every numpy field of the two
+    FlushSnapshots compares as raw bytes (stricter than array_equal —
+    distinguishes NaN payloads and signed zeros), and the generated
+    InterMetric streams (which cover the host-side scalars, names and
+    tags) compare exactly."""
+    import dataclasses
+
+    from veneur_tpu.core.flusher import generate_inter_metrics
+
+    for f in dataclasses.fields(a):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            assert va is not None and vb is not None, (path, f.name)
+            assert va.dtype == vb.dtype and va.shape == vb.shape, (
+                path, f.name, va.dtype, vb.dtype, va.shape, vb.shape)
+            assert va.tobytes() == vb.tobytes(), (path, f.name, va, vb)
+        elif isinstance(va, (int, float)) or va is None:
+            assert va == vb, (path, f.name, va, vb)
+    ma = generate_inter_metrics(a, True, PCTS, AGGS, now=1000)
+    mb = generate_inter_metrics(b, True, PCTS, AGGS, now=1000)
+    key = lambda m: (m.name, m.type, tuple(m.tags))  # noqa: E731
+    da = {key(m): m.value for m in ma}
+    db = {key(m): m.value for m in mb}
+    assert da == db, (path, {k: (da.get(k), db.get(k))
+                             for k in set(da) ^ set(db) or
+                             {k for k in da if da[k] != db.get(k)}})
+
+
+def _drive_worker(micro: bool, use_native: bool, *, fold_every: int = 2,
+                  intervals: int = INTERVALS):
+    """Ingest a deterministic mixed workload (t-digest timers, HLL sets,
+    scalar counters/gauges) for `intervals` flush intervals, micro-
+    folding every `fold_every` batches; return (snapshots, worker,
+    folds-per-interval). batch_size is small so the python staging
+    plane fills mid-interval; thresholds stay under the stage depth so
+    no nondeterministic spill folds run."""
+    w = DeviceWorker(compression=100, stage_depth=64, batch_size=6,
+                     micro_fold=micro, micro_fold_rows=1,
+                     micro_fold_max_age_s=1e9)
+    if use_native:
+        if not w.attach_native():
+            pytest.skip("native ingest library unavailable")
+    rng = np.random.default_rng(7)
+    snaps, folds = [], []
+    for _ in range(intervals):
+        for batch in range(8):
+            lines = []
+            for i in range(6):
+                lines.append(f"h{i}:{rng.normal():.6f}|ms|#a:b")
+                lines.append(f"c{i}:1.5|c")
+                lines.append(f"g{i}:{rng.normal():.6f}|g")
+                lines.append(f"s{i}:{rng.integers(100)}|s")
+            if use_native:
+                w.ingest_datagram("\n".join(lines).encode())
+            else:
+                for ln in lines:
+                    w.process_metric(parse_metric(ln.encode()))
+            if micro and batch % fold_every == 0 and w.micro_fold_due():
+                w.micro_fold_once()
+        folds.append(w.micro_folds_epoch)
+        snaps.append(w.flush(QS))
+    return snaps, w, folds
+
+
+@pytest.mark.parametrize("use_native", [False, True],
+                         ids=["python-plane", "native-plane"])
+def test_micro_fold_bit_identical_to_batch_fold(use_native):
+    base, _, _ = _drive_worker(False, use_native)
+    micro, w, folds = _drive_worker(True, use_native)
+    assert len(folds) >= INTERVALS
+    assert all(f >= MIN_FOLDS_PER_INTERVAL for f in folds), folds
+    assert w.micro_folds_total == sum(folds)
+    for n, (a, b) in enumerate(zip(base, micro)):
+        _assert_snapshots_identical(a, b, f"interval{n}")
+
+
+@pytest.mark.parametrize("use_native", [False, True],
+                         ids=["python-plane", "native-plane"])
+def test_swap_mid_micro_fold_no_loss_no_double(use_native):
+    """The fence, deterministically: folds land at different batch
+    offsets (including right before the swap with residual staged rows
+    outstanding), so every interval's swap runs with a partially
+    mirrored plane. Identity must hold for every partition."""
+    base, _, _ = _drive_worker(False, use_native)
+    for fold_every in (1, 3, 7):
+        micro, _, folds = _drive_worker(True, use_native,
+                                        fold_every=fold_every)
+        assert all(f >= 1 for f in folds), (fold_every, folds)
+        for n, (a, b) in enumerate(zip(base, micro)):
+            _assert_snapshots_identical(a, b, f"every{fold_every}.interval{n}")
+
+
+def test_swap_racing_micro_folds_conserves_samples():
+    """Threaded smoke of the swap fence: a scheduler thread micro-folds
+    while the main thread flushes mid-stream. Lost rows would show up
+    as a short histogram count; double-folded rows as a long one (and
+    as an inflated counter total)."""
+    w = DeviceWorker(compression=100, stage_depth=256, batch_size=4,
+                     micro_fold=True, micro_fold_rows=1,
+                     micro_fold_max_age_s=1e9)
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def scheduler():
+        while not stop.is_set():
+            with lock:
+                if w.micro_fold_due():
+                    w.micro_fold_once()
+            time.sleep(0.001)
+
+    t = threading.Thread(target=scheduler, daemon=True)
+    t.start()
+    total = 0
+    counts = []
+    try:
+        for burst in range(6):
+            for i in range(200):
+                with lock:
+                    w.process_metric(parse_metric(b"race.t:%d|ms" % i))
+                    w.process_metric(parse_metric(b"race.c:1|c"))
+                total += 1
+            with lock:
+                swapped = w.swap(QS)
+            snap = w.extract_snapshot(swapped, QS)
+            counts.append(snap)
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    from veneur_tpu.core.flusher import generate_inter_metrics
+
+    got_histo = 0.0
+    got_counter = 0.0
+    for snap in counts:
+        by_key = {(m.name, m.type): m.value
+                  for m in generate_inter_metrics(snap, True, PCTS, AGGS,
+                                                  now=1000)}
+        got_histo += by_key.get(("race.t.count", MetricType.COUNTER), 0.0)
+        got_counter += by_key.get(("race.c", MetricType.COUNTER), 0.0)
+    assert got_histo == float(total)
+    assert got_counter == float(total)
+
+
+# -- transfer-ledger accounting -------------------------------------------
+
+
+def _micro_ledger_bytes(fold_every: int, depth: int) -> tuple[int, dict]:
+    w = DeviceWorker(compression=100, stage_depth=depth, batch_size=6,
+                     micro_fold=True, micro_fold_rows=1,
+                     micro_fold_max_age_s=1e9)
+    if not w.attach_native():
+        pytest.skip("native ingest library unavailable")
+    rng = np.random.default_rng(3)
+    for batch in range(12):
+        lines = [f"h{i}:{rng.normal():.6f}|ms" for i in range(6)]
+        w.ingest_datagram("\n".join(lines).encode())
+        if batch % fold_every == 0 and w.micro_fold_due():
+            w.micro_fold_once()
+    w.flush(QS)
+    h2d = dict(w.ledger.flush_h2d())
+    return h2d.get("micro_fold", 0), h2d
+
+
+def test_ledger_micro_fold_bytes_partition_invariant():
+    """N micro-folds of the same staged stream book exactly the bytes
+    of a single final drain: uploads go out in fixed padded chunks, the
+    remainder carries host-side across drains (+-0, not approximately)."""
+    ref, _ = _micro_ledger_bytes(12, 64)  # one drain (all at swap)
+    assert ref > 0
+    for fold_every in (1, 3):
+        got, _ = _micro_ledger_bytes(fold_every, 64)
+        assert got == ref, (fold_every, got, ref)
+
+
+def test_ledger_micro_fold_bytes_independent_of_depth():
+    """O(samples), never O(micro_folds x depth): COO entries price the
+    samples, not the plane shape they land in."""
+    totals = {d: _micro_ledger_bytes(1, d)[0] for d in (16, 64, 128)}
+    assert len(set(totals.values())) == 1, totals
+    # 72 samples -> one padded MICRO_CHUNK of 16-byte COO entries
+    from veneur_tpu.ops.microfold import MICRO_CHUNK
+
+    assert totals[64] == 16 * MICRO_CHUNK
+
+
+def test_ledger_epoch_window_attribution():
+    """Micro-fold bytes accumulate against the EPOCH being staged and
+    surface in the flush window that extracts it, not the window that
+    happens to be open when the fold runs."""
+    led = TransferLedger()
+    led.count_epoch_h2d(100, "micro_fold")
+    led.roll_epoch()                     # swap closes the epoch
+    led.begin_flush()                    # its extraction opens a window
+    assert led.flush_h2d() == {"micro_fold": 100}
+    led.begin_flush()                    # next window: nothing pending
+    assert led.flush_h2d() == {}
+    assert led.total_h2d_bytes == 100
+
+
+# -- config / engagement ---------------------------------------------------
+
+
+def test_env_escape_hatch_disables_micro_fold():
+    assert load_config(data={}, env={}).micro_fold is True
+    cfg = load_config(data={}, env={"VENEUR_MICRO_FOLD": "0"})
+    assert cfg.micro_fold is False
+
+
+def test_worker_micro_fold_inert_when_disabled():
+    w = DeviceWorker(stage_depth=64, micro_fold=False)
+    w.process_metric(parse_metric(b"off.t:1|ms"))
+    assert not w.micro_fold_due()
+    assert w.micro_fold_once() == 0
+    assert w.micro_folds_total == 0
+
+
+def test_server_flush_parity_with_scheduler(tmp_path):
+    """Server-level parity under the real micro-fold scheduler thread:
+    identical ingest into a micro-fold server (config via load_config,
+    so the CI lane's VENEUR_MICRO_FOLD=0 pass exercises the disabled
+    path here) and an explicitly-off server must flush equal metrics,
+    whenever the scheduler happened to drain."""
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.channel import ChannelMetricSink
+
+    base = dict(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                num_workers=1, num_readers=1, interval="10s",
+                percentiles=PCTS, micro_fold_rows=1,
+                micro_fold_max_age_s=0.02)
+
+    def boot(cfg):
+        sink = ChannelMetricSink()
+        srv = Server(cfg, metric_sinks=[sink])
+        srv.start()
+        # small pending batches so the python staging plane fills (and
+        # micro-folds engage) at test-sized sample counts
+        for w in srv.workers:
+            w.batch_size = 8
+        return srv
+
+    on = boot(load_config(data=dict(base)))
+    off = boot(Config(micro_fold=False, **base))
+    try:
+        rng = np.random.default_rng(11)
+        lines = []
+        for i in range(40):
+            lines.append(f"sv.h{i % 5}:{rng.normal():.6f}|ms")
+            lines.append(f"sv.c{i % 5}:2|c")
+            lines.append(f"sv.s{i % 5}:{rng.integers(50)}|s")
+        for srv in (on, off):
+            w = srv.workers[0]
+            for ln in lines:
+                # native-attached workers stage through the C++ plane
+                # (the one micro-folds source from); python-only rigs
+                # exercise the python plane
+                with srv._worker_locks[0]:
+                    if w._native is not None:
+                        w.ingest_datagram(ln.encode())
+                    else:
+                        w.process_metric(parse_metric(ln.encode()))
+        if on.config.micro_fold:
+            # let the scheduler drain at least once before the flush
+            deadline = time.time() + 5.0
+            while (time.time() < deadline
+                   and on.workers[0].micro_folds_epoch == 0):
+                time.sleep(0.01)
+            assert on.workers[0].micro_folds_epoch > 0
+        m_on = {(m.name, m.type, tuple(m.tags)): m.value
+                for m in on.flush()}
+        m_off = {(m.name, m.type, tuple(m.tags)): m.value
+                 for m in off.flush()}
+        drop = {MetricType.STATUS}
+        m_on = {k: v for k, v in m_on.items() if k[1] not in drop}
+        m_off = {k: v for k, v in m_off.items() if k[1] not in drop}
+        assert m_on == m_off
+    finally:
+        on.shutdown()
+        off.shutdown()
+
+
+# -- controller warmup classification (satellite: cadence judgment) --------
+
+
+def _iv(ok: bool, tick: float = 100.0, stall: float = 50.0) -> dict:
+    return {"cadence_ok": ok, "tick_block_ms": tick,
+            "ingest_stall_ms": stall, "flush_ms": tick * 2,
+            "drain_ms": 1.0}
+
+
+def test_classify_warmup_first_interval_compile():
+    """The committed-artifact shape: first confirm interval misses
+    cadence under a first-encounter XLA compile, the rest land. The
+    compile interval is warmup — excluded from steady means and from
+    the judged cadence fraction."""
+    ivs = [_iv(False, tick=1105.8)] + [_iv(True) for _ in range(9)]
+    out = classify_warmup(ivs)
+    assert out["warmup_intervals"] == 1
+    assert ivs[0]["warmup"] is True
+    assert all(i["warmup"] is False for i in ivs[1:])
+    assert out["cadence_frac_steady"] == 1.0
+    assert out["tick_block_ms_steady"] == 100.0  # compile spike excluded
+
+
+def test_classify_warmup_grace_is_one_interval():
+    """Two leading misses: only the first is warmup — a second
+    straggler is a pipeline problem and must count against cadence."""
+    ivs = [_iv(False), _iv(False)] + [_iv(True) for _ in range(8)]
+    out = classify_warmup(ivs)
+    assert out["warmup_intervals"] == 1
+    assert ivs[1]["warmup"] is False
+    assert out["cadence_frac_steady"] == round(8 / 9, 4)
+
+
+def test_classify_warmup_never_reclassifies_good_intervals():
+    ivs = [_iv(True)] + [_iv(False)] + [_iv(True) for _ in range(4)]
+    out = classify_warmup(ivs)
+    assert out["warmup_intervals"] == 0
+    assert out["cadence_frac_steady"] == round(5 / 6, 4)
+
+
+def test_classify_warmup_all_warmup_judges_nothing():
+    out = classify_warmup([_iv(False)])
+    assert out["warmup_intervals"] == 1
+    assert out["cadence_frac_steady"] == 1.0
+    assert out["tick_block_ms_steady"] == 0.0

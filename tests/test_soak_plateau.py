@@ -1,0 +1,84 @@
+"""RSS-plateau judgment for the topology soak (tools/soak_topology.py).
+
+The multi-hour leak-hunt mode (--min-intervals / --min-duration) passes
+only when the post-warmup rss_growth_per_interval_mb window series
+falls monotonically — a process whose per-interval growth keeps rising
+is leaking, however small each step. The classifier is pure, so the
+tier-1 lane pins its edges on synthetic series here; the slow-marked
+test drives the real soak end to end at miniature scale and checks the
+artifact carries the series and verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+from soak_topology import classify_rss_plateau  # noqa: E402
+
+
+def test_plateau_falling_series_passes():
+    out = classify_rss_plateau([2.0, 0.8, 0.3, 0.1, 0.05])
+    assert out["judgeable"] and out["plateau_ok"]
+    assert out["monotonic_falling"] and out["rising_at_window"] is None
+
+
+def test_plateau_rising_series_fails_and_names_the_window():
+    out = classify_rss_plateau([0.5, 0.2, 0.2, 0.9])
+    assert out["judgeable"]
+    assert not out["plateau_ok"]
+    assert out["rising_at_window"] == 3
+
+
+def test_plateau_noise_floor_tolerates_jitter():
+    # +0.03 MB/interval window-to-window is allocator noise, not a leak
+    out = classify_rss_plateau([0.50, 0.20, 0.23, 0.21])
+    assert out["plateau_ok"]
+    # an explicit tighter floor turns the same jitter into a failure
+    out = classify_rss_plateau([0.50, 0.20, 0.23, 0.21], tol=0.01)
+    assert not out["plateau_ok"]
+
+
+def test_plateau_short_series_judges_nothing():
+    for series in ([], [1.0], [1.0, 2.0]):
+        out = classify_rss_plateau(series)
+        assert not out["judgeable"]
+        assert out["plateau_ok"]  # never gates with too few windows
+
+
+@pytest.mark.slow
+def test_soak_topology_short_run_records_plateau_series(tmp_path):
+    """End-to-end miniature soak: the artifact must carry the window
+    series and the classifier's verdict. Tiny series counts and 14
+    intervals (warmup 10 + one 2-interval window x2) keep this minutes,
+    not hours — still slow-marked out of tier-1."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(JAX_PLATFORMS="cpu", VENEUR_SOAK_INTERVALS="14",
+               VENEUR_SOAK_HISTO_SERIES="60",
+               VENEUR_SOAK_COUNTER_SERIES="20",
+               VENEUR_ARTIFACT_DIR=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "soak_topology.py"),
+         "--rss-window", "2"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=600)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    art = json.load(open(tmp_path / "TOPOLOGY_SOAK.json"))
+    assert art["conservation_ok"]
+    assert art["rss_window_intervals"] == 2
+    assert len(art["rss_windows"]) >= 2
+    for w in art["rss_windows"]:
+        assert set(w) == {"upto_interval", "rss_mb", "intervals",
+                          "growth_per_interval_mb"}
+    assert set(art["rss_plateau"]) == {"judgeable", "monotonic_falling",
+                                       "rising_at_window", "plateau_ok"}
+    assert art["rss_plateau_gates"] is False  # default run records only

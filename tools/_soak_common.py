@@ -79,7 +79,9 @@ def drain_tail(srv) -> None:
 
 
 def write_artifact(name: str, payload: dict) -> None:
-    path = os.path.join(REPO, name)
+    # VENEUR_ARTIFACT_DIR redirects the artifact (test harnesses run
+    # miniature soaks without clobbering the committed repo-root copies)
+    path = os.path.join(os.environ.get("VENEUR_ARTIFACT_DIR", REPO), name)
     with open(path + ".tmp", "w") as f:
         json.dump(payload, f, indent=1)
     os.replace(path + ".tmp", path)

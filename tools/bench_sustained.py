@@ -90,11 +90,14 @@ def main() -> None:
                          "same ring, and write one artifact with both "
                          "modes plus the speedup")
     ap.add_argument("--ab-axis", default="pipeline",
-                    choices=["pipeline", "emit-native"],
+                    choices=["pipeline", "emit-native", "micro-fold"],
                     help="what --ab compares: serial vs pipelined "
-                         "flush (default), or Python vs native emit "
+                         "flush (default), Python vs native emit "
                          "serializers (forces --sink serialize; both "
-                         "sides use --flush-pipeline as given)")
+                         "sides use --flush-pipeline as given), or "
+                         "once-per-interval vs always-hot micro-fold "
+                         "staging (both sides use --flush-pipeline and "
+                         "--sink as given)")
     ap.add_argument("--emit-native", default="on", choices=["on", "off"],
                     help="native emit tier (native/emit.cpp) for "
                          "non-AB runs; --ab --ab-axis emit-native "
@@ -181,6 +184,16 @@ def main() -> None:
             sink_mode = "serialize"
             mode_list = [("emit_python", {"flush_emit_native": False}),
                          ("emit_native", {"flush_emit_native": True})]
+        elif args.ab_axis == "micro-fold":
+            # once-per-interval batch fold vs always-hot micro-fold
+            # staging; the interesting numbers are the steady-state
+            # tick_block/ingest_stall decomposition (the flush's
+            # deadline-time device work is what micro-folds amortize
+            # away), so both sides run whatever sink/pipeline flags the
+            # caller chose and differ ONLY in cfg.micro_fold
+            sink_mode = args.sink
+            mode_list = [("micro_off", {"micro_fold": False}),
+                         ("micro_on", {"micro_fold": True})]
         else:
             sink_mode = args.sink
             mode_list = [("serial", {"flush_pipeline": False}),
@@ -261,6 +274,56 @@ def main() -> None:
             summary["python_emit_lines_per_s"] = base_rate
             summary["speedup_vs_python_emit"] = speedup
             summary["emit_generate_ms"] = out["emit_generate_ms"]
+        elif args.ab_axis == "micro-fold":
+            out["speedup_vs_micro_off"] = speedup
+
+            # the A/B's target comparison (ISSUE acceptance): with
+            # micro-folds on, the steady-state deadline-time numbers —
+            # tick block and ingest stall — must come DOWN, because the
+            # staged state is already device-resident when the tick
+            # lands. Confirm-run steady means (warmup excluded) on both
+            # sides; rates differ between sides, so the matched-rate
+            # growth trials at --start-rate ride along for the
+            # apples-to-apples read.
+            def _steady(mode, key):
+                v = mode.get(key)
+                return round(v, 2) if v is not None else None
+
+            def _at_start_rate(mode, key):
+                for t in mode["search_trials"]:
+                    if t["offered_lines_per_s"] == args.start_rate:
+                        return t.get(key)
+                return None
+
+            out["micro_fold_ab"] = {
+                "matched_rate_lines_per_s": args.start_rate,
+                "tick_block_ms_steady": {
+                    "off": _steady(modes["micro_off"],
+                                   "tick_block_ms_steady"),
+                    "on": _steady(modes["micro_on"],
+                                  "tick_block_ms_steady"),
+                    "off_matched": _at_start_rate(
+                        modes["micro_off"], "tick_block_ms_steady"),
+                    "on_matched": _at_start_rate(
+                        modes["micro_on"], "tick_block_ms_steady"),
+                },
+                "ingest_stall_ms_steady": {
+                    "off": _steady(modes["micro_off"],
+                                   "ingest_stall_ms_steady"),
+                    "on": _steady(modes["micro_on"],
+                                  "ingest_stall_ms_steady"),
+                    "off_matched": _at_start_rate(
+                        modes["micro_off"], "ingest_stall_ms_steady"),
+                    "on_matched": _at_start_rate(
+                        modes["micro_on"], "ingest_stall_ms_steady"),
+                },
+                "micro_folds_total": modes["micro_on"].get(
+                    "micro_folds_total"),
+                "drain_ms_mean": modes["micro_on"].get("drain_ms_mean"),
+            }
+            summary["micro_off_lines_per_s"] = base_rate
+            summary["speedup_vs_micro_off"] = speedup
+            summary["micro_fold_ab"] = out["micro_fold_ab"]
         else:
             out["speedup_vs_serial"] = speedup
             summary["serial_lines_per_s"] = base_rate

@@ -64,6 +64,18 @@ echo "== pipelined-flush equality lane (serial == pipelined) =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python -m pytest tests/test_pipeline.py -q -m 'not slow'
 
+# Micro-fold parity lane: the always-hot flush path (ops/microfold.py)
+# must be BIT-identical to the once-per-interval batch fold for every
+# metric class, cost identical H2D bytes, and hold the epoch-swap fence.
+# Runs twice, mirroring the emit lane: default (micro-folds on) and with
+# the escape hatch thrown (VENEUR_MICRO_FOLD=0) — a parity drift is
+# named by the first pass, a broken disable path by the second.
+echo "== micro-fold parity lane (always-hot on + escape hatch) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_microfold.py -q -m 'not slow'
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_MICRO_FOLD=0 \
+  python -m pytest tests/test_microfold.py -q -m 'not slow'
+
 # Delivery chaos lane: a pipelined server flushing into HTTP sinks whose
 # openers inject seeded faults (utils/faults.py) — refusals, 5xx, slow
 # responses, mid-body resets, payload rejections, and a deterministic

@@ -23,6 +23,16 @@ shard_map path's leak window needs the longer run to separate compile-
 cache warmup from steady-state growth), VENEUR_SOAK_HISTO_SERIES
 (default 1500), VENEUR_SOAK_COUNTER_SERIES (default 500).
 
+RSS-plateau confirmation: --min-intervals N and/or --min-duration D
+("90m", "3h") extend the run for a multi-hour leak hunt. Post-warmup,
+RSS is sampled in fixed interval windows and the artifact records the
+per-window rss_growth_per_interval_mb series; a healthy process
+plateaus, i.e. the series falls monotonically (within a noise floor —
+classify_rss_plateau). When an extended run was requested the plateau
+is a PASS CRITERION: a flat-or-rising growth series exits nonzero. The
+short default run records the series without gating on it (too few
+windows to judge).
+
 VENEUR_SOAK_MESH=1 (VERDICT r4 item 7): the global tier runs
 mesh-sharded — each global Server gets `tpu_mesh_devices: 8` over a
 virtual 8-device CPU mesh (xla_force_host_platform_device_count), so
@@ -34,6 +44,7 @@ conservation criterion. The artifact records `mesh_global: true`.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -45,8 +56,55 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from _soak_common import rss_mb, write_artifact  # noqa: E402
 
+# Below this, window-to-window RSS-growth jitter is allocator noise
+# (arena reuse, page-cache rounding), not signal: a "rise" smaller than
+# the floor never fails the plateau check.
+RSS_NOISE_MB_PER_INTERVAL = 0.05
+
+
+def classify_rss_plateau(growth_series: list[float],
+                         tol: float = RSS_NOISE_MB_PER_INTERVAL) -> dict:
+    """Judge a post-warmup rss_growth_per_interval_mb window series.
+
+    A plateauing process leaks less per interval as caches fill, so the
+    series must be monotonically falling: each window's growth at most
+    the previous window's plus the noise floor. Returns the verdict,
+    the first offending window index (None when ok), and whether there
+    were enough windows to judge at all (fewer than 3 judges nothing —
+    one comparison can't distinguish a trend from jitter).
+
+    Pure — no clocks, no I/O — so the tier-1 suite pins it against
+    synthetic series while the multi-hour soak consumes it live.
+    """
+    judgeable = len(growth_series) >= 3
+    rising_at = None
+    for k in range(1, len(growth_series)):
+        if growth_series[k] > growth_series[k - 1] + tol:
+            rising_at = k
+            break
+    return {
+        "judgeable": judgeable,
+        "monotonic_falling": rising_at is None,
+        "rising_at_window": rising_at,
+        "plateau_ok": (rising_at is None) if judgeable else True,
+    }
+
 
 def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--min-intervals", type=int, default=0,
+                    help="run at least this many flush intervals "
+                         "(floors VENEUR_SOAK_INTERVALS; turns the "
+                         "plateau series into a pass criterion)")
+    ap.add_argument("--min-duration", default=None,
+                    help="run until at least this much wall time has "
+                         "passed, e.g. 90m or 3h (extends the interval "
+                         "loop; turns the plateau series into a pass "
+                         "criterion)")
+    ap.add_argument("--rss-window", type=int, default=0,
+                    help="intervals per RSS-growth window (default: "
+                         "post-warmup span / 6, floored at 5)")
+    args = ap.parse_args()
     mesh_global = os.environ.get("VENEUR_SOAK_MESH") == "1"
     if mesh_global and os.environ.get("_VENEUR_SOAK_REEXEC") != "1":
         # the mesh globals shard over 8 virtual CPU devices, the same
@@ -78,8 +136,16 @@ def main() -> None:
     from veneur_tpu.distributed.import_server import ImportServer
     from veneur_tpu.distributed.proxy import ProxyServer
 
-    intervals = int(os.environ.get("VENEUR_SOAK_INTERVALS",
-                                   60 if mesh_global else 30))
+    from veneur_tpu.core.config import parse_duration
+
+    intervals = max(int(os.environ.get("VENEUR_SOAK_INTERVALS",
+                                       60 if mesh_global else 30)),
+                    args.min_intervals)
+    min_duration_s = (parse_duration(args.min_duration)
+                      if args.min_duration else 0.0)
+    # an extended run was explicitly requested: the plateau series has
+    # enough windows to be a pass criterion, not just a recording
+    plateau_gates = bool(args.min_intervals or args.min_duration)
     s_histo = int(os.environ.get("VENEUR_SOAK_HISTO_SERIES", 1500))
     s_counter = int(os.environ.get("VENEUR_SOAK_COUNTER_SERIES", 500))
     pcts = [0.5, 0.99]
@@ -133,6 +199,29 @@ def main() -> None:
     # rss_end - rss_start
     warmup_intervals = min(10, intervals)
     rss_warm = None
+    # fixed-size post-warmup windows for the plateau series: each
+    # closes with its growth-per-interval, the judgment the multi-hour
+    # confirmation runs on
+    rss_win_len = args.rss_window or max(
+        5, (intervals - warmup_intervals) // 6)
+    rss_windows: list[dict] = []
+    rss_win_prev = None
+    rss_win_start = warmup_intervals
+
+    def close_rss_window(upto: int) -> None:
+        nonlocal rss_win_prev, rss_win_start
+        if rss_win_prev is None or upto <= rss_win_start:
+            return
+        cur = rss_mb()
+        n = upto - rss_win_start
+        rss_windows.append({
+            "upto_interval": upto,
+            "rss_mb": round(cur, 1),
+            "intervals": n,
+            "growth_per_interval_mb": round(
+                (cur - rss_win_prev) / n, 3),
+        })
+        rss_win_prev, rss_win_start = cur, upto
     # Python-heap attribution for the post-warmup accrual: the RSS
     # delta alone can't name a retainer. Snapshot the traced heap at
     # the warmup boundary and diff it against the end — the top
@@ -151,10 +240,17 @@ def main() -> None:
             out["local_forward"] = client.stats()
         return out
 
-    for it in range(intervals):
+    it = 0
+    while (it < intervals
+           or (min_duration_s
+               and time.perf_counter() - t_start < min_duration_s)):
         if it == warmup_intervals:
             rss_warm = rss_mb()
+            rss_win_prev = rss_warm
             tm_warm = tracemalloc.take_snapshot()
+        elif (it > warmup_intervals
+              and (it - warmup_intervals) % rss_win_len == 0):
+            close_rss_window(it)
         if it == join_at:
             proxy.set_destinations(dests([0, 1, 2]))
             churn_events.append({"interval": it, "event": "join",
@@ -222,6 +318,12 @@ def main() -> None:
                 "expected": per_interval,
                 **forward_path_stats(),
             })
+        it += 1
+
+    intervals = it  # actual count (a --min-duration run overshoots the plan)
+    close_rss_window(it)
+    rss_plateau = classify_rss_plateau(
+        [w["growth_per_interval_mb"] for w in rss_windows])
 
     # end-of-loop heap snapshot BEFORE the final accounting flushes
     # below allocate their own transient state: the diff should show
@@ -302,6 +404,13 @@ def main() -> None:
             round((rss_end - rss_warm)
                   / max(1, intervals - warmup_intervals), 3)
             if rss_warm is not None else None),
+        # the plateau series: post-warmup RSS growth per interval, per
+        # window — falling means caches are filling, flat-or-rising
+        # means a leak (the multi-hour confirmation's pass criterion)
+        "rss_window_intervals": rss_win_len,
+        "rss_windows": rss_windows,
+        "rss_plateau": rss_plateau,
+        "rss_plateau_gates": plateau_gates,
         "traced_py_growth_mb": round(traced_growth / 1048576.0, 2),
         "tracemalloc_top": tracemalloc_top,
     }
@@ -318,8 +427,12 @@ def main() -> None:
                       "value": 1.0 if out["conservation_ok"] else 0.0,
                       "unit": "bool",
                       "drops": out["proxy_drops"],
-                      "stalled_intervals": out["stalled_intervals"]}))
+                      "stalled_intervals": out["stalled_intervals"],
+                      "rss_plateau_ok": rss_plateau["plateau_ok"]}))
     if not out["conservation_ok"] or out["proxy_drops"]:
+        sys.exit(1)
+    if plateau_gates and rss_plateau["judgeable"] \
+            and not rss_plateau["plateau_ok"]:
         sys.exit(1)
 
 

@@ -194,6 +194,17 @@ class Config:
     # per interval (worker._histo_fold_staged); rows that fill their
     # staging mid-interval spill through the direct device fold
     tpu_stage_depth: int = 64
+    # always-hot flush (ops/microfold.py): stream the staging plane to a
+    # device mirror in sub-interval micro-folds, every time the staged
+    # backlog crosses micro_fold_rows samples or ages past
+    # micro_fold_max_age_s, so the flush tick's fold collapses to a
+    # residual drain. Bit-identical to the batch fold per metric class
+    # (tests/test_microfold.py); VENEUR_MICRO_FOLD=0 is the env escape
+    # hatch. Inert when staging is off (tpu_stage_depth 0) or a device
+    # mesh is attached.
+    micro_fold: bool = True
+    micro_fold_rows: int = 8192
+    micro_fold_max_age_s: float = 0.25
     # entries per pending-batch (SoA) class before ingest sheds samples
     # (drop-don't-block under overload; counted in
     # veneur.ingest.overload_dropped_total). Bounds native ingest memory
@@ -601,6 +612,11 @@ def validate_config(cfg: Config) -> None:
         raise ValueError("tpu_stage_depth must be >= 1")
     if cfg.tpu_spill_cap < 1:
         raise ValueError("tpu_spill_cap must be >= 1")
+    if cfg.micro_fold_rows < 1:
+        raise ValueError("micro_fold_rows must be >= 1")
+    if cfg.micro_fold_max_age_s <= 0:
+        raise ValueError("micro_fold_max_age_s must be positive (it is"
+                         " the staged-backlog age that forces a drain)")
     if not (1 <= cfg.loadgen_num_keys <= (1 << 24)):
         raise ValueError("loadgen_num_keys must be in [1, 2^24]")
     if cfg.loadgen_zipf_s < 0:

@@ -131,6 +131,9 @@ class Server:
                 set_hash=cfg.set_hash,
                 set_store=cfg.tpu_set_store,
                 spill_cap=cfg.tpu_spill_cap,
+                micro_fold=cfg.micro_fold,
+                micro_fold_rows=cfg.micro_fold_rows,
+                micro_fold_max_age_s=cfg.micro_fold_max_age_s,
             )
             for _ in range(cfg.num_workers)
         ]
@@ -443,6 +446,12 @@ class Server:
             # ingest-stall component of the cadence decomposition (the
             # loadgen controller reports it per interval)
             "last_tick_s": self.last_tick_s,
+            # always-hot flush: lifetime micro-fold drains plus the last
+            # closed interval's count (the controller's per-interval
+            # micro_folds is a delta of the lifetime tally)
+            "micro_folds_total": sum(
+                getattr(w, "micro_folds_total", 0) for w in self.workers),
+            "last_micro_folds": getattr(self, "last_micro_folds", 0),
         }
         if self.flush_pipeline is not None:
             out["pipeline"] = self.flush_pipeline.stats()
@@ -1268,6 +1277,11 @@ class Server:
             # stage threads must exist before the first tick enqueues
             self.flush_pipeline.start()
         self._spawn(self._flush_loop, "flush-ticker", compute=True)
+        if self.config.micro_fold:
+            # always-hot flush scheduler (worker.micro_fold_once): the
+            # staged ingest planes stream to the device mirrors DURING
+            # the interval, so the tick's fold shrinks to a drain
+            self._spawn(self._micro_fold_loop, "micro-fold", compute=True)
         if self.native_mode:
             self._spawn(self._series_sync_loop, "series-sync",
                         compute=True)
@@ -1330,6 +1344,32 @@ class Server:
                 self.sync_native_series_once()
             except Exception:
                 log.exception("series sync sweep failed")
+
+    def _micro_fold_loop(self) -> None:
+        """Sub-interval micro-fold scheduler (always-hot flush): poll
+        each worker's staged backlog and drain it to the device mirror
+        whenever the row-count or age threshold trips
+        (worker.micro_fold_due / micro_fold_once). The due probe is
+        lock-free (native: one C call; Python: a numpy sum); only an
+        actual drain takes the worker's ingest lock, and briefly — the
+        COO copy is a memcpy and the device feeds are async dispatches.
+        Poll cadence tracks the age threshold so a trickle workload
+        still drains within ~max_age."""
+        cadence = max(0.01, min(1.0,
+                                self.config.micro_fold_max_age_s / 2.0,
+                                self.interval / 20.0))
+        while not (self._shutdown.is_set() or self._quiesce.is_set()):
+            if self._shutdown.wait(cadence):
+                return
+            for i, worker in enumerate(self.workers):
+                try:
+                    if worker.micro_fold_due():
+                        with self._worker_locks[i]:
+                            worker.micro_fold_once()
+                except Exception:
+                    if self._shutdown.is_set():
+                        return
+                    log.exception("micro-fold drain failed (worker %d)", i)
 
     def _flush_loop(self) -> None:
         """Interval ticker, optionally aligned to the wall clock
@@ -1559,6 +1599,18 @@ class Server:
                 for pkt in pkts:
                     self.handle_trace_packet(pkt)
         phases["swap_s"] = time.perf_counter() - _t
+        # always-hot flush decomposition: how many micro-folds streamed
+        # the closed epoch to the device mirrors, and how much of the
+        # swap above was the final residual drain + mirror handoff (the
+        # loadgen controller reports both per interval as micro_folds /
+        # drain_ms)
+        micro_folds = sum(getattr(w, "micro_folds_swapped", 0)
+                          for w in self.workers)
+        phases["drain_s"] = sum(
+            getattr(w, "micro_drain_swapped_s", 0.0) for w in self.workers)
+        self.last_micro_folds = micro_folds
+        if micro_folds:
+            self.stats.count("worker.micro_folds_total", micro_folds)
         self.flush_governor.beat()  # swap complete: flush is live
         return FlushJob(ts=int(flush_start), flush_start=flush_start,
                         qs=qs, swapped=swapped, span_counts=span_counts,
@@ -1605,7 +1657,9 @@ class Server:
             self.stats.count("flush.transfer_d2h_bytes", d2h)
         chunk_report = self.flush_governor.last_report
         self.last_flush_chunks = chunk_report
-        if chunk_report:
+        # a micro-folds-only report (sub-floor pool: no chunking ran)
+        # carries no chunk keys — guard on the key, not truthiness
+        if "chunks" in chunk_report:
             self.stats.gauge("flush.extract_chunks",
                              chunk_report["chunks"])
             self.stats.time_in_nanoseconds(

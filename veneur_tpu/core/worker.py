@@ -47,6 +47,7 @@ from veneur_tpu.core.directory import ScopeClass, SeriesDirectory, classify
 from veneur_tpu.core.metrics import MetricKey, UDPMetric, route_info
 from veneur_tpu.health.ledger import TransferLedger
 from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import microfold as mf
 from veneur_tpu.ops import tdigest as td
 from veneur_tpu.ops.scalars import counter_contribution
 from veneur_tpu.utils.hashing import hll_hash, fmix64, metric_digest
@@ -544,6 +545,18 @@ class SwappedEpoch:
     # measurement: swap 42s of a 44s flush, all in the spill fold).
     # extract_snapshot folds it off the lock, like the staged planes.
     spill_histo: Optional[tuple] = None
+    # micro-fold device mirror of the epoch's staging plane
+    # (ops/microfold.MirrorState): already resident on device at swap, so
+    # extract folds it without an upload. Replaces the plane it mirrored
+    # in staged_histo — exactly one of the two carries a given sample.
+    device_stage: Optional[object] = None
+    # the epoch's rotated MicroFoldMirror plus the residual COO deltas
+    # collected under the swap fence but NOT yet fed: the device feeds
+    # are deferred to extract_snapshot (off the tick) — a starved
+    # scheduler must not turn the swap into the upload burst micro-folds
+    # exist to remove. extract feeds these, finish()es the mirror, and
+    # populates device_stage.
+    micro_residual: Optional[tuple] = None
 
 
 class DeviceWorker:
@@ -570,6 +583,9 @@ class DeviceWorker:
         set_store: str = "staged",
         stage_depth: int = 64,
         spill_cap: int = 1 << 22,
+        micro_fold: bool = False,
+        micro_fold_rows: int = 8192,
+        micro_fold_max_age_s: float = 0.25,
     ) -> None:
         self.batch_size = batch_size
         # native pending-batch bound; beyond it samples shed, counted in
@@ -621,6 +637,24 @@ class DeviceWorker:
         self.governor = None
         self._native = None
         self._mesh_pool = None
+        # always-hot flush (ops/microfold.py): when enabled, a scheduler
+        # calls micro_fold_once() every time the staged-sample backlog
+        # crosses micro_fold_rows or ages past micro_fold_max_age_s, so
+        # the staging plane streams to a device mirror DURING the
+        # interval and swap's fold shrinks to a residual drain
+        self.micro_fold = bool(micro_fold)
+        self.micro_fold_rows = int(micro_fold_rows)
+        self.micro_fold_max_age_s = float(micro_fold_max_age_s)
+        self._micro: Optional[mf.MicroFoldMirror] = None
+        self._micro_last_drain = time.monotonic()
+        # lifetime / per-epoch micro-fold drains, and the seconds swap
+        # spent on the final residual drain + mirror fence (the server's
+        # per-flush drain_ms telemetry; captured at swap like
+        # staged_samples_swapped)
+        self.micro_folds_total = 0
+        self.micro_folds_epoch = 0
+        self.micro_folds_swapped = 0
+        self.micro_drain_swapped_s = 0.0
         # cross-epoch series-metadata cache (see _sync_native_series);
         # deliberately NOT in _reset_epoch — surviving the per-flush
         # directory swap is its whole purpose
@@ -902,6 +936,141 @@ class DeviceWorker:
             pool.present[rows] = True
         return deferred
 
+    # -- micro-folds (always-hot flush) --------------------------------------
+
+    def _micro_active(self) -> bool:
+        """Micro-folds engage only where the staged fold exists: staging
+        on and no mesh (mesh rows bypass the staging plane entirely)."""
+        return (self.micro_fold and self.stage_depth > 0
+                and self._mesh_pool is None)
+
+    def _ensure_micro(self) -> "mf.MicroFoldMirror":
+        if self._micro is None:
+            self._micro = mf.MicroFoldMirror(
+                self.stage_depth, ledger=self.ledger,
+                initial_rows=self._initial_histo_rows)
+        return self._micro
+
+    def micro_fold_pending(self) -> int:
+        """Staged samples not yet streamed to the device mirror (the
+        scheduler's due check; caller holds the worker ingest lock)."""
+        if not self._micro_active():
+            return 0
+        if self._native is not None:
+            try:
+                return int(self._native.stage_pending)
+            except AttributeError:  # stale .so without the delta API
+                return 0
+        if self._stage_count is None:
+            return 0
+        total = int(self._stage_count.sum())
+        mark = self._ustage_mark
+        if mark is not None:
+            total -= int(mark[:len(self._stage_count)].sum())
+        return total
+
+    def micro_fold_due(self) -> bool:
+        pending = self.micro_fold_pending()
+        if pending <= 0:
+            return False
+        if pending >= self.micro_fold_rows:
+            return True
+        return (time.monotonic() - self._micro_last_drain
+                >= self.micro_fold_max_age_s)
+
+    def micro_fold_once(self) -> int:
+        """One micro-fold: stream the staged samples accumulated since
+        the last drain into the device mirror (ops/microfold.py), and —
+        native mode — drain the pending scalar/set/spill SoA batches so
+        swap inherits none of them either. Caller holds the worker
+        ingest lock. Returns samples streamed."""
+        if not self._micro_active():
+            return 0
+        self._micro_last_drain = time.monotonic()
+        if self._native is not None:
+            # mid-interval SoA drain first: counters are np.add.at in
+            # drain order and gauges last-write-wins, so draining more
+            # often splits the stream into ordered deltas — the folded
+            # result is bitwise what one deadline-time drain produces
+            self.drain_native()
+            fed = self._micro_drain_native()
+        else:
+            fed = self._micro_drain_python()
+        if fed:
+            self.micro_folds_total += 1
+            self.micro_folds_epoch += 1
+            gov = self.governor
+            if gov is not None:
+                try:
+                    gov.note_micro_fold(fed)
+                except AttributeError:
+                    pass
+        return fed
+
+    def _micro_drain_native(self) -> int:
+        """COO-drain the C++ staging plane's undrained delta into the
+        mirror. drain_stage_delta advances the plane's per-row watermark
+        WITHOUT touching counts, so the per-epoch depth cap (and the
+        spill partitioning) is identical to a run with no micro-folds."""
+        try:
+            if self._native.stage_pending <= 0:
+                return 0
+        except AttributeError:  # stale .so without the delta API
+            return 0
+        micro = self._ensure_micro()
+        fed = 0
+        cap = 1 << 18
+        while True:
+            rows, slots, vals, wts = self._native.drain_stage_delta(cap)
+            n = len(rows)
+            if n == 0:
+                break
+            micro.feed(rows, slots, vals, wts)
+            fed += n
+            if n < cap:
+                break
+        return fed
+
+    def _python_stage_delta(self) -> Optional[tuple]:
+        """The Python staging plane's [mark, count) delta per row as one
+        COO tuple (rows, slots, vals, wts — all copies), advancing the
+        watermark; None when nothing is undrained. Touches only what
+        _device_histo_step already wrote — it never forces the pending
+        SoA batches through, so the spill-fold batch boundaries stay
+        exactly the batch path's."""
+        counts = self._stage_count
+        if counts is None:
+            return None
+        rows_n = len(counts)
+        mark = self._ustage_mark
+        if mark is None or len(mark) < rows_n:
+            nm = np.zeros(rows_n, np.int32)
+            if mark is not None:
+                nm[:len(mark)] = mark
+            mark = self._ustage_mark = nm
+        delta = counts - mark[:rows_n]
+        live = np.flatnonzero(delta > 0)
+        if not len(live):
+            return None
+        reps = delta[live]
+        total = int(reps.sum())
+        rows = np.repeat(live.astype(np.int32), reps)
+        run_starts = np.cumsum(reps) - reps
+        intra = (np.arange(total, dtype=np.int32)
+                 - np.repeat(run_starts, reps).astype(np.int32))
+        slots = np.repeat(mark[live], reps).astype(np.int32) + intra
+        coo = (rows, slots, self._stage_vals[rows, slots],
+               self._stage_wts[rows, slots])
+        mark[live] = counts[live]
+        return coo
+
+    def _micro_drain_python(self) -> int:
+        coo = self._python_stage_delta()
+        if coo is None:
+            return 0
+        self._ensure_micro().feed(*coo)
+        return len(coo[0])
+
     # -- epoch lifecycle ----------------------------------------------------
 
     def _reset_epoch(self) -> None:
@@ -935,6 +1104,11 @@ class DeviceWorker:
         self._stage_vals: Optional[np.ndarray] = None
         self._stage_wts: Optional[np.ndarray] = None
         self._stage_count: Optional[np.ndarray] = None
+        # micro-fold watermark for the Python plane: slots
+        # [mark[r], count[r]) are staged but not yet mirrored
+        self._ustage_mark: Optional[np.ndarray] = None
+        self.micro_folds_epoch = 0
+        self._micro_last_drain = time.monotonic()
         # pending SoA buffers (host)
         self._ph_rows: list[int] = []
         self._ph_vals: list[float] = []
@@ -1535,6 +1709,9 @@ class DeviceWorker:
         self.processed_total += self.processed
         native_stage = None
         spill_histo = None
+        micro_s = 0.0
+        micro_coo: list = []
+        native_mirrored = False
         if self._native is not None:
             # drain, detach the staging plane, and close the native epoch
             # under one lock hold: a routed commit can otherwise land
@@ -1542,6 +1719,28 @@ class DeviceWorker:
             # the old epoch
             self._native.lock()
             try:
+                if self._micro_active():
+                    # residual micro-drain in the SAME critical section
+                    # as the detach: every staged sample is either
+                    # already mirrored or copied out here, and nothing
+                    # can land in between — the swap fence that makes
+                    # in-flight micro-folds lose or double-fold nothing.
+                    # Host memcpy only; the device feeds run after
+                    # unlock so reader commits aren't stalled.
+                    _t = time.perf_counter()
+                    try:
+                        cap = 1 << 18
+                        while True:
+                            coo = self._native.drain_stage_delta(cap)
+                            if not len(coo[0]):
+                                break
+                            micro_coo.append(coo)
+                            if len(coo[0]) < cap:
+                                break
+                        native_mirrored = self._native.stage_pending == 0
+                    except AttributeError:  # stale .so: plane path below
+                        native_mirrored = False
+                    micro_s += time.perf_counter() - _t
                 raw = self._drain_native_raw(detach_stage=True)
                 native_stage = raw[4]
                 # event/service-check lines + fallback SSF payloads caught
@@ -1602,11 +1801,47 @@ class DeviceWorker:
                 quantiles, self.directory.num_histo_rows)
             self._mesh_pool.reset()
 
+        # close the epoch's micro-fold mirror: python-path residual
+        # drain (the caller's ingest lock serializes this against
+        # _device_histo_step) is host-only COO collection; the device
+        # feeds + carry dispatch are DEFERRED to extract_snapshot via
+        # micro_residual — a starved scheduler leaves a large residual,
+        # and feeding it here would put the upload burst back on the
+        # very tick path micro-folds exist to clear. The new epoch gets
+        # a fresh mirror lazily (_ensure_micro).
+        device_stage = None
+        micro_residual = None
+        if self._micro_active():
+            _t = time.perf_counter()
+            if self._native is None:
+                coo = self._python_stage_delta()
+                if coo is not None:
+                    micro_coo.append(coo)
+            mirror, self._micro = self._micro, None
+            residual_n = sum(len(c[0]) for c in micro_coo)
+            if (mirror is not None and mirror.samples > 0) or residual_n:
+                if mirror is None:
+                    mirror = mf.MicroFoldMirror(
+                        self.stage_depth, ledger=self.ledger,
+                        initial_rows=self._initial_histo_rows)
+                mirror.book_in_flush = True
+                micro_residual = (mirror, micro_coo)
+                micro_samples = mirror.samples + residual_n
+            micro_s += time.perf_counter() - _t
+        self.micro_drain_swapped_s = micro_s
+        self.micro_folds_swapped = self.micro_folds_epoch
+        # micro-fold upload bytes belong to the flush that extracts this
+        # epoch: queue the closed epoch's tally for its begin_flush
+        self.ledger.roll_epoch()
+
         staged = 0
-        if native_stage is not None:
-            staged += int(native_stage[2].sum())
         staged_histo = []
-        if self._stage_count is not None and self._stage_count.any():
+        # a mirrored plane is handed over as micro_residual (mirror +
+        # deferred COO) INSTEAD of a host plane — exactly one of the two
+        # carries a given sample
+        python_mirrored = micro_residual is not None and self._native is None
+        if (self._stage_count is not None and self._stage_count.any()
+                and not python_mirrored):
             staged += int(self._stage_count.sum())
             # hand the host staging planes to the closed epoch; the fold
             # into the digest runs in extract_snapshot, OFF the ingest lock
@@ -1615,10 +1850,19 @@ class DeviceWorker:
                 StagedPlane(self._stage_vals, self._stage_wts, None, None))
         if native_stage is not None:
             sv, sw, counts, unit, free = native_stage
-            # unit weights (no sampled metrics this epoch): skip the
-            # weights plane upload; the fold rebuilds it from counts
-            staged_histo.append(
-                StagedPlane(sv, None if unit else sw, counts, free))
+            if native_mirrored and micro_residual is not None:
+                # plane content fully captured by the mirror + residual
+                # COO (all copies): release the C++ memory now, nothing
+                # to upload at flush
+                free()
+            else:
+                staged += int(counts.sum())
+                # unit weights (no sampled metrics this epoch): skip the
+                # weights plane upload; the fold rebuilds it from counts
+                staged_histo.append(
+                    StagedPlane(sv, None if unit else sw, counts, free))
+        if micro_residual is not None:
+            staged += micro_samples
         staged_histo = staged_histo or None
         # flush self-telemetry (veneur.worker.samples_staged_total)
         self.staged_samples_swapped = staged
@@ -1627,7 +1871,8 @@ class DeviceWorker:
             histo=self._histo, sets=self._sets,
             staged_sets=self._staged_sets, umts=self._umts,
             mesh_out=mesh_out, staged_histo=staged_histo,
-            spill_histo=spill_histo,
+            spill_histo=spill_histo, device_stage=device_stage,
+            micro_residual=micro_residual,
         )
         self.processed = 0
         self.imported = 0
@@ -1797,6 +2042,35 @@ class DeviceWorker:
                 # (per-flush data is expendable, README.md:135-137);
                 # leaked native memory is not.
                 _free_staged_planes(pending)
+            if swapped.micro_residual is not None:
+                # deferred residual feeds: whatever the scheduler had not
+                # streamed by swap time lands on the device HERE, in the
+                # extract stage, exactly like the batch path's upload —
+                # the tick paid only the host-side COO memcpy
+                mirror, coos = swapped.micro_residual
+                swapped.micro_residual = None
+                for coo in coos:
+                    mirror.feed(*coo)
+                swapped.device_stage = mirror.finish()
+                if gov is not None:
+                    gov.beat()
+            dstage = swapped.device_stage
+            swapped.device_stage = None
+            if dstage is not None:
+                # micro-fold mirror: the epoch's staging plane is already
+                # resident on device, so this is the SAME single fold the
+                # batch path runs minus the upload — mirror_dense yields
+                # bitwise the array _expand_flat_planes / the dense
+                # Python upload would have built (values and weights at
+                # the same absolute slots, zeros elsewhere), which is
+                # what pins micro-folded == batch-folded
+                fields = _histo_fold_staged(
+                    *fields,
+                    mf.mirror_dense(dstage.vals, s_eff),
+                    mf.mirror_dense(dstage.wts, s_eff),
+                    compression=self.compression)
+                if gov is not None:
+                    gov.beat()
             qs = self.ledger.h2d(
                 np.asarray(quantiles, dtype=np.float32), "quantiles")
             run = (gov.begin_extract(s_eff)
@@ -1871,6 +2145,10 @@ class DeviceWorker:
             # meaningful, but C++ memory must still be released
             _free_staged_planes(swapped.staged_histo)
             swapped.staged_histo = None
+        # (a mirror with nowhere to fold is just device garbage — drop it,
+        # along with any never-fed residual: no rows means nothing to lose)
+        swapped.device_stage = None
+        swapped.micro_residual = None
         if swapped.mesh_out is not None:
             mout = swapped.mesh_out
             n = directory.num_histo_rows

@@ -129,6 +129,12 @@ class FlushDeadlineGovernor:
         # per-flush report (reset by begin_flush, read by telemetry)
         self._chunk_times: list[float] = []
         self._chunk_rows: list[int] = []
+        # mid-interval micro-fold accounting (always-hot flush): each
+        # drain beats the progress clock — micro-folds ARE flush-path
+        # liveness — and tallies here for telemetry/benches
+        self.micro_folds_total = 0
+        self.micro_fold_samples_total = 0
+        self._micro_folds_window = 0
 
     @property
     def enabled(self) -> bool:
@@ -180,6 +186,18 @@ class FlushDeadlineGovernor:
         with self._lock:
             self._last_beat_unix = time.time()
 
+    def note_micro_fold(self, samples: int) -> None:
+        """One mid-interval micro-fold drained `samples` staged samples
+        to the device mirror (worker.micro_fold_once). Counts as
+        flush-path liveness for the watchdog — a host busy streaming
+        micro-folds is making the deadline-time fold smaller, the
+        opposite of stalled."""
+        with self._lock:
+            self._last_beat_unix = time.time()
+            self.micro_folds_total += 1
+            self.micro_fold_samples_total += int(samples)
+            self._micro_folds_window += 1
+
     def progress(self) -> dict:
         """Snapshot for the watchdog deferral decision."""
         with self._lock:
@@ -195,14 +213,17 @@ class FlushDeadlineGovernor:
         with self._lock:
             times = list(self._chunk_times)
             rows = list(self._chunk_rows)
+            micro = self._micro_folds_window
+            self._micro_folds_window = 0
         if not times:
-            return {}
+            return {"micro_folds": micro} if micro else {}
         return {
             "chunks": len(times),
             "chunk_rows_max": max(rows),
             "chunk_max_s": max(times),
             "chunk_mean_s": sum(times) / len(times),
             "chunk_target_ms": self.chunk_target_ms,
+            "micro_folds": micro,
         }
 
     # -- extraction scheduling (called by workers) ------------------------

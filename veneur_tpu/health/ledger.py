@@ -50,10 +50,19 @@ class TransferLedger:
         self.total_h2d_bytes = 0
         self.total_d2h_bytes = 0
         self.flushes = 0
+        # micro-fold uploads happen DURING the epoch, before the flush
+        # window that will report them opens. They accumulate here;
+        # roll_epoch() (called at swap) queues the closed epoch's tally,
+        # and begin_flush() folds the oldest queued epoch into the new
+        # window — correct under the stage pipeline, where swaps and
+        # extractions interleave but stay 1:1 (only generate/emit shed).
+        self._epoch_h2d: dict[str, int] = {}
+        self._pending_epochs: list[dict[str, int]] = []
 
     def begin_flush(self) -> None:
         with self._lock:
-            self._flush_h2d = {}
+            self._flush_h2d = (
+                self._pending_epochs.pop(0) if self._pending_epochs else {})
             self._flush_d2h = {}
             self.flushes += 1
 
@@ -71,6 +80,28 @@ class TransferLedger:
         out = np.asarray(dev_arr)
         self.count_d2h(out.nbytes, kind)
         return out
+
+    def epoch_h2d(self, host_arr, kind: str):
+        """Count and perform one mid-epoch (micro-fold) upload. Bytes
+        land in the epoch accumulator, not the open flush window — they
+        belong to the flush that will extract this epoch's state."""
+        import jax.numpy as jnp
+
+        self.count_epoch_h2d(host_arr.nbytes, kind)
+        return jnp.asarray(host_arr)
+
+    def count_epoch_h2d(self, nbytes: int, kind: str) -> None:
+        with self._lock:
+            self._epoch_h2d[kind] = self._epoch_h2d.get(kind, 0) + int(nbytes)
+            self.total_h2d_bytes += int(nbytes)
+
+    def roll_epoch(self) -> None:
+        """Close the current epoch's micro-fold tally (called at swap):
+        queue it for the flush window that extracts the swapped state."""
+        with self._lock:
+            if self._epoch_h2d:
+                self._pending_epochs.append(self._epoch_h2d)
+                self._epoch_h2d = {}
 
     def count_h2d(self, nbytes: int, kind: str) -> None:
         with self._lock:

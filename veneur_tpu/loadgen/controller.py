@@ -32,6 +32,56 @@ log = logging.getLogger("veneur_tpu.loadgen")
 # reader-core budget to feed it at the measured sustained rate
 NORTH_STAR_LINES_PER_S = 50e6
 
+# At most this many leading cadence misses of a trial may be classed as
+# warmup. One is the honest number: a trial's first interval is where a
+# first-encounter XLA compile lands (pow2 shape buckets mean a new rate
+# tier compiles once), and a SECOND straggler is a pipeline problem, not
+# a compile.
+WARMUP_GRACE_INTERVALS = 1
+
+
+def classify_warmup(intervals: list[dict],
+                    grace: int = WARMUP_GRACE_INTERVALS) -> dict:
+    """Split a trial's interval records into warmup vs steady state.
+
+    A leading interval that missed cadence is warmup — the flush that
+    closed it paid first-encounter XLA compiles (multi-second on CPU),
+    which is a property of the trial boundary, not of the pipeline. At
+    most `grace` intervals qualify, they must be a prefix, and an
+    interval that made cadence is never reclassified. Mutates each
+    record with a "warmup" bool and returns the steady-state view:
+
+        warmup_intervals    how many leading records were excluded
+        cadence_frac_steady misses / steady count (1.0 when no steady
+                            records exist — an all-warmup trial judges
+                            nothing)
+        <m>_steady          mean over steady records for each of
+                            tick_block_ms, ingest_stall_ms, flush_ms,
+                            drain_ms
+
+    Pure beyond the "warmup" stamp: no controller state, no clocks —
+    unit-testable against synthetic interval lists.
+    """
+    n_warm = 0
+    for rec in intervals:
+        if n_warm >= grace or rec.get("cadence_ok", False):
+            break
+        n_warm += 1
+    for k, rec in enumerate(intervals):
+        rec["warmup"] = k < n_warm
+    steady = intervals[n_warm:]
+    n = len(steady)
+    out = {
+        "warmup_intervals": n_warm,
+        "cadence_frac_steady": round(
+            sum(1 for i in steady if i["cadence_ok"]) / n, 4)
+        if n else 1.0,
+    }
+    for m in ("tick_block_ms", "ingest_stall_ms", "flush_ms", "drain_ms"):
+        out[m + "_steady"] = round(
+            sum(i.get(m, 0.0) for i in steady) / n, 2) if n else 0.0
+    return out
+
 
 class LoadHarness:
     """A running Server plus a connected send socket and a prebuilt
@@ -217,6 +267,14 @@ class LoadHarness:
                         flush_phases.get("swap_s", 0.0) * 1e3, 2),
                     "flush_ms": round(
                         sum(flush_phases.values()) * 1e3, 2),
+                    # always-hot flush: micro-folds that ran during this
+                    # window (lifetime-counter delta, so folds landing
+                    # near the flush boundary are never lost) and the
+                    # swap-time residual drain + mirror handoff
+                    "micro_folds": (snap.get("micro_folds_total", 0)
+                                    - prev.get("micro_folds_total", 0)),
+                    "drain_ms": round(
+                        flush_phases.get("drain_s", 0.0) * 1e3, 2),
                     # the emit A/B's two phases of interest: columnar
                     # batch assembly and sink serialization+emission
                     "generate_ms": round(
@@ -237,6 +295,17 @@ class LoadHarness:
         n_ok = sum(1 for i in intervals if i["cadence_ok"])
         n_iv = max(1, len(intervals))
         pipeline_stats = self.server.ingress_stats().get("pipeline")
+        # warmup vs steady state: a first-interval cadence miss from a
+        # first-encounter XLA compile is a trial-boundary artifact, not
+        # a pipeline failure. The judged cadence_frac excludes warmup
+        # from BOTH numerator and denominator (a trial of N intervals
+        # with one warmup is judged on the other N-1, or on
+        # n_intervals-1 when the run aborted early); the raw fraction
+        # over all requested intervals stays in the record.
+        steady = classify_warmup(intervals)
+        n_warm = steady["warmup_intervals"]
+        n_ok_steady = sum(1 for i in intervals
+                          if i["cadence_ok"] and not i["warmup"])
         return {
             "tick_block_ms_mean": round(
                 sum(i["tick_block_ms"] for i in intervals) / n_iv, 2),
@@ -248,6 +317,10 @@ class LoadHarness:
                 sum(i["generate_ms"] for i in intervals) / n_iv, 2),
             "emit_ms_mean": round(
                 sum(i["emit_ms"] for i in intervals) / n_iv, 2),
+            "drain_ms_mean": round(
+                sum(i["drain_ms"] for i in intervals) / n_iv, 2),
+            "micro_folds_total": sum(i["micro_folds"] for i in intervals),
+            **steady,
             **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             "offered_lines_per_s": rate,
             "intervals": intervals,
@@ -259,7 +332,9 @@ class LoadHarness:
             if total_dt > 0 else 0.0,
             "loss_frac": round(max(0.0, 1.0 - total_acc / total_sent), 5)
             if total_sent > 0 else 1.0,
-            "cadence_frac": round(n_ok / n_intervals, 4),
+            "cadence_frac": round(
+                n_ok_steady / max(1, n_intervals - n_warm), 4),
+            "cadence_frac_raw": round(n_ok / n_intervals, 4),
             "intervals_completed": len(intervals),
         }
 
@@ -409,16 +484,29 @@ def result_artifact(spec: WorkloadSpec, harness: LoadHarness,
         "flush_ms_mean": confirm.get("flush_ms_mean"),
         "generate_ms_mean": confirm.get("generate_ms_mean"),
         "emit_ms_mean": confirm.get("emit_ms_mean"),
+        # steady-state decomposition (warmup excluded) plus the
+        # always-hot flush accounting of the confirmation run
+        "warmup_intervals": confirm.get("warmup_intervals"),
+        "cadence_frac_raw": confirm.get("cadence_frac_raw"),
+        "tick_block_ms_steady": confirm.get("tick_block_ms_steady"),
+        "ingest_stall_ms_steady": confirm.get("ingest_stall_ms_steady"),
+        "flush_ms_steady": confirm.get("flush_ms_steady"),
+        "drain_ms_mean": confirm.get("drain_ms_mean"),
+        "micro_folds_total": confirm.get("micro_folds_total"),
         **({"pipeline": confirm["pipeline"]}
            if confirm.get("pipeline") else {}),
         "search_trials": [
-            {k: t[k] for k in ("offered_lines_per_s",
-                               "accepted_lines_per_s", "loss_frac",
-                               "cadence_frac", "passed",
-                               "tick_block_ms_mean",
-                               "ingest_stall_ms_mean", "flush_ms_mean",
-                               "generate_ms_mean", "emit_ms_mean",
-                               "total_shed")}
+            {k: t.get(k) for k in ("offered_lines_per_s",
+                                   "accepted_lines_per_s", "loss_frac",
+                                   "cadence_frac", "cadence_frac_raw",
+                                   "warmup_intervals", "passed",
+                                   "tick_block_ms_mean",
+                                   "ingest_stall_ms_mean", "flush_ms_mean",
+                                   "tick_block_ms_steady",
+                                   "ingest_stall_ms_steady",
+                                   "generate_ms_mean", "emit_ms_mean",
+                                   "drain_ms_mean", "micro_folds_total",
+                                   "total_shed")}
             for t in search["search_trials"]],
         "north_star_lines_per_s": NORTH_STAR_LINES_PER_S,
         "cores_needed_for_north_star":

@@ -241,6 +241,12 @@ def load_library() -> Optional[ctypes.CDLL]:
             lib.vn_stage_free.argtypes = [c.c_void_p]
             lib.vn_stage_total.restype = c.c_longlong
             lib.vn_stage_total.argtypes = [c.c_void_p]
+            lib.vn_stage_pending.restype = c.c_longlong
+            lib.vn_stage_pending.argtypes = [c.c_void_p]
+            lib.vn_stage_drain_delta.restype = c.c_int64
+            lib.vn_stage_drain_delta.argtypes = [
+                c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+                c.c_void_p, c.c_int64]
             lib.vn_stage_unit_wts.restype = c.c_int
             lib.vn_stage_unit_wts.argtypes = [c.c_void_p]
             lib.vn_reader_start.restype = c.c_void_p
@@ -374,6 +380,28 @@ class NativeIngest:
     @property
     def stage_total(self) -> int:
         return int(self._lib.vn_stage_total(self._ctx))
+
+    @property
+    def stage_pending(self) -> int:
+        """Staged samples not yet copied out by drain_stage_delta
+        (micro-fold due checks). 0 on a stale .so without the API."""
+        fn = getattr(self._lib, "vn_stage_pending", None)
+        return int(fn(self._ctx)) if fn is not None else 0
+
+    def drain_stage_delta(self, cap: int):
+        """Copy up to `cap` not-yet-drained staged samples out as COO
+        (rows, slots, vals, wts) with ABSOLUTE slot positions, advancing
+        the plane's per-row drained watermark. The plane's counts are
+        untouched, so the per-epoch depth cap (and the spill
+        partitioning) is identical to a run with no micro-folds. Raises
+        AttributeError on a stale .so (callers gate on stage_pending)."""
+        rows = np.empty(cap, np.int32)
+        slots = np.empty(cap, np.int32)
+        vals = np.empty(cap, np.float32)
+        wts = np.empty(cap, np.float32)
+        n = self._lib.vn_stage_drain_delta(
+            self._ctx, _ptr(rows), _ptr(slots), _ptr(vals), _ptr(wts), cap)
+        return rows[:n], slots[:n], vals[:n], wts[:n]
 
     def detach_stage(self):
         """Detach the staged plane: returns (vals[rows, depth],

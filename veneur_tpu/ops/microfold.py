@@ -1,0 +1,227 @@
+"""Streaming micro-fold mirror: always-hot device staging.
+
+The once-per-interval flush pays a synchronous upload+fold burst at the
+deadline (SUSTAINED_PIPELINE.json: tick_block_ms ~1100 with chip compute
+in the milliseconds — the device is cold between flushes). This module
+keeps a device-side mirror of the staging plane warm DURING the
+interval: every micro-fold drains the staged samples accumulated since
+the last drain as COO deltas (row, absolute slot, value, weight) and
+scatters them into a persistent [M, B] mirror with donated dispatches,
+so by flush time the staged state is already resident on device and the
+tick's fold collapses to a drain.
+
+Bit-identity by construction: slots are ABSOLUTE positions in the host
+staging plane, so after the final drain the mirror holds exactly the
+dense [S, B] array the batch path would have uploaded (values/weights at
+filled slots, zeros elsewhere — including unit weights, which both paths
+materialize as exact 1.0f). The flush then runs the SAME single
+``_histo_fold_staged`` program over the mirror sliced to ``s_eff`` that
+the batch path runs over its uploaded plane, so micro-folded ==
+batch-folded is bitwise, not approximate (tests/test_microfold.py pins
+all three metric classes).
+
+Transfer accounting stays O(samples) and partition-invariant: uploads go
+out in fixed MICRO_CHUNK-entry COO chunks (16 bytes/entry), the carry
+remainder is buffered host-side across drains, and the final partial
+chunk is padded with drop-sentinel rows (scatter ``mode="drop"``).
+Total bytes = ceil(samples / MICRO_CHUNK) x MICRO_CHUNK x 16 no matter
+how many micro-folds the scheduler ran — the ledger-equality contract
+(tests assert +-0 against a single-drain run) and a single jit
+specialization (no per-size compile ladder).
+
+Overlap discipline (double buffering): each chunk's four COO arrays are
+device_put first (async), then the scatter is dispatched; with at most
+two unsynced scatters in the queue the upload of chunk N+1 overlaps the
+scatter of chunk N, and the fence (block on the latest mirror) bounds
+the dispatch queue so a fast producer cannot run the host arbitrarily
+far ahead of the device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# COO entries per upload chunk. 65536 x 16B = 1 MB per dispatch: large
+# enough to amortize dispatch overhead (and, on backends that cannot
+# honor the scatter's donation — XLA-CPU copies the whole [M, B] mirror
+# per dispatch — to keep the per-interval dispatch count in the single
+# digits), small enough that the carry buffer and the padded final
+# chunk stay trivial and uploads still interleave with compute.
+MICRO_CHUNK = 65536
+
+# Sentinel row for padding the final partial chunk: out of bounds for
+# any mirror, so the donated scatter's mode="drop" discards it.
+DROP_ROW = np.int32(np.iinfo(np.int32).max)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_chunk(dvals, dwts, rows, slots, vals, wts):
+    """Scatter one COO chunk into the mirror (padding rows dropped)."""
+    dvals = dvals.at[rows, slots].set(vals, mode="drop")
+    dwts = dwts.at[rows, slots].set(wts, mode="drop")
+    return dvals, dwts
+
+
+@functools.partial(jax.jit, static_argnames=("new_rows",),
+                   donate_argnums=(0,))
+def _grow_mirror(old, new_rows: int):
+    s, b = old.shape
+    return jnp.zeros((new_rows, b), old.dtype).at[:s].set(old)
+
+
+def mirror_dense(arr, s_eff: int):
+    """The mirror as a dense [s_eff, B] plane: slice when the mirror is
+    larger, zero-pad when the directory outgrew it. Either way the
+    result is bitwise the array the batch path would have built."""
+    m = arr.shape[0]
+    if m == s_eff:
+        return arr
+    if m > s_eff:
+        return arr[:s_eff]
+    return jnp.zeros((s_eff, arr.shape[1]), arr.dtype).at[:m].set(arr)
+
+
+class MirrorState(NamedTuple):
+    """A finished epoch's mirror, handed to the swapped-epoch extract."""
+
+    vals: jax.Array
+    wts: jax.Array
+    rows_hi: int
+    samples: int
+    chunks: int
+
+
+class MicroFoldMirror:
+    """Device-side [M, B] mirror of one epoch's staging plane.
+
+    Single-threaded by contract: the worker's ingest lock serializes
+    feed() (micro-fold scheduler) against finish() (swap). The ledger
+    (optional) books uploads into its epoch accumulator, so the flush
+    that extracts this epoch reports them.
+    """
+
+    def __init__(self, depth: int, ledger=None,
+                 initial_rows: int = 1024,
+                 chunk: int = MICRO_CHUNK) -> None:
+        self.depth = int(depth)
+        self.chunk = int(chunk)
+        self._ledger = ledger
+        # False while the epoch is live (uploads book into the ledger's
+        # epoch accumulator, surfaced by the flush that extracts it);
+        # the swap rotation flips it True so the deferred residual feeds
+        # — which run inside extract_snapshot, after begin_flush() popped
+        # this epoch's tally as the open window — book into that same
+        # window directly.
+        self.book_in_flush = False
+        self._rows0 = max(1, int(initial_rows))
+        self._dvals: Optional[jax.Array] = None
+        self._dwts: Optional[jax.Array] = None
+        self._m = 0
+        self.rows_hi = 0   # 1 + highest real row scattered this epoch
+        self.samples = 0   # real COO entries fed (padding excluded)
+        self.chunks = 0    # fixed-size scatter dispatches
+        self._unsynced = 0
+        # carry buffer: the partial-chunk remainder persists across
+        # drains so upload totals are partition-invariant
+        self._c_rows = np.empty(self.chunk, np.int32)
+        self._c_slots = np.empty(self.chunk, np.int32)
+        self._c_vals = np.empty(self.chunk, np.float32)
+        self._c_wts = np.empty(self.chunk, np.float32)
+        self._c_n = 0
+
+    def feed(self, rows, slots, vals, wts) -> None:
+        """Buffer one drained COO delta; dispatch every full chunk."""
+        n = len(rows)
+        if n == 0:
+            return
+        self.samples += n
+        hi = int(rows.max()) + 1
+        if hi > self.rows_hi:
+            self.rows_hi = hi
+        i = 0
+        while i < n:
+            take = min(self.chunk - self._c_n, n - i)
+            s = slice(self._c_n, self._c_n + take)
+            self._c_rows[s] = rows[i:i + take]
+            self._c_slots[s] = slots[i:i + take]
+            self._c_vals[s] = vals[i:i + take]
+            self._c_wts[s] = wts[i:i + take]
+            self._c_n += take
+            i += take
+            if self._c_n == self.chunk:
+                self._dispatch()
+                self._c_n = 0
+
+    def finish(self) -> Optional[MirrorState]:
+        """Flush the carry (padded to a full chunk with drop-sentinel
+        rows), detach the mirror for the swapped epoch, and reset.
+        None when nothing was staged this epoch."""
+        if self.samples == 0:
+            self._c_n = 0
+            return None
+        if self._c_n > 0:
+            self._c_rows[self._c_n:] = DROP_ROW
+            self._c_slots[self._c_n:] = 0
+            self._c_vals[self._c_n:] = 0.0
+            self._c_wts[self._c_n:] = 0.0
+            self._dispatch()
+            self._c_n = 0
+        state = MirrorState(self._dvals, self._dwts, self.rows_hi,
+                            self.samples, self.chunks)
+        self._dvals = None
+        self._dwts = None
+        self._m = 0
+        self.rows_hi = 0
+        self.samples = 0
+        self.chunks = 0
+        self._unsynced = 0
+        return state
+
+    # -- internals --------------------------------------------------------
+
+    def _dispatch(self) -> None:
+        # upload first (async) so it overlaps the in-flight scatter
+        if self._ledger is not None:
+            up = (self._ledger.h2d if self.book_in_flush
+                  else self._ledger.epoch_h2d)
+            drows = up(self._c_rows, "micro_fold")
+            dslots = up(self._c_slots, "micro_fold")
+            dvals = up(self._c_vals, "micro_fold")
+            dwts = up(self._c_wts, "micro_fold")
+        else:
+            drows = jnp.asarray(self._c_rows)
+            dslots = jnp.asarray(self._c_slots)
+            dvals = jnp.asarray(self._c_vals)
+            dwts = jnp.asarray(self._c_wts)
+        self._ensure_rows(self.rows_hi)
+        # double-buffer fence: at most two unsynced scatters queued
+        self._unsynced += 1
+        if self._unsynced > 2:
+            jax.block_until_ready(self._dvals)
+            self._unsynced = 1
+        self._dvals, self._dwts = _scatter_chunk(
+            self._dvals, self._dwts, drows, dslots, dvals, dwts)
+        self.chunks += 1
+
+    def _ensure_rows(self, needed: int) -> None:
+        if self._dvals is None:
+            m = self._rows0
+            while m < needed:
+                m *= 2
+            self._dvals = jnp.zeros((m, self.depth), jnp.float32)
+            self._dwts = jnp.zeros((m, self.depth), jnp.float32)
+            self._m = m
+            return
+        if needed <= self._m:
+            return
+        m = self._m
+        while m < needed:
+            m *= 2
+        self._dvals = _grow_mirror(self._dvals, m)
+        self._dwts = _grow_mirror(self._dwts, m)
+        self._m = m
