@@ -151,16 +151,45 @@ def test_proxy_consistent_routing():
         imp2.stop()
 
 
-def test_proxy_unreachable_destination_counts_drops():
-    proxy = ProxyServer(["127.0.0.1:1"])  # nothing listens there
-    proxy.timeout_s = 0.5
+def test_proxy_unreachable_destination_spills_then_counts_drops():
+    from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+    # default policy: a transient failure (connection refused) DEFERS
+    # the fragment to the bounded spill — the delivery layer holds it
+    # for retry/re-route instead of the old drop-on-first-failure
+    proxy = ProxyServer(["127.0.0.1:1"],  # nothing listens there
+                        timeout_s=0.5, handoff_window_s=60.0,
+                        delivery=DeliveryPolicy(
+                            retry_max=0, timeout_s=0.5, deadline_s=0.5,
+                            backoff_base_s=0.01))
     batch = codec.pb.MetricBatch()
     m = batch.metrics.add()
     m.name = "x"
     m.kind = codec.pb.KIND_COUNTER
     m.counter.value = 1
     proxy._route_batch(batch)
+    assert proxy.drops == 0
+    assert proxy.spilled_metrics == 1
+    assert proxy.conserved()
+    proxy.stop()
+
+    # spill disabled (caps 0): the deferral becomes an honest drop —
+    # the pre-PR-7 accounting as the degenerate configuration
+    proxy = ProxyServer(["127.0.0.1:1"],
+                        timeout_s=0.5, handoff_window_s=60.0,
+                        delivery=DeliveryPolicy(
+                            retry_max=0, spill_max_bytes=0,
+                            spill_max_payloads=0, timeout_s=0.5,
+                            deadline_s=0.5, backoff_base_s=0.01))
+    batch2 = codec.pb.MetricBatch()
+    m = batch2.metrics.add()
+    m.name = "x"
+    m.kind = codec.pb.KIND_COUNTER
+    m.counter.value = 1
+    proxy._route_batch(batch2)
     assert proxy.drops == 1
+    assert proxy.spilled_metrics == 0
+    assert proxy.conserved()
     proxy.stop()
 
 
@@ -255,6 +284,73 @@ def test_ring_set_members_prunes():
     assert ring.set_members(["b:1", "c:1"])
     assert ring.members() == ["b:1", "c:1"]
     assert not ring.set_members(["b:1", "c:1"])  # no change
+
+
+def test_ring_version_bumps_once_per_mutation():
+    ring = ConsistentRing(["a:1", "b:1"])
+    assert ring.version == 1  # construction with members is version 1
+    change = ring.set_members(["a:1", "b:1", "c:1"])
+    assert change is not None and change.version == ring.version == 2
+    assert change.added == ["c:1"] and change.removed == []
+    assert ring.set_members(["a:1", "b:1", "c:1"]) is None  # no-op: no bump
+    assert ring.version == 2
+    assert ring.add("c:1") is None and ring.version == 2
+    assert ring.remove("zzz:1") is None and ring.version == 2
+    assert ring.remove("c:1").version == 3
+    assert ring.add("d:1").version == 4
+    assert ConsistentRing([]).version == 0  # empty construction is version 0
+
+
+def test_ring_change_diff_is_exactly_the_moved_keys():
+    # the moved_ranges diff must agree with brute-force owner comparison
+    # in BOTH directions: every key whose owner changed falls inside a
+    # moved range, and every key inside a moved range changed owner
+    members = [f"g{i}:80" for i in range(4)]
+    ring = ConsistentRing(members)
+    keys = [f"diffkey-{i}" for i in range(600)]
+    before = {k: ring.get(k) for k in keys}
+    change = ring.set_members([m for m in members if m != "g2:80"])
+    assert change.removed == ["g2:80"]
+    for k in keys:
+        h = ConsistentRing._hash(k)
+        moved = before[k] != ring.get(k)
+        assert change.owner_changed(h) == moved, k
+    # minimal remap: a leave only moves arcs the departed member owned
+    assert all(old == "g2:80" for _, _, old, _ in change.moved_ranges)
+    assert 0.0 < change.moved_fraction() < 0.6
+
+
+def test_ring_concurrent_lookup_sees_one_membership():
+    # owners_for_hashes racing set_members must place every hash of one
+    # call on ONE snapshot: all returned owners belong to set A or all
+    # to set B, never a mix of a member only in A with one only in B
+    import threading as _threading
+
+    set_a = ["a:1", "b:1", "c:1"]
+    set_b = ["b:1", "c:1", "d:1", "e:1"]
+    only_a, only_b = {"a:1"}, {"d:1", "e:1"}
+    ring = ConsistentRing(set_a)
+    hashes = np.asarray([ConsistentRing._hash(f"race-{i}")
+                         for i in range(200)], dtype=np.uint64)
+    stop = _threading.Event()
+    violations = []
+
+    def flip():
+        while not stop.is_set():
+            ring.set_members(set_b)
+            ring.set_members(set_a)
+
+    t = _threading.Thread(target=flip)
+    t.start()
+    try:
+        for _ in range(300):
+            owners = set(ring.owners_for_hashes(hashes))
+            if owners & only_a and owners & only_b:
+                violations.append(owners)
+    finally:
+        stop.set()
+        t.join()
+    assert not violations, violations[:3]
 
 
 # ---------------------------------------------------------------------------

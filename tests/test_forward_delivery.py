@@ -1,0 +1,416 @@
+"""Forward-path delivery guarantees: reshard handoff, spill re-routing,
+breaker cycles, and the bounded routing executor (distributed/proxy.py
+over sinks/delivery.py).
+
+The acceptance pin for the live-membership tier is
+test_reshard_mid_batch_lands_every_metric_exactly_once: a destination
+dies mid-batch, the membership reshards it away, and every metric still
+lands on exactly one live owner — nothing lost, nothing duplicated.
+"""
+
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.core.config import load_proxy_config
+from veneur_tpu.distributed import codec, rpc
+from veneur_tpu.distributed.discovery import StaticDiscoverer
+from veneur_tpu.distributed.proxy import (
+    DestinationRefresher,
+    ProxyServer,
+    RoutingPool,
+)
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class ScriptedClient:
+    """Forward-client stand-in with a harness-scripted `down` switch:
+    down sends raise a classified transient ForwardError (the shape the
+    real gRPC client raises for an unreachable peer); up sends record
+    the delivered metric names."""
+
+    def __init__(self, dest):
+        self.address = dest
+        self.down = False
+        self.sent = []            # metric names, in delivery order
+        self.send_calls = 0
+        self._lock = threading.Lock()
+
+    def _gate(self):
+        with self._lock:
+            self.send_calls += 1
+            if self.down:
+                raise rpc.ForwardError("unavailable", self.address,
+                                       "scripted: down")
+
+    def send_or_raise(self, batch, timeout_s=None):
+        self._gate()
+        with self._lock:
+            self.sent.extend(m.name for m in batch.metrics)
+
+    def send_raw_or_raise(self, blob, n_metrics, timeout_s=None):
+        self._gate()
+        with self._lock:
+            self.sent.extend(
+                m.name for m in pb.MetricBatch.FromString(blob).metrics)
+
+    def send(self, batch, timeout_s=None):
+        try:
+            self.send_or_raise(batch, timeout_s)
+        except Exception:
+            return False
+        return True
+
+    def send_raw(self, blob, n_metrics, timeout_s=None):
+        try:
+            self.send_raw_or_raise(blob, n_metrics, timeout_s)
+        except Exception:
+            return False
+        return True
+
+    def stats(self):
+        return {"address": self.address, "reconnects": 0, "errors": {}}
+
+    def close(self):
+        pass
+
+
+def _fast_policy(**overrides):
+    kw = dict(retry_max=0, breaker_threshold=0, timeout_s=0.2,
+              deadline_s=0.2, backoff_base_s=0.001, backoff_max_s=0.005)
+    kw.update(overrides)
+    return DeliveryPolicy(**kw)
+
+
+def _make_proxy(dests, clients, policy=None, **kw):
+    kw.setdefault("handoff_window_s", 60.0)  # bg drain stays out of the way
+    return ProxyServer(
+        dests, timeout_s=0.5,
+        delivery=policy or _fast_policy(),
+        client_factory=lambda dest, timeout_s, idle_timeout_s: clients[dest],
+        **kw)
+
+
+def _batch(names):
+    batch = pb.MetricBatch()
+    for name in names:
+        m = batch.metrics.add()
+        m.name = name
+        m.kind = pb.KIND_COUNTER
+        m.counter.value = 1
+    return batch
+
+
+def test_reshard_mid_batch_lands_every_metric_exactly_once():
+    # ISSUE acceptance pin: dest B dies mid-batch; the ring reshards B
+    # away; the handoff drain re-routes B's spilled fragment under the
+    # NEW ring — every metric lands on exactly one surviving owner.
+    dests = ["a:1", "b:1", "c:1"]
+    clients = {d: ScriptedClient(d) for d in dests}
+    proxy = _make_proxy(dests, clients, handoff_window_s=0.1)
+    try:
+        names = [f"reshard-{i}" for i in range(60)]
+        # make sure the batch actually straddles B (the test is vacuous
+        # if no key hashes there)
+        assert any(proxy.ring.get(
+            codec.metric_key(m).key_string()) == "b:1"
+            for m in _batch(names).metrics)
+        clients["b:1"].down = True
+        proxy._route_batch(_batch(names))
+        assert proxy.drops == 0
+        assert proxy.spilled_metrics > 0  # B's share parked, not lost
+        assert proxy.conserved()
+
+        change = proxy.set_destinations(["a:1", "c:1"])
+        assert change is not None and change.removed == ["b:1"]
+        # the reshard wakes the drain thread: B's spill re-routes to the
+        # survivors without any further prodding
+        assert _wait_until(lambda: proxy.spilled_metrics == 0, timeout=5.0)
+        assert proxy.drops == 0
+        assert proxy.conserved()
+
+        landed = clients["a:1"].sent + clients["b:1"].sent \
+            + clients["c:1"].sent
+        assert sorted(landed) == sorted(names)  # exactly once, each
+        assert not clients["b:1"].sent          # B never took a metric
+        assert proxy.proxied_metrics == len(names)
+        assert proxy.reshards == 1
+        assert proxy.forward_stats()["ring_version"] == 2
+    finally:
+        proxy.stop()
+
+
+def test_spill_redelivered_to_recovered_destination():
+    # no reshard: a transient outage spills, and the periodic drain
+    # re-delivers to the SAME owner once it recovers
+    clients = {"a:1": ScriptedClient("a:1")}
+    proxy = _make_proxy(["a:1"], clients)
+    try:
+        clients["a:1"].down = True
+        proxy._route_batch(_batch(["recover-0", "recover-1"]))
+        assert proxy.spilled_metrics == 2 and proxy.drops == 0
+
+        clients["a:1"].down = False
+        drained = proxy.drain_spill()
+        assert drained["drained_metrics"] == 2
+        assert proxy.spilled_metrics == 0 and proxy.drops == 0
+        assert sorted(clients["a:1"].sent) == ["recover-0", "recover-1"]
+        assert proxy.conserved()
+    finally:
+        proxy.stop()
+
+
+def test_breaker_cycle_open_half_open_closed_on_revival():
+    clients = {"a:1": ScriptedClient("a:1")}
+    proxy = _make_proxy(["a:1"], clients,
+                        policy=_fast_policy(breaker_threshold=1))
+    try:
+        clients["a:1"].down = True
+        proxy._route_batch(_batch(["brk-0"]))   # fails → breaker opens
+        proxy._route_batch(_batch(["brk-1"]))   # short-circuits → spill
+
+        def delivery():
+            return proxy.forward_stats()["destinations"]["a:1"]["delivery"]
+
+        assert delivery()["circuit_state"] == "open"
+        calls_before = clients["a:1"].send_calls
+        # drain while still down: exactly ONE half-open probe goes out,
+        # fails, and the breaker re-opens — a dead peer costs one probe
+        # per drain interval, not a retry storm
+        proxy.drain_spill()
+        assert clients["a:1"].send_calls == calls_before + 1
+        assert delivery()["circuit_state"] == "open"
+        assert proxy.drops == 0
+
+        clients["a:1"].down = False
+        proxy.drain_spill()  # probe succeeds → closed, spill delivered
+        st = delivery()
+        assert st["circuit_state"] == "closed"
+        # the full revival cycle, in order
+        transitions = st["breaker_transitions"]
+        want = iter(transitions)
+        assert all(s in want for s in ("open", "half_open", "closed"))
+        assert proxy.spilled_metrics == 0 and proxy.drops == 0
+        assert sorted(clients["a:1"].sent) == ["brk-0", "brk-1"]
+        assert proxy.conserved()
+    finally:
+        proxy.stop()
+
+
+def test_routing_pool_sheds_when_full_with_honest_counters():
+    release = threading.Event()
+    in_send = threading.Event()
+
+    class BlockingClient(ScriptedClient):
+        def _gate(self):
+            in_send.set()
+            release.wait(10.0)
+            super()._gate()
+
+    clients = {"a:1": BlockingClient("a:1")}
+    proxy = _make_proxy(["a:1"], clients,
+                        policy=_fast_policy(deadline_s=30.0, timeout_s=30.0),
+                        routing_workers=1, routing_queue_max=1)
+    try:
+        proxy.handle_batch(_batch(["shed-0"]))
+        assert in_send.wait(5.0)                 # the one worker is busy
+        proxy.handle_batch(_batch(["shed-1", "shed-1b"]))  # queued (depth 1)
+        proxy.handle_batch(_batch(["shed-2", "shed-2b", "shed-2c"]))  # full
+        stats = proxy.forward_stats()
+        assert stats["routing"]["shed_batches"] == 1
+        assert proxy.shed_metrics == 3           # per-METRIC honest count
+        assert proxy.drops == 3                  # sheds are declared drops
+
+        release.set()
+        assert _wait_until(
+            lambda: proxy.forward_stats()["routing"]["routed"] == 2)
+        assert proxy.proxied_metrics == 3        # shed-0 + shed-1 + shed-1b
+        assert proxy.conserved()
+        # sustained shedding feeds the downstream-behind signal
+        assert not proxy._pool.behind()          # single shed: not behind
+    finally:
+        release.set()
+        proxy.stop()
+
+
+def test_routing_pool_behind_signal_after_consecutive_sheds():
+    # wedge the queue so submits shed: fill it while the one worker is
+    # parked on the first item; ≥2 consecutive sheds flips `behind`
+    gate = threading.Event()
+    pool = RoutingPool(lambda kind, item: gate.wait(5.0),
+                       workers=1, queue_max=1)
+    try:
+        assert pool.submit("batch", 1)
+        _wait_until(lambda: pool.stats()["queue_depth"] == 0)
+        assert pool.submit("batch", 2)      # queued
+        assert not pool.submit("batch", 3)  # shed 1
+        assert not pool.behind()
+        assert not pool.submit("batch", 4)  # shed 2 → behind
+        assert pool.behind()
+        gate.set()
+        _wait_until(lambda: pool.stats()["queue_depth"] == 0)
+        if pool.submit("batch", 5):         # accepted submit resets the gate
+            assert not pool.behind()
+    finally:
+        gate.set()
+        pool.stop()
+
+
+def test_route_batch_mid_loop_ring_loss_drops_only_remainder():
+    # satellite (b): the ring emptying mid-route must lose only the
+    # UN-routed remainder; metrics already grouped still forward
+    clients = {"a:1": ScriptedClient("a:1"), "b:1": ScriptedClient("b:1")}
+    proxy = _make_proxy(["a:1", "b:1"], clients)
+    try:
+        real_ring = proxy.ring
+
+        class FlakyRing:
+            def __init__(self, fail_after):
+                self.gets = 0
+                self.fail_after = fail_after
+
+            def get(self, key):
+                if self.gets >= self.fail_after:
+                    raise LookupError("empty ring")
+                self.gets += 1
+                return real_ring.get(key)
+
+            def __getattr__(self, name):
+                return getattr(real_ring, name)
+
+        proxy.ring = FlakyRing(fail_after=2)
+        proxy._route_batch(_batch([f"flaky-{i}" for i in range(5)]))
+        assert proxy.drops == 3                  # only the remainder
+        assert proxy.proxied_metrics == 2        # the grouped prefix lands
+        landed = clients["a:1"].sent + clients["b:1"].sent
+        assert sorted(landed) == ["flaky-0", "flaky-1"]
+        assert proxy.conserved()
+    finally:
+        proxy.ring = real_ring
+        proxy.stop()
+
+
+def test_refresher_empty_set_keeps_last_refresh_stale():
+    # satellite (a): an empty discovery answer keeps the ring AND keeps
+    # last_refresh stale — staleness telemetry must not report a healthy
+    # feed while the ring ages unrefreshed
+    clients = {"a:1": ScriptedClient("a:1")}
+    proxy = _make_proxy(["a:1"], clients)
+    disc = StaticDiscoverer(["a:1"])
+    try:
+        refresher = DestinationRefresher(proxy, disc, "veneur-global",
+                                         interval_s=3600.0)
+        refresher.refresh()
+        t_good = refresher.last_refresh
+        assert t_good > 0
+
+        disc.empty_next(1)
+        refresher.refresh()
+        assert refresher.refresh_empty == 1
+        assert refresher.last_refresh == t_good  # NOT advanced
+        assert len(proxy.ring) == 1              # last-good kept
+
+        disc.fail_next(1)
+        refresher.refresh()
+        assert refresher.refresh_errors == 1
+        assert refresher.last_refresh == t_good
+
+        stats = proxy.forward_stats()
+        assert stats["refresh_errors"] == 1
+        assert stats["refresh"]["refresh_empty"] == 1
+        assert stats["refresh"]["last_refresh_age_s"] is not None
+        assert stats["ring_version"] == 1
+        assert stats["ring_age_s"] >= 0.0
+    finally:
+        proxy.stop()
+
+
+def test_departed_manager_retired_after_spill_drains():
+    dests = ["a:1", "b:1"]
+    clients = {d: ScriptedClient(d) for d in dests}
+    proxy = _make_proxy(dests, clients)
+    try:
+        clients["b:1"].down = True
+        names = [f"retire-{i}" for i in range(40)]
+        proxy._route_batch(_batch(names))
+        assert proxy.spilled_metrics > 0
+        assert "b:1" in proxy._managers
+
+        proxy.set_destinations(["a:1"])
+        assert _wait_until(lambda: proxy.spilled_metrics == 0, timeout=5.0)
+        # B's manager is gone once its spill drained and nothing is in
+        # flight; its conservation closed out via handoff accounting
+        assert _wait_until(lambda: "b:1" not in proxy._managers, timeout=5.0)
+        assert proxy.drops == 0
+        assert sorted(clients["a:1"].sent) == sorted(names)
+        assert proxy.conserved()
+    finally:
+        proxy.stop()
+
+
+def test_handoff_window_exhaustion_parks_instead_of_sending():
+    # bounded handoff: a drain pass past its window parks fragments on
+    # the new owner WITHOUT a network attempt (they go out next drain)
+    clients = {"a:1": ScriptedClient("a:1")}
+    proxy = _make_proxy(["a:1"], clients)
+    try:
+        clients["a:1"].down = True
+        proxy._route_batch(_batch(["park-0"]))
+        assert proxy.spilled_metrics == 1
+        clients["a:1"].down = False
+        calls_before = clients["a:1"].send_calls
+        proxy.drain_spill(window_s=0.0)          # window already exhausted
+        assert clients["a:1"].send_calls == calls_before  # no send attempt
+        assert proxy.spilled_metrics == 1        # parked, still conserved
+        assert proxy.conserved()
+        proxy.drain_spill()                      # a real window delivers it
+        assert proxy.spilled_metrics == 0
+        assert clients["a:1"].sent == ["park-0"]
+        assert proxy.conserved()
+    finally:
+        proxy.stop()
+
+
+def test_proxy_config_validation_accepts_and_rejects():
+    cfg = load_proxy_config(data={"forward_retry_max": 5,
+                                  "handoff_window_s": 2.5,
+                                  "routing_queue_max": 64}, env={})
+    assert cfg.forward_retry_max == 5
+    assert cfg.handoff_window_s == 2.5
+    assert cfg.routing_queue_max == 64
+
+    for bad in ({"handoff_window_s": 0},
+                {"handoff_window_s": -1.0},
+                {"routing_queue_max": 0},
+                {"routing_pool_workers": 0},
+                {"forward_retry_max": -1},
+                {"forward_breaker_threshold": -2},
+                {"forward_spill_max_bytes": -1},
+                {"max_idle_conns": -1}):
+        with pytest.raises(ValueError):
+            load_proxy_config(data=bad, env={})
+
+
+def test_static_discoverer_scripting():
+    disc = StaticDiscoverer(["a:1", "b:1"])
+    assert disc.get_destinations_for_service("x") == ["a:1", "b:1"]
+    disc.set_destinations(["c:1"])
+    assert disc.get_destinations_for_service("x") == ["c:1"]
+    disc.fail_next(1)
+    with pytest.raises(ConnectionError):
+        disc.get_destinations_for_service("x")
+    assert disc.get_destinations_for_service("x") == ["c:1"]  # recovered
+    disc.empty_next(1)
+    assert disc.get_destinations_for_service("x") == []
+    assert disc.calls == 5

@@ -238,11 +238,12 @@ def test_proxy_import_hop_continues_trace_and_ring_routes_span():
         assert span.trace_id == parent.span.trace_id
         assert span.parent_id == parent.span.id
         # the body's metric was decoded and ring-routed (to the
-        # unreachable destination, where it counts as a drop)
+        # unreachable destination, where it spills for redelivery)
         deadline = time.time() + 5.0
-        while proxy.drops < 1 and time.time() < deadline:
+        while proxy.spilled_metrics < 1 and time.time() < deadline:
             time.sleep(0.05)
-        assert proxy.drops == 1
+        assert proxy.spilled_metrics == 1
+        assert proxy.drops == 0
     finally:
         front.stop()
         tp.stop()

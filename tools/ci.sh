@@ -87,6 +87,20 @@ echo "== delivery chaos lane (seeded fault soak) =="
 timeout -k 10 120 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python tools/soak_faults.py --quick
 
+# Ring-churn chaos lane: local → proxy → 3 globals over real gRPC while
+# a scripted schedule kills/restarts a member (breaker cycle on the
+# revival), reshards the ring twice through the discovery-refresh path,
+# and flaps discovery — under seeded transient forward faults. Gates
+# the live-membership tier's contracts (distributed/proxy.py): exact
+# tier-wide conservation, zero drops/sheds, spill fully settled, and a
+# full breaker open→half-open→closed cycle. Artifact: RING_CHURN_SOAK
+# .json (committed copy is the full 36-interval run; the lane redirects
+# its miniature artifact to /tmp so quick never clobbers it).
+echo "== ring-churn chaos lane (seeded membership soak) =="
+timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/soak_ring_churn.py --quick
+
 # Sustained-rate floor: the loadgen harness drives a live server's UDP
 # socket at a fixed offered rate for 5 flush intervals and fails on
 # loss or broken flush cadence. 50k lines/s with the pipelined flush

@@ -1,0 +1,369 @@
+"""Chaos churn soak for the live-membership global tier.
+
+One local Server forwards every interval through a ProxyServer to N
+global Servers over real gRPC, while a seeded, scripted chaos schedule
+exercises the whole PR-7 robustness surface:
+
+- a global is KILLED (its gRPC import server stops cold) and later
+  RESTARTED on the same port, while staying in the ring — its arc's
+  fragments spill bounded and deliver after revival, driving the
+  per-destination circuit breaker through a full
+  open → half-open → closed cycle;
+- the ring RESHARDS at least twice (a join and a leave flow through
+  StaticDiscoverer + DestinationRefresher — the real discovery path),
+  and the handoff drain re-routes every spilled fragment under the new
+  membership;
+- a link PARTITIONS for a window (FaultyForwardClient.set_partitioned)
+  and heals;
+- discovery FLAPS (one injected failure, one empty answer) and must
+  keep the last-good ring with honest staleness counters;
+- every forward send runs through a seeded FaultPlan injecting ONLY
+  transient faults (refusals, sub-deadline slowness), so the retry/
+  spill machinery is continuously exercised without any legitimate
+  drop.
+
+Pass criteria, checked after a bounded settling drain:
+
+    exact tier-wide conservation  ingested == globally flushed
+                                  (counters AND histogram .count sums),
+    proxy.drops == 0, zero routing sheds, zero import errors,
+    proxied == received across every kill/partition/reshard,
+    a full breaker cycle on the revived member,
+    refresh_errors >= 1 and refresh_empty >= 1,
+    every per-destination delivery ledger conserved.
+
+Writes RING_CHURN_SOAK.json at the repo root (VENEUR_ARTIFACT_DIR
+redirects) and prints one JSON line; exits nonzero on any violation.
+
+--quick is the CI lane: 3 globals, short run, one kill/restart plus a
+leave/rejoin reshard pair — same invariants, miniature schedule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _soak_common import rss_mb, write_artifact  # noqa: E402
+from soak_faults import has_breaker_cycle  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI lane: 3 globals, short schedule")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.flusher import (
+        device_quantiles,
+        generate_inter_metrics,
+    )
+    from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.distributed import rpc
+    from veneur_tpu.distributed.discovery import StaticDiscoverer
+    from veneur_tpu.distributed.forward import install_forwarder
+    from veneur_tpu.distributed.import_server import ImportServer
+    from veneur_tpu.distributed.proxy import (
+        DestinationRefresher,
+        ProxyServer,
+    )
+    from veneur_tpu.sinks.delivery import DeliveryPolicy
+    from veneur_tpu.utils.faults import FaultPlan, FaultyForwardClient
+
+    quick = args.quick
+    n_globals = 3 if quick else 4
+    intervals = int(os.environ.get("VENEUR_SOAK_INTERVALS",
+                                   14 if quick else 36))
+    s_histo = int(os.environ.get("VENEUR_SOAK_HISTO_SERIES",
+                                 200 if quick else 800))
+    s_counter = int(os.environ.get("VENEUR_SOAK_COUNTER_SERIES",
+                                   100 if quick else 300))
+    pcts = [0.5, 0.99]
+    aggs = ["min", "max", "count"]
+    per_interval = s_histo + s_counter
+    rss0 = rss_mb()
+    t_start = time.perf_counter()
+
+    globals_ = []
+    for _ in range(n_globals):
+        cfg = Config(interval="10s", percentiles=pcts, aggregates=aggs,
+                     num_workers=2)
+        srv = Server(cfg)
+        imp = ImportServer(srv)
+        imp.start_grpc()
+        globals_.append((srv, imp))
+
+    def addr(i: int) -> str:
+        return globals_[i][1].address
+
+    # every proxy->global link gets a seeded fault wrapper injecting
+    # ONLY transient kinds (refusals + sub-deadline slowness): the
+    # delivery layer must absorb them without a single legitimate drop
+    fault_clients: dict[str, FaultyForwardClient] = {}
+
+    def client_factory(dest: str, timeout_s: float,
+                       idle_timeout_s: float) -> FaultyForwardClient:
+        inner = rpc.ForwardClient(dest, timeout_s,
+                                  idle_timeout_s=idle_timeout_s)
+        plan = FaultPlan(seed=args.seed + sum(dest.encode()),
+                         p_refuse=0.04, p_slow=0.04, slow_s=0.03)
+        fc = FaultyForwardClient(plan, inner)
+        fault_clients[dest] = fc
+        return fc
+
+    policy = DeliveryPolicy(retry_max=2, breaker_threshold=3,
+                            spill_max_bytes=8 << 20, spill_max_payloads=512,
+                            timeout_s=1.0, deadline_s=1.0,
+                            backoff_base_s=0.02, backoff_max_s=0.1)
+    # the LAST global joins mid-run (full mode); quick runs a
+    # leave/rejoin pair on it instead
+    initial = list(range(n_globals if quick else n_globals - 1))
+    proxy = ProxyServer([addr(i) for i in initial], timeout_s=2.0,
+                        delivery=policy, handoff_window_s=0.5,
+                        client_factory=client_factory)
+    pport = proxy.start_grpc()
+
+    disc = StaticDiscoverer([addr(i) for i in initial])
+    refresher = DestinationRefresher(proxy, disc, "veneur-global",
+                                     interval_s=3600.0)  # driven manually
+
+    lcfg = Config(interval="10s", percentiles=pcts, aggregates=aggs,
+                  forward_address=f"127.0.0.1:{pport}",
+                  forward_use_grpc=True)
+    local = Server(lcfg)
+    install_forwarder(local)
+
+    def received_total() -> int:
+        return sum(imp.received_metrics for _, imp in globals_)
+
+    # -- the chaos schedule, by interval index (seeded + scripted: the
+    # run is reproducible) -------------------------------------------------
+    churn = n_globals - 1           # the member that joins/leaves
+    victim = 1                      # the member that is killed/restarted
+    part = 2                        # the member whose link partitions
+    if quick:
+        # 3 globals, 14 intervals: flaps at 2/3, kill 4..7, leave 9,
+        # rejoin 11 (two reshard events)
+        fail_flap_at, empty_flap_at = 2, 3
+        kill_at, restart_at = 4, 7
+        leave_at, rejoin_at = 9, 11
+        join_at = None
+        part_window = None
+    else:
+        fail_flap_at, empty_flap_at = 4, 5
+        join_at = intervals // 3                 # reshard 1: churn joins
+        kill_at, restart_at = join_at + 2, join_at + 6
+        part_window = (restart_at + 2, restart_at + 5)
+        leave_at = 2 * intervals // 3            # reshard 2: member 0 leaves
+        rejoin_at = None
+    events = []
+
+    def log_event(it: int, event: str, **kw) -> None:
+        events.append({"interval": it, "event": event, **kw})
+        print(json.dumps(events[-1]), file=sys.stderr, flush=True)
+
+    victim_addr = addr(victim)
+    interval_receipts = []
+    for it in range(intervals):
+        if it == fail_flap_at:
+            disc.fail_next(1)
+            log_event(it, "discovery_fail_flap")
+        elif it == empty_flap_at:
+            disc.empty_next(1)
+            log_event(it, "discovery_empty_flap")
+        if join_at is not None and it == join_at:
+            disc.set_destinations([addr(i) for i in range(n_globals)])
+            log_event(it, "join", member=addr(churn))
+        if it == kill_at:
+            # cold-stop the victim's import server; it STAYS in the ring
+            # (a crashed-but-registered instance), so its arc spills and
+            # its breaker opens — the revival must close the full cycle.
+            # Settle the spill first: a drain-thread delivery in flight
+            # at the cold stop could land AND error (grace=0 cancels the
+            # response), and its retry would double-deliver
+            settle_tries = 0
+            while proxy.spilled_metrics > 0 and settle_tries < 100:
+                proxy.drain_spill()
+                settle_tries += 1
+                time.sleep(0.02)
+            globals_[victim][1].stop(grace=0)
+            log_event(it, "kill", member=victim_addr)
+        elif it == restart_at:
+            globals_[victim][1].start_grpc(victim_addr)
+            log_event(it, "restart", member=victim_addr)
+        if part_window is not None and it == part_window[0]:
+            fc = fault_clients.get(addr(part))
+            if fc is not None:
+                fc.set_partitioned(True)
+            log_event(it, "partition", member=addr(part))
+        elif part_window is not None and it == part_window[1]:
+            fc = fault_clients.get(addr(part))
+            if fc is not None:
+                fc.set_partitioned(False)
+            log_event(it, "heal", member=addr(part))
+        if it == leave_at:
+            keep = [i for i in range(n_globals)
+                    if i != (0 if not quick else churn)]
+            # full mode: member 0 leaves for good; quick: churn leaves
+            # and rejoins later (the second reshard)
+            if quick:
+                keep = [i for i in range(n_globals) if i != churn]
+            disc.set_destinations([addr(i) for i in keep])
+            log_event(it, "leave",
+                      member=addr(0 if not quick else churn))
+        if rejoin_at is not None and it == rejoin_at:
+            disc.set_destinations([addr(i) for i in range(n_globals)])
+            log_event(it, "rejoin", member=addr(churn))
+        # membership changes flow through the REAL discovery-refresh
+        # path every interval (set_destinations only on actual change)
+        refresher.refresh()
+
+        lines = []
+        for i in range(s_histo):
+            lines.append(b"soak.h%d:%d|ms|#shard:%d,veneurglobalonly"
+                         % (i, (i * 31 + it) % 997, i % 16))
+        for i in range(s_counter):
+            lines.append(b"soak.c%d:2|c|#veneurglobalonly" % i)
+        max_len = lcfg.metric_max_length
+        batch, size = [], 0
+        for line in lines:
+            if size + len(line) + 1 > max_len and batch:
+                local.process_metric_packet(b"\n".join(batch))
+                batch, size = [], 0
+            batch.append(line)
+            size += len(line) + 1
+        if batch:
+            local.process_metric_packet(b"\n".join(batch))
+
+        before = received_total()
+        local.flush()
+        # pace on full receipt where possible; a kill/partition window
+        # legitimately runs short (the missing share is parked in spill
+        # — the settling drain must account for ALL of it)
+        deadline = time.time() + (2.0 if quick else 3.0)
+        while time.time() < deadline:
+            if received_total() - before >= per_interval:
+                break
+            time.sleep(0.02)
+        interval_receipts.append(received_total() - before)
+
+    # -- settling: heal everything, then drain until the tier is empty
+    for fc in fault_clients.values():
+        fc.set_partitioned(False)
+        fc.plan = FaultPlan(seed=0)  # faults off: settle deterministically
+    settle_drains = 0
+    settle_deadline = time.time() + 60.0
+    while proxy.spilled_metrics > 0 and time.time() < settle_deadline:
+        proxy.drain_spill()
+        settle_drains += 1
+        time.sleep(0.05)
+    # let in-flight deliveries land on the import servers
+    time.sleep(0.3)
+
+    # -- final accounting: flush EVERY global (members that left the
+    # ring still hold earlier intervals' state) and sum exactly
+    qs = device_quantiles(pcts, HistogramAggregates.from_names(aggs))
+    counter_total = 0.0
+    histo_count_total = 0.0
+    for srv, _ in globals_:
+        metrics = []
+        for w, lock in zip(srv.workers, srv._worker_locks):
+            with lock:
+                snap = w.flush(qs, 10.0)
+            metrics.extend(generate_inter_metrics(
+                snap, False, pcts, HistogramAggregates.from_names(aggs)))
+        for m in metrics:
+            if m.type == MetricType.COUNTER and m.name.startswith("soak.c"):
+                counter_total += m.value
+            if m.name.endswith(".count") and m.name.startswith("soak.h"):
+                histo_count_total += m.value
+
+    stats = proxy.forward_stats()
+    victim_delivery = stats["destinations"].get(
+        victim_addr, {}).get("delivery", {})
+    transitions = victim_delivery.get("breaker_transitions", [])
+    import_errors = sum(imp.import_errors for _, imp in globals_)
+    received = received_total()
+    injected = {}
+    for dest, fc in fault_clients.items():
+        for k, v in fc.injected.items():
+            if k != "passed":
+                injected[k] = injected.get(k, 0) + v
+
+    expected_counter = 2.0 * s_counter * intervals
+    expected_histo = float(s_histo * intervals)
+    checks = {
+        "counter_conservation_exact": counter_total == expected_counter,
+        "histo_conservation_exact": histo_count_total == expected_histo,
+        "zero_drops": proxy.drops == 0,
+        "zero_sheds": stats["routing"]["shed_batches"] == 0,
+        "zero_import_errors": import_errors == 0,
+        "spill_settled": proxy.spilled_metrics == 0,
+        "proxied_equals_received": stats["proxied_metrics"] == received,
+        "reshards_at_least_two": proxy.reshards >= 2,
+        "breaker_full_cycle_on_revived": has_breaker_cycle(transitions),
+        "refresh_error_flap_seen": refresher.refresh_errors >= 1,
+        "refresh_empty_flap_seen": refresher.refresh_empty >= 1,
+        "ledgers_conserved": proxy.conserved(),
+    }
+    failures = sorted(k for k, ok in checks.items() if not ok)
+
+    out = {
+        "quick": quick,
+        "seed": args.seed,
+        "globals": n_globals,
+        "intervals": intervals,
+        "histo_series": s_histo,
+        "counter_series": s_counter,
+        "samples_sent": per_interval * intervals,
+        "events": events,
+        "counter_total_expected": expected_counter,
+        "counter_total_observed": counter_total,
+        "histo_count_expected": expected_histo,
+        "histo_count_observed": histo_count_total,
+        "received_total": received,
+        "interval_receipts": interval_receipts,
+        "settle_drains": settle_drains,
+        "injected_faults": injected,
+        "victim_breaker_transitions": transitions,
+        "proxy": {k: stats[k] for k in (
+            "proxied_metrics", "drops", "spilled_metrics", "shed_metrics",
+            "reshards", "handoffs", "ring_version", "ring_members",
+            "last_ring_change", "errors_total", "routing")},
+        "refresh": refresher.stats(),
+        "checks": checks,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_start, 1),
+        "rss_start_mb": round(rss0, 1),
+        "rss_end_mb": round(rss_mb(), 1),
+    }
+
+    local.shutdown()
+    refresher.stop()
+    proxy.stop()
+    for srv, imp in globals_:
+        imp.stop(grace=0.5)
+        srv.shutdown()
+
+    write_artifact("RING_CHURN_SOAK.json", out)
+    print(json.dumps({"metric": "ring_churn_soak_ok",
+                      "value": 0.0 if failures else 1.0,
+                      "unit": "bool",
+                      "reshards": out["proxy"]["reshards"],
+                      "drops": out["proxy"]["drops"],
+                      "failures": failures}))
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -79,10 +79,24 @@ def main(argv=None) -> int:
 
     idle_s = (parse_duration(cfg.idle_connection_timeout)
               if cfg.idle_connection_timeout else 0.0)
+    from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+    timeout_s = parse_duration(cfg.forward_timeout)
+    policy = DeliveryPolicy(
+        retry_max=cfg.forward_retry_max,
+        breaker_threshold=cfg.forward_breaker_threshold,
+        spill_max_bytes=cfg.forward_spill_max_bytes,
+        spill_max_payloads=cfg.forward_spill_max_payloads,
+        timeout_s=min(timeout_s, cfg.handoff_window_s),
+        deadline_s=cfg.handoff_window_s)
     proxy = ProxyServer(static,
-                        timeout_s=parse_duration(cfg.forward_timeout),
+                        timeout_s=timeout_s,
                         idle_timeout_s=idle_s,
-                        max_idle_conns=cfg.max_idle_conns)
+                        max_idle_conns=cfg.max_idle_conns,
+                        delivery=policy,
+                        routing_workers=cfg.routing_pool_workers,
+                        routing_queue_max=cfg.routing_queue_max,
+                        handoff_window_s=cfg.handoff_window_s)
     address = cfg.grpc_address or "127.0.0.1:8128"
     port = proxy.start_grpc(address)
     log.info("proxy serving gRPC on %s (port %s)", address, port)

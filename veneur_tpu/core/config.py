@@ -392,6 +392,20 @@ class ProxyConfig:
     enable_profiling: bool = False
     forward_address: str = ""  # static destination (no discovery)
     forward_timeout: str = "10s"
+    # forward-path delivery guarantees (the PR-5 sink delivery layer
+    # applied per destination; sinks/delivery.py DeliveryPolicy):
+    # bounded retry on transient failures, per-destination circuit
+    # breaker, bounded spill re-routed on the current ring each drain
+    forward_retry_max: int = 2
+    forward_breaker_threshold: int = 3
+    forward_spill_max_bytes: int = 8 << 20
+    forward_spill_max_payloads: int = 512
+    # bounded reshard-handoff window: the drain cadence and the budget
+    # for re-routing spilled fragments after a membership change
+    handoff_window_s: float = 5.0
+    # bounded routing executor replacing per-batch thread spawn
+    routing_pool_workers: int = 4
+    routing_queue_max: int = 128
     grpc_address: str = ""
     grpc_forward_address: str = ""
     http_address: str = ""
@@ -439,7 +453,35 @@ def load_proxy_config(path: Optional[str] = None,
                 setattr(cfg, name,
                         _coerce(env[candidate], getattr(cfg, name), name))
                 break
+    validate_proxy_config(cfg)
     return cfg
+
+
+def validate_proxy_config(cfg: ProxyConfig) -> None:
+    parse_duration(cfg.forward_timeout)  # raises on nonsense
+    parse_duration(cfg.consul_refresh_interval)
+    parse_duration(cfg.runtime_metrics_interval)
+    if cfg.idle_connection_timeout:
+        parse_duration(cfg.idle_connection_timeout)
+    if cfg.forward_retry_max < 0:
+        raise ValueError("forward_retry_max must be >= 0 (0 means one"
+                         " attempt, no retries)")
+    if cfg.forward_breaker_threshold < 0:
+        raise ValueError("forward_breaker_threshold must be >= 0"
+                         " (0 disables the circuit breaker)")
+    if cfg.forward_spill_max_bytes < 0 or cfg.forward_spill_max_payloads < 0:
+        raise ValueError("forward spill caps must be >= 0 (0 drops failed"
+                         " fragments instead of spilling them)")
+    if cfg.handoff_window_s <= 0:
+        raise ValueError("handoff_window_s must be positive (it bounds"
+                         " the reshard drain AND paces the drain thread)")
+    if cfg.routing_pool_workers < 1:
+        raise ValueError("routing_pool_workers must be >= 1")
+    if cfg.routing_queue_max < 1:
+        raise ValueError("routing_queue_max must be >= 1 (the bound is"
+                         " the whole point of the routing executor)")
+    if cfg.max_idle_conns < 0:
+        raise ValueError("max_idle_conns must be >= 0 (0 = unlimited)")
 
 
 SECRET_FIELDS = {

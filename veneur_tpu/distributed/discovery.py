@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import logging
 import ssl
+import threading
 import urllib.request
 from typing import Callable, Optional, Protocol
 
@@ -20,6 +21,45 @@ log = logging.getLogger("veneur_tpu.discovery")
 
 class Discoverer(Protocol):
     def get_destinations_for_service(self, service: str) -> list[str]: ...
+
+
+class StaticDiscoverer:
+    """Settable in-memory discoverer: the churn soak's scriptable
+    discovery backend and a unit-test double. Membership changes go
+    through set_destinations; fail_next/empty_next script the two
+    flap modes a real backend exhibits (request error vs an empty
+    passing-set answer), so DestinationRefresher's keep-last-good and
+    staleness accounting are drivable deterministically."""
+
+    def __init__(self, destinations: Optional[list[str]] = None) -> None:
+        self._lock = threading.Lock()
+        self._dests = list(destinations or [])
+        self._fail_next = 0
+        self._empty_next = 0
+        self.calls = 0
+
+    def set_destinations(self, destinations: list[str]) -> None:
+        with self._lock:
+            self._dests = list(destinations)
+
+    def fail_next(self, n: int = 1) -> None:
+        with self._lock:
+            self._fail_next += int(n)
+
+    def empty_next(self, n: int = 1) -> None:
+        with self._lock:
+            self._empty_next += int(n)
+
+    def get_destinations_for_service(self, service: str) -> list[str]:
+        with self._lock:
+            self.calls += 1
+            if self._fail_next > 0:
+                self._fail_next -= 1
+                raise ConnectionError("injected discovery failure")
+            if self._empty_next > 0:
+                self._empty_next -= 1
+                return []
+            return list(self._dests)
 
 
 def _default_opener(url: str, headers: Optional[dict] = None,

@@ -34,8 +34,10 @@ class ImportServer:
         self.server = server
         self.grpc_server: Optional[grpc.Server] = None
         self.port: Optional[int] = None
+        self.address: Optional[str] = None
         self.received_metrics = 0
         self.import_errors = 0
+        self.last_import_unix = 0.0
         # concurrent imports (one thread per HTTP request + gRPC handlers)
         # hold different worker locks; the tallies need their own
         self._stats_lock = threading.Lock()
@@ -62,6 +64,7 @@ class ImportServer:
         with self._stats_lock:
             self.received_metrics += received
             self.import_errors += errors
+            self.last_import_unix = time.time()
         stats = getattr(self.server, "stats", None)
         if stats is not None:
             # canonical import telemetry (README.md:295: the merge part
@@ -160,6 +163,7 @@ class ImportServer:
         with self._stats_lock:
             self.received_metrics += received
             self.import_errors += errors
+            self.last_import_unix = time.time()
         stats = getattr(self.server, "stats", None)
         if stats is not None:
             stats.time_in_nanoseconds(
@@ -168,13 +172,27 @@ class ImportServer:
         return int(d.n)
 
     def start_grpc(self, address: str = "127.0.0.1:0") -> int:
+        """Start (or RESTART after stop — the churn soak's kill/restart
+        cycle rebinds the same port) the gRPC listener."""
         self.grpc_server, self.port = rpc.make_server(
             self.handle_batch, address, raw_handler=self.handle_wire)
+        self.address = f"{address.rsplit(':', 1)[0]}:{self.port}"
         return self.port
 
-    def stop(self) -> None:
+    def stop(self, grace: float = 1.0) -> None:
         if self.grpc_server is not None:
-            self.grpc_server.stop(grace=1.0)
+            self.grpc_server.stop(grace=grace).wait()
+            self.grpc_server = None
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            return {
+                "address": self.address,
+                "received_metrics": self.received_metrics,
+                "import_errors": self.import_errors,
+                "last_import_unix": self.last_import_unix,
+                "serving": self.grpc_server is not None,
+            }
 
 
 def decode_http_import_body(body: bytes, content_encoding: str

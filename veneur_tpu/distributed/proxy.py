@@ -4,36 +4,176 @@ Parity: reference proxysrv (proxysrv/server.go:44-384 — gRPC proxy with a
 connection map pruned on membership change, fire-and-forget forwarding) and
 the veneur-proxy HTTP tier (proxy.go:40-687 — ring routing, periodic
 service-discovery refresh keeping last-good destinations on error).
+
+Live-membership robustness (the PR-7 layer over that skeleton):
+
+- Every forward send runs through a per-destination DeliveryManager
+  (sinks/delivery.py — the same retry/breaker/bounded-spill machinery
+  the sinks got in PR 5): transient failures retry with backoff+jitter
+  clipped to the handoff window, a dead global costs one breaker probe
+  per drain interval, and failed fragments spill bounded instead of
+  dropping on first error. The conservation contract extends across the
+  tier: every metric accepted by the proxy is delivered, declared
+  dropped, or sitting in a bounded spill — exactly.
+- Ring reshard handoff: set_destinations reshards the ring (versioned;
+  distributed/ring.py) and wakes the drain thread, which re-routes every
+  spilled fragment under the NEW ring within a bounded handoff window —
+  a join/leave loses no interval. Fragments carry their per-record
+  placement hashes/keys so re-routing never re-decodes payloads.
+- Bounded routing executor: handle_batch/handle_wire enqueue onto a
+  fixed worker pool over a bounded queue (health/policy.py
+  routing_should_shed) instead of spawning a daemon thread per batch;
+  a full queue sheds the batch with honest per-metric drop counters and
+  feeds the downstream-behind signal.
 """
 
 from __future__ import annotations
 
 import logging
+import queue
 import socket
 import threading
 import time
 from collections import OrderedDict
-from typing import Optional
+from typing import Callable, Optional
 
 import grpc
 
 from veneur_tpu.distributed import codec, rpc
 from veneur_tpu.distributed.ring import ConsistentRing
 from veneur_tpu.gen import veneur_tpu_pb2 as pb
+from veneur_tpu.health.policy import (
+    ROUTING_QUEUE_MAX,
+    delivery_should_signal_behind,
+    routing_should_shed,
+)
+from veneur_tpu.sinks.delivery import DeliveryManager, DeliveryPolicy
 from veneur_tpu.utils.http import parse_host_port
 from veneur_tpu.protocol import ssf_wire
 
 log = logging.getLogger("veneur_tpu.proxy")
 
 
+class _Fragment:
+    """One ring-routed slice of a forwarded batch, carrying enough
+    context to be RE-routed under a newer ring after a spill: the raw
+    record byte-slices plus each record's placement hash (wire path),
+    or the pb.Metric objects plus each metric's key string (protobuf
+    path). `meta[i]` always places `parts[i]`."""
+
+    __slots__ = ("wire", "parts", "meta", "count", "nbytes")
+
+    def __init__(self, wire: bool, parts: list, meta: list) -> None:
+        self.wire = wire
+        self.parts = parts
+        self.meta = meta
+        self.count = len(parts)
+        self.nbytes = (sum(len(p) for p in parts) if wire
+                       else sum(m.ByteSize() for m in parts))
+
+
+class RoutingPool:
+    """Bounded routing executor: a fixed worker pool drains a bounded
+    queue of forwarded batches. Replaces the unbounded per-batch daemon
+    thread spawn — a slow global tier now surfaces as a full queue and
+    honest shed counters (routing_should_shed) instead of unbounded
+    proxy threads and memory. consecutive_sheds feeds the same
+    ≥2-consecutive gate the sink delivery layer uses for its
+    downstream-behind signal."""
+
+    def __init__(self, route_fn: Callable[[str, object], None],
+                 workers: int = 4,
+                 queue_max: int = ROUTING_QUEUE_MAX) -> None:
+        self._route = route_fn
+        self.workers = max(1, int(workers))
+        self.queue_max = max(1, int(queue_max))
+        self._q: queue.Queue = queue.Queue(self.queue_max)
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.routed = 0
+        self.shed_batches = 0
+        self.consecutive_sheds = 0
+        self._threads = []
+        for i in range(self.workers):
+            t = threading.Thread(target=self._work, daemon=True,
+                                 name=f"proxy-route-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def submit(self, kind: str, item: object) -> bool:
+        """Enqueue one batch for routing; False means SHED (queue full —
+        the caller owns the per-metric drop accounting)."""
+        if not routing_should_shed(self._q.qsize(), self.queue_max):
+            try:
+                self._q.put_nowait((kind, item))
+            except queue.Full:
+                pass  # raced to full between the check and the put
+            else:
+                with self._lock:
+                    self.submitted += 1
+                    self.consecutive_sheds = 0
+                return True
+        with self._lock:
+            self.shed_batches += 1
+            self.consecutive_sheds += 1
+        return False
+
+    def behind(self) -> bool:
+        """The downstream-behind signal: sustained shedding, gated the
+        same way sink delivery gates its behind signal."""
+        with self._lock:
+            return delivery_should_signal_behind(self.consecutive_sheds)
+
+    def _work(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            kind, payload = item
+            try:
+                self._route(kind, payload)
+            except Exception:  # noqa: BLE001 — workers must survive
+                log.exception("proxy routing worker failed")
+            finally:
+                with self._lock:
+                    self.routed += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "workers": self.workers,
+                "queue_max": self.queue_max,
+                "queue_depth": self._q.qsize(),
+                "submitted": self.submitted,
+                "routed": self.routed,
+                "shed_batches": self.shed_batches,
+                "consecutive_sheds": self.consecutive_sheds,
+            }
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            try:
+                self._q.put(None, timeout=1.0)
+            except queue.Full:  # wedged worker; daemon threads die anyway
+                break
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
 class ProxyServer:
     """Receives MetricBatch RPCs and re-sends each metric to the global
-    instance owning its key on the consistent ring."""
+    instance owning its key on the consistent ring, with per-destination
+    delivery guarantees and reshard handoff (module docstring)."""
 
     def __init__(self, destinations: Optional[list[str]] = None,
                  timeout_s: float = 10.0,
                  idle_timeout_s: float = 0.0,
-                 max_idle_conns: int = 0) -> None:
+                 max_idle_conns: int = 0,
+                 delivery: Optional[DeliveryPolicy] = None,
+                 routing_workers: int = 4,
+                 routing_queue_max: int = ROUTING_QUEUE_MAX,
+                 handoff_window_s: float = 5.0,
+                 client_factory: Optional[Callable] = None) -> None:
         self.ring = ConsistentRing(destinations or [])
         self.timeout_s = timeout_s
         self.idle_timeout_s = idle_timeout_s
@@ -41,30 +181,80 @@ class ProxyServer:
         # config_proxy.go:16 MaxIdleConns on the shared http.Transport);
         # 0 = unlimited
         self.max_idle_conns = max_idle_conns
+        self.handoff_window_s = max(0.05, float(handoff_window_s))
+        # per-attempt forward timeout can't usefully exceed the handoff
+        # window that bounds the whole delivery budget
+        self._policy = delivery or DeliveryPolicy(
+            timeout_s=min(timeout_s, self.handoff_window_s),
+            deadline_s=self.handoff_window_s)
+        # tests and the churn soak inject scripted/faulty clients here;
+        # None = real gRPC ForwardClient
+        self._client_factory = client_factory
         self._conns: "OrderedDict[str, rpc.ForwardClient]" = OrderedDict()
+        self._managers: dict[str, DeliveryManager] = {}
+        # deliveries/deferrals in flight per destination, so manager
+        # retirement can prove nothing can repopulate a drained spill
+        self._inflight: dict[str, int] = {}
         self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
         self.grpc_server: Optional[grpc.Server] = None
         self.port: Optional[int] = None
         self.proxied_metrics = 0
         self.drops = 0
+        self.spilled_metrics = 0   # metrics currently parked in spills
+        self.shed_metrics = 0      # subset of drops: routing-queue sheds
+        self.reshards = 0
+        self.handoffs = 0
+        self.last_ring_change: Optional[dict] = None
+        self._ring_changed_unix = time.time()
+        self.refresher = None      # attached by DestinationRefresher
+        self._pool = RoutingPool(self._route_one, routing_workers,
+                                 routing_queue_max)
+        self._drain_event = threading.Event()
+        self._stop_event = threading.Event()
+        self._drain_thread = threading.Thread(
+            target=self._drain_loop, daemon=True, name="proxy-handoff")
+        self._drain_thread.start()
 
     # -- membership (reference SetDestinations, proxysrv/server.go:148-176)
 
-    def set_destinations(self, destinations: list[str]) -> None:
+    def set_destinations(self, destinations: list[str]):
+        """Reshard the ring; returns the RingChange (None if membership
+        is unchanged). A change wakes the handoff drain so spilled
+        fragments re-route under the NEW ring within the bounded
+        window."""
         with self._lock:
-            if not self.ring.set_members(destinations):
-                return
+            change = self.ring.set_members(destinations)
+            if not change:
+                return None
             live = set(destinations)
             for dest in list(self._conns):
                 if dest not in live:
                     self._conns.pop(dest).close()
+        with self._stats_lock:
+            self.reshards += 1
+            self._ring_changed_unix = time.time()
+            self.last_ring_change = {
+                "version": change.version,
+                "added": list(change.added),
+                "removed": list(change.removed),
+                "moved_ranges": len(change.moved_ranges),
+                "moved_fraction": round(change.moved_fraction(), 6),
+            }
+        self._drain_event.set()
+        return change
 
     def _conn(self, dest: str) -> rpc.ForwardClient:
         with self._lock:
             client = self._conns.get(dest)
             if client is None:
-                client = rpc.ForwardClient(dest, self.timeout_s,
-                                           idle_timeout_s=self.idle_timeout_s)
+                if self._client_factory is not None:
+                    client = self._client_factory(
+                        dest, self.timeout_s, self.idle_timeout_s)
+                else:
+                    client = rpc.ForwardClient(
+                        dest, self.timeout_s,
+                        idle_timeout_s=self.idle_timeout_s)
                 self._conns[dest] = client
                 while (self.max_idle_conns > 0
                        and len(self._conns) > self.max_idle_conns):
@@ -74,21 +264,130 @@ class ProxyServer:
                 self._conns.move_to_end(dest)
             return client
 
+    # -- per-destination delivery (PR 5 machinery over the forward path)
+
+    def _on_spill_evict(self, frag) -> None:
+        # a spill cap pushed out an older fragment: its metrics leave
+        # the spill gauge and become declared drops
+        if frag is None:
+            return
+        with self._stats_lock:
+            self.spilled_metrics -= frag.count
+            self.drops += frag.count
+
+    def _checkout_manager(self, dest: str) -> DeliveryManager:
+        """Resolve (or create) dest's manager and mark a delivery in
+        flight; pair with _checkin_manager."""
+        with self._lock:
+            man = self._managers.get(dest)
+            if man is None:
+                man = DeliveryManager("forward:" + dest, self._policy,
+                                      evict_cb=self._on_spill_evict)
+                self._managers[dest] = man
+            self._inflight[dest] = self._inflight.get(dest, 0) + 1
+            return man
+
+    def _checkin_manager(self, dest: str) -> None:
+        with self._lock:
+            self._inflight[dest] -= 1
+
+    def _make_send(self, dest: str, frag: _Fragment):
+        """One-attempt send closure over a routed fragment (the shape
+        DeliveryManager drives). Clients exposing the *_or_raise API get
+        classified ForwardErrors; bool-returning stand-ins (bench/test
+        fakes) degrade to a permanent "send" failure on False — the old
+        drop semantics."""
+
+        def send(timeout_s: float) -> None:
+            client = self._conn(dest)
+            if frag.wire:
+                blob = b"".join(frag.parts)
+                fn = getattr(client, "send_raw_or_raise", None)
+                if fn is not None:
+                    fn(blob, frag.count, timeout_s)
+                elif not client.send_raw(blob, frag.count):
+                    raise rpc.ForwardError("send", dest,
+                                           "send_raw returned False")
+            else:
+                sub = pb.MetricBatch()
+                sub.metrics.extend(frag.parts)
+                fn = getattr(client, "send_or_raise", None)
+                if fn is not None:
+                    fn(sub, timeout_s)
+                elif not client.send(sub):
+                    raise rpc.ForwardError("send", dest,
+                                           "send returned False")
+
+        return send
+
+    def _deliver_fragment(self, dest: str, frag: _Fragment) -> str:
+        man = self._checkout_manager(dest)
+        try:
+            outcome = man.deliver(self._make_send(dest, frag),
+                                  frag.nbytes, payload=frag)
+        finally:
+            self._checkin_manager(dest)
+        with self._stats_lock:
+            if outcome == "delivered":
+                self.proxied_metrics += frag.count
+            elif outcome == "deferred":
+                self.spilled_metrics += frag.count
+            else:
+                self.drops += frag.count
+        return outcome
+
+    def _defer_fragment(self, dest: str, frag: _Fragment) -> str:
+        """Park a fragment in dest's spill without a network attempt —
+        the bounded-handoff path when the reshard window runs out."""
+        man = self._checkout_manager(dest)
+        try:
+            outcome = man.defer(self._make_send(dest, frag),
+                                frag.nbytes, payload=frag)
+        finally:
+            self._checkin_manager(dest)
+        with self._stats_lock:
+            if outcome == "deferred":
+                self.spilled_metrics += frag.count
+            else:
+                self.drops += frag.count
+        return outcome
+
     # -- forwarding (reference SendMetrics :180 / sendMetrics :190)
 
     def handle_batch(self, batch: pb.MetricBatch) -> None:
-        # return to the caller immediately; route in the background
+        # return to the caller immediately; the bounded pool routes it
         # (reference returns before forwarding completes)
-        threading.Thread(
-            target=self._route_batch, args=(batch,), daemon=True,
-            name="proxy-route",
-        ).start()
+        if not self._pool.submit("batch", batch):
+            self._shed(len(batch.metrics))
 
     def handle_wire(self, blob: bytes) -> None:
-        threading.Thread(
-            target=self._route_wire, args=(blob,), daemon=True,
-            name="proxy-route",
-        ).start()
+        if not self._pool.submit("wire", blob):
+            self._shed(self._wire_count(blob))
+
+    def _shed(self, n: int) -> None:
+        with self._stats_lock:
+            self.drops += n
+            self.shed_metrics += n
+
+    def _wire_count(self, blob: bytes) -> int:
+        """Metric count of a wire blob for honest shed accounting (the
+        shed path is off the hot path by definition, so the decode cost
+        lands only on batches that were refused anyway)."""
+        from veneur_tpu import native as native_mod
+
+        d = native_mod.decode_metric_batch(blob)
+        if d is not None:
+            return int(d.n)
+        try:
+            return len(pb.MetricBatch.FromString(blob).metrics)
+        except Exception:
+            return 1  # undecodable: same unit the decode-failure path drops
+
+    def _route_one(self, kind: str, item) -> None:
+        if kind == "wire":
+            self._route_wire(item)
+        else:
+            self._route_batch(item)
 
     def _route_wire(self, blob: bytes) -> None:
         """Ring-split a serialized batch by BYTE SLICING: the native
@@ -104,12 +403,13 @@ class ProxyServer:
             # native decoder rejected (malformed per protobuf spec since
             # the round-4 strictness fixes, or stale .so): the Python
             # parser gets a say, but ITS rejection must surface in the
-            # proxy's own telemetry, not as a bare daemon-thread
-            # traceback with the drop uncounted
+            # proxy's own telemetry, not as a bare worker traceback with
+            # the drop uncounted
             try:
                 batch = pb.MetricBatch.FromString(blob)
             except Exception as e:
-                self.drops += 1
+                with self._stats_lock:
+                    self.drops += 1
                 log.warning("undecodable forward body dropped: %s", e)
                 return
             self._route_batch(batch)
@@ -118,61 +418,197 @@ class ProxyServer:
             return
         off = d.rec_off.tolist()
         ln = d.rec_len.tolist()
-        by_dest: dict[str, list] = {}
-        counts: dict[str, int] = {}
+        hashes = d.ring_hash.tolist()
         try:
             # placement hashes came out of the decoder; one vectorized
             # searchsorted places the whole batch on the ring
             dests = self.ring.owners_for_hashes(d.ring_hash)
         except LookupError:
-            self.drops += d.n
+            with self._stats_lock:
+                self.drops += d.n
             log.warning("no destinations; dropping batch")
             return
+        groups: dict[str, tuple[list, list]] = {}
         for i, dest in enumerate(dests):
-            by_dest.setdefault(dest, []).append(
-                blob[off[i]:off[i] + ln[i]])
-            counts[dest] = counts.get(dest, 0) + 1
-        for dest, parts in by_dest.items():
-            if self._conn(dest).send_raw(b"".join(parts), counts[dest]):
-                self.proxied_metrics += counts[dest]
-            else:
-                self.drops += counts[dest]
+            parts, meta = groups.setdefault(dest, ([], []))
+            parts.append(blob[off[i]:off[i] + ln[i]])
+            meta.append(hashes[i])
+        for dest, (parts, meta) in groups.items():
+            self._deliver_fragment(dest, _Fragment(True, parts, meta))
 
     def _route_batch(self, batch: pb.MetricBatch) -> None:
-        by_dest: dict[str, pb.MetricBatch] = {}
-        for m in batch.metrics:
-            key = codec.metric_key(m)
+        groups: dict[str, tuple[list, list]] = {}
+        metrics = list(batch.metrics)
+        for i, m in enumerate(metrics):
+            key = codec.metric_key(m).key_string()
             try:
-                dest = self.ring.get(key.key_string())
+                dest = self.ring.get(key)
             except LookupError:
-                self.drops += len(batch.metrics)
-                log.warning("no destinations; dropping batch")
-                return
-            by_dest.setdefault(dest, pb.MetricBatch()).metrics.append(m)
-        for dest, sub in by_dest.items():
-            if self._conn(dest).send(sub):
-                self.proxied_metrics += len(sub.metrics)
+                # ring emptied mid-route: only the UN-routed remainder
+                # is lost — metrics already grouped still forward below
+                remainder = len(metrics) - i
+                with self._stats_lock:
+                    self.drops += remainder
+                log.warning(
+                    "ring emptied mid-route; dropping %d un-routed "
+                    "metrics (%d already grouped still forward)",
+                    remainder, i)
+                break
+            parts, meta = groups.setdefault(dest, ([], []))
+            parts.append(m)
+            meta.append(key)
+        for dest, (parts, meta) in groups.items():
+            self._deliver_fragment(dest, _Fragment(False, parts, meta))
+
+    # -- reshard handoff ----------------------------------------------------
+
+    def _reroute_fragment(self, frag: _Fragment,
+                          deadline_mono: float) -> None:
+        """Split a drained fragment under the CURRENT ring and re-
+        deliver each piece; past the handoff deadline, pieces park on
+        their new owner's spill without a network attempt (bounded
+        handoff). An empty ring declares the drop."""
+        try:
+            if frag.wire:
+                owners = self.ring.owners_for_hashes(frag.meta)
             else:
-                self.drops += len(sub.metrics)
+                view = self.ring.view()
+                owners = [view.get_hashed(ConsistentRing._hash(k))
+                          for k in frag.meta]
+        except LookupError:
+            with self._stats_lock:
+                self.drops += frag.count
+            log.warning("ring empty during handoff; dropping %d spilled "
+                        "metrics", frag.count)
+            return
+        groups: dict[str, tuple[list, list]] = {}
+        for part, meta, dest in zip(frag.parts, frag.meta, owners):
+            parts, metas = groups.setdefault(dest, ([], []))
+            parts.append(part)
+            metas.append(meta)
+        for dest, (parts, metas) in groups.items():
+            nf = _Fragment(frag.wire, parts, metas)
+            if time.monotonic() >= deadline_mono:
+                self._defer_fragment(dest, nf)
+            else:
+                self._deliver_fragment(dest, nf)
+
+    def drain_spill(self, window_s: Optional[float] = None) -> dict:
+        """One handoff/drain pass, bounded by the handoff window: every
+        destination manager gets its interval edge (an open breaker arms
+        its half-open probe), then all spilled fragments are popped and
+        re-routed under the CURRENT ring. Runs periodically from the
+        drain thread and immediately on reshard; also the soak's lever
+        for deterministic final settling."""
+        window = self.handoff_window_s if window_s is None \
+            else float(window_s)
+        deadline = time.monotonic() + window
+        with self._lock:
+            managers = dict(self._managers)
+        drained_payloads = drained_metrics = 0
+        for dest, man in managers.items():
+            man.begin_flush(window)
+            entries = man.drain_spill()
+            if not entries:
+                continue
+            popped = sum(e.payload.count for e in entries
+                         if e.payload is not None)
+            with self._stats_lock:
+                self.spilled_metrics -= popped
+            for e in entries:
+                drained_payloads += 1
+                if e.payload is None:
+                    # not a routed fragment (foreign deliver() caller):
+                    # park it back untouched
+                    man.defer(e.send, e.nbytes)
+                    continue
+                drained_metrics += e.payload.count
+                self._reroute_fragment(e.payload, deadline)
+        self._retire_departed()
+        with self._stats_lock:
+            self.handoffs += 1
+        return {"drained_payloads": drained_payloads,
+                "drained_metrics": drained_metrics}
+
+    def _retire_departed(self) -> None:
+        """Drop managers of destinations no longer in the ring, once
+        their spill is empty and nothing is in flight toward them (the
+        in-flight guard makes "empty" stable under _lock: a new
+        delivery/deferral must check the manager out under _lock
+        first)."""
+        with self._lock:
+            members = self.ring.view().members
+            for dest in list(self._managers):
+                if (dest not in members
+                        and not self._inflight.get(dest, 0)
+                        and not len(self._managers[dest].spill)):
+                    del self._managers[dest]
+                    self._inflight.pop(dest, None)
+
+    def _drain_loop(self) -> None:
+        while not self._stop_event.is_set():
+            self._drain_event.wait(self.handoff_window_s)
+            if self._stop_event.is_set():
+                return
+            self._drain_event.clear()
+            try:
+                self.drain_spill()
+            except Exception:  # noqa: BLE001 — the drain must survive
+                log.exception("proxy handoff drain failed")
+
+    # -- introspection ------------------------------------------------------
 
     def forward_stats(self) -> dict:
-        """Per-destination forward-path health (ForwardClient.stats):
-        attempt timings, error classes, consecutive failures and channel
-        reconnects — what the mesh soak reads to name the wedged side
-        of a forward-wait stall instead of timing out silently."""
+        """Tier health snapshot: per-destination forward-path stats
+        (ForwardClient.stats) and delivery ledgers (DeliveryManager.
+        stats), ring version/age, routing-executor backpressure, and
+        discovery-refresh staleness — what the churn soak asserts
+        conservation and breaker cycles against."""
         with self._lock:
-            per_dest = {dest: c.stats() for dest, c in self._conns.items()}
-        return {
-            "proxied_metrics": self.proxied_metrics,
-            "drops": self.drops,
+            conn_stats = {dest: c.stats()
+                          for dest, c in self._conns.items()}
+            managers = dict(self._managers)
+        per_dest: dict[str, dict] = dict(conn_stats)
+        for dest, man in managers.items():
+            per_dest.setdefault(dest, {"address": dest})["delivery"] = \
+                man.stats()
+        with self._stats_lock:
+            out = {
+                "proxied_metrics": self.proxied_metrics,
+                "drops": self.drops,
+                "spilled_metrics": self.spilled_metrics,
+                "shed_metrics": self.shed_metrics,
+                "reshards": self.reshards,
+                "handoffs": self.handoffs,
+                "last_ring_change": self.last_ring_change,
+                "ring_age_s": round(
+                    time.time() - self._ring_changed_unix, 3),
+            }
+        out.update({
+            "ring_version": self.ring.version,
+            "ring_members": len(self.ring),
             "destinations": per_dest,
             "reconnects_total": sum(
-                d["reconnects"] for d in per_dest.values()),
+                d.get("reconnects", 0) for d in per_dest.values()),
             "errors_total": {
-                cause: sum(d["errors"].get(cause, 0)
+                cause: sum(d.get("errors", {}).get(cause, 0)
                            for d in per_dest.values())
                 for cause in ("deadline_exceeded", "unavailable", "send")},
-        }
+            "routing": self._pool.stats(),
+            "behind": self._pool.behind(),
+        })
+        if self.refresher is not None:
+            out["refresh"] = self.refresher.stats()
+            out["refresh_errors"] = self.refresher.refresh_errors
+        return out
+
+    def conserved(self) -> bool:
+        """The tier-wide exact-conservation check at a quiescent point:
+        every per-destination delivery ledger balances (see
+        DeliveryManager.conserved)."""
+        with self._lock:
+            managers = list(self._managers.values())
+        return all(m.conserved() for m in managers)
 
     def start_grpc(self, address: str = "127.0.0.1:0") -> int:
         self.grpc_server, self.port = rpc.make_server(
@@ -180,8 +616,12 @@ class ProxyServer:
         return self.port
 
     def stop(self) -> None:
+        self._stop_event.set()
+        self._drain_event.set()
         if self.grpc_server is not None:
             self.grpc_server.stop(grace=1.0)
+        self._pool.stop()
+        self._drain_thread.join(timeout=2.0)
         with self._lock:
             for client in self._conns.values():
                 client.close()
@@ -422,7 +862,14 @@ class DestinationRefresher:
         self.interval_s = interval_s
         self._stop = threading.Event()
         self.refresh_errors = 0
+        self.refresh_empty = 0
         self.last_refresh: float = 0.0
+        # let forward_stats() surface refresh staleness alongside the
+        # ring version/age it gates
+        try:
+            proxy.refresher = self
+        except AttributeError:  # pragma: no cover - exotic proxy stand-in
+            pass
 
     def refresh(self) -> None:
         try:
@@ -433,9 +880,28 @@ class DestinationRefresher:
             log.warning("discovery refresh failed (keeping %d last-good"
                         " destinations): %s", len(self.proxy.ring), e)
             return
-        if destinations:
-            self.proxy.set_destinations(destinations)
+        if not destinations:
+            # an empty answer is indistinguishable from a discovery
+            # outage (reference proxy.go:505-515 keeps last-good):
+            # keep the ring AND keep last_refresh stale — advancing it
+            # here (the old behaviour) made staleness telemetry report
+            # a healthy feed while the ring aged unrefreshed
+            self.refresh_empty += 1
+            log.warning("discovery returned no destinations (keeping %d"
+                        " last-good)", len(self.proxy.ring))
+            return
+        self.proxy.set_destinations(destinations)
         self.last_refresh = time.time()
+
+    def stats(self) -> dict:
+        now = time.time()
+        return {
+            "refresh_errors": self.refresh_errors,
+            "refresh_empty": self.refresh_empty,
+            "last_refresh_unix": self.last_refresh,
+            "last_refresh_age_s": (round(now - self.last_refresh, 3)
+                                   if self.last_refresh else None),
+        }
 
     def start(self) -> None:
         self.refresh()
@@ -478,6 +944,9 @@ class ProxyRuntimeReporter:
                          drops - self._last["drops"])
         self._last["proxied"], self._last["drops"] = proxied, drops
         self.stats.gauge("destinations_total", float(len(self.proxy.ring)))
+        self.stats.gauge("ring.version", float(self.proxy.ring.version))
+        self.stats.gauge("spilled_metrics",
+                         float(self.proxy.spilled_metrics))
         if self.trace_proxy is not None:
             spans = self.trace_proxy.proxied_spans
             self.stats.count("spans_proxied",

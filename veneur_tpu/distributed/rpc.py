@@ -20,6 +20,29 @@ from veneur_tpu.gen import veneur_tpu_pb2 as pb
 SERVICE_NAME = "veneurtpu.Forward"
 SEND_METRICS = f"/{SERVICE_NAME}/SendMetrics"
 
+# the reference's flusher.go:511-527 error taxonomy; transport-shaped
+# causes are worth retrying against the same destination, "send" means
+# the call or payload itself was rejected
+TRANSIENT_CAUSES = frozenset({"deadline_exceeded", "unavailable"})
+
+
+class ForwardError(Exception):
+    """A classified forward-send failure. `transient` feeds the shared
+    delivery layer's retry classification (sinks/delivery.py retryable()
+    honours a bool `transient` attribute before its own heuristics), so
+    the proxy's per-destination DeliveryManager retries/spills exactly
+    the transport-shaped failures and drops the permanent ones."""
+
+    def __init__(self, cause: str, address: str = "",
+                 detail: str = "") -> None:
+        msg = f"forward to {address or '?'} failed ({cause})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.cause = cause
+        self.address = address
+        self.transient = cause in TRANSIENT_CAUSES
+
 
 def make_server(handler: Callable[[pb.MetricBatch], None],
                 address: str = "127.0.0.1:0",
@@ -141,15 +164,31 @@ class ForwardClient:
 
     def send(self, batch: pb.MetricBatch,
              timeout_s: Optional[float] = None) -> bool:
-        return self._send(self._call, batch, len(batch.metrics), timeout_s)
+        return self._send(self._call, batch,
+                          len(batch.metrics), timeout_s) is None
 
     def send_raw(self, blob: bytes, n_metrics: int,
                  timeout_s: Optional[float] = None) -> bool:
         """Send pre-serialized MetricBatch bytes (native encoder path)."""
-        return self._send(self._call_raw, blob, n_metrics, timeout_s)
+        return self._send(self._call_raw, blob, n_metrics, timeout_s) is None
+
+    def send_or_raise(self, batch: pb.MetricBatch,
+                      timeout_s: Optional[float] = None) -> None:
+        """send(), but failures raise a classified ForwardError — the
+        shape the proxy's DeliveryManager retry/spill path consumes."""
+        cause = self._send(self._call, batch, len(batch.metrics), timeout_s)
+        if cause is not None:
+            raise ForwardError(cause, self.address)
+
+    def send_raw_or_raise(self, blob: bytes, n_metrics: int,
+                          timeout_s: Optional[float] = None) -> None:
+        cause = self._send(self._call_raw, blob, n_metrics, timeout_s)
+        if cause is not None:
+            raise ForwardError(cause, self.address)
 
     def _send(self, call, payload, n_metrics: int,
-              timeout_s: Optional[float]) -> bool:
+              timeout_s: Optional[float]) -> Optional[str]:
+        """One attempt; returns None on success, else the error cause."""
         t0 = time.perf_counter()
         try:
             call(payload, timeout=timeout_s or self.timeout_s)
@@ -165,16 +204,16 @@ class ForwardClient:
             self.errors[cause] += 1
             self.last_error_cause = cause
             self.consecutive_failures += 1
-            if cause in ("deadline_exceeded", "unavailable"):
+            if cause in TRANSIENT_CAUSES:
                 self._maybe_reconnect()
-            return False
+            return cause
         self._note_attempt(t0)
         self.consecutive_failures = 0
         self._reconnect_backoff_s = 1.0
         self.last_ok_unix = time.time()
         self.sent_batches += 1
         self.sent_metrics += n_metrics
-        return True
+        return None
 
     def _note_attempt(self, t0: float) -> None:
         self.last_send_s = time.perf_counter() - t0

@@ -74,6 +74,25 @@ def delivery_should_signal_behind(
     return consecutive_behind >= max(1, int(threshold))
 
 
+# Proxy routing-executor backpressure (distributed/proxy.py
+# RoutingPool): unlike the flush pipeline's one-interval bound, the
+# proxy queue holds whole forwarded batches from MANY upstream locals,
+# so the bound is a count of batches, not intervals. Past it the proxy
+# sheds the incoming batch with honest per-metric drop counters — the
+# alternative (the pre-PR-7 behaviour) was an unbounded daemon thread
+# per batch, which converts a slow global tier into proxy memory growth
+# and thread exhaustion instead of a visible, bounded drop signal.
+ROUTING_QUEUE_MAX = 128
+
+
+def routing_should_shed(queue_depth: int,
+                        queue_max: int = ROUTING_QUEUE_MAX) -> bool:
+    """The proxy routing executor's shed rule: refuse a batch once the
+    bounded routing queue is full. Centralised beside the pipeline shed
+    gate so both backpressure policies read as one contract."""
+    return queue_depth >= max(1, int(queue_max))
+
+
 def pipeline_should_shed(queue_depth: int,
                          max_backlog: int = MAX_STAGE_BACKLOG) -> bool:
     """The backpressure contract for the stage-parallel flush executor:

@@ -32,11 +32,14 @@ Accounting contract (the chaos soak's conservation invariant,
 tools/soak_faults.py):
 
     accepted_payloads == delivered_payloads + dropped_payloads
+                         + handed_off_payloads
                          + spilled_payloads (still queued)
 
 holds exactly at any quiescent point: every payload handed to deliver()
-is eventually delivered, declared dropped, or sitting in the bounded
-spill. Nothing is silently lost.
+is eventually delivered, declared dropped, handed off (drained out by
+the proxy's ring-reshard re-routing, where it is re-accepted by the new
+owner's manager), or sitting in the bounded spill. Nothing is silently
+lost.
 
 The clock, sleep, and jitter RNG are injectable so the breaker state
 machine and deadline math are unit-testable deterministically
@@ -71,9 +74,17 @@ def retryable(exc: BaseException) -> bool:
     DNS/socket OSErrors), timeouts, and HTTP 408/429/5xx. NOT
     retryable: other HTTP 4xx (the payload is bad; resending the same
     bytes re-fails) and non-network exceptions (serializer bugs must
-    surface, not loop)."""
+    surface, not loop).
+
+    Exceptions carrying their own verdict (a bool `transient` attribute
+    — distributed/rpc.py ForwardError maps the gRPC status taxonomy:
+    deadline/unavailable are transport-shaped, other send failures are
+    permanent) are classified by it directly."""
     from veneur_tpu.utils.http import HTTPError
 
+    transient = getattr(exc, "transient", None)
+    if isinstance(transient, bool):
+        return transient
     if isinstance(exc, HTTPError):
         return exc.status in RETRYABLE_STATUSES or exc.status >= 500
     if isinstance(exc, (TimeoutError, ConnectionError)):
@@ -191,6 +202,10 @@ class CircuitBreaker:
 class _SpillEntry:
     send: Callable[[float], None]  # one attempt over serialized bytes
     nbytes: int
+    # opaque caller context travelling with the spilled payload — the
+    # proxy stores its routed fragment here so a ring reshard can drain
+    # the spill and RE-route it under the new membership (drain_spill)
+    payload: object = None
 
 
 class SpillBuffer:
@@ -241,12 +256,19 @@ class DeliveryManager:
                  policy: Optional[DeliveryPolicy] = None,
                  time_fn: Callable[[], float] = time.monotonic,
                  sleep_fn: Callable[[float], None] = time.sleep,
-                 rng: Optional[random.Random] = None) -> None:
+                 rng: Optional[random.Random] = None,
+                 evict_cb: Optional[Callable[[object], None]] = None) -> None:
         self.sink_name = name
         self.policy = policy or DeliveryPolicy()
         self._time = time_fn
         self._sleep = sleep_fn
         self._rng = rng or random.Random()
+        # called (with the evicted entry's payload context) when a spill
+        # cap pushes out an OLDER entry — the owner keeps its own
+        # metric-level drop accounting in sync with the payload-level
+        # counters here. The entry being spilled right now reports its
+        # own eviction through the "dropped" return instead.
+        self._evict_cb = evict_cb
         self._lock = threading.Lock()
         self.breaker = CircuitBreaker(self.policy.breaker_threshold)
         self.spill = SpillBuffer(self.policy.spill_max_bytes,
@@ -261,6 +283,7 @@ class DeliveryManager:
         self.deferred_payloads = 0   # deferral EVENTS (a payload may defer
         self.deadline_clipped = 0    # across several intervals)
         self.breaker_short_circuits = 0
+        self.handed_off_payloads = 0  # drained out for re-routing
 
     # -- flush-edge hooks ---------------------------------------------------
 
@@ -288,15 +311,40 @@ class DeliveryManager:
                 delivered += 1
         return delivered
 
+    def drain_spill(self) -> list[_SpillEntry]:
+        """Hand every spilled payload back to the caller for re-routing
+        (the ring-reshard handoff: the proxy drains each destination's
+        spill and re-places the fragments under the CURRENT ring).
+        Popped entries count as handed_off — they leave this manager's
+        conservation ledger and are re-accepted wherever the caller
+        re-delivers them, so the tier-wide sum stays exact."""
+        with self._lock:
+            entries = self.spill.pop_all()
+            self.handed_off_payloads += len(entries)
+        return entries
+
     # -- the payload path ---------------------------------------------------
 
-    def deliver(self, send: Callable[[float], None], nbytes: int) -> str:
+    def deliver(self, send: Callable[[float], None], nbytes: int,
+                payload: object = None) -> str:
         """Drive one fresh serialized payload; see class docstring for
         the outcome contract. `send(timeout_s)` performs exactly one
-        network attempt and raises on failure."""
+        network attempt and raises on failure. `payload` is opaque
+        caller context that travels with the entry into the spill (see
+        _SpillEntry.payload)."""
         with self._lock:
             self.accepted_payloads += 1
-        return self._deliver_entry(_SpillEntry(send, int(nbytes)))
+        return self._deliver_entry(_SpillEntry(send, int(nbytes), payload))
+
+    def defer(self, send: Callable[[float], None], nbytes: int,
+              payload: object = None) -> str:
+        """Accept a payload straight into the spill without a network
+        attempt — the proxy's bounded-handoff path when the reshard
+        window runs out before a drained fragment could be re-sent.
+        Returns "deferred" or "dropped" (self-evicted by the caps)."""
+        with self._lock:
+            self.accepted_payloads += 1
+            return self._spill_locked(_SpillEntry(send, int(nbytes), payload))
 
     def _deliver_entry(self, entry: _SpillEntry) -> str:
         with self._lock:
@@ -363,7 +411,14 @@ class DeliveryManager:
         for old in self.spill.push(entry):
             self.dropped_payloads += 1
             self.dropped_bytes += old.nbytes
-            dropped_self = dropped_self or old is entry
+            if old is entry:
+                dropped_self = True
+            elif self._evict_cb is not None:
+                try:
+                    self._evict_cb(old.payload)
+                except Exception:  # noqa: BLE001
+                    log.exception("sink %s: evict callback failed",
+                                  self.sink_name)
         if dropped_self:
             # never made it into the spill: the deferral became a drop
             return "dropped"
@@ -385,6 +440,7 @@ class DeliveryManager:
                 "deferred_payloads": self.deferred_payloads,
                 "deadline_clipped": self.deadline_clipped,
                 "breaker_short_circuits": self.breaker_short_circuits,
+                "handed_off_payloads": self.handed_off_payloads,
                 "breaker_opened_total": self.breaker.opened_total,
                 "circuit_state": self.breaker.state,
                 "circuit_state_code": STATE_CODES[self.breaker.state],
@@ -394,11 +450,14 @@ class DeliveryManager:
             }
 
     def conserved(self) -> bool:
-        """The exact-conservation invariant (see module docstring)."""
+        """The exact-conservation invariant (see module docstring).
+        Handed-off payloads (drain_spill) left this ledger for another
+        manager's — they are accounted as such, keeping the per-manager
+        sum exact even across ring-reshard re-routing."""
         with self._lock:
             return (self.accepted_payloads
                     == self.delivered_payloads + self.dropped_payloads
-                    + len(self.spill))
+                    + self.handed_off_payloads + len(self.spill))
 
 
 def make_manager(name: str, delivery) -> DeliveryManager:

@@ -1,10 +1,11 @@
 """Deterministic seeded fault injection for sink delivery paths.
 
-FaultyOpener wraps the injectable `opener` every HTTP sink takes and
-FaultySocket stands in for the statsd-repeater sockets; both consult a
-seeded FaultPlan so every unit test and the chaos soak
-(tools/soak_faults.py) replays the exact same failure sequence for a
-given seed. Injected faults mirror the real failure modes the delivery
+FaultyOpener wraps the injectable `opener` every HTTP sink takes,
+FaultySocket stands in for the statsd-repeater sockets, and
+FaultyForwardClient wraps the proxy tier's gRPC forward clients; all
+consult a seeded FaultPlan so every unit test and the chaos soaks
+(tools/soak_faults.py, tools/soak_ring_churn.py) replay the exact same
+failure sequence for a given seed. Injected faults mirror the real failure modes the delivery
 layer (sinks/delivery.py) classifies:
 
 - refusal            → ConnectionRefusedError (retryable)
@@ -137,6 +138,90 @@ class FaultyOpener(_FaultBase):
         if self.inner is not None:
             return self.inner(req, timeout)
         return b"{}"
+
+
+class FaultyForwardClient(_FaultBase):
+    """Wraps a distributed/rpc.ForwardClient for the proxy's forward
+    path: every send consults the plan, plus a harness-scripted
+    `partitioned` toggle (the churn soak's link-partition windows).
+    Injected faults surface as classified ForwardErrors — the shape the
+    proxy's DeliveryManager retry/spill path consumes — with the same
+    taxonomy mapping FaultySocket uses: refusals/resets are
+    transport-shaped ("unavailable", transient), over-budget slowness is
+    a deadline, and HTTP-ish 5xx/rejection degrade to a permanent "send"
+    on a gRPC link."""
+
+    def __init__(self, plan: FaultPlan, inner,
+                 sleep_fn: Callable[[float], None] = time.sleep) -> None:
+        super().__init__(plan, sleep_fn)
+        self.inner = inner
+        self.address = getattr(inner, "address", "?")
+        self._partitioned = False
+
+    def set_partitioned(self, on: bool) -> None:
+        with self._lock:
+            self._partitioned = bool(on)
+
+    def _gate(self, timeout_s: Optional[float]) -> None:
+        # deferred import: utils.faults stays importable without grpc
+        from veneur_tpu.distributed.rpc import ForwardError
+
+        with self._lock:
+            partitioned = self._partitioned
+        if partitioned:
+            with self._lock:
+                self.calls += 1
+                self.injected["refused"] += 1
+            raise ForwardError("unavailable", self.address,
+                               "injected: partitioned link")
+        kind = self._decide()
+        if kind == "passed":
+            return
+        timeout = timeout_s or getattr(self.inner, "timeout_s", 10.0)
+        if kind == "slow":
+            if self.plan.slow_s >= timeout:
+                self._sleep(timeout)
+                raise ForwardError("deadline_exceeded", self.address,
+                                   "injected: slower than deadline")
+            self._sleep(self.plan.slow_s)
+            return
+        if kind in ("refused", "reset"):
+            raise ForwardError("unavailable", self.address,
+                               f"injected: {kind}")
+        raise ForwardError("send", self.address, f"injected: {kind}")
+
+    def send_or_raise(self, batch, timeout_s=None) -> None:
+        self._gate(timeout_s)
+        self.inner.send_or_raise(batch, timeout_s)
+
+    def send_raw_or_raise(self, blob: bytes, n_metrics: int,
+                          timeout_s=None) -> None:
+        self._gate(timeout_s)
+        self.inner.send_raw_or_raise(blob, n_metrics, timeout_s)
+
+    def send(self, batch, timeout_s=None) -> bool:
+        try:
+            self.send_or_raise(batch, timeout_s)
+        except Exception:
+            return False
+        return True
+
+    def send_raw(self, blob: bytes, n_metrics: int, timeout_s=None) -> bool:
+        try:
+            self.send_raw_or_raise(blob, n_metrics, timeout_s)
+        except Exception:
+            return False
+        return True
+
+    def stats(self) -> dict:
+        st = self.inner.stats()
+        with self._lock:
+            st["injected_faults"] = dict(self.injected)
+            st["partitioned"] = self._partitioned
+        return st
+
+    def close(self) -> None:
+        self.inner.close()
 
 
 class FaultySocket(_FaultBase):
