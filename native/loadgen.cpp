@@ -111,6 +111,15 @@ static bool get_u32(const unsigned char* p, size_t n, size_t& off,
 static const char* kTypeSuffix[] = {"c", "g", "ms", "h", "s"};
 static const int kNumTypes = 5;
 
+static int64_t cum_pick(const std::vector<double>& cum, double u) {
+    size_t lo = 0, hi = cum.size();
+    while (lo < hi) {
+        size_t mid = (lo + hi) / 2;
+        if (cum[mid] <= u) lo = mid + 1; else hi = mid;
+    }
+    return (int64_t)(lo < cum.size() ? lo : cum.size() - 1);
+}
+
 struct Synth {
     Rng rng;
     std::vector<double> type_cum;    // cumulative type-mix weights
@@ -119,11 +128,24 @@ struct Synth {
     int n_tags;
     int64_t tag_card;
     std::string prefix;
+    // multi-tenant dimension (per-tenant QoS soak): <= 1 tenant means
+    // NO tenant logic at all — zero extra RNG draws, no tenant tag,
+    // byte-identical legacy output. With more, the LAST tenant id is
+    // the abusive one: abusive_frac of lines go to it and its key
+    // space churns over churn_keys names BEYOND n_keys (the
+    // cardinality attack); innocents draw Zipf over the rest.
+    int64_t n_tenants;
+    double abusive_frac;
+    int64_t churn_keys;
+    std::vector<double> tenant_cum;  // Zipf over the innocent tenants
 
     Synth(uint64_t seed, const double* mix, int64_t keys, double zipf_s,
-          int tags, int64_t tagc, const char* pfx, int pfx_len)
+          int tags, int64_t tagc, const char* pfx, int pfx_len,
+          int64_t tenants, double ab_frac, double tenant_zipf_s,
+          int64_t churn)
         : rng(seed), n_keys(keys), n_tags(tags), tag_card(tagc),
-          prefix(pfx, (size_t)pfx_len) {
+          prefix(pfx, (size_t)pfx_len), n_tenants(tenants),
+          abusive_frac(ab_frac), churn_keys(churn) {
         double acc = 0;
         for (int i = 0; i < kNumTypes; i++) {
             acc += (mix[i] > 0 ? mix[i] : 0);
@@ -135,6 +157,13 @@ struct Synth {
             zacc += 1.0 / std::pow((double)(k + 1), zipf_s);
             zipf_cum.push_back(zacc);
         }
+        if (n_tenants > 1) {
+            double tacc = 0;
+            for (int64_t k = 0; k < n_tenants - 1; k++) {
+                tacc += 1.0 / std::pow((double)(k + 1), tenant_zipf_s);
+                tenant_cum.push_back(tacc);
+            }
+        }
     }
 
     int pick_type() {
@@ -145,13 +174,7 @@ struct Synth {
     }
 
     int64_t pick_key() {
-        double u = rng.uniform() * zipf_cum.back();
-        size_t lo = 0, hi = zipf_cum.size();
-        while (lo < hi) {
-            size_t mid = (lo + hi) / 2;
-            if (zipf_cum[mid] <= u) lo = mid + 1; else hi = mid;
-        }
-        return (int64_t)(lo < zipf_cum.size() ? lo : zipf_cum.size() - 1);
+        return cum_pick(zipf_cum, rng.uniform() * zipf_cum.back());
     }
 
     // One DogStatsD line. Tag values are a deterministic function of
@@ -159,8 +182,21 @@ struct Synth {
     // cardinality equals realized key cardinality, not its product
     // with tag_card^n_tags.
     void emit_line(std::string& out) {
+        int64_t tenant = -1;     // -1 = single-tenant legacy output
+        int64_t key_override = -1;
+        if (n_tenants > 1) {
+            if (rng.uniform() < abusive_frac) {
+                tenant = n_tenants - 1;
+                if (churn_keys > 0)
+                    key_override =
+                        n_keys + (int64_t)rng.below((uint64_t)churn_keys);
+            } else {
+                tenant = cum_pick(tenant_cum,
+                                  rng.uniform() * tenant_cum.back());
+            }
+        }
         int t = pick_type();
-        int64_t key = pick_key();
+        int64_t key = key_override >= 0 ? key_override : pick_key();
         char buf[64];
         out += prefix;
         snprintf(buf, sizeof buf, ".%s%lld:", kTypeSuffix[t],
@@ -191,7 +227,7 @@ struct Synth {
         out += buf;
         out += '|';
         out += kTypeSuffix[t];
-        if (n_tags > 0) {
+        if (n_tags > 0 || tenant >= 0) {
             out += "|#";
             uint64_t h = fnv1a64(&key, sizeof key, 1469598103934665603ULL);
             for (int i = 0; i < n_tags; i++) {
@@ -201,6 +237,13 @@ struct Synth {
                          (unsigned long long)(tag_card > 0
                                                   ? h % (uint64_t)tag_card
                                                   : 0));
+                out += buf;
+            }
+            if (tenant >= 0) {
+                // tenant tag LAST, so single- and multi-tenant lines
+                // share their prefix byte-for-byte up to it
+                snprintf(buf, sizeof buf, "%stenant:t%lld",
+                         n_tags > 0 ? "," : "", (long long)tenant);
                 out += buf;
             }
         }
@@ -380,17 +423,25 @@ long long vn_lg_ring_append(void* r, const char* data, long long len,
 // ----- synth ----------------------------------------------------------
 // Build ~n_lines of DogStatsD traffic into the ring, packed into
 // datagrams of at most dgram_target bytes. type_mix is 5 weights in
-// fixed order {c, g, ms, h, s}. Returns datagram count, -1 on bad args.
+// fixed order {c, g, ms, h, s}. n_tenants <= 1 emits single-tenant
+// traffic byte-identical to the pre-tenant synth; > 1 stamps a
+// trailing tenant:tN tag per line (see struct Synth). Returns datagram
+// count, -1 on bad args.
 long long vn_lg_ring_synth(void* r, unsigned long long seed,
                            long long n_keys, double zipf_s,
                            const double* type_mix,
                            int n_tags, long long tag_card,
                            const char* prefix, int prefix_len,
-                           int dgram_target, long long n_lines) {
+                           int dgram_target, long long n_lines,
+                           long long n_tenants, double abusive_frac,
+                           double tenant_zipf_s, long long churn_keys) {
     if (!r || !type_mix || !prefix || n_keys <= 0 ||
         n_keys > (1LL << 24) || n_lines <= 0 || prefix_len <= 0 ||
         n_tags < 0 || n_tags > 16 || dgram_target < 64 ||
         dgram_target > 65507 || zipf_s < 0)
+        return -1;
+    if (n_tenants < 1 || n_tenants > 4096 || abusive_frac < 0 ||
+        abusive_frac > 1 || tenant_zipf_s < 0 || churn_keys < 0)
         return -1;
     double mix_sum = 0;
     for (int i = 0; i < kNumTypes; i++) {
@@ -400,7 +451,8 @@ long long vn_lg_ring_synth(void* r, unsigned long long seed,
     if (mix_sum <= 0) return -1;
     Ring* ring = (Ring*)r;
     Synth sy(seed, type_mix, n_keys, zipf_s, n_tags, tag_card, prefix,
-             prefix_len);
+             prefix_len, n_tenants, abusive_frac, tenant_zipf_s,
+             churn_keys);
     std::string dgram, line;
     int32_t dlines = 0;
     for (int64_t i = 0; i < n_lines; i++) {

@@ -1,0 +1,194 @@
+"""Heavy-hitter sketch ops (ops/heavyhitter.py): merge algebra, seeded
+count-min accuracy bounds, chunked==single-shot bit-identity (the PR 1
+pow2-ladder discipline applied to the QoS sketch), and space-saving
+top-k stability under merge."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.ops import heavyhitter as hh
+
+
+def _random_batch(rng, n, num_tenants=4, num_keys=500,
+                  depth=hh.DEFAULT_DEPTH, width=hh.DEFAULT_WIDTH):
+    keys = [f"k{rng.integers(num_keys)}" for _ in range(n)]
+    rows = rng.integers(0, num_tenants, size=n).astype(np.int32)
+    counts = rng.integers(1, 20, size=n).astype(np.int32)
+    cols = hh.split_hashes(hh.hash_keys(keys), depth, width)
+    return keys, rows, cols, counts
+
+
+def _fold(pool, rows, cols, counts):
+    import jax.numpy as jnp
+
+    return hh.insert_batch(pool, jnp.asarray(rows), jnp.asarray(cols),
+                           jnp.asarray(counts))
+
+
+def test_init_pool_rejects_non_pow2_width():
+    with pytest.raises(ValueError):
+        hh.init_pool(2, width=1000)
+
+
+def test_split_hashes_probes_distinct_per_key():
+    # odd stride: the D probe columns are pairwise distinct mod pow2 W
+    cols = hh.split_hashes(hh.hash_keys([f"k{i}" for i in range(64)]),
+                           depth=4, width=2048)
+    for j in range(cols.shape[1]):
+        assert len(set(cols[:, j].tolist())) == 4
+
+
+def test_merge_commutative_and_associative():
+    rng = np.random.default_rng(3)
+    pools = []
+    for seed in range(3):
+        _, rows, cols, counts = _random_batch(
+            np.random.default_rng(seed), 200)
+        pools.append(_fold(hh.init_pool(4), rows, cols, counts))
+    a, b, c = pools
+    ab = np.asarray(hh.merge(a, b))
+    ba = np.asarray(hh.merge(b, a))
+    assert (ab == ba).all()
+    abc1 = np.asarray(hh.merge(hh.merge(a, b), c))
+    abc2 = np.asarray(hh.merge(a, hh.merge(b, c)))
+    assert (abc1 == abc2).all()
+    del rng
+
+
+def test_merge_equals_joint_insert():
+    # folding two halves into separate pools then merging must equal
+    # folding the concatenation into one pool (the cross-host contract)
+    rng = np.random.default_rng(11)
+    _, rows, cols, counts = _random_batch(rng, 400)
+    joint = _fold(hh.init_pool(4), rows, cols, counts)
+    half_a = _fold(hh.init_pool(4), rows[:200], cols[:, :200], counts[:200])
+    half_b = _fold(hh.init_pool(4), rows[200:], cols[:, 200:], counts[200:])
+    assert (np.asarray(hh.merge(half_a, half_b))
+            == np.asarray(joint)).all()
+
+
+def test_chunked_insert_bit_identical_to_single_shot():
+    rng = np.random.default_rng(7)
+    _, rows, cols, counts = _random_batch(rng, 1000)
+    single = _fold(hh.init_pool(4), rows, cols, counts)
+    for chunk in (64, 256, 1024, 4096):
+        chunked = hh.insert_chunked(hh.init_pool(4), rows, cols, counts,
+                                    chunk)
+        assert (np.asarray(chunked) == np.asarray(single)).all(), chunk
+
+
+@pytest.mark.parametrize("num_keys", [1000, 100_000])
+def test_query_accuracy_bounds(num_keys):
+    """The CMS guarantee at the default shape: never underestimates,
+    and overestimates by at most eps*N (eps = e/W) with probability
+    1 - e^-D — seeded, so a hash regression fails deterministically."""
+    rng = np.random.default_rng(num_keys)
+    n = 20_000
+    key_ids = rng.zipf(1.3, size=n) % num_keys
+    truth: dict[int, int] = {}
+    for k in key_ids.tolist():
+        truth[k] = truth.get(k, 0) + 1
+    keys = [f"key{k}" for k in truth]
+    exact = np.array([truth[k] for k in truth], dtype=np.int64)
+    cols = hh.split_hashes(hh.hash_keys(keys))
+    rows = np.zeros(len(keys), dtype=np.int32)
+    pool = hh.insert_chunked(hh.init_pool(1), rows, cols,
+                             exact.astype(np.int32), 4096)
+    import jax.numpy as jnp
+
+    est = np.asarray(hh.query(pool, jnp.asarray(rows), jnp.asarray(cols)))
+    # never under (the one-sided CMS error)
+    assert (est >= exact).all()
+    eps_n = np.e / hh.DEFAULT_WIDTH * n
+    over = est - exact
+    frac_bad = float((over > eps_n).mean())
+    assert frac_bad <= np.exp(-hh.DEFAULT_DEPTH) + 0.01
+    # total inserted mass is exact per tenant row
+    assert int(np.asarray(hh.tenant_totals(pool))[0]) == n
+
+
+def test_tenant_rows_isolated():
+    # inserts into tenant row 1 never move row 0's counters
+    rng = np.random.default_rng(2)
+    keys, _, cols, counts = _random_batch(rng, 100, num_tenants=1)
+    pool = _fold(hh.init_pool(2), np.zeros(100, np.int32), cols, counts)
+    before = np.asarray(pool)[0].copy()
+    pool = _fold(pool, np.ones(100, np.int32), cols, counts)
+    after = np.asarray(pool)
+    assert (after[0] == before).all()
+    assert (after[1] == before).all()  # same batch, same counters
+    del keys
+
+
+# -- space-saving top-k ----------------------------------------------------
+
+
+def test_topk_exact_below_capacity():
+    s = hh.SpaceSavingTopK(8)
+    for key, n in [("a", 5), ("b", 3), ("a", 2), ("c", 1)]:
+        s.offer(key, n)
+    assert s.items() == [("a", 7, 0), ("b", 3, 0), ("c", 1, 0)]
+
+
+def test_topk_eviction_inherits_floor():
+    s = hh.SpaceSavingTopK(2)
+    s.offer("a", 10)
+    s.offer("b", 4)
+    s.offer("c", 1)  # evicts b (min), inherits its count as error
+    items = s.items()
+    assert items[0] == ("a", 10, 0)
+    assert items[1] == ("c", 5, 4)  # floor 4 + offered 1, error 4
+    # guarantee: stored - error <= true <= stored
+    assert items[1][1] - items[1][2] <= 1 <= items[1][1]
+
+
+def test_topk_heavy_hitters_survive_stream():
+    rng = np.random.default_rng(9)
+    s = hh.SpaceSavingTopK(8)
+    heavy = {f"hot{i}": 500 + 100 * i for i in range(4)}
+    offers = [(k, 1) for k, n in heavy.items() for _ in range(n)]
+    offers += [(f"cold{rng.integers(2000)}", 1) for _ in range(3000)]
+    rng.shuffle(offers)
+    for k, n in offers:
+        s.offer(k, n)
+    got = {k for k, _, _ in s.items()}
+    assert set(heavy) <= got  # any key with true count > min is present
+
+
+def test_topk_merge_stability():
+    """Merging two shard summaries reports the true heavy hitters with
+    counts within the documented error bounds, and merge order does not
+    change the reported (key, count) set."""
+    rng = np.random.default_rng(21)
+    truth: dict[str, int] = {}
+    shards = [hh.SpaceSavingTopK(8) for _ in range(2)]
+    heavy = {f"hh{i}": 800 - 50 * i for i in range(4)}
+    offers = [(k, 1) for k, n in heavy.items() for _ in range(n)]
+    offers += [(f"noise{rng.integers(500)}", 1) for _ in range(2000)]
+    rng.shuffle(offers)
+    for i, (k, n) in enumerate(offers):
+        truth[k] = truth.get(k, 0) + n
+        shards[i % 2].offer(k, n)
+
+    ab = hh.SpaceSavingTopK(8)
+    ab.merge(shards[0])
+    ab.merge(shards[1])
+    ba = hh.SpaceSavingTopK(8)
+    ba.merge(shards[1])
+    ba.merge(shards[0])
+    assert ab.items() == ba.items()
+    got = dict((k, (c, e)) for k, c, e in ab.items())
+    for k in heavy:
+        assert k in got
+        c, e = got[k]
+        assert c - e <= truth[k] <= c  # the space-saving bound
+
+
+def test_topk_merge_empty_identity():
+    s = hh.SpaceSavingTopK(4)
+    s.offer("x", 3)
+    s.merge(hh.SpaceSavingTopK(4))
+    assert s.items() == [("x", 3, 0)]
+    t = hh.SpaceSavingTopK(4)
+    t.merge(s)
+    assert t.items() == [("x", 3, 0)]

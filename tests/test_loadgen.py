@@ -193,3 +193,78 @@ def test_server_ingress_stats_survive_flush():
         assert st["flush_count"] >= 2
     finally:
         srv.shutdown()
+
+
+# -- tenant dimension (per-tenant QoS soak workloads) -----------------------
+
+
+def test_tenant_synth_deterministic_and_tagged():
+    spec = small_spec(tenant_count=4, tenant_abusive_frac=0.3,
+                      tenant_zipf_s=1.0, tenant_churn_keys=50)
+    a = spec.build_ring()
+    b = spec.build_ring()
+    assert a.content_hash == b.content_hash
+    seen = set()
+    for dgram in a.datagrams():
+        for line in dgram.split(b"\n"):
+            m = parse_metric(line)
+            tenants = [t for t in m.tags if t.startswith("tenant:")]
+            assert len(tenants) == 1
+            assert m.tags[-1] == tenants[0]  # tenant tag appended LAST
+            seen.add(tenants[0])
+    assert seen <= {"tenant:t%d" % i for i in range(4)}
+    assert len(seen) >= 2  # multiple tenants actually drawn
+
+
+def test_single_tenant_emits_no_tenant_tag():
+    ring = small_spec(tenant_count=1).build_ring()
+    for dgram in ring.datagrams():
+        assert b"tenant:" not in dgram
+    # tenant_count=1 is bit-identical to a spec that never heard of
+    # tenants: the knobs are dormant (zero extra RNG draws)
+    legacy = small_spec().build_ring()
+    assert ring.content_hash == legacy.content_hash
+    assert ring.serialize() == legacy.serialize()
+
+
+def test_abusive_tenant_churns_keys_beyond_num_keys():
+    spec = small_spec(num_keys=50, tenant_count=3,
+                      tenant_abusive_frac=0.5, tenant_churn_keys=400,
+                      ring_lines=2000)
+    churned = set()
+    abusive_lines = 0
+    for dgram in spec.build_ring().datagrams():
+        for line in dgram.split(b"\n"):
+            m = parse_metric(line)
+            # names look like "lg.ms195": prefix, type token, key id
+            key_id = int(m.key.name.split(".")[-1].lstrip(
+                "abcdefghijklmnopqrstuvwxyz"))
+            if "tenant:t2" in m.tags:  # last tenant id is the abuser
+                abusive_lines += 1
+                assert key_id >= 50  # churned namespace only
+                churned.add(key_id)
+            else:
+                assert key_id < 50  # innocents never touch it
+    assert abusive_lines > 500  # ~half the 2000 lines
+    assert len(churned) > 100  # the cardinality attack is real
+
+
+def test_spec_tenant_validation():
+    for bad in (dict(tenant_count=0), dict(tenant_count=5000),
+                dict(tenant_abusive_frac=-0.1),
+                dict(tenant_abusive_frac=1.5),
+                dict(tenant_zipf_s=-1.0), dict(tenant_churn_keys=-1)):
+        with pytest.raises(ValueError):
+            small_spec(**bad).build_ring()
+
+
+def test_config_loadgen_tenant_keys_flow_to_spec():
+    cfg = Config(loadgen_tenant_count=8, loadgen_tenant_abusive_frac=0.25,
+                 loadgen_tenant_zipf_s=1.2, loadgen_tenant_churn_keys=99)
+    validate_config(cfg)
+    spec = WorkloadSpec.from_config(cfg)
+    assert spec.tenant_count == 8
+    assert spec.tenant_abusive_frac == 0.25
+    assert spec.tenant_zipf_s == 1.2
+    assert spec.tenant_churn_keys == 99
+    assert spec.to_dict()["tenant_count"] == 8

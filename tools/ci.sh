@@ -101,6 +101,20 @@ timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
   python tools/soak_ring_churn.py --quick
 
+# Tenant-isolation lane: two seeded runs sharing bit-identical innocent
+# traffic — baseline vs an abusive tenant exploding series cardinality
+# against a per-tenant budget (core/tenancy.py). Gates the QoS layer's
+# contracts: innocents emit bit-for-bit what the baseline emits, the
+# abuser is capped at exactly its budget (reject-new, never evict-live),
+# per-tenant conservation is exact, and the heavy-hitter sketch names
+# the abuser's hot key. Artifact: TENANT_ISOLATION_SOAK.json (committed
+# copy is the full 12-interval run; the lane redirects its miniature
+# artifact to /tmp so quick never clobbers it).
+echo "== tenant-isolation lane (seeded adversarial QoS soak) =="
+timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/soak_tenant_isolation.py --quick
+
 # Sustained-rate floor: the loadgen harness drives a live server's UDP
 # socket at a fixed offered rate for 5 flush intervals and fails on
 # loss or broken flush cadence. 50k lines/s with the pipelined flush

@@ -299,6 +299,7 @@ def fuzz_loadgen(rng, t_end) -> int:
     path. A generator that emits unparseable traffic would silently
     deflate every sustained-pipeline number (loss would be synthetic)."""
     from veneur_tpu import native as native_mod
+    from veneur_tpu.core.metrics import DEFAULT_TENANT, tenant_of
     from veneur_tpu.loadgen.spec import WorkloadSpec
     from veneur_tpu.protocol import ssf_wire
     from veneur_tpu.protocol.dogstatsd import parse_metric, ParseError
@@ -311,6 +312,7 @@ def fuzz_loadgen(rng, t_end) -> int:
     while time.time() < t_end:
         mix = [rng.random() for _ in range(5)]
         mix[rng.randrange(5)] += 0.2  # guarantee a positive sum
+        tenants = rng.choice([1, 1, 2, 5, 16])
         spec = WorkloadSpec(
             seed=rng.randrange(1 << 30),
             num_keys=rng.choice([1, 3, 97, 1000]),
@@ -320,7 +322,13 @@ def fuzz_loadgen(rng, t_end) -> int:
             tag_cardinality=rng.choice([1, 5, 50]),
             prefix=rng.choice(["lg", "fz.deep.prefix", "a"]),
             datagram_bytes=rng.choice([64, 512, 1400, 8192]),
-            ring_lines=2000)
+            ring_lines=2000,
+            tenant_count=tenants,
+            tenant_abusive_frac=(
+                0.0 if tenants == 1 else rng.choice([0.0, 0.3, 1.0])),
+            tenant_zipf_s=rng.choice([0.0, 1.0]),
+            tenant_churn_keys=rng.choice([0, 500]))
+        valid_tenants = {f"t{i}" for i in range(tenants)}
         ring = spec.build_ring()
         py_total = native_total = 0
         for i in range(len(ring)):
@@ -335,6 +343,18 @@ def fuzz_loadgen(rng, t_end) -> int:
                 if not m.key.name.startswith(spec.prefix + "."):
                     print(f"loadgen DIVERGE name outside prefix: "
                           f"{m.key.name!r} spec={spec.to_dict()}")
+                    return -1
+                # tenant stamping property: multi-tenant specs put a
+                # valid tenant:tN tag on EVERY line, single-tenant
+                # specs on none (tenant_of sees only the default)
+                t = tenant_of(m.tags, "tenant")
+                if tenants == 1 and t != DEFAULT_TENANT:
+                    print(f"loadgen DIVERGE tenant tag on single-tenant"
+                          f" line: {line!r} spec={spec.to_dict()}")
+                    return -1
+                if tenants > 1 and t not in valid_tenants:
+                    print(f"loadgen DIVERGE bad tenant {t!r}: {line!r} "
+                          f"spec={spec.to_dict()}")
                     return -1
                 py_total += 1
             before = ni.processed

@@ -1,0 +1,285 @@
+"""Adversarial tenant-isolation soak for the per-tenant QoS layer.
+
+Two seeded runs share BIT-IDENTICAL innocent traffic (three tagged
+tenants plus the untagged default tenant, fixed series sets, values
+varying by interval):
+
+  baseline — innocents only;
+  abuse    — the same innocent lines with an abusive tenant ("evil")
+             interleaved at seeded positions, exploding fresh series
+             names every interval (the cardinality attack) while also
+             hammering a couple of legitimately-admitted hot series.
+
+The abuser is capped by a per-tenant series budget (core/tenancy.py);
+innocents are unbudgeted. Pass criteria, per interval and at the end:
+
+    isolation      every innocent metric the abuse run emits is
+                   bit-for-bit identical to the baseline run, interval
+                   for interval (names, values, tags, types);
+    capped         the abuser's live series == its budget exactly, and
+                   every sample for an already-admitted abusive series
+                   keeps aggregating (reject-new, never evict-live);
+    conservation   per tenant, lifetime accepted == kept + rejected +
+                   dropped, exact (Python ingest path: true rejection,
+                   dropped == 0);
+    honest ledger  series-level rejections counted for the abuser only,
+                   zero governor shed events attributable to innocents;
+    detection      the heavy-hitter sketch names the abuser's hot key,
+                   and its per-tenant insert totals are exact for the
+                   innocents.
+
+Writes TENANT_ISOLATION_SOAK.json at the repo root (VENEUR_ARTIFACT_DIR
+redirects) and prints one JSON line; exits nonzero on any violation.
+
+--quick is the CI lane: fewer intervals and smaller series sets, same
+invariants.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _soak_common import rss_mb, write_artifact  # noqa: E402
+
+INNOCENTS = ("t0", "t1", "t2")
+ABUSER = "evil"
+
+
+def innocent_lines(it: int, n_histo: int, n_counter: int,
+                   n_set: int) -> list[bytes]:
+    """Deterministic per-interval innocent traffic: identical in both
+    runs by construction (no RNG)."""
+    lines = []
+    for t in INNOCENTS:
+        for j in range(n_histo):
+            v = (j * 13 + it * 7) % 211
+            lines.append(b"iso.%s.h%d:%d|ms|#tenant:%s"
+                         % (t.encode(), j, v, t.encode()))
+        for j in range(n_counter):
+            lines.append(b"iso.%s.c%d:2|c|#tenant:%s"
+                         % (t.encode(), j, t.encode()))
+        for j in range(n_set):
+            lines.append(b"iso.%s.s%d:item%d|s|#tenant:%s"
+                         % (t.encode(), j, it % 5, t.encode()))
+    # the untagged default tenant must ride through untouched too
+    for j in range(10):
+        lines.append(b"iso.plain.c%d:1|c" % j)
+    return lines
+
+
+def abusive_lines(it: int, churn: int, hot_samples: int) -> list[bytes]:
+    """The attack: `churn` fresh series names per interval (unbounded
+    cardinality) plus a hot, legitimately-admitted series hammered with
+    samples — the budget must cap the former without touching the
+    latter."""
+    ab = ABUSER.encode()
+    lines = [b"iso.evil.k%d:1|c|#tenant:%s" % (it * churn + j, ab)
+             for j in range(churn)]
+    lines += [b"iso.evil.hot:%d|ms|#tenant:%s" % (j % 50, ab)
+              for j in range(hot_samples)]
+    return lines
+
+
+def run_side(abuse: bool, *, intervals: int, budget: int, n_histo: int,
+             n_counter: int, n_set: int, churn: int, hot_samples: int,
+             seed: int, pcts, aggs) -> dict:
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.flusher import (
+        device_quantiles,
+        generate_inter_metrics,
+    )
+    from veneur_tpu.core.metrics import HistogramAggregates
+    from veneur_tpu.core.server import Server
+
+    cfg = Config(interval="10s", percentiles=pcts, aggregates=aggs,
+                 num_workers=2, tpu_native_ingest=False,
+                 tenant_budgets={ABUSER: budget})
+    srv = Server(cfg)
+    qs = device_quantiles(pcts, HistogramAggregates.from_names(aggs))
+    rng = random.Random(seed)  # drives ONLY abusive interleave positions
+    innocent_hashes = []
+    innocent_counts = []
+    try:
+        for it in range(intervals):
+            lines = innocent_lines(it, n_histo, n_counter, n_set)
+            if abuse:
+                # interleave at seeded positions; insertion preserves the
+                # innocents' relative order, so their per-worker sample
+                # order — and therefore every fold — is unchanged
+                for line in abusive_lines(it, churn, hot_samples):
+                    lines.insert(rng.randrange(len(lines) + 1), line)
+            batch, size = [], 0
+            for line in lines:
+                if size + len(line) + 1 > cfg.metric_max_length and batch:
+                    srv.process_metric_packet(b"\n".join(batch))
+                    batch, size = [], 0
+                batch.append(line)
+                size += len(line) + 1
+            if batch:
+                srv.process_metric_packet(b"\n".join(batch))
+
+            metrics = []
+            for w, lock in zip(srv.workers, srv._worker_locks):
+                with lock:
+                    snap = w.flush(qs, 10.0)
+                metrics.extend(generate_inter_metrics(
+                    snap, True, pcts, HistogramAggregates.from_names(aggs),
+                    now=1000 + it))
+            innocent = sorted(
+                (m.name, int(m.type), repr(float(m.value)), tuple(m.tags))
+                for m in metrics
+                if "tenant:%s" % ABUSER not in m.tags)
+            innocent_counts.append(len(innocent))
+            innocent_hashes.append(hashlib.sha256(
+                json.dumps(innocent).encode()).hexdigest())
+
+        # lifetime per-tenant accounting, summed across workers
+        life: dict[str, dict[str, int]] = {
+            k: {} for k in ("accepted", "kept", "rejected", "dropped")}
+        for w, lock in zip(srv.workers, srv._worker_locks):
+            with lock:
+                wl = w.tenant_lifetime()
+            for kind, per in wl.items():
+                acc = life[kind]
+                for t, n in per.items():
+                    acc[t] = acc.get(t, 0) + n
+        sketch_totals: dict[str, int] = {}
+        hot_named = False
+        for w in srv.workers:
+            sk = w.tenant_sketch
+            if sk is None:
+                continue
+            for t, n in sk.totals().items():
+                sketch_totals[t] = sketch_totals.get(t, 0) + n
+            hot_named = hot_named or any(
+                "iso.evil.hot" in key for key, _, _ in sk.top_keys(ABUSER))
+        return {
+            "innocent_hashes": innocent_hashes,
+            "innocent_counts": innocent_counts,
+            "life": life,
+            "ledger_live": srv.tenant_ledger.live_counts(),
+            "ledger_over_budget": sorted(srv.tenant_ledger.over_budget()),
+            "series_rejected": srv.tenant_ledger.series_rejected_counts(),
+            "governor_sheds": dict(
+                srv.flush_governor.tenant_shed_counts()),
+            "sketch_totals": sketch_totals,
+            "abuser_hot_key_named": hot_named,
+            "overload_dropped": srv.ingress_stats()["overload_dropped"],
+        }
+    finally:
+        srv.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI lane: short run, small series sets")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    quick = args.quick
+
+    intervals = int(os.environ.get("VENEUR_SOAK_INTERVALS",
+                                   4 if quick else 12))
+    n_histo = 10 if quick else 30
+    n_counter = 8 if quick else 15
+    n_set = 5 if quick else 10
+    budget = 12 if quick else 40
+    churn = 60 if quick else 150
+    hot_samples = 20
+    pcts = [0.5]
+    aggs = ["min", "max", "count"]
+    rss0 = rss_mb()
+    t_start = time.perf_counter()
+
+    knobs = dict(intervals=intervals, budget=budget, n_histo=n_histo,
+                 n_counter=n_counter, n_set=n_set, churn=churn,
+                 hot_samples=hot_samples, seed=args.seed, pcts=pcts,
+                 aggs=aggs)
+    base = run_side(False, **knobs)
+    abusive = run_side(True, **knobs)
+
+    # what each tenant actually put on the wire
+    innocent_sent = n_histo + n_counter + n_set
+    abuser_sent = (churn + hot_samples) * intervals
+    life = abusive["life"]
+
+    def gap(t: str) -> int:
+        return (life["accepted"].get(t, 0) - life["kept"].get(t, 0)
+                - life["rejected"].get(t, 0) - life["dropped"].get(t, 0))
+
+    tenants = set(life["accepted"])
+    innocents = [t for t in tenants if t != ABUSER]
+    checks = {
+        "innocents_bit_identical": (
+            base["innocent_hashes"] == abusive["innocent_hashes"]),
+        "baseline_clean": (base["ledger_over_budget"] == []
+                           and base["series_rejected"] == {}),
+        "abuser_capped_at_budget": (
+            abusive["ledger_live"].get(ABUSER, 0) == budget),
+        "abuser_over_budget_flagged": (
+            abusive["ledger_over_budget"] == [ABUSER]),
+        "abuser_accepted_exact": (
+            life["accepted"].get(ABUSER, 0) == abuser_sent),
+        "abuser_admitted_series_keep_aggregating": (
+            life["kept"].get(ABUSER, 0) >= hot_samples * intervals),
+        "abuser_rejections_counted": (
+            life["rejected"].get(ABUSER, 0) > 0
+            and abusive["series_rejected"].get(ABUSER, 0) > 0),
+        "rejections_name_only_abuser": (
+            set(abusive["series_rejected"]) == {ABUSER}),
+        "conservation_exact_per_tenant": all(
+            gap(t) == 0 for t in tenants),
+        "python_path_true_rejection": (
+            all(life["dropped"].get(t, 0) == 0 for t in tenants)
+            and abusive["overload_dropped"] == 0),
+        "zero_innocent_sheds": all(
+            t not in abusive["governor_sheds"] for t in innocents),
+        "innocent_accepted_exact": all(
+            life["accepted"].get(t, 0) == innocent_sent * intervals
+            for t in INNOCENTS),
+        "sketch_innocent_totals_exact": all(
+            abusive["sketch_totals"].get(t, 0) == n_histo * intervals
+            for t in INNOCENTS),
+        "sketch_names_abuser_hot_key": abusive["abuser_hot_key_named"],
+    }
+    failures = sorted(k for k, ok in checks.items() if not ok)
+
+    out = {
+        "quick": quick,
+        "seed": args.seed,
+        "intervals": intervals,
+        "budget": budget,
+        "innocent_series_per_tenant": innocent_sent,
+        "abuser_churn_per_interval": churn,
+        "abuser_samples_sent": abuser_sent,
+        "baseline": base,
+        "abuse": abusive,
+        "checks": checks,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_start, 1),
+        "rss_start_mb": round(rss0, 1),
+        "rss_end_mb": round(rss_mb(), 1),
+    }
+    write_artifact("TENANT_ISOLATION_SOAK.json", out)
+    print(json.dumps({"metric": "tenant_isolation_soak_ok",
+                      "value": 0.0 if failures else 1.0,
+                      "unit": "bool",
+                      "abuser_live": abusive["ledger_live"].get(ABUSER, 0),
+                      "abuser_rejected":
+                          life["rejected"].get(ABUSER, 0),
+                      "failures": failures}))
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

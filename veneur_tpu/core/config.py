@@ -226,6 +226,32 @@ class Config:
     loadgen_prefix: str = "lg"
     loadgen_datagram_bytes: int = 1400  # pack target per datagram
     loadgen_ring_lines: int = 200000  # distinct lines in the send ring
+    # multi-tenant workloads (per-tenant QoS soak): >1 stamps every line
+    # with a tenant:tN tag. The LAST tenant (t{count-1}) is the abusive
+    # one: abusive_frac of all lines go to it, and its key space churns
+    # over tenant_churn_keys extra names (the cardinality attack the
+    # series budget defends against). Innocent tenants draw Zipf
+    # (tenant_zipf_s; 0 = uniform) over the remaining ids. 1 (default)
+    # emits byte-identical legacy output — no tenant tag at all.
+    loadgen_tenant_count: int = 1
+    loadgen_tenant_abusive_frac: float = 0.0
+    loadgen_tenant_zipf_s: float = 0.0
+    loadgen_tenant_churn_keys: int = 0
+    # per-tenant QoS (core/tenancy.py): tag key whose value names the
+    # owning tenant (samples without it belong to the "default" tenant),
+    # a per-tenant distinct-series budget enforced at series-adopt time
+    # (over budget: NEW series are rejected with honest
+    # tenant.samples_rejected_total counters; existing series keep
+    # aggregating — reject-new, never evict-live), and the on-device
+    # heavy-hitter sketch dimensions (ops/heavyhitter.py) behind the
+    # per-tenant top-k telemetry. tenant_default_budget 0 with no
+    # per-tenant override disables the whole layer (zero overhead).
+    tenant_tag_key: str = "tenant"
+    tenant_default_budget: int = 0  # distinct series per tenant; 0 = off
+    tenant_budgets: dict = field(default_factory=dict)  # tenant → budget
+    tenant_sketch_depth: int = 4
+    tenant_sketch_width: int = 2048  # power of two
+    tenant_topk: int = 8
     # set-sketch storage: "staged" keeps small sets host-side sparse and
     # promotes rows past 2^p/8 distinct registers to dense device rows
     # (the scalable default — 1M small-set series costs ~MBs instead of
@@ -521,6 +547,17 @@ def _coerce(value: Any, target: Any, key: str) -> Any:
         if isinstance(value, str):
             return [v for v in value.split(",") if v]
         return list(value)
+    if isinstance(target, dict):
+        # env overlay form: "name:value,name:value" (tenant_budgets)
+        if isinstance(value, str):
+            out: dict[str, int] = {}
+            for part in value.split(","):
+                if not part:
+                    continue
+                name, _, v = part.partition(":")
+                out[name] = int(v)
+            return out
+        return dict(value)
     return value
 
 
@@ -679,3 +716,30 @@ def validate_config(cfg: Config) -> None:
         raise ValueError("loadgen_ring_lines must be >= 1")
     if not cfg.loadgen_prefix or cfg.loadgen_prefix[0] in "0123456789":
         raise ValueError("loadgen_prefix must be a valid metric name stem")
+    if not (1 <= cfg.loadgen_tenant_count <= 4096):
+        raise ValueError("loadgen_tenant_count must be in [1, 4096]")
+    if not (0.0 <= cfg.loadgen_tenant_abusive_frac <= 1.0):
+        raise ValueError("loadgen_tenant_abusive_frac must be in [0,1]")
+    if cfg.loadgen_tenant_zipf_s < 0:
+        raise ValueError("loadgen_tenant_zipf_s must be >= 0")
+    if cfg.loadgen_tenant_churn_keys < 0:
+        raise ValueError("loadgen_tenant_churn_keys must be >= 0")
+    if not cfg.tenant_tag_key:
+        raise ValueError("tenant_tag_key must be non-empty")
+    if cfg.tenant_default_budget < 0:
+        raise ValueError("tenant_default_budget must be >= 0 (0 disables"
+                         " the tenant QoS layer)")
+    if not isinstance(cfg.tenant_budgets, dict) or any(
+            not isinstance(k, str) or int(v) < 0
+            for k, v in cfg.tenant_budgets.items()):
+        raise ValueError("tenant_budgets must map tenant name → series"
+                         " budget >= 0 (0 = unlimited for that tenant)")
+    if not (1 <= cfg.tenant_sketch_depth <= 8):
+        raise ValueError("tenant_sketch_depth must be in [1,8]")
+    w = cfg.tenant_sketch_width
+    if not (64 <= w <= (1 << 20)) or (w & (w - 1)):
+        raise ValueError("tenant_sketch_width must be a power of two"
+                         " in [64, 2^20] (the sketch hash masks, never"
+                         " mods)")
+    if not (1 <= cfg.tenant_topk <= 1024):
+        raise ValueError("tenant_topk must be in [1,1024]")

@@ -78,6 +78,13 @@ class RowMeta:
     tags: list[str]
     scope_class: ScopeClass
     sinks: Optional[frozenset[str]]  # from veneursinkonly: tags
+    # per-tenant QoS (core/tenancy.py): which tenant owns the series, and
+    # whether the tenant ledger admitted it. The Python upsert path never
+    # creates a row for a rejected series; the native path assigns rows in
+    # C++ before Python sees them, so a rejected series lands here with
+    # admitted=False and the flush skips it (both emit paths).
+    tenant: str = ""
+    admitted: bool = True
     # lazily-built wire fragment for the native encoders; False = not
     # yet built, None = contains the separators, use the Python path
     _frag: object = False
@@ -103,6 +110,12 @@ class _Pool:
     # no-routing case skips per-row checks entirely
     scope_codes: array = field(default_factory=lambda: array("b"))
     routed_rows: int = 0
+    # per-row admission codes (1 admitted / 0 rejected), same packed-byte
+    # idiom as scope_codes so the columnar flush gets a zero-copy numpy
+    # mask; rejected_rows counts them so the common all-admitted case
+    # skips per-row checks entirely
+    admit_codes: array = field(default_factory=lambda: array("b"))
+    rejected_rows: int = 0
     # \x1e-joined wire_frag arena over rows [0, len(rows)), maintained
     # incrementally at adopt so the flush hands the native emit tier one
     # contiguous buffer with zero per-row work; poisoned (frag_clean
@@ -115,23 +128,23 @@ class _Pool:
         when some row needs the Python path."""
         return self.frag_arena if self.frag_clean else None
 
-    def upsert(self, key: MetricKey, scope_class: ScopeClass, tags: list[str]
-               ) -> tuple[int, bool]:
+    def upsert(self, key: MetricKey, scope_class: ScopeClass, tags: list[str],
+               tenant: str = "") -> tuple[int, bool]:
         k = (key, scope_class)
         row = self.index.get(k)
         if row is not None:
             return row, False
         row = len(self.rows)
-        self.adopt(row, key, scope_class, tags)
+        self.adopt(row, key, scope_class, tags, tenant=tenant)
         return row, True
 
     def adopt(self, row: int, key: MetricKey, scope_class: ScopeClass,
-              tags: list[str]) -> None:
+              tags: list[str], tenant: str = "") -> None:
         """Register metadata for a row assigned externally (the native
         directory assigns rows in the same append order)."""
         self.adopt_meta(row, RowMeta(
             key=key, tags=tags, scope_class=scope_class,
-            sinks=route_info(tags)))
+            sinks=route_info(tags), tenant=tenant))
 
     def adopt_meta(self, row: int, meta: RowMeta) -> None:
         """Adopt with prebuilt metadata (the worker's cross-epoch adopt
@@ -143,6 +156,9 @@ class _Pool:
         if meta.sinks is not None:
             self.routed_rows += 1
         self.scope_codes.append(int(meta.scope_class))
+        self.admit_codes.append(1 if meta.admitted else 0)
+        if not meta.admitted:
+            self.rejected_rows += 1
         self.rows.append(meta)
         if self.frag_clean:
             frag = meta.wire_frag()
@@ -167,12 +183,12 @@ class SeriesDirectory:
         self.sets = _Pool()  # set series → HLL rows
 
     def upsert_histo(self, key: MetricKey, scope_class: ScopeClass,
-                     tags: list[str]) -> tuple[int, bool]:
-        return self.histo.upsert(key, scope_class, tags)
+                     tags: list[str], tenant: str = "") -> tuple[int, bool]:
+        return self.histo.upsert(key, scope_class, tags, tenant=tenant)
 
     def upsert_set(self, key: MetricKey, scope_class: ScopeClass,
-                   tags: list[str]) -> tuple[int, bool]:
-        return self.sets.upsert(key, scope_class, tags)
+                   tags: list[str], tenant: str = "") -> tuple[int, bool]:
+        return self.sets.upsert(key, scope_class, tags, tenant=tenant)
 
     @property
     def num_histo_rows(self) -> int:

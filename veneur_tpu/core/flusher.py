@@ -106,6 +106,7 @@ def generate_inter_metrics(
             want_median=bool(aggregates.value & Aggregate.MEDIAN),
             want_hmean=bool(aggregates.value & Aggregate.HARMONIC_MEAN),
         )
+        hrej = snap.directory.histo.rejected_rows > 0
         for row, meta in enumerate(hrows):
             if governor is not None and row and row % 200_000 == 0:
                 # the entry beat above covers small flushes; at 1M rows
@@ -113,6 +114,11 @@ def generate_inter_metrics(
                 # pipeline it overlaps the NEXT interval's extract — the
                 # watchdog must keep seeing progress, not entry-silence
                 governor.beat()
+            if hrej and not meta.admitted:
+                # tenant-budget-rejected series (native path marks the
+                # row instead of refusing it; see directory.RowMeta) —
+                # never emitted, by either path
+                continue
             cls = meta.scope_class
             if cls == ScopeClass.MIXED:
                 # locals forward mixed digests and emit no percentiles
@@ -128,7 +134,10 @@ def generate_inter_metrics(
     # -- set rows ----------------------------------------------------------
     srows = snap.directory.sets.rows
     if srows:
+        srej = snap.directory.sets.rejected_rows > 0
         for row, meta in enumerate(srows):
+            if srej and not meta.admitted:
+                continue
             # mixed sets have no local part: only the global instance emits
             # them (flusher.go:269-274); local-only sets always flush
             if meta.scope_class == ScopeClass.MIXED and is_local:
@@ -145,9 +154,13 @@ def generate_inter_metrics(
             )
 
     # -- counters ----------------------------------------------------------
-    for (key, tags, cls, sinks), value in zip(
+    cpool = snap.scalars.counters
+    crej = cpool.rejected_rows > 0
+    for row, ((key, tags, cls, sinks), value) in enumerate(zip(
         snap.scalars.counter_meta, snap.scalars.counter_values
-    ):
+    )):
+        if crej and not cpool.admit_codes[row]:
+            continue
         if cls == ScopeClass.GLOBAL and is_local:
             continue  # forwarded, not emitted (flusher.go:276-283)
         out.append(
@@ -158,9 +171,13 @@ def generate_inter_metrics(
         )
 
     # -- gauges ------------------------------------------------------------
-    for (key, tags, cls, sinks), value in zip(
+    gpool = snap.scalars.gauges
+    grej = gpool.rejected_rows > 0
+    for row, ((key, tags, cls, sinks), value) in enumerate(zip(
         snap.scalars.gauge_meta, snap.scalars.gauge_values
-    ):
+    )):
+        if grej and not gpool.admit_codes[row]:
+            continue
         if cls == ScopeClass.GLOBAL and is_local:
             continue
         out.append(
@@ -320,6 +337,15 @@ def generate_columnar(
         is_global_row = sc == int(ScopeClass.GLOBAL)
         # a local instance forwards global rows instead of emitting them
         base = ~is_global_row if is_local else None
+        # tenant-budget-rejected rows (native path) are cut from EVERY
+        # family; hadm folds into base AND into pmask below — percentile
+        # families bypass base, and a rejected row must not leak through
+        # them. Zero-tenant runs never build the mask (rejected_rows 0).
+        hadm = None
+        if snap.directory.histo.rejected_rows > 0:
+            hadm = np.frombuffer(snap.directory.histo.admit_codes,
+                                 dtype=np.int8)[: len(hrows)] != 0
+            base = hadm if base is None else (base & hadm)
         use_global = (np.zeros(len(hrows), bool) if is_local
                       else is_global_row)
         # widen to f64 up front: the object path boxes every f32 column
@@ -388,6 +414,8 @@ def generate_columnar(
                 # mixed rows emit percentiles only on the global instance
                 # (flusher.go:61-74); local-only rows always do
                 pmask = (sc == int(ScopeClass.LOCAL)) if is_local else None
+                if hadm is not None:
+                    pmask = hadm if pmask is None else (pmask & hadm)
                 q_index = {float(q): i for i, q in
                            enumerate(np.asarray(snap.quantile_qs))}
                 for p in percentiles:
@@ -415,6 +443,10 @@ def generate_columnar(
         ssc = np.frombuffer(snap.directory.sets.scope_codes,
                             dtype=np.int8)[: len(srows)]
         smask = (~(ssc == int(ScopeClass.MIXED))) if is_local else None
+        if snap.directory.sets.rejected_rows > 0:
+            sadm = np.frombuffer(snap.directory.sets.admit_codes,
+                                 dtype=np.int8)[: len(srows)] != 0
+            smask = sadm if smask is None else (smask & sadm)
 
         def set_meta(i, _rows=srows):
             m = _rows[i]
@@ -437,6 +469,9 @@ def generate_columnar(
             continue
         csc = np.frombuffer(pool.scope_codes, dtype=np.int8)[:n]
         cmask = (~(csc == int(ScopeClass.GLOBAL))) if is_local else None
+        if pool.rejected_rows > 0:
+            cadm = np.frombuffer(pool.admit_codes, dtype=np.int8)[:n] != 0
+            cmask = cadm if cmask is None else (cmask & cadm)
 
         def scalar_meta(i, _meta=pool.meta):
             key, tags, _cls, sinks = _meta[i]
@@ -492,17 +527,30 @@ def forwardable_rows(snap: FlushSnapshot):
        dmin, dmax, drecip)
       ("set", key, tags, registers)
     """
-    for (key, tags, cls, _sinks), value in zip(
+    # tenant-budget-rejected rows never forward either: letting them ride
+    # upstream would re-spend the tenant's budget on the global tier
+    cpool = snap.scalars.counters
+    crej = cpool.rejected_rows > 0
+    for row, ((key, tags, cls, _sinks), value) in enumerate(zip(
         snap.scalars.counter_meta, snap.scalars.counter_values
-    ):
+    )):
+        if crej and not cpool.admit_codes[row]:
+            continue
         if cls == ScopeClass.GLOBAL:
             yield ("counter", key, tags, value)
-    for (key, tags, cls, _sinks), value in zip(
+    gpool = snap.scalars.gauges
+    grej = gpool.rejected_rows > 0
+    for row, ((key, tags, cls, _sinks), value) in enumerate(zip(
         snap.scalars.gauge_meta, snap.scalars.gauge_values
-    ):
+    )):
+        if grej and not gpool.admit_codes[row]:
+            continue
         if cls == ScopeClass.GLOBAL:
             yield ("gauge", key, tags, value)
+    hrej = snap.directory.histo.rejected_rows > 0
     for row, meta in enumerate(snap.directory.histo.rows):
+        if hrej and not meta.admitted:
+            continue
         if meta.scope_class == ScopeClass.LOCAL:
             continue
         if snap.digest_means is None:
@@ -518,6 +566,9 @@ def forwardable_rows(snap: FlushSnapshot):
         )
     if snap.set_registers is not None:
         # terminal (global) snapshots skip register materialization
+        srej = snap.directory.sets.rejected_rows > 0
         for row, meta in enumerate(snap.directory.sets.rows):
+            if srej and not meta.admitted:
+                continue
             if meta.scope_class == ScopeClass.MIXED:
                 yield ("set", meta.key, meta.tags, snap.set_registers[row])

@@ -29,6 +29,12 @@ TAG_LOCAL_ONLY = "veneurlocalonly"
 TAG_GLOBAL_ONLY = "veneurglobalonly"
 SINK_ONLY_TAG_PREFIX = "veneursinkonly:"
 
+# Tenant identity for the per-tenant QoS layer (core/tenancy.py). No
+# reference analog — veneur has no tenant concept; the tag key is
+# configurable (`tenant_tag_key`) and untagged traffic pools here.
+DEFAULT_TENANT_TAG_KEY = "tenant"
+DEFAULT_TENANT = "default"
+
 
 # ---------------------------------------------------------------------------
 # Metric identity
@@ -118,6 +124,22 @@ def route_info(tags: list[str]) -> Optional[frozenset[str]]:
             name = tag[len(SINK_ONLY_TAG_PREFIX):]
             info = frozenset([name]) if info is None else info | {name}
     return info
+
+
+def tenant_of(tags: list[str], tag_key: str = DEFAULT_TENANT_TAG_KEY) -> str:
+    """Extract the tenant id from a sample's tags at parse/ingest time.
+
+    The tag key is configurable (``tenant_tag_key``); untagged traffic
+    pools into ``DEFAULT_TENANT`` so single-tenant deployments see one
+    uniform bucket. Same single-scan shape as ``route_info`` above —
+    this runs on the per-sample hot path.
+    """
+    prefix = tag_key + ":"
+    plen = len(prefix)
+    for tag in tags:
+        if tag.startswith(prefix):
+            return tag[plen:] or DEFAULT_TENANT
+    return DEFAULT_TENANT
 
 
 def route_to(sinks: Optional[frozenset[str]], sink_name: str) -> bool:

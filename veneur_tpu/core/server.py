@@ -156,6 +156,26 @@ class Server:
         for w in self.workers:
             w.fold_budget_s = 0.5 * self.interval
             w.governor = self.flush_governor
+        # per-tenant QoS (core/tenancy.py): one shared series-budget
+        # ledger across workers (a tenant's budget is global, not
+        # per-shard) plus a per-worker heavy-hitter sketch folded over
+        # the columnar batch at extract time. Disabled entirely (zero
+        # overhead, bitwise-identical flushes) unless a budget is set.
+        self.tenant_ledger = None
+        self._tenant_reported: dict = {}
+        if cfg.tenant_default_budget > 0 or cfg.tenant_budgets:
+            from veneur_tpu.core.tenancy import TenantLedger, TenantSketch
+
+            self.tenant_ledger = TenantLedger(
+                default_budget=cfg.tenant_default_budget,
+                budgets=cfg.tenant_budgets,
+                tag_key=cfg.tenant_tag_key)
+            for w in self.workers:
+                w.tenancy = self.tenant_ledger
+                w.tenant_sketch = TenantSketch(
+                    depth=cfg.tenant_sketch_depth,
+                    width=cfg.tenant_sketch_width,
+                    topk=cfg.tenant_topk)
         if cfg.tpu_mesh_devices > 1:
             # config-driven mesh sharding for the aggregation state (the
             # global tier's import merge rides ICI collectives; see
@@ -1584,6 +1604,25 @@ class Server:
                 if n_staged:
                     self.stats.count("worker.samples_staged_total",
                                      n_staged, tags=[f"worker:{i}"])
+                if self.tenant_ledger is not None:
+                    # per-tenant honest-drop counters, emitted as deltas
+                    # of the worker's LIFETIME tallies (read post-swap:
+                    # swap() folds the closing epoch — including any
+                    # swap-time shed attribution — into the totals before
+                    # resetting, exactly like processed_total). Lifetime
+                    # deltas survive the epoch swap; a pre-swap per-epoch
+                    # read would miss samples shed inside swap() itself.
+                    life = worker.tenant_lifetime()
+                    for kind, stat in (
+                            ("rejected", "tenant.samples_rejected_total"),
+                            ("dropped", "tenant.overload_dropped_total")):
+                        for t, total in life[kind].items():
+                            k = (i, kind, t)
+                            delta = total - self._tenant_reported.get(k, 0)
+                            if delta:
+                                self._tenant_reported[k] = total
+                                self.stats.count(
+                                    stat, delta, tags=[f"tenant:{t}"])
         # event lines the swap caught at epoch close (would otherwise be
         # destroyed by the context reset): parse them into the NEW epoch,
         # OUTSIDE the worker locks — parsing re-enters _route
@@ -1848,6 +1887,12 @@ class Server:
         # dead backend would drop data the other sinks still take).
         behind = False
         for rname, man in self._delivery_managers():
+            if (self.tenant_ledger is not None
+                    and man.abusive_tenants is None):
+                # tenant-aware spill eviction (sinks/delivery.py): wired
+                # lazily so sinks attached after server construction
+                # still get the hook by their first flush
+                man.abusive_tenants = self.tenant_ledger.over_budget
             dstats = man.stats()
             tags = [f"sink:{rname}"]
             for key in DELIVERY_STAT_COUNTERS:
@@ -1876,6 +1921,21 @@ class Server:
                     self._delivery_behind_consec)):
             self.stats.count("flush.delivery_behind_total", 1)
             self.flush_pipeline.note_downstream_behind()
+        # per-tenant QoS gauges (core/tenancy.py): live/rejected series
+        # per tenant from the shared ledger, plus overload-shed samples
+        # attributed by the governor — the operator-facing view of which
+        # tenant is spending the cardinality budget
+        led = self.tenant_ledger
+        if led is not None:
+            for t, n in led.live_counts().items():
+                self.stats.gauge("tenant.series_live", float(n),
+                                 tags=[f"tenant:{t}"])
+            for t, n in led.series_rejected_counts().items():
+                self.stats.gauge("tenant.series_rejected", float(n),
+                                 tags=[f"tenant:{t}"])
+            for t, n in self.flush_governor.tenant_shed_counts().items():
+                self.stats.gauge("tenant.shed_samples", float(n),
+                                 tags=[f"tenant:{t}"])
         # runtime gauges (analog of the Go runtime stats, flusher.go:32-47;
         # gc.number is cumulative completed collections, mem.rss_bytes is
         # CURRENT resident set from /proc — not the misleading peak)

@@ -44,7 +44,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from veneur_tpu.core.directory import ScopeClass, SeriesDirectory, classify
-from veneur_tpu.core.metrics import MetricKey, UDPMetric, route_info
+from veneur_tpu.core.metrics import (DEFAULT_TENANT, MetricKey, UDPMetric,
+                                     route_info, tenant_of)
+from veneur_tpu.core.tenancy import TenantTallies
 from veneur_tpu.health.ledger import TransferLedger
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import microfold as mf
@@ -65,6 +67,13 @@ def _next_pow2(n: int, floor: int = 1) -> int:
     while v < n:
         v *= 2
     return v
+
+
+def _series_budget_id(scope_class: ScopeClass, key: MetricKey) -> str:
+    """The tenant ledger's series identity: distinct (key, scope_class)
+    pairs occupy distinct rows (see SeriesDirectory), so each consumes
+    budget separately."""
+    return f"{int(scope_class)}\x1f{key.key_string()}"
 
 
 # ---------------------------------------------------------------------------
@@ -335,6 +344,11 @@ class ScalarPool:
 
         self.scope_codes = _array("b")
         self.routed_rows = 0
+        # per-row admission codes + rejected-row count (per-tenant QoS,
+        # see directory._Pool): only the native path can produce a
+        # rejected scalar row (C++ assigns rows before the ledger runs)
+        self.admit_codes = _array("b")
+        self.rejected_rows = 0
         # incremental \x1e-joined wire-frag arena (see directory._Pool):
         # the native emit tier reads this buffer zero-copy at flush
         self.frag_arena = bytearray()
@@ -367,13 +381,16 @@ class ScalarPool:
         return row
 
     def adopt_row(self, row: int, key, tags, scope_class, sinks,
-                  frag=False) -> None:
+                  frag=False, admitted=True) -> None:
         """Register metadata for a row assigned externally (native path).
         ``frag`` carries a prebuilt wire_frag (the worker's cross-epoch
         RowMeta cache); False = build here (the Python upsert path)."""
         assert row == len(self.meta), "rows must be adopted in order"
         self.meta.append((key, tags, scope_class, sinks))
         self.scope_codes.append(int(scope_class))
+        self.admit_codes.append(1 if admitted else 0)
+        if not admitted:
+            self.rejected_rows += 1
         if sinks is not None:
             self.routed_rows += 1
         if self.frag_clean:
@@ -659,6 +676,17 @@ class DeviceWorker:
         # deliberately NOT in _reset_epoch — surviving the per-flush
         # directory swap is its whole purpose
         self._adopt_cache: dict = {}
+        # per-tenant QoS (core/tenancy.py), installed by the server when
+        # tenancy is configured; None keeps every tenant path dormant.
+        # The ledger is SHARED across workers (admission is a host-global
+        # decision — one tenant's series spread across workers by digest)
+        self.tenancy = None
+        self.tenant_sketch = None
+        # per-epoch / lifetime sample accounting per tenant; the epoch
+        # tallies fold into the totals at swap, the processed_total
+        # pattern (see swap())
+        self.tenant_tallies = TenantTallies()
+        self.tenant_tallies_total = TenantTallies()
         self._reset_epoch()
 
     def attach_mesh_pool(self, pool) -> None:
@@ -769,9 +797,21 @@ class DeviceWorker:
                 mtype = NativeIngest.TYPE_BY_KIND[kind]
                 key = MetricKey(name=name, type=mtype, joined_tags=joined)
                 tags = joined.split(",") if joined else []
+                tenant = ""
+                admitted = True
+                if self.tenancy is not None:
+                    # native-path budget gate: C++ already assigned the
+                    # row, so a rejected series keeps its row but is
+                    # marked admitted=False — the flusher skips it on
+                    # both emit paths. The decision caches with the
+                    # RowMeta (admission is per series lifetime).
+                    tenant = tenant_of(tags, self.tenancy.tag_key)
+                    admitted = self.tenancy.admit(
+                        tenant, _series_budget_id(ScopeClass(scope), key))
                 meta = RowMeta(key=key, tags=tags,
                                scope_class=ScopeClass(scope),
-                               sinks=route_info(tags))
+                               sinks=route_info(tags),
+                               tenant=tenant, admitted=admitted)
                 if len(cache) >= 4_000_000:
                     # unbounded series churn: drop the cache rather than
                     # grow without limit (steady workloads never hit it)
@@ -790,11 +830,11 @@ class DeviceWorker:
             elif pool == 2:
                 self.scalars.counters.adopt_row(
                     row, meta.key, meta.tags, meta.scope_class, meta.sinks,
-                    frag=meta.wire_frag())
+                    frag=meta.wire_frag(), admitted=meta.admitted)
             else:
                 self.scalars.gauges.adopt_row(
                     row, meta.key, meta.tags, meta.scope_class, meta.sinks,
-                    frag=meta.wire_frag())
+                    frag=meta.wire_frag(), admitted=meta.admitted)
 
     def sync_native_series(self) -> None:
         """Adopt pending new-series registrations mid-epoch.
@@ -1087,6 +1127,10 @@ class DeviceWorker:
             self._native_drop_seen = 0
         self._processed_py = 0
         self.parse_errors = getattr(self, "parse_errors", 0)
+        # the epoch's per-tenant tallies were accumulated into the
+        # lifetime totals by swap() before this reset (never reset the
+        # totals — they are the cross-epoch truth, like processed_total)
+        self.tenant_tallies.reset()
         self.directory = SeriesDirectory()
         self.scalars = HostScalars()
         self._histo: Optional[HistoDeviceState] = None
@@ -1156,6 +1200,22 @@ class DeviceWorker:
         self.processed += 1
         mtype = m.key.type
         scope_class = classify(mtype, m.scope)
+        tenant = ""
+        if self.tenancy is not None:
+            # budgeted admission (core/tenancy.py): a sample for a series
+            # the tenant ledger refuses is rejected HERE, before any row
+            # exists — already-admitted series always pass (the ledger is
+            # idempotent), so innocent dashboards never flap. Status
+            # checks are host-health plumbing, never budgeted.
+            tenant = tenant_of(m.tags, self.tenancy.tag_key)
+            tt = self.tenant_tallies
+            tt.accepted[tenant] = tt.accepted.get(tenant, 0) + 1
+            if self._native is None and mtype != "status":
+                if not self._admit_sample(tenant, m.key, scope_class,
+                                          mtype):
+                    tt.rejected[tenant] = tt.rejected.get(tenant, 0) + 1
+                    return
+                tt.kept[tenant] = tt.kept.get(tenant, 0) + 1
         if self.count_unique_timeseries:
             self._sample_timeseries(m, mtype, scope_class)
 
@@ -1165,7 +1225,7 @@ class DeviceWorker:
         elif mtype == "gauge":
             self._host_gauge(m.key, scope_class, m.tags, float(m.value))
         elif mtype in ("histogram", "timer"):
-            row = self._upsert_histo(m.key, scope_class, m.tags)
+            row = self._upsert_histo(m.key, scope_class, m.tags, tenant)
             if self._mesh_pool is not None:
                 self._mesh_pool.add_sample(
                     row, float(m.value), 1.0 / m.sample_rate,
@@ -1179,7 +1239,7 @@ class DeviceWorker:
             if len(self._ph_rows) >= self.batch_size:
                 self._flush_pending_histos()
         elif mtype == "set":
-            row = self._upsert_set(m.key, scope_class, m.tags)
+            row = self._upsert_set(m.key, scope_class, m.tags, tenant)
             self._ensure_sets(max(self.directory.num_set_rows, row + 1))
             h = self._set_hash64(str(m.value).encode("utf-8"))
             idx, rank = hll_ops.split_hashes(
@@ -1193,8 +1253,26 @@ class DeviceWorker:
         elif mtype == "status":
             self._host_status(m)
 
+    def _admit_sample(self, tenant: str, key: MetricKey,
+                      scope_class: ScopeClass, mtype: str) -> bool:
+        """Python-path budget gate: a series already rowed this epoch was
+        admitted (rejected series never get rows here); otherwise ask the
+        shared ledger — which is free for already-admitted series and
+        only consumes budget for genuinely new ones."""
+        if mtype in ("histogram", "timer"):
+            index = self.directory.histo.index
+        elif mtype == "set":
+            index = self.directory.sets.index
+        elif mtype == "counter":
+            index = self.scalars.counters.index
+        else:
+            index = self.scalars.gauges.index
+        if (key, scope_class) in index:
+            return True
+        return self.tenancy.admit(tenant, _series_budget_id(scope_class, key))
+
     def _upsert_histo(self, key: MetricKey, scope_class: ScopeClass,
-                      tags: list[str]) -> int:
+                      tags: list[str], tenant: str = "") -> int:
         if self._native is not None:
             row = self._native.upsert(key.name, key.type, key.joined_tags,
                                       int(scope_class))
@@ -1205,18 +1283,20 @@ class DeviceWorker:
             if self._native.pending_new_series >= 1024:
                 self._sync_native_series()
             return row
-        row, _ = self.directory.upsert_histo(key, scope_class, tags)
+        row, _ = self.directory.upsert_histo(key, scope_class, tags,
+                                             tenant=tenant)
         return row
 
     def _upsert_set(self, key: MetricKey, scope_class: ScopeClass,
-                    tags: list[str]) -> int:
+                    tags: list[str], tenant: str = "") -> int:
         if self._native is not None:
             row = self._native.upsert(key.name, "set", key.joined_tags,
                                       int(scope_class))
             if self._native.pending_new_series >= 1024:
                 self._sync_native_series()
             return row
-        row, _ = self.directory.upsert_set(key, scope_class, tags)
+        row, _ = self.directory.upsert_set(key, scope_class, tags,
+                                           tenant=tenant)
         return row
 
     def _should_count_timeseries(self, mtype: str, cls: ScopeClass) -> bool:
@@ -1771,7 +1851,51 @@ class DeviceWorker:
                     shed = total - budget
                     self.overload_dropped += shed
                     self.overload_dropped_total += shed
-                    spill_histo = tuple(a[-budget:] for a in spill_histo)
+                    led = self.tenancy
+                    if led is None:
+                        spill_histo = tuple(
+                            a[-budget:] for a in spill_histo)
+                    else:
+                        # tenant-aware shed (health/policy.py): samples
+                        # of over-budget tenants go first; with no such
+                        # tenant the keep set reduces bitwise to the
+                        # a[-budget:] slice above. Per-tenant drop
+                        # attribution lands in the epoch tallies and the
+                        # governor (the isolation soak's zero-innocent-
+                        # shed assertion reads both).
+                        from veneur_tpu.health.policy import shed_spill_keep
+
+                        sp_rows = spill_histo[0]
+                        hrows = self.directory.histo.rows
+                        row_tenants = np.array(
+                            [m.tenant or DEFAULT_TENANT for m in hrows],
+                            dtype=object)
+                        abusive = led.over_budget()
+                        if abusive:
+                            is_abusive = np.isin(
+                                row_tenants[sp_rows],
+                                np.array(sorted(abusive), dtype=object))
+                            keep = shed_spill_keep(is_abusive, budget)
+                        else:
+                            keep = np.arange(total - budget, total,
+                                             dtype=np.int64)
+                        drop_mask = np.ones(total, bool)
+                        drop_mask[keep] = False
+                        t_list, t_counts = np.unique(
+                            row_tenants[sp_rows[drop_mask]],
+                            return_counts=True)
+                        tt = self.tenant_tallies
+                        gov = self.governor
+                        for t, c in zip(t_list.tolist(),
+                                        t_counts.tolist()):
+                            tt.dropped[t] = tt.dropped.get(t, 0) + int(c)
+                            if gov is not None:
+                                try:
+                                    gov.note_tenant_shed(t, int(c))
+                                except AttributeError:
+                                    pass
+                        spill_histo = tuple(
+                            a[keep] for a in spill_histo)
             if native_stage is not None and self._mesh_pool is not None:
                 # samples staged before attach_mesh_pool() disabled
                 # staging belong to the mesh shards, not the local fold
@@ -1874,10 +1998,21 @@ class DeviceWorker:
             spill_histo=spill_histo, device_stage=device_stage,
             micro_residual=micro_residual,
         )
+        # per-tenant lifetime fold, still under the caller's ingest lock
+        # and BEFORE the epoch reset zeroes the per-epoch dicts — the
+        # processed_total pattern above, per tenant per kind, so a
+        # tenant's drops in this epoch survive a late pipelined extract
+        self.tenant_tallies.accumulate_into(self.tenant_tallies_total)
         self.processed = 0
         self.imported = 0
         self._reset_epoch()
         return swapped
+
+    def tenant_lifetime(self) -> dict:
+        """Lifetime + current-epoch per-tenant tallies as plain dicts
+        (the ingress_stats pattern: totals + live epoch). Caller holds
+        this worker's ingest lock."""
+        return self.tenant_tallies_total.merged_with(self.tenant_tallies)
 
     def _fold_one_plane(self, fields: tuple, pending: list, s_eff: int
                         ) -> tuple:
@@ -2117,6 +2252,21 @@ class DeviceWorker:
             snap.dsum, snap.dcount, snap.drecip = dsum[:n], dcount[:n], drecip[:n]
             snap.lmin, snap.lmax = lmin[:n], lmax[:n]
             snap.lsum, snap.lweight, snap.lrecip = lsum[:n], lweight[:n], lrecip[:n]
+            sk = self.tenant_sketch
+            if sk is not None and n:
+                # heavy-hitter fold (core/tenancy.TenantSketch): one
+                # (tenant row, series key, folded sample count) triple
+                # per live histo series per interval, scatter-added into
+                # the per-tenant count-min pool on device. Runs here —
+                # off the ingest lock, extractions never overlap — so
+                # detection costs the ingest path nothing.
+                hrows = directory.histo.rows
+                tenants = [m.tenant or DEFAULT_TENANT for m in hrows]
+                skeys = [m.key.key_string() for m in hrows]
+                kcounts = np.maximum(
+                    np.nan_to_num(snap.dcount[:n]), 0).astype(np.int64)
+                sk.fold(tenants, skeys, kcounts,
+                        _next_pow2(min(len(skeys), 1 << 15), 256))
             # the [S,C] centroid pools are read back ONLY where forwarding
             # can consume them (a local tier serializes digests upstream;
             # reference flusher.go:338-433). A terminal server — global or

@@ -135,6 +135,11 @@ class FlushDeadlineGovernor:
         self.micro_folds_total = 0
         self.micro_fold_samples_total = 0
         self._micro_folds_window = 0
+        # per-tenant shed attribution (per-tenant QoS, core/tenancy.py):
+        # lifetime overload-shed sample counts by tenant. The isolation
+        # soak's contract reads from here — zero shed events may ever be
+        # attributable to an innocent tenant while an abusive one floods
+        self.tenant_shed_total: dict = {}
 
     @property
     def enabled(self) -> bool:
@@ -197,6 +202,21 @@ class FlushDeadlineGovernor:
             self.micro_folds_total += 1
             self.micro_fold_samples_total += int(samples)
             self._micro_folds_window += 1
+
+    def note_tenant_shed(self, tenant: str, samples: int) -> None:
+        """Attribute `samples` overload-shed samples to `tenant` (the
+        worker's swap-time spill shed, health/policy.shed_spill_keep).
+        Kept on the governor because shedding is a governor-adjacent
+        overload signal and the soak reads one shared attribution
+        point across all workers."""
+        with self._lock:
+            self._last_beat_unix = time.time()
+            self.tenant_shed_total[tenant] = (
+                self.tenant_shed_total.get(tenant, 0) + int(samples))
+
+    def tenant_shed_counts(self) -> dict:
+        with self._lock:
+            return dict(self.tenant_shed_total)
 
     def progress(self) -> dict:
         """Snapshot for the watchdog deferral decision."""

@@ -103,6 +103,39 @@ def pipeline_should_shed(queue_depth: int,
     return queue_depth >= max(1, int(max_backlog))
 
 
+# Tenant-aware shed ordering (per-tenant QoS, core/tenancy.py): when the
+# worker's swap-time spill shed must drop samples to hold the fold
+# budget, samples belonging to an OVER-BUDGET tenant go first — the
+# tenant already exceeding its series budget is, by construction, the
+# one converting overload into everyone else's flush latency. Within a
+# class (abusive / innocent) the newest samples are kept, matching the
+# blanket shed's freshest-values-win rule, so a run with no over-budget
+# tenant reduces bitwise to the old `a[-budget:]` slice.
+
+
+def shed_spill_keep(is_abusive, budget: int):
+    """Indices (ascending, length min(budget, n)) of the spill samples to
+    KEEP: newest innocents first, then newest abusive samples only if
+    innocents alone can't fill the budget. `is_abusive` is a bool array
+    over the spill batch in arrival order. Pure numpy, deterministic."""
+    import numpy as np
+
+    flags = np.asarray(is_abusive, dtype=bool)
+    n = len(flags)
+    budget = max(0, int(budget))
+    if n <= budget:
+        return np.arange(n, dtype=np.int64)
+    idx = np.arange(n, dtype=np.int64)
+    innocents = idx[~flags]
+    if len(innocents) >= budget:
+        return innocents[len(innocents) - budget:]
+    abusive = idx[flags]
+    keep = np.concatenate(
+        [innocents, abusive[len(abusive) - (budget - len(innocents)):]])
+    keep.sort()
+    return keep
+
+
 def stall_window_s(interval_s: float, chunk_target_s: float) -> float:
     """Maximum progress-beat age that still counts as a live flush."""
     return max(float(interval_s), STALL_MULTIPLIER * float(chunk_target_s))

@@ -34,6 +34,17 @@ class WorkloadSpec:
     prefix: str = "lg"
     datagram_bytes: int = 1400
     ring_lines: int = 200000
+    # multi-tenant dimension (per-tenant QoS soak): tenant_count > 1
+    # stamps every line with a trailing tenant:tN tag. The LAST tenant
+    # is the abusive one — tenant_abusive_frac of lines go to it and
+    # its key space churns over tenant_churn_keys names beyond
+    # num_keys (the cardinality attack the series budget defends
+    # against); innocents draw Zipf(tenant_zipf_s; 0 = uniform) over
+    # the remaining ids. 1 emits byte-identical single-tenant output.
+    tenant_count: int = 1
+    tenant_abusive_frac: float = 0.0
+    tenant_zipf_s: float = 0.0
+    tenant_churn_keys: int = 0
 
     @classmethod
     def from_config(cls, cfg: "Config") -> "WorkloadSpec":
@@ -47,6 +58,10 @@ class WorkloadSpec:
             prefix=cfg.loadgen_prefix,
             datagram_bytes=cfg.loadgen_datagram_bytes,
             ring_lines=cfg.loadgen_ring_lines,
+            tenant_count=cfg.loadgen_tenant_count,
+            tenant_abusive_frac=cfg.loadgen_tenant_abusive_frac,
+            tenant_zipf_s=cfg.loadgen_tenant_zipf_s,
+            tenant_churn_keys=cfg.loadgen_tenant_churn_keys,
         )
 
     def validate(self) -> None:
@@ -69,6 +84,14 @@ class WorkloadSpec:
             raise ValueError("ring_lines must be >= 1")
         if not self.prefix:
             raise ValueError("prefix must be non-empty")
+        if not (1 <= self.tenant_count <= 4096):
+            raise ValueError("tenant_count must be in [1, 4096]")
+        if not (0.0 <= self.tenant_abusive_frac <= 1.0):
+            raise ValueError("tenant_abusive_frac must be in [0,1]")
+        if self.tenant_zipf_s < 0:
+            raise ValueError("tenant_zipf_s must be >= 0")
+        if self.tenant_churn_keys < 0:
+            raise ValueError("tenant_churn_keys must be >= 0")
 
     def to_dict(self) -> dict:
         return {
@@ -78,6 +101,10 @@ class WorkloadSpec:
             "tag_cardinality": self.tag_cardinality,
             "prefix": self.prefix, "datagram_bytes": self.datagram_bytes,
             "ring_lines": self.ring_lines,
+            "tenant_count": self.tenant_count,
+            "tenant_abusive_frac": self.tenant_abusive_frac,
+            "tenant_zipf_s": self.tenant_zipf_s,
+            "tenant_churn_keys": self.tenant_churn_keys,
         }
 
     def build_ring(self) -> "native.LoadgenRing":
@@ -88,7 +115,11 @@ class WorkloadSpec:
         ring.synth(self.seed, self.num_keys, self.zipf_s, self.type_mix,
                    self.num_tags, self.tag_cardinality,
                    self.prefix.encode("utf-8"), self.datagram_bytes,
-                   self.ring_lines)
+                   self.ring_lines,
+                   tenant_count=self.tenant_count,
+                   tenant_abusive_frac=self.tenant_abusive_frac,
+                   tenant_zipf_s=self.tenant_zipf_s,
+                   tenant_churn_keys=self.tenant_churn_keys)
         return ring
 
     def build_ssf_ring(self, n_spans: int = 2000) -> "native.LoadgenRing":

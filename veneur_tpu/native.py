@@ -1144,7 +1144,8 @@ def load_loadgen_library() -> Optional[ctypes.CDLL]:
         lib.vn_lg_ring_synth.argtypes = [
             c.c_void_p, c.c_uint64, c.c_longlong, c.c_double,
             c.POINTER(c.c_double), c.c_int, c.c_longlong,
-            c.c_char_p, c.c_int, c.c_int, c.c_longlong]
+            c.c_char_p, c.c_int, c.c_int, c.c_longlong,
+            c.c_longlong, c.c_double, c.c_double, c.c_longlong]
         lib.vn_lg_ring_serialize.restype = c.c_longlong
         lib.vn_lg_ring_serialize.argtypes = [
             c.c_void_p, c.POINTER(c.c_char_p)]
@@ -1245,13 +1246,21 @@ class LoadgenRing:
 
     def synth(self, seed: int, n_keys: int, zipf_s: float,
               type_mix: "list[float]", n_tags: int, tag_card: int,
-              prefix: bytes, dgram_target: int, n_lines: int) -> int:
+              prefix: bytes, dgram_target: int, n_lines: int,
+              tenant_count: int = 1, tenant_abusive_frac: float = 0.0,
+              tenant_zipf_s: float = 0.0,
+              tenant_churn_keys: int = 0) -> int:
         """Build ~n_lines of DogStatsD traffic. type_mix is 5 weights
-        in LOADGEN_TYPES order. Returns the datagram count."""
+        in LOADGEN_TYPES order. tenant_count > 1 stamps a trailing
+        tenant:tN tag per line (the last tenant is the abusive one);
+        1 is byte-identical single-tenant output. Returns the
+        datagram count."""
         mix = (ctypes.c_double * len(LOADGEN_TYPES))(*type_mix)
         n = self._lib.vn_lg_ring_synth(
             self._ring, seed, n_keys, float(zipf_s), mix, n_tags,
-            tag_card, prefix, len(prefix), dgram_target, n_lines)
+            tag_card, prefix, len(prefix), dgram_target, n_lines,
+            int(tenant_count), float(tenant_abusive_frac),
+            float(tenant_zipf_s), int(tenant_churn_keys))
         if n < 0:
             raise ValueError("invalid workload spec for synth")
         return int(n)

@@ -1,0 +1,245 @@
+"""Mergeable heavy-hitter sketches on TPU: count-min + space-saving top-k.
+
+Semantics spec: Cormode & Muthukrishnan's count-min sketch (2005) and
+Metwally et al.'s space-saving top-k (2005) — the two classic mergeable
+heavy-hitter summaries. The reference has no analog (its only cardinality
+defense is coarse worker shedding); this module is the device half of the
+per-tenant QoS layer (core/tenancy.py holds the budgets it informs).
+
+Design, mirroring ops/hll.py:
+
+* A pool of T per-tenant sketches is one dense `int32[T, D, W]` counter
+  array (depth D rows of width W each). int32 — NOT float — so the
+  scatter-add is order-invariant and chunked inserts under the PR 1 pow2
+  ladder are bit-identical to a single shot (f32 accumulation would not
+  commute). W is required to be a power of two so the column index is a
+  mask, and D·W at the defaults (4×2048 = 32 KiB/tenant) stays trivially
+  small next to the t-digest and HLL pools.
+
+* Keys are hashed host-side (strings never touch the device): one
+  fmix64(fnv1a64) digest per key splits into D column indices by classic
+  double hashing — `col_d = (h1 + d·h2) mod W` with h2 forced odd so the
+  probe sequence covers the row for any pow2 W. See `split_hashes`.
+
+* insert = one flattened `scatter-add` per batch over the whole pool
+  (duplicates allowed — adds commute); cross-epoch / cross-host merge =
+  elementwise `+` (the associative reduce, same shape as hll.merge's
+  maximum); query = min over D of the addressed counters, the classic CMS
+  point estimate (overestimates by at most ε·N with probability 1-δ,
+  ε = e/W, δ = e^-D — what tests/test_heavyhitter.py asserts).
+
+* The top-k half is host-side: `SpaceSavingTopK`, a small mergeable
+  stream-summary fed per flush from the already-folded per-row counts.
+  It never touches the device — k is tiny (default 8) and the candidate
+  stream is one entry per live series per interval, not per sample.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.utils.hashing import fmix64, fnv1a_64
+
+DEFAULT_DEPTH = 4
+DEFAULT_WIDTH = 2048
+DEFAULT_TOPK = 8
+
+
+def init_pool(
+    num_tenants: int,
+    depth: int = DEFAULT_DEPTH,
+    width: int = DEFAULT_WIDTH,
+) -> jax.Array:
+    if width & (width - 1):
+        raise ValueError(f"count-min width must be a power of two, got {width}")
+    return jnp.zeros((num_tenants, depth, width), dtype=jnp.int32)
+
+
+def hash_keys(keys: list[str]) -> np.ndarray:
+    """One 64-bit digest per key, host-side: fmix64(fnv1a64(utf-8)).
+
+    fmix64 on top of fnv1a matches the ring's hashing idiom
+    (distributed/ring.py) and breaks fnv's low-bit correlation before the
+    double-hash split below.
+    """
+    out = np.empty(len(keys), dtype=np.uint64)
+    for i, k in enumerate(keys):
+        out[i] = fmix64(fnv1a_64(k.encode("utf-8")))
+    return out
+
+
+def split_hashes(
+    hashes: np.ndarray,
+    depth: int = DEFAULT_DEPTH,
+    width: int = DEFAULT_WIDTH,
+) -> np.ndarray:
+    """64-bit digests → i32[D, N] column indices via double hashing.
+
+    h1 = low 32 bits, h2 = high 32 bits forced odd (odd stride is coprime
+    with any pow2 width, so the D probes are distinct mod W for D ≤ W).
+    """
+    h = hashes.astype(np.uint64)
+    h1 = (h & np.uint64(0xFFFFFFFF)).astype(np.int64)
+    h2 = ((h >> np.uint64(32)) | np.uint64(1)).astype(np.int64)
+    d = np.arange(depth, dtype=np.int64)[:, None]
+    return ((h1[None, :] + d * h2[None, :]) & (width - 1)).astype(np.int32)
+
+
+@jax.jit
+def insert_batch(
+    pool: jax.Array,
+    rows: jax.Array,
+    col_idx: jax.Array,
+    counts: jax.Array,
+) -> jax.Array:
+    """Scatter-add a batch of (tenant row, key columns, count) into the pool.
+
+    rows: i32[N] tenant sketch row per sample; col_idx: i32[D, N] from
+    `split_hashes`; counts: i32[N] (padding: count 0 — add is a no-op).
+    Integer adds commute, so duplicate slots and any chunking of the batch
+    produce bit-identical pools (pinned by tests/test_heavyhitter.py).
+    """
+    t, d, w = pool.shape
+    flat = (rows[None, :] * d + jnp.arange(d, dtype=jnp.int32)[:, None]) * w \
+        + col_idx
+    vals = jnp.broadcast_to(counts[None, :], col_idx.shape)
+    out = pool.reshape(-1).at[flat.reshape(-1)].add(
+        vals.reshape(-1).astype(pool.dtype), mode="drop")
+    return out.reshape(t, d, w)
+
+
+def insert_chunked(
+    pool: jax.Array,
+    rows: np.ndarray,
+    col_idx: np.ndarray,
+    counts: np.ndarray,
+    chunk: int,
+) -> jax.Array:
+    """Feed a large batch through `insert_batch` in fixed-size chunks.
+
+    The tail chunk is zero-padded to `chunk` (count 0 → no-op) so XLA only
+    ever sees one batch shape per chunk size — the same compile-cache
+    discipline as the PR 1 pow2 extract ladder. Bit-identical to a single
+    `insert_batch` over the whole batch because int32 adds commute.
+    """
+    n = len(counts)
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        r = np.zeros(chunk, dtype=np.int32)
+        c = np.zeros((col_idx.shape[0], chunk), dtype=np.int32)
+        v = np.zeros(chunk, dtype=np.int32)
+        r[: hi - lo] = rows[lo:hi]
+        c[:, : hi - lo] = col_idx[:, lo:hi]
+        v[: hi - lo] = counts[lo:hi]
+        pool = insert_batch(pool, jnp.asarray(r), jnp.asarray(c),
+                            jnp.asarray(v))
+    return pool
+
+
+@jax.jit
+def merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Counter-wise add — the associative cross-epoch/cross-host reduce."""
+    return a + b
+
+
+@jax.jit
+def query(pool: jax.Array, rows: jax.Array, col_idx: jax.Array) -> jax.Array:
+    """CMS point estimate per sample: min over depth of the addressed
+    counters. i32[T,D,W] × i32[N] × i32[D,N] → i32[N]."""
+    d = pool.shape[1]
+    picked = pool[rows[None, :], jnp.arange(d, dtype=jnp.int32)[:, None],
+                  col_idx]
+    return jnp.min(picked, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def tenant_totals(pool: jax.Array) -> jax.Array:
+    """Total inserted count per tenant row: any single depth row sums to
+    the exact insert total (every insert adds `count` to each depth)."""
+    return jnp.sum(pool[:, 0, :], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Host-side mergeable top-k (space-saving / stream-summary)
+
+
+class SpaceSavingTopK:
+    """Metwally-style space-saving summary over (key, count) offers.
+
+    Holds at most ``capacity`` keys. A new key arriving into a full summary
+    evicts the current minimum and inherits its count as error bound —
+    the classic guarantee: stored_count - error <= true_count <=
+    stored_count, and any key with true count > min_count is present.
+
+    ``merge`` is the standard summary merge: counts add for shared keys;
+    a key present on one side only is credited the other side's min-count
+    as its possible undercount (added to the error bound, not the count),
+    then the union is re-truncated to capacity. Merge is commutative in
+    the reported counts (tests pin top-k stability under merge).
+    """
+
+    __slots__ = ("capacity", "counts", "errors")
+
+    def __init__(self, capacity: int = DEFAULT_TOPK):
+        if capacity < 1:
+            raise ValueError("top-k capacity must be >= 1")
+        self.capacity = capacity
+        self.counts: dict[str, int] = {}
+        self.errors: dict[str, int] = {}
+
+    def _min_key(self) -> str:
+        return min(self.counts, key=lambda k: (self.counts[k], k))
+
+    def offer(self, key: str, count: int = 1) -> None:
+        if count <= 0:
+            return
+        if key in self.counts:
+            self.counts[key] += count
+            return
+        if len(self.counts) < self.capacity:
+            self.counts[key] = count
+            self.errors[key] = 0
+            return
+        victim = self._min_key()
+        floor = self.counts.pop(victim)
+        self.errors.pop(victim)
+        self.counts[key] = floor + count
+        self.errors[key] = floor
+
+    def merge(self, other: "SpaceSavingTopK") -> None:
+        if not other.counts:
+            return
+        self_floor = min(self.counts.values()) if (
+            len(self.counts) >= self.capacity) else 0
+        other_floor = min(other.counts.values()) if (
+            len(other.counts) >= other.capacity) else 0
+        merged_counts: dict[str, int] = {}
+        merged_errors: dict[str, int] = {}
+        for key in set(self.counts) | set(other.counts):
+            a = self.counts.get(key)
+            b = other.counts.get(key)
+            if a is not None and b is not None:
+                merged_counts[key] = a + b
+                merged_errors[key] = self.errors[key] + other.errors[key]
+            elif a is not None:
+                merged_counts[key] = a + other_floor
+                merged_errors[key] = self.errors[key] + other_floor
+            else:
+                merged_counts[key] = b + self_floor
+                merged_errors[key] = other.errors[key] + self_floor
+        keep = sorted(merged_counts, key=lambda k: (-merged_counts[k], k))
+        keep = keep[: self.capacity]
+        self.counts = {k: merged_counts[k] for k in keep}
+        self.errors = {k: merged_errors[k] for k in keep}
+
+    def items(self) -> list[tuple[str, int, int]]:
+        """(key, count, error) descending by count, ties by key — the
+        deterministic order telemetry and tests rely on."""
+        return [
+            (k, self.counts[k], self.errors[k])
+            for k in sorted(self.counts, key=lambda k: (-self.counts[k], k))
+        ]

@@ -206,6 +206,11 @@ class _SpillEntry:
     # proxy stores its routed fragment here so a ring reshard can drain
     # the spill and RE-route it under the new membership (drain_spill)
     payload: object = None
+    # owning tenant when the caller knows it (per-tenant QoS): an
+    # over-budget tenant's spilled payloads are evicted FIRST when the
+    # caps bite, so an abusive tenant's flood can't push innocents'
+    # deferred data out of the bounded spill
+    tenant: str = ""
 
 
 class SpillBuffer:
@@ -223,10 +228,24 @@ class SpillBuffer:
     def __len__(self) -> int:
         return len(self._q)
 
-    def push(self, entry: _SpillEntry) -> list[_SpillEntry]:
+    def push(self, entry: _SpillEntry,
+             abusive: frozenset = frozenset()) -> list[_SpillEntry]:
         self._q.append(entry)
         self.bytes += entry.nbytes
         evicted: list[_SpillEntry] = []
+        while abusive and (len(self._q) > self.max_payloads
+                           or self.bytes > self.max_bytes):
+            # tenant-aware eviction order (health/policy.py shed
+            # ordering, applied to the spill): oldest payloads of
+            # OVER-BUDGET tenants go first; only when none remain does
+            # the blanket oldest-first rule below touch innocents
+            victim = next((e for e in self._q if e.tenant in abusive),
+                          None)
+            if victim is None:
+                break
+            self._q.remove(victim)
+            self.bytes -= victim.nbytes
+            evicted.append(victim)
         while self._q and (len(self._q) > self.max_payloads
                            or self.bytes > self.max_bytes):
             old = self._q.popleft()
@@ -269,6 +288,11 @@ class DeliveryManager:
         # counters here. The entry being spilled right now reports its
         # own eviction through the "dropped" return instead.
         self._evict_cb = evict_cb
+        # per-tenant QoS hook (installed by the server when a tenant
+        # ledger exists): zero-arg callable returning the frozenset of
+        # currently over-budget tenants, consulted at spill-eviction
+        # time so abusive tenants' payloads are pushed out first
+        self.abusive_tenants: Optional[Callable[[], frozenset]] = None
         self._lock = threading.Lock()
         self.breaker = CircuitBreaker(self.policy.breaker_threshold)
         self.spill = SpillBuffer(self.policy.spill_max_bytes,
@@ -326,25 +350,28 @@ class DeliveryManager:
     # -- the payload path ---------------------------------------------------
 
     def deliver(self, send: Callable[[float], None], nbytes: int,
-                payload: object = None) -> str:
+                payload: object = None, tenant: str = "") -> str:
         """Drive one fresh serialized payload; see class docstring for
         the outcome contract. `send(timeout_s)` performs exactly one
         network attempt and raises on failure. `payload` is opaque
         caller context that travels with the entry into the spill (see
-        _SpillEntry.payload)."""
+        _SpillEntry.payload); `tenant` names the owning tenant when the
+        caller knows it (tenant-aware spill eviction)."""
         with self._lock:
             self.accepted_payloads += 1
-        return self._deliver_entry(_SpillEntry(send, int(nbytes), payload))
+        return self._deliver_entry(
+            _SpillEntry(send, int(nbytes), payload, tenant))
 
     def defer(self, send: Callable[[float], None], nbytes: int,
-              payload: object = None) -> str:
+              payload: object = None, tenant: str = "") -> str:
         """Accept a payload straight into the spill without a network
         attempt — the proxy's bounded-handoff path when the reshard
         window runs out before a drained fragment could be re-sent.
         Returns "deferred" or "dropped" (self-evicted by the caps)."""
         with self._lock:
             self.accepted_payloads += 1
-            return self._spill_locked(_SpillEntry(send, int(nbytes), payload))
+            return self._spill_locked(
+                _SpillEntry(send, int(nbytes), payload, tenant))
 
     def _deliver_entry(self, entry: _SpillEntry) -> str:
         with self._lock:
@@ -408,7 +435,14 @@ class DeliveryManager:
         are declared dropped."""
         self.deferred_payloads += 1
         dropped_self = False
-        for old in self.spill.push(entry):
+        abusive: frozenset = frozenset()
+        if self.abusive_tenants is not None:
+            try:
+                abusive = self.abusive_tenants()
+            except Exception:  # noqa: BLE001
+                log.exception("sink %s: abusive-tenant probe failed",
+                              self.sink_name)
+        for old in self.spill.push(entry, abusive):
             self.dropped_payloads += 1
             self.dropped_bytes += old.nbytes
             if old is entry:
