@@ -1,0 +1,633 @@
+"""Write-ahead spill journal (utils/journal.py) and its delivery-layer
+integration (sinks/delivery.py): record format round-trips, crash-shaped
+corruption tolerance (torn tails, bit flips, empty segments), bounded
+retention, replay idempotence across double restarts, recovery ordering
+ahead of fresh data, the journaling-OFF A/B identity, and the splunk
+send-once journal_exempt regression."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from veneur_tpu.sinks.delivery import DeliveryPolicy
+from veneur_tpu.sinks.journal_codec import (
+    HttpEnvelope,
+    decode_envelope,
+    encode_envelope,
+    make_entry_codec,
+)
+from veneur_tpu.utils.http import HTTPError
+from veneur_tpu.utils.journal import (
+    SpillJournal,
+    _segment_name,
+    scan_pending,
+)
+
+from tests.test_delivery import FakeClock, FlakySend, make_mgr
+
+
+def mk(tmp_path, **kw):
+    kw.setdefault("fsync", "never")
+    return SpillJournal(str(tmp_path / "j"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# basic append / ack / replay
+
+
+def test_append_ack_replay_roundtrip(tmp_path):
+    j = mk(tmp_path)
+    ids = [j.append(f"payload-{i}".encode()) for i in range(5)]
+    assert ids == [1, 2, 3, 4, 5]
+    j.ack(2)
+    j.ack(4)
+    j.close()
+
+    j2 = mk(tmp_path)
+    got = j2.replay_pending()
+    assert got == [(1, b"payload-0"), (3, b"payload-2"), (5, b"payload-4")]
+    # payloads released after the first call; ids stay pending til acked
+    assert j2.replay_pending() == []
+    assert j2.pending_records() == 3
+    # ids resume past everything seen — an ACK written post-restart
+    # still cancels a pre-crash DATA record
+    assert j2.append(b"fresh") == 6
+    j2.close()
+
+
+def test_ack_unknown_id_is_noop(tmp_path):
+    j = mk(tmp_path)
+    j.append(b"x")
+    j.ack(999)
+    assert j.pending_records() == 1
+    assert j.stats()["acked"] == 0
+    j.close()
+
+
+def test_append_never_raises_after_close(tmp_path):
+    j = mk(tmp_path)
+    j.close()
+    assert j.append(b"late") is None
+    assert j.stats()["append_failed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# crash-shaped corruption
+
+
+def _only_segment(j: SpillJournal) -> str:
+    segs = sorted(
+        n for n in os.listdir(j.directory) if n.endswith(".wal")
+        and os.path.getsize(os.path.join(j.directory, n)) > 0)
+    assert len(segs) == 1
+    return os.path.join(j.directory, segs[0])
+
+
+def test_torn_tail_keeps_prefix(tmp_path):
+    j = mk(tmp_path)
+    for i in range(3):
+        j.append(f"rec-{i}".encode())
+    path = _only_segment(j)
+    j.close()
+    # SIGKILL mid-append: chop the final record in half
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(size - 5)
+
+    j2 = mk(tmp_path)
+    assert [p for _, p in j2.replay_pending()] == [b"rec-0", b"rec-1"]
+    assert j2.stats()["torn_tails"] == 1
+    assert j2.stats()["skipped_corrupt"] == 0
+    j2.close()
+
+
+def test_bit_flip_mid_segment_skips_that_record_only(tmp_path):
+    j = mk(tmp_path)
+    ids = [j.append(f"rec-{i}".encode()) for i in range(3)]
+    path = _only_segment(j)
+    j.close()
+    with open(path, "rb") as fh:
+        data = bytearray(fh.read())
+    # flip a byte inside the SECOND record's payload: its CRC fails, the
+    # length prefix resynchronises, and the third record survives
+    rec_len = len(data) // 3
+    data[rec_len + rec_len // 2] ^= 0xFF
+    with open(path, "wb") as fh:
+        fh.write(bytes(data))
+
+    j2 = mk(tmp_path)
+    got = j2.replay_pending()
+    assert [r for r, _ in got] == [ids[0], ids[2]]
+    assert j2.stats()["skipped_corrupt"] == 1
+    assert j2.stats()["torn_tails"] == 0
+    j2.close()
+
+
+def test_zero_length_segment_is_harmless(tmp_path):
+    j = mk(tmp_path)
+    j.append(b"alive")
+    j.close()
+    # a crash between segment create and first append leaves a 0-byte file
+    open(os.path.join(str(tmp_path / "j"), _segment_name(99)), "wb").close()
+
+    j2 = mk(tmp_path)
+    assert [p for _, p in j2.replay_pending()] == [b"alive"]
+    assert j2.stats()["torn_tails"] == 0
+    # fresh appends land past the empty segment's sequence number
+    with open(os.path.join(j2.directory, _segment_name(100)), "ab") as fh:
+        assert fh  # segment 100 is the active one
+    j2.close()
+
+
+def test_double_restart_replay_is_idempotent(tmp_path):
+    j = mk(tmp_path)
+    for i in range(4):
+        j.append(f"rec-{i}".encode())
+    j.close()
+
+    # restart 1: replay, ack one, crash before the rest deliver
+    j2 = mk(tmp_path)
+    got1 = j2.replay_pending()
+    assert len(got1) == 4
+    j2.ack(got1[0][0])
+    j2.close()
+
+    # restart 2: the three unacked records replay exactly once more,
+    # same ids, same payloads — no duplication from re-appending
+    j3 = mk(tmp_path)
+    got2 = j3.replay_pending()
+    assert got2 == got1[1:]
+    assert j3.stats()["appended"] == 0  # nothing re-written
+    j3.close()
+
+
+# ---------------------------------------------------------------------------
+# bounds: rolling, compaction, eviction
+
+
+def test_segment_roll_and_compaction(tmp_path):
+    # tiny segments force a roll every ~2 records
+    j = SpillJournal(str(tmp_path / "j"), fsync="never",
+                     max_bytes=1 << 20, max_segments=8,
+                     segment_bytes=80)
+    ids = [j.append(bytes(16)) for _ in range(8)]
+    assert j.stats()["segments"] > 2
+    for rid in ids:
+        j.ack(rid)
+    # every DATA acked: oldest closed segments compact away
+    assert j.stats()["compacted_segments"] > 0
+    assert j.pending_records() == 0
+    j.close()
+
+
+def test_eviction_counts_live_records(tmp_path):
+    warnings = []
+    j = SpillJournal(str(tmp_path / "j"), fsync="never",
+                     max_bytes=300, max_segments=2,
+                     segment_bytes=100, log=warnings.append)
+    for _ in range(12):
+        j.append(bytes(24))
+    st = j.stats()
+    # the cap held by deleting oldest closed segments, counting their
+    # unacked records — never silently
+    assert st["segments"] <= 2
+    assert st["evicted_records"] > 0
+    assert st["pending_records"] + st["evicted_records"] == 12
+    assert any("evicting" in w for w in warnings)
+    j.close()
+
+
+def test_set_policy_hot_reload(tmp_path):
+    j = mk(tmp_path, max_bytes=1 << 20, max_segments=8)
+    with pytest.raises(ValueError):
+        j.set_policy(fsync="sometimes")
+    j.set_policy(fsync="always", max_bytes=2 << 20, max_segments=4)
+    assert j.fsync == "always"
+    assert j.max_segments == 4
+    j.close()
+
+
+def test_scan_pending_matches_reader_view(tmp_path):
+    j = mk(tmp_path)
+    ids = [j.append(f"p{i}".encode()) for i in range(3)]
+    j.ack(ids[1])
+    # read-only cross-process view (the crash soak's kill-time census)
+    assert dict(scan_pending(j.directory)) == {ids[0]: b"p0",
+                                               ids[2]: b"p2"}
+    j.close()
+    assert dict(scan_pending(j.directory)) == {ids[0]: b"p0",
+                                               ids[2]: b"p2"}
+    assert scan_pending(str(tmp_path / "nonexistent")) == []
+
+
+# ---------------------------------------------------------------------------
+# envelope codec
+
+
+def test_envelope_codec_roundtrip():
+    env = HttpEnvelope(url="http://h:1/api", body=b"\x00bin\xff",
+                       headers={"X-K": "v"}, count=7, tenant="t1")
+    env2 = decode_envelope(encode_envelope(env))
+    assert env2 == env
+    assert decode_envelope(b"not json\nbody") is None
+    assert decode_envelope(b"") is None
+
+
+def test_entry_codec_rebuilds_sendable_entry():
+    sent = []
+
+    def opener(req, timeout):  # utils.http.Opener signature
+        sent.append((req.full_url, req.data, req.get_header("A")))
+        return b""
+
+    encode, decode = make_entry_codec(opener=opener)
+    env = HttpEnvelope(url="http://h:1/x", body=b"B", headers={"A": "b"})
+    from veneur_tpu.sinks.delivery import _SpillEntry
+
+    blob = encode(_SpillEntry(lambda t: None, 1, payload=env))
+    entry = decode(blob)
+    assert entry.nbytes == len(env.body)
+    entry.send(2.0)
+    assert sent == [("http://h:1/x", b"B", "b")]
+    # payloads without durable context stay RAM-only
+    assert encode(_SpillEntry(lambda t: None, 1, payload=None)) is None
+    assert decode(b"garbage") is None
+
+
+# ---------------------------------------------------------------------------
+# delivery-manager integration
+
+
+def outage_send():
+    return FlakySend([HTTPError(503, b"")] * 99)
+
+
+def test_spill_is_journaled_and_acked_on_delivery(tmp_path):
+    mgr, clock = make_mgr(retry_max=0, breaker_threshold=99,
+                          spill_max_bytes=1 << 20, spill_max_payloads=10)
+    encode, decode = make_entry_codec()
+    j = mk(tmp_path)
+    assert mgr.attach_journal(j, encode) is True
+
+    env = HttpEnvelope(url="http://h:1/x", body=b"payload")
+    fs = FlakySend([HTTPError(503, b""), None])
+    assert mgr.deliver(fs, len(env.body), payload=env) == "deferred"
+    assert j.pending_records() == 1
+    assert mgr.stats()["journal_appended"] == 1
+
+    mgr.begin_flush(10.0)
+    assert mgr.retry_spill() == 1
+    # terminal outcome: the journal record is acked
+    assert j.pending_records() == 0
+    assert mgr.conserved()
+    j.close()
+
+
+def test_recovery_replays_into_spill_ahead_of_fresh(tmp_path):
+    encode, decode = make_entry_codec()
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                      spill_max_bytes=1 << 20, spill_max_payloads=10)
+    j = mk(tmp_path)
+    mgr.attach_journal(j, encode)
+    env = HttpEnvelope(url="http://h:1/x", body=b"old-payload")
+    mgr.deliver(outage_send(), len(env.body), payload=env)
+    j.close()  # SIGKILL: the manager and its RAM spill are gone
+
+    # next incarnation
+    order = []
+
+    def opener(req, timeout):
+        order.append(bytes(req.data))
+        return b""
+
+    enc2, dec2 = make_entry_codec(opener=opener)
+    mgr2, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                       spill_max_bytes=1 << 20, spill_max_payloads=10)
+    j2 = mk(tmp_path)
+    mgr2.attach_journal(j2, enc2)
+    assert mgr2.recover(dec2) == 1
+    st = mgr2.stats()
+    assert st["journal_recovered"] == 1
+    assert st["accepted_payloads"] == 1  # recovered entries are accepted
+    assert mgr2.conserved()
+
+    # fresh payload joins BEHIND the recovered one
+    fresh = HttpEnvelope(url="http://h:1/x", body=b"fresh-payload")
+    mgr2.deliver(outage_send(), len(fresh.body), payload=fresh)
+    mgr2.begin_flush(10.0)
+    assert mgr2.retry_spill() >= 1
+    assert order[0] == b"old-payload"
+    assert j2.pending_records() == 0 or order  # recovered acked once sent
+    assert mgr2.conserved()
+    j2.close()
+
+
+def test_recovered_entries_keep_ids_across_double_restart(tmp_path):
+    encode, decode = make_entry_codec()
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                      spill_max_bytes=1 << 20, spill_max_payloads=10)
+    j = mk(tmp_path)
+    mgr.attach_journal(j, encode)
+    env = HttpEnvelope(url="http://h:1/x", body=b"p")
+    mgr.deliver(outage_send(), 1, payload=env)
+    j.close()
+
+    # restart 1: recover but never deliver (outage persists), crash again
+    mgr2, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                       spill_max_bytes=1 << 20, spill_max_payloads=10)
+    j2 = mk(tmp_path)
+    mgr2.attach_journal(j2, encode)
+    assert mgr2.recover(decode) == 1
+    assert mgr2.stats()["journal_appended"] == 0  # no re-append
+    j2.close()
+
+    # restart 2: the same single record replays once more
+    j3 = mk(tmp_path)
+    assert len(j3.replay_pending()) == 1
+    j3.close()
+
+
+def test_undecodable_record_is_acked_and_counted(tmp_path):
+    j = mk(tmp_path)
+    j.append(b"garbage that decode_envelope rejects")
+    j.close()
+
+    _, decode = make_entry_codec()
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                      spill_max_bytes=1 << 20, spill_max_payloads=10)
+    j2 = mk(tmp_path)
+    encode, _ = make_entry_codec()
+    mgr.attach_journal(j2, encode)
+    assert mgr.recover(decode) == 0
+    assert mgr.stats()["journal_decode_failed"] == 1
+    assert j2.pending_records() == 0  # acked, not left to fail forever
+    j2.close()
+
+
+def test_spill_eviction_acks_journal_record(tmp_path):
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                      spill_max_bytes=1 << 20, spill_max_payloads=1)
+    encode, _ = make_entry_codec()
+    j = mk(tmp_path)
+    mgr.attach_journal(j, encode)
+    e1 = HttpEnvelope(url="u", body=b"first")
+    e2 = HttpEnvelope(url="u", body=b"second")
+    mgr.deliver(outage_send(), 5, payload=e1)
+    mgr.deliver(outage_send(), 6, payload=e2)  # evicts e1 (cap 1)
+    assert mgr.stats()["dropped_payloads"] == 1
+    # the evicted payload's record is terminal — it must never replay
+    assert j.pending_records() == 1
+    assert dict(scan_pending(j.directory)).popitem()[1].endswith(b"second")
+    assert mgr.conserved()
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# journaling OFF == byte-identical behavior (the A/B pin)
+
+
+def run_scripted_manager(journal_dir=None):
+    """Identical fault script with/without a journal attached."""
+    mgr, clock = make_mgr(retry_max=1, breaker_threshold=3,
+                          spill_max_bytes=1 << 20, spill_max_payloads=4)
+    j = None
+    if journal_dir is not None:
+        encode, _ = make_entry_codec()
+        j = SpillJournal(str(journal_dir), fsync="never")
+        mgr.attach_journal(j, encode)
+    script = [
+        None,                                   # clean delivery
+        HTTPError(503, b""), None,              # retry succeeds
+        HTTPError(503, b""), HTTPError(503, b""),  # spills
+        HTTPError(400, b""),                    # permanent drop
+        None,
+    ]
+    sends = FlakySend(script)
+    for i in range(5):
+        env = HttpEnvelope(url="http://h:1/x", body=f"p{i}".encode())
+        mgr.begin_flush(10.0)
+        mgr.retry_spill()
+        mgr.deliver(sends, len(env.body), payload=env)
+    if j is not None:
+        j.close()
+    st = mgr.stats()
+    # drop the journal-only keys: everything else must match exactly
+    return {k: v for k, v in st.items() if not k.startswith("journal")}
+
+
+def test_journaling_off_is_identical(tmp_path):
+    assert run_scripted_manager(None) == run_scripted_manager(
+        tmp_path / "ab")
+
+
+def test_journal_hooks_are_noops_when_unattached():
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                      spill_max_bytes=1 << 20, spill_max_payloads=4)
+    assert mgr.recover(lambda b: None) == 0
+    mgr.begin_flush(10.0)  # no journal.sync() to call
+    st = mgr.stats()
+    assert st["journal_appended"] == 0 and st["journal_pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# proxy fragment journaling
+
+
+def _counter_batch(n):
+    from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+    batch = pb.MetricBatch()
+    for i in range(n):
+        m = batch.metrics.add()
+        m.name = f"px{i}"
+        m.kind = pb.KIND_COUNTER
+        m.counter.value = 1
+    return batch
+
+
+def test_fragment_codec_roundtrip_both_paths():
+    from veneur_tpu.distributed.proxy import (
+        _Fragment,
+        _fragment_decode,
+        _fragment_encode,
+    )
+
+    wire = _Fragment(True, [b"raw-a", b"raw-bb"], [11, 22])
+    got = _fragment_decode(_fragment_encode(wire))
+    assert got.wire and got.parts == [b"raw-a", b"raw-bb"]
+    assert got.meta == [11, 22] and got.count == 2
+
+    metrics = list(_counter_batch(2).metrics)
+    batchfrag = _Fragment(False, metrics, ["k0", "k1"])
+    got2 = _fragment_decode(_fragment_encode(batchfrag))
+    assert not got2.wire and got2.meta == ["k0", "k1"]
+    assert [m.name for m in got2.parts] == ["px0", "px1"]
+
+    assert _fragment_decode(b"no header") is None
+    assert _fragment_decode(b'{"w":1,"meta":[1],"lens":[99]}\nshort') is None
+
+
+def test_proxy_spill_survives_restart_via_journal(tmp_path):
+    from veneur_tpu.distributed.proxy import ProxyServer
+    from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+    def policy():
+        return DeliveryPolicy(retry_max=0, timeout_s=0.3, deadline_s=0.3,
+                              backoff_base_s=0.01)
+
+    jdir = tmp_path / "pj"
+    j = SpillJournal(str(jdir), fsync="never")
+    proxy = ProxyServer(["127.0.0.1:1"], timeout_s=0.3,
+                        handoff_window_s=5.0, delivery=policy(),
+                        journal=j)
+    proxy._route_batch(_counter_batch(3))
+    assert proxy.spilled_metrics == 3
+    assert j.pending_records() == 1  # the parked fragment is durable
+    proxy.stop()  # closes the journal; RAM spill dies with the process
+
+    # next incarnation: recovery re-routes the fragment under the
+    # current ring; still unreachable → it re-parks WITH a fresh record
+    j2 = SpillJournal(str(jdir), fsync="never")
+    proxy2 = ProxyServer(["127.0.0.1:1"], timeout_s=0.3,
+                         handoff_window_s=5.0, delivery=policy(),
+                         journal=j2)
+    rec = proxy2.recover_journal(window_s=0.0)  # window 0: defer, park
+    assert rec == {"recovered_payloads": 1, "recovered_metrics": 3}
+    assert proxy2.spilled_metrics == 3
+    assert proxy2.conserved()
+    assert j2.pending_records() == 1  # re-journaled, old record acked
+    st = proxy2.forward_stats()
+    assert st["journal_recovered_metrics"] == 3
+    assert st["journal"]["pending_records"] == 1
+    proxy2.stop()
+
+
+# ---------------------------------------------------------------------------
+# send-once managers opt out (splunk HEC regression)
+
+
+def test_server_graceful_drain_settles_and_clips(tmp_path, monkeypatch):
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.server import Server
+
+    srv = Server(Config(interval="10s",
+                        shutdown_drain_deadline_s=0.5))
+    monkeypatch.setattr(srv, "flush", lambda: None)  # tested elsewhere
+
+    # a sink whose spilled payload delivers on the drain pass...
+    ok_mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                         spill_max_bytes=1 << 20, spill_max_payloads=10)
+    ok_mgr.deliver(FlakySend([HTTPError(503, b""), None]), 3)
+    # ...and one stuck behind a permanent outage (clipped by deadline)
+    bad_mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                          spill_max_bytes=1 << 20, spill_max_payloads=10)
+    bad_mgr.deliver(outage_send(), 5)
+
+    class FakeSink:
+        def __init__(self, nm, man):
+            self._n, self.delivery = nm, man
+
+        def name(self):
+            return self._n
+
+    srv.metric_sinks = [FakeSink("ok", ok_mgr), FakeSink("bad", bad_mgr)]
+    out = srv.graceful_drain()
+    assert out["final_flush"] is True
+    assert out["drained_payloads"] == 1
+    assert out["clipped_payloads"] == 1 and out["deadline_clipped"]
+    assert srv.shutdown_stats is out
+    assert srv.ingress_stats()["shutdown"]["clipped_payloads"] == 1
+    assert ok_mgr.conserved() and bad_mgr.conserved()
+
+
+def test_quiet_tick_still_drains_spill():
+    """A flush interval with zero aggregated metrics must still run the
+    spill-retry funnel: an idle server would otherwise freeze spilled
+    payloads (and a recovered-journal backlog) until fresh traffic
+    happened to arrive."""
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.server import Server
+
+    srv = Server(Config(interval="50ms"))
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                      spill_max_bytes=1 << 20, spill_max_payloads=10)
+    # fails once (spills), delivers on the quiet tick's retry pass
+    mgr.deliver(FlakySend([HTTPError(503, b""), None]), 3)
+    assert mgr.stats()["spilled_payloads"] == 1
+
+    class FakeSink:
+        def __init__(self, nm, man):
+            self._n, self.delivery = nm, man
+
+        def name(self):
+            return self._n
+
+    srv.metric_sinks = [FakeSink("quiet", mgr)]
+    srv.flush()  # nothing ingested: a genuinely quiet tick
+    st = mgr.stats()
+    assert st["delivered_payloads"] == 1
+    assert st["spilled_payloads"] == 0
+    assert mgr.conserved()
+    srv.shutdown()
+
+
+def test_server_attach_journals_and_recover(tmp_path, monkeypatch):
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.server import Server
+
+    jdir = str(tmp_path / "wal")
+    # seed a prior incarnation's unacked payload for the datadog sink
+    encode, _ = make_entry_codec()
+    from veneur_tpu.sinks.delivery import _SpillEntry
+
+    prior = SpillJournal(os.path.join(jdir, "sink-datadog"),
+                         fsync="never")
+    prior.append(encode(_SpillEntry(
+        lambda t: None, 4,
+        payload=HttpEnvelope(url="http://127.0.0.1:1/x", body=b"old"))))
+    prior.close()
+
+    srv = Server(Config(interval="10s", spill_journal_dir=jdir))
+    mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                      spill_max_bytes=1 << 20, spill_max_payloads=10)
+    exempt_mgr, _ = make_mgr(retry_max=0, breaker_threshold=99,
+                             spill_max_bytes=1 << 20,
+                             spill_max_payloads=10)
+    exempt_mgr.journal_exempt = True
+
+    class FakeSink:
+        def __init__(self, nm, man):
+            self._n, self.delivery = nm, man
+
+        def name(self):
+            return self._n
+
+    srv.metric_sinks = [FakeSink("datadog", mgr),
+                        FakeSink("sendonce", exempt_mgr)]
+    srv._attach_journals()
+    # the journaled payload from the dead incarnation is back in spill
+    assert mgr.stats()["journal_recovered"] == 1
+    assert mgr.stats()["spilled_payloads"] == 1
+    assert mgr.conserved()
+    # exempt managers get no journal — and no directory
+    assert set(srv._journals) == {"datadog"}
+    assert not os.path.isdir(os.path.join(jdir, "sink-sendonce"))
+    assert "datadog" in srv.ingress_stats()["journal"]
+    srv._shutdown_teardown()
+    assert srv._journals == {}
+
+
+def test_splunk_manager_is_journal_exempt(tmp_path):
+    from veneur_tpu.sinks.splunk import SplunkSpanSink
+
+    sink = SplunkSpanSink("http://127.0.0.1:1", "token",
+                          delivery=DeliveryPolicy())
+    assert sink.delivery.journal_exempt
+    encode, _ = make_entry_codec()
+    j = mk(tmp_path)
+    assert sink.delivery.attach_journal(j, encode) is False
+    # nothing attached: a spill on this manager writes no records
+    assert sink.delivery._journal is None
+    j.close()

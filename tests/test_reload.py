@@ -1,0 +1,119 @@
+"""Config hot-reload (core/reload.py): whitelist-only live updates,
+log-and-ignore for everything else, wholesale rejection of invalid
+edits, and the ledger/journal push-through."""
+
+from __future__ import annotations
+
+import os
+import types
+
+from veneur_tpu.core.config import load_config
+from veneur_tpu.core.reload import RELOADABLE, ConfigReloader
+from veneur_tpu.core.tenancy import TenantLedger
+from veneur_tpu.utils.journal import SpillJournal
+
+
+def _write(path, text):
+    path.write_text(text)
+    # mtime_ns granularity can swallow back-to-back writes in-tests
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+
+
+def _server(tmp_path, text):
+    cfg_path = tmp_path / "cfg.yaml"
+    _write(cfg_path, text)
+    cfg = load_config(str(cfg_path))
+    server = types.SimpleNamespace(
+        config=cfg,
+        tenant_ledger=(TenantLedger(cfg.tenant_default_budget,
+                                    cfg.tenant_budgets)
+                       if cfg.tenant_default_budget > 0
+                       or cfg.tenant_budgets else None),
+        _journals={},
+    )
+    return cfg_path, server
+
+
+BASE = "interval: 5s\npercentiles: [0.5]\ntenant_default_budget: 10\n"
+
+
+def test_no_change_is_a_noop(tmp_path):
+    cfg_path, server = _server(tmp_path, BASE)
+    r = ConfigReloader(str(cfg_path), server, poll_s=1.0)
+    assert r.check_once() is False
+    assert r.reloads_applied == 0
+
+
+def test_whitelisted_keys_apply_live(tmp_path):
+    cfg_path, server = _server(tmp_path, BASE)
+    r = ConfigReloader(str(cfg_path), server, poll_s=1.0)
+    _write(cfg_path, BASE.replace("tenant_default_budget: 10",
+                                  "tenant_default_budget: 3")
+           + "tenant_budgets: {noisy: 1}\n"
+           + "shutdown_drain_deadline_s: 2.5\n")
+    assert r.check_once() is True
+    assert server.config.tenant_default_budget == 3
+    assert server.config.shutdown_drain_deadline_s == 2.5
+    # pushed into the live ledger, not just the dataclass
+    assert server.tenant_ledger.budget_for("noisy") == 1
+    assert server.tenant_ledger.budget_for("other") == 3
+    assert r.ignored_keys_total == 0
+
+
+def test_lowered_budget_keeps_admitted_series(tmp_path):
+    cfg_path, server = _server(tmp_path, BASE)
+    led = server.tenant_ledger
+    for i in range(5):
+        assert led.admit("t", f"s{i}")
+    r = ConfigReloader(str(cfg_path), server, poll_s=1.0)
+    _write(cfg_path, BASE.replace("tenant_default_budget: 10",
+                                  "tenant_default_budget: 2"))
+    assert r.check_once()
+    # reject-new-never-evict: the 5 admitted series keep aggregating,
+    # only genuinely new ones are refused
+    assert all(led.admit("t", f"s{i}") for i in range(5))
+    assert not led.admit("t", "s-new")
+
+
+def test_non_whitelisted_keys_log_and_ignore(tmp_path):
+    cfg_path, server = _server(tmp_path, BASE)
+    r = ConfigReloader(str(cfg_path), server, poll_s=1.0)
+    _write(cfg_path, BASE + "num_workers: 7\n")
+    assert r.check_once() is True
+    assert server.config.num_workers != 7  # wiring is build-time
+    assert r.ignored_keys_total == 1
+
+
+def test_invalid_config_rejected_wholesale(tmp_path):
+    cfg_path, server = _server(tmp_path, BASE)
+    r = ConfigReloader(str(cfg_path), server, poll_s=1.0)
+    _write(cfg_path, BASE.replace("tenant_default_budget: 10",
+                                  "tenant_default_budget: 5")
+           + "spill_journal_fsync: sometimes\n")
+    assert r.check_once() is False
+    assert r.reload_rejected == 1
+    # the valid-looking budget edit must NOT have been half-applied
+    assert server.config.tenant_default_budget == 10
+
+
+def test_journal_policy_pushed_to_live_journals(tmp_path):
+    cfg_path, server = _server(tmp_path, BASE)
+    j = SpillJournal(str(tmp_path / "j"), fsync="never")
+    server._journals = {"datadog": j}
+    r = ConfigReloader(str(cfg_path), server, poll_s=1.0)
+    _write(cfg_path, BASE + "spill_journal_fsync: always\n"
+           + "spill_journal_max_segments: 3\n")
+    assert r.check_once()
+    assert j.fsync == "always"
+    assert j.max_segments == 3
+    j.close()
+
+
+def test_whitelist_is_the_contract():
+    # the documented reloadable set (README Durability section); growing
+    # it is fine, shrinking it silently is an operator-facing break
+    assert {"tenant_budgets", "tenant_default_budget",
+            "spill_journal_fsync", "spill_journal_max_bytes",
+            "spill_journal_max_segments",
+            "shutdown_drain_deadline_s"} <= RELOADABLE

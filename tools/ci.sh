@@ -115,6 +115,22 @@ timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
   python tools/soak_tenant_isolation.py --quick
 
+# Crash-recovery lane: a supervised real server journaling its delivery
+# spill (utils/journal.py) is SIGKILLed at seeded adversarial points
+# under load — mid-outage, before a recovered backlog delivers
+# (double-restart replay), after a scripted partial drain — restarted,
+# and finally SIGTERMed. Gates the durability contracts: every kill's
+# read-only journal census equals the next incarnation's replay count,
+# cross-incarnation conservation is exact against the receiver's own
+# 2xx ledger, zero drops/evictions, and the graceful drain exits with
+# an empty spill and an empty journal. Artifact: CRASH_RECOVERY_SOAK
+# .json (committed copy is the full run; the lane redirects its
+# miniature artifact to /tmp so quick never clobbers it).
+echo "== crash-recovery lane (kill-9 durability soak) =="
+timeout -k 10 420 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/soak_crash_recovery.py --quick
+
 # Sustained-rate floor: the loadgen harness drives a live server's UDP
 # socket at a fixed offered rate for 5 flush intervals and fails on
 # loss or broken flush cadence. 50k lines/s with the pipelined flush

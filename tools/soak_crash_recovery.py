@@ -1,0 +1,497 @@
+"""Supervised kill-9 crash-recovery soak for the spill journal.
+
+A parent process drives a REAL aggregation server (child process:
+this script with --child) through repeated SIGKILL/restart cycles
+under seeded loadgen traffic, with the child's datadog sink pointed
+at a parent-controlled HTTP receiver that scripts outages (503) and
+recovery windows. The child journals its delivery spill
+(spill_journal_dir, utils/journal.py); the parent proves the
+crash-consistency contract end to end:
+
+1. EXACT REPLAY — what incarnation i left durable (the parent's
+   read-only ``scan_pending`` census of the journal directory, taken
+   after the SIGKILL) is exactly what incarnation i+1 recovers:
+   ``journal_recovered_{i+1} == journal_pending_at_kill_i``.
+2. PER-INCARNATION CONSERVATION — at every kill point (traffic
+   quiesced so the child's atomically-written stats file is current):
+   ``accepted == delivered + dropped + still-spilled``.
+3. CROSS-INCARNATION CONSERVATION — recovered payloads are accepted
+   again by the next incarnation, so summing ``accepted - recovered``
+   (each payload's FIRST acceptance) over all incarnations:
+   ``sum(fresh) == sum(delivered) + sum(dropped) + final-spilled``
+   with final-spilled == 0 after the last incarnation's graceful
+   drain. The receiver's own 2xx count must equal sum(delivered)
+   exactly — the wire agrees with the ledger.
+4. ZERO SILENT LOSS — dropped == 0 (the receiver never 4xxes),
+   journal evictions / append failures / decode failures == 0.
+5. GRACEFUL DRAIN — the final incarnation exits on SIGTERM via
+   Server.graceful_drain: spill empty, journal pending 0, honest
+   shutdown.* ledger in the artifact.
+
+Kills are scheduled at adversarial machinery points: every kill lands
+while the child is mid-outage with the breaker/retry/journal machinery
+live (flush ticks retrying spill, journal fsyncs running), at a seeded
+sub-interval phase offset so successive kills land at different points
+of the flush tick — mid-flush and mid-append at the file level (the
+torn-tail tolerance absorbs it) while payload accounting stays exact
+because traffic is quiesced. Cycle styles: kill with journaled spill
+(outage), kill again before the backlog could deliver (double-restart
+replay), kill after a scripted partial drain (journal acks written).
+
+Writes CRASH_RECOVERY_SOAK.json at the repo root and prints one JSON
+line; exits nonzero on any violated invariant.
+
+Usage: python tools/soak_crash_recovery.py [--quick] [--seed 42]
+       [--pps 300] [--load-s 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _soak_common import make_blaster, write_artifact  # noqa: E402
+
+INTERVAL_S = 1.0
+SINK = "datadog"  # the journaled sink under test
+
+
+# ---------------------------------------------------------------------------
+# child: a real server whose datadog sink flushes at the parent receiver
+
+
+def run_child(args) -> int:
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+    cfg = Config(
+        interval="1s", percentiles=[0.5],
+        aggregates=["min", "max", "count"],
+        statsd_listen_addresses=[f"udp://127.0.0.1:{args.port}"],
+        num_workers=1, num_readers=1,
+        spill_journal_dir=args.journal_dir,
+        spill_journal_fsync="always",
+        shutdown_drain_deadline_s=8.0)
+    dd = DatadogMetricSink(
+        interval=INTERVAL_S, flush_max_per_body=10_000,
+        hostname="crash-soak", tags=[], dd_hostname=args.dd_url,
+        api_key="soak",
+        delivery=DeliveryPolicy(
+            retry_max=1, breaker_threshold=3,
+            spill_max_bytes=8 << 20, spill_max_payloads=512,
+            timeout_s=0.5, deadline_s=0.8,
+            backoff_base_s=0.02, backoff_max_s=0.1))
+    srv = Server(cfg, metric_sinks=[dd])
+    srv.start()
+    man = dd.delivery
+
+    def snapshot(extra=None) -> dict:
+        out = {
+            "gen": args.gen, "pid": os.getpid(), "ts": time.time(),
+            "flush_count": srv.flush_count,
+            "delivery": man.stats(),
+            "journal": {r: j.stats() for r, j in srv._journals.items()},
+        }
+        if extra:
+            out.update(extra)
+        return out
+
+    def write_stats(extra=None) -> None:
+        tmp = args.stats + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snapshot(extra), f)
+        os.replace(tmp, args.stats)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    def monitor() -> None:
+        while not stop.is_set():
+            write_stats()
+            time.sleep(0.2)
+
+    mon = threading.Thread(target=monitor, daemon=True)
+    mon.start()
+    stop.wait()
+    # SIGTERM: the veneur_main contract — graceful drain (final flush +
+    # bounded spill settling), then teardown; the final stats write
+    # carries the drain ledger for the parent's assertions
+    mon.join(timeout=2)
+    drain = srv.graceful_drain()
+    write_stats({"graceful": True, "drain": drain})
+    srv.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: scripted receiver + kill/restart supervision
+
+
+class Receiver:
+    """HTTP endpoint with a scriptable disposition: 'down' 503s
+    everything, 'up' 200s everything, a budget allows exactly N 200s
+    before going down again (the partial-drain cycle)."""
+
+    def __init__(self):
+        self.mode = "down"
+        self.budget = 0
+        self.posts = 0
+        self.ok = 0
+        self.lock = threading.Lock()
+        recv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                with recv.lock:
+                    recv.posts += 1
+                    if recv.mode == "up" or (recv.mode == "budget"
+                                             and recv.budget > 0):
+                        if recv.mode == "budget":
+                            recv.budget -= 1
+                        recv.ok += 1
+                        code, body = 200, b"{}"
+                    else:
+                        code, body = 503, b"unavailable"
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def set(self, mode: str, budget: int = 0) -> None:
+        with self.lock:
+            self.mode = mode
+            self.budget = budget
+
+    def ok_count(self) -> int:
+        with self.lock:
+            return self.ok
+
+
+def read_stats(path: str, gen: int):
+    """The child's latest atomic snapshot, or None if not this gen's."""
+    try:
+        with open(path) as f:
+            st = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return st if st.get("gen") == gen else None
+
+
+def conservation_key(st: dict) -> tuple:
+    d = st["delivery"]
+    return (d["accepted_payloads"], d["delivered_payloads"],
+            d["dropped_payloads"], d["spilled_payloads"],
+            d["journal_pending"])
+
+
+def wait_stable(path: str, gen: int, min_spilled: int = 0,
+                min_delivered: int = 0, timeout: float = 90.0):
+    """Poll the child's stats until the conservation tuple is unchanged
+    for 3 consecutive interval-spaced reads (the quiesced-exact point:
+    every offered sample has flushed into a payload and every payload
+    has reached spill or a terminal state). The min_* floors gate the
+    stability count on the scripted phase actually having happened —
+    e.g. the partial-drain cycle must not latch onto the (also stable)
+    pre-delivery state."""
+    deadline = time.monotonic() + timeout
+    last, stable = None, 0
+    while time.monotonic() < deadline:
+        st = read_stats(path, gen)
+        if st is not None:
+            key = conservation_key(st)
+            if (st["delivery"]["spilled_payloads"] >= min_spilled
+                    and st["delivery"]["delivered_payloads"]
+                    >= min_delivered):
+                stable = stable + 1 if key == last else 0
+                if stable >= 2:
+                    return st
+            last = key
+        time.sleep(INTERVAL_S * 1.5)
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--gen", type=int, default=0)
+    ap.add_argument("--port", type=int, default=19131)
+    ap.add_argument("--dd-url", default="")
+    ap.add_argument("--journal-dir", default="")
+    ap.add_argument("--stats", default="")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI lane: short load windows, same 3+1 cycles")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--pps", type=int, default=300)
+    ap.add_argument("--load-s", type=float, default=6.0)
+    args = ap.parse_args()
+    if args.child:
+        return run_child(args)
+
+    load_s = 3.0 if args.quick else args.load_s
+    pps = min(args.pps, 200) if args.quick else args.pps
+    rng = random.Random(args.seed)
+
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="crash-soak-")
+    journal_dir = os.path.join(work, "wal")
+    sink_dir = os.path.join(journal_dir, f"sink-{SINK}")
+    recv = Receiver()
+    failures: list[str] = []
+    cycles: list[dict] = []
+    from veneur_tpu.utils.journal import scan_pending
+
+    udp_port = args.port
+
+    def spawn(gen: int) -> tuple[subprocess.Popen, str]:
+        stats = os.path.join(work, f"stats-{gen}.json")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "--gen", str(gen), "--port", str(udp_port),
+             "--dd-url", f"http://127.0.0.1:{recv.port}",
+             "--journal-dir", journal_dir, "--stats", stats],
+            cwd=REPO)
+        return proc, stats
+
+    def blast(seconds: float) -> None:
+        stop = threading.Event()
+        sent = {"packets": 0, "lines": 0, "garbage": 0}
+        lock = threading.Lock()
+        t = make_blaster(udp_port, 0, stop, sent, lock, pps=pps)
+        t.start()
+        time.sleep(seconds)
+        stop.set()
+        t.join(timeout=10)
+
+    # cycle styles: (receiver script before the kill, description)
+    styles = [
+        ("outage", "kill with journaled spill mid-outage"),
+        ("outage", "kill again before the backlog delivers "
+                   "(double-restart replay)"),
+        ("partial", "kill after a scripted partial drain "
+                    "(journal acks on disk)"),
+    ]
+
+    t0 = time.time()
+    census_prev = None  # journal census at the previous kill
+    incarnations: list[dict] = []
+    proc = stats_path = None
+
+    def ensure_dead(p) -> None:
+        # an aborted cycle must not leave its child alive: a straggler
+        # holds the shared journal dir open and poisons every
+        # census/recovery assertion downstream
+        if p is not None and p.poll() is None:
+            p.kill()
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+    for gen, (style, desc) in enumerate(styles, start=1):
+        recv.set("down")
+        proc, stats_path = spawn(gen)
+        st = wait_stable(stats_path, gen, timeout=120.0)
+        if st is None:
+            failures.append(f"gen {gen}: child never produced stable "
+                            f"stats at startup")
+            break
+        # EXACT REPLAY: what the dead incarnation left durable is
+        # exactly what this one recovered at startup
+        recovered = st["delivery"]["journal_recovered"]
+        if census_prev is not None and recovered != census_prev:
+            failures.append(
+                f"gen {gen}: journal_recovered {recovered} != "
+                f"pending-at-kill census {census_prev}")
+        blast(load_s)
+        st = wait_stable(stats_path, gen, min_spilled=1)
+        if st is None:
+            failures.append(f"gen {gen}: no stable spill after load")
+            break
+        if style == "partial":
+            # lift the outage for exactly 2 deliveries, then re-503:
+            # journal ACK records hit disk, the rest stays pending
+            recv.set("budget", budget=2)
+            st = wait_stable(stats_path, gen, min_delivered=1)
+            recv.set("down")
+            if st is None or st["delivery"]["delivered_payloads"] == 0:
+                failures.append(f"gen {gen}: partial drain never "
+                                f"delivered")
+                break
+        d = st["delivery"]
+        if (d["accepted_payloads"] != d["delivered_payloads"]
+                + d["dropped_payloads"] + d["handed_off_payloads"]
+                + d["spilled_payloads"]):
+            failures.append(f"gen {gen}: conservation violated at kill "
+                            f"point: {d}")
+        if d["spilled_payloads"] != d["journal_pending"]:
+            failures.append(
+                f"gen {gen}: spill/journal divergence: "
+                f"{d['spilled_payloads']} spilled vs "
+                f"{d['journal_pending']} journaled")
+        # seeded adversarial phase: land the SIGKILL at a different
+        # point of the (live, retrying, fsyncing) flush tick each cycle
+        phase = rng.uniform(0.0, INTERVAL_S)
+        time.sleep(phase)
+        proc.kill()  # SIGKILL
+        proc.wait(timeout=30)
+        census = len(scan_pending(sink_dir))
+        if census != d["journal_pending"]:
+            failures.append(
+                f"gen {gen}: post-kill census {census} != last stable "
+                f"journal_pending {d['journal_pending']}")
+        census_prev = census
+        jstats = st["journal"].get(SINK, {})
+        for k in ("evicted_records", "append_failed"):
+            if jstats.get(k, 0):
+                failures.append(f"gen {gen}: journal {k}="
+                                f"{jstats[k]} (silent-loss risk)")
+        incarnations.append(st)
+        cycles.append({
+            "gen": gen, "style": style, "desc": desc,
+            "kill_phase_s": round(phase, 3),
+            "journal_pending_at_kill": census,
+            "journal_recovered_at_start": recovered,
+            "delivery_at_kill": d,
+            "journal_at_kill": jstats,
+        })
+
+    # final incarnation: recover, lift the outage, graceful SIGTERM
+    ensure_dead(proc)  # no-op unless a cycle aborted mid-flight
+    final = None
+    if not failures or incarnations:
+        gen = len(styles) + 1
+        recv.set("down")
+        proc, stats_path = spawn(gen)
+        st = wait_stable(stats_path, gen, timeout=120.0)
+        if st is None:
+            failures.append(f"gen {gen}: final incarnation never stable")
+        else:
+            recovered = st["delivery"]["journal_recovered"]
+            if census_prev is not None and recovered != census_prev:
+                failures.append(
+                    f"gen {gen}: journal_recovered {recovered} != "
+                    f"pending-at-kill census {census_prev}")
+            blast(load_s)
+            recv.set("up")
+            time.sleep(INTERVAL_S)
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                failures.append(f"gen {gen}: graceful shutdown hung")
+            final = read_stats(stats_path, gen)
+            if final is None or not final.get("graceful"):
+                failures.append(f"gen {gen}: no graceful-drain ledger "
+                                f"in final stats")
+            else:
+                d = final["delivery"]
+                if d["spilled_payloads"] != 0 or d["journal_pending"]:
+                    failures.append(
+                        f"gen {gen}: graceful drain left "
+                        f"{d['spilled_payloads']} spilled / "
+                        f"{d['journal_pending']} journaled")
+                if final["drain"]["deadline_clipped"]:
+                    failures.append(f"gen {gen}: drain deadline clipped "
+                                    f"under an UP receiver")
+                if len(scan_pending(sink_dir)) != 0:
+                    failures.append(f"gen {gen}: journal still has "
+                                    f"pending records after drain")
+                incarnations.append(final)
+                cycles.append({
+                    "gen": gen, "style": "sigterm-drain",
+                    "desc": "graceful drain to empty under a healthy "
+                            "receiver",
+                    "drain": final["drain"],
+                    "delivery_at_exit": d,
+                })
+
+    ensure_dead(proc)  # final child, if a failure path left it running
+
+    # cross-incarnation conservation: each payload's FIRST acceptance,
+    # summed, must equal everything that terminally landed
+    sum_fresh = sum(st["delivery"]["accepted_payloads"]
+                    - st["delivery"]["journal_recovered"]
+                    for st in incarnations)
+    sum_delivered = sum(st["delivery"]["delivered_payloads"]
+                        for st in incarnations)
+    sum_dropped = sum(st["delivery"]["dropped_payloads"]
+                      for st in incarnations)
+    final_spilled = (incarnations[-1]["delivery"]["spilled_payloads"]
+                     if incarnations else 0)
+    if sum_fresh != sum_delivered + sum_dropped + final_spilled:
+        failures.append(
+            f"cross-incarnation conservation violated: fresh "
+            f"{sum_fresh} != delivered {sum_delivered} + dropped "
+            f"{sum_dropped} + final-spilled {final_spilled}")
+    if sum_dropped:
+        failures.append(f"{sum_dropped} payload(s) dropped under a "
+                        f"never-4xx receiver (silent loss)")
+    if recv.ok_count() != sum_delivered:
+        failures.append(
+            f"wire/ledger divergence: receiver 2xx {recv.ok_count()} "
+            f"!= sum(delivered) {sum_delivered}")
+    kills = sum(1 for c in cycles if c["style"] != "sigterm-drain")
+    if kills < 3:
+        failures.append(f"only {kills} SIGKILL cycles completed")
+
+    out = {
+        "platform": "cpu",
+        "seed": args.seed,
+        "quick": args.quick,
+        "interval": "1s",
+        "pps": pps,
+        "load_s_per_cycle": load_s,
+        "duration_s": round(time.time() - t0, 1),
+        "sigkill_cycles": kills,
+        "cycles": cycles,
+        "cross_incarnation": {
+            "fresh_accepted": sum_fresh,
+            "delivered": sum_delivered,
+            "dropped": sum_dropped,
+            "final_spilled": final_spilled,
+            "receiver_2xx": recv.ok_count(),
+            "receiver_posts": recv.posts,
+            "exact": sum_fresh == sum_delivered + sum_dropped
+            + final_spilled,
+        },
+        "failures": failures,
+        "ok": not failures,
+    }
+    write_artifact("CRASH_RECOVERY_SOAK.json", out)
+    print(json.dumps({
+        "metric": "crash_recovery_soak_ok", "value": out["ok"],
+        "sigkill_cycles": kills,
+        "cross_incarnation": out["cross_incarnation"],
+        "failures": failures,
+    }))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

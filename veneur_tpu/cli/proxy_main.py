@@ -89,6 +89,16 @@ def main(argv=None) -> int:
         spill_max_payloads=cfg.forward_spill_max_payloads,
         timeout_s=min(timeout_s, cfg.handoff_window_s),
         deadline_s=cfg.handoff_window_s)
+    journal = None
+    if cfg.spill_journal_dir:
+        from veneur_tpu.utils.journal import SpillJournal
+
+        journal = SpillJournal(
+            cfg.spill_journal_dir,
+            fsync=cfg.spill_journal_fsync,
+            max_bytes=cfg.spill_journal_max_bytes,
+            max_segments=cfg.spill_journal_max_segments,
+            log=log.warning)
     proxy = ProxyServer(static,
                         timeout_s=timeout_s,
                         idle_timeout_s=idle_s,
@@ -96,7 +106,14 @@ def main(argv=None) -> int:
                         delivery=policy,
                         routing_workers=cfg.routing_pool_workers,
                         routing_queue_max=cfg.routing_queue_max,
-                        handoff_window_s=cfg.handoff_window_s)
+                        handoff_window_s=cfg.handoff_window_s,
+                        journal=journal)
+    if journal is not None:
+        # re-route the previous incarnation's durable spill under the
+        # current ring before accepting fresh traffic
+        rec = proxy.recover_journal()
+        if rec["recovered_payloads"]:
+            log.info("journal recovery: %s", rec)
     address = cfg.grpc_address or "127.0.0.1:8128"
     port = proxy.start_grpc(address)
     log.info("proxy serving gRPC on %s (port %s)", address, port)
@@ -173,6 +190,25 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    # graceful drain: bounded spill-settling passes before teardown —
+    # whatever the deadline clips stays durable in the journal (when
+    # configured) for the next incarnation's recover_journal
+    if cfg.shutdown_drain_deadline_s > 0:
+        import time as _time
+
+        drain_deadline = _time.monotonic() + cfg.shutdown_drain_deadline_s
+        while _time.monotonic() < drain_deadline:
+            proxy.drain_spill(
+                min(cfg.handoff_window_s,
+                    max(0.05, drain_deadline - _time.monotonic())))
+            if proxy.spilled_metrics <= 0:
+                break
+            _time.sleep(0.05)
+        if proxy.spilled_metrics > 0:
+            log.warning("shutdown drain deadline clipped: %d metric(s) "
+                        "still spilled%s", proxy.spilled_metrics,
+                        " (journaled for next start)" if journal is not None
+                        else "")
     if reporter is not None:
         reporter.stop()
     if refresher is not None:

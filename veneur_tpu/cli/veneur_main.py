@@ -86,6 +86,17 @@ def main(argv=None) -> int:
         "veneur-tpu %s serving (local=%s) listeners=%s",
         server.version, server.is_local, ports)
 
+    # config hot-reload (mtime-watch; SIGHUP is taken by the graceful
+    # restart): whitelisted keys — tenant budgets, journal knobs, drain
+    # deadline — apply live, everything else logs-and-ignores
+    reloader = None
+    if cfg.config_reload_s > 0:
+        from veneur_tpu.core.reload import ConfigReloader
+
+        reloader = ConfigReloader(args.config, server,
+                                  poll_s=cfg.config_reload_s)
+        reloader.start()
+
     stop = threading.Event()
     restart = threading.Event()
 
@@ -109,6 +120,8 @@ def main(argv=None) -> int:
     # server._shutdown; the process must exit too, reference http.go:37-44)
     while not stop.is_set() and not server._shutdown.is_set():
         stop.wait(0.5)
+    if reloader is not None:
+        reloader.stop()
     manifest = None
     if restart.is_set():
         # quiesce readers FIRST — from here, datagrams queue in the
@@ -122,6 +135,17 @@ def main(argv=None) -> int:
         except Exception:
             logging.getLogger("veneur_tpu").exception(
                 "final flush before restart failed")
+    elif not server._shutdown.is_set():
+        # plain SIGTERM/SIGINT: graceful drain — final-epoch flush, then
+        # bounded spill settling with honest shutdown.* counters for
+        # whatever the deadline clips (Server.graceful_drain)
+        try:
+            drain = server.graceful_drain()
+            logging.getLogger("veneur_tpu").info(
+                "graceful drain: %s", drain)
+        except Exception:
+            logging.getLogger("veneur_tpu").exception("graceful drain"
+                                                      " failed")
     clean = server.shutdown()
     if not clean and not restart.is_set():
         # a compute thread is still inside XLA/C++ after the bounded

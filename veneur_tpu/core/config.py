@@ -135,6 +135,31 @@ class Config:
     # counters — graceful degradation, never unbounded memory
     sink_spill_max_bytes: int = 4194304
     sink_spill_max_payloads: int = 256
+    # write-ahead spill journal (utils/journal.py): when a directory is
+    # set, every journalable sink's spill gets a durable shadow — a
+    # SIGKILL no longer destroys deferred payloads; the next incarnation
+    # replays them AHEAD of fresh data and the conservation contract
+    # extends across process lifetimes. Empty (the default) = off,
+    # byte-identical to the in-RAM-only behaviour.
+    spill_journal_dir: str = ""
+    # fsync policy: "always" (per append — strongest, slowest),
+    # "interval" (at each flush edge — the default), "never" (OS cache)
+    spill_journal_fsync: str = "interval"
+    # journal bounds: total bytes across segment files and segment-file
+    # count; oldest segment evicted first when either cap bites (live
+    # records evicted are counted, never silent)
+    spill_journal_max_bytes: int = 64 << 20
+    spill_journal_max_segments: int = 8
+    # graceful drain (SIGTERM): final-epoch flush then bounded
+    # spill-settling passes before exit; whatever the deadline clips is
+    # counted under shutdown.* (and stays journaled when the journal is
+    # on). 0 disables the drain (the pre-PR-9 hard stop).
+    shutdown_drain_deadline_s: float = 10.0
+    # config hot-reload: poll the config file's mtime every N seconds
+    # and re-apply WHITELISTED keys (tenant budgets, journal knobs,
+    # drain deadline) without a restart; other changed keys log-and-
+    # ignore with a counter. 0 (default) = off.
+    config_reload_s: float = 0.0
     flush_max_per_body: int = 0
     flush_file: str = ""
     omit_empty_hostname: bool = False
@@ -429,6 +454,14 @@ class ProxyConfig:
     # bounded reshard-handoff window: the drain cadence and the budget
     # for re-routing spilled fragments after a membership change
     handoff_window_s: float = 5.0
+    # write-ahead spill journal for the forward-path spill (shared
+    # across per-destination managers; utils/journal.py). Empty = off.
+    spill_journal_dir: str = ""
+    spill_journal_fsync: str = "interval"
+    spill_journal_max_bytes: int = 64 << 20
+    spill_journal_max_segments: int = 8
+    # SIGTERM drain budget: bounded spill-settling passes before exit
+    shutdown_drain_deadline_s: float = 10.0
     # bounded routing executor replacing per-batch thread spawn
     routing_pool_workers: int = 4
     routing_queue_max: int = 128
@@ -483,6 +516,24 @@ def load_proxy_config(path: Optional[str] = None,
     return cfg
 
 
+def _validate_journal_keys(cfg) -> None:
+    """Shared journal/drain key validation (Config and ProxyConfig carry
+    the same spill_journal_* / shutdown_drain_deadline_s knobs)."""
+    from veneur_tpu.utils.journal import FSYNC_POLICIES
+
+    if cfg.spill_journal_fsync not in FSYNC_POLICIES:
+        raise ValueError(
+            f"spill_journal_fsync must be one of {FSYNC_POLICIES}")
+    if cfg.spill_journal_max_bytes < 1:
+        raise ValueError("spill_journal_max_bytes must be >= 1 (unset"
+                         " spill_journal_dir to disable journaling)")
+    if cfg.spill_journal_max_segments < 1:
+        raise ValueError("spill_journal_max_segments must be >= 1")
+    if cfg.shutdown_drain_deadline_s < 0:
+        raise ValueError("shutdown_drain_deadline_s must be >= 0"
+                         " (0 disables the graceful drain)")
+
+
 def validate_proxy_config(cfg: ProxyConfig) -> None:
     parse_duration(cfg.forward_timeout)  # raises on nonsense
     parse_duration(cfg.consul_refresh_interval)
@@ -501,6 +552,7 @@ def validate_proxy_config(cfg: ProxyConfig) -> None:
     if cfg.handoff_window_s <= 0:
         raise ValueError("handoff_window_s must be positive (it bounds"
                          " the reshard drain AND paces the drain thread)")
+    _validate_journal_keys(cfg)
     if cfg.routing_pool_workers < 1:
         raise ValueError("routing_pool_workers must be >= 1")
     if cfg.routing_queue_max < 1:
@@ -685,6 +737,10 @@ def validate_config(cfg: Config) -> None:
     if cfg.sink_spill_max_bytes < 0 or cfg.sink_spill_max_payloads < 0:
         raise ValueError("sink spill caps must be >= 0 (0 drops failed"
                          " payloads instead of spilling them)")
+    _validate_journal_keys(cfg)
+    if cfg.config_reload_s < 0:
+        raise ValueError("config_reload_s must be >= 0 (0 disables the"
+                         " config hot-reload watcher)")
     if cfg.forward_statsd_network not in ("udp", "tcp"):
         raise ValueError("forward_statsd_network must be 'udp' or 'tcp'")
     if cfg.tpu_stage_depth < 1:

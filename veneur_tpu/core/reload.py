@@ -1,0 +1,134 @@
+"""Config hot-reload: mtime-watched, whitelist-only live updates.
+
+The reference restarts to pick up config (cmd/veneur/main.go reads it
+once); SIGHUP here is already taken by the zero-downtime graceful
+restart (cli/veneur_main.py). For the knobs where a restart is
+disproportionate — tenant series budgets during an incident, journal
+fsync/retention, the shutdown drain deadline — this module polls the
+config file's mtime and applies *only* a whitelisted set of keys live.
+
+Everything else is deliberately log-and-ignore (counted in
+``ignored_keys_total``): most keys wire object graphs at build time
+(listeners, sinks, worker pools) and "reloading" them would silently
+do nothing or, worse, half-apply. An operator who edits a
+non-reloadable key gets a WARNING naming it, not a mystery.
+
+A config edit that no longer parses/validates is rejected wholesale
+(``reload_rejected`` counter, nothing applied) — a typo'd file must
+never degrade a running server.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+log = logging.getLogger("veneur_tpu.reload")
+
+# Keys that apply safely to a live server. Each one is consumed at use
+# time (no object-graph rebuild): tenant budgets are read per-adopt under
+# the ledger lock, journal policy per-append, the drain deadline at
+# SIGTERM time.
+RELOADABLE = frozenset({
+    "tenant_budgets",
+    "tenant_default_budget",
+    "spill_journal_fsync",
+    "spill_journal_max_bytes",
+    "spill_journal_max_segments",
+    "shutdown_drain_deadline_s",
+})
+
+
+class ConfigReloader:
+    """Polls ``path`` for mtime changes and applies RELOADABLE diffs to
+    ``server`` in place. Runs as a daemon thread (``start``/``stop``);
+    ``check_once`` is the testable unit."""
+
+    def __init__(self, path: str, server, poll_s: float = 5.0) -> None:
+        self.path = path
+        self.server = server
+        self.poll_s = max(0.5, float(poll_s))
+        self._stop = threading.Event()
+        self._thread = None
+        self._mtime = self._stat_mtime()
+        # honest telemetry: reloads that applied, were rejected (invalid
+        # file), and edits to keys we refuse to hot-apply
+        self.reloads_applied = 0
+        self.reload_rejected = 0
+        self.ignored_keys_total = 0
+
+    def _stat_mtime(self):
+        try:
+            return os.stat(self.path).st_mtime_ns
+        except OSError:
+            return None
+
+    def check_once(self) -> bool:
+        """Re-read the config if the file changed; returns True iff a
+        reload was applied (even one applying zero whitelisted keys)."""
+        mtime = self._stat_mtime()
+        if mtime is None or mtime == self._mtime:
+            return False
+        self._mtime = mtime
+        from veneur_tpu.core.config import load_config
+
+        try:
+            new = load_config(self.path)
+        except Exception as e:
+            self.reload_rejected += 1
+            log.warning("config reload rejected (nothing applied): %s", e)
+            return False
+        old = self.server.config
+        changed = [f for f in old.__dataclass_fields__
+                   if getattr(old, f) != getattr(new, f)]
+        ignored = [f for f in changed if f not in RELOADABLE]
+        if ignored:
+            self.ignored_keys_total += len(ignored)
+            log.warning("config reload: ignoring non-reloadable key(s) "
+                        "%s (restart to apply)", sorted(ignored))
+        applied = [f for f in changed if f in RELOADABLE]
+        for f in applied:
+            setattr(old, f, getattr(new, f))
+        if ("tenant_budgets" in applied
+                or "tenant_default_budget" in applied):
+            led = self.server.tenant_ledger
+            if led is not None:
+                led.set_budgets(old.tenant_default_budget,
+                                old.tenant_budgets)
+            else:
+                # tenancy was off at build time: the ledger (and the
+                # per-worker sketches) only exist when a budget was
+                # configured at start — that wiring is a build-time graph
+                log.warning("config reload: tenant budgets set but "
+                            "tenancy was disabled at startup; restart "
+                            "to enable enforcement")
+        if any(f.startswith("spill_journal_") for f in applied):
+            for j in getattr(self.server, "_journals", {}).values():
+                j.set_policy(fsync=old.spill_journal_fsync,
+                             max_bytes=old.spill_journal_max_bytes,
+                             max_segments=old.spill_journal_max_segments)
+        # shutdown_drain_deadline_s needs no push: graceful_drain reads
+        # server.config at SIGTERM time, which we just mutated
+        self.reloads_applied += 1
+        if applied:
+            log.info("config reload applied: %s", sorted(applied))
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check_once()
+            except Exception:
+                log.exception("config reload check failed")
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="config-reload", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -69,6 +69,17 @@ class TenantLedger:
     def budget_for(self, tenant: str) -> int:
         return self.budgets.get(tenant, self.default_budget)
 
+    def set_budgets(self, default_budget: int,
+                    budgets: Optional[dict[str, int]] = None) -> None:
+        """Hot-swap the budget table (config reload). Admitted series are
+        untouched — a lowered budget rejects *new* series only, keeping
+        the reject-new-never-evict contract; a raised budget takes effect
+        on the next adopt."""
+        with self._lock:
+            self.default_budget = int(default_budget)
+            self.budgets = {
+                str(k): int(v) for k, v in (budgets or {}).items()}
+
     def admit(self, tenant: str, series_key: str) -> bool:
         """True iff ``series_key`` may (continue to) aggregate for
         ``tenant``. Idempotent: an admitted series stays admitted for the

@@ -25,6 +25,22 @@ from veneur_tpu.utils.http import APIHandlerBase
 log = logging.getLogger("veneur_tpu.import")
 
 
+def _import_scope(m: pb.Metric):
+    """The scope class an imported metric's series will occupy — the same
+    fixups ``codec.apply_to_worker`` / ``handle_wire`` apply (counters and
+    gauges forced global, HLLs mixed) so the tenant ledger charges the
+    exact (key, scope) identity the directory will row."""
+    from veneur_tpu.core.directory import ScopeClass
+    from veneur_tpu.distributed.codec import _SCOPE_FROM_PB
+
+    which = m.WhichOneof("value")
+    if which in ("counter", "gauge"):
+        return ScopeClass.GLOBAL
+    if which == "hll":
+        return ScopeClass.MIXED
+    return _SCOPE_FROM_PB.get(m.scope, ScopeClass.MIXED)
+
+
 class ImportServer:
     """Receives MetricBatch RPCs and routes metrics into a server's
     workers by identity digest (one series → one worker shard,
@@ -37,6 +53,7 @@ class ImportServer:
         self.address: Optional[str] = None
         self.received_metrics = 0
         self.import_errors = 0
+        self.tenant_rejected_metrics = 0
         self.last_import_unix = 0.0
         # concurrent imports (one thread per HTTP request + gRPC handlers)
         # hold different worker locks; the tallies need their own
@@ -51,12 +68,37 @@ class ImportServer:
         for m in batch.metrics:
             i = codec.routing_digest(m) % len(workers)
             chunks.setdefault(i, []).append(m)
-        received = errors = 0
+        # per-tenant budget enforcement on the import path (ROADMAP open
+        # item 4): the global tier is the cardinality chokepoint — every
+        # local's forwarded mixed-scope series lands here — so an
+        # unbudgeted /import would let one tenant blow past the exact cap
+        # the ingest path enforces. Same ledger, same tallies (into the
+        # receiving worker's per-epoch TenantTallies under its held
+        # lock), so per-tenant conservation stays exact across tiers.
+        ledger = getattr(self.server, "tenant_ledger", None)
+        if ledger is not None:
+            from veneur_tpu.core.metrics import tenant_of
+            from veneur_tpu.core.worker import _series_budget_id
+        received = errors = budget_rejected = 0
         for i, metrics in chunks.items():
             with locks[i]:
+                w = workers[i]
                 for m in metrics:
+                    if ledger is not None:
+                        tenant = tenant_of(list(m.tags), ledger.tag_key)
+                        tt = w.tenant_tallies
+                        tt.accepted[tenant] = (
+                            tt.accepted.get(tenant, 0) + 1)
+                        if not ledger.admit(
+                                tenant, _series_budget_id(
+                                    _import_scope(m), codec.metric_key(m))):
+                            tt.rejected[tenant] = (
+                                tt.rejected.get(tenant, 0) + 1)
+                            budget_rejected += 1
+                            continue
+                        tt.kept[tenant] = tt.kept.get(tenant, 0) + 1
                     try:
-                        codec.apply_to_worker(workers[i], m)
+                        codec.apply_to_worker(w, m)
                         received += 1
                     except ValueError as e:
                         errors += 1
@@ -64,6 +106,7 @@ class ImportServer:
         with self._stats_lock:
             self.received_metrics += received
             self.import_errors += errors
+            self.tenant_rejected_metrics += budget_rejected
             self.last_import_unix = time.time()
         stats = getattr(self.server, "stats", None)
         if stats is not None:
@@ -89,7 +132,14 @@ class ImportServer:
 
         workers = self.server.workers
         d = None
-        if getattr(self.server, "native_mode", False):
+        if (getattr(self.server, "native_mode", False)
+                and getattr(self.server, "tenant_ledger", None) is None):
+            # tenancy admission needs each metric's tags, which the
+            # native decode keeps as an opaque meta blob — with budgets
+            # configured the Python batch path (which enforces them)
+            # wins over the fast path: budgets are an incident defense,
+            # and an unbudgeted fast lane is exactly the bypass an
+            # abusive tenant would ride
             d = native_mod.decode_metric_batch(blob)
         if d is None:
             batch = pb.MetricBatch.FromString(blob)
@@ -190,6 +240,7 @@ class ImportServer:
                 "address": self.address,
                 "received_metrics": self.received_metrics,
                 "import_errors": self.import_errors,
+                "tenant_rejected_metrics": self.tenant_rejected_metrics,
                 "last_import_unix": self.last_import_unix,
                 "serving": self.grpc_server is not None,
             }

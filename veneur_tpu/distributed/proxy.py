@@ -29,6 +29,7 @@ Live-membership robustness (the PR-7 layer over that skeleton):
 
 from __future__ import annotations
 
+import json
 import logging
 import queue
 import socket
@@ -70,6 +71,61 @@ class _Fragment:
         self.count = len(parts)
         self.nbytes = (sum(len(p) for p in parts) if wire
                        else sum(m.ByteSize() for m in parts))
+
+
+def _fragment_encode(frag: _Fragment) -> bytes:
+    """Serialize a fragment for the write-ahead spill journal
+    (utils/journal.py): a JSON header (wire flag, placement meta, part
+    lengths) + the concatenated part bytes. Both routing paths are
+    journalable — wire parts ARE bytes; batch parts serialize via
+    pb.Metric. The journal checksums the whole record."""
+    if frag.wire:
+        parts = frag.parts
+    else:
+        parts = [m.SerializeToString() for m in frag.parts]
+    hdr = json.dumps(
+        {"w": 1 if frag.wire else 0, "meta": list(frag.meta),
+         "lens": [len(p) for p in parts]},
+        separators=(",", ":")).encode()
+    return hdr + b"\n" + b"".join(parts)
+
+
+def _fragment_decode(blob: bytes) -> Optional[_Fragment]:
+    """Inverse of _fragment_encode; None on any malformation (the
+    caller acks-and-counts, never crashes on a stale or foreign
+    record)."""
+    nl = blob.find(b"\n")
+    if nl < 0:
+        return None
+    try:
+        hdr = json.loads(blob[:nl])
+        wire = bool(hdr["w"])
+        meta = list(hdr["meta"])
+        lens = [int(n) for n in hdr["lens"]]
+    except (ValueError, KeyError, TypeError):
+        return None
+    if len(meta) != len(lens) or sum(lens) != len(blob) - nl - 1:
+        return None
+    parts: list = []
+    off = nl + 1
+    for n in lens:
+        parts.append(blob[off:off + n])
+        off += n
+    if not wire:
+        try:
+            parts = [pb.Metric.FromString(p) for p in parts]
+        except Exception:  # noqa: BLE001 — foreign/corrupt protobuf
+            return None
+    return _Fragment(wire, parts, meta)
+
+
+def _entry_encode(entry) -> Optional[bytes]:
+    """DeliveryManager journal-encode hook: only routed fragments carry
+    durable context; foreign deliver() callers stay RAM-only."""
+    frag = entry.payload
+    if not isinstance(frag, _Fragment):
+        return None
+    return _fragment_encode(frag)
 
 
 class RoutingPool:
@@ -173,8 +229,17 @@ class ProxyServer:
                  routing_workers: int = 4,
                  routing_queue_max: int = ROUTING_QUEUE_MAX,
                  handoff_window_s: float = 5.0,
-                 client_factory: Optional[Callable] = None) -> None:
+                 client_factory: Optional[Callable] = None,
+                 journal=None) -> None:
         self.ring = ConsistentRing(destinations or [])
+        # one SHARED write-ahead journal (utils/journal.py) across every
+        # per-destination manager: a fragment spilled toward A, drained
+        # by a reshard, and re-spilled toward B keeps one durable record
+        # until it reaches a terminal outcome. None = journaling off.
+        self._journal = journal
+        self.journal_recovered_payloads = 0
+        self.journal_recovered_metrics = 0
+        self.journal_decode_failed = 0
         self.timeout_s = timeout_s
         self.idle_timeout_s = idle_timeout_s
         # LRU bound on kept-alive downstream conns (reference
@@ -283,6 +348,8 @@ class ProxyServer:
             if man is None:
                 man = DeliveryManager("forward:" + dest, self._policy,
                                       evict_cb=self._on_spill_evict)
+                if self._journal is not None:
+                    man.attach_journal(self._journal, _entry_encode)
                 self._managers[dest] = man
             self._inflight[dest] = self._inflight.get(dest, 0) + 1
             return man
@@ -524,11 +591,54 @@ class ProxyServer:
                     continue
                 drained_metrics += e.payload.count
                 self._reroute_fragment(e.payload, deadline)
+                # the re-route gave every surviving piece its own journal
+                # record (deferred pieces re-append on their new owner's
+                # spill) — only now is the ORIGINAL record's story over.
+                # Crash between the two: duplicates on replay, never loss.
+                if self._journal is not None and e.jid is not None:
+                    self._journal.ack(e.jid)
+                    e.jid = None
         self._retire_departed()
         with self._stats_lock:
             self.handoffs += 1
         return {"drained_payloads": drained_payloads,
                 "drained_metrics": drained_metrics}
+
+    def recover_journal(self, window_s: Optional[float] = None) -> dict:
+        """Replay the shared journal's unacked fragments from a prior
+        incarnation and re-route them under the CURRENT ring — the old
+        destination may be long gone; placement meta travels in the
+        record precisely so recovery is a re-route, not a blind resend.
+        Pieces that can't go out inside the window park (with fresh
+        journal records) on their new owners' spills; only then is the
+        replayed record acked, so a crash mid-recovery re-replays
+        instead of losing. Call once at startup, before traffic."""
+        if self._journal is None:
+            return {"recovered_payloads": 0, "recovered_metrics": 0}
+        window = self.handoff_window_s if window_s is None \
+            else float(window_s)
+        deadline = time.monotonic() + window
+        recovered_payloads = recovered_metrics = 0
+        for rid, blob in self._journal.replay_pending():
+            frag = _fragment_decode(blob)
+            if frag is None:
+                with self._stats_lock:
+                    self.journal_decode_failed += 1
+                self._journal.ack(rid)
+                continue
+            self._reroute_fragment(frag, deadline)
+            self._journal.ack(rid)
+            recovered_payloads += 1
+            recovered_metrics += frag.count
+        with self._stats_lock:
+            self.journal_recovered_payloads += recovered_payloads
+            self.journal_recovered_metrics += recovered_metrics
+        if recovered_payloads:
+            log.info("proxy journal recovery: %d payload(s), %d metric(s)"
+                     " re-routed under ring v%d", recovered_payloads,
+                     recovered_metrics, self.ring.version)
+        return {"recovered_payloads": recovered_payloads,
+                "recovered_metrics": recovered_metrics}
 
     def _retire_departed(self) -> None:
         """Drop managers of destinations no longer in the ring, once
@@ -597,6 +707,12 @@ class ProxyServer:
             "routing": self._pool.stats(),
             "behind": self._pool.behind(),
         })
+        with self._stats_lock:
+            out["journal_recovered_payloads"] = self.journal_recovered_payloads
+            out["journal_recovered_metrics"] = self.journal_recovered_metrics
+            out["journal_decode_failed"] = self.journal_decode_failed
+        if self._journal is not None:
+            out["journal"] = self._journal.stats()
         if self.refresher is not None:
             out["refresh"] = self.refresher.stats()
             out["refresh_errors"] = self.refresher.refresh_errors
@@ -626,6 +742,11 @@ class ProxyServer:
             for client in self._conns.values():
                 client.close()
             self._conns.clear()
+        if self._journal is not None:
+            # whatever is still spilled stays durable for the next
+            # incarnation's recover_journal
+            self._journal.sync()
+            self._journal.close()
 
 
 class TraceProxy:

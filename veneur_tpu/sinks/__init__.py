@@ -27,7 +27,7 @@ METRIC_KEY_TOTAL_METRICS_SKIPPED = "sink.metrics_skipped_total"
 DELIVERY_STAT_COUNTERS = (
     "delivered_payloads", "dropped_payloads", "dropped_bytes",
     "retries", "deferred_payloads", "deadline_clipped",
-    "breaker_short_circuits",
+    "breaker_short_circuits", "journal_appended", "journal_recovered",
 )
 
 

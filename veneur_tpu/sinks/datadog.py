@@ -21,6 +21,7 @@ from veneur_tpu.core.metrics import InterMetric, MetricType
 from veneur_tpu.protocol import dogstatsd as ddproto
 from veneur_tpu.sinks import MetricSink, SpanSink
 from veneur_tpu.sinks.delivery import make_manager
+from veneur_tpu.sinks.journal_codec import HttpEnvelope
 from veneur_tpu.ssf import SSFSample, SSFSpan
 from veneur_tpu.utils.http import default_opener, json_body, post_bytes
 
@@ -264,7 +265,11 @@ class DatadogMetricSink(MetricSink):
             post_bytes(url, body, headers, timeout, self.opener)
             self.flushed_metrics += count
 
-        if self.delivery.deliver(send, len(body)) != "delivered":
+        # the envelope is the entry's durable context: when a spill
+        # journal is attached (core/server.py), a spilled body survives
+        # SIGKILL and is re-POSTed by the next incarnation
+        env = HttpEnvelope(url=url, body=body, headers=headers, count=count)
+        if self.delivery.deliver(send, len(body), payload=env) != "delivered":
             self.flush_errors += 1
             log.warning("datadog %s post not delivered this flush", what)
 
@@ -416,6 +421,8 @@ class DatadogSpanSink(SpanSink):
                        body, hdrs, timeout, self.opener)
             self.spans_flushed += len(spans)
 
-        if self.delivery.deliver(send, len(body)) != "delivered":
+        env = HttpEnvelope(url=f"{self.trace_api_address}/v0.3/traces",
+                           body=body, headers=hdrs, count=len(spans))
+        if self.delivery.deliver(send, len(body), payload=env) != "delivered":
             self.flush_errors += 1
             log.warning("datadog trace post not delivered this flush")

@@ -211,6 +211,11 @@ class _SpillEntry:
     # caps bite, so an abusive tenant's flood can't push innocents'
     # deferred data out of the bounded spill
     tenant: str = ""
+    # write-ahead journal record id once the entry has a durable shadow
+    # (utils/journal.py). Set on first spill, preserved across re-spills
+    # and drain/re-route handoffs; acked at the terminal outcome. None =
+    # never journaled (journaling off, or the payload isn't encodable).
+    jid: Optional[int] = None
 
 
 class SpillBuffer:
@@ -293,6 +298,16 @@ class DeliveryManager:
         # currently over-budget tenants, consulted at spill-eviction
         # time so abusive tenants' payloads are pushed out first
         self.abusive_tenants: Optional[Callable[[], frozenset]] = None
+        # write-ahead spill journal (attach_journal); None = journaling
+        # off, and every hook below is a no-op so behaviour is identical
+        # to the in-RAM-only manager (pinned by tests/test_journal.py)
+        self._journal = None
+        self._journal_encode: Optional[Callable[[_SpillEntry],
+                                                Optional[bytes]]] = None
+        # send-once sinks (splunk HEC: retry_max=0, no spill) set this to
+        # refuse journaling explicitly — a replayed payload would violate
+        # their at-most-once semantics
+        self.journal_exempt = False
         self._lock = threading.Lock()
         self.breaker = CircuitBreaker(self.policy.breaker_threshold)
         self.spill = SpillBuffer(self.policy.spill_max_bytes,
@@ -308,6 +323,76 @@ class DeliveryManager:
         self.deadline_clipped = 0    # across several intervals)
         self.breaker_short_circuits = 0
         self.handed_off_payloads = 0  # drained out for re-routing
+        self.journal_appended = 0     # spilled payloads given a durable shadow
+        self.journal_append_failed = 0
+        self.journal_recovered = 0    # payloads replayed from a prior
+        self.journal_decode_failed = 0  # incarnation's journal
+
+    # -- durability hooks ---------------------------------------------------
+
+    def attach_journal(self, journal,
+                       encode: Callable[["_SpillEntry"], Optional[bytes]],
+                       ) -> bool:
+        """Back this manager's spill with a write-ahead journal
+        (utils/journal.py). `encode(entry)` serializes a spill entry to
+        journal bytes, or returns None for payloads that carry no
+        durable context (those stay RAM-only, exactly as before).
+        Refused (returns False) for journal_exempt managers — send-once
+        sinks must never replay."""
+        if self.journal_exempt:
+            log.info("sink %s: journal attach refused (send-once "
+                     "semantics, journal_exempt)", self.sink_name)
+            return False
+        with self._lock:
+            self._journal = journal
+            self._journal_encode = encode
+        return True
+
+    def recover(self, decode: Callable[[bytes], Optional["_SpillEntry"]],
+                ) -> int:
+        """Replay the attached journal's unacked payloads into the spill
+        so they are retried AHEAD of fresh data (the existing
+        retry_spill contract). Recovered entries keep their original
+        record ids — no re-append — so a second restart before delivery
+        replays the same records once more (idempotent). They count into
+        accepted_payloads and journal_recovered, extending conservation
+        across incarnations:
+
+            accepted (incl. recovered) == delivered + dropped
+                                          + handed_off + still-spilled
+
+        Undecodable records (corrupt payload that passed the CRC, or a
+        format from a newer build) are acked and counted — declared,
+        not silently dropped on the floor of every future replay."""
+        if self._journal is None:
+            return 0
+        recovered = 0
+        for rid, blob in self._journal.replay_pending():
+            try:
+                entry = decode(blob)
+            except Exception:  # noqa: BLE001 — decoder bugs must not
+                entry = None   # wedge startup
+            if entry is None:
+                with self._lock:
+                    self.journal_decode_failed += 1
+                self._journal.ack(rid)
+                continue
+            entry.jid = rid
+            with self._lock:
+                self.accepted_payloads += 1
+                self.journal_recovered += 1
+                self._spill_locked(entry)
+            recovered += 1
+        if recovered:
+            log.info("sink %s: recovered %d journaled payload(s) into "
+                     "spill", self.sink_name, recovered)
+        return recovered
+
+    def _journal_ack_locked(self, entry: "_SpillEntry") -> None:
+        """Terminal outcome for a journaled entry (caller holds _lock)."""
+        if self._journal is not None and entry.jid is not None:
+            self._journal.ack(entry.jid)
+            entry.jid = None
 
     # -- flush-edge hooks ---------------------------------------------------
 
@@ -320,6 +405,10 @@ class DeliveryManager:
                 self.policy.deadline_s if deadline_s is None
                 else float(deadline_s))
             self.breaker.begin_interval()
+            if self._journal is not None:
+                # the "interval" fsync-policy edge: whatever spilled
+                # since the last flush becomes durable now
+                self._journal.sync()
 
     def retry_spill(self) -> int:
         """Re-deliver spilled payloads AHEAD of fresh data; returns how
@@ -402,6 +491,7 @@ class DeliveryManager:
                     if not transient:
                         self.dropped_payloads += 1
                         self.dropped_bytes += entry.nbytes
+                        self._journal_ack_locked(entry)
                         log.warning(
                             "sink %s: permanent delivery failure, payload "
                             "dropped (%d bytes): %s", self.sink_name,
@@ -427,6 +517,7 @@ class DeliveryManager:
                 with self._lock:
                     self.breaker.record_success()
                     self.delivered_payloads += 1
+                    self._journal_ack_locked(entry)
                 return "delivered"
 
     def _spill_locked(self, entry: _SpillEntry) -> str:
@@ -445,6 +536,7 @@ class DeliveryManager:
         for old in self.spill.push(entry, abusive):
             self.dropped_payloads += 1
             self.dropped_bytes += old.nbytes
+            self._journal_ack_locked(old)  # eviction is terminal
             if old is entry:
                 dropped_self = True
             elif self._evict_cb is not None:
@@ -456,6 +548,22 @@ class DeliveryManager:
         if dropped_self:
             # never made it into the spill: the deferral became a drop
             return "dropped"
+        if (self._journal is not None and entry.jid is None
+                and self._journal_encode is not None):
+            # write-ahead shadow for the payload now parked in RAM; a
+            # re-spilled or recovered entry already has its record
+            blob = None
+            try:
+                blob = self._journal_encode(entry)
+            except Exception:  # noqa: BLE001
+                log.exception("sink %s: journal encode failed",
+                              self.sink_name)
+            if blob is not None:
+                entry.jid = self._journal.append(blob)
+                if entry.jid is not None:
+                    self.journal_appended += 1
+                else:
+                    self.journal_append_failed += 1
         return "deferred"
 
     # -- introspection ------------------------------------------------------
@@ -481,6 +589,12 @@ class DeliveryManager:
                 "breaker_transitions": list(self.breaker.transitions),
                 "spilled_payloads": len(self.spill),
                 "spilled_bytes": self.spill.bytes,
+                "journal_appended": self.journal_appended,
+                "journal_append_failed": self.journal_append_failed,
+                "journal_recovered": self.journal_recovered,
+                "journal_decode_failed": self.journal_decode_failed,
+                "journal_pending": (self._journal.pending_records()
+                                    if self._journal is not None else 0),
             }
 
     def conserved(self) -> bool:

@@ -16,6 +16,7 @@ from typing import Optional
 from veneur_tpu.core.metrics import InterMetric, MetricType
 from veneur_tpu.sinks import MetricSink
 from veneur_tpu.sinks.delivery import make_manager
+from veneur_tpu.sinks.journal_codec import HttpEnvelope
 
 log = logging.getLogger("veneur_tpu.sinks.prometheus")
 
@@ -354,13 +355,15 @@ class PrometheusExpositionSink(MetricSink):
         if not count:
             return
 
+        hdrs = {"Content-Type": "text/plain; version=0.0.4"}
+
         def send(timeout: float) -> None:
-            post_bytes(self.address, body,
-                       {"Content-Type": "text/plain; version=0.0.4"},
-                       timeout, self.opener)
+            post_bytes(self.address, body, hdrs, timeout, self.opener)
             self.flushed_metrics += count
 
-        if self.delivery.deliver(send, len(body)) != "delivered":
+        env = HttpEnvelope(url=self.address, body=body, headers=hdrs,
+                           count=count)
+        if self.delivery.deliver(send, len(body), payload=env) != "delivered":
             self.flush_errors += 1
             log.warning("prometheus exposition post not delivered "
                         "this flush")

@@ -19,6 +19,7 @@ from veneur_tpu.core.metrics import InterMetric, MetricType
 from veneur_tpu.protocol import dogstatsd as ddproto
 from veneur_tpu.sinks import MetricSink
 from veneur_tpu.sinks.delivery import make_manager
+from veneur_tpu.sinks.journal_codec import HttpEnvelope
 from veneur_tpu.ssf import SSFSample
 from veneur_tpu.utils.http import default_opener, json_body, post_bytes
 
@@ -278,7 +279,10 @@ class SignalFxMetricSink(MetricSink):
             post_bytes(url, body, headers, timeout, self.opener)
             self.flushed_metrics += count
 
-        if self.delivery.deliver(send, len(body)) != "delivered":
+        # durable spill context: with a journal attached a spilled body
+        # survives SIGKILL and is re-POSTed by the next incarnation
+        env = HttpEnvelope(url=url, body=body, headers=headers, count=count)
+        if self.delivery.deliver(send, len(body), payload=env) != "delivered":
             self.flush_errors += 1
             log.warning("signalfx %s post not delivered this flush", what)
 

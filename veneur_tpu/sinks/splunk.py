@@ -154,6 +154,12 @@ class SplunkSpanSink(SpanSink):
             delivery = replace(delivery, retry_max=0,
                                spill_max_bytes=0, spill_max_payloads=0)
         self.delivery = make_manager("splunk", delivery)
+        # send-once semantics extend across incarnations too: a journaled
+        # HEC batch replayed after a restart could double-index events the
+        # server already accepted, so this manager refuses journal attach
+        # (DeliveryManager.attach_journal returns False) no matter what
+        # spill_journal_dir says
+        self.delivery.journal_exempt = True
         self.queue: "queue.Queue" = queue.Queue(maxsize=batch_size * 16)
         self.spans_flushed = 0
         self.spans_dropped = 0
