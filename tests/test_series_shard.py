@@ -1,0 +1,327 @@
+"""Device-sharded series axis: sharded == unsharded, bit for bit.
+
+The series-sharding contract (ops/series_shard.py) is that partitioning
+the sketch pools over a device mesh is INVISIBLE in the output: every
+flush snapshot — t-digest quantiles/aggregates, HLL set estimates and
+registers, scalar planes, forwarded centroid pools — must be
+byte-for-byte what the single-device path produces, for any shard
+count, with micro-folds on or off, across epoch swaps with residual
+staged rows, through spill folds and wire imports. This file pins that
+golden matrix plus the host-side index math it rests on (the
+logical↔physical row interleave), the config validation, and the
+VENEUR_SERIES_SHARDS escape hatch.
+
+The suite runs on a virtual 8-device CPU platform (conftest.py forces
+--xla_force_host_platform_device_count=8), so the sharded paths execute
+under plain tier-1. CI additionally runs this file twice — default and
+VENEUR_SERIES_SHARDS=0 (tools/ci.sh) — mirroring the micro-fold lane:
+the worker tests pin the mechanism explicitly; the env pass proves the
+escape hatch really disengages it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from veneur_tpu.core.config import Config, validate_config
+from veneur_tpu.core.directory import ScopeClass, SeriesDirectory
+from veneur_tpu.core.flusher import device_quantiles, generate_inter_metrics
+from veneur_tpu.core.metrics import HistogramAggregates, MetricKey
+from veneur_tpu.core.worker import DeviceWorker
+from veneur_tpu.ops import scalars
+from veneur_tpu.ops import series_shard as ss
+from veneur_tpu.protocol.dogstatsd import parse_metric
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+PCTS = [0.5, 0.9, 0.99]
+QS = device_quantiles(PCTS, AGGS)
+
+SHARDS = 4
+
+
+def _need_devices(n: int) -> None:
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices, have {jax.device_count()}")
+
+
+# -- host-side index math ---------------------------------------------------
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+@pytest.mark.parametrize("rows", [8, 64, 1024])
+def test_perm_roundtrip_and_phys_rows(shards, rows):
+    """perm_l2p / perm_p2l are inverse permutations; phys_rows agrees
+    with perm_l2p pointwise; sentinels (>= pool rows) pass through; the
+    scratch row S-1 always self-maps (so _ensure_histo sizing needs no
+    per-shard scratch reservation)."""
+    _need_devices(shards)
+    sh = ss.SeriesSharding(shards)
+    l2p = sh.perm_l2p(rows)
+    p2l = sh.perm_p2l(rows)
+    assert np.array_equal(np.sort(l2p), np.arange(rows))
+    assert np.array_equal(l2p[p2l], np.arange(rows))
+    assert np.array_equal(p2l[l2p], np.arange(rows))
+    cap = rows // shards
+    r = np.arange(rows)
+    assert np.array_equal(l2p, (r % shards) * cap + r // shards)
+    assert np.array_equal(sh.phys_rows(r.astype(np.int32), rows), l2p)
+    assert l2p[rows - 1] == rows - 1  # scratch self-map
+    # sentinel passthrough: ids at/above the pool stay untranslated so
+    # drop-sentinels (e.g. microfold.DROP_ROW) stay out of range on
+    # every shard
+    sent = np.asarray([rows, rows + 7, np.iinfo(np.int32).max], np.int64)
+    assert np.array_equal(sh.phys_rows(sent, rows),
+                          sent.astype(np.int64).clip(max=2**31 - 1))
+
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_interleave_closure_under_prefix_slice(shards):
+    """a.reshape(D, cap)[:, :ecap] keeps exactly logical rows
+    [0, D*ecap) in D*ecap-interleaved layout — the property that makes
+    slice/grow/chunk per-shard prefix ops with no resharding."""
+    _need_devices(shards)
+    sh = ss.SeriesSharding(shards)
+    rows, erows = 64, 32
+    a = np.arange(rows)[sh.perm_p2l(rows)]  # phys layout of 0..rows-1
+    sub = a.reshape(shards, rows // shards)[:, :erows // shards].reshape(-1)
+    assert np.array_equal(sub, np.arange(erows)[sh.perm_p2l(erows)])
+
+
+def test_directory_shard_counts():
+    d = SeriesDirectory()
+    for i in range(11):
+        d.upsert_histo(MetricKey(name=f"h{i}", type="timer", joined_tags=""),
+                       ScopeClass.MIXED, [])
+    for i in range(5):
+        d.upsert_set(MetricKey(name=f"s{i}", type="set", joined_tags=""),
+                     ScopeClass.MIXED, [])
+    h, s = d.shard_counts(4)
+    assert h == [3, 3, 3, 2] and sum(h) == 11
+    assert s == [2, 1, 1, 1] and sum(s) == 5
+
+
+# -- config + env resolution ------------------------------------------------
+
+
+def test_resolve_env_escape_hatch(monkeypatch):
+    monkeypatch.delenv(ss._ENV_KEY, raising=False)
+    assert ss.resolve_series_shards(4) == 4
+    monkeypatch.setenv(ss._ENV_KEY, "0")
+    assert ss.resolve_series_shards(4) == 0
+    monkeypatch.setenv(ss._ENV_KEY, "8")
+    assert ss.resolve_series_shards(0) == 8
+    monkeypatch.setenv(ss._ENV_KEY, "nonsense")
+    assert ss.resolve_series_shards(4) == 4
+
+
+def test_shards_usable():
+    assert not ss.shards_usable(0)
+    assert not ss.shards_usable(1)
+    assert not ss.shards_usable(3)  # not pow2
+    assert ss.shards_usable(2) == (jax.device_count() >= 2)
+    assert not ss.shards_usable(jax.device_count() * 2)
+
+
+def test_config_validation():
+    validate_config(Config(series_shards=0))
+    validate_config(Config(series_shards=1))
+    validate_config(Config(series_shards=8))
+    with pytest.raises(ValueError, match="power of two"):
+        validate_config(Config(series_shards=3))
+    with pytest.raises(ValueError, match="series_shards"):
+        validate_config(Config(series_shards=-2))
+    with pytest.raises(ValueError, match="1024"):
+        validate_config(Config(series_shards=2048))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        validate_config(Config(series_shards=2, tpu_mesh_devices=2))
+
+
+# -- the golden matrix ------------------------------------------------------
+
+
+@pytest.fixture
+def pin_hatch(monkeypatch):
+    """Clear the env escape hatch for tests that pin the sharded
+    mechanism itself: the CI env pass (VENEUR_SERIES_SHARDS=0,
+    tools/ci.sh) must not turn their sharded worker into a legacy one
+    and make the comparison legacy-vs-legacy."""
+    monkeypatch.delenv(ss._ENV_KEY, raising=False)
+
+
+def _assert_snapshots_identical(a, b, path):
+    """Bitwise FlushSnapshot equality (same discipline as
+    tests/test_microfold.py): raw-byte numpy compares — stricter than
+    array_equal — plus exact InterMetric-stream equality for the
+    host-side scalars, names and tags."""
+    for f in dataclasses.fields(a):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            assert va is not None and vb is not None, (path, f.name)
+            assert va.dtype == vb.dtype and va.shape == vb.shape, (
+                path, f.name, va.dtype, vb.dtype, va.shape, vb.shape)
+            assert va.tobytes() == vb.tobytes(), (path, f.name, va, vb)
+        elif isinstance(va, (int, float)) or va is None:
+            assert va == vb, (path, f.name, va, vb)
+    ma = generate_inter_metrics(a, True, PCTS, AGGS, now=1000)
+    mb = generate_inter_metrics(b, True, PCTS, AGGS, now=1000)
+    key = lambda m: (m.name, m.type, tuple(m.tags))  # noqa: E731
+    da = {key(m): m.value for m in ma}
+    db = {key(m): m.value for m in mb}
+    assert da == db, (path, set(da) ^ set(db))
+
+
+def _drive_worker(shards: int, micro: bool, *, intervals: int = 3,
+                  stage_depth: int = 64, with_imports: bool = False,
+                  fold_every: int = 2):
+    """Deterministic mixed workload — t-digest timers (several rows past
+    the initial pool so growth runs), HLL sets, counters, gauges —
+    optionally plus wire imports (digest + register merges) and
+    micro-folds at varying offsets so swaps land with residual staged
+    rows. Small stage_depth makes per-series backlogs spill
+    mid-interval, exercising the sharded spill ingest."""
+    w = DeviceWorker(compression=100, stage_depth=stage_depth,
+                     batch_size=8, initial_histo_rows=8, initial_set_rows=8,
+                     is_local=True, micro_fold=micro, micro_fold_rows=1,
+                     micro_fold_max_age_s=1e9, series_shards=shards)
+    rng = np.random.default_rng(11)
+    snaps = []
+    for _ in range(intervals):
+        for batch in range(10):
+            for i in range(12):
+                k = (batch * 12 + i) % 23
+                w.process_metric(parse_metric(
+                    f"h{k}:{rng.normal():.6f}|ms|#a:{k % 3}".encode()))
+                w.process_metric(parse_metric(f"c{k}:{1 + k % 4}|c".encode()))
+                w.process_metric(parse_metric(
+                    f"g{k}:{rng.normal():.6f}|g".encode()))
+                w.process_metric(parse_metric(
+                    f"s{k}:v{rng.integers(200)}|s".encode()))
+            if with_imports and batch == 5:
+                key = MetricKey(name="imp.h", type="timer", joined_tags="")
+                w.import_digest(
+                    key, ["x:y"], "timer", ScopeClass.GLOBAL,
+                    np.asarray([1.0, 2.5, 7.0], np.float32),
+                    np.asarray([3.0, 2.0, 5.0], np.float32),
+                    1.0, 7.0, 0.5)
+                regs = np.zeros(1 << w.hll_precision, np.int8)
+                regs[rng.integers(0, regs.size, 50)] = 3
+                w.import_hll(MetricKey(name="imp.s", type="set", joined_tags=""), [],
+                             ScopeClass.MIXED, regs)
+            if micro and batch % fold_every == 0 and w.micro_fold_due():
+                w.micro_fold_once()
+        snaps.append(w.flush(QS))
+    return w, snaps
+
+
+@pytest.mark.parametrize("micro", [False, True], ids=["batch", "micro"])
+@pytest.mark.parametrize("with_imports", [False, True],
+                         ids=["no-imports", "imports"])
+def test_sharded_matches_unsharded_bitwise(micro, with_imports, pin_hatch):
+    _need_devices(SHARDS)
+    wu, base = _drive_worker(0, micro, with_imports=with_imports)
+    wsh, got = _drive_worker(SHARDS, micro, with_imports=with_imports)
+    assert wu._shard is None
+    assert wsh._shard is not None and wsh.series_shards == SHARDS, \
+        "sharding did not engage — matrix would compare legacy to legacy"
+    for n, (a, b) in enumerate(zip(base, got)):
+        _assert_snapshots_identical(a, b, f"micro={micro} interval={n}")
+
+
+def test_sharded_spill_bitwise(pin_hatch):
+    """Tiny stage depth: every series backlog spills to the device
+    mid-interval, so the sharded replicated-batch spill ingest (the one
+    batch-global kernel) carries the epoch."""
+    _need_devices(SHARDS)
+    _, base = _drive_worker(0, False, stage_depth=4)
+    wsh, got = _drive_worker(SHARDS, False, stage_depth=4)
+    assert wsh._shard is not None
+    for n, (a, b) in enumerate(zip(base, got)):
+        _assert_snapshots_identical(a, b, f"spill interval={n}")
+
+
+def test_sharded_micro_residual_offsets(pin_hatch):
+    """Micro-fold cadences that leave different residual staged rows at
+    each swap (the deferred-residual fence) must all be invisible."""
+    _need_devices(SHARDS)
+    _, base = _drive_worker(0, False)
+    for fold_every in (1, 3, 7):
+        _, got = _drive_worker(SHARDS, True, fold_every=fold_every)
+        for n, (a, b) in enumerate(zip(base, got)):
+            _assert_snapshots_identical(a, b, f"every{fold_every}.int{n}")
+
+
+def test_degenerate_one_shard_is_legacy_path():
+    """series_shards: 1 resolves to the UNMODIFIED single-device path —
+    not a 1-shard mesh — and its output is byte-identical to 0."""
+    w1, s1 = _drive_worker(1, True)
+    w0, s0 = _drive_worker(0, True)
+    assert w1._shard is None and w1.series_shards == 1
+    for n, (a, b) in enumerate(zip(s0, s1)):
+        _assert_snapshots_identical(a, b, f"degenerate interval={n}")
+
+
+def test_env_zero_disables_sharding(monkeypatch):
+    monkeypatch.setenv(ss._ENV_KEY, "0")
+    w = DeviceWorker(initial_histo_rows=8, series_shards=SHARDS)
+    assert w._shard is None and w.series_shards == 1
+
+
+def test_unusable_shards_fall_back(monkeypatch):
+    monkeypatch.delenv(ss._ENV_KEY, raising=False)
+    w = DeviceWorker(initial_histo_rows=8,
+                     series_shards=jax.device_count() * 2)
+    assert w._shard is None and w.series_shards == 1
+
+
+# -- sharded scalar segment ops --------------------------------------------
+
+
+def test_segment_ops_match_unsharded():
+    """The device scalar reductions (bench/mesh path twins of
+    ops/scalars) resolve identically on the sharded plane."""
+    _need_devices(SHARDS)
+    sh = ss.SeriesSharding(SHARDS)
+    num_rows = 16
+    rng = np.random.default_rng(5)
+    rows = rng.integers(0, num_rows, 300).astype(np.int32)
+    contrib = rng.integers(1, 10, 300).astype(np.float32)
+    vals = rng.normal(size=300).astype(np.float32)
+
+    ref = np.asarray(scalars.segment_counter_sum(
+        jax.numpy.asarray(rows), jax.numpy.asarray(contrib), num_rows))
+    got = np.asarray(sh.segment_counter_sum(
+        sh.phys_rows(rows, num_rows), contrib, num_rows))
+    assert np.array_equal(got[sh.perm_l2p(num_rows)], ref)
+
+    ref_v, ref_p = scalars.segment_gauge_last(
+        jax.numpy.asarray(rows), jax.numpy.asarray(vals), num_rows)
+    got_v, got_p = sh.segment_gauge_last(
+        sh.phys_rows(rows, num_rows), vals, num_rows)
+    l2p = sh.perm_l2p(num_rows)
+    ref_p = np.asarray(ref_p)
+    assert np.array_equal(np.asarray(got_p)[l2p], ref_p)
+    # value only meaningful where present
+    assert np.array_equal(np.asarray(got_v)[l2p][ref_p],
+                          np.asarray(ref_v)[ref_p])
+
+
+# -- ledger + governor shard accounting -------------------------------------
+
+
+def test_per_shard_ledger_and_governor_report(pin_hatch):
+    """Sharded flushes book per-shard H2D/D2H tallies and the governor
+    report carries the shard-aware chunk floor."""
+    _need_devices(SHARDS)
+    w, _ = _drive_worker(SHARDS, False)
+    per = w.ledger.flush_h2d_per_shard()
+    assert len(per) == SHARDS and sum(per) > 0, per
+    d2h = w.ledger.flush_d2h_per_shard()
+    assert len(d2h) == SHARDS and sum(d2h) > 0, d2h
+    # replicated uploads and the packed readback land evenly; nothing
+    # silently funnels through shard 0
+    assert min(d2h) > 0 and min(per) > 0

@@ -19,6 +19,17 @@ whether the worst chunk stayed near the sub-interval target. This is
 the CPU story for sizes past the cardinality knee: the flush exceeds
 the 10s budget, but in bounded, watchdog-visible steps.
 
+With --shards N: the device-sharded series axis (ops/series_shard.py,
+`series_shards` in config). Single-size mode runs the flush over an
+N-way shard mesh; with --scaling it ALSO appends a sharded row set
+where the series count grows proportionally with the shard count
+(base, 1x) -> (2*base, 2x) -> ... (N*base, Nx) — the capacity claim in
+one curve: per-flush device fold time should stay ~flat as series and
+shards scale together. On hosts with fewer than N devices the process
+re-execs itself with --xla_force_host_platform_device_count=N (the CPU
+mesh CI and this bench share that trick); a real TPU with enough chips
+runs as-is.
+
 Env: VENEUR_E2E_SERIES (default 2^20 on TPU, 2^16 elsewhere),
 VENEUR_E2E_SAMPLES_PER_SERIES (default 4),
 VENEUR_E2E_SCALING_SIZES (comma-separated override),
@@ -70,7 +81,7 @@ def _backend() -> str:
 
 
 def run_one(series: int, per: int, persist_partial: bool = False,
-            chunk_target_ms: int = 0) -> dict:
+            chunk_target_ms: int = 0, shards: int = 0) -> dict:
     """Cold pass (pool growth + XLA compile) then one steady-state
     ingest+flush round — the reference's world, where every 10s interval
     sees the same series again and reuses everything (metrics expire at
@@ -83,11 +94,16 @@ def run_one(series: int, per: int, persist_partial: bool = False,
     cfg = Config(interval="10s", percentiles=[0.5, 0.9, 0.99],
                  aggregates=["min", "max", "count"],
                  tpu_native_ingest=True, num_workers=1, num_readers=1,
-                 flush_chunk_target_ms=chunk_target_ms)
+                 flush_chunk_target_ms=chunk_target_ms,
+                 series_shards=shards)
     srv = Server(cfg, metric_sinks=[BlackholeMetricSink()])
     if not srv.native_mode:
         print("warning: native ingest unavailable; using Python parser",
               file=sys.stderr)
+    if shards > 1 and srv.workers[0].series_shards != shards:
+        print(f"warning: series_shards={shards} did not engage "
+              f"(have {srv.workers[0].series_shards}); measuring the "
+              "single-device path", file=sys.stderr)
 
     t0 = time.perf_counter()
     datagrams = build_datagrams(series, per, cfg.metric_max_length)
@@ -159,6 +175,7 @@ def run_one(series: int, per: int, persist_partial: bool = False,
         }
     return {
         "series": series,
+        **({"series_shards": shards} if shards > 1 else {}),
         "samples": n_samples,
         "datagram_gen_s": round(gen_s, 3),
         "cold_ingest_s": round(cold_ingest_s, 3),
@@ -177,7 +194,40 @@ def run_one(series: int, per: int, persist_partial: bool = False,
     }
 
 
+def _shards_arg(argv: list) -> int:
+    for i, a in enumerate(argv):
+        if a == "--shards" and i + 1 < len(argv):
+            return int(argv[i + 1])
+        if a.startswith("--shards="):
+            return int(a.split("=", 1)[1])
+    return 0
+
+
+def _ensure_devices(shards: int) -> None:
+    """Re-exec with a forced host-device count when the backend cannot
+    give `shards` devices (the CPU case — same trick as the CI sharding
+    lane). A real TPU with enough chips passes through untouched. Must
+    run before any jax computation so the flag lands at backend init;
+    _backend() above only reads the platform name, which is safe."""
+    import jax
+
+    if jax.device_count() >= shards:
+        return
+    if os.environ.get("_VENEUR_E2E_SHARDS_REEXEC"):
+        print(f"error: {jax.device_count()} devices even after forcing "
+              f"{shards}; cannot run sharded", file=sys.stderr)
+        sys.exit(2)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={shards} "
+                        + env.get("XLA_FLAGS", "")).strip()
+    env["_VENEUR_E2E_SHARDS_REEXEC"] = "1"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def main() -> None:
+    shards = _shards_arg(sys.argv[1:])
+    if shards > 1:
+        _ensure_devices(shards)
     backend = _backend()
     on_tpu = backend == "tpu"
     per = int(os.environ.get("VENEUR_E2E_SAMPLES_PER_SERIES", 4))
@@ -206,9 +256,9 @@ def main() -> None:
                                   row["bounded_degradation"]}
                                  if "bounded_degradation" in row else {})}),
                   flush=True)
-        row_keys = ("series", "ingest_samples_per_s", "flush_total_s",
-                    "flush_phases", "fits_interval", "bounded_degradation",
-                    "transfer_bytes")
+        row_keys = ("series", "series_shards", "ingest_samples_per_s",
+                    "flush_total_s", "flush_phases", "fits_interval",
+                    "bounded_degradation", "transfer_bytes")
         out = {
             "platform": backend,
             "note": ("end-to-end Server.flush latency vs series count; "
@@ -221,6 +271,53 @@ def main() -> None:
                 rows[-1]["flush_total_s"] / max(rows[0]["flush_total_s"],
                                                 1e-9), 2),
         }
+        if shards > 1:
+            # the capacity curve: series grow WITH the shard count from
+            # the smallest size, so per-flush device fold (extract) time
+            # flat-ish across the set is the evidence that sharding buys
+            # proportional series capacity per host
+            srows = []
+            d = 1
+            while d <= shards:
+                r = run_one(sizes[0] * d, per, chunk_target_ms=chunk_ms,
+                            shards=d)
+                srows.append({k: r[k] for k in row_keys if k in r})
+                print(json.dumps({"series": sizes[0] * d,
+                                  "series_shards": d,
+                                  "extract_s":
+                                      r["flush_phases"].get("extract_s"),
+                                  "flush_total_s": r["flush_total_s"]}),
+                      flush=True)
+                d *= 2
+            ex = [r["flush_phases"].get("extract_s", 0.0) for r in srows]
+            out["sharded_rows"] = srows
+            # per-shard normalization is the honest readout on a
+            # shared-silicon rig: the forced host devices all run on the
+            # same CPU cores, so wall-clock extract still grows with
+            # TOTAL series even though each shard's rows, fold program,
+            # and readback bytes are constant by construction. The flat
+            # curve the layout buys shows up here as d2h_bytes_per_shard
+            # and device_chunk_s_per_shard; wall-clock flatness needs
+            # real per-shard silicon.
+            out["sharded_per_shard"] = [
+                {"series_shards": max(int(r.get("series_shards", 1)), 1),
+                 "d2h_bytes_per_shard":
+                     r["transfer_bytes"]["d2h_bytes"]
+                     // max(int(r.get("series_shards", 1)), 1),
+                 "device_chunk_s_per_shard": round(
+                     r["bounded_degradation"]["chunk_max_s"]
+                     / max(int(r.get("series_shards", 1)), 1), 4)}
+                for r in srows]
+            out["sharded_note"] = (
+                "series scale proportionally with series_shards from the "
+                "base size; per-shard rows and d2h readback bytes are "
+                "constant by construction (see sharded_per_shard). On "
+                "this rig the forced host devices share the CPU cores, "
+                "so wall-clock extract_s still grows with total series "
+                "(sharded_extract_max_over_min); flat wall clock "
+                "requires real per-shard silicon.")
+            out["sharded_extract_max_over_min"] = round(
+                max(ex) / max(min(ex), 1e-9), 3)
         with open(os.path.join(root, "E2E_SCALING.json"), "w") as f:
             json.dump(out, f, indent=1)
         return
@@ -229,7 +326,7 @@ def main() -> None:
                                 1 << 20 if on_tpu else 1 << 16))
     out = {"platform": backend,
        **run_one(series, per, persist_partial=True,
-                 chunk_target_ms=chunk_ms)}
+                 chunk_target_ms=chunk_ms, shards=shards)}
     with open(os.path.join(root, "E2E_FLUSH.json"), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"metric": "e2e_flush_latency_s",

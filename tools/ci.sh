@@ -76,6 +76,24 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_MICRO_FOLD=0 \
   python -m pytest tests/test_microfold.py -q -m 'not slow'
 
+# Series-sharding parity lane: the device-sharded series axis
+# (ops/series_shard.py) must be BIT-identical to the single-device
+# path for every metric class, spills and imports included, with
+# micro-folds on and off. Runs twice, mirroring the micro-fold lane:
+# default (tests/conftest.py forces an 8-device virtual CPU platform,
+# so the sharded golden matrix executes for real; XLA_FLAGS here is
+# belt-and-braces for a stripped environment) and with the escape
+# hatch thrown (VENEUR_SERIES_SHARDS=0) — a parity drift is named by
+# the first pass, a broken disable path by the second.
+echo "== series-sharding parity lane (sharded on + escape hatch) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m pytest tests/test_series_shard.py -q -m 'not slow'
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  VENEUR_SERIES_SHARDS=0 \
+  python -m pytest tests/test_series_shard.py -q -m 'not slow'
+
 # Delivery chaos lane: a pipelined server flushing into HTTP sinks whose
 # openers inject seeded faults (utils/faults.py) — refusals, 5xx, slow
 # responses, mid-body resets, payload rejections, and a deterministic

@@ -31,6 +31,28 @@ import numpy as np
 
 from veneur_tpu.core.metrics import InterMetric, MetricType
 
+# aggregate columns appended after the [S, P] quantile block by the
+# worker's packed extract (worker._pack_extract_columns): dmin, dmax,
+# dsum, dcount, drecip, lmin, lmax, lsum, lweight, lrecip
+EXTRACT_AGG_COLUMNS = 10
+
+
+def unpack_extract_columns(packed: np.ndarray, p: int,
+                           perm: Optional[np.ndarray] = None):
+    """Split a packed extract array [S, P+10] back into the [S, P]
+    quantile block and the ten [S] aggregate columns (the inverse of
+    worker._pack_extract_columns, minus the f32 cast — that is one-way
+    by design).
+
+    ``perm``: optional row gather applied first — the series-sharded
+    extract reads back in physical (shard-interleaved) row order and
+    hands the logical-order permutation here."""
+    if perm is not None:
+        packed = packed[perm]
+    qv = packed[:, :p]
+    aggs = tuple(packed[:, p + i] for i in range(EXTRACT_AGG_COLUMNS))
+    return qv, aggs
+
 
 @dataclass
 class MetricFamily:

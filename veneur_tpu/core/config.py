@@ -230,6 +230,16 @@ class Config:
     micro_fold: bool = True
     micro_fold_rows: int = 8192
     micro_fold_max_age_s: float = 0.25
+    # device-sharded series axis (ops/series_shard.py): >1 partitions
+    # each worker's sketch pools (t-digest rows, HLL registers, the
+    # micro-fold mirror) over that many devices with a shard_map row
+    # interleave — upload, micro-fold, and fold all run shard-local, one
+    # packed readback at extract. Must be a power of two <= the visible
+    # device count; bit-identical to the single-device path per metric
+    # class (tests/test_series_shard.py). VENEUR_SERIES_SHARDS=0 is the
+    # env escape hatch. Mutually exclusive with tpu_mesh_devices (the
+    # global tier's mesh owns its own layout).
+    series_shards: int = 0
     # entries per pending-batch (SoA) class before ingest sheds samples
     # (drop-don't-block under overload; counted in
     # veneur.ingest.overload_dropped_total). Bounds native ingest memory
@@ -709,6 +719,24 @@ def validate_config(cfg: Config) -> None:
         if cfg.tpu_mesh_devices % cfg.tpu_mesh_hosts:
             raise ValueError("tpu_mesh_devices must be divisible by"
                              " tpu_mesh_hosts")
+    if cfg.series_shards < 0:
+        raise ValueError("series_shards must be >= 0 (0/1 disable"
+                         " series sharding)")
+    if cfg.series_shards > 1:
+        s = cfg.series_shards
+        if s & (s - 1):
+            raise ValueError("series_shards must be a power of two (the"
+                             " row interleave needs shards | pool rows,"
+                             " and pool sizes are powers of two)")
+        if s > 1024:
+            raise ValueError("series_shards must be <= 1024 (chunked"
+                             " extraction aligns chunk starts to the"
+                             " shard count, floored at 1024 rows)")
+        if cfg.tpu_mesh_devices > 1:
+            raise ValueError(
+                "series_shards and tpu_mesh_devices are mutually"
+                " exclusive: the global tier's mesh owns the device"
+                " layout; a worker cannot also shard its pools over it")
     if cfg.set_hash not in ("fnv", "metro"):
         raise ValueError("set_hash must be 'fnv' or 'metro'")
     if cfg.tpu_set_store not in ("staged", "dense"):

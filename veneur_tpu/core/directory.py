@@ -197,3 +197,15 @@ class SeriesDirectory:
     @property
     def num_set_rows(self) -> int:
         return len(self.sets.rows)
+
+    def shard_counts(self, shards: int) -> tuple[list[int], list[int]]:
+        """Live rows per device shard under the series-sharded row
+        interleave (ops/series_shard.py: logical row r lives on shard
+        r % shards): (histo_rows_per_shard, set_rows_per_shard).
+
+        The interleave balances by construction — max−min ≤ 1 per pool —
+        so this is a telemetry/bench readout (shard occupancy for
+        capacity math), never a balancing input."""
+        nh, ns = len(self.histo.rows), len(self.sets.rows)
+        return ([(nh + shards - 1 - d) // shards for d in range(shards)],
+                [(ns + shards - 1 - d) // shards for d in range(shards)])

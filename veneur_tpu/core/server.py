@@ -134,6 +134,7 @@ class Server:
                 micro_fold=cfg.micro_fold,
                 micro_fold_rows=cfg.micro_fold_rows,
                 micro_fold_max_age_s=cfg.micro_fold_max_age_s,
+                series_shards=cfg.series_shards,
             )
             for _ in range(cfg.num_workers)
         ]
@@ -572,20 +573,20 @@ class Server:
         """Pull buffered event/service-check lines out of the C++ context
         and parse them on the Python path. MUST NOT be called while
         holding a worker lock — the parsed lines re-enter _route, which
-        takes them."""
-        with self._worker_locks[0]:
-            others = self.workers[0]._native.drain_other()
-        for line in others:
+        takes them. Deliberately lock-free on the Python side: the drain
+        serializes on the C++ ctx mutex (per-thread scratch in native.py),
+        so reader threads no longer funnel through worker 0's ingest
+        lock; each parsed line then routes to its digest owner."""
+        for line in self.workers[0]._native.drain_other():
             self.handle_metric_packet(line)
 
     def _drain_native_ssf_fallbacks(self) -> None:
         """Raw SSF payloads the C++ SSF reader handed back (STATUS spans
-        need the Python pipeline). Same no-lock-held rule as events."""
+        need the Python pipeline). Same no-lock-held, no-funnel rule as
+        events."""
         if not self._native_ssf_readers:
             return
-        with self._worker_locks[0]:
-            pkts = self.workers[0]._native.drain_ssf_fallback()
-        for pkt in pkts:
+        for pkt in self.workers[0]._native.drain_ssf_fallback():
             self.handle_trace_packet(pkt)
 
     # -- SSF ingest ---------------------------------------------------------
@@ -1449,6 +1450,7 @@ class Server:
                 # warmed shapes differ from the first real flush's
                 initial_histo_rows=self.config.tpu_initial_histo_rows,
                 is_local=self.is_local,
+                series_shards=self.config.series_shards,
             )
             w.process_metric(
                 dogstatsd.parse_metric(b"veneur.warmup:1|ms"))

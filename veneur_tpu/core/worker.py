@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veneur_tpu.core import columnar
 from veneur_tpu.core.directory import ScopeClass, SeriesDirectory, classify
 from veneur_tpu.core.metrics import (DEFAULT_TENANT, MetricKey, UDPMetric,
                                      route_info, tenant_of)
@@ -50,6 +51,7 @@ from veneur_tpu.core.tenancy import TenantTallies
 from veneur_tpu.health.ledger import TransferLedger
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import microfold as mf
+from veneur_tpu.ops import series_shard as ss
 from veneur_tpu.ops import tdigest as td
 from veneur_tpu.ops.scalars import counter_contribution
 from veneur_tpu.utils.hashing import hll_hash, fmix64, metric_digest
@@ -481,25 +483,43 @@ class HistoDeviceState:
     def num_rows(self) -> int:
         return self.means.shape[0]
 
-    def grow(self, new_rows: int) -> "HistoDeviceState":
+    def fields(self) -> tuple:
+        """The 14 device arrays in the kernel argument order."""
+        return (self.means, self.weights, self.dmin, self.dmax,
+                self.drecip, self.drecip_c, self.lmin, self.lmax,
+                self.lsum, self.lsum_c, self.lweight, self.lweight_c,
+                self.lrecip, self.lrecip_c)
+
+    def placed(self, shard) -> "HistoDeviceState":
+        """Commit every pool array to a SeriesSharding's mesh (fresh
+        pools are all-constant, so the initial resharding copy is the
+        only cross-device move the sharded pool ever makes)."""
+        return HistoDeviceState(*(shard.place(a) for a in self.fields()))
+
+    def grow(self, new_rows: int, shard=None) -> "HistoDeviceState":
         # zero-filled new mean rows are safe: every kernel keys empty slots
-        # off weight==0, never the stored mean
+        # off weight==0, never the stored mean. Sharded pools pad each
+        # shard's local block instead of appending at the end, which keeps
+        # every existing logical row on its shard at its local index
+        # (ops/series_shard.grow_2d) — growth moves no data between devices.
         inf = float("inf")
+        g2 = _grow_2d if shard is None else shard.grow_2d
+        g1 = _grow_1d if shard is None else shard.grow_1d
         return HistoDeviceState(
-            means=_grow_2d(self.means, new_rows),
-            weights=_grow_2d(self.weights, new_rows),
-            dmin=_grow_1d(self.dmin, new_rows, inf),
-            dmax=_grow_1d(self.dmax, new_rows, -inf),
-            drecip=_grow_1d(self.drecip, new_rows, 0.0),
-            drecip_c=_grow_1d(self.drecip_c, new_rows, 0.0),
-            lmin=_grow_1d(self.lmin, new_rows, inf),
-            lmax=_grow_1d(self.lmax, new_rows, -inf),
-            lsum=_grow_1d(self.lsum, new_rows, 0.0),
-            lsum_c=_grow_1d(self.lsum_c, new_rows, 0.0),
-            lweight=_grow_1d(self.lweight, new_rows, 0.0),
-            lweight_c=_grow_1d(self.lweight_c, new_rows, 0.0),
-            lrecip=_grow_1d(self.lrecip, new_rows, 0.0),
-            lrecip_c=_grow_1d(self.lrecip_c, new_rows, 0.0),
+            means=g2(self.means, new_rows),
+            weights=g2(self.weights, new_rows),
+            dmin=g1(self.dmin, new_rows, inf),
+            dmax=g1(self.dmax, new_rows, -inf),
+            drecip=g1(self.drecip, new_rows, 0.0),
+            drecip_c=g1(self.drecip_c, new_rows, 0.0),
+            lmin=g1(self.lmin, new_rows, inf),
+            lmax=g1(self.lmax, new_rows, -inf),
+            lsum=g1(self.lsum, new_rows, 0.0),
+            lsum_c=g1(self.lsum_c, new_rows, 0.0),
+            lweight=g1(self.lweight, new_rows, 0.0),
+            lweight_c=g1(self.lweight_c, new_rows, 0.0),
+            lrecip=g1(self.lrecip, new_rows, 0.0),
+            lrecip_c=g1(self.lrecip_c, new_rows, 0.0),
         )
 
 
@@ -603,6 +623,7 @@ class DeviceWorker:
         micro_fold: bool = False,
         micro_fold_rows: int = 8192,
         micro_fold_max_age_s: float = 0.25,
+        series_shards: int = 0,
     ) -> None:
         self.batch_size = batch_size
         # native pending-batch bound; beyond it samples shed, counted in
@@ -624,6 +645,28 @@ class DeviceWorker:
             self._set_hash64 = hll_hash
         self._initial_histo_rows = initial_histo_rows
         self._initial_set_rows = initial_set_rows
+        # device-sharded series axis (ops/series_shard.py): partition the
+        # sketch pools over a 1-D device mesh. Resolved through the
+        # VENEUR_SERIES_SHARDS escape hatch; an unusable request (not a
+        # pow2, more shards than devices) degrades to the legacy
+        # single-device path with a warning rather than failing ingest.
+        shards = ss.resolve_series_shards(series_shards)
+        self._shard: Optional[ss.SeriesSharding] = None
+        if shards > 1:
+            if ss.shards_usable(shards):
+                self._shard = ss.SeriesSharding(shards, compression)
+                # pool row counts must stay pow2 multiples of the shard
+                # count so every growth/slice divides evenly
+                self._initial_histo_rows = _next_pow2(
+                    max(initial_histo_rows, shards))
+                self._initial_set_rows = _next_pow2(
+                    max(initial_set_rows, shards))
+            else:
+                log.warning(
+                    "series_shards=%d unusable (need a power of two <= "
+                    "visible device count); using the single-device pool",
+                    shards)
+        self.series_shards = self._shard.shards if self._shard else 1
         self.count_unique_timeseries = count_unique_timeseries
         self.is_local = is_local
         self.set_store = set_store
@@ -697,6 +740,15 @@ class DeviceWorker:
         Intended for the global tier (config tpu_mesh_devices); local
         scalar aggregates (.min/.max of mixed-scope rows emitted by
         locals) are not tracked on the mesh path."""
+        if self._shard is not None:
+            # the mesh pool owns its own device layout; routing rows into
+            # BOTH layouts would split series state. Config validation
+            # rejects the combination up front; this guard covers direct
+            # construction (tools/tests).
+            log.warning("series sharding disabled: mesh pool attached "
+                        "(tpu_mesh_devices and series_shards are exclusive)")
+            self._shard = None
+            self.series_shards = 1
         self._mesh_pool = pool
         if self._native is not None:
             # staging would divert samples from the mesh pool: mesh rows
@@ -988,7 +1040,8 @@ class DeviceWorker:
         if self._micro is None:
             self._micro = mf.MicroFoldMirror(
                 self.stage_depth, ledger=self.ledger,
-                initial_rows=self._initial_histo_rows)
+                initial_rows=self._initial_histo_rows,
+                shard=self._shard)
         return self._micro
 
     def micro_fold_pending(self) -> int:
@@ -1140,7 +1193,8 @@ class DeviceWorker:
         if self.set_store == "staged":
             from veneur_tpu.ops.staged_sets import StagedSetStore
 
-            self._staged_sets = StagedSetStore(self.hll_precision)
+            self._staged_sets = StagedSetStore(self.hll_precision,
+                                               shard=self._shard)
         else:
             self._staged_sets = None
         # host raw-sample staging planes (see _device_histo_step); created
@@ -1171,13 +1225,18 @@ class DeviceWorker:
 
     def _ensure_histo(self, needed_rows: int) -> None:
         # keep one scratch row free at the top for gather/scatter padding
+        # (under sharding the scratch row — logical S-1 — maps to physical
+        # S-1, shard D-1's last local row, so sizing is shard-oblivious)
         if self._histo is None:
             rows = _next_pow2(needed_rows + 1, self._initial_histo_rows)
-            self._histo = HistoDeviceState.create(rows, self.capacity)
+            st = HistoDeviceState.create(rows, self.capacity)
+            self._histo = (st if self._shard is None
+                           else st.placed(self._shard))
         elif needed_rows + 1 > self._histo.num_rows:
             self._flush_pending_histos()  # pending lids reference old layout
             self._histo = self._histo.grow(
-                _next_pow2(needed_rows + 1, self._histo.num_rows * 2)
+                _next_pow2(needed_rows + 1, self._histo.num_rows * 2),
+                shard=self._shard,
             )
 
     def _ensure_sets(self, needed_rows: int) -> None:
@@ -1185,12 +1244,15 @@ class DeviceWorker:
             return  # the staged store sizes itself
         if self._sets is None:
             rows = _next_pow2(needed_rows + 1, self._initial_set_rows)
-            self._sets = hll_ops.init_pool(rows, self.hll_precision)
+            pool = hll_ops.init_pool(rows, self.hll_precision)
+            self._sets = (pool if self._shard is None
+                          else self._shard.place(pool))
         elif needed_rows + 1 > self._sets.shape[0]:
             self._flush_pending_sets()
-            self._sets = _grow_2d(
-                self._sets, _next_pow2(needed_rows + 1, self._sets.shape[0] * 2)
-            )
+            new_rows = _next_pow2(needed_rows + 1, self._sets.shape[0] * 2)
+            self._sets = (_grow_2d(self._sets, new_rows)
+                          if self._shard is None
+                          else self._shard.grow_2d(self._sets, new_rows))
 
     # -- ingest -------------------------------------------------------------
 
@@ -1470,13 +1532,24 @@ class DeviceWorker:
         active, lids, v, w = self._pad_spill_batch(
             rows, vals, wts, h.num_rows - 1)
 
-        out = _histo_ingest_step(
-            h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
-            h.lmin, h.lmax, h.lsum, h.lsum_c, h.lweight, h.lweight_c,
-            h.lrecip, h.lrecip_c,
-            jnp.asarray(active), jnp.asarray(lids), jnp.asarray(v),
-            jnp.asarray(w), compression=self.compression,
-        )
+        sh = self._shard
+        if sh is not None:
+            # replicated COO, physical `active`: every shard folds the
+            # bit-identical batch and keeps only the writes it owns
+            # (ops/series_shard.ingest_step — the OOB-foreign remap)
+            out = sh.ingest_step(
+                *h.fields(),
+                sh.replicate(sh.phys_rows(active, h.num_rows)),
+                sh.replicate(lids), sh.replicate(v), sh.replicate(w),
+            )
+        else:
+            out = _histo_ingest_step(
+                h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
+                h.lmin, h.lmax, h.lsum, h.lsum_c, h.lweight, h.lweight_c,
+                h.lrecip, h.lrecip_c,
+                jnp.asarray(active), jnp.asarray(lids), jnp.asarray(v),
+                jnp.asarray(w), compression=self.compression,
+            )
         (h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
          h.lmin, h.lmax, h.lsum, h.lsum_c, h.lweight, h.lweight_c,
          h.lrecip, h.lrecip_c) = out
@@ -1506,6 +1579,18 @@ class DeviceWorker:
         active, lids, v, w = self._pad_spill_batch(
             rows, vals, wts, pool_rows - 1)
         led = self.ledger
+        sh = self._shard
+        if sh is not None:
+            # replication is a real per-device transfer: book the batch
+            # once per shard (the transfer-diet pin stays honest), then
+            # fold it everywhere with the OOB-foreign remap
+            d = sh.shards
+            act = sh.phys_rows(active, pool_rows)
+            ups = []
+            for a in (act, lids, v, w):
+                led.count_h2d_shards([a.nbytes] * d, "spill")
+                ups.append(sh.replicate(a))
+            return sh.ingest_step(*fields, *ups)
         return _histo_ingest_step(
             *fields,
             led.h2d(active, "spill"), led.h2d(lids, "spill"),
@@ -1537,6 +1622,15 @@ class DeviceWorker:
         pidx[: len(rows)] = idx
         prank = np.zeros(n, dtype=np.int8)
         prank[: len(rows)] = rank
+        sh = self._shard
+        if sh is not None:
+            # int8 scatter-max is order- and placement-independent, so the
+            # sharded insert is bit-identical by construction; padding rows
+            # (scratch, rank 0) stay a no-op max on their owner
+            self._sets = sh.hll_insert(
+                regs, sh.replicate(sh.phys_rows(prow, regs.shape[0])),
+                sh.replicate(pidx), sh.replicate(prank))
+            return
         self._sets = hll_ops.insert_batch(
             regs, jnp.asarray(prow), jnp.asarray(pidx), jnp.asarray(prank)
         )
@@ -1698,13 +1792,25 @@ class DeviceWorker:
                     imp_max[i] = max(imp_max[i], mx)
                     imp_recip[i] += rc
             self._imp_digests = {}
-            out = _histo_import_step(
-                h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
-                jnp.asarray(arows), jnp.asarray(imp_means),
-                jnp.asarray(imp_w), jnp.asarray(imp_min),
-                jnp.asarray(imp_max), jnp.asarray(imp_recip),
-                compression=self.compression,
-            )
+            sh = self._shard
+            if sh is not None:
+                out = sh.import_step(
+                    h.means, h.weights, h.dmin, h.dmax, h.drecip,
+                    h.drecip_c,
+                    sh.replicate(sh.phys_rows(arows, h.num_rows)),
+                    sh.replicate(imp_means), sh.replicate(imp_w),
+                    sh.replicate(imp_min), sh.replicate(imp_max),
+                    sh.replicate(imp_recip),
+                )
+            else:
+                out = _histo_import_step(
+                    h.means, h.weights, h.dmin, h.dmax, h.drecip,
+                    h.drecip_c,
+                    jnp.asarray(arows), jnp.asarray(imp_means),
+                    jnp.asarray(imp_w), jnp.asarray(imp_min),
+                    jnp.asarray(imp_max), jnp.asarray(imp_recip),
+                    compression=self.compression,
+                )
             (h.means, h.weights, h.dmin, h.dmax, h.drecip,
              h.drecip_c) = out
 
@@ -1716,9 +1822,15 @@ class DeviceWorker:
             arows = np.asarray(rows, dtype=np.int32)
             imp = np.stack([self._imp_hll[r] for r in rows])
             self._imp_hll = {}
-            self._sets = regs.at[jnp.asarray(arows)].max(
-                jnp.asarray(imp), mode="drop"
-            )
+            sh = self._shard
+            if sh is not None:
+                self._sets = sh.hll_max_rows(
+                    regs, sh.replicate(sh.phys_rows(arows, regs.shape[0])),
+                    sh.replicate(imp))
+            else:
+                self._sets = regs.at[jnp.asarray(arows)].max(
+                    jnp.asarray(imp), mode="drop"
+                )
 
     _pallas_ok: Optional[bool] = None
     # process-lifetime count of Pallas->XLA demotions, surfaced in the
@@ -1947,7 +2059,8 @@ class DeviceWorker:
                 if mirror is None:
                     mirror = mf.MicroFoldMirror(
                         self.stage_depth, ledger=self.ledger,
-                        initial_rows=self._initial_histo_rows)
+                        initial_rows=self._initial_histo_rows,
+                        shard=self._shard)
                 mirror.book_in_flush = True
                 micro_residual = (mirror, micro_coo)
                 micro_samples = mirror.samples + residual_n
@@ -2042,46 +2155,127 @@ class DeviceWorker:
                 # the native plane grows by its own pow2 schedule and
                 # can trail the pool's; rows past its end are empty
                 counts_np = np.pad(counts_np, (0, s_eff - rows_avail))
-            n_pad = _next_pow2(max(len(flat_v), 1), 1024)
-            fv = np.zeros(n_pad, np.float32)
-            fv[:len(flat_v)] = flat_v
-            # fv/fw/counts_np are Python-owned copies (fancy indexing /
-            # np.minimum / np.pad) — nothing below aliases the C++
-            # plane, so free() needs no upload synchronization. The
-            # ledger pins these uploads at O(samples) + O(rows) bytes:
-            # the whole point of the compaction, and what the
-            # test_health_ledger regression test asserts
-            fvj = self.ledger.h2d(fv, "staged_flat")
-            cj = self.ledger.h2d(counts_np, "staged_counts")
             unit = plane.wts is None
-            if unit:
-                fwj = fvj  # ignored under unit=True (XLA DCEs it)
+            sh = self._shard
+            if sh is not None:
+                flat_w = (None if unit
+                          else plane.wts[:rows_avail][mask])
+                fvj, fwj, cj = self._shard_flat_upload(
+                    flat_v, flat_w, counts_np, s_eff)
+                if unit:
+                    fwj = fvj  # ignored under unit=True (XLA DCEs it)
+                plane.free()
+                pending[0] = plane._replace(free=None)
+                svj, swj = sh.expand_flat(fvj, fwj, cj, B, unit)
             else:
-                flat_w = plane.wts[:rows_avail][mask]
-                fw = np.zeros(n_pad, np.float32)
-                fw[:len(flat_w)] = flat_w
-                fwj = self.ledger.h2d(fw, "staged_flat")
-            plane.free()
-            # freed: the caller's cleanup must not free it again
-            pending[0] = plane._replace(free=None)
-            svj, swj = _expand_flat_planes(fvj, fwj, cj, B, unit)
+                n_pad = _next_pow2(max(len(flat_v), 1), 1024)
+                fv = np.zeros(n_pad, np.float32)
+                fv[:len(flat_v)] = flat_v
+                # fv/fw/counts_np are Python-owned copies (fancy indexing /
+                # np.minimum / np.pad) — nothing below aliases the C++
+                # plane, so free() needs no upload synchronization. The
+                # ledger pins these uploads at O(samples) + O(rows) bytes:
+                # the whole point of the compaction, and what the
+                # test_health_ledger regression test asserts
+                fvj = self.ledger.h2d(fv, "staged_flat")
+                cj = self.ledger.h2d(counts_np, "staged_counts")
+                if unit:
+                    fwj = fvj  # ignored under unit=True (XLA DCEs it)
+                else:
+                    flat_w = plane.wts[:rows_avail][mask]
+                    fw = np.zeros(n_pad, np.float32)
+                    fw[:len(flat_w)] = flat_w
+                    fwj = self.ledger.h2d(fw, "staged_flat")
+                plane.free()
+                # freed: the caller's cleanup must not free it again
+                pending[0] = plane._replace(free=None)
+                svj, swj = _expand_flat_planes(fvj, fwj, cj, B, unit)
         else:
             # Python-owned plane: the dense upload IS O(rows x depth) —
             # acceptable only because this path serves small non-native
             # deployments; the ledger keeps it visible ("staged_dense"
             # stays zero whenever native staging is attached)
-            svj = self.ledger.h2d(plane.vals[:s_eff], "staged_dense")
-            swj = self.ledger.h2d(plane.wts[:s_eff], "staged_dense")
-            if svj.shape[0] < s_eff:
-                pad = s_eff - svj.shape[0]
-                svj = jnp.concatenate(
-                    [svj, jnp.zeros((pad, svj.shape[1]), jnp.float32)])
-                swj = jnp.concatenate(
-                    [swj, jnp.zeros((pad, swj.shape[1]), jnp.float32)])
-        fields = _histo_fold_staged(
-            *fields, svj, swj, compression=self.compression)
+            sh = self._shard
+            if sh is not None:
+                sv = np.asarray(plane.vals[:s_eff], np.float32)
+                sw = np.asarray(plane.wts[:s_eff], np.float32)
+                if sv.shape[0] < s_eff:
+                    pad = s_eff - sv.shape[0]
+                    sv = np.pad(sv, ((0, pad), (0, 0)))
+                    sw = np.pad(sw, ((0, pad), (0, 0)))
+                # host-permute to the physical interleave, then one
+                # partitioned placement per plane
+                p2l = sh.perm_p2l(s_eff)
+                d = sh.shards
+                self.ledger.count_h2d_shards(
+                    [sv.nbytes // d] * d, "staged_dense")
+                self.ledger.count_h2d_shards(
+                    [sw.nbytes // d] * d, "staged_dense")
+                svj = sh.place(sv[p2l])
+                swj = sh.place(sw[p2l])
+            else:
+                svj = self.ledger.h2d(plane.vals[:s_eff], "staged_dense")
+                swj = self.ledger.h2d(plane.wts[:s_eff], "staged_dense")
+                if svj.shape[0] < s_eff:
+                    pad = s_eff - svj.shape[0]
+                    svj = jnp.concatenate(
+                        [svj, jnp.zeros((pad, svj.shape[1]), jnp.float32)])
+                    swj = jnp.concatenate(
+                        [swj, jnp.zeros((pad, swj.shape[1]), jnp.float32)])
+        if self._shard is not None:
+            fields = self._shard.fold_staged(*fields, svj, swj)
+        else:
+            fields = _histo_fold_staged(
+                *fields, svj, swj, compression=self.compression)
         pending.pop(0)
         return fields
+
+    def _shard_flat_upload(self, flat_v, flat_w, counts_np, s_eff: int):
+        """Split one compacted staged plane (flat samples in LOGICAL row
+        order + per-row counts) into per-shard segments for the sharded
+        expand (ops/series_shard.expand_flat).
+
+        Each shard's segment concatenates its local rows' samples in
+        local order (= the physical counts order), padded to a common
+        pow2 length: a [D, Lmax] upload that stays O(samples/shard) per
+        device, against the [s_eff] counts in physical order. Returns
+        the placed (flat_v, flat_w_or_None, counts) device arrays with
+        per-shard ledger bookings."""
+        sh = self._shard
+        d = sh.shards
+        p2l = sh.perm_p2l(s_eff)
+        counts64 = counts_np.astype(np.int64)
+        # logical sample offsets per row, then gathered per-shard-major
+        off = np.zeros(s_eff, np.int64)
+        np.cumsum(counts64[:-1], out=off[1:])
+        reps = counts64[p2l]
+        total = int(reps.sum())
+        run_starts = np.cumsum(reps) - reps
+        gidx = (np.repeat(off[p2l], reps)
+                + np.arange(total, dtype=np.int64)
+                - np.repeat(run_starts, reps))
+        seg_len = reps.reshape(d, -1).sum(axis=1)
+        lmax = _next_pow2(int(seg_len.max()) if total else 1, 1024)
+        seg_off = np.cumsum(seg_len) - seg_len
+        col = (np.arange(total, dtype=np.int64)
+               - np.repeat(seg_off, seg_len))
+        srd = np.repeat(np.arange(d), seg_len)
+        led = self.ledger
+        fv2 = np.zeros((d, lmax), np.float32)
+        fv2[srd, col] = flat_v[gidx]
+        led.count_h2d_shards([lmax * 4] * d, "staged_flat")
+        fvj = sh.place(fv2)
+        counts_phys = counts_np[p2l].astype(np.int32)
+        led.count_h2d_shards(
+            [counts_phys.nbytes // d] * d, "staged_counts")
+        cj = sh.place(counts_phys)
+        fwj = None
+        if flat_w is not None:
+            fw2 = np.zeros((d, lmax), np.float32)
+            fw2[srd, col] = flat_w[gidx]
+            led.count_h2d_shards([lmax * 4] * d, "staged_flat")
+            fwj = sh.place(fw2)
+        return fvj, fwj, cj
 
     def extract_snapshot(self, swapped: "SwappedEpoch",
                          quantiles: np.ndarray,
@@ -2161,8 +2355,16 @@ class DeviceWorker:
                     rate = len(sp_rows) / t_fold
                     self._fold_rate_ewma = (
                         0.5 * self._fold_rate_ewma + 0.5 * rate)
-            fields = tuple(
-                a if a.shape[0] == s_eff else a[:s_eff] for a in full)
+            sh = self._shard
+            if sh is None:
+                fields = tuple(
+                    a if a.shape[0] == s_eff else a[:s_eff] for a in full)
+            else:
+                # sharded shrink: each shard keeps its local prefix (the
+                # interleave closure property) — no resharding
+                fields = tuple(
+                    a if a.shape[0] == s_eff else sh.slice_field(a, s_eff)
+                    for a in full)
             pending = list(swapped.staged_histo or ())
             swapped.staged_histo = None
             try:
@@ -2199,27 +2401,47 @@ class DeviceWorker:
                 # Python upload would have built (values and weights at
                 # the same absolute slots, zeros elsewhere), which is
                 # what pins micro-folded == batch-folded
-                fields = _histo_fold_staged(
+                dense = (mf.mirror_dense if sh is None
+                         else sh.mirror_dense)
+                folder = (sh.fold_staged if sh is not None
+                          else functools.partial(
+                              _histo_fold_staged,
+                              compression=self.compression))
+                fields = folder(
                     *fields,
-                    mf.mirror_dense(dstage.vals, s_eff),
-                    mf.mirror_dense(dstage.wts, s_eff),
-                    compression=self.compression)
+                    dense(dstage.vals, s_eff),
+                    dense(dstage.wts, s_eff))
                 if gov is not None:
                     gov.beat()
-            qs = self.ledger.h2d(
-                np.asarray(quantiles, dtype=np.float32), "quantiles")
-            run = (gov.begin_extract(s_eff)
+            qnp = np.asarray(quantiles, dtype=np.float32)
+            if sh is None:
+                qs = self.ledger.h2d(qnp, "quantiles")
+            else:
+                qs = self.ledger.h2d(qnp, "quantiles",
+                                     replicas=sh.shards, put=sh.replicate)
+            run = (gov.begin_extract(s_eff, sh.shards if sh else 1)
                    if gov is not None and gov.enabled else None)
             if run is None:
-                out = self._extract(fields, qs)
-                # ONE device→host transfer for the whole extraction:
-                # eleven per-array np.asarray calls are eleven
-                # synchronous D2H round-trips, and on a link with
-                # per-transfer latency (the tunnelled relay; any
-                # remote-device setup) the round-trips dominate the
-                # bytes at 1M rows
-                packed = self.ledger.d2h(
-                    _pack_extract_columns(*out), "extract_packed")
+                if sh is not None:
+                    # sharded extract bypasses the Pallas single-device
+                    # kernel: the GSPMD XLA program runs shard-local and
+                    # the one packed readback assembles all shards
+                    out = sh.flush_extract(*fields, qs)
+                    packed = np.asarray(_pack_extract_columns(*out))
+                    self.ledger.count_d2h_shards(
+                        [packed.nbytes // sh.shards] * sh.shards,
+                        "extract_packed")
+                    packed = packed[sh.perm_l2p(s_eff)]
+                else:
+                    out = self._extract(fields, qs)
+                    # ONE device→host transfer for the whole extraction:
+                    # eleven per-array np.asarray calls are eleven
+                    # synchronous D2H round-trips, and on a link with
+                    # per-transfer latency (the tunnelled relay; any
+                    # remote-device setup) the round-trips dominate the
+                    # bytes at 1M rows
+                    packed = self.ledger.d2h(
+                        _pack_extract_columns(*out), "extract_packed")
                 p = out[0].shape[1]
             else:
                 # governed degraded mode: extract in row chunks sized to
@@ -2233,19 +2455,34 @@ class DeviceWorker:
                 p = 0
                 while (c := run.next_rows()):
                     t0 = time.perf_counter()
-                    sub = tuple(
-                        jax.lax.dynamic_slice_in_dim(a, run.start, c, 0)
-                        for a in fields)
-                    out = self._extract(sub, qs)
-                    parts.append(self.ledger.d2h(
-                        _pack_extract_columns(*out), "extract_packed"))
+                    if sh is not None:
+                        # lockstep per-shard slice: a c-row chunk at a
+                        # D-aligned start is rows [start/D, start/D+c/D)
+                        # on every shard; the per-chunk inverse perm
+                        # restores logical order, so the concat below is
+                        # already logical end to end
+                        sub = tuple(sh.slice_chunk(a, run.start, c)
+                                    for a in fields)
+                        out = sh.flush_extract(*sub, qs)
+                        pk = np.asarray(_pack_extract_columns(*out))
+                        self.ledger.count_d2h_shards(
+                            [pk.nbytes // sh.shards] * sh.shards,
+                            "extract_packed")
+                        parts.append(pk[sh.chunk_perm(c)])
+                    else:
+                        sub = tuple(
+                            jax.lax.dynamic_slice_in_dim(a, run.start, c, 0)
+                            for a in fields)
+                        out = self._extract(sub, qs)
+                        parts.append(self.ledger.d2h(
+                            _pack_extract_columns(*out), "extract_packed"))
                     p = out[0].shape[1]
                     run.note(c, time.perf_counter() - t0)
                 packed = (parts[0] if len(parts) == 1
                           else np.concatenate(parts, axis=0))
-            qv = packed[:, :p]
-            (dmin, dmax, dsum, dcount, drecip, lmin, lmax, lsum, lweight,
-             lrecip) = (packed[:, p + i] for i in range(10))
+            qv, (dmin, dmax, dsum, dcount, drecip, lmin, lmax, lsum,
+                 lweight, lrecip) = columnar.unpack_extract_columns(
+                     packed, p)
             snap.quantile_values = qv[:n]
             snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
             snap.dmin, snap.dmax = dmin[:n], dmax[:n]
@@ -2276,10 +2513,20 @@ class DeviceWorker:
             # extract phase. Consumers (codec.py, flusher.forward
             # iterator) already handle digest_means is None.
             if self.is_local:
-                snap.digest_means = self.ledger.d2h(
-                    fields[0], "forward_digests")[:n]
-                snap.digest_weights = self.ledger.d2h(
-                    fields[1], "forward_digests")[:n]
+                if sh is not None:
+                    l2p = sh.perm_l2p(s_eff)[:n]
+                    dm = np.asarray(fields[0])
+                    dw = np.asarray(fields[1])
+                    self.ledger.count_d2h_shards(
+                        [(dm.nbytes + dw.nbytes) // sh.shards] * sh.shards,
+                        "forward_digests")
+                    snap.digest_means = dm[l2p]
+                    snap.digest_weights = dw[l2p]
+                else:
+                    snap.digest_means = self.ledger.d2h(
+                        fields[0], "forward_digests")[:n]
+                    snap.digest_weights = self.ledger.d2h(
+                        fields[1], "forward_digests")[:n]
         elif spill is not None and len(spill[0]):
             # deferred spill with nowhere to fold (ADVICE item 2): the
             # samples are lost either way, but lost-and-counted — the
@@ -2325,10 +2572,16 @@ class DeviceWorker:
                 snap.set_registers = staged_sets.registers(n)
         elif sets is not None and directory.num_set_rows:
             n = directory.num_set_rows
-            snap.set_estimates = np.asarray(
-                hll_ops.estimate(sets, self.hll_precision)
-            )[:n]
-            snap.set_registers = np.asarray(sets)[:n]
+            if self._shard is not None:
+                l2p = self._shard.perm_l2p(sets.shape[0])[:n]
+                snap.set_estimates = np.asarray(self._shard.hll_estimate(
+                    sets, self.hll_precision))[l2p]
+                snap.set_registers = np.asarray(sets)[l2p]
+            else:
+                snap.set_estimates = np.asarray(
+                    hll_ops.estimate(sets, self.hll_precision)
+                )[:n]
+                snap.set_registers = np.asarray(sets)[:n]
         return snap
 
     def flush(self, quantiles: np.ndarray, interval_s: float = 10.0

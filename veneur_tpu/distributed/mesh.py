@@ -67,6 +67,20 @@ def make_mesh(n_devices: Optional[int] = None, hosts: Optional[int] = None
     return Mesh(arr, ("hosts", "series"))
 
 
+def make_series_mesh(shards: int) -> Mesh:
+    """1-D mesh over the first `shards` devices for the within-host
+    series-axis split (ops/series_shard.py). Named "series" so it
+    composes with make_mesh's (hosts, series) convention: the global
+    tier reduces over "hosts", the local pools partition over
+    "series" — the same axis name means the same ownership rule
+    (row -> shard by r % D) at both tiers."""
+    devs = jax.devices()
+    if shards > len(devs):
+        raise ValueError(
+            f"series_shards={shards} exceeds {len(devs)} visible devices")
+    return Mesh(np.array(devs[:shards]), ("series",))
+
+
 def _local_aggregate_step(means, weights, dmin, dmax, drecip,
                           rows, values, wts, qs, compression):
     """Per-device block: ingest this host-shard's batch into its series

@@ -59,13 +59,21 @@ class ChunkRun:
     """
 
     def __init__(self, governor: "FlushDeadlineGovernor",
-                 total_rows: int) -> None:
+                 total_rows: int, shards: int = 1) -> None:
         self._gov = governor
         self.total = int(total_rows)
         self.start = 0
         self.chunks = 0
+        # series-sharded pools (ops/series_shard.py): every chunk is a
+        # LOCKSTEP slice — a c-row chunk is c/shards rows on each shard,
+        # so sizing each chunk sizes every shard's slice independently
+        # of the others' row counts. The floor rises to the shard count
+        # so chunk sizes stay divisible (both are pow2; shards <= 1024
+        # == MIN_CHUNK_ROWS is enforced at config validation).
+        self.shards = max(1, int(shards))
+        self._floor = max(MIN_CHUNK_ROWS, self.shards)
         pow2 = self.total > 0 and (self.total & (self.total - 1)) == 0
-        if not pow2 or self.total <= MIN_CHUNK_ROWS:
+        if not pow2 or self.total <= self._floor:
             self._next = self.total
         else:
             self._next = governor._initial_chunk(self.total)
@@ -82,7 +90,7 @@ class ChunkRun:
         rate (the per-chunk deadline check)."""
         self.start += rows
         self.chunks += 1
-        self._gov._note_chunk(rows, dt_s)
+        self._gov._note_chunk(rows, dt_s, self.shards)
         remaining = self.total - self.start
         if remaining <= 0:
             return
@@ -95,7 +103,7 @@ class ChunkRun:
             if nxt <= remaining and remaining % nxt == 0:
                 self._next = nxt
         elif want < cur:
-            self._next = max(MIN_CHUNK_ROWS, cur // 2)
+            self._next = max(self._floor, cur // 2)
         if self._next > remaining:
             # remaining is a multiple of the previous size and smaller
             # than the doubled one, hence itself the previous pow2
@@ -129,6 +137,10 @@ class FlushDeadlineGovernor:
         # per-flush report (reset by begin_flush, read by telemetry)
         self._chunk_times: list[float] = []
         self._chunk_rows: list[int] = []
+        # shard count of the most recent chunked extraction (1 on the
+        # single-device path); surfaces per-shard chunk rows in the
+        # report so operators can see each shard's slice size
+        self._report_shards = 1
         # mid-interval micro-fold accounting (always-hot flush): each
         # drain beats the progress clock — micro-folds ARE flush-path
         # liveness — and tallies here for telemetry/benches
@@ -234,10 +246,11 @@ class FlushDeadlineGovernor:
             times = list(self._chunk_times)
             rows = list(self._chunk_rows)
             micro = self._micro_folds_window
+            shards = self._report_shards
             self._micro_folds_window = 0
         if not times:
             return {"micro_folds": micro} if micro else {}
-        return {
+        report = {
             "chunks": len(times),
             "chunk_rows_max": max(rows),
             "chunk_max_s": max(times),
@@ -245,11 +258,15 @@ class FlushDeadlineGovernor:
             "chunk_target_ms": self.chunk_target_ms,
             "micro_folds": micro,
         }
+        if shards > 1:
+            report["series_shards"] = shards
+            report["chunk_rows_max_per_shard"] = max(rows) // shards
+        return report
 
     # -- extraction scheduling (called by workers) ------------------------
 
-    def begin_extract(self, total_rows: int) -> ChunkRun:
-        return ChunkRun(self, total_rows)
+    def begin_extract(self, total_rows: int, shards: int = 1) -> ChunkRun:
+        return ChunkRun(self, total_rows, shards)
 
     def _initial_chunk(self, total_rows: int) -> int:
         """First chunk of a flush: the rate-derived target size, or the
@@ -267,7 +284,7 @@ class FlushDeadlineGovernor:
         return max(MIN_CHUNK_ROWS,
                    min(_floor_pow2(max(want, 1.0)), _floor_pow2(limit_rows)))
 
-    def _note_chunk(self, rows: int, dt_s: float) -> None:
+    def _note_chunk(self, rows: int, dt_s: float, shards: int = 1) -> None:
         if dt_s > 1e-6:
             rate = rows / dt_s
             self._rate_ewma = (rate if self._rate_ewma is None
@@ -277,3 +294,4 @@ class FlushDeadlineGovernor:
             self._chunks_done += 1
             self._chunk_times.append(dt_s)
             self._chunk_rows.append(rows)
+            self._report_shards = max(1, int(shards))

@@ -58,22 +58,37 @@ class TransferLedger:
         # extractions interleave but stay 1:1 (only generate/emit shed).
         self._epoch_h2d: dict[str, int] = {}
         self._pending_epochs: list[dict[str, int]] = []
+        # per-SHARD byte breakdown for the current flush (series-sharded
+        # pools, ops/series_shard.py): index i = bytes that landed on /
+        # came from shard i. Empty on the single-device path. The chunk
+        # governor's per-shard sizing and the sharded transfer-diet test
+        # read these; kind tallies above stay the cross-shard totals.
+        self._flush_h2d_shards: list[int] = []
+        self._flush_d2h_shards: list[int] = []
 
     def begin_flush(self) -> None:
         with self._lock:
             self._flush_h2d = (
                 self._pending_epochs.pop(0) if self._pending_epochs else {})
             self._flush_d2h = {}
+            self._flush_h2d_shards = []
+            self._flush_d2h_shards = []
             self.flushes += 1
 
     # -- transfer wrappers ------------------------------------------------
 
-    def h2d(self, host_arr, kind: str):
-        """Count and perform one host->device upload."""
+    def h2d(self, host_arr, kind: str, replicas: int = 1, put=None):
+        """Count and perform one host->device upload. `replicas` > 1
+        books the bytes once per device for a replicated placement
+        (series-sharded COO batches, ops/series_shard.py): replication
+        is a real per-device transfer, and the O(samples) transfer-diet
+        pin must stay honest about the multiplier. `put` overrides the
+        placement (e.g. SeriesSharding.replicate / .place); default is
+        the process-default device."""
         import jax.numpy as jnp
 
-        self.count_h2d(host_arr.nbytes, kind)
-        return jnp.asarray(host_arr)
+        self.count_h2d(host_arr.nbytes * replicas, kind)
+        return jnp.asarray(host_arr) if put is None else put(host_arr)
 
     def d2h(self, dev_arr, kind: str) -> np.ndarray:
         """Count and perform one device->host readback."""
@@ -81,14 +96,15 @@ class TransferLedger:
         self.count_d2h(out.nbytes, kind)
         return out
 
-    def epoch_h2d(self, host_arr, kind: str):
+    def epoch_h2d(self, host_arr, kind: str, replicas: int = 1, put=None):
         """Count and perform one mid-epoch (micro-fold) upload. Bytes
         land in the epoch accumulator, not the open flush window — they
-        belong to the flush that will extract this epoch's state."""
+        belong to the flush that will extract this epoch's state.
+        `replicas`/`put` as in h2d (sharded micro-fold COO batches)."""
         import jax.numpy as jnp
 
-        self.count_epoch_h2d(host_arr.nbytes, kind)
-        return jnp.asarray(host_arr)
+        self.count_epoch_h2d(host_arr.nbytes * replicas, kind)
+        return jnp.asarray(host_arr) if put is None else put(host_arr)
 
     def count_epoch_h2d(self, nbytes: int, kind: str) -> None:
         with self._lock:
@@ -112,6 +128,43 @@ class TransferLedger:
         with self._lock:
             self._flush_d2h[kind] = self._flush_d2h.get(kind, 0) + int(nbytes)
             self.total_d2h_bytes += int(nbytes)
+
+    # -- per-shard accounting (series-sharded pools) ----------------------
+
+    def count_h2d_shards(self, per_shard, kind: str) -> None:
+        """Book one sharded upload: per_shard[i] bytes land on shard i
+        (a replicated batch books its nbytes once PER shard; a
+        partitioned plane books each shard's segment). The kind tally
+        gets the total; the breakdown feeds flush_h2d_per_shard()."""
+        per_shard = [int(b) for b in per_shard]
+        total = sum(per_shard)
+        with self._lock:
+            self._flush_h2d[kind] = self._flush_h2d.get(kind, 0) + total
+            self.total_h2d_bytes += total
+            self._acc_shards(self._flush_h2d_shards, per_shard)
+
+    def count_d2h_shards(self, per_shard, kind: str) -> None:
+        per_shard = [int(b) for b in per_shard]
+        total = sum(per_shard)
+        with self._lock:
+            self._flush_d2h[kind] = self._flush_d2h.get(kind, 0) + total
+            self.total_d2h_bytes += total
+            self._acc_shards(self._flush_d2h_shards, per_shard)
+
+    @staticmethod
+    def _acc_shards(acc: list, per_shard: list) -> None:
+        if len(acc) < len(per_shard):
+            acc.extend([0] * (len(per_shard) - len(acc)))
+        for i, b in enumerate(per_shard):
+            acc[i] += b
+
+    def flush_h2d_per_shard(self) -> list:
+        with self._lock:
+            return list(self._flush_h2d_shards)
+
+    def flush_d2h_per_shard(self) -> list:
+        with self._lock:
+            return list(self._flush_d2h_shards)
 
     # -- reads ------------------------------------------------------------
 

@@ -620,14 +620,20 @@ class NativeIngest:
         return out
 
     def _drain_buf(self) -> ctypes.Array:
-        """Persistent 1 MiB drain scratch: the native pump polls
+        """Per-thread 1 MiB drain scratch: the native pump polls
         drain_other/drain_ssf_fallback 10x/s per context, and a fresh
         zero-filled ctypes buffer per call was ~20 MiB/s of allocation
-        churn at idle. Callers run under the worker lock, which
-        serializes access."""
-        buf = getattr(self, "_drain_scratch", None)
+        churn at idle. Thread-local rather than lock-guarded: the C++
+        side already serializes each buffer cut on the ctx mutex, and a
+        Python lock here would invert against callers that drain while
+        HOLDING the ctx lock (the flush epoch close) versus callers that
+        take it inside the drain call (reader-thread event drains)."""
+        tl = getattr(self, "_drain_tl", None)
+        if tl is None:
+            tl = self._drain_tl = threading.local()
+        buf = getattr(tl, "buf", None)
         if buf is None:
-            buf = self._drain_scratch = ctypes.create_string_buffer(1 << 20)
+            buf = tl.buf = ctypes.create_string_buffer(1 << 20)
         return buf
 
     def drain_ssf_fallback(self) -> list[bytes]:

@@ -107,10 +107,17 @@ class MicroFoldMirror:
 
     def __init__(self, depth: int, ledger=None,
                  initial_rows: int = 1024,
-                 chunk: int = MICRO_CHUNK) -> None:
+                 chunk: int = MICRO_CHUNK, shard=None) -> None:
         self.depth = int(depth)
         self.chunk = int(chunk)
         self._ledger = ledger
+        # series-sharded mirror (ops/series_shard.SeriesSharding): the
+        # carry buffers keep LOGICAL rows — translation to physical slots
+        # happens at dispatch, against the mirror size current THEN, so
+        # growth between drains never strands a buffered row. Growth and
+        # the dense view go through the shard's per-local-block programs
+        # (append-at-end growth would break the interleave).
+        self._shard = shard
         # False while the epoch is live (uploads book into the ledger's
         # epoch accumulator, surfaced by the flush that extracts it);
         # the swap rotation flips it True so the deferred residual feeds
@@ -119,6 +126,13 @@ class MicroFoldMirror:
         # window directly.
         self.book_in_flush = False
         self._rows0 = max(1, int(initial_rows))
+        if shard is not None:
+            # mirror rows must stay pow2 multiples of the shard count so
+            # local blocks are equal-sized
+            r = 1
+            while r < max(self._rows0, shard.shards):
+                r *= 2
+            self._rows0 = r
         self._dvals: Optional[jax.Array] = None
         self._dwts: Optional[jax.Array] = None
         self._m = 0
@@ -185,16 +199,31 @@ class MicroFoldMirror:
     # -- internals --------------------------------------------------------
 
     def _dispatch(self) -> None:
-        # upload first (async) so it overlaps the in-flight scatter
+        sh = self._shard
+        # sharded: the physical-slot translation needs the mirror's
+        # CURRENT row count, so sizing runs before the upload; unsharded
+        # keeps the upload-first order (it overlaps the in-flight scatter)
+        if sh is not None:
+            self._ensure_rows(self.rows_hi)
+            rows_np = sh.phys_rows(self._c_rows, self._m)
+        else:
+            rows_np = self._c_rows
+        reps = sh.shards if sh is not None else 1
+        put = sh.replicate if sh is not None else None
         if self._ledger is not None:
             up = (self._ledger.h2d if self.book_in_flush
                   else self._ledger.epoch_h2d)
-            drows = up(self._c_rows, "micro_fold")
-            dslots = up(self._c_slots, "micro_fold")
-            dvals = up(self._c_vals, "micro_fold")
-            dwts = up(self._c_wts, "micro_fold")
+            drows = up(rows_np, "micro_fold", replicas=reps, put=put)
+            dslots = up(self._c_slots, "micro_fold", replicas=reps, put=put)
+            dvals = up(self._c_vals, "micro_fold", replicas=reps, put=put)
+            dwts = up(self._c_wts, "micro_fold", replicas=reps, put=put)
+        elif sh is not None:
+            drows = sh.replicate(rows_np)
+            dslots = sh.replicate(self._c_slots)
+            dvals = sh.replicate(self._c_vals)
+            dwts = sh.replicate(self._c_wts)
         else:
-            drows = jnp.asarray(self._c_rows)
+            drows = jnp.asarray(rows_np)
             dslots = jnp.asarray(self._c_slots)
             dvals = jnp.asarray(self._c_vals)
             dwts = jnp.asarray(self._c_wts)
@@ -204,7 +233,8 @@ class MicroFoldMirror:
         if self._unsynced > 2:
             jax.block_until_ready(self._dvals)
             self._unsynced = 1
-        self._dvals, self._dwts = _scatter_chunk(
+        scatter = _scatter_chunk if sh is None else sh.scatter_chunk
+        self._dvals, self._dwts = scatter(
             self._dvals, self._dwts, drows, dslots, dvals, dwts)
         self.chunks += 1
 
@@ -213,8 +243,13 @@ class MicroFoldMirror:
             m = self._rows0
             while m < needed:
                 m *= 2
-            self._dvals = jnp.zeros((m, self.depth), jnp.float32)
-            self._dwts = jnp.zeros((m, self.depth), jnp.float32)
+            dv = jnp.zeros((m, self.depth), jnp.float32)
+            dw = jnp.zeros((m, self.depth), jnp.float32)
+            if self._shard is not None:
+                dv = self._shard.place(dv)
+                dw = self._shard.place(dw)
+            self._dvals = dv
+            self._dwts = dw
             self._m = m
             return
         if needed <= self._m:
@@ -222,6 +257,10 @@ class MicroFoldMirror:
         m = self._m
         while m < needed:
             m *= 2
-        self._dvals = _grow_mirror(self._dvals, m)
-        self._dwts = _grow_mirror(self._dwts, m)
+        if self._shard is not None:
+            self._dvals = self._shard.grow_2d(self._dvals, m)
+            self._dwts = self._shard.grow_2d(self._dwts, m)
+        else:
+            self._dvals = _grow_mirror(self._dvals, m)
+            self._dwts = _grow_mirror(self._dwts, m)
         self._m = m

@@ -46,8 +46,14 @@ class StagedSetStore:
 
     def __init__(self, precision: int = hll_ops.DEFAULT_PRECISION,
                  promote_entries: Optional[int] = None,
-                 compact_every: int = 1 << 16) -> None:
+                 compact_every: int = 1 << 16, shard=None) -> None:
         self.precision = precision
+        # series-sharded dense tier (ops/series_shard.SeriesSharding):
+        # the [slots, m] register plane partitions over the shard mesh
+        # with the same row interleave as the sketch pools — slots are
+        # promotion-order, so the interleave spreads hot promoted rows
+        # round-robin. The sparse host tier is unaffected.
+        self._shard = shard
         self.m = hll_ops.num_registers(precision)
         self.promote_entries = promote_entries or max(self.m // 8, 64)
         self.compact_every = compact_every
@@ -114,14 +120,30 @@ class StagedSetStore:
         stacked = np.stack([self._imp_dense[r] for r in rows])
         self._imp_dense = {}
         assert self._dense is not None
-        self._dense = self._dense.at[jnp.asarray(slots)].max(
-            jnp.asarray(stacked))
+        sh = self._shard
+        if sh is not None:
+            self._dense = sh.hll_max_rows(
+                self._dense,
+                sh.replicate(sh.phys_rows(slots, self._dense.shape[0])),
+                sh.replicate(stacked))
+        else:
+            self._dense = self._dense.at[jnp.asarray(slots)].max(
+                jnp.asarray(stacked))
 
     # -- internals ----------------------------------------------------------
 
     def _dense_insert(self, slots: np.ndarray, idx: np.ndarray,
                       rank: np.ndarray) -> None:
         assert self._dense is not None
+        sh = self._shard
+        if sh is not None:
+            self._dense = sh.hll_insert(
+                self._dense,
+                sh.replicate(sh.phys_rows(slots.astype(np.int32),
+                                          self._dense.shape[0])),
+                sh.replicate(idx.astype(np.int32)),
+                sh.replicate(rank.astype(np.int8)))
+            return
         self._dense = hll_ops.insert_batch(
             self._dense, jnp.asarray(slots.astype(np.int32)),
             jnp.asarray(idx.astype(np.int32)),
@@ -153,10 +175,25 @@ class StagedSetStore:
         self._slot_lut[row] = slot
         if self._dense is None or slot >= self._dense.shape[0]:
             grown = max(16, (slot + 1) * 2)
-            fresh = jnp.zeros((grown, self.m), jnp.int8)
-            if self._dense is not None:
-                fresh = fresh.at[:self._dense.shape[0]].set(self._dense)
-            self._dense = fresh
+            sh = self._shard
+            if sh is not None:
+                # pow2 multiple of the shard count so the slot-axis
+                # interleave stays divisible; per-shard local pad keeps
+                # every promoted slot on its shard across growth
+                g = sh.shards
+                while g < grown:
+                    g *= 2
+                grown = g
+                if self._dense is None:
+                    self._dense = sh.place(
+                        jnp.zeros((grown, self.m), jnp.int8))
+                else:
+                    self._dense = sh.grow_2d(self._dense, grown)
+            else:
+                fresh = jnp.zeros((grown, self.m), jnp.int8)
+                if self._dense is not None:
+                    fresh = fresh.at[:self._dense.shape[0]].set(self._dense)
+                self._dense = fresh
         mask = (self._ckeys // self.m) == row
         if mask.any():
             idx = (self._ckeys[mask] % self.m).astype(np.int32)
@@ -219,8 +256,14 @@ class StagedSetStore:
             else:
                 out[r] = raw
         if self._slot_of_row and self._dense is not None:
-            dense_est = np.asarray(hll_ops.estimate(
-                self._dense, self.precision))
+            if self._shard is not None:
+                sh = self._shard
+                dense_est = np.asarray(sh.hll_estimate(
+                    self._dense, self.precision
+                ))[sh.perm_l2p(self._dense.shape[0])]
+            else:
+                dense_est = np.asarray(hll_ops.estimate(
+                    self._dense, self.precision))
             for r, s in self._slot_of_row.items():
                 if r < num_rows:
                     out[r] = dense_est[s]
@@ -239,6 +282,9 @@ class StagedSetStore:
         out[rows[mask], idx[mask]] = self._crank[mask]
         if self._slot_of_row and self._dense is not None:
             dense_np = np.asarray(self._dense)
+            if self._shard is not None:
+                dense_np = dense_np[
+                    self._shard.perm_l2p(self._dense.shape[0])]
             for r, s in self._slot_of_row.items():
                 if r < num_rows:
                     out[r] = dense_np[s]
