@@ -389,6 +389,9 @@ def test_proxy_config_validation_accepts_and_rejects():
     assert cfg.forward_retry_max == 5
     assert cfg.handoff_window_s == 2.5
     assert cfg.routing_queue_max == 64
+    assert cfg.forward_dedup is True           # exactly-once by default
+    assert cfg.forward_dedup_window_ids == 65536
+    assert cfg.forward_dedup_window_bytes == 8 << 20
 
     for bad in ({"handoff_window_s": 0},
                 {"handoff_window_s": -1.0},
@@ -397,9 +400,189 @@ def test_proxy_config_validation_accepts_and_rejects():
                 {"forward_retry_max": -1},
                 {"forward_breaker_threshold": -2},
                 {"forward_spill_max_bytes": -1},
+                {"forward_dedup_window_ids": 0},
+                {"forward_dedup_window_bytes": -1},
                 {"max_idle_conns": -1}):
         with pytest.raises(ValueError):
             load_proxy_config(data=bad, env={})
+
+    # the escape hatch rides the standard env overlay
+    cfg = load_proxy_config(data={}, env={"VENEUR_FORWARD_DEDUP": "0"})
+    assert cfg.forward_dedup is False
+
+
+# ---------------------------------------------------------------------------
+# exactly-once forwards: journal-minted dedup keys on the wire
+
+
+class DedupWireClient(ScriptedClient):
+    """Wire-sniffing stand-in: records the (sender, id, count) envelope
+    of every raw send ATTEMPT — failed ones included, the way a packet
+    capture would — then delivers like ScriptedClient. `fail_causes`
+    scripts per-attempt ForwardError causes ahead of the steady `down`
+    switch."""
+
+    def __init__(self, dest):
+        super().__init__(dest)
+        self.attempts = []       # (key, names, delivered)
+        self.fail_causes = []
+
+    def send_raw_or_raise(self, blob, n_metrics, timeout_s=None):
+        key, body = codec.decode_dedup_envelope(blob)
+        names = tuple(m.name
+                      for m in pb.MetricBatch.FromString(body).metrics)
+        with self._lock:
+            self.send_calls += 1
+            cause = self.fail_causes.pop(0) if self.fail_causes else (
+                "unavailable" if self.down else None)
+            self.attempts.append((key, names, cause is None))
+            if cause is None:
+                self.sent.extend(names)
+                return
+        raise rpc.ForwardError(cause, self.address, f"scripted: {cause}")
+
+
+def test_dedup_retry_reuses_the_minted_key():
+    # the whole point of minting at checkout: the retry of a failed
+    # attempt carries the SAME key, so a receiver that actually got the
+    # first send recognises the second as a replay
+    clients = {"a:1": DedupWireClient("a:1")}
+    proxy = _make_proxy(["a:1"], clients, policy=_fast_policy(retry_max=1),
+                        dedup=True, dedup_sender="sender-A")
+    try:
+        clients["a:1"].fail_causes = ["unavailable"]
+        proxy._route_batch(_batch(["retry-0", "retry-1"]))
+        (k1, _, ok1), (k2, _, ok2) = clients["a:1"].attempts
+        assert not ok1 and ok2
+        assert k1 == k2
+        sender, dedup_id, count = k1
+        assert sender == "sender-A" and count == 2 and dedup_id >= 1
+        assert proxy.forward_stats()["dedup"]["minted"] == 1
+        assert proxy.conserved()
+    finally:
+        proxy.stop()
+
+
+def test_dedup_spill_drain_reuses_key_and_counts_resend():
+    clients = {"a:1": DedupWireClient("a:1")}
+    proxy = _make_proxy(["a:1"], clients, dedup=True, dedup_sender="s")
+    try:
+        clients["a:1"].down = True
+        proxy._route_batch(_batch(["spill-0"]))
+        assert proxy.spilled_metrics == 1
+        clients["a:1"].down = False
+        proxy.drain_spill()
+        at = clients["a:1"].attempts
+        assert [ok for _, _, ok in at] == [False, True]
+        assert at[0][0] == at[1][0]   # redelivery under the same key
+        st = proxy.forward_stats()
+        assert st["handoff"]["resend_total"] == 1
+        assert st["handoff"]["clipped_resend"] == 0
+        assert st["dedup"]["minted"] == 1
+        assert proxy.spilled_metrics == 0 and proxy.conserved()
+    finally:
+        proxy.stop()
+
+
+def test_deadline_clipped_resend_is_attributed():
+    # satellite: a deadline_exceeded attempt is the AMBIGUOUS one (the
+    # send may have landed); its re-send gets its own counter
+    clients = {"a:1": DedupWireClient("a:1")}
+    proxy = _make_proxy(["a:1"], clients, dedup=True, dedup_sender="s")
+    try:
+        clients["a:1"].fail_causes = ["deadline_exceeded"]
+        proxy._route_batch(_batch(["clip-0"]))
+        assert proxy.spilled_metrics == 1
+        proxy.drain_spill()
+        st = proxy.forward_stats()["handoff"]
+        assert st["resend_total"] == 1
+        assert st["clipped_resend"] == 1
+        at = clients["a:1"].attempts
+        assert at[0][0] == at[1][0]   # same key: the replay dedups
+        assert proxy.conserved()
+    finally:
+        proxy.stop()
+
+
+def test_reshard_remints_for_new_owners_never_reuses_b_keys():
+    # keys that hit the wire toward the departed owner are NOT reused
+    # toward survivors (their windows never saw them) — the re-mint is
+    # counted, and every metric still lands exactly once
+    dests = ["a:1", "b:1", "c:1"]
+    clients = {d: DedupWireClient(d) for d in dests}
+    proxy = _make_proxy(dests, clients, handoff_window_s=0.1,
+                        dedup=True, dedup_sender="s")
+    try:
+        names = [f"remint-{i}" for i in range(60)]
+        clients["b:1"].down = True
+        proxy._route_batch(_batch(names))
+        b_keys = {k for k, _, _ in clients["b:1"].attempts}
+        assert b_keys and proxy.spilled_metrics > 0
+
+        proxy.set_destinations(["a:1", "c:1"])
+        assert _wait_until(lambda: proxy.spilled_metrics == 0, timeout=5.0)
+        landed = clients["a:1"].sent + clients["c:1"].sent
+        assert sorted(landed) == sorted(names)
+        survivor_keys = {k for c in ("a:1", "c:1")
+                         for k, _, _ in clients[c].attempts}
+        assert not (b_keys & survivor_keys)
+        st = proxy.forward_stats()["dedup"]
+        assert st["remint_after_attempt"] >= 1
+        assert proxy.drops == 0 and proxy.conserved()
+    finally:
+        proxy.stop()
+
+
+def test_dedup_off_wire_path_is_byte_identical_passthrough():
+    # A/B pin: the default (dedup off) single-owner wire path hands the
+    # destination the exact routed bytes — no envelope, no re-encode —
+    # so dedup-unaware receivers are untouched by this PR
+    blobs = []
+
+    class RawClient(ScriptedClient):
+        def send_raw_or_raise(self, blob, n_metrics, timeout_s=None):
+            blobs.append(blob)
+            super().send_raw_or_raise(blob, n_metrics, timeout_s)
+
+    wire = _batch(["w-0", "w-1"]).SerializeToString()
+    proxy = _make_proxy(["a:1"], {"a:1": RawClient("a:1")})
+    try:
+        assert proxy.forward_stats()["dedup"]["enabled"] is False
+        proxy._route_wire(wire)
+        assert blobs == [wire]
+    finally:
+        proxy.stop()
+    # same route with dedup on: the SAME bytes, wrapped in the envelope
+    blobs.clear()
+    proxy = _make_proxy(["a:1"], {"a:1": RawClient("a:1")},
+                        dedup=True, dedup_sender="s")
+    try:
+        proxy._route_wire(wire)
+        assert len(blobs) == 1 and blobs[0].startswith(codec.DEDUP_MAGIC)
+        key, body = codec.decode_dedup_envelope(blobs[0])
+        assert body == wire
+        assert key == ("s", key[1], 2)
+    finally:
+        proxy.stop()
+
+
+def test_faulty_client_duplicate_injection_and_scripted_replay():
+    from veneur_tpu.utils.faults import FaultPlan, FaultyForwardClient
+
+    inner = ScriptedClient("a:1")
+    fc = FaultyForwardClient(FaultPlan(seed=1, p_duplicate=1.0), inner)
+    fc.send_or_raise(_batch(["dup-0"]))
+    assert inner.sent == ["dup-0", "dup-0"]   # landed, then replayed
+    assert fc.injected["duplicated"] == 1
+    assert fc.replay_last()                   # scripted replay-on-demand
+    assert inner.sent == ["dup-0"] * 3
+    assert fc.injected["duplicated"] == 2
+    # a plan without duplication consumes no extra draws and never dups
+    inner2 = ScriptedClient("b:1")
+    fc2 = FaultyForwardClient(FaultPlan(seed=1), inner2)
+    fc2.send_or_raise(_batch(["one"]))
+    assert inner2.sent == ["one"]
+    assert fc2.injected["duplicated"] == 0
 
 
 def test_static_discoverer_scripting():

@@ -6,9 +6,12 @@ per-tenant tallies, and the wire path's tenancy fallback."""
 
 from __future__ import annotations
 
+import pytest
+
 from veneur_tpu.core.config import Config
 from veneur_tpu.core.server import Server
-from veneur_tpu.distributed.import_server import ImportServer
+from veneur_tpu.distributed import codec
+from veneur_tpu.distributed.import_server import DedupWindow, ImportServer
 from veneur_tpu.gen import veneur_tpu_pb2 as pb
 
 
@@ -102,3 +105,99 @@ def test_wire_path_enforces_budgets_via_fallback():
     assert srv.tenant_ledger.live("noisy") == 2
     assert imp.tenant_rejected_metrics == 4
     assert imp.received_metrics == 2
+
+
+# ---------------------------------------------------------------------------
+# exactly-once dedup window on the import path
+
+
+def _wrap(batch, sender="s", did=1):
+    return codec.encode_dedup_envelope(
+        sender, did, len(batch.metrics), batch.SerializeToString())
+
+
+def test_wire_replay_is_rejected_and_counted():
+    srv, imp = _server()
+    blob = _wrap(_batch(4), did=7)
+    assert imp.handle_wire(blob) == 4
+    assert imp.received_metrics == 4
+    # the replay is ACKED at the envelope's count (the sender's ledger
+    # sees a normal acceptance) but never re-merged
+    assert imp.handle_wire(blob) == 4
+    assert imp.received_metrics == 4
+    assert imp.metrics_deduped == 4
+    st = imp.stats()
+    assert st["metrics_deduped"] == 4
+    assert st["dedup"]["hits"] == 1 and st["dedup"]["inserts"] == 1
+
+
+def test_headerless_sender_keeps_at_least_once_semantics():
+    # dedup-unaware (old) senders interop: bare blobs merge every time,
+    # exactly as before this PR
+    srv, imp = _server()
+    blob = _batch(3).SerializeToString()
+    assert imp.handle_wire(blob) == 3
+    assert imp.handle_wire(blob) == 3
+    assert imp.received_metrics == 6
+    assert imp.metrics_deduped == 0
+    assert imp.stats()["dedup"]["hits"] == 0
+
+
+def test_senders_have_independent_id_spaces():
+    srv, imp = _server()
+    assert imp.handle_wire(_wrap(_batch(1), sender="p1", did=1)) == 1
+    assert imp.handle_wire(_wrap(_batch(1), sender="p2", did=1)) == 1
+    assert imp.received_metrics == 2   # same id, different sender: both merge
+    assert imp.metrics_deduped == 0
+
+
+def test_window_eviction_degrades_to_at_least_once_with_counter():
+    srv, imp = _server(forward_dedup_window_ids=2)
+    b = _batch(1)
+    for did in (1, 2, 3):               # 3 evicts 1
+        imp.handle_wire(_wrap(b, did=did))
+    st = imp.stats()["dedup"]
+    assert st["evictions"] == 1 and st["window_ids"] == 2
+    assert st["max_ids"] == 2
+    # a replay of the EVICTED id re-merges: honest at-least-once downgrade
+    imp.handle_wire(_wrap(b, did=1))
+    assert imp.received_metrics == 4
+    assert imp.metrics_deduped == 0
+    # an id still in the window dedups
+    imp.handle_wire(_wrap(b, did=3))
+    assert imp.metrics_deduped == 1
+
+
+def test_merge_failure_forgets_the_id_so_retry_is_fresh():
+    srv, imp = _server()
+    bad = codec.encode_dedup_envelope("s", 9, 1, b"\xff\xff\xff\xff garbage")
+    with pytest.raises(Exception):
+        imp.handle_wire(bad)
+    # the merge never landed, so the retry under the SAME id must merge
+    assert imp.handle_wire(_wrap(_batch(1), did=9)) == 1
+    assert imp.received_metrics == 1
+    assert imp.metrics_deduped == 0
+
+
+def test_forward_dedup_off_applies_replays_without_window():
+    srv, imp = _server(forward_dedup=False)
+    blob = _wrap(_batch(2), did=5)
+    assert imp.handle_wire(blob) == 2
+    assert imp.handle_wire(blob) == 2
+    assert imp.received_metrics == 4   # envelope decoded, window skipped
+    assert imp.metrics_deduped == 0
+
+
+def test_dedup_window_bytes_cap_models_entry_size():
+    w = DedupWindow(max_ids=1000, max_bytes=3 * (100 + 6))
+    for did in range(5):                # entries of 100 + len("sender")
+        assert not w.seen_or_insert("sender", did)
+    st = w.stats()
+    assert st["window_ids"] == 3        # byte cap, not id cap, bound it
+    assert st["window_bytes"] <= 3 * (100 + 6)
+    assert st["evictions"] == 2
+    # LRU, not FIFO: touching the oldest survivor protects it
+    assert w.seen_or_insert("sender", 2)
+    assert not w.seen_or_insert("sender", 5)   # evicts 3, not 2
+    assert w.seen_or_insert("sender", 2)
+    assert not w.seen_or_insert("sender", 3)

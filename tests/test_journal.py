@@ -223,6 +223,76 @@ def test_scan_pending_matches_reader_view(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# dedup-id minting: block reservation + sender identity
+
+
+def test_mint_id_never_reuses_across_dirty_restart(tmp_path):
+    # the exactly-once keystone: ids minted before a crash must never
+    # be minted again by the next incarnation, even though the crash
+    # lost the in-RAM counter — the RESERVE record persists the bound
+    j = mk(tmp_path)
+    j.reserve_block = 4
+    minted = [j.mint_id() for _ in range(6)]   # crosses one block edge
+    assert minted == sorted(set(minted))       # unique, monotone
+    assert j.stats()["reserved_blocks"] == 2
+    # dirty restart: no close, no ack — reopen from disk alone
+    j2 = mk(tmp_path)
+    again = [j2.mint_id() for _ in range(4)]
+    assert min(again) > max(minted)
+    j2.close()
+
+
+def test_mint_id_shares_the_record_id_sequence(tmp_path):
+    # minted dedup ids and DATA record ids come from ONE sequence, so a
+    # journal-recovered fragment's id can never collide with a fresh mint
+    j = mk(tmp_path)
+    seen = [j.append(b"a"), j.mint_id(), j.append(b"b"), j.mint_id()]
+    assert seen == sorted(set(seen))
+    assert j.stats()["minted"] == 2
+    j.close()
+    j2 = mk(tmp_path)
+    assert j2.append(b"c") > max(seen)
+    j2.close()
+
+
+def test_mint_reservation_survives_segment_roll(tmp_path):
+    # compaction evicts old segments; the live reservation must be
+    # re-asserted in each fresh active segment or a restart after
+    # eviction would re-mint the reserved range
+    j = mk(tmp_path, max_bytes=1 << 20, max_segments=8, segment_bytes=100)
+    j.reserve_block = 1000
+    first = j.mint_id()
+    for i in range(12):                        # force rolls + compaction
+        rid = j.append(b"x" * 24)
+        j.ack(rid)
+    assert j.stats()["compacted_segments"] > 0
+    j.close()
+    j2 = mk(tmp_path)
+    assert j2.mint_id() >= first + 1000        # bound survived eviction
+    j2.close()
+
+
+def test_sender_token_stable_until_directory_wipe(tmp_path):
+    from veneur_tpu.utils.journal import sender_token
+
+    d = str(tmp_path / "j")
+    t1 = sender_token(d)
+    assert t1 and t1 == sender_token(d)        # stable across calls
+    j = mk(tmp_path)
+    j.append(b"x")
+    j.close()
+    assert sender_token(d) == t1               # journal traffic: same id
+    # a wiped journal dir is a NEW incarnation with a fresh id sequence;
+    # the sender identity must rotate too or stale receiver windows
+    # would falsely dedup the restarted sequence
+    import shutil
+
+    shutil.rmtree(d)
+    t2 = sender_token(d)
+    assert t2 != t1
+
+
+# ---------------------------------------------------------------------------
 # envelope codec
 
 

@@ -22,7 +22,8 @@ TOOLS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tools")
 sys.path.insert(0, TOOLS)
 
-from soak_topology import classify_rss_plateau  # noqa: E402
+from soak_topology import (  # noqa: E402
+    churn_rebound_windows, classify_rss_plateau)
 
 
 def test_plateau_falling_series_passes():
@@ -45,6 +46,41 @@ def test_plateau_noise_floor_tolerates_jitter():
     # an explicit tighter floor turns the same jitter into a failure
     out = classify_rss_plateau([0.50, 0.20, 0.23, 0.21], tol=0.01)
     assert not out["plateau_ok"]
+
+
+def test_plateau_churn_rebound_is_excused_not_a_leak():
+    # a real trace shape: falling, then a join at window 3 recompiles
+    # the forward path (growth rebounds), then falls again to the tail
+    series = [2.0, 0.8, 0.3, 1.1, 0.4, 0.1]
+    out = classify_rss_plateau(series)
+    assert not out["plateau_ok"] and out["rising_at_window"] == 3
+    out = classify_rss_plateau(series, rebound_windows=[3])
+    assert out["plateau_ok"] and out["rising_at_window"] is None
+    assert out["excused_rebounds"] == 1
+    assert out["monotonic_falling"]
+
+
+def test_plateau_tail_must_still_fall_after_excused_rebound():
+    # the excuse restarts the chain; a rise AFTER the churn window is
+    # still a leak
+    out = classify_rss_plateau([2.0, 0.8, 1.1, 0.4, 0.9],
+                               rebound_windows=[2])
+    assert not out["plateau_ok"]
+    assert out["rising_at_window"] == 4
+    assert out["excused_rebounds"] == 1
+
+
+def test_churn_rebound_windows_maps_intervals_to_windows():
+    # windows of 5 intervals closing at 15/20/25: spans (10,15], (15,20],
+    # (20,25] — with the soak's close-before-churn ordering a churn at
+    # interval c lands in the window with start <= c < upto
+    wins = [{"upto_interval": u, "intervals": 5, "rss_mb": 0.0,
+             "growth_per_interval_mb": 0.0} for u in (15, 20, 25)]
+    # churn at 17 → window 1 elevated, window 2 may carry the compile tail
+    assert churn_rebound_windows(wins, [17]) == [1, 2]
+    # churn past the last window excuses nothing
+    assert churn_rebound_windows(wins, [25]) == []
+    assert churn_rebound_windows(wins, []) == []
 
 
 def test_plateau_short_series_judges_nothing():
@@ -80,5 +116,6 @@ def test_soak_topology_short_run_records_plateau_series(tmp_path):
         assert set(w) == {"upto_interval", "rss_mb", "intervals",
                           "growth_per_interval_mb"}
     assert set(art["rss_plateau"]) == {"judgeable", "monotonic_falling",
-                                       "rising_at_window", "plateau_ok"}
+                                       "rising_at_window",
+                                       "excused_rebounds", "plateau_ok"}
     assert art["rss_plateau_gates"] is False  # default run records only

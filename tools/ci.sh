@@ -111,13 +111,29 @@ timeout -k 10 120 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # and flaps discovery — under seeded transient forward faults. Gates
 # the live-membership tier's contracts (distributed/proxy.py): exact
 # tier-wide conservation, zero drops/sheds, spill fully settled, and a
-# full breaker open→half-open→closed cycle. Artifact: RING_CHURN_SOAK
-# .json (committed copy is the full 36-interval run; the lane redirects
-# its miniature artifact to /tmp so quick never clobbers it).
+# full breaker open→half-open→closed cycle — and, with seeded
+# duplicate injection active, the exactly-once contract:
+# duplicates == 0 with the dedup window provably engaged. Artifact:
+# RING_CHURN_SOAK.json (committed copy is the full 36-interval run; the
+# lane redirects its miniature artifact to /tmp so quick never
+# clobbers it).
 echo "== ring-churn chaos lane (seeded membership soak) =="
 timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
   python tools/soak_ring_churn.py --quick
+# Hard duplicates==0 gate, independent of the soak's own pass bar: the
+# artifact's counter/histogram excess over exact expected totals must
+# be zero AND the dedup window must have absorbed at least one injected
+# replay (a zero that never faced a duplicate proves nothing).
+python - "${TMPDIR:-/tmp}/RING_CHURN_SOAK.json" <<'PYGATE'
+import json, sys
+a = json.load(open(sys.argv[1]))
+assert a["duplicates_observed"] == 0, \
+    f"duplicates observed: {a['duplicates_observed']}"
+assert a["dedup_stats"]["hits"] >= 1, "dedup window never engaged"
+print(f"duplicates==0 gate: OK (hits={a['dedup_stats']['hits']}, "
+      f"deduped={a['dedup_stats']['metrics_deduped']} metrics)")
+PYGATE
 
 # Tenant-isolation lane: two seeded runs sharing bit-identical innocent
 # traffic — baseline vs an abusive tenant exploding series cardinality
@@ -148,6 +164,19 @@ echo "== crash-recovery lane (kill-9 durability soak) =="
 timeout -k 10 420 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
   python tools/soak_crash_recovery.py --quick
+# Hard duplicates==0 gate: every successful sink POST was replayed
+# (p_duplicate=1.0) under its journal-minted Idempotency-Key, so the
+# receiver must have absorbed a nonzero replay count while its 2xx
+# ledger stays exactly equal to the delivered sum (zero double-counts).
+python - "${TMPDIR:-/tmp}/CRASH_RECOVERY_SOAK.json" <<'PYGATE'
+import json, sys
+a = json.load(open(sys.argv[1]))["dedup"]
+assert a["receiver_double_counts"] == 0, f"double counts: {a}"
+assert a["duplicates_injected"] >= 1, "duplicate injection never engaged"
+assert a["receiver_replays_absorbed"] >= 1, "receiver absorbed no replays"
+print(f"duplicates==0 gate: OK ({a['duplicates_injected']} injected, "
+      f"{a['receiver_replays_absorbed']} absorbed)")
+PYGATE
 
 # Sustained-rate floor: the loadgen harness drives a live server's UDP
 # socket at a fixed offered rate for 5 flush intervals and fails on

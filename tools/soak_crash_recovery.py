@@ -27,6 +27,12 @@ crash-consistency contract end to end:
 5. GRACEFUL DRAIN — the final incarnation exits on SIGTERM via
    Server.graceful_drain: spill empty, journal pending 0, honest
    shutdown.* ledger in the artifact.
+6. DUPLICATES == 0 — the child's opener replays EVERY successful POST
+   (seeded duplicate injection, p_duplicate=1.0) under the same
+   journal-minted Idempotency-Key; the receiver acknowledges replays
+   of committed keys without counting them, so ``receiver 2xx ==
+   sum(delivered)`` holds exactly even under continuous duplication —
+   and the replay count must be nonzero, or the attack was vacuous.
 
 Kills are scheduled at adversarial machinery points: every kill lands
 while the child is mid-outage with the breaker/retry/journal machinery
@@ -78,6 +84,8 @@ def run_child(args) -> int:
     from veneur_tpu.core.server import Server
     from veneur_tpu.sinks.datadog import DatadogMetricSink
     from veneur_tpu.sinks.delivery import DeliveryPolicy
+    from veneur_tpu.utils.faults import FaultPlan, FaultyOpener
+    from veneur_tpu.utils.http import default_opener
 
     cfg = Config(
         interval="1s", percentiles=[0.5],
@@ -87,10 +95,17 @@ def run_child(args) -> int:
         spill_journal_dir=args.journal_dir,
         spill_journal_fsync="always",
         shutdown_drain_deadline_s=8.0)
+    # duplicate-injection on the HTTP path: every successful POST is
+    # replayed verbatim (same body, same journal-minted Idempotency-Key
+    # header) — the receiver must absorb every replay without
+    # double-counting, or conservation breaks loudly in the parent
+    opener = FaultyOpener(
+        FaultPlan(seed=args.seed + args.gen, p_duplicate=1.0),
+        inner=default_opener)
     dd = DatadogMetricSink(
         interval=INTERVAL_S, flush_max_per_body=10_000,
         hostname="crash-soak", tags=[], dd_hostname=args.dd_url,
-        api_key="soak",
+        api_key="soak", opener=opener,
         delivery=DeliveryPolicy(
             retry_max=1, breaker_threshold=3,
             spill_max_bytes=8 << 20, spill_max_payloads=512,
@@ -106,6 +121,7 @@ def run_child(args) -> int:
             "flush_count": srv.flush_count,
             "delivery": man.stats(),
             "journal": {r: j.stats() for r, j in srv._journals.items()},
+            "duplicates_injected": opener.injected["duplicated"],
         }
         if extra:
             out.update(extra)
@@ -145,13 +161,23 @@ def run_child(args) -> int:
 class Receiver:
     """HTTP endpoint with a scriptable disposition: 'down' 503s
     everything, 'up' 200s everything, a budget allows exactly N 200s
-    before going down again (the partial-drain cycle)."""
+    before going down again (the partial-drain cycle).
+
+    Idempotent: every POST carries the sink's journal-minted
+    Idempotency-Key header; a key that already got a 200 gets 200 again
+    WITHOUT counting — regardless of the current disposition, the way a
+    real committed-write endpoint answers a replay. ok_count() is
+    therefore the exactly-once truth the parent's ledger comparison
+    rides on, even though the child injects a replay of every
+    successful POST."""
 
     def __init__(self):
         self.mode = "down"
         self.budget = 0
         self.posts = 0
         self.ok = 0
+        self.deduped = 0
+        self.committed: set = set()
         self.lock = threading.Lock()
         recv = self
 
@@ -159,13 +185,21 @@ class Receiver:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 self.rfile.read(length)
+                key = self.headers.get("Idempotency-Key")
                 with recv.lock:
                     recv.posts += 1
-                    if recv.mode == "up" or (recv.mode == "budget"
-                                             and recv.budget > 0):
+                    if key is not None and key in recv.committed:
+                        # replay of a committed write: acknowledge,
+                        # never double-count, never charge the budget
+                        recv.deduped += 1
+                        code, body = 200, b"{}"
+                    elif recv.mode == "up" or (recv.mode == "budget"
+                                               and recv.budget > 0):
                         if recv.mode == "budget":
                             recv.budget -= 1
                         recv.ok += 1
+                        if key is not None:
+                            recv.committed.add(key)
                         code, body = 200, b"{}"
                     else:
                         code, body = 503, b"unavailable"
@@ -190,6 +224,10 @@ class Receiver:
     def ok_count(self) -> int:
         with self.lock:
             return self.ok
+
+    def dedup_count(self) -> int:
+        with self.lock:
+            return self.deduped
 
 
 def read_stats(path: str, gen: int):
@@ -274,7 +312,8 @@ def main() -> int:
             [sys.executable, os.path.abspath(__file__), "--child",
              "--gen", str(gen), "--port", str(udp_port),
              "--dd-url", f"http://127.0.0.1:{recv.port}",
-             "--journal-dir", journal_dir, "--stats", stats],
+             "--journal-dir", journal_dir, "--stats", stats,
+             "--seed", str(args.seed)],
             cwd=REPO)
         return proc, stats
 
@@ -456,6 +495,15 @@ def main() -> int:
         failures.append(
             f"wire/ledger divergence: receiver 2xx {recv.ok_count()} "
             f"!= sum(delivered) {sum_delivered}")
+    duplicates_injected = sum(st.get("duplicates_injected", 0)
+                              for st in incarnations)
+    if incarnations and duplicates_injected == 0:
+        failures.append("duplicate injection never engaged "
+                        "(duplicates==0 would be vacuous)")
+    if duplicates_injected and recv.dedup_count() == 0:
+        failures.append(
+            f"{duplicates_injected} duplicates injected but the "
+            f"receiver absorbed none (keys not carried/replayed?)")
     kills = sum(1 for c in cycles if c["style"] != "sigterm-drain")
     if kills < 3:
         failures.append(f"only {kills} SIGKILL cycles completed")
@@ -480,6 +528,12 @@ def main() -> int:
             "exact": sum_fresh == sum_delivered + sum_dropped
             + final_spilled,
         },
+        "dedup": {
+            "duplicates_injected": duplicates_injected,
+            "receiver_replays_absorbed": recv.dedup_count(),
+            "receiver_double_counts": 0 if recv.ok_count()
+            == sum_delivered else recv.ok_count() - sum_delivered,
+        },
         "failures": failures,
         "ok": not failures,
     }
@@ -488,6 +542,7 @@ def main() -> int:
         "metric": "crash_recovery_soak_ok", "value": out["ok"],
         "sigkill_cycles": kills,
         "cross_incarnation": out["cross_incarnation"],
+        "dedup": out["dedup"],
         "failures": failures,
     }))
     return 0 if not failures else 1

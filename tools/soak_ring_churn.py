@@ -18,14 +18,23 @@ exercises the whole PR-7 robustness surface:
 - discovery FLAPS (one injected failure, one empty answer) and must
   keep the last-good ring with honest staleness counters;
 - every forward send runs through a seeded FaultPlan injecting ONLY
-  transient faults (refusals, sub-deadline slowness), so the retry/
+  transient faults (refusals, sub-deadline slowness) plus DUPLICATES
+  (a delivered payload re-sent, and a scripted replay of the last
+  delivered frame straight across the victim's restart), so the retry/
   spill machinery is continuously exercised without any legitimate
-  drop.
+  drop — and the exactly-once window is continuously attacked.
+
+The proxy runs with forward dedup ON over a real spill journal, so
+every fragment's idempotency key is journal-minted and the sender
+identity comes from the journal's sender token.
 
 Pass criteria, checked after a bounded settling drain:
 
     exact tier-wide conservation  ingested == globally flushed
                                   (counters AND histogram .count sums),
+    duplicates == 0               nothing merged twice, though the
+                                  harness provably injected duplicates
+                                  (dedup hits >= injected replays > 0),
     proxy.drops == 0, zero routing sheds, zero import errors,
     proxied == received across every kill/partition/reshard,
     a full breaker cycle on the revived member,
@@ -59,6 +68,9 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true",
                     help="CI lane: 3 globals, short schedule")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--no-dedup", action="store_true",
+                    help="A/B lane: historical at-least-once wire (no "
+                         "idempotency envelopes, no duplicate injection)")
     args = ap.parse_args()
 
     from veneur_tpu.core.config import Config
@@ -110,12 +122,15 @@ def main() -> None:
     # delivery layer must absorb them without a single legitimate drop
     fault_clients: dict[str, FaultyForwardClient] = {}
 
+    dedup = not args.no_dedup
+
     def client_factory(dest: str, timeout_s: float,
                        idle_timeout_s: float) -> FaultyForwardClient:
         inner = rpc.ForwardClient(dest, timeout_s,
                                   idle_timeout_s=idle_timeout_s)
         plan = FaultPlan(seed=args.seed + sum(dest.encode()),
-                         p_refuse=0.04, p_slow=0.04, slow_s=0.03)
+                         p_refuse=0.04, p_slow=0.04, slow_s=0.03,
+                         p_duplicate=0.08 if dedup else 0.0)
         fc = FaultyForwardClient(plan, inner)
         fault_clients[dest] = fc
         return fc
@@ -124,12 +139,21 @@ def main() -> None:
                             spill_max_bytes=8 << 20, spill_max_payloads=512,
                             timeout_s=1.0, deadline_s=1.0,
                             backoff_base_s=0.02, backoff_max_s=0.1)
+    # a real spill journal so idempotency keys are journal-minted and
+    # the wire sender identity is the journal's durable sender token
+    import tempfile
+
+    from veneur_tpu.utils.journal import SpillJournal
+
+    journal_dir = tempfile.mkdtemp(prefix="churn-journal-")
+    journal = SpillJournal(journal_dir, fsync="never")
     # the LAST global joins mid-run (full mode); quick runs a
     # leave/rejoin pair on it instead
     initial = list(range(n_globals if quick else n_globals - 1))
     proxy = ProxyServer([addr(i) for i in initial], timeout_s=2.0,
                         delivery=policy, handoff_window_s=0.5,
-                        client_factory=client_factory)
+                        client_factory=client_factory,
+                        journal=journal, dedup=dedup)
     pport = proxy.start_grpc()
 
     disc = StaticDiscoverer([addr(i) for i in initial])
@@ -187,19 +211,33 @@ def main() -> None:
             # cold-stop the victim's import server; it STAYS in the ring
             # (a crashed-but-registered instance), so its arc spills and
             # its breaker opens — the revival must close the full cycle.
-            # Settle the spill first: a drain-thread delivery in flight
-            # at the cold stop could land AND error (grace=0 cancels the
-            # response), and its retry would double-deliver
-            settle_tries = 0
-            while proxy.spilled_metrics > 0 and settle_tries < 100:
-                proxy.drain_spill()
-                settle_tries += 1
-                time.sleep(0.02)
+            # A drain-thread delivery in flight at the cold stop can
+            # land AND error (grace=0 cancels the response); its retry
+            # re-sends the SAME idempotency key, and the window absorbs
+            # it — the pre-dedup incarnation of this soak had to settle
+            # the spill before killing to dodge exactly that race. The
+            # --no-dedup A/B lane keeps the historical settle.
+            if not dedup:
+                settle_tries = 0
+                while proxy.spilled_metrics > 0 and settle_tries < 100:
+                    proxy.drain_spill()
+                    settle_tries += 1
+                    time.sleep(0.02)
             globals_[victim][1].stop(grace=0)
             log_event(it, "kill", member=victim_addr)
         elif it == restart_at:
             globals_[victim][1].start_grpc(victim_addr)
-            log_event(it, "restart", member=victim_addr)
+            replayed = False
+            if dedup:
+                # scripted replay straight across the restart: the
+                # last frame delivered to the victim goes out again —
+                # the window hangs off the ImportServer object, not the
+                # listener, so the replay must dedup
+                fc = fault_clients.get(victim_addr)
+                if fc is not None:
+                    replayed = fc.replay_last()
+            log_event(it, "restart", member=victim_addr,
+                      replayed_last=replayed)
         if part_window is not None and it == part_window[0]:
             fc = fault_clients.get(addr(part))
             if fc is not None:
@@ -298,12 +336,21 @@ def main() -> None:
         for k, v in fc.injected.items():
             if k != "passed":
                 injected[k] = injected.get(k, 0) + v
+    dedup_hits = sum(imp.stats()["dedup"]["hits"] for _, imp in globals_)
+    dedup_evictions = sum(
+        imp.stats()["dedup"]["evictions"] for _, imp in globals_)
+    metrics_deduped = sum(imp.metrics_deduped for _, imp in globals_)
 
     expected_counter = 2.0 * s_counter * intervals
     expected_histo = float(s_histo * intervals)
+    # anything merged twice shows up as excess over the exact expected
+    # totals — THE duplicates observable, independent of any counter
+    duplicates_observed = (max(0.0, counter_total - expected_counter)
+                           + max(0.0, histo_count_total - expected_histo))
     checks = {
         "counter_conservation_exact": counter_total == expected_counter,
         "histo_conservation_exact": histo_count_total == expected_histo,
+        "duplicates_zero": duplicates_observed == 0.0,
         "zero_drops": proxy.drops == 0,
         "zero_sheds": stats["routing"]["shed_batches"] == 0,
         "zero_import_errors": import_errors == 0,
@@ -315,11 +362,18 @@ def main() -> None:
         "refresh_empty_flap_seen": refresher.refresh_empty >= 1,
         "ledgers_conserved": proxy.conserved(),
     }
+    if dedup:
+        # duplicates must have been provably injected AND absorbed, or
+        # duplicates_zero is vacuous
+        checks["dedup_engaged"] = (injected.get("duplicated", 0) >= 1
+                                   and dedup_hits >= 1)
+        checks["dedup_no_evictions"] = dedup_evictions == 0
     failures = sorted(k for k, ok in checks.items() if not ok)
 
     out = {
         "quick": quick,
         "seed": args.seed,
+        "dedup": dedup,
         "globals": n_globals,
         "intervals": intervals,
         "histo_series": s_histo,
@@ -334,6 +388,18 @@ def main() -> None:
         "interval_receipts": interval_receipts,
         "settle_drains": settle_drains,
         "injected_faults": injected,
+        "duplicates_observed": duplicates_observed,
+        "dedup_stats": {
+            "sender": stats["dedup"]["sender"],
+            "minted": stats["dedup"]["minted"],
+            "remint_after_attempt": stats["dedup"]["remint_after_attempt"],
+            "hits": dedup_hits,
+            "evictions": dedup_evictions,
+            "metrics_deduped": metrics_deduped,
+            "window_bytes": sum(imp.stats()["dedup"]["window_bytes"]
+                                for _, imp in globals_),
+        },
+        "handoff": stats["handoff"],
         "victim_breaker_transitions": transitions,
         "proxy": {k: stats[k] for k in (
             "proxied_metrics", "drops", "spilled_metrics", "shed_metrics",
@@ -350,6 +416,10 @@ def main() -> None:
     local.shutdown()
     refresher.stop()
     proxy.stop()
+    journal.close()
+    import shutil
+
+    shutil.rmtree(journal_dir, ignore_errors=True)
     for srv, imp in globals_:
         imp.stop(grace=0.5)
         srv.shutdown()
@@ -360,6 +430,8 @@ def main() -> None:
                       "unit": "bool",
                       "reshards": out["proxy"]["reshards"],
                       "drops": out["proxy"]["drops"],
+                      "duplicates": duplicates_observed,
+                      "dedup_hits": dedup_hits,
                       "failures": failures}))
     if failures:
         sys.exit(1)

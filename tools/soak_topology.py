@@ -62,30 +62,60 @@ from _soak_common import rss_mb, write_artifact  # noqa: E402
 RSS_NOISE_MB_PER_INTERVAL = 0.05
 
 
+def churn_rebound_windows(rss_windows: list[dict],
+                          churn_intervals: list[int]) -> list[int]:
+    """Window indices whose growth a membership change can legitimately
+    elevate: the window whose span contains the churn interval, plus
+    the one after it (a join/leave re-plumbs destinations and triggers
+    fresh XLA compiles whose allocations can trail past the containing
+    window). classify_rss_plateau restarts its monotone chain at these
+    indices instead of calling the expected rebound a leak."""
+    out: set[int] = set()
+    for k, w in enumerate(rss_windows):
+        lo = w["upto_interval"] - w["intervals"]
+        for c in churn_intervals:
+            if lo <= c < w["upto_interval"]:
+                out.add(k)
+                out.add(k + 1)
+    return sorted(i for i in out if i < len(rss_windows))
+
+
 def classify_rss_plateau(growth_series: list[float],
-                         tol: float = RSS_NOISE_MB_PER_INTERVAL) -> dict:
+                         tol: float = RSS_NOISE_MB_PER_INTERVAL,
+                         rebound_windows: list[int] = ()) -> dict:
     """Judge a post-warmup rss_growth_per_interval_mb window series.
 
     A plateauing process leaks less per interval as caches fill, so the
     series must be monotonically falling: each window's growth at most
-    the previous window's plus the noise floor. Returns the verdict,
-    the first offending window index (None when ok), and whether there
-    were enough windows to judge at all (fewer than 3 judges nothing —
-    one comparison can't distinguish a trend from jitter).
+    the previous window's plus the noise floor. Windows listed in
+    `rebound_windows` (from churn_rebound_windows) are excused: a
+    membership change recompiles the forward path, so the window
+    straddling it rises for a real, bounded reason — the chain restarts
+    there, and the TAIL after the last excused window must still fall.
+    Returns the verdict, the first offending window index (None when
+    ok), how many rises were excused as churn rebounds, and whether
+    there were enough windows to judge at all (fewer than 3 judges
+    nothing — one comparison can't distinguish a trend from jitter).
 
     Pure — no clocks, no I/O — so the tier-1 suite pins it against
     synthetic series while the multi-hour soak consumes it live.
     """
+    excused = set(rebound_windows)
     judgeable = len(growth_series) >= 3
     rising_at = None
+    excused_rebounds = 0
     for k in range(1, len(growth_series)):
         if growth_series[k] > growth_series[k - 1] + tol:
+            if k in excused:
+                excused_rebounds += 1
+                continue
             rising_at = k
             break
     return {
         "judgeable": judgeable,
         "monotonic_falling": rising_at is None,
         "rising_at_window": rising_at,
+        "excused_rebounds": excused_rebounds,
         "plateau_ok": (rising_at is None) if judgeable else True,
     }
 
@@ -323,7 +353,9 @@ def main() -> None:
     intervals = it  # actual count (a --min-duration run overshoots the plan)
     close_rss_window(it)
     rss_plateau = classify_rss_plateau(
-        [w["growth_per_interval_mb"] for w in rss_windows])
+        [w["growth_per_interval_mb"] for w in rss_windows],
+        rebound_windows=churn_rebound_windows(
+            rss_windows, [e["interval"] for e in churn_events]))
 
     # end-of-loop heap snapshot BEFORE the final accounting flushes
     # below allocate their own transient state: the diff should show

@@ -107,7 +107,8 @@ def main(argv=None) -> int:
                         routing_workers=cfg.routing_pool_workers,
                         routing_queue_max=cfg.routing_queue_max,
                         handoff_window_s=cfg.handoff_window_s,
-                        journal=journal)
+                        journal=journal,
+                        dedup=cfg.forward_dedup)
     if journal is not None:
         # re-route the previous incarnation's durable spill under the
         # current ring before accepting fresh traffic

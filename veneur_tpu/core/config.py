@@ -195,6 +195,15 @@ class Config:
     # forwardrpc.Forward/SendMetrics + metricpb wire, for forwarding into
     # a stock veneur global — see distributed/interop.py)
     forward_format: str = "veneurtpu"
+    # exactly-once forwards: the import path keeps a bounded per-sender
+    # window of recently seen dedup ids and drops replays
+    # (distributed/import_server.py DedupWindow). Sized by ids AND
+    # bytes; eviction degrades to at-least-once (counted), never blocks
+    # ingest. forward_dedup: false applies payloads without the window
+    # check (envelopes still decode for interop).
+    forward_dedup: bool = True
+    forward_dedup_window_ids: int = 65536
+    forward_dedup_window_bytes: int = 8 << 20
     # set-element hash: "fnv" (this framework's own, utils/hashing.hll_hash)
     # or "metro" (metro64 seed=1337, what the Go fleet inserts with —
     # REQUIRED on any instance that shares set series with Go veneur
@@ -453,6 +462,15 @@ class ProxyConfig:
     enable_profiling: bool = False
     forward_address: str = ""  # static destination (no discovery)
     forward_timeout: str = "10s"
+    # exactly-once forwards: mint a journal-backed dedup id per forward
+    # fragment and carry it in a versioned wire envelope so the import
+    # path can reject replays (retries, handoff re-sends, network
+    # duplicates). Escape hatch: VENEUR_FORWARD_DEDUP=0. The window
+    # keys size this proxy's OWN import window when it receives
+    # forwards (same keys as the server config).
+    forward_dedup: bool = True
+    forward_dedup_window_ids: int = 65536
+    forward_dedup_window_bytes: int = 8 << 20
     # forward-path delivery guarantees (the PR-5 sink delivery layer
     # applied per destination; sinks/delivery.py DeliveryPolicy):
     # bounded retry on transient failures, per-destination circuit
@@ -544,6 +562,17 @@ def _validate_journal_keys(cfg) -> None:
                          " (0 disables the graceful drain)")
 
 
+def _validate_dedup_keys(cfg) -> None:
+    """Shared dedup-window validation (Config and ProxyConfig carry the
+    same forward_dedup_* knobs)."""
+    if cfg.forward_dedup_window_ids < 1:
+        raise ValueError("forward_dedup_window_ids must be >= 1 (set"
+                         " forward_dedup: false to disable dedup)")
+    if cfg.forward_dedup_window_bytes < 1:
+        raise ValueError("forward_dedup_window_bytes must be >= 1 (set"
+                         " forward_dedup: false to disable dedup)")
+
+
 def validate_proxy_config(cfg: ProxyConfig) -> None:
     parse_duration(cfg.forward_timeout)  # raises on nonsense
     parse_duration(cfg.consul_refresh_interval)
@@ -563,6 +592,7 @@ def validate_proxy_config(cfg: ProxyConfig) -> None:
         raise ValueError("handoff_window_s must be positive (it bounds"
                          " the reshard drain AND paces the drain thread)")
     _validate_journal_keys(cfg)
+    _validate_dedup_keys(cfg)
     if cfg.routing_pool_workers < 1:
         raise ValueError("routing_pool_workers must be >= 1")
     if cfg.routing_queue_max < 1:
@@ -766,6 +796,7 @@ def validate_config(cfg: Config) -> None:
         raise ValueError("sink spill caps must be >= 0 (0 drops failed"
                          " payloads instead of spilling them)")
     _validate_journal_keys(cfg)
+    _validate_dedup_keys(cfg)
     if cfg.config_reload_s < 0:
         raise ValueError("config_reload_s must be >= 0 (0 disables the"
                          " config hot-reload watcher)")

@@ -196,6 +196,65 @@ def snapshot_to_wire(snap: FlushSnapshot,
             len(batch.metrics) + native_count)
 
 
+# --------------------------------------------------------------- dedup
+#
+# Wire-level idempotency envelope.  grpc_tools isn't available to grow
+# the proto schema, so the dedup key rides as a versioned byte header
+# prepended to the serialized MetricBatch.  The magic's leading byte is
+# 'V' (0x56): as a protobuf tag it decodes to field 10 / wire type 6,
+# which is invalid, so a headered blob can never parse as a legacy
+# MetricBatch and the two shapes sniff apart unambiguously.  Headerless
+# blobs pass through untouched — a dedup-unaware sender interops at
+# at-least-once semantics, exactly as before.
+
+DEDUP_MAGIC = b"VDE1"  # 'V'-leading, versioned; u16 LE header length follows
+
+
+def encode_dedup_envelope(sender: str, dedup_id: int, count: int,
+                          body: bytes) -> bytes:
+    """Prepend the versioned idempotency header to MetricBatch bytes.
+
+    ``count`` (the batch's metric count) is REQUIRED in the header: a
+    receiver that dedups a replay must still report the batch's size as
+    accepted (the HTTP import path treats 0 as a malformed body)."""
+    import json as _json
+
+    hdr = _json.dumps(
+        {"s": sender, "i": int(dedup_id), "n": int(count)},
+        separators=(",", ":"),
+    ).encode("utf-8")
+    if len(hdr) > 0xFFFF:
+        raise ValueError("dedup header too large")
+    return DEDUP_MAGIC + len(hdr).to_bytes(2, "little") + hdr + body
+
+
+def decode_dedup_envelope(
+    blob: bytes,
+) -> "tuple[tuple[str, int, int] | None, bytes]":
+    """Split a wire blob into ``((sender, id, count) | None, body)``.
+
+    Headerless blobs (old senders) return ``(None, blob)`` unchanged.
+    A blob that *starts* like an envelope but is malformed raises
+    ValueError — it cannot be a legacy MetricBatch either."""
+    import json as _json
+
+    if not blob.startswith(DEDUP_MAGIC):
+        return None, blob
+    if len(blob) < len(DEDUP_MAGIC) + 2:
+        raise ValueError("truncated dedup envelope")
+    off = len(DEDUP_MAGIC)
+    hlen = int.from_bytes(blob[off:off + 2], "little")
+    off += 2
+    if len(blob) < off + hlen:
+        raise ValueError("truncated dedup envelope header")
+    try:
+        meta = _json.loads(blob[off:off + hlen].decode("utf-8"))
+        key = (str(meta["s"]), int(meta["i"]), int(meta["n"]))
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+        raise ValueError(f"bad dedup envelope header: {e}") from e
+    return key, blob[off + hlen:]
+
+
 def metric_key(m: pb.Metric) -> MetricKey:
     return MetricKey(
         name=m.name,

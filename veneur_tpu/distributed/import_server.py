@@ -13,8 +13,9 @@ import logging
 import time
 import threading
 import zlib
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Tuple
 
 import grpc
 
@@ -41,6 +42,78 @@ def _import_scope(m: pb.Metric):
     return _SCOPE_FROM_PB.get(m.scope, ScopeClass.MIXED)
 
 
+class DedupWindow:
+    """Bounded memory of recently seen idempotency keys, per sender.
+
+    Exactly-once enforcement on the import path: a forward payload whose
+    ``(sender, id)`` was already accepted is a replay (retry after a
+    deadline-clipped send, handoff re-send, crash-journal replay,
+    duplicate injection) and must not re-merge. The window is an LRU
+    capped by BOTH id count and modeled bytes; hitting a cap evicts
+    oldest-first, which honestly degrades that sender's oldest ids back
+    to at-least-once — counted in ``evictions``, never blocking ingest.
+    """
+
+    # modeled per-entry overhead beyond the sender string: dict node,
+    # key tuple, boxed int (PERF_MODEL.md "Dedup window memory")
+    ENTRY_OVERHEAD_BYTES = 100
+
+    def __init__(self, max_ids: int = 65536,
+                 max_bytes: int = 8 << 20) -> None:
+        self.max_ids = max(1, int(max_ids))
+        self.max_bytes = max(1, int(max_bytes))
+        self._lock = threading.Lock()
+        self._seen: "OrderedDict[Tuple[str, int], int]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.inserts = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _entry_bytes(sender: str) -> int:
+        return DedupWindow.ENTRY_OVERHEAD_BYTES + len(sender)
+
+    def seen_or_insert(self, sender: str, dedup_id: int) -> bool:
+        """True if (sender, id) was already seen (a replay); else insert
+        it and return False. The check-and-insert is atomic so two
+        concurrent replays racing through the handler pool can't both
+        merge; the caller must ``forget`` on a failed merge."""
+        key = (sender, dedup_id)
+        with self._lock:
+            if key in self._seen:
+                self._seen.move_to_end(key)
+                self.hits += 1
+                return True
+            nbytes = self._entry_bytes(sender)
+            self._seen[key] = nbytes
+            self._bytes += nbytes
+            self.inserts += 1
+            while self._seen and (len(self._seen) > self.max_ids
+                                  or self._bytes > self.max_bytes):
+                _, evicted = self._seen.popitem(last=False)
+                self._bytes -= evicted
+                self.evictions += 1
+            return False
+
+    def forget(self, sender: str, dedup_id: int) -> None:
+        with self._lock:
+            nbytes = self._seen.pop((sender, dedup_id), None)
+            if nbytes is not None:
+                self._bytes -= nbytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "inserts": self.inserts,
+                "evictions": self.evictions,
+                "window_ids": len(self._seen),
+                "window_bytes": self._bytes,
+                "max_ids": self.max_ids,
+                "max_bytes": self.max_bytes,
+            }
+
+
 class ImportServer:
     """Receives MetricBatch RPCs and routes metrics into a server's
     workers by identity digest (one series → one worker shard,
@@ -54,7 +127,17 @@ class ImportServer:
         self.received_metrics = 0
         self.import_errors = 0
         self.tenant_rejected_metrics = 0
+        self.metrics_deduped = 0
         self.last_import_unix = 0.0
+        # exactly-once replay rejection, sized by the server config when
+        # present; the window outlives gRPC stop/start cycles (it hangs
+        # off THIS object), so a replay across a listener restart still
+        # dedups
+        cfg = getattr(server, "config", None)
+        self.dedup_enabled = bool(getattr(cfg, "forward_dedup", True))
+        self.dedup = DedupWindow(
+            max_ids=getattr(cfg, "forward_dedup_window_ids", 65536),
+            max_bytes=getattr(cfg, "forward_dedup_window_bytes", 8 << 20))
         # concurrent imports (one thread per HTTP request + gRPC handlers)
         # hold different worker locks; the tallies need their own
         self._stats_lock = threading.Lock()
@@ -118,13 +201,41 @@ class ImportServer:
                 (time.time() - started) * 1e9, tags=["part:merge"])
 
     def handle_wire(self, blob: bytes) -> int:
-        """Apply a serialized MetricBatch; returns the metric count seen
-        (applied + rejected). Fast path: the C++ wire decoder + batched
-        native directory upsert (one lock hold per worker chunk) — no
-        per-metric Python protobuf objects. Falls back to the Python
-        path (which raises DecodeError on malformed bytes) when the
-        native library is unavailable, any worker lacks a native
-        context, or the blob needs the lenient per-metric handling."""
+        """Apply a forward wire blob; returns the metric count seen
+        (applied + rejected + deduped).
+
+        A blob may arrive wrapped in the versioned idempotency envelope
+        (codec.encode_dedup_envelope); a replayed (sender, id) is
+        acknowledged WITHOUT re-merging — the original delivery already
+        counted — at the envelope's metric count, so the sender's
+        ledger and the HTTP 200 path see a normal acceptance.
+        Headerless blobs (dedup-unaware senders) keep the exact
+        at-least-once semantics they always had."""
+        key, blob = codec.decode_dedup_envelope(blob)
+        if key is None or not self.dedup_enabled:
+            return self._apply_wire(blob)
+        sender, dedup_id, count = key
+        if self.dedup.seen_or_insert(sender, dedup_id):
+            with self._stats_lock:
+                self.metrics_deduped += count
+                self.last_import_unix = time.time()
+            return count
+        try:
+            return self._apply_wire(blob)
+        except Exception:
+            # the merge did NOT land: a retry of this id is a fresh
+            # attempt, not a replay
+            self.dedup.forget(sender, dedup_id)
+            raise
+
+    def _apply_wire(self, blob: bytes) -> int:
+        """Apply a bare serialized MetricBatch. Fast path: the C++ wire
+        decoder + batched native directory upsert (one lock hold per
+        worker chunk) — no per-metric Python protobuf objects. Falls
+        back to the Python path (which raises DecodeError on malformed
+        bytes) when the native library is unavailable, any worker lacks
+        a native context, or the blob needs the lenient per-metric
+        handling."""
         import numpy as np
 
         from veneur_tpu.core.directory import ScopeClass
@@ -241,8 +352,10 @@ class ImportServer:
                 "received_metrics": self.received_metrics,
                 "import_errors": self.import_errors,
                 "tenant_rejected_metrics": self.tenant_rejected_metrics,
+                "metrics_deduped": self.metrics_deduped,
                 "last_import_unix": self.last_import_unix,
                 "serving": self.grpc_server is not None,
+                "dedup": self.dedup.stats(),
             }
 
 

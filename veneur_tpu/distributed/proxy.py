@@ -60,9 +60,17 @@ class _Fragment:
     context to be RE-routed under a newer ring after a spill: the raw
     record byte-slices plus each record's placement hash (wire path),
     or the pb.Metric objects plus each metric's key string (protobuf
-    path). `meta[i]` always places `parts[i]`."""
+    path). `meta[i]` always places `parts[i]`.
 
-    __slots__ = ("wire", "parts", "meta", "count", "nbytes")
+    Exactly-once context (dedup mode): `dedup_id` is the wire-level
+    idempotency key, minted at delivery checkout for `minted_for` and
+    journaled with the fragment so crash replay re-sends the SAME key;
+    `attempts`/`last_cause` record whether a prior send may have landed
+    (a deadline-clipped attempt is ambiguous — the receiver may hold the
+    data), which governs whether a reshard may split the fragment."""
+
+    __slots__ = ("wire", "parts", "meta", "count", "nbytes",
+                 "dedup_id", "minted_for", "attempts", "last_cause")
 
     def __init__(self, wire: bool, parts: list, meta: list) -> None:
         self.wire = wire
@@ -71,6 +79,10 @@ class _Fragment:
         self.count = len(parts)
         self.nbytes = (sum(len(p) for p in parts) if wire
                        else sum(m.ByteSize() for m in parts))
+        self.dedup_id: Optional[int] = None
+        self.minted_for: Optional[str] = None
+        self.attempts = 0
+        self.last_cause: Optional[str] = None
 
 
 def _fragment_encode(frag: _Fragment) -> bytes:
@@ -83,10 +95,18 @@ def _fragment_encode(frag: _Fragment) -> bytes:
         parts = frag.parts
     else:
         parts = [m.SerializeToString() for m in frag.parts]
-    hdr = json.dumps(
-        {"w": 1 if frag.wire else 0, "meta": list(frag.meta),
-         "lens": [len(p) for p in parts]},
-        separators=(",", ":")).encode()
+    meta: dict = {"w": 1 if frag.wire else 0, "meta": list(frag.meta),
+                  "lens": [len(p) for p in parts]}
+    if frag.dedup_id is not None:
+        # the idempotency key must survive the crash WITH the payload:
+        # replay re-sends under the original id so the receiver's window
+        # rejects what the dead incarnation already delivered
+        meta["did"] = frag.dedup_id
+        meta["dfor"] = frag.minted_for
+        meta["att"] = frag.attempts
+        if frag.last_cause:
+            meta["lc"] = frag.last_cause
+    hdr = json.dumps(meta, separators=(",", ":")).encode()
     return hdr + b"\n" + b"".join(parts)
 
 
@@ -116,7 +136,16 @@ def _fragment_decode(blob: bytes) -> Optional[_Fragment]:
             parts = [pb.Metric.FromString(p) for p in parts]
         except Exception:  # noqa: BLE001 — foreign/corrupt protobuf
             return None
-    return _Fragment(wire, parts, meta)
+    frag = _Fragment(wire, parts, meta)
+    if hdr.get("did") is not None:
+        try:
+            frag.dedup_id = int(hdr["did"])
+            frag.minted_for = hdr.get("dfor")
+            frag.attempts = int(hdr.get("att", 0))
+            frag.last_cause = hdr.get("lc")
+        except (ValueError, TypeError):
+            frag.dedup_id = None
+    return frag
 
 
 def _entry_encode(entry) -> Optional[bytes]:
@@ -230,8 +259,31 @@ class ProxyServer:
                  routing_queue_max: int = ROUTING_QUEUE_MAX,
                  handoff_window_s: float = 5.0,
                  client_factory: Optional[Callable] = None,
-                 journal=None) -> None:
+                 journal=None,
+                 dedup: bool = False,
+                 dedup_sender: Optional[str] = None) -> None:
         self.ring = ConsistentRing(destinations or [])
+        # exactly-once forwards: when on, every fragment carries a
+        # wire-level idempotency key (versioned envelope, codec.py) the
+        # import tier dedups on. Default OFF at this layer so the config
+        # wires it deliberately — off, the wire bytes are byte-identical
+        # to the at-least-once tier.
+        self.dedup = bool(dedup)
+        if dedup_sender is not None:
+            self._dedup_sender = str(dedup_sender)
+        elif journal is not None:
+            from veneur_tpu.utils.journal import sender_token
+
+            self._dedup_sender = sender_token(journal.directory)
+        else:
+            import os as _os
+
+            # no journal: ids are only process-unique, so the sender
+            # token must be process-unique too — a restart is a new
+            # sender and can never collide with the dead one's window
+            self._dedup_sender = _os.urandom(8).hex()
+        self._mint_lock = threading.Lock()
+        self._mint_next = 1  # journal-less fallback id sequence
         # one SHARED write-ahead journal (utils/journal.py) across every
         # per-destination manager: a fragment spilled toward A, drained
         # by a reshard, and re-spilled toward B keeps one durable record
@@ -270,6 +322,14 @@ class ProxyServer:
         self.shed_metrics = 0      # subset of drops: routing-queue sheds
         self.reshards = 0
         self.handoffs = 0
+        self.dedup_minted = 0
+        # re-sends of fragments whose prior attempt may have landed —
+        # the duplicate source PR 10 could only infer from soak diffs
+        self.handoff_resend_total = 0
+        self.handoff_clipped_resend = 0  # prior attempt deadline-clipped
+        # reshard forced a split/re-mint after an ambiguous attempt:
+        # residual at-least-once risk, counted never silent
+        self.dedup_remint_after_attempt = 0
         self.last_ring_change: Optional[dict] = None
         self._ring_changed_unix = time.time()
         self.refresher = None      # attached by DestinationRefresher
@@ -358,6 +418,36 @@ class ProxyServer:
         with self._lock:
             self._inflight[dest] -= 1
 
+    # -- exactly-once dedup keys (ISSUE 11) ---------------------------------
+
+    def _mint_id(self) -> int:
+        """Cross-incarnation-unique id: the journal's durably reserved
+        sequence when journaling is on (utils/journal.mint_id), else a
+        process-local counter (the sender token is then process-unique,
+        so (sender, id) stays globally unique either way)."""
+        if self._journal is not None:
+            return self._journal.mint_id()
+        with self._mint_lock:
+            rid = self._mint_next
+            self._mint_next = rid + 1
+            return rid
+
+    def _mint_dedup(self, dest: str, frag: _Fragment) -> None:
+        """Give a fragment its idempotency key at delivery checkout.
+
+        A fragment keeps its key across retries, spills, and handoff
+        re-sends to the SAME destination — only then can the receiver's
+        window recognise a replay. A fragment headed somewhere its key
+        was never seen (split or moved by a reshard before any send
+        landed) re-mints: the old key means nothing to the new owner."""
+        if frag.dedup_id is None or frag.minted_for != dest:
+            frag.dedup_id = self._mint_id()
+            frag.minted_for = dest
+            frag.attempts = 0
+            frag.last_cause = None
+            with self._stats_lock:
+                self.dedup_minted += 1
+
     def _make_send(self, dest: str, frag: _Fragment):
         """One-attempt send closure over a routed fragment (the shape
         DeliveryManager drives). Clients exposing the *_or_raise API get
@@ -367,27 +457,55 @@ class ProxyServer:
 
         def send(timeout_s: float) -> None:
             client = self._conn(dest)
-            if frag.wire:
-                blob = b"".join(frag.parts)
-                fn = getattr(client, "send_raw_or_raise", None)
-                if fn is not None:
-                    fn(blob, frag.count, timeout_s)
-                elif not client.send_raw(blob, frag.count):
-                    raise rpc.ForwardError("send", dest,
-                                           "send_raw returned False")
-            else:
-                sub = pb.MetricBatch()
-                sub.metrics.extend(frag.parts)
-                fn = getattr(client, "send_or_raise", None)
-                if fn is not None:
-                    fn(sub, timeout_s)
-                elif not client.send(sub):
-                    raise rpc.ForwardError("send", dest,
-                                           "send returned False")
+            if frag.attempts > 0:
+                # a prior attempt errored but may have LANDED — this
+                # re-send is exactly what the dedup window exists for
+                with self._stats_lock:
+                    self.handoff_resend_total += 1
+                    if frag.last_cause == "deadline_exceeded":
+                        self.handoff_clipped_resend += 1
+            frag.attempts += 1
+            dedup = self.dedup and frag.dedup_id is not None
+            try:
+                if frag.wire:
+                    blob = b"".join(frag.parts)
+                    if dedup:
+                        blob = codec.encode_dedup_envelope(
+                            self._dedup_sender, frag.dedup_id,
+                            frag.count, blob)
+                    fn = getattr(client, "send_raw_or_raise", None)
+                    if fn is not None:
+                        fn(blob, frag.count, timeout_s)
+                    elif not client.send_raw(blob, frag.count):
+                        raise rpc.ForwardError("send", dest,
+                                               "send_raw returned False")
+                else:
+                    sub = pb.MetricBatch()
+                    sub.metrics.extend(frag.parts)
+                    fnr = getattr(client, "send_raw_or_raise", None)
+                    if dedup and fnr is not None:
+                        # the envelope only rides the raw path; serialize
+                        # the sub-batch and wrap it
+                        fnr(codec.encode_dedup_envelope(
+                            self._dedup_sender, frag.dedup_id,
+                            frag.count, sub.SerializeToString()),
+                            frag.count, timeout_s)
+                        return
+                    fn = getattr(client, "send_or_raise", None)
+                    if fn is not None:
+                        fn(sub, timeout_s)
+                    elif not client.send(sub):
+                        raise rpc.ForwardError("send", dest,
+                                               "send returned False")
+            except rpc.ForwardError as e:
+                frag.last_cause = e.cause
+                raise
 
         return send
 
     def _deliver_fragment(self, dest: str, frag: _Fragment) -> str:
+        if self.dedup:
+            self._mint_dedup(dest, frag)
         man = self._checkout_manager(dest)
         try:
             outcome = man.deliver(self._make_send(dest, frag),
@@ -406,6 +524,8 @@ class ProxyServer:
     def _defer_fragment(self, dest: str, frag: _Fragment) -> str:
         """Park a fragment in dest's spill without a network attempt —
         the bounded-handoff path when the reshard window runs out."""
+        if self.dedup:
+            self._mint_dedup(dest, frag)
         man = self._checkout_manager(dest)
         try:
             outcome = man.defer(self._make_send(dest, frag),
@@ -534,7 +654,25 @@ class ProxyServer:
         """Split a drained fragment under the CURRENT ring and re-
         deliver each piece; past the handoff deadline, pieces park on
         their new owner's spill without a network attempt (bounded
-        handoff). An empty ring declares the drop."""
+        handoff). An empty ring declares the drop.
+
+        Dedup mode: a fragment whose prior attempt may have LANDED
+        (attempts > 0 — e.g. a deadline-clipped send the receiver
+        actually merged) must NOT be split or moved: only its original
+        destination's window knows the key, so the whole fragment goes
+        back to `minted_for` while it remains a member. If the reshard
+        removed `minted_for`, splitting re-mints and we degrade to
+        at-least-once for that fragment — counted, never silent."""
+        if (self.dedup and frag.dedup_id is not None
+                and frag.attempts > 0):
+            if frag.minted_for in self.ring.view().members:
+                if time.monotonic() >= deadline_mono:
+                    self._defer_fragment(frag.minted_for, frag)
+                else:
+                    self._deliver_fragment(frag.minted_for, frag)
+                return
+            with self._stats_lock:
+                self.dedup_remint_after_attempt += 1
         try:
             if frag.wire:
                 owners = self.ring.owners_for_hashes(frag.meta)
@@ -555,6 +693,14 @@ class ProxyServer:
             metas.append(meta)
         for dest, (parts, metas) in groups.items():
             nf = _Fragment(frag.wire, parts, metas)
+            if (frag.dedup_id is not None and len(groups) == 1
+                    and dest == frag.minted_for):
+                # unsplit, unmoved: a pure retry keeps its key (and its
+                # attempt history) so the receiver recognises the replay
+                nf.dedup_id = frag.dedup_id
+                nf.minted_for = frag.minted_for
+                nf.attempts = frag.attempts
+                nf.last_cause = frag.last_cause
             if time.monotonic() >= deadline_mono:
                 self._defer_fragment(dest, nf)
             else:
@@ -693,6 +839,17 @@ class ProxyServer:
                 "last_ring_change": self.last_ring_change,
                 "ring_age_s": round(
                     time.time() - self._ring_changed_unix, 3),
+                "handoff": {
+                    "resend_total": self.handoff_resend_total,
+                    "clipped_resend": self.handoff_clipped_resend,
+                },
+                "dedup": {
+                    "enabled": self.dedup,
+                    "sender": self._dedup_sender,
+                    "minted": self.dedup_minted,
+                    "remint_after_attempt":
+                        self.dedup_remint_after_attempt,
+                },
             }
         out.update({
             "ring_version": self.ring.version,

@@ -261,6 +261,13 @@ class DatadogMetricSink(MetricSink):
         """Hand one serialized body to the delivery layer; the sink's
         own flushed counter advances inside the send closure so a
         spilled body delivered a later interval still counts."""
+        # every body carries a crash-stable idempotency key: the header
+        # is journaled WITH the body (HttpEnvelope below), so a replayed
+        # POST after SIGKILL reuses the key and an idempotent receiver
+        # can 2xx the replay without double-counting
+        headers = dict(headers)
+        headers["Idempotency-Key"] = self.delivery.mint_key()
+
         def send(timeout: float) -> None:
             post_bytes(url, body, headers, timeout, self.opener)
             self.flushed_metrics += count
@@ -415,6 +422,8 @@ class DatadogSpanSink(SpanSink):
         self.delivery.begin_flush()
         self.delivery.retry_spill()
         body, hdrs = json_body(list(traces.values()))
+        hdrs = dict(hdrs)
+        hdrs["Idempotency-Key"] = self.delivery.mint_key()
 
         def send(timeout: float) -> None:
             post_bytes(f"{self.trace_api_address}/v0.3/traces",

@@ -327,6 +327,9 @@ class DeliveryManager:
         self.journal_append_failed = 0
         self.journal_recovered = 0    # payloads replayed from a prior
         self.journal_decode_failed = 0  # incarnation's journal
+        # idempotency-key minting (mint_key): sender token + sequence
+        self._mint_sender: Optional[str] = None
+        self._mint_next = 0
 
     # -- durability hooks ---------------------------------------------------
 
@@ -387,6 +390,33 @@ class DeliveryManager:
             log.info("sink %s: recovered %d journaled payload(s) into "
                      "spill", self.sink_name, recovered)
         return recovered
+
+    def mint_key(self) -> str:
+        """Idempotency key for one outbound payload (``sender:id``).
+
+        With a journal attached, ids come from the journal's durably
+        reserved sequence (utils/journal.mint_id) and the sender token
+        lives in the journal directory — so a payload journaled with its
+        ``Idempotency-Key`` header and replayed after a crash re-POSTs
+        under the SAME key, and a receiver that remembers keys can 2xx
+        the replay without double-counting. Without a journal the sender
+        token is process-unique (a restart is a new sender — RAM spill
+        died with the process, so nothing can replay anyway)."""
+        with self._lock:
+            journal = self._journal
+            if self._mint_sender is None:
+                if journal is not None:
+                    from veneur_tpu.utils.journal import sender_token
+
+                    self._mint_sender = sender_token(journal.directory)
+                else:
+                    import os
+
+                    self._mint_sender = os.urandom(8).hex()
+            if journal is not None:
+                return f"{self._mint_sender}:{journal.mint_id()}"
+            self._mint_next += 1
+            return f"{self._mint_sender}:{self._mint_next}"
 
     def _journal_ack_locked(self, entry: "_SpillEntry") -> None:
         """Terminal outcome for a journaled entry (caller holds _lock)."""

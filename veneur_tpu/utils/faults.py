@@ -15,6 +15,10 @@ layer (sinks/delivery.py) classifies:
 - mid-body reset     → ConnectionResetError after a partial-write delay
                        (retryable)
 - payload rejection  → HTTPError(400) (PERMANENT: never retried)
+- duplicate delivery → after a successful send, the same payload is
+                       sent again (and replay_last() re-sends it on
+                       demand, e.g. across a receiver restart) — the
+                       at-least-once artifact exactly-once dedup absorbs
 - flap schedules     → down_ranges of call indices that hard-refuse,
                        bracketed so breaker open→half-open→closed
                        cycles are reproducible on demand
@@ -35,7 +39,8 @@ from typing import Callable, Optional
 
 from veneur_tpu.utils.http import HTTPError
 
-FAULT_KINDS = ("refused", "http_5xx", "slow", "reset", "rejected", "passed")
+FAULT_KINDS = ("refused", "http_5xx", "slow", "reset", "rejected",
+               "duplicated", "passed")
 
 
 @dataclass
@@ -50,6 +55,12 @@ class FaultPlan:
     p_slow: float = 0.0
     p_reset: float = 0.0
     p_reject: float = 0.0
+    # duplicate-injection (ISSUE 11): after a SUCCESSFUL send, re-send
+    # the same payload — the network artifact exactly-once dedup exists
+    # to absorb. Drawn separately from the failure kinds (a duplicate
+    # is not a failure), and only when > 0, so plans without it keep
+    # their exact historical decision sequences.
+    p_duplicate: float = 0.0
     slow_s: float = 0.2
     reset_after_s: float = 0.01   # partial body went out, then RST
     status_5xx: int = 503
@@ -99,6 +110,19 @@ class _FaultBase:
             self.injected[kind] += 1
             return kind
 
+    def _dup_decide(self) -> bool:
+        """Separate post-success draw: should the payload that just
+        landed be sent again? Guarded on p_duplicate > 0 so plans
+        without duplication consume no extra RNG draws (their decision
+        sequences stay bit-identical to pre-dedup runs)."""
+        with self._lock:
+            if self.plan.p_duplicate <= 0.0:
+                return False
+            if self._rng.random() >= self.plan.p_duplicate:
+                return False
+            self.injected["duplicated"] += 1
+            return True
+
     def _raise_for(self, kind: str, timeout: float) -> None:
         """Apply one non-pass decision (caller handles 'passed' /
         'slow'-then-success itself)."""
@@ -136,8 +160,19 @@ class FaultyOpener(_FaultBase):
         elif kind != "passed":
             self._raise_for(kind, timeout)
         if self.inner is not None:
-            return self.inner(req, timeout)
-        return b"{}"
+            out = self.inner(req, timeout)
+        else:
+            out = b"{}"
+        if self._dup_decide():
+            # the request landed, then the network replayed it (retried
+            # POST whose first response was lost); best-effort — a real
+            # ghost retry failing changes nothing for the original
+            try:
+                if self.inner is not None:
+                    self.inner(req, timeout)
+            except Exception:
+                pass
+        return out
 
 
 class FaultyForwardClient(_FaultBase):
@@ -157,6 +192,9 @@ class FaultyForwardClient(_FaultBase):
         self.inner = inner
         self.address = getattr(inner, "address", "?")
         self._partitioned = False
+        # last successfully delivered payload, for p_duplicate re-sends
+        # and harness-scripted replay_last() across a receiver restart
+        self._last_sent: Optional[tuple] = None
 
     def set_partitioned(self, on: bool) -> None:
         with self._lock:
@@ -193,11 +231,46 @@ class FaultyForwardClient(_FaultBase):
     def send_or_raise(self, batch, timeout_s=None) -> None:
         self._gate(timeout_s)
         self.inner.send_or_raise(batch, timeout_s)
+        with self._lock:
+            self._last_sent = ("batch", batch, None)
+        if self._dup_decide():
+            try:
+                self.inner.send_or_raise(batch, timeout_s)
+            except Exception:
+                pass  # ghost retry; the original already landed
 
     def send_raw_or_raise(self, blob: bytes, n_metrics: int,
                           timeout_s=None) -> None:
         self._gate(timeout_s)
         self.inner.send_raw_or_raise(blob, n_metrics, timeout_s)
+        with self._lock:
+            self._last_sent = ("raw", blob, n_metrics)
+        if self._dup_decide():
+            try:
+                self.inner.send_raw_or_raise(blob, n_metrics, timeout_s)
+            except Exception:
+                pass  # ghost retry; the original already landed
+
+    def replay_last(self, timeout_s=None) -> bool:
+        """Harness hook: re-deliver the last successfully sent payload
+        verbatim — the scripted 'network replays an old frame across a
+        receiver restart' fault the churn soak drives. Returns False if
+        nothing has been delivered yet. Counted under 'duplicated'."""
+        with self._lock:
+            last = self._last_sent
+        if last is None:
+            return False
+        with self._lock:
+            self.injected["duplicated"] += 1
+        kind, payload, n_metrics = last
+        try:
+            if kind == "raw":
+                self.inner.send_raw_or_raise(payload, n_metrics, timeout_s)
+            else:
+                self.inner.send_or_raise(payload, timeout_s)
+        except Exception:
+            pass  # replayed frame bounced; still counts as injected
+        return True
 
     def send(self, batch, timeout_s=None) -> bool:
         try:

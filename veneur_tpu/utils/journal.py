@@ -12,8 +12,12 @@ incarnations.  This module gives that spill a durable shadow:
   - each record is length-prefixed and CRC-checksummed:
     ``u32 body_len | u32 crc32(body) | body`` where
     ``body = type(1B) | record_id(u64 LE) | payload``;
-  - two record types: ``D`` (DATA: a spilled payload) and ``A`` (ACK: the
-    payload reached a terminal state — delivered, dropped, or evicted);
+  - three record types: ``D`` (DATA: a spilled payload), ``A`` (ACK: the
+    payload reached a terminal state — delivered, dropped, or evicted),
+    and ``R`` (RESERVE: the id space below the record's id is claimed —
+    ``mint_id`` hands out dedup ids from durably reserved blocks so an id
+    used on the wire before its payload ever spilled can still never be
+    re-minted by a later incarnation);
   - replay tolerates a **torn tail** (partial final record from a crash
     mid-append: stop that segment, keep everything before it) and
     **bit flips** (CRC-failing record mid-segment: skip it, keep going);
@@ -40,6 +44,7 @@ _HDR = struct.Struct("<II")  # body_len, crc32(body)
 _ID = struct.Struct("<Q")
 _TYPE_DATA = 0x44  # 'D'
 _TYPE_ACK = 0x41  # 'A'
+_TYPE_RESERVE = 0x52  # 'R' — rid is the exclusive upper bound of a minted block
 
 # A single journal record larger than this is insane for metric payloads;
 # a length field above it is treated as a torn/corrupt tail.
@@ -100,7 +105,7 @@ def _scan_segment(path: str) -> Tuple[List[Tuple[int, int, bytes]], int, int]:
             skipped += 1
             continue
         rtype = body[0]
-        if rtype not in (_TYPE_DATA, _TYPE_ACK):
+        if rtype not in (_TYPE_DATA, _TYPE_ACK, _TYPE_RESERVE):
             skipped += 1
             continue
         (rid,) = _ID.unpack_from(body, 1)
@@ -127,9 +132,44 @@ def scan_pending(directory: str) -> List[Tuple[int, bytes]]:
         for rtype, rid, payload in events:
             if rtype == _TYPE_DATA:
                 pending[rid] = payload
-            else:
+            elif rtype == _TYPE_ACK:
                 pending.pop(rid, None)
+            # RESERVE claims id space; it never cancels a pending DATA
     return list(pending.items())
+
+
+SENDER_TOKEN_FILE = "sender.id"
+
+
+def sender_token(directory: str) -> str:
+    """Stable per-journal sender identity for wire-level dedup keys.
+
+    A dedup id is only unique within one minting sequence; the receiver
+    keys its window on ``(sender, id)``.  The token lives next to the
+    segments (``sender.id``) so it survives restarts with the journal —
+    a wiped journal directory is a new id sequence AND a new sender, so
+    stale receiver windows can never falsely dedup the fresh sequence.
+    """
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, SENDER_TOKEN_FILE)
+    try:
+        with open(path, "r", encoding="ascii") as fh:
+            tok = fh.read().strip()
+        if tok:
+            return tok
+    except OSError:
+        pass
+    tok = os.urandom(8).hex()
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="ascii") as fh:
+            fh.write(tok)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        pass  # unwritable dir: token is process-lifetime only
+    return tok
 
 
 class SpillJournal:
@@ -172,12 +212,18 @@ class SpillJournal:
         self._seg_pending: Dict[int, Dict[int, None]] = {}
         self._seg_sizes: Dict[int, int] = {}
         self._next_id = 1
+        # dedup-id minting: ids below _reserved_to are durably claimed by
+        # a RESERVE record, so mint_id() is one fsync per block, not per id
+        self._reserved_to = 1
+        self.reserve_block = 4096
         # payloads recovered at open, released by replay_pending()
         self._recovered: List[Tuple[int, bytes]] = []
         # counters
         self.appended = 0
         self.acked = 0
         self.append_failed = 0
+        self.minted = 0
+        self.reserved_blocks = 0
         self.replayed = 0
         self.skipped_corrupt = 0
         self.torn_tails = 0
@@ -207,6 +253,11 @@ class SpillJournal:
             self._seg_pending.setdefault(seq, {})
             max_seq = max(max_seq, seq)
             for rtype, rid, payload in events:
+                if rtype == _TYPE_RESERVE:
+                    # rid is an exclusive bound: the previous incarnation
+                    # may have minted any id below it onto the wire
+                    max_id = max(max_id, rid - 1)
+                    continue
                 max_id = max(max_id, rid)
                 if rtype == _TYPE_DATA:
                     pending[rid] = payload
@@ -221,6 +272,7 @@ class SpillJournal:
         self._pending_seg = pending_seg
         self._recovered = list(pending.items())
         self._next_id = max_id + 1
+        self._reserved_to = self._next_id  # no live reservation headroom
         # Never append to a pre-existing segment (its tail may be torn);
         # start a fresh one past everything seen.
         self._active_seq = max_seq + 1
@@ -242,6 +294,17 @@ class SpillJournal:
         self._active_size = os.path.getsize(path)
         self._seg_sizes[seq] = self._active_size
         self._seg_pending.setdefault(seq, {})
+        if self._reserved_to > self._next_id:
+            # Re-assert the live reservation in the fresh segment: the
+            # active segment is never evicted, so compaction deleting the
+            # segment the original R landed in can't lose the bound.
+            if self._write_record(
+                bytes([_TYPE_RESERVE]) + _ID.pack(self._reserved_to)
+            ):
+                try:
+                    os.fsync(self._fh.fileno())
+                except OSError:
+                    pass
         self._sync_dir()
 
     def _sync_dir(self) -> None:
@@ -292,6 +355,43 @@ class SpillJournal:
             self._pending_seg[rid] = self._active_seq
             self._seg_pending.setdefault(self._active_seq, {})[rid] = None
             self._enforce_caps()
+            return rid
+
+    def mint_id(self) -> int:
+        """Mint an id unique across incarnations WITHOUT journaling data.
+
+        ``append`` already makes spilled payloads' ids crash-unique; this
+        extends the same discipline to ids used purely as wire dedup keys
+        (in-flight fragments that may never spill).  Ids come from the
+        same sequence as record ids, pre-claimed in durable blocks: one
+        RESERVE record (fsynced regardless of policy) covers the next
+        ``reserve_block`` mints, so a restarted incarnation resumes past
+        everything a dead one could possibly have put on the wire.
+        """
+        with self._lock:
+            if self._next_id >= self._reserved_to:
+                bound = self._next_id + max(1, int(self.reserve_block))
+                body = bytes([_TYPE_RESERVE]) + _ID.pack(bound)
+                if self._active_size + _HDR.size + len(body) > self.segment_bytes:
+                    self._roll_to(self._active_seq + 1)
+                    self._enforce_caps()
+                if self._write_record(body):
+                    # the reservation must hit the platter BEFORE any id
+                    # from the block rides the wire as a dedup key
+                    try:
+                        assert self._fh is not None
+                        os.fsync(self._fh.fileno())
+                    except (OSError, AssertionError):
+                        pass
+                    self.reserved_blocks += 1
+                else:
+                    # degraded disk: keep minting (uniqueness within this
+                    # incarnation still holds); counted, never silent
+                    self.append_failed += 1
+                self._reserved_to = bound
+            rid = self._next_id
+            self._next_id = rid + 1
+            self.minted += 1
             return rid
 
     def ack(self, rid: int) -> None:
@@ -407,6 +507,8 @@ class SpillJournal:
                 "appended": self.appended,
                 "acked": self.acked,
                 "append_failed": self.append_failed,
+                "minted": self.minted,
+                "reserved_blocks": self.reserved_blocks,
                 "replayed": self.replayed,
                 "skipped_corrupt": self.skipped_corrupt,
                 "torn_tails": self.torn_tails,
