@@ -1,0 +1,387 @@
+"""Columnar SSF span pipeline tests.
+
+Pins the subsystem's one non-negotiable property: the columnar path
+(veneur_tpu/spans/) derives bit-identical metrics to the per-span Python
+reference (core/spans.py convert_* functions) for every metric class —
+t-digest timers/histograms, counters, gauges, sets, status — under
+micro-fold on/off, series_shards, and multi-worker routing. Plus the
+VSB1 wire format, the batch sink's DeliveryManager conservation, the
+segmented-log writer, tenancy admission of span-derived series, and the
+ingress-stats span conservation ledger."""
+
+import time
+
+import pytest
+
+from veneur_tpu import ssf
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.delivery import DeliveryPolicy
+from veneur_tpu.spans import (
+    ColumnarSpanPipeline,
+    SpanBatchSink,
+    SpanColumnizer,
+    StringArena,
+    TemplateStore,
+    columnar_enabled,
+    decode_batch,
+    encode_batch,
+)
+from veneur_tpu.spans.sink import SegmentedLogWriter, read_segmented_log
+
+
+@pytest.fixture(autouse=True)
+def _env_neutral(monkeypatch):
+    """These tests choose the path per-server via span_columnar config
+    (the parity sweep runs BOTH paths in one test), so the CI lane's
+    VENEUR_SPAN_COLUMNAR hatch must not override them."""
+    monkeypatch.delenv("VENEUR_SPAN_COLUMNAR", raising=False)
+
+
+def _span(**kw) -> ssf.SSFSpan:
+    base = dict(
+        trace_id=5, id=6, parent_id=1,
+        start_timestamp=1_000_000_000, end_timestamp=2_000_000_000,
+        service="svc", name="op",
+    )
+    base.update(kw)
+    return ssf.SSFSpan(**base)
+
+
+def _mk_spans(n: int = 60) -> list[ssf.SSFSpan]:
+    """A deterministic mixed workload: every SSF sample kind, invalid
+    samples (empty name), invalid trace spans (end=0), root spans
+    (id == trace_id), empty services, and ssf_objective overrides."""
+    spans = []
+    for i in range(n):
+        tags = {"host": "h%d" % (i % 3)}
+        if i % 5 == 0:
+            tags["ssf_objective"] = "obj%d" % (i % 2)
+        metrics = []
+        if i % 2 == 0:
+            metrics.append(
+                ssf.count("par.hits", float(i % 7 + 1), {"k": "v%d" % (i % 4)}))
+        if i % 3 == 0:
+            metrics.append(ssf.gauge("par.load", float(i)))
+        if i % 4 == 0:
+            metrics.append(ssf.timing_ns("par.latency", 1000 + i))
+        if i % 6 == 0:
+            metrics.append(ssf.set_sample("par.users", "u%d" % (i % 5), {"k": "v"}))
+        if i % 7 == 0:
+            metrics.append(ssf.status("par.check", 1, "warn"))
+        if i % 11 == 0:
+            metrics.append(ssf.count("", 1.0))  # invalid: empty name
+        spans.append(_span(
+            trace_id=100 + i,
+            id=(100 + i) if i % 9 == 0 else 500 + i,  # some roots
+            start_timestamp=10 ** 9 + i * 1000,
+            # i % 13 == 0 → end 0: invalid trace span, indicator skipped
+            end_timestamp=(10 ** 9 + i * 1000 + 50_000) if i % 13 else 0,
+            service=("svc-%d" % (i % 2)) if i % 8 else "",
+            name="op%d" % (i % 6),
+            indicator=(i % 3 == 0),
+            error=(i % 4 == 0),
+            tags=tags,
+            metrics=metrics,
+        ))
+    return spans
+
+
+def _materialize(out):
+    return out.materialize() if hasattr(out, "materialize") else out
+
+
+def _norm(metrics):
+    return sorted(
+        (m.name, str(m.type), tuple(m.tags), m.timestamp,
+         repr(m.value), m.message, m.hostname)
+        for m in _materialize(metrics))
+
+
+# ---------------------------------------------------------------------------
+# Bit-identical derivation vs the per-span Python reference
+
+
+_PARITY_CASES = [
+    ({}, "default"),
+    ({"micro_fold": False}, "no_micro_fold"),
+    ({"series_shards": 2}, "series_shards"),
+    ({"num_workers": 2}, "two_workers"),
+    ({"ssf_span_uniqueness_rate": 0.0}, "no_uniqueness"),
+]
+
+
+@pytest.mark.parametrize(
+    "overrides", [c for c, _ in _PARITY_CASES],
+    ids=[name for _, name in _PARITY_CASES])
+def test_columnar_matches_python_derivation(overrides):
+    """Flush output of the columnar server equals the per-span reference
+    bit-for-bit (same templates, values, tags, digests → same sketch
+    folds) across metric classes and routing configs. uniqueness rate is
+    pinned to 1.0/0.0 — fractional rates consult the global RNG on both
+    paths and would diverge."""
+    base = dict(
+        interval="10s",
+        indicator_span_timer_name="ssf.indicator",
+        objective_span_timer_name="ssf.objective",
+        ssf_span_uniqueness_rate=1.0,
+    )
+    base.update(overrides)
+    srv1 = Server(Config(**base))
+    srv2 = Server(Config(**dict(base, span_columnar=False)))
+    assert srv1.span_pipeline is not None
+    assert srv2.span_pipeline is None
+    try:
+        for s in _mk_spans():
+            srv1.handle_ssf(s)
+        # reference path: ingest synchronously through the extraction
+        # sink, exactly what a span-worker lane consumer executes
+        for s in _mk_spans():
+            srv2._extraction_sink.ingest(s)
+        now = time.time()
+        out1 = _norm(srv1.flush(now=now))
+        out2 = _norm(srv2.flush(now=now))
+        assert out1, "workload must derive at least one metric"
+        assert out1 == out2
+    finally:
+        srv1.shutdown()
+        srv2.shutdown()
+
+
+def test_columnar_env_hatch_disables_pipeline(monkeypatch):
+    monkeypatch.setenv("VENEUR_SPAN_COLUMNAR", "0")
+    assert not columnar_enabled(True)
+    srv = Server(Config(interval="10s"))
+    try:
+        assert srv.span_pipeline is None
+    finally:
+        srv.shutdown()
+    # the hatch overrides in both directions; unset defers to config
+    monkeypatch.setenv("VENEUR_SPAN_COLUMNAR", "1")
+    assert columnar_enabled(False)
+    monkeypatch.delenv("VENEUR_SPAN_COLUMNAR")
+    assert columnar_enabled(True)
+    assert not columnar_enabled(False)
+
+
+def test_span_sink_without_batch_support_forces_legacy_path():
+    from veneur_tpu.sinks.channel import ChannelSpanSink
+
+    srv = Server(Config(interval="10s"), span_sinks=[ChannelSpanSink()])
+    try:
+        assert srv.span_pipeline is None
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Tenancy: span-derived series admit through the ledger like any other
+
+
+def test_span_derived_series_respect_tenant_budget():
+    cfg = Config(
+        interval="10s",
+        indicator_span_timer_name="ssf.indicator",
+        objective_span_timer_name="ssf.objective",
+        ssf_span_uniqueness_rate=0.0,
+        tenant_tag_key="service",
+        tenant_default_budget=2,
+    )
+    srv = Server(cfg)
+    try:
+        assert srv.span_pipeline is not None
+        assert srv.tenant_ledger is not None
+        # each span mints a distinct objective series for tenant "svc"
+        for i in range(20):
+            srv.handle_ssf(_span(
+                trace_id=1000 + i, id=2000 + i, indicator=True,
+                tags={"ssf_objective": "obj%d" % i}))
+        srv.flush()
+        assert sum(srv.tenant_ledger.series_rejected.values()) > 0
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Ingress-stats span conservation
+
+
+def test_ingress_stats_span_conservation():
+    srv = Server(Config(interval="10s"))
+    try:
+        for s in _mk_spans(10):
+            srv.handle_ssf(s)
+        srv.flush()
+        stats = srv.ingress_stats()["spans"]
+        assert stats["columnar"] is True
+        assert stats["received"] >= 10
+        assert stats["received"] == (
+            stats["derived"] + stats["dropped"] + stats["pending"])
+    finally:
+        srv.shutdown()
+
+
+def test_pipeline_pending_cap_sheds_conserved():
+    routed = []
+    pipe = ColumnarSpanPipeline(
+        route_many=routed.extend, batch_sinks=[], common_tags={},
+        batch_rows=2, pending_cap=4)
+    for i in range(9):
+        pipe.ingest(_span(trace_id=50 + i, id=60 + i))
+    assert pipe.spans_dropped > 0
+    assert pipe.spans_ingested + pipe.spans_dropped == 9
+    spans, _rows = pipe.flush()
+    assert spans == pipe.spans_ingested
+    assert pipe.pending == 0
+
+
+# ---------------------------------------------------------------------------
+# VSB1 wire format
+
+
+def _sealed_batch(n=5):
+    arena = StringArena()
+    store = TemplateStore(arena, "ssf.indicator", "ssf.objective")
+    col = SpanColumnizer(arena, store, {"env": "prod"})
+    for s in _mk_spans(n):
+        assert col.append(s)
+    batches = col.take_sealed()
+    assert len(batches) == 1
+    return batches[0]
+
+
+def test_vsb1_roundtrip():
+    sealed = _sealed_batch()
+    frame = encode_batch(sealed)
+    dec = decode_batch(frame)
+    assert dec["rows"] == sealed.batch.rows
+    assert len(dec["samples"]) == sealed.batch.samples
+    # decoded columns match the batch arrays value-for-value
+    assert list(dec["columns"]["trace_id"]) == list(sealed.batch.trace_id)
+    assert list(dec["columns"]["start_ns"]) == list(sealed.batch.start_ns)
+    # interned strings survive the local-table remap
+    names = {dec["strings"][sid] for sid in dec["columns"]["name"]}
+    assert names == {sealed.arena.strings[sid]
+                     for sid in sealed.batch.name_id}
+
+
+def test_vsb1_rejects_corruption():
+    frame = encode_batch(_sealed_batch())
+    with pytest.raises(ValueError):
+        decode_batch(b"XXXX" + frame[4:])  # bad magic
+    flipped = bytearray(frame)
+    flipped[len(frame) // 2] ^= 0xFF
+    with pytest.raises(ValueError):
+        decode_batch(bytes(flipped))  # CRC mismatch
+    with pytest.raises(ValueError):
+        decode_batch(frame[:-3])  # truncated
+    with pytest.raises(ValueError):
+        decode_batch(frame + b"\x00")  # trailing garbage
+
+
+# ---------------------------------------------------------------------------
+# Batch sink: DeliveryManager conservation, spill → heal → redeliver
+
+
+class _FlakyWriter:
+    def __init__(self):
+        self.fail = True
+        self.payloads = []
+
+    def write(self, payload: bytes, timeout_s: float) -> None:
+        if self.fail:
+            raise ConnectionResetError("backend down")
+        self.payloads.append(payload)
+
+
+def test_span_batch_sink_spills_then_redelivers():
+    writer = _FlakyWriter()
+    policy = DeliveryPolicy(
+        retry_max=0, breaker_threshold=0, backoff_base_s=0.0,
+        backoff_max_s=0.0, timeout_s=0.5, deadline_s=5.0)
+    sink = SpanBatchSink(writer, name="flaky", delivery=policy,
+                         batch_rows=4)
+    for i in range(6):
+        sink.ingest(_span(trace_id=10 + i, id=20 + i,
+                          metrics=[ssf.count("s.c", 1.0)]))
+    sink.flush()
+    man = sink.delivery
+    # transient failure with no retry budget → both batches spilled
+    assert sink.spans_deferred == 6
+    assert len(man.spill) == 2
+    assert man.conserved()
+    writer.fail = False
+    sink.flush()  # retry_spill drains ahead of (empty) fresh data
+    assert len(man.spill) == 0
+    assert man.delivered_payloads == man.accepted_payloads == 2
+    assert man.conserved()
+    assert len(writer.payloads) == 2
+    for frame in writer.payloads:
+        decode_batch(frame)  # spilled bytes are intact VSB1
+
+
+def test_span_batch_sink_permanent_error_drops_conserved():
+    class _BadPayloadWriter:
+        def write(self, payload, timeout_s):
+            raise ValueError("payload rejected")  # non-retryable
+
+    sink = SpanBatchSink(_BadPayloadWriter(), name="perm",
+                         delivery=DeliveryPolicy(retry_max=0,
+                                                 breaker_threshold=0))
+    for i in range(3):
+        sink.ingest(_span(trace_id=30 + i, id=40 + i))
+    sink.flush()
+    assert sink.spans_dropped == 3
+    assert sink.delivery.conserved()
+    assert sink.delivery.dropped_payloads == 1
+
+
+def test_span_batch_sink_pending_cap_drops():
+    sink = SpanBatchSink(_FlakyWriter(), name="cap", batch_rows=2)
+    sink.MAX_PENDING_BATCHES = 1
+    col = SpanColumnizer(StringArena(),
+                         TemplateStore(StringArena()), {}, batch_rows=2)
+    for i in range(6):
+        col.append(_span(trace_id=70 + i, id=80 + i))
+    batches = col.take_sealed()
+    assert len(batches) == 3
+    for sb in batches:
+        sink.ingest_batch(sb)
+    # one adopted, two shed at the cap — rows declared dropped
+    assert sink.spans_dropped == 4
+
+
+# ---------------------------------------------------------------------------
+# Segmented log writer
+
+
+def test_segmented_log_rotation_and_readback(tmp_path):
+    d = str(tmp_path / "spanlog")
+    w = SegmentedLogWriter(d, max_segment_bytes=1, max_segments=3)
+    frames = [encode_batch(_sealed_batch(n)) for n in (2, 3, 4, 5)]
+    for f in frames:
+        w.write(f, timeout_s=1.0)
+    w.close()
+    # 1-byte segments force rotation per write; cap 3 drops the oldest
+    back = read_segmented_log(d)
+    assert back == frames[-3:]
+    # a fresh writer resumes the sequence instead of clobbering
+    w2 = SegmentedLogWriter(d, max_segment_bytes=1, max_segments=3)
+    extra = encode_batch(_sealed_batch(6))
+    w2.write(extra, timeout_s=1.0)
+    w2.close()
+    assert read_segmented_log(d)[-1] == extra
+
+
+def test_segmented_log_stops_at_torn_tail(tmp_path):
+    d = str(tmp_path / "torn")
+    w = SegmentedLogWriter(d, max_segment_bytes=1 << 20)
+    good = encode_batch(_sealed_batch(2))
+    w.write(good, timeout_s=1.0)
+    w.write(encode_batch(_sealed_batch(3)), timeout_s=1.0)
+    w.close()
+    seg = sorted((tmp_path / "torn").iterdir())[0]
+    data = seg.read_bytes()
+    seg.write_bytes(data[:len(data) - 5])  # tear the last record
+    back = read_segmented_log(d)
+    assert back == [good]

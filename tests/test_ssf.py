@@ -600,3 +600,177 @@ def test_unknown_enum_values_ride_through_decode():
     metrics, invalid = convert_metrics(span)
     assert [m.key.name for m in metrics] == ["ok.counter"]
     assert invalid == 1
+
+
+# ---------------------------------------------------------------------------
+# _SinkLane accounting (per-sink queue + consumer isolation)
+
+
+class _GatedSink:
+    """A span sink whose ingest blocks on an Event — the wedged-backend
+    stand-in for lane accounting tests."""
+
+    def __init__(self, gate: threading.Event):
+        self.gate = gate
+
+    def name(self) -> str:
+        return "gated"
+
+    def start(self, trace_client=None) -> None:
+        pass
+
+    def ingest(self, span) -> None:
+        self.gate.wait(10)
+
+    def flush(self) -> None:
+        pass
+
+
+def test_sink_lane_oldest_busy_tracks_wedged_consumer():
+    """oldest_busy() is 0.0 when idle and the EARLIEST in-flight start
+    when consumers are wedged — the signal the worker uses to classify a
+    lane-full drop as an ingest timeout."""
+    from veneur_tpu.core.spans import _SinkLane
+
+    gate = threading.Event()
+    lane = _SinkLane(_GatedSink(gate), capacity=4, consumers=2)
+    assert lane.oldest_busy() == 0.0
+    lane.start()
+    try:
+        lane.put(_span(id=1))
+        lane.put(_span(id=2))
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and sum(1 for b in lane._busy if b) < 2):
+            time.sleep(0.005)
+        busy = lane.oldest_busy()
+        assert busy > 0.0
+        assert busy == min(b for b in lane._busy if b)
+    finally:
+        gate.set()
+    assert lane.drain(time.monotonic() + 5)
+    assert lane.oldest_busy() == 0.0
+    lane.stop()
+
+
+def test_sink_lane_put_nonblocking_when_full():
+    from veneur_tpu.core.spans import _SinkLane
+
+    gate = threading.Event()
+    lane = _SinkLane(_GatedSink(gate), capacity=1)
+    lane.start()
+    try:
+        lane.put(_span(id=1))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not lane.oldest_busy():
+            time.sleep(0.005)
+        assert lane.put(_span(id=2)) is True   # fills the single slot
+        assert lane.put(_span(id=3)) is False  # full: refused, no block
+    finally:
+        gate.set()
+    lane.stop()
+
+
+def test_sink_lane_stop_never_blocks_on_full_lane():
+    """stop() must deliver its sentinel even when the lane is full of a
+    wedged sink's spans (the shutdown scenario the lane design exists
+    for): it discards queued spans to make room rather than blocking."""
+    from veneur_tpu.core.spans import _SinkLane
+
+    gate = threading.Event()
+    lane = _SinkLane(_GatedSink(gate), capacity=1)
+    lane.start()
+    try:
+        lane.put(_span(id=1))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not lane.oldest_busy():
+            time.sleep(0.005)
+        assert lane.put(_span(id=2)) is True
+        assert lane.put(_span(id=3)) is False
+        stopped = threading.Event()
+
+        def stopper():
+            lane.stop()
+            stopped.set()
+
+        threading.Thread(target=stopper, daemon=True).start()
+        # the sentinel insert must not hang on the full queue; the only
+        # wait left is joining the wedged consumer, released here
+        time.sleep(0.05)
+    finally:
+        gate.set()
+    assert stopped.wait(5)
+
+
+def test_lane_drop_vs_ingest_timeout_attribution():
+    """A lane-full drop while the consumer has been busy LONGER than
+    sink_timeout_s counts as an ingest timeout (the reference's
+    worker.span.ingest_timeout_total); a fresh-burst overflow counts as
+    a plain lane drop. The split is what separates 'sink is wedged'
+    from 'traffic burst' on dashboards."""
+    # burst case: enormous timeout, consumer busy only briefly
+    gate = threading.Event()
+    w = SpanWorker([_GatedSink(gate)], capacity=1, sink_timeout_s=60.0)
+    w.start()
+    try:
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and not w.lane_drops.get("gated")):
+            w.ingest(_span(id=1))
+            time.sleep(0.002)
+        assert w.lane_drops.get("gated", 0) >= 1
+        assert w.ingest_timeouts.get("gated", 0) == 0
+    finally:
+        gate.set()
+        w.stop()
+
+    # wedge case: tiny timeout, consumer stuck well past it
+    gate2 = threading.Event()
+    w2 = SpanWorker([_GatedSink(gate2)], capacity=1, sink_timeout_s=0.05)
+    w2.start()
+    try:
+        w2.ingest(_span(id=1))
+        time.sleep(0.2)  # let the in-flight ingest age past the timeout
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and not w2.ingest_timeouts.get("gated")):
+            w2.ingest(_span(id=2))
+            time.sleep(0.002)
+        assert w2.ingest_timeouts.get("gated", 0) >= 1
+    finally:
+        gate2.set()
+        w2.stop()
+
+
+def test_span_flush_drain_budget_honored():
+    """flush_drain_s=0 (config span_flush_drain_s) skips the lane-drain
+    wait entirely: flush returns immediately even with a wedged sink."""
+    gate = threading.Event()
+    w = SpanWorker([_GatedSink(gate)], capacity=4, flush_drain_s=0.0)
+    w.start()
+    try:
+        w.ingest(_span(id=1))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and w.pending() == 0:
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        w.flush()
+        assert time.monotonic() - t0 < 0.25
+    finally:
+        gate.set()
+        w.stop()
+
+
+def test_span_config_validation():
+    from veneur_tpu.core.config import validate_config
+
+    with pytest.raises(ValueError):
+        validate_config(Config(span_flush_drain_s=-0.1))
+    with pytest.raises(ValueError):
+        validate_config(Config(span_batch_rows=0))
+    with pytest.raises(ValueError):
+        validate_config(Config(span_pending_cap=0))
+    with pytest.raises(ValueError):
+        validate_config(Config(kafka_span_serialization_format="msgpack"))
+    # columnar is a legal kafka span format
+    validate_config(Config(kafka_span_serialization_format="columnar"))

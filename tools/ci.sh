@@ -200,6 +200,37 @@ timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python tools/bench_sustained.py --smoke --rate 50000 --intervals 5 \
     --interval 2s --min-cadence 0.7 --keys 2000 --flush-pipeline
 
+# Span-parity lane: the columnar SSF pipeline (veneur_tpu/spans/) must
+# derive metrics BIT-identical to the per-span Python reference for
+# every metric class, with series shards and micro-folds on and off.
+# Runs twice, mirroring the micro-fold lane: default (columnar on) and
+# with the escape hatch thrown (VENEUR_SPAN_COLUMNAR=0) — a derivation
+# drift is named by the first pass, a broken per-span fallback (the
+# SpanWorker lanes the columnar path replaced as default) by the
+# second, which also re-runs the SSF suite on the legacy path.
+echo "== span-parity lane (columnar on + escape hatch) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_spans_columnar.py -q -m 'not slow'
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_SPAN_COLUMNAR=0 \
+  python -m pytest tests/test_spans_columnar.py tests/test_ssf.py \
+    -q -m 'not slow'
+
+# SSF sustained-rate floor: mixed statsd+SSF traffic (10% spans) with
+# the columnar pipeline deriving span metrics on the flush path; gates
+# the SSF packet path (zero loss), spans actually arriving, and exact
+# span conservation (received == derived + dropped + pending) at a
+# rate well under the rig's measured headroom. The cadence floor is
+# deliberately loose here: span-derived series perturb XLA shapes for
+# the first few intervals on the 1-core rig, so tick-deferral noise is
+# expected — the statsd lane above owns the strict cadence gate.
+# Artifact stays in /tmp — the committed SPAN_SUSTAINED.json is the
+# full search run.
+echo "== SSF sustained-rate smoke (span workload + conservation gate) =="
+timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python tools/bench_sustained.py --smoke --workload ssf --rate 20000 \
+    --intervals 4 --interval 2s --min-cadence 0.25 --keys 1000 \
+    --flush-pipeline --out "${TMPDIR:-/tmp}/SPAN_SUSTAINED_SMOKE.json"
+
 echo "== test suite =="
 python -m pytest tests/ -q
 

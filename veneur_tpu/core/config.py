@@ -334,6 +334,24 @@ class Config:
     # span-name uniqueness Set sampling rate; the reference hardcodes 0.01
     # (sinks/ssfmetrics/metrics.go ConvertSpanUniquenessMetrics)
     ssf_span_uniqueness_rate: float = 0.01
+    # columnar span pipeline (veneur_tpu/spans/): ingest batches spans
+    # into interned columns, derivation runs at the flush edge straight
+    # into the device workers, and batch-capable sinks get sealed batches
+    # instead of per-span objects. Env escape hatch: VENEUR_SPAN_COLUMNAR=0
+    # falls back to the per-span SpanWorker path.
+    span_columnar: bool = True
+    # rows per sealed columnar batch (one VSB1 frame per batch on egress)
+    span_batch_rows: int = 512
+    # span rows buffered between flushes before ingest sheds
+    # (loss-over-stall, counted; the columnar analog of
+    # span_channel_capacity)
+    span_pending_cap: int = 1 << 20
+    # shared lane-drain budget per SpanWorker.flush pass (seconds);
+    # was a hardcoded 0.5s
+    span_flush_drain_s: float = 0.5
+    # when set, a SegmentedLogWriter SpanBatchSink appends VSB1 frames
+    # to this directory (brokerless columnar span egress)
+    span_log_dir: str = ""
 
     # sink: datadog
     datadog_api_hostname: str = ""
@@ -858,3 +876,17 @@ def validate_config(cfg: Config) -> None:
                          " mods)")
     if not (1 <= cfg.tenant_topk <= 1024):
         raise ValueError("tenant_topk must be in [1,1024]")
+    if cfg.span_flush_drain_s < 0:
+        raise ValueError("span_flush_drain_s must be >= 0 (0 skips the"
+                         " lane drain entirely; spans accepted late ship"
+                         " next flush)")
+    if cfg.span_batch_rows < 1:
+        raise ValueError("span_batch_rows must be >= 1")
+    if cfg.span_pending_cap < 1:
+        raise ValueError("span_pending_cap must be >= 1")
+    if cfg.kafka_span_serialization_format not in (
+            "protobuf", "json", "columnar"):
+        raise ValueError("kafka_span_serialization_format must be"
+                         " 'protobuf', 'json' or 'columnar' (columnar"
+                         " ships one VSB1 frame per sealed span batch"
+                         " through the delivery manager)")

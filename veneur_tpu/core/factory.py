@@ -170,11 +170,26 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
                     buffer_ms=_buf_ms(cfg.kafka_span_buffer_frequency),
                     buffer_messages=cfg.kafka_span_buffer_mesages,
                     partitioner=cfg.kafka_partitioner or "hash")
-                span_sinks.append(KafkaSpanSink(
-                    span_producer, cfg.kafka_span_topic,
-                    cfg.kafka_span_serialization_format,
-                    cfg.kafka_span_sample_rate_percent,
-                    cfg.kafka_span_sample_tag))
+                if cfg.kafka_span_serialization_format == "columnar":
+                    # columnar batch lane: one VSB1 frame per sealed
+                    # batch through the delivery manager (retry/breaker/
+                    # spill) instead of the drop-only per-span sink
+                    from veneur_tpu.spans import (
+                        KafkaBatchWriter, SpanBatchSink)
+
+                    span_sinks.append(SpanBatchSink(
+                        KafkaBatchWriter(span_producer,
+                                         cfg.kafka_span_topic),
+                        name="kafka",
+                        delivery=policy,
+                        batch_rows=cfg.span_batch_rows,
+                        pending_cap=cfg.span_pending_cap))
+                else:
+                    span_sinks.append(KafkaSpanSink(
+                        span_producer, cfg.kafka_span_topic,
+                        cfg.kafka_span_serialization_format,
+                        cfg.kafka_span_sample_rate_percent,
+                        cfg.kafka_span_sample_tag))
         except RuntimeError as e:
             log.warning("kafka sink disabled: %s", e)
 
@@ -235,6 +250,16 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
         from veneur_tpu.sinks.grpsink import FalconerSpanSink
 
         span_sinks.append(FalconerSpanSink(cfg.falconer_address))
+
+    if cfg.span_log_dir:
+        from veneur_tpu.spans import SegmentedLogWriter, SpanBatchSink
+
+        span_sinks.append(SpanBatchSink(
+            SegmentedLogWriter(cfg.span_log_dir),
+            name="span_log",
+            delivery=policy,
+            batch_rows=cfg.span_batch_rows,
+            pending_cap=cfg.span_pending_cap))
 
     if cfg.debug_flushed_metrics:
         from veneur_tpu.sinks.debug import DebugMetricSink

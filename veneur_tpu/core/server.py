@@ -29,6 +29,7 @@ from veneur_tpu.core.config import Config, parse_duration
 from veneur_tpu.core.flusher import device_quantiles, generate_inter_metrics
 from veneur_tpu.core.metrics import HistogramAggregates, InterMetric
 from veneur_tpu.core.spans import MetricExtractionSink, SpanWorker
+from veneur_tpu.spans import ColumnarSpanPipeline, columnar_enabled
 from veneur_tpu.core.worker import DeviceWorker, FlushSnapshot
 from veneur_tpu.protocol import dogstatsd, ssf_wire
 from veneur_tpu.sinks import (
@@ -213,9 +214,37 @@ class Server:
             common_tags=common_tags,
             capacity=cfg.span_channel_capacity,
             workers=cfg.num_span_workers,
+            flush_drain_s=cfg.span_flush_drain_s,
         )
+        # columnar span pipeline (veneur_tpu/spans/): on when configured
+        # and every span sink takes sealed batches; one per-span-only
+        # sink keeps the whole path on the SpanWorker lanes — a span must
+        # flow through exactly one of the two or it derives twice
+        self.span_pipeline: Optional[ColumnarSpanPipeline] = None
+        if columnar_enabled(cfg.span_columnar) and all(
+                hasattr(s, "ingest_batch") for s in self.span_sinks):
+            self.span_pipeline = ColumnarSpanPipeline(
+                route_many=self._route_many,
+                batch_sinks=self.span_sinks,
+                common_tags=common_tags,
+                indicator_timer_name=cfg.indicator_span_timer_name,
+                objective_timer_name=cfg.objective_span_timer_name,
+                uniqueness_rate=cfg.ssf_span_uniqueness_rate,
+                batch_rows=cfg.span_batch_rows,
+                pending_cap=cfg.span_pending_cap,
+            )
+        # handle_ssf's columnar fast path stands down the moment the
+        # span worker is customized at runtime (a sink appended to
+        # span_worker.span_sinks, or ingest itself tapped/replaced —
+        # established patterns for observing the span stream); the
+        # baseline length is what "uncustomized" means
+        self._span_worker_sink_count = len(self.span_worker.span_sinks)
         # per-service span ingest counters (reference server.go:1088-1101)
         self.ssf_spans_received: dict[str, int] = {}
+        # lifetime tallies for span conservation (the per-service dict
+        # swaps every flush; ingress_stats needs monotonic counts)
+        self.ssf_spans_received_total = 0
+        self._spans_native_total = 0
         self._ssf_stats_lock = threading.Lock()
 
         # installed by distributed/forward.py on local instances
@@ -480,6 +509,7 @@ class Server:
                 getattr(w, "micro_folds_total", 0) for w in self.workers),
             "last_micro_folds": getattr(self, "last_micro_folds", 0),
         }
+        out["spans"] = self._span_stats()
         if self.flush_pipeline is not None:
             out["pipeline"] = self.flush_pipeline.stats()
         delivery = {rname: man.stats()
@@ -492,6 +522,65 @@ class Server:
         if self.shutdown_stats:
             out["shutdown"] = dict(self.shutdown_stats)
         return out
+
+    def _span_stats(self) -> dict:
+        """Span conservation for the loadgen controller. On the columnar
+        path the books balance exactly:
+        received == derived + dropped + pending (received counts every
+        handle_ssf plus native-extracted spans; derived counts spans
+        whose metrics reached the workers — on device for the native
+        rows). The legacy SpanWorker path reports the same fields from
+        its channel/lane tallies; its pending is a point-in-time queue
+        depth, so the balance there is an eventual one, not an exact
+        invariant."""
+        with self._ssf_stats_lock:
+            received = self.ssf_spans_received_total
+        native = self._spans_native_total
+        received += native
+        if self.span_pipeline is not None:
+            ps = self.span_pipeline.stats()
+            # legacy-worker tallies are zero in pure columnar operation,
+            # but a runtime customization (see handle_ssf) reroutes the
+            # stream through the lanes — fold those books in so the
+            # conservation invariant survives the mixed case too
+            ext = self._extraction_sink
+            sw = self.span_worker
+            with ext._stats_lock:
+                lderived = ext.spans_seen
+                lrows = ext.derived_rows
+                linvalid = ext.invalid_samples
+            with sw._stats_lock:
+                ldropped = (sw.spans_dropped
+                            + sw.lane_drops.get(ext.name(), 0)
+                            + sw.ingest_timeouts.get(ext.name(), 0))
+            return {
+                "received": received,
+                "derived": ps["spans_derived"] + native + lderived,
+                "derived_rows": ps["derived_rows"] + lrows,
+                "dropped": ps["spans_dropped"] + ldropped,
+                "pending": ps["pending"] + sw.pending(),
+                "invalid_samples": ps["invalid_samples"] + linvalid,
+                "columnar": True,
+            }
+        ext = self._extraction_sink
+        sw = self.span_worker
+        with ext._stats_lock:
+            derived = ext.spans_seen
+            rows = ext.derived_rows
+            invalid = ext.invalid_samples
+        with sw._stats_lock:
+            dropped = (sw.spans_dropped
+                       + sw.lane_drops.get(ext.name(), 0)
+                       + sw.ingest_timeouts.get(ext.name(), 0))
+        return {
+            "received": received,
+            "derived": derived + native,
+            "derived_rows": rows,
+            "dropped": dropped,
+            "pending": sw.pending(),
+            "invalid_samples": invalid,
+            "columnar": False,
+        }
 
     def _delivery_managers(self):
         """(report name, DeliveryManager) for every sink that carries
@@ -529,6 +618,29 @@ class Server:
         i = metric.digest % len(self.workers)
         with self._worker_locks[i]:
             self.workers[i].process_metric(metric)
+
+    def _route_many(self, metrics: list) -> None:
+        """Route a burst of metrics taking each worker lock once per
+        group instead of once per metric (the columnar span pipeline
+        derives thousands of rows at the flush edge). Per-worker order is
+        exactly what per-metric _route would produce — grouping is a
+        stable partition of one FIFO stream — so sketch state stays
+        bit-identical to the per-span path."""
+        nw = len(self.workers)
+        if nw == 1:
+            with self._worker_locks[0]:
+                process = self.workers[0].process_metric
+                for m in metrics:
+                    process(m)
+            return
+        groups: dict[int, list] = {}
+        for m in metrics:
+            groups.setdefault(m.digest % nw, []).append(m)
+        for i, group in groups.items():
+            with self._worker_locks[i]:
+                process = self.workers[i].process_metric
+                for m in group:
+                    process(m)
 
     def process_metric_packet(self, datagram: bytes) -> None:
         """Split a datagram on newlines and handle each line
@@ -676,7 +788,18 @@ class Server:
         with self._ssf_stats_lock:
             self.ssf_spans_received[service] = (
                 self.ssf_spans_received.get(service, 0) + 1)
-        self.span_worker.ingest(span)
+            self.ssf_spans_received_total += 1
+        sw = self.span_worker
+        # columnar only while the worker is pristine: a runtime-appended
+        # per-span sink or a tapped/replaced ingest (both long-standing
+        # observation patterns) must keep seeing every span, so either
+        # customization routes the whole stream back through the lanes
+        if (self.span_pipeline is not None
+                and getattr(sw.ingest, "__func__", None) is SpanWorker.ingest
+                and len(sw.span_sinks) == self._span_worker_sink_count):
+            self.span_pipeline.ingest(span)
+        else:
+            sw.ingest(span)
 
     def start_ssf_udp(self, addr: str, port: int) -> int:
         sock = self._adopt_fd()
@@ -1680,6 +1803,11 @@ class Server:
                 log.exception("sink %s FlushOtherSamples failed", sink.name())
 
         _t_span = time.perf_counter()
+        if self.span_pipeline is not None:
+            # derive the interval's span batches into the workers BEFORE
+            # the epoch swap below, so a span's metrics land in the same
+            # epoch as the statsd samples that arrived beside it
+            self.span_pipeline.flush()
         self.span_worker.flush()
         self.stats.time_in_nanoseconds(
             "worker.span.flush_duration_ns",
@@ -1709,6 +1837,11 @@ class Server:
                     for svc, n in (
                             worker._native.drain_ssf_services().items()):
                         span_counts[svc] = span_counts.get(svc, 0) + n
+                        # native-extracted spans derive on device and
+                        # never pass handle_ssf: fold them into the
+                        # conservation tallies here (same lock hold as
+                        # the context reset, so none are lost mid-swap)
+                        self._spans_native_total += n
                 # canonical per-worker tallies (README.md:292-294),
                 # captured before flush resets the epoch counters
                 self.stats.count("worker.metrics_processed_total",
@@ -2011,6 +2144,41 @@ class Server:
         self._span_sink_reported[key] = self.span_worker.spans_dropped
         if delta:
             self.stats.count("worker.span.hit_chan_cap", delta)
+        # span→metric derivation counters (satellite of the columnar
+        # pipeline: soaks assert span conservation from these plus the
+        # ingress_stats "spans" block)
+        if self.span_pipeline is not None:
+            pstats = self.span_pipeline.stats()
+            pairs = (
+                ("spans_ingested", "worker.span.columnar_ingested_total"),
+                ("spans_derived", "worker.span.derived_total"),
+                ("derived_rows", "worker.span.derived_metric_rows_total"),
+                ("spans_dropped", "worker.span.pipeline_drop_total"),
+                ("invalid_samples", "worker.span.invalid_samples_total"),
+            )
+            for attr, metric in pairs:
+                key = ("__span_pipeline__", attr)
+                delta = pstats[attr] - self._span_sink_reported.get(key, 0)
+                self._span_sink_reported[key] = pstats[attr]
+                if delta:
+                    self.stats.count(metric, delta)
+        else:
+            ext = self._extraction_sink
+            with ext._stats_lock:
+                ext_pairs = (
+                    ("spans_seen", "worker.span.derived_total",
+                     ext.spans_seen),
+                    ("derived_rows", "worker.span.derived_metric_rows_total",
+                     ext.derived_rows),
+                    ("invalid_samples", "worker.span.invalid_samples_total",
+                     ext.invalid_samples),
+                )
+            for attr, metric, total in ext_pairs:
+                key = ("__extraction__", attr)
+                delta = total - self._span_sink_reported.get(key, 0)
+                self._span_sink_reported[key] = total
+                if delta:
+                    self.stats.count(metric, delta)
         # span-sink delta counters (reference sinks/sinks.go:60-78;
         # sinks track cumulative attributes, telemetry reports deltas)
         for sink in self.span_sinks:

@@ -110,6 +110,10 @@ class MetricExtractionSink:
         self.objective_timer_name = objective_timer_name
         self.uniqueness_rate = uniqueness_rate
         self.invalid_samples = 0
+        # lifetime tallies for span conservation (ingress_stats folds
+        # these into received == derived + dropped + pending)
+        self.spans_seen = 0
+        self.derived_rows = 0
         # ingest runs concurrently under num_span_workers > 1
         self._stats_lock = threading.Lock()
 
@@ -133,6 +137,9 @@ class MetricExtractionSink:
         if self.uniqueness_rate > 0:
             metrics.extend(
                 convert_span_uniqueness_metrics(span, self.uniqueness_rate))
+        with self._stats_lock:
+            self.spans_seen += 1
+            self.derived_rows += len(metrics)
         for m in metrics:
             self.route_metric(m)
 
@@ -247,12 +254,15 @@ class SpanWorker:
 
     def __init__(self, span_sinks: list, common_tags: Optional[dict] = None,
                  capacity: int = 100, sink_timeout_s: float = 9.0,
-                 workers: int = 1) -> None:
+                 workers: int = 1, flush_drain_s: float = 0.5) -> None:
         self.span_sinks = span_sinks
         self.common_tags = common_tags or {}
         self.chan: "queue.Queue[Optional[ssf.SSFSpan]]" = queue.Queue(capacity)
         self.capacity = capacity
         self.sink_timeout_s = sink_timeout_s
+        # shared lane-drain budget per flush pass (config
+        # span_flush_drain_s; was a hardcoded 0.5s)
+        self.flush_drain_s = max(0.0, flush_drain_s)
         self.spans_ingested = 0
         self.spans_dropped = 0
         self.sink_errors: dict[str, int] = {}
@@ -274,7 +284,11 @@ class SpanWorker:
         try:
             self.chan.put_nowait(span)
         except queue.Full:
-            self.spans_dropped += 1
+            # ingest is called from every listener thread; the tally must
+            # take the same lock work() takes for spans_ingested or drops
+            # under-count exactly when the channel is contended
+            with self._stats_lock:
+                self.spans_dropped += 1
 
     def _lane_for(self, sink) -> _SinkLane:
         lane = self._lanes.get(id(sink))
@@ -345,6 +359,15 @@ class SpanWorker:
                         self.lane_drops[name] = (
                             self.lane_drops.get(name, 0) + 1)
 
+    def pending(self) -> int:
+        """Spans accepted but not yet through every sink: channel backlog
+        plus the deepest lane's unfinished work (a span fans out to all
+        lanes, so the max — not the sum — is the count still in flight)."""
+        lanes = list(self._lanes.values())
+        deepest = max((lane.q.unfinished_tasks for lane in lanes),
+                      default=0)
+        return self.chan.qsize() + deepest
+
     def flush(self) -> None:
         # fold lane-level ingest errors into the per-sink error tally
         with self._stats_lock:
@@ -356,9 +379,9 @@ class SpanWorker:
                         self.sink_errors.get(name, 0) + n)
         # give the lanes a moment to finish spans already accepted this
         # interval, so they ship in this flush instead of the next; one
-        # shared deadline bounds the whole pass at 0.5s no matter how
-        # many sinks are backed up
-        drain_deadline = time.monotonic() + 0.5
+        # shared deadline bounds the whole pass at flush_drain_s no
+        # matter how many sinks are backed up
+        drain_deadline = time.monotonic() + self.flush_drain_s
         for sink in self.span_sinks:
             lane = self._lanes.get(id(sink))
             if lane is not None:

@@ -90,13 +90,39 @@ class LoadHarness:
     def __init__(self, cfg, spec: Optional[WorkloadSpec] = None,
                  transport: str = "udp",
                  ring: Optional["native.LoadgenRing"] = None,
-                 sink_mode: str = "channel") -> None:
+                 sink_mode: str = "channel",
+                 ssf_frac: float = 0.0,
+                 ssf_spans: int = 2000) -> None:
         from veneur_tpu.core.server import Server
 
         self.spec = spec or WorkloadSpec.from_config(cfg)
         self.transport = transport
         self.interval = cfg.interval_seconds()
         self.ring = ring if ring is not None else self.spec.build_ring()
+        # mixed statsd+SSF workload: a second paced sender offers SSF
+        # span datagrams at rate*ssf_frac against a real SSF listener;
+        # egress goes through a serialize-only SpanBatchSink (full VSB1
+        # encode + delivery manager, zero network variance)
+        self.ssf_frac = ssf_frac
+        self.ssf_ring = None
+        self.span_sink = None
+        self._ssf_sock: Optional[socket.socket] = None
+        self._ssf_sender: Optional["native.LoadgenSender"] = None
+        span_sinks: list = []
+        if ssf_frac > 0:
+            from veneur_tpu.sinks.delivery import DeliveryPolicy
+            from veneur_tpu.spans import DiscardWriter, SpanBatchSink
+
+            if not cfg.ssf_listen_addresses:
+                cfg.ssf_listen_addresses = ["udp://127.0.0.1:0"]
+            self._ssf_specs = list(cfg.ssf_listen_addresses)
+            self.span_sink = SpanBatchSink(
+                DiscardWriter(), name="loadgen_discard",
+                delivery=DeliveryPolicy.from_config(cfg, self.interval),
+                batch_rows=cfg.span_batch_rows,
+                pending_cap=cfg.span_pending_cap)
+            span_sinks = [self.span_sink]
+            self.ssf_ring = self.spec.build_ssf_ring(ssf_spans)
         if sink_mode == "serialize":
             # a real serializing sink: the datadog formatter builds the
             # full chunked JSON series bodies (deflate included) against
@@ -117,9 +143,12 @@ class LoadHarness:
             self.sink = ChannelMetricSink()
         else:
             raise ValueError("sink_mode must be channel or serialize")
-        self.server = Server(cfg, metric_sinks=[self.sink])
+        self.server = Server(cfg, metric_sinks=[self.sink],
+                             span_sinks=span_sinks)
         ports = self.server.start()
         self._sock = self._connect(ports)
+        if ssf_frac > 0:
+            self._ssf_sock = self._connect_ssf(ports)
         self.flushed_series = 0
         self._sender: Optional["native.LoadgenSender"] = None
 
@@ -149,6 +178,21 @@ class LoadHarness:
             s.connect(spec_port[0][len("unixgram://"):])
             return s
         raise ValueError("transport must be udp, tcp or unixgram")
+
+    def _connect_ssf(self, ports: dict) -> socket.socket:
+        # server.start() prefixes the SSF port with "ssf:" only when its
+        # spec collides with a statsd listener's
+        cand = [(s, p) for s, p in ports.items()
+                if s.startswith("ssf:udp://")]
+        if not cand:
+            cand = [(s, p) for s, p in ports.items()
+                    if s.startswith("udp://") and s in self._ssf_specs]
+        if not cand:
+            raise RuntimeError("no ssf udp listener in %s" % ports)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 22)
+        s.connect(("127.0.0.1", cand[0][1]))
+        return s
 
     def warmup(self, rate: float = 100_000.0,
                timeout: float = 300.0) -> bool:
@@ -196,6 +240,9 @@ class LoadHarness:
         snap["sent_lines"] = sender.sent_lines if sender else 0
         snap["sent_packets"] = sender.sent_packets if sender else 0
         snap["send_errors"] = sender.send_errors if sender else 0
+        ssf_sender = self._ssf_sender
+        snap["ssf_sent_spans"] = (ssf_sender.sent_lines
+                                  if ssf_sender else 0)
         return snap
 
     def _drain_sink(self) -> None:
@@ -224,6 +271,10 @@ class LoadHarness:
         self._sender = native.LoadgenSender(
             self.ring, self._sock.fileno(), rate,
             stream=(self.transport == "tcp"))
+        if self.ssf_frac > 0:
+            self._ssf_sender = native.LoadgenSender(
+                self.ssf_ring, self._ssf_sock.fileno(),
+                max(1.0, rate * self.ssf_frac), stream=False)
         intervals = []
         try:
             if settle:
@@ -282,6 +333,22 @@ class LoadHarness:
                     "emit_ms": round(
                         flush_phases.get("sink_flush_s", 0.0) * 1e3, 2),
                 })
+                if self.ssf_frac > 0:
+                    sp_now = snap.get("spans") or {}
+                    sp_prev = prev.get("spans") or {}
+                    intervals[-1].update({
+                        "spans_sent": (snap["ssf_sent_spans"]
+                                       - prev["ssf_sent_spans"]),
+                        "spans_received": (sp_now.get("received", 0)
+                                           - sp_prev.get("received", 0)),
+                        "spans_derived": (sp_now.get("derived", 0)
+                                          - sp_prev.get("derived", 0)),
+                        "spans_dropped": (sp_now.get("dropped", 0)
+                                          - sp_prev.get("dropped", 0)),
+                        "span_metric_rows": (
+                            sp_now.get("derived_rows", 0)
+                            - sp_prev.get("derived_rows", 0)),
+                    })
                 prev = snap
                 self._drain_sink()
                 if not ok:
@@ -289,6 +356,9 @@ class LoadHarness:
         finally:
             self._sender.stop()
             self._sender = None
+            if self._ssf_sender is not None:
+                self._ssf_sender.stop()
+                self._ssf_sender = None
         total_sent = sum(i["sent_lines"] for i in intervals)
         total_acc = sum(i["accepted_lines"] for i in intervals)
         total_dt = sum(i["duration_s"] for i in intervals)
@@ -306,7 +376,28 @@ class LoadHarness:
         n_warm = steady["warmup_intervals"]
         n_ok_steady = sum(1 for i in intervals
                           if i["cadence_ok"] and not i["warmup"])
+        span_agg = {}
+        if self.ssf_frac > 0:
+            sp_sent = sum(i.get("spans_sent", 0) for i in intervals)
+            sp_recv = sum(i.get("spans_received", 0) for i in intervals)
+            span_agg = {
+                "offered_spans_per_s": rate * self.ssf_frac,
+                "total_spans_sent": sp_sent,
+                "total_spans_received": sp_recv,
+                "total_spans_derived": sum(
+                    i.get("spans_derived", 0) for i in intervals),
+                "total_spans_dropped": sum(
+                    i.get("spans_dropped", 0) for i in intervals),
+                "span_metric_rows": sum(
+                    i.get("span_metric_rows", 0) for i in intervals),
+                # sent-vs-received gap is UDP loss; received-vs-derived
+                # is pipeline shed (counted) or pending carryover
+                "span_loss_frac": round(
+                    max(0.0, 1.0 - sp_recv / sp_sent), 5)
+                if sp_sent > 0 else 0.0,
+            }
         return {
+            **span_agg,
             "tick_block_ms_mean": round(
                 sum(i["tick_block_ms"] for i in intervals) / n_iv, 2),
             "ingest_stall_ms_mean": round(
@@ -349,14 +440,29 @@ class LoadHarness:
             time.sleep(0.05)
         return False
 
+    def span_conservation(self) -> dict:
+        """The server's span books, with the exactness bit: on the
+        columnar path received == derived + dropped + pending holds at
+        any quiescent instant (no sender running, flush not mid-tick)."""
+        s = dict(self.server.ingress_stats().get("spans") or {})
+        if s:
+            s["balanced"] = (
+                s["received"] == s["derived"] + s["dropped"] + s["pending"])
+        return s
+
     def close(self) -> None:
         if self._sender is not None:
             self._sender.stop()
             self._sender = None
+        if self._ssf_sender is not None:
+            self._ssf_sender.stop()
+            self._ssf_sender = None
         try:
             self.server.shutdown()
         finally:
             self._sock.close()
+            if self._ssf_sock is not None:
+                self._ssf_sock.close()
 
 
 def trial_passes(trial: dict, n_intervals: int, max_loss: float,
@@ -512,4 +618,15 @@ def result_artifact(spec: WorkloadSpec, harness: LoadHarness,
         "cores_needed_for_north_star":
             round(NORTH_STAR_LINES_PER_S / measured, 2)
             if measured > 0 else None,
+        # mixed statsd+SSF runs: the confirmation run's span-side
+        # aggregates plus the final conservation check (exact on the
+        # columnar path: received == derived + dropped + pending)
+        **({"spans": {
+            k: confirm.get(k)
+            for k in ("offered_spans_per_s", "total_spans_sent",
+                      "total_spans_received", "total_spans_derived",
+                      "total_spans_dropped", "span_metric_rows",
+                      "span_loss_frac")},
+            "span_conservation": harness.span_conservation()}
+           if harness.ssf_frac > 0 else {}),
     }
