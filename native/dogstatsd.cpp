@@ -1690,8 +1690,13 @@ int vn_ingest(void* p, const char* buf, int len) {
 // native twin of the reference's contention-free Digest%N worker routing
 // (server.go:1028-1039). Multiple SO_REUSEPORT readers call this
 // concurrently; ctypes drops the GIL, so parsing genuinely parallelizes.
-// Events/service checks and parse errors land on shard 0.
-int vn_ingest_routed(void** ctxps, int nctx, const char* buf, int len) {
+// Events/service checks and parse errors land on the caller's home shard
+// so one noisy event stream can't serialize every reader behind shard 0.
+// With nctx == 1 and home == 0 this degenerates to the shared-nothing
+// per-reader commit path: every line commits into the caller's own ctx
+// under a mutex nobody else touches on the line path.
+int vn_ingest_home(void** ctxps, int nctx, const char* buf, int len,
+                   int home) {
   thread_local Scratch sc;
   Ctx** ctxs = reinterpret_cast<Ctx**>(ctxps);
   std::string_view data(buf, static_cast<size_t>(len));
@@ -1704,15 +1709,15 @@ int vn_ingest_routed(void** ctxps, int nctx, const char* buf, int len) {
                                         : data.substr(nl + 1);
     if (line.empty()) continue;
     if (line.substr(0, 3) == "_e{" || line.substr(0, 3) == "_sc") {
-      std::lock_guard<std::recursive_mutex> g(ctxs[0]->mu);
-      ctxs[0]->other_lines.append(line);
-      ctxs[0]->other_lines.push_back('\n');
+      std::lock_guard<std::recursive_mutex> g(ctxs[home]->mu);
+      ctxs[home]->other_lines.append(line);
+      ctxs[home]->other_lines.push_back('\n');
       continue;
     }
     Parsed parsed;
     if (!parse_line(&sc, line, &parsed)) {
-      std::lock_guard<std::recursive_mutex> g(ctxs[0]->mu);
-      ++ctxs[0]->errors;
+      std::lock_guard<std::recursive_mutex> g(ctxs[home]->mu);
+      ++ctxs[home]->errors;
       continue;
     }
     Ctx* target = ctxs[parsed.digest % static_cast<uint32_t>(nctx)];
@@ -1753,6 +1758,10 @@ int vn_ingest_routed(void** ctxps, int nctx, const char* buf, int len) {
   return accepted;
 }
 
+int vn_ingest_routed(void** ctxps, int nctx, const char* buf, int len) {
+  return vn_ingest_home(ctxps, nctx, buf, len, 0);
+}
+
 // ---------------------------------------------------------------------------
 // Native UDP reader: a C++ thread owning the recv loop — datagram to
 // staged sample with no Python (and no GIL) anywhere on the path. The
@@ -1769,6 +1778,7 @@ struct Reader {
   std::atomic<long long> packets{0};
   int fd = -1;
   int max_len = 0;
+  int home = 0;  // shard receiving this reader's events/errors
   std::vector<Ctx*> ctxs;
 };
 
@@ -1783,13 +1793,13 @@ void reader_loop(Reader* r) {
     }
     r->packets.fetch_add(1, std::memory_order_relaxed);
     if (n > r->max_len) {
-      std::lock_guard<std::recursive_mutex> g(r->ctxs[0]->mu);
-      ++r->ctxs[0]->errors;
+      std::lock_guard<std::recursive_mutex> g(r->ctxs[r->home]->mu);
+      ++r->ctxs[r->home]->errors;
       continue;
     }
-    vn_ingest_routed(reinterpret_cast<void**>(r->ctxs.data()),
-                     static_cast<int>(r->ctxs.size()), buf.data(),
-                     static_cast<int>(n));
+    vn_ingest_home(reinterpret_cast<void**>(r->ctxs.data()),
+                   static_cast<int>(r->ctxs.size()), buf.data(),
+                   static_cast<int>(n), r->home);
   }
 }
 
@@ -1849,7 +1859,13 @@ void ssf_reader_loop(SsfReader* r) {
 // polled; ownership of the fd stays with the caller. Returns NULL if
 // the timeout cannot be applied — a reader whose recv never times out
 // could not be stopped, and would hang shutdown/handoff in join().
-void* vn_reader_start(void** ctxps, int nctx, int fd, int max_len) {
+// home selects the shard that absorbs this reader's events/service
+// checks and parse errors (vn_reader_start pins it to 0 for ABI
+// compatibility). A reader given nctx == 1 owns its ctx outright: the
+// shared-nothing per-reader commit shape.
+void* vn_reader_start2(void** ctxps, int nctx, int fd, int max_len,
+                       int home) {
+  if (home < 0 || home >= nctx) return nullptr;
   int fl = fcntl(fd, F_GETFL);
   if (fl < 0) return nullptr;
   if ((fl & O_NONBLOCK) && fcntl(fd, F_SETFL, fl & ~O_NONBLOCK) < 0)
@@ -1862,10 +1878,15 @@ void* vn_reader_start(void** ctxps, int nctx, int fd, int max_len) {
   Reader* r = new Reader();
   r->fd = fd;
   r->max_len = max_len;
+  r->home = home;
   for (int i = 0; i < nctx; ++i)
     r->ctxs.push_back(static_cast<Ctx*>(ctxps[i]));
   r->th = std::thread(reader_loop, r);
   return r;
+}
+
+void* vn_reader_start(void** ctxps, int nctx, int fd, int max_len) {
+  return vn_reader_start2(ctxps, nctx, fd, max_len, 0);
 }
 
 long long vn_reader_packets(void* p) {
@@ -1903,6 +1924,7 @@ struct StreamReader {
   std::atomic<long long> lines{0};
   int fd = -1;
   int max_len = 0;
+  int home = 0;  // shard receiving this reader's events/errors
   std::vector<Ctx*> ctxs;
 };
 
@@ -1926,12 +1948,13 @@ void stream_reader_loop(StreamReader* r) {
         skipping = false;  // tail of the dropped overlong line
       } else if (len > 0) {
         if (len > static_cast<size_t>(r->max_len)) {
-          std::lock_guard<std::recursive_mutex> g(r->ctxs[0]->mu);
-          ++r->ctxs[0]->errors;
+          std::lock_guard<std::recursive_mutex> g(r->ctxs[r->home]->mu);
+          ++r->ctxs[r->home]->errors;
         } else {
-          vn_ingest_routed(reinterpret_cast<void**>(r->ctxs.data()),
-                           static_cast<int>(r->ctxs.size()),
-                           buf.data() + start, static_cast<int>(len));
+          vn_ingest_home(reinterpret_cast<void**>(r->ctxs.data()),
+                         static_cast<int>(r->ctxs.size()),
+                         buf.data() + start, static_cast<int>(len),
+                         r->home);
           r->lines.fetch_add(1, std::memory_order_relaxed);
         }
       }
@@ -1941,8 +1964,8 @@ void stream_reader_loop(StreamReader* r) {
     if (!skipping && buf.size() > static_cast<size_t>(r->max_len)) {
       // partial line already too long: drop it now (bounded memory;
       // the Python path buffers unboundedly here)
-      std::lock_guard<std::recursive_mutex> g(r->ctxs[0]->mu);
-      ++r->ctxs[0]->errors;
+      std::lock_guard<std::recursive_mutex> g(r->ctxs[r->home]->mu);
+      ++r->ctxs[r->home]->errors;
       buf.clear();
       skipping = true;
     }
@@ -1963,7 +1986,9 @@ int vn_stream_reader_done(void* p) {
              : 0;
 }
 
-void* vn_stream_reader_start(void** ctxps, int nctx, int fd, int max_len) {
+void* vn_stream_reader_start2(void** ctxps, int nctx, int fd, int max_len,
+                              int home) {
+  if (home < 0 || home >= nctx) return nullptr;
   int fl = fcntl(fd, F_GETFL);
   if (fl < 0) return nullptr;
   if ((fl & O_NONBLOCK) && fcntl(fd, F_SETFL, fl & ~O_NONBLOCK) < 0)
@@ -1976,10 +2001,15 @@ void* vn_stream_reader_start(void** ctxps, int nctx, int fd, int max_len) {
   StreamReader* r = new StreamReader();
   r->fd = fd;
   r->max_len = max_len;
+  r->home = home;
   for (int i = 0; i < nctx; ++i)
     r->ctxs.push_back(static_cast<Ctx*>(ctxps[i]));
   r->th = std::thread(stream_reader_loop, r);
   return r;
+}
+
+void* vn_stream_reader_start(void** ctxps, int nctx, int fd, int max_len) {
+  return vn_stream_reader_start2(ctxps, nctx, fd, max_len, 0);
 }
 
 // Join and free; returns lines ingested. The reader closes its fd.

@@ -90,14 +90,31 @@ def main() -> None:
                          "same ring, and write one artifact with both "
                          "modes plus the speedup")
     ap.add_argument("--ab-axis", default="pipeline",
-                    choices=["pipeline", "emit-native", "micro-fold"],
+                    choices=["pipeline", "emit-native", "micro-fold",
+                             "reader-shards"],
                     help="what --ab compares: serial vs pipelined "
                          "flush (default), Python vs native emit "
                          "serializers (forces --sink serialize; both "
-                         "sides use --flush-pipeline as given), or "
+                         "sides use --flush-pipeline as given), "
                          "once-per-interval vs always-hot micro-fold "
                          "staging (both sides use --flush-pipeline and "
-                         "--sink as given)")
+                         "--sink as given), or legacy digest-routed vs "
+                         "shared-nothing reader-sharded ingest (both "
+                         "sides run --readers reader threads; only the "
+                         "commit topology differs)")
+    ap.add_argument("--readers", type=int, default=1,
+                    help="C++ reader threads sharing the listen port "
+                         "(SO_REUSEPORT). With num_workers=1 and >1 "
+                         "readers the server auto-engages reader-"
+                         "sharded ingest (reader_shards: -1); interval "
+                         "records then carry per-reader committed/"
+                         "dropped deltas")
+    ap.add_argument("--pin-cpus", type=int, default=0, metavar="N",
+                    help="pin this process (readers included — they "
+                         "inherit the mask) to the first N online CPUs "
+                         "via os.sched_setaffinity; bounds scheduler-"
+                         "migration noise on many-core rigs. 0 = no "
+                         "pinning")
     ap.add_argument("--emit-native", default="on", choices=["on", "off"],
                     help="native emit tier (native/emit.cpp) for "
                          "non-AB runs; --ab --ab-axis emit-native "
@@ -142,10 +159,16 @@ def main() -> None:
               "tcp": "tcp://127.0.0.1:0",
               "unixgram": "unixgram:///tmp/veneur_lg_%d.sock"
                           % os.getpid()}[args.transport]
+    if args.pin_cpus:
+        try:
+            os.sched_setaffinity(0, set(range(args.pin_cpus)))
+        except (AttributeError, OSError) as e:
+            print(f"cpu pinning unavailable: {e}", file=sys.stderr)
+
     cfg = Config(
         statsd_listen_addresses=[listen],
         interval=args.interval,
-        num_workers=1, num_readers=1,
+        num_workers=1, num_readers=max(1, args.readers),
         percentiles=[0.5, 0.99],
         # a serious rcvbuf: kernel drops are measured as loss, not
         # hidden by a tiny default buffer
@@ -211,6 +234,18 @@ def main() -> None:
             sink_mode = args.sink
             mode_list = [("micro_off", {"micro_fold": False}),
                          ("micro_on", {"micro_fold": True})]
+        elif args.ab_axis == "reader-shards":
+            # legacy digest-routed commits vs shared-nothing per-reader
+            # contexts, same reader count on both sides — the axis is
+            # the commit topology, nothing else
+            if args.readers < 2:
+                print("--ab-axis reader-shards needs --readers >= 2",
+                      file=sys.stderr)
+                sys.exit(2)
+            sink_mode = args.sink
+            mode_list = [("legacy_routed", {"reader_shards": 0}),
+                         ("reader_sharded",
+                          {"reader_shards": args.readers})]
         else:
             sink_mode = args.sink
             mode_list = [("serial", {"flush_pipeline": False}),
@@ -341,6 +376,11 @@ def main() -> None:
             summary["micro_off_lines_per_s"] = base_rate
             summary["speedup_vs_micro_off"] = speedup
             summary["micro_fold_ab"] = out["micro_fold_ab"]
+        elif args.ab_axis == "reader-shards":
+            out["speedup_vs_legacy_routed"] = speedup
+            summary["legacy_routed_lines_per_s"] = base_rate
+            summary["speedup_vs_legacy_routed"] = speedup
+            summary["readers"] = args.readers
         else:
             out["speedup_vs_serial"] = speedup
             summary["serial_lines_per_s"] = base_rate
@@ -386,6 +426,10 @@ def main() -> None:
                 "passed": trial["passed"],
                 "platform": platform,
             }
+            if args.readers > 1:
+                payload["readers"] = args.readers
+                per = [iv.get("per_reader") for iv in trial["intervals"]]
+                payload["per_reader"] = [p for p in per if p]
             if ssf_frac > 0:
                 cons = settled_conservation()
                 payload["spans"] = {
@@ -409,6 +453,7 @@ def main() -> None:
         out = result_artifact(spec, harness, search, platform)
         out["sink_mode"] = args.sink
         out["workload_kind"] = args.workload
+        out["readers"] = args.readers
         if ssf_frac > 0:
             out["schema"] = "span_sustained_v1"
             out["ssf_frac"] = ssf_frac

@@ -215,6 +215,24 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_SPAN_COLUMNAR=0 \
   python -m pytest tests/test_spans_columnar.py tests/test_ssf.py \
     -q -m 'not slow'
 
+# Reader-shard parity lane: the shared-nothing multi-reader ingest
+# (core/worker.attach_reader_shards) must produce the same keyed flush
+# output as the legacy digest-routed path for every metric class, with
+# exact conservation and per-reader attribution. Runs the server /
+# ingest / micro-fold suites twice, mirroring the micro-fold lane:
+# once with the env hatch forcing reader_shards=4 (every qualifying
+# server in the suites boots sharded; non-qualifying configs degrade
+# to legacy by the resolve gates) and once pinned legacy
+# (VENEUR_READER_SHARDS=0) — a shard-mode drift is named by the first
+# pass, a broken escape hatch by the second.
+echo "== reader-shard parity lane (sharded num_readers=4 + legacy) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_READER_SHARDS=4 \
+  python -m pytest tests/test_reader_shards.py tests/test_server.py \
+    tests/test_native.py tests/test_microfold.py -q -m 'not slow'
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_READER_SHARDS=0 \
+  python -m pytest tests/test_reader_shards.py tests/test_server.py \
+    tests/test_native.py tests/test_microfold.py -q -m 'not slow'
+
 # SSF sustained-rate floor: mixed statsd+SSF traffic (10% spans) with
 # the columnar pipeline deriving span metrics on the flush path; gates
 # the SSF packet path (zero loss), spans actually arriving, and exact

@@ -13,6 +13,11 @@ the C++ MetricBatch decoder):
   gob        round-trip identity + clean bounded-time GobError on
              mutated bytes (untrusted peer input on /import)
 
+Later rounds added ssf_stream (framed-stream recoverability), loadgen
+(generated traffic must parse in both codecs), and reader_commit
+(shared-nothing per-reader owned contexts vs one legacy context over
+the same per-reader streams — keyed fold parity).
+
 Usage: python tools/fuzz_differential.py [--seconds 30] [--seed N]
 Exit 0 = no divergence; 1 = divergence (repro printed with seed).
 """
@@ -382,9 +387,141 @@ def fuzz_loadgen(rng, t_end) -> int:
     return n
 
 
+def fuzz_reader_commit(rng, t_end) -> int:
+    """Shared-nothing reader-commit differential (the reader-shard line
+    path): R private owned contexts (vn_ingest_home, one per reader)
+    vs ONE legacy context processing the same per-reader streams
+    serialized in reader order. Everything keyed must agree exactly:
+    processed/error tallies and the per-series folds — counter
+    contribution sums, timer/histogram (value, weight) multisets, set
+    HLL (index, rank) updates, and last-value gauges. Gauge keys are
+    per-reader-disjoint: cross-reader last-writer ordering is not part
+    of the contract (same ground truth as tests/test_reader_shards.py);
+    counters, timers, and sets DO overlap across readers."""
+    from veneur_tpu import native as native_mod
+
+    R = 3
+    owned = [native_mod.NativeIngest() for _ in range(R)]
+    legacy = native_mod.NativeIngest()
+    for ctx in owned + [legacy]:
+        ctx.set_spill_cap(1 << 20)
+
+    # (pool, row) -> key maps persist for a context's lifetime;
+    # drain_new_series only reports rows created since the last drain
+    name_maps = {id(c): {} for c in owned + [legacy]}
+
+    def drain_keyed(ctx):
+        names = name_maps[id(ctx)]
+        names.update({(p, r): (nm, tg) for p, r, _k, _s, nm, tg
+                      in ctx.drain_new_series()})
+        out = {"h": {}, "c": {}, "g": {}, "s": {}}
+        while True:
+            hr, hv, hw = ctx.drain_histo(4096)
+            for r, v, w in zip(hr.tolist(), hv.tolist(), hw.tolist()):
+                out["h"].setdefault(names[(0, r)], []).append((v, w))
+            sr, si, sk = ctx.drain_set(4096)
+            for r, i, k in zip(sr.tolist(), si.tolist(), sk.tolist()):
+                out["s"].setdefault(names[(1, r)], set()).add((i, k))
+            cr, cc = ctx.drain_counter(4096)
+            for r, c in zip(cr.tolist(), cc.tolist()):
+                key = names[(2, r)]
+                out["c"][key] = out["c"].get(key, 0.0) + c
+            gr, gv = ctx.drain_gauge(4096)
+            for r, v in zip(gr.tolist(), gv.tolist()):
+                out["g"][names[(3, r)]] = v
+            if not (ctx.pending_histo or ctx.pending_set
+                    or ctx.pending_counter or ctx.pending_gauge):
+                break
+        for v in out["h"].values():
+            v.sort()
+        return out
+
+    n = 0
+    seen = [0] * (2 * (R + 1))  # processed/errors offsets per context
+    while time.time() < t_end:
+        keys = [b"fz.k%d" % j for j in range(rng.randrange(1, 40))]
+        streams = []
+        for r in range(R):
+            lines = []
+            for _ in range(rng.randrange(20, 200)):
+                roll = rng.random()
+                if roll < 0.08:
+                    lines.append(rng.choice(
+                        [b"bad line", b":|c", b"fz.x:|g", b"fz.x:1|zz",
+                         b"fz.x:nope|c", b""]))
+                    continue
+                name = rng.choice(keys)
+                if roll < 0.30:
+                    line = name + b":%d|c" % rng.randrange(-50, 50)
+                    if rng.random() < 0.3:
+                        line += b"|@0.5"
+                elif roll < 0.55:
+                    line = name + b":%d.%d|ms" % (rng.randrange(500),
+                                                  rng.randrange(100))
+                elif roll < 0.75:
+                    line = name + b":u%d|s" % rng.randrange(200)
+                else:  # per-reader-disjoint gauge namespace
+                    line = b"fz.g%d.%s:%d|g" % (r, name, rng.randrange(999))
+                if rng.random() < 0.4:
+                    line += b"|#t:%d" % rng.randrange(4)
+                lines.append(line)
+            dgrams = [b"\n".join(lines[i:i + 20])
+                      for i in range(0, len(lines), 20)]
+            streams.append(dgrams)
+
+        for r in range(R):
+            for d in streams[r]:
+                owned[r].ingest_owned(d)
+        for r in range(R):  # reader (context) order — the parity contract
+            for d in streams[r]:
+                legacy.ingest(d)
+
+        tallies = []
+        for i, ctx in enumerate(owned + [legacy]):
+            p = int(ctx.processed) - seen[2 * i]
+            e = int(ctx.errors) - seen[2 * i + 1]
+            seen[2 * i], seen[2 * i + 1] = int(ctx.processed), int(ctx.errors)
+            if int(ctx.overload_dropped):
+                print("reader_commit spill cap hit — raise cap")
+                return -1
+            tallies.append((p, e))
+        sp = sum(t[0] for t in tallies[:R])
+        se = sum(t[1] for t in tallies[:R])
+        if (sp, se) != tallies[R]:
+            print(f"reader_commit TALLY sharded=({sp},{se}) "
+                  f"legacy={tallies[R]}")
+            return -1
+
+        got = {"h": {}, "c": {}, "g": {}, "s": {}}
+        for ctx in owned:  # fold per-reader drains in reader order
+            part = drain_keyed(ctx)
+            for key, vw in part["h"].items():
+                got["h"].setdefault(key, []).extend(vw)
+            for key, pairs in part["s"].items():
+                got["s"].setdefault(key, set()).update(pairs)
+            for key, c in part["c"].items():
+                got["c"][key] = got["c"].get(key, 0.0) + c
+            got["g"].update(part["g"])
+        for v in got["h"].values():
+            v.sort()
+        want = drain_keyed(legacy)
+        if got != want:
+            for cls in ("h", "c", "g", "s"):
+                if got[cls] != want[cls]:
+                    diff = (set(got[cls]) ^ set(want[cls])) or {
+                        k for k in got[cls]
+                        if got[cls][k] != want[cls].get(k)}
+                    print(f"reader_commit DIVERGE class={cls} "
+                          f"keys={sorted(diff)[:5]}")
+            return -1
+        n += sp + se
+    return n
+
+
 TARGETS = {"dogstatsd": fuzz_dogstatsd, "ssf": fuzz_ssf,
            "metricpb": fuzz_metricpb, "gob": fuzz_gob,
-           "ssf_stream": fuzz_ssf_stream, "loadgen": fuzz_loadgen}
+           "ssf_stream": fuzz_ssf_stream, "loadgen": fuzz_loadgen,
+           "reader_commit": fuzz_reader_commit}
 
 
 def _git_rev() -> str:
@@ -439,7 +576,7 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--targets",
                     default="dogstatsd,ssf,metricpb,gob,ssf_stream,"
-                            "loadgen")
+                            "loadgen,reader_commit")
     ap.add_argument("--tally", default=None, metavar="PATH",
                     help="accumulate results into this JSON artifact")
     ap.add_argument("--rounds", type=int, default=1,

@@ -249,6 +249,21 @@ class Config:
     # env escape hatch. Mutually exclusive with tpu_mesh_devices (the
     # global tier's mesh owns its own layout).
     series_shards: int = 0
+    # shared-nothing multi-reader ingest: each C++ UDP reader thread
+    # commits into its OWN native context (private directory + staging
+    # plane + SoA spill epoch — no shared mutex on the line path), and
+    # the flush reconciles the per-reader row spaces at the series sync
+    # and folds all planes on-device as one stacked batch
+    # (ops/reader_stack.py). -1 (default) = auto: one shard per reader
+    # when native ingest + native readers are on, num_workers is 1 and
+    # num_readers > 1; 0 disables (legacy digest-routed commits through
+    # the shared per-worker context). Explicit N requests N shards.
+    # Bit-identical flush output either way per metric class
+    # (tests/test_reader_shards.py); VENEUR_READER_SHARDS=0 is the env
+    # escape hatch. Requires num_workers: 1 (the canonical row space is
+    # the single worker's directory); incompatible requests degrade to
+    # the legacy path with a warning rather than failing ingest.
+    reader_shards: int = -1
     # entries per pending-batch (SoA) class before ingest sheds samples
     # (drop-don't-block under overload; counted in
     # veneur.ingest.overload_dropped_total). Bounds native ingest memory
@@ -741,6 +756,51 @@ def load_config(path: Optional[str] = None, data: Optional[dict] = None,
     return cfg
 
 
+def resolve_reader_shards(cfg: Config) -> int:
+    """Effective reader-shard count for this process.
+
+    VENEUR_READER_SHARDS overrides the config key (same escape-hatch
+    idiom as VENEUR_SERIES_SHARDS, ops/series_shard.py): =0 pins the
+    legacy digest-routed path. -1 (auto) resolves to num_readers when
+    the shared-nothing layout applies — native ingest + native readers
+    on, a single worker (the canonical row space is that worker's
+    directory), and more than one reader to shard. Incompatible
+    explicit requests degrade to 0 with a warning rather than failing
+    ingest."""
+    value = cfg.reader_shards
+    env = os.environ.get("VENEUR_READER_SHARDS")
+    if env is not None:
+        try:
+            value = int(env)
+        except ValueError:
+            log.warning("VENEUR_READER_SHARDS=%r is not an integer;"
+                        " using reader_shards=%d", env, value)
+    if value == 0:
+        return 0
+    if not (cfg.tpu_native_ingest and cfg.tpu_native_readers):
+        if value > 0:
+            log.warning("reader_shards=%d needs tpu_native_ingest and"
+                        " tpu_native_readers; using the legacy path",
+                        value)
+        return 0
+    if cfg.num_workers != 1:
+        if value > 0:
+            log.warning("reader_shards=%d requires num_workers: 1 (the"
+                        " canonical row space is the single worker's"
+                        " directory); using the legacy digest-routed"
+                        " path", value)
+        return 0
+    if cfg.tpu_mesh_devices > 1:
+        if value > 0:
+            log.warning("reader_shards=%d is incompatible with the"
+                        " global tier's mesh; using the legacy path",
+                        value)
+        return 0
+    if value == -1:
+        return cfg.num_readers if cfg.num_readers > 1 else 0
+    return value
+
+
 def validate_config(cfg: Config) -> None:
     parse_duration(cfg.interval)  # raises on nonsense
     if cfg.interval_seconds() <= 0:
@@ -785,6 +845,13 @@ def validate_config(cfg: Config) -> None:
                 "series_shards and tpu_mesh_devices are mutually"
                 " exclusive: the global tier's mesh owns the device"
                 " layout; a worker cannot also shard its pools over it")
+    if cfg.reader_shards < -1:
+        raise ValueError("reader_shards must be >= -1 (-1 auto, 0"
+                         " disables reader sharding)")
+    if cfg.reader_shards > 256:
+        raise ValueError("reader_shards must be <= 256 (each shard is a"
+                         " full native context; hundreds of readers"
+                         " should be split across processes)")
     if cfg.set_hash not in ("fnv", "metro"):
         raise ValueError("set_hash must be 'fnv' or 'metro'")
     if cfg.tpu_set_store not in ("staged", "dense"):

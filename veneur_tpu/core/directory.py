@@ -146,6 +146,20 @@ class _Pool:
             key=key, tags=tags, scope_class=scope_class,
             sinks=route_info(tags), tenant=tenant))
 
+    def upsert_meta(self, meta: RowMeta) -> tuple[int, bool]:
+        """Upsert with prebuilt metadata: the reader-shard reconcile path
+        (core/worker._sync_native_series) folds N per-reader row spaces
+        into this canonical directory, so the same series arriving via
+        several readers must dedup here instead of adopting per-context
+        rows verbatim."""
+        k = (meta.key, meta.scope_class)
+        row = self.index.get(k)
+        if row is not None:
+            return row, False
+        row = len(self.rows)
+        self.adopt_meta(row, meta)
+        return row, True
+
     def adopt_meta(self, row: int, meta: RowMeta) -> None:
         """Adopt with prebuilt metadata (the worker's cross-epoch adopt
         cache reuses one RowMeta per series: the same series re-registers

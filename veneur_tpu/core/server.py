@@ -382,6 +382,23 @@ class Server:
                     [w._native for w in self.workers])
                 log.info("native C++ ingest pipeline enabled"
                          " (%d shards)", len(self.workers))
+        # shared-nothing reader shards: each C++ reader thread commits
+        # into a PRIVATE context (no shared mutex on the line path); the
+        # flush folds the per-reader planes on-device as one stacked
+        # batch (core/worker.attach_reader_shards, ops/reader_stack.py).
+        # resolve_reader_shards gates on single-worker native-reader
+        # mode and honors the VENEUR_READER_SHARDS=0 legacy hatch.
+        self._reader_shards = 0
+        self._lock_stats_enabled = False
+        self.last_reader_stats = None
+        if self.native_mode:
+            from veneur_tpu.core.config import resolve_reader_shards
+
+            n_rs = resolve_reader_shards(cfg)
+            if n_rs and self.workers[0].attach_reader_shards(n_rs):
+                self._reader_shards = n_rs
+                log.info("reader-sharded ingest enabled"
+                         " (%d shared-nothing reader shards)", n_rs)
 
         # native SSF span fast path: only when the extraction sink is the
         # sole span consumer (other span sinks need the Python span
@@ -467,6 +484,23 @@ class Server:
                     n += router.reader_packets(h)
         return n
 
+    def set_lock_stats(self, enabled: bool) -> None:
+        """Toggle commit-mutex contention recording on every native
+        context (global C++ flag; ~10-20% per-line overhead while on)
+        and reset the tallies, so a measurement window starts clean.
+        Stats surface in ingress_stats()["reader_shards"]["lock"] and
+        the per-flush reader telemetry."""
+        self._lock_stats_enabled = bool(enabled)
+        for w in self.workers:
+            native = getattr(w, "_native", None)
+            if native is None:
+                continue
+            fn = getattr(native._lib, "vn_set_lock_stats", None)
+            if fn is not None:
+                fn(1 if enabled else 0)
+            for ctx in [native] + list(getattr(w, "_reader_ctxs", ())):
+                ctx.reset_lock_stats()
+
     def ingress_stats(self) -> dict:
         """Cumulative ingress counters for the loadgen controller
         (veneur_tpu/loadgen): lifetime tallies that survive epoch swaps,
@@ -489,6 +523,10 @@ class Server:
                 if native is not None:
                     dropped += (int(native.overload_dropped)
                                 - getattr(w, "_native_drop_seen", 0))
+                    for j, ctx in enumerate(
+                            getattr(w, "_reader_ctxs", ())):
+                        dropped += (int(ctx.overload_dropped)
+                                    - w._reader_drop_seen[j])
         out = {
             "packets_received": self.packets_received,
             "parse_errors": self.parse_errors,
@@ -509,6 +547,14 @@ class Server:
                 getattr(w, "micro_folds_total", 0) for w in self.workers),
             "last_micro_folds": getattr(self, "last_micro_folds", 0),
         }
+        w0 = self.workers[0]
+        if getattr(w0, "_reader_ctxs", None):
+            # shared-nothing ingest: per-context lifetime attribution
+            # (index 0 = home context, 1.. = reader shards) plus the
+            # commit-mutex contention record when recording is on —
+            # contended_fraction ~ 0 is the shared-nothing proof
+            out["reader_shards"] = w0.reader_stats(
+                lock_stats=self._lock_stats_enabled)
         out["spans"] = self._span_stats()
         if self.flush_pipeline is not None:
             out["pipeline"] = self.flush_pipeline.stats()
@@ -609,6 +655,8 @@ class Server:
             native = getattr(w, "_native", None)
             if native is not None:
                 n += int(native.errors) - w._native_errs_seen
+                for j, ctx in enumerate(getattr(w, "_reader_ctxs", ())):
+                    n += int(ctx.errors) - w._reader_errs_seen[j]
         return n
 
     def _bump_errors(self, n: int = 1) -> None:
@@ -676,8 +724,9 @@ class Server:
         crossed batch_size (shared by the strided ingest check and the
         native-reader pump)."""
         for i, w in enumerate(self.workers):
-            if (w._native.pending_histo >= w.batch_size
-                    or w._native.pending_set >= w.batch_size):
+            ctxs = [w._native] + list(getattr(w, "_reader_ctxs", ()))
+            if any(c.pending_histo >= w.batch_size
+                   or c.pending_set >= w.batch_size for c in ctxs):
                 with self._worker_locks[i]:
                     w.drain_native()
 
@@ -689,8 +738,10 @@ class Server:
         serializes on the C++ ctx mutex (per-thread scratch in native.py),
         so reader threads no longer funnel through worker 0's ingest
         lock; each parsed line then routes to its digest owner."""
-        for line in self.workers[0]._native.drain_other():
-            self.handle_metric_packet(line)
+        for w in self.workers:
+            for ctx in [w._native] + list(getattr(w, "_reader_ctxs", ())):
+                for line in ctx.drain_other():
+                    self.handle_metric_packet(line)
 
     def _drain_native_ssf_fallbacks(self) -> None:
         """Raw SSF payloads the C++ SSF reader handed back (STATUS spans
@@ -1054,9 +1105,24 @@ class Server:
             return False
         try:
             sock.setblocking(True)
-            h = self._native_router.start_reader(
-                sock.fileno(), self.config.metric_max_length)
-            self._native_readers.append(h)
+            with self._native_reader_lock:
+                idx = len(self._native_readers)
+            if self._reader_shards:
+                # shared-nothing: reader idx commits exclusively into
+                # reader context idx % R — no shared mutex on the line
+                # path (events/errors stay on that context too)
+                ctxs = self.workers[0]._reader_ctxs
+                h = ctxs[idx % len(ctxs)].start_owned_reader(
+                    sock.fileno(), self.config.metric_max_length)
+            else:
+                # digest-routed commits; `home` spreads each reader's
+                # event/service-check/error buffers across the worker
+                # contexts instead of funnelling them onto shard 0
+                h = self._native_router.start_reader(
+                    sock.fileno(), self.config.metric_max_length,
+                    home=idx % len(self.workers))
+            with self._native_reader_lock:
+                self._native_readers.append(h)
             self._start_native_pump()
             return True
         except (AttributeError, RuntimeError) as e:
@@ -1591,8 +1657,7 @@ class Server:
         The pending probe is a lock-free C call, so an idle sweep costs
         no worker-lock churn."""
         for i, worker in enumerate(self.workers):
-            n = worker._native
-            if n is None or not n.pending_new_series:
+            if worker._native is None or not worker.native_series_pending():
                 continue
             with self._worker_locks[i]:
                 worker.sync_native_series()
@@ -1717,6 +1782,8 @@ class Server:
                 if w._native is not None:
                     try:
                         w._native.set_spill_cap(new)
+                        for ctx in getattr(w, "_reader_ctxs", ()):
+                            ctx.set_spill_cap(new)
                     except AttributeError:  # stale .so without the cap API
                         pass
 
@@ -1861,6 +1928,25 @@ class Server:
                 if n_staged:
                     self.stats.count("worker.samples_staged_total",
                                      n_staged, tags=[f"worker:{i}"])
+                if getattr(worker, "_reader_ctxs", None):
+                    # per-reader commit attribution (swap's fence just
+                    # settled reader_committed) + contention record:
+                    # emitted as lifetime-deltas per context, stashed
+                    # whole for ingress_stats/bench readers
+                    rs = worker.reader_stats(
+                        lock_stats=self._lock_stats_enabled)
+                    prev = getattr(self, "_reader_reported", None) or {}
+                    for kind, stat in (
+                            ("committed", "ingest.reader_committed_total"),
+                            ("dropped", "ingest.reader_dropped_total")):
+                        for j, total in enumerate(rs[kind]):
+                            delta = total - prev.get((kind, j), 0)
+                            if delta:
+                                self.stats.count(
+                                    stat, delta, tags=[f"reader:{j}"])
+                            prev[(kind, j)] = total
+                    self._reader_reported = prev
+                    self.last_reader_stats = rs
                 if self.tenant_ledger is not None:
                     # per-tenant honest-drop counters, emitted as deltas
                     # of the worker's LIFETIME tallies (read post-swap:

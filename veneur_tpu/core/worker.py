@@ -36,6 +36,7 @@ from __future__ import annotations
 import functools
 import logging
 import time
+from array import array
 from dataclasses import dataclass, field
 from typing import NamedTuple, Optional
 
@@ -51,6 +52,7 @@ from veneur_tpu.core.tenancy import TenantTallies
 from veneur_tpu.health.ledger import TransferLedger
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import microfold as mf
+from veneur_tpu.ops import reader_stack as rstack
 from veneur_tpu.ops import series_shard as ss
 from veneur_tpu.ops import tdigest as td
 from veneur_tpu.ops.scalars import counter_contribution
@@ -594,6 +596,13 @@ class SwappedEpoch:
     # exist to remove. extract feeds these, finish()es the mirror, and
     # populates device_stage.
     micro_residual: Optional[tuple] = None
+    # shared-nothing reader shards (DeviceWorker.attach_reader_shards):
+    # each context's detached staging plane paired with a COPY of its
+    # local-row → canonical-row map, in context order. extract_snapshot
+    # merges them into ONE flat batch (ops/reader_stack.py) feeding the
+    # legacy staged fold; the native memory is released right after the
+    # merge copies out of it.
+    reader_planes: Optional[list] = None
 
 
 class DeviceWorker:
@@ -696,6 +705,22 @@ class DeviceWorker:
         # server; None (or disabled) keeps single-shot extraction
         self.governor = None
         self._native = None
+        # shared-nothing reader shards (attach_reader_shards): extra
+        # native contexts, one per C++ reader thread, each with its own
+        # directory/staging plane/spill epoch so the commit hot path
+        # takes no shared mutex. Empty list == legacy single-context
+        # mode everywhere (the checks below are `if self._reader_ctxs`).
+        self._reader_ctxs: list = []
+        # per-reader-context rebasing baselines (the home context keeps
+        # its historical scalar fields — the server reads those directly)
+        self._reader_errs_seen: list[int] = []
+        self._reader_proc_seen: list[int] = []
+        self._reader_drop_seen: list[int] = []
+        # lifetime per-context conservation attribution, [home] + one
+        # per reader shard: samples committed (counted at the flush-edge
+        # detach fence) and samples shed at that context's spill caps
+        self.reader_committed: list[int] = []
+        self.reader_dropped: list[int] = []
         self._mesh_pool = None
         # always-hot flush (ops/microfold.py): when enabled, a scheduler
         # calls micro_fold_once() every time the staged-sample backlog
@@ -766,6 +791,8 @@ class DeviceWorker:
         n = self._processed_py
         if self._native is not None:
             n += int(self._native.processed) - self._native_proc_seen
+        for i, ctx in enumerate(self._reader_ctxs):
+            n += int(ctx.processed) - self._reader_proc_seen[i]
         return n
 
     @processed.setter
@@ -775,6 +802,8 @@ class DeviceWorker:
         nd = 0
         if self._native is not None:
             nd = int(self._native.processed) - self._native_proc_seen
+        for i, ctx in enumerate(self._reader_ctxs):
+            nd += int(ctx.processed) - self._reader_proc_seen[i]
         self._processed_py = v - nd
 
     # -- native front-end ----------------------------------------------------
@@ -803,6 +832,60 @@ class DeviceWorker:
                 pass
         return True
 
+    def attach_reader_shards(self, n: int) -> bool:
+        """Shared-nothing multi-reader ingest: give each of n reader
+        threads its OWN native context — private directory, staging
+        plane, SoA spill epoch — so the commit hot path takes no shared
+        mutex (the per-context lock survives only at the flush-edge
+        detach fence and the periodic drains, where it is uncontended).
+
+        Series identity becomes (reader, local row), reconciled into
+        this worker's canonical Python directory at the series sync
+        (_sync_native_series appends each context's local row to a
+        local→canonical map); the flush folds all staging planes
+        on-device as ONE stacked batch (ops/reader_stack.py), so every
+        downstream consumer sees the same output as the legacy
+        digest-routed path. Requires an attached home context, no mesh
+        pool, and a reader-shard-capable .so. Returns False (legacy
+        path keeps working) when any precondition fails."""
+        if n < 1 or self._reader_ctxs:
+            return bool(self._reader_ctxs)
+        if self._native is None or self._mesh_pool is not None:
+            return False
+        if not hasattr(self._native._lib, "vn_ingest_home"):
+            return False  # stale .so: no home-aware commit entry point
+        from veneur_tpu.native import NativeIngest
+
+        ctxs = []
+        try:
+            for _ in range(n):
+                ctx = NativeIngest(self.hll_precision,
+                                   set_hash=self.set_hash)
+                if self.stage_depth > 0:
+                    ctx.set_stage_depth(self.stage_depth)
+                if self.spill_cap:
+                    ctx.set_spill_cap(self.spill_cap)
+                ctxs.append(ctx)
+        except (RuntimeError, OSError, AttributeError):
+            for ctx in ctxs:
+                ctx.close()
+            return False
+        self._reader_ctxs = ctxs
+        self._reader_errs_seen = [0] * n
+        self._reader_proc_seen = [0] * n
+        self._reader_drop_seen = [0] * n
+        self.reader_committed = [0] * (n + 1)
+        self.reader_dropped = [0] * (n + 1)
+        # the maps were sized for zero reader contexts at construction
+        self._ctx_maps = [tuple(array("i") for _ in range(4))
+                          for _ in range(n + 1)]
+        return True
+
+    def _all_ctxs(self) -> list:
+        """[home context] + reader-shard contexts, in the context order
+        every reconciliation structure is indexed by."""
+        return [self._native] + self._reader_ctxs
+
     def ingest_datagram(self, datagram: bytes) -> int:
         """Native-path ingest of one (possibly multi-line) datagram.
         Returns leftover event/service-check lines via drain_other on the
@@ -828,12 +911,36 @@ class DeviceWorker:
                 self.drain_native()
         return rc
 
-    def _sync_native_series(self) -> None:
+    def _scalar_upsert_meta(self, pool, meta) -> int:
+        """ScalarPool twin of _Pool.upsert_meta: dedup by (key, class),
+        adopting a fresh row only for a genuinely new series (adopt_row
+        leaves index maintenance to its caller)."""
+        k = (meta.key, meta.scope_class)
+        row = pool.index.get(k)
+        if row is not None:
+            return row
+        row = len(pool.meta)
+        pool.index[k] = row
+        pool.adopt_row(row, meta.key, meta.tags, meta.scope_class,
+                       meta.sinks, frag=meta.wire_frag(),
+                       admitted=meta.admitted)
+        return row
+
+    def _sync_native_series(self, ctx=None, ctx_i: int = 0) -> None:
         from veneur_tpu.core.directory import RowMeta
         from veneur_tpu.native import NativeIngest
 
-        if not self._native.pending_new_series:
+        if ctx is None:
+            ctx = self._native
+        if not ctx.pending_new_series:
             return
+        # reader-shard mode: context rows are LOCAL — reconcile each into
+        # the worker's canonical directory (dedup by series identity, so
+        # the same series arriving via several readers shares one
+        # canonical row) and append the translation to this context's
+        # local→canonical map. The home context (ctx_i 0) reconciles the
+        # same way so every native row space is treated uniformly.
+        shard_maps = self._ctx_maps[ctx_i] if self._reader_ctxs else None
         # cross-epoch adopt cache: every flush resets the directory and
         # the same series re-register next interval; their RowMeta
         # (key, tags, routing) is identical every time, so build it once
@@ -841,7 +948,7 @@ class DeviceWorker:
         # of the global tier's steady-state import before this cache
         cache = self._adopt_cache
         for pool, row, kind, scope, name, joined in (
-            self._native.drain_new_series()
+            ctx.drain_new_series()
         ):
             ck = (pool, kind, scope, name, joined)
             meta = cache.get(ck)
@@ -875,7 +982,22 @@ class DeviceWorker:
                 # path, worker.go:300-341) and per-series feeding agree
                 self._sample_timeseries_key(name, meta.key.type, joined,
                                             meta.scope_class)
-            if pool == 0:
+            if shard_maps is not None:
+                arr = shard_maps[pool]
+                assert row == len(arr), \
+                    "reader-shard series must drain in row order"
+                if pool == 0:
+                    crow, _ = self.directory.histo.upsert_meta(meta)
+                elif pool == 1:
+                    crow, _ = self.directory.sets.upsert_meta(meta)
+                elif pool == 2:
+                    crow = self._scalar_upsert_meta(
+                        self.scalars.counters, meta)
+                else:
+                    crow = self._scalar_upsert_meta(
+                        self.scalars.gauges, meta)
+                arr.append(crow)
+            elif pool == 0:
                 self.directory.histo.adopt_meta(row, meta)
             elif pool == 1:
                 self.directory.sets.adopt_meta(row, meta)
@@ -901,17 +1023,40 @@ class DeviceWorker:
         context lock itself."""
         if self._native is None:
             return
-        self._native.lock()
-        try:
-            self._sync_native_series()
-        finally:
-            self._native.unlock()
+        for i, ctx in enumerate(self._all_ctxs()
+                                if self._reader_ctxs else [self._native]):
+            ctx.lock()
+            try:
+                self._sync_native_series(ctx, i)
+            finally:
+                ctx.unlock()
+
+    def native_series_pending(self) -> bool:
+        """Lock-free pending-new-series probe across every native
+        context (the server's sync-sweep early-out)."""
+        if self._native is None:
+            return False
+        if any(ctx.pending_new_series for ctx in self._reader_ctxs):
+            return True
+        return bool(self._native.pending_new_series)
 
     def drain_native(self) -> None:
         """Move everything pending in the native pipeline into device/host
         state. Holds the context lock across the whole raw-drain so routed
         commits from reader threads can't interleave between calls."""
         if self._native is None:
+            return
+        if self._reader_ctxs:
+            # shard mode: per-context drain → local→canonical row
+            # translation → apply. Each context's lock is held only for
+            # its own drain (shared-nothing extends to the drain path).
+            for i, ctx in enumerate(self._all_ctxs()):
+                ctx.lock()
+                try:
+                    raw = self._drain_native_raw_ctx(ctx, i)
+                finally:
+                    ctx.unlock()
+                self._apply_native_raw(self._map_raw_rows(i, raw))
             return
         self._native.lock()
         try:
@@ -920,7 +1065,93 @@ class DeviceWorker:
             self._native.unlock()
         self._apply_native_raw(raw)
 
+    def _map_raw_rows(self, ctx_i: int, raw):
+        """Translate one context's drained SoA batches from its LOCAL
+        row space to canonical rows via the reconciliation maps built at
+        series sync. Samples drain after their series registration (same
+        C++ critical section ordering), so every row has a map entry —
+        a miss is a bug and raises IndexError loudly."""
+        h, s, c, g, st, others, ssf_fb = raw
+        maps = self._ctx_maps[ctx_i]
+
+        def translate(pool_i: int, rows):
+            return np.frombuffer(maps[pool_i], dtype=np.int32)[rows]
+
+        if h is not None and len(h[0]):
+            h = (translate(0, h[0]), h[1], h[2])
+        if s is not None and len(s[0]):
+            s = (translate(1, s[0]), s[1], s[2])
+        rows, contribs = c
+        if len(rows):
+            c = (translate(2, rows), contribs)
+        rows, vals = g
+        if len(rows):
+            g = (translate(3, rows), vals)
+        return h, s, c, g, st, others, ssf_fb
+
+    def native_rows_canonical(self, rows, kinds, sel):
+        """Translate rows handed back by the home context's batched
+        upsert (native.upsert_many — the import wire path) to canonical
+        rows. Identity on the legacy path; in reader-shard mode every
+        native row space is local and maps through the home context's
+        reconciliation map (the caller must have synced new series
+        first, so the map covers every returned row)."""
+        if not self._reader_ctxs:
+            return rows
+        maps = self._ctx_maps[0]
+        out = np.asarray(rows).copy()
+        for pool_i, kmask in ((0, (kinds == 2) | (kinds == 3)),
+                              (1, kinds == 4),
+                              (2, kinds == 0),
+                              (3, kinds == 1)):
+            m = sel & kmask
+            if m.any():
+                lookup = np.frombuffer(maps[pool_i], dtype=np.int32)
+                out[m] = lookup[out[m]]
+        return out
+
+    def reader_stats(self, lock_stats: bool = False) -> dict:
+        """Per-context ingest attribution for Server.ingress_stats /
+        flush telemetry: context order is [home] + reader shards.
+        lock_stats=True also reads each context's commit-mutex record
+        (meaningful only while vn_set_lock_stats is on)."""
+        out = {
+            "shards": len(self._reader_ctxs),
+            "committed": list(self.reader_committed),
+            "dropped": list(self.reader_dropped),
+        }
+        if lock_stats and self._native is not None:
+            locks = []
+            for ctx in self._all_ctxs():
+                st = ctx.lock_stats()
+                acq = st["acquisitions"]
+                waits = sorted(st["wait_ns_samples"])
+                holds = sorted(st["hold_ns_samples"])
+
+                def pct(sorted_ns, q):
+                    if not sorted_ns:
+                        return 0
+                    return sorted_ns[min(len(sorted_ns) - 1,
+                                         int(q * len(sorted_ns)))]
+
+                locks.append({
+                    "acquisitions": acq,
+                    "contended": st["contended"],
+                    "contended_fraction": (st["contended"] / acq
+                                           if acq else 0.0),
+                    "wait_ns_p50": pct(waits, 0.50),
+                    "wait_ns_p99": pct(waits, 0.99),
+                    "hold_ns_p50": pct(holds, 0.50),
+                    "hold_ns_p99": pct(holds, 0.99),
+                })
+            out["lock"] = locks
+        return out
+
     def _drain_native_raw(self, detach_stage: bool = False):
+        return self._drain_native_raw_ctx(self._native, 0, detach_stage)
+
+    def _drain_native_raw_ctx(self, ctx, ctx_i: int,
+                              detach_stage: bool = False):
         """Pull raw sample buffers + bookkeeping out of the C++ context.
         Caller holds the context lock. Samples drain BEFORE the new-series
         sync: a sample's series record is committed at-or-before the
@@ -931,34 +1162,46 @@ class DeviceWorker:
         detach_stage (flush only): also detach the C++ staging plane —
         must happen in the same critical section as the epoch close so no
         staged sample is destroyed by the reset."""
-        errs = int(self._native.errors)
-        self.parse_errors += errs - self._native_errs_seen
-        self._native_errs_seen = errs
-        dropped = int(self._native.overload_dropped)
-        delta = dropped - self._native_drop_seen
+        errs = int(ctx.errors)
+        dropped = int(ctx.overload_dropped)
+        if ctx_i == 0:
+            e_seen, d_seen = self._native_errs_seen, self._native_drop_seen
+            self._native_errs_seen = errs
+            self._native_drop_seen = dropped
+        else:
+            j = ctx_i - 1
+            e_seen = self._reader_errs_seen[j]
+            d_seen = self._reader_drop_seen[j]
+            self._reader_errs_seen[j] = errs
+            self._reader_drop_seen[j] = dropped
+        self.parse_errors += errs - e_seen
+        delta = dropped - d_seen
         self.overload_dropped += delta
         # lifetime tally (never reset): self-telemetry consumes the
         # per-interval field above; soaks/operators read this one
         self.overload_dropped_total += delta
-        self._native_drop_seen = dropped
-        n = self._native.pending_histo
-        h = self._native.drain_histo(n) if n else None
-        n = self._native.pending_set
-        s = self._native.drain_set(n) if n else None
+        if self.reader_dropped:
+            # per-context shed attribution (conservation: committed ==
+            # folded + shed, per reader)
+            self.reader_dropped[ctx_i] += delta
+        n = ctx.pending_histo
+        h = ctx.drain_histo(n) if n else None
+        n = ctx.pending_set
+        s = ctx.drain_set(n) if n else None
         # sized by the actual pending counts: a fixed 4M-entry drain both
         # allocated ~50MB of scratch per (100ms-cadence) pump call and
         # silently destroyed anything beyond it at the epoch reset when
         # tpu_spill_cap is raised above the old constant
-        n = self._native.pending_counter
-        c = self._native.drain_counter(n)
-        n = self._native.pending_gauge
-        g = self._native.drain_gauge(n)
+        n = ctx.pending_counter
+        c = ctx.drain_counter(n)
+        n = ctx.pending_gauge
+        g = ctx.drain_gauge(n)
         st = None
         others: list = []
         ssf_fb: list = []
         if detach_stage:
             try:
-                st = self._native.detach_stage()
+                st = ctx.detach_stage()
             except AttributeError:  # stale .so without the staging API
                 st = None
             # epoch close: pull buffered event/service-check lines and
@@ -966,12 +1209,12 @@ class DeviceWorker:
             # the reset right after this drain clears both buffers, and
             # anything landing between a separate drain and the reset
             # would be destroyed
-            others = self._native.drain_other()
+            others = ctx.drain_other()
             try:
-                ssf_fb = self._native.drain_ssf_fallback()
+                ssf_fb = ctx.drain_ssf_fallback()
             except AttributeError:  # stale .so without the SSF reader API
                 pass
-        self._sync_native_series()
+        self._sync_native_series(ctx, ctx_i)
         return h, s, c, g, st, others, ssf_fb
 
     def _apply_native_raw(self, raw, defer_histo_spill: bool = False):
@@ -1032,9 +1275,13 @@ class DeviceWorker:
 
     def _micro_active(self) -> bool:
         """Micro-folds engage only where the staged fold exists: staging
-        on and no mesh (mesh rows bypass the staging plane entirely)."""
+        on and no mesh (mesh rows bypass the staging plane entirely).
+        Reader-shard mode also opts out: the mirror would need N
+        per-context COO streams re-keyed to canonical rows mid-interval;
+        the stacked flush-edge merge (ops/reader_stack.py) covers the
+        same work, so always-hot flush stays a legacy-path feature."""
         return (self.micro_fold and self.stage_depth > 0
-                and self._mesh_pool is None)
+                and self._mesh_pool is None and not self._reader_ctxs)
 
     def _ensure_micro(self) -> "mf.MicroFoldMirror":
         if self._micro is None:
@@ -1168,9 +1415,9 @@ class DeviceWorker:
 
     def _reset_epoch(self) -> None:
         if getattr(self, "_native_epoch_closed", False):
-            # flush already reset the context atomically with its drain;
-            # resetting again here would destroy new-epoch commits that
-            # routed readers landed in the meantime
+            # flush already reset the context(s) atomically with its
+            # drain; resetting again here would destroy new-epoch commits
+            # that routed readers landed in the meantime
             self._native_epoch_closed = False
         else:
             if self._native is not None:
@@ -1178,6 +1425,17 @@ class DeviceWorker:
             self._native_errs_seen = 0
             self._native_proc_seen = 0
             self._native_drop_seen = 0
+            for i, ctx in enumerate(self._reader_ctxs):
+                ctx.reset()
+                self._reader_errs_seen[i] = 0
+                self._reader_proc_seen[i] = 0
+                self._reader_drop_seen[i] = 0
+        # per-context local-row → canonical-row reconciliation maps, one
+        # int32 array per pool kind (histo/set/counter/gauge), [home] +
+        # readers. Rebuilt every epoch: context resets restart local rows
+        # at 0 and the canonical directory is fresh too.
+        self._ctx_maps = [tuple(array("i") for _ in range(4))
+                          for _ in range(1 + len(self._reader_ctxs))]
         self._processed_py = 0
         self.parse_errors = getattr(self, "parse_errors", 0)
         # the epoch's per-tenant tallies were accumulated into the
@@ -1272,7 +1530,11 @@ class DeviceWorker:
             tenant = tenant_of(m.tags, self.tenancy.tag_key)
             tt = self.tenant_tallies
             tt.accepted[tenant] = tt.accepted.get(tenant, 0) + 1
-            if self._native is None and mtype != "status":
+            # reader-shard mode takes the Python branch too: its Python-
+            # path series live in the Python pools (the canonical row
+            # space), so admission happens here exactly like non-native
+            if ((self._native is None or self._reader_ctxs)
+                    and mtype != "status"):
                 if not self._admit_sample(tenant, m.key, scope_class,
                                           mtype):
                     tt.rejected[tenant] = tt.rejected.get(tenant, 0) + 1
@@ -1335,7 +1597,10 @@ class DeviceWorker:
 
     def _upsert_histo(self, key: MetricKey, scope_class: ScopeClass,
                       tags: list[str], tenant: str = "") -> int:
-        if self._native is not None:
+        # reader-shard mode routes Python-path samples through the
+        # Python pools: the canonical row space IS the Python directory
+        # there, and a native upsert would hand back a context-LOCAL row
+        if self._native is not None and not self._reader_ctxs:
             row = self._native.upsert(key.name, key.type, key.joined_tags,
                                       int(scope_class))
             # adoption is deferred and batched: metadata drains every
@@ -1351,7 +1616,7 @@ class DeviceWorker:
 
     def _upsert_set(self, key: MetricKey, scope_class: ScopeClass,
                     tags: list[str], tenant: str = "") -> int:
-        if self._native is not None:
+        if self._native is not None and not self._reader_ctxs:
             row = self._native.upsert(key.name, "set", key.joined_tags,
                                       int(scope_class))
             if self._native.pending_new_series >= 1024:
@@ -1398,7 +1663,7 @@ class DeviceWorker:
     def _host_counter(self, key: MetricKey, scope_class: ScopeClass,
                       tags: list[str], contribution: int) -> None:
         pool = self.scalars.counters
-        if self._native is not None:
+        if self._native is not None and not self._reader_ctxs:
             row = self._native.upsert(key.name, "counter", key.joined_tags,
                                       int(scope_class))
             self._sync_native_series()
@@ -1410,7 +1675,7 @@ class DeviceWorker:
     def _host_gauge(self, key: MetricKey, scope_class: ScopeClass,
                     tags: list[str], value: float) -> None:
         pool = self.scalars.gauges
-        if self._native is not None:
+        if self._native is not None and not self._reader_ctxs:
             row = self._native.upsert(key.name, "gauge", key.joined_tags,
                                       int(scope_class))
             self._sync_native_series()
@@ -1877,6 +2142,63 @@ class DeviceWorker:
 
     # -- flush --------------------------------------------------------------
 
+    def _shed_spill_budget(self, spill_histo):
+        """Bound the fold work this flush inherits: backlog past what
+        the measured fold rate can absorb in the budget sheds here
+        (newest samples kept — freshest values win), counted like every
+        other overload drop. Without this a starved host hands a 40s+
+        backlog to every flush and the cadence collapses (round-5
+        overload measurement). Tenant-aware when a ledger is installed
+        (health/policy.py): over-budget tenants shed first."""
+        if spill_histo is None:
+            return None
+        budget = max(_FOLD_CHUNK,
+                     int(self._fold_rate_ewma * self.fold_budget_s))
+        total = len(spill_histo[0])
+        if total <= budget:
+            return spill_histo
+        shed = total - budget
+        self.overload_dropped += shed
+        self.overload_dropped_total += shed
+        led = self.tenancy
+        if led is None:
+            return tuple(a[-budget:] for a in spill_histo)
+        # tenant-aware shed (health/policy.py): samples of over-budget
+        # tenants go first; with no such tenant the keep set reduces
+        # bitwise to the a[-budget:] slice above. Per-tenant drop
+        # attribution lands in the epoch tallies and the governor (the
+        # isolation soak's zero-innocent-shed assertion reads both).
+        from veneur_tpu.health.policy import shed_spill_keep
+
+        sp_rows = spill_histo[0]
+        hrows = self.directory.histo.rows
+        row_tenants = np.array(
+            [m.tenant or DEFAULT_TENANT for m in hrows],
+            dtype=object)
+        abusive = led.over_budget()
+        if abusive:
+            is_abusive = np.isin(
+                row_tenants[sp_rows],
+                np.array(sorted(abusive), dtype=object))
+            keep = shed_spill_keep(is_abusive, budget)
+        else:
+            keep = np.arange(total - budget, total, dtype=np.int64)
+        drop_mask = np.ones(total, bool)
+        drop_mask[keep] = False
+        t_list, t_counts = np.unique(
+            row_tenants[sp_rows[drop_mask]],
+            return_counts=True)
+        tt = self.tenant_tallies
+        gov = self.governor
+        for t, c in zip(t_list.tolist(), t_counts.tolist()):
+            tt.dropped[t] = tt.dropped.get(t, 0) + int(c)
+            if gov is not None:
+                try:
+                    gov.note_tenant_shed(t, int(c))
+                except AttributeError:
+                    pass
+        return tuple(a[keep] for a in spill_histo)
+
     def swap(self, quantiles: np.ndarray) -> "SwappedEpoch":
         """Close the current epoch and return the old-interval state.
 
@@ -1897,14 +2219,90 @@ class DeviceWorker:
         # accumulator — same split as overload_dropped vs
         # overload_dropped_total). The caller holds this worker's ingest
         # lock across swap(), which is what keeps the pair (total,
-        # per-epoch) consistent for locked readers.
-        self.processed_total += self.processed
+        # per-epoch) consistent for locked readers. Reader-shard mode
+        # accumulates the native deltas inside the flush-edge fence
+        # instead: owned readers commit WITHOUT the worker lock, so an
+        # unlocked pre-fence read here would miss lines landing before
+        # each context's locked fence read and break the exact
+        # attribution books (sum(reader_committed) == processed_total).
+        if self._native is not None and self._reader_ctxs:
+            self.processed_total += self._processed_py
+        else:
+            self.processed_total += self.processed
         native_stage = None
         spill_histo = None
         micro_s = 0.0
         micro_coo: list = []
         native_mirrored = False
-        if self._native is not None:
+        reader_planes = None
+        if self._native is not None and self._reader_ctxs:
+            # shared-nothing flush-edge fence: walk [home] + reader
+            # contexts; each context's lock is held only for its OWN
+            # drain + detach + reset, so a committing reader contends
+            # only when the fence reaches its shard — exactly once per
+            # flush. Micro-folds are inactive in shard mode (see
+            # _micro_active), so no mirror fence is needed.
+            raws = []
+            for i, ctx in enumerate(self._all_ctxs()):
+                seen = (self._native_proc_seen if i == 0
+                        else self._reader_proc_seen[i - 1])
+                ctx.lock()
+                try:
+                    raw = self._drain_native_raw_ctx(
+                        ctx, i, detach_stage=True)
+                    # per-context committed attribution, read inside
+                    # the lock so the reset below can't race a commit;
+                    # the same locked delta feeds processed_total (see
+                    # the swap-top comment)
+                    delta = int(ctx.processed) - seen
+                    self.reader_committed[i] += delta
+                    self.processed_total += delta
+                    ctx.reset()
+                    if i == 0:
+                        self._native_errs_seen = 0
+                        self._native_proc_seen = 0
+                        self._native_drop_seen = 0
+                    else:
+                        self._reader_errs_seen[i - 1] = 0
+                        self._reader_proc_seen[i - 1] = 0
+                        self._reader_drop_seen[i - 1] = 0
+                finally:
+                    ctx.unlock()
+                raws.append(raw)
+            self._native_epoch_closed = True
+            # off-lock: translate each context's SoA rows to canonical,
+            # apply them in context order (counters add in order,
+            # gauges stay last-write-wins in context order — the
+            # serialized-reader-order ground truth the parity tests
+            # pin), and collect the detached planes with map COPIES
+            # for the stacked fold at extraction
+            others: list = []
+            ssf_fb: list = []
+            spills: list = []
+            planes: list = []
+            for i, raw in enumerate(raws):
+                mapped = self._map_raw_rows(i, raw)
+                d = self._apply_native_raw(mapped, defer_histo_spill=True)
+                if d is not None and len(d[0]):
+                    spills.append(d)
+                others.extend(raw[5])
+                ssf_fb.extend(raw[6])
+                if raw[4] is not None:
+                    planes.append((raw[4], np.frombuffer(
+                        self._ctx_maps[i][0], dtype=np.int32).copy()))
+            self.pending_other_lines = others
+            self.pending_ssf_fallback = ssf_fb
+            if spills:
+                spill_histo = (spills[0] if len(spills) == 1 else tuple(
+                    np.concatenate([sp[k] for sp in spills])
+                    for k in range(3)))
+            spill_histo = self._shed_spill_budget(spill_histo)
+            reader_planes = planes or None
+            if reader_planes:
+                # the stacked fold lands in the canonical pool: it must
+                # exist even when every sample this epoch was staged
+                self._ensure_histo(self.directory.num_histo_rows)
+        elif self._native is not None:
             # drain, detach the staging plane, and close the native epoch
             # under one lock hold: a routed commit can otherwise land
             # between the last drain and the reset and be destroyed with
@@ -1947,67 +2345,8 @@ class DeviceWorker:
                 self._native_epoch_closed = True
             finally:
                 self._native.unlock()
-            spill_histo = self._apply_native_raw(raw,
-                                                 defer_histo_spill=True)
-            if spill_histo is not None:
-                # bound the fold work this flush inherits: backlog past
-                # what the measured fold rate can absorb in the budget
-                # sheds here (newest samples kept — freshest values win),
-                # counted like every other overload drop. Without this a
-                # starved host hands a 40s+ backlog to every flush and
-                # the cadence collapses (round-5 overload measurement).
-                budget = max(_FOLD_CHUNK,
-                             int(self._fold_rate_ewma * self.fold_budget_s))
-                total = len(spill_histo[0])
-                if total > budget:
-                    shed = total - budget
-                    self.overload_dropped += shed
-                    self.overload_dropped_total += shed
-                    led = self.tenancy
-                    if led is None:
-                        spill_histo = tuple(
-                            a[-budget:] for a in spill_histo)
-                    else:
-                        # tenant-aware shed (health/policy.py): samples
-                        # of over-budget tenants go first; with no such
-                        # tenant the keep set reduces bitwise to the
-                        # a[-budget:] slice above. Per-tenant drop
-                        # attribution lands in the epoch tallies and the
-                        # governor (the isolation soak's zero-innocent-
-                        # shed assertion reads both).
-                        from veneur_tpu.health.policy import shed_spill_keep
-
-                        sp_rows = spill_histo[0]
-                        hrows = self.directory.histo.rows
-                        row_tenants = np.array(
-                            [m.tenant or DEFAULT_TENANT for m in hrows],
-                            dtype=object)
-                        abusive = led.over_budget()
-                        if abusive:
-                            is_abusive = np.isin(
-                                row_tenants[sp_rows],
-                                np.array(sorted(abusive), dtype=object))
-                            keep = shed_spill_keep(is_abusive, budget)
-                        else:
-                            keep = np.arange(total - budget, total,
-                                             dtype=np.int64)
-                        drop_mask = np.ones(total, bool)
-                        drop_mask[keep] = False
-                        t_list, t_counts = np.unique(
-                            row_tenants[sp_rows[drop_mask]],
-                            return_counts=True)
-                        tt = self.tenant_tallies
-                        gov = self.governor
-                        for t, c in zip(t_list.tolist(),
-                                        t_counts.tolist()):
-                            tt.dropped[t] = tt.dropped.get(t, 0) + int(c)
-                            if gov is not None:
-                                try:
-                                    gov.note_tenant_shed(t, int(c))
-                                except AttributeError:
-                                    pass
-                        spill_histo = tuple(
-                            a[keep] for a in spill_histo)
+            spill_histo = self._shed_spill_budget(
+                self._apply_native_raw(raw, defer_histo_spill=True))
             if native_stage is not None and self._mesh_pool is not None:
                 # samples staged before attach_mesh_pool() disabled
                 # staging belong to the mesh shards, not the local fold
@@ -2100,6 +2439,8 @@ class DeviceWorker:
                     StagedPlane(sv, None if unit else sw, counts, free))
         if micro_residual is not None:
             staged += micro_samples
+        if reader_planes:
+            staged += sum(int(st[2].sum()) for st, _m in reader_planes)
         staged_histo = staged_histo or None
         # flush self-telemetry (veneur.worker.samples_staged_total)
         self.staged_samples_swapped = staged
@@ -2109,7 +2450,7 @@ class DeviceWorker:
             staged_sets=self._staged_sets, umts=self._umts,
             mesh_out=mesh_out, staged_histo=staged_histo,
             spill_histo=spill_histo, device_stage=device_stage,
-            micro_residual=micro_residual,
+            micro_residual=micro_residual, reader_planes=reader_planes,
         )
         # per-tenant lifetime fold, still under the caller's ingest lock
         # and BEFORE the epoch reset zeroes the per-epoch dicts — the
@@ -2189,6 +2530,41 @@ class DeviceWorker:
                 plane.free()
                 # freed: the caller's cleanup must not free it again
                 pending[0] = plane._replace(free=None)
+                svj, swj = _expand_flat_planes(fvj, fwj, cj, B, unit)
+        elif plane.counts is not None:
+            # pre-compacted flat plane (ops/reader_stack.merge_reader_
+            # planes): vals/wts are ALREADY the 1-D row-major compaction
+            # the native branch above builds, in canonical row order —
+            # skip the compaction and go straight to the flat upload +
+            # on-device expand, the exact legacy program
+            flat_v = plane.vals
+            counts_np = plane.counts
+            if len(counts_np) < s_eff:
+                counts_np = np.pad(counts_np, (0, s_eff - len(counts_np)))
+            elif len(counts_np) > s_eff:
+                counts_np = counts_np[:s_eff]
+            unit = plane.wts is None
+            B = self.stage_depth
+            sh = self._shard
+            if sh is not None:
+                fvj, fwj, cj = self._shard_flat_upload(
+                    flat_v, plane.wts, counts_np, s_eff)
+                if unit:
+                    fwj = fvj  # ignored under unit=True (XLA DCEs it)
+                svj, swj = sh.expand_flat(fvj, fwj, cj, B, unit)
+            else:
+                n_pad = _next_pow2(max(len(flat_v), 1), 1024)
+                fv = np.zeros(n_pad, np.float32)
+                fv[:len(flat_v)] = flat_v
+                fvj = self.ledger.h2d(fv, "staged_flat")
+                cj = self.ledger.h2d(counts_np.astype(np.int32),
+                                     "staged_counts")
+                if unit:
+                    fwj = fvj  # ignored under unit=True (XLA DCEs it)
+                else:
+                    fw = np.zeros(n_pad, np.float32)
+                    fw[:len(plane.wts)] = plane.wts
+                    fwj = self.ledger.h2d(fw, "staged_flat")
                 svj, swj = _expand_flat_planes(fvj, fwj, cj, B, unit)
         else:
             # Python-owned plane: the dense upload IS O(rows x depth) —
@@ -2325,6 +2701,39 @@ class DeviceWorker:
                     histo.lmin, histo.lmax, histo.lsum, histo.lsum_c,
                     histo.lweight, histo.lweight_c, histo.lrecip,
                     histo.lrecip_c)
+            merged_plane = None
+            rplanes = swapped.reader_planes
+            swapped.reader_planes = None
+            if rplanes:
+                # stacked reader-shard fold: host-merge the per-context
+                # planes into ONE canonical flat batch (stable context
+                # order per row — the serialized-reader-order ground
+                # truth), release the C++ memory, and feed the batch to
+                # the same flat-upload fold the legacy plane takes.
+                # Rows whose stacked total exceeds the staging depth
+                # route the excess through the spill fold below —
+                # conservation stays exact.
+                flat_v, flat_w, rcounts, rspill, per_ctx = (
+                    rstack.merge_reader_planes(rplanes, s_eff))
+                for st, _m in rplanes:
+                    if st[4] is not None:
+                        try:
+                            st[4]()
+                        except Exception:  # pragma: no cover
+                            log.exception("reader plane free failed")
+                if any(per_ctx):
+                    # per-reader upload attribution (health/ledger.py):
+                    # the actual h2d bytes are booked by the fold below;
+                    # this records who contributed them
+                    self.ledger.count_h2d_readers(
+                        [int(k) * 4 for k in per_ctx], "staged_flat")
+                if flat_v is not None:
+                    merged_plane = StagedPlane(flat_v, flat_w, rcounts,
+                                               None)
+                if rspill is not None:
+                    spill = (rspill if spill is None else tuple(
+                        np.concatenate([spill[k], rspill[k]])
+                        for k in range(3)))
             if spill is not None:
                 # hot-row spill backlog deferred by swap(): chunked fold
                 # off the ingest lock (plain numpy from drain_histo — no
@@ -2366,6 +2775,8 @@ class DeviceWorker:
                     a if a.shape[0] == s_eff else sh.slice_field(a, s_eff)
                     for a in full)
             pending = list(swapped.staged_histo or ())
+            if merged_plane is not None:
+                pending.append(merged_plane)
             swapped.staged_histo = None
             try:
                 while pending:
@@ -2542,6 +2953,17 @@ class DeviceWorker:
             # meaningful, but C++ memory must still be released
             _free_staged_planes(swapped.staged_histo)
             swapped.staged_histo = None
+        if swapped.reader_planes:
+            # same skip case for reader-shard planes: no canonical histo
+            # rows means no staged histo samples synced, but the C++
+            # plane memory must still be released
+            for st, _m in swapped.reader_planes:
+                if st[4] is not None:
+                    try:
+                        st[4]()
+                    except Exception:  # pragma: no cover
+                        log.exception("reader plane free failed")
+            swapped.reader_planes = None
         # (a mirror with nowhere to fold is just device garbage — drop it,
         # along with any never-fed residual: no rows means nothing to lose)
         swapped.device_stage = None

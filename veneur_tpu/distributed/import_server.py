@@ -294,6 +294,10 @@ class ImportServer:
                 # adopt new series now: the batched drain keeps the
                 # Python directory mirror in lockstep
                 w._sync_native_series()
+                # reader-shard mode: upsert_many rows are home-context-
+                # LOCAL; the import appliers below address canonical
+                # pool rows (identity on the legacy path)
+                rows = w.native_rows_canonical(rows, d.kinds, sel)
                 hmask = sel & (vk == 3)
                 if hmask.any():
                     idx = np.nonzero(hmask)[0]

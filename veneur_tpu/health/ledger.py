@@ -65,6 +65,13 @@ class TransferLedger:
         # read these; kind tallies above stay the cross-shard totals.
         self._flush_h2d_shards: list[int] = []
         self._flush_d2h_shards: list[int] = []
+        # per-READER byte attribution for the current flush (reader-
+        # sharded ingest, core/worker.attach_reader_shards): index i =
+        # staged bytes that originated in context i (0 = home). Unlike
+        # the shard lists these do NOT add to the kind tallies — the
+        # merged batch is uploaded once and booked by h2d(); this is a
+        # provenance breakdown of that single transfer.
+        self._flush_h2d_readers: list[int] = []
 
     def begin_flush(self) -> None:
         with self._lock:
@@ -73,6 +80,7 @@ class TransferLedger:
             self._flush_d2h = {}
             self._flush_h2d_shards = []
             self._flush_d2h_shards = []
+            self._flush_h2d_readers = []
             self.flushes += 1
 
     # -- transfer wrappers ------------------------------------------------
@@ -161,6 +169,24 @@ class TransferLedger:
     def flush_h2d_per_shard(self) -> list:
         with self._lock:
             return list(self._flush_h2d_shards)
+
+    # -- per-reader accounting (reader-sharded ingest) --------------------
+
+    def count_h2d_readers(self, per_reader, kind: str) -> None:
+        """Attribute already-booked upload bytes to reader contexts:
+        per_reader[i] bytes of the merged staged batch originated in
+        context i (0 = home, 1.. = reader shards). ATTRIBUTION ONLY —
+        the merged flat plane goes through ONE h2d() call in
+        _fold_one_plane which books the kind tally and totals; counting
+        the bytes again here would double the transfer-diet pin, so
+        this only feeds the flush_h2d_per_reader() breakdown."""
+        per_reader = [int(b) for b in per_reader]
+        with self._lock:
+            self._acc_shards(self._flush_h2d_readers, per_reader)
+
+    def flush_h2d_per_reader(self) -> list:
+        with self._lock:
+            return list(self._flush_h2d_readers)
 
     def flush_d2h_per_shard(self) -> list:
         with self._lock:

@@ -333,6 +333,25 @@ class LoadHarness:
                     "emit_ms": round(
                         flush_phases.get("sink_flush_s", 0.0) * 1e3, 2),
                 })
+                rs_now = snap.get("reader_shards")
+                if rs_now:
+                    # shared-nothing ingest: per-context committed/
+                    # dropped deltas for this window (index 0 = home
+                    # context, 1.. = reader shards) — the reader-balance
+                    # evidence in the --readers bench artifact
+                    rs_prev = (prev.get("reader_shards") or
+                               {"committed": [], "dropped": []})
+
+                    def _deltas(key):
+                        now = rs_now.get(key) or []
+                        before = rs_prev.get(key) or []
+                        before = before + [0] * (len(now) - len(before))
+                        return [int(a - b) for a, b in zip(now, before)]
+
+                    intervals[-1]["per_reader"] = {
+                        "committed": _deltas("committed"),
+                        "dropped": _deltas("dropped"),
+                    }
                 if self.ssf_frac > 0:
                     sp_now = snap.get("spans") or {}
                     sp_prev = prev.get("spans") or {}

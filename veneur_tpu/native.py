@@ -274,6 +274,23 @@ def load_library() -> Optional[ctypes.CDLL]:
                 c.c_void_p, c.c_char_p, c.c_int]
         except AttributeError:
             pass
+        try:
+            # reader-shard API: home-aware routed ingest (events/errors
+            # land on the caller's own shard) and reader constructors
+            # that take a home shard. Absent on a stale .so — callers
+            # fall back to the shard-0 funnel behaviour.
+            lib.vn_ingest_home.restype = c.c_int
+            lib.vn_ingest_home.argtypes = [
+                c.POINTER(c.c_void_p), c.c_int, c.c_char_p, c.c_int,
+                c.c_int]
+            lib.vn_reader_start2.restype = c.c_void_p
+            lib.vn_reader_start2.argtypes = [
+                c.POINTER(c.c_void_p), c.c_int, c.c_int, c.c_int, c.c_int]
+            lib.vn_stream_reader_start2.restype = c.c_void_p
+            lib.vn_stream_reader_start2.argtypes = [
+                c.POINTER(c.c_void_p), c.c_int, c.c_int, c.c_int, c.c_int]
+        except AttributeError:  # pre-reader-shard library
+            pass
         _lib = lib
         return _lib
 
@@ -322,6 +339,59 @@ class NativeIngest:
 
     def ingest(self, datagram: bytes) -> int:
         return self._lib.vn_ingest(self._ctx, datagram, len(datagram))
+
+    # shared-nothing reader-shard path --------------------------------------
+
+    def _self_arr(self):
+        arr = getattr(self, "_self_arr_c", None)
+        if arr is None:
+            arr = self._self_arr_c = (ctypes.c_void_p * 1)(self._ctx)
+        return arr
+
+    def ingest_owned(self, datagram: bytes) -> int:
+        """Shared-nothing ingest: parse lock-free, commit every line into
+        THIS context under its own (uncontended on the reader-shard path)
+        mutex — the in-process twin of an owned C++ reader thread.
+        Events/service checks and parse errors stay on this context too.
+        Raises AttributeError on a stale .so."""
+        return self._lib.vn_ingest_home(
+            self._self_arr(), 1, datagram, len(datagram), 0)
+
+    def start_owned_reader(self, fd: int, max_len: int):
+        """Spawn a C++ reader thread committing exclusively into this
+        context (the shared-nothing per-reader shape; same fd/stop
+        contract as NativeRouter.start_reader). Raises AttributeError on
+        a stale .so."""
+        h = self._lib.vn_reader_start2(self._self_arr(), 1, fd, max_len, 0)
+        if not h:
+            raise RuntimeError("vn_reader_start2 failed")
+        return h
+
+    def lock_stats(self) -> dict:
+        """This context's commit-mutex contention record (same shape as
+        NativeRouter.lock_stats); zeros on a stale .so."""
+        fn = getattr(self._lib, "vn_lock_stats", None)
+        if fn is None:
+            return {"acquisitions": 0, "contended": 0, "wait_ns_total": 0,
+                    "hold_ns_total": 0, "wait_ns_samples": [],
+                    "hold_ns_samples": []}
+        totals = (ctypes.c_longlong * 5)()
+        wait = (ctypes.c_longlong * 4096)()
+        hold = (ctypes.c_longlong * 4096)()
+        n = fn(self._ctx, totals, wait, hold, 4096)
+        return {
+            "acquisitions": int(totals[0]),
+            "contended": int(totals[1]),
+            "wait_ns_total": int(totals[2]),
+            "hold_ns_total": int(totals[3]),
+            "wait_ns_samples": [int(wait[i]) for i in range(n)],
+            "hold_ns_samples": [int(hold[i]) for i in range(n)],
+        }
+
+    def reset_lock_stats(self) -> None:
+        fn = getattr(self._lib, "vn_lock_stats_reset", None)
+        if fn is not None:
+            fn(self._ctx)
 
     # pending counts ---------------------------------------------------------
 
@@ -1035,12 +1105,18 @@ class NativeRouter:
 
     # native reader threads (C++ recv loop; no Python on the path) -----------
 
-    def start_reader(self, fd: int, max_len: int):
+    def start_reader(self, fd: int, max_len: int, home: int = 0):
         """Spawn a C++ reader thread on an already-bound datagram fd.
         The fd stays owned by the caller (keep the Python socket object
         alive); stop_reader() joins without closing it, preserving
-        fd-handoff semantics."""
-        h = self._lib.vn_reader_start(self._arr, self._n, fd, max_len)
+        fd-handoff semantics. `home` picks the shard that absorbs this
+        reader's events/service checks and parse errors (spreading the
+        funnel across workers); 0 on a stale .so without the API."""
+        start2 = getattr(self._lib, "vn_reader_start2", None)
+        if home and start2 is not None:
+            h = start2(self._arr, self._n, fd, max_len, home % self._n)
+        else:
+            h = self._lib.vn_reader_start(self._arr, self._n, fd, max_len)
         if not h:
             raise RuntimeError("vn_reader_start failed")
         return h
@@ -1054,12 +1130,18 @@ class NativeRouter:
         a pre-join snapshot would undercount)."""
         return int(self._lib.vn_reader_stop(handle))
 
-    def start_stream_reader(self, fd: int, max_len: int):
+    def start_stream_reader(self, fd: int, max_len: int, home: int = 0):
         """Spawn a C++ line-stream reader for a plain TCP connection.
         The reader OWNS fd (pass a dup) and closes it on exit; reap
-        finished readers with stream_reader_done + stop_stream_reader."""
-        h = self._lib.vn_stream_reader_start(self._arr, self._n, fd,
-                                             max_len)
+        finished readers with stream_reader_done + stop_stream_reader.
+        `home` routes this connection's events/errors like
+        start_reader's."""
+        start2 = getattr(self._lib, "vn_stream_reader_start2", None)
+        if home and start2 is not None:
+            h = start2(self._arr, self._n, fd, max_len, home % self._n)
+        else:
+            h = self._lib.vn_stream_reader_start(self._arr, self._n, fd,
+                                                 max_len)
         if not h:
             raise RuntimeError("vn_stream_reader_start failed")
         return h
