@@ -8,6 +8,7 @@ consul_discovery_test.go (stubbed HTTP responses).
 
 import json
 import time
+import urllib.error
 
 import numpy as np
 import pytest
@@ -394,6 +395,104 @@ def test_kubernetes_discoverer_parses_pod_list():
     d = KubernetesDiscoverer(opener=opener, token="tok")
     dests = d.get_destinations_for_service("veneur-global")
     assert dests == ["10.1.0.1:8128"]  # pending pod excluded
+
+
+def test_consul_discoverer_malformed_and_partial_entries():
+    # malformed body raises (the refresher's keep-last-good absorbs it)
+    d = ConsulDiscoverer(opener=lambda url, **kw: b'{"not a list"')
+    with pytest.raises(ValueError):
+        d.get_destinations_for_service("svc")
+    # entries missing an address or port are skipped, not emitted torn
+    payload = json.dumps([
+        {"Service": {"Address": "10.0.0.1", "Port": 8128}},
+        {"Service": {"Address": "", "Port": 8128}, "Node": {}},  # no addr
+        {"Service": {"Address": "10.0.0.3"}},                    # no port
+        {"Node": {"Address": "10.0.0.4"},
+         "Service": {"Port": 9000}},   # node-address fallback
+    ]).encode()
+    d = ConsulDiscoverer(opener=lambda url, **kw: payload)
+    assert d.get_destinations_for_service("svc") == [
+        "10.0.0.1:8128", "10.0.0.4:9000"]
+
+
+def test_kubernetes_discoverer_port_name_preference():
+    def pod(ip, ports):
+        return {"status": {"phase": "Running", "podIP": ip},
+                "spec": {"containers": [{"ports": ports}]}}
+
+    payload = json.dumps({"items": [
+        # "grpc" wins over "http" regardless of declaration order
+        pod("10.1.0.1", [{"name": "http", "containerPort": 80},
+                         {"name": "grpc", "containerPort": 8128}]),
+        # "import" beats "http"
+        pod("10.1.0.2", [{"name": "http", "containerPort": 80},
+                         {"name": "import", "containerPort": 8127}]),
+        # no preferred name: first declared port
+        pod("10.1.0.3", [{"name": "metrics", "containerPort": 9090},
+                         {"name": "debug", "containerPort": 6060}]),
+        # no ports at all: skipped
+        pod("10.1.0.4", []),
+    ]}).encode()
+    d = KubernetesDiscoverer(opener=lambda url, **kw: payload, token="tok")
+    assert d.get_destinations_for_service("svc") == [
+        "10.1.0.1:8128", "10.1.0.2:8127", "10.1.0.3:9090"]
+
+
+def test_kubernetes_token_reread_on_auth_failure(tmp_path):
+    token_file = tmp_path / "token"
+    token_file.write_text("tok-v1\n")
+    payload = json.dumps({"items": []}).encode()
+    seen_tokens = []
+
+    valid = {"Bearer tok-v1"}
+
+    def opener(url, headers=None, **kw):
+        tok = (headers or {}).get("Authorization", "")
+        seen_tokens.append(tok)
+        if tok not in valid:
+            raise urllib.error.HTTPError(url, 401, "Unauthorized", {}, None)
+        return payload
+
+    d = KubernetesDiscoverer(opener=opener, token_path=str(token_file))
+    assert d.get_destinations_for_service("svc") == []  # caches tok-v1
+    # the kubelet rotates the projected token: the API starts rejecting
+    # the cached credential, the discoverer re-reads the file and
+    # retries once instead of failing the refresh
+    valid.clear()
+    valid.add("Bearer tok-v2")
+    token_file.write_text("tok-v2\n")
+    assert d.get_destinations_for_service("svc") == []
+    assert seen_tokens == ["Bearer tok-v1", "Bearer tok-v1",
+                           "Bearer tok-v2"]
+    assert d.token_rereads == 1
+    # a ctor-injected token never refreshes: the 401 propagates
+    d2 = KubernetesDiscoverer(opener=opener, token="tok-v1")
+    with pytest.raises(urllib.error.HTTPError):
+        d2.get_destinations_for_service("svc")
+
+
+def test_kubernetes_token_ttl_expiry_rereads(tmp_path):
+    token_file = tmp_path / "token"
+    token_file.write_text("tok-v1")
+    payload = json.dumps({"items": []}).encode()
+    now = {"t": 1000.0}
+    seen_tokens = []
+
+    def opener(url, headers=None, **kw):
+        seen_tokens.append((headers or {}).get("Authorization"))
+        return payload
+
+    d = KubernetesDiscoverer(opener=opener, token_path=str(token_file),
+                             token_ttl_s=300.0, time_fn=lambda: now["t"])
+    d.get_destinations_for_service("svc")
+    now["t"] += 100.0
+    d.get_destinations_for_service("svc")    # inside TTL: cached
+    assert d.token_rereads == 0
+    token_file.write_text("tok-v2")
+    now["t"] += 300.0                        # past TTL: re-read
+    d.get_destinations_for_service("svc")
+    assert d.token_rereads == 1
+    assert seen_tokens[-1] == "Bearer tok-v2"
 
 
 def test_destination_refresher_keeps_last_good():

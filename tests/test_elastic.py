@@ -1,0 +1,540 @@
+"""Elastic global tier: watchable file discovery, health-gated
+membership, and the hysteresis autoscale controller
+(distributed/elastic.py + FileWatchDiscoverer + the gated
+DestinationRefresher path).
+
+The acceptance pins: an unreachable candidate never enters the ring; a
+breaker-open member leaves only via the handoff (per-destination
+`accepted == delivered + dropped + handed_off + spilled` holds through
+quarantine); a single pressured interval never scales; deadband
+oscillation produces zero membership changes; the member count never
+falls below min_members.
+"""
+
+import json
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.distributed import rpc
+from veneur_tpu.distributed.discovery import FileWatchDiscoverer
+from veneur_tpu.distributed.elastic import (
+    ElasticController,
+    HealthGate,
+    ProxyPressureSource,
+    tcp_probe,
+)
+from veneur_tpu.distributed.proxy import DestinationRefresher, ProxyServer
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+from veneur_tpu.health.policy import (
+    elastic_pressure_reasons,
+    elastic_scale_decision,
+)
+from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class ScriptedClient:
+    """Forward-client stand-in with a harness-scripted `down` switch
+    (transient classified failures, the unreachable-peer shape)."""
+
+    def __init__(self, dest):
+        self.address = dest
+        self.down = False
+        self.sent = []
+        self._lock = threading.Lock()
+
+    def _gate(self):
+        with self._lock:
+            if self.down:
+                raise rpc.ForwardError("unavailable", self.address,
+                                       "scripted: down")
+
+    def send_or_raise(self, batch, timeout_s=None):
+        self._gate()
+        with self._lock:
+            self.sent.extend(m.name for m in batch.metrics)
+
+    def send_raw_or_raise(self, blob, n_metrics, timeout_s=None):
+        self._gate()
+        with self._lock:
+            self.sent.extend(
+                m.name for m in pb.MetricBatch.FromString(blob).metrics)
+
+    def send(self, batch, timeout_s=None):
+        try:
+            self.send_or_raise(batch, timeout_s)
+        except Exception:
+            return False
+        return True
+
+    def send_raw(self, blob, n_metrics, timeout_s=None):
+        try:
+            self.send_raw_or_raise(blob, n_metrics, timeout_s)
+        except Exception:
+            return False
+        return True
+
+    def stats(self):
+        return {"address": self.address, "reconnects": 0, "errors": {}}
+
+    def close(self):
+        pass
+
+
+def _batch(names):
+    batch = pb.MetricBatch()
+    for name in names:
+        m = batch.metrics.add()
+        m.name = name
+        m.kind = pb.KIND_COUNTER
+        m.counter.value = 1
+    return batch
+
+
+# ---------------------------------------------------------------------------
+# FileWatchDiscoverer
+
+
+def test_file_watch_parses_all_three_formats(tmp_path):
+    p = tmp_path / "members.json"
+    p.write_text(json.dumps({"members": ["a:1", "b:2"],
+                             "standby": ["c:3"]}))
+    d = FileWatchDiscoverer(str(p))
+    assert d.get_destinations_for_service() == ["a:1", "b:2"]
+    assert d.desired() == (["a:1", "b:2"], ["c:3"])
+
+    p.write_text(json.dumps(["x:1", "y:2"]))
+    assert FileWatchDiscoverer(str(p)).desired() == (["x:1", "y:2"], [])
+
+    p.write_text("# global tier\na:1\n\nb:2\n")
+    assert FileWatchDiscoverer(str(p)).desired() == (["a:1", "b:2"], [])
+
+
+def test_file_watch_reparses_only_on_signature_change(tmp_path):
+    p = tmp_path / "members.json"
+    p.write_text(json.dumps({"members": ["a:1"]}))
+    d = FileWatchDiscoverer(str(p))
+    for _ in range(5):
+        d.get_destinations_for_service()
+    assert d.reads == 1  # four of five polls were a single stat()
+    # rewrite with a guaranteed-new mtime_ns signature
+    time.sleep(0.01)
+    p.write_text(json.dumps({"members": ["a:1", "b:2"]}))
+    assert d.get_destinations_for_service() == ["a:1", "b:2"]
+    assert d.reads == 2
+
+
+def test_file_watch_missing_and_malformed_raise(tmp_path):
+    missing = FileWatchDiscoverer(str(tmp_path / "absent.json"))
+    with pytest.raises(OSError):
+        missing.get_destinations_for_service()
+    p = tmp_path / "bad.json"
+    p.write_text('{"members": ["a:1"')   # torn write
+    with pytest.raises(ValueError):
+        FileWatchDiscoverer(str(p)).get_destinations_for_service()
+
+
+def test_file_watch_write_members_visible_to_other_pollers(tmp_path):
+    p = tmp_path / "members.json"
+    p.write_text(json.dumps({"members": ["a:1"], "standby": ["b:2"]}))
+    writer = FileWatchDiscoverer(str(p))
+    other = FileWatchDiscoverer(str(p))
+    assert other.desired() == (["a:1"], ["b:2"])
+    writer.write_members(["a:1", "b:2"], [])
+    # the atomic replace bumps the signature; the other poller re-reads
+    assert other.desired() == (["a:1", "b:2"], [])
+    assert writer.writes == 1
+
+
+def test_refresher_keeps_last_good_when_membership_file_vanishes(tmp_path):
+    p = tmp_path / "members.json"
+    p.write_text(json.dumps({"members": ["a:1", "b:2"]}))
+    proxy = ProxyServer(["old:1"])
+    try:
+        r = DestinationRefresher(proxy, FileWatchDiscoverer(str(p)), "")
+        r.refresh()
+        assert proxy.ring.members() == ["a:1", "b:2"]
+        p.unlink()
+        r.refresh()
+        assert proxy.ring.members() == ["a:1", "b:2"]
+        assert r.refresh_errors == 1
+    finally:
+        proxy.stop()
+
+
+# ---------------------------------------------------------------------------
+# Refresher jitter
+
+
+def test_refresher_jitter_bounds_and_spread():
+    proxy = ProxyServer(["a:1"])
+    try:
+        r = DestinationRefresher(proxy, FileWatchDiscoverer("unused"),
+                                 "", interval_s=10.0, jitter=0.5,
+                                 rng=random.Random(42))
+        waits = [r._next_wait() for _ in range(500)]
+        assert all(5.0 <= w <= 15.0 for w in waits)
+        # full jitter actually spreads — not pinned near the mean
+        assert min(waits) < 6.0 and max(waits) > 14.0
+        r.jitter = 0.0
+        assert r._next_wait() == 10.0
+    finally:
+        proxy.stop()
+
+
+# ---------------------------------------------------------------------------
+# tcp_probe
+
+
+def test_tcp_probe_listening_vs_dead_port():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    try:
+        assert tcp_probe(f"127.0.0.1:{port}", timeout_s=1.0)
+    finally:
+        srv.close()
+    # closed listener: connect refused
+    assert not tcp_probe(f"127.0.0.1:{port}", timeout_s=0.5)
+
+
+# ---------------------------------------------------------------------------
+# HealthGate
+
+
+class ScriptedProbe:
+    def __init__(self, healthy):
+        self.healthy = set(healthy)
+        self.calls = []
+
+    def __call__(self, dest, timeout_s):
+        self.calls.append(dest)
+        return dest in self.healthy
+
+
+def test_gate_unreachable_candidate_never_enters_ring(tmp_path):
+    p = tmp_path / "members.json"
+    p.write_text(json.dumps({"members": ["a:1", "b:2"]}))
+    proxy = ProxyServer([])
+    try:
+        probe = ScriptedProbe({"a:1"})
+        gate = HealthGate(proxy, probe=probe)
+        r = DestinationRefresher(proxy, FileWatchDiscoverer(str(p)), "",
+                                 gate=gate)
+        r.refresh()
+        assert proxy.ring.members() == ["a:1"]   # b:2 refused at the door
+        assert gate.probe_failures == 1
+        assert "quarantine" not in (proxy.last_ring_change or {}).get(
+            "cause", "")
+        # the candidate comes up: next refresh probes again and admits
+        probe.healthy.add("b:2")
+        r.refresh()
+        assert proxy.ring.members() == ["a:1", "b:2"]
+        assert "admit:b:2" in proxy.last_ring_change["cause"]
+    finally:
+        proxy.stop()
+
+
+def test_gate_quarantine_readmission_and_conservation(tmp_path):
+    """A member whose breaker stays open leaves the ring ONLY via the
+    handoff: its arcs reshard away, its spill drains, and the delivery
+    ledger identity holds for every destination throughout."""
+    p = tmp_path / "members.json"
+    p.write_text(json.dumps({"members": ["a:1", "b:2"]}))
+    clients = {d: ScriptedClient(d) for d in ("a:1", "b:2")}
+    policy = DeliveryPolicy(retry_max=0, breaker_threshold=2,
+                            timeout_s=0.2, deadline_s=0.2,
+                            backoff_base_s=0.001, backoff_max_s=0.005)
+    proxy = ProxyServer(
+        ["a:1", "b:2"], timeout_s=0.5, delivery=policy,
+        handoff_window_s=60.0,   # bg drain stays out of the way
+        client_factory=lambda dest, t, i: clients[dest])
+    try:
+        probe = ScriptedProbe({"a:1", "b:2"})
+        gate = HealthGate(proxy, probe=probe, quarantine_after=2)
+        r = DestinationRefresher(proxy, FileWatchDiscoverer(str(p)), "",
+                                 gate=gate)
+        r.refresh()
+        assert proxy.ring.members() == ["a:1", "b:2"]
+
+        clients["b:2"].down = True
+        names = [f"m{i}" for i in range(64)]
+        # one fragment per destination per batch: route several so b's
+        # consecutive failures cross the breaker threshold
+        for lo in range(0, 64, 16):
+            proxy._route_batch(_batch(names[lo:lo + 16]))
+        # b's breaker opened (threshold 2) and some payloads spilled
+        assert proxy.breaker_states()["b:2"] == "open"
+        st = proxy.forward_stats()["destinations"]["b:2"]["delivery"]
+        assert st["spilled_payloads"] > 0
+
+        # two consecutive refreshes observing the open breaker: the
+        # second one quarantines (probe still passes — TCP up, merge
+        # sick — so this is the breaker path, not the probe path)
+        r.refresh()
+        assert proxy.ring.members() == ["a:1", "b:2"]  # streak == 1
+        r.refresh()
+        assert proxy.ring.members() == ["a:1"]
+        assert gate.quarantined_total == 1
+        assert "quarantine:b:2" in proxy.last_ring_change["cause"]
+
+        # the quarantined member's spill re-homes through the ordinary
+        # handoff; nothing is dropped on the floor
+        proxy.drain_spill()
+        assert _wait_until(
+            lambda: proxy.forward_stats()["spilled_metrics"] == 0)
+        for dest in ("a:1", "b:2"):
+            st = proxy.forward_stats()["destinations"].get(dest)
+            if st is None:      # b's manager may already be retired
+                continue
+            d = st["delivery"]
+            assert d["accepted_payloads"] == (
+                d["delivered_payloads"] + d["dropped_payloads"]
+                + d["handed_off_payloads"] + d["spilled_payloads"])
+        assert proxy.drops == 0
+        # every accepted metric landed on the healthy member
+        assert sorted(clients["a:1"].sent) == sorted(names)
+
+        # recovery: probe still ok, so the next refresh re-admits
+        clients["b:2"].down = False
+        r.refresh()
+        assert proxy.ring.members() == ["a:1", "b:2"]
+        assert gate.readmitted_total == 1
+        assert "readmit:b:2" in proxy.last_ring_change["cause"]
+
+        # re-admission is probe-gated: quarantine again, then take the
+        # endpoint down — it must stay out until the probe passes
+        clients["b:2"].down = True
+        for i in range(4):
+            proxy._route_batch(_batch([f"n{i}a", f"n{i}b"]))
+        r.refresh()
+        r.refresh()
+        assert proxy.ring.members() == ["a:1"]
+        probe.healthy.discard("b:2")
+        r.refresh()
+        assert proxy.ring.members() == ["a:1"]   # probe fails: stays out
+        assert gate.probe_failures >= 1
+        probe.healthy.add("b:2")
+        r.refresh()
+        assert proxy.ring.members() == ["a:1", "b:2"]
+        assert gate.readmitted_total == 2
+    finally:
+        proxy.stop()
+
+
+def test_gate_min_admitted_floor_blocks_last_quarantine():
+    class FakeProxy:
+        def __init__(self):
+            self.states = {"a:1": "open", "b:2": "open"}
+
+        def breaker_states(self):
+            return dict(self.states)
+
+    fp = FakeProxy()
+    gate = HealthGate(fp, probe=lambda d, t: True, quarantine_after=2,
+                      min_admitted=1)
+    assert gate.admit(["a:1", "b:2"]) == ["a:1", "b:2"]  # streak == 1
+    # both breakers open for quarantine_after ticks: one member is
+    # quarantined, the floor refuses to empty the ring for the other
+    out = gate.admit(["a:1", "b:2"])
+    assert out == ["b:2"]
+    assert gate.quarantined_total == 1
+    assert gate.quarantine_deferred == 1
+    # a tier-wide breaker storm (the network died, not the members)
+    # cycles members through quarantine but NEVER empties the ring
+    for _ in range(5):
+        assert len(gate.admit(["a:1", "b:2"])) >= 1
+
+
+def test_gate_forgets_members_that_leave_discovery():
+    class FakeProxy:
+        @staticmethod
+        def breaker_states():
+            return {}
+
+    probe = ScriptedProbe({"a:1", "b:2"})
+    gate = HealthGate(FakeProxy(), probe=probe, quarantine_after=1)
+    assert gate.admit(["a:1", "b:2"]) == ["a:1", "b:2"]
+    assert gate.admit(["a:1"]) == ["a:1"]
+    assert sorted(gate.stats()["admitted"]) == ["a:1"]
+    # coming back means re-proving readiness as a newcomer
+    probe.healthy.discard("b:2")
+    assert gate.admit(["a:1", "b:2"]) == ["a:1"]
+
+
+# ---------------------------------------------------------------------------
+# ElasticController
+
+
+class FakeSource:
+    """In-memory stand-in for FileWatchDiscoverer's desired/write half."""
+
+    def __init__(self, members, standby=()):
+        self.members = list(members)
+        self.standby = list(standby)
+        self.writes = []
+
+    def desired(self):
+        return list(self.members), list(self.standby)
+
+    def write_members(self, members, standby=None):
+        self.members = list(members)
+        if standby is not None:
+            self.standby = list(standby)
+        self.writes.append((list(self.members), list(self.standby)))
+
+
+def _controller(source, pressured_fn, **kw):
+    kw.setdefault("hysteresis_k", 3)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("min_members", 1)
+    signals_on = {"routing_shed_delta": 1}
+    return ElasticController(
+        source, lambda: signals_on if pressured_fn() else {}, **kw)
+
+
+def test_single_pressured_interval_never_scales():
+    src = FakeSource(["a:1"], ["b:2"])
+    c = _controller(src, lambda: True)
+    assert c.tick() is None
+    assert c.tick() is None         # k=3: two intervals still no action
+    assert src.writes == []
+
+
+def test_hysteresis_scale_out_then_graceful_scale_in():
+    src = FakeSource(["a:1"], ["b:2"])
+    pressured = {"on": True}
+    retired, drained = [], {"b:2": False, "a:1": False}
+    c = _controller(src, lambda: pressured["on"],
+                    drained_fn=lambda d: drained[d],
+                    retire_fn=retired.append)
+    for _ in range(2):
+        assert c.tick() is None
+    assert c.tick() == "out"
+    assert src.members == ["a:1", "b:2"] and src.standby == []
+    assert c.scale_out_total == 1
+
+    pressured["on"] = False
+    for _ in range(2):
+        assert c.tick() is None
+    assert c.tick() == "in"
+    # leave-the-ring-first: the write-back happened, retirement did not
+    assert src.members == ["a:1"]
+    assert c.draining() == ["b:2"] and retired == []
+    # not drained yet: stays in the draining set across ticks
+    c.tick()
+    assert c.draining() == ["b:2"] and c.retired_total == 0
+    # handoff finished: the next tick retires and demotes to standby
+    drained["b:2"] = True
+    c.tick()
+    assert retired == ["b:2"] and c.draining() == []
+    assert src.standby == ["b:2"] and c.retired_total == 1
+
+
+def test_deadband_oscillation_changes_nothing():
+    src = FakeSource(["a:1", "b:2"], ["c:3"])
+    flip = {"on": False}
+
+    def osc():
+        flip["on"] = not flip["on"]
+        return flip["on"]
+
+    c = _controller(src, osc)
+    for _ in range(40):
+        assert c.tick() is None
+    assert src.writes == []
+    assert c.scale_out_total == 0 and c.scale_in_total == 0
+
+
+def test_scale_in_never_below_min_members():
+    src = FakeSource(["a:1", "b:2"], [])
+    c = _controller(src, lambda: False, min_members=2)
+    for _ in range(20):
+        assert c.tick() is None
+    assert src.members == ["a:1", "b:2"] and src.writes == []
+
+
+def test_scale_out_capped_and_blocked_without_standby():
+    src = FakeSource(["a:1"], [])
+    c = _controller(src, lambda: True)
+    for _ in range(3):
+        c.tick()
+    assert src.writes == [] and c.scale_blocked_no_capacity == 1
+    # with capacity but at max_members the decision itself is None
+    src2 = FakeSource(["a:1", "b:2"], ["c:3"])
+    c2 = _controller(src2, lambda: True, max_members=2)
+    for _ in range(6):
+        assert c2.tick() is None
+    assert src2.writes == []
+
+
+def test_cooldown_separates_consecutive_actions():
+    src = FakeSource(["a:1"], ["b:2", "c:3"])
+    now = {"t": 100.0}
+    c = _controller(src, lambda: True, cooldown_s=30.0,
+                    time_fn=lambda: now["t"])
+    for _ in range(2):
+        c.tick()
+    assert c.tick() == "out"
+    # pressure persists, streak rebuilds to k — but cooldown holds
+    for _ in range(3):
+        assert c.tick() is None
+    assert c.cooldown_skips >= 1
+    # the streak kept building through the cooldown, so the first tick
+    # past its edge acts immediately
+    now["t"] += 31.0
+    assert c.tick() == "out"
+    assert src.members == ["a:1", "b:2", "c:3"]
+
+
+# ---------------------------------------------------------------------------
+# Pressure source + policy functions
+
+
+def test_proxy_pressure_source_emits_deltas():
+    proxy = ProxyServer(["a:1"])
+    try:
+        ps = ProxyPressureSource(proxy)
+        first = ps()
+        assert first["routing_shed_delta"] == 0
+        assert first["spilled_metrics"] == 0
+        assert not elastic_pressure_reasons(first)
+    finally:
+        proxy.stop()
+
+
+def test_elastic_pressure_reasons_classification():
+    assert elastic_pressure_reasons({}) == []
+    assert elastic_pressure_reasons(
+        {"routing_shed_delta": 2}) == ["routing_shed"]
+    assert elastic_pressure_reasons(
+        {"routing_queue_depth": 2}) == ["routing_queue"]
+    assert elastic_pressure_reasons({"routing_queue_depth": 1}) == []
+    assert elastic_pressure_reasons(
+        {"delivery_deferred_delta": 1}) == ["delivery_deferred"]
+    assert elastic_pressure_reasons(
+        {"spilled_metrics": 5}) == ["spill_nonempty"]
+    assert elastic_pressure_reasons(
+        {"delivery_behind": True}) == ["delivery_behind"]
+
+
+def test_elastic_scale_decision_bounds():
+    assert elastic_scale_decision(3, 0, 2, k=3) == "out"
+    assert elastic_scale_decision(2, 0, 2, k=3) is None
+    assert elastic_scale_decision(3, 0, 4, k=3, max_members=4) is None
+    assert elastic_scale_decision(0, 3, 2, k=3) == "in"
+    assert elastic_scale_decision(0, 3, 1, k=3, min_members=1) is None
+    assert elastic_scale_decision(0, 99, 2, k=3, min_members=2) is None

@@ -23,7 +23,7 @@ TOOLS = os.path.join(os.path.dirname(os.path.dirname(
 sys.path.insert(0, TOOLS)
 
 from soak_topology import (  # noqa: E402
-    churn_rebound_windows, classify_rss_plateau)
+    attribute_tail_growth, churn_rebound_windows, classify_rss_plateau)
 
 
 def test_plateau_falling_series_passes():
@@ -88,6 +88,46 @@ def test_plateau_short_series_judges_nothing():
         out = classify_rss_plateau(series)
         assert not out["judgeable"]
         assert out["plateau_ok"]  # never gates with too few windows
+
+
+def _win(rss, py=None):
+    w = {"growth_per_interval_mb": rss}
+    if py is not None:
+        w["py_heap_growth_per_interval_mb"] = py
+    return w
+
+
+def test_tail_attribution_names_the_dominant_side():
+    # the residual tail is mostly native (XLA caches / malloc arenas):
+    # the python heap explains only a sliver of what RSS gained
+    out = attribute_tail_growth(
+        [_win(2.0, 1.5), _win(0.10, 0.01), _win(0.08, 0.01),
+         _win(0.06, 0.02)])
+    assert out["judgeable"] and out["windows"] == 3
+    assert out["dominant"] == "native"
+    assert out["py_heap_fraction"] < 0.5
+    # flip it: the python heap explains the whole tail
+    out = attribute_tail_growth(
+        [_win(0.10, 0.09), _win(0.08, 0.08), _win(0.06, 0.06)])
+    assert out["dominant"] == "python_heap"
+    assert out["py_heap_fraction"] >= 0.5
+
+
+def test_tail_attribution_clamps_and_degenerate_cases():
+    # a SHRINKING python heap inside growing RSS: all-native, frac 0
+    out = attribute_tail_growth(
+        [_win(0.10, -0.50), _win(0.10, -0.40), _win(0.10, -0.30)])
+    assert out["dominant"] == "native" and out["py_heap_fraction"] == 0.0
+    # flat-or-falling RSS tail: nothing to attribute
+    out = attribute_tail_growth(
+        [_win(-0.05, 0.0), _win(0.0, 0.0), _win(-0.01, 0.0)])
+    assert out["dominant"] == "none"
+    # windows recorded before the tracemalloc sampling began (no
+    # py_heap key) are excluded from the tail
+    out = attribute_tail_growth([_win(5.0), _win(0.1, 0.05)])
+    assert out["windows"] == 1
+    # no instrumented windows at all: not judgeable
+    assert not attribute_tail_growth([_win(5.0)])["judgeable"]
 
 
 @pytest.mark.slow

@@ -135,6 +135,40 @@ print(f"duplicates==0 gate: OK (hits={a['dedup_stats']['hits']}, "
       f"deduped={a['dedup_stats']['metrics_deduped']} metrics)")
 PYGATE
 
+# Autoscale chaos lane: the elastic tier end to end — a watched
+# membership file (members + standby pool), the HealthGate probing and
+# quarantining on the refresh path, and the ElasticController scaling
+# on the tier's own pressure signals. The scripted run doubles the
+# offered load against capacity-throttled real import servers (scale
+# 2 -> 4 under hysteresis + cooldown), halves it back (graceful-drain
+# scale-in to 2, retire only when idle), then kills a member cold
+# (breaker-streak quarantine -> ring 1 -> probed re-admission). Gates:
+# exact conservation and duplicates == 0 through every reshard, the
+# calm phase never scales, scale-out AND quarantine actually happened.
+# Artifact: AUTOSCALE_SOAK.json (committed copy is the full run; the
+# lane redirects its miniature artifact to /tmp).
+echo "== autoscale chaos lane (elastic tier soak) =="
+timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/soak_autoscale.py --quick
+# Hard gates, independent of the soak's own pass bar: conservation
+# must be exact with zero duplicate excess, and the elastic story must
+# have actually run (reached 4 members, quarantined the sick one).
+python - "${TMPDIR:-/tmp}/AUTOSCALE_SOAK.json" <<'PYGATE'
+import json, sys
+a = json.load(open(sys.argv[1]))
+assert a["duplicates_observed"] == 0, \
+    f"duplicates observed: {a['duplicates_observed']}"
+assert a["counter_total_observed"] == a["counter_total_expected"], \
+    "counter conservation not exact"
+assert a["histo_count_observed"] == a["histo_count_expected"], \
+    "histogram conservation not exact"
+assert a["max_ring_members"] == 4, "tier never scaled out to 4"
+assert a["gate"]["quarantined_total"] >= 1, "sick member never quarantined"
+print(f"autoscale gate: OK (max_ring={a['max_ring_members']}, "
+      f"quarantined={a['gate']['quarantined_total']}, duplicates=0)")
+PYGATE
+
 # Tenant-isolation lane: two seeded runs sharing bit-identical innocent
 # traffic — baseline vs an abusive tenant exploding series cardinality
 # against a per-tenant budget (core/tenancy.py). Gates the QoS layer's

@@ -1,0 +1,553 @@
+"""Autoscale chaos soak for the elastic global tier (ISSUE 14).
+
+One local Server forwards every wall-clock tick through a ProxyServer
+whose membership is the REAL elastic loop: a FileWatchDiscoverer
+(members + standby pool in a watched file), a HealthGate probing and
+quarantining on the refresh path, and an ElasticController observing
+the tier's own pressure signals and writing the desired member set
+back through the file. Four real import servers run throughout; each
+member's merge path is throttled to a fixed metrics/second capacity
+(merge serialized under a per-member lock, response delayed by
+n/capacity), so receipt is genuinely capacity-bound and overload shows
+up as deadline-clipped sends, deferrals, and spill — the exact signals
+the controller scales on.
+
+The scripted run:
+
+  warmup   both load shapes compiled, tier settled
+  P1 calm  base load, 2 members, controller live, no action expected
+  P2 surge offered load DOUBLES: 2 members saturate, cadence falls
+           behind, the controller scales 2 -> 3 -> 4 (hysteresis K
+           pressured ticks + cooldown between steps), cadence recovers
+  P3 ebb   load halves back: spill drains, K calm ticks each, the
+           controller scales 4 -> 3 -> 2 by graceful drain — the
+           member leaves the ring FIRST, the handoff window re-homes
+           its spill, and it is retired (listener stopped, demoted to
+           standby) only when the proxy reports it idle
+  P4 sick  controller paused; one member's import server is killed
+           cold. Its breaker opens, stays open, and after
+           quarantine_after refresh ticks the HealthGate evicts it
+           from the ring (ring -> 1); re-probes fail and are counted;
+           the listener restarts and the next probe re-admits it
+           (ring -> 2)
+
+Every forward send also runs a seeded duplicate-injection fault plan,
+so the exactly-once window is attacked through every reshard.
+
+Pass criteria, checked after a bounded settling drain: exact
+conservation (counters AND histogram .count sums vs the per-phase
+offered totals), duplicates_observed == 0, zero drops/sheds/import
+errors, the ring reached 4 and returned to 2, cadence degraded in P2
+and fully recovered, every scale-in retired only after drained, the
+sick member quarantined then re-admitted with probe failures counted,
+and every per-destination delivery ledger conserved.
+
+Writes AUTOSCALE_SOAK.json (VENEUR_ARTIFACT_DIR redirects); --quick is
+the CI lane (shorter phases, smaller hysteresis/cooldown).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _soak_common import rss_mb, write_artifact  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI lane: shorter phases, tighter hysteresis")
+    ap.add_argument("--seed", type=int, default=11)
+    args = ap.parse_args()
+
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.flusher import (
+        device_quantiles,
+        generate_inter_metrics,
+    )
+    from veneur_tpu.core.metrics import HistogramAggregates, MetricType
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.distributed import rpc
+    from veneur_tpu.distributed.discovery import FileWatchDiscoverer
+    from veneur_tpu.distributed.elastic import (
+        ElasticController,
+        HealthGate,
+        ProxyPressureSource,
+    )
+    from veneur_tpu.distributed.forward import install_forwarder
+    from veneur_tpu.distributed.import_server import ImportServer
+    from veneur_tpu.distributed.proxy import (
+        DestinationRefresher,
+        ProxyServer,
+    )
+    from veneur_tpu.sinks.delivery import DeliveryPolicy
+    from veneur_tpu.utils.faults import FaultPlan, FaultyForwardClient
+
+    quick = args.quick
+    period_s = 1.25 if quick else 1.5
+    s_histo, s_counter = 220, 80          # base: 300 metrics/tick
+    capacity_per_s = 150.0                # per-member merge throughput
+    hysteresis_k = 2 if quick else 3
+    cooldown_s = 2.5 if quick else 4.0
+    quarantine_after = 3 if quick else 5
+    p1_ticks = 2 if quick else 3
+    p2_ticks = 10 if quick else 14
+    p3_ticks = 9 if quick else 12
+    p3_extra = 10 if quick else 12        # controller-only settle ticks
+    p4_cap = 14 if quick else 18
+    pcts = [0.5, 0.99]
+    aggs = ["min", "max", "count"]
+    rss0 = rss_mb()
+    t_start = time.perf_counter()
+
+    # -- the tier: 4 real import servers, all listening up-front (2
+    # members + 2 provisioned standbys the controller promotes from)
+    globals_ = []
+    for _ in range(4):
+        cfg = Config(interval="10s", percentiles=pcts, aggregates=aggs,
+                     num_workers=2)
+        srv = Server(cfg)
+        imp = ImportServer(srv)
+        # member-side capacity throttle: merge serialized under a
+        # per-member lock, the response delayed by merged/capacity —
+        # receipt is genuinely capacity-bound, so overload manifests as
+        # deadline-clipped sends and spill, never as lost merges. A
+        # dedup-absorbed replay costs ~nothing (a window lookup, not a
+        # merge), so clipped-but-landed fragments confirm fast on
+        # re-send instead of re-paying the merge they already did.
+        # Instance-attr shadowing installed BEFORE start_grpc so the
+        # listener (and every restart) binds the wrapper.
+        orig = imp.handle_wire
+        lock = threading.Lock()
+
+        def throttled(blob: bytes, _orig=orig, _lock=lock,
+                      _imp=imp) -> int:
+            with _lock:
+                before = _imp.metrics_deduped
+                n = _orig(blob)
+                merged = n - (_imp.metrics_deduped - before)
+                if merged > 0:
+                    time.sleep(merged / capacity_per_s)
+                return n
+
+        imp.handle_wire = throttled
+        imp.start_grpc()
+        globals_.append((srv, imp))
+
+    def addr(i: int) -> str:
+        return globals_[i][1].address
+
+    imp_by_addr = {addr(i): globals_[i][1] for i in range(4)}
+
+    # -- seeded duplicate injection on every proxy->member link: the
+    # exactly-once window must absorb replays through every reshard.
+    # fault_clients maps dest -> CURRENT client (quarantine/readmit and
+    # rescale recreate clients); all_fault_clients keeps every
+    # generation so injected-fault counters survive recreation.
+    fault_clients: dict[str, FaultyForwardClient] = {}
+    all_fault_clients: list[FaultyForwardClient] = []
+
+    def client_factory(dest: str, timeout_s: float,
+                       idle_timeout_s: float) -> FaultyForwardClient:
+        inner = rpc.ForwardClient(dest, timeout_s,
+                                  idle_timeout_s=idle_timeout_s)
+        # the wedged-channel rebuild heuristic (2 consecutive clips ->
+        # rebuild, aborting concurrent in-flight sends as permanent
+        # "send" failures) misfires here: these members are healthy but
+        # deliberately slow, so clips are the OVERLOAD signal, not a
+        # dead transport. A rebuild mid-merge would turn a by-design
+        # clip into a counted drop.
+        inner.RECONNECT_AFTER_FAILURES = 1 << 30
+        plan = FaultPlan(seed=args.seed + sum(dest.encode()),
+                         p_duplicate=0.05)
+        fc = FaultyForwardClient(plan, inner)
+        fault_clients[dest] = fc
+        all_fault_clients.append(fc)
+        return fc
+
+    # the per-attempt budget must fit one fragment's throttled merge
+    # with no queue ahead of it (the worst calm-phase fragment is 300
+    # metrics = 2.0s at capacity), so a clipped send always means
+    # QUEUEING at the member — the overload signal — never a merge
+    # that could never fit. The breaker threshold is high enough that
+    # overload clip streaks don't open it between drain successes (a
+    # false quarantine reshard of maybe-landed spill is the
+    # remint-duplicate risk); the P4 dead member fails fast and often,
+    # so it still opens within a few ticks there.
+    policy = DeliveryPolicy(retry_max=1,
+                            breaker_threshold=10 if quick else 12,
+                            spill_max_bytes=32 << 20,
+                            spill_max_payloads=4096,
+                            timeout_s=3.0, deadline_s=3.0,
+                            backoff_base_s=0.05, backoff_max_s=0.2)
+    import tempfile
+
+    from veneur_tpu.utils.journal import SpillJournal
+
+    journal_dir = tempfile.mkdtemp(prefix="autoscale-journal-")
+    journal = SpillJournal(journal_dir, fsync="never")
+
+    # the drain loop arms every manager's delivery deadline to the
+    # handoff window each pass, so the window bounds LIVE sends too —
+    # it must exceed the worst unqueued merge (2.0s) or calm-phase
+    # sends clip and the tier can never read as calm
+    proxy = ProxyServer([], timeout_s=3.5, delivery=policy,
+                        routing_workers=4, routing_queue_max=256,
+                        handoff_window_s=3.0,
+                        client_factory=client_factory,
+                        journal=journal, dedup=True)
+    pport = proxy.start_grpc()
+
+    # -- the elastic loop, end to end real: file -> gate -> ring, and
+    # controller -> file
+    membership_file = os.path.join(journal_dir, "members.json")
+    watcher = FileWatchDiscoverer(membership_file)
+    watcher.write_members([addr(0), addr(1)], [addr(2), addr(3)])
+    gate = HealthGate(proxy, probe_timeout_s=0.5,
+                      quarantine_after=quarantine_after, min_admitted=1)
+    refresher = DestinationRefresher(proxy, watcher, "",
+                                     interval_s=3600.0, gate=gate)
+    refresher.refresh()   # driven manually each tick
+
+    retire_events = []
+
+    def retire(dest: str) -> None:
+        # drained_fn gated this: out of ring, no inflight, spill empty
+        retire_events.append({"member": dest,
+                              "idle": proxy.destination_idle(dest)})
+        imp_by_addr[dest].stop(grace=0.5)
+
+    psource = ProxyPressureSource(proxy)
+    controller = ElasticController(
+        watcher, psource,
+        hysteresis_k=hysteresis_k, cooldown_s=cooldown_s,
+        min_members=2, max_members=4,
+        drained_fn=proxy.destination_idle, retire_fn=retire)
+
+    lcfg = Config(interval="10s", percentiles=pcts, aggregates=aggs,
+                  forward_address=f"127.0.0.1:{pport}",
+                  forward_use_grpc=True)
+    local = Server(lcfg)
+    install_forwarder(local)
+
+    def received_total() -> int:
+        return sum(imp.received_metrics for _, imp in globals_)
+
+    events = []
+
+    def log_event(tick: int, event: str, **kw) -> None:
+        events.append({"tick": tick, "event": event, **kw})
+        print(json.dumps(events[-1]), file=sys.stderr, flush=True)
+
+    # -- per-tick drive: send `factor` x base load, flush, pace on the
+    # wall clock (NOT on receipt — when the tier lags, backlog must
+    # accumulate into real pressure, not silently thin the offered rate)
+    sent_counter_value = 0.0
+    sent_histo_count = 0.0
+    sent_metrics = 0
+    ticks = []
+    tick_no = 0
+
+    def run_tick(phase: str, factor: float, use_controller: bool) -> dict:
+        nonlocal sent_counter_value, sent_histo_count, sent_metrics, tick_no
+        t0 = time.perf_counter()
+        nh, nc = int(s_histo * factor), int(s_counter * factor)
+        lines = []
+        for i in range(nh):
+            lines.append(b"soak.h%d:%d|ms|#shard:%d,veneurglobalonly"
+                         % (i, (i * 31 + tick_no) % 997, i % 16))
+        for i in range(nc):
+            lines.append(b"soak.c%d:2|c|#veneurglobalonly" % i)
+        max_len = lcfg.metric_max_length
+        batch, size = [], 0
+        for line in lines:
+            if size + len(line) + 1 > max_len and batch:
+                local.process_metric_packet(b"\n".join(batch))
+                batch, size = [], 0
+            batch.append(line)
+            size += len(line) + 1
+        if batch:
+            local.process_metric_packet(b"\n".join(batch))
+        local.flush()
+        sent_counter_value += 2.0 * nc
+        sent_histo_count += float(nh)
+        sent_metrics += nh + nc
+        # wall-clock pacing: sleep to the tick boundary
+        remaining = period_s - (time.perf_counter() - t0)
+        if remaining > 0:
+            time.sleep(remaining)
+        action = controller.tick() if use_controller else None
+        refresher.refresh()
+        rec = {
+            "tick": tick_no, "phase": phase, "offered": nh + nc,
+            "sent_cum": sent_metrics, "received_cum": received_total(),
+            "caught_up": received_total() >= sent_metrics,
+            "ring_members": len(proxy.ring),
+            "spilled": proxy.spilled_metrics,
+            "action": action,
+            "reasons": list(controller.last_reasons),
+        }
+        ticks.append(rec)
+        if action or not rec["caught_up"] or tick_no % 5 == 0:
+            print(json.dumps(rec), file=sys.stderr, flush=True)
+        tick_no += 1
+        return rec
+
+    def settle(deadline_s: float, want_receipt: bool = True) -> None:
+        """Drain spill (and optionally wait for full receipt) without
+        offering load — the quiescent point between phases."""
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            if proxy.spilled_metrics > 0:
+                proxy.drain_spill()
+            elif not want_receipt or received_total() >= sent_metrics:
+                break
+            time.sleep(0.05)
+
+    # -- warmup: both load shapes through the whole path, then settled
+    for _ in range(2):
+        run_tick("warmup", 1.0, use_controller=False)
+    run_tick("warmup", 2.0, use_controller=False)
+    settle(30.0)
+    log_event(tick_no, "warmup_settled", received=received_total())
+    # consume the warmup's deferral deltas so the controller's first
+    # observation starts from the settled baseline, not from history
+    psource()
+
+    # -- P1: calm baseline — controller live, zero actions expected
+    for _ in range(p1_ticks):
+        run_tick("p1_calm", 1.0, use_controller=True)
+    reshards_after_p1 = proxy.reshards
+    # scripted replay: re-deliver each live link's last landed frame
+    # verbatim (the network-replays-an-old-frame fault). The seeded
+    # p_duplicate ghosts are a per-fragment coin flip and a short run
+    # can legitimately draw zero, so dedup_engaged is pinned here by
+    # script, not by RNG luck.
+    for d in watcher.desired()[0]:
+        fc = fault_clients.get(d)
+        if fc is not None:
+            fc.replay_last(2.0)
+
+    # -- P2: offered load doubles; the tier must scale 2 -> 4
+    for _ in range(p2_ticks):
+        run_tick("p2_surge", 2.0, use_controller=True)
+    log_event(tick_no, "p2_done",
+              ring_members=len(proxy.ring),
+              scale_out_total=controller.scale_out_total)
+
+    # -- P3: load halves back; the tier must scale 4 -> 2 gracefully
+    for _ in range(p3_ticks):
+        run_tick("p3_ebb", 1.0, use_controller=True)
+    extra = 0
+    while ((len(watcher.desired()[0]) > 2 or controller.draining())
+           and extra < p3_extra):
+        run_tick("p3_settle", 0.0, use_controller=True)
+        extra += 1
+    log_event(tick_no, "p3_done",
+              ring_members=len(proxy.ring),
+              scale_in_total=controller.scale_in_total,
+              retired_total=controller.retired_total)
+
+    # -- P4: sick member — quarantine and re-admission. The controller
+    # is paused (a dead member's deferrals read as pressure; scaling
+    # during the experiment would confound it — noted in the artifact).
+    # Settle FIRST so the spill holds nothing with a maybe-landed
+    # attempt: post-kill spill toward the victim then only ever carries
+    # never-landed ("unavailable") attempts, and the quarantine reshard
+    # re-mints nothing that could double-count.
+    settle(45.0)
+    victim = watcher.desired()[0][-1]
+    min_ring_p4 = len(proxy.ring)
+    imp_by_addr[victim].stop(grace=0)
+    log_event(tick_no, "kill", member=victim)
+    quarantined_at = restarted_at = readmitted_at = None
+    for _ in range(p4_cap):
+        run_tick("p4_sick", 0.5, use_controller=False)
+        min_ring_p4 = min(min_ring_p4, len(proxy.ring))
+        gs = gate.stats()
+        if quarantined_at is None and victim in gs["quarantined"]:
+            quarantined_at = tick_no - 1
+            log_event(tick_no - 1, "quarantined", member=victim,
+                      ring_members=len(proxy.ring))
+        if (quarantined_at is not None and restarted_at is None
+                and tick_no - 1 >= quarantined_at + 2):
+            # two extra ticks quarantined: re-probes fail and are
+            # counted before recovery begins
+            imp_by_addr[victim].start_grpc(victim)
+            restarted_at = tick_no - 1
+            log_event(tick_no - 1, "restart", member=victim)
+        if (restarted_at is not None and victim in gs["admitted"]
+                and len(proxy.ring) == 2):
+            readmitted_at = tick_no - 1
+            log_event(tick_no - 1, "readmitted", member=victim,
+                      ring_members=len(proxy.ring))
+            break
+    if restarted_at is None:
+        # quarantine never happened within the cap; restart anyway so
+        # the settle below can complete (the checks will fail honestly)
+        imp_by_addr[victim].start_grpc(victim)
+        refresher.refresh()
+
+    # -- final settle: faults off, everything must land exactly once
+    for fc in all_fault_clients:
+        fc.set_partitioned(False)
+        fc.plan = FaultPlan(seed=0)
+    settle(90.0)
+    time.sleep(0.3)
+
+    # -- final accounting: flush all 4 globals (retired members still
+    # hold earlier intervals' state) and sum exactly
+    qs = device_quantiles(pcts, HistogramAggregates.from_names(aggs))
+    counter_total = 0.0
+    histo_count_total = 0.0
+    for srv, _ in globals_:
+        metrics = []
+        for w, lk in zip(srv.workers, srv._worker_locks):
+            with lk:
+                snap = w.flush(qs, 10.0)
+            metrics.extend(generate_inter_metrics(
+                snap, False, pcts, HistogramAggregates.from_names(aggs)))
+        for m in metrics:
+            if m.type == MetricType.COUNTER and m.name.startswith("soak.c"):
+                counter_total += m.value
+            if m.name.endswith(".count") and m.name.startswith("soak.h"):
+                histo_count_total += m.value
+
+    stats = proxy.forward_stats()
+    received = received_total()
+    import_errors = sum(imp.import_errors for _, imp in globals_)
+    injected = {}
+    for fc in all_fault_clients:
+        for k, v in fc.injected.items():
+            if k != "passed":
+                injected[k] = injected.get(k, 0) + v
+    dedup_hits = sum(imp.stats()["dedup"]["hits"] for _, imp in globals_)
+    dedup_evictions = sum(
+        imp.stats()["dedup"]["evictions"] for _, imp in globals_)
+
+    duplicates_observed = (
+        max(0.0, counter_total - sent_counter_value)
+        + max(0.0, histo_count_total - sent_histo_count))
+    p2 = [t for t in ticks if t["phase"] == "p2_surge"]
+    max_ring = max(t["ring_members"] for t in ticks)
+    gs = gate.stats()
+    cs = controller.stats()
+    checks = {
+        "counter_conservation_exact": counter_total == sent_counter_value,
+        "histo_conservation_exact": histo_count_total == sent_histo_count,
+        "duplicates_zero": duplicates_observed == 0.0,
+        "zero_drops": proxy.drops == 0,
+        "zero_sheds": stats["routing"]["shed_batches"] == 0,
+        "zero_import_errors": import_errors == 0,
+        "spill_settled": proxy.spilled_metrics == 0,
+        "proxied_equals_received": stats["proxied_metrics"] == received,
+        "ledgers_conserved": proxy.conserved(),
+        "dedup_engaged": (injected.get("duplicated", 0) >= 1
+                          and dedup_hits >= 1),
+        "dedup_no_evictions": dedup_evictions == 0,
+        # the autoscale story, tick by tick
+        "p1_no_actions": reshards_after_p1 <= 1,  # initial admit only
+        "cadence_degraded_in_p2": any(not t["caught_up"] for t in p2),
+        "scaled_out_to_max": (max_ring == 4
+                              and cs["scale_out_total"] >= 2),
+        "scaled_in_to_min": (len(proxy.ring) == 2
+                             and cs["scale_in_total"] >= 2),
+        "retired_after_drain": (cs["retired_total"] >= 2
+                                and all(e["idle"]
+                                        for e in retire_events)),
+        "cadence_recovered": received >= sent_metrics,
+        # the quarantine story
+        "quarantine_evicted": (gs["quarantined_total"] >= 1
+                               and min_ring_p4 == 1),
+        "readmitted": (gs["readmitted_total"] >= 1
+                       and readmitted_at is not None),
+        "probe_failures_counted": gs["probe_failures"] >= 1,
+    }
+    failures = sorted(k for k, ok in checks.items() if not ok)
+
+    out = {
+        "quick": quick,
+        "seed": args.seed,
+        "period_s": period_s,
+        "capacity_per_member_per_s": capacity_per_s,
+        "hysteresis_k": hysteresis_k,
+        "cooldown_s": cooldown_s,
+        "quarantine_after": quarantine_after,
+        "histo_series": s_histo,
+        "counter_series": s_counter,
+        "ticks": ticks,
+        "events": events,
+        "sent_metrics": sent_metrics,
+        "received_total": received,
+        "counter_total_expected": sent_counter_value,
+        "counter_total_observed": counter_total,
+        "histo_count_expected": sent_histo_count,
+        "histo_count_observed": histo_count_total,
+        "duplicates_observed": duplicates_observed,
+        "injected_faults": injected,
+        "dedup_stats": {
+            "minted": stats["dedup"]["minted"],
+            "remint_after_attempt": stats["dedup"]["remint_after_attempt"],
+            "hits": dedup_hits,
+            "evictions": dedup_evictions,
+        },
+        "controller": cs,
+        "controller_events": controller.events,
+        "controller_paused_in_p4": True,
+        "gate": gs,
+        "retire_events": retire_events,
+        "quarantined_at_tick": quarantined_at,
+        "restarted_at_tick": restarted_at,
+        "readmitted_at_tick": readmitted_at,
+        "min_ring_members_p4": min_ring_p4,
+        "max_ring_members": max_ring,
+        "final_ring_members": len(proxy.ring),
+        "discovery": watcher.stats(),
+        "refresh": refresher.stats(),
+        "proxy": {k: stats[k] for k in (
+            "proxied_metrics", "drops", "spilled_metrics", "shed_metrics",
+            "reshards", "handoffs", "ring_version", "ring_members",
+            "last_ring_change", "errors_total", "routing")},
+        "checks": checks,
+        "failures": failures,
+        "wall_s": round(time.perf_counter() - t_start, 1),
+        "rss_start_mb": round(rss0, 1),
+        "rss_end_mb": round(rss_mb(), 1),
+    }
+
+    local.shutdown()
+    refresher.stop()
+    controller.stop()
+    proxy.stop()
+    journal.close()
+    import shutil
+
+    shutil.rmtree(journal_dir, ignore_errors=True)
+    for srv, imp in globals_:
+        imp.stop(grace=0.5)
+        srv.shutdown()
+
+    write_artifact("AUTOSCALE_SOAK.json", out)
+    print(json.dumps({"metric": "autoscale_soak_ok",
+                      "value": 0.0 if failures else 1.0,
+                      "unit": "bool",
+                      "max_ring": max_ring,
+                      "scale_out": cs["scale_out_total"],
+                      "scale_in": cs["scale_in_total"],
+                      "quarantined": gs["quarantined_total"],
+                      "duplicates": duplicates_observed,
+                      "failures": failures}))
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,7 +63,8 @@ def main(argv=None) -> int:
     forward_service = (cfg.consul_forward_service_name
                        or cfg.consul_forward_grpc_service_name)
     accepting_forwards = bool(static or forward_service
-                              or cfg.kubernetes_forward_service_name)
+                              or cfg.kubernetes_forward_service_name
+                              or cfg.elastic_membership_file)
     accepting_traces = bool(cfg.trace_address
                             or cfg.consul_trace_service_name)
     if not accepting_forwards and not accepting_traces:
@@ -157,7 +158,36 @@ def main(argv=None) -> int:
                     "proxy routes HTTP and gRPC forwards over one ring, "
                     "discovered from consul_forward_service_name %r",
                     cfg.consul_forward_grpc_service_name, forward_service)
-    if forward_service:
+    controller = None
+    if cfg.elastic_membership_file:
+        # elastic tier: watchable file membership, health-gated through
+        # the refresher (consul/k8s answers are already health-filtered
+        # upstream; the file is raw desired state, so the gate probes)
+        from veneur_tpu.distributed.discovery import FileWatchDiscoverer
+        from veneur_tpu.distributed.elastic import (
+            ElasticController,
+            HealthGate,
+            ProxyPressureSource,
+        )
+
+        watcher = FileWatchDiscoverer(cfg.elastic_membership_file)
+        gate = HealthGate(
+            proxy,
+            probe_timeout_s=cfg.elastic_probe_timeout_s,
+            quarantine_after=cfg.elastic_quarantine_intervals,
+            min_admitted=cfg.elastic_min_members)
+        refresher = DestinationRefresher(
+            proxy, watcher, "",
+            parse_duration(cfg.consul_refresh_interval), gate=gate)
+        if cfg.elastic_autoscale:
+            controller = ElasticController(
+                watcher, ProxyPressureSource(proxy),
+                hysteresis_k=cfg.elastic_hysteresis_intervals,
+                cooldown_s=cfg.elastic_cooldown_s,
+                min_members=cfg.elastic_min_members,
+                max_members=cfg.elastic_max_members,
+                drained_fn=proxy.destination_idle)
+    elif forward_service:
         from veneur_tpu.distributed.discovery import ConsulDiscoverer
 
         refresher = DestinationRefresher(
@@ -173,6 +203,8 @@ def main(argv=None) -> int:
             parse_duration(cfg.consul_refresh_interval))
     if refresher is not None:
         refresher.start()
+    if controller is not None:
+        controller.start(cfg.elastic_observe_interval_s)
 
     reporter = None
     if cfg.stats_address:
@@ -212,6 +244,8 @@ def main(argv=None) -> int:
                         else "")
     if reporter is not None:
         reporter.stop()
+    if controller is not None:
+        controller.stop()
     if refresher is not None:
         refresher.stop()
     if trace_refresher is not None:

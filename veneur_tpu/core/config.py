@@ -535,6 +535,25 @@ class ProxyConfig:
     max_idle_conns: int = 0
     max_idle_conns_per_host: int = 100
     sentry_dsn: str = ""
+    # elastic tier (distributed/elastic.py): watchable file-based
+    # membership + health-gated admission/quarantine + optional
+    # load-driven autoscaling. Setting elastic_membership_file selects
+    # the FileWatchDiscoverer (takes precedence over consul/k8s) and
+    # arms the HealthGate on the refresh path.
+    elastic_membership_file: str = ""
+    elastic_probe_timeout_s: float = 1.0
+    # refresh intervals a member's breaker must stay open before it is
+    # quarantined out of the ring
+    elastic_quarantine_intervals: int = 3
+    # autoscale controller: K consecutive pressured (calm) observation
+    # intervals before scale-out (scale-in), plus a cooldown between
+    # actions so one reshard settles before the next reading
+    elastic_autoscale: bool = False
+    elastic_hysteresis_intervals: int = 3
+    elastic_cooldown_s: float = 60.0
+    elastic_min_members: int = 1
+    elastic_max_members: int = 0       # 0 = uncapped
+    elastic_observe_interval_s: float = 10.0
     # accepted for YAML compatibility with reference proxy configs;
     # nothing consumes it there either (config_proxy.go:23 has no
     # reader outside the config struct)
@@ -606,6 +625,31 @@ def _validate_dedup_keys(cfg) -> None:
                          " forward_dedup: false to disable dedup)")
 
 
+def _validate_elastic_keys(cfg) -> None:
+    if cfg.elastic_probe_timeout_s <= 0:
+        raise ValueError("elastic_probe_timeout_s must be positive")
+    if cfg.elastic_quarantine_intervals < 1:
+        raise ValueError("elastic_quarantine_intervals must be >= 1")
+    if cfg.elastic_hysteresis_intervals < 1:
+        raise ValueError("elastic_hysteresis_intervals must be >= 1")
+    if cfg.elastic_cooldown_s < 0:
+        raise ValueError("elastic_cooldown_s must be >= 0")
+    if cfg.elastic_min_members < 1:
+        raise ValueError("elastic_min_members must be >= 1 (an empty"
+                         " ring loses routing entirely)")
+    if cfg.elastic_max_members and \
+            cfg.elastic_max_members < cfg.elastic_min_members:
+        raise ValueError("elastic_max_members must be 0 (uncapped) or"
+                         " >= elastic_min_members")
+    if cfg.elastic_observe_interval_s <= 0:
+        raise ValueError("elastic_observe_interval_s must be positive")
+    if cfg.elastic_autoscale and not cfg.elastic_membership_file:
+        raise ValueError("elastic_autoscale requires"
+                         " elastic_membership_file (the controller"
+                         " writes the desired member set back through"
+                         " the watchable file)")
+
+
 def validate_proxy_config(cfg: ProxyConfig) -> None:
     parse_duration(cfg.forward_timeout)  # raises on nonsense
     parse_duration(cfg.consul_refresh_interval)
@@ -626,6 +670,7 @@ def validate_proxy_config(cfg: ProxyConfig) -> None:
                          " the reshard drain AND paces the drain thread)")
     _validate_journal_keys(cfg)
     _validate_dedup_keys(cfg)
+    _validate_elastic_keys(cfg)
     if cfg.routing_pool_workers < 1:
         raise ValueError("routing_pool_workers must be >= 1")
     if cfg.routing_queue_max < 1:

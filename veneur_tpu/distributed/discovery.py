@@ -11,8 +11,10 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import ssl
 import threading
+import time
 import urllib.request
 from typing import Callable, Optional, Protocol
 
@@ -62,6 +64,111 @@ class StaticDiscoverer:
             return list(self._dests)
 
 
+class FileWatchDiscoverer:
+    """Watchable membership source: an mtime-polled file of members —
+    the elastic tier's discovery backend (ROADMAP item 4: the interface
+    matters, not the backend; a Consul watch or a k8s informer would
+    slot in behind the same Discoverer protocol).
+
+    Accepted formats, sniffed per read:
+
+    - a JSON object ``{"members": [...], "standby": [...]}`` — the
+      native format. ``standby`` is the provisioned-but-unrouted pool
+      the autoscale controller promotes from / demotes to;
+    - a bare JSON array of ``"host:port"`` strings (all members);
+    - newline-separated plain text (``#`` comments and blanks skipped).
+
+    The file is re-parsed only when its (mtime_ns, size, inode)
+    signature changes — a poll against an unchanged file costs one
+    stat. A missing file or malformed content raises, which the
+    DestinationRefresher's keep-last-good path absorbs and counts.
+
+    `write_members` is the controller's write-back half of the loop:
+    an atomic tmp+rename rewrite (object format), so every consumer
+    polling the file — this process's refresher AND any other proxy
+    watching the same file — observes the new desired set on its next
+    poll, never a torn write.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self._sig: Optional[tuple] = None
+        self._members: list[str] = []
+        self._standby: list[str] = []
+        self.reads = 0    # actual re-parses, not polls
+        self.writes = 0
+
+    @staticmethod
+    def _parse(text: str) -> tuple[list[str], list[str]]:
+        stripped = text.lstrip()
+        if stripped[:1] in ("{", "["):
+            data = json.loads(text)  # malformed JSON raises ValueError
+            if isinstance(data, dict):
+                members = [str(m) for m in data.get("members", [])]
+                standby = [str(m) for m in data.get("standby", [])]
+                return members, standby
+            return [str(m) for m in data], []
+        members = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line and not line.startswith("#"):
+                members.append(line)
+        return members, []
+
+    def _load_locked(self) -> None:
+        st = os.stat(self.path)  # missing file raises OSError
+        sig = (st.st_mtime_ns, st.st_size, st.st_ino)
+        if sig == self._sig:
+            return
+        with open(self.path) as f:
+            text = f.read()
+        self._members, self._standby = self._parse(text)
+        self._sig = sig
+        self.reads += 1
+
+    def get_destinations_for_service(self, service: str = "") -> list[str]:
+        with self._lock:
+            self._load_locked()
+            return list(self._members)
+
+    def desired(self) -> tuple[list[str], list[str]]:
+        """The controller's view: (members, standby), freshly polled."""
+        with self._lock:
+            self._load_locked()
+            return list(self._members), list(self._standby)
+
+    def write_members(self, members: list[str],
+                      standby: Optional[list[str]] = None) -> None:
+        """Atomically rewrite the desired member set (and standby pool);
+        the rename bumps the signature so every poller re-reads."""
+        with self._lock:
+            if standby is None:
+                standby = self._standby
+            payload = json.dumps(
+                {"members": list(members), "standby": list(standby)},
+                indent=0)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.path)
+            st = os.stat(self.path)
+            self._sig = (st.st_mtime_ns, st.st_size, st.st_ino)
+            self._members = list(members)
+            self._standby = list(standby)
+            self.writes += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "path": self.path,
+                "members": list(self._members),
+                "standby": list(self._standby),
+                "reads": self.reads,
+                "writes": self.writes,
+            }
+
+
 def _default_opener(url: str, headers: Optional[dict] = None,
                     ca_file: Optional[str] = None, timeout: float = 10.0
                     ) -> bytes:
@@ -103,29 +210,72 @@ class KubernetesDiscoverer:
     TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
     CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 
+    # projected service-account tokens rotate (kubelet refreshes the
+    # file); a cached copy is only trustworthy for so long
+    TOKEN_TTL_S = 300.0
+
     def __init__(self, api_url: str = "https://kubernetes.default.svc",
                  namespace: str = "default",
                  opener: Callable = _default_opener,
-                 token: Optional[str] = None) -> None:
+                 token: Optional[str] = None,
+                 token_path: Optional[str] = None,
+                 token_ttl_s: Optional[float] = None,
+                 time_fn: Callable[[], float] = time.monotonic) -> None:
         self.api_url = api_url.rstrip("/")
         self.namespace = namespace
         self.opener = opener
+        self.token_path = token_path or self.TOKEN_PATH
+        self.token_ttl_s = (self.TOKEN_TTL_S if token_ttl_s is None
+                            else float(token_ttl_s))
+        self._time = time_fn
         self._token = token
+        # a ctor-injected token is the caller's to manage and never
+        # refreshes; only file-read tokens age out / retry on 401
+        self._token_from_file = token is None
+        self._token_read_at: Optional[float] = None
+        self.token_rereads = 0
 
-    def _read_token(self) -> str:
-        if self._token is None:
-            with open(self.TOKEN_PATH) as f:
+    def _read_token(self, force: bool = False) -> str:
+        if not self._token_from_file:
+            return self._token
+        now = self._time()
+        stale = (self._token_read_at is not None
+                 and now - self._token_read_at >= self.token_ttl_s)
+        if self._token is None or force or stale:
+            with open(self.token_path) as f:
                 self._token = f.read().strip()
+            if self._token_read_at is not None:
+                self.token_rereads += 1
+            self._token_read_at = now
         return self._token
+
+    @staticmethod
+    def _auth_failed(exc: Exception) -> bool:
+        return getattr(exc, "code", None) in (401, 403)
 
     def get_destinations_for_service(self, service: str) -> list[str]:
         url = (f"{self.api_url}/api/v1/namespaces/{self.namespace}/pods"
                f"?labelSelector=app%3D{service}")
-        body = self.opener(
-            url,
-            headers={"Authorization": f"Bearer {self._read_token()}"},
-            ca_file=self.CA_PATH,
-        )
+        try:
+            body = self.opener(
+                url,
+                headers={"Authorization": f"Bearer {self._read_token()}"},
+                ca_file=self.CA_PATH,
+            )
+        except Exception as e:
+            # a rejected credential on a file-read token usually means
+            # the kubelet rotated it under us: re-read and retry once
+            # before declaring the refresh failed
+            if not (self._token_from_file and self._auth_failed(e)):
+                raise
+            log.warning("kubernetes API rejected token (%s); re-reading"
+                        " %s and retrying", e, self.token_path)
+            body = self.opener(
+                url,
+                headers={"Authorization":
+                         f"Bearer {self._read_token(force=True)}"},
+                ca_file=self.CA_PATH,
+            )
         data = json.loads(body)
         out = []
         for pod in data.get("items", []):
@@ -137,10 +287,12 @@ class KubernetesDiscoverer:
                 pod.get("spec", {}).get("containers", [{}])[0]
                 .get("ports", [])
             )
+            by_name = {p.get("name"): p.get("containerPort")
+                       for p in ports}
             port = None
-            for p in ports:
-                if p.get("name") in ("grpc", "import", "http"):
-                    port = p.get("containerPort")
+            for name in ("grpc", "import", "http"):
+                if by_name.get(name) is not None:
+                    port = by_name[name]
                     break
             if port is None and ports:
                 port = ports[0].get("containerPort")

@@ -349,6 +349,12 @@ class ImportServer:
             self.grpc_server.stop(grace=grace).wait()
             self.grpc_server = None
 
+    def ready(self) -> bool:
+        """Readiness for the elastic tier's admission probe: the
+        listener is up (elastic.tcp_probe checks the same thing from
+        the proxy's side of the network)."""
+        return self.grpc_server is not None
+
     def stats(self) -> dict:
         with self._stats_lock:
             return {

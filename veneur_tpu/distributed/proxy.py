@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import logging
 import queue
+import random
 import socket
 import threading
 import time
@@ -343,18 +344,25 @@ class ProxyServer:
 
     # -- membership (reference SetDestinations, proxysrv/server.go:148-176)
 
-    def set_destinations(self, destinations: list[str]):
+    def set_destinations(self, destinations: list[str], cause: str = ""):
         """Reshard the ring; returns the RingChange (None if membership
         is unchanged). A change wakes the handoff drain so spilled
         fragments re-route under the NEW ring within the bounded
-        window."""
+        window. `cause` stamps WHY membership moved ("discovery",
+        "quarantine", "scale_in", ...) into the change and telemetry."""
         with self._lock:
-            change = self.ring.set_members(destinations)
+            change = self.ring.set_members(destinations, cause=cause)
             if not change:
                 return None
             live = set(destinations)
             for dest in list(self._conns):
-                if dest not in live:
+                # a departed destination's client must outlive the
+                # reshard while a send toward it is in flight — closing
+                # the channel mid-call aborts the attempt as a permanent
+                # "send" failure even though the member is healthy (the
+                # graceful scale-in drop). Busy clients are closed by
+                # _retire_departed once the last send lands.
+                if dest not in live and not self._inflight.get(dest, 0):
                     self._conns.pop(dest).close()
         with self._stats_lock:
             self.reshards += 1
@@ -365,9 +373,33 @@ class ProxyServer:
                 "removed": list(change.removed),
                 "moved_ranges": len(change.moved_ranges),
                 "moved_fraction": round(change.moved_fraction(), 6),
+                "cause": change.cause,
             }
         self._drain_event.set()
         return change
+
+    def breaker_states(self) -> dict[str, str]:
+        """Per-destination circuit-breaker state ("closed"/"open"/
+        "half_open") for every destination with a delivery manager — the
+        health gate's quarantine signal."""
+        with self._lock:
+            managers = dict(self._managers)
+        return {dest: man.stats()["circuit_state"]
+                for dest, man in managers.items()}
+
+    def destination_idle(self, dest: str) -> bool:
+        """Whether a departed destination has fully drained: out of the
+        ring, nothing in flight toward it, and its spill empty (or its
+        manager already retired). This is the elastic controller's
+        "safe to retire" signal — the same condition _retire_departed
+        enforces, read without mutating."""
+        with self._lock:
+            if dest in self.ring.view().members:
+                return False
+            if self._inflight.get(dest, 0):
+                return False
+            man = self._managers.get(dest)
+            return man is None or not len(man.spill)
 
     def _conn(self, dest: str) -> rpc.ForwardClient:
         with self._lock:
@@ -708,11 +740,11 @@ class ProxyServer:
 
     def drain_spill(self, window_s: Optional[float] = None) -> dict:
         """One handoff/drain pass, bounded by the handoff window: every
-        destination manager gets its interval edge (an open breaker arms
-        its half-open probe), then all spilled fragments are popped and
-        re-routed under the CURRENT ring. Runs periodically from the
-        drain thread and immediately on reshard; also the soak's lever
-        for deterministic final settling."""
+        destination manager with pass work gets its interval edge (an
+        open breaker arms its half-open probe), then all spilled
+        fragments are popped and re-routed under the CURRENT ring. Runs
+        periodically from the drain thread and immediately on reshard;
+        also the soak's lever for deterministic final settling."""
         window = self.handoff_window_s if window_s is None \
             else float(window_s)
         deadline = time.monotonic() + window
@@ -720,7 +752,15 @@ class ProxyServer:
             managers = dict(self._managers)
         drained_payloads = drained_metrics = 0
         for dest, man in managers.items():
-            man.begin_flush(window)
+            # arm the pass edge only when this manager has pass work:
+            # spill to re-send, or a tripped breaker awaiting its
+            # half-open probe. Arming unconditionally would couple
+            # every LIVE forward's delivery budget to the drain
+            # cadence — a fragment routed late in the armed window
+            # inherits the window's TAIL as its whole budget and clips
+            # spuriously on a healthy, keeping-up destination.
+            if len(man.spill) or man.breaker.state != "closed":
+                man.begin_flush(window)
             entries = man.drain_spill()
             if not entries:
                 continue
@@ -800,6 +840,11 @@ class ProxyServer:
                         and not len(self._managers[dest].spill)):
                     del self._managers[dest]
                     self._inflight.pop(dest, None)
+                    # now truly idle: close the client set_destinations
+                    # left open for the in-flight tail
+                    conn = self._conns.pop(dest, None)
+                    if conn is not None:
+                        conn.close()
 
     def _drain_loop(self) -> None:
         while not self._stop_event.is_set():
@@ -1130,17 +1175,30 @@ class ProxyHTTPServer:
 class DestinationRefresher:
     """Periodically re-poll service discovery and reset the ring, keeping
     the last good destination set on error
-    (reference proxy.go:328-354, 505-515)."""
+    (reference proxy.go:328-354, 505-515).
+
+    Each loop wait is full-jittered to interval_s * [1-jitter, 1+jitter]
+    so a fleet of proxies restarted together doesn't hit the discovery
+    backend on the same beat forever. An optional health `gate`
+    (elastic.HealthGate) filters every discovered set before it reaches
+    the ring: unreachable candidates never enter, breaker-open members
+    are quarantined out."""
 
     def __init__(self, proxy: ProxyServer, discoverer, service: str,
-                 interval_s: float = 30.0) -> None:
+                 interval_s: float = 30.0, gate=None,
+                 jitter: float = 0.5,
+                 rng: Optional[random.Random] = None) -> None:
         self.proxy = proxy
         self.discoverer = discoverer
         self.service = service
         self.interval_s = interval_s
+        self.gate = gate
+        self.jitter = min(max(float(jitter), 0.0), 1.0)
+        self._rng = rng or random.Random()
         self._stop = threading.Event()
         self.refresh_errors = 0
         self.refresh_empty = 0
+        self.refresh_gated_empty = 0
         self.last_refresh: float = 0.0
         # let forward_stats() surface refresh staleness alongside the
         # ring version/age it gates
@@ -1148,6 +1206,14 @@ class DestinationRefresher:
             proxy.refresher = self
         except AttributeError:  # pragma: no cover - exotic proxy stand-in
             pass
+
+    def _next_wait(self) -> float:
+        """Full jitter: uniform in interval_s * [1-jitter, 1+jitter]."""
+        if self.jitter <= 0.0:
+            return self.interval_s
+        lo = 1.0 - self.jitter
+        return self.interval_s * (lo + 2.0 * self.jitter
+                                  * self._rng.random())
 
     def refresh(self) -> None:
         try:
@@ -1168,24 +1234,42 @@ class DestinationRefresher:
             log.warning("discovery returned no destinations (keeping %d"
                         " last-good)", len(self.proxy.ring))
             return
-        self.proxy.set_destinations(destinations)
+        cause = "discovery"
+        if self.gate is not None:
+            admitted = self.gate.admit(destinations)
+            if not admitted:
+                # the gate refusing everyone is a health outage, not a
+                # membership decision: keep last-good like an empty
+                # discovery answer
+                self.refresh_gated_empty += 1
+                log.warning("health gate admitted no destinations"
+                            " (keeping %d last-good)", len(self.proxy.ring))
+                return
+            if self.gate.last_events:
+                cause = "discovery+" + ",".join(self.gate.last_events)
+            destinations = admitted
+        self.proxy.set_destinations(destinations, cause=cause)
         self.last_refresh = time.time()
 
     def stats(self) -> dict:
         now = time.time()
-        return {
+        out = {
             "refresh_errors": self.refresh_errors,
             "refresh_empty": self.refresh_empty,
+            "refresh_gated_empty": self.refresh_gated_empty,
             "last_refresh_unix": self.last_refresh,
             "last_refresh_age_s": (round(now - self.last_refresh, 3)
                                    if self.last_refresh else None),
         }
+        if self.gate is not None:
+            out["gate"] = self.gate.stats()
+        return out
 
     def start(self) -> None:
         self.refresh()
 
         def loop():
-            while not self._stop.wait(self.interval_s):
+            while not self._stop.wait(self._next_wait()):
                 self.refresh()
 
         threading.Thread(target=loop, daemon=True,

@@ -89,6 +89,10 @@ class RingChange:
     # (lo, hi, old_owner, new_owner) half-open ranges, wraparound split
     # into its two linear pieces
     moved_ranges: list = field(default_factory=list)
+    # provenance of the mutation ("discovery", "quarantine", "scale_in",
+    # ...) — the elastic tier stamps it so a reshard in telemetry or a
+    # soak event log names WHY membership moved, not just what moved
+    cause: str = ""
 
     def __bool__(self) -> bool:
         return True
@@ -221,9 +225,11 @@ class ConsistentRing:
         return RingChange(self.version, removed=[member],
                           moved_ranges=_moved_ranges(old, self._view))
 
-    def set_members(self, members: list[str]) -> Optional[RingChange]:
+    def set_members(self, members: list[str],
+                    cause: str = "") -> Optional[RingChange]:
         """Replace membership; returns the RingChange (truthy) if
-        anything changed, None otherwise."""
+        anything changed, None otherwise. `cause` stamps the change's
+        provenance for telemetry (see RingChange.cause)."""
         new = set(members)
         if new == self._members:
             return None
@@ -237,7 +243,8 @@ class ConsistentRing:
         self.version += 1
         self._rebuild_view()
         return RingChange(self.version, added=added, removed=removed,
-                          moved_ranges=_moved_ranges(old, self._view))
+                          moved_ranges=_moved_ranges(old, self._view),
+                          cause=cause)
 
     def members(self) -> list[str]:
         return sorted(self._view.members)

@@ -162,3 +162,63 @@ def watchdog_should_defer(now_unix: float, governor,
         f"flush in flight but stalled: last progress {age:.1f}s ago "
         f"(>= {window:.1f}s stall window, "
         f"{prog['chunks_done']} chunks done)")
+
+
+# -- elastic-tier autoscale policy (ISSUE 14) ---------------------------------
+
+# consecutive pressured (resp. calm) observation intervals before the
+# controller scales out (resp. in) — the hysteresis deadband
+ELASTIC_HYSTERESIS_INTERVALS = 3
+
+# a routing queue holding this many batches at observation time counts
+# as pressure even when nothing shed yet (depth is the leading signal,
+# sheds the lagging one)
+ELASTIC_QUEUE_PRESSURE_DEPTH = 2
+
+
+def elastic_pressure_reasons(signals: dict) -> list[str]:
+    """Classify one observation interval of tier signals into pressure
+    reasons ([] == calm). The signals are deltas/gauges the system
+    already emits (ProxyPressureSource assembles them):
+
+    - routing_shed_delta: batches shed by the routing pool this interval
+    - routing_queue_depth: routing queue occupancy right now
+    - delivery_deferred_delta: payloads newly deferred to spill/retry
+    - spilled_metrics: metrics currently parked in spill (a non-empty
+      spill also blocks scale-in: re-homing a spilled fragment whose
+      prior attempt may have landed is the remint-duplicate risk, so
+      "calm" must mean "nothing parked")
+    - delivery_behind / tenant_pressure: optional upstream booleans
+    """
+    reasons = []
+    if signals.get("routing_shed_delta", 0) > 0:
+        reasons.append("routing_shed")
+    if signals.get("routing_queue_depth", 0) >= ELASTIC_QUEUE_PRESSURE_DEPTH:
+        reasons.append("routing_queue")
+    if signals.get("delivery_deferred_delta", 0) > 0:
+        reasons.append("delivery_deferred")
+    if signals.get("spilled_metrics", 0) > 0:
+        reasons.append("spill_nonempty")
+    if signals.get("delivery_behind"):
+        reasons.append("delivery_behind")
+    if signals.get("tenant_pressure"):
+        reasons.append("tenant_pressure")
+    return reasons
+
+
+def elastic_scale_decision(pressured_streak: int, calm_streak: int,
+                           members: int, *, k: int,
+                           min_members: int = 1,
+                           max_members: int = 0) -> Optional[str]:
+    """Hysteresis decision: "out" after >= k consecutive pressured
+    intervals (capped by max_members unless 0 == uncapped), "in" after
+    >= k consecutive calm intervals (floored at min_members), else None.
+    Oscillation inside the deadband resets both streaks upstream, so it
+    can never reach k — zero membership changes by construction."""
+    if pressured_streak >= k:
+        if max_members and members >= max_members:
+            return None
+        return "out"
+    if calm_streak >= k and members > min_members:
+        return "in"
+    return None
