@@ -942,7 +942,7 @@ def test_proxy_runtime_reporter_emits_deltas():
 
     cap = scopedstatsd.CaptureSender()
     stats = scopedstatsd.ScopedClient(cap, namespace="veneur_proxy.")
-    proxy = ProxyServer(["127.0.0.1:1"])
+    proxy = ProxyServer(["127.0.0.1:1"], streaming=True)
     proxy.proxied_metrics = 10
     proxy.drops = 3
     rep = ProxyRuntimeReporter(proxy, stats, interval_s=60.0)
@@ -956,6 +956,10 @@ def test_proxy_runtime_reporter_emits_deltas():
     assert by_dest[1].split("|")[0].endswith(":15")  # delta, not total
     assert any(l.startswith("veneur_proxy.destinations_total:1") for l in lines)
     assert any(l.startswith("veneur_proxy.mem.rss_bytes") for l in lines)
+    # streaming forward path rides the same reporter: ack/reconnect
+    # deltas plus the in-flight window depth
+    assert any(l.startswith("veneur_proxy.stream.acked") for l in lines)
+    assert any(l.startswith("veneur_proxy.stream.unacked_frames") for l in lines)
 
 
 def test_proxy_main_refuses_empty_destinations(tmp_path):

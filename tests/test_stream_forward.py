@@ -1,0 +1,531 @@
+"""Streaming forward RPC tests: the long-lived StreamMetrics channel
+(PR 15) — pipelined frames under a bounded ack window, server-side
+cross-sender coalescing, mixed-version interop via UNIMPLEMENTED
+downgrade, and dedup-across-reconnect (a torn stream's replayed tail
+never double-merges).
+"""
+
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.distributed import codec, rpc
+from veneur_tpu.distributed.import_server import ImportServer, StreamCoalescer
+from veneur_tpu.distributed.proxy import ProxyServer
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+
+
+def _counter_blob(name: str, value: int = 1, tags=()) -> bytes:
+    batch = pb.MetricBatch()
+    m = batch.metrics.add()
+    m.name = name
+    m.tags.extend(tags)
+    m.kind = pb.KIND_COUNTER
+    m.scope = pb.SCOPE_GLOBAL
+    m.counter.value = value
+    return batch.SerializeToString()
+
+
+def _global_server():
+    cfg = Config(interval="10s", percentiles=[0.5], num_workers=2)
+    srv = Server(cfg)
+    imp = ImportServer(srv)
+    port = imp.start_grpc()
+    return srv, imp, port
+
+
+def _counter_total(srv: Server, name: str) -> float:
+    total = 0.0
+    for w, lock in zip(srv.workers, srv._worker_locks):
+        with lock:
+            for (key, _tags, _cls, _sinks), value in zip(
+                    w.scalars.counter_meta, w.scalars.counter_values):
+                if key.name == name:
+                    total += float(value)
+    return total
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------- framing
+
+
+def test_stream_frame_roundtrip():
+    frame = codec.encode_stream_frame(1 << 40, b"body-bytes")
+    assert codec.decode_stream_frame(frame) == (1 << 40, b"body-bytes")
+    with pytest.raises(ValueError):
+        codec.decode_stream_frame(b"nope")
+    ack = codec.encode_stream_ack(7, ok=True)
+    assert codec.decode_stream_ack(ack) == (7, codec.STREAM_ACK_OK)
+    assert codec.decode_stream_ack(
+        codec.encode_stream_ack(9, ok=False)) == (9, codec.STREAM_ACK_FAILED)
+    assert codec.decode_stream_ack(
+        codec.encode_stream_ack(3, codec.STREAM_ACK_BUSY)
+    ) == (3, codec.STREAM_ACK_BUSY)
+    with pytest.raises(ValueError):
+        codec.decode_stream_ack(b"\x00" * 4)
+
+
+# ------------------------------------------------------------- stream path
+
+
+def test_streaming_client_to_streaming_server():
+    _srv, imp, port = _global_server()
+    client = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=5.0,
+                               streaming=True, stream_window=8)
+    try:
+        for i in range(20):
+            client.send_raw_or_raise(_counter_blob(f"s.c{i}"), 1)
+        assert _wait_until(lambda: imp.received_metrics >= 20)
+        s = client.stats()["stream"]
+        assert s["opened"] == 1
+        assert s["acked_total"] == 20
+        assert not s["downgraded"]
+        assert client.sent_batches == 20 and client.sent_metrics == 20
+        # the unary error taxonomy stayed clean
+        assert client.errors == {"deadline_exceeded": 0,
+                                 "unavailable": 0, "send": 0,
+                                 "busy": 0}
+        cstats = imp.stats()["stream"]
+        assert cstats["frames"] >= 20 and cstats["batches"] >= 1
+    finally:
+        client.close()
+        imp.stop()
+
+
+def test_stream_batch_send_serializes_through_stream():
+    _srv, imp, port = _global_server()
+    client = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=5.0,
+                               streaming=True)
+    try:
+        batch = pb.MetricBatch()
+        for i in range(3):
+            m = batch.metrics.add()
+            m.name = f"b.c{i}"
+            m.kind = pb.KIND_COUNTER
+            m.scope = pb.SCOPE_GLOBAL
+            m.counter.value = 1
+        client.send_or_raise(batch)
+        assert _wait_until(lambda: imp.received_metrics >= 3)
+        assert client.stats()["stream"]["acked_total"] == 1
+    finally:
+        client.close()
+        imp.stop()
+
+
+def test_stream_window_stall_counted():
+    # a slow receiver + window=1 forces the second concurrent sender to
+    # block on window admission, which must be counted, not silent
+    gate = threading.Event()
+    seen = []
+
+    def slow_handler(body):
+        seen.append(body)
+        gate.wait(2.0)
+
+    srv, port = rpc.make_server(None, raw_handler=slow_handler,
+                                compat=False)
+    client = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=10.0,
+                               streaming=True, stream_window=1)
+    try:
+        t = threading.Thread(
+            target=lambda: client.send_raw_or_raise(b"frame-a", 1))
+        t.start()
+        assert _wait_until(lambda: len(seen) == 1)
+        t2 = threading.Thread(
+            target=lambda: client.send_raw_or_raise(b"frame-b", 1))
+        t2.start()
+        assert _wait_until(
+            lambda: client.stream_window_stalls >= 1, timeout=5.0)
+        gate.set()
+        t.join(timeout=5.0)
+        t2.join(timeout=5.0)
+        assert client.stream_acked == 2
+    finally:
+        client.close()
+        srv.stop(0)
+
+
+def test_busy_ack_is_transient_and_keeps_stream():
+    # admission backpressure: a busy-acked frame surfaces as a transient
+    # "busy" ForwardError (the delivery layer retries it under the same
+    # dedup key) WITHOUT tearing down the healthy stream
+    taken = []
+
+    class FlipSink:
+        busy = True
+
+        def submit(self, body, done):
+            if self.busy:
+                self.busy = False
+                done(codec.STREAM_ACK_BUSY)
+            else:
+                taken.append(body)
+                done(True)
+
+    srv, port = rpc.make_server(None, raw_handler=None, compat=False,
+                                stream_sink=FlipSink())
+    client = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=5.0,
+                               streaming=True, stream_window=4)
+    try:
+        with pytest.raises(rpc.ForwardError) as ei:
+            client.send_raw_or_raise(b"frame-a", 1)
+        assert ei.value.cause == "busy" and ei.value.transient
+        client.send_raw_or_raise(b"frame-a", 1)  # the retry lands
+        assert taken == [b"frame-a"]
+        # same stream served both attempts: busy never reconnects
+        assert client.stream_opened == 1 and client.stream_reconnects == 0
+        assert client.errors["busy"] == 1
+    finally:
+        client.close()
+        srv.stop(0)
+
+
+# ------------------------------------------------- mixed-version interop
+
+
+def test_new_client_downgrades_to_unary_on_old_server():
+    # "old server": StreamMetrics not registered -> UNIMPLEMENTED
+    got = []
+    srv, port = rpc.make_server(None, raw_handler=got.append,
+                                compat=False, enable_stream=False)
+    client = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=5.0,
+                               streaming=True)
+    try:
+        # the downgrade send itself must succeed (no spurious failure)
+        client.send_raw_or_raise(b"first", 1)
+        client.send_raw_or_raise(b"second", 1)
+        assert got == [b"first", b"second"]
+        s = client.stats()["stream"]
+        assert s["downgraded"] and s["acked_total"] == 0
+        # downgrade is not an error: breaker food stays untouched
+        assert client.errors == {"deadline_exceeded": 0,
+                                 "unavailable": 0, "send": 0,
+                                 "busy": 0}
+        assert client.consecutive_failures == 0
+        assert client.sent_batches == 2
+    finally:
+        client.close()
+        srv.stop(0)
+
+
+def test_old_unary_client_against_streaming_server():
+    # streaming server keeps serving unary callers (old client side of
+    # the bidirectional interop contract)
+    _srv, imp, port = _global_server()
+    client = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=5.0,
+                               streaming=False)
+    try:
+        client.send_raw_or_raise(_counter_blob("old.c"), 1)
+        assert _wait_until(lambda: imp.received_metrics >= 1)
+        assert "stream" not in client.stats()
+    finally:
+        client.close()
+        imp.stop()
+
+
+def test_unary_and_streaming_callers_share_one_server():
+    _srv, imp, port = _global_server()
+    new = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=5.0,
+                            streaming=True)
+    old = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=5.0)
+    try:
+        new.send_raw_or_raise(_counter_blob("mix.new"), 1)
+        old.send_raw_or_raise(_counter_blob("mix.old"), 1)
+        assert _wait_until(lambda: imp.received_metrics >= 2)
+        assert new.stats()["stream"]["acked_total"] == 1
+    finally:
+        new.close()
+        old.close()
+        imp.stop()
+
+
+# -------------------------------------------- dedup across reconnects
+
+
+def _send_retrying(client, blob, deadline_s=10.0):
+    """What the DeliveryManager does for transient causes: retry the
+    same payload (same dedup envelope) until the transport recovers."""
+    end = time.time() + deadline_s
+    while True:
+        try:
+            client.send_raw_or_raise(blob, 1)
+            return
+        except rpc.ForwardError as e:
+            if not e.transient or time.time() >= end:
+                raise
+            time.sleep(0.05)
+
+
+def test_dedup_absorbs_replayed_tail_across_reconnect():
+    """A stream torn mid-window replays its unacked tail under the
+    ORIGINAL dedup keys; the import window absorbs every replay —
+    zero double-merges — and per-sender id spaces stay independent."""
+    gsrv, imp, port = _global_server()
+    addr = f"127.0.0.1:{port}"
+    client = rpc.ForwardClient(addr, timeout_s=2.0, streaming=True,
+                               stream_window=8)
+    bodies = {
+        i: codec.encode_dedup_envelope(
+            "sender-a", i, 1, _counter_blob("dd.c", 1, (f"id:{i}",)))
+        for i in range(1, 6)
+    }
+    try:
+        # frames 1..4 deliver and ack
+        for i in range(1, 5):
+            client.send_raw_or_raise(bodies[i], 1)
+        assert _wait_until(lambda: imp.received_metrics >= 4)
+
+        # tear the stream mid-window: server gone, frame 5 fails as a
+        # classified transient (what the DeliveryManager would retry)
+        imp.stop(grace=0)
+        with pytest.raises(rpc.ForwardError) as ei:
+            client.send_raw_or_raise(bodies[5], 1)
+        assert ei.value.transient
+
+        # server back on the same port (same ImportServer object — same
+        # dedup window, same coalescer, like a restarted listener)
+        imp.start_grpc(addr)
+
+        # the delivery layer replays the unacked tail under the original
+        # keys: the ambiguous frame 5 plus already-acked 1..4 (the
+        # worst-case handoff replay)
+        for i in range(1, 6):
+            _send_retrying(client, bodies[i])
+
+        assert _wait_until(lambda: imp.received_metrics >= 5)
+        time.sleep(0.1)  # let any stray merge land before asserting
+        # exactly 5 unique frames merged; 4 replays absorbed
+        assert imp.received_metrics == 5
+        assert imp.metrics_deduped == 4
+        assert _counter_total(gsrv, "dd.c") == 5.0
+        # per-sender id spaces: sender-b reuses id 1 and still merges
+        _send_retrying(client, codec.encode_dedup_envelope(
+            "sender-b", 1, 1, _counter_blob("dd.other", 1)))
+        assert _wait_until(lambda: imp.received_metrics >= 6)
+        assert imp.metrics_deduped == 4
+        s = client.stats()["stream"]
+        assert s["opened"] >= 2 and s["reconnects"] >= 1
+        assert s["unacked_frames"] == 0
+    finally:
+        client.close()
+        imp.stop()
+
+
+# ------------------------------------------------- server-side coalescing
+
+
+class _StubImport:
+    dedup_enabled = True
+
+    def __init__(self):
+        from veneur_tpu.distributed.import_server import DedupWindow
+
+        self.dedup = DedupWindow()
+        self.applied = []
+        self.deduped = 0
+        self.fail_blobs = set()
+
+    def _apply_wire(self, blob):
+        if blob in self.fail_blobs:
+            raise ValueError("poisoned")
+        self.applied.append(blob)
+        return 1
+
+    def note_deduped(self, n):
+        self.deduped += n
+
+
+def test_coalescer_batches_across_senders():
+    imp = _StubImport()
+    # auto_flush off: only the threshold path flushes, deterministically
+    co = StreamCoalescer(imp, max_frames=3, auto_flush=False)
+    acks = []
+    try:
+        env = lambda s, i: codec.encode_dedup_envelope(  # noqa: E731
+            s, i, 1, b"B%d" % i)
+        co.submit(env("sender-a", 1), acks.append)
+        co.submit(env("sender-b", 7), acks.append)
+        assert acks == []  # nothing acked before the merge lands
+        co.submit(env("sender-a", 2), acks.append)  # threshold flush
+        assert acks == [True, True, True]
+        # one concatenated merge for the whole cross-sender batch
+        assert imp.applied == [b"B1B7B2"]
+        st = co.stats()
+        assert st["batches"] == 1 and st["coalesced_frames"] == 3
+        assert st["max_frames_per_batch"] == 3
+    finally:
+        co.close()
+
+
+def test_coalescer_dedups_per_frame_and_acks_replays():
+    imp = _StubImport()
+    co = StreamCoalescer(imp, max_frames=2, auto_flush=False)
+    acks = []
+    try:
+        body = codec.encode_dedup_envelope("s", 42, 3, b"X")
+        co.submit(body, acks.append)
+        co.submit(body, acks.append)  # replay in the same batch
+        assert acks == [True, True]
+        assert imp.applied == [b"X"]  # merged once
+        assert imp.deduped == 3      # replay acked at envelope count
+    finally:
+        co.close()
+
+
+def test_coalescer_poisoned_batch_falls_back_per_frame():
+    imp = _StubImport()
+    imp.fail_blobs = {b"GOODBAD"}  # the concatenation fails ...
+    co = StreamCoalescer(imp, max_frames=2, auto_flush=False)
+    acks = []
+    try:
+        good = codec.encode_dedup_envelope("s", 1, 1, b"GOOD")
+        bad = codec.encode_dedup_envelope("s", 2, 1, b"BAD")
+        imp.fail_blobs.add(b"BAD")  # ... and so does the bad frame alone
+        co.submit(good, acks.append)
+        co.submit(bad, acks.append)
+        assert acks == [True, False]
+        assert imp.applied == [b"GOOD"]
+        # the failed frame's key is forgotten: its retry is fresh
+        assert not imp.dedup.seen_or_insert("s", 2)
+        assert co.stats()["batch_fallbacks"] == 1
+        assert co.stats()["frame_failures"] == 1
+    finally:
+        co.close()
+
+
+# ----------------------------------------------------- proxy integration
+
+
+def test_proxy_streams_to_globals_with_telemetry():
+    g1, imp1, p1 = _global_server()
+    g2, imp2, p2 = _global_server()
+    proxy = ProxyServer([f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"],
+                        timeout_s=5.0, dedup=True, streaming=True,
+                        stream_window=16)
+    try:
+        for i in range(40):
+            proxy.handle_wire(_counter_blob(f"px.c{i}"))
+        # wait on the proxy-side counter too: the import applies the
+        # merge before the ack lands back at the sender, so sampling on
+        # received_metrics alone can beat the last proxied increments
+        assert _wait_until(
+            lambda: (imp1.received_metrics + imp2.received_metrics >= 40
+                     and proxy.forward_stats()["proxied_metrics"] >= 40))
+        fs = proxy.forward_stats()
+        assert fs["stream"]["enabled"]
+        assert fs["stream"]["acked_total"] >= 1
+        assert fs["stream"]["opened"] >= 1
+        assert fs["stream"]["downgraded"] == 0
+        # both globals saw streamed frames through their coalescers
+        assert fs["proxied_metrics"] == 40
+        assert proxy.conserved()
+        # per-destination stream blocks ride under destinations too
+        per_dest = fs["destinations"]
+        assert any("stream" in d for d in per_dest.values())
+    finally:
+        proxy.stop()
+        imp1.stop()
+        imp2.stop()
+
+
+# --------------------------------------------- coldest-member scale-in
+
+
+class _FakeSource:
+    def __init__(self, members, standby=()):
+        self.members = list(members)
+        self.standby = list(standby)
+
+    def desired(self):
+        return list(self.members), list(self.standby)
+
+    def write_members(self, members, standby):
+        self.members = list(members)
+        self.standby = list(standby)
+
+
+def _calm_controller(source, loads=None, **kw):
+    from veneur_tpu.distributed.elastic import ElasticController
+
+    return ElasticController(
+        source, lambda: {},  # no pressure signals: calm every tick
+        hysteresis_k=1, cooldown_s=0.0, min_members=1,
+        member_load_fn=(None if loads is None else (lambda: dict(loads))),
+        **kw)
+
+
+def test_scale_in_picks_coldest_member():
+    src = _FakeSource(["g-a", "g-b", "g-c"])
+    ctl = _calm_controller(src, loads={"g-a": 50.0, "g-b": 1.5,
+                                      "g-c": 20.0})
+    assert ctl.tick() == "in"
+    assert src.members == ["g-a", "g-c"]
+    assert ctl.draining() == ["g-b"]
+    ev = [e for e in ctl.events if e["event"] == "scale_in"][0]
+    assert ev["member"] == "g-b" and ev["load"] == 1.5
+
+
+def test_scale_in_tie_breaks_lifo_and_falls_back_without_loads():
+    # all-equal loads: the most recently added member moves (old LIFO)
+    src = _FakeSource(["g-a", "g-b", "g-c"])
+    ctl = _calm_controller(src, loads={"g-a": 2.0, "g-b": 2.0,
+                                      "g-c": 2.0})
+    assert ctl.tick() == "in"
+    assert ctl.draining() == ["g-c"]
+    # no member_load_fn at all: LIFO
+    src2 = _FakeSource(["g-a", "g-b", "g-c"])
+    ctl2 = _calm_controller(src2)
+    assert ctl2.tick() == "in"
+    assert ctl2.draining() == ["g-c"]
+    # a member missing from the load map is genuinely cold
+    src3 = _FakeSource(["g-a", "g-b", "g-c"])
+    ctl3 = _calm_controller(src3, loads={"g-a": 9.0, "g-c": 3.0})
+    assert ctl3.tick() == "in"
+    assert ctl3.draining() == ["g-b"]
+
+
+def test_pressure_source_member_load_deltas():
+    from veneur_tpu.distributed.elastic import ProxyPressureSource
+
+    class FakeProxy:
+        def __init__(self):
+            self.sent = {"d1": 100, "d2": 100}
+            self.unacked = {"d1": 0, "d2": 0}
+
+        def forward_stats(self):
+            return {
+                "routing": {"shed_batches": 0, "queue_depth": 0},
+                "spilled_metrics": 0,
+                "behind": False,
+                "destinations": {
+                    d: {
+                        "sent_metrics": self.sent[d],
+                        "delivery": {"deferred_payloads": 0,
+                                     "delivered_payloads": 0,
+                                     "spilled_payloads": 0},
+                        "stream": {"unacked_frames": self.unacked[d]},
+                    }
+                    for d in self.sent
+                },
+            }
+
+    proxy = FakeProxy()
+    src = ProxyPressureSource(proxy)
+    src()  # establish marks
+    proxy.sent = {"d1": 500, "d2": 110}
+    proxy.unacked = {"d1": 3, "d2": 0}
+    src()
+    loads = src.member_load()
+    assert loads["d1"] == 403.0  # 400 delta + 3 unacked
+    assert loads["d2"] == 10.0

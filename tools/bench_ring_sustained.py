@@ -1,0 +1,558 @@
+"""Sustained-rate measurement for the WHOLE forward ring.
+
+Drives senders -> ProxyServer -> N global ImportServers over real gRPC
+and searches for the maximum offered metric rate the ring holds without
+loss: multiplicative growth to bracket the cliff, bisection inside the
+bracket, then a longer confirmation run. The paced senders are
+ForwardClients (streaming or unary — the same client the local tier's
+GRPCForwarder uses), so the measured hop chain is the production one:
+client -> proxy ingest -> consistent-hash routing -> per-destination
+DeliveryManager -> forward RPC -> import merge.
+
+Every trial settles to quiescence and then asserts the PR-11/15
+exactness contract before it may pass:
+
+    conservation exact   ingested == proxied + dropped (spill drained)
+    duplicates == 0      received never exceeds what delivery delivered
+                         (max(0, received - (proxied - drops)))
+
+--ab runs the full search twice on identical topologies — unary first,
+then streaming — and writes one artifact with both modes plus the
+speedup; the headline fields come from the streaming run. --smoke is
+the bounded CI lane: one fixed-rate pass/fail trial on the streaming
+path (exit 1 on failure), same invariants.
+
+Usage:
+    python tools/bench_ring_sustained.py --ab          # full A/B search
+    python tools/bench_ring_sustained.py --smoke --rate 2e4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _reexec_scrubbed() -> None:
+    # fresh interpreter without the axon pool var: the dev rig's site
+    # hook registers the wedging single-client TPU relay plugin at
+    # interpreter startup, so in-process env edits are too late
+    # (tools/soak_topology.py, TPU_BACKEND.md recipe)
+    if os.environ.get("_VENEUR_LG_REEXEC") == "1":
+        return
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["_VENEUR_LG_REEXEC"] = "1"
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+              env)
+
+
+class RingHarness:
+    """One live ring (senders + proxy + globals) in one forward mode.
+
+    Owns every process-local piece; close() tears it all down. The
+    sender side is `senders` threads, each with its own ForwardClient
+    (mirroring N independent local servers), paced against a shared
+    metrics/s budget.
+    """
+
+    def __init__(self, n_globals: int, senders: int, batch: int,
+                 series: int, streaming: bool, window: int,
+                 interval_s: float = 1.0) -> None:
+        from veneur_tpu.core.config import Config
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.distributed import rpc
+        from veneur_tpu.distributed.import_server import ImportServer
+        from veneur_tpu.distributed.proxy import ProxyServer
+        from veneur_tpu.gen import veneur_tpu_pb2 as pb
+        from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+        self.streaming = streaming
+        self.window = window
+        self.batch = batch
+        self.interval_s = interval_s
+        self.senders = senders
+        self._rpc = rpc
+        self.globals_ = []
+        for _ in range(n_globals):
+            cfg = Config(interval="10s", percentiles=[0.5], num_workers=2)
+            srv = Server(cfg)
+            imp = ImportServer(srv)
+            imp.start_grpc()
+            self.globals_.append((srv, imp))
+        policy = DeliveryPolicy(retry_max=2, breaker_threshold=8,
+                                spill_max_bytes=16 << 20,
+                                spill_max_payloads=1024,
+                                timeout_s=1.0, deadline_s=2.0,
+                                backoff_base_s=0.02, backoff_max_s=0.1)
+        self.proxy = ProxyServer(
+            [imp.address for _, imp in self.globals_],
+            timeout_s=2.0, delivery=policy, handoff_window_s=0.5,
+            dedup=True, streaming=streaming, stream_window=window)
+        self.pport = self.proxy.start_grpc()
+        addr = f"127.0.0.1:{self.pport}"
+        self.clients = [
+            rpc.ForwardClient(addr, timeout_s=2.0, streaming=streaming,
+                              stream_window=window)
+            for _ in range(senders)]
+        # the series universe, pre-serialized into cycling wire blobs of
+        # `batch` global counters each — routing splits every blob
+        # across the ring by metric key, so each payload exercises the
+        # fan-out, not one arc
+        self._blobs: list[bytes] = []
+        for base in range(0, max(series, batch), batch):
+            b = pb.MetricBatch()
+            for i in range(base, base + batch):
+                m = b.metrics.add()
+                m.name = f"ring.c{i % series}"
+                m.tags.append(f"shard:{i % 16}")
+                m.kind = pb.KIND_COUNTER
+                m.scope = pb.SCOPE_GLOBAL
+                m.counter.value = 1
+            self._blobs.append(b.SerializeToString())
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def received_total(self) -> int:
+        return sum(imp.received_metrics for _, imp in self.globals_)
+
+    def ingested_total(self) -> int:
+        return sum(c.sent_metrics for c in self.clients)
+
+    def snapshot(self) -> dict:
+        fs = self.proxy.forward_stats()
+        return {
+            "t": time.time(),
+            "ingested": self.ingested_total(),
+            "offered": sum(getattr(c, "_offered", 0)
+                           for c in self.clients),
+            "proxied": fs["proxied_metrics"],
+            "drops": fs["drops"],
+            "shed": fs["shed_metrics"],
+            "spilled": fs["spilled_metrics"],
+            "received": self.received_total(),
+            "queue_depth": fs["routing"]["queue_depth"],
+            "stream": dict(fs["stream"]),
+            "coalesce": {
+                "batches": sum(
+                    (imp.stats()["stream"] or {}).get("batches", 0)
+                    for _, imp in self.globals_),
+                "frames": sum(
+                    (imp.stats()["stream"] or {}).get("frames", 0)
+                    for _, imp in self.globals_),
+                "coalesced_frames": sum(
+                    (imp.stats()["stream"] or {}).get(
+                        "coalesced_frames", 0)
+                    for _, imp in self.globals_),
+            },
+        }
+
+    # -- one paced trial -----------------------------------------------------
+
+    def _sender_loop(self, client, rate: float, stop: threading.Event,
+                     blob_offset: int) -> None:
+        # rate is this thread's metrics/s budget; each send is one blob
+        # of self.batch metrics. Missed slots are skipped, not bursted:
+        # a ring that can't ack fast enough shows up as offered-vs-
+        # ingested gap, never as a catch-up flood after the stall.
+        per_send = self.batch / rate
+        k = blob_offset
+        next_t = time.monotonic()
+        while not stop.is_set():
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.05))
+                continue
+            if now - next_t > 1.0:
+                next_t = now  # fell behind a full second: drop the slots
+            client._offered = getattr(client, "_offered", 0) + self.batch
+            try:
+                client.send_raw_or_raise(
+                    self._blobs[k % len(self._blobs)], self.batch)
+            except self._rpc.ForwardError:
+                pass  # counted: offered but not ingested
+            k += 1
+            next_t += per_send
+
+    def quiesce(self, grace_s: float = 20.0) -> bool:
+        """Drain to a quiescent instant: spill empty, routing queue
+        drained, received stable. The conservation identities are exact
+        only here."""
+        deadline = time.time() + grace_s
+        last_rx = -1
+        stable_since = 0.0
+        while time.time() < deadline:
+            if self.proxy.spilled_metrics > 0:
+                self.proxy.drain_spill()
+            snap = self.snapshot()
+            rx = snap["received"]
+            if (snap["spilled"] == 0 and snap["queue_depth"] == 0
+                    and rx == last_rx):
+                if stable_since == 0.0:
+                    stable_since = time.time()
+                elif time.time() - stable_since >= 0.3:
+                    return True
+            else:
+                stable_since = 0.0
+            last_rx = rx
+            time.sleep(0.05)
+        return False
+
+    def run_trial(self, rate: float, n_intervals: int,
+                  max_loss: float = 0.005,
+                  min_attain: float = 0.9) -> dict:
+        start = self.snapshot()
+        stop = threading.Event()
+        threads = [
+            threading.Thread(
+                target=self._sender_loop,
+                args=(c, max(1.0, rate / self.senders), stop, j * 7),
+                name=f"ring-send-{j}")
+            for j, c in enumerate(self.clients)]
+        prev = start
+        intervals = []
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(n_intervals):
+                time.sleep(self.interval_s)
+                snap = self.snapshot()
+                dt = snap["t"] - prev["t"]
+                ing = snap["ingested"] - prev["ingested"]
+                off = snap["offered"] - prev["offered"]
+                intervals.append({
+                    "duration_s": round(dt, 4),
+                    "offered_metrics": off,
+                    "ingested_metrics": ing,
+                    "received_metrics": snap["received"] - prev["received"],
+                    "ingested_per_s": round(ing / dt, 1) if dt > 0 else 0.0,
+                    "queue_depth": snap["queue_depth"],
+                    # attainment is judged against the REQUESTED rate:
+                    # the pacer skips missed slots, so sender-side
+                    # "offered" self-throttles to whatever the ring
+                    # acks and would vacuously pass at any rate
+                    "attained": bool(dt > 0
+                                     and ing >= min_attain * rate * dt),
+                    "stream_acked_delta": (snap["stream"]["acked_total"]
+                                           - prev["stream"]["acked_total"]),
+                    "stream_stalls_delta": (
+                        snap["stream"]["window_stalls"]
+                        - prev["stream"]["window_stalls"]),
+                    "unacked_frames": snap["stream"]["unacked_frames"],
+                })
+                prev = snap
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+        send_s = prev["t"] - start["t"]
+        quiesced = self.quiesce()
+        end = self.snapshot()
+        ingested = end["ingested"] - start["ingested"]
+        offered = end["offered"] - start["offered"]
+        proxied = end["proxied"] - start["proxied"]
+        drops = end["drops"] - start["drops"]
+        received = end["received"] - start["received"]
+        delivered = proxied - 0  # proxied counts delivered fragments
+        duplicates = max(0, received - delivered)
+        conserved_exact = (quiesced and ingested == proxied + drops
+                           and self.proxy.conserved())
+        loss = (1.0 - received / ingested) if ingested > 0 else 1.0
+        attain = (ingested / (rate * send_s)
+                  if rate > 0 and send_s > 0 else 0.0)
+        n_att = sum(1 for i in intervals if i["attained"])
+        trial = {
+            "offered_metrics_per_s": rate,
+            "intervals": intervals,
+            "intervals_completed": len(intervals),
+            "offered_total": offered,
+            "ingested_total": ingested,
+            "proxied_total": proxied,
+            "drops_total": drops,
+            "received_total": received,
+            "duplicates_observed": duplicates,
+            "quiesced": quiesced,
+            "conservation_exact": conserved_exact,
+            "send_duration_s": round(send_s, 3),
+            "ring_metrics_per_s": round(received / send_s, 1)
+            if send_s > 0 else 0.0,
+            "loss_frac": round(max(0.0, loss), 5),
+            "attain_frac": round(attain, 4),
+            "attain_interval_frac": round(n_att / max(1, len(intervals)), 4),
+        }
+        trial["passed"] = bool(
+            quiesced and conserved_exact and duplicates == 0
+            and trial["loss_frac"] <= max_loss
+            and attain >= min_attain)
+        return trial
+
+    def stream_telemetry(self) -> dict:
+        snap = self.snapshot()
+        out = dict(snap["stream"])
+        out["coalesce"] = snap["coalesce"]
+        return out
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()
+        self.proxy.stop()
+        for srv, imp in self.globals_:
+            imp.stop(grace=0.2)
+            srv.shutdown()
+
+
+def search_ring_sustained(h: RingHarness, *, start_rate: float,
+                          max_rate: float, growth: float = 1.6,
+                          trial_intervals: int = 3,
+                          confirm_intervals: int = 6,
+                          bisect_steps: int = 4,
+                          max_loss: float = 0.005) -> dict:
+    """Bracket-then-bisect over offered metric rate, then confirm."""
+    trials = []
+    lo, hi = 0.0, 0.0
+    rate = start_rate
+
+    def run(r: float, n: int) -> dict:
+        t = h.run_trial(r, n, max_loss=max_loss)
+        print(json.dumps({
+            "trial": r, "ingested_per_s": round(
+                t["ingested_total"] / max(t["send_duration_s"], 1e-9), 1),
+            "ring_metrics_per_s": t["ring_metrics_per_s"],
+            "loss": t["loss_frac"], "attain": t["attain_frac"],
+            "dups": t["duplicates_observed"],
+            "passed": t["passed"]}), file=sys.stderr, flush=True)
+        return t
+
+    while rate <= max_rate:
+        t = run(rate, trial_intervals)
+        trials.append(t)
+        if t["passed"]:
+            lo = rate
+            rate *= growth
+        else:
+            hi = rate
+            break
+    if lo == 0.0:
+        hi = hi or start_rate
+        lo = hi * 0.25
+    if hi > 0.0:
+        for _ in range(bisect_steps):
+            mid = (lo + hi) / 2.0
+            if mid <= lo * 1.05:
+                break
+            t = run(mid, trial_intervals)
+            trials.append(t)
+            if t["passed"]:
+                lo = mid
+            else:
+                hi = mid
+    confirm = None
+    rate = lo
+    for _ in range(3):
+        confirm = run(rate, confirm_intervals)
+        if confirm["passed"]:
+            break
+        rate *= 0.9
+    return {
+        "search_trials": [
+            {k: t.get(k) for k in (
+                "offered_metrics_per_s", "ring_metrics_per_s",
+                "loss_frac", "attain_frac", "duplicates_observed",
+                "conservation_exact", "passed")}
+            for t in trials],
+        "confirm": confirm,
+        "sustained_offered_metrics_per_s": rate,
+        "sustained_ring_metrics_per_s":
+            confirm["ring_metrics_per_s"] if confirm else 0.0,
+        "confirmed": bool(confirm and confirm["passed"]),
+    }
+
+
+def _mode_result(h: RingHarness, search: dict) -> dict:
+    confirm = search.get("confirm") or {}
+    return {
+        "streaming": h.streaming,
+        "stream_window": h.window,
+        "sustained_ring_metrics_per_s":
+            search["sustained_ring_metrics_per_s"],
+        "sustained_offered_metrics_per_s":
+            search["sustained_offered_metrics_per_s"],
+        "confirmed": search["confirmed"],
+        "search_trials": search["search_trials"],
+        "confirm": confirm,
+        "duplicates_observed": confirm.get("duplicates_observed"),
+        "conservation_exact": confirm.get("conservation_exact"),
+        "stream": h.stream_telemetry(),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="single fixed-rate pass/fail run (CI lane)")
+    ap.add_argument("--rate", type=float, default=2e4,
+                    help="offered metrics/s for --smoke")
+    ap.add_argument("--intervals", type=int, default=0,
+                    help="measurement windows per trial (default: 3 "
+                         "smoke/bracket, 6 confirm)")
+    ap.add_argument("--interval-s", type=float, default=1.0,
+                    help="measurement window length")
+    ap.add_argument("--globals", type=int, default=3, dest="n_globals")
+    ap.add_argument("--senders", type=int, default=4,
+                    help="paced sender threads (each its own client)")
+    ap.add_argument("--batch", type=int, default=100,
+                    help="metrics per forward payload")
+    ap.add_argument("--series", type=int, default=2000,
+                    help="distinct counter series in the workload")
+    ap.add_argument("--window", type=int, default=32,
+                    help="stream ack window (streaming mode)")
+    ap.add_argument("--start-rate", type=float, default=2e4)
+    ap.add_argument("--max-rate", type=float, default=2e6)
+    ap.add_argument("--max-loss", type=float, default=0.005)
+    ap.add_argument("--mode", default="streaming",
+                    choices=["streaming", "unary"],
+                    help="forward mode for --smoke / single-mode search")
+    ap.add_argument("--ab", action="store_true",
+                    help="run the search in BOTH modes (unary first) on "
+                         "identical topologies; one artifact, headline "
+                         "from streaming, speedup recorded")
+    ap.add_argument("--out", default="RING_SUSTAINED.json")
+    args = ap.parse_args()
+    _reexec_scrubbed()
+
+    from _soak_common import write_artifact
+
+    try:
+        import jax
+        platform = jax.default_backend()
+    except Exception:
+        platform = "unknown"
+
+    def mk(streaming: bool) -> RingHarness:
+        return RingHarness(args.n_globals, args.senders, args.batch,
+                           args.series, streaming, args.window,
+                           interval_s=args.interval_s)
+
+    base = {
+        "platform": platform,
+        "globals": args.n_globals,
+        "senders": args.senders,
+        "batch_metrics": args.batch,
+        "series": args.series,
+        "stream_window": args.window,
+        "interval_s": args.interval_s,
+    }
+    t0 = time.time()
+
+    if args.smoke:
+        h = mk(args.mode == "streaming")
+        try:
+            trial = h.run_trial(args.rate, args.intervals or 3,
+                                max_loss=args.max_loss)
+            stream = h.stream_telemetry()
+        finally:
+            h.close()
+        engaged = (args.mode != "streaming"
+                   or (stream["acked_total"] > 0
+                       and stream["downgraded"] == 0))
+        payload = {
+            "metric": "ring_sustained_smoke_metrics_per_s",
+            "value": trial["ring_metrics_per_s"],
+            "unit": "metrics/s",
+            "mode": args.mode,
+            "offered": args.rate,
+            "loss_frac": trial["loss_frac"],
+            "attain_frac": trial["attain_frac"],
+            "duplicates_observed": trial["duplicates_observed"],
+            "conservation_exact": trial["conservation_exact"],
+            "stream_engaged": engaged,
+            "passed": bool(trial["passed"] and engaged),
+            "platform": platform,
+        }
+        print(json.dumps(payload))
+        if not payload["passed"]:
+            sys.exit(1)
+        return
+
+    modes: dict[str, dict] = {}
+    mode_list = ([("unary", False), ("streaming", True)] if args.ab
+                 else [(args.mode, args.mode == "streaming")])
+    for name, streaming in mode_list:
+        h = mk(streaming)
+        try:
+            search = search_ring_sustained(
+                h, start_rate=args.start_rate, max_rate=args.max_rate,
+                trial_intervals=args.intervals or 3,
+                confirm_intervals=(args.intervals or 6),
+                max_loss=args.max_loss)
+            modes[name] = _mode_result(h, search)
+        finally:
+            h.close()
+
+    head_name = mode_list[-1][0]
+    head = modes[head_name]
+    out = {
+        "schema": "ring_sustained_v1",
+        **base,
+        "modes": modes,
+        "sustained_ring_metrics_per_s":
+            head["sustained_ring_metrics_per_s"],
+        "confirmed": head["confirmed"],
+        "duplicates_observed": head["duplicates_observed"],
+        "conservation_exact": head["conservation_exact"],
+        "wall_s": round(time.time() - t0, 1),
+    }
+    checks = {
+        "confirmed": bool(head["confirmed"]),
+        "duplicates_zero": head["duplicates_observed"] == 0,
+        "conservation_exact": bool(head["conservation_exact"]),
+    }
+    if "streaming" in modes:
+        st = modes["streaming"]["stream"]
+        checks["stream_engaged"] = (st["acked_total"] > 0
+                                    and st["downgraded"] == 0)
+        checks["coalescing_engaged"] = (
+            st["coalesce"]["coalesced_frames"] > 0)
+    if args.ab:
+        u = modes["unary"]["sustained_ring_metrics_per_s"]
+        s = modes["streaming"]["sustained_ring_metrics_per_s"]
+        out["unary_metrics_per_s"] = u
+        out["speedup_vs_unary"] = round(s / u, 3) if u > 0 else None
+        checks["unary_confirmed"] = bool(modes["unary"]["confirmed"])
+        checks["unary_duplicates_zero"] = (
+            modes["unary"]["duplicates_observed"] == 0)
+        checks["streaming_ge_unary"] = s >= u
+        out["streaming_ge_unary"] = checks["streaming_ge_unary"]
+    failures = sorted(k for k, ok in checks.items() if not ok)
+    out["checks"] = checks
+    out["failures"] = failures
+    write_artifact(args.out, out)
+    summary = {
+        "metric": "sustained_ring_metrics_per_s",
+        "value": out["sustained_ring_metrics_per_s"],
+        "unit": "metrics/s",
+        "confirmed": out["confirmed"],
+        "duplicates_observed": out["duplicates_observed"],
+        "platform": platform,
+    }
+    if args.ab:
+        summary["unary_metrics_per_s"] = out["unary_metrics_per_s"]
+        summary["speedup_vs_unary"] = out["speedup_vs_unary"]
+        summary["streaming_ge_unary"] = out["streaming_ge_unary"]
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -212,6 +212,49 @@ print(f"duplicates==0 gate: OK ({a['duplicates_injected']} injected, "
       f"{a['receiver_replays_absorbed']} absorbed)")
 PYGATE
 
+# Ring-sustained smoke: the whole-ring harness (paced senders → proxy
+# → 3 globals over real gRPC, tools/bench_ring_sustained.py) at a
+# fixed offered rate on the streaming forward path. Gates the PR 15
+# transport end to end: frames pipelined under the ack window,
+# server-side coalescing engaged, exact ring conservation
+# (ingested == proxied + drops at quiescence) and duplicates == 0 at a
+# rate (15k metrics/s) well under the rig's measured A/B cliff so
+# host noise never flakes the lane. Artifact goes to /tmp — the
+# committed RING_SUSTAINED.json is the full --ab search, gated below.
+echo "== ring-sustained smoke (streaming forward path) =="
+timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/bench_ring_sustained.py --smoke --mode streaming \
+    --rate 15000 --out "${TMPDIR:-/tmp}/RING_SUSTAINED_SMOKE.json"
+
+# Committed-artifact gates: the repo-root soak/bench artifacts are the
+# full runs' evidence — re-parse them so a regeneration that silently
+# lost the exactly-once or streaming-wins property fails CI even if
+# nobody reran the quick lanes' miniature twins.
+python - <<'PYGATE'
+import json
+a = json.load(open("RING_CHURN_SOAK.json"))
+assert a["duplicates_observed"] == 0, \
+    f"committed churn soak: duplicates {a['duplicates_observed']}"
+assert a["checks"]["streaming_engaged"], \
+    "committed churn soak: streaming never engaged"
+b = json.load(open("AUTOSCALE_SOAK.json"))
+assert b["duplicates_observed"] == 0, \
+    f"committed autoscale soak: duplicates {b['duplicates_observed']}"
+assert b["checks"]["streaming_engaged"], \
+    "committed autoscale soak: streaming never engaged"
+r = json.load(open("RING_SUSTAINED.json"))
+assert not r["failures"], f"committed ring A/B failed: {r['failures']}"
+assert r["checks"]["streaming_ge_unary"], \
+    "committed ring A/B: streaming slower than unary"
+for mode, m in r["modes"].items():
+    assert m["duplicates_observed"] == 0, \
+        f"committed ring A/B: {mode} duplicates"
+print("committed-artifact gates: OK (churn dup=0, autoscale dup=0, "
+      f"ring streaming {r['sustained_ring_metrics_per_s']}/s >= "
+      f"unary {r['modes']['unary']['sustained_ring_metrics_per_s']}/s)")
+PYGATE
+
 # Sustained-rate floor: the loadgen harness drives a live server's UDP
 # socket at a fixed offered rate for 5 flush intervals and fails on
 # loss or broken flush cadence. 50k lines/s with the pipelined flush

@@ -119,26 +119,26 @@ def main() -> None:
         # member-side capacity throttle: merge serialized under a
         # per-member lock, the response delayed by merged/capacity —
         # receipt is genuinely capacity-bound, so overload manifests as
-        # deadline-clipped sends and spill, never as lost merges. A
-        # dedup-absorbed replay costs ~nothing (a window lookup, not a
-        # merge), so clipped-but-landed fragments confirm fast on
-        # re-send instead of re-paying the merge they already did.
+        # deadline-clipped sends and spill, never as lost merges. The
+        # shadow sits on _apply_wire — the merge entrypoint BOTH paths
+        # funnel into (unary handle_wire and the stream coalescer's
+        # batched flush) — so streamed frames are throttled identically;
+        # dedup hits never reach _apply_wire, so a dedup-absorbed replay
+        # still costs ~nothing (a window lookup, not a merge) and
+        # clipped-but-landed fragments confirm fast on re-send.
         # Instance-attr shadowing installed BEFORE start_grpc so the
         # listener (and every restart) binds the wrapper.
-        orig = imp.handle_wire
+        orig = imp._apply_wire
         lock = threading.Lock()
 
-        def throttled(blob: bytes, _orig=orig, _lock=lock,
-                      _imp=imp) -> int:
+        def throttled(blob: bytes, _orig=orig, _lock=lock) -> int:
             with _lock:
-                before = _imp.metrics_deduped
                 n = _orig(blob)
-                merged = n - (_imp.metrics_deduped - before)
-                if merged > 0:
-                    time.sleep(merged / capacity_per_s)
+                if n > 0:
+                    time.sleep(n / capacity_per_s)
                 return n
 
-        imp.handle_wire = throttled
+        imp._apply_wire = throttled
         imp.start_grpc()
         globals_.append((srv, imp))
 
@@ -157,8 +157,12 @@ def main() -> None:
 
     def client_factory(dest: str, timeout_s: float,
                        idle_timeout_s: float) -> FaultyForwardClient:
+        # PR 15: streaming forward hop. A deadline-clipped ack leaves
+        # the stream UP by design (slow member != dead transport), so
+        # this stays consistent with the rebuild suppression below.
         inner = rpc.ForwardClient(dest, timeout_s,
-                                  idle_timeout_s=idle_timeout_s)
+                                  idle_timeout_s=idle_timeout_s,
+                                  streaming=True)
         # the wedged-channel rebuild heuristic (2 consecutive clips ->
         # rebuild, aborting concurrent in-flight sends as permanent
         # "send" failures) misfires here: these members are healthy but
@@ -203,7 +207,7 @@ def main() -> None:
                         routing_workers=4, routing_queue_max=256,
                         handoff_window_s=3.0,
                         client_factory=client_factory,
-                        journal=journal, dedup=True)
+                        journal=journal, dedup=True, streaming=True)
     pport = proxy.start_grpc()
 
     # -- the elastic loop, end to end real: file -> gate -> ring, and
@@ -230,7 +234,8 @@ def main() -> None:
         watcher, psource,
         hysteresis_k=hysteresis_k, cooldown_s=cooldown_s,
         min_members=2, max_members=4,
-        drained_fn=proxy.destination_idle, retire_fn=retire)
+        drained_fn=proxy.destination_idle, retire_fn=retire,
+        member_load_fn=psource.member_load)
 
     lcfg = Config(interval="10s", percentiles=pcts, aggregates=aggs,
                   forward_address=f"127.0.0.1:{pport}",
@@ -255,9 +260,14 @@ def main() -> None:
     sent_metrics = 0
     ticks = []
     tick_no = 0
+    # per-tick stream telemetry deltas (satellite: soak artifacts carry
+    # the streaming evidence, not just final totals). Deltas clamp at 0:
+    # reshard/quarantine retire clients, so the aggregate can step down.
+    prev_stream = proxy.forward_stats()["stream"]
 
     def run_tick(phase: str, factor: float, use_controller: bool) -> dict:
-        nonlocal sent_counter_value, sent_histo_count, sent_metrics, tick_no
+        nonlocal sent_counter_value, sent_histo_count, sent_metrics, \
+            tick_no, prev_stream
         t0 = time.perf_counter()
         nh, nc = int(s_histo * factor), int(s_counter * factor)
         lines = []
@@ -286,6 +296,7 @@ def main() -> None:
             time.sleep(remaining)
         action = controller.tick() if use_controller else None
         refresher.refresh()
+        cur_stream = proxy.forward_stats()["stream"]
         rec = {
             "tick": tick_no, "phase": phase, "offered": nh + nc,
             "sent_cum": sent_metrics, "received_cum": received_total(),
@@ -294,7 +305,17 @@ def main() -> None:
             "spilled": proxy.spilled_metrics,
             "action": action,
             "reasons": list(controller.last_reasons),
+            "stream": {
+                "acked_delta": max(0, cur_stream["acked_total"]
+                                   - prev_stream["acked_total"]),
+                "reconnects_delta": max(0, cur_stream["reconnects"]
+                                        - prev_stream["reconnects"]),
+                "window_stalls_delta": max(0, cur_stream["window_stalls"]
+                                           - prev_stream["window_stalls"]),
+                "unacked_frames": cur_stream["unacked_frames"],
+            },
         }
+        prev_stream = cur_stream
         ticks.append(rec)
         if action or not rec["caught_up"] or tick_no % 5 == 0:
             print(json.dumps(rec), file=sys.stderr, flush=True)
@@ -471,6 +492,16 @@ def main() -> None:
                        and readmitted_at is not None),
         "probe_failures_counted": gs["probe_failures"] >= 1,
     }
+    # streaming evidence: frames really rode the stream channel (acks
+    # accumulated across ticks and no destination fell back to unary)
+    stream_final = stats["stream"]
+    stream_frames = sum(
+        (imp.stats().get("stream") or {}).get("frames", 0)
+        for _, imp in globals_)
+    checks["streaming_engaged"] = (
+        sum(t["stream"]["acked_delta"] for t in ticks) >= 1
+        and stream_final["downgraded"] == 0)
+    checks["stream_tail_drained"] = stream_final["unacked_frames"] == 0
     failures = sorted(k for k, ok in checks.items() if not ok)
 
     out = {
@@ -499,6 +530,7 @@ def main() -> None:
             "hits": dedup_hits,
             "evictions": dedup_evictions,
         },
+        "stream": {**stream_final, "import_frames": stream_frames},
         "controller": cs,
         "controller_events": controller.events,
         "controller_paused_in_p4": True,

@@ -126,8 +126,12 @@ def main() -> None:
 
     def client_factory(dest: str, timeout_s: float,
                        idle_timeout_s: float) -> FaultyForwardClient:
+        # PR 15: the proxy->global hop rides the long-lived streaming
+        # channel; the fault wrapper is transparent to it (faults gate
+        # BEFORE dispatch, duplicates re-send the same dedup envelope)
         inner = rpc.ForwardClient(dest, timeout_s,
-                                  idle_timeout_s=idle_timeout_s)
+                                  idle_timeout_s=idle_timeout_s,
+                                  streaming=True)
         plan = FaultPlan(seed=args.seed + sum(dest.encode()),
                          p_refuse=0.04, p_slow=0.04, slow_s=0.03,
                          p_duplicate=0.08 if dedup else 0.0)
@@ -153,7 +157,7 @@ def main() -> None:
     proxy = ProxyServer([addr(i) for i in initial], timeout_s=2.0,
                         delivery=policy, handoff_window_s=0.5,
                         client_factory=client_factory,
-                        journal=journal, dedup=dedup)
+                        journal=journal, dedup=dedup, streaming=True)
     pport = proxy.start_grpc()
 
     disc = StaticDiscoverer([addr(i) for i in initial])
@@ -197,6 +201,10 @@ def main() -> None:
 
     victim_addr = addr(victim)
     interval_receipts = []
+    # per-interval stream telemetry deltas (satellite: soak artifacts
+    # must carry the streaming evidence, not just the final totals)
+    interval_stream = []
+    prev_stream = proxy.forward_stats()["stream"]
     for it in range(intervals):
         if it == fail_flap_at:
             disc.fail_next(1)
@@ -293,6 +301,19 @@ def main() -> None:
                 break
             time.sleep(0.02)
         interval_receipts.append(received_total() - before)
+        cur_stream = proxy.forward_stats()["stream"]
+        # deltas clamp at 0: a reshard retires clients, and the
+        # aggregate (a sum over CURRENT clients) can step down with them
+        interval_stream.append({
+            "acked_delta": max(0, cur_stream["acked_total"]
+                               - prev_stream["acked_total"]),
+            "reconnects_delta": max(0, cur_stream["reconnects"]
+                                    - prev_stream["reconnects"]),
+            "window_stalls_delta": max(0, cur_stream["window_stalls"]
+                                       - prev_stream["window_stalls"]),
+            "unacked_frames": cur_stream["unacked_frames"],
+        })
+        prev_stream = cur_stream
 
     # -- settling: heal everything, then drain until the tier is empty
     for fc in fault_clients.values():
@@ -362,6 +383,16 @@ def main() -> None:
         "refresh_empty_flap_seen": refresher.refresh_empty >= 1,
         "ledgers_conserved": proxy.conserved(),
     }
+    # streaming evidence: frames really rode the stream channel (acks
+    # landed) and nothing silently downgraded to unary mid-soak
+    stream_final = stats["stream"]
+    stream_frames = sum(
+        (imp.stats().get("stream") or {}).get("frames", 0)
+        for _, imp in globals_)
+    checks["streaming_engaged"] = (
+        sum(iv["acked_delta"] for iv in interval_stream) >= 1
+        and stream_final["downgraded"] == 0)
+    checks["stream_tail_drained"] = stream_final["unacked_frames"] == 0
     if dedup:
         # duplicates must have been provably injected AND absorbed, or
         # duplicates_zero is vacuous
@@ -400,6 +431,8 @@ def main() -> None:
                                 for _, imp in globals_),
         },
         "handoff": stats["handoff"],
+        "stream": {**stream_final, "import_frames": stream_frames},
+        "interval_stream": interval_stream,
         "victim_breaker_transitions": transitions,
         "proxy": {k: stats[k] for k in (
             "proxied_metrics", "drops", "spilled_metrics", "shed_metrics",

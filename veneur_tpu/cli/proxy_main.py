@@ -109,7 +109,9 @@ def main(argv=None) -> int:
                         routing_queue_max=cfg.routing_queue_max,
                         handoff_window_s=cfg.handoff_window_s,
                         journal=journal,
-                        dedup=cfg.forward_dedup)
+                        dedup=cfg.forward_dedup,
+                        streaming=cfg.forward_streaming,
+                        stream_window=cfg.forward_stream_window)
     if journal is not None:
         # re-route the previous incarnation's durable spill under the
         # current ring before accepting fresh traffic
@@ -180,13 +182,15 @@ def main(argv=None) -> int:
             proxy, watcher, "",
             parse_duration(cfg.consul_refresh_interval), gate=gate)
         if cfg.elastic_autoscale:
+            psource = ProxyPressureSource(proxy)
             controller = ElasticController(
-                watcher, ProxyPressureSource(proxy),
+                watcher, psource,
                 hysteresis_k=cfg.elastic_hysteresis_intervals,
                 cooldown_s=cfg.elastic_cooldown_s,
                 min_members=cfg.elastic_min_members,
                 max_members=cfg.elastic_max_members,
-                drained_fn=proxy.destination_idle)
+                drained_fn=proxy.destination_idle,
+                member_load_fn=psource.member_load)
     elif forward_service:
         from veneur_tpu.distributed.discovery import ConsulDiscoverer
 

@@ -204,6 +204,14 @@ class Config:
     forward_dedup: bool = True
     forward_dedup_window_ids: int = 65536
     forward_dedup_window_bytes: int = 8 << 20
+    # streaming forwards: ride one long-lived StreamMetrics channel to
+    # the upstream instead of a unary call per flush payload, with at
+    # most forward_stream_window unacked frames in flight (client
+    # buffer ≈ window × flush payload bytes). An old upstream answers
+    # UNIMPLEMENTED once and the client downgrades to unary for the
+    # connection's lifetime, so mixed fleets interop either way.
+    forward_streaming: bool = True
+    forward_stream_window: int = 32
     # set-element hash: "fnv" (this framework's own, utils/hashing.hll_hash)
     # or "metro" (metro64 seed=1337, what the Go fleet inserts with —
     # REQUIRED on any instance that shares set series with Go veneur
@@ -504,6 +512,14 @@ class ProxyConfig:
     forward_dedup: bool = True
     forward_dedup_window_ids: int = 65536
     forward_dedup_window_bytes: int = 8 << 20
+    # streaming forwards (the PR-15 hop): one long-lived StreamMetrics
+    # channel per destination with a bounded in-flight ack window
+    # replacing a unary call per fragment. A frame is delivered only on
+    # its ack, so retry/breaker/spill and the dedup keys behave exactly
+    # as on the unary path; old destinations downgrade the client to
+    # unary via UNIMPLEMENTED. Escape hatch: VENEUR_FORWARD_STREAMING=0.
+    forward_streaming: bool = True
+    forward_stream_window: int = 32
     # forward-path delivery guarantees (the PR-5 sink delivery layer
     # applied per destination; sinks/delivery.py DeliveryPolicy):
     # bounded retry on transient failures, per-destination circuit
@@ -625,6 +641,14 @@ def _validate_dedup_keys(cfg) -> None:
                          " forward_dedup: false to disable dedup)")
 
 
+def _validate_stream_keys(cfg) -> None:
+    """Shared streaming-forward validation (Config and ProxyConfig carry
+    the same forward_streaming/forward_stream_window knobs)."""
+    if cfg.forward_stream_window < 1:
+        raise ValueError("forward_stream_window must be >= 1 (set"
+                         " forward_streaming: false to disable streaming)")
+
+
 def _validate_elastic_keys(cfg) -> None:
     if cfg.elastic_probe_timeout_s <= 0:
         raise ValueError("elastic_probe_timeout_s must be positive")
@@ -670,6 +694,7 @@ def validate_proxy_config(cfg: ProxyConfig) -> None:
                          " the reshard drain AND paces the drain thread)")
     _validate_journal_keys(cfg)
     _validate_dedup_keys(cfg)
+    _validate_stream_keys(cfg)
     _validate_elastic_keys(cfg)
     if cfg.routing_pool_workers < 1:
         raise ValueError("routing_pool_workers must be >= 1")
@@ -927,6 +952,7 @@ def validate_config(cfg: Config) -> None:
                          " payloads instead of spilling them)")
     _validate_journal_keys(cfg)
     _validate_dedup_keys(cfg)
+    _validate_stream_keys(cfg)
     if cfg.config_reload_s < 0:
         raise ValueError("config_reload_s must be >= 0 (0 disables the"
                          " config hot-reload watcher)")

@@ -255,6 +255,62 @@ def decode_dedup_envelope(
     return key, blob[off + hlen:]
 
 
+# ------------------------------------------------------------- stream
+#
+# Framing for the long-lived StreamMetrics channel (reference
+# forwardrpc SendMetricsV2 client-streaming + importsrv server-side
+# batching).  gRPC already length-delimits messages, so a frame is one
+# gRPC message: a versioned magic, a u64 LE sequence number minted by
+# the sender, then the exact bytes a unary SendMetrics would have
+# carried (a VDE1 dedup envelope or a bare MetricBatch).  Acks flow
+# the other way as (u64 LE seq, u8 status) — a frame is "delivered"
+# only when its ack arrives, which is what lets the DeliveryManager's
+# retry/breaker/spill semantics and the dedup keys survive unchanged.
+
+STREAM_FRAME_MAGIC = b"VSF1"  # 'V'-leading, versioned, like VDE1
+STREAM_ACK_OK = 0
+STREAM_ACK_FAILED = 1  # receiver could not merge this frame (permanent)
+STREAM_ACK_BUSY = 2    # receiver full, frame NOT taken (transient: the
+#                        sender retries under the same dedup key — this
+#                        is how streamed ingest backpressure reaches the
+#                        delivery layer instead of shedding server-side)
+
+_SEQ_OFF = len(STREAM_FRAME_MAGIC)
+_BODY_OFF = _SEQ_OFF + 8
+
+
+def encode_stream_frame(seq: int, body: bytes) -> bytes:
+    """One stream frame: magic + u64 LE seq + unary-shaped body."""
+    return STREAM_FRAME_MAGIC + int(seq).to_bytes(8, "little") + body
+
+
+def decode_stream_frame(blob: bytes) -> tuple[int, bytes]:
+    """Split a stream frame into (seq, body); ValueError on garbage."""
+    if not blob.startswith(STREAM_FRAME_MAGIC) or len(blob) < _BODY_OFF:
+        raise ValueError("bad stream frame")
+    return (int.from_bytes(blob[_SEQ_OFF:_BODY_OFF], "little"),
+            blob[_BODY_OFF:])
+
+
+def encode_stream_ack(seq: int, ok=True) -> bytes:
+    """Ack one frame. `ok` is a bool (True/False -> OK/FAILED, the
+    common sink-callback shape) or an explicit STREAM_ACK_* status."""
+    if ok is True:
+        status = STREAM_ACK_OK
+    elif ok is False:
+        status = STREAM_ACK_FAILED
+    else:
+        status = int(ok)
+    return int(seq).to_bytes(8, "little") + bytes((status,))
+
+
+def decode_stream_ack(blob: bytes) -> tuple[int, int]:
+    """Split an ack into (seq, STREAM_ACK_* status)."""
+    if len(blob) != 9:
+        raise ValueError("bad stream ack")
+    return int.from_bytes(blob[:8], "little"), blob[8]
+
+
 def metric_key(m: pb.Metric) -> MetricKey:
     return MetricKey(
         name=m.name,

@@ -210,15 +210,40 @@ class ProxyPressureSource:
         self.proxy = proxy
         self._last_shed = 0
         self._last_deferred = 0
+        # per-member cumulative marks for the load attribution below
+        self._member_marks: dict[str, float] = {}
+        self._member_load: dict[str, float] = {}
 
     def __call__(self) -> dict:
         fs = self.proxy.forward_stats()
         shed = fs["routing"]["shed_batches"]
         deferred = 0
-        for dest_stats in fs["destinations"].values():
+        member_load: dict[str, float] = {}
+        marks: dict[str, float] = {}
+        for dest, dest_stats in fs["destinations"].items():
             delivery = dest_stats.get("delivery")
             if delivery:
                 deferred += delivery.get("deferred_payloads", 0)
+            # per-member load attribution for coldest-member scale-in:
+            # traffic delivered toward the member this interval (client
+            # sent_metrics + delivered payloads, cumulative → delta,
+            # clamped because a quarantine cycle recreates the client)
+            # plus what is CURRENTLY parked or unacked toward it — a
+            # member with pending work is not cold even if its interval
+            # delta was.
+            mark = float(dest_stats.get("sent_metrics", 0))
+            if delivery:
+                mark += float(delivery.get("delivered_payloads", 0))
+            marks[dest] = mark
+            load = max(0.0, mark - self._member_marks.get(dest, 0.0))
+            if delivery:
+                load += float(delivery.get("spilled_payloads", 0))
+            stream = dest_stats.get("stream")
+            if stream:
+                load += float(stream.get("unacked_frames", 0))
+            member_load[dest] = load
+        self._member_marks = marks
+        self._member_load = member_load
         signals = {
             "routing_shed_delta": shed - self._last_shed,
             "routing_queue_depth": fs["routing"]["queue_depth"],
@@ -230,6 +255,13 @@ class ProxyPressureSource:
         self._last_deferred = deferred
         return signals
 
+    def member_load(self) -> dict[str, float]:
+        """Per-destination load attribution from the most recent
+        observation (stream/delivery deltas + pending work), for the
+        controller's coldest-member scale-in. A member with no entry
+        never received routed traffic — genuinely cold (0.0)."""
+        return dict(self._member_load)
+
 
 class ElasticController:
     """Hysteresis + cooldown autoscale loop over a writable discovery
@@ -237,10 +269,16 @@ class ElasticController:
     `write_members(members, standby)`).
 
     Scale-out promotes the first standby member into the member list;
-    scale-in removes the most-recently-added member (LIFO — the member
-    whose series moved last moves again, everyone else's arcs stay
-    put), writes the shrunk set back FIRST so the member leaves every
-    consumer's ring, then tracks it as draining: each tick, a draining
+    scale-in removes the COLDEST member when per-member load
+    attribution is wired (member_load_fn, fed by ProxyPressureSource.
+    member_load's stream/delivery deltas) — evicting the member with
+    the least pending+delivered work minimizes both the series that
+    reshard and the unacked tail the handoff drain must re-home. With
+    no attribution (or on ties) it falls back to the most-recently-
+    added member (LIFO — the member whose series moved last moves
+    again, everyone else's arcs stay put). Either way it writes the
+    shrunk set back FIRST so the member leaves every consumer's ring,
+    then tracks it as draining: each tick, a draining
     member that `drained_fn` reports idle (ProxyServer.destination_idle
     — out of ring, no inflight, spill empty) is retired via `retire_fn`
     and appended back to standby. Streaks reset on every action and on
@@ -256,9 +294,12 @@ class ElasticController:
                  max_members: int = 0,
                  drained_fn: Optional[Callable[[str], bool]] = None,
                  retire_fn: Optional[Callable[[str], None]] = None,
+                 member_load_fn: Optional[
+                     Callable[[], dict[str, float]]] = None,
                  time_fn: Callable[[], float] = time.monotonic) -> None:
         self.source = source
         self.pressure_fn = pressure_fn
+        self.member_load_fn = member_load_fn
         self.hysteresis_k = max(1, int(hysteresis_k))
         self.cooldown_s = float(cooldown_s)
         self.min_members = max(1, int(min_members))
@@ -349,20 +390,43 @@ class ElasticController:
             log.info("elastic: scale-out promoted %s (%s); members=%d",
                      promoted, ",".join(reasons), len(members) + 1)
         else:
-            victim = members[-1]
+            victim, victim_load = self._pick_scale_in_victim(members)
             # leave the ring first; retirement waits for the drain
-            self.source.write_members(members[:-1], standby)
+            self.source.write_members(
+                [m for m in members if m != victim], standby)
             self._draining.append(victim)
             self.scale_in_total += 1
             self._record("scale_in", member=victim,
-                         members=len(members) - 1)
-            log.info("elastic: scale-in removed %s (draining);"
-                     " members=%d", victim, len(members) - 1)
+                         members=len(members) - 1, load=victim_load)
+            log.info("elastic: scale-in removed %s (coldest, load=%s,"
+                     " draining); members=%d", victim, victim_load,
+                     len(members) - 1)
 
         self._cooldown_until = now + self.cooldown_s
         self._pressured_streak = 0
         self._calm_streak = 0
         return decision
+
+    def _pick_scale_in_victim(
+            self, members: list[str]) -> tuple[str, Optional[float]]:
+        """Coldest member by per-destination load attribution
+        (ProxyPressureSource.member_load: stream/delivery deltas plus
+        pending work). Ties — including every load equal, and the
+        no-data fallback when member_load_fn is unset or fails — break
+        toward the most recently added member (the old LIFO behavior:
+        the member whose series moved last moves again, everyone
+        else's arcs stay put)."""
+        if self.member_load_fn is None:
+            return members[-1], None
+        try:
+            loads = self.member_load_fn() or {}
+        except Exception:  # noqa: BLE001 — stats must never block scaling
+            log.exception("member_load_fn failed; falling back to LIFO")
+            return members[-1], None
+        victim = min(
+            reversed(members),
+            key=lambda dest: loads.get(dest, 0.0))
+        return victim, loads.get(victim, 0.0)
 
     def draining(self) -> list[str]:
         return list(self._draining)

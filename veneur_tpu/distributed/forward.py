@@ -45,8 +45,14 @@ def _report_forward(stats, n_metrics: int, started: float,
 class GRPCForwarder:
     def __init__(self, address: str, timeout_s: float = 10.0,
                  compression: float = 100.0, hll_precision: int = 14,
-                 stats=None) -> None:
-        self.client = ForwardClient(address, timeout_s)
+                 stats=None, streaming: bool = False,
+                 stream_window: int = 32) -> None:
+        # streaming rides the long-lived StreamMetrics channel (one
+        # flush payload per frame); an old upstream downgrades the
+        # client back to unary on its first UNIMPLEMENTED
+        self.client = ForwardClient(address, timeout_s,
+                                    streaming=streaming,
+                                    stream_window=stream_window)
         self.compression = compression
         self.hll_precision = hll_precision
         self.stats = stats
@@ -182,7 +188,10 @@ def install_forwarder(server, compression: Optional[float] = None,
         else:
             server.forwarder = GRPCForwarder(
                 addr, timeout, compression, hll_precision,
-                stats=getattr(server, "stats", None))
+                stats=getattr(server, "stats", None),
+                streaming=bool(getattr(cfg, "forward_streaming", False)),
+                stream_window=int(
+                    getattr(cfg, "forward_stream_window", 32)))
     else:
         server.forwarder = HTTPForwarder(
             cfg.forward_address, timeout, compression, hll_precision,

@@ -179,6 +179,7 @@ class RoutingPool:
         self.routed = 0
         self.shed_batches = 0
         self.consecutive_sheds = 0
+        self.admission_timeouts = 0  # stream frames busy-acked back
         self._threads = []
         for i in range(self.workers):
             t = threading.Thread(target=self._work, daemon=True,
@@ -203,6 +204,23 @@ class RoutingPool:
             self.shed_batches += 1
             self.consecutive_sheds += 1
         return False
+
+    def submit_wait(self, kind: str, item: object,
+                    timeout_s: float) -> bool:
+        """Blocking admission for streamed ingest: wait for queue space
+        instead of shedding. False means NOT ADMITTED — the caller
+        still owns the payload (nothing was dropped here), and reports
+        that upstream so the sender's delivery layer retries it."""
+        try:
+            self._q.put((kind, item), timeout=timeout_s)
+        except queue.Full:
+            with self._lock:
+                self.admission_timeouts += 1
+            return False
+        with self._lock:
+            self.submitted += 1
+            self.consecutive_sheds = 0
+        return True
 
     def behind(self) -> bool:
         """The downstream-behind signal: sustained shedding, gated the
@@ -234,6 +252,7 @@ class RoutingPool:
                 "routed": self.routed,
                 "shed_batches": self.shed_batches,
                 "consecutive_sheds": self.consecutive_sheds,
+                "admission_timeouts": self.admission_timeouts,
             }
 
     def stop(self) -> None:
@@ -244,6 +263,31 @@ class RoutingPool:
                 break
         for t in self._threads:
             t.join(timeout=2.0)
+
+
+class _StreamAdmissionSink:
+    """Streamed-ingest admission: a frame is acked only once its payload
+    is ADMITTED to the routing queue. A full queue delays the ack — the
+    sender's in-flight window absorbs the wait, which is the
+    backpressure a paced unary caller gets for free by blocking on its
+    RPC — and an admission timeout busy-acks the frame back (the sender
+    retries it under the same dedup key). Streamed overload therefore
+    degrades into sender-side throttling, never into a server-side shed
+    of payloads the sender already counts as in flight."""
+
+    ADMIT_TIMEOUT_S = 1.0
+
+    def __init__(self, proxy: "ProxyServer") -> None:
+        self._proxy = proxy
+
+    def submit(self, body: bytes, done) -> None:
+        from veneur_tpu.distributed import codec as _codec
+
+        if self._proxy._pool.submit_wait(
+                "wire", body, self.ADMIT_TIMEOUT_S):
+            done(True)
+        else:
+            done(_codec.STREAM_ACK_BUSY)
 
 
 class ProxyServer:
@@ -262,8 +306,17 @@ class ProxyServer:
                  client_factory: Optional[Callable] = None,
                  journal=None,
                  dedup: bool = False,
-                 dedup_sender: Optional[str] = None) -> None:
+                 dedup_sender: Optional[str] = None,
+                 streaming: bool = False,
+                 stream_window: int = 32) -> None:
         self.ring = ConsistentRing(destinations or [])
+        # long-lived StreamMetrics channel per destination instead of a
+        # unary call per fragment. Default OFF at this layer (like
+        # dedup) so the config wires it deliberately; a frame is
+        # delivered only on its ack, so the delivery-manager contract
+        # is identical either way.
+        self.streaming = bool(streaming)
+        self.stream_window = max(1, int(stream_window))
         # exactly-once forwards: when on, every fragment carries a
         # wire-level idempotency key (versioned envelope, codec.py) the
         # import tier dedups on. Default OFF at this layer so the config
@@ -411,7 +464,9 @@ class ProxyServer:
                 else:
                     client = rpc.ForwardClient(
                         dest, self.timeout_s,
-                        idle_timeout_s=self.idle_timeout_s)
+                        idle_timeout_s=self.idle_timeout_s,
+                        streaming=self.streaming,
+                        stream_window=self.stream_window)
                 self._conns[dest] = client
                 while (self.max_idle_conns > 0
                        and len(self._conns) > self.max_idle_conns):
@@ -896,10 +951,27 @@ class ProxyServer:
                         self.dedup_remint_after_attempt,
                 },
             }
+        # stream-level telemetry aggregated across destinations (each
+        # client's block also rides under destinations.<addr>.stream)
+        stream_tot = {"opened": 0, "reconnects": 0, "acked_total": 0,
+                      "window_stalls": 0, "unacked_frames": 0,
+                      "downgraded": 0}
+        for d in per_dest.values():
+            s = d.get("stream")
+            if not s:
+                continue
+            for k in ("opened", "reconnects", "acked_total",
+                      "window_stalls", "unacked_frames"):
+                stream_tot[k] += s.get(k, 0)
+            if s.get("downgraded"):
+                stream_tot["downgraded"] += 1
+        stream_tot["enabled"] = self.streaming
+        stream_tot["window"] = self.stream_window
         out.update({
             "ring_version": self.ring.version,
             "ring_members": len(self.ring),
             "destinations": per_dest,
+            "stream": stream_tot,
             "reconnects_total": sum(
                 d.get("reconnects", 0) for d in per_dest.values()),
             "errors_total": {
@@ -930,7 +1002,8 @@ class ProxyServer:
 
     def start_grpc(self, address: str = "127.0.0.1:0") -> int:
         self.grpc_server, self.port = rpc.make_server(
-            self.handle_batch, address, raw_handler=self.handle_wire)
+            self.handle_batch, address, raw_handler=self.handle_wire,
+            stream_sink=_StreamAdmissionSink(self))
         return self.port
 
     def stop(self) -> None:
@@ -1293,7 +1366,8 @@ class ProxyRuntimeReporter:
         self.trace_proxy = trace_proxy
         self.interval_s = interval_s
         self._stop = threading.Event()
-        self._last = {"proxied": 0, "drops": 0, "spans": 0}
+        self._last = {"proxied": 0, "drops": 0, "spans": 0,
+                      "acked": 0, "reconnects": 0, "stalls": 0}
 
     def report_once(self) -> None:
         from veneur_tpu.utils.proc import current_rss_bytes
@@ -1309,6 +1383,25 @@ class ProxyRuntimeReporter:
         self.stats.gauge("ring.version", float(self.proxy.ring.version))
         self.stats.gauge("spilled_metrics",
                          float(self.proxy.spilled_metrics))
+        stream = self.proxy.forward_stats()["stream"]
+        if stream["enabled"]:
+            # deltas clamp at 0: reshards retire clients, so the
+            # aggregate can step down between reports
+            self.stats.count(
+                "stream.acked",
+                max(0, stream["acked_total"] - self._last["acked"]))
+            self.stats.count(
+                "stream.reconnects",
+                max(0, stream["reconnects"] - self._last["reconnects"]))
+            self.stats.count(
+                "stream.window_stalls",
+                max(0, stream["window_stalls"] - self._last["stalls"]))
+            self._last["acked"] = stream["acked_total"]
+            self._last["reconnects"] = stream["reconnects"]
+            self._last["stalls"] = stream["window_stalls"]
+            self.stats.gauge("stream.unacked_frames",
+                             float(stream["unacked_frames"]))
+            self.stats.gauge("stream.open_streams", float(stream["opened"]))
         if self.trace_proxy is not None:
             spans = self.trace_proxy.proxied_spans
             self.stats.count("spans_proxied",

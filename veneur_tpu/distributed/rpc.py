@@ -8,6 +8,7 @@ message codegen comes from protoc; see proto/veneur_tpu.proto).
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from concurrent import futures
@@ -15,15 +16,17 @@ from typing import Callable, Optional
 
 import grpc
 
+from veneur_tpu.distributed import codec
 from veneur_tpu.gen import veneur_tpu_pb2 as pb
 
 SERVICE_NAME = "veneurtpu.Forward"
 SEND_METRICS = f"/{SERVICE_NAME}/SendMetrics"
+STREAM_METRICS = f"/{SERVICE_NAME}/StreamMetrics"
 
 # the reference's flusher.go:511-527 error taxonomy; transport-shaped
 # causes are worth retrying against the same destination, "send" means
 # the call or payload itself was rejected
-TRANSIENT_CAUSES = frozenset({"deadline_exceeded", "unavailable"})
+TRANSIENT_CAUSES = frozenset({"deadline_exceeded", "unavailable", "busy"})
 
 
 class ForwardError(Exception):
@@ -44,11 +47,83 @@ class ForwardError(Exception):
         self.transient = cause in TRANSIENT_CAUSES
 
 
+class _InlineFrameSink:
+    """Default stream sink: applies each frame synchronously through the
+    same code path a unary SendMetrics would take. The proxy's stream
+    receiver uses this (handle_wire only enqueues into the routing pool,
+    so per-frame application is already cheap); the import server swaps
+    in a StreamCoalescer for cross-sender batching."""
+
+    def __init__(self, apply_fn: Callable[[bytes], None]) -> None:
+        self._apply = apply_fn
+
+    def submit(self, body: bytes, done: Callable[[bool], None]) -> None:
+        try:
+            self._apply(body)
+        except Exception:
+            done(False)
+        else:
+            done(True)
+
+
+class _StreamEof:
+    __slots__ = ("count",)
+
+    def __init__(self, count: int) -> None:
+        self.count = count
+
+
+def _make_stream_behavior(sink):
+    """Bidi StreamMetrics handler: a reader thread decodes frames off the
+    request iterator and submits them to the sink; completion callbacks
+    queue acks, which the response generator yields back to the sender.
+    Frames ack out of arrival order when the sink batches — the client
+    matches acks to frames by seq, not position. The response stream ends
+    only after every received frame has been acked (or the peer goes
+    away), so a clean stream close never strands a delivery."""
+
+    def stream_metrics(request_iterator, context):
+        out_q: "queue.SimpleQueue" = queue.SimpleQueue()
+
+        def reader() -> None:
+            n = 0
+            try:
+                for msg in request_iterator:
+                    try:
+                        seq, body = codec.decode_stream_frame(msg)
+                    except ValueError:
+                        break  # version mismatch; drain what was taken
+                    n += 1
+                    sink.submit(
+                        body,
+                        lambda ok, _s=seq: out_q.put(
+                            codec.encode_stream_ack(_s, ok)))
+            except Exception:
+                pass  # peer cancelled/disconnected mid-read
+            out_q.put(_StreamEof(n))
+
+        threading.Thread(target=reader, daemon=True,
+                         name="fwd-stream-rx").start()
+        yielded = 0
+        total = None
+        while total is None or yielded < total:
+            item = out_q.get()
+            if isinstance(item, _StreamEof):
+                total = item.count
+                continue
+            yield item
+            yielded += 1
+
+    return stream_metrics
+
+
 def make_server(handler: Callable[[pb.MetricBatch], None],
                 address: str = "127.0.0.1:0",
                 max_workers: int = 4,
                 compat: bool = True,
-                raw_handler: Optional[Callable[[bytes], None]] = None
+                raw_handler: Optional[Callable[[bytes], None]] = None,
+                stream_sink=None,
+                enable_stream: bool = True
                 ) -> tuple[grpc.Server, int]:
     """Start a Forward gRPC server; returns (server, bound_port).
 
@@ -59,6 +134,16 @@ def make_server(handler: Callable[[pb.MetricBatch], None],
     default) the same port also serves the reference Go fleet's
     /forwardrpc.Forward/SendMetrics wire (distributed/interop), feeding
     the message handler.
+
+    The same port also serves the bidi StreamMetrics channel (the
+    reference forwardrpc SendMetricsV2 analog): frames apply through
+    stream_sink when given (an object with submit(body, done) — the
+    import server's cross-sender StreamCoalescer), else inline through
+    raw_handler/handler. enable_stream=False leaves the method
+    unregistered — callers get UNIMPLEMENTED, which is how the
+    mixed-version interop test simulates an old server. Note each live
+    stream holds one executor thread for its lifetime; senders are
+    proxies/locals (few per server), unary callers share the rest.
     """
 
     if raw_handler is not None:
@@ -75,16 +160,27 @@ def make_server(handler: Callable[[pb.MetricBatch], None],
 
         deserializer = pb.MetricBatch.FromString
 
+    method_handlers = {
+        "SendMetrics": grpc.unary_unary_rpc_method_handler(
+            send_metrics,
+            request_deserializer=deserializer,
+            response_serializer=pb.SendResponse.SerializeToString,
+        )
+    }
+    if enable_stream:
+        if stream_sink is None:
+            if raw_handler is not None:
+                stream_sink = _InlineFrameSink(raw_handler)
+            else:
+                stream_sink = _InlineFrameSink(
+                    lambda body: handler(pb.MetricBatch.FromString(body)))
+        method_handlers["StreamMetrics"] = grpc.stream_stream_rpc_method_handler(
+            _make_stream_behavior(stream_sink),
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
     rpc_handlers = grpc.method_handlers_generic_handler(
-        SERVICE_NAME,
-        {
-            "SendMetrics": grpc.unary_unary_rpc_method_handler(
-                send_metrics,
-                request_deserializer=deserializer,
-                response_serializer=pb.SendResponse.SerializeToString,
-            )
-        },
-    )
+        SERVICE_NAME, method_handlers)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((rpc_handlers,))
     if compat:
@@ -94,6 +190,46 @@ def make_server(handler: Callable[[pb.MetricBatch], None],
     port = server.add_insecure_port(address)
     server.start()
     return server, port
+
+
+_UNIMPLEMENTED = "__unimplemented__"  # internal downgrade signal, not a cause
+
+
+class _StreamWaiter:
+    __slots__ = ("event", "ok", "cause")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.ok = False
+        self.cause: Optional[str] = None
+
+
+class _StreamState:
+    """One live bidi stream: the out-queue feeding the request iterator,
+    per-seq ack waiters, and the bounded in-flight window. Whoever
+    removes a waiter from `pending` owns releasing its window slot —
+    ack receiver, stream-failure sweep, or the sender giving up on
+    timeout — so a slot is released exactly once per frame."""
+
+    __slots__ = ("out_q", "lock", "pending", "sem", "dead", "dead_cause",
+                 "seq", "call")
+
+    def __init__(self, window: int) -> None:
+        self.out_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.lock = threading.Lock()
+        self.pending: dict[int, _StreamWaiter] = {}
+        self.sem = threading.Semaphore(window)
+        self.dead = False
+        self.dead_cause: Optional[str] = None
+        self.seq = 0
+        self.call = None
+
+    def requests(self):
+        while True:
+            item = self.out_q.get()
+            if item is None:
+                return
+            yield item
 
 
 class ForwardClient:
@@ -117,7 +253,9 @@ class ForwardClient:
     RECONNECT_BACKOFF_MAX_S = 30.0
 
     def __init__(self, address: str, timeout_s: float = 10.0,
-                 idle_timeout_s: float = 0.0) -> None:
+                 idle_timeout_s: float = 0.0,
+                 streaming: bool = False,
+                 stream_window: int = 32) -> None:
         self.address = address
         self.timeout_s = timeout_s
         options = []
@@ -129,9 +267,19 @@ class ForwardClient:
                 ("grpc.client_idle_timeout_ms", int(idle_timeout_s * 1000)))
         self._options = options
         self._lock = threading.Lock()
+        self.streaming = streaming
+        self.stream_window = max(1, int(stream_window))
+        self._stream_lock = threading.Lock()
+        self._stream: Optional[_StreamState] = None
+        self.stream_opened = 0
+        self.stream_reconnects = 0
+        self.stream_acked = 0
+        self.stream_window_stalls = 0
+        self.stream_downgraded = False
         self._build_channel()
         self.errors: dict[str, int] = {
             "deadline_exceeded": 0, "unavailable": 0, "send": 0,
+            "busy": 0,
         }
         self.last_error_cause: Optional[str] = None
         self.sent_batches = 0
@@ -161,30 +309,63 @@ class ForwardClient:
             request_serializer=lambda b: b,
             response_deserializer=pb.SendResponse.FromString,
         )
+        # bidi frame stream: both directions are hand-framed bytes
+        # (codec.encode_stream_frame / encode_stream_ack)
+        self._stream_call = self.channel.stream_stream(
+            STREAM_METRICS,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        # a channel rebuild orphans any stream riding the old transport:
+        # fail its in-flight frames now so their senders retry/spill
+        # through the delivery layer instead of waiting out the deadline
+        self._kill_stream("unavailable")
 
     def send(self, batch: pb.MetricBatch,
              timeout_s: Optional[float] = None) -> bool:
-        return self._send(self._call, batch,
-                          len(batch.metrics), timeout_s) is None
+        return self._dispatch(batch, timeout_s) is None
 
     def send_raw(self, blob: bytes, n_metrics: int,
                  timeout_s: Optional[float] = None) -> bool:
         """Send pre-serialized MetricBatch bytes (native encoder path)."""
-        return self._send(self._call_raw, blob, n_metrics, timeout_s) is None
+        return self._dispatch_raw(blob, n_metrics, timeout_s) is None
 
     def send_or_raise(self, batch: pb.MetricBatch,
                       timeout_s: Optional[float] = None) -> None:
         """send(), but failures raise a classified ForwardError — the
         shape the proxy's DeliveryManager retry/spill path consumes."""
-        cause = self._send(self._call, batch, len(batch.metrics), timeout_s)
+        cause = self._dispatch(batch, timeout_s)
         if cause is not None:
             raise ForwardError(cause, self.address)
 
     def send_raw_or_raise(self, blob: bytes, n_metrics: int,
                           timeout_s: Optional[float] = None) -> None:
-        cause = self._send(self._call_raw, blob, n_metrics, timeout_s)
+        cause = self._dispatch_raw(blob, n_metrics, timeout_s)
         if cause is not None:
             raise ForwardError(cause, self.address)
+
+    def _stream_active(self) -> bool:
+        return self.streaming and not self.stream_downgraded
+
+    def _dispatch(self, batch: pb.MetricBatch,
+                  timeout_s: Optional[float]) -> Optional[str]:
+        if self._stream_active():
+            # frames carry serialized bytes; identical wire either way
+            return self._dispatch_raw(
+                batch.SerializeToString(), len(batch.metrics), timeout_s)
+        return self._send(self._call, batch, len(batch.metrics), timeout_s)
+
+    def _dispatch_raw(self, blob: bytes, n_metrics: int,
+                      timeout_s: Optional[float]) -> Optional[str]:
+        if self._stream_active():
+            cause = self._send_stream(blob, n_metrics, timeout_s)
+            if cause != _UNIMPLEMENTED:
+                return cause
+            # old server: downgrade permanently and retry this very
+            # payload as a unary call — mixed-version interop costs one
+            # extra round-trip once, never a spurious delivery failure
+            self.stream_downgraded = True
+        return self._send(self._call_raw, blob, n_metrics, timeout_s)
 
     def _send(self, call, payload, n_metrics: int,
               timeout_s: Optional[float]) -> Optional[str]:
@@ -243,10 +424,181 @@ class ForwardClient:
         except Exception:
             pass
 
+    # ------------------------------------------------------ streaming
+
+    def _open_stream(self) -> _StreamState:
+        """Current live stream, opening one lazily. Reopening after a
+        death is the 'reconnect': unacked frames of the dead stream were
+        already failed back to their senders, who retry through the
+        delivery layer under their original dedup keys."""
+        with self._stream_lock:
+            st = self._stream
+            if st is not None and not st.dead:
+                return st
+            st = _StreamState(self.stream_window)
+            st.call = self._stream_call(st.requests())
+            threading.Thread(
+                target=self._stream_recv_loop, args=(st,), daemon=True,
+                name=f"fwd-stream-ack:{self.address}").start()
+            self._stream = st
+            self.stream_opened += 1
+            if self.stream_opened > 1:
+                self.stream_reconnects += 1
+            return st
+
+    def _stream_recv_loop(self, st: _StreamState) -> None:
+        cause = "unavailable"  # a cleanly-closed ack stream still means
+        try:                   # "this stream delivers nothing further"
+            for msg in st.call:
+                try:
+                    seq, status = codec.decode_stream_ack(msg)
+                except ValueError:
+                    cause = "send"
+                    break
+                with st.lock:
+                    w = st.pending.pop(seq, None)
+                if w is not None:  # late ack after give-up: no waiter
+                    if status == codec.STREAM_ACK_OK:
+                        w.ok = True
+                    elif status == codec.STREAM_ACK_BUSY:
+                        # receiver full, frame not taken: transient, but
+                        # the transport is healthy — retry, don't rebuild
+                        w.cause = "busy"
+                    else:
+                        w.ok = False
+                    w.event.set()
+                    st.sem.release()
+        except grpc.RpcError as e:
+            try:
+                code = e.code()
+            except Exception:
+                code = None
+            if code == grpc.StatusCode.UNIMPLEMENTED:
+                cause = _UNIMPLEMENTED
+            elif code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                cause = "deadline_exceeded"
+            else:
+                cause = "unavailable"
+        except Exception:
+            cause = "unavailable"
+        with self._stream_lock:
+            if self._stream is st:
+                self._stream = None
+        self._fail_stream_state(st, cause)
+        try:
+            st.call.cancel()
+        except Exception:
+            pass
+
+    def _fail_stream_state(self, st: _StreamState, cause: str) -> None:
+        with st.lock:
+            if st.dead:
+                waiters = []
+            else:
+                st.dead = True
+                st.dead_cause = cause
+                waiters = list(st.pending.values())
+                st.pending.clear()
+        st.out_q.put(None)  # end the request iterator
+        for w in waiters:
+            w.cause = cause
+            w.event.set()
+            st.sem.release()
+
+    def _kill_stream(self, cause: str) -> None:
+        with self._stream_lock:
+            st = self._stream
+            self._stream = None
+        if st is None:
+            return
+        self._fail_stream_state(st, cause)
+        try:
+            if st.call is not None:
+                st.call.cancel()
+        except Exception:
+            pass
+
+    def _send_stream(self, blob: bytes, n_metrics: int,
+                     timeout_s: Optional[float]) -> Optional[str]:
+        """One streamed attempt: admit under the window, write the
+        frame, block until its ack. None on success, _UNIMPLEMENTED to
+        trigger the unary downgrade, else a classified cause — the same
+        contract as _send, so breakers/retry/spill see identical shapes.
+        A frame that times out may still land server-side; its retry
+        re-sends the same dedup envelope, which the import window
+        absorbs — at-least-once on the wire, exactly-once in the merge.
+        """
+        timeout = timeout_s or self.timeout_s
+        deadline = time.monotonic() + timeout
+        t0 = time.perf_counter()
+        try:
+            st = self._open_stream()
+        except Exception:
+            self._note_attempt(t0)
+            return self._note_stream_failure("unavailable")
+        if not st.sem.acquire(blocking=False):
+            self.stream_window_stalls += 1
+            if not st.sem.acquire(
+                    timeout=max(0.0, deadline - time.monotonic())):
+                self._note_attempt(t0)
+                return self._note_stream_failure("deadline_exceeded")
+        w = _StreamWaiter()
+        with st.lock:
+            if st.dead:
+                dead_cause = st.dead_cause or "unavailable"
+            else:
+                dead_cause = None
+                st.seq += 1
+                seq = st.seq
+                st.pending[seq] = w
+        if dead_cause is not None:
+            st.sem.release()
+            self._note_attempt(t0)
+            if dead_cause == _UNIMPLEMENTED:
+                return _UNIMPLEMENTED
+            return self._note_stream_failure(dead_cause)
+        st.out_q.put(codec.encode_stream_frame(seq, blob))
+        if not w.event.wait(max(0.0, deadline - time.monotonic())):
+            with st.lock:
+                still_pending = st.pending.pop(seq, None)
+            if still_pending is not None:
+                st.sem.release()
+                self._note_attempt(t0)
+                return self._note_stream_failure("deadline_exceeded")
+            # the ack raced our give-up: fall through to its result
+        self._note_attempt(t0)
+        if w.cause is not None:
+            if w.cause == _UNIMPLEMENTED:
+                return _UNIMPLEMENTED
+            return self._note_stream_failure(w.cause)
+        if not w.ok:
+            return self._note_stream_failure("send")
+        self.consecutive_failures = 0
+        self._reconnect_backoff_s = 1.0
+        self.last_ok_unix = time.time()
+        self.sent_batches += 1
+        self.sent_metrics += n_metrics
+        self.stream_acked += 1
+        return None
+
+    def _note_stream_failure(self, cause: str) -> str:
+        """Identical bookkeeping to the unary failure path, so the
+        RECONNECT_AFTER_FAILURES channel-rebuild heuristic (and the
+        soaks that pin it) governs streams too — a rebuild kills the
+        stream and the next send opens a fresh one. A busy-ack never
+        reconnects: the peer answered, so the transport is proven
+        healthy and a rebuild would only thrash the window."""
+        self.errors[cause] += 1
+        self.last_error_cause = cause
+        self.consecutive_failures += 1
+        if cause in TRANSIENT_CAUSES and cause != "busy":
+            self._maybe_reconnect()
+        return cause
+
     def stats(self) -> dict:
         """Forward-path health snapshot (read by the proxy's
         forward_stats and the mesh soak's stall diagnostics)."""
-        return {
+        out = {
             "address": self.address,
             "sent_batches": self.sent_batches,
             "sent_metrics": self.sent_metrics,
@@ -258,6 +610,20 @@ class ForwardClient:
             "last_ok_unix": self.last_ok_unix,
             "last_error_cause": self.last_error_cause,
         }
+        if self.streaming:
+            st = self._stream
+            out["stream"] = {
+                "enabled": True,
+                "window": self.stream_window,
+                "opened": self.stream_opened,
+                "reconnects": self.stream_reconnects,
+                "acked_total": self.stream_acked,
+                "window_stalls": self.stream_window_stalls,
+                "unacked_frames": len(st.pending) if st is not None else 0,
+                "downgraded": self.stream_downgraded,
+            }
+        return out
 
     def close(self) -> None:
+        self._kill_stream("unavailable")
         self.channel.close()
