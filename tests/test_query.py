@@ -1,0 +1,412 @@
+"""Live query subsystem tests (veneur_tpu/query/).
+
+The three contracts the subsystem stands on:
+
+* query == flush parity, bitwise, at the epoch fence — the device query
+  evaluator re-runs the flush's own compiled extraction program over the
+  retained post-fold arrays, so a force_device query at the flush
+  quantile vector must equal the flush readback bit for bit (the CI
+  parity lane runs this file).
+* epoch-fence snapshot isolation — concurrent ingest + repeated queries
+  return values from exactly one committed epoch, across workers.
+* fenced heavy-hitter reads leave the pool bit-identical (the
+  regression for ops/heavyhitter.read_query / read_totals).
+"""
+
+import functools
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import Config, validate_config
+from veneur_tpu.core.flusher import device_quantiles, generate_columnar
+from veneur_tpu.core.metrics import DEFAULT_TENANT, HistogramAggregates
+from veneur_tpu.core.tenancy import TenantSketch
+from veneur_tpu.core.worker import DeviceWorker
+from veneur_tpu.ops import heavyhitter as hh
+from veneur_tpu.ops import query as qops
+from veneur_tpu.protocol.dogstatsd import parse_metric
+from veneur_tpu.query.engine import QueryEngine
+from veneur_tpu.sinks.prometheus import PrometheusExpositionSink
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+PCTS = [0.5, 0.9, 0.99]
+QS = device_quantiles(PCTS, AGGS)
+
+
+def _engine_worker(**kw):
+    eng = QueryEngine(PCTS, AGGS, is_local=True)
+    w = DeviceWorker(**kw)
+    w.query_publisher = functools.partial(eng.stage, 0)
+    return eng, w
+
+
+def _fill(w, n=100):
+    for i in range(n):
+        w.process_metric(parse_metric(f"q.t:{i % 13}|ms".encode()))
+        w.process_metric(parse_metric(f"q.h:{i}|h|#k:v".encode()))
+        w.process_metric(parse_metric(f"q.s:u{i % 7}|s".encode()))
+
+
+def _flush_commit(eng, w, ts=1000):
+    snap = w.flush(QS, interval_s=10.0)
+    eng.commit(ts)
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# parity: query == flush, bitwise, at the epoch fence
+
+
+@pytest.mark.parametrize("shards", [0, 4])
+def test_query_flush_parity_bitwise(shards):
+    eng, w = _engine_worker(initial_histo_rows=8, series_shards=shards)
+    _fill(w)
+    snap = _flush_commit(eng, w)
+    rows = {m.key.name: i
+            for i, m in enumerate(snap.directory.histo.rows)}
+    r = eng.query_quantiles(force_device=True)
+    assert r["epoch"] == 1 and r["results"]
+    for res in r["results"]:
+        dev = np.asarray(res["values"], np.float32)
+        ref = snap.quantile_values[rows[res["name"]]].astype(np.float32)
+        assert np.array_equal(dev, ref, equal_nan=True)
+    # the zero-device-work host path serves the identical values
+    host = eng.query_quantiles()
+    assert [x["values"] for x in host["results"]] == \
+        [x["values"] for x in r["results"]]
+
+
+def test_query_cardinality_matches_flush():
+    eng, w = _engine_worker()
+    _fill(w)
+    snap = _flush_commit(eng, w)
+    r = eng.query_cardinality(name="q.s")
+    assert len(r["results"]) == 1
+    assert r["results"][0]["estimate"] == float(snap.set_estimates[0])
+
+
+def test_query_scalars_match_flush():
+    eng, w = _engine_worker()
+    _fill(w, n=50)
+    snap = _flush_commit(eng, w)
+    r = eng.query_scalars(name="q.h")
+    row = [m.key.name for m in snap.directory.histo.rows].index("q.h")
+    res = r["results"][0]
+    assert res["count"] == float(snap.dcount[row]) == 50.0
+    assert res["min"] == float(snap.dmin[row]) == 0.0
+    assert res["max"] == float(snap.dmax[row]) == 49.0
+
+
+def test_adhoc_quantiles_device_path():
+    eng, w = _engine_worker()
+    for i in range(1, 101):
+        w.process_metric(parse_metric(f"u:{i}|h".encode()))
+    _flush_commit(eng, w)
+    # 0.25/0.75 are not in the flush vector: the device path evaluates
+    # them through the retained program; sanity-bound the interpolation
+    r = eng.query_quantiles(qs=[0.25, 0.75], name="u")
+    v25, v75 = r["results"][0]["values"]
+    assert 20.0 < v25 < 30.0 and 70.0 < v75 < 80.0
+    # pad ladder: 2 quantiles pad to MIN_QS, result slices back to 2
+    assert len(r["results"][0]["qs"]) == 2
+
+
+def test_tag_filtering_and_limit():
+    eng, w = _engine_worker()
+    w.process_metric(parse_metric(b"m:1|h|#env:prod"))
+    w.process_metric(parse_metric(b"m:2|h|#env:dev"))
+    _flush_commit(eng, w)
+    r = eng.query_scalars(name="m", tags=["env:prod"])
+    assert len(r["results"]) == 1 and r["results"][0]["max"] == 1.0
+    r = eng.query_scalars(limit=1)
+    assert len(r["results"]) == 1 and r.get("truncated") is True
+
+
+# ---------------------------------------------------------------------------
+# fenced heavy-hitter reads: a query must leave the pool bit-identical
+
+
+def test_heavyhitter_fenced_read_pool_bit_identical():
+    sk = TenantSketch(depth=4, width=256, topk=4)
+    keys = [f"series-{i}" for i in range(50)]
+    tenants = ["default"] * 25 + ["acme"] * 25
+    counts = np.arange(1, 51, dtype=np.int64)
+    sk.fold(tenants, keys, counts, chunk=64)
+    before = np.asarray(sk.pool).copy()
+    pool_ref = sk.pool
+
+    view = sk.snapshot()
+    est = view.estimate("acme", keys[25:])
+    totals = view.totals()
+    top = view.top_keys("acme")
+    _ = hh.read_query(sk.pool, 0, keys[:25])
+    _ = hh.read_totals(sk.pool)
+
+    # the pool object was not replaced and its bytes did not change
+    assert sk.pool is pool_ref
+    assert np.array_equal(np.asarray(sk.pool), before)
+    # and the reads were right: CMS estimates upper-bound the truth,
+    # totals are exact, top-k surfaces the heaviest keys
+    assert np.all(est >= counts[25:])
+    assert totals["acme"] == int(counts[25:].sum())
+    assert totals[DEFAULT_TENANT] == int(counts[:25].sum())
+    assert top[0][0] == "series-49"
+
+
+def test_sketch_snapshot_isolated_from_later_folds():
+    sk = TenantSketch(depth=4, width=256, topk=4)
+    sk.fold(["default"], ["a"], np.asarray([5]), chunk=16)
+    view = sk.snapshot()
+    sk.fold(["default"], ["a"], np.asarray([100]), chunk=16)
+    # the view still answers from the fence: later folds replaced the
+    # pool (insert is copy-on-write) and the top-k items were copied out
+    assert view.totals()[DEFAULT_TENANT] == 5
+    assert view.estimate("default", ["a"])[0] == 5
+    assert view.top_keys("default") == [("a", 5, 0)]
+    assert sk.totals()[DEFAULT_TENANT] == 105
+
+
+# ---------------------------------------------------------------------------
+# epoch-fence snapshot isolation under concurrent ingest
+
+
+def test_snapshot_isolation_under_concurrent_ingest():
+    """Pairs (iso.a, iso.b) always ingest atomically with equal counts;
+    a query whose response mixed two epochs would see them differ."""
+    eng = QueryEngine(PCTS, AGGS, is_local=True)
+    workers = [DeviceWorker() for _ in range(2)]
+    for i, w in enumerate(workers):
+        w.query_publisher = functools.partial(eng.stage, i)
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def ingest():
+        v = 0
+        while not stop.is_set():
+            with lock:
+                # one pair per worker, all-or-nothing under the lock
+                for w in workers:
+                    w.process_metric(parse_metric(f"iso.a:{v}|h".encode()))
+                    w.process_metric(parse_metric(f"iso.b:{v}|h".encode()))
+            v += 1
+
+    def flusher():
+        while not stop.is_set():
+            swapped = []
+            with lock:
+                for w in workers:
+                    swapped.append(w.swap(QS))
+            for w, sw in zip(workers, swapped):
+                w.extract_snapshot(sw, QS, 10.0)
+            eng.commit()
+
+    threads = [threading.Thread(target=ingest),
+               threading.Thread(target=flusher)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 8.0
+        checked = 0
+        last_epoch = 0
+        while time.time() < deadline and checked < 25:
+            r = eng.query_scalars(name="iso.a")
+            r2 = eng.query_scalars(name="iso.b")
+            if not r["results"]:
+                continue
+            # epochs only move forward
+            assert r["epoch"] >= last_epoch
+            last_epoch = r["epoch"]
+            if r["epoch"] != r2["epoch"]:
+                continue  # a commit landed between the two reads — retry
+            a = sorted(x["count"] for x in r["results"])
+            b = sorted(x["count"] for x in r2["results"])
+            assert a == b, (r["epoch"], a, b)
+            checked += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert checked >= 5  # the race actually got exercised
+
+
+def test_commit_is_atomic_across_workers():
+    """Staged-but-uncommitted views must stay invisible: after worker 0
+    re-stages a new epoch, queries still serve the old one until commit."""
+    eng = QueryEngine(PCTS, AGGS, is_local=True)
+    w = DeviceWorker()
+    w.query_publisher = functools.partial(eng.stage, 0)
+    w.process_metric(parse_metric(b"x:1|h"))
+    w.flush(QS, interval_s=10.0)
+    eng.commit(100)
+    first = eng.query_scalars(name="x")
+    assert first["epoch"] == 1 and first["results"][0]["count"] == 1.0
+
+    for _ in range(5):
+        w.process_metric(parse_metric(b"x:2|h"))
+    w.flush(QS, interval_s=10.0)  # stages epoch 2, NOT committed yet
+    again = eng.query_scalars(name="x")
+    assert again["epoch"] == 1
+    assert again["results"][0]["count"] == 1.0
+
+    eng.commit(200)
+    now = eng.query_scalars(name="x")
+    assert now["epoch"] == 2 and now["results"][0]["count"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# exposition surface: the shared renderer serializes identically to the sink
+
+
+def test_query_exposition_matches_sink_bytes():
+    eng, w = _engine_worker()
+    _fill(w, n=30)
+    snap = _flush_commit(eng, w, ts=1234)
+    body, count, ctype = eng.render_exposition()
+    assert ctype.startswith("text/plain")
+
+    sink = PrometheusExpositionSink("http://example.invalid/push")
+    posted = {}
+    sink._post = lambda b, c: posted.update(body=b, count=c)
+    batch = generate_columnar(snap, True, PCTS, AGGS, now=1234)
+    sink.flush_columnar(batch)
+    assert posted["body"] == body
+    assert posted["count"] == count
+
+
+def test_exposition_cached_per_epoch():
+    eng, w = _engine_worker()
+    _fill(w, n=10)
+    _flush_commit(eng, w)
+    b1, _, _ = eng.render_exposition()
+    b2, _, _ = eng.render_exposition()
+    assert b1 is b2  # same cached object, not re-rendered
+    _fill(w, n=10)
+    _flush_commit(eng, w, ts=2000)
+    b3, _, _ = eng.render_exposition()
+    assert b3 is not b1
+
+
+# ---------------------------------------------------------------------------
+# the two fronts: gRPC and HTTP round-trips
+
+
+def test_grpc_front_round_trip():
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from veneur_tpu.query.service import QueryClient, make_query_server
+
+    eng, w = _engine_worker()
+    _fill(w, n=20)
+    _flush_commit(eng, w)
+    server, port = make_query_server(eng, "127.0.0.1:0")
+    try:
+        client = QueryClient(f"127.0.0.1:{port}")
+        r = client.query({"op": "quantiles", "name": "q.t"})
+        assert r["epoch"] == 1 and len(r["results"]) == 1
+        assert r["results"][0]["qs"] == [float(q) for q in QS]
+        r = client.query({"op": "cardinality"})
+        assert r["results"][0]["name"] == "q.s"
+        r = client.query({"op": "nope"})
+        assert "error" in r
+        client.close()
+    finally:
+        server.stop(grace=0)
+
+
+def test_http_front_round_trip():
+    from veneur_tpu.query.http import make_http_server
+
+    eng, w = _engine_worker()
+    _fill(w, n=20)
+    _flush_commit(eng, w)
+    server, port = make_http_server(eng, "127.0.0.1:0")
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(base + "/healthz") as resp:
+            assert json.load(resp)["epoch"] == 1
+        req = urllib.request.Request(
+            base + "/query",
+            data=json.dumps({"op": "scalars", "name": "q.h"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            r = json.load(resp)
+        assert r["results"][0]["count"] == 20.0
+        # GET with query params answers identically to the POST body form
+        with urllib.request.urlopen(
+                base + "/query?op=scalars&name=q.h") as resp:
+            assert json.load(resp) == r
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read()
+        assert body == eng.render_exposition()[0]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# heavy hitters through the engine
+
+
+def test_query_topk_and_totals():
+    eng = QueryEngine(PCTS, AGGS, is_local=True)
+    w = DeviceWorker()
+    w.query_publisher = functools.partial(eng.stage, 0)
+    w.tenant_sketch = TenantSketch(depth=4, width=256, topk=4)
+    for i in range(40):
+        w.process_metric(parse_metric(b"hot:1|h"))
+        if i % 4 == 0:
+            w.process_metric(parse_metric(b"cold:1|h"))
+    w.flush(QS, interval_s=10.0)
+    eng.commit()
+    r = eng.query_topk()
+    assert r["results"][0]["count"] == 40
+    totals = eng.query_tenant_totals()
+    assert totals["results"][DEFAULT_TENANT] == 50
+    keys = [m.key.key_string() for m in
+            eng.epoch().views[0].snap.directory.histo.rows]
+    cms = eng.query_cms(keys)
+    assert all(v >= 10 for v in cms["results"].values())
+
+
+# ---------------------------------------------------------------------------
+# kernels and config
+
+
+def test_pad_quantiles_ladder():
+    padded, n = qops.pad_quantiles([0.5])
+    assert n == 1 and len(padded) == qops.MIN_QS
+    assert np.all(padded == np.float32(0.5))
+    padded, n = qops.pad_quantiles([0.1] * 5)
+    assert n == 5 and len(padded) == 8
+    padded, n = qops.pad_quantiles([0.1] * 4)
+    assert (n, len(padded)) == (4, 4)  # exact pow2: no padding
+
+
+def test_quantile_rows_kernel_matches_reference():
+    rng = np.random.default_rng(7)
+    s, c = 16, 32
+    means = np.sort(rng.normal(size=(s, c)).astype(np.float32), axis=1)
+    weights = rng.uniform(0.0, 4.0, size=(s, c)).astype(np.float32)
+    dmin = means.min(axis=1) - 1.0
+    dmax = means.max(axis=1) + 1.0
+    rows = np.asarray([3, 0, 15], np.int32)
+    qs = np.asarray([0.25, 0.5, 0.9, 0.99], np.float32)
+    dev = np.asarray(qops.quantile_rows(means, weights, dmin, dmax,
+                                        rows, qs))
+    ref = qops.np_quantile(means, weights, dmin, dmax, qs)[rows]
+    assert np.allclose(dev, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_query_config_validation():
+    validate_config(Config(query_listen_addrs=[]))
+    validate_config(Config(query_listen_addrs=[
+        "http://127.0.0.1:0", "grpc://0.0.0.0:9100"]))
+    for bad in ["127.0.0.1:9100", "tcp://1.2.3.4:1", "http://:1",
+                "grpc://host", "http://host:abc"]:
+        with pytest.raises(ValueError):
+            validate_config(Config(query_listen_addrs=[bad]))

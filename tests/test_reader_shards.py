@@ -264,8 +264,15 @@ def test_torn_epoch_threaded_conservation():
         got_c += by.get(("torn.c", MetricType.COUNTER, ()), 0.0)
     total = float(sum(sent))
     shed = float(w.overload_dropped_total)
-    assert got_h + shed == total, (got_h, shed, total)
-    assert got_c == total  # counters never shed at the spill caps
+    # One timer + one counter line per send, and overload_dropped counts
+    # sheds from EVERY class: on a fast rig only the histogram cap
+    # engages (got_c == total), but on a slow or loaded rig the
+    # GIL-free reader threads outrun the five flushes far enough that
+    # the counter cap sheds too. The two-class identity is exact in
+    # both regimes — a torn epoch (lost or double-folded sample)
+    # breaks it either way.
+    assert got_h + got_c + shed == 2 * total, (got_h, got_c, shed, total)
+    assert got_h <= total and got_c <= total, (got_h, got_c, total)
     assert sum(w.reader_committed) == w.processed_total
     np.testing.assert_array_equal(
         np.asarray(w.reader_committed[1:]) >= 0, True)

@@ -94,6 +94,39 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   VENEUR_SERIES_SHARDS=0 \
   python -m pytest tests/test_series_shard.py -q -m 'not slow'
 
+# Live-query lane: the read path (veneur_tpu/query/) must answer from
+# exactly one committed epoch and agree with the flush bit-for-bit at
+# the fence — tests/test_query.py pins query==flush parity (unsharded
+# AND sharded), snapshot isolation under concurrent ingest, the
+# heavy-hitter fenced-read no-mutation regression, and both serving
+# fronts. The bench smoke then validates the QUERY_BENCH artifact
+# schema and the sub-second latency claim on live cells with
+# concurrent ingest. (The query differential fuzz target rides the
+# codec fuzz lane above — it is in the default target set.)
+echo "== live-query lane (epoch-fence parity + bench smoke) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m pytest tests/test_query.py -q -m 'not slow'
+timeout -k 10 600 python tools/bench_query.py --smoke \
+  --out "${TMPDIR:-/tmp}/QUERY_BENCH_SMOKE.json"
+python - <<'PYGATE'
+import json, os
+with open(os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                       "QUERY_BENCH_SMOKE.json")) as f:
+    a = json.load(f)
+cells = {(c["series"], c["shards"], c["concurrent_ingest"])
+         for c in a["grid"]}
+assert (128, 0, True) in cells and (128, 4, True) in cells, \
+    f"smoke grid must cover unsharded+sharded under ingest: {cells}"
+for c in a["grid"]:
+    for op, s in c["ops"].items():
+        assert 0 < s["p50_ms"] <= s["p99_ms"] < 1000, \
+            f"sub-second claim broken: {op} {s} in cell {c}"
+assert a["sustained_ab"]["ratio"] > 0.5, \
+    f"ingest rate under query load: {a['sustained_ab']}"
+print("query bench artifact OK")
+PYGATE
+
 # Delivery chaos lane: a pipelined server flushing into HTTP sinks whose
 # openers inject seeded faults (utils/faults.py) — refusals, 5xx, slow
 # responses, mid-body resets, payload rejections, and a deterministic

@@ -14,9 +14,10 @@ the C++ MetricBatch decoder):
              mutated bytes (untrusted peer input on /import)
 
 Later rounds added ssf_stream (framed-stream recoverability), loadgen
-(generated traffic must parse in both codecs), and reader_commit
+(generated traffic must parse in both codecs), reader_commit
 (shared-nothing per-reader owned contexts vs one legacy context over
-the same per-reader streams — keyed fold parity).
+the same per-reader streams — keyed fold parity), and query (live-query
+device kernels vs independent numpy references on randomized pools).
 
 Usage: python tools/fuzz_differential.py [--seconds 30] [--seed N]
 Exit 0 = no divergence; 1 = divergence (repro printed with seed).
@@ -518,10 +519,131 @@ def fuzz_reader_commit(rng, t_end) -> int:
     return n
 
 
+def fuzz_query(rng, t_end) -> int:
+    """Live-query differential (veneur_tpu/query/): the device query
+    kernels vs their independent numpy references on randomized pools —
+
+      quantile_rows  vs np_quantile      (f32 vs f64, tolerance)
+      hll.estimate   vs np_hll_estimate  (random register fields, both
+                     the linear-counting and raw-harmonic branches)
+      heavyhitter.query vs np_cms_query  (exact: same int32 counters)
+                     + CMS upper-bound and read_totals-exact properties
+      SpaceSavingTopK with capacity >= distinct keys vs exact Counter
+
+    Fixed pool shapes keep the jit cache at one compile per kernel."""
+    from collections import Counter
+
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import heavyhitter as hh
+    from veneur_tpu.ops import hll
+    from veneur_tpu.ops import query as qops
+
+    nprng = np.random.default_rng(rng.randrange(1 << 30))
+    S, C = 16, 32
+    n = 0
+    while time.time() < t_end:
+        for _ in range(10):
+            # t-digest quantiles: left-packed digests (k live centroids,
+            # zero-weight tail), one always-empty row for the NaN path
+            means = np.sort(nprng.uniform(-1e3, 1e3, (S, C)),
+                            axis=1).astype(np.float32)
+            weights = nprng.uniform(0.1, 8.0, (S, C)).astype(np.float32)
+            for i in range(S):
+                weights[i, nprng.integers(0 if i == 0 else 1, C + 1):] = 0.0
+            dmin = means[:, 0] - nprng.uniform(0, 10, S).astype(np.float32)
+            kmax = np.maximum((weights > 0).sum(axis=1) - 1, 0)
+            dmax = (means[np.arange(S), kmax]
+                    + nprng.uniform(0, 10, S).astype(np.float32))
+            qs = np.sort(nprng.uniform(0.0, 1.0, rng.choice([1, 3, 5, 8])))
+            if rng.random() < 0.3:
+                qs[0], qs[-1] = 0.0, 1.0
+            qpad, norig = qops.pad_quantiles(qs)
+            rows, nrows = qops.pad_rows(
+                nprng.integers(0, S, rng.choice([3, 4, 7, 8])))
+            dev = np.asarray(qops.quantile_rows(
+                jnp.asarray(means), jnp.asarray(weights), jnp.asarray(dmin),
+                jnp.asarray(dmax), jnp.asarray(rows), jnp.asarray(qpad)))
+            ref = qops.np_quantile(means, weights, dmin, dmax,
+                                   qpad)[rows]
+            if not np.allclose(dev[:nrows, :norig], ref[:nrows, :norig],
+                               rtol=1e-3, atol=1e-2, equal_nan=True):
+                print(f"query QUANTILE DIVERGE rows={rows[:nrows]} "
+                      f"qs={qs!r}\n dev={dev[:nrows, :norig]!r}\n "
+                      f"ref={ref[:nrows, :norig]!r}")
+                return -1
+
+            # HLL estimate: random register fields, forcing both branches
+            p = rng.choice([6, 10])
+            m = 1 << p
+            regs = nprng.integers(0, 64 - p + 2, (8, m)).astype(np.int8)
+            regs[0, :] = 0  # empty row: pure linear counting
+            regs[1, nprng.random(m) < 0.99] = 0  # sparse: zeros > 0
+            dev_e = np.asarray(hll.estimate(jnp.asarray(regs), p))
+            ref_e = qops.np_hll_estimate(regs, p)
+            if not np.allclose(dev_e, ref_e, rtol=1e-3):
+                print(f"query HLL DIVERGE p={p}\n dev={dev_e!r}\n "
+                      f"ref={ref_e!r}")
+                return -1
+
+            # CMS: device point query is bit-equal to the reference and
+            # upper-bounds the truth; totals are exact
+            T, D, W = 4, 4, 256
+            keys = [f"qk{j}" for j in range(rng.randrange(1, 60))]
+            nins = rng.randrange(1, 200)
+            ins_rows = nprng.integers(0, T, nins).astype(np.int32)
+            ins_keys = [rng.choice(keys) for _ in range(nins)]
+            counts = nprng.integers(1, 1000, nins).astype(np.int32)
+            cols = hh.split_hashes(hh.hash_keys(ins_keys), D, W)
+            pool = hh.insert_chunked(hh.init_pool(T, D, W), ins_rows, cols,
+                                     counts, chunk=256)
+            qrows = np.repeat(np.arange(T, dtype=np.int32), len(keys))
+            qcols = np.tile(hh.split_hashes(hh.hash_keys(keys), D, W), T)
+            dev_c = np.asarray(hh.query(pool, jnp.asarray(qrows),
+                                        jnp.asarray(qcols)))
+            ref_c = qops.np_cms_query(np.asarray(pool), qrows, qcols)
+            if not np.array_equal(dev_c, ref_c):
+                print(f"query CMS DIVERGE keys={len(keys)} nins={nins}")
+                return -1
+            truth = Counter()
+            for t, k, c in zip(ins_rows.tolist(), ins_keys,
+                               counts.tolist()):
+                truth[(t, k)] += c
+            est = dev_c.reshape(T, len(keys))
+            for t in range(T):
+                for j, k in enumerate(keys):
+                    if est[t, j] < truth[(t, k)]:
+                        print(f"query CMS UNDER-estimate t={t} key={k}: "
+                              f"{est[t, j]} < {truth[(t, k)]}")
+                        return -1
+            tot = np.asarray(hh.read_totals(pool))
+            want_tot = np.bincount(ins_rows, weights=counts,
+                                   minlength=T).astype(np.int64)
+            if not np.array_equal(tot, want_tot):
+                print(f"query TOTALS DIVERGE {tot!r} != {want_tot!r}")
+                return -1
+
+            # space-saving with room for every distinct key == exact
+            ss = hh.SpaceSavingTopK(capacity=len(keys))
+            stream = Counter()
+            for _ in range(rng.randrange(1, 300)):
+                k = rng.choice(keys)
+                c = rng.randrange(1, 20)
+                ss.offer(k, c)
+                stream[k] += c
+            got = {k: (c, e) for k, c, e in ss.items()}
+            want = {k: (c, 0) for k, c in stream.items()}
+            if got != want:
+                print(f"query TOPK DIVERGE {got!r} != {want!r}")
+                return -1
+            n += 1
+    return n
+
+
 TARGETS = {"dogstatsd": fuzz_dogstatsd, "ssf": fuzz_ssf,
            "metricpb": fuzz_metricpb, "gob": fuzz_gob,
            "ssf_stream": fuzz_ssf_stream, "loadgen": fuzz_loadgen,
-           "reader_commit": fuzz_reader_commit}
+           "reader_commit": fuzz_reader_commit, "query": fuzz_query}
 
 
 def _git_rev() -> str:
@@ -576,7 +698,7 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--targets",
                     default="dogstatsd,ssf,metricpb,gob,ssf_stream,"
-                            "loadgen,reader_commit")
+                            "loadgen,reader_commit,query")
     ap.add_argument("--tally", default=None, metavar="PATH",
                     help="accumulate results into this JSON artifact")
     ap.add_argument("--rounds", type=int, default=1,

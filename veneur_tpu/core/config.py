@@ -181,6 +181,12 @@ class Config:
     grpc_address: str = ""
     http_quit: bool = False
     stats_address: str = ""
+    # live query subsystem (veneur_tpu/query/): addresses to serve
+    # epoch-fenced reads on, each "http://host:port" (exposition /metrics
+    # + JSON /query) or "grpc://host:port" (veneurtpu.Query/Query).
+    # Port 0 binds ephemerally (tests). Empty list keeps the whole query
+    # path dormant — no retained device views, no listeners.
+    query_listen_addrs: list[str] = field(default_factory=list)
 
     # TLS
     tls_key: str = ""
@@ -1028,3 +1034,18 @@ def validate_config(cfg: Config) -> None:
                          " 'protobuf', 'json' or 'columnar' (columnar"
                          " ships one VSB1 frame per sealed span batch"
                          " through the delivery manager)")
+    _validate_query_keys(cfg)
+
+
+def _validate_query_keys(cfg) -> None:
+    for addr in cfg.query_listen_addrs:
+        scheme, sep, hostport = addr.partition("://")
+        if not sep or scheme not in ("http", "grpc"):
+            raise ValueError(
+                f"query_listen_addrs entry {addr!r} must be"
+                " 'http://host:port' or 'grpc://host:port'")
+        host, sep, port = hostport.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(
+                f"query_listen_addrs entry {addr!r} needs host:port"
+                " (port 0 binds ephemerally)")

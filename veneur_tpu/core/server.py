@@ -178,6 +178,27 @@ class Server:
                     depth=cfg.tenant_sketch_depth,
                     width=cfg.tenant_sketch_width,
                     topk=cfg.tenant_topk)
+        # live query subsystem (veneur_tpu/query/): dormant unless
+        # addresses are configured. Each worker's extract fence publishes
+        # its epoch view into the engine (stage); _flush_extract commits
+        # all workers' views as one epoch after the loop — the two-phase
+        # publish that makes cross-worker reads tear-free.
+        self.query_engine = None
+        self._query_servers: list = []
+        self._query_reported = (0, 0)  # (served, failed) at last report
+        if cfg.query_listen_addrs:
+            import functools
+
+            from veneur_tpu.query import QueryEngine
+
+            self.query_engine = QueryEngine(
+                percentiles=self.percentiles,
+                aggregates=self.aggregates,
+                is_local=self.is_local,
+                topk=cfg.tenant_topk)
+            for i, w in enumerate(self.workers):
+                w.query_publisher = functools.partial(
+                    self.query_engine.stage, i)
         if cfg.tpu_mesh_devices > 1:
             # config-driven mesh sharding for the aggregation state (the
             # global tier's import merge rides ICI collectives; see
@@ -1495,6 +1516,8 @@ class Server:
                 except OSError:
                     pass
         self._inherited.clear()
+        for spec, port in self._start_query_listeners().items():
+            ports[spec] = port
         if self.config.tpu_warmup_compile:
             self._spawn(self._warmup_compile, "warmup-compile",
                         compute=True)
@@ -1510,6 +1533,37 @@ class Server:
         if self.native_mode:
             self._spawn(self._series_sync_loop, "series-sync",
                         compute=True)
+        return ports
+
+    def _start_query_listeners(self) -> dict[str, int]:
+        """Bind the live query fronts (config query_listen_addrs):
+        http:// addresses serve /metrics (exposition) + /query (JSON),
+        grpc:// addresses serve veneurtpu.Query/Query. Returns
+        {spec: bound_port} merged into the start() port report."""
+        ports: dict[str, int] = {}
+        if self.query_engine is None:
+            return ports
+        for spec in self.config.query_listen_addrs:
+            scheme, _, hostport = spec.partition("://")
+            try:
+                if scheme == "grpc":
+                    from veneur_tpu.query.service import make_query_server
+
+                    server, port = make_query_server(
+                        self.query_engine, hostport)
+                else:
+                    from veneur_tpu.query.http import make_http_server
+
+                    server, port = make_http_server(
+                        self.query_engine, hostport)
+            except Exception:
+                # a query front failing to bind must not take down
+                # ingest — the pipeline is the product, reads are a view
+                log.exception("query listener %s failed to start", spec)
+                continue
+            self._query_servers.append((scheme, server))
+            ports[spec] = port
+            log.info("query listener on %s (port %d)", spec, port)
         return ports
 
     def _attach_journals(self) -> None:
@@ -2014,6 +2068,10 @@ class Server:
                 # already-swapped intervals of the others
                 log.exception("flush extraction failed for worker %d", i)
             self.flush_governor.beat()  # one worker's extraction done
+        if self.query_engine is not None:
+            # commit AFTER every worker extracted: the query surface
+            # flips to the new epoch atomically across workers
+            self.query_engine.commit(job.ts)
         for snap in snaps:
             # per-type flushed-series counts (README.md:293)
             d = snap.directory
@@ -2176,6 +2234,16 @@ class Server:
                 "flush.unique_timeseries_total", self._tally_timeseries(snaps),
                 tags=[f"global_veneur:{str(not self.is_local).lower()}"])
         self.stats.count("flush.post_metrics_total", n_flushed)
+        if self.query_engine is not None:
+            served = self.query_engine.queries_served
+            failed = self.query_engine.queries_failed
+            if served - self._query_reported[0]:
+                self.stats.count("query.served_total",
+                                 served - self._query_reported[0])
+            if failed - self._query_reported[1]:
+                self.stats.count("query.errors_total",
+                                 failed - self._query_reported[1])
+            self._query_reported = (served, failed)
         # per-phase wall times as self-metrics (the reference samples its
         # flush phases via ssf.Timing in tallyMetrics/generateInterMetrics,
         # flusher.go:169-298; ours are exact phase boundaries)
@@ -2561,6 +2629,16 @@ class Server:
             self.import_server.stop()
         if self.import_http is not None:
             self.import_http.stop()
+        for scheme, server in self._query_servers:
+            try:
+                if scheme == "grpc":
+                    server.stop(grace=0.5)
+                else:
+                    server.shutdown()
+                    server.server_close()
+            except Exception:
+                log.exception("query listener (%s) failed to stop", scheme)
+        self._query_servers.clear()
         for journal in self._journals.values():
             # final durability point: whatever is still spilled survives
             # for the next incarnation's recovery

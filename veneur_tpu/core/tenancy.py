@@ -36,6 +36,7 @@ per-sample hot path.
 from __future__ import annotations
 
 import threading
+from dataclasses import dataclass
 from typing import Iterable, Optional
 
 import numpy as np
@@ -250,3 +251,46 @@ class TenantSketch:
     def top_keys(self, tenant: str) -> list[tuple[str, int, int]]:
         summ = self.topk.get(tenant)
         return summ.items() if summ is not None else []
+
+    def snapshot(self) -> "SketchView":
+        """Fenced read view for the live query path (veneur_tpu/query/).
+
+        Captured at the epoch fence — inside extract_snapshot, right
+        after fold(), where extractions never overlap — so the view is a
+        consistent point-in-time read. The pool reference is safe to
+        share without copying: every pool mutation goes through
+        insert_chunked, which REPLACES self.pool with a new array, never
+        writes in place, so a captured reference stays bit-identical
+        forever. The top-k summaries DO mutate in place (host dicts), so
+        their items are copied out here."""
+        return SketchView(
+            pool=self.pool,
+            row_of=dict(self._row_of),
+            topk={t: s.items() for t, s in self.topk.items()},
+        )
+
+
+@dataclass
+class SketchView:
+    """Immutable heavy-hitter read view from TenantSketch.snapshot():
+    what a live query serves between epoch fences. All reads go through
+    the fenced (non-mutating) entry points in ops/heavyhitter."""
+
+    pool: object  # i32[T, D, W] device array (reference, never mutated)
+    row_of: dict[str, int]
+    topk: dict[str, list[tuple[str, int, int]]]
+
+    def totals(self) -> dict[str, int]:
+        from veneur_tpu.ops import heavyhitter
+
+        tt = heavyhitter.read_totals(self.pool)
+        return {t: int(tt[row]) for t, row in self.row_of.items()}
+
+    def top_keys(self, tenant: str) -> list[tuple[str, int, int]]:
+        return list(self.topk.get(tenant, ()))
+
+    def estimate(self, tenant: str, keys: list[str]) -> np.ndarray:
+        from veneur_tpu.ops import heavyhitter
+
+        return heavyhitter.read_query(
+            self.pool, self.row_of.get(tenant, 0), keys)

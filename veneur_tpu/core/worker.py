@@ -750,6 +750,16 @@ class DeviceWorker:
         # decision — one tenant's series spread across workers by digest)
         self.tenancy = None
         self.tenant_sketch = None
+        # live query subsystem (veneur_tpu/query/): when the server wires
+        # a publisher, extract_snapshot hands it this epoch's read view —
+        # the FlushSnapshot, a device evaluator closed over the retained
+        # post-fold field arrays, and a fenced tenant-sketch view — right
+        # before returning. The engine stages per-worker views and the
+        # server commits them as ONE epoch after every worker extracted,
+        # so queries never see a torn cross-worker state. None keeps the
+        # whole path dormant (no retained device memory).
+        self.query_publisher = None
+        self.query_epoch_seq = 0
         # per-epoch / lifetime sample accounting per tenant; the epoch
         # tallies fold into the totals at swap, the processed_total
         # pattern (see swap())
@@ -2690,6 +2700,12 @@ class DeviceWorker:
         # live, not stalled — chunked extraction alone would leave a
         # multi-second fold silent for longer than the stall window
         gov = self.governor
+        # epoch read view for the live query path: the fully-folded field
+        # arrays (and their effective row count) captured after the last
+        # fold below — the same arrays the extraction reads, retained
+        # because no extract program donates them
+        view_fields = None
+        view_s_eff = 0
         if histo is not None and directory.num_histo_rows:
             n = directory.num_histo_rows
             # fold + extract over the USED rows only: the pool is up to 2x
@@ -2824,6 +2840,8 @@ class DeviceWorker:
                     dense(dstage.wts, s_eff))
                 if gov is not None:
                     gov.beat()
+            view_fields = fields
+            view_s_eff = s_eff
             qnp = np.asarray(quantiles, dtype=np.float32)
             if sh is None:
                 qs = self.ledger.h2d(qnp, "quantiles")
@@ -3004,7 +3022,56 @@ class DeviceWorker:
                     hll_ops.estimate(sets, self.hll_precision)
                 )[:n]
                 snap.set_registers = np.asarray(sets)[:n]
+        pub = self.query_publisher
+        if pub is not None:
+            # publish this epoch's read view. A publish failure must not
+            # fail the flush — the query surface going stale for one
+            # interval is strictly better than losing the interval.
+            self.query_epoch_seq += 1
+            sk = self.tenant_sketch
+            try:
+                pub(self.query_epoch_seq, snap,
+                    self._make_query_eval(view_fields, view_s_eff),
+                    sk.snapshot() if sk is not None else None)
+            except Exception:
+                log.exception("query view publish failed")
         return snap
+
+    def _make_query_eval(self, fields, s_eff: int):
+        """Build the epoch's device query evaluator: a closure over the
+        retained post-fold field arrays that re-runs the SAME compiled
+        extraction programs the flush used (`_extract` unsharded,
+        `SeriesSharding.flush_extract` sharded) at an arbitrary quantile
+        vector. Identical executable + identical input arrays is what
+        makes a query at the flush qs bitwise equal to the flush readback
+        (the parity CI lane in tools/ci.sh). Retaining `fields` is safe:
+        no extract program donates them (the donating fold programs ran
+        earlier, producing these arrays). Transfers here deliberately
+        bypass the flush TransferLedger — a query must not perturb the
+        O(samples) transfer-window accounting the flush telemetry pins.
+
+        Returns None when the epoch had no histogram rows."""
+        if fields is None:
+            return None
+        sh = self._shard
+
+        def evaluate(qs_np: np.ndarray) -> tuple[np.ndarray, int]:
+            """f32[P] quantiles → (packed [s_eff, P+10] host array in
+            LOGICAL row order, P). Column layout: see
+            columnar.unpack_extract_columns."""
+            qnp = np.asarray(qs_np, dtype=np.float32)
+            if sh is not None:
+                qs = sh.replicate(qnp)
+                out = sh.flush_extract(*fields, qs)
+                packed = np.asarray(_pack_extract_columns(*out))
+                packed = packed[sh.perm_l2p(s_eff)]
+            else:
+                qs = jnp.asarray(qnp)
+                out = self._extract(fields, qs)
+                packed = np.asarray(_pack_extract_columns(*out))
+            return packed, out[0].shape[1]
+
+        return evaluate
 
     def flush(self, quantiles: np.ndarray, interval_s: float = 10.0
               ) -> FlushSnapshot:

@@ -164,6 +164,38 @@ def tenant_totals(pool: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# Fenced reads (the live query path, veneur_tpu/query/)
+#
+# `query` / `tenant_totals` above were written for the flush path, where
+# the caller owns the pool lifecycle. The entry points below are for
+# reads OUTSIDE the flush — they take the pool reference captured at the
+# epoch fence, hash keys host-side, run only the pure (non-donating)
+# jitted programs, and read the result back to host. Nothing here can
+# mutate pool state: every mutation in this module goes through
+# insert_batch/insert_chunked, which RETURN new arrays rather than
+# writing in place — pinned by the bit-identity regression in
+# tests/test_query.py.
+
+
+def read_query(pool: jax.Array, tenant_row: int,
+               keys: list[str]) -> np.ndarray:
+    """Fenced CMS point estimates for `keys` against one tenant's sketch
+    row: i64[len(keys)], pool state untouched."""
+    if not keys:
+        return np.zeros(0, dtype=np.int64)
+    _t, d, w = pool.shape
+    rows = np.full(len(keys), int(tenant_row), dtype=np.int32)
+    cols = split_hashes(hash_keys(keys), d, w)
+    est = query(pool, jnp.asarray(rows), jnp.asarray(cols))
+    return np.asarray(est).astype(np.int64)
+
+
+def read_totals(pool: jax.Array) -> np.ndarray:
+    """Fenced per-tenant exact insert totals: i64[T], pool untouched."""
+    return np.asarray(tenant_totals(pool)).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
 # Host-side mergeable top-k (space-saving / stream-summary)
 
 

@@ -1,0 +1,24 @@
+"""Live query subsystem: epoch-fenced reads over the always-hot device
+mirror.
+
+Between flush ticks every sketch stays resident on device (the PR 6
+always-hot mirror, sharded since PR 10), but until this package that
+state was write-only — readable once per interval, through the flush.
+The query subsystem turns the aggregation tier into a queryable store:
+
+* ``engine``  — QueryEngine: per-worker epoch views staged at the fence
+  inside extract_snapshot, committed as ONE epoch by the server after
+  every worker extracted (two-phase publish: no torn cross-worker reads)
+* ``service`` — the gRPC front (veneurtpu.Query/Query, JSON over raw
+  bytes, riding the distributed/rpc.py plumbing)
+* ``http``    — the HTTP front: /metrics in Prometheus exposition text
+  (the SAME renderer the exposition sink uses, sinks/exposition.py),
+  /query for the JSON API, /healthz
+
+Parity contract (the CI lane): a query at the flush quantile vector is
+bitwise identical to what the flush itself read back, because the
+evaluator re-runs the very same compiled extraction program over the
+very same retained post-fold device arrays.
+"""
+
+from veneur_tpu.query.engine import QueryEngine  # noqa: F401

@@ -9,7 +9,6 @@ character set.
 from __future__ import annotations
 
 import logging
-import re
 import socket
 from typing import Optional
 
@@ -18,56 +17,19 @@ from veneur_tpu.sinks import MetricSink
 from veneur_tpu.sinks.delivery import make_manager
 from veneur_tpu.sinks.journal_codec import HttpEnvelope
 
+# the exposition-text formatter lives in sinks/exposition.py so the
+# live query surface (veneur_tpu/query/http.py) and this sink serialize
+# series identically; the names are re-exported here for compatibility
+from veneur_tpu.sinks.exposition import (  # noqa: F401
+    expo_sample,
+    expo_value,
+    render_columnar,
+    render_metrics,
+    sanitize_name,
+    sanitize_tag,
+)
+
 log = logging.getLogger("veneur_tpu.sinks.prometheus")
-
-_INVALID_NAME = re.compile(r"[^a-zA-Z0-9_:.]")  # dots map to exporter paths
-_INVALID_TAG = re.compile(r"[^a-zA-Z0-9_:,=\.]")
-# exposition format: metric names allow [a-zA-Z0-9_:], label names
-# [a-zA-Z0-9_] (the exposition writer has no dot-to-path mapping)
-_INVALID_EXPO_NAME = re.compile(r"[^a-zA-Z0-9_:]")
-_INVALID_EXPO_LABEL = re.compile(r"[^a-zA-Z0-9_]")
-
-
-def sanitize_name(name: str) -> str:
-    return _INVALID_NAME.sub("_", name)
-
-
-def sanitize_tag(tag: str) -> str:
-    return _INVALID_TAG.sub("_", tag)
-
-
-def expo_value(v: float) -> str:
-    """Exposition sample value rendering (pinned == the native
-    emitter's expo_value_append)."""
-    if v != v:
-        return "NaN"
-    if v == float("inf"):
-        return "+Inf"
-    if v == float("-inf"):
-        return "-Inf"
-    return str(v)
-
-
-def expo_sample(name: str, tags: list[str], value: float,
-                excluded_tags=None) -> str:
-    """One exposition text line: name{label="value",...} value\\n.
-    Label keys dedup by their SANITIZED form (last value wins, first
-    position kept); exclusion matches the RAW tag key. Pinned
-    byte-identical to vn_encode_prometheus_exposition."""
-    labels: dict[str, str] = {}
-    for tag in tags:
-        rawkey, _, val = tag.partition(":")
-        if excluded_tags and rawkey in excluded_tags:
-            continue
-        key = _INVALID_EXPO_LABEL.sub("_", rawkey)
-        labels[key] = val
-    line = _INVALID_EXPO_NAME.sub("_", name)
-    if labels:
-        line += "{" + ",".join(
-            '%s="%s"' % (k, v.replace("\\", "\\\\").replace('"', '\\"')
-                         .replace("\n", "\\n"))
-            for k, v in labels.items()) + "}"
-    return f"{line} {expo_value(value)}\n"
 
 
 class PrometheusMetricSink(MetricSink):
@@ -278,73 +240,23 @@ class PrometheusExpositionSink(MetricSink):
     def name(self) -> str:
         return "prometheus"
 
-    def _group_samples(self, g, excluded_tags, append) -> None:
-        counter = MetricType.COUNTER
-        gauge = MetricType.GAUGE
-        for fam in g.families:
-            if fam.type not in (counter, gauge):
-                continue
-            vals = fam.values.tolist()
-            suffix = fam.suffix
-            for i in g.rows_for(fam).tolist():
-                name, tags, sinks = g.meta_at(i)
-                if g.has_routing and sinks is not None \
-                        and self.name() not in sinks:
-                    continue
-                append(expo_sample(name + suffix if suffix else name,
-                                   tags, vals[i], excluded_tags))
-
-    def _extra_samples(self, batch, excluded_tags, append) -> None:
-        for m in batch.extras:
-            if m.sinks is not None and self.name() not in m.sinks:
-                continue
-            if m.type not in (MetricType.COUNTER, MetricType.GAUGE):
-                continue
-            append(expo_sample(m.name, m.tags, m.value, excluded_tags))
-
     def flush(self, metrics) -> None:
-        parts = []
-        for m in metrics:
-            if m.type in (MetricType.COUNTER, MetricType.GAUGE):
-                parts.append(expo_sample(m.name, m.tags, m.value))
-        self._post("".join(parts).encode("utf-8"), len(parts))
+        body, count = render_metrics(metrics)
+        self._post(body, count)
 
     def flush_columnar(self, batch, excluded_tags=None) -> None:
-        parts: list[str] = []
-        for g in batch.groups:
-            self._group_samples(g, excluded_tags, parts.append)
-        self._extra_samples(batch, excluded_tags, parts.append)
-        self._post("".join(parts).encode("utf-8"), len(parts))
+        body, count = render_columnar(batch, self.name(), excluded_tags,
+                                      native=False)
+        self._post(body, count)
 
     def flush_columnar_native(self, batch, excluded_tags=None) -> bool:
         from veneur_tpu import native as native_mod
 
         if not native_mod.emit_available():
             return False
-        plans = batch.emit_plan()
-        chunks: list[bytes] = []
-        count = 0
-        excl = sorted(excluded_tags) if excluded_tags else []
-        for g, plan in zip(batch.groups, plans):
-            out = None
-            if plan is not None:
-                out = native_mod.encode_prometheus_exposition(
-                    plan.meta_blob, plan.nrows, plan.suffixes,
-                    plan.family_types, plan.values, plan.masks, excl)
-            if out is None:
-                parts: list[str] = []
-                self._group_samples(g, excluded_tags, parts.append)
-                chunks.append("".join(parts).encode("utf-8"))
-                count += len(parts)
-                continue
-            blob, n = out
-            chunks.append(blob)
-            count += n
-        parts = []
-        self._extra_samples(batch, excluded_tags, parts.append)
-        chunks.append("".join(parts).encode("utf-8"))
-        count += len(parts)
-        self._post(b"".join(chunks), count)
+        body, count = render_columnar(batch, self.name(), excluded_tags,
+                                      native=True)
+        self._post(body, count)
         return True
 
     def _post(self, body: bytes, count: int) -> None:
