@@ -32,6 +32,7 @@
 #include <cstring>
 #include <string>
 #include <string_view>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -996,6 +997,90 @@ long long vn_deflate_chunks(const char* buf, const long long* offs,
   *out = zbuf.data();
   *out_len = static_cast<long long>(zbuf.size());
   return n_chunks;
+}
+
+// ---------------------------------------------------------------------------
+// VMB1 archive section (veneur_tpu/archive/wire.py SECTION_COLUMNAR):
+// one ColumnGroup serialized dense — a first-appearance local string
+// table (per-row name then tags, then family suffixes), the row
+// metadata table, the family table, then the f64 value / u8 mask planes
+// memcpy'd straight from the flush arrays. Byte-identical to the Python
+// encoder (_columnar_section_py), pinned by tests/test_archive.py; all
+// integers little-endian (LE-only CI, like the span wire). Returns the
+// emitted sample count (mask popcount), or -1 on malformed meta.
+
+long long vn_encode_archive_section(
+    const char* meta, long long meta_len, long long nrows,
+    const char* suffixes_blob, long long suffixes_len,
+    const signed char* family_types, int nfam, const double* values,
+    const unsigned char* masks, const char** out, long long* out_len) {
+  thread_local std::string buf;
+  buf.clear();
+
+  auto put_u16 = [](std::string* b, unsigned v) {
+    char t[2] = {static_cast<char>(v), static_cast<char>(v >> 8)};
+    b->append(t, 2);
+  };
+  auto put_u32 = [](std::string* b, unsigned long v) {
+    char t[4] = {static_cast<char>(v), static_cast<char>(v >> 8),
+                 static_cast<char>(v >> 16), static_cast<char>(v >> 24)};
+    b->append(t, 4);
+  };
+
+  std::vector<std::string_view> strings;
+  std::unordered_map<std::string_view, uint32_t> ids;
+  auto sid = [&](std::string_view s) -> uint32_t {
+    auto it = ids.find(s);
+    if (it != ids.end()) return it->second;
+    uint32_t i = static_cast<uint32_t>(strings.size());
+    ids.emplace(s, i);
+    strings.push_back(s);
+    return i;
+  };
+
+  std::vector<std::string_view> recs = split_rs(
+      std::string_view(meta, static_cast<size_t>(meta_len)), nrows);
+  std::string rows;
+  rows.reserve(static_cast<size_t>(nrows) * 10);
+  for (auto& rec : recs) {
+    std::vector<std::string_view> fields = split_us(rec);
+    if (fields.empty()) fields.push_back(std::string_view());
+    if (fields.size() - 1 > 0xFFFF) return -1;
+    put_u32(&rows, sid(fields[0]));
+    put_u16(&rows, static_cast<unsigned>(fields.size() - 1));
+    for (size_t t = 1; t < fields.size(); ++t) put_u32(&rows, sid(fields[t]));
+  }
+
+  std::vector<std::string_view> suffixes =
+      split_us(std::string_view(suffixes_blob,
+                                static_cast<size_t>(suffixes_len)));
+  while (static_cast<int>(suffixes.size()) < nfam)
+    suffixes.push_back(std::string_view());
+  std::string fams;
+  put_u32(&fams, static_cast<unsigned long>(nfam));
+  for (int f = 0; f < nfam; ++f) {
+    fams.push_back(static_cast<char>(family_types[f]));
+    put_u32(&fams, sid(suffixes[static_cast<size_t>(f)]));
+  }
+
+  size_t plane = static_cast<size_t>(nfam) * static_cast<size_t>(nrows);
+  buf.reserve(rows.size() + fams.size() + plane * 9 + strings.size() * 12);
+  put_u32(&buf, static_cast<unsigned long>(strings.size()));
+  for (auto& s : strings) {
+    put_u32(&buf, static_cast<unsigned long>(s.size()));
+    buf.append(s.data(), s.size());
+  }
+  put_u32(&buf, static_cast<unsigned long>(nrows));
+  buf.append(rows);
+  buf.append(fams);
+  buf.append(reinterpret_cast<const char*>(values), plane * 8);
+  buf.append(reinterpret_cast<const char*>(masks), plane);
+
+  long long count = 0;
+  for (size_t i = 0; i < plane; ++i) count += masks[i] ? 1 : 0;
+  *out = buf.data();
+  *out_len = static_cast<long long>(buf.size());
+  return count;
 }
 
 }  // extern "C"

@@ -1,0 +1,444 @@
+"""Flush archival & replay: the VMB1 wire format (python/native
+parity, corruption matrix), the segmented archive sink (rotation,
+bounds, delivery conservation), and bit-identical capture→replay
+through the import path."""
+
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from veneur_tpu import native
+from veneur_tpu.archive.wire import (MAGIC, _frame, decode_flush,
+                                     encode_flush, encode_metrics)
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.flusher import device_quantiles, generate_columnar
+from veneur_tpu.core.metrics import (HistogramAggregates, InterMetric,
+                                     MetricType)
+from veneur_tpu.core.server import Server
+from veneur_tpu.core.worker import DeviceWorker
+from veneur_tpu.protocol.dogstatsd import parse_metric, parse_service_check
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+PCTS = [0.5, 0.99]
+
+
+def _workload(w: DeviceWorker):
+    rng = np.random.default_rng(7)
+    for i in range(12):
+        for v in rng.gamma(2.0, 50.0, 15):
+            w.process_metric(
+                parse_metric(f"h{i}:{v:.3f}|ms|#k:{i}".encode()))
+    for i in range(20):
+        w.process_metric(parse_metric(f"c{i}:3|c|#a:{i},b:x".encode()))
+        w.process_metric(parse_metric(f"g{i}:7.25|g".encode()))
+    for j in range(40):
+        w.process_metric(parse_metric(f"s0:item{j}|s".encode()))
+    w.process_metric(parse_metric(b"routed:1|c|#veneursinkonly:datadog"))
+    w.process_metric(parse_service_check(b"_sc|svc.check|1|m:all good"))
+
+
+def _batch(now=1234):
+    w = DeviceWorker()
+    _workload(w)
+    snap = w.flush(device_quantiles(PCTS, AGGS), interval_s=10.0)
+    return generate_columnar(snap, True, PCTS, AGGS, now=now)
+
+
+def _canon(m):
+    """Bit-exact sample identity (timestamps/hostnames excluded)."""
+    return (m["name"] if isinstance(m, dict) else m.name,
+            tuple(sorted(m["tags"] if isinstance(m, dict) else m.tags)),
+            int(m["type"] if isinstance(m, dict) else m.type),
+            struct.pack(
+                "<d",
+                float(m["value"] if isinstance(m, dict) else m.value)
+            ).hex())
+
+
+# ---------------------------------------------------------------------------
+# VMB1 wire format
+
+
+def test_object_path_roundtrip_is_exact():
+    metrics = [
+        InterMetric(name="c", timestamp=99, value=17.0, tags=["a:1"],
+                    type=MetricType.COUNTER),
+        InterMetric(name="g", timestamp=99, value=0.1 + 0.2, tags=[],
+                    type=MetricType.GAUGE),
+        InterMetric(name="chk", timestamp=99, value=1.0, tags=["t:x"],
+                    type=MetricType.STATUS, message="all good",
+                    hostname="h9"),
+    ]
+    frame, n = encode_metrics(metrics, hostname="me")
+    assert n == 3
+    out = decode_flush(frame)
+    assert out["hostname"] == "me" and out["timestamp"] == 99
+    assert [s["name"] for s in out["samples"]] == ["c", "g", "chk"]
+    # raw IEEE-754 bits, not a parse-back: 0.1+0.2 survives exactly
+    assert struct.pack("<d", out["samples"][1]["value"]) == struct.pack(
+        "<d", 0.1 + 0.2)
+    assert out["samples"][2]["message"] == "all good"
+    assert out["samples"][2]["hostname"] == "h9"
+
+
+def test_columnar_frame_matches_materialize_bit_exact():
+    batch = _batch()
+    frame, n = encode_flush(batch, "host1", use_native=False)
+    mats = batch.materialize()
+    assert n == len(mats)
+    decoded = decode_flush(frame)
+    assert sorted(map(_canon, decoded["samples"])) == sorted(
+        map(_canon, mats))
+
+
+@pytest.mark.skipif(not native.emit_available(),
+                    reason="native emit tier not loaded")
+def test_native_and_python_frames_byte_identical():
+    batch = _batch()
+    py, n_py = encode_flush(batch, "host1", use_native=False)
+    nat, n_nat = encode_flush(batch, "host1", use_native=True)
+    assert n_py == n_nat
+    assert py == nat
+
+
+def test_routing_honors_sink_name():
+    batch = _batch()
+    _, total = encode_flush(batch, use_native=False)
+    frame_arch, n_arch = encode_flush(batch, sink_name="archive",
+                                      use_native=False)
+    names_arch = {s["name"]
+                  for s in decode_flush(frame_arch)["samples"]}
+    assert "routed" not in names_arch  # veneursinkonly:datadog
+    assert "svc.check" in names_arch   # unrouted extra rides along
+    frame_dd, n_dd = encode_flush(batch, sink_name="datadog",
+                                  use_native=False)
+    names_dd = {s["name"] for s in decode_flush(frame_dd)["samples"]}
+    assert "routed" in names_dd
+    assert n_dd == total and n_arch == total - 1
+
+
+def test_excluded_tags_rewrite_rows():
+    batch = _batch()
+    frame, n = encode_flush(batch, excluded_tags={"a"},
+                            use_native=False)
+    decoded = decode_flush(frame)
+    assert n == len(batch.materialize())  # exclusion drops tags, not rows
+    assert all(not t.startswith("a:")
+               for s in decoded["samples"] for t in s["tags"])
+    assert any("b:x" in s["tags"] for s in decoded["samples"])
+
+
+def _corruptions():
+    good, _ = encode_metrics(
+        [InterMetric(name="x", timestamp=1, value=2.0, tags=[],
+                     type=MetricType.GAUGE)])
+    flipped = bytearray(good)
+    flipped[12] ^= 0x40
+    yield "bad-magic", b"XXXX" + good[4:]
+    yield "empty", b""
+    yield "truncated-header", good[:6]
+    yield "truncated-payload", good[:-3]
+    yield "payload-bitflip", bytes(flipped)
+    yield "trailing-bytes", good + b"\x00"
+    # valid outer CRC, garbage inside:
+    yield "unknown-section-kind", _frame(1, "", [(7, b"")])
+    yield "truncated-section", _frame(1, "", [(1, b"\x01\x00")])
+    yield "columnar-plane-mismatch", _frame(1, "", [(0, (
+        struct.pack("<I", 1) + struct.pack("<I", 1) + b"n"   # strings
+        + struct.pack("<I", 1)                               # nrows
+        + struct.pack("<IH", 0, 0)                           # row
+        + struct.pack("<I", 1) + struct.pack("<BI", 0, 0)    # fam
+        + b"\x00" * 5))])                                    # != 9 bytes
+
+
+@pytest.mark.parametrize("name,frame", list(_corruptions()),
+                         ids=[n for n, _ in _corruptions()])
+def test_corruption_matrix_raises_never_garbage(name, frame):
+    with pytest.raises(ValueError):
+        decode_flush(frame)
+
+
+def test_decoder_accepts_what_the_matrix_mutated():
+    # the corruption fixtures start from a decodable frame — prove it
+    good, _ = encode_metrics(
+        [InterMetric(name="x", timestamp=1, value=2.0, tags=[],
+                     type=MetricType.GAUGE)])
+    assert decode_flush(good)["samples"][0]["name"] == "x"
+
+
+# ---------------------------------------------------------------------------
+# segmented archive writer
+
+
+def test_writer_rotates_prunes_and_reads_back(tmp_path):
+    from veneur_tpu.archive.sink import (SegmentedArchiveWriter,
+                                         read_archive)
+
+    d = str(tmp_path)
+    w = SegmentedArchiveWriter(d, max_segment_bytes=120, max_segments=2)
+    frames = [f"frame-{i:04d}".encode() * 4 for i in range(10)]
+    for f in frames:
+        w.write(f, 1.0)
+    w.close()
+    segs = [n for n in sorted(os.listdir(d))
+            if n.startswith("metrics-") and n.endswith(".vmb")]
+    assert 1 <= len(segs) <= 2  # bounded: oldest segments pruned
+    got = read_archive(d)
+    assert got  # the surviving tail, in write order
+    assert got == frames[-len(got):]
+
+
+def test_writer_seq_resumes_without_clobbering(tmp_path):
+    from veneur_tpu.archive.sink import (SegmentedArchiveWriter,
+                                         read_archive)
+
+    d = str(tmp_path)
+    w = SegmentedArchiveWriter(d, max_segment_bytes=1, max_segments=8)
+    w.write(b"first", 1.0)
+    w.close()
+    w2 = SegmentedArchiveWriter(d, max_segment_bytes=1, max_segments=8)
+    w2.write(b"second", 1.0)
+    w2.close()
+    assert read_archive(d) == [b"first", b"second"]
+    assert len(os.listdir(d)) == 2  # a new segment, not an overwrite
+
+
+def test_read_archive_stops_at_torn_tail(tmp_path):
+    from veneur_tpu.archive.sink import (SegmentedArchiveWriter,
+                                         read_archive)
+
+    d = str(tmp_path)
+    w = SegmentedArchiveWriter(d)
+    w.write(b"good-frame", 1.0)
+    w.write(b"also-good", 1.0)
+    w.close()
+    seg = os.path.join(d, sorted(os.listdir(d))[-1])
+    with open(seg, "ab") as fh:  # a crash mid-append: header, no body
+        fh.write(struct.pack("<II", 100, zlib.crc32(b"never-written")))
+        fh.write(b"partial")
+    assert read_archive(d) == [b"good-frame", b"also-good"]
+
+
+# ---------------------------------------------------------------------------
+# archive sink: delivery conservation under a failing disk
+
+
+class _FlakyWriter:
+    def __init__(self):
+        self.fail = False
+        self.frames = []
+
+    def write(self, payload: bytes, timeout_s: float) -> None:
+        if self.fail:
+            raise OSError("disk full")
+        self.frames.append(payload)
+
+    def close(self) -> None:
+        pass
+
+
+def _policy(**kw):
+    from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+    base = dict(retry_max=0, breaker_threshold=0,
+                spill_max_bytes=1 << 20, spill_max_payloads=16,
+                timeout_s=1.0, deadline_s=1.0, backoff_base_s=0.0,
+                backoff_max_s=0.0)
+    base.update(kw)
+    return DeliveryPolicy(**base)
+
+
+def test_sink_spills_and_redelivers_on_disk_recovery():
+    from veneur_tpu.archive.sink import MetricArchiveSink
+
+    batch = _batch()
+    # the sink routes as "archive": the veneursinkonly:datadog row is
+    # someone else's, so it never enters this sink's sample ledger
+    _, n = encode_flush(batch, sink_name="archive", use_native=False)
+    writer = _FlakyWriter()
+    sink = MetricArchiveSink(writer, hostname="h", delivery=_policy())
+    writer.fail = True
+    sink.flush_columnar(batch)
+    assert sink.metrics_deferred == n and sink.metrics_flushed == 0
+    assert sink.delivery.conserved()
+    writer.fail = False
+    sink.flush_columnar(batch)  # next interval: spill drains first
+    assert len(writer.frames) == 2
+    st = sink.delivery.stats()
+    assert st["delivered_payloads"] == 2 and st["spilled_payloads"] == 0
+    assert sink.metrics_flushed == n  # the second frame's samples
+    assert sink.delivery.conserved()
+
+
+def test_sink_drops_honestly_with_spill_disabled():
+    from veneur_tpu.archive.sink import MetricArchiveSink
+
+    batch = _batch()
+    _, n = encode_flush(batch, sink_name="archive", use_native=False)
+    writer = _FlakyWriter()
+    sink = MetricArchiveSink(
+        writer, delivery=_policy(spill_max_bytes=0, spill_max_payloads=0))
+    writer.fail = True
+    sink.flush_columnar(batch)
+    assert sink.metrics_dropped == n and sink.metrics_flushed == 0
+    assert sink.delivery.stats()["dropped_payloads"] == 1
+    assert sink.delivery.conserved()
+    # sample ledger: flushed + dropped + deferred covers every sample
+    assert (sink.metrics_flushed + sink.metrics_dropped
+            + sink.metrics_deferred) == n
+
+
+# ---------------------------------------------------------------------------
+# capture → replay through the import path
+
+
+def _canon_flush(out):
+    mats = out.materialize() if hasattr(out, "materialize") else list(out)
+    from collections import Counter
+    return Counter(map(_canon, mats))
+
+
+def test_capture_replay_bit_identical(tmp_path):
+    from veneur_tpu.archive.replay import replay_frames
+    from veneur_tpu.archive.sink import (MetricArchiveSink,
+                                         SegmentedArchiveWriter,
+                                         read_archive)
+    from veneur_tpu.distributed.import_server import ImportServer
+
+    sink = MetricArchiveSink(SegmentedArchiveWriter(str(tmp_path)),
+                             hostname="a")
+    srv_a = Server(Config(interval="10s", percentiles=PCTS,
+                          aggregates=["min", "max", "count"]),
+                   metric_sinks=[sink])
+    try:
+        for i in range(30):
+            srv_a.process_metric_packet(f"rt.c{i}:{3 * i + 1}|c".encode())
+            srv_a.process_metric_packet(f"rt.g{i}:{i}.625|g".encode())
+            srv_a.process_metric_packet(f"rt.t{i}:{i}.5|ms".encode())
+        expected = _canon_flush(srv_a.flush())
+    finally:
+        srv_a.shutdown()
+    frames = read_archive(str(tmp_path))
+    assert frames and sink.metrics_flushed == sum(expected.values())
+
+    srv_b = Server(Config(interval="10s"))
+    try:
+        imp = ImportServer(srv_b)
+        stats = replay_frames(frames, apply_batch=imp.handle_batch)
+        assert stats["frames_applied"] == len(frames)
+        assert stats["skipped_status"] == stats["skipped_inexact"] == 0
+        assert _canon_flush(srv_b.flush()) == expected
+    finally:
+        srv_b.shutdown()
+
+
+def test_replay_twice_with_dedup_merges_once(tmp_path):
+    from veneur_tpu.archive.replay import replay_frames
+    from veneur_tpu.archive.sink import (MetricArchiveSink,
+                                         SegmentedArchiveWriter,
+                                         read_archive)
+    from veneur_tpu.distributed.import_server import ImportServer
+
+    sink = MetricArchiveSink(SegmentedArchiveWriter(str(tmp_path)))
+    srv_a = Server(Config(interval="10s"), metric_sinks=[sink])
+    try:
+        for i in range(10):
+            srv_a.process_metric_packet(f"dd.c{i}:5|c".encode())
+        expected = _canon_flush(srv_a.flush())
+    finally:
+        srv_a.shutdown()
+    frames = read_archive(str(tmp_path))
+
+    srv_b = Server(Config(interval="10s"))
+    try:
+        imp = ImportServer(srv_b)
+        s1 = replay_frames(frames, apply_wire=imp.handle_wire, dedup=True)
+        s2 = replay_frames(frames, apply_wire=imp.handle_wire, dedup=True)
+        # same archive → same sender token → same (sender, id) keys
+        assert s1["sender"] == s2["sender"]
+        assert s1["sender"].startswith("archive:")
+        assert imp.metrics_deduped == s2["imported"] > 0
+        assert _canon_flush(srv_b.flush()) == expected
+    finally:
+        srv_b.shutdown()
+
+
+def test_replay_requires_wire_entrypoint_for_dedup():
+    from veneur_tpu.archive.replay import replay_frames
+
+    with pytest.raises(ValueError):
+        replay_frames([], apply_batch=lambda b: None, dedup=True)
+
+
+def test_replay_skips_status_and_inexact_counters():
+    from veneur_tpu.archive.replay import samples_to_batch
+
+    samples = [
+        {"name": "ok", "tags": ["a:1"], "type": int(MetricType.COUNTER),
+         "value": 4.0, "message": "", "hostname": ""},
+        {"name": "frac", "tags": [], "type": int(MetricType.COUNTER),
+         "value": 1.5, "message": "", "hostname": ""},
+        {"name": "chk", "tags": [], "type": int(MetricType.STATUS),
+         "value": 1.0, "message": "m", "hostname": "h"},
+        {"name": "g", "tags": [], "type": int(MetricType.GAUGE),
+         "value": 2.5, "message": "", "hostname": ""},
+    ]
+    batch, skipped = samples_to_batch(samples)
+    assert [m.name for m in batch.metrics] == ["ok", "g"]
+    assert skipped == {"status": 1, "inexact": 1}
+
+
+def test_replay_counts_undecodable_frames_not_fatal():
+    from veneur_tpu.archive.replay import replay_frames
+
+    good, _ = encode_metrics(
+        [InterMetric(name="x", timestamp=1, value=2.0, tags=[],
+                     type=MetricType.GAUGE)])
+    applied = []
+    stats = replay_frames([good, b"garbage", good],
+                          apply_batch=applied.append)
+    assert stats["frames_undecodable"] == 1
+    assert stats["frames_applied"] == 2 and len(applied) == 2
+
+
+def test_sender_token_is_content_derived():
+    from veneur_tpu.archive.replay import archive_sender_token
+
+    a = archive_sender_token([b"f1", b"f2"])
+    assert a == archive_sender_token([b"f1", b"f2"])
+    assert a != archive_sender_token([b"f2", b"f1"])
+    assert a.startswith("archive:")
+
+
+# ---------------------------------------------------------------------------
+# server integration: archive sink on the native-emit flush path
+
+
+def test_server_flush_drives_archive_sink_natively(tmp_path):
+    from veneur_tpu.archive.sink import (MetricArchiveSink,
+                                         SegmentedArchiveWriter,
+                                         read_archive)
+
+    sink = MetricArchiveSink(SegmentedArchiveWriter(str(tmp_path)),
+                             hostname="nat")
+    srv = Server(Config(interval="10s", percentiles=[0.5],
+                        aggregates=["min", "max", "count"]),
+                 metric_sinks=[sink])
+    try:
+        for i in range(8):
+            srv.process_metric_packet(f"nv{i}:2|c".encode())
+            srv.process_metric_packet(f"nt{i}:3.5|ms".encode())
+        expected = _canon_flush(srv.flush())
+    finally:
+        srv.shutdown()
+    [frame] = read_archive(str(tmp_path))
+    decoded = decode_flush(frame)
+    assert decoded["hostname"] == "nat"
+    from collections import Counter
+    assert Counter(map(_canon, decoded["samples"])) == expected
+    assert sink.metrics_flushed == sum(expected.values())
+    assert sink.frames_encoded == 1
+    assert sink.bytes_encoded == len(frame)

@@ -147,9 +147,10 @@ def test_server_columnar_with_legacy_sink():
         srv.shutdown()
 
 
-def test_server_object_path_with_plugin():
-    """Plugins still need the object list, so their presence keeps the
-    legacy path (flush returns the list itself)."""
+def test_server_columnar_path_with_plugin():
+    """Plugins ride the columnar path: they receive the batch itself
+    (iterable through the shared memoized materialization), so their
+    presence no longer demotes every sink to the object path."""
     from veneur_tpu.sinks.channel import ChannelMetricSink
 
     class _Plugin:
@@ -168,9 +169,12 @@ def test_server_object_path_with_plugin():
     try:
         srv.process_metric_packet(b"t:5|ms")
         out = srv.flush()
-        assert isinstance(out, list)
+        names = {m.name for m in out}  # columnar batch, iterable
+        assert names == {"t.count"}
         got = sink.queue.get_nowait()
         assert got and got[0].name == "t.count"
+        assert _Plugin.flushed is not None
+        assert [m.name for m in _Plugin.flushed] == ["t.count"]
     finally:
         srv.shutdown()
 

@@ -91,17 +91,20 @@ def main() -> None:
                          "modes plus the speedup")
     ap.add_argument("--ab-axis", default="pipeline",
                     choices=["pipeline", "emit-native", "micro-fold",
-                             "reader-shards"],
+                             "reader-shards", "archive"],
                     help="what --ab compares: serial vs pipelined "
                          "flush (default), Python vs native emit "
                          "serializers (forces --sink serialize; both "
                          "sides use --flush-pipeline as given), "
                          "once-per-interval vs always-hot micro-fold "
                          "staging (both sides use --flush-pipeline and "
-                         "--sink as given), or legacy digest-routed vs "
+                         "--sink as given), legacy digest-routed vs "
                          "shared-nothing reader-sharded ingest (both "
                          "sides run --readers reader threads; only the "
-                         "commit topology differs)")
+                         "commit topology differs), or archive sink "
+                         "off vs on (flushes additionally serialize "
+                         "into the segmented VMB1 archive; speedup <= 1 "
+                         "is the honest archival overhead)")
     ap.add_argument("--readers", type=int, default=1,
                     help="C++ reader threads sharing the listen port "
                          "(SO_REUSEPORT). With num_workers=1 and >1 "
@@ -142,6 +145,9 @@ def main() -> None:
     args = ap.parse_args()
     if args.workload == "ssf" and args.out == "SUSTAINED_PIPELINE.json":
         args.out = "SPAN_SUSTAINED.json"
+    if (args.ab and args.ab_axis == "archive"
+            and args.out == "SUSTAINED_PIPELINE.json"):
+        args.out = "ARCHIVE_SUSTAINED.json"
     _reexec_scrubbed()
 
     from _soak_common import write_artifact
@@ -246,6 +252,17 @@ def main() -> None:
             mode_list = [("legacy_routed", {"reader_shards": 0}),
                          ("reader_sharded",
                           {"reader_shards": args.readers})]
+        elif args.ab_axis == "archive":
+            # flush with vs without the segmented VMB1 archive sink.
+            # This axis measures a COST, not a win: the on side pays
+            # native frame serialization + checksummed segment appends
+            # every interval, so speedup <= 1 is the honest number.
+            import tempfile as _tempfile
+
+            sink_mode = args.sink
+            archive_dir = _tempfile.mkdtemp(prefix="bench-archive-")
+            mode_list = [("archive_off", {}),
+                         ("archive_on", {"archive_dir": archive_dir})]
         else:
             sink_mode = args.sink
             mode_list = [("serial", {"flush_pipeline": False}),
@@ -381,6 +398,30 @@ def main() -> None:
             summary["legacy_routed_lines_per_s"] = base_rate
             summary["speedup_vs_legacy_routed"] = speedup
             summary["readers"] = args.readers
+        elif args.ab_axis == "archive":
+            # honest overhead: speedup <= 1 means archival costs
+            # throughput; the conservation block proves the measured
+            # run archived every sample it claims to have (exact
+            # ledger, nothing dropped or deferred on a healthy disk)
+            out["speedup_vs_archive_off"] = speedup
+            on = modes["archive_on"]
+            ledger = on.get("archive_ledger") or {}
+            out["archive_ab"] = {
+                "overhead_frac": (round(1.0 - speedup, 3)
+                                  if speedup is not None else None),
+                **{k: (on.get("archive_confirm") or {}).get(k)
+                   for k in ("archive_frames_total",
+                             "archive_bytes_total",
+                             "archive_samples_total",
+                             "archive_bytes_per_interval_mean")},
+                "ledger": ledger,
+                "conserved": bool(ledger.get("conserved"))
+                and not (ledger.get("metrics_dropped")
+                         or ledger.get("metrics_deferred")),
+            }
+            summary["archive_off_lines_per_s"] = base_rate
+            summary["speedup_vs_archive_off"] = speedup
+            summary["archive_conserved"] = out["archive_ab"]["conserved"]
         else:
             out["speedup_vs_serial"] = speedup
             summary["serial_lines_per_s"] = base_rate

@@ -359,6 +359,38 @@ timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     --intervals 4 --interval 2s --min-cadence 0.25 --keys 1000 \
     --flush-pipeline --out "${TMPDIR:-/tmp}/SPAN_SUSTAINED_SMOKE.json"
 
+# Archive round-trip lane: the flush archive (veneur_tpu/archive/) must
+# capture a real factory-wired server's flush bit-identically (raw
+# IEEE-754 value planes in VMB1 frames), replay it through the import
+# path into a fresh server bit-identically, and absorb a SECOND dedup
+# replay without double-counting — with the sink's sample ledger and
+# the delivery manager's payload ledger exact. The VMB1 corruption
+# matrix (torn tails, bit flips, truncated sections, unknown kinds)
+# and the SigV4 blob-egress vectors run first so a codec or signer
+# drift is named by its test, not by the soak. The soak's miniature
+# artifact goes to /tmp — the committed ARCHIVE_REPLAY_SOAK.json is
+# the full-workload run.
+echo "== archive round-trip lane (capture -> replay -> dedup) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_archive.py tests/test_plugins.py \
+    -q -m 'not slow'
+timeout -k 10 300 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/soak_archive_replay.py --quick
+env -u PALLAS_AXON_POOL_IPS python - <<PYGATE
+import json, os
+p = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                 "ARCHIVE_REPLAY_SOAK.json")
+d = json.load(open(p))
+bi = d["bit_identical"]
+assert bi["archive"], "archived frames drifted from the flush"
+assert bi["replay"], "replayed flush drifted from the original"
+assert bi["dedup_twice"], "double dedup-replay double-counted"
+assert d["conservation"]["exact"], d["conservation"]
+assert d["ok"] and not d["failures"], d["failures"]
+print("archive round-trip gate: bit-identical x3, conservation exact")
+PYGATE
+
 echo "== test suite =="
 python -m pytest tests/ -q
 

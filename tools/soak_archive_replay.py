@@ -1,0 +1,245 @@
+"""Capture→replay round-trip soak for the flush archive.
+
+Drives a REAL server (built through the factory, so the archive_dir
+config wiring is under test) with a seeded deterministic workload,
+flushes once into the segmented VMB1 archive, then proves the full
+archival contract end to end:
+
+1. ARCHIVE FIDELITY — decoding the archived frames yields exactly the
+   multiset of (name, sorted-tags, type, IEEE-754 value bits) the
+   server flushed. Bit-identical, not approximately-equal: the frame
+   carries the raw f64 flush planes.
+2. REPLAY FIDELITY — re-ingesting the archive through the import path
+   (ImportServer.handle_batch, the same merge entrypoint forwarded
+   traffic uses) into a FRESH server and flushing it re-emits the
+   identical multiset. Counters merge as integers, gauges as raw
+   doubles; nothing rounds.
+3. REPLAY IDEMPOTENCE — replaying the same archive TWICE under VDE1
+   dedup envelopes (--dedup path of tools/replay_archive.py) merges
+   ONCE: the second pass is absorbed by the receiver's dedup window,
+   and the doubly-replayed server still flushes the single-copy
+   multiset.
+4. EXACT CONSERVATION — the archive sink's sample ledger
+   (``metrics_flushed + metrics_dropped + metrics_deferred``) equals
+   every sample encoded, zero dropped/deferred on a healthy disk, and
+   the DeliveryManager's payload ledger
+   (``accepted == delivered + dropped + spilled``) holds exactly.
+
+Writes ARCHIVE_REPLAY_SOAK.json at the repo root and prints one JSON
+line; exits nonzero on any violated invariant.
+
+Usage: python tools/soak_archive_replay.py [--quick] [--seed 42]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import random
+import struct
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _soak_common import write_artifact  # noqa: E402
+
+
+def canon_metric(name, tags, mtype, value) -> tuple:
+    """The bit-exact identity of one flushed sample: timestamps and
+    hostnames excluded (they legitimately differ across the replay),
+    value keyed by its raw IEEE-754 bits, never by float equality."""
+    return (name, tuple(sorted(tags)), int(mtype),
+            struct.pack("<d", float(value)).hex())
+
+
+def canon_flush(out) -> collections.Counter:
+    mats = out.materialize() if hasattr(out, "materialize") else list(out)
+    return collections.Counter(
+        canon_metric(m.name, m.tags, m.type, m.value) for m in mats)
+
+
+def canon_samples(samples) -> collections.Counter:
+    return collections.Counter(
+        canon_metric(s["name"], s["tags"], s["type"], s["value"])
+        for s in samples)
+
+
+def diff_summary(a: collections.Counter, b: collections.Counter) -> dict:
+    return {"only_expected": len(a - b), "only_got": len(b - a),
+            "sample_only_expected": list(map(str, list((a - b))[:3])),
+            "sample_only_got": list(map(str, list((b - a))[:3]))}
+
+
+def inject(srv, seed: int, quick: bool) -> int:
+    """Seeded deterministic workload across every archivable shape:
+    integer counters, full-precision double gauges, timers (whose
+    aggregates flush as counter + gauges), and an HLL set."""
+    rng = random.Random(seed)
+    n = 40 if quick else 200
+    lines = 0
+    for i in range(n):
+        srv.process_metric_packet(
+            f"arch.count{i}:{rng.randrange(1, 1 << 30)}|c"
+            f"|#shard:{i % 7}".encode())
+        srv.process_metric_packet(
+            f"arch.gauge{i}:{rng.random() * 1e6!r}|g"
+            f"|#shard:{i % 5}".encode())
+        lines += 2
+    for i in range(n // 2):
+        for _ in range(8):
+            srv.process_metric_packet(
+                f"arch.timer{i}:{rng.random() * 100.0!r}|ms".encode())
+            lines += 1
+    for i in range(n):
+        srv.process_metric_packet(f"arch.set:{rng.randrange(5000)}|s"
+                                  .encode())
+        lines += 1
+    return lines
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI lane: smaller workload, same invariants")
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+
+    from veneur_tpu.archive.replay import (archive_sender_token,
+                                           replay_frames)
+    from veneur_tpu.archive.sink import read_archive
+    from veneur_tpu.archive.wire import decode_flush
+    from veneur_tpu.core.config import Config
+    from veneur_tpu.core.factory import build_server
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.distributed.import_server import ImportServer
+
+    t0 = time.time()
+    failures: list[str] = []
+    work = tempfile.mkdtemp(prefix="archive-soak-")
+    archive_dir = os.path.join(work, "archive")
+
+    # -- phase 1: capture (factory-wired server, one archived flush) --
+    cfg = Config(interval="10s", percentiles=[0.5, 0.99],
+                 aggregates=["min", "max", "count"],
+                 hostname="archive-soak", num_workers=2,
+                 archive_dir=archive_dir)
+    srv_a = build_server(cfg)
+    sink = next(s for s in srv_a.metric_sinks if s.name() == "archive")
+    lines = inject(srv_a, args.seed, args.quick)
+    out_a = srv_a.flush()
+    expected = canon_flush(out_a)
+    sink_stats = {
+        "metrics_flushed": sink.metrics_flushed,
+        "metrics_dropped": sink.metrics_dropped,
+        "metrics_deferred": sink.metrics_deferred,
+        "frames_encoded": sink.frames_encoded,
+        "bytes_encoded": sink.bytes_encoded,
+    }
+    delivery = sink.delivery.stats()
+    conserved = sink.delivery.conserved()
+    srv_a.shutdown()
+
+    total = sum(expected.values())
+    if sink.metrics_flushed != total:
+        failures.append(
+            f"sink ledger: metrics_flushed {sink.metrics_flushed} != "
+            f"{total} flushed samples")
+    if sink.metrics_dropped or sink.metrics_deferred:
+        failures.append(
+            f"healthy disk but dropped={sink.metrics_dropped} "
+            f"deferred={sink.metrics_deferred}")
+    if not conserved:
+        failures.append(f"delivery payload ledger violated: {delivery}")
+
+    # -- invariant 1: archive fidelity (decode == flushed, bit-exact) --
+    frames = read_archive(archive_dir)
+    if not frames:
+        failures.append("no frames in the archive after flush")
+    archived = collections.Counter()
+    for frame in frames:
+        try:
+            archived += canon_samples(decode_flush(frame)["samples"])
+        except ValueError as e:
+            failures.append(f"archived frame undecodable: {e}")
+    archive_identical = archived == expected
+    if not archive_identical:
+        failures.append(
+            f"archive != flush: {diff_summary(expected, archived)}")
+
+    # -- invariant 2: replay fidelity (fresh server, import path) -----
+    srv_b = Server(Config(interval="10s", num_workers=2))
+    imp_b = ImportServer(srv_b)
+    stats_b = replay_frames(frames, apply_batch=imp_b.handle_batch)
+    replayed = canon_flush(srv_b.flush())
+    srv_b.shutdown()
+    replay_identical = replayed == expected
+    if not replay_identical:
+        failures.append(
+            f"replay != flush: {diff_summary(expected, replayed)}")
+    if stats_b["skipped_status"] or stats_b["skipped_inexact"]:
+        failures.append(f"replay skipped samples on an exact workload: "
+                        f"{stats_b}")
+
+    # -- invariant 3: dedup idempotence (twice replayed, once merged) --
+    srv_c = Server(Config(interval="10s", num_workers=2))
+    imp_c = ImportServer(srv_c)
+    sender = archive_sender_token(frames)
+    stats_c1 = replay_frames(frames, apply_wire=imp_c.handle_wire,
+                             dedup=True, sender=sender)
+    stats_c2 = replay_frames(frames, apply_wire=imp_c.handle_wire,
+                             dedup=True, sender=sender)
+    deduped = canon_flush(srv_c.flush())
+    srv_c.shutdown()
+    dedup_identical = deduped == expected
+    if not dedup_identical:
+        failures.append(
+            f"double dedup-replay != single copy: "
+            f"{diff_summary(expected, deduped)}")
+    if stats_c1["sender"] != stats_c2["sender"]:
+        failures.append("sender token unstable across replay runs")
+
+    out = {
+        "platform": "cpu",
+        "seed": args.seed,
+        "quick": args.quick,
+        "workload_lines": lines,
+        "flushed_samples": total,
+        "frames": len(frames),
+        "archive_bytes": sum(len(f) for f in frames),
+        "bit_identical": {
+            "archive": archive_identical,
+            "replay": replay_identical,
+            "dedup_twice": dedup_identical,
+        },
+        "conservation": {
+            "sink": sink_stats,
+            "delivery": delivery,
+            "exact": conserved
+            and sink.metrics_flushed == total
+            and not (sink.metrics_dropped or sink.metrics_deferred),
+        },
+        "replay_stats": stats_b,
+        "dedup_stats": {"first": stats_c1, "second": stats_c2},
+        "duration_s": round(time.time() - t0, 1),
+        "failures": failures,
+        "ok": not failures,
+    }
+    write_artifact("ARCHIVE_REPLAY_SOAK.json", out)
+    print(json.dumps({
+        "metric": "archive_replay_soak_ok", "value": out["ok"],
+        "flushed_samples": total, "frames": len(frames),
+        "bit_identical": out["bit_identical"],
+        "conservation_exact": out["conservation"]["exact"],
+        "failures": failures,
+    }))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

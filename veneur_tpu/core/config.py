@@ -483,6 +483,20 @@ class Config:
     aws_region: str = ""
     aws_s3_bucket: str = ""
 
+    # flush archival (veneur_tpu/archive/): a rotated, size-and-count-
+    # bounded local VMB1 archive of every flush, replayable through the
+    # import path (tools/replay_archive.py). Empty archive_dir = off.
+    archive_dir: str = ""
+    archive_max_bytes: int = 64 << 20    # per-segment rotation size
+    archive_max_segments: int = 8        # oldest segment unlinked past this
+    # blob egress: the same VMB1 frames PUT to S3-compatible storage
+    # under archive/<hostname>/<timestamp>-<seq>.vmb, through the
+    # delivery layer (retry/breaker/spill). Empty bucket = off.
+    archive_blob_bucket: str = ""
+    archive_blob_region: str = "us-east-1"
+    archive_blob_access_key: str = ""
+    archive_blob_secret_key: str = ""
+
     def interval_seconds(self) -> float:
         return parse_duration(self.interval)
 
@@ -716,6 +730,7 @@ SECRET_FIELDS = {
     "aws_access_key_id", "aws_secret_access_key", "newrelic_insert_key",
     "splunk_hec_token", "lightstep_access_token",
     "trace_lightstep_access_token", "tls_key",
+    "archive_blob_secret_key",
 }
 
 
@@ -1034,7 +1049,25 @@ def validate_config(cfg: Config) -> None:
                          " 'protobuf', 'json' or 'columnar' (columnar"
                          " ships one VSB1 frame per sealed span batch"
                          " through the delivery manager)")
+    _validate_archive_keys(cfg)
     _validate_query_keys(cfg)
+
+
+def _validate_archive_keys(cfg) -> None:
+    if cfg.archive_max_bytes < 1:
+        raise ValueError("archive_max_bytes must be >= 1 (a segment must"
+                         " be able to hold at least one byte; rotation"
+                         " is checked per-frame, not mid-frame)")
+    if cfg.archive_max_segments < 1:
+        raise ValueError("archive_max_segments must be >= 1 (the archive"
+                         " keeps at least the active segment)")
+    if cfg.archive_blob_bucket and not cfg.archive_blob_access_key:
+        raise ValueError("archive_blob_bucket requires"
+                         " archive_blob_access_key (+ secret); the blob"
+                         " egress signs every PUT with SigV4")
+    if cfg.archive_blob_access_key and not cfg.archive_blob_secret_key:
+        raise ValueError("archive_blob_access_key requires"
+                         " archive_blob_secret_key")
 
 
 def _validate_query_keys(cfg) -> None:

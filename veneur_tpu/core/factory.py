@@ -261,6 +261,17 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
             batch_rows=cfg.span_batch_rows,
             pending_cap=cfg.span_pending_cap))
 
+    if cfg.archive_dir:
+        from veneur_tpu.archive import (
+            MetricArchiveSink, SegmentedArchiveWriter)
+
+        metric_sinks.append(MetricArchiveSink(
+            SegmentedArchiveWriter(cfg.archive_dir,
+                                   max_segment_bytes=cfg.archive_max_bytes,
+                                   max_segments=cfg.archive_max_segments),
+            hostname=hostname,
+            delivery=policy))
+
     if cfg.debug_flushed_metrics:
         from veneur_tpu.sinks.debug import DebugMetricSink
 
@@ -285,6 +296,14 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
             cfg.aws_s3_bucket, cfg.aws_region or "us-east-1",
             cfg.aws_access_key_id, cfg.aws_secret_access_key, interval,
             **kw,
+        ))
+    if cfg.archive_blob_bucket and cfg.archive_blob_access_key:
+        from veneur_tpu.archive import ArchiveBlobPlugin
+
+        server.plugins.append(ArchiveBlobPlugin(
+            cfg.archive_blob_bucket, cfg.archive_blob_region,
+            cfg.archive_blob_access_key, cfg.archive_blob_secret_key,
+            **dkw,
         ))
 
     # forwarding (local instances)

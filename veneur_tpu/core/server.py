@@ -348,6 +348,9 @@ class Server:
         # (health/policy.py delivery_should_signal_behind)
         self._delivery_reported: dict[tuple[str, str], int] = {}
         self._delivery_behind_consec = 0
+        # plugins.* interval-delta bookkeeping (plugin flush failures
+        # ride the self-telemetry stream, not just the logs)
+        self._plugin_reported: dict[tuple[str, str], int] = {}
         # write-ahead spill journals (utils/journal.py), one per
         # journalable delivery manager, attached in start() when
         # spill_journal_dir is set; shutdown_stats is filled by
@@ -662,6 +665,10 @@ class Server:
             man = getattr(sink, "delivery", None)
             if man is not None:
                 out.append((sink.name() + "_spans", man))
+        for plugin in self.plugins:
+            man = getattr(plugin, "delivery", None)
+            if man is not None:
+                out.append((plugin.name(), man))
         return out
 
     @property
@@ -2118,8 +2125,11 @@ class Server:
         # consume the SoA batch directly; the rest share ONE memoized
         # materialization via the base flush_columnar, so a single legacy
         # sink no longer demotes every sink to the object path. Plugins
-        # still need the object list, so they keep the legacy path.
-        use_columnar = bool(self.metric_sinks) and not self.plugins
+        # ride it too: they receive the batch itself — archival plugins
+        # (veneur_tpu/archive/blob.py) serialize its arrays zero-copy,
+        # and legacy TSV plugins iterate it, which shares the same
+        # memoized materialization the object-path sinks use.
+        use_columnar = bool(self.metric_sinks or self.plugins)
         final = job.final
         batch = None
         n_flushed = 0
@@ -2183,6 +2193,8 @@ class Server:
             for t in threads:
                 t.join(timeout=self.interval)
             phases["sink_flush_s"] = time.perf_counter() - _t
+            if self.plugins:
+                self._run_plugins_clipped(batch, phases)
         elif final:
             threads = []
             for sink in self.metric_sinks:
@@ -2199,10 +2211,7 @@ class Server:
                 t.join(timeout=self.interval)
             phases["sink_flush_s"] = time.perf_counter() - _t
             if self.plugins:
-                threading.Thread(
-                    target=self._flush_plugins, args=(final,), daemon=True,
-                    name="flush-plugins",
-                ).start()
+                self._run_plugins_clipped(final, phases)
         else:
             # quiet tick (nothing aggregated this interval): the sinks'
             # flush funnels never ran, but spilled payloads must keep
@@ -2347,6 +2356,25 @@ class Server:
                 self._span_sink_reported[key] = total
                 if delta:
                     self.stats.count(metric, delta, tags=tags)
+        # plugin delta counters: the plugins' own cumulative failure /
+        # progress attributes (localfile/s3/archive_blob) reported as
+        # interval deltas like the sinks above, so a silently failing
+        # archiver shows up on the same dashboard as a failing sink
+        for plugin in self.plugins:
+            pname = plugin.name()
+            ptags = [f"plugin:{pname}"]
+            for attr, metric in (
+                    ("flush_errors", "plugins.flush_errors_total"),
+                    ("uploads", "plugins.uploads_total"),
+                    ("rotations", "plugins.rotations_total")):
+                total = getattr(plugin, attr, None)
+                if total is None:
+                    continue
+                key = (pname, attr)
+                delta = total - self._plugin_reported.get(key, 0)
+                self._plugin_reported[key] = total
+                if delta:
+                    self.stats.count(metric, delta, tags=ptags)
         # delivery-reliability telemetry (sinks/delivery.py): every
         # manager's cumulative counters as interval deltas, breaker and
         # spill occupancy as gauges. A sink behind — breaker not closed
@@ -2441,13 +2469,41 @@ class Server:
         est = hll_ops.estimate(merged[None, :], precision=precision)
         return int(float(np.asarray(est)[0]))
 
-    def _flush_plugins(self, metrics: list[InterMetric]) -> None:
-        """reference flusher.go:117-131: plugins run after the sinks."""
+    def _run_plugins_clipped(self, metrics, phases: dict) -> None:
+        """Run the plugin pass in a worker thread joined at the flush
+        interval — the same deadline clipping sinks get — so a hung
+        plugin (blocked PUT, full disk) can never stall the emit stage
+        past its tick. The thread is daemon: an overrun finishes (or
+        dies with the process) without wedging shutdown."""
+        t0 = time.perf_counter()
+        t = threading.Thread(
+            target=self._flush_plugins, args=(metrics,), daemon=True,
+            name="flush-plugins",
+        )
+        t.start()
+        t.join(timeout=self.interval)
+        if t.is_alive():
+            self.stats.count("plugins.flush_clipped_total", 1)
+        phases["plugin_flush_s"] = time.perf_counter() - t0
+
+    def _flush_plugins(self, metrics) -> None:
+        """reference flusher.go:117-131: plugins run after the sinks.
+        ``metrics`` is the ColumnarMetrics batch on the columnar path
+        (iterable via the shared materialization) or the object list.
+        Failures count — an exception here rides the self-telemetry
+        stream as plugins.flush_errors_total, not just the log."""
         for plugin in self.plugins:
+            start = time.time()
+            tags = [f"plugin:{plugin.name()}"]
             try:
                 plugin.flush(metrics, self.hostname)
             except Exception:
                 log.exception("plugin %s flush failed", plugin.name())
+                self.stats.count("plugins.flush_errors_total", 1, tags=tags)
+            finally:
+                self.stats.time_in_nanoseconds(
+                    "plugins.flush_total_duration_ns",
+                    (time.time() - start) * 1e9, tags=tags)
 
     def _flush_sink_columnar(self, sink: MetricSink, batch,
                              excluded_tags) -> None:

@@ -143,7 +143,27 @@ class LoadHarness:
             self.sink = ChannelMetricSink()
         else:
             raise ValueError("sink_mode must be channel or serialize")
-        self.server = Server(cfg, metric_sinks=[self.sink],
+        # flush archival rides the measured flush path when configured:
+        # the --ab-axis archive "on" side attaches the real
+        # MetricArchiveSink (native VMB1 serialize + segmented append
+        # behind the delivery manager) alongside the measurement sink,
+        # so the A/B prices exactly what production would pay
+        self.archive_sink = None
+        metric_sinks = [self.sink]
+        if cfg.archive_dir:
+            from veneur_tpu.archive import (MetricArchiveSink,
+                                            SegmentedArchiveWriter)
+            from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+            self.archive_sink = MetricArchiveSink(
+                SegmentedArchiveWriter(
+                    cfg.archive_dir,
+                    max_segment_bytes=cfg.archive_max_bytes,
+                    max_segments=cfg.archive_max_segments),
+                hostname="loadgen",
+                delivery=DeliveryPolicy.from_config(cfg, self.interval))
+            metric_sinks.append(self.archive_sink)
+        self.server = Server(cfg, metric_sinks=metric_sinks,
                              span_sinks=span_sinks)
         ports = self.server.start()
         self._sock = self._connect(ports)
@@ -243,6 +263,15 @@ class LoadHarness:
         ssf_sender = self._ssf_sender
         snap["ssf_sent_spans"] = (ssf_sender.sent_lines
                                   if ssf_sender else 0)
+        arch = self.archive_sink
+        if arch is not None:
+            snap["archive"] = {
+                "frames": arch.frames_encoded,
+                "bytes": arch.bytes_encoded,
+                "samples": arch.metrics_flushed,
+                "dropped": arch.metrics_dropped,
+                "deferred": arch.metrics_deferred,
+            }
         return snap
 
     def _drain_sink(self) -> None:
@@ -352,6 +381,20 @@ class LoadHarness:
                         "committed": _deltas("committed"),
                         "dropped": _deltas("dropped"),
                     }
+                if self.archive_sink is not None:
+                    # per-interval archive egress deltas: the A/B
+                    # artifact's evidence that archival kept pace with
+                    # the flush cadence, and at what byte cost
+                    a_now = snap.get("archive") or {}
+                    a_prev = prev.get("archive") or {}
+                    intervals[-1].update({
+                        "archive_frames": (a_now.get("frames", 0)
+                                           - a_prev.get("frames", 0)),
+                        "archive_bytes": (a_now.get("bytes", 0)
+                                          - a_prev.get("bytes", 0)),
+                        "archive_samples": (a_now.get("samples", 0)
+                                            - a_prev.get("samples", 0)),
+                    })
                 if self.ssf_frac > 0:
                     sp_now = snap.get("spans") or {}
                     sp_prev = prev.get("spans") or {}
@@ -430,6 +473,15 @@ class LoadHarness:
             "drain_ms_mean": round(
                 sum(i["drain_ms"] for i in intervals) / n_iv, 2),
             "micro_folds_total": sum(i["micro_folds"] for i in intervals),
+            **({"archive_frames_total": sum(
+                    i.get("archive_frames", 0) for i in intervals),
+                "archive_bytes_total": sum(
+                    i.get("archive_bytes", 0) for i in intervals),
+                "archive_samples_total": sum(
+                    i.get("archive_samples", 0) for i in intervals),
+                "archive_bytes_per_interval_mean": round(sum(
+                    i.get("archive_bytes", 0) for i in intervals) / n_iv)}
+               if self.archive_sink is not None else {}),
             **steady,
             **({"pipeline": pipeline_stats} if pipeline_stats else {}),
             "offered_lines_per_s": rate,
@@ -468,6 +520,22 @@ class LoadHarness:
             s["balanced"] = (
                 s["received"] == s["derived"] + s["dropped"] + s["pending"])
         return s
+
+    def archive_stats(self) -> dict:
+        """The archive sink's sample ledger plus its delivery manager's
+        payload ledger — the A/B artifact's conservation evidence."""
+        a = self.archive_sink
+        if a is None:
+            return {}
+        return {
+            "frames_encoded": a.frames_encoded,
+            "bytes_encoded": a.bytes_encoded,
+            "metrics_flushed": a.metrics_flushed,
+            "metrics_dropped": a.metrics_dropped,
+            "metrics_deferred": a.metrics_deferred,
+            "delivery": a.delivery.stats(),
+            "conserved": a.delivery.conserved(),
+        }
 
     def close(self) -> None:
         if self._sender is not None:
@@ -648,4 +716,14 @@ def result_artifact(spec: WorkloadSpec, harness: LoadHarness,
                       "span_loss_frac")},
             "span_conservation": harness.span_conservation()}
            if harness.ssf_frac > 0 else {}),
+        # archive-sink runs: the confirmation run's archival volume
+        # (per-interval frames/bytes ride in confirm_intervals) plus
+        # the sink's lifetime sample/payload ledgers
+        **({"archive_confirm": {
+            k: confirm.get(k)
+            for k in ("archive_frames_total", "archive_bytes_total",
+                      "archive_samples_total",
+                      "archive_bytes_per_interval_mean")},
+            "archive_ledger": harness.archive_stats()}
+           if harness.archive_sink is not None else {}),
     }

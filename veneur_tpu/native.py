@@ -145,6 +145,16 @@ def load_library() -> Optional[ctypes.CDLL]:
         except AttributeError:  # pre-emit-tier library
             pass
         try:
+            # archive tier (native/emit.cpp): VMB1 columnar sections
+            lib.vn_encode_archive_section.restype = c.c_longlong
+            lib.vn_encode_archive_section.argtypes = [
+                c.c_char_p, c.c_longlong, c.c_longlong,
+                c.c_char_p, c.c_longlong,
+                c.c_void_p, c.c_int, c.c_void_p, c.c_void_p,
+                c.POINTER(c.c_char_p), c.POINTER(c.c_longlong)]
+        except AttributeError:  # pre-archive library
+            pass
+        try:
             lib.vn_set_lock_stats.argtypes = [c.c_int]
             lib.vn_lock_stats.restype = c.c_int
             lib.vn_lock_stats.argtypes = [
@@ -1015,6 +1025,34 @@ def _encode_lines(symbol: str, meta_blob, nrows: int,
     if n < 0:
         return None
     return ctypes.string_at(out, out_len.value), int(n)
+
+
+def encode_archive_section(meta_blob, nrows: int,
+                           suffixes: list[str],
+                           family_types: np.ndarray,
+                           values: np.ndarray, masks: np.ndarray
+                           ) -> "Optional[bytes]":
+    """One VMB1 columnar section body (archive/wire.py) straight from an
+    EmitGroupPlan's buffers, GIL-free; byte-identical to the Python
+    encoder. None when the library lacks the symbol."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_encode_archive_section"):
+        return None
+    c = ctypes
+    values = np.ascontiguousarray(values, np.float64)
+    masks = np.ascontiguousarray(masks, np.uint8)
+    family_types = np.ascontiguousarray(family_types, np.int8)
+    suffix_blob = "\x1f".join(suffixes).encode("utf-8")
+    meta_arg, meta_len = _blob_arg(meta_blob)
+    out = c.c_char_p()
+    out_len = c.c_longlong()
+    n = lib.vn_encode_archive_section(
+        meta_arg, meta_len, nrows, suffix_blob, len(suffix_blob),
+        _ptr(family_types), len(suffixes), _ptr(values), _ptr(masks),
+        c.byref(out), c.byref(out_len))
+    if n < 0:
+        return None
+    return ctypes.string_at(out, out_len.value)
 
 
 def encode_prometheus_lines(meta_blob, nrows: int,

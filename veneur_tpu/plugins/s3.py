@@ -13,6 +13,7 @@ import gzip
 import hashlib
 import hmac
 import logging
+import urllib.parse
 import urllib.request
 
 from veneur_tpu.plugins import Plugin, encode_inter_metrics_tsv
@@ -25,23 +26,50 @@ def _sign(key: bytes, msg: str) -> bytes:
     return hmac.new(key, msg.encode("utf-8"), hashlib.sha256).digest()
 
 
+def _canonical_query(query: str) -> str:
+    """RFC-3986 canonical query string: each name and value URI-encoded
+    (unreserved chars kept), pairs sorted by name then value, valueless
+    params rendered ``name=`` (the documented GET-bucket-lifecycle
+    example)."""
+    if not query:
+        return ""
+    pairs = []
+    for part in query.split("&"):
+        name, _, value = part.partition("=")
+        pairs.append((urllib.parse.quote(name, safe="-_.~"),
+                      urllib.parse.quote(value, safe="-_.~")))
+    return "&".join(f"{n}={v}" for n, v in sorted(pairs))
+
+
 def sigv4_headers(method: str, host: str, path: str, region: str,
                   access_key: str, secret_key: str, payload: bytes,
-                  now: datetime.datetime | None = None) -> dict[str, str]:
-    """Minimal AWS Signature Version 4 for S3 PUT/GET."""
+                  now: datetime.datetime | None = None, query: str = "",
+                  extra_headers: dict[str, str] | None = None
+                  ) -> dict[str, str]:
+    """AWS Signature Version 4 for S3, pinned against the documented AWS
+    signing examples (tests/test_plugins.py): canonical URI encoding,
+    canonical query strings, and arbitrary extra signed headers. The
+    returned dict carries everything the request must send (including
+    the extra headers), minus Host — the transport sets that."""
     t = now or datetime.datetime.now(datetime.timezone.utc)
     amz_date = t.strftime("%Y%m%dT%H%M%SZ")
     datestamp = t.strftime("%Y%m%d")
     payload_hash = hashlib.sha256(payload).hexdigest()
 
-    canonical_headers = (
-        f"host:{host}\n"
-        f"x-amz-content-sha256:{payload_hash}\n"
-        f"x-amz-date:{amz_date}\n"
-    )
-    signed_headers = "host;x-amz-content-sha256;x-amz-date"
+    headers = {
+        "host": host,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+    }
+    for k, v in (extra_headers or {}).items():
+        headers[k.lower()] = str(v).strip()
+    names = sorted(headers)
+    canonical_headers = "".join(f"{n}:{headers[n]}\n" for n in names)
+    signed_headers = ";".join(names)
+    canonical_uri = urllib.parse.quote(path, safe="/-_.~")
     canonical_request = "\n".join([
-        method, path, "", canonical_headers, signed_headers, payload_hash,
+        method, canonical_uri, _canonical_query(query),
+        canonical_headers, signed_headers, payload_hash,
     ])
     scope = f"{datestamp}/{region}/s3/aws4_request"
     string_to_sign = "\n".join([
@@ -54,7 +82,7 @@ def sigv4_headers(method: str, host: str, path: str, region: str,
     k = _sign(k, "aws4_request")
     signature = hmac.new(k, string_to_sign.encode(),
                          hashlib.sha256).hexdigest()
-    return {
+    out = {
         "x-amz-date": amz_date,
         "x-amz-content-sha256": payload_hash,
         "Authorization": (
@@ -62,6 +90,9 @@ def sigv4_headers(method: str, host: str, path: str, region: str,
             f" SignedHeaders={signed_headers}, Signature={signature}"
         ),
     }
+    for k, v in (extra_headers or {}).items():
+        out.setdefault(k, str(v).strip())
+    return out
 
 
 class S3Plugin(Plugin):

@@ -87,36 +87,45 @@ class KafkaBatchWriter:
 class SegmentedLogWriter:
     """Append-only local span-batch log, journal-style framed records
     (u32 length + u32 CRC + frame), size-rotated and segment-bounded:
-    oldest segment unlinked first, never unbounded disk."""
+    oldest segment unlinked first, never unbounded disk.
+
+    ``prefix``/``suffix`` name the segment files — the metric archive
+    (veneur_tpu/archive/sink.py) reuses this exact discipline for VMB1
+    frames under ``metrics-*.vmb``."""
 
     def __init__(self, directory: str, max_segment_bytes: int = 16 << 20,
-                 max_segments: int = 8) -> None:
+                 max_segments: int = 8, prefix: str = "spans-",
+                 suffix: str = ".vsb") -> None:
         self.directory = directory
         self.max_segment_bytes = max(1, int(max_segment_bytes))
         self.max_segments = max(1, int(max_segments))
+        self.prefix = prefix
+        self.suffix = suffix
         self._lock = threading.Lock()
         self._fh = None
         self._seq = 0
         self._written = 0
         os.makedirs(directory, exist_ok=True)
         for name in sorted(os.listdir(directory)):
-            if name.startswith("spans-") and name.endswith(".vsb"):
+            if name.startswith(prefix) and name.endswith(suffix):
                 try:
-                    self._seq = max(self._seq,
-                                    int(name[len("spans-"):-len(".vsb")]) + 1)
+                    self._seq = max(
+                        self._seq,
+                        int(name[len(prefix):-len(suffix)]) + 1)
                 except ValueError:
                     continue
 
     def _segments(self) -> list[str]:
         return sorted(
             n for n in os.listdir(self.directory)
-            if n.startswith("spans-") and n.endswith(".vsb"))
+            if n.startswith(self.prefix) and n.endswith(self.suffix))
 
     def _rotate_locked(self) -> None:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
-        path = os.path.join(self.directory, f"spans-{self._seq:08d}.vsb")
+        path = os.path.join(
+            self.directory, f"{self.prefix}{self._seq:08d}{self.suffix}")
         self._seq += 1
         self._fh = open(path, "ab")
         self._written = 0
@@ -141,12 +150,13 @@ class SegmentedLogWriter:
                 self._fh = None
 
 
-def read_segmented_log(directory: str) -> list[bytes]:
-    """Yield every VSB1 frame across the log's segments in write order
+def read_segmented_log(directory: str, prefix: str = "spans-",
+                       suffix: str = ".vsb") -> list[bytes]:
+    """Yield every frame across the log's segments in write order
     (replay tooling + tests); stops at a torn tail instead of raising."""
     frames: list[bytes] = []
     for name in sorted(os.listdir(directory)):
-        if not (name.startswith("spans-") and name.endswith(".vsb")):
+        if not (name.startswith(prefix) and name.endswith(suffix)):
             continue
         with open(os.path.join(directory, name), "rb") as fh:
             data = fh.read()
