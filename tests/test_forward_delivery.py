@@ -267,6 +267,47 @@ def test_routing_pool_behind_signal_after_consecutive_sheds():
         pool.stop()
 
 
+def test_routing_pool_stop_routes_admitted_backlog():
+    # an admitted batch has been acked upstream (streamed admission acks
+    # on enqueue), so stop() must drain the backlog through the workers
+    # — abandoning it would lose acked data with no drop counted
+    gate = threading.Event()
+    routed = []
+
+    def slow_route(kind, item):
+        gate.wait(5.0)
+        routed.append(item)
+
+    pool = RoutingPool(slow_route, workers=1, queue_max=4)
+    try:
+        for i in range(5):   # 1 in the worker + 4 queued
+            assert pool.submit_wait("batch", i, timeout_s=1.0)
+        assert pool.stats()["queue_depth"] == 4
+        t = threading.Thread(target=pool.stop)
+        t.start()
+        gate.set()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert sorted(routed) == [0, 1, 2, 3, 4]
+        assert pool.stats()["routed"] == 5
+        assert pool.stats()["queue_depth"] == 0
+    finally:
+        gate.set()
+
+
+def test_routing_pool_refuses_admission_while_stopping():
+    # late frames racing the shutdown grace window must NOT be acked:
+    # a busy-ack sends them to a live proxy instead (submit sheds, and
+    # the unary caller owns the drop accounting as usual)
+    pool = RoutingPool(lambda kind, item: None, workers=1, queue_max=4)
+    pool.stop()
+    assert not pool.submit_wait("batch", 1, timeout_s=0.1)
+    assert pool.stats()["admission_timeouts"] == 0  # refused, not timed out
+    assert not pool.submit("batch", 2)
+    assert pool.stats()["shed_batches"] == 1
+    assert pool.stats()["queue_depth"] == 0
+
+
 def test_route_batch_mid_loop_ring_loss_drops_only_remainder():
     # satellite (b): the ring emptying mid-route must lose only the
     # UN-routed remainder; metrics already grouped still forward

@@ -1,0 +1,204 @@
+"""Sharded proxy tier, sender side: SpreadForwarder spreading flush
+payloads across a live proxy fleet (distributed/spread.py).
+
+The acceptance pins mirror the proxy tier's own delivery tests:
+a dead proxy's share re-routes to survivors exactly once (respread
+counted, nothing silently lost, per-lane conservation identities exact
+through membership churn), and ambiguous re-sends are never counted as
+safe ones.
+"""
+
+import threading
+
+import pytest
+
+from veneur_tpu.distributed import rpc
+from veneur_tpu.distributed.spread import (
+    RESPREAD_SAFE_CAUSES,
+    SpreadForwarder,
+)
+from veneur_tpu.gen import veneur_tpu_pb2 as pb
+from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+
+class LaneClient:
+    """Scripted stand-in for a lane's ForwardClient: `down` sends raise
+    a classified ForwardError with a scriptable cause; up sends record
+    the delivered metric names and count like the real client does."""
+
+    streaming = False
+
+    def __init__(self, dest, timeout_s=1.0):
+        self.address = dest
+        self.timeout_s = timeout_s
+        self.down = False
+        self.cause = "unavailable"
+        self.sent = []            # metric names, in delivery order
+        self.sent_metrics = 0
+        self.send_calls = 0
+        self.closed = False
+        self._lock = threading.Lock()
+
+    def send_raw_or_raise(self, blob, n_metrics, timeout_s=None):
+        with self._lock:
+            self.send_calls += 1
+            if self.down:
+                raise rpc.ForwardError(self.cause, self.address,
+                                       f"scripted: {self.cause}")
+            self.sent.extend(
+                m.name for m in pb.MetricBatch.FromString(blob).metrics)
+            self.sent_metrics += n_metrics
+
+    def stats(self):
+        return {"address": self.address, "sent_batches": 0,
+                "sent_metrics": self.sent_metrics, "errors": {}}
+
+    def close(self):
+        self.closed = True
+
+
+def _blob(names):
+    b = pb.MetricBatch()
+    for n in names:
+        m = b.metrics.add()
+        m.name = n
+        m.kind = pb.KIND_COUNTER
+        m.scope = pb.SCOPE_GLOBAL
+        m.counter.value = 1
+    return b.SerializeToString()
+
+
+def _fwd(addrs, *, policy=None, spread_policy="p2c", clients=None):
+    clients = clients if clients is not None else {}
+
+    def factory(addr, timeout_s):
+        c = LaneClient(addr, timeout_s)
+        clients[addr] = c
+        return c
+
+    fwd = SpreadForwarder(
+        addrs,
+        timeout_s=0.2,
+        policy=policy or DeliveryPolicy(
+            retry_max=0, breaker_threshold=2, timeout_s=0.2,
+            deadline_s=5.0, backoff_base_s=0.0, backoff_max_s=0.0,
+            spill_max_bytes=1 << 20, spill_max_payloads=64),
+        spread_policy=spread_policy,
+        client_factory=factory)
+    return fwd, clients
+
+
+def test_spread_uses_every_live_proxy():
+    fwd, clients = _fwd(["p1:1", "p2:2", "p3:3"])
+    for i in range(60):
+        assert fwd.send_wire(_blob([f"m{i}"]), 1) == "delivered"
+    delivered = {a: len(c.sent) for a, c in clients.items()}
+    assert sum(delivered.values()) == 60
+    assert all(n > 0 for n in delivered.values()), delivered
+    assert fwd.ingested_metrics() == 60
+    assert fwd.conserved()
+    assert fwd.respread_total == 0 and fwd.dropped_metrics == 0
+
+
+def test_p2c_steers_away_from_deep_lane():
+    fwd, clients = _fwd(["p1:1", "p2:2"])
+    # park payloads toward p1: scripted down -> deliver defers to spill,
+    # raising p1's depth while p2 stays shallow
+    clients["p1:1"].down = True
+    fwd.send_wire(_blob(["park0"]), 1)
+    while not any(len(ln.manager.spill)
+                  for ln in fwd._lanes.values()):  # depth signal armed
+        fwd.send_wire(_blob(["park1"]), 1)
+    clients["p1:1"].down = False
+    before = clients["p2:2"].send_calls
+    for i in range(40):
+        fwd.send_wire(_blob([f"m{i}"]), 1)
+    # every depth-informed pick must prefer the shallow lane; sticky
+    # round-robin only fires on ties, which a parked spill rules out
+    assert fwd.picks_p2c > 0
+    assert clients["p2:2"].send_calls - before == 40
+
+
+def test_dead_proxy_share_respreads_to_survivor_exactly_once():
+    fwd, clients = _fwd(["p1:1", "p2:2"], spread_policy="round_robin")
+    names = [f"m{i}" for i in range(30)]
+    clients["p1:1"].down = True   # transport-refused: a safe cause
+    for n in names:
+        fwd.send_wire(_blob([n]), 1)
+    fwd.begin_flush()             # sweeps the opened lane's spill over
+    delivered = clients["p1:1"].sent + clients["p2:2"].sent
+    assert sorted(delivered) == sorted(names)     # nothing lost...
+    assert len(delivered) == len(set(delivered))  # ...nothing doubled
+    assert fwd.respread_total > 0
+    assert fwd.respread_ambiguous_total == 0   # unavailable is safe
+    assert fwd.dropped_metrics == 0
+    assert fwd.conserved()
+    # begin_flush arms a fresh breaker interval, so the dead lane reads
+    # open or half_open (probe pending) — anything but closed
+    assert fwd.breaker_states()["p1:1"] in ("open", "half_open")
+    stats = fwd.forward_stats()
+    assert stats["destinations"]["p1:1"]["respread_out"] > 0
+    assert stats["destinations"]["p2:2"]["respread_in"] > 0
+
+
+def test_ambiguous_cause_respreads_but_is_counted_separately():
+    assert "deadline_exceeded" not in RESPREAD_SAFE_CAUSES
+    fwd, clients = _fwd(["p1:1", "p2:2"], spread_policy="round_robin")
+    clients["p1:1"].down = True
+    clients["p1:1"].cause = "deadline_exceeded"
+    for i in range(20):
+        fwd.send_wire(_blob([f"m{i}"]), 1)
+    fwd.begin_flush()
+    assert fwd.respread_total > 0
+    # every ambiguous re-send is visible in BOTH counters — never
+    # laundered into the safe total
+    assert fwd.respread_ambiguous_total == fwd.respread_total
+    assert fwd.conserved()
+
+
+def test_membership_removal_respreads_spill_and_retains_ledger():
+    fwd, clients = _fwd(["p1:1", "p2:2"])
+    clients["p1:1"].down = True
+    names = [f"m{i}" for i in range(20)]
+    for n in names:
+        fwd.send_wire(_blob([n]), 1)
+    spilled = sum(len(ln.manager.spill) for ln in fwd._lanes.values())
+    change = fwd.set_destinations(["p2:2"], cause="discovery")
+    assert change["removed"] == ["p1:1"]
+    if spilled:
+        assert fwd.respread_total > 0
+    # exactly-once across the whole membership change
+    delivered = clients["p1:1"].sent + clients["p2:2"].sent
+    assert sorted(delivered) == sorted(names)
+    assert len(delivered) == len(set(delivered))
+    assert clients["p1:1"].closed
+    # the retired ledger still participates in conservation and stats
+    assert fwd.conserved()
+    dest = fwd.forward_stats()["destinations"]["p1:1"]
+    assert dest["live"] is False
+    assert fwd.ingested_metrics() == len(names)
+
+
+def test_no_survivors_is_a_declared_drop_not_a_silent_one():
+    fwd, clients = _fwd(["p1:1"])
+    clients["p1:1"].down = True
+    for i in range(10):
+        fwd.send_wire(_blob([f"m{i}"]), 1)
+    fwd.begin_flush()   # breaker open, respread finds no survivor
+    remaining = fwd.drain(deadline_s=0.1)
+    fwd.close()
+    # every undeliverable metric is either still parked or declared
+    # dropped — the ledger identity stays exact either way
+    assert fwd.dropped_metrics + remaining + len(clients["p1:1"].sent) >= 10
+    assert fwd.conserved()
+
+
+def test_spread_policy_validated():
+    with pytest.raises(ValueError):
+        SpreadForwarder(["p1:1"], spread_policy="random")
+
+
+def test_empty_fleet_drops_with_counter():
+    fwd, _ = _fwd([])
+    assert fwd.send_wire(_blob(["m0"]), 1) == "dropped"
+    assert fwd.dropped_metrics == 1

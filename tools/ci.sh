@@ -260,6 +260,46 @@ timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python tools/bench_ring_sustained.py --smoke --mode streaming \
     --rate 15000 --out "${TMPDIR:-/tmp}/RING_SUSTAINED_SMOKE.json"
 
+# Sharded-tier smoke: the same ring with spread senders over M=1 and
+# M=2 proxies. Gates the proxy-tier spreading path end to end: exact
+# conservation and duplicates == 0 through the SpreadForwarder, and
+# the 2-proxy fleet's capacity (sum of per-proxy metrics per proxy
+# CPU-second) at least that of 1 proxy — the co-scheduled 1-core rig
+# can't scale wall-clock throughput, so the capacity metric is the
+# honest scaling signal (see RING_PROXY_SCALING.json for the full
+# M=1/2/4 cells + chaos run).
+echo "== sharded proxy tier smoke (spread senders, M=1 vs M=2) =="
+timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/bench_ring_sustained.py --smoke --mode streaming \
+    --rate 15000 --spread --proxies 1 \
+    --out "${TMPDIR:-/tmp}/RING_SPREAD_SMOKE_1.json"
+timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/bench_ring_sustained.py --smoke --mode streaming \
+    --rate 15000 --proxies 2 \
+    --out "${TMPDIR:-/tmp}/RING_SPREAD_SMOKE_2.json"
+python - "${TMPDIR:-/tmp}/RING_SPREAD_SMOKE_1.json" \
+         "${TMPDIR:-/tmp}/RING_SPREAD_SMOKE_2.json" <<'PYGATE'
+import json, sys
+one = json.load(open(sys.argv[1]))
+two = json.load(open(sys.argv[2]))
+for cell in (one, two):
+    m = cell["proxies"]
+    assert cell["passed"], f"{m}-proxy spread smoke failed"
+    assert cell["duplicates_observed"] == 0, f"{m}-proxy: duplicates"
+    assert cell["conservation_exact"], f"{m}-proxy: conservation broken"
+    assert cell["spread_senders"], f"{m}-proxy: spread path not engaged"
+cap1 = one["proxy_tier_capacity_metrics_per_s"]
+cap2 = two["proxy_tier_capacity_metrics_per_s"]
+assert cap2 >= cap1, f"2-proxy capacity {cap2} < 1-proxy {cap1}"
+# co-scheduled guard: spreading must not cost wall-clock throughput
+assert two["value"] >= 0.85 * one["value"], \
+    f"2-proxy co-scheduled rate {two['value']} << 1-proxy {one['value']}"
+print(f"sharded-tier smoke: OK (capacity {cap1:.0f} -> {cap2:.0f} "
+      f"metrics/cpu-s, dups 0/0, conservation exact)")
+PYGATE
+
 # Committed-artifact gates: the repo-root soak/bench artifacts are the
 # full runs' evidence — re-parse them so a regeneration that silently
 # lost the exactly-once or streaming-wins property fails CI even if
@@ -283,9 +323,21 @@ assert r["checks"]["streaming_ge_unary"], \
 for mode, m in r["modes"].items():
     assert m["duplicates_observed"] == 0, \
         f"committed ring A/B: {mode} duplicates"
+s = json.load(open("RING_PROXY_SCALING.json"))
+assert not s["failures"], f"committed proxy scaling failed: {s['failures']}"
+for m, c in s["cells"].items():
+    assert c["duplicates_observed"] == 0, f"scaling cell {m}: duplicates"
+    assert c["conservation_exact"], f"scaling cell {m}: conservation"
+assert s["checks"]["capacity_scaling_near_linear"], \
+    "committed proxy scaling: capacity not near-linear"
+ch = s["chaos"]
+assert ch and not ch["failures"], \
+    f"committed proxy scaling chaos cell: {ch and ch['failures']}"
 print("committed-artifact gates: OK (churn dup=0, autoscale dup=0, "
       f"ring streaming {r['sustained_ring_metrics_per_s']}/s >= "
-      f"unary {r['modes']['unary']['sustained_ring_metrics_per_s']}/s)")
+      f"unary {r['modes']['unary']['sustained_ring_metrics_per_s']}/s, "
+      f"proxy capacity x{max(s['cells'])}/x{min(s['cells'])} "
+      f"{[v for k, v in s['capacity_scaling'].items() if k.startswith('x')][0]})")
 PYGATE
 
 # Sustained-rate floor: the loadgen harness drives a live server's UDP

@@ -48,18 +48,11 @@ def main(argv=None) -> int:
 
     # this proxy forwards downstream over one (gRPC) ring, so the
     # reference's separate HTTP and gRPC forward rings (proxy.go:163-166,
-    # 184-187) unify. When both static addresses are set they almost
-    # certainly name the same downstream pool — ring both and half the
-    # keys would dial a dead member — so the gRPC one wins.
-    if cfg.forward_address and cfg.grpc_forward_address:
-        log.warning("forward_address %r ignored: this proxy routes all "
-                    "forwards over one gRPC ring, using "
-                    "grpc_forward_address %r",
-                    cfg.forward_address, cfg.grpc_forward_address)
-        static = [cfg.grpc_forward_address]
-    else:
-        static = [a for a in (cfg.forward_address,
-                              cfg.grpc_forward_address) if a]
+    # 184-187) unify. A DIFFERING pair of static addresses is rejected
+    # at validation (validate_proxy_config) — by here at most one
+    # distinct address survives.
+    static = list(dict.fromkeys(
+        a for a in (cfg.forward_address, cfg.grpc_forward_address) if a))
     forward_service = (cfg.consul_forward_service_name
                        or cfg.consul_forward_grpc_service_name)
     accepting_forwards = bool(static or forward_service
@@ -210,6 +203,34 @@ def main(argv=None) -> int:
     if controller is not None:
         controller.start(cfg.elastic_observe_interval_s)
 
+    fleet_controller = None
+    if cfg.fleet_membership_file and cfg.fleet_autoscale:
+        # elastic PROXY tier: this proxy observes its own fan-in
+        # pressure (admission timeouts, stream window stalls, routing
+        # sheds/depth) and writes the desired proxy member set back
+        # through the shared fleet file every local-tier sender watches
+        # via forward_discovery_file. Exactly one proxy per fleet should
+        # arm this. No drained_fn: a demoted proxy keeps draining its
+        # own spill toward the globals after it leaves the fleet file —
+        # senders simply stop picking it.
+        from veneur_tpu.distributed.discovery import FileWatchDiscoverer
+        from veneur_tpu.distributed.elastic import (
+            ElasticController,
+            ProxyTierPressureSource,
+        )
+
+        fleet_watcher = FileWatchDiscoverer(cfg.fleet_membership_file)
+        tier_source = ProxyTierPressureSource(
+            lambda: {address: proxy.forward_stats()})
+        fleet_controller = ElasticController(
+            fleet_watcher, tier_source,
+            hysteresis_k=cfg.elastic_hysteresis_intervals,
+            cooldown_s=cfg.elastic_cooldown_s,
+            min_members=1,
+            max_members=cfg.elastic_max_members,
+            member_load_fn=tier_source.member_load)
+        fleet_controller.start(cfg.elastic_observe_interval_s)
+
     reporter = None
     if cfg.stats_address:
         from veneur_tpu import scopedstatsd
@@ -248,6 +269,8 @@ def main(argv=None) -> int:
                         else "")
     if reporter is not None:
         reporter.stop()
+    if fleet_controller is not None:
+        fleet_controller.stop()
     if controller is not None:
         controller.stop()
     if refresher is not None:

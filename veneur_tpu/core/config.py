@@ -218,6 +218,33 @@ class Config:
     # connection's lifetime, so mixed fleets interop either way.
     forward_streaming: bool = True
     forward_stream_window: int = 32
+    # sharded proxy tier (distributed/spread.py): instead of pinning ONE
+    # upstream in forward_address, the local tier can discover the proxy
+    # FLEET and spread each flush's forward payloads across live proxies
+    # (per-proxy streaming client + delivery manager; spread policy
+    # below). forward_discovery_file names a FileWatchDiscoverer
+    # members/standby file — the same watchable membership format the
+    # elastic global tier uses, so one fleet file feeds both the senders
+    # (read) and a proxy-tier autoscale controller (write).
+    # forward_address doubles as a STATIC fleet when it holds a
+    # comma-separated address list (no discovery daemon needed).
+    forward_discovery_file: str = ""
+    forward_discovery_interval: str = "10s"
+    # probe-gate discovered proxies (elastic.HealthGate over tcp_probe):
+    # unreachable candidates never enter the spread; a proxy whose
+    # breaker stays open across refreshes is quarantined out and
+    # re-admitted only on probe success
+    forward_discovery_probe: bool = True
+    # "p2c" = power-of-two-choices on in-flight window depth with a
+    # sticky round-robin fallback when depths tie; "round_robin" = plain
+    # rotation
+    forward_spread_policy: str = "p2c"
+    # per-proxy delivery knobs for the spread lanes (sinks/delivery.py
+    # DeliveryPolicy — the same machinery the proxies run per global)
+    forward_retry_max: int = 2
+    forward_breaker_threshold: int = 3
+    forward_spill_max_bytes: int = 8 << 20
+    forward_spill_max_payloads: int = 256
     # set-element hash: "fnv" (this framework's own, utils/hashing.hll_hash)
     # or "metro" (metro64 seed=1337, what the Go fleet inserts with —
     # REQUIRED on any instance that shares set series with Go veneur
@@ -501,9 +528,16 @@ class Config:
         return parse_duration(self.interval)
 
     def is_local(self) -> bool:
-        """A server is 'local' iff it forwards upstream
-        (reference server.go:1489-1491)."""
-        return self.forward_address != ""
+        """A server is 'local' iff it forwards upstream — through a
+        static address (or comma-separated fleet) OR a discovered proxy
+        fleet (reference server.go:1489-1491)."""
+        return bool(self.forward_address or self.forward_discovery_file)
+
+    def forward_destinations(self) -> list[str]:
+        """forward_address split as a static destination list (scheme
+        prefixes stripped for the gRPC path by the forwarder)."""
+        return [a.strip() for a in self.forward_address.split(",")
+                if a.strip()]
 
 
 @dataclass
@@ -590,6 +624,17 @@ class ProxyConfig:
     elastic_min_members: int = 1
     elastic_max_members: int = 0       # 0 = uncapped
     elastic_observe_interval_s: float = 10.0
+    # proxy-TIER elastics (the other half of "elastic both tiers"): this
+    # proxy can run the FLEET's autoscale controller over a shared
+    # members/standby file — the same watchable file the local tier's
+    # senders read through forward_discovery_file. Pressure comes from
+    # the proxy's OWN fan-in signals (routing-queue admission timeouts,
+    # stream window stalls, routing sheds; elastic.ProxyTierPressureSource)
+    # and the controller applies the same hysteresis/cooldown/
+    # graceful-leave semantics (elastic_* keys above) to the proxy
+    # fleet. Exactly one proxy per fleet should arm fleet_autoscale.
+    fleet_membership_file: str = ""
+    fleet_autoscale: bool = False
     # accepted for YAML compatibility with reference proxy configs;
     # nothing consumes it there either (config_proxy.go:23 has no
     # reader outside the config struct)
@@ -692,12 +737,29 @@ def _validate_elastic_keys(cfg) -> None:
                          " elastic_membership_file (the controller"
                          " writes the desired member set back through"
                          " the watchable file)")
+    if getattr(cfg, "fleet_autoscale", False) \
+            and not getattr(cfg, "fleet_membership_file", ""):
+        raise ValueError("fleet_autoscale requires fleet_membership_file"
+                         " (the proxy-tier controller writes the fleet's"
+                         " desired member set back through the watchable"
+                         " file the senders discover from)")
 
 
 def validate_proxy_config(cfg: ProxyConfig) -> None:
     parse_duration(cfg.forward_timeout)  # raises on nonsense
     parse_duration(cfg.consul_refresh_interval)
     parse_duration(cfg.runtime_metrics_interval)
+    if (cfg.forward_address and cfg.grpc_forward_address
+            and cfg.forward_address != cfg.grpc_forward_address):
+        # this proxy routes ALL forwards over one gRPC ring, so two
+        # different static addresses is an ambiguous config that used to
+        # be silently resolved by dropping forward_address — reject it
+        # at validation instead (set exactly one, or the same value)
+        raise ValueError(
+            "forward_address and grpc_forward_address are both set (to"
+            f" {cfg.forward_address!r} and {cfg.grpc_forward_address!r})"
+            " but this proxy routes all forwards over one gRPC ring —"
+            " set exactly one of them")
     if cfg.idle_connection_timeout:
         parse_duration(cfg.idle_connection_timeout)
     if cfg.forward_retry_max < 0:
@@ -910,6 +972,33 @@ def validate_config(cfg: Config) -> None:
     if cfg.forward_format == "jsonmetric" and cfg.forward_use_grpc:
         raise ValueError("forward_format: jsonmetric is the legacy HTTP"
                          " body; set forward_use_grpc: false")
+    # sharded proxy tier: the multi-destination spread rides the
+    # native-wire gRPC path only (spread.py sends serialized MetricBatch
+    # bytes per lane; the HTTP and forwardrpc interop forwarders stay
+    # single-destination)
+    multi_dest = (bool(cfg.forward_discovery_file)
+                  or len(cfg.forward_destinations()) > 1)
+    if multi_dest and not cfg.forward_use_grpc:
+        raise ValueError("a proxy fleet (forward_discovery_file or a"
+                         " comma-separated forward_address) requires"
+                         " forward_use_grpc: true")
+    if multi_dest and cfg.forward_format != "veneurtpu":
+        raise ValueError("a proxy fleet requires forward_format:"
+                         " veneurtpu (interop forwarders are"
+                         " single-destination)")
+    if cfg.forward_spread_policy not in ("p2c", "round_robin"):
+        raise ValueError("forward_spread_policy must be 'p2c' or"
+                         " 'round_robin'")
+    if cfg.forward_retry_max < 0:
+        raise ValueError("forward_retry_max must be >= 0 (0 means one"
+                         " attempt, no retries)")
+    if cfg.forward_breaker_threshold < 0:
+        raise ValueError("forward_breaker_threshold must be >= 0"
+                         " (0 disables the circuit breaker)")
+    if cfg.forward_spill_max_bytes < 0 or cfg.forward_spill_max_payloads < 0:
+        raise ValueError("forward spill caps must be >= 0 (0 drops"
+                         " failed payloads instead of spilling them)")
+    parse_duration(cfg.forward_discovery_interval)  # raises on nonsense
     if cfg.tpu_mesh_devices > 1 and cfg.num_workers != 1:
         raise ValueError(
             "tpu_mesh_devices requires num_workers: 1 (the mesh shards"

@@ -306,8 +306,9 @@ def build_server(cfg: Config, extra_metric_sinks=None, extra_span_sinks=None,
             **dkw,
         ))
 
-    # forwarding (local instances)
-    if cfg.forward_address:
+    # forwarding (local instances): one static upstream, or the sharded
+    # proxy tier (comma-separated forward_address / discovered fleet)
+    if cfg.forward_address or cfg.forward_discovery_file:
         from veneur_tpu.distributed.forward import install_forwarder
 
         install_forwarder(server)

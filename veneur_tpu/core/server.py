@@ -351,6 +351,9 @@ class Server:
         # plugins.* interval-delta bookkeeping (plugin flush failures
         # ride the self-telemetry stream, not just the logs)
         self._plugin_reported: dict[tuple[str, str], int] = {}
+        # forward.* interval-delta bookkeeping: per-proxy sender-side
+        # forwarder counters, keyed (proxy_addr, stat)
+        self._forward_reported: dict[tuple[str, str], int] = {}
         # write-ahead spill journals (utils/journal.py), one per
         # journalable delivery manager, attached in start() when
         # spill_journal_dir is set; shutdown_stats is filled by
@@ -2419,6 +2422,60 @@ class Server:
                     self._delivery_behind_consec)):
             self.stats.count("flush.delivery_behind_total", 1)
             self.flush_pipeline.note_downstream_behind()
+        # forward-path self-telemetry: the local forwarder's cumulative
+        # counters as interval deltas, per proxy destination (tagged
+        # proxy:<addr>) plus the spread-level respread/pick counters.
+        # GRPCForwarder and SpreadForwarder report the same shape via
+        # forward_stats() (the plain `stats` attribute is their
+        # telemetry sink), so a single-proxy deployment shows the same
+        # dashboard with one proxy tag value.
+        fwd = self.forwarder
+        if fwd is not None and hasattr(fwd, "forward_stats"):
+            try:
+                fstats = fwd.forward_stats()
+            except Exception:  # noqa: BLE001 — telemetry must not wedge
+                log.exception("forwarder stats failed")
+                fstats = None
+            if fstats:
+                for name in ("respread_total", "respread_ambiguous_total",
+                             "dropped_metrics", "picks_p2c", "picks_rr"):
+                    total = fstats.get(name)
+                    if total is None:
+                        continue
+                    key = ("", name)
+                    delta = total - self._forward_reported.get(key, 0)
+                    self._forward_reported[key] = total
+                    if delta:
+                        self.stats.count(f"forward.{name}", delta)
+                self.stats.gauge("forward.proxies",
+                                 float(fstats.get("proxies", 0)))
+                for addr, dest in fstats.get("destinations", {}).items():
+                    ptags = [f"proxy:{addr}"]
+                    for name in ("sent_metrics", "sent_batches",
+                                 "respread_in", "respread_out"):
+                        total = dest.get(name)
+                        if total is None:
+                            continue
+                        key = (addr, name)
+                        delta = total - self._forward_reported.get(key, 0)
+                        self._forward_reported[key] = total
+                        if delta:
+                            self.stats.count(f"forward.{name}", delta,
+                                             tags=ptags)
+                    for cause, total in (dest.get("errors") or {}).items():
+                        key = (addr, f"errors.{cause}")
+                        delta = total - self._forward_reported.get(key, 0)
+                        self._forward_reported[key] = total
+                        if delta:
+                            self.stats.count(
+                                "forward.errors_total", delta,
+                                tags=ptags + [f"cause:{cause}"])
+                    live = bool(dest.get("live", True))
+                    self.stats.gauge("forward.lane_live",
+                                     1.0 if live else 0.0, tags=ptags)
+                    if live and "depth" in dest:
+                        self.stats.gauge("forward.lane_depth",
+                                         float(dest["depth"]), tags=ptags)
         # per-tenant QoS gauges (core/tenancy.py): live/rejected series
         # per tenant from the shared ledger, plus overload-shed samples
         # attributed by the governor — the operator-facing view of which
@@ -2676,6 +2733,14 @@ class Server:
             self._profile_dir = None
         self.stats.close()
         self.span_worker.stop()
+        if self.forwarder is not None and hasattr(self.forwarder, "close"):
+            # the spread forwarder settles its per-proxy spills and
+            # stops its discovery refresher; single-destination
+            # forwarders just close their channel
+            try:
+                self.forwarder.close()
+            except Exception:
+                log.exception("forwarder failed to close")
         for sink in list(self.metric_sinks) + list(self.span_sinks):
             try:
                 sink.stop()

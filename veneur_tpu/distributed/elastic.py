@@ -263,6 +263,92 @@ class ProxyPressureSource:
         return dict(self._member_load)
 
 
+class ProxyTierPressureSource:
+    """Pressure signals for scaling the PROXY tier itself (ISSUE 18:
+    elastic both tiers). Where ProxyPressureSource watches one proxy's
+    view of its global destinations, this watches the proxies: a
+    `fleet_stats_fn` returns `{proxy_addr: forward_stats-shaped dict}`
+    for every live fleet member (in the bench, the in-process
+    ProxyServers; in a real deployment, the proxy's own forward_stats
+    keyed under its advertised address — each proxy observes itself and
+    one of them arms the controller).
+
+    Fleet-wide deltas per observation interval:
+
+    - admission_timeout_delta: senders timed out at an admission gate
+      (routing.admission_timeouts) — fan-in saturated at the door
+    - window_stall_delta: stream frames stalled on a full in-flight
+      window (stream.window_stalls) — egress toward globals saturated
+    - routing_shed_delta: batches shed by a routing pool
+      (routing.shed_batches) — the lagging, data-losing signal
+    - routing_queue_depth: Σ queue occupancy right now (gauge)
+
+    Cumulative marks are kept per proxy address and deltas clamped >= 0
+    so members joining/leaving (or restarting, counters reset) between
+    observations never produce phantom pressure. member_load() is the
+    per-proxy routed-batches delta — the controller's coldest-member
+    scale-in evicts the proxy absorbing the least fan-in."""
+
+    def __init__(self, fleet_stats_fn: Callable[[], dict]) -> None:
+        self.fleet_stats_fn = fleet_stats_fn
+        self._marks: dict[str, dict[str, float]] = {}
+        self._member_load: dict[str, float] = {}
+
+    @staticmethod
+    def _observe(fs: dict) -> dict[str, float]:
+        routing = fs.get("routing") or {}
+        stream = fs.get("stream") or {}
+        stalls = float(stream.get("window_stalls", 0))
+        if not stream:
+            # no aggregate stream block: sum the per-destination ones
+            for dest_stats in (fs.get("destinations") or {}).values():
+                dstream = dest_stats.get("stream")
+                if dstream:
+                    stalls += float(dstream.get("window_stalls", 0))
+        return {
+            "admission_timeouts": float(
+                routing.get("admission_timeouts", 0)),
+            "window_stalls": stalls,
+            "shed_batches": float(routing.get("shed_batches", 0)),
+            "queue_depth": float(routing.get("queue_depth", 0)),
+            "routed": float(routing.get("routed", 0)),
+        }
+
+    def __call__(self) -> dict:
+        fleet = self.fleet_stats_fn() or {}
+        totals = {"admission_timeouts": 0.0, "window_stalls": 0.0,
+                  "shed_batches": 0.0, "queue_depth": 0.0}
+        marks: dict[str, dict[str, float]] = {}
+        member_load: dict[str, float] = {}
+        for addr, fs in fleet.items():
+            try:
+                now = self._observe(fs)
+            except Exception:  # noqa: BLE001 — one sick stat never blinds the tier
+                log.exception("proxy tier stats unreadable for %s", addr)
+                continue
+            prev = self._marks.get(addr, {})
+            marks[addr] = now
+            for key in ("admission_timeouts", "window_stalls",
+                        "shed_batches"):
+                totals[key] += max(0.0, now[key] - prev.get(key, 0.0))
+            totals["queue_depth"] += now["queue_depth"]
+            member_load[addr] = max(
+                0.0, now["routed"] - prev.get("routed", 0.0))
+        self._marks = marks
+        self._member_load = member_load
+        return {
+            "admission_timeout_delta": totals["admission_timeouts"],
+            "window_stall_delta": totals["window_stalls"],
+            "routing_shed_delta": totals["shed_batches"],
+            "routing_queue_depth": totals["queue_depth"],
+        }
+
+    def member_load(self) -> dict[str, float]:
+        """Per-proxy routed-batches delta from the most recent
+        observation — the fan-in share each member actually absorbed."""
+        return dict(self._member_load)
+
+
 class ElasticController:
     """Hysteresis + cooldown autoscale loop over a writable discovery
     source (FileWatchDiscoverer: `desired() -> (members, standby)` and

@@ -83,6 +83,28 @@ class GRPCForwarder:
                         None if ok else self.client.last_error_cause,
                         content_length=len(payload))
 
+    def forward_stats(self) -> dict:
+        """Per-destination forwarder telemetry in the same shape the
+        multi-proxy SpreadForwarder reports (one destination, no spread
+        counters) so the server's flush self-telemetry renders both.
+        Named forward_stats because `stats` is the telemetry sink."""
+        cs = self.client.stats()
+        return {
+            "proxies": 1,
+            "respread_total": 0,
+            "respread_ambiguous_total": 0,
+            "destinations": {
+                self.client.address: {
+                    "live": True,
+                    "sent_batches": cs["sent_batches"],
+                    "sent_metrics": cs["sent_metrics"],
+                    "errors": cs["errors"],
+                    "stream": cs.get("stream"),
+                    "delivery": None,
+                },
+            },
+        }
+
     def close(self) -> None:
         self.client.close()
 
@@ -163,21 +185,72 @@ class HTTPForwarder:
                 span.finish()
 
 
+def _strip_scheme(addr: str) -> str:
+    for prefix in ("grpc://", "http://", "https://"):
+        if addr.startswith(prefix):
+            return addr[len(prefix):]
+    return addr
+
+
+def _install_spread(server, cfg, compression: float,
+                    hll_precision: int, timeout: float) -> None:
+    """Wire the sharded proxy tier: a SpreadForwarder over a static
+    address list and/or a discovered fleet (FileWatchDiscoverer through
+    the same DestinationRefresher/HealthGate stack the proxies run for
+    globals, distributed/spread.py module docstring)."""
+    from veneur_tpu.core.config import parse_duration
+    from veneur_tpu.distributed.spread import SpreadForwarder
+    from veneur_tpu.sinks.delivery import DeliveryPolicy
+
+    static = [_strip_scheme(a) for a in cfg.forward_destinations()]
+    policy = DeliveryPolicy(
+        retry_max=cfg.forward_retry_max,
+        breaker_threshold=cfg.forward_breaker_threshold,
+        spill_max_bytes=cfg.forward_spill_max_bytes,
+        spill_max_payloads=cfg.forward_spill_max_payloads,
+        timeout_s=timeout, deadline_s=timeout)
+    fwd = SpreadForwarder(
+        static, timeout, compression, hll_precision,
+        stats=getattr(server, "stats", None),
+        streaming=bool(getattr(cfg, "forward_streaming", False)),
+        stream_window=int(getattr(cfg, "forward_stream_window", 32)),
+        policy=policy, spread_policy=cfg.forward_spread_policy)
+    if cfg.forward_discovery_file:
+        from veneur_tpu.distributed.discovery import FileWatchDiscoverer
+        from veneur_tpu.distributed.proxy import DestinationRefresher
+
+        gate = None
+        if cfg.forward_discovery_probe:
+            from veneur_tpu.distributed.elastic import HealthGate
+
+            gate = HealthGate(fwd)
+        refresher = DestinationRefresher(
+            fwd, FileWatchDiscoverer(cfg.forward_discovery_file), "",
+            parse_duration(cfg.forward_discovery_interval), gate=gate)
+        refresher.start()
+    server.forwarder = fwd
+
+
 def install_forwarder(server, compression: Optional[float] = None,
                       hll_precision: Optional[int] = None) -> None:
-    """Wire a Server's forward_address into the right forwarder
-    (reference flusher.go:82-95 picks gRPC vs HTTP by config)."""
+    """Wire a Server's forward config into the right forwarder
+    (reference flusher.go:82-95 picks gRPC vs HTTP by config): the
+    single-destination gRPC/HTTP/interop forwarders for one static
+    upstream, or the multi-destination SpreadForwarder when the config
+    names a proxy FLEET (forward_discovery_file, or a comma-separated
+    forward_address)."""
     cfg = server.config
-    if not cfg.forward_address:
+    if not (cfg.forward_address or cfg.forward_discovery_file):
         return
     compression = compression or cfg.tpu_compression
     hll_precision = hll_precision or cfg.tpu_hll_precision
     timeout = cfg.interval_seconds()
+    if (cfg.forward_discovery_file
+            or len(cfg.forward_destinations()) > 1):
+        _install_spread(server, cfg, compression, hll_precision, timeout)
+        return
     if cfg.forward_use_grpc:
-        addr = cfg.forward_address
-        for prefix in ("grpc://", "http://", "https://"):
-            if addr.startswith(prefix):
-                addr = addr[len(prefix):]
+        addr = _strip_scheme(cfg.forward_address)
         if cfg.forward_format == "forwardrpc":
             # upstream is a stock Go veneur global: speak its wire
             from veneur_tpu.distributed.interop import CompatForwarder

@@ -174,6 +174,7 @@ class RoutingPool:
         self.workers = max(1, int(workers))
         self.queue_max = max(1, int(queue_max))
         self._q: queue.Queue = queue.Queue(self.queue_max)
+        self._stopping = False
         self._lock = threading.Lock()
         self.submitted = 0
         self.routed = 0
@@ -190,6 +191,11 @@ class RoutingPool:
     def submit(self, kind: str, item: object) -> bool:
         """Enqueue one batch for routing; False means SHED (queue full —
         the caller owns the per-metric drop accounting)."""
+        if self._stopping:
+            with self._lock:
+                self.shed_batches += 1
+                self.consecutive_sheds += 1
+            return False
         if not routing_should_shed(self._q.qsize(), self.queue_max):
             try:
                 self._q.put_nowait((kind, item))
@@ -211,6 +217,10 @@ class RoutingPool:
         instead of shedding. False means NOT ADMITTED — the caller
         still owns the payload (nothing was dropped here), and reports
         that upstream so the sender's delivery layer retries it."""
+        if self._stopping:
+            # busy-ack during shutdown: the sender re-routes the frame
+            # to a live proxy instead of us acking work we won't do
+            return False
         try:
             self._q.put((kind, item), timeout=timeout_s)
         except queue.Full:
@@ -255,7 +265,19 @@ class RoutingPool:
                 "admission_timeouts": self.admission_timeouts,
             }
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 5.0) -> None:
+        # admitted == acked upstream: a queued batch will never be
+        # re-sent by its sender, so a stopping pool lets the workers
+        # drain the backlog before the sentinels go in — abandoning it
+        # would silently lose acked data with no drop counted (and a
+        # full queue would also time the sentinel put out). The wait is
+        # bounded: the queue holds at most queue_max batches and ingest
+        # has already stopped when this runs (ProxyServer.stop stops
+        # gRPC first).
+        self._stopping = True  # new admissions refused from here on
+        deadline = time.monotonic() + max(0.0, drain_s)
+        while self._q.qsize() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
         for _ in self._threads:
             try:
                 self._q.put(None, timeout=1.0)
@@ -263,6 +285,24 @@ class RoutingPool:
                 break
         for t in self._threads:
             t.join(timeout=2.0)
+        # an admission blocked in submit_wait when _stopping flipped can
+        # still land its item behind the sentinels — already acked, so
+        # route it inline rather than abandon it
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            kind, payload = item
+            try:
+                self._route(kind, payload)
+            except Exception:  # noqa: BLE001 — drain must finish
+                log.exception("proxy routing stop-drain failed")
+            finally:
+                with self._lock:
+                    self.routed += 1
 
 
 class _StreamAdmissionSink:
@@ -283,6 +323,7 @@ class _StreamAdmissionSink:
     def submit(self, body: bytes, done) -> None:
         from veneur_tpu.distributed import codec as _codec
 
+        self._proxy._register_cpu_thread()
         if self._proxy._pool.submit_wait(
                 "wire", body, self.ADMIT_TIMEOUT_S):
             done(True)
@@ -387,6 +428,15 @@ class ProxyServer:
         self.last_ring_change: Optional[dict] = None
         self._ring_changed_unix = time.time()
         self.refresher = None      # attached by DestinationRefresher
+        # CPU service-demand accounting: native thread ids of every
+        # thread that does this proxy's work (gRPC ingest handlers,
+        # routing workers, the handoff drain). cpu_seconds() sums their
+        # /proc/self/task/<tid>/schedstat runtime so a multi-proxy
+        # bench in ONE process can attribute CPU per proxy — the number
+        # the fan-in capacity model divides throughput by.
+        self._cpu_tids: set[int] = set()
+        self._cpu_last_ns: dict[int, int] = {}
+        self._cpu_lock = threading.Lock()
         self._pool = RoutingPool(self._route_one, routing_workers,
                                  routing_queue_max)
         self._drain_event = threading.Event()
@@ -635,8 +685,38 @@ class ProxyServer:
             self._shed(len(batch.metrics))
 
     def handle_wire(self, blob: bytes) -> None:
+        self._register_cpu_thread()
         if not self._pool.submit("wire", blob):
             self._shed(self._wire_count(blob))
+
+    def _register_cpu_thread(self) -> None:
+        """Record the calling thread in the CPU-attribution set (cheap:
+        a set lookup after the first call from each thread)."""
+        tid = threading.get_native_id()
+        if tid in self._cpu_tids:
+            return
+        with self._cpu_lock:
+            self._cpu_tids.add(tid)
+
+    def cpu_seconds(self) -> float:
+        """Cumulative CPU runtime of this proxy's worker threads, from
+        /proc/self/task/<tid>/schedstat (field 1: on-cpu nanoseconds).
+        A thread that exited keeps its last observed reading, so deltas
+        across a measurement window never go backwards. Returns 0.0
+        where /proc is unavailable (non-Linux) — callers treat that as
+        'no attribution', not as free work."""
+        with self._cpu_lock:
+            tids = list(self._cpu_tids)
+        total_ns = 0
+        for tid in tids:
+            try:
+                with open(f"/proc/self/task/{tid}/schedstat") as f:
+                    ns = int(f.read().split()[0])
+                self._cpu_last_ns[tid] = ns
+            except (OSError, ValueError, IndexError):
+                ns = self._cpu_last_ns.get(tid, 0)
+            total_ns += ns
+        return total_ns / 1e9
 
     def _shed(self, n: int) -> None:
         with self._stats_lock:
@@ -658,6 +738,7 @@ class ProxyServer:
             return 1  # undecodable: same unit the decode-failure path drops
 
     def _route_one(self, kind: str, item) -> None:
+        self._register_cpu_thread()
         if kind == "wire":
             self._route_wire(item)
         else:
@@ -902,6 +983,7 @@ class ProxyServer:
                         conn.close()
 
     def _drain_loop(self) -> None:
+        self._register_cpu_thread()
         while not self._stop_event.is_set():
             self._drain_event.wait(self.handoff_window_s)
             if self._stop_event.is_set():
@@ -980,6 +1062,7 @@ class ProxyServer:
                 for cause in ("deadline_exceeded", "unavailable", "send")},
             "routing": self._pool.stats(),
             "behind": self._pool.behind(),
+            "cpu_seconds": round(self.cpu_seconds(), 6),
         })
         with self._stats_lock:
             out["journal_recovered_payloads"] = self.journal_recovered_payloads

@@ -189,10 +189,19 @@ def elastic_pressure_reasons(signals: dict) -> list[str]:
       prior attempt may have landed is the remint-duplicate risk, so
       "calm" must mean "nothing parked")
     - delivery_behind / tenant_pressure: optional upstream booleans
+    - admission_timeout_delta / window_stall_delta: proxy-TIER signals
+      (ProxyTierPressureSource sums them fleet-wide): senders timing out
+      at a proxy's admission gate, and stream frames stalling on a full
+      in-flight window — both mean the fan-in tier itself is saturated,
+      independent of whether anything shed yet
     """
     reasons = []
     if signals.get("routing_shed_delta", 0) > 0:
         reasons.append("routing_shed")
+    if signals.get("admission_timeout_delta", 0) > 0:
+        reasons.append("admission_timeout")
+    if signals.get("window_stall_delta", 0) > 0:
+        reasons.append("window_stall")
     if signals.get("routing_queue_depth", 0) >= ELASTIC_QUEUE_PRESSURE_DEPTH:
         reasons.append("routing_queue")
     if signals.get("delivery_deferred_delta", 0) > 0:
