@@ -1,0 +1,340 @@
+// Native VSF1/VDE1 forward-frame codec (third TU of libveneur_native.so).
+//
+// The streaming forward hop frames every payload twice: a VDE1 dedup
+// envelope header (canonical one-line JSON, distributed/codec.py
+// encode_dedup_envelope) and a VSF1 stream frame (magic + u64 LE seq).
+// Both run per-frame on the proxy fan-out, so like the emit tier
+// (emit.cpp) they move here and run with the GIL released; the Python
+// reference implementations stay pinned byte-identical and every entry
+// point returns a "fall back" code for any input whose Python semantics
+// this TU does not replicate exactly (non-UTF-8 senders, out-of-i64
+// ints, non-canonical headers), so the wrappers never change behavior —
+// only speed.
+//
+// Out-buffer contract matches emit.cpp: results live in thread_local
+// std::string buffers, valid until the calling thread's next call.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_frame_buf;
+thread_local std::string g_hdr_buf;
+thread_local std::string g_sender_buf;
+
+const char kFrameMagic[4] = {'V', 'S', 'F', '1'};
+const char kDedupMagic[4] = {'V', 'D', 'E', '1'};
+
+void put_u64_le(std::string& out, uint64_t v) {
+    char b[8];
+    for (int i = 0; i < 8; i++) b[i] = (char)((v >> (8 * i)) & 0xff);
+    out.append(b, 8);
+}
+
+uint64_t get_u64_le(const unsigned char* p) {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++) v |= (uint64_t)p[i] << (8 * i);
+    return v;
+}
+
+// json.dumps ensure_ascii string escape: \" \\ \b \t \n \f \r, \u00xx
+// for remaining chars outside 0x20..0x7e, and \uxxxx (surrogate pairs
+// for astral planes, lowercase hex) for non-ASCII code points decoded
+// from the UTF-8 input. Returns false on malformed UTF-8 (overlong,
+// truncated, surrogate, out of range) — caller falls back to Python.
+bool json_escape_utf8(const unsigned char* s, long long n,
+                      std::string& out) {
+    char tmp[16];
+    long long i = 0;
+    while (i < n) {
+        unsigned char c = s[i];
+        if (c == '"') { out += "\\\""; i++; }
+        else if (c == '\\') { out += "\\\\"; i++; }
+        else if (c == '\b') { out += "\\b"; i++; }
+        else if (c == '\t') { out += "\\t"; i++; }
+        else if (c == '\n') { out += "\\n"; i++; }
+        else if (c == '\f') { out += "\\f"; i++; }
+        else if (c == '\r') { out += "\\r"; i++; }
+        else if (c < 0x20 || c == 0x7f) {
+            snprintf(tmp, sizeof tmp, "\\u%04x", c);
+            out += tmp;
+            i++;
+        } else if (c < 0x80) {
+            out += (char)c;
+            i++;
+        } else {
+            unsigned cp;
+            int len;
+            if ((c & 0xe0) == 0xc0) { len = 2; cp = c & 0x1f; }
+            else if ((c & 0xf0) == 0xe0) { len = 3; cp = c & 0x0f; }
+            else if ((c & 0xf8) == 0xf0) { len = 4; cp = c & 0x07; }
+            else return false;
+            if (i + len > n) return false;
+            for (int k = 1; k < len; k++) {
+                unsigned char cc = s[i + k];
+                if ((cc & 0xc0) != 0x80) return false;
+                cp = (cp << 6) | (cc & 0x3f);
+            }
+            if (cp > 0x10ffff) return false;
+            if (cp >= 0xd800 && cp <= 0xdfff) return false;
+            if ((len == 2 && cp < 0x80) || (len == 3 && cp < 0x800) ||
+                (len == 4 && cp < 0x10000))
+                return false;  // overlong
+            if (cp < 0x10000) {
+                snprintf(tmp, sizeof tmp, "\\u%04x", cp);
+                out += tmp;
+            } else {
+                cp -= 0x10000;
+                snprintf(tmp, sizeof tmp, "\\u%04x\\u%04x",
+                         0xd800 + (cp >> 10), 0xdc00 + (cp & 0x3ff));
+                out += tmp;
+            }
+            i += len;
+        }
+    }
+    return true;
+}
+
+void utf8_append(std::string& out, unsigned cp) {
+    if (cp < 0x80) {
+        out += (char)cp;
+    } else if (cp < 0x800) {
+        out += (char)(0xc0 | (cp >> 6));
+        out += (char)(0x80 | (cp & 0x3f));
+    } else if (cp < 0x10000) {
+        out += (char)(0xe0 | (cp >> 12));
+        out += (char)(0x80 | ((cp >> 6) & 0x3f));
+        out += (char)(0x80 | (cp & 0x3f));
+    } else {
+        out += (char)(0xf0 | (cp >> 18));
+        out += (char)(0x80 | ((cp >> 12) & 0x3f));
+        out += (char)(0x80 | ((cp >> 6) & 0x3f));
+        out += (char)(0x80 | (cp & 0x3f));
+    }
+}
+
+int hex_val(unsigned char c) {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+}
+
+// Strict JSON string body (between the quotes) -> UTF-8 in `out`.
+// Only ASCII input is accepted (the canonical encoder is ensure_ascii);
+// lone surrogates fall back — json.loads accepts them but the result
+// can't travel through a UTF-8 out-buffer. Advances *pos past the
+// closing quote. Returns false -> caller falls back to Python.
+bool parse_json_string(const unsigned char* h, long long n,
+                       long long* pos, std::string& out) {
+    long long i = *pos;
+    while (i < n) {
+        unsigned char c = h[i];
+        if (c == '"') {
+            *pos = i + 1;
+            return true;
+        }
+        if (c < 0x20 || c >= 0x80) return false;  // strict / non-ASCII
+        if (c != '\\') {
+            out += (char)c;
+            i++;
+            continue;
+        }
+        if (i + 1 >= n) return false;
+        unsigned char e = h[i + 1];
+        i += 2;
+        switch (e) {
+            case '"': out += '"'; break;
+            case '\\': out += '\\'; break;
+            case '/': out += '/'; break;
+            case 'b': out += '\b'; break;
+            case 'f': out += '\f'; break;
+            case 'n': out += '\n'; break;
+            case 'r': out += '\r'; break;
+            case 't': out += '\t'; break;
+            case 'u': {
+                if (i + 4 > n) return false;
+                unsigned cp = 0;
+                for (int k = 0; k < 4; k++) {
+                    int v = hex_val(h[i + k]);
+                    if (v < 0) return false;
+                    cp = (cp << 4) | (unsigned)v;
+                }
+                i += 4;
+                if (cp >= 0xdc00 && cp <= 0xdfff) return false;
+                if (cp >= 0xd800 && cp <= 0xdbff) {
+                    if (i + 6 > n || h[i] != '\\' || h[i + 1] != 'u')
+                        return false;
+                    unsigned lo = 0;
+                    for (int k = 0; k < 4; k++) {
+                        int v = hex_val(h[i + 2 + k]);
+                        if (v < 0) return false;
+                        lo = (lo << 4) | (unsigned)v;
+                    }
+                    if (lo < 0xdc00 || lo > 0xdfff) return false;
+                    i += 6;
+                    cp = 0x10000 + ((cp - 0xd800) << 10) + (lo - 0xdc00);
+                }
+                utf8_append(out, cp);
+                break;
+            }
+            default:
+                return false;
+        }
+    }
+    return false;  // unterminated
+}
+
+// Decimal integer with i64 overflow detection; no leading zeros beyond
+// a bare "0", no sign handling beyond one leading '-' (the canonical
+// encoder never emits "+" or exponents). Returns false -> fall back
+// (Python ints are unbounded, json.loads parses what we can't).
+bool parse_json_int(const unsigned char* h, long long n, long long* pos,
+                    long long* out) {
+    long long i = *pos;
+    bool neg = false;
+    if (i < n && h[i] == '-') {
+        neg = true;
+        i++;
+    }
+    if (i >= n || h[i] < '0' || h[i] > '9') return false;
+    if (h[i] == '0' && i + 1 < n && h[i + 1] >= '0' && h[i + 1] <= '9')
+        return false;  // leading zero: not canonical
+    uint64_t v = 0;
+    const uint64_t lim = neg ? (uint64_t)1 << 63
+                             : ((uint64_t)1 << 63) - 1;
+    while (i < n && h[i] >= '0' && h[i] <= '9') {
+        unsigned d = h[i] - '0';
+        if (v > (lim - d) / 10) return false;  // i64 overflow
+        v = v * 10 + d;
+        i++;
+    }
+    *pos = i;
+    *out = neg ? (long long)(-(int64_t)v) : (long long)v;
+    return true;
+}
+
+bool expect(const unsigned char* h, long long n, long long* pos,
+            const char* lit) {
+    size_t len = strlen(lit);
+    if (*pos + (long long)len > n) return false;
+    if (memcmp(h + *pos, lit, len) != 0) return false;
+    *pos += (long long)len;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------- VSF1 frame
+
+// Full frame (magic + u64 LE seq + body) into the thread-local buffer.
+// Returns 0; *out is valid until this thread's next call.
+long long vn_stream_frame_encode(unsigned long long seq,
+                                 const unsigned char* body,
+                                 long long body_len,
+                                 const char** out, long long* out_len) {
+    g_frame_buf.clear();
+    g_frame_buf.reserve(12 + (size_t)(body_len > 0 ? body_len : 0));
+    g_frame_buf.append(kFrameMagic, 4);
+    put_u64_le(g_frame_buf, seq);
+    if (body_len > 0) g_frame_buf.append((const char*)body,
+                                         (size_t)body_len);
+    *out = g_frame_buf.data();
+    *out_len = (long long)g_frame_buf.size();
+    return 0;
+}
+
+// Returns the body offset (12) with *seq_out filled, or -1 on a blob
+// that is not a VSF1 frame (wrapper raises ValueError, like Python).
+long long vn_stream_frame_decode(const unsigned char* blob,
+                                 long long len,
+                                 unsigned long long* seq_out) {
+    if (len < 12 || memcmp(blob, kFrameMagic, 4) != 0) return -1;
+    *seq_out = get_u64_le(blob + 4);
+    return 12;
+}
+
+// ------------------------------------------------------------ VSF1 ack
+
+// 9 ack bytes (u64 LE seq + u8 status) into the caller's buffer.
+long long vn_stream_ack_encode(unsigned long long seq, int status,
+                               unsigned char* out9) {
+    for (int i = 0; i < 8; i++)
+        out9[i] = (unsigned char)((seq >> (8 * i)) & 0xff);
+    out9[8] = (unsigned char)(status & 0xff);
+    return 0;
+}
+
+// Returns the status byte (0..255) with *seq_out filled, or -1 when
+// the blob is not exactly 9 bytes.
+long long vn_stream_ack_decode(const unsigned char* blob, long long len,
+                               unsigned long long* seq_out) {
+    if (len != 9) return -1;
+    *seq_out = get_u64_le(blob);
+    return (long long)blob[8];
+}
+
+// -------------------------------------------------------- VDE1 envelope
+
+// Envelope prefix (magic + u16 LE header length + canonical JSON
+// header) into the thread-local buffer; the wrapper appends the body.
+// Returns 0 on success, -1 on malformed-UTF-8 sender (fall back to
+// Python), -2 when the header exceeds the u16 length field (wrapper
+// raises the pinned "dedup header too large" ValueError).
+long long vn_dedup_header_encode(const unsigned char* sender,
+                                 long long sender_len,
+                                 long long dedup_id, long long count,
+                                 const char** out, long long* out_len) {
+    g_hdr_buf.clear();
+    g_hdr_buf.reserve(32 + (size_t)(sender_len > 0 ? sender_len : 0));
+    g_hdr_buf += "{\"s\":\"";
+    if (!json_escape_utf8(sender, sender_len, g_hdr_buf)) return -1;
+    char tmp[48];
+    snprintf(tmp, sizeof tmp, "\",\"i\":%lld,\"n\":%lld}", dedup_id,
+             count);
+    g_hdr_buf += tmp;
+    size_t hlen = g_hdr_buf.size();
+    if (hlen > 0xffff) return -2;
+    g_frame_buf.clear();
+    g_frame_buf.reserve(6 + hlen);
+    g_frame_buf.append(kDedupMagic, 4);
+    g_frame_buf += (char)(hlen & 0xff);
+    g_frame_buf += (char)((hlen >> 8) & 0xff);
+    g_frame_buf += g_hdr_buf;
+    *out = g_frame_buf.data();
+    *out_len = (long long)g_frame_buf.size();
+    return 0;
+}
+
+// Strict canonical parse of the JSON header bytes (what the canonical
+// encoder emits: {"s":<string>,"i":<int>,"n":<int>}, no whitespace, no
+// reordering). Returns 0 with sender (UTF-8, thread-local) + id +
+// count, or -1 for anything else — the wrapper falls back to
+// json.loads so non-canonical-but-valid headers keep their exact
+// Python semantics (bigints, float coercion, lone surrogates, ...).
+long long vn_dedup_header_parse(const unsigned char* hdr, long long hlen,
+                                const char** sender_out,
+                                long long* sender_len,
+                                long long* id_out,
+                                long long* count_out) {
+    long long pos = 0;
+    if (!expect(hdr, hlen, &pos, "{\"s\":\"")) return -1;
+    g_sender_buf.clear();
+    if (!parse_json_string(hdr, hlen, &pos, g_sender_buf)) return -1;
+    if (!expect(hdr, hlen, &pos, ",\"i\":")) return -1;
+    if (!parse_json_int(hdr, hlen, &pos, id_out)) return -1;
+    if (!expect(hdr, hlen, &pos, ",\"n\":")) return -1;
+    if (!parse_json_int(hdr, hlen, &pos, count_out)) return -1;
+    if (!expect(hdr, hlen, &pos, "}")) return -1;
+    if (pos != hlen) return -1;
+    *sender_out = g_sender_buf.data();
+    *sender_len = (long long)g_sender_buf.size();
+    return 0;
+}
+
+}  // extern "C"

@@ -47,17 +47,17 @@ def test_lock_stats_instrumentation():
 
 def test_library_matches_source():
     """The loaded .so's build stamp equals the sha256 prefix of the
-    current sources (dogstatsd.cpp + emit.cpp, the two TUs of the
-    library) — a stale committed binary (library no longer built from
-    the checked-in source) fails here instead of silently testing old
-    code."""
+    current sources (dogstatsd.cpp + emit.cpp + forward_codec.cpp, the
+    three TUs of the library) — a stale committed binary (library no
+    longer built from the checked-in source) fails here instead of
+    silently testing old code."""
     import hashlib
     import os
 
     ndir = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "native")
     h = hashlib.sha256()
-    for fn in ("dogstatsd.cpp", "emit.cpp"):
+    for fn in ("dogstatsd.cpp", "emit.cpp", "forward_codec.cpp"):
         h.update(open(os.path.join(ndir, fn), "rb").read())
     assert native_mod.source_hash() == h.hexdigest()[:16]
 

@@ -439,6 +439,207 @@ def test_proxy_streams_to_globals_with_telemetry():
         imp2.stop()
 
 
+# ------------------------------------------- adaptive window (AIMD)
+
+
+class _OkSink:
+    def __init__(self):
+        self.taken = []
+
+    def submit(self, body, done):
+        self.taken.append(body)
+        done(True)
+
+
+def test_adaptive_window_collapses_to_min_and_recovers():
+    """A scripted busy storm halves the window down to the floor; clean
+    acks afterwards grow it back to (past) the pre-storm operating
+    point — the AIMD sawtooth, end to end over a real stream."""
+    from veneur_tpu.utils.faults import FaultPlan, FaultyStreamSink
+
+    # frame indices 30..41 busy-ack: 12 congestion signals collapse any
+    # window <= 16 to the floor
+    sink = FaultyStreamSink(FaultPlan(busy_ranges=[(30, 42)]), _OkSink())
+    srv, port = rpc.make_server(None, raw_handler=None, compat=False,
+                                stream_sink=sink)
+    client = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=5.0,
+                               streaming=True, stream_window=8,
+                               stream_window_min=1, stream_window_max=16)
+    try:
+        adaptive = rpc.stream_adaptive_enabled(True)
+        for i in range(30):
+            client.send_raw_or_raise(b"frame-%d" % i, 1)
+        grown = client.stats()["stream"]["window_current"]
+        if adaptive:
+            assert grown > 8  # additive increase under clean acks
+        else:  # env hatch: pinned at the configured window
+            assert grown == 8
+        # the storm: the retried frame eats every busy index, then lands
+        _send_retrying(client, b"storm")
+        s = client.stats()["stream"]
+        if adaptive:
+            assert s["window_min_seen"] == 1  # multiplicative collapse
+            assert s["shrink_events"] >= 4
+            assert s["window_current"] <= 2
+        else:
+            assert s["window_min_seen"] == 8
+            assert s["shrink_events"] == 0
+        # recovery: clean acks only; 1/W growth reaches the pre-storm
+        # operating point within ~W^2/2 acks
+        for i in range(80):
+            client.send_raw_or_raise(b"rec-%d" % i, 1)
+        s = client.stats()["stream"]
+        assert s["window_current"] >= grown
+        assert s["window_max_seen"] <= 16
+        # busy never reconnects: the same stream served the whole arc
+        assert client.stream_reconnects == 0
+        assert sink.injected["busy"] == 12
+    finally:
+        client.close()
+        srv.stop(0)
+
+
+def test_adaptive_off_pins_fixed_window():
+    """The escape hatch: adaptive off (ctor flag or
+    VENEUR_STREAM_ADAPTIVE=0) pins the PR 15 fixed window — busy-acks
+    classify and retry exactly as before but never move the window."""
+    from veneur_tpu.utils.faults import FaultPlan, FaultyStreamSink
+
+    sink = FaultyStreamSink(FaultPlan(busy_ranges=[(2, 5)]), _OkSink())
+    srv, port = rpc.make_server(None, raw_handler=None, compat=False,
+                                stream_sink=sink)
+    client = rpc.ForwardClient(f"127.0.0.1:{port}", timeout_s=5.0,
+                               streaming=True, stream_window=8,
+                               stream_adaptive=False)
+    try:
+        for i in range(2):
+            client.send_raw_or_raise(b"a-%d" % i, 1)
+        _send_retrying(client, b"storm")
+        for i in range(10):
+            client.send_raw_or_raise(b"b-%d" % i, 1)
+        s = client.stats()["stream"]
+        assert s["adaptive"] is False
+        assert s["window_current"] == 8
+        assert s["window_min_seen"] == 8 and s["window_max_seen"] == 8
+        assert s["shrink_events"] == 0
+        assert client.errors["busy"] >= 1  # the taxonomy still counted
+    finally:
+        client.close()
+        srv.stop(0)
+
+
+def test_adaptive_env_hatch_overrides_config(monkeypatch):
+    monkeypatch.setenv("VENEUR_STREAM_ADAPTIVE", "0")
+    assert rpc.stream_adaptive_enabled(True) is False
+    client = rpc.ForwardClient("127.0.0.1:1", timeout_s=0.1,
+                               streaming=True, stream_window=4)
+    try:
+        assert client.stats()["stream"]["adaptive"] is False
+    finally:
+        client.close()
+    monkeypatch.delenv("VENEUR_STREAM_ADAPTIVE")
+    assert rpc.stream_adaptive_enabled(True) is True
+
+
+def test_duplicates_zero_across_reconnect_mid_collapse():
+    """The ISSUE's hard case: a busy storm collapses the window, the
+    stream tears mid-collapse, and the replayed tail under the original
+    dedup keys must still merge exactly once — duplicates stay 0 while
+    the window is anywhere in [wmin, wmax]."""
+    from veneur_tpu.utils.faults import FaultPlan, FaultyStreamSink
+
+    gsrv, imp, port = _global_server()
+    imp.stop()
+    # re-arm the listener with a scripted receiver: frames 4..9 busy
+    imp._coalescer = FaultyStreamSink(FaultPlan(busy_ranges=[(4, 10)]),
+                                      StreamCoalescer(imp))
+    port = imp.start_grpc()
+    addr = f"127.0.0.1:{port}"
+    client = rpc.ForwardClient(addr, timeout_s=2.0, streaming=True,
+                               stream_window=8, stream_window_min=1)
+    bodies = {
+        i: codec.encode_dedup_envelope(
+            "sender-a", i, 1, _counter_blob("mc.c", 1, (f"id:{i}",)))
+        for i in range(1, 6)
+    }
+    try:
+        for i in range(1, 5):
+            client.send_raw_or_raise(bodies[i], 1)
+        _send_retrying(client, bodies[5])  # rides out the busy storm
+        assert _wait_until(lambda: imp.received_metrics >= 5)
+        s = client.stats()["stream"]
+        if rpc.stream_adaptive_enabled(True):
+            assert s["shrink_events"] >= 3 and s["window_min_seen"] == 1
+        # tear mid-collapse, restart on the same port (same dedup
+        # window, same coalescer), replay the whole tail
+        imp.stop(grace=0)
+        with pytest.raises(rpc.ForwardError) as ei:
+            client.send_raw_or_raise(bodies[5], 1)
+        assert ei.value.transient
+        imp.start_grpc(addr)
+        for i in range(1, 6):
+            _send_retrying(client, bodies[i])
+        assert _wait_until(lambda: imp.metrics_deduped >= 5)
+        time.sleep(0.1)
+        assert imp.received_metrics == 5     # zero double-merges
+        assert _counter_total(gsrv, "mc.c") == 5.0
+        assert client.stream_reconnects >= 1
+    finally:
+        client.close()
+        imp.stop()
+
+
+# ------------------------------------------ native/Python codec parity
+
+
+def test_codec_native_python_parity():
+    """The public codec entry points must be byte-identical to the
+    pinned *_py references whether or not the native library is loaded
+    (CI runs this twice: native on, and VENEUR_CODEC_NATIVE=0)."""
+    bodies = [b"", b"x", b"\x00\xff" * 200]
+    for seq in (0, 1, 2**32, 2**63, 2**64 - 1):
+        for body in bodies:
+            frame = codec.encode_stream_frame(seq, body)
+            assert frame == codec.encode_stream_frame_py(seq, body)
+            assert codec.decode_stream_frame(frame) == (seq, body)
+            assert codec.decode_stream_frame_py(frame) == (seq, body)
+    for status in (True, False, 0, 1, 2, 255):
+        ack = codec.encode_stream_ack(9, status)
+        assert ack == codec.encode_stream_ack_py(9, status)
+        assert codec.decode_stream_ack(ack) == codec.decode_stream_ack_py(ack)
+    senders = ["s", "sender-a", 'quo"te\\slash', "unié中\U0001f600",
+               "ctl\x01\x1f\x7f"]
+    for sender in senders:
+        for did, cnt in ((1, 1), (0, 0), (2**63 - 1, 7),
+                         (-(2**63), 3)):
+            env = codec.encode_dedup_envelope(sender, did, cnt, b"BODY")
+            assert env == codec.encode_dedup_envelope_py(
+                sender, did, cnt, b"BODY")
+            assert codec.decode_dedup_envelope(env) == (
+                (sender, did, cnt), b"BODY")
+            assert codec.decode_dedup_envelope_py(env) == (
+                (sender, did, cnt), b"BODY")
+    # out-of-i64 ids fall back to the Python path and still round-trip
+    env = codec.encode_dedup_envelope("s", 2**64, 1, b"B")
+    assert env == codec.encode_dedup_envelope_py("s", 2**64, 1, b"B")
+    assert codec.decode_dedup_envelope(env)[0][1] == 2**64
+    # headerless blobs pass through unchanged on both paths
+    assert codec.decode_dedup_envelope(b"nope") == (None, b"nope")
+    assert codec.decode_dedup_envelope_py(b"nope") == (None, b"nope")
+    # corruption: the same typed error from both paths
+    for blob in (b"nope", b"VSF1\x00", b"VDE1\xff\xff", b""):
+        for fn in (codec.decode_stream_frame, codec.decode_stream_frame_py,
+                   codec.decode_stream_ack, codec.decode_stream_ack_py):
+            with pytest.raises(ValueError):
+                fn(blob)
+    for blob in (b"VDE1\xff\xff", b"VDE1\x05\x00abc",
+                 b"VDE1\x02\x00{}", b'VDE1\x08\x00{"s":"x"}'):
+        with pytest.raises(ValueError):
+            codec.decode_dedup_envelope(blob)
+        with pytest.raises(ValueError):
+            codec.decode_dedup_envelope_py(blob)
+
+
 # --------------------------------------------- coldest-member scale-in
 
 

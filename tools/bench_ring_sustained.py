@@ -34,7 +34,13 @@ numbers plus the rig note.
 
 --ab runs the full search twice on identical topologies — unary first,
 then streaming — and writes one artifact with both modes plus the
-speedup; the headline fields come from the streaming run. --smoke is
+speedup; the headline fields come from the streaming run.
+--ab --ab-axis stream-window adds a third cell: the PR 15 fixed ack
+window (forward_stream_adaptive off) searched at saturation, plus one
+calm fixed-rate trial per streaming cell at --start-rate, so the
+artifact pins adaptive >= fixed at BOTH operating points
+(stream_window_ab block; "streaming" stays the adaptive cell so the
+parsed keys are unchanged). --smoke is
 the bounded CI lane: one fixed-rate pass/fail trial on the streaming
 path (exit 1 on failure), same invariants. --scaling runs the
 multi-proxy cells (M=1/2/4 spread senders) plus a chaos cell: a
@@ -85,11 +91,15 @@ class _ClientSender:
     with, kept bit-for-bit so --ab stays comparable."""
 
     def __init__(self, addr: str, rpc, streaming: bool,
-                 window: int) -> None:
+                 window: int, adaptive: bool = True,
+                 window_min: int = 1, window_max: int = 128) -> None:
         self._rpc = rpc
         self.client = rpc.ForwardClient(addr, timeout_s=2.0,
                                         streaming=streaming,
-                                        stream_window=window)
+                                        stream_window=window,
+                                        stream_adaptive=adaptive,
+                                        stream_window_min=window_min,
+                                        stream_window_max=window_max)
         self.offered = 0
 
     def maintain(self) -> None:
@@ -117,6 +127,10 @@ class _ClientSender:
         return {"respread_total": 0, "respread_ambiguous_total": 0,
                 "dropped_metrics": 0, "picks_p2c": 0, "picks_rr": 0}
 
+    def stream_stats(self) -> list[dict]:
+        s = self.client.stats().get("stream")
+        return [s] if s else []
+
     def conserved(self) -> bool:
         return True
 
@@ -130,7 +144,8 @@ class _SpreadSender:
     per-lane DeliveryManager failover)."""
 
     def __init__(self, fleet: list[str], streaming: bool, window: int,
-                 timeout_s: float = 5.0) -> None:
+                 timeout_s: float = 5.0, adaptive: bool = True,
+                 window_min: int = 1, window_max: int = 128) -> None:
         from veneur_tpu.distributed.spread import SpreadForwarder
         from veneur_tpu.sinks.delivery import DeliveryPolicy
 
@@ -140,7 +155,8 @@ class _SpreadSender:
         # deadline classifies the attempt ambiguous
         self.fwd = SpreadForwarder(
             fleet, timeout_s=timeout_s, streaming=streaming,
-            stream_window=window,
+            stream_window=window, stream_adaptive=adaptive,
+            stream_window_min=window_min, stream_window_max=window_max,
             policy=DeliveryPolicy(retry_max=1, breaker_threshold=3,
                                   spill_max_bytes=16 << 20,
                                   spill_max_payloads=1024,
@@ -181,6 +197,11 @@ class _SpreadSender:
             "picks_rr": self.fwd.picks_rr,
         }
 
+    def stream_stats(self) -> list[dict]:
+        per = self.fwd.forward_stats()["destinations"]
+        return [d["stream"] for d in per.values()
+                if d.get("live") and d.get("stream")]
+
     def conserved(self) -> bool:
         return self.fwd.conserved()
 
@@ -204,7 +225,9 @@ class RingHarness:
                  interval_s: float = 1.0, n_proxies: int = 1,
                  standby: int = 0, use_spread: bool | None = None,
                  routing_workers: int = 4,
-                 routing_queue_max: int | None = None) -> None:
+                 routing_queue_max: int | None = None,
+                 adaptive: bool = True, window_min: int = 1,
+                 window_max: int = 128) -> None:
         from veneur_tpu.core.config import Config
         from veneur_tpu.core.server import Server
         from veneur_tpu.distributed import rpc
@@ -218,6 +241,9 @@ class RingHarness:
 
         self.streaming = streaming
         self.window = window
+        self.adaptive = bool(adaptive)
+        self.window_min = window_min
+        self.window_max = window_max
         self.batch = batch
         self.interval_s = interval_s
         self.senders = senders
@@ -241,7 +267,10 @@ class RingHarness:
             p = ProxyServer(
                 gaddrs, timeout_s=2.0, delivery=policy,
                 handoff_window_s=0.5, dedup=True, streaming=streaming,
-                stream_window=window, routing_workers=routing_workers,
+                stream_window=window, stream_adaptive=adaptive,
+                stream_window_min=window_min,
+                stream_window_max=window_max,
+                routing_workers=routing_workers,
                 routing_queue_max=(routing_queue_max
                                    or ROUTING_QUEUE_MAX))
             port = p.start_grpc()
@@ -254,11 +283,15 @@ class RingHarness:
         self.use_spread = bool(use_spread)
         if self.use_spread:
             self.sender_objs = [
-                _SpreadSender(self.fleet, streaming, window)
+                _SpreadSender(self.fleet, streaming, window,
+                              adaptive=adaptive, window_min=window_min,
+                              window_max=window_max)
                 for _ in range(senders)]
         else:
             self.sender_objs = [
-                _ClientSender(self.fleet[0], rpc, streaming, window)
+                _ClientSender(self.fleet[0], rpc, streaming, window,
+                              adaptive=adaptive, window_min=window_min,
+                              window_max=window_max)
                 for _ in range(senders)]
         # the series universe, pre-serialized into cycling wire blobs of
         # `batch` global counters each — routing splits every blob
@@ -290,7 +323,18 @@ class RingHarness:
                "queue_depth": 0}
         stream_tot = {"opened": 0, "reconnects": 0, "acked_total": 0,
                       "window_stalls": 0, "unacked_frames": 0,
-                      "downgraded": 0}
+                      "downgraded": 0, "shrink_events": 0,
+                      "window_current": 0, "window_min_seen": 0,
+                      "window_max_seen": 0}
+        # window gauges fold in BOTH streaming hops (sender->proxy and
+        # proxy->global): window_current/max_seen are worst-case maxima,
+        # window_min_seen the deepest collapse anywhere in the chain
+        gauge_blocks: list[dict] = []
+        for s in self.sender_objs:
+            for blk in s.stream_stats():
+                gauge_blocks.append(blk)
+                stream_tot["shrink_events"] += blk.get(
+                    "shrink_events", 0)
         for addr, p in zip(self.proxy_addrs, self.proxies):
             fs = p.forward_stats()
             per_proxy[addr] = {
@@ -311,8 +355,23 @@ class RingHarness:
             tot["spilled"] += fs["spilled_metrics"]
             tot["queue_depth"] += fs["routing"]["queue_depth"]
             for k in ("opened", "reconnects", "acked_total",
-                      "window_stalls", "unacked_frames", "downgraded"):
+                      "window_stalls", "unacked_frames", "downgraded",
+                      "shrink_events"):
                 stream_tot[k] += fs["stream"].get(k, 0)
+            gauge_blocks.append(fs["stream"])
+        seen_gauge = False
+        for s in gauge_blocks:
+            cur = s.get("window_current", 0)
+            stream_tot["window_current"] = max(
+                stream_tot["window_current"], cur)
+            lo = s.get("window_min_seen", cur)
+            stream_tot["window_min_seen"] = (
+                lo if not seen_gauge
+                else min(stream_tot["window_min_seen"], lo))
+            stream_tot["window_max_seen"] = max(
+                stream_tot["window_max_seen"],
+                s.get("window_max_seen", cur))
+            seen_gauge = True
         spread = {"respread_total": 0, "respread_ambiguous_total": 0,
                   "dropped_metrics": 0, "picks_p2c": 0, "picks_rr": 0}
         for s in self.sender_objs:
@@ -463,6 +522,9 @@ class RingHarness:
                         snap["stream"]["window_stalls"]
                         - prev["stream"]["window_stalls"]),
                     "unacked_frames": snap["stream"]["unacked_frames"],
+                    "window_current": snap["stream"]["window_current"],
+                    "shrink_delta": (snap["stream"]["shrink_events"]
+                                     - prev["stream"]["shrink_events"]),
                     "respread_delta": (snap["spread"]["respread_total"]
                                        - prev["spread"]["respread_total"]),
                     "per_proxy": self.per_proxy_delta(snap, prev),
@@ -645,6 +707,9 @@ def _mode_result(h: RingHarness, search: dict) -> dict:
     return {
         "streaming": h.streaming,
         "stream_window": h.window,
+        "stream_adaptive": h.adaptive,
+        "stream_window_min": h.window_min,
+        "stream_window_max": h.window_max,
         "proxies": len(h.fleet),
         "spread_senders": h.use_spread,
         "sustained_ring_metrics_per_s":
@@ -971,7 +1036,15 @@ def main() -> None:
     ap.add_argument("--series", type=int, default=2000,
                     help="distinct counter series in the workload")
     ap.add_argument("--window", type=int, default=32,
-                    help="stream ack window (streaming mode)")
+                    help="stream ack window (streaming mode; the AIMD "
+                         "starting point when adaptive)")
+    ap.add_argument("--no-adaptive", action="store_true",
+                    help="pin the fixed PR 15 window (adaptive AIMD is "
+                         "the default)")
+    ap.add_argument("--window-min", type=int, default=1,
+                    help="adaptive window floor")
+    ap.add_argument("--window-max", type=int, default=128,
+                    help="adaptive window ceiling")
     ap.add_argument("--proxies", type=int, default=1,
                     help="live proxy fleet size (M > 1 spreads senders)")
     ap.add_argument("--standby", type=int, default=0,
@@ -988,6 +1061,13 @@ def main() -> None:
                     help="run the search in BOTH modes (unary first) on "
                          "identical topologies; one artifact, headline "
                          "from streaming, speedup recorded")
+    ap.add_argument("--ab-axis", default="mode",
+                    choices=["mode", "stream-window"],
+                    help="what --ab compares: forward mode (unary vs "
+                         "streaming), or stream-window adds a third "
+                         "fixed-window streaming cell plus calm-point "
+                         "trials — adaptive vs fixed-32 at calm AND "
+                         "saturated rates, same artifact")
     ap.add_argument("--scaling", action="store_true",
                     help="sharded-tier cells (--cells) + chaos cell; "
                          "artifact RING_PROXY_SCALING.json")
@@ -1028,7 +1108,8 @@ def main() -> None:
     def mk(streaming: bool, n_proxies: int | None = None,
            standby: int | None = None, use_spread: bool | None = None,
            routing_workers: int = 4,
-           routing_queue_max: int | None = None) -> RingHarness:
+           routing_queue_max: int | None = None,
+           adaptive: bool | None = None) -> RingHarness:
         return RingHarness(
             args.n_globals, args.senders, args.batch, args.series,
             streaming, args.window, interval_s=args.interval_s,
@@ -1037,7 +1118,10 @@ def main() -> None:
             use_spread=(args.spread or None) if use_spread is None
             else use_spread,
             routing_workers=routing_workers,
-            routing_queue_max=routing_queue_max)
+            routing_queue_max=routing_queue_max,
+            adaptive=(not args.no_adaptive) if adaptive is None
+            else adaptive,
+            window_min=args.window_min, window_max=args.window_max)
 
     base = {
         "platform": platform,
@@ -1046,6 +1130,9 @@ def main() -> None:
         "batch_metrics": args.batch,
         "series": args.series,
         "stream_window": args.window,
+        "stream_adaptive": not args.no_adaptive,
+        "stream_window_min": args.window_min,
+        "stream_window_max": args.window_max,
         "interval_s": args.interval_s,
     }
     t0 = time.time()
@@ -1086,6 +1173,9 @@ def main() -> None:
             "value": trial["ring_metrics_per_s"],
             "unit": "metrics/s",
             "mode": args.mode,
+            "adaptive": not args.no_adaptive,
+            "window_current": stream.get("window_current", 0),
+            "shrink_events": stream.get("shrink_events", 0),
             "proxies": len(h.fleet),
             "spread_senders": h.use_spread,
             "offered": args.rate,
@@ -1109,11 +1199,36 @@ def main() -> None:
         return
 
     modes: dict[str, dict] = {}
-    mode_list = ([("unary", False), ("streaming", True)] if args.ab
-                 else [(args.mode, args.mode == "streaming")])
-    for name, streaming in mode_list:
-        h = mk(streaming)
+    window_ab = args.ab and args.ab_axis == "stream-window"
+    if window_ab:
+        # unary baseline, the PR 15 fixed window, and the adaptive
+        # window, all on identical topologies; "streaming" stays the
+        # adaptive (production-default) cell so the artifact keys the
+        # CI gates parse are unchanged
+        mode_list = [("unary", False, None),
+                     ("fixed_window", True, False),
+                     ("streaming", True, True)]
+    elif args.ab:
+        mode_list = [("unary", False, None), ("streaming", True, None)]
+    else:
+        mode_list = [(args.mode, args.mode == "streaming", None)]
+    calm: dict[str, dict] = {}
+    for name, streaming, adaptive in mode_list:
+        h = mk(streaming, adaptive=adaptive)
         try:
+            if window_ab and streaming:
+                # the calm point: a fixed low rate well inside capacity,
+                # where adaptive must not cost anything
+                print(f"== calm point ({name}) ==", file=sys.stderr,
+                      flush=True)
+                t = h.run_trial(args.start_rate, args.intervals or 3,
+                                max_loss=args.max_loss)
+                calm[name] = {k: t[k] for k in (
+                    "ring_metrics_per_s", "loss_frac", "attain_frac",
+                    "duplicates_observed", "conservation_exact",
+                    "passed")}
+                calm[name]["window_current_trace"] = [
+                    i["window_current"] for i in t["intervals"]]
             search = search_ring_sustained(
                 h, start_rate=args.start_rate, max_rate=args.max_rate,
                 trial_intervals=args.intervals or 3,
@@ -1158,6 +1273,39 @@ def main() -> None:
             modes["unary"]["duplicates_observed"] == 0)
         checks["streaming_ge_unary"] = s >= u
         out["streaming_ge_unary"] = checks["streaming_ge_unary"]
+    if window_ab:
+        fx = modes["fixed_window"]
+        ad = modes["streaming"]
+        f_sat = fx["sustained_ring_metrics_per_s"]
+        a_sat = ad["sustained_ring_metrics_per_s"]
+        f_calm = calm["fixed_window"]["ring_metrics_per_s"]
+        a_calm = calm["streaming"]["ring_metrics_per_s"]
+        out["stream_window_ab"] = {
+            "fixed_window": args.window,
+            "calm_rate_metrics_per_s": args.start_rate,
+            "calm": calm,
+            "saturated": {
+                "fixed_window_metrics_per_s": f_sat,
+                "adaptive_metrics_per_s": a_sat,
+                "ratio": round(a_sat / f_sat, 3) if f_sat > 0 else None,
+            },
+        }
+        # the adaptive window must win (or tie within paced-load noise)
+        # at BOTH operating points; CALM_TOL absorbs scheduler jitter on
+        # a fixed offered rate both cells attain anyway
+        CALM_TOL = 0.97
+        checks["adaptive_ge_fixed_saturated"] = a_sat >= f_sat
+        checks["adaptive_ge_fixed_calm"] = (
+            f_calm <= 0 or a_calm >= CALM_TOL * f_calm)
+        checks["fixed_window_confirmed"] = bool(fx["confirmed"])
+        checks["fixed_window_duplicates_zero"] = (
+            fx["duplicates_observed"] == 0)
+        checks["fixed_window_conservation_exact"] = bool(
+            fx["conservation_exact"])
+        checks["calm_duplicates_zero"] = all(
+            c["duplicates_observed"] == 0 for c in calm.values())
+        checks["calm_conservation_exact"] = all(
+            bool(c["conservation_exact"]) for c in calm.values())
     failures = sorted(k for k, ok in checks.items() if not ok)
     out["checks"] = checks
     out["failures"] = failures
@@ -1174,6 +1322,13 @@ def main() -> None:
         summary["unary_metrics_per_s"] = out["unary_metrics_per_s"]
         summary["speedup_vs_unary"] = out["speedup_vs_unary"]
         summary["streaming_ge_unary"] = out["streaming_ge_unary"]
+    if window_ab:
+        summary["stream_window_ab"] = {
+            "saturated": out["stream_window_ab"]["saturated"],
+            "adaptive_ge_fixed_saturated":
+                checks["adaptive_ge_fixed_saturated"],
+            "adaptive_ge_fixed_calm": checks["adaptive_ge_fixed_calm"],
+        }
     summary["failures"] = failures
     print(json.dumps(summary))
     if failures:

@@ -245,20 +245,76 @@ print(f"duplicates==0 gate: OK ({a['duplicates_injected']} injected, "
       f"{a['receiver_replays_absorbed']} absorbed)")
 PYGATE
 
+# Streaming congestion lane: the adaptive ack window (AIMD controller,
+# distributed/rpc.py) under scripted busy-ack storms and ack-delay
+# windows (utils/faults.py FaultyStreamSink) — collapse to the floor,
+# recovery after the storm, duplicates == 0 across a reconnect landing
+# mid-collapse, and the native VSF1/VDE1 codec parity matrix. Runs
+# twice, mirroring the micro-fold lane: default (adaptive on) and with
+# the escape hatch thrown (VENEUR_STREAM_ADAPTIVE=0, which must
+# reproduce the PR 15 fixed-window wire shape) — a controller
+# regression is named by the first pass, a broken hatch by the second.
+echo "== streaming congestion lane (adaptive on + escape hatch) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_stream_forward.py -q -m 'not slow'
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_STREAM_ADAPTIVE=0 \
+  python -m pytest tests/test_stream_forward.py -q -m 'not slow'
+
+# Forward-codec parity lane: the native frame/ack/dedup-envelope codec
+# (native/forward_codec.cpp) must be byte-identical to the pinned
+# Python encoders and reject-identical on corrupt input. The native-on
+# pass rides the congestion lane above; this pass masks the .so so a
+# broken fallback negotiation is named here. (The forward_codec
+# differential fuzz target rides the codec fuzz lane at the top — it
+# is in the default target set.)
+echo "== forward codec parity lane (native masked) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_CODEC_NATIVE=0 \
+  python -m pytest tests/test_stream_forward.py -q -m 'not slow' \
+    -k 'codec or parity'
+
 # Ring-sustained smoke: the whole-ring harness (paced senders → proxy
 # → 3 globals over real gRPC, tools/bench_ring_sustained.py) at a
-# fixed offered rate on the streaming forward path. Gates the PR 15
-# transport end to end: frames pipelined under the ack window,
-# server-side coalescing engaged, exact ring conservation
-# (ingested == proxied + drops at quiescence) and duplicates == 0 at a
-# rate (15k metrics/s) well under the rig's measured A/B cliff so
-# host noise never flakes the lane. Artifact goes to /tmp — the
-# committed RING_SUSTAINED.json is the full --ab search, gated below.
-echo "== ring-sustained smoke (streaming forward path) =="
+# fixed offered rate on the streaming forward path — adaptive window
+# by default, plus a fixed-window (--no-adaptive, the PR 15 shape)
+# A/B cell at the same rate. Gates the transport end to end: frames
+# pipelined under the ack window, server-side coalescing engaged,
+# exact ring conservation (ingested == proxied + drops at quiescence)
+# and duplicates == 0 in BOTH cells at a rate (15k metrics/s) well
+# under the rig's measured A/B cliff so host noise never flakes the
+# lane, and the adaptive cell at least matching the fixed cell.
+# Artifacts go to /tmp — the committed RING_SUSTAINED.json is the
+# full --ab --ab-axis stream-window search, gated below.
+echo "== ring-sustained smoke (adaptive + fixed-window A/B) =="
 timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
   python tools/bench_ring_sustained.py --smoke --mode streaming \
     --rate 15000 --out "${TMPDIR:-/tmp}/RING_SUSTAINED_SMOKE.json"
+timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/bench_ring_sustained.py --smoke --mode streaming \
+    --rate 15000 --no-adaptive \
+    --out "${TMPDIR:-/tmp}/RING_SUSTAINED_SMOKE_FIXED.json"
+python - "${TMPDIR:-/tmp}/RING_SUSTAINED_SMOKE.json" \
+         "${TMPDIR:-/tmp}/RING_SUSTAINED_SMOKE_FIXED.json" <<'PYGATE'
+import json, sys
+ad = json.load(open(sys.argv[1]))
+fx = json.load(open(sys.argv[2]))
+assert ad["adaptive"] and not fx["adaptive"], (ad["adaptive"],
+                                               fx["adaptive"])
+for cell in (ad, fx):
+    w = "adaptive" if cell["adaptive"] else "fixed"
+    assert cell["passed"], f"{w} smoke cell failed"
+    assert cell["duplicates_observed"] == 0, f"{w}: duplicates"
+    assert cell["conservation_exact"], f"{w}: conservation broken"
+# both cells attain the same paced offered rate; the adaptive window
+# must not cost throughput (0.95 absorbs scheduler jitter on 1 core)
+assert ad["value"] >= 0.95 * fx["value"], \
+    f"adaptive smoke rate {ad['value']} << fixed {fx['value']}"
+assert ad["window_current"] >= 1, "adaptive window gauge missing"
+print(f"stream-window smoke A/B: OK (adaptive {ad['value']:.0f}/s "
+      f"window={ad['window_current']} vs fixed {fx['value']:.0f}/s, "
+      f"dups 0/0)")
+PYGATE
 
 # Sharded-tier smoke: the same ring with spread senders over M=1 and
 # M=2 proxies. Gates the proxy-tier spreading path end to end: exact
@@ -323,6 +379,13 @@ assert r["checks"]["streaming_ge_unary"], \
 for mode, m in r["modes"].items():
     assert m["duplicates_observed"] == 0, \
         f"committed ring A/B: {mode} duplicates"
+assert "stream_window_ab" in r, \
+    "committed ring A/B missing the stream-window axis (regenerate with" \
+    " --ab --ab-axis stream-window)"
+assert r["checks"]["adaptive_ge_fixed_saturated"], \
+    "committed ring A/B: adaptive window slower than fixed at saturation"
+assert r["checks"]["adaptive_ge_fixed_calm"], \
+    "committed ring A/B: adaptive window slower than fixed at the calm point"
 s = json.load(open("RING_PROXY_SCALING.json"))
 assert not s["failures"], f"committed proxy scaling failed: {s['failures']}"
 for m, c in s["cells"].items():

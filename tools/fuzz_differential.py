@@ -16,8 +16,11 @@ the C++ MetricBatch decoder):
 Later rounds added ssf_stream (framed-stream recoverability), loadgen
 (generated traffic must parse in both codecs), reader_commit
 (shared-nothing per-reader owned contexts vs one legacy context over
-the same per-reader streams — keyed fold parity), and query (live-query
-device kernels vs independent numpy references on randomized pools).
+the same per-reader streams — keyed fold parity), query (live-query
+device kernels vs independent numpy references on randomized pools),
+and forward_codec (native VSF1/VDE1 stream-frame codec vs the pinned
+Python reference: byte-identical encodes, round-trip decodes, same
+typed verdict on corrupted blobs).
 
 Usage: python tools/fuzz_differential.py [--seconds 30] [--seed N]
 Exit 0 = no divergence; 1 = divergence (repro printed with seed).
@@ -640,10 +643,106 @@ def fuzz_query(rng, t_end) -> int:
     return n
 
 
+def fuzz_forward_codec(rng, t_end) -> int:
+    """Native VSF1/VDE1 forward-frame codec vs the pinned Python
+    reference: encoded bytes identical, decodes round-trip through both
+    paths, and corrupted blobs draw the same typed verdict (accept with
+    equal value, or ValueError) from both. Runs against whatever
+    dispatch is live — with VENEUR_CODEC_NATIVE=0 it degrades to a
+    Python self-consistency sweep (CI runs it both ways)."""
+    from veneur_tpu.distributed import codec
+
+    if codec._native_codec() is None:
+        print("forward_codec: native codec not loaded "
+              "(Python self-consistency only)")
+
+    def rand_sender() -> str:
+        chars = []
+        for _ in range(rng.randrange(0, 14)):
+            r = rng.random()
+            if r < 0.55:
+                chars.append(chr(rng.randrange(0x20, 0x7F)))
+            elif r < 0.70:   # controls + DEL: the \u00xx escape path
+                chars.append(chr(rng.choice(
+                    list(range(0x00, 0x20)) + [0x7F])))
+            elif r < 0.85:   # BMP non-ASCII: \uxxxx escapes
+                chars.append(chr(rng.randrange(0x80, 0x3000)))
+            elif r < 0.95:   # astral: surrogate-pair escapes
+                chars.append(chr(rng.randrange(0x10000, 0x10400)))
+            else:            # lone surrogate: native must decline,
+                chars.append(chr(rng.randrange(0xD800, 0xE000)))
+        return "".join(chars)  # ... and fall back per-call
+
+    def verdict(fn, blob):
+        try:
+            return ("ok", fn(blob))
+        except ValueError:
+            return ("reject", None)
+
+    n = 0
+    while time.time() < t_end:
+        for _ in range(1500):
+            seq = rng.randrange(0, 1 << 64)
+            body = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 48)))
+            frame = codec.encode_stream_frame(seq, body)
+            if frame != codec.encode_stream_frame_py(seq, body):
+                print(f"forward_codec FRAME ENC DIVERGE seq={seq}")
+                return -1
+            if (codec.decode_stream_frame(frame) != (seq, body)
+                    or codec.decode_stream_frame_py(frame) != (seq, body)):
+                print(f"forward_codec FRAME DEC DIVERGE seq={seq}")
+                return -1
+            status = rng.randrange(0, 256)
+            ack = codec.encode_stream_ack(seq, status)
+            if (ack != codec.encode_stream_ack_py(seq, status)
+                    or codec.decode_stream_ack(ack)
+                    != codec.decode_stream_ack_py(ack)):
+                print(f"forward_codec ACK DIVERGE seq={seq} st={status}")
+                return -1
+            sender = rand_sender()
+            did = rng.randrange(-(1 << 66), 1 << 66)  # straddles i64
+            cnt = rng.randrange(0, 1 << 40)
+            env = codec.encode_dedup_envelope(sender, did, cnt, body)
+            if env != codec.encode_dedup_envelope_py(
+                    sender, did, cnt, body):
+                print(f"forward_codec ENV ENC DIVERGE {sender!r} {did}")
+                return -1
+            # ground truth is the JSON escape round-trip: two adjacent
+            # lone surrogates re-merge into one astral char on decode
+            # (a Python-reference property the native path must match)
+            import json as _json
+            want = ((_json.loads(_json.dumps(sender)), did, cnt), body)
+            if (codec.decode_dedup_envelope(env) != want
+                    or codec.decode_dedup_envelope_py(env) != want):
+                print(f"forward_codec ENV DEC DIVERGE {sender!r} {did}")
+                return -1
+            # corruption: one mutated byte must draw the same verdict
+            # (and value, when accepted) from both decode paths
+            blob = env if rng.random() < 0.5 else frame
+            pos = rng.randrange(len(blob))
+            mutated = (blob[:pos]
+                       + bytes([blob[pos] ^ (1 << rng.randrange(8))])
+                       + blob[pos + 1:])
+            for pub, ref in ((codec.decode_dedup_envelope,
+                              codec.decode_dedup_envelope_py),
+                             (codec.decode_stream_frame,
+                              codec.decode_stream_frame_py),
+                             (codec.decode_stream_ack,
+                              codec.decode_stream_ack_py)):
+                if verdict(pub, mutated) != verdict(ref, mutated):
+                    print(f"forward_codec CORRUPT DIVERGE {pub.__name__}"
+                          f" pos={pos} blob={mutated!r}")
+                    return -1
+            n += 1
+    return n
+
+
 TARGETS = {"dogstatsd": fuzz_dogstatsd, "ssf": fuzz_ssf,
            "metricpb": fuzz_metricpb, "gob": fuzz_gob,
            "ssf_stream": fuzz_ssf_stream, "loadgen": fuzz_loadgen,
-           "reader_commit": fuzz_reader_commit, "query": fuzz_query}
+           "reader_commit": fuzz_reader_commit, "query": fuzz_query,
+           "forward_codec": fuzz_forward_codec}
 
 
 def _git_rev() -> str:
@@ -698,7 +797,7 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--targets",
                     default="dogstatsd,ssf,metricpb,gob,ssf_stream,"
-                            "loadgen,reader_commit,query")
+                            "loadgen,reader_commit,query,forward_codec")
     ap.add_argument("--tally", default=None, metavar="PATH",
                     help="accumulate results into this JSON artifact")
     ap.add_argument("--rounds", type=int, default=1,

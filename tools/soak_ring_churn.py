@@ -312,6 +312,9 @@ def main() -> None:
             "window_stalls_delta": max(0, cur_stream["window_stalls"]
                                        - prev_stream["window_stalls"]),
             "unacked_frames": cur_stream["unacked_frames"],
+            "window_current": cur_stream.get("window_current", 0),
+            "shrink_delta": max(0, cur_stream.get("shrink_events", 0)
+                                - prev_stream.get("shrink_events", 0)),
         })
         prev_stream = cur_stream
 

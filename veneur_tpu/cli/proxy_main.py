@@ -104,7 +104,13 @@ def main(argv=None) -> int:
                         journal=journal,
                         dedup=cfg.forward_dedup,
                         streaming=cfg.forward_streaming,
-                        stream_window=cfg.forward_stream_window)
+                        stream_window=cfg.forward_stream_window,
+                        stream_adaptive=getattr(
+                            cfg, "forward_stream_adaptive", True),
+                        stream_window_min=getattr(
+                            cfg, "forward_stream_window_min", 1),
+                        stream_window_max=getattr(
+                            cfg, "forward_stream_window_max", 128))
     if journal is not None:
         # re-route the previous incarnation's durable spill under the
         # current ring before accepting fresh traffic

@@ -218,6 +218,23 @@ class Config:
     # connection's lifetime, so mixed fleets interop either way.
     forward_streaming: bool = True
     forward_stream_window: int = 32
+    # adaptive ack window (distributed/rpc.py _WindowController): the
+    # in-flight window self-tunes AIMD-style per destination — +1/W per
+    # clean ack, halved on busy-acks/ack-timeouts — clamped to
+    # [forward_stream_window_min, forward_stream_window_max];
+    # forward_stream_window is the starting point. Off (or the
+    # VENEUR_STREAM_ADAPTIVE=0 escape hatch) pins the PR-15 fixed
+    # window for old-peer interop, byte-identical on the wire.
+    forward_stream_adaptive: bool = True
+    forward_stream_window_min: int = 1
+    forward_stream_window_max: int = 128
+    # byte target per stream frame: senders coalesce flush payloads up
+    # to ~this many bytes per frame (a frame's cost becomes predictable,
+    # making the window controller's unit meaningful); per-destination
+    # frame memory is bounded by window_max × frame_bytes. The import
+    # side's StreamCoalescer group-commits on a multiple of the same
+    # budget.
+    forward_stream_frame_bytes: int = 262144
     # sharded proxy tier (distributed/spread.py): instead of pinning ONE
     # upstream in forward_address, the local tier can discover the proxy
     # FLEET and spread each flush's forward payloads across live proxies
@@ -574,6 +591,13 @@ class ProxyConfig:
     # unary via UNIMPLEMENTED. Escape hatch: VENEUR_FORWARD_STREAMING=0.
     forward_streaming: bool = True
     forward_stream_window: int = 32
+    # adaptive AIMD ack window + byte-sized frames (same keys and
+    # semantics as the server config; see Config above). Escape hatch:
+    # VENEUR_STREAM_ADAPTIVE=0 pins the fixed PR-15 window.
+    forward_stream_adaptive: bool = True
+    forward_stream_window_min: int = 1
+    forward_stream_window_max: int = 128
+    forward_stream_frame_bytes: int = 262144
     # forward-path delivery guarantees (the PR-5 sink delivery layer
     # applied per destination; sinks/delivery.py DeliveryPolicy):
     # bounded retry on transient failures, per-destination circuit
@@ -708,10 +732,23 @@ def _validate_dedup_keys(cfg) -> None:
 
 def _validate_stream_keys(cfg) -> None:
     """Shared streaming-forward validation (Config and ProxyConfig carry
-    the same forward_streaming/forward_stream_window knobs)."""
+    the same forward_streaming/forward_stream_* knobs)."""
     if cfg.forward_stream_window < 1:
         raise ValueError("forward_stream_window must be >= 1 (set"
                          " forward_streaming: false to disable streaming)")
+    if cfg.forward_stream_window_min < 1:
+        raise ValueError("forward_stream_window_min must be >= 1 (a"
+                         " zero window can never admit a frame)")
+    if cfg.forward_stream_window_max < cfg.forward_stream_window_min:
+        raise ValueError("forward_stream_window_max must be >="
+                         " forward_stream_window_min")
+    if not (cfg.forward_stream_window_min <= cfg.forward_stream_window
+            <= cfg.forward_stream_window_max):
+        raise ValueError("forward_stream_window (the adaptive starting"
+                         " point) must lie in [forward_stream_window_min,"
+                         " forward_stream_window_max]")
+    if cfg.forward_stream_frame_bytes < 1:
+        raise ValueError("forward_stream_frame_bytes must be >= 1")
 
 
 def _validate_elastic_keys(cfg) -> None:

@@ -206,17 +206,43 @@ def snapshot_to_wire(snap: FlushSnapshot,
 # MetricBatch and the two shapes sniff apart unambiguously.  Headerless
 # blobs pass through untouched — a dedup-unaware sender interops at
 # at-least-once semantics, exactly as before.
+#
+# The VDE1/VSF1 encode/decode hot paths dispatch to the native codec
+# (native/forward_codec.cpp, GIL released) when libveneur_native.so
+# carries it; the *_py functions below are the pinned byte-identical
+# reference — the wire contract — and the only implementation when the
+# library is absent or VENEUR_CODEC_NATIVE=0 masks it out. Native
+# entry points decline (return None) on any input whose Python
+# semantics they don't replicate exactly, so the dispatchers fall back
+# per-call, never per-process.
 
 DEDUP_MAGIC = b"VDE1"  # 'V'-leading, versioned; u16 LE header length follows
 
+_native_codec_mod = None
+_native_codec_checked = False
 
-def encode_dedup_envelope(sender: str, dedup_id: int, count: int,
-                          body: bytes) -> bytes:
-    """Prepend the versioned idempotency header to MetricBatch bytes.
 
-    ``count`` (the batch's metric count) is REQUIRED in the header: a
-    receiver that dedups a replay must still report the batch's size as
-    accepted (the HTTP import path treats 0 as a malformed body)."""
+def _native_codec():
+    """The native module when the forward codec is usable, else None.
+    Cached after the first probe (build-on-load makes the probe
+    expensive); VENEUR_CODEC_NATIVE is read at probe time, so the
+    escape hatch is a process-start switch like VENEUR_EMIT_NATIVE."""
+    global _native_codec_mod, _native_codec_checked
+    if not _native_codec_checked:
+        _native_codec_checked = True
+        try:
+            from veneur_tpu import native as _native
+
+            _native_codec_mod = (_native if _native.codec_available()
+                                 else None)
+        except Exception:
+            _native_codec_mod = None
+    return _native_codec_mod
+
+
+def encode_dedup_envelope_py(sender: str, dedup_id: int, count: int,
+                             body: bytes) -> bytes:
+    """Pinned Python reference for the VDE1 envelope wire bytes."""
     import json as _json
 
     hdr = _json.dumps(
@@ -228,14 +254,32 @@ def encode_dedup_envelope(sender: str, dedup_id: int, count: int,
     return DEDUP_MAGIC + len(hdr).to_bytes(2, "little") + hdr + body
 
 
-def decode_dedup_envelope(
+def encode_dedup_envelope(sender: str, dedup_id: int, count: int,
+                          body: bytes) -> bytes:
+    """Prepend the versioned idempotency header to MetricBatch bytes.
+
+    ``count`` (the batch's metric count) is REQUIRED in the header: a
+    receiver that dedups a replay must still report the batch's size as
+    accepted (the HTTP import path treats 0 as a malformed body)."""
+    n = _native_codec()
+    if (n is not None and isinstance(sender, str)
+            and isinstance(body, bytes)):
+        try:
+            sender_b = sender.encode("utf-8")
+        except UnicodeEncodeError:
+            sender_b = None  # lone surrogates: Python json handles them
+        if sender_b is not None:
+            prefix = n.dedup_header_encode(sender_b, int(dedup_id),
+                                           int(count))
+            if prefix is not None:
+                return prefix + body
+    return encode_dedup_envelope_py(sender, dedup_id, count, body)
+
+
+def decode_dedup_envelope_py(
     blob: bytes,
 ) -> "tuple[tuple[str, int, int] | None, bytes]":
-    """Split a wire blob into ``((sender, id, count) | None, body)``.
-
-    Headerless blobs (old senders) return ``(None, blob)`` unchanged.
-    A blob that *starts* like an envelope but is malformed raises
-    ValueError — it cannot be a legacy MetricBatch either."""
+    """Pinned Python reference for the VDE1 envelope split."""
     import json as _json
 
     if not blob.startswith(DEDUP_MAGIC):
@@ -252,6 +296,31 @@ def decode_dedup_envelope(
         key = (str(meta["s"]), int(meta["i"]), int(meta["n"]))
     except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
         raise ValueError(f"bad dedup envelope header: {e}") from e
+    return key, blob[off + hlen:]
+
+
+def decode_dedup_envelope(
+    blob: bytes,
+) -> "tuple[tuple[str, int, int] | None, bytes]":
+    """Split a wire blob into ``((sender, id, count) | None, body)``.
+
+    Headerless blobs (old senders) return ``(None, blob)`` unchanged.
+    A blob that *starts* like an envelope but is malformed raises
+    ValueError — it cannot be a legacy MetricBatch either."""
+    n = _native_codec()
+    if (n is None or not isinstance(blob, bytes)
+            or not blob.startswith(DEDUP_MAGIC)):
+        return decode_dedup_envelope_py(blob)
+    if len(blob) < len(DEDUP_MAGIC) + 2:
+        raise ValueError("truncated dedup envelope")
+    off = len(DEDUP_MAGIC)
+    hlen = int.from_bytes(blob[off:off + 2], "little")
+    off += 2
+    if len(blob) < off + hlen:
+        raise ValueError("truncated dedup envelope header")
+    key = n.dedup_header_parse(blob[off:off + hlen])
+    if key is None:  # non-canonical header: exact Python semantics
+        return decode_dedup_envelope_py(blob)
     return key, blob[off + hlen:]
 
 
@@ -279,36 +348,108 @@ _SEQ_OFF = len(STREAM_FRAME_MAGIC)
 _BODY_OFF = _SEQ_OFF + 8
 
 
-def encode_stream_frame(seq: int, body: bytes) -> bytes:
-    """One stream frame: magic + u64 LE seq + unary-shaped body."""
+def encode_stream_frame_py(seq: int, body: bytes) -> bytes:
+    """Pinned Python reference for the VSF1 frame wire bytes."""
     return STREAM_FRAME_MAGIC + int(seq).to_bytes(8, "little") + body
 
 
-def decode_stream_frame(blob: bytes) -> tuple[int, bytes]:
-    """Split a stream frame into (seq, body); ValueError on garbage."""
+def encode_stream_frame(seq: int, body: bytes) -> bytes:
+    """One stream frame: magic + u64 LE seq + unary-shaped body."""
+    n = _native_codec()
+    if (n is not None and isinstance(seq, int)
+            and isinstance(body, bytes)):
+        out = n.stream_frame_encode(seq, body)
+        if out is not None:
+            return out
+    return encode_stream_frame_py(seq, body)
+
+
+def decode_stream_frame_py(blob: bytes) -> tuple[int, bytes]:
+    """Pinned Python reference for the VSF1 frame split."""
     if not blob.startswith(STREAM_FRAME_MAGIC) or len(blob) < _BODY_OFF:
         raise ValueError("bad stream frame")
     return (int.from_bytes(blob[_SEQ_OFF:_BODY_OFF], "little"),
             blob[_BODY_OFF:])
 
 
+def decode_stream_frame(blob: bytes) -> tuple[int, bytes]:
+    """Split a stream frame into (seq, body); ValueError on garbage."""
+    n = _native_codec()
+    if n is not None and isinstance(blob, bytes):
+        res = n.stream_frame_decode(blob)
+        if res is None:  # codec loaded, so None means a non-frame blob
+            raise ValueError("bad stream frame")
+        return res
+    return decode_stream_frame_py(blob)
+
+
+def _ack_status(ok) -> int:
+    if ok is True:
+        return STREAM_ACK_OK
+    if ok is False:
+        return STREAM_ACK_FAILED
+    return int(ok)
+
+
+def encode_stream_ack_py(seq: int, ok=True) -> bytes:
+    """Pinned Python reference for the 9-byte ack wire bytes."""
+    return int(seq).to_bytes(8, "little") + bytes((_ack_status(ok),))
+
+
 def encode_stream_ack(seq: int, ok=True) -> bytes:
     """Ack one frame. `ok` is a bool (True/False -> OK/FAILED, the
     common sink-callback shape) or an explicit STREAM_ACK_* status."""
-    if ok is True:
-        status = STREAM_ACK_OK
-    elif ok is False:
-        status = STREAM_ACK_FAILED
-    else:
-        status = int(ok)
-    return int(seq).to_bytes(8, "little") + bytes((status,))
+    n = _native_codec()
+    if n is not None and isinstance(seq, int):
+        out = n.stream_ack_encode(seq, _ack_status(ok))
+        if out is not None:
+            return out
+    return encode_stream_ack_py(seq, ok)
+
+
+def decode_stream_ack_py(blob: bytes) -> tuple[int, int]:
+    """Pinned Python reference for the ack split."""
+    if len(blob) != 9:
+        raise ValueError("bad stream ack")
+    return int.from_bytes(blob[:8], "little"), blob[8]
 
 
 def decode_stream_ack(blob: bytes) -> tuple[int, int]:
     """Split an ack into (seq, STREAM_ACK_* status)."""
-    if len(blob) != 9:
-        raise ValueError("bad stream ack")
-    return int.from_bytes(blob[:8], "little"), blob[8]
+    n = _native_codec()
+    if n is not None and isinstance(blob, bytes):
+        res = n.stream_ack_decode(blob)
+        if res is None:
+            raise ValueError("bad stream ack")
+        return res
+    return decode_stream_ack_py(blob)
+
+
+def frame_groups(parts: "list[tuple[bytes, int]]",
+                 target_bytes: int) -> "list[tuple[bytes, int]]":
+    """Group (blob, metric_count) pairs into frames of ~target_bytes.
+
+    Consecutive blobs concatenate (serialized MetricBatch blobs merge
+    by concatenation — repeated `metrics` fields append) until adding
+    the next blob would cross the target; a single oversize blob stays
+    its own frame, never split. ONLY valid for bare MetricBatch blobs:
+    a VDE1-enveloped payload carries its own dedup identity and must
+    stay one frame (the local→proxy and local→global hops qualify —
+    envelopes are minted proxy-side)."""
+    groups: list[tuple[bytes, int]] = []
+    cur: list[bytes] = []
+    cur_bytes = 0
+    cur_n = 0
+    for blob, n in parts:
+        if cur and cur_bytes + len(blob) > target_bytes:
+            groups.append((b"".join(cur), cur_n))
+            cur, cur_bytes, cur_n = [], 0, 0
+        cur.append(blob)
+        cur_bytes += len(blob)
+        cur_n += n
+    if cur:
+        groups.append((b"".join(cur), cur_n))
+    return groups
 
 
 def metric_key(m: pb.Metric) -> MetricKey:

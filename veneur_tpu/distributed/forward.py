@@ -46,19 +46,36 @@ class GRPCForwarder:
     def __init__(self, address: str, timeout_s: float = 10.0,
                  compression: float = 100.0, hll_precision: int = 14,
                  stats=None, streaming: bool = False,
-                 stream_window: int = 32) -> None:
-        # streaming rides the long-lived StreamMetrics channel (one
-        # flush payload per frame); an old upstream downgrades the
-        # client back to unary on its first UNIMPLEMENTED
+                 stream_window: int = 32,
+                 stream_adaptive: bool = True,
+                 stream_window_min: int = 1,
+                 stream_window_max: int = 128,
+                 stream_frame_bytes: int = 262144) -> None:
+        # streaming rides the long-lived StreamMetrics channel; an old
+        # upstream downgrades the client back to unary on its first
+        # UNIMPLEMENTED. With the adaptive path on, flush payloads are
+        # regrouped into ~stream_frame_bytes frames so the AIMD window's
+        # unit (one frame) has a predictable cost.
         self.client = ForwardClient(address, timeout_s,
                                     streaming=streaming,
-                                    stream_window=stream_window)
+                                    stream_window=stream_window,
+                                    stream_adaptive=stream_adaptive,
+                                    stream_window_min=stream_window_min,
+                                    stream_window_max=stream_window_max)
         self.compression = compression
         self.hll_precision = hll_precision
+        self.stream_frame_bytes = max(1, int(stream_frame_bytes))
         self.stats = stats
 
+    def _byte_framing(self) -> bool:
+        # byte-sized frames ride the same switch as the adaptive window
+        # (config forward_stream_adaptive / VENEUR_STREAM_ADAPTIVE=0):
+        # with it off the wire reverts to the PR 15 shape — one joined
+        # payload per flush — byte-identically, for old-peer interop
+        return self.client.stream_adaptive and self.client.stream_active()
+
     def __call__(self, snapshots) -> None:
-        # serialized MetricBatch blobs concatenate into one merged batch
+        # serialized MetricBatch blobs concatenate into merged batches
         # (repeated field append) — each snapshot encodes independently
         # (histo rows through the native C++ wire encoder when available)
         parts = []
@@ -67,21 +84,28 @@ class GRPCForwarder:
             blob, n = codec.snapshot_to_wire(
                 snap, self.compression, self.hll_precision)
             if n:
-                parts.append(blob)
+                parts.append((blob, n))
                 total += n
         if not total:
             return
-        payload = b"".join(parts)
+        if self._byte_framing():
+            payloads = codec.frame_groups(parts, self.stream_frame_bytes)
+        else:
+            payloads = [(b"".join(b for b, _ in parts), total)]
         started = time.time()
-        ok = self.client.send_raw(payload, total)
-        if not ok:
+        cause = None
+        sent_bytes = 0
+        for payload, n in payloads:
+            sent_bytes += len(payload)
+            if not self.client.send_raw(payload, n):
+                cause = self.client.last_error_cause
+        if cause is not None:
             log.warning(
                 "forward to %s failed (errors so far: %s)",
                 self.client.address, self.client.errors,
             )
-        _report_forward(self.stats, total, started,
-                        None if ok else self.client.last_error_cause,
-                        content_length=len(payload))
+        _report_forward(self.stats, total, started, cause,
+                        content_length=sent_bytes)
 
     def forward_stats(self) -> dict:
         """Per-destination forwarder telemetry in the same shape the
@@ -214,6 +238,14 @@ def _install_spread(server, cfg, compression: float,
         stats=getattr(server, "stats", None),
         streaming=bool(getattr(cfg, "forward_streaming", False)),
         stream_window=int(getattr(cfg, "forward_stream_window", 32)),
+        stream_adaptive=bool(
+            getattr(cfg, "forward_stream_adaptive", True)),
+        stream_window_min=int(
+            getattr(cfg, "forward_stream_window_min", 1)),
+        stream_window_max=int(
+            getattr(cfg, "forward_stream_window_max", 128)),
+        stream_frame_bytes=int(
+            getattr(cfg, "forward_stream_frame_bytes", 262144)),
         policy=policy, spread_policy=cfg.forward_spread_policy)
     if cfg.forward_discovery_file:
         from veneur_tpu.distributed.discovery import FileWatchDiscoverer
@@ -264,7 +296,15 @@ def install_forwarder(server, compression: Optional[float] = None,
                 stats=getattr(server, "stats", None),
                 streaming=bool(getattr(cfg, "forward_streaming", False)),
                 stream_window=int(
-                    getattr(cfg, "forward_stream_window", 32)))
+                    getattr(cfg, "forward_stream_window", 32)),
+                stream_adaptive=bool(
+                    getattr(cfg, "forward_stream_adaptive", True)),
+                stream_window_min=int(
+                    getattr(cfg, "forward_stream_window_min", 1)),
+                stream_window_max=int(
+                    getattr(cfg, "forward_stream_window_max", 128)),
+                stream_frame_bytes=int(
+                    getattr(cfg, "forward_stream_frame_bytes", 262144)))
     else:
         server.forwarder = HTTPForwarder(
             cfg.forward_address, timeout, compression, hll_precision,

@@ -504,7 +504,15 @@ class ImportServer:
         """Start (or RESTART after stop — the churn soak's kill/restart
         cycle rebinds the same port) the gRPC listener."""
         if self._coalescer is None:
-            self._coalescer = StreamCoalescer(self)
+            # group-commit byte budget tracks the senders' frame target
+            # (a few sender frames per merged batch); max_frames stays
+            # the safety cap against pathological tiny-frame floods
+            cfg = getattr(self.server, "config", None)
+            frame_bytes = int(
+                getattr(cfg, "forward_stream_frame_bytes", 262144)
+                or 262144)
+            self._coalescer = StreamCoalescer(
+                self, max_bytes=max(1 << 20, 4 * frame_bytes))
         self.grpc_server, self.port = rpc.make_server(
             self.handle_batch, address, raw_handler=self.handle_wire,
             stream_sink=self._coalescer)

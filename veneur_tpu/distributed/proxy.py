@@ -349,7 +349,10 @@ class ProxyServer:
                  dedup: bool = False,
                  dedup_sender: Optional[str] = None,
                  streaming: bool = False,
-                 stream_window: int = 32) -> None:
+                 stream_window: int = 32,
+                 stream_adaptive: bool = True,
+                 stream_window_min: int = 1,
+                 stream_window_max: int = 128) -> None:
         self.ring = ConsistentRing(destinations or [])
         # long-lived StreamMetrics channel per destination instead of a
         # unary call per fragment. Default OFF at this layer (like
@@ -358,6 +361,12 @@ class ProxyServer:
         # is identical either way.
         self.streaming = bool(streaming)
         self.stream_window = max(1, int(stream_window))
+        # AIMD ack-window bounds threaded to each destination client;
+        # resolution of the env hatch happens inside ForwardClient
+        self.stream_adaptive = bool(stream_adaptive)
+        self.stream_window_min = max(1, int(stream_window_min))
+        self.stream_window_max = max(
+            self.stream_window_min, int(stream_window_max))
         # exactly-once forwards: when on, every fragment carries a
         # wire-level idempotency key (versioned envelope, codec.py) the
         # import tier dedups on. Default OFF at this layer so the config
@@ -516,7 +525,10 @@ class ProxyServer:
                         dest, self.timeout_s,
                         idle_timeout_s=self.idle_timeout_s,
                         streaming=self.streaming,
-                        stream_window=self.stream_window)
+                        stream_window=self.stream_window,
+                        stream_adaptive=self.stream_adaptive,
+                        stream_window_min=self.stream_window_min,
+                        stream_window_max=self.stream_window_max)
                 self._conns[dest] = client
                 while (self.max_idle_conns > 0
                        and len(self._conns) > self.max_idle_conns):
@@ -1037,17 +1049,35 @@ class ProxyServer:
         # client's block also rides under destinations.<addr>.stream)
         stream_tot = {"opened": 0, "reconnects": 0, "acked_total": 0,
                       "window_stalls": 0, "unacked_frames": 0,
-                      "downgraded": 0}
+                      "downgraded": 0, "shrink_events": 0,
+                      "window_current": 0, "window_min_seen": 0,
+                      "window_max_seen": 0}
+        saw_stream = False
         for d in per_dest.values():
             s = d.get("stream")
             if not s:
                 continue
             for k in ("opened", "reconnects", "acked_total",
-                      "window_stalls", "unacked_frames"):
+                      "window_stalls", "unacked_frames", "shrink_events"):
                 stream_tot[k] += s.get(k, 0)
             if s.get("downgraded"):
                 stream_tot["downgraded"] += 1
+            # window gauges: worst-destination view — max operating
+            # point / deepest collapse observed across the fleet
+            cur = s.get("window_current", 0)
+            stream_tot["window_current"] = max(
+                stream_tot["window_current"], cur)
+            lo = s.get("window_min_seen", cur)
+            stream_tot["window_min_seen"] = (
+                lo if not saw_stream
+                else min(stream_tot["window_min_seen"], lo))
+            stream_tot["window_max_seen"] = max(
+                stream_tot["window_max_seen"],
+                s.get("window_max_seen", cur))
+            saw_stream = True
         stream_tot["enabled"] = self.streaming
+        stream_tot["adaptive"] = rpc.stream_adaptive_enabled(
+            self.stream_adaptive)
         stream_tot["window"] = self.stream_window
         out.update({
             "ring_version": self.ring.version,
@@ -1450,7 +1480,8 @@ class ProxyRuntimeReporter:
         self.interval_s = interval_s
         self._stop = threading.Event()
         self._last = {"proxied": 0, "drops": 0, "spans": 0,
-                      "acked": 0, "reconnects": 0, "stalls": 0}
+                      "acked": 0, "reconnects": 0, "stalls": 0,
+                      "shrinks": 0}
 
     def report_once(self) -> None:
         from veneur_tpu.utils.proc import current_rss_bytes
@@ -1479,12 +1510,23 @@ class ProxyRuntimeReporter:
             self.stats.count(
                 "stream.window_stalls",
                 max(0, stream["window_stalls"] - self._last["stalls"]))
+            self.stats.count(
+                "stream.shrink_events",
+                max(0, stream.get("shrink_events", 0)
+                    - self._last["shrinks"]))
             self._last["acked"] = stream["acked_total"]
             self._last["reconnects"] = stream["reconnects"]
             self._last["stalls"] = stream["window_stalls"]
+            self._last["shrinks"] = stream.get("shrink_events", 0)
             self.stats.gauge("stream.unacked_frames",
                              float(stream["unacked_frames"]))
             self.stats.gauge("stream.open_streams", float(stream["opened"]))
+            self.stats.gauge("stream.window_current",
+                             float(stream.get("window_current", 0)))
+            self.stats.gauge("stream.window_min_seen",
+                             float(stream.get("window_min_seen", 0)))
+            self.stats.gauge("stream.window_max_seen",
+                             float(stream.get("window_max_seen", 0)))
         if self.trace_proxy is not None:
             spans = self.trace_proxy.proxied_spans
             self.stats.count("spans_proxied",

@@ -8,7 +8,9 @@ message codegen comes from protoc; see proto/veneur_tpu.proto).
 
 from __future__ import annotations
 
+import os
 import queue
+import random
 import threading
 import time
 from concurrent import futures
@@ -195,6 +197,125 @@ def make_server(handler: Callable[[pb.MetricBatch], None],
 _UNIMPLEMENTED = "__unimplemented__"  # internal downgrade signal, not a cause
 
 
+def stream_adaptive_enabled(flag) -> bool:
+    """Whether the adaptive window is on for a client configured with
+    `flag`. VENEUR_STREAM_ADAPTIVE=0 is the escape hatch back to PR 15's
+    fixed-window wire behavior (byte-identical frames, constant window)
+    regardless of config — the old-peer interop/rollback switch."""
+    if os.environ.get("VENEUR_STREAM_ADAPTIVE", "").lower() in (
+            "0", "false", "off", "no"):
+        return False
+    return bool(flag)
+
+
+class _WindowController:
+    """AIMD ack-window controller for one destination's stream.
+
+    The in-flight window is the congestion variable: every clean ack
+    grows it additively (+1/W per ack — one window's worth of acks adds
+    one slot, TCP-Reno shaped), every congestion signal (a busy-ack
+    from a full receiver, or a frame ack-timeout) halves it, clamped to
+    [wmin, wmax]. With adaptive off the window is pinned to the
+    configured initial and every hook is a no-op — the PR 15 fixed
+    semaphore, bit for bit.
+
+    `shrink_events` counts congestion signals applied (also at the
+    floor: a busy storm at wmin is still signal), `window_min_seen` /
+    `window_max_seen` bound the operating range since open — the
+    gauges forward_stats()["stream"] exports per destination."""
+
+    __slots__ = ("adaptive", "wmin", "wmax", "lock", "_current",
+                 "window_min_seen", "window_max_seen", "shrink_events")
+
+    def __init__(self, initial: int, wmin: int, wmax: int,
+                 adaptive: bool) -> None:
+        self.adaptive = bool(adaptive)
+        self.wmin = max(1, int(wmin))
+        self.wmax = max(self.wmin, int(wmax))
+        if self.adaptive:
+            cur = min(self.wmax, max(self.wmin, int(initial)))
+        else:
+            cur = max(1, int(initial))
+        self.lock = threading.Lock()
+        self._current = float(cur)
+        self.window_min_seen = cur
+        self.window_max_seen = cur
+        self.shrink_events = 0
+
+    def window(self) -> int:
+        return int(self._current)
+
+    def on_ack(self) -> None:
+        """Additive increase: one clean ack grows the window by 1/W."""
+        if not self.adaptive:
+            return
+        with self.lock:
+            cur = self._current
+            if cur < self.wmax:
+                cur = min(float(self.wmax), cur + 1.0 / max(cur, 1.0))
+                self._current = cur
+                if int(cur) > self.window_max_seen:
+                    self.window_max_seen = int(cur)
+
+    def on_congestion(self) -> None:
+        """Multiplicative decrease: busy-ack or ack-timeout halves the
+        window (clamped to wmin)."""
+        if not self.adaptive:
+            return
+        with self.lock:
+            self.shrink_events += 1
+            cur = max(float(self.wmin), self._current / 2.0)
+            self._current = cur
+            if int(cur) < self.window_min_seen:
+                self.window_min_seen = int(cur)
+
+
+class _WindowGate:
+    """Admission gate bounding in-flight frames by the controller's
+    LIVE window: capacity is re-read on every admit, so a shrink
+    applies to the next admission instantly (frames already in flight
+    above a collapsed window drain naturally — no slot is revoked).
+    acquire/release carry the same exactly-once slot-release ownership
+    contract the fixed Semaphore did; with adaptive off the capacity is
+    constant and this IS a semaphore."""
+
+    __slots__ = ("_ctl", "_cond", "_inflight")
+
+    def __init__(self, ctl: _WindowController) -> None:
+        self._ctl = ctl
+        self._cond = threading.Condition()
+        self._inflight = 0
+
+    def acquire(self, blocking: bool = True,
+                timeout: Optional[float] = None) -> bool:
+        deadline = None
+        with self._cond:
+            while self._inflight >= self._ctl.window():
+                if not blocking:
+                    return False
+                if timeout is not None:
+                    if deadline is None:
+                        deadline = time.monotonic() + timeout
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return False
+                    self._cond.wait(left)
+                else:
+                    self._cond.wait()
+            self._inflight += 1
+            return True
+
+    def release(self) -> None:
+        with self._cond:
+            if self._inflight > 0:
+                self._inflight -= 1
+            self._cond.notify()
+
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+
 class _StreamWaiter:
     __slots__ = ("event", "ok", "cause")
 
@@ -206,19 +327,20 @@ class _StreamWaiter:
 
 class _StreamState:
     """One live bidi stream: the out-queue feeding the request iterator,
-    per-seq ack waiters, and the bounded in-flight window. Whoever
-    removes a waiter from `pending` owns releasing its window slot —
-    ack receiver, stream-failure sweep, or the sender giving up on
-    timeout — so a slot is released exactly once per frame."""
+    per-seq ack waiters, and the bounded in-flight window (a _WindowGate
+    over the client's AIMD controller). Whoever removes a waiter from
+    `pending` owns releasing its window slot — ack receiver,
+    stream-failure sweep, or the sender giving up on timeout — so a
+    slot is released exactly once per frame."""
 
-    __slots__ = ("out_q", "lock", "pending", "sem", "dead", "dead_cause",
-                 "seq", "call")
+    __slots__ = ("out_q", "lock", "pending", "gate", "dead",
+                 "dead_cause", "seq", "call")
 
-    def __init__(self, window: int) -> None:
+    def __init__(self, ctl: _WindowController) -> None:
         self.out_q: "queue.SimpleQueue" = queue.SimpleQueue()
         self.lock = threading.Lock()
         self.pending: dict[int, _StreamWaiter] = {}
-        self.sem = threading.Semaphore(window)
+        self.gate = _WindowGate(ctl)
         self.dead = False
         self.dead_cause: Optional[str] = None
         self.seq = 0
@@ -255,7 +377,10 @@ class ForwardClient:
     def __init__(self, address: str, timeout_s: float = 10.0,
                  idle_timeout_s: float = 0.0,
                  streaming: bool = False,
-                 stream_window: int = 32) -> None:
+                 stream_window: int = 32,
+                 stream_adaptive: bool = True,
+                 stream_window_min: int = 1,
+                 stream_window_max: int = 128) -> None:
         self.address = address
         self.timeout_s = timeout_s
         options = []
@@ -269,6 +394,13 @@ class ForwardClient:
         self._lock = threading.Lock()
         self.streaming = streaming
         self.stream_window = max(1, int(stream_window))
+        self.stream_adaptive = stream_adaptive_enabled(stream_adaptive)
+        # one AIMD controller per destination, shared across stream
+        # incarnations: a reconnect reopens the stream at the last
+        # operating point, not back at the configured initial
+        self._window_ctl = _WindowController(
+            self.stream_window, stream_window_min, stream_window_max,
+            self.stream_adaptive)
         self._stream_lock = threading.Lock()
         self._stream: Optional[_StreamState] = None
         self.stream_opened = 0
@@ -347,6 +479,13 @@ class ForwardClient:
     def _stream_active(self) -> bool:
         return self.streaming and not self.stream_downgraded
 
+    def stream_active(self) -> bool:
+        """Whether sends currently ride the streamed path (configured
+        on and not downgraded) — callers gate byte-sized frame grouping
+        on this so a downgraded/unary client keeps the PR 15 payload
+        shape."""
+        return self._stream_active()
+
     def _dispatch(self, batch: pb.MetricBatch,
                   timeout_s: Optional[float]) -> Optional[str]:
         if self._stream_active():
@@ -403,9 +542,12 @@ class ForwardClient:
 
     def _maybe_reconnect(self) -> None:
         """Rebuild the channel after repeated transport-shaped failures,
-        at most once per backoff window (1s doubling to 30s). The old
-        channel is closed AFTER the swap so a concurrent sender fails
-        fast (classified "send") instead of hanging on it."""
+        at most once per backoff window (1s doubling to 30s, FULL
+        jitter: the actual window is uniform in (0, backoff], so a
+        proxy fleet whose upstream restarted spreads its reconnects
+        instead of thundering-herding the import listener in lockstep).
+        The old channel is closed AFTER the swap so a concurrent sender
+        fails fast (classified "send") instead of hanging on it."""
         if self.consecutive_failures < self.RECONNECT_AFTER_FAILURES:
             return
         now = time.time()
@@ -415,7 +557,8 @@ class ForwardClient:
             backoff = self._reconnect_backoff_s
             self._reconnect_backoff_s = min(
                 self.RECONNECT_BACKOFF_MAX_S, backoff * 2.0)
-            self._next_reconnect_unix = now + backoff
+            self._next_reconnect_unix = now + random.uniform(
+                0.0, backoff)
             old = self.channel
             self._build_channel()
             self.reconnects += 1
@@ -435,7 +578,7 @@ class ForwardClient:
             st = self._stream
             if st is not None and not st.dead:
                 return st
-            st = _StreamState(self.stream_window)
+            st = _StreamState(self._window_ctl)
             st.call = self._stream_call(st.requests())
             threading.Thread(
                 target=self._stream_recv_loop, args=(st,), daemon=True,
@@ -460,14 +603,21 @@ class ForwardClient:
                 if w is not None:  # late ack after give-up: no waiter
                     if status == codec.STREAM_ACK_OK:
                         w.ok = True
+                        # additive increase BEFORE the release so the
+                        # woken waiter sees the grown window
+                        self._window_ctl.on_ack()
                     elif status == codec.STREAM_ACK_BUSY:
                         # receiver full, frame not taken: transient, but
-                        # the transport is healthy — retry, don't rebuild
+                        # the transport is healthy — retry, don't
+                        # rebuild. The congestion signal halves the
+                        # window: backpressure reaches admission, not
+                        # just this frame's retry
                         w.cause = "busy"
+                        self._window_ctl.on_congestion()
                     else:
                         w.ok = False
                     w.event.set()
-                    st.sem.release()
+                    st.gate.release()
         except grpc.RpcError as e:
             try:
                 code = e.code()
@@ -503,7 +653,7 @@ class ForwardClient:
         for w in waiters:
             w.cause = cause
             w.event.set()
-            st.sem.release()
+            st.gate.release()
 
     def _kill_stream(self, cause: str) -> None:
         with self._stream_lock:
@@ -536,9 +686,9 @@ class ForwardClient:
         except Exception:
             self._note_attempt(t0)
             return self._note_stream_failure("unavailable")
-        if not st.sem.acquire(blocking=False):
+        if not st.gate.acquire(blocking=False):
             self.stream_window_stalls += 1
-            if not st.sem.acquire(
+            if not st.gate.acquire(
                     timeout=max(0.0, deadline - time.monotonic())):
                 self._note_attempt(t0)
                 return self._note_stream_failure("deadline_exceeded")
@@ -552,7 +702,7 @@ class ForwardClient:
                 seq = st.seq
                 st.pending[seq] = w
         if dead_cause is not None:
-            st.sem.release()
+            st.gate.release()
             self._note_attempt(t0)
             if dead_cause == _UNIMPLEMENTED:
                 return _UNIMPLEMENTED
@@ -562,7 +712,10 @@ class ForwardClient:
             with st.lock:
                 still_pending = st.pending.pop(seq, None)
             if still_pending is not None:
-                st.sem.release()
+                st.gate.release()
+                # an unacked frame inside the deadline is the stream's
+                # loss signal: multiplicative decrease, like a busy-ack
+                self._window_ctl.on_congestion()
                 self._note_attempt(t0)
                 return self._note_stream_failure("deadline_exceeded")
             # the ack raced our give-up: fall through to its result
@@ -612,9 +765,15 @@ class ForwardClient:
         }
         if self.streaming:
             st = self._stream
+            ctl = self._window_ctl
             out["stream"] = {
                 "enabled": True,
                 "window": self.stream_window,
+                "adaptive": self.stream_adaptive,
+                "window_current": ctl.window(),
+                "window_min_seen": ctl.window_min_seen,
+                "window_max_seen": ctl.window_max_seen,
+                "shrink_events": ctl.shrink_events,
                 "opened": self.stream_opened,
                 "reconnects": self.stream_reconnects,
                 "acked_total": self.stream_acked,

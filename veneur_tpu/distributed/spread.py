@@ -59,7 +59,9 @@ import time
 from typing import Callable, Optional
 
 from veneur_tpu.distributed import codec
-from veneur_tpu.distributed.rpc import ForwardClient, ForwardError
+from veneur_tpu.distributed.rpc import (
+    ForwardClient, ForwardError, stream_adaptive_enabled,
+)
 from veneur_tpu.sinks.delivery import DeliveryManager, DeliveryPolicy
 
 log = logging.getLogger("veneur_tpu.spread")
@@ -143,6 +145,10 @@ class SpreadForwarder:
                  compression: float = 100.0, hll_precision: int = 14,
                  stats=None, streaming: bool = True,
                  stream_window: int = 32,
+                 stream_adaptive: bool = True,
+                 stream_window_min: int = 1,
+                 stream_window_max: int = 128,
+                 stream_frame_bytes: int = 262144,
                  policy: Optional[DeliveryPolicy] = None,
                  spread_policy: str = "p2c",
                  client_factory: Optional[Callable] = None,
@@ -156,6 +162,11 @@ class SpreadForwarder:
         self.stats = stats
         self.streaming = bool(streaming)
         self.stream_window = max(1, int(stream_window))
+        self.stream_adaptive = bool(stream_adaptive)
+        self.stream_window_min = max(1, int(stream_window_min))
+        self.stream_window_max = max(
+            self.stream_window_min, int(stream_window_max))
+        self.stream_frame_bytes = max(1, int(stream_frame_bytes))
         self.spread_policy = spread_policy
         self._policy = policy or DeliveryPolicy(
             timeout_s=timeout_s, deadline_s=timeout_s)
@@ -183,9 +194,13 @@ class SpreadForwarder:
         if self._client_factory is not None:
             client = self._client_factory(addr, self.timeout_s)
         else:
-            client = ForwardClient(addr, self.timeout_s,
-                                   streaming=self.streaming,
-                                   stream_window=self.stream_window)
+            client = ForwardClient(
+                addr, self.timeout_s,
+                streaming=self.streaming,
+                stream_window=self.stream_window,
+                stream_adaptive=self.stream_adaptive,
+                stream_window_min=self.stream_window_min,
+                stream_window_max=self.stream_window_max)
         manager = DeliveryManager("forward:" + addr, self._policy)
         return _Lane(addr, client, manager)
 
@@ -396,18 +411,30 @@ class SpreadForwarder:
     def __call__(self, snapshots) -> None:
         """The flush entry point (`server.forwarder`): encode each
         worker snapshot to wire bytes and spread the payloads across
-        the live fleet."""
+        the live fleet. With the adaptive streaming path on, consecutive
+        snapshot blobs are regrouped to ~stream_frame_bytes payloads
+        (safe on this hop: bare MetricBatch blobs concatenate into a
+        merged batch — the local→proxy leg carries no dedup envelopes),
+        so each spread unit costs one predictable stream-window slot."""
         started = time.time()
         self.begin_flush()
+        parts: list[tuple[bytes, int]] = []
         total = 0
-        sent_bytes = 0
-        worst_cause: Optional[str] = None
         for snap in snapshots:
             blob, n = codec.snapshot_to_wire(
                 snap, self.compression, self.hll_precision)
             if not n:
                 continue
+            parts.append((blob, n))
             total += n
+        if self.streaming and stream_adaptive_enabled(self.stream_adaptive):
+            payloads = codec.frame_groups(parts, self.stream_frame_bytes)
+        else:
+            # adaptive off: the PR 15 shape — one payload per snapshot
+            payloads = parts
+        sent_bytes = 0
+        worst_cause: Optional[str] = None
+        for blob, n in payloads:
             sent_bytes += len(blob)
             outcome = self.send_wire(blob, n)
             if outcome == "dropped":

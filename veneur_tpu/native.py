@@ -155,6 +155,33 @@ def load_library() -> Optional[ctypes.CDLL]:
         except AttributeError:  # pre-archive library
             pass
         try:
+            # forward frame codec (native/forward_codec.cpp): VSF1
+            # stream frames/acks + the VDE1 dedup envelope header
+            lib.vn_stream_frame_encode.restype = c.c_longlong
+            lib.vn_stream_frame_encode.argtypes = [
+                c.c_ulonglong, c.c_char_p, c.c_longlong,
+                c.POINTER(c.c_char_p), c.POINTER(c.c_longlong)]
+            lib.vn_stream_frame_decode.restype = c.c_longlong
+            lib.vn_stream_frame_decode.argtypes = [
+                c.c_char_p, c.c_longlong, c.POINTER(c.c_ulonglong)]
+            lib.vn_stream_ack_encode.restype = c.c_longlong
+            lib.vn_stream_ack_encode.argtypes = [
+                c.c_ulonglong, c.c_int, c.c_char_p]
+            lib.vn_stream_ack_decode.restype = c.c_longlong
+            lib.vn_stream_ack_decode.argtypes = [
+                c.c_char_p, c.c_longlong, c.POINTER(c.c_ulonglong)]
+            lib.vn_dedup_header_encode.restype = c.c_longlong
+            lib.vn_dedup_header_encode.argtypes = [
+                c.c_char_p, c.c_longlong, c.c_longlong, c.c_longlong,
+                c.POINTER(c.c_char_p), c.POINTER(c.c_longlong)]
+            lib.vn_dedup_header_parse.restype = c.c_longlong
+            lib.vn_dedup_header_parse.argtypes = [
+                c.c_char_p, c.c_longlong,
+                c.POINTER(c.c_char_p), c.POINTER(c.c_longlong),
+                c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+        except AttributeError:  # pre-forward-codec library
+            pass
+        try:
             lib.vn_set_lock_stats.argtypes = [c.c_int]
             lib.vn_lock_stats.restype = c.c_int
             lib.vn_lock_stats.argtypes = [
@@ -764,6 +791,19 @@ def emit_available() -> bool:
     return lib is not None and hasattr(lib, "vn_deflate")
 
 
+def codec_available() -> bool:
+    """True when the native forward frame codec
+    (native/forward_codec.cpp) is loadable and not masked out.
+    VENEUR_CODEC_NATIVE=0 forces the pinned Python codec — the CI
+    parity lane and fuzz_differential flip this without touching the
+    .so on disk (same contract as VENEUR_EMIT_NATIVE)."""
+    if os.environ.get("VENEUR_CODEC_NATIVE", "").lower() in (
+            "0", "false", "off", "no"):
+        return False
+    lib = load_library()
+    return lib is not None and hasattr(lib, "vn_stream_frame_encode")
+
+
 def _blob_arg(blob) -> tuple:
     """(c_char_p-compatible arg, length) for a meta blob that may be a
     bytes object or a pool's live bytearray arena (zero-copy: the arena
@@ -1106,6 +1146,112 @@ def deflate(data: bytes) -> Optional[bytes]:
                       c.byref(out_len)) < 0:
         return None
     return ctypes.string_at(out, out_len.value)
+
+
+def stream_frame_encode(seq: int, body: bytes) -> Optional[bytes]:
+    """VSF1 frame (magic + u64 LE seq + body) with the GIL released;
+    byte-identical to codec.encode_stream_frame_py. None -> caller
+    falls back to the Python reference (library or symbol missing,
+    seq outside u64)."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_stream_frame_encode"):
+        return None
+    if not 0 <= seq < 1 << 64:
+        return None  # Python raises OverflowError; keep that path
+    c = ctypes
+    out = c.c_char_p()
+    out_len = c.c_longlong()
+    if lib.vn_stream_frame_encode(seq, body, len(body), c.byref(out),
+                                  c.byref(out_len)) != 0:
+        return None
+    return ctypes.string_at(out, out_len.value)
+
+
+def stream_frame_decode(blob: bytes) -> "Optional[tuple[int, bytes]]":
+    """(seq, body) for a VSF1 frame; None on a non-frame blob (caller
+    raises the pinned ValueError) or a missing library — callers
+    distinguish the two with codec_available()."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_stream_frame_decode"):
+        return None
+    c = ctypes
+    seq = c.c_ulonglong()
+    off = lib.vn_stream_frame_decode(blob, len(blob), c.byref(seq))
+    if off < 0:
+        return None
+    return seq.value, blob[off:]
+
+
+def stream_ack_encode(seq: int, status: int) -> Optional[bytes]:
+    """9 ack bytes (u64 LE seq + u8 status); None -> Python fallback."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_stream_ack_encode"):
+        return None
+    if not 0 <= seq < 1 << 64 or not 0 <= status <= 0xFF:
+        return None  # Python raises Overflow/ValueError; keep that path
+    buf = ctypes.create_string_buffer(9)
+    lib.vn_stream_ack_encode(seq, status, buf)
+    return buf.raw[:9]
+
+
+def stream_ack_decode(blob: bytes) -> "Optional[tuple[int, int]]":
+    """(seq, status) for a 9-byte ack; None on a non-ack blob or a
+    missing library."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_stream_ack_decode"):
+        return None
+    c = ctypes
+    seq = c.c_ulonglong()
+    status = lib.vn_stream_ack_decode(blob, len(blob), c.byref(seq))
+    if status < 0:
+        return None
+    return seq.value, status
+
+
+def dedup_header_encode(sender: bytes, dedup_id: int,
+                        count: int) -> Optional[bytes]:
+    """VDE1 envelope prefix (magic + u16 LE len + canonical JSON
+    header) for a UTF-8 sender; the caller appends the body. None ->
+    Python fallback (ints outside i64, malformed UTF-8); ValueError
+    for the pinned too-large header."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_dedup_header_encode"):
+        return None
+    if not (-(1 << 63) <= dedup_id < 1 << 63
+            and -(1 << 63) <= count < 1 << 63):
+        return None
+    c = ctypes
+    out = c.c_char_p()
+    out_len = c.c_longlong()
+    rc = lib.vn_dedup_header_encode(sender, len(sender), dedup_id,
+                                    count, c.byref(out),
+                                    c.byref(out_len))
+    if rc == -2:
+        raise ValueError("dedup header too large")
+    if rc != 0:
+        return None
+    return ctypes.string_at(out, out_len.value)
+
+
+def dedup_header_parse(hdr: bytes) -> "Optional[tuple[str, int, int]]":
+    """(sender, id, count) for a canonical VDE1 JSON header; None when
+    the header isn't canonical (caller falls back to json.loads for
+    the exact Python semantics) or the library is missing."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "vn_dedup_header_parse"):
+        return None
+    c = ctypes
+    sender = c.c_char_p()
+    sender_len = c.c_longlong()
+    id_out = c.c_longlong()
+    count_out = c.c_longlong()
+    rc = lib.vn_dedup_header_parse(hdr, len(hdr), c.byref(sender),
+                                   c.byref(sender_len), c.byref(id_out),
+                                   c.byref(count_out))
+    if rc != 0:
+        return None
+    return (ctypes.string_at(sender, sender_len.value).decode("utf-8"),
+            id_out.value, count_out.value)
 
 
 def source_hash() -> str:

@@ -22,6 +22,11 @@ layer (sinks/delivery.py) classifies:
 - flap schedules     → down_ranges of call indices that hard-refuse,
                        bracketed so breaker open→half-open→closed
                        cycles are reproducible on demand
+- congestion windows → busy_ranges / ack_delay_ranges: scripted
+                       receiver backpressure (busy-acks) and delayed
+                       acks, the deterministic drivers for the AIMD
+                       stream-window collapse/recovery edges
+                       (FaultyForwardClient and FaultyStreamSink)
 
 Decisions are drawn from one random.Random(seed) under a lock: the
 aggregate fault sequence is deterministic; which concurrent payload
@@ -40,7 +45,7 @@ from typing import Callable, Optional
 from veneur_tpu.utils.http import HTTPError
 
 FAULT_KINDS = ("refused", "http_5xx", "slow", "reset", "rejected",
-               "duplicated", "passed")
+               "duplicated", "busy", "ack_delay", "passed")
 
 
 @dataclass
@@ -67,6 +72,18 @@ class FaultPlan:
     # [(start, end)) call-index windows that hard-refuse: a deterministic
     # outage → recovery edge, the breaker-cycle driver
     down_ranges: list[tuple[int, int]] = field(default_factory=list)
+    # [(start, end)) call-index windows of explicit receiver
+    # backpressure: the forward client surfaces ForwardError("busy")
+    # (FaultyForwardClient) or the stream sink busy-acks the frame
+    # (FaultyStreamSink) — the AIMD window's multiplicative-decrease
+    # driver, scripted so collapse/recovery edges are reproducible
+    busy_ranges: list[tuple[int, int]] = field(default_factory=list)
+    # [(start, end)) call-index windows whose ack is delayed by
+    # ack_delay_s before the send/frame proceeds: past the caller's
+    # ack budget this manifests as an ack-timeout (the sender's OTHER
+    # shrink signal), inside it as harmless latency
+    ack_delay_ranges: list[tuple[int, int]] = field(default_factory=list)
+    ack_delay_s: float = 0.2
 
     def total_p(self) -> float:
         return (self.p_refuse + self.p_5xx + self.p_slow + self.p_reset
@@ -212,10 +229,31 @@ class FaultyForwardClient(_FaultBase):
                 self.injected["refused"] += 1
             raise ForwardError("unavailable", self.address,
                                "injected: partitioned link")
+        # scripted stream-congestion windows consume the call index
+        # BEFORE the probabilistic draw so plans without them keep
+        # their exact historical decision sequences
+        timeout = timeout_s or getattr(self.inner, "timeout_s", 10.0)
+        with self._lock:
+            idx = self.calls
+            busy = any(s <= idx < e for s, e in self.plan.busy_ranges)
+            delayed = (not busy and any(
+                s <= idx < e for s, e in self.plan.ack_delay_ranges))
+            if busy or delayed:
+                self.calls += 1
+                self.injected["busy" if busy else "ack_delay"] += 1
+        if busy:
+            raise ForwardError("busy", self.address,
+                               "injected: receiver busy-ack")
+        if delayed:
+            if self.plan.ack_delay_s >= timeout:
+                self._sleep(timeout)
+                raise ForwardError("deadline_exceeded", self.address,
+                                   "injected: ack delayed past budget")
+            self._sleep(self.plan.ack_delay_s)
+            return
         kind = self._decide()
         if kind == "passed":
             return
-        timeout = timeout_s or getattr(self.inner, "timeout_s", 10.0)
         if kind == "slow":
             if self.plan.slow_s >= timeout:
                 self._sleep(timeout)
@@ -295,6 +333,60 @@ class FaultyForwardClient(_FaultBase):
 
     def close(self) -> None:
         self.inner.close()
+
+
+class FaultyStreamSink:
+    """Receiver-side scripted congestion for the StreamMetrics path:
+    wraps an import-tier stream sink (an object with
+    submit(body, done)) and consults the plan's busy_ranges /
+    ack_delay_ranges by FRAME index. A busy-windowed frame is
+    busy-acked without touching the inner sink (the receiver
+    explicitly refusing admission — the real AIMD shrink driver); a
+    delay-windowed frame holds its ack for ack_delay_s before the
+    inner sink sees it (an ack-timeout driver when the delay exceeds
+    the sender's ack budget). Everything else passes through, so
+    exactly-once dedup and coalescing behave normally around the
+    scripted storm."""
+
+    def __init__(self, plan: FaultPlan, inner,
+                 sleep_fn: Callable[[float], None] = time.sleep) -> None:
+        self.plan = plan
+        self.inner = inner
+        self._sleep = sleep_fn
+        self._lock = threading.Lock()
+        self.frames = 0
+        self.injected = {"busy": 0, "ack_delay": 0, "passed": 0}
+
+    def submit(self, body: bytes, done) -> None:
+        from veneur_tpu.distributed import codec
+
+        with self._lock:
+            idx = self.frames
+            self.frames += 1
+            busy = any(s <= idx < e for s, e in self.plan.busy_ranges)
+            delayed = (not busy and any(
+                s <= idx < e for s, e in self.plan.ack_delay_ranges))
+            self.injected[
+                "busy" if busy else
+                "ack_delay" if delayed else "passed"] += 1
+        if busy:
+            done(codec.STREAM_ACK_BUSY)
+            return
+        if delayed:
+            # hold the whole frame, not just the ack: the sender sees
+            # dead air exactly as it would from a stalled receiver
+            self._sleep(self.plan.ack_delay_s)
+        self.inner.submit(body, done)
+
+    def stats(self) -> dict:
+        st = self.inner.stats() if hasattr(self.inner, "stats") else {}
+        with self._lock:
+            st["injected_faults"] = dict(self.injected)
+        return st
+
+    def close(self) -> None:
+        if hasattr(self.inner, "close"):
+            self.inner.close()
 
 
 class FaultySocket(_FaultBase):
