@@ -245,6 +245,46 @@ print(f"duplicates==0 gate: OK ({a['duplicates_injected']} injected, "
       f"{a['receiver_replays_absorbed']} absorbed)")
 PYGATE
 
+# Device-fault lane: the guarded TPU execution domain (ops/device_guard
+# .py + ops/host_engine.py) — fault classification taxonomy, breaker
+# streak, host-mirror failover bit-identical for every metric class
+# (sharded and unsharded, micro-folds on and off), probe re-admission,
+# and the HBM grow valve. The guard-mechanics suite runs with the guard
+# on (its tests inject seeded device faults); the escape-hatch pass
+# then re-runs the micro-fold parity suite under VENEUR_DEVICE_GUARD=0
+# — a failover drift is named by the first pass, a hatch that perturbs
+# the healthy flush path by the second. The seeded chaos soak drives
+# scripted fault shapes (transient OOM burst, hard outage → quarantine
+# → probe readmission, mid-micro-fold, mid-extract) against a clean
+# twin. (The device_fallback differential fuzz target rides the codec
+# fuzz lane at the top — it is in the default target set.) Artifact:
+# DEVICE_FAULT_SOAK.json (committed copy is the full run; the lane
+# redirects its miniature artifact to /tmp so quick never clobbers it).
+echo "== device-fault lane (guarded execution + escape hatch + chaos) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m pytest tests/test_device_guard.py -q -m 'not slow'
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu VENEUR_DEVICE_GUARD=0 \
+  python -m pytest tests/test_microfold.py -q -m 'not slow'
+timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  VENEUR_ARTIFACT_DIR="${TMPDIR:-/tmp}" \
+  python tools/soak_device_faults.py --quick
+# Hard gate on the committed full-run artifact: parity bitwise, exact
+# conservation, the complete breaker cycle, healthy overhead <= 1%.
+python - <<'PYGATE'
+import json
+a = json.load(open("DEVICE_FAULT_SOAK.json"))
+assert a["ok"] and not a["failures"], a["failures"]
+assert a["parity_bitwise_all"], "host failover drifted from device path"
+assert a["conservation_exact_all"], "a faulted flush lost samples"
+cyc = a["scenarios"]["hard_outage_readmission"]["breaker_cycle"]
+assert all(cyc.values()), f"incomplete breaker cycle: {cyc}"
+ab = a["healthy_ab"]
+assert ab["ok"] and ab["overhead_pct"] <= ab["rel_limit_pct"], ab
+print(f"device-fault gate: OK (breaker cycle complete, parity bitwise, "
+      f"healthy overhead {ab['overhead_pct']}% <= {ab['rel_limit_pct']}%)")
+PYGATE
+
 # Streaming congestion lane: the adaptive ack window (AIMD controller,
 # distributed/rpc.py) under scripted busy-ack storms and ack-delay
 # windows (utils/faults.py FaultyStreamSink) — collapse to the floor,

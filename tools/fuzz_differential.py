@@ -738,11 +738,111 @@ def fuzz_forward_codec(rng, t_end) -> int:
     return n
 
 
+def fuzz_device_fallback(rng, t_end) -> int:
+    """Device fault-domain differential (ops/device_guard +
+    ops/host_engine): a worker under a randomized seeded
+    DeviceFaultPlan — random fault kind, random per-op dispatch windows,
+    random breaker streak, micro-folds on or off — must flush
+    byte-identical snapshots to a clean worker fed the same stream, for
+    every metric class. This is the no-epoch-lost contract: whatever
+    subset of device ops fault, and whether or not the breaker trips,
+    failover to the host engine conserves everything, bitwise (only the
+    ``degraded`` flag may differ)."""
+    import dataclasses
+
+    from veneur_tpu.core.flusher import device_quantiles
+    from veneur_tpu.core.metrics import HistogramAggregates
+    from veneur_tpu.core.worker import DeviceWorker
+    from veneur_tpu.protocol.dogstatsd import parse_metric
+    from veneur_tpu.utils import faults as fl
+
+    qs = device_quantiles(
+        [0.5, 0.9, 0.99], HistogramAggregates.from_names(
+            ["min", "max", "sum", "count"]))
+    ops_all = ("fold", "spill", "staged", "micro", "extract", "sets",
+               "grow", "import")
+
+    # fixed shapes: one jit specialization set for the whole run
+    def mk(streak, micro):
+        return DeviceWorker(compression=100, stage_depth=32, batch_size=8,
+                            initial_histo_rows=8, initial_set_rows=8,
+                            micro_fold=micro, micro_fold_rows=1,
+                            micro_fold_max_age_s=1e9,
+                            device_fault_streak=streak)
+
+    def drive(w, lines, micro):
+        for ln in lines:
+            if ln is None:
+                if micro and w.micro_fold_due():
+                    w.micro_fold_once()
+                continue
+            w.process_metric(parse_metric(ln.encode()))
+        return w.flush(qs)
+
+    n = 0
+    while time.time() < t_end:
+        seed = rng.randrange(1 << 30)
+        nprng = np.random.default_rng(seed)
+        micro = rng.random() < 0.5
+        streak = rng.choice([1, 2, 3])
+        nser = rng.randrange(3, 20)
+        lines = []
+        for _ in range(rng.randrange(3, 9)):
+            for _ in range(rng.randrange(4, 14)):
+                k = int(nprng.integers(nser))
+                t = rng.random()
+                if t < 0.4:
+                    lines.append(f"h{k}:{nprng.normal():.6f}|ms|#a:{k % 3}")
+                elif t < 0.6:
+                    lines.append(f"c{k}:{1 + k % 5}|c")
+                elif t < 0.8:
+                    lines.append(f"s{k}:v{nprng.integers(50)}|s")
+                else:
+                    lines.append(f"g{k}:{nprng.normal():.6f}|g")
+            lines.append(None)  # micro-fold point
+        kind = rng.choice(["oom", "compile", "lost", "other"])
+        ops = rng.sample(ops_all, rng.randrange(1, len(ops_all) + 1))
+        start = rng.randrange(0, 8)
+        width = rng.randrange(1, 12)
+        plan = fl.DeviceFaultPlan(seed=seed, op_windows={
+            op: [(start, start + width, kind)] for op in ops})
+
+        base = drive(mk(streak, micro), lines, micro)
+        w = mk(streak, micro)
+        with fl.DeviceFaultInjector(plan) as inj:
+            got = drive(w, lines, micro)
+        injected = sum(inj.injected[k]
+                       for k in ("oom", "compile", "lost", "other"))
+        ctx = (f"seed={seed} kind={kind} ops={ops} "
+               f"window=({start},{start + width}) micro={micro} "
+               f"streak={streak} injected={injected} "
+               f"quarantined={w.guard.quarantined}")
+        for f in dataclasses.fields(base):
+            if f.name == "degraded":
+                continue
+            va, vb = getattr(base, f.name), getattr(got, f.name)
+            if not (isinstance(va, np.ndarray)
+                    or isinstance(vb, np.ndarray)):
+                continue
+            if (va is None or vb is None or va.dtype != vb.dtype
+                    or va.shape != vb.shape
+                    or va.tobytes() != vb.tobytes()):
+                print(f"device_fallback DIVERGE field={f.name} {ctx}\n"
+                      f" base={va!r}\n got={vb!r}")
+                return -1
+        if got.degraded and not injected:
+            print(f"device_fallback PHANTOM degraded flush {ctx}")
+            return -1
+        n += 1
+    return n
+
+
 TARGETS = {"dogstatsd": fuzz_dogstatsd, "ssf": fuzz_ssf,
            "metricpb": fuzz_metricpb, "gob": fuzz_gob,
            "ssf_stream": fuzz_ssf_stream, "loadgen": fuzz_loadgen,
            "reader_commit": fuzz_reader_commit, "query": fuzz_query,
-           "forward_codec": fuzz_forward_codec}
+           "forward_codec": fuzz_forward_codec,
+           "device_fallback": fuzz_device_fallback}
 
 
 def _git_rev() -> str:

@@ -322,6 +322,20 @@ class Config:
     # the single worker's directory); incompatible requests degrade to
     # the legacy path with a warning rather than failing ingest.
     reader_shards: int = -1
+    # device fault domain (ops/device_guard.py): every device entry
+    # point on the worker hot path runs under a guarded executor that
+    # classifies device errors (device.fault.{oom,compile,lost,other}),
+    # retries once where operands are not donated, and — after
+    # device_fault_streak CONSECUTIVE faults — trips a per-worker
+    # breaker that quarantines the device path and fails over to the
+    # host engine (ops/host_engine.py), bit-identical per metric class.
+    # While quarantined, a compile+fold+extract probe runs every
+    # device_probe_interval_s; success re-admits the device path and
+    # re-uploads the host state. VENEUR_DEVICE_GUARD=0 is the env
+    # escape hatch (disables the guard entirely for bisection).
+    device_guard: bool = True
+    device_fault_streak: int = 3
+    device_probe_interval_s: float = 30.0
     # entries per pending-batch (SoA) class before ingest sheds samples
     # (drop-don't-block under overload; counted in
     # veneur.ingest.overload_dropped_total). Bounds native ingest memory
@@ -1114,6 +1128,14 @@ def validate_config(cfg: Config) -> None:
     if cfg.micro_fold_max_age_s <= 0:
         raise ValueError("micro_fold_max_age_s must be positive (it is"
                          " the staged-backlog age that forces a drain)")
+    if cfg.device_fault_streak < 1:
+        raise ValueError("device_fault_streak must be >= 1 (the"
+                         " consecutive-fault count that trips the"
+                         " device breaker)")
+    if cfg.device_probe_interval_s <= 0:
+        raise ValueError("device_probe_interval_s must be positive (it"
+                         " paces re-admission probes while the device"
+                         " path is quarantined)")
     if not (1 <= cfg.loadgen_num_keys <= (1 << 24)):
         raise ValueError("loadgen_num_keys must be in [1, 2^24]")
     if cfg.loadgen_zipf_s < 0:

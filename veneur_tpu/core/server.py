@@ -136,10 +136,20 @@ class Server:
                 micro_fold_rows=cfg.micro_fold_rows,
                 micro_fold_max_age_s=cfg.micro_fold_max_age_s,
                 series_shards=cfg.series_shards,
+                device_guard=cfg.device_guard,
+                device_fault_streak=cfg.device_fault_streak,
+                device_probe_interval_s=cfg.device_probe_interval_s,
             )
             for _ in range(cfg.num_workers)
         ]
         self._worker_locks = [threading.Lock() for _ in self.workers]
+        # device fault domain bookkeeping: last guard fault seen per
+        # worker (so each new classified fault reaches the governor's
+        # watchdog verdict exactly once) and the lifetime guard-counter
+        # totals already emitted (telemetry reports deltas)
+        self._guard_last_fault: dict = {}
+        self._guard_counters_reported: dict = {}
+        self._host_fallbacks_reported = 0
         # adaptive overload shedding starts at the configured ceiling and
         # tightens when flushes overrun the interval (_adapt_spill_caps);
         # each flush may inherit at most half an interval of spill-fold
@@ -1765,6 +1775,11 @@ class Server:
                 except Exception:
                     if self._shutdown.is_set():
                         return
+                    # counted, not fatal: the staging plane retains every
+                    # sample the mirror held, so the flush still folds the
+                    # epoch — but a recurring drain error must be visible
+                    self.stats.count("micro_fold.errors_total", 1,
+                                     tags=[f"worker:{i}"])
                     log.exception("micro-fold drain failed (worker %d)", i)
 
     def _flush_loop(self) -> None:
@@ -2078,6 +2093,20 @@ class Server:
                 # already-swapped intervals of the others
                 log.exception("flush extraction failed for worker %d", i)
             self.flush_governor.beat()  # one worker's extraction done
+            # guard maintenance runs with the ingest lock held — it
+            # mutates LIVE state (quarantine to host / probe re-admit),
+            # unlike the extraction above which only reads swapped state
+            with self._worker_locks[i]:
+                worker.device_guard_tick()
+            g = worker.guard
+            if (g.last_fault is not None
+                    and g.last_fault != self._guard_last_fault.get(i)):
+                # surface each new classified fault to the governor, so
+                # a watchdog panic right after names the device error
+                self._guard_last_fault[i] = g.last_fault
+                desc = g.last_fault + (
+                    f" — {g.trip_reason}" if g.trip_reason else "")
+                self.flush_governor.note_fault(desc)
         if self.query_engine is not None:
             # commit AFTER every worker extracted: the query surface
             # flips to the new epoch atomically across workers
@@ -2271,6 +2300,26 @@ class Server:
             self.stats.count("flush.pallas_fallback_total",
                              _DW.pallas_fallbacks)
             _DW.pallas_fallbacks = 0
+        # device fault domain telemetry (ops/device_guard.py): the guard
+        # counters are lifetime totals — emit deltas, same discipline as
+        # the reader/tenant counters above. host_fallbacks counts flushes
+        # that completed on the host engine (degraded but conserved).
+        fallbacks = sum(w.host_fallback_flushes for w in self.workers)
+        if fallbacks - self._host_fallbacks_reported:
+            self.stats.count("flush.host_fallbacks",
+                             fallbacks - self._host_fallbacks_reported)
+        self._host_fallbacks_reported = fallbacks
+        quarantined = 0
+        for i, w in enumerate(self.workers):
+            if w.guard.quarantined:
+                quarantined += 1
+            for key, total in w.guard.counters().items():
+                k = (i, key)
+                delta = total - self._guard_counters_reported.get(k, 0)
+                if delta:
+                    self._guard_counters_reported[k] = total
+                    self.stats.count(key, delta, tags=[f"worker:{i}"])
+        self.stats.gauge("device.guard.quarantined_workers", quarantined)
         for svc, n in span_counts.items():
             self.stats.count("ssf.spans.received_total", n,
                              tags=[f"service:{svc}"])

@@ -50,7 +50,10 @@ from veneur_tpu.core.metrics import (DEFAULT_TENANT, MetricKey, UDPMetric,
                                      route_info, tenant_of)
 from veneur_tpu.core.tenancy import TenantTallies
 from veneur_tpu.health.ledger import TransferLedger
+from veneur_tpu.ops import device_guard as dg
+from veneur_tpu.ops import exactnum as exn
 from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import host_engine as he
 from veneur_tpu.ops import microfold as mf
 from veneur_tpu.ops import reader_stack as rstack
 from veneur_tpu.ops import series_shard as ss
@@ -64,6 +67,12 @@ log = logging.getLogger("veneur_tpu.core.worker")
 # max spilled samples per direct-fold dispatch (see _apply_native_raw);
 # bounds drain memory to O(chunk) x the in-flight window, not O(backlog)
 _FOLD_CHUNK = 1 << 18
+
+# HBM valve threshold (see _ensure_histo): pool growths whose estimated
+# device footprint stays under this skip the allocation pre-flight — a
+# kB-scale grow cannot exhaust HBM, and pre-flighting it would put an
+# extra device dispatch on every interval's early-growth ladder
+_GROW_PREFLIGHT_MIN_BYTES = 4 << 20
 
 
 def _next_pow2(n: int, floor: int = 1) -> int:
@@ -174,6 +183,25 @@ def _free_staged_planes(planes) -> None:
                 log.exception("staged plane free failed")
 
 
+def _staged_plane_to_host(plane: StagedPlane) -> StagedPlane:
+    """Copy a native plane's content out of C++ memory (flat compaction,
+    same layout _fold_one_plane uploads) and release it, so a device
+    failover can replay the plane through the host engine. Host-owned
+    planes pass through untouched."""
+    if plane.free is None:
+        return plane
+    B = plane.vals.shape[1]
+    counts_np = np.minimum(plane.counts, B).astype(np.int32)
+    mask = (np.arange(B, dtype=np.int32)[None, :] < counts_np[:, None])
+    flat_v = plane.vals[mask]
+    flat_w = None if plane.wts is None else plane.wts[mask]
+    try:
+        plane.free()
+    except Exception:  # pragma: no cover
+        log.exception("staged plane free failed")
+    return StagedPlane(flat_v, flat_w, counts_np, None)
+
+
 @functools.partial(jax.jit, static_argnames=("depth",))
 def _unit_wts_plane(counts, depth: int):
     """Rebuild a unit-weights staging plane from per-row staged counts:
@@ -237,9 +265,11 @@ def _histo_fold_staged(
     """
     c = means.shape[1]
     live = swts > 0
-    s_w = jnp.sum(swts, axis=-1)
-    s_sum = jnp.sum(jnp.where(live, svals * swts, 0.0), axis=-1)
-    s_recip = jnp.sum(jnp.where(live, swts / svals, 0.0), axis=-1)
+    # Order-pinned tree sums (ops/exactnum.py): the host fallback engine
+    # replays this fold over the same staged plane bitwise.
+    s_w = exn.tsum(swts)
+    s_sum = exn.tsum(jnp.where(live, svals * swts, 0.0))
+    s_recip = exn.tsum(jnp.where(live, swts / svals, 0.0))
     s_min = jnp.min(jnp.where(live, svals, jnp.inf), axis=-1)
     s_max = jnp.max(jnp.where(live, svals, -jnp.inf), axis=-1)
 
@@ -555,6 +585,12 @@ class FlushSnapshot:
     set_registers: Optional[np.ndarray] = None  # [S_sets, m] (forwarding)
     # unique-timeseries count for this worker (None if disabled):
     unique_timeseries_registers: Optional[np.ndarray] = None
+    # True when this interval's extraction finished on the HOST engine
+    # after a device fault or while the device path was quarantined
+    # (ops/device_guard); surfaced by the live query layer so readers
+    # know the numbers came from the fallback path (still bit-identical
+    # by the host-engine parity contract, but worth flagging)
+    degraded: bool = False
 
 
 @dataclass
@@ -603,6 +639,14 @@ class SwappedEpoch:
     # legacy staged fold; the native memory is released right after the
     # merge copies out of it.
     reader_planes: Optional[list] = None
+    # conservation insurance for the micro-fold mirror (device fault
+    # domain): the staging plane the mirror fully covered, RETAINED
+    # (host-side) instead of freed at swap. If a device fault voids the
+    # mirror before or during extract, the flush folds this plane on
+    # the host engine — no epoch lost. Freed once the mirror's fold
+    # lands. A StagedPlane (native path, flat host copies) or a dense
+    # (vals, wts) pair (python path).
+    micro_replay: Optional[object] = None
 
 
 class DeviceWorker:
@@ -633,6 +677,9 @@ class DeviceWorker:
         micro_fold_rows: int = 8192,
         micro_fold_max_age_s: float = 0.25,
         series_shards: int = 0,
+        device_guard: bool = True,
+        device_fault_streak: int = dg.DEFAULT_STREAK_LIMIT,
+        device_probe_interval_s: float = dg.DEFAULT_PROBE_INTERVAL_S,
     ) -> None:
         self.batch_size = batch_size
         # native pending-batch bound; beyond it samples shed, counted in
@@ -765,6 +812,28 @@ class DeviceWorker:
         # pattern (see swap())
         self.tenant_tallies = TenantTallies()
         self.tenant_tallies_total = TenantTallies()
+        # device fault domain (ops/device_guard.py): one breaker per
+        # worker over every device entry point. While quarantined
+        # (_host_live) the live pools are host numpy state driven by the
+        # host engine (ops/host_engine.py, bit-identical per metric
+        # class); device_guard_tick() — run by the server after each
+        # extraction, under the ingest lock — handles quarantine of the
+        # live epoch, probing, and re-admission.
+        self.guard = dg.DeviceGuard(
+            streak_limit=device_fault_streak,
+            probe_interval_s=device_probe_interval_s,
+            enabled=bool(device_guard) and dg.guard_enabled_default())
+        # live pools are host-side (HostHistoState / np registers)
+        self._host_live = False
+        # a device fault voided this epoch's micro-fold mirror: the
+        # staging plane retains every sample, micro-folding pauses until
+        # the next epoch, and the swap folds the plane as if micro-fold
+        # were off
+        self._micro_fault_epoch = False
+        # lifetime count of flushes whose extraction completed on the
+        # host engine (mirrors ledger.host_fallbacks; kept on the worker
+        # for the soak's conservation accounting)
+        self.host_fallback_flushes = 0
         self._reset_epoch()
 
     def attach_mesh_pool(self, pool) -> None:
@@ -1289,16 +1358,23 @@ class DeviceWorker:
         Reader-shard mode also opts out: the mirror would need N
         per-context COO streams re-keyed to canonical rows mid-interval;
         the stacked flush-edge merge (ops/reader_stack.py) covers the
-        same work, so always-hot flush stays a legacy-path feature."""
+        same work, so always-hot flush stays a legacy-path feature.
+
+        The device fault domain pauses micro-folds too: quarantined (or
+        live-failed-over) workers have no device to mirror into, and an
+        epoch whose mirror already faulted keeps every sample in the
+        retained staging plane instead (conservation over warmth)."""
         return (self.micro_fold and self.stage_depth > 0
-                and self._mesh_pool is None and not self._reader_ctxs)
+                and self._mesh_pool is None and not self._reader_ctxs
+                and not self._host_live and not self.guard.quarantined
+                and not self._micro_fault_epoch)
 
     def _ensure_micro(self) -> "mf.MicroFoldMirror":
         if self._micro is None:
             self._micro = mf.MicroFoldMirror(
                 self.stage_depth, ledger=self.ledger,
                 initial_rows=self._initial_histo_rows,
-                shard=self._shard)
+                shard=self._shard, guard=self.guard)
         return self._micro
 
     def micro_fold_pending(self) -> int:
@@ -1337,15 +1413,27 @@ class DeviceWorker:
         if not self._micro_active():
             return 0
         self._micro_last_drain = time.monotonic()
-        if self._native is not None:
-            # mid-interval SoA drain first: counters are np.add.at in
-            # drain order and gauges last-write-wins, so draining more
-            # often splits the stream into ordered deltas — the folded
-            # result is bitwise what one deadline-time drain produces
-            self.drain_native()
-            fed = self._micro_drain_native()
-        else:
-            fed = self._micro_drain_python()
+        try:
+            if self._native is not None:
+                # mid-interval SoA drain first: counters are np.add.at in
+                # drain order and gauges last-write-wins, so draining more
+                # often splits the stream into ordered deltas — the folded
+                # result is bitwise what one deadline-time drain produces
+                self.drain_native()
+                fed = self._micro_drain_native()
+            else:
+                fed = self._micro_drain_python()
+        except dg.DeviceFaultError as exc:
+            # the mirror is a CACHE of the staging plane — the plane
+            # retains every sample (watermarks advanced, counts did
+            # not), so dropping the mirror loses nothing. Micro-folding
+            # pauses for the rest of the epoch; the swap folds the
+            # retained plane exactly as if micro-fold were off.
+            log.warning("micro-fold device fault (%s); mirror dropped, "
+                        "epoch falls back to the staged plane", exc)
+            self._micro = None
+            self._micro_fault_epoch = True
+            return 0
         if fed:
             self.micro_folds_total += 1
             self.micro_folds_epoch += 1
@@ -1454,6 +1542,10 @@ class DeviceWorker:
         self.tenant_tallies.reset()
         self.directory = SeriesDirectory()
         self.scalars = HostScalars()
+        # fresh epoch, fresh mirror fault state (the voided mirror was
+        # epoch-scoped; a new epoch may micro-fold again if the guard is
+        # otherwise healthy)
+        self._micro_fault_epoch = False
         self._histo: Optional[HistoDeviceState] = None
         self._sets: Optional[jax.Array] = None
         # staged (sparse-host / dense-device) set store — the scalable
@@ -1462,7 +1554,9 @@ class DeviceWorker:
             from veneur_tpu.ops.staged_sets import StagedSetStore
 
             self._staged_sets = StagedSetStore(self.hll_precision,
-                                               shard=self._shard)
+                                               shard=self._shard,
+                                               guard=self.guard,
+                                               host=self._host_live)
         else:
             self._staged_sets = None
         # host raw-sample staging planes (see _device_histo_step); created
@@ -1492,9 +1586,24 @@ class DeviceWorker:
         )
 
     def _ensure_histo(self, needed_rows: int) -> None:
+        # a tripped breaker fails the live epoch over right here, before
+        # any pool is created or grown on the dying device — the server's
+        # post-flush device_guard_tick() would do it anyway, but ingest
+        # between the trip and the tick must not re-fault
+        if self.guard.quarantined and not self._host_live:
+            self._quarantine_live()
         # keep one scratch row free at the top for gather/scatter padding
         # (under sharding the scratch row — logical S-1 — maps to physical
         # S-1, shard D-1's last local row, so sizing is shard-oblivious)
+        if self._host_live or isinstance(self._histo, he.HostHistoState):
+            if self._histo is None:
+                rows = _next_pow2(needed_rows + 1, self._initial_histo_rows)
+                self._histo = he.HostHistoState.create(rows, self.capacity)
+            elif needed_rows + 1 > self._histo.num_rows:
+                self._flush_pending_histos()
+                self._histo = self._histo.grow(
+                    _next_pow2(needed_rows + 1, self._histo.num_rows * 2))
+            return
         if self._histo is None:
             rows = _next_pow2(needed_rows + 1, self._initial_histo_rows)
             st = HistoDeviceState.create(rows, self.capacity)
@@ -1502,14 +1611,62 @@ class DeviceWorker:
                            else st.placed(self._shard))
         elif needed_rows + 1 > self._histo.num_rows:
             self._flush_pending_histos()  # pending lids reference old layout
-            self._histo = self._histo.grow(
-                _next_pow2(needed_rows + 1, self._histo.num_rows * 2),
-                shard=self._shard,
-            )
+            if isinstance(self._histo, he.HostHistoState):
+                # the pending fold itself faulted and quarantined us
+                self._ensure_histo(needed_rows)
+                return
+            new_rows = _next_pow2(needed_rows + 1, self._histo.num_rows * 2)
+            # HBM pressure valve: growth doubles the pool's device
+            # footprint and the donating grow programs free the OLD
+            # buffers only after the new ones materialize. Pre-flight
+            # the allocation with a throwaway (non-donated) buffer of
+            # the target size: an OOM here is a clean fault — the old
+            # pool is untouched — and degrades to the host engine
+            # instead of faulting mid-grow. Only worth a dispatch when
+            # the target is big enough to plausibly OOM: pools are
+            # re-created per epoch, so an unconditional pre-flight would
+            # tax every interval's early-growth ladder (~0.5ms/dispatch)
+            # to guard kB-scale allocations that cannot exhaust HBM.
+            try:
+                if (self.guard.enabled and new_rows * self.capacity * 12
+                        >= _GROW_PREFLIGHT_MIN_BYTES):
+                    def _preflight():
+                        probe = jnp.zeros((new_rows, 2 * self.capacity),
+                                          jnp.float32)
+                        if self._shard is not None:
+                            probe = self._shard.place(probe)
+                        jax.block_until_ready(probe)
+
+                    self.guard.call("grow", _preflight, retryable=True)
+                self._histo = self.guard.call(
+                    "grow", self._histo.grow, new_rows, shard=self._shard)
+            except dg.DeviceFaultError as exc:
+                self.guard.bump("device.valve.grow_oom")
+                self.guard.trip(f"pool growth to {new_rows} rows faulted "
+                                f"[{exc.kind}] — HBM valve")
+                self._quarantine_live()
+                # _quarantine_live moved the (old-size) pool to host;
+                # grow it there
+                self._histo = self._histo.grow(new_rows)
 
     def _ensure_sets(self, needed_rows: int) -> None:
+        if self.guard.quarantined and not self._host_live:
+            self._quarantine_live()
         if self._staged_sets is not None:
             return  # the staged store sizes itself
+        if self._host_live or isinstance(self._sets, np.ndarray):
+            if self._sets is None:
+                rows = _next_pow2(needed_rows + 1, self._initial_set_rows)
+                m = hll_ops.num_registers(self.hll_precision)
+                self._sets = np.zeros((rows, m), np.int8)
+            elif needed_rows + 1 > self._sets.shape[0]:
+                self._flush_pending_sets()
+                new_rows = _next_pow2(needed_rows + 1,
+                                      self._sets.shape[0] * 2)
+                grown = np.zeros((new_rows, self._sets.shape[1]), np.int8)
+                grown[:self._sets.shape[0]] = self._sets
+                self._sets = grown
+            return
         if self._sets is None:
             rows = _next_pow2(needed_rows + 1, self._initial_set_rows)
             pool = hll_ops.init_pool(rows, self.hll_precision)
@@ -1517,10 +1674,20 @@ class DeviceWorker:
                           else self._shard.place(pool))
         elif needed_rows + 1 > self._sets.shape[0]:
             self._flush_pending_sets()
+            if isinstance(self._sets, np.ndarray):
+                self._ensure_sets(needed_rows)
+                return
             new_rows = _next_pow2(needed_rows + 1, self._sets.shape[0] * 2)
-            self._sets = (_grow_2d(self._sets, new_rows)
-                          if self._shard is None
-                          else self._shard.grow_2d(self._sets, new_rows))
+            try:
+                self._sets = self.guard.call(
+                    "grow",
+                    (_grow_2d if self._shard is None
+                     else self._shard.grow_2d), self._sets, new_rows)
+            except dg.DeviceFaultError as exc:
+                self.guard.trip(f"set pool growth to {new_rows} rows "
+                                f"faulted [{exc.kind}]")
+                self._quarantine_live()
+                self._ensure_sets(needed_rows)
 
     # -- ingest -------------------------------------------------------------
 
@@ -1807,24 +1974,51 @@ class DeviceWorker:
         active, lids, v, w = self._pad_spill_batch(
             rows, vals, wts, h.num_rows - 1)
 
+        if isinstance(h, he.HostHistoState):
+            # quarantined: the host engine's bit-identical ingest twin
+            out = he.np_ingest_step(*h.fields(), active, lids, v, w,
+                                    compression=self.compression)
+            (h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
+             h.lmin, h.lmax, h.lsum, h.lsum_c, h.lweight, h.lweight_c,
+             h.lrecip, h.lrecip_c) = out
+            return
+
         sh = self._shard
-        if sh is not None:
-            # replicated COO, physical `active`: every shard folds the
-            # bit-identical batch and keeps only the writes it owns
-            # (ops/series_shard.ingest_step — the OOB-foreign remap)
-            out = sh.ingest_step(
-                *h.fields(),
-                sh.replicate(sh.phys_rows(active, h.num_rows)),
-                sh.replicate(lids), sh.replicate(v), sh.replicate(w),
-            )
-        else:
-            out = _histo_ingest_step(
-                h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
-                h.lmin, h.lmax, h.lsum, h.lsum_c, h.lweight, h.lweight_c,
-                h.lrecip, h.lrecip_c,
-                jnp.asarray(active), jnp.asarray(lids), jnp.asarray(v),
-                jnp.asarray(w), compression=self.compression,
-            )
+        try:
+            if sh is not None:
+                # replicated COO, physical `active`: every shard folds the
+                # bit-identical batch and keeps only the writes it owns
+                # (ops/series_shard.ingest_step — the OOB-foreign remap)
+                out = self.guard.call(
+                    "fold", sh.ingest_step,
+                    *h.fields(),
+                    sh.replicate(sh.phys_rows(active, h.num_rows)),
+                    sh.replicate(lids), sh.replicate(v), sh.replicate(w),
+                )
+            else:
+                out = self.guard.call(
+                    "fold", _histo_ingest_step,
+                    h.means, h.weights, h.dmin, h.dmax, h.drecip,
+                    h.drecip_c, h.lmin, h.lmax, h.lsum, h.lsum_c,
+                    h.lweight, h.lweight_c, h.lrecip, h.lrecip_c,
+                    jnp.asarray(active), jnp.asarray(lids), jnp.asarray(v),
+                    jnp.asarray(w), compression=self.compression,
+                )
+        except dg.DeviceFaultError:
+            # the fold donates the pool, so no in-place retry. The host
+            # inputs are still ours: if the breaker tripped, quarantine
+            # the live epoch (pool → host) and fold this batch there;
+            # otherwise re-stage the samples into the pending SoA — the
+            # next flush (or next spill drain) replays them naturally,
+            # and a still-sick device walks the streak to the breaker.
+            if self.guard.quarantined:
+                self._quarantine_live()
+                self._fold_batch_direct(rows, vals, wts)
+            else:
+                self._ph_rows.extend(rows.tolist())
+                self._ph_vals.extend(vals.tolist())
+                self._ph_wts.extend(wts.tolist())
+            return
         (h.means, h.weights, h.dmin, h.dmax, h.drecip, h.drecip_c,
          h.lmin, h.lmax, h.lsum, h.lsum_c, h.lweight, h.lweight_c,
          h.lrecip, h.lrecip_c) = out
@@ -1865,8 +2059,9 @@ class DeviceWorker:
             for a in (act, lids, v, w):
                 led.count_h2d_shards([a.nbytes] * d, "spill")
                 ups.append(sh.replicate(a))
-            return sh.ingest_step(*fields, *ups)
-        return _histo_ingest_step(
+            return self.guard.call("spill", sh.ingest_step, *fields, *ups)
+        return self.guard.call(
+            "spill", _histo_ingest_step,
             *fields,
             led.h2d(active, "spill"), led.h2d(lids, "spill"),
             led.h2d(v, "spill"), led.h2d(w, "spill"),
@@ -1897,18 +2092,35 @@ class DeviceWorker:
         pidx[: len(rows)] = idx
         prank = np.zeros(n, dtype=np.int8)
         prank[: len(rows)] = rank
-        sh = self._shard
-        if sh is not None:
-            # int8 scatter-max is order- and placement-independent, so the
-            # sharded insert is bit-identical by construction; padding rows
-            # (scratch, rank 0) stay a no-op max on their owner
-            self._sets = sh.hll_insert(
-                regs, sh.replicate(sh.phys_rows(prow, regs.shape[0])),
-                sh.replicate(pidx), sh.replicate(prank))
+        if isinstance(regs, np.ndarray):
+            # quarantined: host numpy registers, same scatter-max
+            self._sets = he.np_hll_insert_batch(
+                regs, prow.astype(np.int64), pidx.astype(np.int64), prank)
             return
-        self._sets = hll_ops.insert_batch(
-            regs, jnp.asarray(prow), jnp.asarray(pidx), jnp.asarray(prank)
-        )
+        sh = self._shard
+        try:
+            if sh is not None:
+                # int8 scatter-max is order- and placement-independent, so
+                # the sharded insert is bit-identical by construction;
+                # padding rows (scratch, rank 0) stay a no-op max on their
+                # owner. The sharded program donates the plane — no retry.
+                self._sets = self.guard.call(
+                    "sets", sh.hll_insert,
+                    regs, sh.replicate(sh.phys_rows(prow, regs.shape[0])),
+                    sh.replicate(pidx), sh.replicate(prank))
+            else:
+                self._sets = self.guard.call(
+                    "sets", hll_ops.insert_batch,
+                    regs, jnp.asarray(prow), jnp.asarray(pidx),
+                    jnp.asarray(prank), retryable=True)
+        except dg.DeviceFaultError:
+            # max-idempotent: re-applying on host after a partial device
+            # write only re-asserts ranks. Pull the plane down and redo.
+            if self.guard.quarantined:
+                self._quarantine_live()
+            else:
+                self._sets = self._sets_to_host(regs)
+            self._device_set_step(rows, idx, rank)
 
     # -- import path (global tier) ------------------------------------------
 
@@ -2067,27 +2279,51 @@ class DeviceWorker:
                     imp_max[i] = max(imp_max[i], mx)
                     imp_recip[i] += rc
             self._imp_digests = {}
-            sh = self._shard
-            if sh is not None:
-                out = sh.import_step(
-                    h.means, h.weights, h.dmin, h.dmax, h.drecip,
-                    h.drecip_c,
-                    sh.replicate(sh.phys_rows(arows, h.num_rows)),
-                    sh.replicate(imp_means), sh.replicate(imp_w),
-                    sh.replicate(imp_min), sh.replicate(imp_max),
-                    sh.replicate(imp_recip),
-                )
+
+            def _host_digest_merge():
+                hh = self._histo
+                out = he.np_import_step(
+                    hh.means, hh.weights, hh.dmin, hh.dmax, hh.drecip,
+                    hh.drecip_c, arows, imp_means, imp_w, imp_min,
+                    imp_max, imp_recip, compression=self.compression)
+                (hh.means, hh.weights, hh.dmin, hh.dmax, hh.drecip,
+                 hh.drecip_c) = out
+
+            if isinstance(h, he.HostHistoState):
+                _host_digest_merge()
             else:
-                out = _histo_import_step(
-                    h.means, h.weights, h.dmin, h.dmax, h.drecip,
-                    h.drecip_c,
-                    jnp.asarray(arows), jnp.asarray(imp_means),
-                    jnp.asarray(imp_w), jnp.asarray(imp_min),
-                    jnp.asarray(imp_max), jnp.asarray(imp_recip),
-                    compression=self.compression,
-                )
-            (h.means, h.weights, h.dmin, h.dmax, h.drecip,
-             h.drecip_c) = out
+                sh = self._shard
+                try:
+                    if sh is not None:
+                        out = self.guard.call(
+                            "import", sh.import_step,
+                            h.means, h.weights, h.dmin, h.dmax, h.drecip,
+                            h.drecip_c,
+                            sh.replicate(sh.phys_rows(arows, h.num_rows)),
+                            sh.replicate(imp_means), sh.replicate(imp_w),
+                            sh.replicate(imp_min), sh.replicate(imp_max),
+                            sh.replicate(imp_recip),
+                        )
+                    else:
+                        out = self.guard.call(
+                            "import", _histo_import_step,
+                            h.means, h.weights, h.dmin, h.dmax, h.drecip,
+                            h.drecip_c,
+                            jnp.asarray(arows), jnp.asarray(imp_means),
+                            jnp.asarray(imp_w), jnp.asarray(imp_min),
+                            jnp.asarray(imp_max), jnp.asarray(imp_recip),
+                            compression=self.compression,
+                        )
+                    (h.means, h.weights, h.dmin, h.dmax, h.drecip,
+                     h.drecip_c) = out
+                except dg.DeviceFaultError as exc:
+                    # the merge runs at swap — there is no later retry
+                    # point for this epoch, so one fault here forces the
+                    # failover (the import buffers are already drained
+                    # into locals; the host merge conserves them all)
+                    self.guard.trip(f"import merge faulted [{exc.kind}]")
+                    self._quarantine_live()
+                    _host_digest_merge()
 
         if self._imp_hll:
             regs = self._sets
@@ -2097,15 +2333,159 @@ class DeviceWorker:
             arows = np.asarray(rows, dtype=np.int32)
             imp = np.stack([self._imp_hll[r] for r in rows])
             self._imp_hll = {}
-            sh = self._shard
-            if sh is not None:
-                self._sets = sh.hll_max_rows(
-                    regs, sh.replicate(sh.phys_rows(arows, regs.shape[0])),
-                    sh.replicate(imp))
+
+            def _host_hll_merge():
+                np.maximum.at(self._sets, arows.astype(np.int64), imp)
+
+            if isinstance(regs, np.ndarray):
+                _host_hll_merge()
             else:
-                self._sets = regs.at[jnp.asarray(arows)].max(
-                    jnp.asarray(imp), mode="drop"
-                )
+                sh = self._shard
+                try:
+                    if sh is not None:
+                        self._sets = self.guard.call(
+                            "import", sh.hll_max_rows,
+                            regs,
+                            sh.replicate(sh.phys_rows(arows,
+                                                      regs.shape[0])),
+                            sh.replicate(imp))
+                    else:
+                        self._sets = self.guard.call(
+                            "import",
+                            lambda r, a, m: r.at[a].max(m, mode="drop"),
+                            regs, jnp.asarray(arows), jnp.asarray(imp),
+                            retryable=True)
+                except dg.DeviceFaultError as exc:
+                    self.guard.trip(f"HLL import merge faulted "
+                                    f"[{exc.kind}]")
+                    self._quarantine_live()
+                    _host_hll_merge()
+
+    # -- device fault domain -------------------------------------------------
+
+    def _sets_to_host(self, regs) -> np.ndarray:
+        """d2h one dense register plane to logical row order; on a hard
+        device loss the readback itself can fail, in which case the set
+        state restarts empty (logged — honest degraded mode)."""
+        try:
+            d = np.array(np.asarray(regs), copy=True)
+        except Exception:
+            log.exception("set pool readback failed during quarantine;"
+                          " restarting host registers empty")
+            return np.zeros(regs.shape, np.int8)
+        if self._shard is not None:
+            d = d[self._shard.perm_l2p(d.shape[0])]
+        return d
+
+    def _quarantine_live(self) -> None:
+        """Fail the LIVE epoch's device state over to the host engine
+        (ops/host_engine.py, bit-identical per metric class). Caller
+        holds the ingest lock. Idempotent. The d2h snapshots are the one
+        device interaction left; on a hard-lost device they can fail
+        too, and then the affected pool restarts empty — counted and
+        logged, with the retained staging plane and pending SoA batches
+        still replaying everything they hold."""
+        if self._host_live:
+            return
+        self._host_live = True
+        h = self._histo
+        if h is not None and not isinstance(h, he.HostHistoState):
+            try:
+                perm = (self._shard.perm_l2p(h.num_rows)
+                        if self._shard is not None else None)
+                self._histo = he.HostHistoState.from_fields(
+                    h.fields(), perm=perm)
+            except Exception:
+                log.exception("digest pool readback failed during "
+                              "quarantine; restarting host pools empty")
+                self._histo = he.HostHistoState.create(
+                    h.num_rows, self.capacity)
+        s = self._sets
+        if s is not None and not isinstance(s, np.ndarray):
+            self._sets = self._sets_to_host(s)
+        if self._staged_sets is not None:
+            self._staged_sets.to_host()
+        # the mirror is device memory; the staging plane retained every
+        # sample it mirrored (watermark drains never consumed counts),
+        # so dropping it loses nothing and the swap folds the plane
+        self._micro = None
+        self._micro_fault_epoch = True
+        self.guard.bump("device.guard.quarantines")
+        log.warning("live epoch quarantined to the host engine (%s)",
+                    self.guard.trip_reason)
+
+    def _readmit_device(self) -> None:
+        """Re-upload the host pools and leave host mode (the probe
+        succeeded; caller holds the ingest lock)."""
+        if not self._host_live:
+            return
+        sh = self._shard
+        h = self._histo
+        if isinstance(h, he.HostHistoState):
+            if sh is not None:
+                perm = sh.perm_p2l(h.num_rows)
+                self._histo = HistoDeviceState(
+                    *(sh.place(a[perm]) for a in h.fields()))
+            else:
+                self._histo = HistoDeviceState(
+                    *(jnp.asarray(a) for a in h.fields()))
+        s = self._sets
+        if isinstance(s, np.ndarray):
+            if sh is not None:
+                self._sets = sh.place(s[sh.perm_p2l(s.shape[0])])
+            else:
+                self._sets = jnp.asarray(s)
+        if self._staged_sets is not None:
+            self._staged_sets.to_device()
+        self._host_live = False
+        self.guard.readmit()
+
+    def _device_probe(self) -> bool:
+        """Tiny compile+fold+extract round trip through the dispatch
+        seam (op "probe") — the half-open breaker's health check. Runs
+        on throwaway buffers so a failing probe cannot touch state."""
+        def _probe():
+            st = HistoDeviceState.create(64, self.capacity)
+            rows = np.array([1, 2, 3], np.int32)
+            vals = np.array([1.0, 2.0, 3.0], np.float32)
+            wts = np.ones(3, np.float32)
+            active, lids, v, w = self._pad_spill_batch(rows, vals, wts, 63)
+            out = _histo_ingest_step(
+                *st.fields(), jnp.asarray(active), jnp.asarray(lids),
+                jnp.asarray(v), jnp.asarray(w),
+                compression=self.compression)
+            qs = jnp.asarray(np.array([0.25, 0.5, 0.75, 0.99], np.float32))
+            ext = _histo_flush_extract(*out, qs)
+            jax.block_until_ready(ext)
+            return True
+
+        try:
+            return bool(self.guard.call("probe", _probe))
+        except dg.DeviceFaultError:
+            return False
+        except Exception:
+            log.exception("device probe raised a non-device error")
+            return False
+
+    def device_guard_tick(self) -> None:
+        """Per-flush guard maintenance, run by the server after each
+        extraction with this worker's ingest lock held (extraction
+        itself must NOT mutate live state — it runs off the lock):
+        quarantine the live epoch if the breaker tripped during the
+        flush, and while quarantined run the re-admission probe when
+        due."""
+        if not self.guard.enabled:
+            return
+        if self.guard.quarantined and not self._host_live:
+            self._quarantine_live()
+        if self._host_live and self.guard.quarantined \
+                and self.guard.probe_due():
+            ok = self._device_probe()
+            self.guard.note_probe(ok)
+            if ok:
+                self._readmit_device()
+                log.warning("device path re-admitted after probe; host "
+                            "state re-uploaded")
 
     _pallas_ok: Optional[bool] = None
     # process-lifetime count of Pallas->XLA demotions, surfaced in the
@@ -2130,14 +2510,20 @@ class DeviceWorker:
             from veneur_tpu.ops import pallas_kernels as pk
 
             try:
-                quant, dsum, dcount = pk.flush_extract(
-                    means, weights, dmin, dmax, qs)
+                quant, dsum, dcount = self.guard.call(
+                    "extract", pk.flush_extract,
+                    means, weights, dmin, dmax, qs, retryable=True)
                 return (quant, dmin, dmax, dsum, dcount,
                         drecip + drecip_c,
                         lmin, lmax,
                         lsum + lsum_c,
                         lweight + lweight_c,
                         lrecip + lrecip_c)
+            except dg.DeviceFaultError:
+                # a classified device fault is NOT a Pallas lowering bug:
+                # let the flush's failover handle it (host completion)
+                # without demoting the kernel for the process lifetime
+                raise
             except Exception:  # pragma: no cover - TPU-only path
                 DeviceWorker._pallas_ok = False
                 DeviceWorker.pallas_fallbacks += 1
@@ -2145,10 +2531,11 @@ class DeviceWorker:
                     "pallas flush_extract failed; demoting to the XLA "
                     "extraction path for the process lifetime",
                     exc_info=True)
-        return _histo_flush_extract(
+        return self.guard.call(
+            "extract", _histo_flush_extract,
             means, weights, dmin, dmax, drecip, drecip_c, lmin, lmax,
             lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c, qs,
-        )
+            retryable=True)
 
     # -- flush --------------------------------------------------------------
 
@@ -2377,6 +2764,19 @@ class DeviceWorker:
                 # exist for the fold to land in
                 self._ensure_histo(self.directory.num_histo_rows)
         self._flush_pending_histos()
+        if self._ph_rows:
+            # a device fault during the pending-batch fold re-staged the
+            # batch instead of folding it (_fold_batch_direct's failover
+            # contract). The epoch reset below would destroy it — divert
+            # the batch into the spill backlog, which extract_snapshot
+            # folds off-lock with its own fault handling. No sample is
+            # lost to the fault; it just rides the slower path.
+            ph = (np.asarray(self._ph_rows, np.int32),
+                  np.asarray(self._ph_vals, np.float32),
+                  np.asarray(self._ph_wts, np.float32))
+            self._ph_rows, self._ph_vals, self._ph_wts = [], [], []
+            spill_histo = (ph if spill_histo is None else tuple(
+                np.concatenate([spill_histo[k], ph[k]]) for k in range(3)))
         self._flush_pending_sets()
         self._merge_imports()
 
@@ -2409,7 +2809,7 @@ class DeviceWorker:
                     mirror = mf.MicroFoldMirror(
                         self.stage_depth, ledger=self.ledger,
                         initial_rows=self._initial_histo_rows,
-                        shard=self._shard)
+                        shard=self._shard, guard=self.guard)
                 mirror.book_in_flush = True
                 micro_residual = (mirror, micro_coo)
                 micro_samples = mirror.samples + residual_n
@@ -2422,25 +2822,49 @@ class DeviceWorker:
 
         staged = 0
         staged_histo = []
+        # device-fault replay batch (ops/device_guard failover): when a
+        # staging plane is handed over as a MIRROR (micro_residual)
+        # instead of a host plane, the mirror is the only carrier of
+        # those samples — and the mirror is device state. micro_replay
+        # retains the host ground truth (the staging plane's content,
+        # which the mirror duplicates bit-for-bit) until the mirror's
+        # flush fold succeeds; if the mirror faults first, the replay
+        # batch folds through the host engine instead. Freed by
+        # extract_snapshot after a clean mirror fold.
+        micro_replay = None
         # a mirrored plane is handed over as micro_residual (mirror +
         # deferred COO) INSTEAD of a host plane — exactly one of the two
         # carries a given sample
         python_mirrored = micro_residual is not None and self._native is None
-        if (self._stage_count is not None and self._stage_count.any()
-                and not python_mirrored):
-            staged += int(self._stage_count.sum())
-            # hand the host staging planes to the closed epoch; the fold
-            # into the digest runs in extract_snapshot, OFF the ingest lock
-            self._ensure_stage()  # pool may have grown since the last stage
-            staged_histo.append(
-                StagedPlane(self._stage_vals, self._stage_wts, None, None))
+        if self._stage_count is not None and self._stage_count.any():
+            if python_mirrored:
+                # the dense host pair IS the mirror's ground truth (the
+                # drains copied deltas out; the plane keeps everything)
+                micro_replay = StagedPlane(
+                    self._stage_vals, self._stage_wts, None, None)
+            else:
+                staged += int(self._stage_count.sum())
+                # hand the host staging planes to the closed epoch; the
+                # fold into the digest runs in extract_snapshot, OFF the
+                # ingest lock
+                self._ensure_stage()  # pool may have grown since staging
+                staged_histo.append(StagedPlane(
+                    self._stage_vals, self._stage_wts, None, None))
         if native_stage is not None:
             sv, sw, counts, unit, free = native_stage
             if native_mirrored and micro_residual is not None:
                 # plane content fully captured by the mirror + residual
-                # COO (all copies): release the C++ memory now, nothing
-                # to upload at flush
+                # COO (all copies): compact a host replay copy out of the
+                # C++ memory, then release it — nothing to upload at
+                # flush unless the mirror faults
+                B = sv.shape[1]
+                counts_np = np.minimum(counts, B).astype(np.int32)
+                r_mask = (np.arange(B, dtype=np.int32)[None, :]
+                          < counts_np[:, None])
+                flat_v = sv[r_mask]
+                flat_w = None if unit else sw[r_mask]
                 free()
+                micro_replay = StagedPlane(flat_v, flat_w, counts_np, None)
             else:
                 staged += int(counts.sum())
                 # unit weights (no sampled metrics this epoch): skip the
@@ -2461,6 +2885,7 @@ class DeviceWorker:
             mesh_out=mesh_out, staged_histo=staged_histo,
             spill_histo=spill_histo, device_stage=device_stage,
             micro_residual=micro_residual, reader_planes=reader_planes,
+            micro_replay=micro_replay,
         )
         # per-tenant lifetime fold, still under the caller's ingest lock
         # and BEFORE the epoch reset zeroes the per-epoch dicts — the
@@ -2507,16 +2932,19 @@ class DeviceWorker:
                 # can trail the pool's; rows past its end are empty
                 counts_np = np.pad(counts_np, (0, s_eff - rows_avail))
             unit = plane.wts is None
+            flat_w = None if unit else plane.wts[:rows_avail][mask]
             sh = self._shard
             if sh is not None:
-                flat_w = (None if unit
-                          else plane.wts[:rows_avail][mask])
                 fvj, fwj, cj = self._shard_flat_upload(
                     flat_v, flat_w, counts_np, s_eff)
                 if unit:
                     fwj = fvj  # ignored under unit=True (XLA DCEs it)
                 plane.free()
-                pending[0] = plane._replace(free=None)
+                # re-stage the HOST copies in place of the freed native
+                # plane: a device fault in the fold below leaves pending[0]
+                # replayable through the host engine (free=None also means
+                # the caller's cleanup won't double-free)
+                pending[0] = StagedPlane(flat_v, flat_w, counts_np, None)
                 svj, swj = sh.expand_flat(fvj, fwj, cj, B, unit)
             else:
                 n_pad = _next_pow2(max(len(flat_v), 1), 1024)
@@ -2533,13 +2961,13 @@ class DeviceWorker:
                 if unit:
                     fwj = fvj  # ignored under unit=True (XLA DCEs it)
                 else:
-                    flat_w = plane.wts[:rows_avail][mask]
                     fw = np.zeros(n_pad, np.float32)
                     fw[:len(flat_w)] = flat_w
                     fwj = self.ledger.h2d(fw, "staged_flat")
                 plane.free()
-                # freed: the caller's cleanup must not free it again
-                pending[0] = plane._replace(free=None)
+                # freed: re-stage the host copies (fault-replayable, and
+                # the caller's cleanup must not free the plane again)
+                pending[0] = StagedPlane(flat_v, flat_w, counts_np, None)
                 svj, swj = _expand_flat_planes(fvj, fwj, cj, B, unit)
         elif plane.counts is not None:
             # pre-compacted flat plane (ops/reader_stack.merge_reader_
@@ -2609,10 +3037,12 @@ class DeviceWorker:
                     swj = jnp.concatenate(
                         [swj, jnp.zeros((pad, swj.shape[1]), jnp.float32)])
         if self._shard is not None:
-            fields = self._shard.fold_staged(*fields, svj, swj)
+            fields = self.guard.call(
+                "staged", self._shard.fold_staged, *fields, svj, swj)
         else:
-            fields = _histo_fold_staged(
-                *fields, svj, swj, compression=self.compression)
+            fields = self.guard.call(
+                "staged", _histo_fold_staged, *fields, svj, swj,
+                compression=self.compression)
         pending.pop(0)
         return fields
 
@@ -2663,6 +3093,375 @@ class DeviceWorker:
             fwj = sh.place(fw2)
         return fvj, fwj, cj
 
+    def _device_extract_histo(self, snap, swapped, full, s_eff, n,
+                              spill, pending, quantiles, gov, st):
+        """The device half of the histo extraction: spill fold, staged
+        plane folds, micro-mirror fold, quantile extract, column unpack,
+        tenant-sketch fold, digest readback. On a DeviceFaultError the
+        caller completes the flush on the host engine; ``st`` tracks the
+        replayable progress (the newest fold state + the spill sample
+        offset) so the failover resumes exactly where the device stopped.
+        The injected-fault seam (ops/device_guard.dispatch) raises BEFORE
+        a dispatch executes, so the tracked state is exact under seeded
+        chaos; a real mid-execution device loss instead replays the
+        retained host inputs with whatever fold state is still readable
+        (honest degraded replay, logged). Returns (view_fields, s_eff)
+        for the query-view publish."""
+        directory = swapped.directory
+        if spill is not None:
+            # hot-row spill backlog deferred by swap(): chunked fold
+            # off the ingest lock (plain numpy from drain_histo — no
+            # native memory to free). Folded at the FULL pool shape —
+            # the exact jit specialization _fold_batch_direct keeps
+            # warm all interval — because a fresh s_eff-shaped
+            # compile on a starved host stalls the flush for longer
+            # than the fold itself (observed: 40s+ XLA compile under
+            # 33x overload). Timed: the measured rate sizes the NEXT
+            # swap's fold budget (closed-loop shedding).
+            sp_rows, sp_vals, sp_wts = spill
+            pool_rows = full[0].shape[0]
+            t_fold = time.perf_counter()
+            inflight = 0
+            for i in range(0, len(sp_rows), _FOLD_CHUNK):
+                full = self._fold_spill_chunk(
+                    full, sp_rows[i:i + _FOLD_CHUNK],
+                    sp_vals[i:i + _FOLD_CHUNK],
+                    sp_wts[i:i + _FOLD_CHUNK], pool_rows)
+                st["fields"] = full
+                st["spill_off"] = min(i + _FOLD_CHUNK, len(sp_rows))
+                inflight += 1
+                if inflight >= 8:  # bound the dispatch queue's memory
+                    self.guard.call("spill", full[0].block_until_ready)
+                    inflight = 0
+                    if gov is not None:
+                        gov.beat()
+            self.guard.call("spill", full[0].block_until_ready)
+            t_fold = time.perf_counter() - t_fold
+            if t_fold > 0.01:
+                rate = len(sp_rows) / t_fold
+                self._fold_rate_ewma = (
+                    0.5 * self._fold_rate_ewma + 0.5 * rate)
+        sh = self._shard
+        if sh is None:
+            fields = tuple(
+                a if a.shape[0] == s_eff else a[:s_eff] for a in full)
+        else:
+            # sharded shrink: each shard keeps its local prefix (the
+            # interleave closure property) — no resharding
+            fields = tuple(
+                a if a.shape[0] == s_eff else sh.slice_field(a, s_eff)
+                for a in full)
+        st["fields"] = fields
+        st["spill_off"] = len(spill[0]) if spill is not None else 0
+        try:
+            while pending:
+                fields = self._fold_one_plane(fields, pending, s_eff)
+                st["fields"] = fields
+                if gov is not None:
+                    gov.beat()
+        except dg.DeviceFaultError:
+            # hand the not-yet-folded tail to the failover as host
+            # copies (pending[0] already is one — _fold_one_plane
+            # re-stages before it dispatches): nothing replayable may
+            # be freed, and nothing native may survive this frame
+            for k in range(len(pending)):
+                pending[k] = _staged_plane_to_host(pending[k])
+            raise
+        except Exception:
+            # an upload/fold failure must not leak the C++ planes: a
+            # repeated failing flush at 1M rows would otherwise leak
+            # hundreds of MB per interval. Data loss here is fine
+            # (per-flush data is expendable, README.md:135-137);
+            # leaked native memory is not.
+            _free_staged_planes(pending)
+            pending.clear()
+            raise
+        if swapped.micro_residual is not None:
+            # deferred residual feeds: whatever the scheduler had not
+            # streamed by swap time lands on the device HERE, in the
+            # extract stage, exactly like the batch path's upload —
+            # the tick paid only the host-side COO memcpy
+            mirror, coos = swapped.micro_residual
+            swapped.micro_residual = None
+            for coo in coos:
+                mirror.feed(*coo)
+            swapped.device_stage = mirror.finish()
+            if gov is not None:
+                gov.beat()
+        dstage = swapped.device_stage
+        swapped.device_stage = None
+        if dstage is not None:
+            # micro-fold mirror: the epoch's staging plane is already
+            # resident on device, so this is the SAME single fold the
+            # batch path runs minus the upload — mirror_dense yields
+            # bitwise the array _expand_flat_planes / the dense
+            # Python upload would have built (values and weights at
+            # the same absolute slots, zeros elsewhere), which is
+            # what pins micro-folded == batch-folded
+            dense = (mf.mirror_dense if sh is None
+                     else sh.mirror_dense)
+            folder = (sh.fold_staged if sh is not None
+                      else functools.partial(
+                          _histo_fold_staged,
+                          compression=self.compression))
+
+            def _mirror_fold(fl):
+                return folder(*fl, dense(dstage.vals, s_eff),
+                              dense(dstage.wts, s_eff))
+
+            fields = self.guard.call("staged", _mirror_fold, fields)
+            st["fields"] = fields
+            if gov is not None:
+                gov.beat()
+        # the mirror's content is folded (or there was none): the host
+        # replay copy swap() retained is no longer needed
+        swapped.micro_replay = None
+        qnp = np.asarray(quantiles, dtype=np.float32)
+        if sh is None:
+            qs = self.ledger.h2d(qnp, "quantiles")
+        else:
+            qs = self.ledger.h2d(qnp, "quantiles",
+                                 replicas=sh.shards, put=sh.replicate)
+        run = (gov.begin_extract(s_eff, sh.shards if sh else 1)
+               if gov is not None and gov.enabled else None)
+        if run is None:
+            if sh is not None:
+                # sharded extract bypasses the Pallas single-device
+                # kernel: the GSPMD XLA program runs shard-local and
+                # the one packed readback assembles all shards
+                out = self.guard.call("extract", sh.flush_extract,
+                                      *fields, qs, retryable=True)
+                packed = np.asarray(_pack_extract_columns(*out))
+                self.ledger.count_d2h_shards(
+                    [packed.nbytes // sh.shards] * sh.shards,
+                    "extract_packed")
+                packed = packed[sh.perm_l2p(s_eff)]
+            else:
+                out = self._extract(fields, qs)
+                # ONE device→host transfer for the whole extraction:
+                # eleven per-array np.asarray calls are eleven
+                # synchronous D2H round-trips, and on a link with
+                # per-transfer latency (the tunnelled relay; any
+                # remote-device setup) the round-trips dominate the
+                # bytes at 1M rows
+                packed = self.ledger.d2h(
+                    _pack_extract_columns(*out), "extract_packed")
+            p = out[0].shape[1]
+        else:
+            # governed degraded mode: extract in row chunks sized to
+            # flush_chunk_target_ms (health/governor.py) so an
+            # extraction-bound host produces a longer-but-BOUNDED
+            # flush with a progress beat per chunk (the watchdog
+            # deferral signal). dynamic_slice keeps one executable
+            # per (pool, chunk) shape pair — a static a[i:j] slice
+            # would compile per start offset.
+            parts = []
+            p = 0
+            while (c := run.next_rows()):
+                t0 = time.perf_counter()
+                if sh is not None:
+                    # lockstep per-shard slice: a c-row chunk at a
+                    # D-aligned start is rows [start/D, start/D+c/D)
+                    # on every shard; the per-chunk inverse perm
+                    # restores logical order, so the concat below is
+                    # already logical end to end
+                    sub = tuple(sh.slice_chunk(a, run.start, c)
+                                for a in fields)
+                    out = self.guard.call("extract", sh.flush_extract,
+                                          *sub, qs, retryable=True)
+                    pk = np.asarray(_pack_extract_columns(*out))
+                    self.ledger.count_d2h_shards(
+                        [pk.nbytes // sh.shards] * sh.shards,
+                        "extract_packed")
+                    parts.append(pk[sh.chunk_perm(c)])
+                else:
+                    sub = tuple(
+                        jax.lax.dynamic_slice_in_dim(a, run.start, c, 0)
+                        for a in fields)
+                    out = self._extract(sub, qs)
+                    parts.append(self.ledger.d2h(
+                        _pack_extract_columns(*out), "extract_packed"))
+                p = out[0].shape[1]
+                run.note(c, time.perf_counter() - t0)
+            packed = (parts[0] if len(parts) == 1
+                      else np.concatenate(parts, axis=0))
+        qv, (dmin, dmax, dsum, dcount, drecip, lmin, lmax, lsum,
+             lweight, lrecip) = columnar.unpack_extract_columns(
+                 packed, p)
+        snap.quantile_values = qv[:n]
+        snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
+        snap.dmin, snap.dmax = dmin[:n], dmax[:n]
+        snap.dsum, snap.dcount, snap.drecip = dsum[:n], dcount[:n], drecip[:n]
+        snap.lmin, snap.lmax = lmin[:n], lmax[:n]
+        snap.lsum, snap.lweight, snap.lrecip = lsum[:n], lweight[:n], lrecip[:n]
+        sk = self.tenant_sketch
+        if sk is not None and n:
+            # heavy-hitter fold (core/tenancy.TenantSketch): one
+            # (tenant row, series key, folded sample count) triple
+            # per live histo series per interval, scatter-added into
+            # the per-tenant count-min pool on device. Runs here —
+            # off the ingest lock, extractions never overlap — so
+            # detection costs the ingest path nothing.
+            hrows = directory.histo.rows
+            tenants = [m.tenant or DEFAULT_TENANT for m in hrows]
+            skeys = [m.key.key_string() for m in hrows]
+            kcounts = np.maximum(
+                np.nan_to_num(snap.dcount[:n]), 0).astype(np.int64)
+            sk.fold(tenants, skeys, kcounts,
+                    _next_pow2(min(len(skeys), 1 << 15), 256))
+        # the [S,C] centroid pools are read back ONLY where forwarding
+        # can consume them (a local tier serializes digests upstream;
+        # reference flusher.go:338-433). A terminal server — global or
+        # standalone, forward_address unset — never touches them, and
+        # at 1M series the two arrays are ~1GB of device→host traffic
+        # that round-4's on-chip E2E run measured at >90s of the 105s
+        # extract phase. Consumers (codec.py, flusher.forward
+        # iterator) already handle digest_means is None.
+        if self.is_local:
+            if sh is not None:
+                l2p = sh.perm_l2p(s_eff)[:n]
+                dm = np.asarray(fields[0])
+                dw = np.asarray(fields[1])
+                self.ledger.count_d2h_shards(
+                    [(dm.nbytes + dw.nbytes) // sh.shards] * sh.shards,
+                    "forward_digests")
+                snap.digest_means = dm[l2p]
+                snap.digest_weights = dw[l2p]
+            else:
+                snap.digest_means = self.ledger.d2h(
+                    fields[0], "forward_digests")[:n]
+                snap.digest_weights = self.ledger.d2h(
+                    fields[1], "forward_digests")[:n]
+        return fields, s_eff
+
+    def _fields_to_host(self, fields) -> tuple:
+        """d2h the 14 fold-state arrays in LOGICAL row order for the
+        host engine. On a d2h failure (hard device loss took the fold
+        state with it) the failover restarts from an empty host pool —
+        logged, honest, degraded data loss rather than a dead flush."""
+        sh = self._shard
+        try:
+            rows = int(fields[0].shape[0])
+            perm = sh.perm_l2p(rows) if sh is not None else None
+            out = []
+            for a in fields:
+                h = np.asarray(a)
+                out.append(np.array(h[perm] if perm is not None else h,
+                                    copy=True))
+            return tuple(out)
+        except Exception:
+            log.exception(
+                "device fold state unreadable during failover — "
+                "restarting from an empty host pool (data loss)")
+            return he.HostHistoState.create(
+                int(fields[0].shape[0]), self.capacity).fields()
+
+    def _host_fold_plane(self, fields: tuple, plane: StagedPlane,
+                         s_eff: int) -> tuple:
+        """Host twin of _fold_one_plane's upload + fold for one
+        host-owned plane (flat + counts, or dense)."""
+        if plane.counts is not None:
+            counts = np.asarray(plane.counts, np.int32)
+            if len(counts) < s_eff:
+                counts = np.pad(counts, (0, s_eff - len(counts)))
+            elif len(counts) > s_eff:
+                counts = counts[:s_eff]
+            unit = plane.wts is None
+            sv, sw = he.np_expand_flat_planes(
+                np.asarray(plane.vals, np.float32),
+                None if unit else np.asarray(plane.wts, np.float32),
+                counts, self.stage_depth, unit)
+        else:
+            sv = np.asarray(plane.vals[:s_eff], np.float32)
+            sw = np.asarray(plane.wts[:s_eff], np.float32)
+            if sv.shape[0] < s_eff:
+                pad = s_eff - sv.shape[0]
+                sv = np.pad(sv, ((0, pad), (0, 0)))
+                sw = np.pad(sw, ((0, pad), (0, 0)))
+        return he.np_fold_staged(*fields, sv, sw,
+                                 compression=self.compression)
+
+    def _host_complete_extract(self, snap, swapped, fields, s_eff, n,
+                               spill, spill_off, pending, quantiles, gov):
+        """Finish a histo extraction on the host engine: the remaining
+        spill chunks, the staged planes, the micro replay batch, then
+        the quantile extract — the bitwise twin programs in
+        ops/host_engine, applied in the device path's exact order with
+        the device path's exact chunk boundaries, which is what makes a
+        host-completed flush == an all-device flush bit for bit. Called
+        either for an epoch quarantined before swap (fields are the
+        HostHistoState's arrays) or mid-extraction after a device fault
+        (fields are the d2h'd fold state at the fault point). Returns
+        (view_fields, s_eff) for the query-view publish."""
+        directory = swapped.directory
+        fields = tuple(np.asarray(a) for a in fields)
+        if spill is not None and spill_off < len(spill[0]):
+            sp_rows, sp_vals, sp_wts = spill
+            pool_rows = fields[0].shape[0]
+            for i in range(spill_off, len(sp_rows), _FOLD_CHUNK):
+                active, lids, v, w = self._pad_spill_batch(
+                    sp_rows[i:i + _FOLD_CHUNK],
+                    sp_vals[i:i + _FOLD_CHUNK],
+                    sp_wts[i:i + _FOLD_CHUNK], pool_rows - 1)
+                fields = he.np_ingest_step(
+                    *fields, active, lids, v, w,
+                    compression=self.compression)
+                if gov is not None:
+                    gov.beat()
+        fields = tuple(a if a.shape[0] == s_eff else a[:s_eff]
+                       for a in fields)
+        while pending:
+            plane = _staged_plane_to_host(pending[0])
+            fields = self._host_fold_plane(fields, plane, s_eff)
+            pending.pop(0)
+            if gov is not None:
+                gov.beat()
+        # the micro mirror (device state) is unreachable or already
+        # dropped; its samples fold from the host replay batch swap()
+        # retained — the no-epoch-lost contract for streamed samples
+        replay = swapped.micro_replay
+        swapped.micro_replay = None
+        swapped.device_stage = None
+        swapped.micro_residual = None
+        if replay is not None:
+            fields = self._host_fold_plane(fields, replay, s_eff)
+            if gov is not None:
+                gov.beat()
+        qnp = np.asarray(quantiles, dtype=np.float32)
+        out = he.np_flush_extract(*fields, qnp)
+        packed = he.np_pack_extract_columns(*out)
+        p = out[0].shape[1]
+        qv, (dmin, dmax, dsum, dcount, drecip, lmin, lmax, lsum,
+             lweight, lrecip) = columnar.unpack_extract_columns(packed, p)
+        snap.quantile_values = qv[:n]
+        snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
+        snap.dmin, snap.dmax = dmin[:n], dmax[:n]
+        snap.dsum, snap.dcount, snap.drecip = (dsum[:n], dcount[:n],
+                                               drecip[:n])
+        snap.lmin, snap.lmax = lmin[:n], lmax[:n]
+        snap.lsum, snap.lweight, snap.lrecip = (lsum[:n], lweight[:n],
+                                                lrecip[:n])
+        sk = self.tenant_sketch
+        if sk is not None and n:
+            # the sketch pool is device state: best-effort under a
+            # fault — one interval of heavy-hitter attribution is
+            # expendable, the flush is not
+            try:
+                hrows = directory.histo.rows
+                tenants = [m.tenant or DEFAULT_TENANT for m in hrows]
+                skeys = [m.key.key_string() for m in hrows]
+                kcounts = np.maximum(
+                    np.nan_to_num(snap.dcount[:n]), 0).astype(np.int64)
+                sk.fold(tenants, skeys, kcounts,
+                        _next_pow2(min(len(skeys), 1 << 15), 256))
+            except Exception:
+                log.exception("tenant sketch fold skipped during host"
+                              " failover")
+        if self.is_local:
+            snap.digest_means = np.array(fields[0][:n])
+            snap.digest_weights = np.array(fields[1][:n])
+        return fields, s_eff
+
     def extract_snapshot(self, swapped: "SwappedEpoch",
                          quantiles: np.ndarray,
                          interval_s: float = 10.0) -> FlushSnapshot:
@@ -2688,6 +3487,15 @@ class DeviceWorker:
             directory=directory, scalars=scalars, interval_s=interval_s,
             unique_timeseries_registers=swapped.umts,
         )
+
+        def _mark_degraded():
+            # first host-fallback event of this flush: flag the snapshot
+            # (query responses surface it as degraded: true) and book the
+            # fallback once in the health ledger
+            if not snap.degraded:
+                snap.degraded = True
+                self.ledger.note_fallback()
+                self.host_fallback_flushes += 1
         # pop the deferred spill backlog UNCONDITIONALLY: when the histo
         # block below is skipped (pool absent / zero rows) the batch is
         # unfoldable and must be counted as shed, not silently discarded
@@ -2750,212 +3558,38 @@ class DeviceWorker:
                     spill = (rspill if spill is None else tuple(
                         np.concatenate([spill[k], rspill[k]])
                         for k in range(3)))
-            if spill is not None:
-                # hot-row spill backlog deferred by swap(): chunked fold
-                # off the ingest lock (plain numpy from drain_histo — no
-                # native memory to free). Folded at the FULL pool shape —
-                # the exact jit specialization _fold_batch_direct keeps
-                # warm all interval — because a fresh s_eff-shaped
-                # compile on a starved host stalls the flush for longer
-                # than the fold itself (observed: 40s+ XLA compile under
-                # 33x overload). Timed: the measured rate sizes the NEXT
-                # swap's fold budget (closed-loop shedding).
-                sp_rows, sp_vals, sp_wts = spill
-                t_fold = time.perf_counter()
-                inflight = 0
-                for i in range(0, len(sp_rows), _FOLD_CHUNK):
-                    full = self._fold_spill_chunk(
-                        full, sp_rows[i:i + _FOLD_CHUNK],
-                        sp_vals[i:i + _FOLD_CHUNK],
-                        sp_wts[i:i + _FOLD_CHUNK], histo.num_rows)
-                    inflight += 1
-                    if inflight >= 8:  # bound the dispatch queue's memory
-                        full[0].block_until_ready()
-                        inflight = 0
-                        if gov is not None:
-                            gov.beat()
-                full[0].block_until_ready()
-                t_fold = time.perf_counter() - t_fold
-                if t_fold > 0.01:
-                    rate = len(sp_rows) / t_fold
-                    self._fold_rate_ewma = (
-                        0.5 * self._fold_rate_ewma + 0.5 * rate)
-            sh = self._shard
-            if sh is None:
-                fields = tuple(
-                    a if a.shape[0] == s_eff else a[:s_eff] for a in full)
-            else:
-                # sharded shrink: each shard keeps its local prefix (the
-                # interleave closure property) — no resharding
-                fields = tuple(
-                    a if a.shape[0] == s_eff else sh.slice_field(a, s_eff)
-                    for a in full)
             pending = list(swapped.staged_histo or ())
             if merged_plane is not None:
                 pending.append(merged_plane)
             swapped.staged_histo = None
-            try:
-                while pending:
-                    fields = self._fold_one_plane(fields, pending, s_eff)
-                    if gov is not None:
-                        gov.beat()
-            finally:
-                # an upload/fold failure must not leak the C++ planes: a
-                # repeated failing flush at 1M rows would otherwise leak
-                # hundreds of MB per interval. Data loss here is fine
-                # (per-flush data is expendable, README.md:135-137);
-                # leaked native memory is not.
-                _free_staged_planes(pending)
-            if swapped.micro_residual is not None:
-                # deferred residual feeds: whatever the scheduler had not
-                # streamed by swap time lands on the device HERE, in the
-                # extract stage, exactly like the batch path's upload —
-                # the tick paid only the host-side COO memcpy
-                mirror, coos = swapped.micro_residual
-                swapped.micro_residual = None
-                for coo in coos:
-                    mirror.feed(*coo)
-                swapped.device_stage = mirror.finish()
-                if gov is not None:
-                    gov.beat()
-            dstage = swapped.device_stage
-            swapped.device_stage = None
-            if dstage is not None:
-                # micro-fold mirror: the epoch's staging plane is already
-                # resident on device, so this is the SAME single fold the
-                # batch path runs minus the upload — mirror_dense yields
-                # bitwise the array _expand_flat_planes / the dense
-                # Python upload would have built (values and weights at
-                # the same absolute slots, zeros elsewhere), which is
-                # what pins micro-folded == batch-folded
-                dense = (mf.mirror_dense if sh is None
-                         else sh.mirror_dense)
-                folder = (sh.fold_staged if sh is not None
-                          else functools.partial(
-                              _histo_fold_staged,
-                              compression=self.compression))
-                fields = folder(
-                    *fields,
-                    dense(dstage.vals, s_eff),
-                    dense(dstage.wts, s_eff))
-                if gov is not None:
-                    gov.beat()
-            view_fields = fields
-            view_s_eff = s_eff
-            qnp = np.asarray(quantiles, dtype=np.float32)
-            if sh is None:
-                qs = self.ledger.h2d(qnp, "quantiles")
+            if isinstance(histo, he.HostHistoState):
+                # the epoch quarantined before swap: the fold state is
+                # already host-resident, so the whole flush runs on the
+                # host engine (the bitwise twin programs)
+                _mark_degraded()
+                view_fields, view_s_eff = self._host_complete_extract(
+                    snap, swapped, full, s_eff, n, spill, 0, pending,
+                    quantiles, gov)
             else:
-                qs = self.ledger.h2d(qnp, "quantiles",
-                                     replicas=sh.shards, put=sh.replicate)
-            run = (gov.begin_extract(s_eff, sh.shards if sh else 1)
-                   if gov is not None and gov.enabled else None)
-            if run is None:
-                if sh is not None:
-                    # sharded extract bypasses the Pallas single-device
-                    # kernel: the GSPMD XLA program runs shard-local and
-                    # the one packed readback assembles all shards
-                    out = sh.flush_extract(*fields, qs)
-                    packed = np.asarray(_pack_extract_columns(*out))
-                    self.ledger.count_d2h_shards(
-                        [packed.nbytes // sh.shards] * sh.shards,
-                        "extract_packed")
-                    packed = packed[sh.perm_l2p(s_eff)]
-                else:
-                    out = self._extract(fields, qs)
-                    # ONE device→host transfer for the whole extraction:
-                    # eleven per-array np.asarray calls are eleven
-                    # synchronous D2H round-trips, and on a link with
-                    # per-transfer latency (the tunnelled relay; any
-                    # remote-device setup) the round-trips dominate the
-                    # bytes at 1M rows
-                    packed = self.ledger.d2h(
-                        _pack_extract_columns(*out), "extract_packed")
-                p = out[0].shape[1]
-            else:
-                # governed degraded mode: extract in row chunks sized to
-                # flush_chunk_target_ms (health/governor.py) so an
-                # extraction-bound host produces a longer-but-BOUNDED
-                # flush with a progress beat per chunk (the watchdog
-                # deferral signal). dynamic_slice keeps one executable
-                # per (pool, chunk) shape pair — a static a[i:j] slice
-                # would compile per start offset.
-                parts = []
-                p = 0
-                while (c := run.next_rows()):
-                    t0 = time.perf_counter()
-                    if sh is not None:
-                        # lockstep per-shard slice: a c-row chunk at a
-                        # D-aligned start is rows [start/D, start/D+c/D)
-                        # on every shard; the per-chunk inverse perm
-                        # restores logical order, so the concat below is
-                        # already logical end to end
-                        sub = tuple(sh.slice_chunk(a, run.start, c)
-                                    for a in fields)
-                        out = sh.flush_extract(*sub, qs)
-                        pk = np.asarray(_pack_extract_columns(*out))
-                        self.ledger.count_d2h_shards(
-                            [pk.nbytes // sh.shards] * sh.shards,
-                            "extract_packed")
-                        parts.append(pk[sh.chunk_perm(c)])
-                    else:
-                        sub = tuple(
-                            jax.lax.dynamic_slice_in_dim(a, run.start, c, 0)
-                            for a in fields)
-                        out = self._extract(sub, qs)
-                        parts.append(self.ledger.d2h(
-                            _pack_extract_columns(*out), "extract_packed"))
-                    p = out[0].shape[1]
-                    run.note(c, time.perf_counter() - t0)
-                packed = (parts[0] if len(parts) == 1
-                          else np.concatenate(parts, axis=0))
-            qv, (dmin, dmax, dsum, dcount, drecip, lmin, lmax, lsum,
-                 lweight, lrecip) = columnar.unpack_extract_columns(
-                     packed, p)
-            snap.quantile_values = qv[:n]
-            snap.quantile_qs = np.asarray(quantiles, dtype=np.float64)
-            snap.dmin, snap.dmax = dmin[:n], dmax[:n]
-            snap.dsum, snap.dcount, snap.drecip = dsum[:n], dcount[:n], drecip[:n]
-            snap.lmin, snap.lmax = lmin[:n], lmax[:n]
-            snap.lsum, snap.lweight, snap.lrecip = lsum[:n], lweight[:n], lrecip[:n]
-            sk = self.tenant_sketch
-            if sk is not None and n:
-                # heavy-hitter fold (core/tenancy.TenantSketch): one
-                # (tenant row, series key, folded sample count) triple
-                # per live histo series per interval, scatter-added into
-                # the per-tenant count-min pool on device. Runs here —
-                # off the ingest lock, extractions never overlap — so
-                # detection costs the ingest path nothing.
-                hrows = directory.histo.rows
-                tenants = [m.tenant or DEFAULT_TENANT for m in hrows]
-                skeys = [m.key.key_string() for m in hrows]
-                kcounts = np.maximum(
-                    np.nan_to_num(snap.dcount[:n]), 0).astype(np.int64)
-                sk.fold(tenants, skeys, kcounts,
-                        _next_pow2(min(len(skeys), 1 << 15), 256))
-            # the [S,C] centroid pools are read back ONLY where forwarding
-            # can consume them (a local tier serializes digests upstream;
-            # reference flusher.go:338-433). A terminal server — global or
-            # standalone, forward_address unset — never touches them, and
-            # at 1M series the two arrays are ~1GB of device→host traffic
-            # that round-4's on-chip E2E run measured at >90s of the 105s
-            # extract phase. Consumers (codec.py, flusher.forward
-            # iterator) already handle digest_means is None.
-            if self.is_local:
-                if sh is not None:
-                    l2p = sh.perm_l2p(s_eff)[:n]
-                    dm = np.asarray(fields[0])
-                    dw = np.asarray(fields[1])
-                    self.ledger.count_d2h_shards(
-                        [(dm.nbytes + dw.nbytes) // sh.shards] * sh.shards,
-                        "forward_digests")
-                    snap.digest_means = dm[l2p]
-                    snap.digest_weights = dw[l2p]
-                else:
-                    snap.digest_means = self.ledger.d2h(
-                        fields[0], "forward_digests")[:n]
-                    snap.digest_weights = self.ledger.d2h(
-                        fields[1], "forward_digests")[:n]
+                # replayable progress for the device→host failover:
+                # "fields" is the newest device fold state (full-pool
+                # until the shrink, s_eff after), "spill_off" counts the
+                # spill samples already folded into it
+                st = {"fields": None, "spill_off": 0}
+                try:
+                    view_fields, view_s_eff = self._device_extract_histo(
+                        snap, swapped, full, s_eff, n, spill, pending,
+                        quantiles, gov, st)
+                except dg.DeviceFaultError as exc:
+                    log.error(
+                        "device fault during extraction (%s) — completing"
+                        " the flush on the host engine", exc)
+                    _mark_degraded()
+                    host_fields = self._fields_to_host(
+                        st["fields"] if st["fields"] is not None else full)
+                    view_fields, view_s_eff = self._host_complete_extract(
+                        snap, swapped, host_fields, s_eff, n, spill,
+                        st["spill_off"], pending, quantiles, gov)
         elif spill is not None and len(spill[0]):
             # deferred spill with nowhere to fold (ADVICE item 2): the
             # samples are lost either way, but lost-and-counted — the
@@ -2983,9 +3617,11 @@ class DeviceWorker:
                         log.exception("reader plane free failed")
             swapped.reader_planes = None
         # (a mirror with nowhere to fold is just device garbage — drop it,
-        # along with any never-fed residual: no rows means nothing to lose)
+        # along with any never-fed residual and its host replay copy: no
+        # rows means nothing to lose)
         swapped.device_stage = None
         swapped.micro_residual = None
+        swapped.micro_replay = None
         if swapped.mesh_out is not None:
             mout = swapped.mesh_out
             n = directory.num_histo_rows
@@ -3010,18 +3646,40 @@ class DeviceWorker:
             # a global is a terminal aggregator for them)
             if self.is_local:
                 snap.set_registers = staged_sets.registers(n)
+            if staged_sets.host_mode:
+                # the store fell to (or started on) its host registers —
+                # the estimates above came from the np twin
+                _mark_degraded()
         elif sets is not None and directory.num_set_rows:
             n = directory.num_set_rows
-            if self._shard is not None:
-                l2p = self._shard.perm_l2p(sets.shape[0])[:n]
-                snap.set_estimates = np.asarray(self._shard.hll_estimate(
-                    sets, self.hll_precision))[l2p]
-                snap.set_registers = np.asarray(sets)[l2p]
+            if isinstance(sets, np.ndarray):
+                # quarantined epoch: host registers, np estimate twin
+                # (already in logical row order — _sets_to_host gathers)
+                _mark_degraded()
+                snap.set_estimates = he.np_hll_estimate_exact(
+                    sets, self.hll_precision)[:n]
+                snap.set_registers = sets[:n]
             else:
-                snap.set_estimates = np.asarray(
-                    hll_ops.estimate(sets, self.hll_precision)
-                )[:n]
-                snap.set_registers = np.asarray(sets)[:n]
+                try:
+                    if self._shard is not None:
+                        est = self.guard.call(
+                            "extract", self._shard.hll_estimate, sets,
+                            self.hll_precision, retryable=True)
+                        l2p = self._shard.perm_l2p(sets.shape[0])[:n]
+                        snap.set_estimates = np.asarray(est)[l2p]
+                        snap.set_registers = np.asarray(sets)[l2p]
+                    else:
+                        est = self.guard.call(
+                            "extract", hll_ops.estimate, sets,
+                            self.hll_precision, retryable=True)
+                        snap.set_estimates = np.asarray(est)[:n]
+                        snap.set_registers = np.asarray(sets)[:n]
+                except dg.DeviceFaultError:
+                    _mark_degraded()
+                    regs = self._sets_to_host(sets)
+                    snap.set_estimates = he.np_hll_estimate_exact(
+                        regs, self.hll_precision)[:n]
+                    snap.set_registers = regs[:n]
         pub = self.query_publisher
         if pub is not None:
             # publish this epoch's read view. A publish failure must not
@@ -3050,26 +3708,49 @@ class DeviceWorker:
         bypass the flush TransferLedger — a query must not perturb the
         O(samples) transfer-window accounting the flush telemetry pins.
 
-        Returns None when the epoch had no histogram rows."""
+        Returns None when the epoch had no histogram rows.
+
+        Host-fallback epochs (quarantined at swap, or failed over
+        mid-extraction) retain HOST field arrays; their evaluator runs
+        the np twin programs — same bits, no device dependency, so the
+        query surface stays live through a quarantine."""
         if fields is None:
             return None
         sh = self._shard
 
+        if isinstance(fields[0], np.ndarray):
+            def evaluate_host(qs_np: np.ndarray) -> tuple[np.ndarray, int]:
+                qnp = np.asarray(qs_np, dtype=np.float32)
+                out = he.np_flush_extract(*fields, qnp)
+                return he.np_pack_extract_columns(*out), out[0].shape[1]
+
+            return evaluate_host
+
         def evaluate(qs_np: np.ndarray) -> tuple[np.ndarray, int]:
             """f32[P] quantiles → (packed [s_eff, P+10] host array in
             LOGICAL row order, P). Column layout: see
-            columnar.unpack_extract_columns."""
+            columnar.unpack_extract_columns. A device fault falls back
+            to the np twins over a one-shot d2h of the fields — a query
+            must survive the breaker tripping between publish and
+            read."""
             qnp = np.asarray(qs_np, dtype=np.float32)
-            if sh is not None:
-                qs = sh.replicate(qnp)
-                out = sh.flush_extract(*fields, qs)
-                packed = np.asarray(_pack_extract_columns(*out))
-                packed = packed[sh.perm_l2p(s_eff)]
-            else:
-                qs = jnp.asarray(qnp)
-                out = self._extract(fields, qs)
-                packed = np.asarray(_pack_extract_columns(*out))
-            return packed, out[0].shape[1]
+            try:
+                if sh is not None:
+                    qs = sh.replicate(qnp)
+                    out = self.guard.call("query", sh.flush_extract,
+                                          *fields, qs, retryable=True)
+                    packed = np.asarray(_pack_extract_columns(*out))
+                    packed = packed[sh.perm_l2p(s_eff)]
+                else:
+                    qs = jnp.asarray(qnp)
+                    # _extract is already guard-wrapped (op "extract")
+                    out = self._extract(fields, qs)
+                    packed = np.asarray(_pack_extract_columns(*out))
+                return packed, out[0].shape[1]
+            except dg.DeviceFaultError:
+                host = self._fields_to_host(fields)
+                out = he.np_flush_extract(*host, qnp)
+                return he.np_pack_extract_columns(*out), out[0].shape[1]
 
         return evaluate
 

@@ -152,6 +152,12 @@ class FlushDeadlineGovernor:
         # soak's contract reads from here — zero shed events may ever be
         # attributable to an innocent tenant while an abusive one floods
         self.tenant_shed_total: dict = {}
+        # last classified device fault ("kind:op — detail", set by the
+        # server from each worker's DeviceGuard after extraction). The
+        # watchdog's panic verdict names it: a flush wedged right after
+        # a device fault is a device postmortem, not a scheduling one.
+        self._last_fault: str | None = None
+        self.device_faults_total = 0
 
     @property
     def enabled(self) -> bool:
@@ -230,6 +236,15 @@ class FlushDeadlineGovernor:
         with self._lock:
             return dict(self.tenant_shed_total)
 
+    def note_fault(self, desc: str) -> None:
+        """Record a classified device fault (ops/device_guard taxonomy,
+        e.g. "oom:fold — 3 consecutive device faults..."). Read back by
+        the watchdog verdict (health/policy.watchdog_verdict) so a panic
+        log names the device error instead of a generic stall."""
+        with self._lock:
+            self._last_fault = str(desc)
+            self.device_faults_total += 1
+
     def progress(self) -> dict:
         """Snapshot for the watchdog deferral decision."""
         with self._lock:
@@ -237,6 +252,7 @@ class FlushDeadlineGovernor:
                 "in_flight": self._in_flight > 0,
                 "last_beat_unix": self._last_beat_unix,
                 "chunks_done": self._chunks_done,
+                "last_device_fault": self._last_fault,
             }
 
     @property

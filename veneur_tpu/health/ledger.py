@@ -72,6 +72,26 @@ class TransferLedger:
         # merged batch is uploaded once and booked by h2d(); this is a
         # provenance breakdown of that single transfer.
         self._flush_h2d_readers: list[int] = []
+        # flushes (lifetime) whose extraction completed on the HOST
+        # engine after a device fault (ops/device_guard quarantine or a
+        # mid-extract fault): the device mirror was bypassed, so the
+        # transfer-diet numbers for those flushes legitimately shrink.
+        # Surfaced as veneur.flush.host_fallbacks by the server.
+        self.host_fallbacks = 0
+        self._flush_fallback = False
+
+    def note_fallback(self) -> None:
+        """Mark the current flush as host-fallback (device path faulted
+        or quarantined; extraction finished on ops/host_engine)."""
+        with self._lock:
+            if not self._flush_fallback:
+                self._flush_fallback = True
+                self.host_fallbacks += 1
+
+    @property
+    def flush_was_fallback(self) -> bool:
+        with self._lock:
+            return self._flush_fallback
 
     def begin_flush(self) -> None:
         with self._lock:
@@ -81,6 +101,7 @@ class TransferLedger:
             self._flush_h2d_shards = []
             self._flush_d2h_shards = []
             self._flush_h2d_readers = []
+            self._flush_fallback = False
             self.flushes += 1
 
     # -- transfer wrappers ------------------------------------------------

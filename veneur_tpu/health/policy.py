@@ -149,19 +149,26 @@ def watchdog_should_defer(now_unix: float, governor,
     the postmortem of a panic (or of a long deferral) is self-reading.
     """
     prog = governor.progress()
+    # Device fault verdict (ops/device_guard taxonomy): when the guard
+    # classified a device error this process lifetime, every watchdog
+    # decision — deferral or panic — names it. A flush that wedges right
+    # after an XLA OOM or a lost device is a DEVICE postmortem; a panic
+    # log that only says "stalled" sends the operator to the scheduler.
+    fault = prog.get("last_device_fault")
+    verdict = f"; last device fault [{fault}]" if fault else ""
     if not prog["in_flight"]:
-        return False, "no flush in flight"
+        return False, "no flush in flight" + verdict
     window = stall_window_s(interval_s, governor.chunk_target_s)
     age = now_unix - prog["last_beat_unix"]
     if age < window:
         return True, (
             f"flush in flight with progress {age:.1f}s ago "
             f"({prog['chunks_done']} chunks done; stall window "
-            f"{window:.1f}s)")
+            f"{window:.1f}s)" + verdict)
     return False, (
         f"flush in flight but stalled: last progress {age:.1f}s ago "
         f"(>= {window:.1f}s stall window, "
-        f"{prog['chunks_done']} chunks done)")
+        f"{prog['chunks_done']} chunks done)" + verdict)
 
 
 # -- elastic-tier autoscale policy (ISSUE 14) ---------------------------------

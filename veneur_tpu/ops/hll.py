@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veneur_tpu.ops import exactnum as exn
+
 DEFAULT_PRECISION = 14  # matches reference (axiomhq) precision
 
 
@@ -126,15 +128,22 @@ def estimate(registers: jax.Array, precision: int = DEFAULT_PRECISION
     """Cardinality estimate per row: int8[S, m] → f32[S].
 
     Harmonic-mean estimator with linear counting below 2.5m.
+
+    Order-pinned form (host fallback parity, see ops/exactnum.py): the
+    transcendentals become host-precomputed f32 tables read by integer
+    gathers (exp2(-rank) is 65 entries; the linear-counting m·ln(m/z)
+    is indexed by the integer zero count), and the Σ 2^-reg reduction is
+    a pairwise halving tree — so ops/host_engine.py reproduces every
+    estimate bitwise.
     """
     m = float(num_registers(precision))
-    regs = registers.astype(jnp.float32)
-    inv_sum = jnp.sum(jnp.exp2(-regs), axis=-1)  # Σ 2^-reg
-    zeros = jnp.sum(registers == 0, axis=-1).astype(jnp.float32)
-    alpha = 0.7213 / (1.0 + 1.079 / m)
-    raw = alpha * m * m / inv_sum
-    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
-    use_linear = (raw <= 2.5 * m) & (zeros > 0)
+    ranks = registers.astype(jnp.int32)
+    ept = jnp.asarray(exn.exp2_neg_table())
+    inv_sum = exn.tsum(ept[ranks])  # Σ 2^-reg, fixed association
+    zeros = jnp.sum((registers == 0).astype(jnp.int32), axis=-1)
+    raw = jnp.asarray(exn.hll_alpha_m2(precision)) / inv_sum
+    linear = jnp.asarray(exn.hll_linear_table(precision))[zeros]
+    use_linear = (raw <= jnp.float32(2.5 * m)) & (zeros > 0)
     return jnp.where(use_linear, linear, raw)
 
 

@@ -1,0 +1,551 @@
+"""Host (NumPy) sketch engine: the device fault domain's failover target.
+
+When ops/device_guard.py trips a worker's breaker, the worker's histogram
+pool, set pool, and flush extraction move onto these kernels until a
+probe re-admits the device. The contract is BIT-EQUALITY: a degraded
+interval must flush byte-identically to what the device path would have
+produced over the same inputs, for every metric class — otherwise
+failover silently shifts quantiles/estimates and the "graceful" in
+graceful degradation is a lie the dashboards can't see.
+
+That contract is only possible because the device kernels are written
+against ops/exactnum.py: every float reduction is an explicitly-coded
+Hillis-Steele scan or pairwise halving tree, every product that feeds an
+add is select-blocked against FMA contraction, and every transcendental
+is a host-precomputed f32 table read by exact integer gathers or
+comparison-exact searchsorted. Each function here replays the SAME
+IEEE-754 operation sequence with NumPy ops:
+
+* ``jax.lax.sort`` (stable)            → ``np.argsort(kind="stable")`` /
+                                          ``np.lexsort`` (both stable)
+* ``exn.cumsum`` / ``exn.tsum``        → ``exn.np_cumsum`` / ``np_tsum``
+                                          (identical shift loops)
+* ``segments.*``                       → their ``np_*`` twins
+* searchsorted / min / max / select /
+  single add / sub / mul / div / sqrt  → IEEE-correctly-rounded on both
+                                          sides; used directly
+
+Mirrors are kept line-for-line parallel with their device source
+(ops/tdigest.py, ops/hll.py, core/worker.py jitted steps) — when editing
+one side, edit the other; tests/test_device_guard.py pins the parity
+matrix and tools/fuzz_differential.py --op device_fallback fuzzes it.
+
+NumPy dtype discipline: every float constant is spelled ``np.float32``
+so no op silently promotes to f64 (JAX's weak-typing keeps the device
+side in f32; NumPy 1.x promotes f32 op python-float to f32 by value but
+an explicit cast removes the footgun). Integer index math may widen to
+int64 host-side — value-exact, so parity is unaffected.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from veneur_tpu.ops import exactnum as exn
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import segments
+from veneur_tpu.ops import tdigest as td
+
+_INF = np.float32(np.inf)
+_F0 = np.float32(0.0)
+_TINY = np.float32(1e-30)
+_NAN = np.float32(np.nan)
+
+# ---------------------------------------------------------------------------
+# t-digest (ops/tdigest.py twins)
+
+
+def _stable_sort_pair(keys: np.ndarray, payload: np.ndarray):
+    """Twin of jax.lax.sort((keys, payload), num_keys=1) along the last
+    axis (stable)."""
+    order = np.argsort(keys, axis=-1, kind="stable")
+    return (np.take_along_axis(keys, order, axis=-1),
+            np.take_along_axis(payload, order, axis=-1))
+
+
+def np_compress_rows(means: np.ndarray, weights: np.ndarray,
+                     compression: float, capacity: int):
+    """Twin of ops/tdigest._compress_rows."""
+    s, m = means.shape
+    with np.errstate(invalid="ignore", divide="ignore"):
+        sort_keys = np.where(weights > 0, means, _INF)
+        sorted_means, sorted_w = _stable_sort_pair(sort_keys, weights)
+        w_cum = exn.np_cumsum(sorted_w)
+        total = w_cum[:, -1:]
+        q_left = (w_cum - sorted_w) / np.maximum(total, _TINY)
+        bucket = np.clip(exn.np_kscale_bucket(q_left, compression),
+                         0, capacity - 1)
+        mw_cum = exn.np_cumsum(
+            np.where(sorted_w > 0, sorted_means * sorted_w, _F0))
+        nxt = np.concatenate(
+            [bucket[:, 1:], np.full((s, 1), -1, np.int32)], axis=-1)
+        is_end = bucket != nxt
+        w_before, mw_before = segments.np_last_marked_carry(
+            is_end, w_cum, mw_cum)
+        seg_w = w_cum - w_before
+        seg_mw = mw_cum - mw_before
+        live = is_end & (seg_w > 0)
+        new_means = np.where(live, seg_mw / np.maximum(seg_w, _TINY), _INF)
+        new_w = np.where(live, seg_w, _F0)
+        new_means, new_w = _stable_sort_pair(new_means, new_w)
+    return new_means[:, :capacity], new_w[:, :capacity]
+
+
+def _np_prefix_scans(srows, svals, sw, n):
+    """Twin of ops/tdigest._prefix_scans_xla."""
+    zero1 = np.zeros((1,), sw.dtype)
+    pre_w = np.concatenate([zero1, exn.np_cumsum(sw)])
+    pre_vw = np.concatenate(
+        [zero1, exn.np_cumsum(exn.np_block(svals * sw))])
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pre_recip = np.concatenate(
+            [zero1, exn.np_cumsum(np.where(sw > 0, sw / svals, _F0))])
+    row_starts = np.concatenate(
+        [np.ones((1,), bool), srows[1:] != srows[:-1]])
+    seg_cum = segments.np_segmented_cumsum(sw, row_starts)
+    row_ends = np.concatenate([row_starts[1:], np.ones((1,), bool)])
+    suffix = segments.np_segmented_cumsum(
+        sw[::-1], row_ends[::-1])[::-1]
+    return pre_w, pre_vw, pre_recip, seg_cum, suffix
+
+
+def np_add_batch(means, weights, dmin, dmax, drecip, rows, values,
+                 sample_weights, compression: float = td.DEFAULT_COMPRESSION):
+    """Twin of ops/tdigest.add_batch (same return contract)."""
+    k, c = means.shape
+    n = rows.shape[0]
+    rows = np.asarray(rows, np.int32)
+    values = np.asarray(values, np.float32)
+    sample_weights = np.asarray(sample_weights, np.float32)
+    live = sample_weights > 0
+    rows = np.where(live, rows, np.int32(k))
+    safe_vals = np.where(live, values, np.float32(1.0))
+
+    # lax.sort((rows, safe_vals, sw), num_keys=2) — lexsort's last key is
+    # primary, and both sorts are stable
+    order = np.lexsort((safe_vals, rows))
+    srows, svals, sw = rows[order], safe_vals[order], sample_weights[order]
+
+    pre_w, pre_vw, pre_recip, seg_cum, suffix = _np_prefix_scans(
+        srows, svals, sw, n)
+
+    kbins = np.arange(k, dtype=np.int32)
+    row_upper = np.searchsorted(srows, kbins, side="right").astype(np.int32)
+    row_lower = np.concatenate(
+        [np.zeros((1,), np.int32), row_upper[:-1]])
+
+    # zero-valued samples put inf in the reciprocal prefix sums; the
+    # inf-inf nan for empty rows is masked by `has` below
+    with np.errstate(invalid="ignore"):
+        seg_w = pre_w[row_upper] - pre_w[row_lower]
+        seg_sum = pre_vw[row_upper] - pre_vw[row_lower]
+        seg_recip = pre_recip[row_upper] - pre_recip[row_lower]
+    has = seg_w > 0
+    seg_min = np.where(has, svals[row_lower], _INF)
+    seg_max = np.where(has, svals[np.maximum(row_upper - 1, 0)], -_INF)
+    stats = td.BatchStats(seg_w, seg_min, seg_max, seg_sum, seg_recip)
+
+    with np.errstate(invalid="ignore", divide="ignore"):
+        row_total = seg_cum + suffix - sw
+        q_left = (seg_cum - sw) / np.maximum(row_total, _TINY)
+    bucket = np.clip(exn.np_kscale_bucket(q_left, compression), 0, c - 1)
+    seg_id = srows * np.int32(c) + bucket
+    starts = np.concatenate(
+        [np.ones((1,), bool), seg_id[1:] != seg_id[:-1]])
+    grank = (np.cumsum(starts.astype(np.int32)) - 1).astype(np.int32)
+    pos = np.where(starts, np.arange(n, dtype=np.int32), np.int32(n))
+    pos_ext = np.concatenate(
+        [np.sort(pos), np.full((1,), n, np.int32)])
+    run_lo = grank[np.clip(row_lower, 0, n - 1)]
+    run_hi = grank[np.maximum(row_upper - 1, 0)] + 1
+    n_runs_row = np.where(has, run_hi - run_lo, 0)
+    j = np.arange(c, dtype=np.int32)
+    runs = np.clip(run_lo[:, None] + j[None, :], 0, n - 1)
+    valid = j[None, :] < n_runs_row[:, None]
+    r_start = pos_ext[runs]
+    last = j[None, :] == (n_runs_row - 1)[:, None]
+    pre = np.stack([pre_w, pre_vw], axis=-1)  # [N+1, 2]
+    at_start = pre[r_start]  # [K, C, 2]
+    at_row_end = pre[row_upper]  # [K, 2]
+    at_next = np.concatenate(
+        [at_start[:, 1:, :], np.zeros((k, 1, 2), at_start.dtype)], axis=1)
+    at_end = np.where(last[:, :, None], at_row_end[:, None, :], at_next)
+    diff = at_end - at_start
+    bd_w = np.where(valid, diff[..., 0], _F0)
+    bd_mw = np.where(valid, diff[..., 1], _F0)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        bd_means = np.where(
+            bd_w > 0, bd_mw / np.maximum(bd_w, _TINY), _INF)
+
+    cat_means = np.concatenate([means, bd_means], axis=-1)
+    cat_w = np.concatenate([weights, bd_w], axis=-1)
+    new_means, new_w = np_compress_rows(cat_means, cat_w, compression, c)
+
+    new_min = np.minimum(dmin, seg_min)
+    new_max = np.maximum(dmax, seg_max)
+    new_recip = drecip + seg_recip
+    return new_means, new_w, new_min, new_max, new_recip, stats
+
+
+def _np_row_bounds(means, weights, dmax):
+    """Twin of ops/tdigest._row_bounds."""
+    s, c = means.shape
+    nonempty = weights > 0
+    count = np.sum(nonempty, axis=-1)
+    idx = np.arange(c)
+    next_means = np.concatenate(
+        [means[:, 1:], np.full((s, 1), _INF, means.dtype)], axis=-1)
+    mid = (means + next_means) / np.float32(2.0)
+    is_last = idx[None, :] == (count - 1)[:, None]
+    ub = np.where(is_last, dmax[:, None], mid)
+    return ub, count
+
+
+# row-chunk size for the [chunk, C, P] comparison in np_quantile: bounds
+# peak memory without changing any arithmetic (comparisons only)
+_Q_CHUNK = 4096
+
+
+def np_quantile(means, weights, dmin, dmax, qs):
+    """Twin of ops/tdigest.quantile (gather form; the mask form is
+    pinned bit-identical to it by test_tdigest)."""
+    s, c = means.shape
+    qs = np.asarray(qs, np.float32)
+    ub, count = _np_row_bounds(means, weights, dmax)
+    w_cum = exn.np_cumsum(weights)
+    total = w_cum[:, -1]
+    lb = np.concatenate([dmin[:, None], ub[:, :-1]], axis=-1)
+
+    with np.errstate(invalid="ignore", divide="ignore"):
+        target = exn.np_block(qs[None, :] * total[:, None])  # [S, P]
+        first_idx = np.empty((s, qs.shape[0]), np.int64)
+        for lo in range(0, s, _Q_CHUNK):
+            hi = min(lo + _Q_CHUNK, s)
+            # searchsorted(cw, t, side="left") == #elements < t; exact
+            first_idx[lo:hi] = np.sum(
+                w_cum[lo:hi, :, None] < target[lo:hi, None, :], axis=1)
+        first_idx = np.minimum(first_idx, c - 1)
+
+        def _at(x):
+            return np.take_along_axis(x, first_idx, axis=1)
+
+        w_at = _at(weights)
+        w_before = _at(w_cum) - w_at
+        lb_at = _at(lb)
+        ub_at = _at(ub)
+        proportion = (target - w_before) / np.maximum(w_at, _TINY)
+        out = lb_at + exn.np_block(proportion * (ub_at - lb_at))
+    return np.where(
+        (total[:, None] > 0) & (count[:, None] > 0), out, _NAN)
+
+
+def np_row_sum(means, weights):
+    """Twin of ops/tdigest.row_sum."""
+    with np.errstate(invalid="ignore"):
+        return exn.np_tsum(np.where(weights > 0, means * weights, _F0))
+
+
+def np_row_count(weights):
+    """Twin of ops/tdigest.row_count."""
+    return exn.np_tsum(weights)
+
+
+# ---------------------------------------------------------------------------
+# HLL (ops/hll.py twins)
+
+
+def np_hll_insert_batch(registers, rows, reg_idx, rank):
+    """Twin of ops/hll.insert_batch. Integer scatter-max is
+    order-independent, so a direct np.maximum.at over in-range entries
+    reproduces the device's sorted run-end scatter bitwise."""
+    registers = np.asarray(registers)
+    s, m = registers.shape
+    rows = np.asarray(rows, np.int64)
+    reg_idx = np.asarray(reg_idx, np.int64)
+    rank = np.asarray(rank, registers.dtype)
+    flat = rows * m + reg_idx
+    ok = (flat >= 0) & (flat < s * m)  # mode="drop"
+    out = registers.reshape(-1).copy()
+    np.maximum.at(out, flat[ok], rank[ok])
+    return out.reshape(s, m)
+
+
+def np_hll_merge(a, b):
+    """Twin of ops/hll.merge."""
+    return np.maximum(a, b)
+
+
+def np_hll_estimate_exact(registers, precision: int = hll_ops.
+                          DEFAULT_PRECISION):
+    """Bitwise twin of ops/hll.estimate (the f64 tolerance reference for
+    the fuzzer lives in ops/query.np_hll_estimate; this one must agree
+    with the device kernel to the bit)."""
+    registers = np.asarray(registers)
+    m = float(1 << precision)
+    ranks = registers.astype(np.int32)
+    ept = exn.exp2_neg_table()
+    inv_sum = exn.np_tsum(ept[ranks])
+    zeros = np.sum(registers == 0, axis=-1).astype(np.int32)
+    raw = exn.hll_alpha_m2(precision) / inv_sum
+    linear = exn.hll_linear_table(precision)[zeros]
+    use_linear = (raw <= np.float32(2.5 * m)) & (zeros > 0)
+    return np.where(use_linear, linear, raw)
+
+
+# ---------------------------------------------------------------------------
+# Worker jitted-step twins (core/worker.py)
+
+
+def np_comp_add(s, c, x):
+    """Twin of core/worker._comp_add (Neumaier compensated add)."""
+    t = s + x
+    with np.errstate(invalid="ignore"):
+        resid = np.where(np.abs(s) >= np.abs(x), (s - t) + x, (x - t) + s)
+        resid = np.where(np.isfinite(t), resid, _F0)
+    return t, c + resid
+
+
+def np_unit_wts_plane(counts, depth: int):
+    """Twin of core/worker._unit_wts_plane."""
+    return (np.arange(depth, dtype=np.int32)[None, :]
+            < np.asarray(counts)[:, None]).astype(np.float32)
+
+
+def np_expand_flat_planes(flat_v, flat_w, counts, depth: int, unit: bool):
+    """Twin of core/worker._expand_flat_planes."""
+    flat_v = np.asarray(flat_v, np.float32)
+    counts = np.asarray(counts, np.int32)
+    b = np.arange(depth, dtype=np.int32)[None, :]
+    offsets = np.concatenate(
+        [np.zeros((1,), np.int32),
+         np.cumsum(counts, dtype=np.int32)[:-1]])
+    idx = np.clip(offsets[:, None] + b, 0, flat_v.shape[0] - 1)
+    valid = b < counts[:, None]
+    sv = np.where(valid, flat_v[idx], _F0)
+    if unit:
+        sw = valid.astype(np.float32)
+    else:
+        sw = np.where(valid, np.asarray(flat_w, np.float32)[idx], _F0)
+    return sv, sw
+
+
+def np_fold_staged(means, weights, dmin, dmax, drecip, drecip_c,
+                   lmin, lmax, lsum, lsum_c, lweight, lweight_c,
+                   lrecip, lrecip_c, svals, swts,
+                   compression: float = td.DEFAULT_COMPRESSION):
+    """Twin of core/worker._histo_fold_staged."""
+    c = means.shape[1]
+    svals = np.asarray(svals, np.float32)
+    swts = np.asarray(swts, np.float32)
+    live = swts > 0
+    with np.errstate(invalid="ignore", divide="ignore"):
+        s_w = exn.np_tsum(swts)
+        s_sum = exn.np_tsum(np.where(live, svals * swts, _F0))
+        s_recip = exn.np_tsum(np.where(live, swts / svals, _F0))
+        s_min = np.min(np.where(live, svals, _INF), axis=-1)
+        s_max = np.max(np.where(live, svals, -_INF), axis=-1)
+
+    cat_means = np.concatenate([means, svals], axis=-1)
+    cat_w = np.concatenate([weights, swts], axis=-1)
+    means, weights = np_compress_rows(cat_means, cat_w, compression, c)
+
+    dmin = np.minimum(dmin, s_min)
+    dmax = np.maximum(dmax, s_max)
+    drecip, drecip_c = np_comp_add(drecip, drecip_c, s_recip)
+    lmin = np.minimum(lmin, s_min)
+    lmax = np.maximum(lmax, s_max)
+    lsum, lsum_c = np_comp_add(lsum, lsum_c, s_sum)
+    lweight, lweight_c = np_comp_add(lweight, lweight_c, s_w)
+    lrecip, lrecip_c = np_comp_add(lrecip, lrecip_c, s_recip)
+    return (means, weights, dmin, dmax, drecip, drecip_c,
+            lmin, lmax, lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c)
+
+
+def np_ingest_step(means, weights, dmin, dmax, drecip, drecip_c,
+                   lmin, lmax, lsum, lsum_c, lweight, lweight_c,
+                   lrecip, lrecip_c, active, lids, values, wts,
+                   compression: float = td.DEFAULT_COMPRESSION):
+    """Twin of core/worker._histo_ingest_step. `active` may contain
+    duplicates (scratch-row padding); every duplicate writes an
+    identical value (gather→compute→scatter of the same inputs), so
+    plain fancy-index assignment matches the device scatter, and the
+    accumulate-min/max scatters use ufunc.at."""
+    active = np.asarray(active, np.int64)
+    g_means = means[active]
+    g_w = weights[active]
+    g_min = dmin[active]
+    g_max = dmax[active]
+    g_recip = drecip[active]
+
+    n_means, n_w, n_min, n_max, _, stats = np_add_batch(
+        g_means, g_w, g_min, g_max, g_recip, lids, values, wts,
+        compression=compression)
+
+    means = means.copy()
+    weights = weights.copy()
+    dmin, dmax = dmin.copy(), dmax.copy()
+    drecip, drecip_c = drecip.copy(), drecip_c.copy()
+    lmin, lmax = lmin.copy(), lmax.copy()
+    lsum, lsum_c = lsum.copy(), lsum_c.copy()
+    lweight, lweight_c = lweight.copy(), lweight_c.copy()
+    lrecip, lrecip_c = lrecip.copy(), lrecip_c.copy()
+
+    means[active] = n_means
+    weights[active] = n_w
+    dmin[active] = n_min
+    dmax[active] = n_max
+    n_recip, n_recip_c = np_comp_add(g_recip, drecip_c[active], stats.recip)
+    drecip[active] = n_recip
+    drecip_c[active] = n_recip_c
+
+    np.minimum.at(lmin, active, stats.min)
+    np.maximum.at(lmax, active, stats.max)
+    n_lsum, n_lsum_c = np_comp_add(lsum[active], lsum_c[active], stats.sum)
+    lsum[active] = n_lsum
+    lsum_c[active] = n_lsum_c
+    n_lw, n_lw_c = np_comp_add(lweight[active], lweight_c[active],
+                               stats.weight)
+    lweight[active] = n_lw
+    lweight_c[active] = n_lw_c
+    n_lr, n_lr_c = np_comp_add(lrecip[active], lrecip_c[active], stats.recip)
+    lrecip[active] = n_lr
+    lrecip_c[active] = n_lr_c
+    return (means, weights, dmin, dmax, drecip, drecip_c,
+            lmin, lmax, lsum, lsum_c, lweight, lweight_c, lrecip, lrecip_c)
+
+
+def np_import_step(means, weights, dmin, dmax, drecip, drecip_c,
+                   rows, imp_means, imp_w, imp_min, imp_max, imp_recip,
+                   compression: float = td.DEFAULT_COMPRESSION):
+    """Twin of core/worker._histo_import_step."""
+    c = means.shape[1]
+    rows = np.asarray(rows, np.int64)
+    g_means = means[rows]
+    g_w = weights[rows]
+    cat_means = np.concatenate(
+        [g_means, np.asarray(imp_means, np.float32)], axis=-1)
+    cat_w = np.concatenate([g_w, np.asarray(imp_w, np.float32)], axis=-1)
+    n_means, n_w = np_compress_rows(cat_means, cat_w, compression, c)
+    means = means.copy()
+    weights = weights.copy()
+    dmin, dmax = dmin.copy(), dmax.copy()
+    drecip, drecip_c = drecip.copy(), drecip_c.copy()
+    means[rows] = n_means
+    weights[rows] = n_w
+    np.minimum.at(dmin, rows, np.asarray(imp_min, np.float32))
+    np.maximum.at(dmax, rows, np.asarray(imp_max, np.float32))
+    n_recip, n_recip_c = np_comp_add(
+        drecip[rows], drecip_c[rows], np.asarray(imp_recip, np.float32))
+    drecip[rows] = n_recip
+    drecip_c[rows] = n_recip_c
+    return means, weights, dmin, dmax, drecip, drecip_c
+
+
+def np_flush_extract(means, weights, dmin, dmax, drecip, drecip_c,
+                     lmin, lmax, lsum, lsum_c, lweight, lweight_c,
+                     lrecip, lrecip_c, qs):
+    """Twin of core/worker._histo_flush_extract."""
+    quantiles = np_quantile(means, weights, dmin, dmax, qs)
+    dsum = np_row_sum(means, weights)
+    dcount = np_row_count(weights)
+    return (quantiles, dmin, dmax, dsum, dcount, drecip + drecip_c,
+            lmin, lmax, lsum + lsum_c, lweight + lweight_c,
+            lrecip + lrecip_c)
+
+
+def np_pack_extract_columns(qv, *cols):
+    """Twin of core/worker._pack_extract_columns."""
+    return np.concatenate(
+        [np.asarray(qv, np.float32)]
+        + [np.asarray(col)[:, None].astype(np.float32) for col in cols],
+        axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Host histogram pool state
+
+
+@dataclass
+class HostHistoState:
+    """NumPy mirror of core/worker.HistoDeviceState — same 14 fields in
+    the same kernel argument order, so a quarantined worker swaps one
+    state class for the other and every call site that only touches
+    `.fields()` / `.num_rows` keeps working."""
+
+    means: np.ndarray
+    weights: np.ndarray
+    dmin: np.ndarray
+    dmax: np.ndarray
+    drecip: np.ndarray
+    drecip_c: np.ndarray
+    lmin: np.ndarray
+    lmax: np.ndarray
+    lsum: np.ndarray
+    lsum_c: np.ndarray
+    lweight: np.ndarray
+    lweight_c: np.ndarray
+    lrecip: np.ndarray
+    lrecip_c: np.ndarray
+
+    @classmethod
+    def create(cls, rows: int, capacity: int) -> "HostHistoState":
+        def _full(v):
+            return np.full((rows,), v, np.float32)
+
+        return cls(
+            means=np.full((rows, capacity), _INF, np.float32),
+            weights=np.zeros((rows, capacity), np.float32),
+            dmin=_full(np.inf), dmax=_full(-np.inf), drecip=_full(0.0),
+            drecip_c=_full(0.0), lmin=_full(np.inf), lmax=_full(-np.inf),
+            lsum=_full(0.0), lsum_c=_full(0.0), lweight=_full(0.0),
+            lweight_c=_full(0.0), lrecip=_full(0.0), lrecip_c=_full(0.0),
+        )
+
+    @classmethod
+    def from_fields(cls, fields, perm=None) -> "HostHistoState":
+        """Snapshot device fields to host (the failover d2h). `perm` is
+        the physical→logical row permutation for series-sharded pools
+        (ops/series_shard.perm_l2p output); the host engine always works
+        in logical row order."""
+        host = []
+        for f in fields:
+            a = np.asarray(f)
+            if perm is not None:
+                a = a[perm]
+            host.append(np.array(a, copy=True))
+        return cls(*host)
+
+    @property
+    def num_rows(self) -> int:
+        return self.means.shape[0]
+
+    def fields(self) -> tuple:
+        return (self.means, self.weights, self.dmin, self.dmax,
+                self.drecip, self.drecip_c, self.lmin, self.lmax,
+                self.lsum, self.lsum_c, self.lweight, self.lweight_c,
+                self.lrecip, self.lrecip_c)
+
+    def grow(self, new_rows: int) -> "HostHistoState":
+        def g2(old):
+            s, c = old.shape
+            out = np.zeros((new_rows, c), old.dtype)
+            out[:s] = old
+            return out
+
+        def g1(old, fill):
+            out = np.full((new_rows,), fill, old.dtype)
+            out[:old.shape[0]] = old
+            return out
+
+        inf = np.float32(np.inf)
+        return HostHistoState(
+            means=g2(self.means), weights=g2(self.weights),
+            dmin=g1(self.dmin, inf), dmax=g1(self.dmax, -inf),
+            drecip=g1(self.drecip, 0.0), drecip_c=g1(self.drecip_c, 0.0),
+            lmin=g1(self.lmin, inf), lmax=g1(self.lmax, -inf),
+            lsum=g1(self.lsum, 0.0), lsum_c=g1(self.lsum_c, 0.0),
+            lweight=g1(self.lweight, 0.0), lweight_c=g1(self.lweight_c, 0.0),
+            lrecip=g1(self.lrecip, 0.0), lrecip_c=g1(self.lrecip_c, 0.0),
+        )

@@ -107,10 +107,18 @@ class MicroFoldMirror:
 
     def __init__(self, depth: int, ledger=None,
                  initial_rows: int = 1024,
-                 chunk: int = MICRO_CHUNK, shard=None) -> None:
+                 chunk: int = MICRO_CHUNK, shard=None,
+                 guard=None) -> None:
         self.depth = int(depth)
         self.chunk = int(chunk)
         self._ledger = ledger
+        # device guard (ops/device_guard.DeviceGuard): the scatter is the
+        # mirror's one donating device dispatch, so it routes through the
+        # guard's fault seam. A fault here surfaces as DeviceFaultError
+        # to the caller (worker.micro_fold_once), which drops the mirror
+        # and falls back to the retained staging plane — the mirror is a
+        # CACHE of staged state, never the only copy.
+        self._guard = guard
         # series-sharded mirror (ops/series_shard.SeriesSharding): the
         # carry buffers keep LOGICAL rows — translation to physical slots
         # happens at dispatch, against the mirror size current THEN, so
@@ -234,8 +242,14 @@ class MicroFoldMirror:
             jax.block_until_ready(self._dvals)
             self._unsynced = 1
         scatter = _scatter_chunk if sh is None else sh.scatter_chunk
-        self._dvals, self._dwts = scatter(
-            self._dvals, self._dwts, drows, dslots, dvals, dwts)
+        if self._guard is not None:
+            # donated operands — never retryable
+            self._dvals, self._dwts = self._guard.call(
+                "micro", scatter,
+                self._dvals, self._dwts, drows, dslots, dvals, dwts)
+        else:
+            self._dvals, self._dwts = scatter(
+                self._dvals, self._dwts, drows, dslots, dvals, dwts)
         self.chunks += 1
 
     def _ensure_rows(self, needed: int) -> None:

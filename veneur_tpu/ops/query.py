@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veneur_tpu.ops import exactnum as exn
 from veneur_tpu.ops import tdigest as td
 
 # smallest padded quantile-vector shape: dashboards ask for 1-3 points;
@@ -88,8 +89,8 @@ def scalar_rows(dmin: jax.Array, dmax: jax.Array, drecip: jax.Array,
     w = weights[rows]
     m = means[rows]
     return (dmin[rows], dmax[rows],
-            jnp.sum(jnp.where(w > 0, m * w, 0.0), axis=-1),
-            jnp.sum(w, axis=-1),
+            exn.tsum(jnp.where(w > 0, m * w, 0.0)),
+            exn.tsum(w),
             drecip[rows] + drecip_c[rows])
 
 

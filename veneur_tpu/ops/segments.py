@@ -5,12 +5,21 @@ XLA lowers `segment_sum`/`segment_min` on TPU to scatters and large
 VPU peak (measured ~9ns/element on v5e). These primitives keep segmented
 reductions in cumsum/select territory instead:
 
-* `segmented_cumsum` — chunked Hillis-Steele scan with an affine
+* `segmented_cumsum` — chunked Hillis-Steele scan with a segmented
   cross-chunk carry stitch; no scatter, no per-segment loop.
 * `last_marked_carry` — exclusive "value at the last marked position"
   scan, the building block that turns per-run sums into differences of
   prefix sums at run boundaries (ops/tdigest.py uses it for t-digest
   bucket accumulation).
+
+Every float add here happens in a fixed, explicitly-coded order (the
+doubling-shift loops), and each primitive has a NumPy twin running the
+IDENTICAL loop — the bit-parity contract the host fallback engine
+(ops/host_engine.py) is built on; see ops/exactnum.py for why. The
+earlier cross-chunk stitch used `lax.associative_scan` over affine
+maps, whose recursive association XLA owns and NumPy cannot mirror; the
+carry is itself just a segmented scan over chunk totals, so it now runs
+the same Hillis loop at the chunk level.
 
 Used by the t-digest batch ingest (ops/tdigest.py); the reference's
 equivalent inner loop is the per-centroid Go walk in
@@ -21,6 +30,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 CHUNK = 128  # one TPU lane tile
 
@@ -34,17 +44,13 @@ def _pad_to_chunks(x: jax.Array, fill) -> jax.Array:
     return x.reshape(-1, CHUNK)
 
 
-def _affine_carry(a: jax.Array, *bs: jax.Array) -> tuple[jax.Array, ...]:
-    """Solve open[g] = a[g]*open[g-1] + b[g] for each payload b via an
-    associative scan of affine maps; returns each open[] (inclusive)."""
-
-    def combine(x, y):
-        ax, *bx = x
-        ay, *by = y
-        return (ax * ay, *[bxi * ay + byi for bxi, byi in zip(bx, by)])
-
-    out = jax.lax.associative_scan(combine, (a, *bs))
-    return out[1:]
+def _np_pad_to_chunks(x: np.ndarray, fill) -> np.ndarray:
+    n = x.shape[0]
+    pad = (-n) % CHUNK
+    if pad:
+        x = np.concatenate(
+            [x, np.full((pad,), fill, dtype=x.dtype)])
+    return x.reshape(-1, CHUNK)
 
 
 def _shift_right(x: jax.Array, fill) -> jax.Array:
@@ -76,17 +82,65 @@ def segmented_cumsum(values: jax.Array, starts: jax.Array) -> jax.Array:
         f = f | fs
         shift *= 2
 
-    # Cross-chunk carry: open[g] = a*open[g-1] + last, a = "no real start
-    # in chunk" (the chunk's whole run continues through it).
-    no_start = ~jnp.any(s2, axis=1)
-    (open_w,) = _affine_carry(
-        no_start.astype(values.dtype), v[:, -1])
-    carry_in = _shift_right(open_w, jnp.zeros((), values.dtype))
+    # Cross-chunk carry: the open-run total entering chunk g is itself a
+    # segmented inclusive cumsum of the chunks' last-column values,
+    # restarting at any chunk that contains a real start — the SAME
+    # Hillis loop as above, run once at the chunk level.
+    has_start = jnp.any(s2, axis=1)
+    cv = v[:, -1]
+    cf = has_start.at[0].set(True)
+    shift = 1
+    while shift < g:
+        cvs = jnp.pad(cv, (shift, 0))[:g]
+        cfs = jnp.pad(cf, (shift, 0), constant_values=True)[:g]
+        cv = jnp.where(cf, cv, cv + cvs)
+        cf = cf | cfs
+        shift *= 2
+    carry_in = _shift_right(cv, jnp.zeros((), values.dtype))
     # carry applies to the head run only: elements before the first real
-    # start of the chunk.
+    # start of the chunk. (Select, not multiply-by-mask: the add order
+    # stays pinned and nothing invites contraction.)
     before_first = jnp.cumsum(s2.astype(jnp.int32), axis=1) == 0
-    out = v + carry_in[:, None] * before_first.astype(values.dtype)
+    out = jnp.where(before_first, v + carry_in[:, None], v)
     return out.reshape(-1)[:n]
+
+
+def np_segmented_cumsum(values: np.ndarray, starts: np.ndarray) -> np.ndarray:
+    """NumPy twin of `segmented_cumsum`: the identical shift loops, so
+    the result is bitwise equal to the device kernel's."""
+    values = np.asarray(values)
+    n = values.shape[0]
+    v = _np_pad_to_chunks(values, values.dtype.type(0))
+    s2 = _np_pad_to_chunks(np.asarray(starts, bool), False).copy()
+    s2[0, 0] = True
+    g, l = v.shape
+
+    f = s2.copy()
+    f[:, 0] = True
+    shift = 1
+    while shift < l:
+        vs = np.pad(v, ((0, 0), (shift, 0)))[:, :l]
+        fs = np.pad(f, ((0, 0), (shift, 0)), constant_values=True)[:, :l]
+        v = np.where(f, v, v + vs)
+        f = f | fs
+        shift *= 2
+
+    has_start = np.any(s2, axis=1)
+    cv = v[:, -1]
+    cf = has_start.copy()
+    cf[0] = True
+    shift = 1
+    while shift < g:
+        cvs = np.pad(cv, (shift, 0))[:g]
+        cfs = np.pad(cf, (shift, 0), constant_values=True)[:g]
+        cv = np.where(cf, cv, cv + cvs)
+        cf = cf | cfs
+        shift *= 2
+    carry_in = np.concatenate(
+        [np.zeros((1,), values.dtype), cv[:-1]])
+    before_first = np.cumsum(s2.astype(np.int32), axis=1) == 0
+    out = np.where(before_first, v + carry_in[:, None], v)
+    return out.reshape(-1)[:n].astype(values.dtype)
 
 
 def last_marked_carry(mask: jax.Array, *values: jax.Array
@@ -117,6 +171,30 @@ def last_marked_carry(mask: jax.Array, *values: jax.Array
         # invariant: (m, vs) at i reflect the last mark in (i-2^k, i]
         m_s = shift_right(m, shift)
         vs = [jnp.where(m, v, shift_right(v, shift, 0))
+              for v in vs]
+        m = m | m_s
+        shift *= 2
+    return tuple(vs)
+
+
+def np_last_marked_carry(mask: np.ndarray, *values: np.ndarray
+                         ) -> tuple[np.ndarray, ...]:
+    """NumPy twin of `last_marked_carry` (selects and shifts only, in
+    the identical order — bitwise equal by construction)."""
+    mask = np.asarray(mask, bool)
+    pad = [(0, 0)] * (mask.ndim - 1) + [(1, 0)]
+    m = np.pad(mask, pad)[..., :-1]
+    vs = [np.pad(np.asarray(v), pad)[..., :-1] for v in values]
+    n = m.shape[-1]
+
+    def shift_right(x, k, fill=False):
+        p = [(0, 0)] * (x.ndim - 1) + [(k, 0)]
+        return np.pad(x, p, constant_values=fill)[..., :n]
+
+    shift = 1
+    while shift < n:
+        m_s = shift_right(m, shift)
+        vs = [np.where(m, v, shift_right(v, shift, 0))
               for v in vs]
         m = m | m_s
         shift *= 2

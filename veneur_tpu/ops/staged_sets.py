@@ -36,17 +36,29 @@ import jax.numpy as jnp
 import numpy as np
 
 from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import host_engine as he
+from veneur_tpu.ops.device_guard import DeviceFaultError
 
 
 class StagedSetStore:
     """Per-epoch set-sketch state for one worker (staged representation).
 
     All rows are identified by the worker directory's set-row index.
+
+    Device fault domain (ops/device_guard): every dense-tier device op
+    routes through the worker's guard under op "sets". Register updates
+    are max-merges — idempotent and order-independent — so the failover
+    story is the simplest in the system: on a classified device fault
+    the dense tier converts to host numpy registers (``to_host``) and
+    the faulted update re-applies there; a partially-applied device
+    update before the fault can only have asserted ranks the host redo
+    asserts again. ``to_device`` re-uploads at probe re-admission.
     """
 
     def __init__(self, precision: int = hll_ops.DEFAULT_PRECISION,
                  promote_entries: Optional[int] = None,
-                 compact_every: int = 1 << 16, shard=None) -> None:
+                 compact_every: int = 1 << 16, shard=None,
+                 guard=None, host: bool = False) -> None:
         self.precision = precision
         # series-sharded dense tier (ops/series_shard.SeriesSharding):
         # the [slots, m] register plane partitions over the shard mesh
@@ -68,11 +80,57 @@ class StagedSetStore:
         self._slot_of_row: dict[int, int] = {}
         # vectorized row→slot lookup (-1 = sparse); grows with max row
         self._slot_lut = np.full(64, -1, np.int32)
-        self._dense = None  # jax int8 [slots, m]
+        self._guard = guard
+        # host mode: _dense is np int8 [slots, m] in LOGICAL slot order
+        # (quarantined worker, or failover after a dense-tier fault)
+        self._host = bool(host)
+        self._dense = None  # jax int8 [slots, m] (np int8 in host mode)
         # imported full-register rows max-merge host-side and batch onto
         # the device once per flush (a per-import device update would
         # copy the whole dense pool each call)
         self._imp_dense: dict[int, np.ndarray] = {}
+
+    # -- device fault domain ------------------------------------------------
+
+    @property
+    def host_mode(self) -> bool:
+        return self._host
+
+    def _dev_call(self, fn, *args, retryable: bool = False):
+        """One dense-tier device op through the worker's guard. The
+        sharded register programs donate the plane (retryable=False);
+        the unsharded inserts and all estimates do not."""
+        if self._guard is None:
+            return fn(*args)
+        return self._guard.call("sets", fn, *args, retryable=retryable)
+
+    def to_host(self) -> None:
+        """Fail the dense tier over to host numpy registers (logical
+        slot order). Safe after a partially-applied faulted update:
+        max-merges re-applied host-side only re-assert existing ranks."""
+        if self._host:
+            return
+        self._host = True
+        if self._dense is None:
+            return
+        d = np.asarray(self._dense)
+        if self._shard is not None:
+            d = d[self._shard.perm_l2p(d.shape[0])]
+        self._dense = d
+
+    def to_device(self) -> None:
+        """Re-admit the dense tier to the device (probe succeeded)."""
+        if not self._host:
+            return
+        self._host = False
+        if self._dense is None:
+            return
+        d = self._dense
+        if self._shard is not None:
+            self._dense = self._shard.place(
+                jnp.asarray(d[self._shard.perm_p2l(d.shape[0])]))
+        else:
+            self._dense = jnp.asarray(d)
 
     # -- ingest -------------------------------------------------------------
 
@@ -120,34 +178,55 @@ class StagedSetStore:
         stacked = np.stack([self._imp_dense[r] for r in rows])
         self._imp_dense = {}
         assert self._dense is not None
+        if self._host:
+            np.maximum.at(self._dense, slots.astype(np.int64), stacked)
+            return
         sh = self._shard
-        if sh is not None:
-            self._dense = sh.hll_max_rows(
-                self._dense,
-                sh.replicate(sh.phys_rows(slots, self._dense.shape[0])),
-                sh.replicate(stacked))
-        else:
-            self._dense = self._dense.at[jnp.asarray(slots)].max(
-                jnp.asarray(stacked))
+        try:
+            if sh is not None:
+                self._dense = self._dev_call(
+                    sh.hll_max_rows, self._dense,
+                    sh.replicate(sh.phys_rows(slots, self._dense.shape[0])),
+                    sh.replicate(stacked))
+            else:
+                self._dense = self._dev_call(
+                    lambda d, s, v: d.at[s].max(v), self._dense,
+                    jnp.asarray(slots), jnp.asarray(stacked),
+                    retryable=True)
+        except DeviceFaultError:
+            self.to_host()
+            np.maximum.at(self._dense, slots.astype(np.int64), stacked)
 
     # -- internals ----------------------------------------------------------
 
     def _dense_insert(self, slots: np.ndarray, idx: np.ndarray,
                       rank: np.ndarray) -> None:
         assert self._dense is not None
-        sh = self._shard
-        if sh is not None:
-            self._dense = sh.hll_insert(
-                self._dense,
-                sh.replicate(sh.phys_rows(slots.astype(np.int32),
-                                          self._dense.shape[0])),
-                sh.replicate(idx.astype(np.int32)),
-                sh.replicate(rank.astype(np.int8)))
+        if self._host:
+            self._dense = he.np_hll_insert_batch(
+                self._dense, slots.astype(np.int64), idx.astype(np.int64),
+                rank.astype(np.int8))
             return
-        self._dense = hll_ops.insert_batch(
-            self._dense, jnp.asarray(slots.astype(np.int32)),
-            jnp.asarray(idx.astype(np.int32)),
-            jnp.asarray(rank.astype(np.int8)))
+        sh = self._shard
+        try:
+            if sh is not None:
+                self._dense = self._dev_call(
+                    sh.hll_insert, self._dense,
+                    sh.replicate(sh.phys_rows(slots.astype(np.int32),
+                                              self._dense.shape[0])),
+                    sh.replicate(idx.astype(np.int32)),
+                    sh.replicate(rank.astype(np.int8)))
+            else:
+                self._dense = self._dev_call(
+                    hll_ops.insert_batch,
+                    self._dense, jnp.asarray(slots.astype(np.int32)),
+                    jnp.asarray(idx.astype(np.int32)),
+                    jnp.asarray(rank.astype(np.int8)), retryable=True)
+        except DeviceFaultError:
+            self.to_host()
+            self._dense = he.np_hll_insert_batch(
+                self._dense, slots.astype(np.int64), idx.astype(np.int64),
+                rank.astype(np.int8))
 
     def _compact(self) -> None:
         self._compact_no_promote()
@@ -176,7 +255,12 @@ class StagedSetStore:
         if self._dense is None or slot >= self._dense.shape[0]:
             grown = max(16, (slot + 1) * 2)
             sh = self._shard
-            if sh is not None:
+            if self._host:
+                fresh = np.zeros((grown, self.m), np.int8)
+                if self._dense is not None:
+                    fresh[:self._dense.shape[0]] = self._dense
+                self._dense = fresh
+            elif sh is not None:
                 # pow2 multiple of the shard count so the slot-axis
                 # interleave stays divisible; per-shard local pad keeps
                 # every promoted slot on its shard across growth
@@ -184,16 +268,34 @@ class StagedSetStore:
                 while g < grown:
                     g *= 2
                 grown = g
-                if self._dense is None:
-                    self._dense = sh.place(
-                        jnp.zeros((grown, self.m), jnp.int8))
-                else:
-                    self._dense = sh.grow_2d(self._dense, grown)
+                try:
+                    if self._dense is None:
+                        self._dense = self._dev_call(
+                            sh.place, jnp.zeros((grown, self.m), jnp.int8))
+                    else:
+                        self._dense = self._dev_call(
+                            sh.grow_2d, self._dense, grown)
+                except DeviceFaultError:
+                    self.to_host()
+                    fresh = np.zeros((grown, self.m), np.int8)
+                    if self._dense is not None:
+                        fresh[:self._dense.shape[0]] = self._dense
+                    self._dense = fresh
             else:
-                fresh = jnp.zeros((grown, self.m), jnp.int8)
-                if self._dense is not None:
-                    fresh = fresh.at[:self._dense.shape[0]].set(self._dense)
-                self._dense = fresh
+                try:
+                    def _grow(old, n):
+                        fresh = jnp.zeros((n, self.m), jnp.int8)
+                        return (fresh if old is None
+                                else fresh.at[:old.shape[0]].set(old))
+
+                    self._dense = self._dev_call(
+                        _grow, self._dense, grown, retryable=True)
+                except DeviceFaultError:
+                    self.to_host()
+                    fresh = np.zeros((grown, self.m), np.int8)
+                    if self._dense is not None:
+                        fresh[:self._dense.shape[0]] = self._dense
+                    self._dense = fresh
         mask = (self._ckeys // self.m) == row
         if mask.any():
             idx = (self._ckeys[mask] % self.m).astype(np.int32)
@@ -256,14 +358,26 @@ class StagedSetStore:
             else:
                 out[r] = raw
         if self._slot_of_row and self._dense is not None:
-            if self._shard is not None:
-                sh = self._shard
-                dense_est = np.asarray(sh.hll_estimate(
-                    self._dense, self.precision
-                ))[sh.perm_l2p(self._dense.shape[0])]
-            else:
-                dense_est = np.asarray(hll_ops.estimate(
-                    self._dense, self.precision))
+            dense_est = None
+            if not self._host:
+                try:
+                    if self._shard is not None:
+                        sh = self._shard
+                        dense_est = np.asarray(self._dev_call(
+                            sh.hll_estimate, self._dense, self.precision,
+                            retryable=True
+                        ))[sh.perm_l2p(self._dense.shape[0])]
+                    else:
+                        dense_est = np.asarray(self._dev_call(
+                            hll_ops.estimate, self._dense, self.precision,
+                            retryable=True))
+                except DeviceFaultError:
+                    self.to_host()
+            if dense_est is None:
+                # host mode: the bitwise f32 twin of the device
+                # estimator (ops/host_engine parity contract)
+                dense_est = he.np_hll_estimate_exact(
+                    self._dense, self.precision)
             for r, s in self._slot_of_row.items():
                 if r < num_rows:
                     out[r] = dense_est[s]
@@ -281,10 +395,13 @@ class StagedSetStore:
         mask = rows < num_rows
         out[rows[mask], idx[mask]] = self._crank[mask]
         if self._slot_of_row and self._dense is not None:
-            dense_np = np.asarray(self._dense)
-            if self._shard is not None:
-                dense_np = dense_np[
-                    self._shard.perm_l2p(self._dense.shape[0])]
+            if self._host:
+                dense_np = self._dense
+            else:
+                dense_np = np.asarray(self._dense)
+                if self._shard is not None:
+                    dense_np = dense_np[
+                        self._shard.perm_l2p(self._dense.shape[0])]
             for r, s in self._slot_of_row.items():
                 if r < num_rows:
                     out[r] = dense_np[s]

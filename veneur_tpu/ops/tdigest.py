@@ -45,12 +45,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veneur_tpu.ops import exactnum as exn
 from veneur_tpu.ops import segments
 
 
 def _prefix_scans_xla(srows, svals, sw, n):
     """The XLA scan stack: three prefix sums + forward/backward
     segmented sums (see add_batch for what each feeds).
+
+    All float scans run order-pinned (ops/exactnum.py Hillis-Steele,
+    product rounded via exn.block before the adds) so the host fallback
+    engine's NumPy twin reproduces them bitwise.
 
     RESOLVED (round 4): a fused two-pass Pallas kernel for these five
     scans (ops/pallas_scan.py, gated behind VENEUR_FUSED_SCANS) was
@@ -62,10 +67,10 @@ def _prefix_scans_xla(srows, svals, sw, n):
     custom kernel to pay for itself. The Pallas kernel that remains on a
     hot path is flush_extract (ops/pallas_kernels.py)."""
     zero1 = jnp.zeros((1,), sw.dtype)
-    pre_w = jnp.concatenate([zero1, jnp.cumsum(sw)])  # [N+1]
-    pre_vw = jnp.concatenate([zero1, jnp.cumsum(svals * sw)])
+    pre_w = jnp.concatenate([zero1, exn.cumsum(sw)])  # [N+1]
+    pre_vw = jnp.concatenate([zero1, exn.cumsum(exn.block(svals * sw))])
     pre_recip = jnp.concatenate(
-        [zero1, jnp.cumsum(jnp.where(sw > 0, sw / svals, 0.0))])
+        [zero1, exn.cumsum(jnp.where(sw > 0, sw / svals, 0.0))])
     row_starts = jnp.concatenate(
         [jnp.ones((1,), bool), srows[1:] != srows[:-1]])
     seg_cum = segments.segmented_cumsum(sw, row_starts)
@@ -123,12 +128,15 @@ def init_pool(num_rows: int, capacity: int = DEFAULT_CAPACITY) -> TDigestPool:
     )
 
 
-def _k_scale(q: jax.Array, compression: float) -> jax.Array:
-    """The t-digest k1 scale function δ·(asin(2q−1)/π + ½)
-    (reference tdigest/merging_digest.go:259-262)."""
-    # clamp: float error can push 2q-1 a hair outside [-1, 1]
-    x = jnp.clip(2.0 * q - 1.0, -1.0, 1.0)
-    return compression * (jnp.arcsin(x) / jnp.pi + 0.5)
+def _k_bucket(q: jax.Array, compression: float, capacity: int) -> jax.Array:
+    """floor of the t-digest k1 scale function δ·(asin(2q−1)/π + ½)
+    (reference tdigest/merging_digest.go:259-262), clipped to the row
+    capacity. Table form (exactnum.kscale_bucket): the arcsin is
+    inverted once on the host into the δ bucket-boundary quantiles and
+    the device does a comparison-exact searchsorted — bitwise
+    reproducible by the host engine's NumPy twin, and cheaper than a
+    transcendental on every element."""
+    return jnp.clip(exn.kscale_bucket(q, compression), 0, capacity - 1)
 
 
 def _compress_rows(
@@ -152,15 +160,15 @@ def _compress_rows(
     # same recompute heuristic exists on TPU).
     sorted_means, sorted_w = jax.lax.optimization_barrier(
         (sorted_means, sorted_w))
-    # 2. Per-row cumulative weight and left-edge quantile.
-    w_cum = jnp.cumsum(sorted_w, axis=-1)
+    # 2. Per-row cumulative weight and left-edge quantile. (Order-pinned
+    #    Hillis scan — the host engine twin mirrors it bitwise.)
+    w_cum = exn.cumsum(sorted_w)
     total = w_cum[:, -1:]
     q_left = (w_cum - sorted_w) / jnp.maximum(total, 1e-30)
     # 3. Quantize to k-function buckets. (Zero-weight padding slots land in
     #    whatever bucket q=1 maps to; they only ever extend a run with zero
     #    weight, so the sums below are unaffected.)
-    bucket = jnp.floor(_k_scale(q_left, compression)).astype(jnp.int32)
-    bucket = jnp.clip(bucket, 0, capacity - 1)
+    bucket = _k_bucket(q_left, compression, capacity)
     w_cum, bucket = jax.lax.optimization_barrier((w_cum, bucket))
     # 4. Bucket accumulation, scatter- AND broadcast-free: buckets are
     #    non-decreasing along a sorted row, so each bucket is one
@@ -169,8 +177,8 @@ def _compress_rows(
     #    so results stay where the run ends and a sort compacts them.
     #    (The previous [S, M, C] compare+select+reduce formulation was
     #    fused but compute-bound: ~34G lane-ops at S=1M; this is O(S·M).)
-    mw_cum = jnp.cumsum(
-        jnp.where(sorted_w > 0, sorted_means * sorted_w, 0.0), axis=-1)
+    mw_cum = exn.cumsum(
+        jnp.where(sorted_w > 0, sorted_means * sorted_w, 0.0))
     nxt = jnp.concatenate(
         [bucket[:, 1:], jnp.full((s, 1), -1, jnp.int32)], axis=-1)
     is_end = bucket != nxt  # last slot of each bucket run (row end included)
@@ -289,9 +297,7 @@ def add_batch(
     #        alone cost ~80% of add_batch on v5e.)
     row_total = seg_cum + suffix - sw  # per-sample total weight of its row
     q_left = (seg_cum - sw) / jnp.maximum(row_total, 1e-30)
-    bucket = jnp.clip(
-        jnp.floor(_k_scale(q_left, compression)).astype(jnp.int32), 0, c - 1
-    )
+    bucket = _k_bucket(q_left, compression, c)
     # Non-decreasing run id; padding (row k) forms its own tail runs that
     # no real row's run window reaches.
     seg_id = srows * c + bucket
@@ -385,7 +391,7 @@ def merge_many(stacked: TDigestPool, compression: float = DEFAULT_COMPRESSION
         weights=weights,
         min=jnp.min(stacked.min, axis=0),
         max=jnp.max(stacked.max, axis=0),
-        recip=jnp.sum(stacked.recip, axis=0),
+        recip=exn.tsum0(stacked.recip),
     )
 
 
@@ -417,11 +423,11 @@ def _quantile_impl(
 ) -> jax.Array:
     s, c = means.shape
     ub, count = _row_bounds(means, weights, dmax)  # [S, C], [S]
-    w_cum = jnp.cumsum(weights, axis=-1)  # [S, C]
+    w_cum = exn.cumsum(weights)  # [S, C]
     total = w_cum[:, -1]  # [S]
     lb = jnp.concatenate([dmin[:, None], ub[:, :-1]], axis=-1)  # [S, C]
 
-    target = qs[None, :] * total[:, None]  # [S, P]
+    target = exn.block(qs[None, :] * total[:, None])  # [S, P]
     # first slot whose cumulative weight reaches the target
     # (reference: q <= weightSoFar + c.Weight), then interpolate inside
     # it. Two equivalent formulations (bit-identical — pinned by
@@ -453,7 +459,7 @@ def _quantile_impl(
     lb_at = _at(lb)
     ub_at = _at(ub)
     proportion = (target - w_before) / jnp.maximum(w_at, 1e-30)
-    out = lb_at + proportion * (ub_at - lb_at)
+    out = lb_at + exn.block(proportion * (ub_at - lb_at))
     return jnp.where((total[:, None] > 0) & (count[:, None] > 0), out, jnp.nan)
 
 
@@ -513,13 +519,13 @@ def cdf(
 @jax.jit
 def row_sum(means: jax.Array, weights: jax.Array) -> jax.Array:
     """Σ mean·weight per row (reference Sum :346-353)."""
-    return jnp.sum(jnp.where(weights > 0, means * weights, 0.0), axis=-1)
+    return exn.tsum(jnp.where(weights > 0, means * weights, 0.0))
 
 
 @jax.jit
 def row_count(weights: jax.Array) -> jax.Array:
     """Total weight per row (reference Count :340-342)."""
-    return jnp.sum(weights, axis=-1)
+    return exn.tsum(weights)
 
 
 # ---------------------------------------------------------------------------

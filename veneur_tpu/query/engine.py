@@ -377,6 +377,17 @@ class QueryEngine:
             else:
                 raise ValueError(f"unknown query op: {op!r}")
             out["op"] = op
+            # device fault domain: when any worker's epoch was completed
+            # on the host fallback engine (snap.degraded — breaker open
+            # or a mid-flush device fault), every response carries the
+            # flag. The numbers are still exact (the host engine is
+            # bit-identical), but readers deserve to know the device
+            # path was out. Omitted entirely on healthy epochs.
+            epoch = self._committed
+            if epoch is not None and any(
+                    getattr(v.snap, "degraded", False)
+                    for v in epoch.views):
+                out["degraded"] = True
             self.queries_served += 1
             return out
         except Exception as exc:
